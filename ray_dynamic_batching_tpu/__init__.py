@@ -1,0 +1,28 @@
+"""ray_dynamic_batching_tpu — a TPU-native dynamic-batching inference-serving framework.
+
+A ground-up re-design (NOT a port) of the capabilities of
+milind7777/ray-dynamic-batching: SLO-aware, profile-driven multi-model serving
+("squishy bin packing", Nexus §6.1) plus the distributed substrate it rides on —
+rebuilt idiomatically for TPU on JAX/XLA/pjit/Pallas:
+
+- compiled, shape-bucketed ``jax.jit`` steps instead of eager torch forwards
+- HBM budgets + compile-cost amortization instead of CUDA-OOM backoff
+- ``jax.sharding.Mesh`` + XLA collectives over ICI instead of NCCL groups
+- a thin asyncio actor runtime + native C++ hot-path helpers instead of Ray core
+
+Layer map (mirrors SURVEY.md section 7):
+
+  utils/      config, metrics, logging, tracing            (ref: src/ray/common, util)
+  profiles/   offline batch profiler + profile tables      (ref: 293-project/profiling)
+  models/     flax model zoo with logical-axis shardings   (ref: torchvision registry)
+  ops/        pallas TPU kernels (attention etc.)          (new, TPU-first)
+  parallel/   mesh manager, TP/DP/SP shardings, ring attn  (ref: ray.util.collective)
+  engine/     queues, batching policies, replica engine    (ref: 293-project/src/scheduler.py)
+  scheduler/  squishy bin packing + live control loop      (ref: 293-project/src/nexus.py)
+  serve/      HTTP ingress, router, deployments, autoscale (ref: python/ray/serve)
+  runtime/    asyncio actors, KV store, health, chaos      (ref: src/ray/{gcs,raylet,core_worker})
+"""
+
+__version__ = "0.1.0"
+
+from ray_dynamic_batching_tpu.utils.config import RDBConfig, get_config  # noqa: F401

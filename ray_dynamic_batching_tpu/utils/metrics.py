@@ -1,0 +1,245 @@
+"""Metrics primitives: Counter / Gauge / Histogram with Prometheus exposition.
+
+TPU-native analogue of the reference's metric stack
+(``python/ray/util/metrics.py:137,187,262`` user API;
+``src/ray/stats/metric_defs.cc`` native registry;
+``python/ray/_private/metrics_agent.py:483`` Prometheus export). Pure Python,
+lock-protected, with a text exposition endpoint consumed by ``serve.ingress``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+TagMap = Tuple[Tuple[str, str], ...]
+
+
+def _tags(tags: Optional[Dict[str, str]]) -> TagMap:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        _default_registry.register(self)
+
+    def _prom_lines(self) -> Iterable[str]:  # pragma: no cover - overridden
+        return ()
+
+
+class Counter(Metric):
+    """Monotonically increasing counter (ref: util/metrics.py:137)."""
+
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[TagMap, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("Counter.inc requires value >= 0")
+        key = _tags(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(_tags(tags), 0.0)
+
+    def _prom_lines(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.description}"
+        yield f"# TYPE {self.name} counter"
+        with self._lock:
+            for tags, v in self._values.items():
+                yield f"{self.name}{_fmt_tags(tags)} {v}"
+
+
+class Gauge(Metric):
+    """Point-in-time value (ref: util/metrics.py:262)."""
+
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[TagMap, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_tags(tags)] = float(value)
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
+        self.inc(-value, tags)
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(_tags(tags), 0.0)
+
+    def _prom_lines(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.description}"
+        yield f"# TYPE {self.name} gauge"
+        with self._lock:
+            for tags, v in self._values.items():
+                yield f"{self.name}{_fmt_tags(tags)} {v}"
+
+
+DEFAULT_LATENCY_BOUNDARIES_MS = (
+    1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000
+)
+
+
+class Histogram(Metric):
+    """Cumulative-bucket histogram (ref: util/metrics.py:187)."""
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Sequence[float] = DEFAULT_LATENCY_BOUNDARIES_MS,
+        tag_keys: Sequence[str] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+        self._buckets: Dict[TagMap, list] = {}
+        self._sum: Dict[TagMap, float] = {}
+        self._count: Dict[TagMap, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags(tags)
+        idx = bisect.bisect_left(self.boundaries, value)
+        with self._lock:
+            buckets = self._buckets.setdefault(key, [0] * (len(self.boundaries) + 1))
+            buckets[idx] += 1
+            self._sum[key] = self._sum.get(key, 0.0) + value
+            self._count[key] = self._count.get(key, 0) + 1
+
+    def percentile(self, p: float, tags: Optional[Dict[str, str]] = None) -> float:
+        """Approximate percentile from bucket counts (upper bound of bucket)."""
+        key = _tags(tags)
+        with self._lock:
+            buckets = self._buckets.get(key)
+            total = self._count.get(key, 0)
+        if not buckets or total == 0:
+            return 0.0
+        target = math.ceil(total * p)
+        cum = 0
+        for i, c in enumerate(buckets):
+            cum += c
+            if cum >= target:
+                return self.boundaries[i] if i < len(self.boundaries) else float("inf")
+        return float("inf")
+
+    def _prom_lines(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.description}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            for key, buckets in self._buckets.items():
+                cum = 0
+                for b, c in zip(self.boundaries, buckets):
+                    cum += c
+                    t = key + (("le", str(b)),)
+                    yield f"{self.name}_bucket{_fmt_tags(t)} {cum}"
+                cum += buckets[-1]
+                t = key + (("le", "+Inf"),)
+                yield f"{self.name}_bucket{_fmt_tags(t)} {cum}"
+                yield f"{self.name}_sum{_fmt_tags(key)} {self._sum.get(key, 0.0)}"
+                yield f"{self.name}_count{_fmt_tags(key)} {self._count.get(key, 0)}"
+
+
+class RollingWindow:
+    """Exact rolling percentiles over the last N observations.
+
+    App-layer analogue of the reference's rolling p95/p99 queue stats
+    (``293-project/src/scheduler.py:343-372``).
+    """
+
+    def __init__(self, maxlen: int = 1000):
+        self._window: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._window.append(value)
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            data = sorted(self._window)
+        if not data:
+            return 0.0
+        idx = min(len(data) - 1, max(0, math.ceil(p * len(data)) - 1))
+        return data[idx]
+
+    def mean(self) -> float:
+        with self._lock:
+            return (sum(self._window) / len(self._window)) if self._window else 0.0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._window)
+
+
+def _escape_label(value: str) -> str:
+    # Prometheus exposition requires \\, \", \n escaping in label values.
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_tags(tags: TagMap) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in tags)
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Process-wide registry; renders the Prometheus text format."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> None:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and existing is not metric:
+                raise ValueError(
+                    f"metric {metric.name!r} already registered; reuse the "
+                    "existing instance (duplicate registration would silently "
+                    "drop the earlier metric's data from export)"
+                )
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def prometheus_text(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m._prom_lines())
+        return "\n".join(lines) + "\n"
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+_default_registry = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+def now_ms() -> float:
+    return time.monotonic() * 1000.0

@@ -1,0 +1,136 @@
+"""Lightweight span tracing with context propagation.
+
+Analogue of the reference's OpenTelemetry task/actor tracing
+(``python/ray/util/tracing/tracing_helper.py:293,326,411`` — spans injected
+around every call, context carried in task metadata via ``_DictPropagator``).
+Here spans are in-process dataclasses with dict-based propagation so they can
+cross actor mailboxes and HTTP hops; an exporter hook collects finished spans.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+# Process-unique random ids: a per-process counter would collide when spans
+# from multiple workers are aggregated by one exporter.
+def _new_span_id() -> int:
+    return random.getrandbits(63)
+
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "rdb_current_span", default=None
+)
+
+# Finished spans kept in-process are bounded; the exporter is the durable sink.
+_FINISHED_SPAN_CAP = 10_000
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: int
+    parent_id: Optional[int]
+    start_ms: float
+    end_ms: Optional[float] = None
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    def duration_ms(self) -> float:
+        return (self.end_ms or time.monotonic() * 1000.0) - self.start_ms
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self._finished: deque = deque(maxlen=_FINISHED_SPAN_CAP)
+        self._lock = threading.Lock()
+        self._exporter: Optional[Callable[[Span], None]] = None
+        self.enabled = False
+
+    def set_exporter(self, exporter: Callable[[Span], None]) -> None:
+        self._exporter = exporter
+        self.enabled = True
+
+    def reset(self) -> None:
+        """Disable tracing and drop exporter + buffered spans (test hygiene)."""
+        self._exporter = None
+        self.enabled = False
+        self.clear()
+
+    @contextmanager
+    def span(self, name: str, **attributes: Any) -> Iterator[Optional[Span]]:
+        if not self.enabled:
+            yield None
+            return
+        parent = _current_span.get()
+        s = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+            span_id=_new_span_id(),
+            parent_id=parent.span_id if parent else None,
+            start_ms=time.monotonic() * 1000.0,
+            attributes=dict(attributes),
+        )
+        token = _current_span.set(s)
+        try:
+            yield s
+        finally:
+            s.end_ms = time.monotonic() * 1000.0
+            _current_span.reset(token)
+            with self._lock:
+                self._finished.append(s)
+            if self._exporter:
+                self._exporter(s)
+
+    # --- context propagation (ref: _DictPropagator, tracing_helper.py:165) ---
+    def inject_context(self) -> Dict[str, Any]:
+        s = _current_span.get()
+        if s is None:
+            return {}
+        return {"trace_id": s.trace_id, "parent_span_id": s.span_id}
+
+    @contextmanager
+    def attach_context(self, ctx: Dict[str, Any], name: str) -> Iterator[Optional[Span]]:
+        if not self.enabled or not ctx:
+            with self.span(name):
+                yield _current_span.get()
+            return
+        s = Span(
+            name=name,
+            trace_id=ctx.get("trace_id", uuid.uuid4().hex),
+            span_id=_new_span_id(),
+            parent_id=ctx.get("parent_span_id"),
+            start_ms=time.monotonic() * 1000.0,
+        )
+        token = _current_span.set(s)
+        try:
+            yield s
+        finally:
+            s.end_ms = time.monotonic() * 1000.0
+            _current_span.reset(token)
+            with self._lock:
+                self._finished.append(s)
+            if self._exporter:
+                self._exporter(s)
+
+    def finished_spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._finished)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+
+_tracer = Tracer()
+
+
+def tracer() -> Tracer:
+    return _tracer

@@ -1,0 +1,40 @@
+"""Test harness: a fake 8-chip TPU cluster on CPU devices.
+
+Mirrors the reference's multi-node-without-a-cluster strategy
+(``python/ray/cluster_utils.py:135`` — multiple raylets as local processes):
+here the stand-in for N TPU chips is N XLA host-platform devices
+(``--xla_force_host_platform_device_count=8``), so every sharding/mesh test
+runs the real pjit/shard_map code paths without TPU hardware.
+
+Must run before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_config():
+    """Each test sees a pristine config (env-derived)."""
+    from ray_dynamic_batching_tpu.utils import config
+
+    config.reset_config()
+    yield
+    config.reset_config()
+
+
+@pytest.fixture
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 fake chips, got {len(devices)}"
+    return devices[:8]

@@ -1,0 +1,93 @@
+"""Tests for utils: config env override, metrics, tracing."""
+
+import os
+
+import pytest
+
+from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.config import RDBConfig, get_config, reset_config
+from ray_dynamic_batching_tpu.utils.tracing import tracer
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = get_config()
+        assert cfg.slo_safety_factor == 2.2
+        assert cfg.rate_change_threshold == 0.05
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("RDB_MAX_BATCH_SIZE", "256")
+        monkeypatch.setenv("RDB_SLO_SAFETY_FACTOR", "1.5")
+        monkeypatch.setenv("RDB_DISCARD_STALE_REQUESTS", "false")
+        reset_config()
+        cfg = get_config()
+        assert cfg.max_batch_size == 256
+        assert cfg.slo_safety_factor == 1.5
+        assert cfg.discard_stale_requests is False
+
+    def test_overrides_kwarg(self):
+        cfg = RDBConfig.from_env(monitoring_interval_s=1.0)
+        assert cfg.monitoring_interval_s == 1.0
+
+
+class TestMetrics:
+    def test_counter(self):
+        c = m.Counter("test_requests_total", "requests")
+        c.inc()
+        c.inc(2, tags={"model": "resnet"})
+        assert c.get() == 1
+        assert c.get({"model": "resnet"}) == 2
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge(self):
+        g = m.Gauge("test_queue_len", "queue length")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.get() == 4
+
+    def test_histogram_percentile(self):
+        h = m.Histogram("test_latency_ms", boundaries=[1, 10, 100])
+        for v in [0.5] * 90 + [50] * 9 + [500]:
+            h.observe(v)
+        assert h.percentile(0.5) == 1  # bucket upper bound
+        assert h.percentile(0.95) == 100
+        assert h.percentile(0.999) == float("inf")
+
+    def test_rolling_window(self):
+        w = m.RollingWindow(maxlen=100)
+        for i in range(1, 101):
+            w.observe(float(i))
+        assert w.percentile(0.95) == 95.0
+        assert w.mean() == 50.5
+
+    def test_prometheus_text(self):
+        c = m.Counter("test_prom_total", "desc")
+        c.inc(3, tags={"model": "a"})
+        text = m.default_registry().prometheus_text()
+        assert '# TYPE test_prom_total counter' in text
+        assert 'test_prom_total{model="a"} 3' in text
+
+
+class TestTracing:
+    @pytest.fixture(autouse=True)
+    def _reset_tracer(self):
+        yield
+        tracer().reset()
+
+    def test_spans_nest_and_propagate(self):
+        t = tracer()
+        collected = []
+        t.set_exporter(collected.append)
+        with t.span("outer") as outer:
+            ctx = t.inject_context()
+            with t.span("inner"):
+                pass
+        assert len(collected) == 2
+        inner, outer_done = collected
+        assert inner.parent_id == outer_done.span_id
+        assert inner.trace_id == outer_done.trace_id
+        # cross-process propagation
+        with t.attach_context(ctx, "remote") as remote:
+            assert remote.trace_id == outer.trace_id
