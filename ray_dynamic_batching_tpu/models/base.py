@@ -1,0 +1,149 @@
+"""Servable-model interface + registry.
+
+TPU-native replacement for the reference's in-process model registry
+(``293-project/src/scheduler.py:40-44`` — dict name→torchvision constructor) and
+its per-model SLO config (``scheduler.py:30-35``). Instead of eager torch
+modules, a servable model here is a *pure apply function* plus enough metadata
+for the profiler, the bucketing layer, and the mesh planner:
+
+- ``init`` / ``apply``: functional params + jittable forward (XLA traces once
+  per input shape bucket; no data-dependent Python control flow inside).
+- ``example_inputs``: canonical input pytree per (batch, seq) bucket — the
+  contract the profiler sweeps and the engine pads to.
+- ``sharding_rules``: regex → ``PartitionSpec`` over logical mesh axes
+  ("dp", "tp", ...) so the same model runs single-chip or pjit-sharded.
+"""
+
+from __future__ import annotations
+
+import abc
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Any  # pytree
+
+
+@dataclass(frozen=True)
+class ModelSLO:
+    """Per-model serving contract (ref: models_config, scheduler.py:30-35)."""
+
+    latency_slo_ms: float
+    # Optional per-model rate hint used by tests/load generators.
+    expected_rate_rps: float = 0.0
+
+
+class ServableModel(abc.ABC):
+    """A model the engine can profile, bucket, schedule, and execute."""
+
+    #: registry key, e.g. "resnet50"
+    name: str = "unnamed"
+    #: "vision" | "text_classifier" | "causal_lm" | "asr"
+    family: str = "vision"
+
+    def __init__(self, dtype: jnp.dtype = jnp.bfloat16):
+        self.dtype = dtype
+
+    # --- functional core -------------------------------------------------
+    @abc.abstractmethod
+    def init(self, rng: jax.Array) -> Params:
+        """Initialize parameters (and any constant state, e.g. BN stats)."""
+
+    @abc.abstractmethod
+    def apply(self, params: Params, *inputs: jax.Array) -> Any:
+        """Pure forward pass; must be jittable with static shapes."""
+
+    # --- shape contract --------------------------------------------------
+    @abc.abstractmethod
+    def example_inputs(
+        self, batch_size: int, seq_len: Optional[int] = None
+    ) -> Tuple[jax.Array, ...]:
+        """Canonical zero inputs for a (batch, seq) bucket."""
+
+    def input_shapes(
+        self, batch_size: int, seq_len: Optional[int] = None
+    ) -> Tuple[jax.ShapeDtypeStruct, ...]:
+        return tuple(
+            jax.ShapeDtypeStruct(x.shape, x.dtype)
+            for x in jax.eval_shape(lambda: self.example_inputs(batch_size, seq_len))
+        )
+
+    # --- planning metadata ----------------------------------------------
+    def flops_per_sample(self, seq_len: Optional[int] = None) -> float:
+        """Rough forward FLOPs per sample (for roofline sanity checks)."""
+        return 0.0
+
+    def param_count(self, params: Params) -> int:
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+    def param_bytes(self, params: Params) -> int:
+        return sum(
+            int(x.size) * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
+        )
+
+    # --- distribution ----------------------------------------------------
+    def sharding_rules(self) -> List[Tuple[str, P]]:
+        """(param-path regex, PartitionSpec over logical axes) — first match wins.
+
+        Logical axis names: "tp" (tensor-parallel), "dp" (data/replica),
+        "sp" (sequence). Unmatched params replicate.
+        """
+        return []
+
+    def partition_spec_for(self, path: str) -> P:
+        for pattern, spec in self.sharding_rules():
+            if re.search(pattern, path):
+                return spec
+        return P()
+
+
+def param_path_specs(model: ServableModel, params: Params) -> Any:
+    """Map every param leaf to its PartitionSpec via the model's rules."""
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    specs = []
+    for path, _leaf in flat:
+        path_str = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        specs.append(model.partition_spec_for(path_str))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+# --- registry (ref: model_registry, 293-project/src/scheduler.py:40-44) ---
+
+_MODEL_REGISTRY: Dict[str, Callable[..., ServableModel]] = {}
+_MODEL_SLOS: Dict[str, ModelSLO] = {}
+
+
+def register_model(
+    name: str, slo: Optional[ModelSLO] = None
+) -> Callable[[Callable[..., ServableModel]], Callable[..., ServableModel]]:
+    def deco(factory: Callable[..., ServableModel]) -> Callable[..., ServableModel]:
+        _MODEL_REGISTRY[name] = factory
+        if slo is not None:
+            _MODEL_SLOS[name] = slo
+        return factory
+
+    return deco
+
+
+def get_model(name: str, **kwargs: Any) -> ServableModel:
+    if name not in _MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; registered: {sorted(_MODEL_REGISTRY)}"
+        )
+    return _MODEL_REGISTRY[name](**kwargs)
+
+
+def get_slo(name: str) -> Optional[ModelSLO]:
+    return _MODEL_SLOS.get(name)
+
+
+def registered_models() -> List[str]:
+    return sorted(_MODEL_REGISTRY)

@@ -1,0 +1,208 @@
+"""Causal-LM servable models (GPT-2 family, Llama family) on the shared decoder.
+
+BASELINE.json configs 3-4: "GPT-2-medium autoregressive decode (KV-cache,
+continuous batching)" and "Llama-3-8B TP=4 over ICI (pjit-sharded replica)".
+The engine drives these through two compiled programs — ``prefill`` (one per
+(batch, seq) bucket) and ``decode_step`` (one per batch-slot count) — with the
+KV cache donated between steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_dynamic_batching_tpu.models.base import (
+    ModelSLO,
+    ServableModel,
+    register_model,
+)
+from ray_dynamic_batching_tpu.models.decoder import (
+    DecoderConfig,
+    DecoderModule,
+    KVCache,
+    decode_mask,
+    prefill_mask,
+)
+
+
+class CausalLM(ServableModel):
+    family = "causal_lm"
+
+    def __init__(
+        self,
+        cfg: DecoderConfig,
+        name: str,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ):
+        super().__init__(dtype)
+        self.name = name
+        self.cfg = cfg
+        self.module = DecoderModule(cfg, dtype=dtype)
+
+    # --- ServableModel interface (apply == prefill logits for profiling) ---
+    def init(self, rng: jax.Array):
+        tokens, attn_mask = self.example_inputs(1, 8)
+        positions = jnp.arange(8)[None, :]
+        mask = prefill_mask(attn_mask)
+        return self.module.init(rng, tokens, positions, mask)
+
+    def apply(self, params, tokens: jax.Array, attn_mask: jax.Array) -> jax.Array:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1])[None, :], tokens.shape
+        )
+        logits, _ = self.module.apply(
+            params, tokens, positions, prefill_mask(attn_mask)
+        )
+        return logits
+
+    def example_inputs(self, batch_size: int, seq_len: Optional[int] = None):
+        T = seq_len or 128
+        return (
+            jnp.zeros((batch_size, T), dtype=jnp.int32),
+            jnp.ones((batch_size, T), dtype=jnp.int32),
+        )
+
+    # --- decode interface (used by engine.decode) -------------------------
+    def make_cache(
+        self, batch_size: int, max_len: Optional[int] = None
+    ) -> KVCache:
+        return KVCache.zeros(self.cfg, batch_size, max_len, dtype=self.dtype)
+
+    def prefill(
+        self, params, tokens: jax.Array, attn_mask: jax.Array, cache: KVCache
+    ) -> Tuple[jax.Array, KVCache]:
+        """Run the prompt through the model, filling the cache.
+
+        tokens [B, T] right-padded; attn_mask [B, T]. Returns last-valid-token
+        logits [B, V] and the cache with ``lengths`` set per row.
+        """
+        B, T = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        lengths = attn_mask.sum(axis=1).astype(jnp.int32)
+        # Queries may attend causally within the prompt; cache positions
+        # beyond T are empty, mask them off.
+        S = cache.capacity
+        base = prefill_mask(attn_mask)  # [B,1,T,T]
+        if S > T:
+            pad = jnp.zeros((B, 1, T, S - T), dtype=bool)
+            mask = jnp.concatenate([base, pad], axis=-1)
+        else:
+            mask = base[..., :S]
+        logits, new_cache = self.module.apply(params, tokens, positions, mask, cache)
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]
+        return last, new_cache.replace(lengths=lengths)
+
+    def decode_step(
+        self,
+        params,
+        tokens: jax.Array,   # [B, 1] current token per slot
+        cache: KVCache,
+        active: jax.Array,   # [B] bool — which slots advance
+    ) -> Tuple[jax.Array, KVCache]:
+        """One decode step for all slots; returns logits [B, V] + new cache."""
+        positions = cache.lengths[:, None]
+        mask = decode_mask(cache.lengths, cache.capacity)
+        logits, new_cache = self.module.apply(params, tokens, positions, mask, cache)
+        new_lengths = cache.lengths + active.astype(jnp.int32)
+        return logits[:, 0], new_cache.replace(lengths=new_lengths)
+
+    # --- planning ---------------------------------------------------------
+    def flops_per_sample(self, seq_len: Optional[int] = None) -> float:
+        T = seq_len or 128
+        c = self.cfg
+        per_tok = 2 * (
+            c.d_model * c.head_dim * (c.num_heads + 2 * c.num_kv_heads)
+            + c.num_heads * c.head_dim * c.d_model
+            + (3 if c.gated_mlp else 2) * c.d_model * c.mlp_dim
+        )
+        attn = 4 * T * c.d_model  # score+value flops per token, avg T/2 ctx * 2
+        return c.num_layers * (per_tok + attn) * T + 2 * c.d_model * c.vocab_size * T
+
+    def kv_bytes_per_slot(self, max_len: Optional[int] = None) -> int:
+        c = self.cfg
+        S = max_len or c.max_seq_len
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return 2 * c.num_layers * S * c.num_kv_heads * c.head_dim * itemsize
+
+    def sharding_rules(self):
+        return [
+            (r"/q/kernel", P(None, "tp", None)),
+            (r"/k/kernel", P(None, "tp", None)),
+            (r"/v/kernel", P(None, "tp", None)),
+            (r"/o/kernel", P("tp", None, None)),
+            (r"mlp_gate/kernel", P(None, "tp")),
+            (r"mlp_up/kernel", P(None, "tp")),
+            (r"mlp_down/kernel", P("tp", None)),
+            (r"tok_embed/embedding", P("tp", None)),
+            (r"lm_head/kernel", P(None, "tp")),
+        ]
+
+    def cache_pspec(self) -> KVCache:
+        """PartitionSpecs for the KV cache (kv heads sharded over tp)."""
+        return KVCache(
+            k=P(None, None, None, "tp", None),   # type: ignore[arg-type]
+            v=P(None, None, None, "tp", None),   # type: ignore[arg-type]
+            lengths=P(None),                      # type: ignore[arg-type]
+        )
+
+
+GPT2_MEDIUM = DecoderConfig(
+    vocab_size=50257,
+    d_model=1024,
+    num_layers=24,
+    num_heads=16,
+    num_kv_heads=16,
+    mlp_dim=4096,
+    max_seq_len=1024,
+    pos="learned",
+    norm="ln",
+    gated_mlp=False,
+    use_bias=True,
+    tie_embeddings=True,
+)
+
+LLAMA3_8B = DecoderConfig(
+    vocab_size=128256,
+    d_model=4096,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    mlp_dim=14336,
+    max_seq_len=8192,
+    pos="rope",
+    norm="rms",
+    gated_mlp=True,
+    use_bias=False,
+    rope_theta=500000.0,
+)
+
+TINY_LM = DecoderConfig(
+    vocab_size=512,
+    d_model=64,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    mlp_dim=128,
+    max_seq_len=256,
+)
+
+
+@register_model("gpt2_medium", slo=ModelSLO(latency_slo_ms=500.0))
+def _gpt2_medium(**kwargs) -> CausalLM:
+    return CausalLM(GPT2_MEDIUM, name="gpt2_medium", **kwargs)
+
+
+@register_model("llama3_8b", slo=ModelSLO(latency_slo_ms=150.0))
+def _llama3_8b(**kwargs) -> CausalLM:
+    return CausalLM(LLAMA3_8B, name="llama3_8b", **kwargs)
+
+
+@register_model("llama_tiny")
+def _llama_tiny(**kwargs) -> CausalLM:
+    return CausalLM(TINY_LM, name="llama_tiny", **kwargs)

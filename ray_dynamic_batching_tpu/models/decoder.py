@@ -1,0 +1,258 @@
+"""Shared causal-decoder transformer with explicit functional KV cache.
+
+New capability relative to the reference (which serves single-shot vision
+models — SURVEY.md section 7 stage 7): autoregressive decode for the
+BASELINE.json GPT-2/Llama configs. TPU-first design decisions:
+
+- The KV cache is an explicit pytree argument returned updated from every
+  step, so the engine can ``jit(..., donate_argnums=...)`` and XLA updates it
+  in place in HBM (no realloc per token).
+- Fixed-capacity caches + scatter-at-``lengths`` writes keep every shape
+  static; continuous batching varies *contents*, never shapes, so one compiled
+  program serves the whole decode stream.
+- Attention flows through :mod:`ops.attention` (Pallas-fused on TPU).
+- GQA (``num_kv_heads < num_heads``) shrinks cache HBM traffic — the decode
+  bottleneck is HBM bandwidth, not MXU FLOPs.
+
+One config-driven module covers both model families (learned-pos/LN/GeLU for
+GPT-2; RoPE/RMSNorm/gated-SiLU/GQA for Llama).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.struct import dataclass as pytree_dataclass
+
+from ray_dynamic_batching_tpu.ops import attention as attn_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int
+    d_model: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    mlp_dim: int
+    max_seq_len: int = 2048
+    pos: str = "rope"  # "rope" | "learned"
+    norm: str = "rms"  # "rms" | "ln"
+    gated_mlp: bool = True  # SwiGLU vs plain GeLU MLP
+    use_bias: bool = False
+    tie_embeddings: bool = False
+    rope_theta: float = 10000.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+@pytree_dataclass
+class KVCache:
+    """Per-model cache: k/v [L, B, S, K, H]; lengths [B] = valid prefix."""
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+    @staticmethod
+    def zeros(
+        cfg: DecoderConfig, batch_size: int, max_len: Optional[int] = None,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "KVCache":
+        S = max_len or cfg.max_seq_len
+        shape = (cfg.num_layers, batch_size, S, cfg.num_kv_heads, cfg.head_dim)
+        return KVCache(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            lengths=jnp.zeros((batch_size,), dtype=jnp.int32),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float = 10000.0
+) -> jax.Array:
+    """Rotary embedding. x [B, T, N, H], positions [B, T]."""
+    H = x.shape[-1]
+    half = H // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class DecoderLayer(nn.Module):
+    cfg: DecoderConfig
+    dtype: Any = jnp.bfloat16
+
+    def _norm(self, name: str):
+        if self.cfg.norm == "rms":
+            return RMSNorm(name=name)
+        return nn.LayerNorm(dtype=jnp.float32, name=name)
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,               # [B, T, D]
+        positions: jax.Array,       # [B, T]
+        mask: jax.Array,            # [B, 1, T, S_attended] True = attend
+        layer_cache: Optional[Tuple[jax.Array, jax.Array]] = None,  # k/v [B,S,K,H]
+    ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
+        cfg = self.cfg
+        dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
+            feats,
+            axis=axis,
+            use_bias=cfg.use_bias,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
+        y = self._norm("attn_norm")(x).astype(self.dtype)
+        q = dense((cfg.num_heads, cfg.head_dim), "q")(y)
+        k = dense((cfg.num_kv_heads, cfg.head_dim), "k")(y)
+        v = dense((cfg.num_kv_heads, cfg.head_dim), "v")(y)
+        if cfg.pos == "rope":
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
+
+        if layer_cache is not None:
+            k_cache, v_cache = layer_cache
+            B, T = positions.shape
+            if T == 1:
+                # Decode: scatter this token's k/v at its row position.
+                idx = positions[:, 0]
+                rows = jnp.arange(B)
+                k_cache = k_cache.at[rows, idx].set(k[:, 0])
+                v_cache = v_cache.at[rows, idx].set(v[:, 0])
+            else:
+                # Prefill into an empty cache: contiguous write at offset 0.
+                k_cache = jax.lax.dynamic_update_slice(
+                    k_cache, k, (0, 0, 0, 0)
+                )
+                v_cache = jax.lax.dynamic_update_slice(
+                    v_cache, v, (0, 0, 0, 0)
+                )
+            attn_out = attn_ops.dot_product_attention(q, k_cache, v_cache, mask=mask)
+            new_cache = (k_cache, v_cache)
+        else:
+            attn_out = attn_ops.dot_product_attention(q, k, v, mask=mask)
+            new_cache = None
+
+        attn_out = dense(cfg.d_model, "o", axis=(-2, -1))(attn_out)
+        x = x + attn_out
+
+        y = self._norm("mlp_norm")(x).astype(self.dtype)
+        if cfg.gated_mlp:
+            gate = dense(cfg.mlp_dim, "mlp_gate")(y)
+            up = dense(cfg.mlp_dim, "mlp_up")(y)
+            y = nn.silu(gate) * up
+        else:
+            y = nn.gelu(dense(cfg.mlp_dim, "mlp_up")(y))
+        y = dense(cfg.d_model, "mlp_down")(y)
+        return x + y, new_cache
+
+
+class DecoderModule(nn.Module):
+    cfg: DecoderConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,          # [B, T]
+        positions: jax.Array,       # [B, T]
+        mask: jax.Array,            # [B, 1, T, S]
+        cache: Optional[KVCache] = None,
+    ) -> Tuple[jax.Array, Optional[KVCache]]:
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="tok_embed",
+        )
+        x = embed(tokens)
+        if cfg.pos == "learned":
+            pos_embed = nn.Embed(
+                cfg.max_seq_len,
+                cfg.d_model,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name="pos_embed",
+            )
+            x = x + pos_embed(positions)
+
+        new_k, new_v = [], []
+        for i in range(cfg.num_layers):
+            layer_cache = (
+                (cache.k[i], cache.v[i]) if cache is not None else None
+            )
+            x, updated = DecoderLayer(cfg, dtype=self.dtype, name=f"layer{i}")(
+                x, positions, mask, layer_cache
+            )
+            if updated is not None:
+                new_k.append(updated[0])
+                new_v.append(updated[1])
+
+        if cfg.norm == "rms":
+            x = RMSNorm(name="final_norm")(x)
+        else:
+            x = nn.LayerNorm(dtype=jnp.float32, name="final_norm")(x)
+
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size,
+                use_bias=False,
+                dtype=jnp.float32,
+                param_dtype=jnp.float32,
+                name="lm_head",
+            )(x)
+
+        out_cache = None
+        if cache is not None:
+            out_cache = KVCache(
+                k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+            )
+        return logits, out_cache
+
+
+def prefill_mask(attn_mask: jax.Array) -> jax.Array:
+    """Causal mask limited to valid tokens. attn_mask [B, T] -> [B, 1, T, T]."""
+    T = attn_mask.shape[1]
+    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+    valid = attn_mask[:, None, None, :].astype(bool)
+    return causal[None, None, :, :] & valid
+
+
+def decode_mask(lengths: jax.Array, capacity: int) -> jax.Array:
+    """Attend to positions [0, lengths] inclusive. lengths [B] -> [B,1,1,S]."""
+    pos = jnp.arange(capacity)[None, None, None, :]
+    return pos <= lengths[:, None, None, None]

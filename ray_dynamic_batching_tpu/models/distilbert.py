@@ -1,0 +1,163 @@
+"""DistilBERT-style text classifier (flax linen, bf16) — the CPU smoke config.
+
+BASELINE.json config 1 ("DistilBERT SST-2 classifier, single replica"): a
+6-layer encoder with learned positions and a 2-way classification head. Serves
+as the minimum end-to-end slice (SURVEY.md section 7 stage 2). Sequence inputs
+are bucket-padded by the engine; the attention mask keeps padding inert.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_dynamic_batching_tpu.models.base import (
+    ModelSLO,
+    ServableModel,
+    register_model,
+)
+from ray_dynamic_batching_tpu.ops import attention as attn_ops
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        D = x.shape[-1]
+        H = D // self.num_heads
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, H),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = attn_ops.dot_product_attention(q, k, v, mask=mask)
+        o = nn.DenseGeneral(
+            D, axis=(-2, -1), dtype=self.dtype, param_dtype=jnp.float32, name="proj"
+        )(o)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + o).astype(self.dtype)
+        y = nn.Dense(
+            self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32, name="mlp_in"
+        )(x)
+        y = nn.gelu(y)
+        y = nn.Dense(D, dtype=self.dtype, param_dtype=jnp.float32, name="mlp_out")(y)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + y).astype(self.dtype)
+
+
+class DistilBertModule(nn.Module):
+    vocab_size: int = 30522
+    max_len: int = 512
+    hidden_dim: int = 768
+    num_layers: int = 6
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, token_ids: jax.Array, attn_mask: jax.Array) -> jax.Array:
+        B, T = token_ids.shape
+        tok = nn.Embed(
+            self.vocab_size,
+            self.hidden_dim,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="tok_embed",
+        )(token_ids)
+        pos = nn.Embed(
+            self.max_len,
+            self.hidden_dim,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="pos_embed",
+        )(jnp.arange(T)[None, :])
+        x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(tok + pos).astype(
+            self.dtype
+        )
+        # [B, 1, Tq, Tk] — keys at padding positions are masked out.
+        mask = attn_mask[:, None, None, :].astype(bool)
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                name=f"layer{i}",
+            )(x, mask)
+        cls = x[:, 0]
+        h = nn.Dense(
+            self.hidden_dim, dtype=self.dtype, param_dtype=jnp.float32, name="pre_head"
+        )(cls)
+        h = nn.relu(h)
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32, name="head"
+        )(h)
+
+
+class DistilBert(ServableModel):
+    family = "text_classifier"
+
+    def __init__(
+        self,
+        dtype: jnp.dtype = jnp.bfloat16,
+        name: str = "distilbert_sst2",
+        **module_kwargs: Any,
+    ):
+        super().__init__(dtype)
+        self.name = name
+        self.module = DistilBertModule(dtype=dtype, **module_kwargs)
+
+    def init(self, rng: jax.Array):
+        return self.module.init(rng, *self.example_inputs(1, 16))
+
+    def apply(self, params, token_ids: jax.Array, attn_mask: jax.Array) -> jax.Array:
+        return self.module.apply(params, token_ids, attn_mask)
+
+    def example_inputs(self, batch_size: int, seq_len: Optional[int] = None):
+        T = seq_len or 128
+        return (
+            jnp.zeros((batch_size, T), dtype=jnp.int32),
+            jnp.ones((batch_size, T), dtype=jnp.int32),
+        )
+
+    def flops_per_sample(self, seq_len: Optional[int] = None) -> float:
+        T = seq_len or 128
+        d, m = self.module.hidden_dim, self.module.mlp_dim
+        per_layer = 4 * T * d * d + 2 * T * T * d + 2 * T * d * m
+        return 2.0 * self.module.num_layers * per_layer
+
+    def sharding_rules(self):
+        # DenseGeneral((3, N, H)) kernel is [D, 3, N, H]: shard the heads axis.
+        return [
+            (r"qkv/kernel", P(None, None, "tp", None)),
+            (r"proj/kernel", P("tp", None, None)),
+            (r"mlp_in/kernel", P(None, "tp")),
+            (r"mlp_out/kernel", P("tp", None)),
+            (r"tok_embed/embedding", P(None, "tp")),
+        ]
+
+
+@register_model("distilbert_sst2", slo=ModelSLO(latency_slo_ms=100.0))
+def _distilbert(**kwargs) -> DistilBert:
+    return DistilBert(**kwargs)
+
+
+@register_model("distilbert_tiny")
+def _distilbert_tiny(**kwargs) -> DistilBert:
+    return DistilBert(
+        name="distilbert_tiny",
+        vocab_size=1000,
+        max_len=128,
+        hidden_dim=64,
+        num_layers=2,
+        num_heads=4,
+        mlp_dim=128,
+        **kwargs,
+    )

@@ -1,0 +1,214 @@
+"""EfficientNetV2-style CNN for TPU inference (flax linen, NHWC, bf16).
+
+Capability parity with the reference's ``efficientnet`` registry entry
+(``293-project/src/scheduler.py:40-44``; profiled in
+``293-project/profiling/efficientnetv2_20241123_125206_report.txt``).
+Implements the V2-S topology: fused-MBConv stages (3x3 conv replaces
+expand+depthwise — better for the MXU) followed by MBConv stages with
+squeeze-excite.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_dynamic_batching_tpu.models.base import (
+    ModelSLO,
+    ServableModel,
+    register_model,
+)
+
+# (block_type, expand, channels, repeats, stride, use_se)
+V2_S_STAGES: Tuple[Tuple[str, int, int, int, int, bool], ...] = (
+    ("fused", 1, 24, 2, 1, False),
+    ("fused", 4, 48, 4, 2, False),
+    ("fused", 4, 64, 4, 2, False),
+    ("mbconv", 4, 128, 6, 2, True),
+    ("mbconv", 6, 160, 9, 1, True),
+    ("mbconv", 6, 256, 15, 2, True),
+)
+
+TINY_STAGES: Tuple[Tuple[str, int, int, int, int, bool], ...] = (
+    ("fused", 1, 16, 1, 1, False),
+    ("fused", 2, 32, 1, 2, False),
+    ("mbconv", 2, 64, 1, 2, True),
+)
+
+
+class SqueezeExcite(nn.Module):
+    reduce_to: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        C = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(self.reduce_to, (1, 1), dtype=self.dtype, param_dtype=jnp.float32, name="reduce")(s)
+        s = nn.silu(s)
+        s = nn.Conv(C, (1, 1), dtype=self.dtype, param_dtype=jnp.float32, name="expand")(s)
+        return x * nn.sigmoid(s)
+
+
+class MBConv(nn.Module):
+    block_type: str  # "fused" | "mbconv"
+    expand: int
+    out_channels: int
+    stride: int
+    use_se: bool
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=True,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32)
+        in_c = x.shape[-1]
+        mid = in_c * self.expand
+        residual = x
+        if self.block_type == "fused":
+            y = conv(mid, (3, 3), strides=(self.stride, self.stride), name="fused_conv")(x)
+            y = nn.silu(norm(name="fused_bn")(y))
+            if self.expand != 1:
+                y = conv(self.out_channels, (1, 1), name="project")(y)
+                y = norm(name="project_bn")(y)
+            else:
+                y = conv(self.out_channels, (1, 1), name="project")(y) if self.out_channels != mid else y
+        else:
+            y = conv(mid, (1, 1), name="expand_conv")(x)
+            y = nn.silu(norm(name="expand_bn")(y))
+            y = conv(
+                mid,
+                (3, 3),
+                strides=(self.stride, self.stride),
+                feature_group_count=mid,
+                name="dw_conv",
+            )(y)
+            y = nn.silu(norm(name="dw_bn")(y))
+            if self.use_se:
+                y = SqueezeExcite(max(1, in_c // 4), dtype=self.dtype, name="se")(y)
+            y = conv(self.out_channels, (1, 1), name="project")(y)
+            y = norm(name="project_bn")(y)
+        if self.stride == 1 and in_c == self.out_channels:
+            y = y + residual
+        return y
+
+
+class EfficientNetV2Module(nn.Module):
+    stages: Tuple[Tuple[str, int, int, int, int, bool], ...] = V2_S_STAGES
+    stem_channels: int = 24
+    final_channels: int = 1280
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.stem_channels,
+            (3, 3),
+            strides=(2, 2),
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="stem_conv",
+        )(x)
+        x = nn.silu(
+            nn.BatchNorm(
+                use_running_average=True,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name="stem_bn",
+            )(x)
+        )
+        for s, (btype, expand, channels, repeats, stride, use_se) in enumerate(
+            self.stages
+        ):
+            for i in range(repeats):
+                x = MBConv(
+                    block_type=btype,
+                    expand=expand,
+                    out_channels=channels,
+                    stride=stride if i == 0 else 1,
+                    use_se=use_se,
+                    dtype=self.dtype,
+                    name=f"stage{s}_block{i}",
+                )(x)
+        x = nn.Conv(
+            self.final_channels,
+            (1, 1),
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="final_conv",
+        )(x)
+        x = nn.silu(
+            nn.BatchNorm(
+                use_running_average=True,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name="final_bn",
+            )(x)
+        )
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32, name="head"
+        )(x)
+
+
+class EfficientNetV2(ServableModel):
+    family = "vision"
+
+    def __init__(
+        self,
+        image_size: int = 384,
+        dtype: jnp.dtype = jnp.bfloat16,
+        name: str = "efficientnet_v2s",
+        **module_kwargs: Any,
+    ):
+        super().__init__(dtype)
+        self.name = name
+        self.image_size = image_size
+        self.module = EfficientNetV2Module(dtype=dtype, **module_kwargs)
+
+    def init(self, rng: jax.Array):
+        return self.module.init(rng, self.example_inputs(1)[0])
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        return self.module.apply(params, x)
+
+    def example_inputs(self, batch_size: int, seq_len: Optional[int] = None):
+        return (
+            jnp.zeros(
+                (batch_size, self.image_size, self.image_size, 3), dtype=self.dtype
+            ),
+        )
+
+    def flops_per_sample(self, seq_len: Optional[int] = None) -> float:
+        return 8.8e9 * 2  # ~8.8 GMACs for V2-S @ 384
+
+
+@register_model("efficientnet_v2s", slo=ModelSLO(latency_slo_ms=40.0))
+def _efficientnet(**kwargs) -> EfficientNetV2:
+    return EfficientNetV2(**kwargs)
+
+
+@register_model("efficientnet_tiny")
+def _efficientnet_tiny(**kwargs) -> EfficientNetV2:
+    kwargs.setdefault("image_size", 32)
+    return EfficientNetV2(
+        name="efficientnet_tiny",
+        stages=TINY_STAGES,
+        stem_channels=8,
+        final_channels=64,
+        num_classes=10,
+        **kwargs,
+    )

@@ -1,0 +1,18 @@
+"""Import-all registry front door (ref: model_registry, scheduler.py:40-44)."""
+
+from ray_dynamic_batching_tpu.models import (  # noqa: F401
+    causal_lm,
+    distilbert,
+    efficientnet,
+    resnet,
+    shufflenet,
+    vit,
+)
+from ray_dynamic_batching_tpu.models.base import (  # noqa: F401
+    ModelSLO,
+    ServableModel,
+    get_model,
+    get_slo,
+    param_path_specs,
+    registered_models,
+)

@@ -1,0 +1,161 @@
+"""ResNet for TPU inference (flax linen, bf16, NHWC).
+
+Re-creates the capability of the reference's ``resnet50`` registry entry
+(``293-project/src/scheduler.py:40-44`` loads torchvision resnet50 onto
+``cuda:0``). Built TPU-first: NHWC layout (XLA's preferred conv layout on TPU),
+bfloat16 compute with float32 BN statistics, inference-mode BN folded into
+running averages, and a purely functional apply so every batch bucket compiles
+to one fused XLA program on the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_dynamic_batching_tpu.models.base import (
+    ModelSLO,
+    ServableModel,
+    register_model,
+)
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        needs_proj = x.shape[-1] != self.features * 4 or self.strides != 1
+        residual = x
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=True,
+            momentum=0.9,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
+        )
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(
+            self.features, (3, 3), strides=(self.strides, self.strides), name="conv2"
+        )(y)
+        y = norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3")(y)
+        if needs_proj:
+            residual = conv(
+                self.features * 4,
+                (1, 1),
+                strides=(self.strides, self.strides),
+                name="proj_conv",
+            )(x)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetModule(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width,
+            (7, 7),
+            strides=(2, 2),
+            padding=[(3, 3), (3, 3)],
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="stem_conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=True,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="stem_bn",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(
+                    features=self.width * (2**i),
+                    strides=strides,
+                    dtype=self.dtype,
+                    name=f"stage{i}_block{j}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="head"
+        )(x)
+        return x.astype(jnp.float32)
+
+
+class ResNet(ServableModel):
+    family = "vision"
+
+    def __init__(
+        self,
+        stage_sizes: Sequence[int] = (3, 4, 6, 3),
+        num_classes: int = 1000,
+        image_size: int = 224,
+        width: int = 64,
+        dtype: jnp.dtype = jnp.bfloat16,
+        name: str = "resnet50",
+    ):
+        super().__init__(dtype)
+        self.name = name
+        self.image_size = image_size
+        self.module = ResNetModule(
+            stage_sizes=stage_sizes,
+            num_classes=num_classes,
+            width=width,
+            dtype=dtype,
+        )
+
+    def init(self, rng: jax.Array):
+        x = self.example_inputs(1)[0]
+        return self.module.init(rng, x)
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        return self.module.apply(params, x)
+
+    def example_inputs(self, batch_size: int, seq_len: Optional[int] = None):
+        return (
+            jnp.zeros(
+                (batch_size, self.image_size, self.image_size, 3), dtype=self.dtype
+            ),
+        )
+
+    def flops_per_sample(self, seq_len: Optional[int] = None) -> float:
+        return 4.1e9 * 2  # ~4.1 GMACs for ResNet-50 @ 224
+
+
+@register_model("resnet50", slo=ModelSLO(latency_slo_ms=2000.0))
+def _resnet50(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), name="resnet50", **kwargs)
+
+
+@register_model("resnet18_tiny")
+def _resnet_tiny(**kwargs) -> ResNet:
+    """Small config for CPU tests (stride-identical topology, 1/8 width)."""
+    kwargs.setdefault("image_size", 32)
+    kwargs.setdefault("width", 8)
+    kwargs.setdefault("num_classes", 10)
+    return ResNet(stage_sizes=(1, 1, 1, 1), name="resnet18_tiny", **kwargs)

@@ -1,0 +1,190 @@
+"""ShuffleNet-v2 for TPU inference (flax linen, NHWC, bf16).
+
+Capability parity with the reference's ``shufflenet_v2`` registry entry
+(``293-project/src/scheduler.py:40-44``; profiled in
+``293-project/profiling/shufflenet_20241123_104115_report.txt``). The channel
+shuffle is a reshape/transpose pair, which XLA fuses into the surrounding
+convs; depthwise convs use ``feature_group_count`` so they lower to TPU's
+native grouped-conv path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_dynamic_batching_tpu.models.base import (
+    ModelSLO,
+    ServableModel,
+    register_model,
+)
+
+
+def channel_shuffle(x: jax.Array, groups: int = 2) -> jax.Array:
+    B, H, W, C = x.shape
+    x = x.reshape(B, H, W, groups, C // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(B, H, W, C)
+
+
+class ShuffleUnit(nn.Module):
+    out_channels: int
+    downsample: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=True,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
+        )
+        branch_c = self.out_channels // 2
+        if self.downsample:
+            # left branch: 3x3 dw stride 2 + 1x1
+            left = conv(
+                x.shape[-1],
+                (3, 3),
+                strides=(2, 2),
+                feature_group_count=x.shape[-1],
+                name="left_dw",
+            )(x)
+            left = norm(name="left_dw_bn")(left)
+            left = conv(branch_c, (1, 1), name="left_pw")(left)
+            left = nn.relu(norm(name="left_pw_bn")(left))
+            right_in = x
+        else:
+            left, right_in = jnp.split(x, 2, axis=-1)
+        stride = 2 if self.downsample else 1
+        right = conv(branch_c, (1, 1), name="right_pw1")(right_in)
+        right = nn.relu(norm(name="right_pw1_bn")(right))
+        right = conv(
+            branch_c,
+            (3, 3),
+            strides=(stride, stride),
+            feature_group_count=branch_c,
+            name="right_dw",
+        )(right)
+        right = norm(name="right_dw_bn")(right)
+        right = conv(branch_c, (1, 1), name="right_pw2")(right)
+        right = nn.relu(norm(name="right_pw2_bn")(right))
+        return channel_shuffle(jnp.concatenate([left, right], axis=-1))
+
+
+class ShuffleNetV2Module(nn.Module):
+    stage_repeats: Sequence[int] = (4, 8, 4)
+    stage_channels: Sequence[int] = (116, 232, 464)
+    final_channels: int = 1024
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            24,
+            (3, 3),
+            strides=(2, 2),
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="stem_conv",
+        )(x)
+        x = nn.relu(
+            nn.BatchNorm(
+                use_running_average=True,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name="stem_bn",
+            )(x)
+        )
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for s, (repeats, channels) in enumerate(
+            zip(self.stage_repeats, self.stage_channels)
+        ):
+            x = ShuffleUnit(
+                channels, downsample=True, dtype=self.dtype, name=f"stage{s}_down"
+            )(x)
+            for i in range(repeats - 1):
+                x = ShuffleUnit(
+                    channels, dtype=self.dtype, name=f"stage{s}_unit{i}"
+                )(x)
+        x = nn.Conv(
+            self.final_channels,
+            (1, 1),
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="final_conv",
+        )(x)
+        x = nn.relu(
+            nn.BatchNorm(
+                use_running_average=True,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name="final_bn",
+            )(x)
+        )
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="head"
+        )(x)
+        return x.astype(jnp.float32)
+
+
+class ShuffleNetV2(ServableModel):
+    family = "vision"
+
+    def __init__(
+        self,
+        image_size: int = 224,
+        dtype: jnp.dtype = jnp.bfloat16,
+        name: str = "shufflenet_v2",
+        **module_kwargs: Any,
+    ):
+        super().__init__(dtype)
+        self.name = name
+        self.image_size = image_size
+        self.module = ShuffleNetV2Module(dtype=dtype, **module_kwargs)
+
+    def init(self, rng: jax.Array):
+        return self.module.init(rng, self.example_inputs(1)[0])
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        return self.module.apply(params, x)
+
+    def example_inputs(self, batch_size: int, seq_len: Optional[int] = None):
+        return (
+            jnp.zeros(
+                (batch_size, self.image_size, self.image_size, 3), dtype=self.dtype
+            ),
+        )
+
+    def flops_per_sample(self, seq_len: Optional[int] = None) -> float:
+        return 146e6 * 2  # ~146 MMACs for 1.0x @ 224
+
+
+@register_model("shufflenet_v2", slo=ModelSLO(latency_slo_ms=1500.0))
+def _shufflenet(**kwargs) -> ShuffleNetV2:
+    return ShuffleNetV2(name="shufflenet_v2", **kwargs)
+
+
+@register_model("shufflenet_tiny")
+def _shufflenet_tiny(**kwargs) -> ShuffleNetV2:
+    kwargs.setdefault("image_size", 32)
+    return ShuffleNetV2(
+        name="shufflenet_tiny",
+        stage_repeats=(1, 1, 1),
+        stage_channels=(16, 32, 64),
+        final_channels=64,
+        num_classes=10,
+        **kwargs,
+    )

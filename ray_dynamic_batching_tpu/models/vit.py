@@ -1,0 +1,186 @@
+"""Vision Transformer for TPU inference (flax linen, bf16).
+
+Capability parity with the reference's ``vit_b_16`` / ViT-G profiling targets
+(``293-project/src/scheduler.py:40-44``;
+``293-project/profiling/vit_g16_20241123_154354_report.txt``). TPU-first
+choices: attention through :mod:`ops.attention` (Pallas-fused on TPU), bf16
+matmuls on the MXU with f32 layernorms, and TP sharding rules over the head
+and MLP dimensions so big variants (ViT-G) shard with pjit instead of
+time-slicing one chip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_dynamic_batching_tpu.models.base import (
+    ModelSLO,
+    ServableModel,
+    register_model,
+)
+from ray_dynamic_batching_tpu.ops import attention as attn_ops
+
+
+class ViTBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        D = x.shape[-1]
+        H = D // self.num_heads
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, H),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="qkv",
+        )(y)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = attn_ops.dot_product_attention(q, k, v)
+        o = nn.DenseGeneral(
+            D,
+            axis=(-2, -1),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="proj",
+        )(o)
+        x = x + o
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        y = nn.Dense(
+            self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32, name="mlp_in"
+        )(y)
+        y = nn.gelu(y)
+        y = nn.Dense(D, dtype=self.dtype, param_dtype=jnp.float32, name="mlp_out")(y)
+        return x + y
+
+
+class ViTModule(nn.Module):
+    patch_size: int = 16
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B = x.shape[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.hidden_dim,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(B, -1, self.hidden_dim)
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, self.hidden_dim), jnp.float32
+        )
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (B, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, x.shape[1], self.hidden_dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = ViTBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32, name="head"
+        )(x[:, 0])
+
+
+class ViT(ServableModel):
+    family = "vision"
+
+    def __init__(
+        self,
+        image_size: int = 224,
+        dtype: jnp.dtype = jnp.bfloat16,
+        name: str = "vit_b_16",
+        **module_kwargs: Any,
+    ):
+        super().__init__(dtype)
+        self.name = name
+        self.image_size = image_size
+        self.module = ViTModule(dtype=dtype, **module_kwargs)
+
+    def init(self, rng: jax.Array):
+        return self.module.init(rng, self.example_inputs(1)[0])
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        return self.module.apply(params, x)
+
+    def example_inputs(self, batch_size: int, seq_len: Optional[int] = None):
+        return (
+            jnp.zeros(
+                (batch_size, self.image_size, self.image_size, 3), dtype=self.dtype
+            ),
+        )
+
+    def flops_per_sample(self, seq_len: Optional[int] = None) -> float:
+        n_tokens = (self.image_size // self.module.patch_size) ** 2 + 1
+        d = self.module.hidden_dim
+        per_layer = 4 * n_tokens * d * d + 2 * n_tokens * n_tokens * d
+        per_layer += 2 * n_tokens * d * self.module.mlp_dim
+        return 2.0 * self.module.num_layers * per_layer
+
+    def sharding_rules(self):
+        # Megatron-style: qkv/mlp_in column-split over heads, proj/mlp_out row-split.
+        # DenseGeneral((3, N, H)) kernel is [D, 3, N, H]: shard the heads axis.
+        return [
+            (r"qkv/kernel", P(None, None, "tp", None)),
+            (r"proj/kernel", P("tp", None, None)),
+            (r"mlp_in/kernel", P(None, "tp")),
+            (r"mlp_out/kernel", P("tp", None)),
+        ]
+
+
+@register_model("vit_b_16", slo=ModelSLO(latency_slo_ms=4000.0))
+def _vit_b16(**kwargs) -> ViT:
+    return ViT(name="vit_b_16", **kwargs)
+
+
+@register_model("vit_g_14")
+def _vit_g14(**kwargs) -> ViT:
+    return ViT(
+        name="vit_g_14",
+        patch_size=14,
+        hidden_dim=1664,
+        num_layers=48,
+        num_heads=16,
+        mlp_dim=8192,
+        **kwargs,
+    )
+
+
+@register_model("vit_tiny")
+def _vit_tiny(**kwargs) -> ViT:
+    kwargs.setdefault("image_size", 32)
+    return ViT(
+        name="vit_tiny",
+        patch_size=8,
+        hidden_dim=64,
+        num_layers=2,
+        num_heads=4,
+        mlp_dim=128,
+        num_classes=10,
+        **kwargs,
+    )

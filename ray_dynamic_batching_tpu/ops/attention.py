@@ -1,0 +1,105 @@
+"""Attention ops with backend dispatch (XLA reference now, Pallas on TPU).
+
+The reference framework has no attention of its own (it serves fixed-shape
+vision models through torch); attention enters via the north-star LLM configs.
+This module is the single place models get attention from, so the engine can
+swap the XLA einsum reference for the fused Pallas kernel
+(:mod:`ray_dynamic_batching_tpu.ops.flash_attention`) on TPU without touching
+model code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_BACKEND = "auto"  # "auto" | "xla" | "pallas"
+
+
+def set_attention_backend(backend: str) -> None:
+    global _BACKEND
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown attention backend {backend!r}")
+    _BACKEND = backend
+
+
+def _use_pallas() -> bool:
+    if _BACKEND == "xla":
+        return False
+    if _BACKEND == "pallas":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Multi-head attention.
+
+    Shapes: q [B, Tq, N, H], k/v [B, Tk, K, H] with K == N or K dividing N
+    (grouped-query attention: each group of N//K query heads shares a kv head).
+    mask: broadcastable to [B, 1, Tq, Tk], True = attend.
+    """
+    if _use_pallas():
+        from ray_dynamic_batching_tpu.ops import flash_attention
+
+        out = flash_attention.flash_attention(
+            q, k, v, causal=causal, mask=mask, scale=scale
+        )
+        if out is not None:
+            return out
+    return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+
+
+def _xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    mask: Optional[jax.Array],
+    scale: Optional[float],
+) -> jax.Array:
+    B, Tq, N, H = q.shape
+    _, Tk, K, _ = k.shape
+    if K != N:
+        assert N % K == 0, f"query heads {N} not divisible by kv heads {K}"
+        k = jnp.repeat(k, N // K, axis=2)
+        v = jnp.repeat(v, N // K, axis=2)
+    scale = scale if scale is not None else H ** -0.5
+    # [B, N, Tq, Tk] logits in f32 for numerical stability on bf16 inputs.
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), k=Tk - Tq)
+        logits = jnp.where(causal_mask[None, None, :, :], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    lengths: jax.Array,
+) -> jax.Array:
+    """Single-token decode attention against a padded KV cache.
+
+    q [B, 1, N, H]; caches [B, S, K, H]; lengths [B] = valid prefix per row
+    BEFORE this token — the current token's k/v sit at index ``lengths``
+    (KVCache convention), so positions <= lengths attend (self included).
+    """
+    S = k_cache.shape[1]
+    pos = jnp.arange(S)[None, None, None, :]  # [1,1,1,S]
+    mask = pos <= lengths[:, None, None, None]
+    return dot_product_attention(q, k_cache, v_cache, mask=mask)

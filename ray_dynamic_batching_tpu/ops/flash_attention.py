@@ -1,0 +1,15 @@
+"""Pallas TPU flash attention (filled in by ops task; returns None to fall back).
+
+Placeholder module so the dispatcher import is stable; the fused kernel lands
+with the Pallas ops milestone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def flash_attention(q, k, v, *, causal=False, mask=None, scale=None) -> Optional[jax.Array]:
+    return None  # fall back to XLA reference until the kernel lands

@@ -31,6 +31,17 @@ class Metric:
         self._lock = threading.Lock()
         _default_registry.register(self)
 
+    def _check_tags(self, tags: Optional[Dict[str, str]]) -> None:
+        # Declared tag_keys are enforced (ref: ray.util.metrics API) so a
+        # typo'd key fails loudly instead of minting a silent new series.
+        if self.tag_keys and tags:
+            unknown = set(tags) - set(self.tag_keys)
+            if unknown:
+                raise ValueError(
+                    f"metric {self.name!r}: unknown tag keys {sorted(unknown)}; "
+                    f"declared: {sorted(self.tag_keys)}"
+                )
+
     def _prom_lines(self) -> Iterable[str]:  # pragma: no cover - overridden
         return ()
 
@@ -45,6 +56,7 @@ class Counter(Metric):
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
         if value < 0:
             raise ValueError("Counter.inc requires value >= 0")
+        self._check_tags(tags)
         key = _tags(tags)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
@@ -69,10 +81,12 @@ class Gauge(Metric):
         self._values: Dict[TagMap, float] = {}
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        self._check_tags(tags)
         with self._lock:
             self._values[_tags(tags)] = float(value)
 
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
+        self._check_tags(tags)
         key = _tags(tags)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
@@ -114,6 +128,7 @@ class Histogram(Metric):
         self._count: Dict[TagMap, int] = {}
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        self._check_tags(tags)
         key = _tags(tags)
         idx = bisect.bisect_left(self.boundaries, value)
         with self._lock:

@@ -6,17 +6,23 @@ here the stand-in for N TPU chips is N XLA host-platform devices
 (``--xla_force_host_platform_device_count=8``), so every sharding/mesh test
 runs the real pjit/shard_map code paths without TPU hardware.
 
-Must run before jax is imported anywhere in the test process.
+NOTE: this environment's sitecustomize imports jax at interpreter startup
+(axon TPU tunnel), so setting JAX_PLATFORMS via os.environ here is too late —
+the platform must be forced through jax.config instead. XLA_FLAGS is still
+honored because the CPU client initializes lazily.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
