@@ -81,17 +81,22 @@ class CausalLM(ServableModel):
         logits [B, V] and the cache with ``lengths`` set per row.
         """
         B, T = tokens.shape
+        S = cache.capacity
+        if T > S:
+            raise ValueError(
+                f"prompt length {T} exceeds KV-cache capacity {S}; "
+                "bucket the prompt or allocate a larger cache"
+            )
         positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         lengths = attn_mask.sum(axis=1).astype(jnp.int32)
         # Queries may attend causally within the prompt; cache positions
         # beyond T are empty, mask them off.
-        S = cache.capacity
         base = prefill_mask(attn_mask)  # [B,1,T,T]
         if S > T:
             pad = jnp.zeros((B, 1, T, S - T), dtype=bool)
             mask = jnp.concatenate([base, pad], axis=-1)
         else:
-            mask = base[..., :S]
+            mask = base
         logits, new_cache = self.module.apply(params, tokens, positions, mask, cache)
         last = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1
@@ -105,8 +110,16 @@ class CausalLM(ServableModel):
         cache: KVCache,
         active: jax.Array,   # [B] bool — which slots advance
     ) -> Tuple[jax.Array, KVCache]:
-        """One decode step for all slots; returns logits [B, V] + new cache."""
-        positions = cache.lengths[:, None]
+        """One decode step for all slots; returns logits [B, V] + new cache.
+
+        Rows whose cache is full are force-deactivated: their scatter would be
+        dropped (JAX out-of-bounds update) and their logits would be garbage,
+        so ``lengths`` stops advancing at capacity and the engine detects
+        exhaustion via ``lengths == capacity`` instead of silently decoding on.
+        """
+        in_bounds = cache.lengths < cache.capacity
+        active = jnp.logical_and(active, in_bounds)
+        positions = jnp.minimum(cache.lengths, cache.capacity - 1)[:, None]
         mask = decode_mask(cache.lengths, cache.capacity)
         logits, new_cache = self.module.apply(params, tokens, positions, mask, cache)
         new_lengths = cache.lengths + active.astype(jnp.int32)

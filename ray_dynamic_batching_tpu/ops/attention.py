@@ -87,19 +87,5 @@ def _xla_attention(
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 
 
-def decode_attention(
-    q: jax.Array,
-    k_cache: jax.Array,
-    v_cache: jax.Array,
-    lengths: jax.Array,
-) -> jax.Array:
-    """Single-token decode attention against a padded KV cache.
-
-    q [B, 1, N, H]; caches [B, S, K, H]; lengths [B] = valid prefix per row
-    BEFORE this token — the current token's k/v sit at index ``lengths``
-    (KVCache convention), so positions <= lengths attend (self included).
-    """
-    S = k_cache.shape[1]
-    pos = jnp.arange(S)[None, None, None, :]  # [1,1,1,S]
-    mask = pos <= lengths[:, None, None, None]
-    return dot_product_attention(q, k_cache, v_cache, mask=mask)
+# NOTE: decode-path masking lives in models/decoder.py (decode_mask) — the
+# single owner of the KV-cache attention-window convention.

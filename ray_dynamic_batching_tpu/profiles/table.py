@@ -1,0 +1,253 @@
+"""Batch-profile tables — the scheduler's ground truth.
+
+Re-creates the contract of the reference's committed profiler outputs
+(``293-project/profiling/*_summary.csv``, loaded by the scheduler at
+``293-project/src/scheduler.py:1019-1041``): per-(batch, seq) rows of measured
+latency / throughput / memory that drive SLO-aware batch selection.
+
+TPU-first differences from the reference CSVs:
+- rows exist only at *bucket* sizes (each bucket is one compiled XLA program;
+  arbitrary batch sizes 1..512 are not "free" like eager CUDA — SURVEY.md §7
+  hard part (a)), and lookups round **up** to the nearest profiled bucket;
+- each row carries ``hbm_bytes`` (total program footprint incl. weights) and
+  ``compile_ms`` so the planner can budget HBM and amortize compiles;
+- a ``seq_len`` column generalizes the table to shape-bucketed LLM prefill
+  (0 = fixed-shape vision input).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import math
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ProfileRow:
+    batch_size: int
+    seq_len: int                 # 0 for fixed-shape models
+    latency_ms: float            # mean step latency at this bucket
+    latency_std_ms: float
+    hbm_bytes: int               # total device footprint (weights+activations)
+    compile_ms: float            # one-time XLA compile cost for this bucket
+    throughput_sps: float = 0.0  # batch_size / latency
+
+    def with_throughput(self) -> "ProfileRow":
+        tput = self.batch_size / (self.latency_ms / 1000.0) if self.latency_ms else 0.0
+        return ProfileRow(
+            self.batch_size,
+            self.seq_len,
+            self.latency_ms,
+            self.latency_std_ms,
+            self.hbm_bytes,
+            self.compile_ms,
+            tput,
+        )
+
+
+CSV_FIELDS = [
+    "batch_size",
+    "seq_len",
+    "latency_ms",
+    "latency_std_ms",
+    "hbm_bytes",
+    "compile_ms",
+    "throughput_sps",
+]
+
+
+class BatchProfile:
+    """All profiled buckets for one model (one seq bucket group per seq_len)."""
+
+    def __init__(self, model_name: str, rows: Iterable[ProfileRow] = ()):
+        self.model_name = model_name
+        self.rows: List[ProfileRow] = sorted(
+            (r.with_throughput() for r in rows),
+            key=lambda r: (r.seq_len, r.batch_size),
+        )
+
+    # --- construction -----------------------------------------------------
+    def add(self, row: ProfileRow) -> None:
+        self.rows.append(row.with_throughput())
+        self.rows.sort(key=lambda r: (r.seq_len, r.batch_size))
+
+    # --- lookups (always round batch UP to a profiled bucket) -------------
+    def _seq_rows(self, seq_len: int = 0) -> List[ProfileRow]:
+        rows = [r for r in self.rows if r.seq_len == seq_len]
+        if not rows and self.rows:
+            # fall back to nearest profiled seq bucket >= requested
+            seqs = sorted({r.seq_len for r in self.rows})
+            chosen = next((s for s in seqs if s >= seq_len), seqs[-1])
+            rows = [r for r in self.rows if r.seq_len == chosen]
+        return rows
+
+    def buckets(self, seq_len: int = 0) -> List[int]:
+        return [r.batch_size for r in self._seq_rows(seq_len)]
+
+    def bucket_for(self, batch_size: int, seq_len: int = 0) -> Optional[ProfileRow]:
+        """Smallest profiled bucket >= batch_size (None if beyond the table)."""
+        for r in self._seq_rows(seq_len):
+            if r.batch_size >= batch_size:
+                return r
+        return None
+
+    def row_for(self, batch_size: int, seq_len: int = 0) -> Optional[ProfileRow]:
+        for r in self._seq_rows(seq_len):
+            if r.batch_size == batch_size:
+                return r
+        return None
+
+    def latency_ms(self, batch_size: int, seq_len: int = 0) -> float:
+        row = self.bucket_for(batch_size, seq_len)
+        if row is None:
+            raise KeyError(
+                f"{self.model_name}: no profiled bucket >= batch {batch_size}"
+            )
+        return row.latency_ms
+
+    def largest_within_latency(
+        self, max_latency_ms: float, seq_len: int = 0,
+        hbm_budget_bytes: Optional[int] = None,
+    ) -> Optional[ProfileRow]:
+        """Largest bucket whose latency (and HBM) fit — the Nexus 'saturate'
+        selection rule (ref nexus.py:154-165), against measured buckets."""
+        best = None
+        for r in self._seq_rows(seq_len):
+            if r.latency_ms <= max_latency_ms and (
+                hbm_budget_bytes is None or r.hbm_bytes <= hbm_budget_bytes
+            ):
+                best = r
+        return best
+
+    def max_throughput(self, seq_len: int = 0) -> float:
+        rows = self._seq_rows(seq_len)
+        return max((r.throughput_sps for r in rows), default=0.0)
+
+    def weights_hbm_bytes(self) -> int:
+        """Lower bound on resident footprint: min over rows (≈ weights)."""
+        return min((r.hbm_bytes for r in self.rows), default=0)
+
+    # --- persistence (the CSV/JSON contract) ------------------------------
+    def to_csv(self, path: Optional[str] = None) -> str:
+        buf = io.StringIO()
+        w = csv.DictWriter(buf, fieldnames=CSV_FIELDS)
+        w.writeheader()
+        for r in self.rows:
+            w.writerow(asdict(r))
+        text = buf.getvalue()
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    @classmethod
+    def from_csv(cls, model_name: str, text_or_path: str) -> "BatchProfile":
+        if "\n" not in text_or_path:
+            with open(text_or_path) as f:
+                text = f.read()
+        else:
+            text = text_or_path
+        rows = []
+        for rec in csv.DictReader(io.StringIO(text)):
+            rows.append(
+                ProfileRow(
+                    batch_size=int(rec["batch_size"]),
+                    seq_len=int(rec.get("seq_len", 0) or 0),
+                    latency_ms=float(rec["latency_ms"]),
+                    latency_std_ms=float(rec.get("latency_std_ms", 0) or 0),
+                    hbm_bytes=int(float(rec.get("hbm_bytes", 0) or 0)),
+                    compile_ms=float(rec.get("compile_ms", 0) or 0),
+                )
+            )
+        return cls(model_name, rows)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"model": self.model_name, "rows": [asdict(r) for r in self.rows]},
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "BatchProfile":
+        obj = json.loads(text)
+        return cls(obj["model"], [ProfileRow(**r) for r in obj["rows"]])
+
+    def report(self) -> str:
+        """Human-readable report (analogue of the reference's report.txt)."""
+        lines = [f"# Batch profile: {self.model_name}", ""]
+        best_t = max(self.rows, key=lambda r: r.throughput_sps, default=None)
+        best_l = min(self.rows, key=lambda r: r.latency_ms, default=None)
+        if best_t:
+            lines.append(
+                f"best throughput: {best_t.throughput_sps:.1f} samples/s "
+                f"@ batch {best_t.batch_size} seq {best_t.seq_len} "
+                f"({best_t.latency_ms:.2f} ms)"
+            )
+        if best_l:
+            lines.append(
+                f"best latency: {best_l.latency_ms:.2f} ms @ batch "
+                f"{best_l.batch_size} seq {best_l.seq_len}"
+            )
+        lines.append("")
+        lines.append(
+            f"{'batch':>6} {'seq':>6} {'lat_ms':>10} {'std':>8} "
+            f"{'tput':>10} {'hbm_mb':>9} {'compile_ms':>10}"
+        )
+        for r in self.rows:
+            lines.append(
+                f"{r.batch_size:>6} {r.seq_len:>6} {r.latency_ms:>10.2f} "
+                f"{r.latency_std_ms:>8.2f} {r.throughput_sps:>10.1f} "
+                f"{r.hbm_bytes / 1e6:>9.1f} {r.compile_ms:>10.0f}"
+            )
+        return "\n".join(lines) + "\n"
+
+
+def default_batch_buckets(max_batch: int, min_batch: int = 1) -> List[int]:
+    """Power-of-two buckets — one XLA program each, bounded jit-cache size."""
+    out = []
+    b = min_batch
+    while b <= max_batch:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def default_seq_buckets(max_seq: int, min_seq: int = 32) -> List[int]:
+    out = []
+    s = min_seq
+    while s <= max_seq:
+        out.append(s)
+        s *= 2
+    return out
+
+
+class ProfileStore:
+    """Named profile collection the scheduler reads (ref: profile CSVs dir)."""
+
+    def __init__(self) -> None:
+        self._profiles: Dict[str, BatchProfile] = {}
+
+    def put(self, profile: BatchProfile) -> None:
+        self._profiles[profile.model_name] = profile
+
+    def get(self, model_name: str) -> BatchProfile:
+        if model_name not in self._profiles:
+            raise KeyError(f"no profile for model {model_name!r}")
+        return self._profiles[model_name]
+
+    def __contains__(self, model_name: str) -> bool:
+        return model_name in self._profiles
+
+    def models(self) -> List[str]:
+        return sorted(self._profiles)
+
+    def load_dir(self, path: str) -> None:
+        import os
+
+        for fn in os.listdir(path):
+            if fn.endswith(".csv"):
+                name = fn[: -len(".csv")]
+                self.put(BatchProfile.from_csv(name, os.path.join(path, fn)))

@@ -1,0 +1,273 @@
+"""Squishy bin packing — SLO-aware multi-model chip allocation (Nexus §6.1).
+
+Re-creates the algorithm of the reference's ``293-project/src/nexus.py``
+(``scheduleSaturate`` :145, ``scheduleResidue`` :241, ``mergeNodes`` :203,
+entry ``squishyBinPacking`` :129) with a TPU cost model:
+
+- **HBM budget replaces gpu_mem** (ref nexus.py:156-165): a placement's
+  footprint comes from the profile row's measured program footprint
+  (weights + activations), and co-located models must *sum* within the chip's
+  planning budget — weights stay resident in HBM across the duty cycle
+  (there is no ``torch.cuda.empty_cache()`` hot path on TPU).
+- **Batches are buckets**: candidate batch sizes are the profiled XLA
+  buckets; merges re-derive batch = ceil(duty*rate/1000) (ref nexus.py:208)
+  then round UP to a bucket, so a merged schedule never runs an uncompiled
+  shape.
+- **No preemptive time-slicing** (SURVEY.md §7(c)): occupancy is computed
+  from worst-case step latency (mean + 2*std) because a long compiled step
+  cannot be preempted mid-flight to honor a co-tenant's slice.
+- The **SLO/2 rule** (ref nexus.py:154): a batch is admissible iff
+  2 * worst_latency(batch) <= slo — half the budget for queueing, half for
+  compute.
+
+Vocabulary mapping (reference → here): session → :class:`Session`,
+node → :class:`NodePlan`, (session, occupancy) pairs → :class:`Placement`.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
+from ray_dynamic_batching_tpu.utils.config import get_config
+
+
+@dataclass(frozen=True)
+class Session:
+    """A model's serving contract at the current request rate
+    (ref: session, nexus.py:17)."""
+
+    model: str
+    slo_ms: float
+    rate_rps: float
+    seq_len: int = 0  # shape bucket for LLM prefill; 0 = fixed-shape
+
+
+@dataclass
+class Placement:
+    """One session's slice of a chip (ref: node.sessions + occupancy lists)."""
+
+    session: Session
+    batch_size: int
+    latency_ms: float       # worst-case step latency at this batch
+    occupancy: float        # latency / duty_cycle
+    hbm_bytes: int
+
+
+@dataclass
+class NodePlan:
+    """One chip's duty-cycle schedule (ref: node, nexus.py:75)."""
+
+    placements: List[Placement] = field(default_factory=list)
+    duty_cycle_ms: float = 0.0
+
+    @property
+    def occupancy(self) -> float:
+        return sum(p.occupancy for p in self.placements)
+
+    @property
+    def hbm_bytes(self) -> int:
+        return sum(p.hbm_bytes for p in self.placements)
+
+    @property
+    def models(self) -> List[str]:
+        return [p.session.model for p in self.placements]
+
+    def describe(self) -> str:
+        parts = ", ".join(
+            f"{p.session.model}(b={p.batch_size}, occ={p.occupancy:.2f})"
+            for p in self.placements
+        )
+        return f"NodePlan(duty={self.duty_cycle_ms:.1f}ms, [{parts}])"
+
+
+def worst_latency_ms(row: ProfileRow) -> float:
+    """Occupancy math uses worst-case step latency (no preemption on TPU)."""
+    return row.latency_ms + 2.0 * row.latency_std_ms
+
+
+class SquishyBinPacker:
+    """The planner. One instance per scheduling domain (a set of identical
+    chips); profiles keyed by model name."""
+
+    def __init__(
+        self,
+        profiles: Dict[str, BatchProfile],
+        hbm_budget_bytes: Optional[int] = None,
+    ):
+        cfg = get_config()
+        self.profiles = profiles
+        self.hbm_budget = int(
+            (hbm_budget_bytes or cfg.hbm_budget_bytes) * cfg.hbm_plan_fraction
+        )
+        self.slo_safety = cfg.slo_safety_factor
+        self.compute_fraction = cfg.slo_compute_fraction
+
+    # --- admissible batch selection (ref nexus.py:145-165) ----------------
+    def _effective_slo(self, session: Session) -> float:
+        return session.slo_ms / self.slo_safety
+
+    def saturate_row(self, session: Session) -> Optional[ProfileRow]:
+        """Largest profiled bucket with worst_latency <= compute share of SLO
+        and footprint within the chip budget."""
+        prof = self.profiles[session.model]
+        budget_ms = self._effective_slo(session) * self.compute_fraction
+        best = None
+        for row in prof._seq_rows(session.seq_len):
+            if (
+                worst_latency_ms(row) <= budget_ms
+                and row.hbm_bytes <= self.hbm_budget
+            ):
+                best = row
+        return best
+
+    # --- phase 1: saturated nodes (ref scheduleSaturate, nexus.py:145) ----
+    def schedule_saturate(
+        self, sessions: List[Session]
+    ) -> Tuple[List[NodePlan], List[Session]]:
+        """Split each session's rate R = n * maxThroughput + r
+        (ref nexus.py:181-190); emit n fully-saturated single-model nodes and
+        return the residue sessions for phase 2."""
+        nodes: List[NodePlan] = []
+        residues: List[Session] = []
+        for session in sessions:
+            row = self.saturate_row(session)
+            if row is None:
+                # No bucket fits the SLO: serve at the smallest bucket anyway
+                # (degraded), one request-rate's worth of nodes.
+                prof = self.profiles[session.model]
+                rows = prof._seq_rows(session.seq_len)
+                if not rows:
+                    raise KeyError(f"no profile rows for {session.model}")
+                row = rows[0]
+            wl = worst_latency_ms(row)
+            max_throughput = row.batch_size / (wl / 1000.0)
+            n_full = int(session.rate_rps // max_throughput)
+            residue_rate = session.rate_rps - n_full * max_throughput
+            for _ in range(n_full):
+                nodes.append(
+                    NodePlan(
+                        placements=[
+                            Placement(
+                                session=session,
+                                batch_size=row.batch_size,
+                                latency_ms=wl,
+                                occupancy=1.0,
+                                hbm_bytes=row.hbm_bytes,
+                            )
+                        ],
+                        duty_cycle_ms=wl,
+                    )
+                )
+            if residue_rate > 1e-9:
+                residues.append(replace(session, rate_rps=residue_rate))
+        return nodes, residues
+
+    # --- phase 2: residue nodes (ref scheduleResidue, nexus.py:241) -------
+    def residue_node(self, session: Session) -> Optional[NodePlan]:
+        """Single-session node at its residual rate: pick the largest bucket
+        whose *end-to-end* time — batch fill at the arrival rate plus compute —
+        fits the SLO (ref nexus.py:246-257: bisect over latency + batch/rate);
+        duty = batch/rate*1000, occupancy = latency/duty (ref nexus.py:263-268).
+        """
+        prof = self.profiles[session.model]
+        rows = prof._seq_rows(session.seq_len)
+        rows = [r for r in rows if r.hbm_bytes <= self.hbm_budget]
+        if not rows:
+            return None
+        slo = self._effective_slo(session)
+        rate = max(session.rate_rps, 1e-9)
+        chosen = rows[0]
+        for cand in rows:
+            fill_ms = cand.batch_size / rate * 1000.0
+            if worst_latency_ms(cand) + fill_ms <= slo:
+                chosen = cand
+        wl = worst_latency_ms(chosen)
+        duty = max(chosen.batch_size / rate * 1000.0, wl)
+        return NodePlan(
+            placements=[
+                Placement(
+                    session=session,
+                    batch_size=chosen.batch_size,
+                    latency_ms=wl,
+                    occupancy=min(wl / duty, 1.0),
+                    hbm_bytes=chosen.hbm_bytes,
+                )
+            ],
+            duty_cycle_ms=duty,
+        )
+
+    # --- merge (ref mergeNodes, nexus.py:202-228) --------------------------
+    def try_merge(self, a: NodePlan, b: NodePlan) -> Optional[NodePlan]:
+        """Merge two nodes onto one chip at duty = min(duties) (the reference
+        keeps the lower-duty node's cycle so no session ever waits longer,
+        nexus.py:203-207): every session's batch is re-derived as
+        ceil(duty * rate / 1000) rounded UP to a profiled bucket
+        (ref nexus.py:211); feasible iff total occupancy <= 1
+        (ref nexus.py:218), summed HBM fits (ref nexus.py:222-226, gpu_mem →
+        HBM budget), and — TPU addition — each re-derived bucket still meets
+        its session's SLO end-to-end (bucket rounding can pick a bigger
+        program than the exact batch the reference would run)."""
+        duty = min(a.duty_cycle_ms, b.duty_cycle_ms)
+        placements: List[Placement] = []
+        hbm_total = 0
+        occ_total = 0.0
+        for p in a.placements + b.placements:
+            s = p.session
+            need = max(math.ceil(duty * s.rate_rps / 1000.0), 1)
+            prof = self.profiles[s.model]
+            row = prof.bucket_for(need, s.seq_len)
+            if row is None:
+                return None  # rate too high for any compiled bucket at this duty
+            wl = worst_latency_ms(row)
+            if wl + duty > self._effective_slo(s):
+                return None  # wait-one-cycle + compute would blow the SLO
+            occ = wl / duty
+            occ_total += occ
+            hbm_total += row.hbm_bytes
+            if occ_total > 1.0 + 1e-9 or hbm_total > self.hbm_budget:
+                return None
+            placements.append(
+                Placement(
+                    session=s,
+                    batch_size=row.batch_size,
+                    latency_ms=wl,
+                    occupancy=occ,
+                    hbm_bytes=row.hbm_bytes,
+                )
+            )
+        return NodePlan(placements=placements, duty_cycle_ms=duty)
+
+    def merge_residues(self, nodes: List[NodePlan]) -> List[NodePlan]:
+        """Best-fit decreasing: walk residue nodes by descending occupancy and
+        merge each into whichever existing node yields the highest resulting
+        occupancy (ref nexus.py:271-293)."""
+        merged: List[NodePlan] = []
+        for residual in sorted(nodes, key=lambda n: -n.occupancy):
+            best: Optional[NodePlan] = None
+            best_idx = -1
+            for i, existing in enumerate(merged):
+                candidate = self.try_merge(existing, residual)
+                if candidate is not None and (
+                    best is None or candidate.occupancy > best.occupancy
+                ):
+                    best, best_idx = candidate, i
+            if best is not None:
+                merged[best_idx] = best
+            else:
+                merged.append(residual)
+        return merged
+
+    # --- entry point (ref squishyBinPacking, nexus.py:129) -----------------
+    def plan(self, sessions: List[Session]) -> List[NodePlan]:
+        active = [s for s in sessions if s.rate_rps > 0]
+        saturated, residues = self.schedule_saturate(active)
+        residue_nodes = [
+            n for s in residues if (n := self.residue_node(s)) is not None
+        ]
+        return saturated + self.merge_residues(residue_nodes)
+
+    def chips_required(self, sessions: List[Session]) -> int:
+        return len(self.plan(sessions))

@@ -32,13 +32,17 @@ class Metric:
         _default_registry.register(self)
 
     def _check_tags(self, tags: Optional[Dict[str, str]]) -> None:
-        # Declared tag_keys are enforced (ref: ray.util.metrics API) so a
-        # typo'd key fails loudly instead of minting a silent new series.
-        if self.tag_keys and tags:
-            unknown = set(tags) - set(self.tag_keys)
-            if unknown:
+        # Declared tag_keys are enforced both ways (ref: ray.util.metrics API):
+        # a typo'd OR omitted key fails loudly instead of minting a silent
+        # parallel series.
+        if self.tag_keys:
+            given = set(tags or {})
+            unknown = given - set(self.tag_keys)
+            missing = set(self.tag_keys) - given
+            if unknown or missing:
                 raise ValueError(
-                    f"metric {self.name!r}: unknown tag keys {sorted(unknown)}; "
+                    f"metric {self.name!r}: tag keys mismatch "
+                    f"(unknown={sorted(unknown)}, missing={sorted(missing)}); "
                     f"declared: {sorted(self.tag_keys)}"
                 )
 

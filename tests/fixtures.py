@@ -1,0 +1,51 @@
+"""Hand-written batch-profile fixtures for scheduler unit tests.
+
+Mirrors the reference's test strategy: a synthetic profile dict feeding the
+bin-packing algorithm directly, no device needed
+(``293-project/src/venkat-code/test_scheduler.py:36-66`` SAMPLE_BATCH_PROFILE).
+"""
+
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
+
+MB = 1024 * 1024
+
+
+def linear_profile(
+    name: str,
+    base_ms: float,
+    per_sample_ms: float,
+    weight_mb: int = 100,
+    act_mb_per_sample: float = 1.0,
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+    compile_ms: float = 1000.0,
+) -> BatchProfile:
+    """Latency = base + per_sample*batch — the canonical accelerator shape."""
+    rows = [
+        ProfileRow(
+            batch_size=b,
+            seq_len=0,
+            latency_ms=base_ms + per_sample_ms * b,
+            latency_std_ms=0.0,
+            hbm_bytes=int((weight_mb + act_mb_per_sample * b) * MB),
+            compile_ms=compile_ms,
+        )
+        for b in buckets
+    ]
+    return BatchProfile(name, rows)
+
+
+def make_profiles():
+    """Three models with distinct latency/memory shapes:
+
+    - "fast": tiny per-sample cost, scales to huge batches (shufflenet-like)
+    - "heavy": large base + per-sample cost (vit-like)
+    - "fat": moderate latency but large memory footprint (efficientnet-like)
+    """
+    return {
+        "fast": linear_profile("fast", base_ms=1.0, per_sample_ms=0.05,
+                               weight_mb=20, act_mb_per_sample=0.2),
+        "heavy": linear_profile("heavy", base_ms=20.0, per_sample_ms=2.0,
+                                weight_mb=500, act_mb_per_sample=10.0),
+        "fat": linear_profile("fat", base_ms=5.0, per_sample_ms=0.5,
+                              weight_mb=4000, act_mb_per_sample=40.0),
+    }

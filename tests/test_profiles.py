@@ -1,0 +1,104 @@
+"""Profile table round-trip + live profiler sweep on a tiny model."""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.models import registry
+from ray_dynamic_batching_tpu.models.base import get_model
+from ray_dynamic_batching_tpu.profiles.profiler import ModelProfiler
+from ray_dynamic_batching_tpu.profiles.table import (
+    BatchProfile,
+    ProfileRow,
+    ProfileStore,
+    default_batch_buckets,
+    default_seq_buckets,
+)
+from tests.fixtures import make_profiles
+
+
+class TestTable:
+    def test_bucket_rounding_up(self):
+        prof = make_profiles()["fast"]
+        assert prof.bucket_for(3).batch_size == 4
+        assert prof.bucket_for(4).batch_size == 4
+        assert prof.bucket_for(129).batch_size == 256
+        assert prof.bucket_for(999) is None
+
+    def test_latency_lookup_and_throughput(self):
+        prof = make_profiles()["fast"]
+        assert prof.latency_ms(16) == pytest.approx(1.0 + 0.05 * 16)
+        row = prof.row_for(256)
+        assert row.with_throughput().throughput_sps == pytest.approx(
+            256 / ((1.0 + 0.05 * 256) / 1000)
+        )
+
+    def test_largest_within_latency_respects_hbm(self):
+        prof = make_profiles()["fast"]
+        row = prof.largest_within_latency(100.0)
+        assert row.batch_size == 256
+        limited = prof.largest_within_latency(
+            100.0, hbm_budget_bytes=(20 + 0.2 * 8) * 1024 * 1024
+        )
+        assert limited.batch_size == 8
+
+    def test_csv_roundtrip(self, tmp_path):
+        prof = make_profiles()["heavy"]
+        p = tmp_path / "heavy.csv"
+        prof.to_csv(str(p))
+        loaded = BatchProfile.from_csv("heavy", str(p))
+        assert [r.batch_size for r in loaded.rows] == [
+            r.batch_size for r in prof.rows
+        ]
+        assert loaded.rows[3].latency_ms == pytest.approx(prof.rows[3].latency_ms)
+
+    def test_json_roundtrip_and_report(self):
+        prof = make_profiles()["fat"]
+        loaded = BatchProfile.from_json(prof.to_json())
+        assert loaded.model_name == "fat"
+        report = prof.report()
+        assert "best throughput" in report and "best latency" in report
+
+    def test_seq_bucket_fallback(self):
+        rows = [
+            ProfileRow(8, 128, 10.0, 0.0, 0, 0),
+            ProfileRow(8, 512, 30.0, 0.0, 0, 0),
+        ]
+        prof = BatchProfile("lm", rows)
+        # ask for seq 256 -> falls to seq-512 rows
+        assert prof.latency_ms(8, seq_len=256) == 30.0
+
+    def test_store_load_dir(self, tmp_path):
+        profs = make_profiles()
+        for p in profs.values():
+            p.to_csv(str(tmp_path / f"{p.model_name}.csv"))
+        store = ProfileStore()
+        store.load_dir(str(tmp_path))
+        assert store.models() == ["fast", "fat", "heavy"]
+        assert "fast" in store
+
+    def test_default_buckets(self):
+        assert default_batch_buckets(64) == [1, 2, 4, 8, 16, 32, 64]
+        assert default_seq_buckets(256, 32) == [32, 64, 128, 256]
+
+
+class TestLiveProfiler:
+    def test_sweep_tiny_model(self, tmp_path):
+        model = get_model("distilbert_tiny", dtype=jnp.float32)
+        profiler = ModelProfiler(model, timing_iters=2, warmup_iters=1)
+        prof = profiler.sweep(batch_buckets=[1, 2], seq_buckets=[16])
+        assert len(prof.rows) == 2
+        for row in prof.rows:
+            assert row.latency_ms > 0
+            assert row.compile_ms > 0
+            assert row.hbm_bytes > 0
+            assert row.seq_len == 16
+        # bigger batch should not be cheaper per batch
+        assert prof.rows[1].throughput_sps >= prof.rows[0].throughput_sps * 0.5
+        csv_path, json_path, report_path = profiler.write_outputs(
+            prof, str(tmp_path)
+        )
+        assert os.path.exists(csv_path)
+        loaded = BatchProfile.from_csv(model.name, csv_path)
+        assert len(loaded.rows) == 2
