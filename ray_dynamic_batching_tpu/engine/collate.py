@@ -1,0 +1,68 @@
+"""Collation: request payloads → bucket-padded device input batches.
+
+The TPU-critical step the reference does with ``torch.stack(...).cuda()``
+(``293-project/src/scheduler.py:443``): here every batch is padded UP to the
+scheduled (batch, seq) bucket so the engine always calls an already-compiled
+XLA program — arbitrary shapes would recompile per request mix
+(SURVEY.md §7 hard part (a)).
+
+Payload contracts by model family:
+- vision:           np.ndarray [H, W, C] float
+- text_classifier:  np.ndarray [T] int32 token ids (ragged across requests)
+- causal_lm:        np.ndarray [T] int32 prompt tokens (decode engine pads)
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.models.base import ServableModel
+
+
+def collate_vision(
+    model: ServableModel, requests: List[Request], batch_bucket: int
+) -> Tuple[Tuple[np.ndarray, ...], int]:
+    n = len(requests)
+    (spec,) = model.input_shapes(batch_bucket)
+    batch = np.zeros(spec.shape, dtype=spec.dtype)
+    for i, req in enumerate(requests):
+        batch[i] = np.asarray(req.payload, dtype=spec.dtype)
+    return (batch,), n
+
+
+def collate_text(
+    model: ServableModel,
+    requests: List[Request],
+    batch_bucket: int,
+    seq_bucket: int,
+) -> Tuple[Tuple[np.ndarray, np.ndarray], int]:
+    n = len(requests)
+    tokens = np.zeros((batch_bucket, seq_bucket), dtype=np.int32)
+    mask = np.zeros((batch_bucket, seq_bucket), dtype=np.int32)
+    for i, req in enumerate(requests):
+        ids = np.asarray(req.payload, dtype=np.int32)[:seq_bucket]
+        tokens[i, : len(ids)] = ids
+        mask[i, : len(ids)] = 1
+    # Padding rows keep all-zero masks; attention treats them as empty.
+    mask[n:, 0] = 1  # at least one valid key so softmax rows are well-formed
+    return (tokens, mask), n
+
+
+def collate(
+    model: ServableModel,
+    requests: List[Request],
+    batch_bucket: int,
+    seq_bucket: int = 0,
+) -> Tuple[Tuple[np.ndarray, ...], int]:
+    if model.family == "vision":
+        return collate_vision(model, requests, batch_bucket)
+    if model.family in ("text_classifier", "causal_lm"):
+        if seq_bucket <= 0:
+            seq_bucket = max(
+                (len(np.atleast_1d(r.payload)) for r in requests), default=1
+            )
+        return collate_text(model, requests, batch_bucket, seq_bucket)
+    raise ValueError(f"no collator for model family {model.family!r}")

@@ -1,0 +1,109 @@
+"""Sliding-window request-rate tracking.
+
+Re-creates the reference's ``RequestTracker``
+(``293-project/src/scheduler.py:115-169``: thread-safe requests/sec over a
+window that resets after ``window_size``). Here the window slides smoothly —
+per-second counts in a ring pruned on read — so the control loop never sees
+the sawtooth a hard reset produces.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+
+class RateTracker:
+    """Requests/sec over a sliding window (one instance per model)."""
+
+    def __init__(self, window_s: float = 10.0, clock=time.monotonic):
+        self.window_s = window_s
+        self._clock = clock
+        self._buckets: Deque[Tuple[int, int]] = deque()  # (second, count)
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def record(self, n: int = 1) -> None:
+        sec = int(self._clock())
+        with self._lock:
+            if self._buckets and self._buckets[-1][0] == sec:
+                s, c = self._buckets[-1]
+                self._buckets[-1] = (s, c + n)
+            else:
+                self._buckets.append((sec, n))
+            self._total += n
+            self._prune(sec)
+
+    def _prune(self, now_sec: int) -> None:
+        cutoff = now_sec - int(self.window_s)
+        while self._buckets and self._buckets[0][0] <= cutoff:
+            _, c = self._buckets.popleft()
+            self._total -= c
+
+    def rate_rps(self) -> float:
+        sec = int(self._clock())
+        with self._lock:
+            self._prune(sec)
+            if not self._buckets:
+                return 0.0
+            # Use the actual covered span so a cold start doesn't under-read.
+            span = max(1.0, min(self.window_s, sec - self._buckets[0][0] + 1))
+            return self._total / span
+
+
+class RateRegistry:
+    """Per-model trackers + significant-change detection for the control loop
+    (ref: threshold test at scheduler.py:794-801 — 5% change triggers a
+    reschedule, doubled for decreases)."""
+
+    def __init__(self, window_s: float = 10.0, clock=time.monotonic):
+        self.window_s = window_s
+        self._clock = clock
+        self._trackers: Dict[str, RateTracker] = {}
+        self._last_scheduled: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def tracker(self, model: str) -> RateTracker:
+        with self._lock:
+            if model not in self._trackers:
+                self._trackers[model] = RateTracker(self.window_s, self._clock)
+            return self._trackers[model]
+
+    def record(self, model: str, n: int = 1) -> None:
+        self.tracker(model).record(n)
+
+    def rates(self) -> Dict[str, float]:
+        with self._lock:
+            items = list(self._trackers.items())
+        return {m: t.rate_rps() for m, t in items}
+
+    def changed_models(
+        self, threshold: float, decrease_multiplier: float = 2.0
+    ) -> Dict[str, float]:
+        """Models whose rate moved beyond the threshold since the last
+        accepted schedule; increases trip at `threshold`, decreases at
+        `threshold * decrease_multiplier` (asymmetric — scaling down too
+        eagerly causes flapping, ref scheduler.py:794-801)."""
+        out: Dict[str, float] = {}
+        for model, rate in self.rates().items():
+            base = self._last_scheduled.get(model)
+            if base is None:
+                if rate > 0:
+                    out[model] = rate
+                continue
+            if base == 0:
+                if rate > 0:
+                    out[model] = rate
+                continue
+            delta = (rate - base) / base
+            if delta > threshold or -delta > threshold * decrease_multiplier:
+                out[model] = rate
+        return out
+
+    def mark_scheduled(self, rates: Optional[Dict[str, float]] = None) -> None:
+        self._last_scheduled.update(rates if rates is not None else self.rates())
+
+    def scheduled_rates(self) -> Dict[str, float]:
+        return dict(self._last_scheduled)

@@ -1,0 +1,61 @@
+"""Request object flowing through queues to the replica engine.
+
+Analogue of the reference's ``BatchRequest``
+(``293-project/src/scheduler.py:181-188``: request_id, data, arrival_time,
+SLO). Result delivery is a ``concurrent.futures.Future`` so the asyncio
+ingress can await it while the engine hot loop stays a plain thread.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+_req_counter = itertools.count(1)
+
+
+def now_ms() -> float:
+    return time.monotonic() * 1000.0
+
+
+@dataclass
+class Request:
+    model: str
+    payload: Any                      # model-specific input (np arrays, tokens)
+    slo_ms: float
+    request_id: str = ""
+    arrival_ms: float = field(default_factory=now_ms)
+    seq_len: int = 0                  # shape bucket hint for LLM inputs
+    future: Future = field(default_factory=Future)
+    trace_ctx: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if not self.request_id:
+            self.request_id = f"{self.model}-{next(_req_counter)}"
+
+    @property
+    def deadline_ms(self) -> float:
+        return self.arrival_ms + self.slo_ms
+
+    def queue_delay_ms(self, now: Optional[float] = None) -> float:
+        return (now if now is not None else now_ms()) - self.arrival_ms
+
+    def reject(self, exc: Exception) -> None:
+        if not self.future.done():
+            self.future.set_exception(exc)
+
+    def fulfill(self, result: Any) -> None:
+        if not self.future.done():
+            self.future.set_result(result)
+
+
+class RequestDropped(Exception):
+    """Raised into a request's future when the queue drops it."""
+
+
+class RequestStale(Exception):
+    """Raised when a request cannot meet its deadline and is discarded
+    (staleness discard, ref scheduler.py:281-283)."""

@@ -1,0 +1,305 @@
+"""Replica engine — one chip's duty-cycle executor.
+
+TPU-native re-design of the reference's ``GPUWorker`` actor
+(``293-project/src/scheduler.py:374-584``): an infinite duty-cycle round-robin
+over (session, occupancy) placements — take a batch from the session's queue
+(:551), run the forward (:435-472), sleep out the rest of the time slice
+(:564-570) — with schedule updates applied at cycle boundaries via an update
+channel (:483-523, :906-929).
+
+TPU-first differences:
+- the "forward" is an **already-compiled XLA program** selected from a
+  (model, batch-bucket, seq-bucket) cache; inputs are bucket-padded by
+  ``collate`` so the hot loop never traces or compiles;
+- hot-swap **precompiles before going live**: a new schedule's buckets are
+  compiled while the old schedule keeps serving, then swapped at a cycle
+  boundary — the TPU analogue of unload→``empty_cache``→load, where the cost
+  is XLA compile + weight upload rather than allocator churn
+  (SURVEY.md §7 hard parts (a)/(b));
+- timing uses ``block_until_ready`` walls (device timeline), and the slice
+  sleep accounts for the measured step, mirroring the reference's
+  ``cuda.synchronize`` timing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from queue import Empty, SimpleQueue
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ray_dynamic_batching_tpu.engine.batching import BatchPolicy, NexusFixedBatch
+from ray_dynamic_batching_tpu.engine.collate import collate
+from ray_dynamic_batching_tpu.engine.host import ModelHost
+from ray_dynamic_batching_tpu.engine.queue import QueueManager
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.scheduler.nexus import NodePlan, Placement
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils import metrics as m
+
+logger = get_logger("engine")
+
+# Module-level metrics (single registration; tagged per model/engine).
+BATCHES_TOTAL = m.Counter(
+    "rdb_engine_batches_total", "Batches executed", tag_keys=("engine", "model")
+)
+REQUESTS_TOTAL = m.Counter(
+    "rdb_engine_requests_total", "Requests served", tag_keys=("engine", "model")
+)
+STEP_LATENCY_MS = m.Histogram(
+    "rdb_engine_step_latency_ms", "Compiled step latency", tag_keys=("engine", "model")
+)
+ENGINE_OCCUPANCY = m.Gauge(
+    "rdb_engine_occupancy", "Scheduled occupancy", tag_keys=("engine",)
+)
+SWAP_TOTAL = m.Counter(
+    "rdb_engine_schedule_swaps_total", "Schedule hot-swaps applied", tag_keys=("engine",)
+)
+
+
+@dataclass
+class CompiledStep:
+    """One (model, batch_bucket, seq_bucket) compiled program + its params."""
+
+    model_name: str
+    batch_bucket: int
+    seq_bucket: int
+    fn: Callable[..., Any]
+    model: Any
+    params: Any
+
+
+@dataclass
+class ActiveSchedule:
+    """The engine's live schedule (placements share one duty cycle)."""
+
+    placements: List[Placement] = field(default_factory=list)
+    duty_cycle_ms: float = 0.0
+    steps: Dict[str, CompiledStep] = field(default_factory=dict)  # by model
+    policies: Dict[str, BatchPolicy] = field(default_factory=dict)
+
+
+class ReplicaEngine:
+    """One executor thread bound to one chip (or one mesh slice)."""
+
+    def __init__(
+        self,
+        engine_id: str,
+        queues: QueueManager,
+        host: ModelHost,
+        seq_bucket_default: int = 0,
+        idle_wait_s: float = 0.01,
+    ):
+        self.engine_id = engine_id
+        self.queues = queues
+        self.host = host
+        self.seq_bucket_default = seq_bucket_default
+        self.idle_wait_s = idle_wait_s
+        self._ready: SimpleQueue = SimpleQueue()  # prepared schedules
+        self._schedule = ActiveSchedule()
+        self._active = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._cycle_count = 0
+        self._last_error: Optional[Exception] = None
+        self._pending_plan: Optional[NodePlan] = None
+        self._assign_lock = threading.Lock()
+        self._preparer: Optional[threading.Thread] = None
+
+    # --- schedule handoff (ref update_queues.put, scheduler.py:906-929) ---
+    def assign(self, plan: NodePlan) -> None:
+        """Queue a new node plan. Params load + XLA compiles run on a
+        background preparer thread while the old schedule keeps serving; the
+        hot loop only performs the pointer swap at a cycle boundary."""
+        with self._assign_lock:
+            self._pending_plan = plan
+            if self._preparer is None or not self._preparer.is_alive():
+                self._preparer = threading.Thread(
+                    target=self._prepare_loop,
+                    name=f"engine-{self.engine_id}-prepare",
+                    daemon=True,
+                )
+                self._preparer.start()
+
+    def _prepare_loop(self) -> None:
+        while True:
+            with self._assign_lock:
+                plan = self._pending_plan
+                self._pending_plan = None
+                if plan is None:
+                    self._preparer = None
+                    return
+            try:
+                self._ready.put((plan, self._prepare(plan)))
+            except Exception as e:  # noqa: BLE001
+                self._last_error = e
+                logger.exception(
+                    "%s: schedule preparation failed; keeping old schedule",
+                    self.engine_id,
+                )
+
+    def _prepare(self, plan: NodePlan) -> ActiveSchedule:
+        """Load params + compile every placement's bucket BEFORE going live
+        (the reference loads inside the swap window, :507-515; on TPU that
+        would stall serving for the full XLA compile)."""
+        steps: Dict[str, CompiledStep] = {}
+        policies: Dict[str, BatchPolicy] = {}
+        for p in plan.placements:
+            name = p.session.model
+            model, params = self.host.acquire(name)
+            seq = p.session.seq_len or self.seq_bucket_default
+            fn = jax.jit(model.apply)
+            example = model.example_inputs(p.batch_size, seq or None)
+            if seq == 0 and model.family in ("text_classifier", "causal_lm"):
+                # Collate must pad to the exact shape the AOT program was
+                # lowered with; recover the model's default seq bucket.
+                seq = int(example[0].shape[1])
+            compiled = fn.lower(params, *example).compile()
+            steps[name] = CompiledStep(
+                model_name=name,
+                batch_bucket=p.batch_size,
+                seq_bucket=seq,
+                fn=compiled,
+                model=model,
+                params=params,
+            )
+            policies[name] = NexusFixedBatch(
+                p.batch_size, expected_latency_ms=p.latency_ms
+            )
+        return ActiveSchedule(
+            placements=list(plan.placements),
+            duty_cycle_ms=plan.duty_cycle_ms,
+            steps=steps,
+            policies=policies,
+        )
+
+    def _apply_updates(self) -> None:
+        """Swap in the newest prepared schedule, if any (ref
+        _check_for_updates, :483-523: unload removed → load added → swap
+        atomically — here load/compile already happened off-thread)."""
+        latest = None
+        while True:
+            try:
+                latest = self._ready.get_nowait()
+            except Empty:
+                break
+        if latest is None:
+            return
+        plan, new_schedule = latest
+        old_models = set(self._schedule.steps)
+        self._schedule = new_schedule  # atomic swap at cycle boundary
+        # Each ActiveSchedule owns exactly one host reference per model
+        # (_prepare acquired for the new one), so release ALL old refs —
+        # retained models keep a balanced count, removed ones unload.
+        for name in old_models:
+            self.host.release(name)
+        ENGINE_OCCUPANCY.set(
+            sum(p.occupancy for p in plan.placements),
+            tags={"engine": self.engine_id},
+        )
+        SWAP_TOTAL.inc(tags={"engine": self.engine_id})
+        logger.info("%s: swapped to %s", self.engine_id, plan.describe())
+
+    # --- hot loop (ref execute_schedule, scheduler.py:525-584) ------------
+    def _run_placement(self, p: Placement, step: CompiledStep,
+                       policy: BatchPolicy) -> float:
+        """Execute one session's slice; returns elapsed ms."""
+        name = p.session.model
+        queue = self.queues.queue(name)
+        batch = policy.next_batch(queue)
+        if not batch:
+            return 0.0
+        t0 = time.perf_counter()
+        inputs, n_real = collate(
+            step.model, batch, step.batch_bucket, step.seq_bucket
+        )
+        try:
+            out = step.fn(step.params, *inputs)
+            out = jax.block_until_ready(out)
+        except Exception as e:  # noqa: BLE001
+            for req in batch:
+                req.reject(e)
+            self._last_error = e
+            logger.error("%s/%s: step failed: %s", self.engine_id, name, e)
+            return (time.perf_counter() - t0) * 1000.0
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        results = np.asarray(out)[:n_real]
+        for req, res in zip(batch, results):
+            req.fulfill(res)
+        queue.record_batch_completion(batch)
+        BATCHES_TOTAL.inc(tags={"engine": self.engine_id, "model": name})
+        REQUESTS_TOTAL.inc(n_real, tags={"engine": self.engine_id, "model": name})
+        STEP_LATENCY_MS.observe(
+            elapsed_ms, tags={"engine": self.engine_id, "model": name}
+        )
+        return elapsed_ms
+
+    def _run_cycle(self) -> None:
+        sched = self._schedule
+        if not sched.placements:
+            time.sleep(self.idle_wait_s)
+            return
+        cycle_start = time.perf_counter()
+        for p in sched.placements:
+            step = sched.steps[p.session.model]
+            policy = sched.policies[p.session.model]
+            elapsed_ms = self._run_placement(p, step, policy)
+            # Sleep out the remainder of this session's slice so co-tenants
+            # get their scheduled share (ref :564-570).
+            slice_ms = p.occupancy * sched.duty_cycle_ms
+            remaining_ms = slice_ms - elapsed_ms
+            if remaining_ms > 0.05:
+                time.sleep(remaining_ms / 1000.0)
+        # Absorb any leftover duty-cycle time (unallocated occupancy).
+        total_ms = (time.perf_counter() - cycle_start) * 1000.0
+        leftover_ms = sched.duty_cycle_ms - total_ms
+        if leftover_ms > 0.05:
+            time.sleep(leftover_ms / 1000.0)
+        self._cycle_count += 1
+
+    def _loop(self) -> None:
+        while self._active.is_set():
+            try:
+                self._apply_updates()
+                self._run_cycle()
+            except Exception as e:  # noqa: BLE001 — engine must not die silently
+                self._last_error = e
+                logger.exception("%s: cycle failed", self.engine_id)
+                time.sleep(0.05)
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._active.set()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"engine-{self.engine_id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._active.clear()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+        for name in list(self._schedule.steps):
+            self.host.release(name)
+        self._schedule = ActiveSchedule()
+
+    @property
+    def cycle_count(self) -> int:
+        return self._cycle_count
+
+    @property
+    def models(self) -> List[str]:
+        return list(self._schedule.steps)
+
+    def describe(self) -> str:
+        s = self._schedule
+        return (
+            f"ReplicaEngine({self.engine_id}, duty={s.duty_cycle_ms:.1f}ms, "
+            f"models={sorted(s.steps)})"
+        )

@@ -112,14 +112,15 @@ class CausalLM(ServableModel):
     ) -> Tuple[jax.Array, KVCache]:
         """One decode step for all slots; returns logits [B, V] + new cache.
 
-        Rows whose cache is full are force-deactivated: their scatter would be
-        dropped (JAX out-of-bounds update) and their logits would be garbage,
-        so ``lengths`` stops advancing at capacity and the engine detects
-        exhaustion via ``lengths == capacity`` instead of silently decoding on.
+        Rows whose cache is full are force-deactivated: their out-of-bounds
+        scatter is explicitly dropped (decoder writes with mode="drop"), their
+        logits are garbage, and ``lengths`` stops advancing at capacity, so
+        the engine detects exhaustion via ``lengths == capacity`` instead of
+        silently decoding on (or corrupting the last cache slot).
         """
         in_bounds = cache.lengths < cache.capacity
         active = jnp.logical_and(active, in_bounds)
-        positions = jnp.minimum(cache.lengths, cache.capacity - 1)[:, None]
+        positions = cache.lengths[:, None]
         mask = decode_mask(cache.lengths, cache.capacity)
         logits, new_cache = self.module.apply(params, tokens, positions, mask, cache)
         new_lengths = cache.lengths + active.astype(jnp.int32)

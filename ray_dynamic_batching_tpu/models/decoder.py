@@ -145,10 +145,12 @@ class DecoderLayer(nn.Module):
             B, T = positions.shape
             if T == 1:
                 # Decode: scatter this token's k/v at its row position.
+                # mode="drop" makes a full row's out-of-bounds write a no-op
+                # instead of clamping onto (and corrupting) the last slot.
                 idx = positions[:, 0]
                 rows = jnp.arange(B)
-                k_cache = k_cache.at[rows, idx].set(k[:, 0])
-                v_cache = v_cache.at[rows, idx].set(v[:, 0])
+                k_cache = k_cache.at[rows, idx].set(k[:, 0], mode="drop")
+                v_cache = v_cache.at[rows, idx].set(v[:, 0], mode="drop")
             else:
                 # Prefill into an empty cache: contiguous write at offset 0.
                 k_cache = jax.lax.dynamic_update_slice(

@@ -1,0 +1,299 @@
+"""Live scheduler — rate monitoring, rebalance, minimal-movement migration.
+
+Re-creates the reference's ``NexusScheduler`` control plane
+(``293-project/src/scheduler.py:602-929``): a monitoring loop samples per-model
+request rates every interval (:763), re-runs squishy bin packing when a rate
+moves past the threshold (5%, doubled for decreases — :794-801), then matches
+old→new node plans to minimize model movement (:857-891) and pushes the new
+(sessions, duty-cycle) to each worker's update channel (:906-929).
+
+TPU-first difference: a "transfer" costs a weight upload **plus an XLA
+compile** for every (model, bucket) the target engine hasn't compiled, so the
+matcher's objective is weighted by profile-measured compile_ms + HBM bytes
+instead of a flat transfer count (SURVEY.md §7 stage 5).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_dynamic_batching_tpu.engine.queue import QueueManager
+from ray_dynamic_batching_tpu.engine.rates import RateRegistry
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.engine.worker import ReplicaEngine
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.scheduler.nexus import (
+    NodePlan,
+    Session,
+    SquishyBinPacker,
+)
+from ray_dynamic_batching_tpu.utils.config import get_config
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("control")
+
+BRUTE_FORCE_LIMIT = 7  # assignment is brute-forced up to this many nodes
+
+
+@dataclass
+class ModelEntry:
+    """Registered model contract (ref models_config, scheduler.py:30-35)."""
+
+    name: str
+    slo_ms: float
+    seq_len: int = 0
+
+
+def transfer_cost(
+    engine_models: frozenset,
+    plan: NodePlan,
+    profiles: Dict[str, BatchProfile],
+) -> float:
+    """Cost of pointing an engine at ``plan``: for every model the engine
+    doesn't already host, charge weight bytes (upload) + compile time."""
+    cost = 0.0
+    for p in plan.placements:
+        name = p.session.model
+        if name in engine_models:
+            continue
+        prof = profiles.get(name)
+        if prof is None:
+            cost += 1.0
+            continue
+        row = prof.row_for(p.batch_size, p.session.seq_len) or prof.bucket_for(
+            p.batch_size, p.session.seq_len
+        )
+        compile_ms = row.compile_ms if row else 1000.0
+        weight_mb = prof.weights_hbm_bytes() / 1e6
+        cost += compile_ms + weight_mb  # ms-equivalent weighting
+    return cost
+
+
+def match_plans_to_engines(
+    engine_models: List[frozenset],
+    plans: List[NodePlan],
+    profiles: Dict[str, BatchProfile],
+) -> List[Optional[NodePlan]]:
+    """Assign new node plans to engines minimizing total transfer cost.
+
+    Brute-force over permutations for small counts (the reference's approach,
+    scheduler.py:857-891), greedy best-match beyond BRUTE_FORCE_LIMIT.
+    Returns, per engine, its new plan (None = engine idles).
+    """
+    n_engines = len(engine_models)
+    padded: List[Optional[NodePlan]] = list(plans) + [None] * max(
+        0, n_engines - len(plans)
+    )
+    if len(plans) > n_engines:
+        logger.warning(
+            "plan needs %d chips but only %d engines; truncating (capacity!)",
+            len(plans), n_engines,
+        )
+        padded = list(plans[:n_engines])
+
+    if n_engines <= BRUTE_FORCE_LIMIT:
+        best: Optional[Tuple[float, Tuple[int, ...]]] = None
+        for perm in itertools.permutations(range(n_engines)):
+            cost = sum(
+                transfer_cost(engine_models[e], padded[i], profiles)
+                for i, e in enumerate(perm)
+                if padded[i] is not None
+            )
+            if best is None or cost < best[0]:
+                best = (cost, perm)
+        assignment: List[Optional[NodePlan]] = [None] * n_engines
+        for i, e in enumerate(best[1]):
+            assignment[e] = padded[i]
+        return assignment
+
+    # Greedy: most expensive-to-move plans pick their cheapest engine first.
+    order = sorted(
+        [i for i, p in enumerate(padded) if p is not None],
+        key=lambda i: -max(
+            transfer_cost(m, padded[i], profiles) for m in engine_models
+        ),
+    )
+    free = set(range(n_engines))
+    assignment = [None] * n_engines
+    for i in order:
+        # Tie-break toward engines hosting fewer models so a zero-savings
+        # plan lands on an empty engine instead of displacing a warm one.
+        e = min(
+            free,
+            key=lambda e: (
+                transfer_cost(engine_models[e], padded[i], profiles),
+                len(engine_models[e]),
+                e,
+            ),
+        )
+        assignment[e] = padded[i]
+        free.remove(e)
+    return assignment
+
+
+class LiveScheduler:
+    """The running control plane for one scheduling domain."""
+
+    def __init__(
+        self,
+        packer: SquishyBinPacker,
+        engines: Sequence[ReplicaEngine],
+        queues: Optional[QueueManager] = None,
+        rates: Optional[RateRegistry] = None,
+        metrics_path: Optional[str] = None,
+        clock=time.monotonic,
+    ):
+        cfg = get_config()
+        self.packer = packer
+        self.engines = list(engines)
+        self.queues = queues or QueueManager(max_len=cfg.max_queue_len)
+        self.rates = rates or RateRegistry(window_s=cfg.rate_window_s)
+        self.metrics_path = metrics_path
+        self.monitoring_interval_s = cfg.monitoring_interval_s
+        self.rate_threshold = cfg.rate_change_threshold
+        self.rate_decrease_multiplier = cfg.rate_decrease_multiplier
+        self._clock = clock
+        self._models: Dict[str, ModelEntry] = {}
+        self._current_plan: List[NodePlan] = []
+        self._assignment: List[Optional[NodePlan]] = [None] * len(self.engines)
+        self._lock = threading.Lock()
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.schedule_changes = 0
+        self.schedule_log: List[Dict] = []
+
+    # --- registration (ref models_config) ---------------------------------
+    def register_model(self, name: str, slo_ms: float, seq_len: int = 0) -> None:
+        if name not in self.packer.profiles:
+            raise KeyError(f"no batch profile for model {name!r}")
+        self._models[name] = ModelEntry(name, slo_ms, seq_len)
+
+    # --- ingress path (ref submit_request, scheduler.py:734-751) ----------
+    def submit_request(self, request: Request) -> bool:
+        entry = self._models.get(request.model)
+        if entry is None:
+            request.reject(KeyError(f"model {request.model!r} not registered"))
+            return False
+        ok = self.queues.queue(request.model).add_request(request)
+        if ok:
+            self.rates.record(request.model)
+        return ok
+
+    # --- scheduling -------------------------------------------------------
+    def _sessions_for(self, rates: Dict[str, float]) -> List[Session]:
+        return [
+            Session(
+                model=e.name,
+                slo_ms=e.slo_ms,
+                rate_rps=rates.get(e.name, 0.0),
+                seq_len=e.seq_len,
+            )
+            for e in self._models.values()
+        ]
+
+    def rebalance(self, rates: Optional[Dict[str, float]] = None) -> List[NodePlan]:
+        """Re-run bin packing and migrate with minimal movement
+        (ref _update_schedule, scheduler.py:834-929)."""
+        with self._lock:
+            rates = rates if rates is not None else self.rates.rates()
+            plan = self.packer.plan(self._sessions_for(rates))
+            engine_models = [
+                frozenset(e.models) for e in self.engines
+            ]
+            assignment = match_plans_to_engines(
+                engine_models, plan, self.packer.profiles
+            )
+            for engine, node_plan in zip(self.engines, assignment):
+                if node_plan is not None:
+                    engine.assign(node_plan)
+                elif engine.models:
+                    engine.assign(NodePlan())  # idle this engine
+            self._current_plan = plan
+            self._assignment = assignment
+            self.rates.mark_scheduled(rates)
+            self.schedule_changes += 1
+            self.schedule_log.append(
+                {
+                    "ts": self._clock(),
+                    "rates": dict(rates),
+                    "nodes": [n.describe() for n in plan],
+                }
+            )
+            logger.info(
+                "rebalance #%d: %d nodes for rates %s",
+                self.schedule_changes, len(plan),
+                {k: round(v, 1) for k, v in rates.items()},
+            )
+            return plan
+
+    # --- monitor loop (ref _monitor_request_rates, scheduler.py:763-801) --
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.monitoring_interval_s):
+            try:
+                changed = self.rates.changed_models(
+                    self.rate_threshold, self.rate_decrease_multiplier
+                )
+                if changed:
+                    logger.info("rate change detected: %s", changed)
+                    self.rebalance()
+                if self.metrics_path:
+                    self.write_metrics()
+            except Exception:  # noqa: BLE001
+                logger.exception("monitor iteration failed")
+
+    def start_monitoring(self) -> None:
+        if self._monitor is not None:
+            return
+        self._stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="rdb-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    def stop_monitoring(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
+
+    # --- observability (ref metrics.json writer, scheduler.py:969-983) ----
+    def snapshot(self) -> Dict:
+        return {
+            "time": self._clock(),
+            "rates_rps": self.rates.rates(),
+            "scheduled_rates_rps": self.rates.scheduled_rates(),
+            "queues": self.queues.stats(),
+            "plan": [n.describe() for n in self._current_plan],
+            "engines": [e.describe() for e in self.engines],
+            "schedule_changes": self.schedule_changes,
+        }
+
+    def write_metrics(self) -> None:
+        with open(self.metrics_path, "w") as f:
+            json.dump(self.snapshot(), f, indent=2)
+
+    def render_status(self) -> str:
+        """Terminal SLO status (ref metrics_display.py:42-66: ✓ >=98%,
+        warning >=95%, critical below)."""
+        cfg = get_config()
+        lines = [f"{'model':<20} {'rate':>8} {'p95ms':>8} {'p99ms':>8} "
+                 f"{'depth':>6} {'SLO%':>7} status"]
+        rates = self.rates.rates()
+        for name, stats in sorted(self.queues.stats().items()):
+            c = stats["slo_compliance"]
+            status = (
+                "ok" if c >= cfg.slo_good_threshold
+                else "warning" if c >= cfg.slo_warn_threshold
+                else "CRITICAL"
+            )
+            lines.append(
+                f"{name:<20} {rates.get(name, 0.0):>8.1f} "
+                f"{stats['latency_p95_ms']:>8.1f} {stats['latency_p99_ms']:>8.1f} "
+                f"{stats['depth']:>6.0f} {c * 100:>6.1f}% {status}"
+            )
+        return "\n".join(lines)

@@ -1,0 +1,235 @@
+"""Engine tests: rates, queues, batching policies, live replica engine."""
+
+import time
+from concurrent.futures import wait
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_dynamic_batching_tpu.engine.batching import (
+    NexusFixedBatch,
+    OpportunisticBatch,
+)
+from ray_dynamic_batching_tpu.engine.host import ModelHost
+from ray_dynamic_batching_tpu.engine.queue import QueueManager, RequestQueue
+from ray_dynamic_batching_tpu.engine.rates import RateRegistry, RateTracker
+from ray_dynamic_batching_tpu.engine.request import (
+    Request,
+    RequestDropped,
+    RequestStale,
+)
+from ray_dynamic_batching_tpu.engine.worker import ReplicaEngine
+from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+from ray_dynamic_batching_tpu.scheduler.nexus import (
+    NodePlan,
+    Placement,
+    Session,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestRates:
+    def test_rate_within_window(self):
+        clock = FakeClock()
+        tr = RateTracker(window_s=10.0, clock=clock)
+        for _ in range(50):
+            tr.record()
+        clock.advance(4.0)
+        for _ in range(50):
+            tr.record()
+        assert tr.rate_rps() == pytest.approx(100 / 5.0)
+
+    def test_old_buckets_pruned(self):
+        clock = FakeClock()
+        tr = RateTracker(window_s=5.0, clock=clock)
+        tr.record(100)
+        clock.advance(10.0)
+        assert tr.rate_rps() == 0.0
+
+    def test_change_detection_asymmetric(self):
+        clock = FakeClock()
+        reg = RateRegistry(window_s=10.0, clock=clock)
+        reg.record("m", 100)
+        reg.mark_scheduled()
+        base = reg.scheduled_rates()["m"]
+        # +4% -> no trigger at 5% threshold
+        reg.record("m", int(base * 0.4))  # small bump within same window
+        changed = reg.changed_models(threshold=0.5, decrease_multiplier=2.0)
+        assert "m" not in changed
+        # big increase trips
+        reg.record("m", 1000)
+        assert "m" in reg.changed_models(threshold=0.5)
+        # decreases need 2x threshold: simulate decay by advancing clock
+        reg.mark_scheduled()
+        clock.advance(9.0)
+        rates = reg.rates()
+        assert "m" in reg.changed_models(threshold=0.05)
+
+
+class TestQueue:
+    def test_drop_when_full(self):
+        q = RequestQueue("m", max_len=2)
+        r1, r2, r3 = (Request("m", i, slo_ms=1000) for i in range(3))
+        assert q.add_request(r1) and q.add_request(r2)
+        assert not q.add_request(r3)
+        with pytest.raises(RequestDropped):
+            r3.future.result(timeout=1)
+        assert q.stats()["dropped"] == 1
+
+    def test_batch_pop_single_sweep(self):
+        q = RequestQueue("m")
+        reqs = [Request("m", i, slo_ms=1000) for i in range(10)]
+        for r in reqs:
+            q.add_request(r)
+        batch = q.get_batch(4)
+        assert [r.payload for r in batch] == [0, 1, 2, 3]
+        assert len(q) == 6
+
+    def test_staleness_discard(self):
+        q = RequestQueue("m")
+        fresh = Request("m", "fresh", slo_ms=10_000)
+        stale = Request("m", "stale", slo_ms=1.0)
+        q.add_request(stale)
+        q.add_request(fresh)
+        time.sleep(0.01)
+        batch = q.get_batch(8, expected_latency_ms=5.0)
+        assert [r.payload for r in batch] == ["fresh"]
+        with pytest.raises(RequestStale):
+            stale.future.result(timeout=1)
+        assert q.stats()["stale"] == 1
+
+    def test_slo_accounting(self):
+        q = RequestQueue("m")
+        good = Request("m", 1, slo_ms=10_000)
+        bad = Request("m", 2, slo_ms=0.001)
+        q.add_request(good), q.add_request(bad)
+        batch = q.get_batch(2, discard_stale=False)
+        violations = q.record_batch_completion(batch)
+        assert violations == 1
+        assert q.slo_compliance() == 0.5
+        s = q.stats()
+        assert s["completed"] == 2 and s["violations"] == 1
+        assert s["latency_p95_ms"] >= 0
+
+
+class TestPolicies:
+    def test_nexus_fixed_never_waits(self):
+        q = RequestQueue("m")
+        pol = NexusFixedBatch(batch_size=4)
+        assert pol.next_batch(q) == []
+        for i in range(6):
+            q.add_request(Request("m", i, slo_ms=1000))
+        assert len(pol.next_batch(q)) == 4
+
+    def test_opportunistic_returns_on_size(self):
+        q = RequestQueue("m")
+        for i in range(8):
+            q.add_request(Request("m", i, slo_ms=1000))
+        pol = OpportunisticBatch(max_batch_size=8, batch_wait_timeout_s=5.0)
+        t0 = time.monotonic()
+        batch = pol.next_batch(q)
+        assert len(batch) == 8
+        assert time.monotonic() - t0 < 1.0  # did not wait for timeout
+
+    def test_opportunistic_returns_on_timeout(self):
+        q = RequestQueue("m")
+        q.add_request(Request("m", 0, slo_ms=1000))
+        pol = OpportunisticBatch(max_batch_size=64, batch_wait_timeout_s=0.05)
+        t0 = time.monotonic()
+        batch = pol.next_batch(q)
+        elapsed = time.monotonic() - t0
+        assert len(batch) == 1
+        assert elapsed < 1.0
+
+
+def _plan_for(model_name: str, batch: int, seq: int = 0,
+              duty_ms: float = 20.0) -> NodePlan:
+    s = Session(model_name, slo_ms=5000.0, rate_rps=100.0, seq_len=seq)
+    return NodePlan(
+        placements=[
+            Placement(
+                session=s, batch_size=batch, latency_ms=5.0,
+                occupancy=0.5, hbm_bytes=0,
+            )
+        ],
+        duty_cycle_ms=duty_ms,
+    )
+
+
+class TestReplicaEngine:
+    @pytest.fixture
+    def setup(self):
+        queues = QueueManager()
+        host = ModelHost(model_kwargs={
+            "distilbert_tiny": {"dtype": jnp.float32},
+            "vit_tiny": {"dtype": jnp.float32},
+        })
+        engine = ReplicaEngine("e0", queues, host)
+        yield queues, host, engine
+        engine.stop()
+
+    def test_serves_requests_end_to_end(self, setup):
+        queues, host, engine = setup
+        engine.assign(_plan_for("distilbert_tiny", batch=4, seq=16))
+        engine.start()
+        reqs = [
+            Request("distilbert_tiny", np.arange(5) + i, slo_ms=30_000)
+            for i in range(10)
+        ]
+        for r in reqs:
+            queues.queue("distilbert_tiny").add_request(r)
+        done, not_done = wait([r.future for r in reqs], timeout=60)
+        assert not not_done
+        for r in reqs:
+            out = r.future.result()
+            assert out.shape == (2,)  # SST-2 logits
+        stats = queues.queue("distilbert_tiny").stats()
+        assert stats["completed"] == 10
+        assert stats["slo_compliance"] == 1.0
+
+    def test_hot_swap_models(self, setup):
+        queues, host, engine = setup
+        engine.assign(_plan_for("distilbert_tiny", batch=2, seq=16))
+        engine.start()
+        r = Request("distilbert_tiny", np.arange(4), slo_ms=30_000)
+        queues.queue("distilbert_tiny").add_request(r)
+        r.future.result(timeout=60)
+        assert engine.models == ["distilbert_tiny"]
+        # swap to vit_tiny; distilbert must unload
+        engine.assign(_plan_for("vit_tiny", batch=2))
+        img = np.zeros((32, 32, 3), np.float32)
+        deadline = time.monotonic() + 60
+        served = False
+        while time.monotonic() < deadline:
+            rv = Request("vit_tiny", img, slo_ms=30_000)
+            queues.queue("vit_tiny").add_request(rv)
+            try:
+                out = rv.future.result(timeout=5)
+                served = True
+                break
+            except Exception:
+                continue
+        assert served and out.shape == (10,)
+        assert "vit_tiny" in engine.models
+        assert host.loaded_models().get("distilbert_tiny") is None
+
+    def test_padding_partial_batches(self, setup):
+        queues, host, engine = setup
+        engine.assign(_plan_for("distilbert_tiny", batch=8, seq=16))
+        engine.start()
+        # single request into a batch-8 program: padded, result unpadded
+        r = Request("distilbert_tiny", np.arange(3), slo_ms=30_000)
+        queues.queue("distilbert_tiny").add_request(r)
+        out = r.future.result(timeout=60)
+        assert out.shape == (2,)
