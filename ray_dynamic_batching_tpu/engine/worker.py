@@ -108,6 +108,12 @@ class ReplicaEngine:
         self._pending_plan: Optional[NodePlan] = None
         self._assign_lock = threading.Lock()
         self._preparer: Optional[threading.Thread] = None
+        # Compiled-executable cache keyed (model, batch_bucket, seq_bucket):
+        # rebalancing between schedules that share buckets must not pay the
+        # 20-40s XLA compile again. Executables hold code, not weights, so
+        # they survive model unload/reload (params are call arguments).
+        self._compile_cache: Dict[Tuple[str, int, int], Any] = {}
+        self._compile_cache_cap = 64
 
     # --- schedule handoff (ref update_queues.put, scheduler.py:906-929) ---
     def assign(self, plan: NodePlan) -> None:
@@ -147,28 +153,42 @@ class ReplicaEngine:
         would stall serving for the full XLA compile)."""
         steps: Dict[str, CompiledStep] = {}
         policies: Dict[str, BatchPolicy] = {}
-        for p in plan.placements:
-            name = p.session.model
-            model, params = self.host.acquire(name)
-            seq = p.session.seq_len or self.seq_bucket_default
-            fn = jax.jit(model.apply)
-            example = model.example_inputs(p.batch_size, seq or None)
-            if seq == 0 and model.family in ("text_classifier", "causal_lm"):
-                # Collate must pad to the exact shape the AOT program was
-                # lowered with; recover the model's default seq bucket.
-                seq = int(example[0].shape[1])
-            compiled = fn.lower(params, *example).compile()
-            steps[name] = CompiledStep(
-                model_name=name,
-                batch_bucket=p.batch_size,
-                seq_bucket=seq,
-                fn=compiled,
-                model=model,
-                params=params,
-            )
-            policies[name] = NexusFixedBatch(
-                p.batch_size, expected_latency_ms=p.latency_ms
-            )
+        acquired: List[str] = []
+        try:
+            for p in plan.placements:
+                name = p.session.model
+                model, params = self.host.acquire(name)
+                acquired.append(name)
+                seq = p.session.seq_len or self.seq_bucket_default
+                example = model.example_inputs(p.batch_size, seq or None)
+                if seq == 0 and model.family in ("text_classifier", "causal_lm"):
+                    # Collate must pad to the exact shape the AOT program was
+                    # lowered with; recover the model's default seq bucket.
+                    seq = int(example[0].shape[1])
+                key = (name, p.batch_size, seq)
+                compiled = self._compile_cache.get(key)
+                if compiled is None:
+                    compiled = jax.jit(model.apply).lower(
+                        params, *example
+                    ).compile()
+                    if len(self._compile_cache) >= self._compile_cache_cap:
+                        self._compile_cache.pop(next(iter(self._compile_cache)))
+                    self._compile_cache[key] = compiled
+                steps[name] = CompiledStep(
+                    model_name=name,
+                    batch_bucket=p.batch_size,
+                    seq_bucket=seq,
+                    fn=compiled,
+                    model=model,
+                    params=params,
+                )
+                policies[name] = NexusFixedBatch(
+                    p.batch_size, expected_latency_ms=p.latency_ms
+                )
+        except Exception:
+            for name in acquired:  # roll back refs or params leak in HBM
+                self.host.release(name)
+            raise
         return ActiveSchedule(
             placements=list(plan.placements),
             duty_cycle_ms=plan.duty_cycle_ms,
@@ -183,9 +203,14 @@ class ReplicaEngine:
         latest = None
         while True:
             try:
-                latest = self._ready.get_nowait()
+                candidate = self._ready.get_nowait()
             except Empty:
                 break
+            if latest is not None:
+                # Superseded schedule: release the refs its _prepare acquired.
+                for name in latest[1].steps:
+                    self.host.release(name)
+            latest = candidate
         if latest is None:
             return
         plan, new_schedule = latest
@@ -213,12 +238,16 @@ class ReplicaEngine:
         if not batch:
             return 0.0
         t0 = time.perf_counter()
-        inputs, n_real = collate(
-            step.model, batch, step.batch_bucket, step.seq_bucket
-        )
         try:
+            inputs, n_real = collate(
+                step.model, batch, step.batch_bucket, step.seq_bucket
+            )
             out = step.fn(step.params, *inputs)
-            out = jax.block_until_ready(out)
+            # np.asarray forces the device->host fetch, which is the only
+            # reliable completion signal on the axon tunnel (block_until_ready
+            # returns early there); the engine needs the results host-side
+            # anyway to fulfill futures.
+            results = np.asarray(out)[:n_real]
         except Exception as e:  # noqa: BLE001
             for req in batch:
                 req.reject(e)
@@ -226,7 +255,6 @@ class ReplicaEngine:
             logger.error("%s/%s: step failed: %s", self.engine_id, name, e)
             return (time.perf_counter() - t0) * 1000.0
         elapsed_ms = (time.perf_counter() - t0) * 1000.0
-        results = np.asarray(out)[:n_real]
         for req, res in zip(batch, results):
             req.fulfill(res)
         queue.record_batch_completion(batch)
@@ -285,6 +313,15 @@ class ReplicaEngine:
         if self._thread is not None:
             self._thread.join(timeout_s)
             self._thread = None
+        # Release refs of the live schedule AND any prepared-but-unapplied
+        # schedules still sitting in the ready queue.
+        while True:
+            try:
+                _, sched = self._ready.get_nowait()
+            except Empty:
+                break
+            for name in sched.steps:
+                self.host.release(name)
         for name in list(self._schedule.steps):
             self.host.release(name)
         self._schedule = ActiveSchedule()

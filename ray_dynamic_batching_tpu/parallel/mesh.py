@@ -1,0 +1,155 @@
+"""Device-mesh management — the framework's ICI/DCN substrate.
+
+TPU-native replacement for the reference's collective-group machinery
+(``python/ray/util/collective/collective.py:40-151`` — named NCCL/Gloo groups
+over actors; ``nccl_collective_group.py:128`` allreduce): instead of explicit
+collective calls between actors, the framework lays models out over a
+``jax.sharding.Mesh`` and lets XLA insert ``psum``/``all_gather``/
+``reduce_scatter`` over ICI under ``jit`` (SURVEY.md §2.4 translation table).
+
+Axes (logical → physical):
+- ``dp``: data/replica parallelism (the reference's replica scaling axis)
+- ``tp``: tensor parallelism (BASELINE config 4: Llama TP=4 over ICI)
+- ``sp``: sequence/context parallelism for long inputs (ring attention)
+
+Multi-host (DCN) boot mirrors the reference's group bootstrap: JAX's
+distributed runtime plays the GCS-address role (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ray_dynamic_batching_tpu.models.base import ServableModel, param_path_specs
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("mesh")
+
+AXIS_ORDER = ("dp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    @staticmethod
+    def auto(n_devices: int, tp: Optional[int] = None, sp: int = 1) -> "MeshConfig":
+        """Pick dp x sp x tp for a device count: prefer TP up to 4 (one ICI
+        hop on v5e trays), data-parallel beyond."""
+        if tp is None:
+            tp = 1
+            for cand in (4, 2):
+                if n_devices % cand == 0 and n_devices >= cand:
+                    tp = cand
+                    break
+        assert n_devices % (tp * sp) == 0, (n_devices, tp, sp)
+        return MeshConfig(dp=n_devices // (tp * sp), sp=sp, tp=tp)
+
+
+def build_mesh(
+    config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = config.n_devices
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh needs {n} devices (dp={config.dp} sp={config.sp} "
+            f"tp={config.tp}) but only {len(devices)} available"
+        )
+    arr = np.array(devices[:n]).reshape(config.dp, config.sp, config.tp)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    devices = [device] if device is not None else jax.devices()[:1]
+    return Mesh(np.array(devices).reshape(1, 1, 1), AXIS_ORDER)
+
+
+# --- sharding helpers -----------------------------------------------------
+
+def _feasible_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Drop mesh axes that don't divide the corresponding dim (e.g. GQA with
+    kv_heads < tp replicates the kv projections instead of erroring)."""
+    out = []
+    for i, ax in enumerate(spec):
+        if ax is None:
+            out.append(None)
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        if i < len(shape) and shape[i] % size == 0:
+            out.append(ax)
+        else:
+            out.append(None)
+    return P(*out)
+
+
+def param_shardings(mesh: Mesh, model: ServableModel, params: Any) -> Any:
+    """NamedShardings for every param leaf from the model's sharding rules
+    (infeasible axes degrade to replication rather than erroring)."""
+    specs = param_path_specs(model, params)
+    return jax.tree_util.tree_map(
+        lambda leaf, s: NamedSharding(mesh, _feasible_spec(s, leaf.shape, mesh)),
+        params,
+        specs,
+    )
+
+
+def shard_params(mesh: Mesh, model: ServableModel, params: Any) -> Any:
+    """Place params on the mesh per the model's rules (TP weights split over
+    the tp axis, everything else replicated)."""
+    shardings = param_shardings(mesh, model, params)
+    return jax.device_put(params, shardings)
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
+    """Shard the leading batch axis over dp; remaining dims replicated."""
+    return NamedSharding(mesh, P("dp", *([None] * extra_dims)))
+
+
+def seq_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    """[B, T, ...] with batch over dp and sequence over sp (long-context)."""
+    return NamedSharding(mesh, P("dp", "sp", *([None] * extra_dims)))
+
+
+# --- multi-host boot (DCN) ------------------------------------------------
+
+def multihost_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Dict[str, int]:
+    """Initialize JAX's distributed runtime across hosts (DCN). The
+    coordinator plays the role the reference's GCS address plays for
+    collective-group bootstrap (SURVEY.md §2.4). No-op when single-process.
+    """
+    if num_processes is None or num_processes <= 1:
+        return {"process_index": 0, "process_count": 1}
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+    }

@@ -13,8 +13,10 @@ tolerance + early stop; driven by ``run_profiler.py:191-196`` batch sweep
   output + temp + generated code size), not an allocator high-water mark —
   exact, available without running, and includes the weights the program holds
   resident in HBM.
-- Timing is wall-clock around ``block_until_ready`` on an async dispatch
-  (device-side timing; the host enqueue cost is what serving actually pays).
+- Timing runs an on-device dependent chain (``lax.fori_loop``) and fetches a
+  scalar at the end: on the axon TPU tunnel ``block_until_ready`` reports
+  completion early, so only a host fetch observes real execution time; the
+  chain amortizes the tunnel round trip over many steps.
 - OOM tolerance: RESOURCE_EXHAUSTED from compile or run marks the bucket
   infeasible; after ``max_consecutive_errors`` the sweep stops early.
 """
@@ -25,6 +27,7 @@ import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ray_dynamic_batching_tpu.models.base import ServableModel
@@ -41,6 +44,40 @@ logger = get_logger("profiler")
 def _is_oom(err: Exception) -> bool:
     msg = str(err)
     return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "OOM" in msg
+
+
+def make_chained_timer(apply_fn, params, inputs):
+    """Build a jitted fn running n dependent apply steps + scalar fetch.
+
+    Each iteration feeds a zero-scaled scalar from the previous output back
+    into the first input, forcing sequential device execution; the returned
+    scalar forces a host sync when read.
+    """
+
+    def chained(params, inputs, n):
+        def body(_, carry):
+            out = apply_fn(params, *carry)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            bump = (jnp.ravel(leaf)[0] * 0).astype(carry[0].dtype)
+            return (carry[0] + bump,) + tuple(carry[1:])
+
+        final = jax.lax.fori_loop(0, n, body, tuple(inputs))
+        out = apply_fn(params, *final)
+        return jnp.ravel(jax.tree_util.tree_leaves(out)[0])[0]
+
+    return jax.jit(chained)
+
+
+def timed_steps_ms(apply_fn, params, inputs, iters: int, warmup: int = 1):
+    """Per-step latency samples via chained on-device loops."""
+    timer = make_chained_timer(apply_fn, params, inputs)
+    float(timer(params, tuple(inputs), warmup))  # compile + warm
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(timer(params, tuple(inputs), iters - 1))
+        samples.append((time.perf_counter() - t0) * 1000.0 / iters)
+    return samples
 
 
 class ModelProfiler:
@@ -90,13 +127,10 @@ class ModelProfiler:
                     - getattr(mem, "alias_size_in_bytes", 0)
                 )
 
-            for _ in range(self.warmup_iters):
-                jax.block_until_ready(compiled(params, *inputs))
-            samples = []
-            for _ in range(self.timing_iters):
-                t0 = time.perf_counter()
-                jax.block_until_ready(compiled(params, *inputs))
-                samples.append((time.perf_counter() - t0) * 1000.0)
+            samples = timed_steps_ms(
+                self.model.apply, params, inputs,
+                iters=max(self.timing_iters, 2), warmup=self.warmup_iters,
+            )
         except Exception as e:  # noqa: BLE001 — XLA raises backend-specific types
             if _is_oom(e):
                 logger.warning(

@@ -179,10 +179,11 @@ class LiveScheduler:
         if entry is None:
             request.reject(KeyError(f"model {request.model!r} not registered"))
             return False
-        ok = self.queues.queue(request.model).add_request(request)
-        if ok:
-            self.rates.record(request.model)
-        return ok
+        # Record DEMAND before the enqueue outcome: if drops suppressed the
+        # signal, an overloaded queue would read as a rate collapse and the
+        # monitor would scale DOWN during overload (inverted feedback).
+        self.rates.record(request.model)
+        return self.queues.queue(request.model).add_request(request)
 
     # --- scheduling -------------------------------------------------------
     def _sessions_for(self, rates: Dict[str, float]) -> List[Session]:
