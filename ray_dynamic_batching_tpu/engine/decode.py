@@ -1,0 +1,353 @@
+"""Continuous-batching decode engine — slot-based KV-cache serving.
+
+New capability relative to the reference, which serves single-shot vision
+models only (SURVEY.md §7 stage 7; the reference's executor takes one batch,
+runs one forward, returns — ``293-project/src/scheduler.py:435-472``).
+Autoregressive decode for the BASELINE.json GPT-2/Llama configs needs a
+different hot loop: requests *join and leave* a long-running batch between
+steps (Orca-style continuous batching).
+
+TPU-first design — everything is static-shape so exactly TWO kinds of
+compiled programs serve the whole stream:
+
+- ``prefill[T]``: one per prompt-length bucket T. Runs the prompt on a fresh
+  single-row cache, scatters the full row into the big decode cache at a
+  *traced* slot index (``lax.dynamic_update_slice`` — no recompile per slot),
+  and returns the first sampled token.
+- ``decode_step``: one program for all ``num_slots`` slots, every step.
+  Inactive slots are masked, their scatters dropped. Greedy sampling happens
+  *in-program* (argmax over vocab) so only ``[B]`` token ids — not ``[B, V]``
+  logits — cross the device→host boundary per step.
+
+The big cache is **donated** through both programs, so XLA updates it in
+place in HBM — zero realloc, zero copy per token (SURVEY.md §7 hard part (e)).
+Admission between steps pulls from the shared :class:`RequestQueue`, keeping
+the Nexus staleness-discard and SLO accounting on the decode path too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_dynamic_batching_tpu.engine.request import Request, now_ms
+from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.profiles.table import bucket_up
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils import metrics as m
+
+logger = get_logger("decode")
+
+TOKENS_TOTAL = m.Counter(
+    "rdb_decode_tokens_total", "Generated tokens", tag_keys=("model",)
+)
+DECODE_STEPS = m.Counter(
+    "rdb_decode_steps_total", "Decode steps executed", tag_keys=("model",)
+)
+PREFILLS_TOTAL = m.Counter(
+    "rdb_decode_prefills_total", "Prompts prefilled", tag_keys=("model",)
+)
+TTFT_MS = m.Histogram(
+    "rdb_decode_ttft_ms", "Time to first token", tag_keys=("model",)
+)
+ACTIVE_SLOTS = m.Gauge(
+    "rdb_decode_active_slots", "Slots currently decoding", tag_keys=("model",)
+)
+
+
+@dataclass
+class DecodeResult:
+    """Fulfilled into the request future when a sequence finishes."""
+
+    tokens: List[int]
+    finish_reason: str            # "eos" | "length" | "capacity"
+    ttft_ms: float
+    total_ms: float
+
+
+@dataclass
+class _Slot:
+    request: Optional[Request] = None
+    generated: List[int] = field(default_factory=list)
+    max_new_tokens: int = 0
+    prefill_done_ms: float = 0.0
+    last_token: int = 0
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+
+class DecodeEngine:
+    """Continuous-batching executor for one CausalLM on one chip/mesh slice.
+
+    ``model`` must provide the decode interface of
+    :class:`~ray_dynamic_batching_tpu.models.causal_lm.CausalLM`:
+    ``make_cache``, ``prefill``, ``decode_step``, and ``cfg``.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        queue: RequestQueue,
+        num_slots: int = 8,
+        max_len: int = 256,
+        prompt_buckets: Optional[Sequence[int]] = None,
+        eos_token_id: Optional[int] = None,
+        default_max_new_tokens: int = 64,
+        idle_wait_s: float = 0.005,
+        sample_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
+    ):
+        self.model = model
+        self.params = params
+        self.queue = queue
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.prompt_buckets = sorted(prompt_buckets or [16, 32, 64, 128])
+        self.prompt_buckets = [b for b in self.prompt_buckets if b <= max_len]
+        self.eos_token_id = eos_token_id
+        self.default_max_new_tokens = default_max_new_tokens
+        self.idle_wait_s = idle_wait_s
+        self._sample = sample_fn or (lambda logits: jnp.argmax(logits, axis=-1))
+
+        self._slots = [_Slot() for _ in range(num_slots)]
+        self._cache = model.make_cache(num_slots, max_len)
+        self._tokens = np.zeros((num_slots, 1), dtype=np.int32)
+        self._active_mask = np.zeros((num_slots,), dtype=bool)
+
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._thread: Optional[threading.Thread] = None
+        self._run = threading.Event()
+        self.steps = 0
+        self.completed = 0
+
+    # --- compiled programs -------------------------------------------------
+    def _prefill_impl(self, params, tokens, attn_mask, cache, slot):
+        """Prompt → big cache row at ``slot`` + first sampled token.
+
+        ``slot`` is a traced int32 scalar: one compiled program per prompt
+        bucket serves every slot (dynamic start index, static shapes).
+        """
+        row_cache = self.model.make_cache(1, self.max_len)
+        last_logits, row = self.model.prefill(params, tokens, attn_mask, row_cache)
+        k = jax.lax.dynamic_update_slice(cache.k, row.k, (0, slot, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(cache.v, row.v, (0, slot, 0, 0, 0))
+        lengths = jax.lax.dynamic_update_slice(cache.lengths, row.lengths, (slot,))
+        first = self._sample(last_logits)[0].astype(jnp.int32)
+        return first, cache.replace(k=k, v=v, lengths=lengths)
+
+    def _decode_impl(self, params, cache, tokens, active):
+        # Rows already at capacity produce garbage logits (decode_step masks
+        # their scatter); fold the in-bounds check into the mask so their
+        # "sampled" token is never surfaced, and return the effective mask so
+        # the host knows which slots actually advanced.
+        advanced = jnp.logical_and(active, cache.lengths < cache.capacity)
+        logits, cache = self.model.decode_step(params, tokens, cache, advanced)
+        nxt = self._sample(logits).astype(jnp.int32)
+        nxt = jnp.where(advanced, nxt, tokens[:, 0])
+        return nxt, cache.lengths, advanced, cache
+
+    def _prefill_fn(self, bucket: int) -> Callable:
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            # Donate the big cache (arg 3) — updated in place in HBM.
+            fn = jax.jit(self._prefill_impl, donate_argnums=(3,))
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def warmup(self) -> None:
+        """Compile every prompt bucket + the decode step before serving."""
+        for b in self.prompt_buckets:
+            tokens = jnp.zeros((1, b), dtype=jnp.int32)
+            mask = jnp.ones((1, b), dtype=jnp.int32)
+            first, self._cache = self._prefill_fn(b)(
+                self.params, tokens, mask, self._cache, jnp.int32(0)
+            )
+            first.block_until_ready()
+        nxt, _, _, self._cache = self._decode_fn(
+            self.params,
+            self._cache,
+            jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
+            jnp.zeros((self.num_slots,), dtype=bool),
+        )
+        nxt.block_until_ready()
+        # Reset state dirtied by warmup runs.
+        self._cache = self._cache.replace(
+            lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
+        )
+        logger.info(
+            "%s: warmed %d prefill buckets + decode step",
+            self.model.name, len(self.prompt_buckets),
+        )
+
+    # --- admission ---------------------------------------------------------
+    def _free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s.free]
+
+    def _admit(self) -> int:
+        """Fill free slots from the queue (continuous batching join)."""
+        free = self._free_slots()
+        if not free:
+            return 0
+        batch = self.queue.get_batch(len(free), discard_stale=True)
+        admitted = 0
+        for req in batch:
+            slot_idx = free[admitted]
+            try:
+                self._start_request(slot_idx, req)
+            except Exception as e:  # noqa: BLE001 — bad prompt must not kill loop
+                req.reject(e)
+                continue
+            admitted += 1
+        return admitted
+
+    def _start_request(self, slot_idx: int, req: Request) -> None:
+        prompt = np.asarray(
+            req.payload["tokens"] if isinstance(req.payload, dict) else req.payload,
+            dtype=np.int32,
+        ).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError(f"{req.request_id}: empty prompt")
+        bucket = bucket_up(int(prompt.size), self.prompt_buckets)
+        if bucket is None:
+            raise ValueError(
+                f"{req.request_id}: prompt length {prompt.size} exceeds "
+                f"largest bucket {self.prompt_buckets[-1]}"
+            )
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, : prompt.size] = prompt
+        mask = np.zeros((1, bucket), dtype=np.int32)
+        mask[0, : prompt.size] = 1
+
+        first, self._cache = self._prefill_fn(bucket)(
+            self.params,
+            jnp.asarray(padded),
+            jnp.asarray(mask),
+            self._cache,
+            jnp.int32(slot_idx),
+        )
+        first_tok = int(first)
+        t = now_ms()
+        max_new = self.default_max_new_tokens
+        if isinstance(req.payload, dict):
+            max_new = int(req.payload.get("max_new_tokens", max_new))
+
+        slot = self._slots[slot_idx]
+        slot.request = req
+        slot.generated = [first_tok]
+        slot.max_new_tokens = max_new
+        slot.prefill_done_ms = t
+        slot.last_token = first_tok
+        self._tokens[slot_idx, 0] = first_tok
+        self._active_mask[slot_idx] = True
+
+        PREFILLS_TOTAL.inc(tags={"model": self.model.name})
+        TTFT_MS.observe(t - req.arrival_ms, tags={"model": self.model.name})
+        # First token may already satisfy the stop conditions.
+        if first_tok == self.eos_token_id or max_new <= 1:
+            reason = "eos" if first_tok == self.eos_token_id else "length"
+            self._finish(slot_idx, reason)
+
+    # --- step + eviction ---------------------------------------------------
+    def _finish(self, slot_idx: int, reason: str) -> None:
+        slot = self._slots[slot_idx]
+        req = slot.request
+        t = now_ms()
+        result = DecodeResult(
+            tokens=list(slot.generated),
+            finish_reason=reason,
+            ttft_ms=slot.prefill_done_ms - req.arrival_ms,
+            total_ms=t - req.arrival_ms,
+        )
+        req.fulfill(result)
+        self.queue.record_batch_completion([req], completed_at_ms=t)
+        TOKENS_TOTAL.inc(len(slot.generated), tags={"model": self.model.name})
+        self._slots[slot_idx] = _Slot()
+        self._active_mask[slot_idx] = False
+        self.completed += 1
+
+    def _step(self) -> None:
+        nxt, lengths, advanced, self._cache = self._decode_fn(
+            self.params,
+            self._cache,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._active_mask),
+        )
+        nxt_host = np.asarray(nxt)
+        lengths_host = np.asarray(lengths)
+        advanced_host = np.asarray(advanced)
+        self.steps += 1
+        DECODE_STEPS.inc(tags={"model": self.model.name})
+        for i, slot in enumerate(self._slots):
+            if slot.free or not self._active_mask[i]:
+                continue
+            if not advanced_host[i]:
+                # Cache was already full at step entry — no token produced.
+                self._finish(i, "capacity")
+                continue
+            tok = int(nxt_host[i])
+            slot.generated.append(tok)
+            slot.last_token = tok
+            self._tokens[i, 0] = tok
+            if self.eos_token_id is not None and tok == self.eos_token_id:
+                self._finish(i, "eos")
+            elif len(slot.generated) >= slot.max_new_tokens:
+                self._finish(i, "length")
+            elif lengths_host[i] >= self.max_len:
+                self._finish(i, "capacity")
+
+    # --- loop --------------------------------------------------------------
+    def run_until_idle(self, timeout_s: float = 60.0) -> None:
+        """Drive admissions + steps until queue and slots are empty (tests,
+        offline batch generation)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            admitted = self._admit()
+            if self._active_mask.any():
+                self._step()
+            elif not admitted and len(self.queue) == 0:
+                return
+        raise TimeoutError(f"{self.model.name}: decode did not drain")
+
+    def _loop(self) -> None:
+        while self._run.is_set():
+            try:
+                self._admit()
+                if self._active_mask.any():
+                    self._step()
+                    ACTIVE_SLOTS.set(
+                        float(self._active_mask.sum()),
+                        tags={"model": self.model.name},
+                    )
+                else:
+                    self.queue.wait_for_requests(self.idle_wait_s)
+            except Exception:  # noqa: BLE001 — engine must not die silently
+                logger.exception("%s: decode loop iteration failed", self.model.name)
+                time.sleep(0.05)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._run.set()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"decode-{self.model.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._run.clear()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+
+    @property
+    def active_slots(self) -> int:
+        return int(self._active_mask.sum())

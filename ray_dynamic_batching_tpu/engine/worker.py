@@ -114,6 +114,7 @@ class ReplicaEngine:
         # they survive model unload/reload (params are call arguments).
         self._compile_cache: Dict[Tuple[str, int, int], Any] = {}
         self._compile_cache_cap = 64
+        self._closed = False
 
     # --- schedule handoff (ref update_queues.put, scheduler.py:906-929) ---
     def assign(self, plan: NodePlan) -> None:
@@ -139,7 +140,15 @@ class ReplicaEngine:
                     self._preparer = None
                     return
             try:
-                self._ready.put((plan, self._prepare(plan)))
+                prepared = self._prepare(plan)
+                with self._assign_lock:  # atomic vs stop()'s drain
+                    if self._closed:
+                        # stop() raced us past its _ready drain: nobody will
+                        # apply this schedule, release its refs here.
+                        for name in prepared.steps:
+                            self.host.release(name)
+                    else:
+                        self._ready.put((plan, prepared))
             except Exception as e:  # noqa: BLE001
                 self._last_error = e
                 logger.exception(
@@ -309,19 +318,30 @@ class ReplicaEngine:
         self._thread.start()
 
     def stop(self, timeout_s: float = 5.0) -> None:
+        self._closed = True
         self._active.clear()
         if self._thread is not None:
             self._thread.join(timeout_s)
             self._thread = None
+        # Wait for any in-flight preparation: a schedule landing in _ready
+        # AFTER the drain below would leak its host refs (params in HBM).
+        with self._assign_lock:
+            self._pending_plan = None  # cancel queued-but-unstarted plans
+            preparer = self._preparer
+        if preparer is not None:
+            preparer.join(timeout_s)
         # Release refs of the live schedule AND any prepared-but-unapplied
-        # schedules still sitting in the ready queue.
-        while True:
-            try:
-                _, sched = self._ready.get_nowait()
-            except Empty:
-                break
-            for name in sched.steps:
-                self.host.release(name)
+        # schedules still sitting in the ready queue. Under the assign lock:
+        # a preparer that outlived the bounded join above will see _closed
+        # inside the same lock and release its own refs instead of putting.
+        with self._assign_lock:
+            while True:
+                try:
+                    _, sched = self._ready.get_nowait()
+                except Empty:
+                    break
+                for name in sched.steps:
+                    self.host.release(name)
         for name in list(self._schedule.steps):
             self.host.release(name)
         self._schedule = ActiveSchedule()

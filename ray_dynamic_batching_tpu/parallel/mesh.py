@@ -48,13 +48,18 @@ class MeshConfig:
     def auto(n_devices: int, tp: Optional[int] = None, sp: int = 1) -> "MeshConfig":
         """Pick dp x sp x tp for a device count: prefer TP up to 4 (one ICI
         hop on v5e trays), data-parallel beyond."""
+        if n_devices % sp != 0:
+            raise ValueError(f"sp={sp} does not divide {n_devices} devices")
         if tp is None:
             tp = 1
             for cand in (4, 2):
-                if n_devices % cand == 0 and n_devices >= cand:
+                if n_devices % (cand * sp) == 0:
                     tp = cand
                     break
-        assert n_devices % (tp * sp) == 0, (n_devices, tp, sp)
+        if n_devices % (tp * sp) != 0:
+            raise ValueError(
+                f"tp={tp} x sp={sp} does not divide {n_devices} devices"
+            )
         return MeshConfig(dp=n_devices // (tp * sp), sp=sp, tp=tp)
 
 

@@ -13,10 +13,12 @@ tolerance + early stop; driven by ``run_profiler.py:191-196`` batch sweep
   output + temp + generated code size), not an allocator high-water mark —
   exact, available without running, and includes the weights the program holds
   resident in HBM.
-- Timing runs an on-device dependent chain (``lax.fori_loop``) and fetches a
-  scalar at the end: on the axon TPU tunnel ``block_until_ready`` reports
-  completion early, so only a host fetch observes real execution time; the
-  chain amortizes the tunnel round trip over many steps.
+- Timing dispatches the **already-compiled** executable (the same one the
+  compile_ms/memory numbers describe — one compile per bucket) many times
+  and fetches one scalar at the end: on the axon TPU tunnel
+  ``block_until_ready`` reports completion early, so only a host fetch
+  observes real execution time; in-order device execution makes the final
+  fetch cover every dispatched step.
 - OOM tolerance: RESOURCE_EXHAUSTED from compile or run marks the bucket
   infeasible; after ``max_consecutive_errors`` the sweep stops early.
 """
@@ -46,36 +48,33 @@ def _is_oom(err: Exception) -> bool:
     return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "OOM" in msg
 
 
-def make_chained_timer(apply_fn, params, inputs):
-    """Build a jitted fn running n dependent apply steps + scalar fetch.
+def _fetch_scalar(out) -> float:
+    """Host fetch of one scalar — the only reliable completion signal on the
+    axon tunnel, where ``block_until_ready`` returns early."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(np.ravel(np.asarray(leaf))[0])
 
-    Each iteration feeds a zero-scaled scalar from the previous output back
-    into the first input, forcing sequential device execution; the returned
-    scalar forces a host sync when read.
+
+def timed_steps_ms(compiled, params, inputs, iters: int, warmup: int = 1):
+    """Per-step latency samples for an already-compiled executable.
+
+    Dispatches ``iters`` async calls and fetches one scalar from the last
+    output: the device executes programs in order, so the final fetch
+    observes every step, and per-call dispatch overhead is included — which
+    is exactly the serving hot path (the engine dispatches each batch from
+    the host too). Reuses the executable the scheduler's compile_ms/memory
+    numbers describe, so each bucket pays XLA compilation exactly once.
     """
-
-    def chained(params, inputs, n):
-        def body(_, carry):
-            out = apply_fn(params, *carry)
-            leaf = jax.tree_util.tree_leaves(out)[0]
-            bump = (jnp.ravel(leaf)[0] * 0).astype(carry[0].dtype)
-            return (carry[0] + bump,) + tuple(carry[1:])
-
-        final = jax.lax.fori_loop(0, n, body, tuple(inputs))
-        out = apply_fn(params, *final)
-        return jnp.ravel(jax.tree_util.tree_leaves(out)[0])[0]
-
-    return jax.jit(chained)
-
-
-def timed_steps_ms(apply_fn, params, inputs, iters: int, warmup: int = 1):
-    """Per-step latency samples via chained on-device loops."""
-    timer = make_chained_timer(apply_fn, params, inputs)
-    float(timer(params, tuple(inputs), warmup))  # compile + warm
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = compiled(params, *inputs)
+    _fetch_scalar(out)
     samples = []
     for _ in range(3):
         t0 = time.perf_counter()
-        float(timer(params, tuple(inputs), iters - 1))
+        for _ in range(iters):
+            out = compiled(params, *inputs)
+        _fetch_scalar(out)
         samples.append((time.perf_counter() - t0) * 1000.0 / iters)
     return samples
 
@@ -128,7 +127,7 @@ class ModelProfiler:
                 )
 
             samples = timed_steps_ms(
-                self.model.apply, params, inputs,
+                compiled, params, inputs,
                 iters=max(self.timing_iters, 2), warmup=self.warmup_iters,
             )
         except Exception as e:  # noqa: BLE001 — XLA raises backend-specific types

@@ -22,7 +22,7 @@ import io
 import json
 import math
 from dataclasses import asdict, dataclass, field
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 
 @dataclass(frozen=True)
@@ -203,6 +203,14 @@ class BatchProfile:
                 f"{r.hbm_bytes / 1e6:>9.1f} {r.compile_ms:>10.0f}"
             )
         return "\n".join(lines) + "\n"
+
+
+def bucket_up(value: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= value; None if value exceeds every bucket."""
+    for b in sorted(buckets):
+        if b >= value:
+            return b
+    return None
 
 
 def default_batch_buckets(max_batch: int, min_batch: int = 1) -> List[int]:

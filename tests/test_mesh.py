@@ -1,0 +1,51 @@
+"""Mesh-config selection and sharding helpers (8 fake CPU devices)."""
+
+import jax
+import pytest
+
+from ray_dynamic_batching_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshConfig,
+    build_mesh,
+    single_device_mesh,
+)
+
+
+class TestMeshConfig:
+    def test_auto_prefers_tp4(self):
+        cfg = MeshConfig.auto(8)
+        assert (cfg.dp, cfg.sp, cfg.tp) == (2, 1, 4)
+
+    def test_auto_respects_sp(self):
+        # tp candidates must account for sp: 4 devices with sp=2 leaves room
+        # for tp=2 only.
+        cfg = MeshConfig.auto(4, sp=2)
+        assert (cfg.dp, cfg.sp, cfg.tp) == (1, 2, 2)
+
+    def test_auto_explicit_tp(self):
+        cfg = MeshConfig.auto(8, tp=2, sp=2)
+        assert (cfg.dp, cfg.sp, cfg.tp) == (2, 2, 2)
+
+    def test_auto_odd_counts(self):
+        cfg = MeshConfig.auto(3)
+        assert (cfg.dp, cfg.sp, cfg.tp) == (3, 1, 1)
+
+    def test_auto_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            MeshConfig.auto(7, sp=2)
+        with pytest.raises(ValueError):
+            MeshConfig.auto(8, tp=3)
+
+    def test_build_mesh_axes(self):
+        cfg = MeshConfig.auto(len(jax.devices()))
+        mesh = build_mesh(cfg)
+        assert mesh.axis_names == AXIS_ORDER
+        assert mesh.shape["tp"] == cfg.tp
+
+    def test_build_mesh_too_few_devices(self):
+        with pytest.raises(ValueError, match="devices"):
+            build_mesh(MeshConfig(dp=1000))
+
+    def test_single_device_mesh(self):
+        mesh = single_device_mesh()
+        assert mesh.devices.size == 1
