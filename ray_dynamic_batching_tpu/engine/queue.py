@@ -51,16 +51,19 @@ class RequestQueue:
         self.total_violations = 0
 
     # --- producer side ----------------------------------------------------
-    def add_request(self, request: Request) -> bool:
-        """Enqueue; drop (and reject the future) when full (ref :238-254)."""
+    def add_request(self, request: Request, reject_on_full: bool = True) -> bool:
+        """Enqueue; when full, drop — rejecting the future (ref :238-254)
+        unless ``reject_on_full=False`` (router retry path: a failed assign
+        must stay retryable on another replica, not poison the future)."""
         with self._lock:
             if len(self._q) >= self.max_len:
                 self.total_dropped += 1
-                request.reject(
-                    RequestDropped(
-                        f"{self.model}: queue full ({self.max_len})"
+                if reject_on_full:
+                    request.reject(
+                        RequestDropped(
+                            f"{self.model}: queue full ({self.max_len})"
+                        )
                     )
-                )
                 return False
             self._q.append(request)
             self.total_enqueued += 1
@@ -128,6 +131,12 @@ class RequestQueue:
                 else:
                     if not self._not_empty.wait(wait_timeout_s):
                         return  # stayed empty for a full timeout
+
+    def wake_waiters(self) -> None:
+        """Wake any consumer blocked in wait_for_batch/wait_for_requests
+        (used by replica shutdown to unblock its loop without a request)."""
+        with self._lock:
+            self._not_empty.notify_all()
 
     def peek_arrival_ms(self) -> Optional[float]:
         with self._lock:

@@ -1,0 +1,6 @@
+"""Runtime substrate: KV store, supervision, native bindings.
+
+The (much smaller) TPU-native counterpart of Ray's C++ control plane —
+GCS KV (runtime.kv), health/restart supervision, and ctypes bindings to the
+native core (SURVEY.md §2.2 translation notes).
+"""

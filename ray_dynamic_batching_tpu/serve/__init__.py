@@ -1,0 +1,32 @@
+"""Serving substrate: controller, routers, replicas, handles, long poll.
+
+TPU-native re-creation of Ray Serve's architecture (SURVEY.md §2.3): a
+controller reconciles deployment state and checkpoints it; routers schedule
+requests over replicas with power-of-two-choices; replicas run user
+callables with size-or-timeout batching; config changes flow over long poll.
+"""
+
+from ray_dynamic_batching_tpu.serve.autoscaling import (
+    AutoscalingConfig,
+    AutoscalingPolicy,
+)
+from ray_dynamic_batching_tpu.serve.controller import (
+    DeploymentConfig,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+from ray_dynamic_batching_tpu.serve.long_poll import LongPollClient, LongPollHost
+from ray_dynamic_batching_tpu.serve.replica import Replica
+from ray_dynamic_batching_tpu.serve.router import Router
+
+__all__ = [
+    "AutoscalingConfig",
+    "AutoscalingPolicy",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "LongPollClient",
+    "LongPollHost",
+    "Replica",
+    "Router",
+    "ServeController",
+]

@@ -1,0 +1,338 @@
+"""Serve controller — deployment reconciliation, autoscaling, recovery.
+
+Re-creates Ray Serve's control plane: the singleton ``ServeController``
+(``python/ray/serve/_private/controller.py``) reconciling deployment target
+state, checkpointing to the GCS KV store under a checkpoint key
+(``controller.py:79-80``, save at ``:545``;
+``application_state.py:65,1096-1110``) so a restarted controller resumes
+where it left off; the deployment state machine scaling replicas up/down and
+replacing unhealthy ones (``deployment_state.py``); replica-set changes
+pushed to routers over long poll (SURVEY.md §2.3).
+
+TPU-first note: replica startup can imply weight upload + XLA warmup, so the
+state machine starts replicas *before* registering them with the router and
+drains before stopping — the same rollout discipline Serve uses for slow
+torch model loads, with compile time in place of load time.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_dynamic_batching_tpu.runtime.kv import KVStore
+from ray_dynamic_batching_tpu.serve.autoscaling import (
+    AutoscalingConfig,
+    AutoscalingPolicy,
+)
+from ray_dynamic_batching_tpu.serve.long_poll import LongPollHost
+from ray_dynamic_batching_tpu.serve.replica import Replica
+from ray_dynamic_batching_tpu.serve.router import Router
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("controller")
+
+CHECKPOINT_KEY = "serve:controller:checkpoint"  # ref controller.py:79-80
+REPLICA_SET_KEY = "serve:replicas:{deployment}"
+
+
+@dataclass
+class DeploymentConfig:
+    """Deployment contract (ref @serve.deployment options + config.py)."""
+
+    name: str
+    num_replicas: int = 1
+    max_batch_size: int = 8
+    batch_wait_timeout_s: float = 0.005
+    max_ongoing_requests: int = 256
+    max_restarts: int = 3
+    autoscaling: Optional[AutoscalingConfig] = None
+    user_config: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        d = {
+            "name": self.name,
+            "num_replicas": self.num_replicas,
+            "max_batch_size": self.max_batch_size,
+            "batch_wait_timeout_s": self.batch_wait_timeout_s,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "max_restarts": self.max_restarts,
+            "user_config": self.user_config,
+        }
+        if self.autoscaling is not None:
+            d["autoscaling"] = vars(self.autoscaling)
+        return d
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "DeploymentConfig":
+        auto = d.pop("autoscaling", None)
+        cfg = DeploymentConfig(**d)
+        if auto is not None:
+            cfg.autoscaling = AutoscalingConfig(**auto)
+        return cfg
+
+
+@dataclass
+class _DeploymentState:
+    """Live state for one deployment (ref DeploymentState)."""
+
+    config: DeploymentConfig
+    factory: Callable[[], Callable[[List[Any]], Sequence[Any]]]
+    replicas: List[Replica] = field(default_factory=list)
+    router: Optional[Router] = None
+    policy: Optional[AutoscalingPolicy] = None
+    restarts: int = 0
+    next_replica_ordinal: int = 0
+    unhealthy: bool = False  # restart budget spent; held until redeploy
+
+
+class ServeController:
+    """Singleton control loop owning deployments, routers, and scaling."""
+
+    def __init__(
+        self,
+        kv: Optional[KVStore] = None,
+        long_poll: Optional[LongPollHost] = None,
+        control_interval_s: float = 0.5,
+    ) -> None:
+        self.kv = kv or KVStore()
+        self.long_poll = long_poll or LongPollHost()
+        self.control_interval_s = control_interval_s
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._factories: Dict[str, Callable] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_checkpoint: Optional[str] = None
+
+    # --- deploy API (ref serve.run / deploy) ------------------------------
+    def register_factory(
+        self,
+        name: str,
+        factory: Callable[[], Callable[[List[Any]], Sequence[Any]]],
+    ) -> None:
+        """Factories are code, not state: after a controller restart the
+        checkpoint restores *configs* and factories must be re-registered
+        (the reference re-imports deployment code the same way)."""
+        self._factories[name] = factory
+
+    def deploy(
+        self,
+        config: DeploymentConfig,
+        factory: Optional[Callable] = None,
+    ) -> Router:
+        with self._lock:
+            if factory is not None:
+                self.register_factory(config.name, factory)
+            if config.name not in self._factories:
+                raise KeyError(f"no factory registered for {config.name!r}")
+            state = self._deployments.get(config.name)
+            if state is None:
+                state = _DeploymentState(
+                    config=config,
+                    factory=self._factories[config.name],
+                    router=Router(config.name),
+                )
+                self._deployments[config.name] = state
+            else:
+                state.config = config
+                state.restarts = 0  # a fresh deploy resets the budget
+                state.unhealthy = False
+                # Push changed batching/concurrency knobs to RUNNING
+                # replicas (otherwise re-deploys silently produce a
+                # mixed-config replica set).
+                for r in state.replicas:
+                    r.reconfigure(
+                        max_batch_size=config.max_batch_size,
+                        batch_wait_timeout_s=config.batch_wait_timeout_s,
+                        max_ongoing_requests=config.max_ongoing_requests,
+                    )
+            if config.autoscaling is not None:
+                state.policy = AutoscalingPolicy(
+                    config.autoscaling, interval_s=self.control_interval_s
+                )
+            else:
+                state.policy = None  # autoscaling removed -> pin num_replicas
+            self._reconcile(state)
+            self._checkpoint()
+            return state.router
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+            if state is None:
+                return
+            for r in state.replicas:
+                r.stop()
+            state.replicas = []
+            self._publish(state)
+            self._checkpoint()
+
+    def get_router(self, name: str) -> Router:
+        with self._lock:
+            return self._deployments[name].router
+
+    def deployments(self) -> List[str]:
+        with self._lock:
+            return sorted(self._deployments)
+
+    # --- state machine (ref deployment_state.py scale/heal) ---------------
+    def _start_replica(self, state: _DeploymentState) -> Replica:
+        cfg = state.config
+        rid = f"{cfg.name}#{state.next_replica_ordinal}"
+        state.next_replica_ordinal += 1
+        replica = Replica(
+            replica_id=rid,
+            deployment=cfg.name,
+            fn=state.factory(),
+            max_batch_size=cfg.max_batch_size,
+            batch_wait_timeout_s=cfg.batch_wait_timeout_s,
+            max_ongoing_requests=cfg.max_ongoing_requests,
+        )
+        replica.start()
+        logger.info("started replica %s", rid)
+        return replica
+
+    def _reconcile(self, state: _DeploymentState) -> None:
+        """Drive actual replica count to target; replace unhealthy."""
+        cfg = state.config
+        # Heal: replace dead replicas up to max_restarts
+        # (ref gcs_actor_manager.cc:1361-1393 restart budget).
+        alive: List[Replica] = []
+        for r in state.replicas:
+            if r.healthy():
+                alive.append(r)
+            else:
+                logger.warning("replica %s unhealthy; stopping", r.replica_id)
+                r.stop(drain=False)
+                if state.restarts < cfg.max_restarts:
+                    state.restarts += 1
+                    alive.append(self._start_replica(state))
+                else:
+                    state.unhealthy = True
+                    logger.error(
+                        "%s: restart budget (%d) exhausted; deployment "
+                        "unhealthy until redeployed",
+                        cfg.name, cfg.max_restarts,
+                    )
+        state.replicas = alive
+        # Scale to target — but an exhausted restart budget stops the
+        # crash-loop: no replacements until a fresh deploy() resets it
+        # (ref gcs_actor_manager.cc:1361-1393 — actors stay DEAD once
+        # max_restarts is spent).
+        while len(state.replicas) < cfg.num_replicas and not state.unhealthy:
+            state.replicas.append(self._start_replica(state))
+        while len(state.replicas) > cfg.num_replicas:
+            victim = state.replicas.pop()  # newest first, ref compact strategy
+            self._publish(state)           # stop routing before draining
+            victim.stop()
+        # Publish only on membership change: every publish clears the
+        # router's queue-len cache, so steady-state reconciles must be quiet.
+        if [r.replica_id for r in state.replicas] != [
+            r.replica_id for r in state.router.replicas()
+        ]:
+            self._publish(state)
+
+    def _publish(self, state: _DeploymentState) -> None:
+        """Push the replica set to routers via long poll (ref long_poll)."""
+        state.router.update_replicas(state.replicas)
+        self.long_poll.notify_changed(
+            REPLICA_SET_KEY.format(deployment=state.config.name),
+            [r.replica_id for r in state.replicas],
+        )
+
+    # --- control loop -----------------------------------------------------
+    def _control_step(self) -> None:
+        with self._lock:
+            for state in list(self._deployments.values()):
+                if state.policy is not None:
+                    metrics = state.router.demand_metrics()
+                    target = state.policy.step(
+                        metrics["total_ongoing"], len(state.replicas)
+                    )
+                    if target is not None and target != state.config.num_replicas:
+                        logger.info(
+                            "%s: autoscale %d -> %d (ongoing=%.0f)",
+                            state.config.name, state.config.num_replicas,
+                            target, metrics["total_ongoing"],
+                        )
+                        state.config.num_replicas = target
+                self._reconcile(state)
+            self._checkpoint()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.control_interval_s):
+            try:
+                self._control_step()
+            except Exception:  # noqa: BLE001
+                logger.exception("control step failed")
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-controller", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            for state in self._deployments.values():
+                for r in state.replicas:
+                    r.stop()
+                state.replicas = []
+
+    # --- checkpoint / recovery (ref controller.py:545, app_state:1096) ----
+    def _checkpoint(self) -> None:
+        payload = json.dumps(
+            {
+                name: state.config.to_json()
+                for name, state in self._deployments.items()
+            },
+            sort_keys=True,
+        )
+        # Checkpoint-on-change: steady-state control steps must not rewrite
+        # the KV file twice a second.
+        if payload != self._last_checkpoint:
+            self.kv.put(CHECKPOINT_KEY, payload)
+            self._last_checkpoint = payload
+
+    def recover(self) -> List[str]:
+        """Restore deployments from the checkpoint (factories must already
+        be re-registered). Returns recovered deployment names."""
+        raw = self.kv.get(CHECKPOINT_KEY)
+        if raw is None:
+            return []
+        recovered = []
+        for name, cfg_json in json.loads(raw).items():
+            if name not in self._factories:
+                logger.warning(
+                    "checkpointed deployment %r has no factory; skipping", name
+                )
+                continue
+            self.deploy(DeploymentConfig.from_json(cfg_json))
+            recovered.append(name)
+        return recovered
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "target_replicas": state.config.num_replicas,
+                    "running_replicas": len(state.replicas),
+                    "replicas": {
+                        r.replica_id: r.stats() for r in state.replicas
+                    },
+                    "restarts": state.restarts,
+                    "healthy": not state.unhealthy,
+                }
+                for name, state in self._deployments.items()
+            }

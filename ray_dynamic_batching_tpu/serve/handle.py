@@ -1,0 +1,54 @@
+"""DeploymentHandle — the caller-side API for a deployment.
+
+Re-creates Ray Serve's ``DeploymentHandle``
+(``python/ray/serve/handle.py:745``; ``.remote()`` at ``:821`` returns a
+response future resolved by the router): ``handle.remote(payload)`` builds a
+request, routes it pow-2, and returns a ``concurrent.futures.Future`` the
+caller (sync or asyncio via ``wrap_future``) awaits.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Any, Optional
+
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.serve.router import Router
+
+
+class DeploymentHandle:
+    """Lightweight, shareable; one per (caller, deployment)."""
+
+    def __init__(
+        self,
+        router: Router,
+        default_slo_ms: float = 30_000.0,
+    ) -> None:
+        self.router = router
+        self.default_slo_ms = default_slo_ms
+
+    @property
+    def deployment(self) -> str:
+        return self.router.deployment
+
+    def remote(
+        self,
+        payload: Any,
+        slo_ms: Optional[float] = None,
+        locality_hint: Optional[str] = None,
+    ) -> Future:
+        """Route one request; the future resolves to the replica's result
+        (ref handle.py:821)."""
+        request = Request(
+            model=self.deployment,
+            payload=payload,
+            slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
+        )
+        self.router.assign_request(request, locality_hint=locality_hint)
+        return request.future
+
+    def options(self, slo_ms: Optional[float] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.router,
+            default_slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
+        )

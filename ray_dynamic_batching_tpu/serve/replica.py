@@ -1,0 +1,203 @@
+"""Serve replica — one worker executing a deployment's callable with
+opportunistic batching.
+
+Re-creates Ray Serve's ``ReplicaActor``
+(``python/ray/serve/_private/replica.py:233``: ``handle_request`` :515-544,
+``UserCallableWrapper`` :810, per-replica metrics :92) fused with
+``@serve.batch`` (``python/ray/serve/batching.py:530``): the replica's loop
+pulls size-or-timeout batches from its own queue and invokes the user
+callable once per batch.
+
+TPU-first notes: for model deployments the callable typically closes over a
+pre-compiled bucket executor (see ``engine.worker``/``engine.decode``); the
+replica layer itself is model-agnostic — it owns queueing, concurrency
+control, health, and stats, mirroring how Serve wraps arbitrary callables.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from ray_dynamic_batching_tpu.engine.batching import OpportunisticBatch
+from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils import metrics as m
+
+logger = get_logger("replica")
+
+REPLICA_REQUESTS = m.Counter(
+    "rdb_replica_requests_total", "Requests processed",
+    tag_keys=("deployment", "replica"),
+)
+REPLICA_BATCHES = m.Counter(
+    "rdb_replica_batches_total", "Batches processed",
+    tag_keys=("deployment", "replica"),
+)
+REPLICA_ERRORS = m.Counter(
+    "rdb_replica_errors_total", "Callable errors",
+    tag_keys=("deployment", "replica"),
+)
+
+
+class Replica:
+    """One deployment replica: queue + batching loop around a user callable.
+
+    ``fn`` maps a list of payloads to a list of results (the ``@serve.batch``
+    contract). ``max_ongoing_requests`` bounds queued+running work — the
+    router's pow-2 scheduler reads :meth:`queue_len` and respects this cap
+    (ref replica_scheduler/replica_wrapper.py queue-length protocol).
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        deployment: str,
+        fn: Callable[[List[Any]], Sequence[Any]],
+        max_batch_size: int = 8,
+        batch_wait_timeout_s: float = 0.005,
+        max_ongoing_requests: int = 256,
+        default_slo_ms: float = 30_000.0,
+    ) -> None:
+        self.replica_id = replica_id
+        self.deployment = deployment
+        self.fn = fn
+        self.max_ongoing_requests = max_ongoing_requests
+        self.default_slo_ms = default_slo_ms
+        self.queue = RequestQueue(deployment, max_len=max_ongoing_requests)
+        self.policy = OpportunisticBatch(
+            max_batch_size=max_batch_size,
+            batch_wait_timeout_s=batch_wait_timeout_s,
+        )
+        self._ongoing = 0
+        self._ongoing_lock = threading.Lock()
+        self._stopped = False
+        self._run = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_heartbeat = time.monotonic()
+        self.started_at = time.monotonic()
+        self._batch_started_at: Optional[float] = None
+
+    # --- router-facing surface -------------------------------------------
+    def queue_len(self) -> int:
+        """Queued + in-flight, the pow-2 routing signal."""
+        with self._ongoing_lock:
+            return len(self.queue) + self._ongoing
+
+    def accepting(self) -> bool:
+        """Not-yet-started replicas accept (they drain once started);
+        stopped replicas never do."""
+        return not self._stopped and self.queue_len() < self.max_ongoing_requests
+
+    def assign(self, request: Request) -> bool:
+        """Enqueue, declining when saturated (ref
+        ``handle_request_with_rejection``, replica.py:544). A declined
+        request stays retryable — the router owns terminal rejection."""
+        if not self.accepting():
+            return False
+        return self.queue.add_request(request, reject_on_full=False)
+
+    # --- loop -------------------------------------------------------------
+    def _process_batch(self, batch: List[Request]) -> None:
+        with self._ongoing_lock:
+            self._ongoing += len(batch)
+        self._batch_started_at = time.monotonic()
+        try:
+            results = self.fn([r.payload for r in batch])
+            if len(results) != len(batch):
+                raise ValueError(
+                    f"callable returned {len(results)} results for "
+                    f"{len(batch)} requests"
+                )
+            for req, res in zip(batch, results):
+                req.fulfill(res)
+            self.queue.record_batch_completion(batch)
+            REPLICA_BATCHES.inc(
+                tags={"deployment": self.deployment, "replica": self.replica_id}
+            )
+            REPLICA_REQUESTS.inc(
+                len(batch),
+                tags={"deployment": self.deployment, "replica": self.replica_id},
+            )
+        except Exception as e:  # noqa: BLE001 — user errors flow to futures
+            for req in batch:
+                req.reject(e)
+            REPLICA_ERRORS.inc(
+                tags={"deployment": self.deployment, "replica": self.replica_id}
+            )
+            logger.warning("%s: batch failed: %s", self.replica_id, e)
+        finally:
+            self._batch_started_at = None
+            with self._ongoing_lock:
+                self._ongoing -= len(batch)
+
+    def _loop(self) -> None:
+        while self._run.is_set():
+            self.last_heartbeat = time.monotonic()
+            batch = self.policy.next_batch(self.queue)
+            if batch:
+                self._process_batch(batch)
+
+    # --- lifecycle (ref deployment_state replica start/stop) --------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._run.set()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"replica-{self.replica_id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0, drain: bool = True) -> None:
+        """Graceful: stop accepting, drain the queue, then join."""
+        self._stopped = True
+        if drain and self._thread is not None:
+            deadline = time.monotonic() + timeout_s
+            while self.queue_len() > 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        self._run.clear()
+        self.queue.wake_waiters()  # unblock the loop's condition wait
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+        # Reject anything left.
+        for req in self.queue.get_batch(self.max_ongoing_requests,
+                                        discard_stale=False):
+            req.reject(RequestDropped(f"{self.replica_id} stopped"))
+
+    def healthy(self, stall_timeout_s: float = 60.0) -> bool:
+        """Liveness check (ref deployment_state health checks): the loop
+        thread must be alive, and any in-flight batch must not have been
+        running longer than ``stall_timeout_s`` (a wedged user callable —
+        e.g. deadlocked on an external resource — is the stall we detect;
+        set the timeout above the worst legitimate batch, XLA warmup
+        compiles included)."""
+        if not self._run.is_set():
+            return False
+        if self._thread is None or not self._thread.is_alive():
+            return False
+        started = self._batch_started_at
+        return started is None or (time.monotonic() - started) < stall_timeout_s
+
+    def reconfigure(
+        self,
+        max_batch_size: Optional[int] = None,
+        batch_wait_timeout_s: Optional[float] = None,
+        max_ongoing_requests: Optional[int] = None,
+    ) -> None:
+        """Apply new batching/concurrency knobs to a RUNNING replica (the
+        runtime-tunable contract of ``@serve.batch``, batching.py:369-386)."""
+        if max_batch_size is not None:
+            self.policy.set_max_batch_size(max_batch_size)
+        if batch_wait_timeout_s is not None:
+            self.policy.set_batch_wait_timeout_s(batch_wait_timeout_s)
+        if max_ongoing_requests is not None:
+            self.max_ongoing_requests = max_ongoing_requests
+            self.queue.max_len = max_ongoing_requests
+
+    def stats(self) -> dict:
+        s = self.queue.stats()
+        s["ongoing"] = float(self.queue_len())
+        return s

@@ -1,0 +1,146 @@
+"""Request router — power-of-two-choices replica scheduling.
+
+Re-creates Ray Serve's ``PowerOfTwoChoicesReplicaScheduler``
+(``python/ray/serve/_private/replica_scheduler/pow_2_scheduler.py:52``; the
+fulfillment loop with backoff is ``:673``): sample two replicas, route to the
+one with the shorter queue, retry with exponential backoff while every
+candidate is saturated. Queue lengths come from a short-TTL cache refreshed
+on use (ref queue-len cache in the same file), and routing prefers
+``locality_hint`` replicas when available (locality/multiplex awareness).
+
+The router also aggregates per-deployment demand metrics for the autoscaler
+(ref ``RouterMetricsManager``, ``serve/_private/router.py:43``).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
+from ray_dynamic_batching_tpu.serve.replica import Replica
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils import metrics as m
+
+logger = get_logger("router")
+
+ROUTED_TOTAL = m.Counter(
+    "rdb_router_routed_total", "Requests routed", tag_keys=("deployment",)
+)
+ROUTER_REJECTED = m.Counter(
+    "rdb_router_rejected_total", "Requests rejected after backoff",
+    tag_keys=("deployment",),
+)
+
+QUEUE_LEN_CACHE_TTL_S = 0.1          # ref pow_2_scheduler queue-len cache
+BACKOFF_INITIAL_S = 0.002
+BACKOFF_MAX_S = 0.1
+
+
+class _CachedLen:
+    __slots__ = ("value", "at")
+
+    def __init__(self, value: int, at: float) -> None:
+        self.value = value
+        self.at = at
+
+
+class Router:
+    """Routes requests for one deployment over its live replica set."""
+
+    def __init__(
+        self,
+        deployment: str,
+        replicas: Optional[Sequence[Replica]] = None,
+        max_assign_timeout_s: float = 1.0,
+    ) -> None:
+        self.deployment = deployment
+        self.max_assign_timeout_s = max_assign_timeout_s
+        self._replicas: List[Replica] = list(replicas or [])
+        self._lock = threading.Lock()
+        self._len_cache: Dict[str, _CachedLen] = {}
+        self.total_routed = 0
+
+    # --- replica-set updates (pushed via long poll) -----------------------
+    def update_replicas(self, replicas: Sequence[Replica]) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+            self._len_cache.clear()
+        logger.info(
+            "%s: replica set -> %s",
+            self.deployment, [r.replica_id for r in replicas],
+        )
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas)
+
+    # --- pow-2 choice -----------------------------------------------------
+    def _queue_len(self, replica: Replica, now: float) -> int:
+        cached = self._len_cache.get(replica.replica_id)
+        if cached is not None and now - cached.at < QUEUE_LEN_CACHE_TTL_S:
+            return cached.value
+        val = replica.queue_len()
+        self._len_cache[replica.replica_id] = _CachedLen(val, now)
+        return val
+
+    def _choose(
+        self, candidates: List[Replica], locality_hint: Optional[str]
+    ) -> Optional[Replica]:
+        if not candidates:
+            return None
+        # Locality first: same-hint replicas tried as their own pool
+        # (ref locality-aware candidate ranking in pow_2_scheduler).
+        if locality_hint:
+            local = [
+                r for r in candidates
+                if getattr(r, "locality", None) == locality_hint
+            ]
+            if local:
+                candidates = local
+        now = time.monotonic()
+        if len(candidates) == 1:
+            chosen = candidates[0]
+        else:
+            a, b = random.sample(candidates, 2)
+            chosen = a if self._queue_len(a, now) <= self._queue_len(b, now) else b
+        return chosen
+
+    def assign_request(
+        self, request: Request, locality_hint: Optional[str] = None
+    ) -> bool:
+        """Route with pow-2 + backoff; reject after the assign timeout
+        (ref fulfillment loop, pow_2_scheduler.py:673)."""
+        deadline = time.monotonic() + self.max_assign_timeout_s
+        backoff = BACKOFF_INITIAL_S
+        while True:
+            candidates = [r for r in self.replicas() if r.accepting()]
+            chosen = self._choose(candidates, locality_hint)
+            if chosen is not None and chosen.assign(request):
+                # Invalidate the cache entry so bursts spread out.
+                self._len_cache.pop(chosen.replica_id, None)
+                self.total_routed += 1
+                ROUTED_TOTAL.inc(tags={"deployment": self.deployment})
+                return True
+            if time.monotonic() >= deadline:
+                ROUTER_REJECTED.inc(tags={"deployment": self.deployment})
+                request.reject(
+                    RequestDropped(
+                        f"{self.deployment}: no replica accepted within "
+                        f"{self.max_assign_timeout_s}s"
+                    )
+                )
+                return False
+            time.sleep(backoff)
+            backoff = min(backoff * 2, BACKOFF_MAX_S)
+
+    # --- autoscaler metrics (ref RouterMetricsManager) --------------------
+    def demand_metrics(self) -> Dict[str, float]:
+        reps = self.replicas()
+        total = sum(r.queue_len() for r in reps)
+        return {
+            "total_ongoing": float(total),
+            "num_replicas": float(len(reps)),
+        }

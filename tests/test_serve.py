@@ -1,0 +1,481 @@
+"""Serve layer: replicas, pow-2 router, autoscaling, long poll, controller.
+
+Mirrors the reference's Serve test strategy (SURVEY.md §4.2:
+``serve/tests/test_batching.py`` semantics, controller-recovery tests
+``test_controller_recovery.py``), with deterministic asserts instead of
+displays. No jax needed — the serve layer is model-agnostic.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
+from ray_dynamic_batching_tpu.runtime.kv import FileKVStore, KVStore
+from ray_dynamic_batching_tpu.serve import (
+    AutoscalingConfig,
+    AutoscalingPolicy,
+    DeploymentConfig,
+    DeploymentHandle,
+    LongPollClient,
+    LongPollHost,
+    Replica,
+    Router,
+    ServeController,
+)
+
+
+def double_batch(payloads):
+    return [p * 2 for p in payloads]
+
+
+def make_replica(rid="r0", dep="doubler", **kwargs):
+    defaults = dict(max_batch_size=4, batch_wait_timeout_s=0.005)
+    defaults.update(kwargs)
+    return Replica(rid, dep, double_batch, **defaults)
+
+
+class TestReplica:
+    def test_batches_and_fulfills(self):
+        rep = make_replica()
+        rep.start()
+        try:
+            reqs = [
+                Request(model="doubler", payload=i, slo_ms=5000)
+                for i in range(10)
+            ]
+            for r in reqs:
+                assert rep.assign(r)
+            for i, r in enumerate(reqs):
+                assert r.future.result(timeout=5) == 2 * i
+            assert rep.queue.total_completed == 10
+        finally:
+            rep.stop()
+
+    def test_batch_size_respected(self):
+        seen = []
+
+        def record(payloads):
+            seen.append(len(payloads))
+            return payloads
+
+        rep = Replica("r0", "rec", record, max_batch_size=3,
+                      batch_wait_timeout_s=0.02)
+        # Enqueue 7 before starting so batching is deterministic.
+        reqs = [Request(model="rec", payload=i, slo_ms=5000) for i in range(7)]
+        for r in reqs:
+            rep.assign(r)
+        rep.start()
+        try:
+            for r in reqs:
+                r.future.result(timeout=5)
+            assert max(seen) <= 3
+            assert sum(seen) == 7
+        finally:
+            rep.stop()
+
+    def test_error_propagates_to_futures(self):
+        def boom(payloads):
+            raise RuntimeError("kaboom")
+
+        rep = Replica("r0", "boom", boom, batch_wait_timeout_s=0.001)
+        rep.start()
+        try:
+            req = Request(model="boom", payload=1, slo_ms=5000)
+            rep.assign(req)
+            with pytest.raises(RuntimeError, match="kaboom"):
+                req.future.result(timeout=5)
+            assert rep.healthy()  # user errors must not kill the loop
+        finally:
+            rep.stop()
+
+    def test_declined_assign_stays_retryable(self):
+        """A saturated replica declining a request must NOT poison its
+        future — another replica can still serve it."""
+        full = make_replica("full", "d", max_ongoing_requests=1)
+        full.assign(Request(model="d", payload=0, slo_ms=5000))
+        req = Request(model="d", payload=21, slo_ms=5000)
+        assert not full.assign(req)
+        assert not req.future.done()
+        other = make_replica("other", "d")
+        assert other.assign(req)
+        other.start()
+        try:
+            assert req.future.result(timeout=5) == 42
+        finally:
+            other.stop()
+            full.stop(timeout_s=0.1)
+
+    def test_saturation_rejects(self):
+        gate = threading.Event()
+
+        def slow(payloads):
+            gate.wait(5)
+            return payloads
+
+        rep = Replica("r0", "slow", slow, max_batch_size=1,
+                      batch_wait_timeout_s=0.001, max_ongoing_requests=2)
+        rep.start()
+        try:
+            a = Request(model="slow", payload=1, slo_ms=5000)
+            b = Request(model="slow", payload=2, slo_ms=5000)
+            assert rep.assign(a)
+            assert rep.assign(b)
+            # saturated now
+            c = Request(model="slow", payload=3, slo_ms=5000)
+            assert not rep.assign(c)
+            gate.set()
+            assert a.future.result(timeout=5) == 1
+        finally:
+            gate.set()
+            rep.stop()
+
+    def test_stop_rejects_leftovers(self):
+        rep = make_replica()
+        req = Request(model="doubler", payload=1, slo_ms=5000)
+        rep.assign(req)  # never started -> nothing consumes it
+        rep.stop(timeout_s=0.2)
+        with pytest.raises(RequestDropped):
+            req.future.result(timeout=1)
+
+
+class TestRouter:
+    def test_pow2_prefers_shorter_queue(self):
+        # Neither replica is started, so queue lengths are fully
+        # deterministic: busy holds 10, idle grows 1..6 — every request must
+        # land on idle (its length never reaches busy's).
+        busy = make_replica("busy", "d")
+        idle = make_replica("idle", "d")
+        for i in range(10):
+            busy.assign(Request(model="d", payload=i, slo_ms=5000))
+        router = Router("d", [busy, idle])
+        reqs = [Request(model="d", payload=i, slo_ms=5000) for i in range(6)]
+        for r in reqs:
+            assert router.assign_request(r)
+        assert idle.queue.total_enqueued == 6
+        assert busy.queue.total_enqueued == 10
+        # Draining: start both, everything completes.
+        busy.start()
+        idle.start()
+        try:
+            for r in reqs:
+                assert r.future.result(timeout=5) == r.payload * 2
+        finally:
+            busy.stop()
+            idle.stop()
+
+    def test_rejects_after_timeout_when_all_saturated(self):
+        gate = threading.Event()
+
+        def slow(payloads):
+            gate.wait(5)
+            return payloads
+
+        rep = Replica("r0", "d", slow, max_batch_size=1,
+                      batch_wait_timeout_s=0.001, max_ongoing_requests=1)
+        rep.start()
+        try:
+            rep.assign(Request(model="d", payload=0, slo_ms=5000))
+            router = Router("d", [rep], max_assign_timeout_s=0.05)
+            req = Request(model="d", payload=1, slo_ms=5000)
+            t0 = time.monotonic()
+            assert not router.assign_request(req)
+            assert time.monotonic() - t0 < 2.0
+            with pytest.raises(RequestDropped):
+                req.future.result(timeout=1)
+        finally:
+            gate.set()
+            rep.stop()
+
+    def test_locality_hint(self):
+        a = make_replica("a", "d")
+        b = make_replica("b", "d")
+        a.locality = "zone1"
+        b.locality = "zone2"
+        a.start()
+        b.start()
+        try:
+            router = Router("d", [a, b])
+            for i in range(10):
+                router.assign_request(
+                    Request(model="d", payload=i, slo_ms=5000),
+                    locality_hint="zone2",
+                )
+            time.sleep(0.1)
+            assert b.queue.total_enqueued == 10
+            assert a.queue.total_enqueued == 0
+        finally:
+            a.stop()
+            b.stop()
+
+
+class TestAutoscalingPolicy:
+    def test_desired_proportional(self):
+        policy = AutoscalingPolicy(
+            AutoscalingConfig(min_replicas=1, max_replicas=10,
+                              target_ongoing_requests=2.0)
+        )
+        # 8 ongoing over 1 replica targeting 2 -> ratio 4 -> 4 replicas
+        assert policy.desired_replicas(8.0, 1) == 4
+        # bounded by max
+        assert policy.desired_replicas(100.0, 5) == 10
+        # idle shrinks toward min (downscale smoothing 0.5: ratio 0 -> 0.5x)
+        assert policy.desired_replicas(0.0, 4) == 2
+        assert policy.desired_replicas(0.0, 1) == 1
+
+    def test_delay_gating(self):
+        policy = AutoscalingPolicy(
+            AutoscalingConfig(min_replicas=1, max_replicas=10,
+                              target_ongoing_requests=1.0,
+                              upscale_delay_s=0.0, downscale_delay_s=2.0),
+            interval_s=1.0,
+        )
+        # Upscale applies immediately (delay 0 -> need 0 -> first step fires).
+        assert policy.step(10.0, 1) is not None
+        # Downscale needs 2 consecutive decisions (2s / 1s interval).
+        assert policy.step(0.0, 4) is None
+        assert policy.step(0.0, 4) is None
+        assert policy.step(0.0, 4) is not None
+
+
+class TestLongPoll:
+    def test_listen_blocks_until_change(self):
+        host = LongPollHost()
+        sid = host.notify_changed("k", "v1")
+        # Stale id -> immediate return.
+        out = host.listen_for_change({"k": sid - 1}, timeout_s=1)
+        assert out["k"][1] == "v1"
+        # Current id -> blocks until notify from another thread.
+        result = {}
+
+        def listen():
+            result.update(host.listen_for_change({"k": sid}, timeout_s=5))
+
+        t = threading.Thread(target=listen)
+        t.start()
+        time.sleep(0.05)
+        host.notify_changed("k", "v2")
+        t.join(timeout=5)
+        assert result["k"][1] == "v2"
+
+    def test_client_callbacks(self):
+        host = LongPollHost()
+        seen = []
+        client = LongPollClient(
+            host, {"cfg": seen.append}, poll_timeout_s=0.1
+        )
+        try:
+            host.notify_changed("cfg", 1)
+            host.notify_changed("cfg", 2)
+            deadline = time.monotonic() + 2
+            while len(seen) < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert seen[-1] == 2 or seen == [1, 2] or seen == [2]
+        finally:
+            client.stop()
+
+
+class TestController:
+    def test_deploy_and_route(self):
+        ctl = ServeController()
+        router = ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=2),
+            factory=lambda: double_batch,
+        )
+        try:
+            handle = DeploymentHandle(router)
+            futures = [handle.remote(i) for i in range(20)]
+            assert [f.result(timeout=5) for f in futures] == [
+                2 * i for i in range(20)
+            ]
+            status = ctl.status()["doubler"]
+            assert status["running_replicas"] == 2
+        finally:
+            ctl.shutdown()
+
+    def test_scale_up_and_down(self):
+        ctl = ServeController()
+        ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=1),
+            factory=lambda: double_batch,
+        )
+        try:
+            ctl.deploy(DeploymentConfig(name="doubler", num_replicas=3))
+            assert ctl.status()["doubler"]["running_replicas"] == 3
+            ctl.deploy(DeploymentConfig(name="doubler", num_replicas=1))
+            assert ctl.status()["doubler"]["running_replicas"] == 1
+        finally:
+            ctl.shutdown()
+
+    def test_unhealthy_replica_replaced(self):
+        ctl = ServeController()
+        router = ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=1, max_restarts=3),
+            factory=lambda: double_batch,
+        )
+        try:
+            victim = router.replicas()[0]
+            victim._run.clear()  # simulate a dead loop
+            victim.queue.wake_waiters()
+            with ctl._lock:
+                ctl._reconcile(ctl._deployments["doubler"])
+            status = ctl.status()["doubler"]
+            assert status["running_replicas"] == 1
+            assert status["restarts"] == 1
+            new = router.replicas()[0]
+            assert new.replica_id != victim.replica_id
+            # New replica serves.
+            handle = DeploymentHandle(router)
+            assert handle.remote(21).result(timeout=5) == 42
+        finally:
+            ctl.shutdown()
+
+    def test_autoscaler_scales_up_under_load(self):
+        gate = threading.Event()
+
+        def slow(payloads):
+            gate.wait(2)
+            return payloads
+
+        ctl = ServeController(control_interval_s=0.05)
+        router = ctl.deploy(
+            DeploymentConfig(
+                name="slow",
+                num_replicas=1,
+                max_batch_size=1,
+                autoscaling=AutoscalingConfig(
+                    min_replicas=1, max_replicas=4,
+                    target_ongoing_requests=2.0,
+                    upscale_delay_s=0.0, downscale_delay_s=10.0,
+                ),
+            ),
+            factory=lambda: slow,
+        )
+        try:
+            handle = DeploymentHandle(router)
+            futures = [handle.remote(i) for i in range(16)]
+            ctl.start()
+            deadline = time.monotonic() + 5
+            while (
+                ctl.status()["slow"]["running_replicas"] < 2
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert ctl.status()["slow"]["running_replicas"] >= 2
+            gate.set()
+            for f in futures:
+                f.result(timeout=5)
+        finally:
+            gate.set()
+            ctl.shutdown()
+
+    def test_checkpoint_recovery(self, tmp_path):
+        kv_path = str(tmp_path / "gcs.json")
+        ctl = ServeController(kv=FileKVStore(kv_path))
+        ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=2),
+            factory=lambda: double_batch,
+        )
+        ctl.shutdown()
+
+        # "Crashed" controller: new instance, same KV file (ref
+        # test_controller_recovery.py).
+        ctl2 = ServeController(kv=FileKVStore(kv_path))
+        ctl2.register_factory("doubler", lambda: double_batch)
+        recovered = ctl2.recover()
+        try:
+            assert recovered == ["doubler"]
+            assert ctl2.status()["doubler"]["running_replicas"] == 2
+            handle = DeploymentHandle(ctl2.get_router("doubler"))
+            assert handle.remote(5).result(timeout=5) == 10
+        finally:
+            ctl2.shutdown()
+
+    def test_restart_budget_stops_crash_loop(self):
+        ctl = ServeController()
+        router = ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=1, max_restarts=2),
+            factory=lambda: double_batch,
+        )
+        try:
+            state = ctl._deployments["doubler"]
+            for _ in range(5):  # keep killing whatever comes up
+                for r in router.replicas():
+                    r._run.clear()
+                    r.queue.wake_waiters()
+                with ctl._lock:
+                    ctl._reconcile(state)
+            status = ctl.status()["doubler"]
+            assert status["restarts"] == 2
+            assert status["running_replicas"] == 0  # no endless respawn
+            assert not status["healthy"]
+            # Redeploy clears the budget and revives the deployment.
+            ctl.deploy(DeploymentConfig(name="doubler", num_replicas=1,
+                                        max_restarts=2))
+            status = ctl.status()["doubler"]
+            assert status["healthy"] and status["running_replicas"] == 1
+        finally:
+            ctl.shutdown()
+
+    def test_redeploy_reconfigures_running_replicas(self):
+        ctl = ServeController()
+        router = ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=1, max_batch_size=8),
+            factory=lambda: double_batch,
+        )
+        try:
+            ctl.deploy(DeploymentConfig(name="doubler", num_replicas=1,
+                                        max_batch_size=32))
+            rep = router.replicas()[0]
+            assert rep.policy.max_batch_size == 32
+        finally:
+            ctl.shutdown()
+
+    def test_redeploy_without_autoscaling_pins_replicas(self):
+        ctl = ServeController(control_interval_s=0.05)
+        ctl.deploy(
+            DeploymentConfig(
+                name="doubler", num_replicas=2,
+                autoscaling=AutoscalingConfig(min_replicas=1, max_replicas=4,
+                                              downscale_delay_s=0.0),
+            ),
+            factory=lambda: double_batch,
+        )
+        try:
+            ctl.deploy(DeploymentConfig(name="doubler", num_replicas=3))
+            ctl.start()
+            time.sleep(0.3)  # idle: stale policy would downscale to 1
+            assert ctl.status()["doubler"]["running_replicas"] == 3
+        finally:
+            ctl.shutdown()
+
+    def test_delete_deployment(self):
+        ctl = ServeController()
+        ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=1),
+            factory=lambda: double_batch,
+        )
+        ctl.delete_deployment("doubler")
+        assert ctl.deployments() == []
+        ctl.shutdown()
+
+
+class TestKVStore:
+    def test_basic_ops(self):
+        kv = KVStore()
+        kv.put("a:1", "x")
+        kv.put("a:2", "y")
+        kv.put("b:1", "z")
+        assert kv.get("a:1") == "x"
+        assert kv.keys("a:") == ["a:1", "a:2"]
+        assert kv.delete("a:1")
+        assert not kv.delete("a:1")
+        assert kv.get("a:1") is None
+
+    def test_file_persistence(self, tmp_path):
+        path = str(tmp_path / "kv.json")
+        kv = FileKVStore(path)
+        kv.put("k", "v")
+        kv2 = FileKVStore(path)
+        assert kv2.get("k") == "v"
