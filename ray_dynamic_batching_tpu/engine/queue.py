@@ -40,6 +40,7 @@ class RequestQueue:
         self._q: Deque[Request] = deque()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
+        self._closed = False
         # --- stats (ref :324-372) ---
         self.latency_window = RollingWindow(1000)
         self.queue_delay_window = RollingWindow(1000)
@@ -56,12 +57,16 @@ class RequestQueue:
         unless ``reject_on_full=False`` (router retry path: a failed assign
         must stay retryable on another replica, not poison the future)."""
         with self._lock:
-            if len(self._q) >= self.max_len:
-                self.total_dropped += 1
+            if self._closed or len(self._q) >= self.max_len:
                 if reject_on_full:
+                    # Retryable declines (reject_on_full=False) are not
+                    # drops — another replica may serve the request.
+                    self.total_dropped += 1
                     request.reject(
                         RequestDropped(
-                            f"{self.model}: queue full ({self.max_len})"
+                            f"{self.model}: "
+                            + ("closed" if self._closed
+                               else f"queue full ({self.max_len})")
                         )
                     )
                 return False
@@ -107,17 +112,27 @@ class RequestQueue:
         with self._lock:
             if self._q:
                 return True
+            if self._closed:
+                return False
             return self._not_empty.wait(timeout_s)
 
-    def wait_for_batch(self, batch_size: int, wait_timeout_s: float) -> None:
+    def wait_for_batch(
+        self,
+        batch_size: int,
+        wait_timeout_s: float,
+        idle_wait_s: float = 0.5,
+    ) -> None:
         """Block until ``batch_size`` requests are queued OR
         ``wait_timeout_s`` has elapsed since the FIRST queued request arrived
         (Serve's size-or-timeout discipline, ref serve/batching.py:146-197).
-        Condition-variable based: no polling, woken by add_request."""
+        Condition-variable based: no polling, woken by add_request. An EMPTY
+        queue blocks up to ``idle_wait_s`` per arm — the batch timeout only
+        gates a partially-filled batch, so idle consumers don't spin at the
+        (possibly sub-ms) batch cadence. Returns immediately once closed."""
         import time as _time
 
         with self._lock:
-            while True:
+            while not self._closed:
                 if len(self._q) >= batch_size:
                     return
                 if self._q:
@@ -129,13 +144,20 @@ class RequestQueue:
                         return
                     self._not_empty.wait(remaining)
                 else:
-                    if not self._not_empty.wait(wait_timeout_s):
-                        return  # stayed empty for a full timeout
+                    if not self._not_empty.wait(max(idle_wait_s, wait_timeout_s)):
+                        return  # stayed empty for a full idle window
 
     def wake_waiters(self) -> None:
         """Wake any consumer blocked in wait_for_batch/wait_for_requests
-        (used by replica shutdown to unblock its loop without a request)."""
+        (they re-arm if the queue is still open and empty)."""
         with self._lock:
+            self._not_empty.notify_all()
+
+    def close(self) -> None:
+        """Unblock and permanently release all waiters; new adds are
+        declined (shutdown path — a closed queue never blocks a consumer)."""
+        with self._lock:
+            self._closed = True
             self._not_empty.notify_all()
 
     def peek_arrival_ms(self) -> Optional[float]:

@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ray_dynamic_batching_tpu.engine.request import RequestDropped
 from ray_dynamic_batching_tpu.runtime.kv import KVStore
 from ray_dynamic_batching_tpu.serve.autoscaling import (
     AutoscalingConfig,
@@ -156,20 +157,23 @@ class ServeController:
                 )
             else:
                 state.policy = None  # autoscaling removed -> pin num_replicas
-            self._reconcile(state)
+            deferred = self._reconcile(state)
             self._checkpoint()
-            return state.router
+        for action in deferred:  # blocking stops run outside the lock
+            action()
+        return state.router
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             state = self._deployments.pop(name, None)
             if state is None:
                 return
-            for r in state.replicas:
-                r.stop()
+            victims = state.replicas
             state.replicas = []
             self._publish(state)
             self._checkpoint()
+        for r in victims:  # blocking drains outside the lock
+            r.stop()
 
     def get_router(self, name: str) -> Router:
         with self._lock:
@@ -196,28 +200,54 @@ class ServeController:
         logger.info("started replica %s", rid)
         return replica
 
-    def _reconcile(self, state: _DeploymentState) -> None:
-        """Drive actual replica count to target; replace unhealthy."""
+    def _retire(
+        self, victim: Replica, replacement: Optional[Replica]
+    ) -> None:
+        """Stop a victim OUTSIDE the controller lock, salvaging its queued
+        requests onto the replacement (terminal rejection belongs to the
+        router, not the heal path)."""
+        if replacement is not None:
+            for req in victim.drain_queue():
+                if not replacement.assign(req):
+                    req.reject(
+                        RequestDropped(
+                            f"{victim.replica_id} retired and replacement "
+                            "saturated"
+                        )
+                    )
+        victim.stop(drain=replacement is None)
+
+    def _reconcile(self, state: _DeploymentState) -> List[Callable[[], None]]:
+        """Drive actual replica count to target; replace unhealthy.
+
+        Returns deferred (blocking) stop actions — callers run them AFTER
+        releasing the controller lock, so a slow drain or a wedged callable
+        can't freeze the whole control plane."""
         cfg = state.config
+        deferred: List[Callable[[], None]] = []
         # Heal: replace dead replicas up to max_restarts
         # (ref gcs_actor_manager.cc:1361-1393 restart budget).
         alive: List[Replica] = []
         for r in state.replicas:
             if r.healthy():
                 alive.append(r)
+                continue
+            logger.warning("replica %s unhealthy; replacing", r.replica_id)
+            replacement: Optional[Replica] = None
+            if state.restarts < cfg.max_restarts:
+                state.restarts += 1
+                replacement = self._start_replica(state)
+                alive.append(replacement)
             else:
-                logger.warning("replica %s unhealthy; stopping", r.replica_id)
-                r.stop(drain=False)
-                if state.restarts < cfg.max_restarts:
-                    state.restarts += 1
-                    alive.append(self._start_replica(state))
-                else:
-                    state.unhealthy = True
-                    logger.error(
-                        "%s: restart budget (%d) exhausted; deployment "
-                        "unhealthy until redeployed",
-                        cfg.name, cfg.max_restarts,
-                    )
+                state.unhealthy = True
+                logger.error(
+                    "%s: restart budget (%d) exhausted; deployment "
+                    "unhealthy until redeployed",
+                    cfg.name, cfg.max_restarts,
+                )
+            deferred.append(
+                lambda v=r, repl=replacement: self._retire(v, repl)
+            )
         state.replicas = alive
         # Scale to target — but an exhausted restart budget stops the
         # crash-loop: no replacements until a fresh deploy() resets it
@@ -227,14 +257,14 @@ class ServeController:
             state.replicas.append(self._start_replica(state))
         while len(state.replicas) > cfg.num_replicas:
             victim = state.replicas.pop()  # newest first, ref compact strategy
-            self._publish(state)           # stop routing before draining
-            victim.stop()
+            deferred.append(lambda v=victim: v.stop())
         # Publish only on membership change: every publish clears the
         # router's queue-len cache, so steady-state reconciles must be quiet.
         if [r.replica_id for r in state.replicas] != [
             r.replica_id for r in state.router.replicas()
         ]:
-            self._publish(state)
+            self._publish(state)  # routing stops before deferred drains run
+        return deferred
 
     def _publish(self, state: _DeploymentState) -> None:
         """Push the replica set to routers via long poll (ref long_poll)."""
@@ -246,6 +276,7 @@ class ServeController:
 
     # --- control loop -----------------------------------------------------
     def _control_step(self) -> None:
+        deferred: List[Callable[[], None]] = []
         with self._lock:
             for state in list(self._deployments.values()):
                 if state.policy is not None:
@@ -260,8 +291,10 @@ class ServeController:
                             target, metrics["total_ongoing"],
                         )
                         state.config.num_replicas = target
-                self._reconcile(state)
+                deferred.extend(self._reconcile(state))
             self._checkpoint()
+        for action in deferred:  # blocking stops run outside the lock
+            action()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.control_interval_s):
@@ -285,10 +318,12 @@ class ServeController:
             self._thread.join(timeout=5)
             self._thread = None
         with self._lock:
+            victims: List[Replica] = []
             for state in self._deployments.values():
-                for r in state.replicas:
-                    r.stop()
+                victims.extend(state.replicas)
                 state.replicas = []
+        for r in victims:
+            r.stop()
 
     # --- checkpoint / recovery (ref controller.py:545, app_state:1096) ----
     def _checkpoint(self) -> None:

@@ -1,0 +1,265 @@
+"""HTTP ingress proxy — the framework's front door.
+
+Re-creates Ray Serve's per-node proxy
+(``python/ray/serve/_private/proxy.py:136`` ``GenericProxy``, ``:779``
+``HTTPProxy``, actor wrapper ``:1153``) and its prefix router
+(``_private/proxy_router.py``): requests are matched by route prefix to a
+deployment handle, awaited, and returned as JSON. Implemented on asyncio
+streams with a minimal HTTP/1.1 parser — the framework owns both sides of
+the socket, so a full ASGI stack buys nothing on the hot path.
+
+Routes:
+- ``POST /api/{deployment}``  body = JSON payload → handle result
+- ``GET  /-/healthz``         liveness (ref proxy health checks)
+- ``GET  /-/status``          controller status snapshot
+- ``GET  /metrics``           Prometheus text exposition
+  (ref ``_private/metrics_agent.py:483,595`` Prometheus surfacing)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils import metrics as m
+
+logger = get_logger("proxy")
+
+PROXY_REQUESTS = m.Counter(
+    "rdb_proxy_requests_total", "HTTP requests", tag_keys=("route", "code")
+)
+
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+def _to_jsonable(obj: Any) -> Any:
+    """Results may be np arrays / DecodeResults; make them JSON-safe."""
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer, np.floating)):
+        return obj.item()
+    if hasattr(obj, "__dict__") and not isinstance(obj, type):
+        return {k: _to_jsonable(v) for k, v in vars(obj).items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    return obj
+
+
+class ProxyRouter:
+    """Longest-prefix route table (ref _private/proxy_router.py)."""
+
+    def __init__(self) -> None:
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._lock = threading.Lock()
+
+    def set_route(self, route: str, handle: DeploymentHandle) -> None:
+        with self._lock:
+            self._handles[route.rstrip("/")] = handle
+
+    def remove_route(self, route: str) -> None:
+        with self._lock:
+            self._handles.pop(route.rstrip("/"), None)
+
+    def match(self, path: str) -> Optional[Tuple[str, DeploymentHandle]]:
+        with self._lock:
+            candidates = sorted(self._handles, key=len, reverse=True)
+            for route in candidates:
+                if path == route or path.startswith(route + "/"):
+                    return route, self._handles[route]
+        return None
+
+
+class HTTPProxy:
+    """Asyncio HTTP server bridging sockets to deployment handles."""
+
+    def __init__(
+        self,
+        router: ProxyRouter,
+        host: str = "127.0.0.1",
+        port: int = 8265,
+        status_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        request_timeout_s: float = 60.0,
+    ) -> None:
+        self.router = router
+        self.host = host
+        self.port = port
+        self.status_fn = status_fn
+        self.request_timeout_s = request_timeout_s
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # --- HTTP plumbing ----------------------------------------------------
+    async def _read_request(
+        self, reader: asyncio.StreamReader
+    ) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, target, _version = line.decode("latin1").strip().split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if b":" in h:
+                k, v = h.decode("latin1").split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", "0") or "0")
+        if length > MAX_BODY_BYTES:
+            return method, target, headers, b""
+        body = await reader.readexactly(length) if length else b""
+        return method, target, headers, body
+
+    @staticmethod
+    def _response(code: int, payload: Any, reason: str = "") -> bytes:
+        body = json.dumps(_to_jsonable(payload)).encode()
+        status = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  500: "Internal Server Error", 503: "Service Unavailable",
+                  504: "Gateway Timeout"}.get(code, reason or "Error")
+        head = (
+            f"HTTP/1.1 {code} {status}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: keep-alive\r\n\r\n"
+        )
+        return head.encode() + body
+
+    @staticmethod
+    def _text_response(code: int, text: str, ctype: str) -> bytes:
+        body = text.encode()
+        head = (
+            f"HTTP/1.1 {code} OK\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: keep-alive\r\n\r\n"
+        )
+        return head.encode() + body
+
+    # --- request handling (ref GenericProxy.proxy_request, proxy.py:446) --
+    async def _handle_one(
+        self, method: str, path: str, body: bytes
+    ) -> Tuple[bytes, str]:
+        if method == "GET" and path == "/-/healthz":
+            return self._response(200, {"status": "ok"}), "healthz"
+        if method == "GET" and path == "/-/status":
+            status = self.status_fn() if self.status_fn else {}
+            return self._response(200, status), "status"
+        if method == "GET" and path == "/metrics":
+            return (
+                self._text_response(
+                    200, m.default_registry().prometheus_text(),
+                    "text/plain; version=0.0.4",
+                ),
+                "metrics",
+            )
+        matched = self.router.match(path)
+        if matched is None:
+            return self._response(404, {"error": f"no route for {path}"}), path
+        route, handle = matched
+        if method != "POST":
+            return self._response(400, {"error": "use POST"}), route
+        try:
+            payload = json.loads(body) if body else None
+        except json.JSONDecodeError as e:
+            return self._response(400, {"error": f"bad JSON: {e}"}), route
+
+        future = handle.remote(payload)
+        try:
+            result = await asyncio.wait_for(
+                asyncio.wrap_future(future), timeout=self.request_timeout_s
+            )
+        except asyncio.TimeoutError:
+            return self._response(504, {"error": "request timed out"}), route
+        except Exception as e:  # noqa: BLE001 — replica-side errors surface as 500
+            code = 503 if "no replica" in str(e) else 500
+            return self._response(code, {"error": str(e)}), route
+        return self._response(200, {"result": result}), route
+
+    async def _serve_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                method, path, _headers, body = req
+                resp, route = await self._handle_one(method, path, body)
+                code = resp.split(b" ", 2)[1].decode()
+                PROXY_REQUESTS.inc(tags={"route": route, "code": code})
+                writer.write(resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("connection handler failed")
+        finally:
+            writer.close()
+
+    # --- lifecycle --------------------------------------------------------
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _start():
+            self._server = await asyncio.start_server(
+                self._serve_conn, self.host, self.port
+            )
+            if self.port == 0:
+                self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+            async with self._server:
+                await self._server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(_start())
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._loop.close()
+
+    def start(self) -> "HTTPProxy":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="http-proxy", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("proxy failed to start")
+        logger.info("http proxy listening on %s:%d", self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        loop, server = self._loop, self._server
+        if loop is not None and server is not None:
+            # One threadsafe callback doing close + cancel atomically in the
+            # loop thread: scheduling a second call after server.close()
+            # races loop shutdown (Server.close() ends serve_forever, which
+            # lets _run's finally close the loop).
+            def _close() -> None:
+                server.close()
+                for task in asyncio.all_tasks(loop):
+                    if task is not asyncio.current_task(loop):
+                        task.cancel()
+
+            try:
+                loop.call_soon_threadsafe(_close)
+            except RuntimeError:
+                pass  # loop already closed — nothing left to stop
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

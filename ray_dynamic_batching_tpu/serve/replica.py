@@ -59,13 +59,11 @@ class Replica:
         max_batch_size: int = 8,
         batch_wait_timeout_s: float = 0.005,
         max_ongoing_requests: int = 256,
-        default_slo_ms: float = 30_000.0,
     ) -> None:
         self.replica_id = replica_id
         self.deployment = deployment
         self.fn = fn
         self.max_ongoing_requests = max_ongoing_requests
-        self.default_slo_ms = default_slo_ms
         self.queue = RequestQueue(deployment, max_len=max_ongoing_requests)
         self.policy = OpportunisticBatch(
             max_batch_size=max_batch_size,
@@ -150,6 +148,19 @@ class Replica:
         )
         self._thread.start()
 
+    def drain_queue(self) -> List[Request]:
+        """Stop accepting and pop everything still queued (the controller's
+        heal path salvages these onto a replacement replica instead of
+        rejecting work a live replica could serve)."""
+        self._stopped = True
+        out: List[Request] = []
+        while len(self.queue) > 0:
+            out.extend(
+                self.queue.get_batch(self.max_ongoing_requests,
+                                     discard_stale=False)
+            )
+        return out
+
     def stop(self, timeout_s: float = 5.0, drain: bool = True) -> None:
         """Graceful: stop accepting, drain the queue, then join."""
         self._stopped = True
@@ -158,13 +169,12 @@ class Replica:
             while self.queue_len() > 0 and time.monotonic() < deadline:
                 time.sleep(0.01)
         self._run.clear()
-        self.queue.wake_waiters()  # unblock the loop's condition wait
+        self.queue.close()  # releases the loop's condition wait permanently
         if self._thread is not None:
             self._thread.join(timeout_s)
             self._thread = None
-        # Reject anything left.
-        for req in self.queue.get_batch(self.max_ongoing_requests,
-                                        discard_stale=False):
+        # Reject everything left, however much reconfigure() shrank max_len.
+        for req in self.drain_queue():
             req.reject(RequestDropped(f"{self.replica_id} stopped"))
 
     def healthy(self, stall_timeout_s: float = 60.0) -> bool:

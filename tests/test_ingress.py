@@ -1,0 +1,199 @@
+"""HTTP proxy + socket ingress + workload patterns."""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.engine.ingress import IngressClient, SocketIngress
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.engine.workload import (
+    RatePattern,
+    WorkloadDriver,
+    arrival_times,
+    run_workloads,
+)
+from ray_dynamic_batching_tpu.serve import (
+    DeploymentConfig,
+    DeploymentHandle,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
+
+
+def double_batch(payloads):
+    return [p * 2 for p in payloads]
+
+
+@pytest.fixture
+def serving():
+    ctl = ServeController()
+    router = ctl.deploy(
+        DeploymentConfig(name="doubler", num_replicas=1),
+        factory=lambda: double_batch,
+    )
+    proxy_router = ProxyRouter()
+    proxy_router.set_route("/api/doubler", DeploymentHandle(router))
+    proxy = HTTPProxy(
+        proxy_router, port=0, status_fn=ctl.status, request_timeout_s=5.0
+    ).start()
+    yield proxy, ctl
+    proxy.stop()
+    ctl.shutdown()
+
+
+def http_req(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request(
+        method, path,
+        body=json.dumps(body) if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+class TestHTTPProxy:
+    def test_inference_roundtrip(self, serving):
+        proxy, _ = serving
+        status, data = http_req(proxy.port, "POST", "/api/doubler", 21)
+        assert status == 200
+        assert json.loads(data)["result"] == 42
+
+    def test_healthz_and_status(self, serving):
+        proxy, _ = serving
+        status, data = http_req(proxy.port, "GET", "/-/healthz")
+        assert status == 200 and json.loads(data)["status"] == "ok"
+        status, data = http_req(proxy.port, "GET", "/-/status")
+        assert status == 200
+        assert json.loads(data)["doubler"]["running_replicas"] == 1
+
+    def test_metrics_exposition(self, serving):
+        proxy, _ = serving
+        http_req(proxy.port, "POST", "/api/doubler", 1)
+        status, data = http_req(proxy.port, "GET", "/metrics")
+        assert status == 200
+        text = data.decode()
+        assert "rdb_proxy_requests_total" in text
+        assert "rdb_replica_requests_total" in text
+
+    def test_unknown_route_404(self, serving):
+        proxy, _ = serving
+        status, _ = http_req(proxy.port, "POST", "/api/nope", 1)
+        assert status == 404
+
+    def test_bad_json_400(self, serving):
+        proxy, _ = serving
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port, timeout=10)
+        conn.request("POST", "/api/doubler", body="{nope",
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 400
+        conn.close()
+
+    def test_keepalive_multiple_requests(self, serving):
+        proxy, _ = serving
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port, timeout=10)
+        for i in range(5):
+            conn.request("POST", "/api/doubler", body=json.dumps(i),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert json.loads(resp.read())["result"] == 2 * i
+        conn.close()
+
+    def test_concurrent_clients(self, serving):
+        proxy, _ = serving
+        results = {}
+
+        def worker(i):
+            results[i] = http_req(proxy.port, "POST", "/api/doubler", i)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        for i in range(8):
+            status, data = results[i]
+            assert status == 200 and json.loads(data)["result"] == 2 * i
+
+
+class TestSocketIngress:
+    def test_roundtrip_and_fire_and_forget(self):
+        served = []
+
+        def submit(req: Request) -> bool:
+            served.append(req)
+            req.fulfill(req.payload * 2)
+            return True
+
+        server = SocketIngress(submit, port=0).start()
+        try:
+            client = IngressClient("127.0.0.1", server.port)
+            out = client.send("m", 21, slo_ms=500.0, request_id="r1")
+            assert out == {"request_id": "r1", "result": 42}
+            # fire-and-forget mode (the reference's PULL behavior)
+            assert client.send("m", 1, reply=False) is None
+            deadline = time.monotonic() + 2
+            while len(served) < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(served) == 2
+            client.close()
+        finally:
+            server.stop()
+
+    def test_bad_request_and_rejection(self):
+        server = SocketIngress(lambda req: False, port=0).start()
+        try:
+            client = IngressClient("127.0.0.1", server.port)
+            out = client.send("m", 1, request_id="rX")
+            assert out["error"] == "rejected"
+            # malformed line
+            client._file.write(b"not json\n")
+            client._file.flush()
+            out = json.loads(client._file.readline())
+            assert "bad request" in out["error"]
+            client.close()
+        finally:
+            server.stop()
+
+
+class TestWorkload:
+    def test_patterns(self):
+        lin = RatePattern(kind="linear", base_rps=10, slope=2)
+        assert lin.rate(0) == 10 and lin.rate(5) == 20
+        sin = RatePattern(kind="sinusoidal", base_rps=10, amplitude=5,
+                          period_s=40)
+        assert sin.rate(10) == pytest.approx(15)
+        assert sin.rate(30) == pytest.approx(5)
+        step = RatePattern(kind="step", base_rps=10, amplitude=20, step_at_s=30)
+        assert step.rate(29) == 10 and step.rate(31) == 30
+        spike = RatePattern(kind="spike", base_rps=5, amplitude=50,
+                            spike_at_s=10, spike_len_s=2)
+        assert spike.rate(9) == 5 and spike.rate(11) == 55 and spike.rate(13) == 5
+        rnd = RatePattern(kind="random", base_rps=10, jitter=0.5, seed=1)
+        assert all(5 <= rnd.rate(t) <= 15 for t in range(10))
+
+    def test_arrival_times_uniform_and_poisson(self):
+        pat = RatePattern(kind="constant", base_rps=100)
+        uni = list(arrival_times(pat, 1.0))
+        assert len(uni) == pytest.approx(100, abs=2)
+        poi = list(arrival_times(pat, 1.0, poisson=True, seed=3))
+        assert 60 < len(poi) < 150  # Poisson spread
+        assert all(poi[i] < poi[i + 1] for i in range(len(poi) - 1))
+
+    def test_driver_submits_at_rate(self):
+        got = []
+        driver = WorkloadDriver(
+            lambda model, off: got.append((model, off)),
+            model="m",
+            pattern=RatePattern(kind="constant", base_rps=200),
+            duration_s=0.25,
+        )
+        total = run_workloads([driver], timeout_s=5)
+        assert total == len(got)
+        assert 30 <= total <= 60  # ~50 expected

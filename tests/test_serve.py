@@ -319,7 +319,9 @@ class TestController:
             victim._run.clear()  # simulate a dead loop
             victim.queue.wake_waiters()
             with ctl._lock:
-                ctl._reconcile(ctl._deployments["doubler"])
+                deferred = ctl._reconcile(ctl._deployments["doubler"])
+            for action in deferred:
+                action()
             status = ctl.status()["doubler"]
             assert status["running_replicas"] == 1
             assert status["restarts"] == 1
@@ -328,6 +330,31 @@ class TestController:
             # New replica serves.
             handle = DeploymentHandle(router)
             assert handle.remote(21).result(timeout=5) == 42
+        finally:
+            ctl.shutdown()
+
+    def test_heal_salvages_queued_requests(self):
+        """Requests queued on a dead replica must be served by its
+        replacement, not rejected."""
+        ctl = ServeController()
+        router = ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=1, max_restarts=3),
+            factory=lambda: double_batch,
+        )
+        try:
+            victim = router.replicas()[0]
+            victim._run.clear()  # dead loop; queue keeps accumulating
+            victim.queue.wake_waiters()
+            reqs = [Request(model="doubler", payload=i, slo_ms=5000)
+                    for i in range(5)]
+            for r in reqs:
+                assert victim.assign(r)
+            with ctl._lock:
+                deferred = ctl._reconcile(ctl._deployments["doubler"])
+            for action in deferred:
+                action()
+            for i, r in enumerate(reqs):
+                assert r.future.result(timeout=5) == 2 * i
         finally:
             ctl.shutdown()
 
@@ -405,7 +432,9 @@ class TestController:
                     r._run.clear()
                     r.queue.wake_waiters()
                 with ctl._lock:
-                    ctl._reconcile(state)
+                    deferred = ctl._reconcile(state)
+                for action in deferred:
+                    action()
             status = ctl.status()["doubler"]
             assert status["restarts"] == 2
             assert status["running_replicas"] == 0  # no endless respawn
