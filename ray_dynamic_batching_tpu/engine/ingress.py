@@ -75,15 +75,11 @@ class _IngressHandler(socketserver.StreamRequestHandler):
 
 
 def _jsonable(result: Any) -> Any:
-    import numpy as np
+    # One JSON-safety convention for both ingresses (dicts, np scalars and
+    # arrays, dataclass-ish results all covered).
+    from ray_dynamic_batching_tpu.serve.proxy import _to_jsonable
 
-    if isinstance(result, np.ndarray):
-        return result.tolist()
-    if hasattr(result, "__dict__") and not isinstance(result, type):
-        return {k: _jsonable(v) for k, v in vars(result).items()}
-    if isinstance(result, (list, tuple)):
-        return [_jsonable(x) for x in result]
-    return result
+    return _to_jsonable(result)
 
 
 class SocketIngress(socketserver.ThreadingTCPServer):

@@ -215,7 +215,10 @@ class ServeController:
                             "saturated"
                         )
                     )
-        victim.stop(drain=replacement is None)
+        # The victim's loop is dead or wedged (that's why it's being retired),
+        # so drain-waiting would just burn the full stop timeout before the
+        # leftover queue is rejected — stop immediately instead.
+        victim.stop(drain=False)
 
     def _reconcile(self, state: _DeploymentState) -> List[Callable[[], None]]:
         """Drive actual replica count to target; replace unhealthy.

@@ -58,20 +58,22 @@ class ProxyRouter:
 
     def __init__(self) -> None:
         self._handles: Dict[str, DeploymentHandle] = {}
+        self._sorted: Tuple[str, ...] = ()  # longest-first; rebuilt on mutation
         self._lock = threading.Lock()
 
     def set_route(self, route: str, handle: DeploymentHandle) -> None:
         with self._lock:
             self._handles[route.rstrip("/")] = handle
+            self._sorted = tuple(sorted(self._handles, key=len, reverse=True))
 
     def remove_route(self, route: str) -> None:
         with self._lock:
             self._handles.pop(route.rstrip("/"), None)
+            self._sorted = tuple(sorted(self._handles, key=len, reverse=True))
 
     def match(self, path: str) -> Optional[Tuple[str, DeploymentHandle]]:
         with self._lock:
-            candidates = sorted(self._handles, key=len, reverse=True)
-            for route in candidates:
+            for route in self._sorted:
                 if path == route or path.startswith(route + "/"):
                     return route, self._handles[route]
         return None
@@ -101,7 +103,7 @@ class HTTPProxy:
     # --- HTTP plumbing ----------------------------------------------------
     async def _read_request(
         self, reader: asyncio.StreamReader
-    ) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+    ) -> Optional[Tuple[str, str, Dict[str, str], Optional[bytes]]]:
         line = await reader.readline()
         if not line:
             return None
@@ -119,7 +121,10 @@ class HTTPProxy:
                 headers[k.strip().lower()] = v.strip()
         length = int(headers.get("content-length", "0") or "0")
         if length > MAX_BODY_BYTES:
-            return method, target, headers, b""
+            # body=None marks an oversized request: the caller answers 413
+            # and closes the connection (the unread bytes would desync any
+            # further pipelined parsing on this stream).
+            return method, target, headers, None
         body = await reader.readexactly(length) if length else b""
         return method, target, headers, body
 
@@ -167,7 +172,9 @@ class HTTPProxy:
             )
         matched = self.router.match(path)
         if matched is None:
-            return self._response(404, {"error": f"no route for {path}"}), path
+            # Fixed sentinel tag: tagging with the raw path would let any
+            # client mint unbounded metric label cardinality.
+            return self._response(404, {"error": f"no route for {path}"}), "unmatched"
         route, handle = matched
         if method != "POST":
             return self._response(400, {"error": "use POST"}), route
@@ -197,6 +204,13 @@ class HTTPProxy:
                 if req is None:
                     break
                 method, path, _headers, body = req
+                if body is None:  # oversized: answer and drop the connection
+                    resp = self._response(413, {"error": "body too large"},
+                                          reason="Payload Too Large")
+                    PROXY_REQUESTS.inc(tags={"route": "oversized", "code": "413"})
+                    writer.write(resp)
+                    await writer.drain()
+                    break
                 resp, route = await self._handle_one(method, path, body)
                 code = resp.split(b" ", 2)[1].decode()
                 PROXY_REQUESTS.inc(tags={"route": route, "code": code})
@@ -215,9 +229,14 @@ class HTTPProxy:
         asyncio.set_event_loop(self._loop)
 
         async def _start():
-            self._server = await asyncio.start_server(
-                self._serve_conn, self.host, self.port
-            )
+            try:
+                self._server = await asyncio.start_server(
+                    self._serve_conn, self.host, self.port
+                )
+            except OSError as e:  # bind failure — surface it to start()
+                self._start_error = e
+                self._started.set()
+                return
             if self.port == 0:
                 self.port = self._server.sockets[0].getsockname()[1]
             self._started.set()
@@ -234,12 +253,22 @@ class HTTPProxy:
     def start(self) -> "HTTPProxy":
         if self._thread is not None:
             return self
+        # Fresh state per start: a previous run's event/error must not make a
+        # restart report success before (or regardless of whether) we bind.
+        self._started = threading.Event()
+        self._start_error: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=self._run, name="http-proxy", daemon=True
         )
         self._thread.start()
         if not self._started.wait(10):
             raise RuntimeError("proxy failed to start")
+        if self._start_error is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+            raise RuntimeError(
+                f"proxy failed to bind {self.host}:{self.port}"
+            ) from self._start_error
         logger.info("http proxy listening on %s:%d", self.host, self.port)
         return self
 

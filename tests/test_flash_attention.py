@@ -1,0 +1,103 @@
+"""Pallas flash attention vs the XLA reference (interpret mode on CPU).
+
+Mirrors the reference's strategy of unit-testing the hot path against a
+trusted oracle (SURVEY.md §4.1 — profile-fixture-driven unit tests); here the
+oracle is the einsum attention in ops.attention._xla_attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_dynamic_batching_tpu.ops import flash_attention as fa
+from ray_dynamic_batching_tpu.ops.attention import _xla_attention
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype=dtype)
+
+
+def _check(q, k, v, *, causal=False, mask=None, atol=2e-3):
+    out = fa.flash_attention(q, k, v, causal=causal, mask=mask, interpret=True)
+    assert out is not None, "kernel declined a shape it should handle"
+    ref = _xla_attention(q, k, v, causal=causal, mask=mask, scale=None)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=atol, rtol=1e-3
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_basic_matches_xla(causal):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = _rand((2, 128, 4, 64), ks[0])
+    k = _rand((2, 128, 4, 64), ks[1])
+    v = _rand((2, 128, 4, 64), ks[2])
+    _check(q, k, v, causal=causal)
+
+
+def test_gqa_heads():
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = _rand((1, 128, 8, 64), ks[0])
+    k = _rand((1, 128, 2, 64), ks[1])
+    v = _rand((1, 128, 2, 64), ks[2])
+    _check(q, k, v, causal=True)
+
+
+def test_cross_lengths_causal_offset():
+    """Tq < Tk: causal offset k <= q + (Tk - Tq) (the decode-window rule)."""
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand((1, 64, 2, 64), ks[0])
+    k = _rand((1, 256, 2, 64), ks[1])
+    v = _rand((1, 256, 2, 64), ks[2])
+    _check(q, k, v, causal=True)
+
+
+def test_non_divisible_tail_blocks():
+    """Tq/Tk not multiples of the preferred tile: tail masking must hold."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand((1, 96, 2, 64), ks[0])
+    k = _rand((1, 160, 2, 64), ks[1])
+    v = _rand((1, 160, 2, 64), ks[2])
+    _check(q, k, v, causal=False)
+    _check(q, k, v, causal=True)
+
+
+def test_padding_mask():
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    B, T = 2, 128
+    q = _rand((B, T, 2, 64), ks[0])
+    k = _rand((B, T, 2, 64), ks[1])
+    v = _rand((B, T, 2, 64), ks[2])
+    lengths = jnp.array([100, 37])
+    key_valid = jnp.arange(T)[None, :] < lengths[:, None]  # [B, T]
+    mask = key_valid[:, None, None, :]  # [B,1,1,Tk]
+    _check(q, k, v, causal=True, mask=mask)
+
+
+def test_fully_masked_rows_zero_not_nan():
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = _rand((1, 32, 1, 64), ks[0])
+    k = _rand((1, 32, 1, 64), ks[1])
+    v = _rand((1, 32, 1, 64), ks[2])
+    mask = jnp.zeros((1, 1, 32, 32), bool)
+    out = fa.flash_attention(q, k, v, mask=mask, interpret=True)
+    assert out is not None
+    assert np.all(np.isfinite(np.asarray(out)))
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_declines_decode_shapes():
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = _rand((4, 1, 2, 64), ks[0])
+    k = _rand((4, 128, 2, 64), ks[1])
+    v = _rand((4, 128, 2, 64), ks[2])
+    assert fa.flash_attention(q, k, v, interpret=True) is None
+
+
+def test_bf16_inputs():
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = _rand((1, 128, 2, 64), ks[0], jnp.bfloat16)
+    k = _rand((1, 128, 2, 64), ks[1], jnp.bfloat16)
+    v = _rand((1, 128, 2, 64), ks[2], jnp.bfloat16)
+    _check(q, k, v, causal=True, atol=2e-2)
