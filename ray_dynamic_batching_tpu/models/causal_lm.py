@@ -54,8 +54,10 @@ class CausalLM(ServableModel):
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1])[None, :], tokens.shape
         )
+        # token_mask path: attention builds its own causal+padding mask and
+        # can route through ring attention under a sequence_parallel context.
         logits, _ = self.module.apply(
-            params, tokens, positions, prefill_mask(attn_mask)
+            params, tokens, positions, None, token_mask=attn_mask
         )
         return logits
 

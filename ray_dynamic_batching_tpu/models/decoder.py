@@ -120,8 +120,9 @@ class DecoderLayer(nn.Module):
         self,
         x: jax.Array,               # [B, T, D]
         positions: jax.Array,       # [B, T]
-        mask: jax.Array,            # [B, 1, T, S_attended] True = attend
+        mask: Optional[jax.Array],  # [B, 1, T, S_attended] True = attend
         layer_cache: Optional[Tuple[jax.Array, jax.Array]] = None,  # k/v [B,S,K,H]
+        token_mask: Optional[jax.Array] = None,  # [B, T] (no-cache path)
     ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
         cfg = self.cfg
         dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
@@ -161,6 +162,11 @@ class DecoderLayer(nn.Module):
                 )
             attn_out = attn_ops.dot_product_attention(q, k_cache, v_cache, mask=mask)
             new_cache = (k_cache, v_cache)
+        elif token_mask is not None:
+            # Full-sequence self-attention: routes through ring attention
+            # over the sp mesh axis under a sequence_parallel context.
+            attn_out = attn_ops.self_attention(q, k, v, token_mask, causal=True)
+            new_cache = None
         else:
             attn_out = attn_ops.dot_product_attention(q, k, v, mask=mask)
             new_cache = None
@@ -188,8 +194,9 @@ class DecoderModule(nn.Module):
         self,
         tokens: jax.Array,          # [B, T]
         positions: jax.Array,       # [B, T]
-        mask: jax.Array,            # [B, 1, T, S]
+        mask: Optional[jax.Array],  # [B, 1, T, S]
         cache: Optional[KVCache] = None,
+        token_mask: Optional[jax.Array] = None,  # [B, T] (no-cache path)
     ) -> Tuple[jax.Array, Optional[KVCache]]:
         cfg = self.cfg
         embed = nn.Embed(
@@ -216,7 +223,7 @@ class DecoderModule(nn.Module):
                 (cache.k[i], cache.v[i]) if cache is not None else None
             )
             x, updated = DecoderLayer(cfg, dtype=self.dtype, name=f"layer{i}")(
-                x, positions, mask, layer_cache
+                x, positions, mask, layer_cache, token_mask
             )
             if updated is not None:
                 new_k.append(updated[0])

@@ -10,12 +10,20 @@ model code.
 
 from __future__ import annotations
 
-from typing import Optional
+import contextlib
+import contextvars
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 _BACKEND = "auto"  # "auto" | "xla" | "pallas"
+# (mesh, axis) when sequence parallelism is active. ContextVar, not a module
+# global: concurrent jit traces (e.g. a serve replica warming up while a
+# train step traces) must not observe each other's mesh.
+_SP_CTX: contextvars.ContextVar[Optional[Tuple]] = contextvars.ContextVar(
+    "sequence_parallel_ctx", default=None
+)
 
 
 def set_attention_backend(backend: str) -> None:
@@ -23,6 +31,51 @@ def set_attention_backend(backend: str) -> None:
     if backend not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown attention backend {backend!r}")
     _BACKEND = backend
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh, axis: str = "sp"):
+    """While active (including during jit tracing), :func:`self_attention`
+    routes through the ring-attention kernel over the mesh's ``axis`` when
+    that axis has more than one device. The trace-time context is baked into
+    the compiled program, so enter it inside the jitted step function."""
+    token = _SP_CTX.set((mesh, axis))
+    try:
+        yield
+    finally:
+        _SP_CTX.reset(token)
+
+
+def self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    token_mask: Optional[jax.Array] = None,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Self-attention over a full (un-cached) sequence; q/k/v [B, T, *, H],
+    token_mask [B, T] True = valid. Under an active :func:`sequence_parallel`
+    context with sp > 1 this dispatches to ring attention (sequence sharded
+    over the ``sp`` mesh axis); otherwise dense attention with the causal +
+    padding mask built here."""
+    ctx = _SP_CTX.get()
+    if ctx is not None:
+        mesh, axis = ctx
+        if mesh.shape.get(axis, 1) > 1:
+            from ray_dynamic_batching_tpu.ops.ring_attention import (
+                ring_self_attention,
+            )
+
+            return ring_self_attention(
+                mesh, q, k, v, token_mask, causal=causal, scale=scale,
+                axis=axis,
+            )
+    mask = None
+    if token_mask is not None:
+        mask = token_mask[:, None, None, :].astype(bool)
+    return dot_product_attention(q, k, v, causal=causal, mask=mask, scale=scale)
 
 
 def _use_pallas() -> bool:

@@ -1,0 +1,175 @@
+"""Ring attention: sequence-parallel exact attention over the ``sp`` mesh axis.
+
+Long-context capability the reference lacks entirely (SURVEY.md §5
+"long-context / sequence parallelism — absent in the reference"; §2.4 lists
+SP/CP as a from-scratch TPU design item). Design follows the blockwise ring
+schedule (Liu et al., ring attention): the sequence is sharded contiguously
+over ``sp`` devices; each device keeps its query block resident and rotates
+the key/value blocks one hop around the ICI ring per step with
+``jax.lax.ppermute``, accumulating exact softmax attention online
+(flash-attention style running max / running sum), so no device ever
+materializes the full [T, T] score matrix and peak memory stays
+O(T_local^2 / sp) while compute stays exact.
+
+Meant to be called INSIDE ``shard_map`` (the framework wraps it via
+:func:`ring_self_attention`); communication is ppermute over ICI, which XLA
+overlaps with the per-block matmuls.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30  # finite sentinel: keeps the online-softmax NaN-free
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    kv_mask: Optional[jax.Array] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded along ``axis_name``.
+
+    Per-shard shapes: q [B, Tq, N, H]; k/v [B, Tk, K, H] with K == N or
+    K dividing N (GQA); kv_mask [B, Tk] True = valid key. The global sequence
+    is the concatenation of the per-device chunks in axis order, so global
+    position = chunk_index * T_local + local_offset (right-padded batches:
+    padding keys are masked via kv_mask, padding queries produce zeros and
+    are expected to be masked by the caller's loss/readout).
+    """
+    B, Tq, N, H = q.shape
+    _, Tk, K, _ = k.shape
+    if K != N:
+        assert N % K == 0, f"query heads {N} not divisible by kv heads {K}"
+        k = jnp.repeat(k, N // K, axis=2)
+        v = jnp.repeat(v, N // K, axis=2)
+    scale = scale if scale is not None else H ** -0.5
+
+    size = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    q_pos = idx * Tq + jnp.arange(Tq)  # global query positions [Tq]
+
+    m = jnp.full((B, N, Tq), _NEG_INF, dtype=jnp.float32)  # running max
+    l = jnp.zeros((B, N, Tq), dtype=jnp.float32)           # running denom
+    acc = jnp.zeros((B, Tq, N, H), dtype=jnp.float32)      # running numer
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Tk), dtype=bool)
+    kv_mask = kv_mask.astype(bool)
+
+    perm = [(j, (j + 1) % size) for j in range(size)]
+
+    def block_update(carry, k_blk, v_blk, mask_blk, src):
+        m, l, acc = carry
+        k_pos = src * Tk + jnp.arange(Tk)  # global key positions [Tk]
+        logits = jnp.einsum(
+            "bqnh,bknh->bnqk", q, k_blk, preferred_element_type=jnp.float32
+        ) * scale
+        valid = mask_blk[:, None, None, :]  # [B,1,1,Tk]
+        if causal:
+            valid = jnp.logical_and(
+                valid, (k_pos[None, None, None, :] <= q_pos[None, None, :, None])
+            )
+        logits = jnp.where(valid, logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        # Explicitly zero masked probabilities: with the finite -1e30
+        # sentinel, exp(logits - m_new) would be 1 (not 0) for a fully
+        # masked row whose running max is still the sentinel.
+        p = jnp.where(valid, jnp.exp(logits - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)  # rescale of previous accumulation
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bnqk,bknh->bqnh", p, v_blk.astype(jnp.float32)
+        )
+        return m_new, l, acc
+
+    # size is a traced value only under pmap; under shard_map over a Mesh it
+    # is static (mesh shape is known), so a Python loop unrolls the ring.
+    n_steps = int(size) if isinstance(size, int) else None
+    if n_steps is None:  # pragma: no cover - defensive; shard_map gives static
+        raise ValueError("ring_attention requires a static mesh axis size")
+
+    carry = (m, l, acc)
+    for step in range(n_steps):
+        src = (idx - step) % n_steps
+        if causal and step > 0:
+            # Skip compute for blocks wholly in the future of every local
+            # query (min key pos > max query pos) — about half the ring
+            # steps under causal masking; the ppermute still rotates.
+            carry = jax.lax.cond(
+                src * Tk > idx * Tq + (Tq - 1),
+                lambda c, *_: c,
+                block_update,
+                carry, k, v, kv_mask, src,
+            )
+        else:
+            carry = block_update(carry, k, v, kv_mask, src)
+        if step != n_steps - 1:
+            k, v, kv_mask = (
+                jax.lax.ppermute(x, axis_name, perm) for x in (k, v, kv_mask)
+            )
+    m, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_fn(
+    mesh: Mesh,
+    axis: str,
+    q_heads_div: bool,
+    causal: bool,
+    scale: Optional[float],
+):
+    head_ax = "tp" if q_heads_div and "tp" in mesh.shape else None
+    batch_ax = "dp" if "dp" in mesh.shape else None
+    qspec = P(batch_ax, axis, head_ax, None)
+    mspec = P(batch_ax, axis)
+    from jax.experimental.shard_map import shard_map
+
+    fn = functools.partial(
+        ring_attention, axis_name=axis, causal=causal, scale=scale
+    )
+    return shard_map(
+        lambda q, k, v, msk: fn(q, k, v, kv_mask=msk),
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec, mspec),
+        out_specs=qspec,
+        check_rep=False,
+    )
+
+
+def ring_self_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    token_mask: Optional[jax.Array] = None,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis: str = "sp",
+) -> jax.Array:
+    """Global-shape entry point: shard_maps :func:`ring_attention` over the
+    mesh (batch→dp, sequence→``axis``, heads→tp when divisible)."""
+    B, T, N, H = q.shape
+    K = k.shape[2]
+    tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get(axis, 1)
+    if T % sp != 0:
+        raise ValueError(f"sequence length {T} not divisible by {axis}={sp}")
+    heads_div = N % tp == 0 and K % tp == 0
+    if token_mask is None:
+        token_mask = jnp.ones((B, T), dtype=bool)
+    fn = _ring_fn(mesh, axis, heads_div, causal, scale)
+    return fn(q, k, v, token_mask.astype(bool))

@@ -19,9 +19,11 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ray_dynamic_batching_tpu.models.causal_lm import CausalLM
+from ray_dynamic_batching_tpu.ops import attention as attn_ops
 from ray_dynamic_batching_tpu.parallel.mesh import (
     batch_sharding,
     param_shardings,
+    seq_sharding,
     shard_params,
 )
 
@@ -56,18 +58,28 @@ def make_train_step(
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
 ) -> Callable:
-    """Compiled full train step: grads + optimizer update, donated state."""
+    """Compiled full train step: grads + optimizer update, donated state.
+
+    With sp > 1 the batch is sharded [dp, sp] (sequence split over sp; T must
+    divide by sp) and attention runs as ring attention over ICI — the
+    long-context training path (SURVEY.md §5)."""
+    sp = mesh.shape.get("sp", 1)
 
     def step(params, opt_state, tokens, attn_mask):
-        loss, grads = jax.value_and_grad(
-            lambda p: causal_lm_loss(model, p, tokens, attn_mask)
-        )(params)
+        # trace-time context: bakes the ring-attention dispatch into the
+        # compiled program when the mesh has a real sp axis
+        with attn_ops.sequence_parallel(mesh):
+            loss, grads = jax.value_and_grad(
+                lambda p: causal_lm_loss(model, p, tokens, attn_mask)
+            )(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     p_shard = param_shardings(mesh, model, model_abstract_params(model))
-    data_shard = batch_sharding(mesh, extra_dims=1)
+    data_shard = (
+        seq_sharding(mesh) if sp > 1 else batch_sharding(mesh, extra_dims=1)
+    )
     return jax.jit(
         step,
         in_shardings=(p_shard, None, data_shard, data_shard),
