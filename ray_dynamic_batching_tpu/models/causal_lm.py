@@ -61,6 +61,26 @@ class CausalLM(ServableModel):
         )
         return logits
 
+    def apply_with_aux(
+        self, params, tokens: jax.Array, attn_mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Forward plus the MoE load-balance auxiliary loss (0 for dense
+        models). Training losses must add ``aux_coef * aux`` or the router
+        collapses onto one expert and overflow tokens get zeroed."""
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1])[None, :], tokens.shape
+        )
+        (logits, _), state = self.module.apply(
+            params, tokens, positions, None, token_mask=attn_mask,
+            mutable=["intermediates"],
+        )
+        aux_leaves = [
+            jnp.asarray(x).sum()
+            for x in jax.tree_util.tree_leaves(state.get("intermediates", {}))
+        ]
+        aux = sum(aux_leaves) if aux_leaves else jnp.zeros((), jnp.float32)
+        return logits, aux
+
     def example_inputs(self, batch_size: int, seq_len: Optional[int] = None):
         T = seq_len or 128
         return (
@@ -155,6 +175,9 @@ class CausalLM(ServableModel):
             (r"mlp_gate/kernel", P(None, "tp")),
             (r"mlp_up/kernel", P(None, "tp")),
             (r"mlp_down/kernel", P("tp", None)),
+            (r"moe/wi", P("ep", None, "tp")),
+            (r"moe/wg", P("ep", None, "tp")),
+            (r"moe/wo", P("ep", "tp", None)),
             (r"tok_embed/embedding", P("tp", None)),
             (r"lm_head/kernel", P(None, "tp")),
         ]
@@ -208,6 +231,18 @@ TINY_LM = DecoderConfig(
     max_seq_len=256,
 )
 
+TINY_MOE = DecoderConfig(
+    vocab_size=512,
+    d_model=64,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    mlp_dim=128,
+    max_seq_len=256,
+    num_experts=4,
+    moe_top_k=2,
+)
+
 
 @register_model("gpt2_medium", slo=ModelSLO(latency_slo_ms=500.0))
 def _gpt2_medium(**kwargs) -> CausalLM:
@@ -222,3 +257,8 @@ def _llama3_8b(**kwargs) -> CausalLM:
 @register_model("llama_tiny")
 def _llama_tiny(**kwargs) -> CausalLM:
     return CausalLM(TINY_LM, name="llama_tiny", **kwargs)
+
+
+@register_model("moe_tiny")
+def _moe_tiny(**kwargs) -> CausalLM:
+    return CausalLM(TINY_MOE, name="moe_tiny", **kwargs)

@@ -46,6 +46,9 @@ class DecoderConfig:
     use_bias: bool = False
     tie_embeddings: bool = False
     rope_theta: float = 10000.0
+    num_experts: int = 0      # > 0 switches the MLP to a MoE block (ep axis)
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -175,13 +178,27 @@ class DecoderLayer(nn.Module):
         x = x + attn_out
 
         y = self._norm("mlp_norm")(x).astype(self.dtype)
-        if cfg.gated_mlp:
+        if cfg.num_experts > 0:
+            from ray_dynamic_batching_tpu.models.moe import MoEBlock
+
+            y = MoEBlock(
+                d_model=cfg.d_model,
+                mlp_dim=cfg.mlp_dim,
+                num_experts=cfg.num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                gated=cfg.gated_mlp,
+                dtype=self.dtype,
+                name="moe",
+            )(y)
+        elif cfg.gated_mlp:
             gate = dense(cfg.mlp_dim, "mlp_gate")(y)
             up = dense(cfg.mlp_dim, "mlp_up")(y)
             y = nn.silu(gate) * up
+            y = dense(cfg.d_model, "mlp_down")(y)
         else:
             y = nn.gelu(dense(cfg.mlp_dim, "mlp_up")(y))
-        y = dense(cfg.d_model, "mlp_down")(y)
+            y = dense(cfg.d_model, "mlp_down")(y)
         return x + y, new_cache
 
 

@@ -52,8 +52,6 @@ def ring_attention(
     _, Tk, K, _ = k.shape
     if K != N:
         assert N % K == 0, f"query heads {N} not divisible by kv heads {K}"
-        k = jnp.repeat(k, N // K, axis=2)
-        v = jnp.repeat(v, N // K, axis=2)
     scale = scale if scale is not None else H ** -0.5
 
     size = jax.lax.psum(1, axis_name)
@@ -71,6 +69,11 @@ def ring_attention(
 
     def block_update(carry, k_blk, v_blk, mask_blk, src):
         m, l, acc = carry
+        if K != N:
+            # GQA expand here, AFTER the ppermute, so the ring only ships
+            # the K kv heads (not the N-head expansion) over ICI
+            k_blk = jnp.repeat(k_blk, N // K, axis=2)
+            v_blk = jnp.repeat(v_blk, N // K, axis=2)
         k_pos = src * Tk + jnp.arange(Tk)  # global key positions [Tk]
         logits = jnp.einsum(
             "bqnh,bknh->bnqk", q, k_blk, preferred_element_type=jnp.float32
@@ -124,28 +127,20 @@ def ring_attention(
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_fn(
-    mesh: Mesh,
-    axis: str,
-    q_heads_div: bool,
-    causal: bool,
-    scale: Optional[float],
-):
-    head_ax = "tp" if q_heads_div and "tp" in mesh.shape else None
-    batch_ax = "dp" if "dp" in mesh.shape else None
-    qspec = P(batch_ax, axis, head_ax, None)
-    mspec = P(batch_ax, axis)
-    from jax.experimental.shard_map import shard_map
-
+def _ring_fn(mesh: Mesh, axis: str, causal: bool, scale: Optional[float]):
+    """Partial-manual shard_map: only the sequence axis is manual (the ring);
+    dp/tp sharding of batch and heads stays under GSPMD inside the body."""
+    qspec = P(None, axis, None, None)
+    mspec = P(None, axis)
     fn = functools.partial(
         ring_attention, axis_name=axis, causal=causal, scale=scale
     )
-    return shard_map(
+    return jax.shard_map(
         lambda q, k, v, msk: fn(q, k, v, kv_mask=msk),
         mesh=mesh,
         in_specs=(qspec, qspec, qspec, mspec),
         out_specs=qspec,
-        check_rep=False,
+        axis_names=frozenset({axis}),
     )
 
 
@@ -163,13 +158,10 @@ def ring_self_attention(
     """Global-shape entry point: shard_maps :func:`ring_attention` over the
     mesh (batch→dp, sequence→``axis``, heads→tp when divisible)."""
     B, T, N, H = q.shape
-    K = k.shape[2]
-    tp = mesh.shape.get("tp", 1)
     sp = mesh.shape.get(axis, 1)
     if T % sp != 0:
         raise ValueError(f"sequence length {T} not divisible by {axis}={sp}")
-    heads_div = N % tp == 0 and K % tp == 0
     if token_mask is None:
         token_mask = jnp.ones((B, T), dtype=bool)
-    fn = _ring_fn(mesh, axis, heads_div, causal, scale)
+    fn = _ring_fn(mesh, axis, causal, scale)
     return fn(q, k, v, token_mask.astype(bool))

@@ -31,18 +31,25 @@ from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("mesh")
 
-AXIS_ORDER = ("dp", "sp", "tp")
+AXIS_ORDER = ("dp", "pp", "sp", "tp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
+    """Axis sizes for the five-way parallelism mesh.
+
+    dp = data/replica, pp = pipeline stages, sp = sequence (ring attention),
+    tp = tensor, ep = expert (MoE). Axes default to 1 (inactive)."""
+
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
+    ep: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.sp * self.tp
+        return self.dp * self.pp * self.sp * self.tp * self.ep
 
     @staticmethod
     def auto(n_devices: int, tp: Optional[int] = None, sp: int = 1) -> "MeshConfig":
@@ -70,16 +77,19 @@ def build_mesh(
     n = config.n_devices
     if len(devices) < n:
         raise ValueError(
-            f"mesh needs {n} devices (dp={config.dp} sp={config.sp} "
-            f"tp={config.tp}) but only {len(devices)} available"
+            f"mesh needs {n} devices (dp={config.dp} pp={config.pp} "
+            f"sp={config.sp} tp={config.tp} ep={config.ep}) but only "
+            f"{len(devices)} available"
         )
-    arr = np.array(devices[:n]).reshape(config.dp, config.sp, config.tp)
+    arr = np.array(devices[:n]).reshape(
+        config.dp, config.pp, config.sp, config.tp, config.ep
+    )
     return Mesh(arr, AXIS_ORDER)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     devices = [device] if device is not None else jax.devices()[:1]
-    return Mesh(np.array(devices).reshape(1, 1, 1), AXIS_ORDER)
+    return Mesh(np.array(devices).reshape(1, 1, 1, 1, 1), AXIS_ORDER)
 
 
 # --- sharding helpers -----------------------------------------------------

@@ -1,0 +1,305 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over the ``pp`` axis.
+
+Pipeline parallelism is absent from the reference (SURVEY.md §2.4: "no
+pipeline engine"; its closest primitive is compiled actor-to-actor DAG
+channels, ``python/ray/dag/``). TPU-first design: instead of actor pipelines
+with NCCL channels, the stages live on a ``pp`` mesh axis inside ONE jitted
+SPMD program — a partial-manual ``shard_map`` is manual over ``pp`` only, so
+each device runs its stage's layers while dp/tp/ep sharding inside the stage
+stays under GSPMD. Activations hop stage→stage via ``ppermute`` over ICI;
+the classic GPipe schedule (M microbatches, S stages, M + S - 1 ticks) keeps
+every shape static so XLA compiles one program for the whole pipeline.
+
+The transformer is split layer-wise: embedding and LM head stay outside the
+pipeline (replicated/tp-sharded under GSPMD); the L decoder layers are
+stacked into leading-dim arrays and split contiguously over stages (device s
+holds layers [s*L/S, (s+1)*L/S)).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ray_dynamic_batching_tpu.models.causal_lm import CausalLM
+from ray_dynamic_batching_tpu.models.decoder import DecoderLayer, RMSNorm
+from ray_dynamic_batching_tpu.parallel.mesh import _feasible_spec
+
+
+class PipelinedCausalLM:
+    """A CausalLM split into pipeline stages over the mesh's ``pp`` axis.
+
+    Same ``apply(params, tokens, attn_mask) -> logits`` contract as
+    :class:`CausalLM`, but params are ``{"outer": ..., "layers": ...}`` with
+    the layer stack stacked along a leading [L] dim sharded over ``pp``.
+    """
+
+    def __init__(self, model: CausalLM, mesh: Mesh, n_microbatches: int = 2):
+        cfg = model.cfg
+        S = mesh.shape.get("pp", 1)
+        if cfg.num_layers % max(S, 1) != 0:
+            raise ValueError(
+                f"{cfg.num_layers} layers not divisible into {S} stages"
+            )
+        if mesh.shape.get("sp", 1) != 1:
+            raise ValueError("pipeline stages require sp=1 (dense attention)")
+        self.model = model
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_stages = S
+        self.n_microbatches = n_microbatches
+        self.dtype = model.dtype
+        self._layer_mod = DecoderLayer(cfg, dtype=model.dtype)
+
+    # --- params ----------------------------------------------------------
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        full = self.model.init(rng)
+        return self.split_params(full)
+
+    def split_params(self, full: Dict[str, Any]) -> Dict[str, Any]:
+        """Restructure flat model params into outer + stacked layers [L]."""
+        p = dict(full["params"])
+        layers = [p.pop(f"layer{i}") for i in range(self.cfg.num_layers)]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+        return {"outer": p, "layers": stacked}
+
+    def merge_params(self, split: Dict[str, Any]) -> Dict[str, Any]:
+        """Inverse of :meth:`split_params` (for checkpoint interchange)."""
+        p = dict(split["outer"])
+        L = self.cfg.num_layers
+        for i in range(L):
+            p[f"layer{i}"] = jax.tree_util.tree_map(
+                lambda x: x[i], split["layers"]
+            )
+        return {"params": p}
+
+    def shardings(self, abstract: Optional[Dict[str, Any]] = None):
+        """NamedShardings: stacked layers get P("pp", <model TP/EP rule>);
+        outer params follow the model's rules."""
+        if abstract is None:
+            abstract = jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
+        rules = self.model.sharding_rules()
+
+        def spec_for(path: str, prefix_pp: bool) -> P:
+            for pat, spec in rules:
+                if re.search(pat, path):
+                    return P("pp", *spec) if prefix_pp else spec
+            return P("pp") if prefix_pp else P()
+
+        def tree_specs(tree, prefix_pp: bool):
+            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+            paths = [
+                "/" + "/".join(
+                    str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+                )
+                for path, _ in flat
+            ]
+            # degrade indivisible dims to replication, like mesh.param_shardings
+            specs = [
+                _feasible_spec(spec_for(p, prefix_pp), leaf.shape, self.mesh)
+                for p, (_, leaf) in zip(paths, flat)
+            ]
+            treedef = jax.tree_util.tree_structure(tree)
+            return jax.tree_util.tree_unflatten(treedef, specs)
+
+        return {
+            "outer": jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                tree_specs(abstract["outer"], False),
+            ),
+            "layers": jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                tree_specs(abstract["layers"], True),
+            ),
+        }
+
+    def shard_init(self, rng: jax.Array) -> Dict[str, Any]:
+        params = self.init(rng)
+        return jax.device_put(params, self.shardings())
+
+    # --- forward ---------------------------------------------------------
+    def _embed(self, outer, tokens, positions):
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=self.dtype,
+            param_dtype=jnp.float32, name="tok_embed",
+        )
+        x = embed.apply({"params": outer["tok_embed"]}, tokens)
+        if cfg.pos == "learned":
+            pos_embed = nn.Embed(
+                cfg.max_seq_len, cfg.d_model, dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )
+            x = x + pos_embed.apply({"params": outer["pos_embed"]}, positions)
+        return x
+
+    def _head(self, outer, x):
+        cfg = self.cfg
+        if cfg.norm == "rms":
+            x = RMSNorm().apply({"params": outer["final_norm"]}, x)
+        else:
+            x = nn.LayerNorm(dtype=jnp.float32).apply(
+                {"params": outer["final_norm"]}, x
+            )
+        if cfg.tie_embeddings:
+            embed = nn.Embed(
+                cfg.vocab_size, cfg.d_model, dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )
+            return embed.apply(
+                {"params": outer["tok_embed"]},
+                x.astype(jnp.float32),
+                method=nn.Embed.attend,
+            )
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32,
+        ).apply({"params": outer["lm_head"]}, x)
+
+    def _stage_fn(self, stage_layers, x, positions, token_mask):
+        """Apply this stage's Lp layers (leading dim) via lax.scan; returns
+        (x, summed MoE aux loss for the stage's layers)."""
+
+        def body(h, layer_params):
+            (h, _), state = self._layer_mod.apply(
+                {"params": layer_params}, h, positions, None, None, token_mask,
+                mutable=["intermediates"],
+            )
+            aux_leaves = jax.tree_util.tree_leaves(
+                state.get("intermediates", {})
+            )
+            aux = (
+                sum(jnp.asarray(a).sum() for a in aux_leaves)
+                if aux_leaves
+                else jnp.zeros((), jnp.float32)
+            )
+            return h, aux
+
+        x, aux = jax.lax.scan(body, x, stage_layers)
+        return x, aux.sum()
+
+    def apply(
+        self, params: Dict[str, Any], tokens: jax.Array, attn_mask: jax.Array
+    ) -> jax.Array:
+        return self.apply_with_aux(params, tokens, attn_mask)[0]
+
+    def apply_with_aux(
+        self, params: Dict[str, Any], tokens: jax.Array, attn_mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Pipelined forward: embed → S stages over pp → head. [B,T]→[B,T,V].
+
+        Also returns the MoE load-balance aux loss summed over layers and
+        averaged over microbatches (0 for dense models)."""
+        B, T = tokens.shape
+        M, S = self.n_microbatches, self.n_stages
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible into {M} microbatches")
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = self._embed(params["outer"], tokens, positions)
+
+        if S == 1:
+            y, aux = self._stage_fn(
+                params["layers"], x, positions, attn_mask
+            )
+            return self._head(params["outer"], y), aux
+
+        mb = B // M
+        x_mb = x.reshape(M, mb, T, -1)
+        pos_mb = positions.reshape(M, mb, T)
+        msk_mb = attn_mask.reshape(M, mb, T)
+
+        stage_fn = self._stage_fn
+
+        def pipe(layers_stage, x_mb, pos_mb, msk_mb):
+            stage = jax.lax.axis_index("pp")
+            buf = jnp.zeros_like(x_mb[0])
+            outs = jnp.zeros_like(x_mb)
+            aux = jnp.zeros((), jnp.float32)
+            for t in range(M + S - 1):
+                # stage s works on microbatch t - s this tick
+                k_idx = jnp.clip(t - stage, 0, M - 1)
+                pos_t = jax.lax.dynamic_index_in_dim(
+                    pos_mb, k_idx, keepdims=False
+                )
+                msk_t = jax.lax.dynamic_index_in_dim(
+                    msk_mb, k_idx, keepdims=False
+                )
+                inp = jnp.where(stage == 0, x_mb[min(t, M - 1)], buf)
+                out, aux_t = stage_fn(layers_stage, inp, pos_t, msk_t)
+                # idle ticks (warmup/drain) compute on garbage — mask their
+                # aux contribution so the router loss sees real tokens only
+                active = jnp.logical_and(t - stage >= 0, t - stage < M)
+                aux = aux + jnp.where(active, aux_t, 0.0)
+                w = t - (S - 1)
+                if w >= 0:  # last stage emits microbatch w
+                    outs = outs.at[w].set(
+                        jnp.where(stage == S - 1, out, outs[w])
+                    )
+                if t != M + S - 2:
+                    buf = jax.lax.ppermute(
+                        out, "pp", [(i, i + 1) for i in range(S - 1)]
+                    )
+            # only the last stage holds real outputs; broadcast them.
+            # aux: each stage contributes its own layers' loss once per
+            # microbatch — psum totals over stages, /M averages microbatches
+            return jax.lax.psum(outs, "pp"), jax.lax.psum(aux, "pp") / M
+
+        y, aux = jax.shard_map(
+            pipe,
+            mesh=self.mesh,
+            in_specs=(P("pp"), P(), P(), P()),
+            out_specs=(P(), P()),
+            axis_names=frozenset({"pp"}),
+        )(params["layers"], x_mb, pos_mb, msk_mb)
+        y = y.reshape(B, T, -1)
+        return self._head(params["outer"], y), aux
+
+
+def make_pp_train_step(
+    pmodel: PipelinedCausalLM,
+    optimizer: optax.GradientTransformation,
+) -> Callable:
+    """Compiled pipelined train step (same contract as make_train_step)."""
+    mesh = pmodel.mesh
+
+    from ray_dynamic_batching_tpu.parallel.train import MOE_AUX_COEF
+
+    def loss_fn(params, tokens, attn_mask):
+        logits, aux = pmodel.apply_with_aux(params, tokens, attn_mask)
+        targets = tokens[:, 1:]
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], targets
+        )
+        w = attn_mask[:, 1:].astype(jnp.float32)
+        return (ce * w).sum() / jnp.maximum(w.sum(), 1.0) + MOE_AUX_COEF * aux
+
+    def step(params, opt_state, tokens, attn_mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, attn_mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    p_shard = pmodel.shardings()
+    data_shard = NamedSharding(mesh, P("dp", None))
+    return jax.jit(
+        step,
+        in_shardings=(p_shard, None, data_shard, data_shard),
+        donate_argnums=(0, 1),
+    )
+
+
+def make_pp_train_state(
+    pmodel: PipelinedCausalLM,
+    optimizer: optax.GradientTransformation,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[Any, Any]:
+    params = pmodel.shard_init(rng if rng is not None else jax.random.PRNGKey(0))
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state

@@ -28,15 +28,24 @@ from ray_dynamic_batching_tpu.parallel.mesh import (
 )
 
 
+MOE_AUX_COEF = 0.01  # load-balance loss weight (GShard-style)
+
+
 def causal_lm_loss(model: CausalLM, params: Any, tokens: jax.Array,
                    attn_mask: jax.Array) -> jax.Array:
-    """Next-token cross entropy, ignoring padding."""
-    logits = model.apply(params, tokens, attn_mask)  # [B, T, V]
+    """Next-token cross entropy, ignoring padding; MoE models add the
+    router load-balance auxiliary loss."""
+    if getattr(model.cfg, "num_experts", 0) > 0:
+        logits, aux = model.apply_with_aux(params, tokens, attn_mask)
+    else:
+        logits, aux = model.apply(params, tokens, attn_mask), 0.0
     targets = tokens[:, 1:]
     shift_logits = logits[:, :-1]
     ce = optax.softmax_cross_entropy_with_integer_labels(shift_logits, targets)
     weights = attn_mask[:, 1:].astype(jnp.float32)
-    return (ce * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    return (ce * weights).sum() / jnp.maximum(weights.sum(), 1.0) + (
+        MOE_AUX_COEF * aux
+    )
 
 
 def make_sharded_train_state(
