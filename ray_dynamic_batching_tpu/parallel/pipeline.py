@@ -57,6 +57,7 @@ class PipelinedCausalLM:
         self.n_microbatches = n_microbatches
         self.dtype = model.dtype
         self._layer_mod = DecoderLayer(cfg, dtype=model.dtype)
+        self._shardings = None  # memoized (init eval_shape is not free)
 
     # --- params ----------------------------------------------------------
     def init(self, rng: jax.Array) -> Dict[str, Any]:
@@ -83,6 +84,8 @@ class PipelinedCausalLM:
     def shardings(self, abstract: Optional[Dict[str, Any]] = None):
         """NamedShardings: stacked layers get P("pp", <model TP/EP rule>);
         outer params follow the model's rules."""
+        if abstract is None and self._shardings is not None:
+            return self._shardings
         if abstract is None:
             abstract = jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
         rules = self.model.sharding_rules()
@@ -109,7 +112,7 @@ class PipelinedCausalLM:
             treedef = jax.tree_util.tree_structure(tree)
             return jax.tree_util.tree_unflatten(treedef, specs)
 
-        return {
+        result = {
             "outer": jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.mesh, s),
                 tree_specs(abstract["outer"], False),
@@ -119,6 +122,8 @@ class PipelinedCausalLM:
                 tree_specs(abstract["layers"], True),
             ),
         }
+        self._shardings = result
+        return result
 
     def shard_init(self, rng: jax.Array) -> Dict[str, Any]:
         params = self.init(rng)
@@ -269,16 +274,12 @@ def make_pp_train_step(
     """Compiled pipelined train step (same contract as make_train_step)."""
     mesh = pmodel.mesh
 
-    from ray_dynamic_batching_tpu.parallel.train import MOE_AUX_COEF
+    from ray_dynamic_batching_tpu.parallel.train import causal_lm_loss
 
     def loss_fn(params, tokens, attn_mask):
-        logits, aux = pmodel.apply_with_aux(params, tokens, attn_mask)
-        targets = tokens[:, 1:]
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], targets
-        )
-        w = attn_mask[:, 1:].astype(jnp.float32)
-        return (ce * w).sum() / jnp.maximum(w.sum(), 1.0) + MOE_AUX_COEF * aux
+        # PipelinedCausalLM satisfies causal_lm_loss's model contract
+        # (.cfg, .apply, .apply_with_aux) — one loss definition, two paths
+        return causal_lm_loss(pmodel, params, tokens, attn_mask)
 
     def step(params, opt_state, tokens, attn_mask):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, attn_mask)

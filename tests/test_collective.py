@@ -1,0 +1,159 @@
+"""Collective API (ray.util.collective equivalent) on the fake 8-chip mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_dynamic_batching_tpu.parallel import collective as col
+from ray_dynamic_batching_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+@pytest.fixture
+def mesh():
+    return build_mesh(MeshConfig(dp=4, tp=2), jax.devices()[:8])
+
+
+@pytest.fixture(autouse=True)
+def _clean_groups():
+    yield
+    for name in ("default", "g2", "dp_tp"):
+        col.destroy_collective_group(name)
+
+
+def _stack(rng, g, shape=(3,)):
+    return jnp.asarray(rng.standard_normal((g, *shape)), jnp.float32)
+
+
+class TestOps:
+    def test_allreduce_ops(self, mesh):
+        group = col.CollectiveGroup(mesh, "dp")
+        rng = np.random.default_rng(0)
+        x = group.device_put(_stack(rng, 4))
+        for op, ref_fn in [
+            ("sum", lambda a: a.sum(0)),
+            ("max", lambda a: a.max(0)),
+            ("min", lambda a: a.min(0)),
+            ("mean", lambda a: a.mean(0)),
+        ]:
+            out = np.asarray(group.allreduce(x, op))
+            ref = np.asarray(ref_fn(np.asarray(x)))
+            for g in range(4):
+                np.testing.assert_allclose(out[g], ref, atol=1e-6)
+
+    def test_allreduce_multi_axis_group(self, mesh):
+        group = col.CollectiveGroup(mesh, ("dp", "tp"))
+        assert group.size == 8
+        rng = np.random.default_rng(1)
+        x = group.device_put(_stack(rng, 8))
+        out = np.asarray(group.allreduce(x))
+        ref = np.asarray(x).sum(0)
+        for g in range(8):
+            np.testing.assert_allclose(out[g], ref, atol=1e-5)
+
+    def test_broadcast(self, mesh):
+        group = col.CollectiveGroup(mesh, "dp")
+        rng = np.random.default_rng(2)
+        x = group.device_put(_stack(rng, 4))
+        out = np.asarray(group.broadcast(x, root=2))
+        for g in range(4):
+            np.testing.assert_allclose(out[g], np.asarray(x)[2], atol=1e-6)
+
+    def test_reduce_to_root(self, mesh):
+        group = col.CollectiveGroup(mesh, "dp")
+        rng = np.random.default_rng(3)
+        x = group.device_put(_stack(rng, 4))
+        out = np.asarray(group.reduce(x, root=1))
+        np.testing.assert_allclose(out[1], np.asarray(x).sum(0), atol=1e-6)
+        for g in (0, 2, 3):
+            np.testing.assert_array_equal(out[g], np.zeros(3, np.float32))
+
+    def test_allgather_replicates(self, mesh):
+        group = col.CollectiveGroup(mesh, "dp")
+        rng = np.random.default_rng(4)
+        x = group.device_put(_stack(rng, 4))
+        out = group.allgather(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+        # every addressable device now holds the full array
+        assert out.sharding.is_fully_replicated
+        # and the collective must compose under jit (real all_gather,
+        # not a resharding that jit would silently drop)
+        out_jit = jax.jit(group.allgather)(x)
+        np.testing.assert_array_equal(np.asarray(out_jit), np.asarray(x))
+        assert out_jit.sharding.is_fully_replicated
+
+    def test_reducescatter(self, mesh):
+        group = col.CollectiveGroup(mesh, "dp")
+        rng = np.random.default_rng(5)
+        x = group.device_put(
+            jnp.asarray(rng.standard_normal((4, 4, 3)), jnp.float32)
+        )
+        out = np.asarray(group.reducescatter(x))
+        ref = np.asarray(x).sum(0)  # [4, 3]: chunk g reduced over ranks
+        for g in range(4):
+            np.testing.assert_allclose(out[g], ref[g], atol=1e-6)
+
+    def test_send_recv_and_permute(self, mesh):
+        group = col.CollectiveGroup(mesh, "dp")
+        rng = np.random.default_rng(6)
+        x = group.device_put(_stack(rng, 4))
+        out = np.asarray(group.send_recv(x, src=0, dst=3))
+        np.testing.assert_allclose(out[3], np.asarray(x)[0], atol=1e-6)
+        for g in (0, 1, 2):
+            np.testing.assert_array_equal(out[g], np.zeros(3, np.float32))
+        ring = np.asarray(group.permute(x, [(i, (i + 1) % 4) for i in range(4)]))
+        for g in range(4):
+            np.testing.assert_allclose(
+                ring[(g + 1) % 4], np.asarray(x)[g], atol=1e-6
+            )
+
+    def test_ops_compose_under_jit(self, mesh):
+        group = col.CollectiveGroup(mesh, "dp")
+        rng = np.random.default_rng(7)
+        x = group.device_put(_stack(rng, 4))
+
+        @jax.jit
+        def fused(x):
+            y = group.allreduce(x)          # collective inside jit
+            return group.broadcast(y * 2, root=0)
+
+        out = np.asarray(fused(x))
+        ref = np.asarray(x).sum(0) * 2
+        for g in range(4):
+            np.testing.assert_allclose(out[g], ref, atol=1e-5)
+
+    def test_barrier_runs(self, mesh):
+        col.CollectiveGroup(mesh, "dp").barrier()
+
+    def test_rank_index(self, mesh):
+        group = col.CollectiveGroup(mesh, ("dp", "tp"))
+        np.testing.assert_array_equal(
+            np.asarray(group.rank_index()), np.arange(8)
+        )
+
+
+class TestRegistry:
+    def test_group_lifecycle(self, mesh):
+        assert not col.is_group_initialized("g2")
+        col.init_collective_group(mesh, "dp", group_name="g2")
+        assert col.is_group_initialized("g2")
+        with pytest.raises(ValueError):
+            col.init_collective_group(mesh, "dp", group_name="g2")
+        rng = np.random.default_rng(8)
+        group = col.get_collective_group("g2")
+        x = group.device_put(_stack(rng, 4))
+        out = np.asarray(col.allreduce(x, group_name="g2"))
+        np.testing.assert_allclose(out[0], np.asarray(x).sum(0), atol=1e-6)
+        col.destroy_collective_group("g2")
+        assert not col.is_group_initialized("g2")
+        with pytest.raises(KeyError):
+            col.get_collective_group("g2")
+
+    def test_bad_axis_and_shape_errors(self, mesh):
+        with pytest.raises(ValueError):
+            col.CollectiveGroup(mesh, "nope")
+        group = col.CollectiveGroup(mesh, "dp")
+        with pytest.raises(ValueError):
+            group.allreduce(jnp.zeros((3, 2)))  # 3 not divisible by 4
+        with pytest.raises(ValueError):
+            group.allreduce(jnp.zeros(()))
