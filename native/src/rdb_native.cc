@@ -1,0 +1,746 @@
+// rdb_native: C++ runtime substrate for the ray_dynamic_batching_tpu
+// framework — the TPU-native answer to the reference's C++ layer
+// (SURVEY.md §2.2): a shared-memory object store (plasma role,
+// src/ray/object_manager/plasma/store.cc), shared-memory MPMC request
+// queues with BATCH pop (fixing the per-item queue.get() RPC the reference
+// pays at 293-project/src/scheduler.py:277), an in-process KV store with
+// versioned long-poll watch (GCS KV + pubsub role, gcs_kv_manager.cc /
+// serve long_poll.py), an actor runtime with per-actor FIFO mailboxes on a
+// worker pool (core_worker actor-task ordering role,
+// transport/actor_scheduling_queue.cc), and a heartbeat health registry
+// (gcs_health_check_manager.cc role).
+//
+// Exposed as a flat C ABI for ctypes (no pybind11 in this image).
+// All blocking waits use condition variables with millisecond timeouts.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <list>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ===========================================================================
+// Shared-memory MPMC queue (cross-process): fixed capacity x item_size ring.
+// ===========================================================================
+
+struct ShmQueueHeader {
+  uint32_t magic;
+  uint32_t capacity;
+  uint32_t item_size;
+  uint32_t head;      // next slot to pop
+  uint32_t tail;      // next slot to push
+  uint32_t count;
+  uint64_t dropped;   // pushes rejected because full (reference drop policy,
+                      // 293-project/src/scheduler.py:238-254)
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  // slots follow: capacity * (4-byte len + item_size bytes)
+};
+
+struct rdb_queue {
+  ShmQueueHeader* h;
+  size_t map_size;
+  std::string name;
+  bool owner;
+};
+
+static constexpr uint32_t kQueueMagic = 0x52444251;  // "RDBQ"
+
+static uint8_t* slot_ptr(ShmQueueHeader* h, uint32_t idx) {
+  uint8_t* base = reinterpret_cast<uint8_t*>(h + 1);
+  return base + static_cast<size_t>(idx) * (4 + h->item_size);
+}
+
+rdb_queue* rdb_queue_create(const char* name, uint32_t capacity,
+                            uint32_t item_size) {
+  size_t size = sizeof(ShmQueueHeader) +
+                static_cast<size_t>(capacity) * (4 + item_size);
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = static_cast<ShmQueueHeader*>(mem);
+  h->capacity = capacity;
+  h->item_size = item_size;
+  h->head = h->tail = h->count = 0;
+  h->dropped = 0;
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_empty, &ca);
+  h->magic = kQueueMagic;
+  return new rdb_queue{h, size, name, true};
+}
+
+rdb_queue* rdb_queue_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = static_cast<ShmQueueHeader*>(mem);
+  if (h->magic != kQueueMagic) {
+    munmap(mem, st.st_size);
+    return nullptr;
+  }
+  return new rdb_queue{h, static_cast<size_t>(st.st_size), name, false};
+}
+
+static int lock_robust(pthread_mutex_t* mu) {
+  int rc = pthread_mutex_lock(mu);
+  if (rc == EOWNERDEAD) {  // a crashed process held the lock: recover
+    pthread_mutex_consistent(mu);
+    return 0;
+  }
+  return rc;
+}
+
+// 0 = ok, -1 = full (dropped), -2 = item too large
+int rdb_queue_push(rdb_queue* q, const uint8_t* data, uint32_t len) {
+  ShmQueueHeader* h = q->h;
+  if (len > h->item_size) return -2;
+  if (lock_robust(&h->mu) != 0) return -3;
+  if (h->count == h->capacity) {
+    h->dropped++;
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint8_t* slot = slot_ptr(h, h->tail);
+  memcpy(slot, &len, 4);
+  memcpy(slot + 4, data, len);
+  h->tail = (h->tail + 1) % h->capacity;
+  h->count++;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Pops up to max_items in ONE call (the batch-pop the reference lacks).
+// Blocks up to timeout_ms for the first item; returns count popped.
+int rdb_queue_pop_batch(rdb_queue* q, uint8_t* out, uint32_t max_items,
+                        uint32_t* lens, int timeout_ms) {
+  ShmQueueHeader* h = q->h;
+  if (lock_robust(&h->mu) != 0) return -3;
+  if (h->count == 0 && timeout_ms > 0) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    ts.tv_sec += timeout_ms / 1000;
+    ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec++;
+      ts.tv_nsec -= 1000000000L;
+    }
+    while (h->count == 0) {
+      if (pthread_cond_timedwait(&h->not_empty, &h->mu, &ts) != 0) break;
+    }
+  }
+  uint32_t n = 0;
+  while (n < max_items && h->count > 0) {
+    uint8_t* slot = slot_ptr(h, h->head);
+    uint32_t len;
+    memcpy(&len, slot, 4);
+    memcpy(out, slot + 4, len);
+    out += h->item_size;  // fixed stride so the caller can index results
+    lens[n] = len;
+    h->head = (h->head + 1) % h->capacity;
+    h->count--;
+    n++;
+  }
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int>(n);
+}
+
+uint32_t rdb_queue_size(rdb_queue* q) {
+  lock_robust(&q->h->mu);
+  uint32_t n = q->h->count;
+  pthread_mutex_unlock(&q->h->mu);
+  return n;
+}
+
+uint64_t rdb_queue_dropped(rdb_queue* q) {
+  lock_robust(&q->h->mu);
+  uint64_t n = q->h->dropped;
+  pthread_mutex_unlock(&q->h->mu);
+  return n;
+}
+
+uint32_t rdb_queue_item_size(rdb_queue* q) { return q->h->item_size; }
+uint32_t rdb_queue_capacity(rdb_queue* q) { return q->h->capacity; }
+
+void rdb_queue_close(rdb_queue* q, int unlink_shm) {
+  munmap(q->h, q->map_size);
+  if (unlink_shm) shm_unlink(q->name.c_str());
+  delete q;
+}
+
+// ===========================================================================
+// Shared-memory object store (plasma role): arena + object table + LRU.
+// ===========================================================================
+
+struct StoreObject {
+  uint64_t oid;
+  uint64_t offset;
+  uint64_t len;
+  uint64_t lru_tick;
+  uint32_t used;  // slot in use
+};
+
+struct StoreHeader {
+  uint32_t magic;
+  uint32_t max_objects;
+  uint64_t arena_bytes;
+  uint64_t used_bytes;
+  uint64_t lru_clock;
+  uint64_t evictions;
+  pthread_mutex_t mu;
+  // StoreObject[max_objects] follows, then the arena
+};
+
+struct rdb_store {
+  StoreHeader* h;
+  size_t map_size;
+  std::string name;
+};
+
+static constexpr uint32_t kStoreMagic = 0x52444253;  // "RDBS"
+
+static StoreObject* store_table(StoreHeader* h) {
+  return reinterpret_cast<StoreObject*>(h + 1);
+}
+static uint8_t* store_arena(StoreHeader* h) {
+  return reinterpret_cast<uint8_t*>(store_table(h) + h->max_objects);
+}
+
+rdb_store* rdb_store_create(const char* name, uint64_t arena_bytes,
+                            uint32_t max_objects) {
+  size_t size = sizeof(StoreHeader) + sizeof(StoreObject) * max_objects +
+                arena_bytes;
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = static_cast<StoreHeader*>(mem);
+  h->max_objects = max_objects;
+  h->arena_bytes = arena_bytes;
+  h->used_bytes = 0;
+  h->lru_clock = 0;
+  h->evictions = 0;
+  memset(store_table(h), 0, sizeof(StoreObject) * max_objects);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  h->magic = kStoreMagic;
+  return new rdb_store{h, size, name};
+}
+
+rdb_store* rdb_store_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = static_cast<StoreHeader*>(mem);
+  if (h->magic != kStoreMagic) {
+    munmap(mem, st.st_size);
+    return nullptr;
+  }
+  return new rdb_store{h, static_cast<size_t>(st.st_size), name};
+}
+
+static StoreObject* find_object(StoreHeader* h, uint64_t oid) {
+  StoreObject* t = store_table(h);
+  for (uint32_t i = 0; i < h->max_objects; i++) {
+    if (t[i].used && t[i].oid == oid) return &t[i];
+  }
+  return nullptr;
+}
+
+// Bump-compact allocator: objects live in a packed prefix [0, used_bytes).
+// On delete/evict we slide the tail down (memmove) and fix offsets — O(n)
+// but keeps zero fragmentation with a handful of large batch payloads,
+// which is the serving workload (plasma pays dlmalloc complexity for a
+// general workload we don't have).
+static void store_remove(StoreHeader* h, StoreObject* obj) {
+  uint8_t* arena = store_arena(h);
+  uint64_t hole_off = obj->offset, hole_len = obj->len;
+  memmove(arena + hole_off, arena + hole_off + hole_len,
+          h->used_bytes - hole_off - hole_len);
+  StoreObject* t = store_table(h);
+  for (uint32_t i = 0; i < h->max_objects; i++) {
+    if (t[i].used && t[i].offset > hole_off) t[i].offset -= hole_len;
+  }
+  h->used_bytes -= hole_len;
+  obj->used = 0;
+}
+
+// -1 full even after eviction, -2 exists, -3 no slots/lock, >=0 ok
+int64_t rdb_store_put(rdb_store* s, uint64_t oid, const uint8_t* data,
+                      uint64_t len) {
+  StoreHeader* h = s->h;
+  if (len > h->arena_bytes) return -1;
+  if (lock_robust(&h->mu) != 0) return -3;
+  if (find_object(h, oid)) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  // evict LRU until it fits (plasma eviction_policy.cc role)
+  while (h->used_bytes + len > h->arena_bytes) {
+    StoreObject* t = store_table(h);
+    StoreObject* victim = nullptr;
+    for (uint32_t i = 0; i < h->max_objects; i++) {
+      if (t[i].used && (!victim || t[i].lru_tick < victim->lru_tick)) {
+        victim = &t[i];
+      }
+    }
+    if (!victim) break;
+    store_remove(h, victim);
+    h->evictions++;
+  }
+  if (h->used_bytes + len > h->arena_bytes) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  StoreObject* t = store_table(h);
+  StoreObject* slot = nullptr;
+  for (uint32_t i = 0; i < h->max_objects; i++) {
+    if (!t[i].used) {
+      slot = &t[i];
+      break;
+    }
+  }
+  if (!slot) {
+    pthread_mutex_unlock(&h->mu);
+    return -3;
+  }
+  slot->oid = oid;
+  slot->offset = h->used_bytes;
+  slot->len = len;
+  slot->lru_tick = ++h->lru_clock;
+  slot->used = 1;
+  memcpy(store_arena(h) + slot->offset, data, len);
+  h->used_bytes += len;
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(len);
+}
+
+int64_t rdb_store_get(rdb_store* s, uint64_t oid, uint8_t* out,
+                      uint64_t cap) {
+  StoreHeader* h = s->h;
+  if (lock_robust(&h->mu) != 0) return -3;
+  StoreObject* obj = find_object(h, oid);
+  if (!obj) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t n = obj->len < cap ? obj->len : cap;
+  memcpy(out, store_arena(h) + obj->offset, n);
+  obj->lru_tick = ++h->lru_clock;
+  int64_t full = static_cast<int64_t>(obj->len);
+  pthread_mutex_unlock(&h->mu);
+  return full;
+}
+
+int rdb_store_delete(rdb_store* s, uint64_t oid) {
+  StoreHeader* h = s->h;
+  if (lock_robust(&h->mu) != 0) return -3;
+  StoreObject* obj = find_object(h, oid);
+  if (!obj) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  store_remove(h, obj);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int rdb_store_contains(rdb_store* s, uint64_t oid) {
+  lock_robust(&s->h->mu);
+  int r = find_object(s->h, oid) != nullptr;
+  pthread_mutex_unlock(&s->h->mu);
+  return r;
+}
+
+uint64_t rdb_store_used(rdb_store* s) {
+  lock_robust(&s->h->mu);
+  uint64_t n = s->h->used_bytes;
+  pthread_mutex_unlock(&s->h->mu);
+  return n;
+}
+
+uint64_t rdb_store_evictions(rdb_store* s) {
+  lock_robust(&s->h->mu);
+  uint64_t n = s->h->evictions;
+  pthread_mutex_unlock(&s->h->mu);
+  return n;
+}
+
+void rdb_store_close(rdb_store* s, int unlink_shm) {
+  munmap(s->h, s->map_size);
+  if (unlink_shm) shm_unlink(s->name.c_str());
+  delete s;
+}
+
+// ===========================================================================
+// KV store with versioned watch (GCS KV + long-poll role).
+// ===========================================================================
+
+struct KvEntry {
+  std::string value;
+  uint64_t version = 0;
+  bool deleted = false;
+};
+
+struct rdb_kv {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, KvEntry> data;
+  uint64_t global_version = 0;
+};
+
+rdb_kv* rdb_kv_create() { return new rdb_kv(); }
+void rdb_kv_destroy(rdb_kv* kv) { delete kv; }
+
+uint64_t rdb_kv_put(rdb_kv* kv, const char* key, const uint8_t* val,
+                    uint32_t len) {
+  std::lock_guard<std::mutex> g(kv->mu);
+  KvEntry& e = kv->data[key];
+  e.value.assign(reinterpret_cast<const char*>(val), len);
+  e.version = ++kv->global_version;
+  e.deleted = false;
+  kv->cv.notify_all();
+  return e.version;
+}
+
+// returns value length (may exceed cap; caller re-calls), -1 = missing
+int64_t rdb_kv_get(rdb_kv* kv, const char* key, uint8_t* out, uint32_t cap,
+                   uint64_t* version) {
+  std::lock_guard<std::mutex> g(kv->mu);
+  auto it = kv->data.find(key);
+  if (it == kv->data.end() || it->second.deleted) return -1;
+  const std::string& v = it->second.value;
+  uint32_t n = v.size() < cap ? v.size() : cap;
+  memcpy(out, v.data(), n);
+  if (version) *version = it->second.version;
+  return static_cast<int64_t>(v.size());
+}
+
+int rdb_kv_del(rdb_kv* kv, const char* key) {
+  std::lock_guard<std::mutex> g(kv->mu);
+  auto it = kv->data.find(key);
+  if (it == kv->data.end() || it->second.deleted) return -1;
+  it->second.deleted = true;
+  it->second.version = ++kv->global_version;
+  kv->cv.notify_all();
+  return 0;
+}
+
+// Long poll (serve/_private/long_poll.py:177 role): block until the key's
+// version advances past have_version (0 = any state change including
+// deletion), or timeout. Returns the new version, or 0 on timeout.
+uint64_t rdb_kv_watch(rdb_kv* kv, const char* key, uint64_t have_version,
+                      int timeout_ms) {
+  std::unique_lock<std::mutex> g(kv->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  std::string k(key);
+  for (;;) {
+    auto it = kv->data.find(k);
+    if (it != kv->data.end() && it->second.version > have_version) {
+      return it->second.version;
+    }
+    if (kv->cv.wait_until(g, deadline) == std::cv_status::timeout) {
+      return 0;
+    }
+  }
+}
+
+// newline-joined live keys with a matching prefix; returns byte length
+int64_t rdb_kv_keys(rdb_kv* kv, const char* prefix, uint8_t* out,
+                    uint32_t cap) {
+  std::lock_guard<std::mutex> g(kv->mu);
+  std::string joined;
+  std::string p(prefix);
+  for (auto& [k, e] : kv->data) {
+    if (e.deleted) continue;
+    if (k.compare(0, p.size(), p) != 0) continue;
+    if (!joined.empty()) joined += '\n';
+    joined += k;
+  }
+  uint32_t n = joined.size() < cap ? joined.size() : cap;
+  memcpy(out, joined.data(), n);
+  return static_cast<int64_t>(joined.size());
+}
+
+// ===========================================================================
+// Actor runtime: per-actor FIFO mailbox, worker-pool execution, restarts.
+// ===========================================================================
+
+typedef int (*rdb_actor_fn)(uint64_t actor_id, const uint8_t* msg,
+                            uint32_t len, void* ctx);
+
+struct Actor {
+  uint64_t id;
+  std::string name;
+  rdb_actor_fn fn;
+  void* ctx;
+  uint32_t mailbox_cap;
+  uint32_t max_restarts;
+  std::deque<std::string> mailbox;
+  bool running = false;   // claimed by a worker (per-actor serial order)
+  bool dead = false;
+  uint32_t restarts = 0;
+  uint64_t processed = 0;
+  uint64_t failed = 0;
+};
+
+struct rdb_actors {
+  std::mutex mu;
+  std::condition_variable work_cv;    // workers wait here
+  std::condition_variable drain_cv;   // drain() waits here
+  std::unordered_map<uint64_t, Actor> actors;
+  std::vector<std::thread> workers;
+  uint64_t next_id = 1;
+  uint64_t inflight = 0;
+  bool stopping = false;
+};
+
+static void actor_worker(rdb_actors* rt) {
+  std::unique_lock<std::mutex> g(rt->mu);
+  for (;;) {
+    Actor* pick = nullptr;
+    for (auto& [id, a] : rt->actors) {
+      if (!a.dead && !a.running && !a.mailbox.empty()) {
+        pick = &a;
+        break;
+      }
+    }
+    if (!pick) {
+      if (rt->stopping) return;
+      rt->work_cv.wait(g);
+      continue;
+    }
+    pick->running = true;
+    std::string msg = std::move(pick->mailbox.front());
+    pick->mailbox.pop_front();
+    rt->inflight++;
+    uint64_t id = pick->id;
+    rdb_actor_fn fn = pick->fn;
+    void* ctx = pick->ctx;
+    g.unlock();
+    int rc = fn(id, reinterpret_cast<const uint8_t*>(msg.data()),
+                msg.size(), ctx);
+    g.lock();
+    auto it = rt->actors.find(id);
+    if (it != rt->actors.end()) {
+      Actor& a = it->second;
+      a.running = false;
+      a.processed++;
+      if (rc != 0) {
+        a.failed++;
+        a.restarts++;
+        if (a.restarts > a.max_restarts) {
+          a.dead = true;  // gcs_actor_manager.cc:1361 max_restarts role
+          a.mailbox.clear();
+        }
+      }
+    }
+    rt->inflight--;
+    rt->work_cv.notify_all();
+    rt->drain_cv.notify_all();
+  }
+}
+
+rdb_actors* rdb_actors_create(uint32_t n_threads) {
+  auto* rt = new rdb_actors();
+  for (uint32_t i = 0; i < n_threads; i++) {
+    rt->workers.emplace_back(actor_worker, rt);
+  }
+  return rt;
+}
+
+uint64_t rdb_actor_register(rdb_actors* rt, const char* name, rdb_actor_fn fn,
+                            void* ctx, uint32_t mailbox_cap,
+                            uint32_t max_restarts) {
+  std::lock_guard<std::mutex> g(rt->mu);
+  uint64_t id = rt->next_id++;
+  Actor a;
+  a.id = id;
+  a.name = name;
+  a.fn = fn;
+  a.ctx = ctx;
+  a.mailbox_cap = mailbox_cap;
+  a.max_restarts = max_restarts;
+  rt->actors.emplace(id, std::move(a));
+  return id;
+}
+
+// 0 ok, -1 mailbox full (backpressure), -2 no such/dead actor
+int rdb_actor_post(rdb_actors* rt, uint64_t actor_id, const uint8_t* msg,
+                   uint32_t len) {
+  std::lock_guard<std::mutex> g(rt->mu);
+  auto it = rt->actors.find(actor_id);
+  if (it == rt->actors.end() || it->second.dead) return -2;
+  Actor& a = it->second;
+  if (a.mailbox.size() >= a.mailbox_cap) return -1;
+  a.mailbox.emplace_back(reinterpret_cast<const char*>(msg), len);
+  rt->work_cv.notify_one();
+  return 0;
+}
+
+// wait until every mailbox is empty and nothing is in flight
+int rdb_actors_drain(rdb_actors* rt, int timeout_ms) {
+  std::unique_lock<std::mutex> g(rt->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    bool idle = rt->inflight == 0;
+    for (auto& [id, a] : rt->actors) {
+      if (!a.dead && !a.mailbox.empty()) idle = false;
+    }
+    if (idle) return 0;
+    if (rt->drain_cv.wait_until(g, deadline) == std::cv_status::timeout) {
+      return -1;
+    }
+  }
+}
+
+uint64_t rdb_actor_processed(rdb_actors* rt, uint64_t actor_id) {
+  std::lock_guard<std::mutex> g(rt->mu);
+  auto it = rt->actors.find(actor_id);
+  return it == rt->actors.end() ? 0 : it->second.processed;
+}
+
+uint64_t rdb_actor_failed(rdb_actors* rt, uint64_t actor_id) {
+  std::lock_guard<std::mutex> g(rt->mu);
+  auto it = rt->actors.find(actor_id);
+  return it == rt->actors.end() ? 0 : it->second.failed;
+}
+
+int rdb_actor_is_dead(rdb_actors* rt, uint64_t actor_id) {
+  std::lock_guard<std::mutex> g(rt->mu);
+  auto it = rt->actors.find(actor_id);
+  return it == rt->actors.end() ? 1 : (it->second.dead ? 1 : 0);
+}
+
+void rdb_actors_destroy(rdb_actors* rt) {
+  {
+    std::lock_guard<std::mutex> g(rt->mu);
+    rt->stopping = true;
+    rt->work_cv.notify_all();
+  }
+  for (auto& t : rt->workers) t.join();
+  delete rt;
+}
+
+// ===========================================================================
+// Health registry: heartbeats + staleness (gcs_health_check_manager role).
+// ===========================================================================
+
+struct rdb_health {
+  std::mutex mu;
+  std::unordered_map<std::string,
+                     std::chrono::steady_clock::time_point> beats;
+  double timeout_s;
+};
+
+rdb_health* rdb_health_create(double timeout_s) {
+  auto* h = new rdb_health();
+  h->timeout_s = timeout_s;
+  return h;
+}
+void rdb_health_destroy(rdb_health* h) { delete h; }
+
+void rdb_health_report(rdb_health* h, const char* node) {
+  std::lock_guard<std::mutex> g(h->mu);
+  h->beats[node] = std::chrono::steady_clock::now();
+}
+
+int rdb_health_remove(rdb_health* h, const char* node) {
+  std::lock_guard<std::mutex> g(h->mu);
+  return h->beats.erase(node) ? 0 : -1;
+}
+
+// newline-joined stale nodes; returns byte length
+int64_t rdb_health_dead(rdb_health* h, uint8_t* out, uint32_t cap) {
+  std::lock_guard<std::mutex> g(h->mu);
+  auto now = std::chrono::steady_clock::now();
+  std::string joined;
+  for (auto& [node, t] : h->beats) {
+    double age = std::chrono::duration<double>(now - t).count();
+    if (age > h->timeout_s) {
+      if (!joined.empty()) joined += '\n';
+      joined += node;
+    }
+  }
+  uint32_t n = joined.size() < cap ? joined.size() : cap;
+  memcpy(out, joined.data(), n);
+  return static_cast<int64_t>(joined.size());
+}
+
+int rdb_health_alive_count(rdb_health* h) {
+  std::lock_guard<std::mutex> g(h->mu);
+  auto now = std::chrono::steady_clock::now();
+  int n = 0;
+  for (auto& [node, t] : h->beats) {
+    if (std::chrono::duration<double>(now - t).count() <= h->timeout_s) n++;
+  }
+  return n;
+}
+
+}  // extern "C"

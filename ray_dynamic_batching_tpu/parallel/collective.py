@@ -119,9 +119,16 @@ class CollectiveGroup:
 
         return self._shard_map(body, 1)(x)
 
+    def _check_rank(self, rank: int, what: str) -> None:
+        if not 0 <= rank < self.size:
+            raise ValueError(
+                f"{what}={rank} out of range for group of size {self.size}"
+            )
+
     def reduce(self, x: jax.Array, root: int = 0, op: str = "sum") -> jax.Array:
         """Like allreduce but only rank ``root`` keeps the result; other
         slots are zero (reference semantics: result lives on dst_rank)."""
+        self._check_rank(root, "root")
         full = self.allreduce(x, op)
 
         def body(red):
@@ -163,11 +170,11 @@ class CollectiveGroup:
     def reducescatter(self, x: jax.Array, op: str = "sum") -> jax.Array:
         """Each rank's buffer is pre-chunked [G, chunk]; rank g receives
         reduce over ranks of chunk g. [G, G, ...] -> [G, ...]."""
-        if x.ndim < 2 or x.shape[0] % self.size or x.shape[1] % self.size:
+        if x.ndim < 2 or x.shape[0] != self.size or x.shape[1] % self.size:
             raise ValueError(
-                f"reducescatter expects [G, G*chunk, ...], got {x.shape}"
+                f"reducescatter expects [G, G*chunk, ...] with G == "
+                f"{self.size}, got {x.shape}"
             )
-        self._check_leading(x)
         ax = self.axes if len(self.axes) > 1 else self.axes[0]
         if op != "sum":
             raise NotImplementedError("reducescatter supports op='sum'")
@@ -183,6 +190,7 @@ class CollectiveGroup:
 
     def broadcast(self, x: jax.Array, root: int = 0) -> jax.Array:
         """All ranks end with rank ``root``'s buffer. [G,...] -> [G,...]."""
+        self._check_rank(root, "root")
         self._check_leading(x)
         ax = self.axes if len(self.axes) > 1 else self.axes[0]
 
@@ -200,6 +208,9 @@ class CollectiveGroup:
         self._check_leading(x)
         if len(self.axes) != 1:
             raise NotImplementedError("permute requires a single-axis group")
+        for src, dst in perm:
+            self._check_rank(src, "src")
+            self._check_rank(dst, "dst")
         ax = self.axes[0]
         perm = list(perm)
 

@@ -84,6 +84,7 @@ class PipelinedCausalLM:
     def shardings(self, abstract: Optional[Dict[str, Any]] = None):
         """NamedShardings: stacked layers get P("pp", <model TP/EP rule>);
         outer params follow the model's rules."""
+        caller_abstract = abstract
         if abstract is None and self._shardings is not None:
             return self._shardings
         if abstract is None:
@@ -122,7 +123,8 @@ class PipelinedCausalLM:
                 tree_specs(abstract["layers"], True),
             ),
         }
-        self._shardings = result
+        if caller_abstract is None:  # don't poison the memo with a
+            self._shardings = result  # caller-supplied tree
         return result
 
     def shard_init(self, rng: jax.Array) -> Dict[str, Any]:

@@ -48,6 +48,43 @@ class KVStore:
         pass
 
 
+class NativeKVStore(KVStore):
+    """Backend on the C++ KV (native/src/rdb_native.cc): same string API
+    plus versioned long-poll ``watch`` — the GCS-KV + long-poll pairing the
+    reference splits across ``gcs_kv_manager.cc`` and
+    ``serve/_private/long_poll.py``."""
+
+    def __init__(self) -> None:
+        from ray_dynamic_batching_tpu.runtime import native
+
+        self._kv = native.KVStore()
+
+    def get(self, key: str) -> Optional[str]:
+        hit = self._kv.get(key)
+        return None if hit is None else hit[0].decode()
+
+    def get_versioned(self, key: str):
+        hit = self._kv.get(key)
+        return None if hit is None else (hit[0].decode(), hit[1])
+
+    def put(self, key: str, value: str) -> None:
+        self._kv.put(key, value.encode())
+
+    def delete(self, key: str) -> bool:
+        return self._kv.delete(key)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return sorted(self._kv.keys(prefix))
+
+    def watch(self, key: str, have_version: int = 0,
+              timeout_ms: int = 1000) -> int:
+        """Block until the key's version advances; 0 on timeout."""
+        return self._kv.watch(key, have_version, timeout_ms)
+
+    def close(self) -> None:
+        self._kv.close()
+
+
 class FileKVStore(KVStore):
     """KV persisted to a JSON file via atomic rename (ref Redis-backed GCS
     storage enabling head-node fault tolerance)."""

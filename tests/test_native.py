@@ -1,0 +1,314 @@
+"""C++ runtime substrate tests: shm queue (cross-thread and cross-process),
+object store (LRU eviction), KV watch (long poll), actor pool (ordering,
+parallelism, restart policy), health registry."""
+
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.runtime.native import (
+    ActorPool,
+    HealthTable,
+    KVStore,
+    NativeQueue,
+    ObjectStore,
+    build_native,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    build_native()
+
+
+def _qname(tag):
+    return f"/rdbtest_q_{tag}_{os.getpid()}"
+
+
+class TestQueue:
+    def test_push_pop_batch(self):
+        q = NativeQueue(_qname("basic"), capacity=16, item_size=64)
+        try:
+            for i in range(5):
+                assert q.push(f"item{i}".encode())
+            assert len(q) == 5
+            batch = q.pop_batch(3)
+            assert batch == [b"item0", b"item1", b"item2"]
+            assert q.pop_batch(10) == [b"item3", b"item4"]
+            assert q.pop_batch(10, timeout_ms=50) == []
+        finally:
+            q.close()
+
+    def test_drop_when_full(self):
+        q = NativeQueue(_qname("full"), capacity=2, item_size=16)
+        try:
+            assert q.push(b"a") and q.push(b"b")
+            assert not q.push(b"c")  # dropped, reference policy
+            assert q.dropped == 1
+        finally:
+            q.close()
+
+    def test_item_too_large(self):
+        q = NativeQueue(_qname("big"), capacity=2, item_size=8)
+        try:
+            with pytest.raises(ValueError):
+                q.push(b"x" * 9)
+        finally:
+            q.close()
+
+    def test_blocking_pop_wakes_on_push(self):
+        q = NativeQueue(_qname("wake"), capacity=8, item_size=32)
+        try:
+            got = []
+
+            def consumer():
+                got.extend(q.pop_batch(4, timeout_ms=2000))
+
+            t = threading.Thread(target=consumer)
+            t.start()
+            time.sleep(0.05)
+            q.push(b"late")
+            t.join(timeout=3)
+            assert got == [b"late"]
+        finally:
+            q.close()
+
+    def test_cross_process(self):
+        name = _qname("xproc")
+        q = NativeQueue(name, capacity=64, item_size=32)
+        try:
+            ctx = mp.get_context("spawn")
+            p = ctx.Process(target=_producer_proc, args=(name, 10))
+            p.start()
+            items = []
+            deadline = time.time() + 10
+            while len(items) < 10 and time.time() < deadline:
+                items.extend(q.pop_batch(10, timeout_ms=500))
+            p.join(timeout=5)
+            assert sorted(items) == [f"p{i}".encode() for i in range(10)]
+        finally:
+            q.close()
+
+
+def _producer_proc(name, n):
+    from ray_dynamic_batching_tpu.runtime.native import NativeQueue
+
+    q = NativeQueue(name, create=False)
+    for i in range(n):
+        q.push(f"p{i}".encode())
+    q.close(unlink=False)
+
+
+class TestObjectStore:
+    def test_put_get_delete(self):
+        s = ObjectStore(_qname("store"), capacity_bytes=1 << 16, max_objects=8)
+        try:
+            assert s.put(1, b"hello")
+            assert s.put(2, b"world!" * 100)
+            assert 1 in s and 2 in s
+            assert s.get(1) == b"hello"
+            assert s.get(2) == b"world!" * 100
+            assert s.get(99) is None
+            with pytest.raises(KeyError):
+                s.put(1, b"dup")  # immutable objects
+            assert s.delete(1)
+            assert 1 not in s
+            assert s.get(2) == b"world!" * 100  # compaction preserved data
+        finally:
+            s.close()
+
+    def test_lru_eviction(self):
+        s = ObjectStore(_qname("lru"), capacity_bytes=1000, max_objects=8)
+        try:
+            s.put(1, b"a" * 400)
+            s.put(2, b"b" * 400)
+            assert s.get(1) == b"a" * 400  # touch 1 -> 2 becomes LRU
+            s.put(3, b"c" * 400)           # must evict 2
+            assert 2 not in s
+            assert s.get(1) == b"a" * 400
+            assert s.get(3) == b"c" * 400
+            assert s.evictions == 1
+        finally:
+            s.close()
+
+    def test_cross_process_visibility(self):
+        name = _qname("storex")
+        s = ObjectStore(name, capacity_bytes=1 << 16)
+        try:
+            ctx = mp.get_context("spawn")
+            p = ctx.Process(target=_store_writer_proc, args=(name,))
+            p.start()
+            p.join(timeout=10)
+            assert p.exitcode == 0
+            assert s.get(42) == b"written-by-child"
+        finally:
+            s.close()
+
+
+def _store_writer_proc(name):
+    from ray_dynamic_batching_tpu.runtime.native import ObjectStore
+
+    s = ObjectStore(name, create=False)
+    assert s.put(42, b"written-by-child")
+    s.close(unlink=False)
+
+
+class TestKV:
+    def test_put_get_versions(self):
+        kv = KVStore()
+        try:
+            v1 = kv.put("a", b"1")
+            v2 = kv.put("a", b"2")
+            assert v2 > v1
+            val, ver = kv.get("a")
+            assert val == b"2" and ver == v2
+            assert kv.get("missing") is None
+            assert sorted(kv.keys()) == ["a"]
+            kv.put("ab", b"x")
+            kv.put("b", b"y")
+            assert sorted(kv.keys("a")) == ["a", "ab"]
+            assert kv.delete("a")
+            assert kv.get("a") is None
+        finally:
+            kv.close()
+
+    def test_watch_long_poll(self):
+        kv = KVStore()
+        try:
+            v = kv.put("cfg", b"v1")
+            # no change yet: times out
+            assert kv.watch("cfg", v, timeout_ms=80) == 0
+            result = {}
+
+            def watcher():
+                result["ver"] = kv.watch("cfg", v, timeout_ms=3000)
+
+            t = threading.Thread(target=watcher)
+            t.start()
+            time.sleep(0.05)
+            v2 = kv.put("cfg", b"v2")
+            t.join(timeout=4)
+            assert result["ver"] == v2
+            # deletion also advances the version (listeners see removals)
+            t2 = threading.Thread(
+                target=lambda: result.update(d=kv.watch("cfg", v2, 3000))
+            )
+            t2.start()
+            time.sleep(0.05)
+            kv.delete("cfg")
+            t2.join(timeout=4)
+            assert result["d"] > v2
+        finally:
+            kv.close()
+
+
+class TestActors:
+    def test_per_actor_fifo_order(self):
+        pool = ActorPool(n_threads=4)
+        try:
+            seen = []
+            lock = threading.Lock()
+
+            def handler(msg):
+                with lock:
+                    seen.append(msg)
+
+            a = pool.register("a", handler)
+            for i in range(50):
+                assert pool.post(a, f"{i}".encode())
+            assert pool.drain(5000)
+            assert seen == [f"{i}".encode() for i in range(50)]
+            assert pool.processed(a) == 50
+        finally:
+            pool.close()
+
+    def test_parallel_across_actors(self):
+        pool = ActorPool(n_threads=4)
+        try:
+            barrier = threading.Barrier(3, timeout=5)
+
+            def handler(_msg):
+                barrier.wait()  # only passes if 3 actors run concurrently
+
+            ids = [pool.register(f"p{i}", handler) for i in range(3)]
+            for aid in ids:
+                pool.post(aid, b"go")
+            assert pool.drain(5000)
+        finally:
+            pool.close()
+
+    def test_max_restarts_kills_actor(self):
+        pool = ActorPool(n_threads=2)
+        try:
+            def bad(_msg):
+                raise RuntimeError("boom")
+
+            a = pool.register("bad", bad, max_restarts=2)
+            for _ in range(3):
+                pool.post(a, b"x")
+                pool.drain(2000)
+            assert pool.failed(a) == 3
+            assert pool.is_dead(a)  # exceeded max_restarts
+            with pytest.raises(KeyError):
+                pool.post(a, b"more")
+        finally:
+            pool.close()
+
+    def test_mailbox_backpressure(self):
+        pool = ActorPool(n_threads=1)
+        try:
+            release = threading.Event()
+
+            def slow(_msg):
+                release.wait(5)
+
+            a = pool.register("slow", slow, mailbox_cap=2)
+            pool.post(a, b"0")  # picked up by the worker
+            time.sleep(0.05)
+            assert pool.post(a, b"1")
+            assert pool.post(a, b"2")
+            assert not pool.post(a, b"3")  # mailbox full
+            release.set()
+            assert pool.drain(5000)
+        finally:
+            pool.close()
+
+
+class TestHealth:
+    def test_staleness(self):
+        h = HealthTable(timeout_s=0.15)
+        try:
+            h.report("node1")
+            h.report("node2")
+            assert h.alive_count == 2
+            assert h.dead_nodes() == []
+            time.sleep(0.2)
+            h.report("node2")  # keep node2 fresh
+            assert sorted(h.dead_nodes()) == ["node1"]
+            assert h.alive_count == 1
+            assert h.remove("node1")
+            assert h.dead_nodes() == []
+        finally:
+            h.close()
+
+
+class TestNativeKVAdapter:
+    def test_string_api_and_watch(self):
+        from ray_dynamic_batching_tpu.runtime.kv import NativeKVStore
+
+        kv = NativeKVStore()
+        try:
+            kv.put("app/state", "v1")
+            assert kv.get("app/state") == "v1"
+            _, ver = kv.get_versioned("app/state")
+            assert kv.watch("app/state", ver, timeout_ms=50) == 0
+            kv.put("app/state", "v2")
+            assert kv.watch("app/state", ver, timeout_ms=1000) > ver
+            assert kv.keys("app/") == ["app/state"]
+            assert kv.delete("app/state")
+            assert kv.get("app/state") is None
+        finally:
+            kv.close()
