@@ -104,14 +104,11 @@ class ServableModel(abc.ABC):
 def param_path_specs(model: ServableModel, params: Params) -> Any:
     """Map every param leaf to its PartitionSpec via the model's rules."""
 
+    from ray_dynamic_batching_tpu.utils.pytree import path_str
+
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     treedef = jax.tree_util.tree_structure(params)
-    specs = []
-    for path, _leaf in flat:
-        path_str = "/".join(
-            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
-        )
-        specs.append(model.partition_spec_for(path_str))
+    specs = [model.partition_spec_for(path_str(path)) for path, _leaf in flat]
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 

@@ -97,14 +97,11 @@ class PipelinedCausalLM:
                     return P("pp", *spec) if prefix_pp else spec
             return P("pp") if prefix_pp else P()
 
+        from ray_dynamic_batching_tpu.utils.pytree import path_str
+
         def tree_specs(tree, prefix_pp: bool):
             flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-            paths = [
-                "/" + "/".join(
-                    str(getattr(k, "key", getattr(k, "idx", k))) for k in path
-                )
-                for path, _ in flat
-            ]
+            paths = ["/" + path_str(path) for path, _ in flat]
             # degrade indivisible dims to replication, like mesh.param_shardings
             specs = [
                 _feasible_spec(spec_for(p, prefix_pp), leaf.shape, self.mesh)

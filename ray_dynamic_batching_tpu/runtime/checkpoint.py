@@ -1,0 +1,223 @@
+"""Checkpoint / resume for model weights and train state.
+
+The reference serves models straight from a Python registry and reloads
+them on placement (``293-project/src/scheduler.py:507-515``) — its only
+checkpointing is control-plane state in GCS KV (SURVEY.md §5). On TPU,
+model placement means restoring weights into HBM with the right shardings,
+so weight checkpointing is a first-class subsystem here:
+
+- :class:`CheckpointManager` — step-indexed, keep-last-N, atomic
+  (write-to-tmp + rename), orbax-style management over a numpy format.
+- Sharding-aware restore: pass ``shardings`` (a pytree of NamedSharding,
+  e.g. from ``mesh.param_shardings``) and leaves land on the mesh directly.
+- Works for bare params or full train state (params + opt state + step).
+
+Control-plane checkpointing (serve controller -> KV under a checkpoint key)
+lives in :mod:`ray_dynamic_batching_tpu.serve.controller`; this module is
+the data-plane (weights) side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils.pytree import flatten_with_paths
+
+logger = get_logger("checkpoint")
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def save_pytree(path: os.PathLike, tree: Any) -> None:
+    """Single-checkpoint save: npz of leaves + json manifest, committed by
+    rename. Overwriting an existing checkpoint swaps via two renames, so
+    the vulnerable window is microseconds (not a whole rmtree)."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    flat = flatten_with_paths(tree)  # raises on path collisions
+    arrays = {k: np.asarray(v) for k, v in flat.items()}
+    np.savez(tmp / "leaves.npz", **arrays)
+    treedef = jax.tree_util.tree_structure(tree)
+    (tmp / "manifest.json").write_text(
+        json.dumps({
+            "keys": list(arrays.keys()),
+            "treedef": str(treedef),
+            "dtypes": {k: str(v.dtype) for k, v in flat.items()},
+        })
+    )
+    old = path.with_name(path.name + ".old")
+    if old.exists():
+        shutil.rmtree(old)
+    if path.exists():
+        path.rename(old)
+    tmp.rename(path)
+    if old.exists():
+        shutil.rmtree(old)
+
+
+def restore_pytree(
+    path: os.PathLike,
+    target: Any,
+    shardings: Optional[Any] = None,
+) -> Any:
+    """Restore into the structure of ``target`` (an abstract or concrete
+    pytree). With ``shardings`` (matching pytree of NamedSharding), leaves
+    are placed on the mesh; otherwise on the default device."""
+    path = Path(path)
+    manifest = json.loads((path / "manifest.json").read_text())
+    saved_dtypes = manifest.get("dtypes", {})
+    flat_target = flatten_with_paths(target)  # ordered: flatten order
+    flat_shard = flatten_with_paths(shardings) if shardings is not None else {}
+    leaves = []
+    with np.load(path / "leaves.npz") as data:
+        missing = [k for k in flat_target if k not in data]
+        if missing:
+            raise KeyError(
+                f"checkpoint {path} missing {len(missing)} leaves, "
+                f"first: {missing[:3]}"
+            )
+        for key, tgt in flat_target.items():
+            arr = data[key]
+            if arr.dtype.kind == "V" and key in saved_dtypes:
+                # custom float (bfloat16 etc): npz round-trips it as raw
+                # void bytes; re-view with the recorded dtype
+                arr = arr.view(jnp.dtype(saved_dtypes[key]))
+            arr = arr.astype(getattr(tgt, "dtype", arr.dtype))
+            if key in flat_shard:
+                leaves.append(jax.device_put(arr, flat_shard[key]))
+            else:
+                leaves.append(jnp.asarray(arr))
+    treedef = jax.tree_util.tree_structure(target)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class CheckpointManager:
+    """Step-indexed checkpoint directory with retention — orbax-style
+    management (step dirs, keep-last-N gc, atomic rename-commit) over a
+    self-contained numpy format (npz leaves + json manifest), so restores
+    have no library-version coupling and custom float dtypes (bfloat16)
+    round-trip by raw view.
+
+    Layout: ``root/step_<N>/{leaves.npz,manifest.json,metadata.json}``;
+    ``latest_step()`` finds the newest.
+    """
+
+    def __init__(self, root: os.PathLike, max_to_keep: int = 3):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._lock = threading.Lock()
+
+    # --- introspection ----------------------------------------------------
+    def steps(self) -> List[int]:
+        out = []
+        for child in self.root.iterdir():
+            m = _STEP_DIR.match(child.name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def _dir(self, step: int) -> Path:
+        return self.root / f"step_{step}"
+
+    # --- save / restore ---------------------------------------------------
+    def save(self, step: int, tree: Any, metadata: Optional[Dict] = None) -> Path:
+        with self._lock:
+            d = self._dir(step)
+            save_pytree(d, tree)
+            if metadata is not None:
+                (d / "metadata.json").write_text(json.dumps(metadata))
+            self._gc()
+            return d
+
+    def restore(
+        self,
+        target: Any,
+        step: Optional[int] = None,
+        shardings: Optional[Any] = None,
+    ) -> Any:
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.root}")
+        return restore_pytree(self._dir(step), target, shardings)
+
+    def metadata(self, step: Optional[int] = None) -> Optional[Dict]:
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        meta = self._dir(step) / "metadata.json"
+        return json.loads(meta.read_text()) if meta.exists() else None
+
+    def delete(self, step: int) -> None:
+        with self._lock:
+            d = self._dir(step)
+            if d.exists():
+                shutil.rmtree(d)
+
+    def _gc(self) -> None:
+        steps = self.steps()
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            shutil.rmtree(self._dir(victim), ignore_errors=True)
+            logger.info("checkpoint gc: removed step_%d", victim)
+
+
+def save_train_state(
+    manager: CheckpointManager,
+    step: int,
+    params: Any,
+    opt_state: Any = None,
+    **metadata: Any,
+) -> Path:
+    """Convenience: params (+ optional optimizer state) under one step."""
+    tree = {"params": params}
+    if opt_state is not None:
+        tree["opt_state"] = opt_state
+    return manager.save(step, tree, metadata={"step": step, **metadata})
+
+
+def restore_train_state(
+    manager: CheckpointManager,
+    params_target: Any,
+    opt_state_target: Any = None,
+    step: Optional[int] = None,
+    params_shardings: Optional[Any] = None,
+    opt_state_shardings: Optional[Any] = None,
+):
+    """Inverse of :func:`save_train_state`; returns (params, opt_state|None,
+    step restored)."""
+    step = step if step is not None else manager.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {manager.root}")
+    target = {"params": params_target}
+    if opt_state_target is not None:
+        target["opt_state"] = opt_state_target
+    # leaves with no sharding entry restore unsharded; either shardings
+    # argument may be given independently of the other
+    shardings = {}
+    if params_shardings is not None:
+        shardings["params"] = params_shardings
+    if opt_state_shardings is not None and opt_state_target is not None:
+        shardings["opt_state"] = opt_state_shardings
+    restored = restore_pytree(
+        manager._dir(step), target, shardings or None
+    )
+    return restored["params"], restored.get("opt_state"), step

@@ -1,0 +1,36 @@
+"""Shared pytree path utilities.
+
+Single owner of the path→string convention used by param sharding rules
+(models/base.py), pipeline layer stacking (parallel/pipeline.py), and
+checkpoint keys (runtime/checkpoint.py) — these must stay byte-identical
+or checkpoint keys stop matching partition-spec paths.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+
+def path_str(path) -> str:
+    """'/'-joined key path for a tree_flatten_with_path entry."""
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
+
+
+def flatten_with_paths(tree: Any) -> Dict[str, Any]:
+    """Ordered {path_str: leaf} (flatten order); raises on key collisions
+    (e.g. {'a': {'b': ...}, 'a/b': ...} both stringify to 'a/b' — silent
+    merging would corrupt checkpoints)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out: Dict[str, Any] = {}
+    for path, leaf in flat:
+        key = path_str(path)
+        if key in out:
+            raise ValueError(
+                f"pytree path collision: two leaves stringify to {key!r}"
+            )
+        out[key] = leaf
+    return out
