@@ -22,6 +22,7 @@ import threading
 from typing import Any, Callable, Optional
 
 from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("ingress")
@@ -47,6 +48,14 @@ class _IngressHandler(socketserver.StreamRequestHandler):
                 )
             except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
                 self._reply({"error": f"bad request: {e}"})
+                continue
+            if chaos().should_fail("ingress.handle"):
+                # chaos: ingress drops the request on the floor (lost
+                # frontend RPC); client sees an error reply, not a hang
+                self._reply(
+                    {"request_id": request.request_id,
+                     "error": "chaos injected at ingress.handle"}
+                )
                 continue
             accepted = server.submit(request)
             if not msg.get("reply", True):

@@ -23,6 +23,7 @@ from typing import Any, Callable, List, Optional, Sequence
 from ray_dynamic_batching_tpu.engine.batching import OpportunisticBatch
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
+from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
 
@@ -103,6 +104,7 @@ class Replica:
             self._ongoing += len(batch)
         self._batch_started_at = time.monotonic()
         try:
+            chaos().maybe_fail("replica.process_batch")
             results = self.fn([r.payload for r in batch])
             if len(results) != len(batch):
                 raise ValueError(
@@ -134,6 +136,9 @@ class Replica:
     def _loop(self) -> None:
         while self._run.is_set():
             self.last_heartbeat = time.monotonic()
+            # chaos: an injected loop failure kills this replica's thread,
+            # simulating a worker crash the controller must detect + replace
+            chaos().maybe_fail("replica.loop")
             batch = self.policy.next_batch(self.queue)
             if batch:
                 self._process_batch(batch)

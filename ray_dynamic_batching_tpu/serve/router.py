@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
 from ray_dynamic_batching_tpu.serve.replica import Replica
+from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
 
@@ -118,6 +119,11 @@ class Router:
         while True:
             candidates = [r for r in self.replicas() if r.accepting()]
             chosen = self._choose(candidates, locality_hint)
+            # chaos: a dropped assignment RPC — falls into the normal
+            # backoff/retry path, like a lost PushActorTask in the reference
+            # (only burns budget when there was a real assignment to drop)
+            if chosen is not None and chaos().should_fail("router.assign"):
+                chosen = None
             if chosen is not None and chosen.assign(request):
                 # Invalidate the cache entry so bursts spread out.
                 self._len_cache.pop(chosen.replica_id, None)

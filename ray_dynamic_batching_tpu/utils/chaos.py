@@ -1,0 +1,113 @@
+"""Fault-injection hooks — the ``RAY_testing_rpc_failure`` equivalent.
+
+The reference injects request/response failures at the RPC layer from an
+env spec (``src/ray/rpc/rpc_chaos.h:23-31``, parsed at ``rpc_chaos.cc:32``:
+``RAY_testing_rpc_failure=method1=N,method2=M``). Here the injection points
+are the framework's own boundaries (replica batch execution, replica loop,
+router assignment, ingress handling), named and budgeted the same way:
+
+    RDB_TESTING_FAILURE="replica.process_batch=3,replica.loop=1"
+
+Each ``point=N`` allows at most N injected failures (-1 = unlimited); an
+optional ``:p<float>`` suffix makes injection probabilistic
+(``point=5:p0.5`` — up to 5 failures, each opportunity failing with
+probability 0.5). Injection is a no-op unless configured, so production
+paths pay one dict lookup.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict, Optional, Tuple
+
+ENV_VAR = "RDB_TESTING_FAILURE"
+
+
+class ChaosInjected(RuntimeError):
+    """Raised at an injection point whose failure budget fired."""
+
+
+class ChaosInjector:
+    def __init__(self, spec: Optional[str] = None) -> None:
+        self._lock = threading.Lock()
+        self._budgets: Dict[str, int] = {}
+        self._probs: Dict[str, float] = {}
+        self._fired: Dict[str, int] = {}
+        self._rng = random.Random(0)
+        self._active = False  # unlocked fast-path flag for hot callers
+        self.configure(spec if spec is not None else os.environ.get(ENV_VAR, ""))
+
+    def configure(self, spec: str) -> None:
+        """Parse ``point=N[:pP],point2=M`` (reference rpc_chaos.cc:32).
+        Parses fully before swapping state, so an invalid spec leaves the
+        previous configuration untouched."""
+        budgets: Dict[str, int] = {}
+        probs: Dict[str, float] = {}
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            if "=" not in part:
+                raise ValueError(f"bad chaos spec entry {part!r}")
+            point, rhs = part.split("=", 1)
+            prob = 1.0
+            if ":p" in rhs:
+                rhs, prob_s = rhs.split(":p", 1)
+                prob = float(prob_s)
+            budgets[point.strip()] = int(rhs)
+            probs[point.strip()] = prob
+        with self._lock:
+            self._budgets = budgets
+            self._probs = probs
+            self._fired = {}
+            self._active = bool(budgets)
+
+    def should_fail(self, point: str) -> bool:
+        """Consume one unit of the point's failure budget (thread-safe).
+        Free when chaos is unconfigured: one unlocked attribute read."""
+        if not self._active:
+            return False
+        with self._lock:
+            budget = self._budgets.get(point)
+            if budget is None or budget == 0:
+                return False
+            if self._probs.get(point, 1.0) < 1.0:
+                if self._rng.random() >= self._probs[point]:
+                    return False
+            if budget > 0:
+                self._budgets[point] = budget - 1
+            self._fired[point] = self._fired.get(point, 0) + 1
+            return True
+
+    def maybe_fail(self, point: str) -> None:
+        if self.should_fail(point):
+            raise ChaosInjected(f"chaos injected at {point}")
+
+    def fired(self, point: str) -> int:
+        with self._lock:
+            return self._fired.get(point, 0)
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+
+_GLOBAL: Optional[ChaosInjector] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def chaos() -> ChaosInjector:
+    """Process-global injector, configured from the environment on first
+    use (mirrors the reference's static init)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = ChaosInjector()
+    return _GLOBAL
+
+
+def reset_chaos(spec: str = "") -> ChaosInjector:
+    """Re-configure the global injector (tests)."""
+    inj = chaos()
+    inj.configure(spec)
+    return inj

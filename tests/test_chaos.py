@@ -1,0 +1,182 @@
+"""Chaos/fault-injection tests (reference: RAY_testing_rpc_failure hooks,
+rpc_chaos.cc; ResourceKillerActor chaos runs, test_utils.py:1433): inject
+failures at framework boundaries and assert the system degrades gracefully
+and recovers."""
+
+import threading
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.serve import (
+    DeploymentConfig,
+    DeploymentHandle,
+    Replica,
+    Router,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.utils.chaos import (
+    ChaosInjected,
+    ChaosInjector,
+    chaos,
+    reset_chaos,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    reset_chaos("")
+    yield
+    reset_chaos("")
+
+
+def double_batch(payloads):
+    return [p * 2 for p in payloads]
+
+
+class TestInjector:
+    def test_spec_parse_and_budget(self):
+        inj = ChaosInjector("a.b=2,c.d=-1")
+        assert inj.should_fail("a.b") and inj.should_fail("a.b")
+        assert not inj.should_fail("a.b")  # budget of 2 spent
+        for _ in range(50):
+            assert inj.should_fail("c.d")  # unlimited
+        assert not inj.should_fail("unknown.point")
+        assert inj.fired("a.b") == 2
+
+    def test_probabilistic(self):
+        inj = ChaosInjector("p.q=-1:p0.5")
+        fired = sum(inj.should_fail("p.q") for _ in range(400))
+        assert 120 < fired < 280  # ~200 expected
+
+    def test_maybe_fail_raises(self):
+        inj = ChaosInjector("x=1")
+        with pytest.raises(ChaosInjected):
+            inj.maybe_fail("x")
+        inj.maybe_fail("x")  # budget spent: no-op
+
+    def test_bad_spec(self):
+        with pytest.raises(ValueError):
+            ChaosInjector("nonsense")
+
+    def test_bad_spec_leaves_config_untouched(self):
+        inj = ChaosInjector("a.b=5")
+        with pytest.raises(ValueError):
+            inj.configure("a.b=1,c.d=oops")
+        assert inj.should_fail("a.b")  # old config still intact
+        assert inj.fired("a.b") == 1
+
+    def test_env_configured(self, monkeypatch):
+        import ray_dynamic_batching_tpu.utils.chaos as chaos_mod
+
+        monkeypatch.setenv(chaos_mod.ENV_VAR, "from.env=1")
+        fresh = ChaosInjector()
+        assert fresh.should_fail("from.env")
+
+    def test_inactive_by_default(self):
+        assert not chaos().active
+
+
+class TestReplicaChaos:
+    def test_batch_failures_flow_to_futures_then_recover(self):
+        """First 2 batches die by injection; their requests get the chaos
+        error, later requests succeed (reference: user errors flow to
+        futures, replica keeps serving)."""
+        reset_chaos("replica.process_batch=2")
+        rep = Replica("r0", "doubler", double_batch,
+                      max_batch_size=1, batch_wait_timeout_s=0.005)
+        rep.start()
+        try:
+            first = [Request(model="doubler", payload=i, slo_ms=5000)
+                     for i in range(2)]
+            for r in first:
+                assert rep.assign(r)
+            for r in first:
+                with pytest.raises(ChaosInjected):
+                    r.future.result(timeout=5)
+            # budget exhausted: service recovers
+            ok = Request(model="doubler", payload=21, slo_ms=5000)
+            assert rep.assign(ok)
+            assert ok.future.result(timeout=5) == 42
+        finally:
+            rep.stop()
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_loop_crash_detected_and_replaced_under_load(self):
+        """An injected loop crash kills the replica thread mid-service; the
+        controller's health check must replace it and service must continue
+        (ResourceKillerActor scenario, deterministically)."""
+        ctl = ServeController(control_interval_s=0.05)
+        router = ctl.deploy(
+            DeploymentConfig(name="doubler", num_replicas=1, max_restarts=5),
+            factory=lambda: double_batch,
+        )
+        ctl.start()  # background reconcile loop does the detection
+        try:
+            handle = DeploymentHandle(router)
+            assert handle.remote(1).result(timeout=5) == 2
+            victim_id = router.replicas()[0].replica_id
+            reset_chaos("replica.loop=1")  # next loop tick dies
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                reps = router.replicas()
+                if reps and reps[0].replica_id != victim_id and reps[0].healthy():
+                    break
+                time.sleep(0.05)
+            reps = router.replicas()
+            assert reps and reps[0].replica_id != victim_id, (
+                "controller did not replace the crashed replica"
+            )
+            # replacement serves traffic
+            assert handle.remote(5).result(timeout=5) == 10
+        finally:
+            ctl.shutdown()
+
+
+class TestIngressChaos:
+    def test_ingress_drop_returns_error_then_recovers(self):
+        from ray_dynamic_batching_tpu.engine.ingress import (
+            IngressClient,
+            SocketIngress,
+        )
+
+        rep = Replica("r0", "echo", lambda ps: ps,
+                      max_batch_size=4, batch_wait_timeout_s=0.005)
+        rep.start()
+        ingress = SocketIngress(submit=rep.assign, port=0).start()
+        client = IngressClient("127.0.0.1", ingress.port)
+        try:
+            reset_chaos("ingress.handle=1")
+            first = client.send("echo", payload="a", slo_ms=5000)
+            assert "chaos" in first.get("error", "")
+            second = client.send("echo", payload="b", slo_ms=5000)
+            assert second.get("result") == "b"
+        finally:
+            client.close()
+            ingress.stop()
+            rep.stop()
+
+
+class TestRouterChaos:
+    def test_dropped_assignments_retry_and_succeed(self):
+        """Injected assignment drops land in the backoff path; requests
+        still complete (transient RPC loss, not terminal rejection)."""
+        rep = Replica("r0", "doubler", double_batch,
+                      max_batch_size=4, batch_wait_timeout_s=0.005)
+        rep.start()
+        router = Router("doubler", replicas=[rep], max_assign_timeout_s=5.0)
+        try:
+            reset_chaos("router.assign=3")
+            reqs = [Request(model="doubler", payload=i, slo_ms=10_000)
+                    for i in range(5)]
+            results = []
+            for r in reqs:
+                assert router.assign_request(r)
+                results.append(r.future.result(timeout=5))
+            assert results == [0, 2, 4, 6, 8]
+            assert chaos().fired("router.assign") == 3
+        finally:
+            rep.stop()
