@@ -279,22 +279,8 @@ class LiveScheduler:
             json.dump(self.snapshot(), f, indent=2)
 
     def render_status(self) -> str:
-        """Terminal SLO status (ref metrics_display.py:42-66: ✓ >=98%,
-        warning >=95%, critical below)."""
-        cfg = get_config()
-        lines = [f"{'model':<20} {'rate':>8} {'p95ms':>8} {'p99ms':>8} "
-                 f"{'depth':>6} {'SLO%':>7} status"]
-        rates = self.rates.rates()
-        for name, stats in sorted(self.queues.stats().items()):
-            c = stats["slo_compliance"]
-            status = (
-                "ok" if c >= cfg.slo_good_threshold
-                else "warning" if c >= cfg.slo_warn_threshold
-                else "CRITICAL"
-            )
-            lines.append(
-                f"{name:<20} {rates.get(name, 0.0):>8.1f} "
-                f"{stats['latency_p95_ms']:>8.1f} {stats['latency_p99_ms']:>8.1f} "
-                f"{stats['depth']:>6.0f} {c * 100:>6.1f}% {status}"
-            )
-        return "\n".join(lines)
+        """Terminal SLO status (ref metrics_display.py:42-66) — one table
+        renderer for scheduler, state CLI, and dashboard alike."""
+        from ray_dynamic_batching_tpu.state import render_queue_table
+
+        return render_queue_table(self.queues.stats(), self.rates.rates())

@@ -1,0 +1,161 @@
+"""Dashboard — HTTP state/metrics surface with a minimal HTML front end.
+
+Re-creates the reference's dashboard head (``python/ray/dashboard/head.py:61``
+aiohttp server + per-module backends + React client) at the scale this
+framework needs: a threaded HTTP server exposing
+
+- ``GET /``            auto-refreshing HTML view (deployments, replicas,
+                       queue SLO table)
+- ``GET /api/state``   full cluster state JSON (StateAPI.summary)
+- ``GET /metrics``     Prometheus text exposition
+
+The heavy lifting (state aggregation) lives in
+:class:`ray_dynamic_batching_tpu.state.StateAPI`; this module is transport.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ray_dynamic_batching_tpu.serve.proxy import _to_jsonable
+from ray_dynamic_batching_tpu.state import StateAPI
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("dashboard")
+
+_PAGE = """<!doctype html>
+<html><head><title>rdb-tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #111; color: #ddd; }
+ table { border-collapse: collapse; margin: 1em 0; }
+ td, th { border: 1px solid #444; padding: 4px 10px; text-align: left; }
+ th { background: #222; }
+ .ok { color: #7c4; } .warning { color: #fb3; } .CRITICAL { color: #f55; }
+ h2 { color: #8ac; }
+</style></head>
+<body>
+<h1>ray_dynamic_batching_tpu</h1>
+<div id="root">loading...</div>
+<script>
+function esc(v) {  // names/ids are arbitrary strings: escape before innerHTML
+  return String(v).replace(/[&<>"']/g,
+    c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+}
+async function tick() {
+  try {
+    const s = await (await fetch('/api/state')).json();
+    const thr = s.slo_thresholds ?? {good: 0.98, warn: 0.95};
+    let html = '';
+    if (s.deployments.length) {
+      html += '<h2>deployments</h2><table><tr><th>name</th><th>replicas</th>'
+            + '<th>target</th><th>healthy</th></tr>';
+      for (const d of s.deployments)
+        html += `<tr><td>${esc(d.name)}</td><td>${d.running_replicas ?? ''}</td>`
+              + `<td>${d.target_replicas ?? d.num_replicas ?? ''}</td>`
+              + `<td>${d.healthy ?? true}</td></tr>`;
+      html += '</table>';
+    }
+    if (s.replicas.length) {
+      html += '<h2>replicas</h2><table><tr><th>deployment</th><th>id</th>'
+            + '<th>healthy</th><th>queue</th><th>accepting</th></tr>';
+      for (const r of s.replicas)
+        html += `<tr><td>${esc(r.deployment)}</td><td>${esc(r.replica_id)}</td>`
+              + `<td>${r.healthy}</td><td>${r.queue_len}</td>`
+              + `<td>${r.accepting}</td></tr>`;
+      html += '</table>';
+    }
+    const queues = Object.entries(s.queues ?? {});
+    if (queues.length) {
+      html += '<h2>queues (SLO)</h2><table><tr><th>model</th><th>p95 ms</th>'
+            + '<th>p99 ms</th><th>depth</th><th>SLO %</th><th>status</th></tr>';
+      for (const [name, q] of queues) {
+        const c = q.slo_compliance ?? 1;
+        const st = c >= thr.good ? 'ok' : c >= thr.warn ? 'warning' : 'CRITICAL';
+        html += `<tr><td>${esc(name)}</td><td>${(q.latency_p95_ms??0).toFixed(1)}</td>`
+              + `<td>${(q.latency_p99_ms??0).toFixed(1)}</td><td>${q.depth??0}</td>`
+              + `<td class="${st}">${(c*100).toFixed(1)}%</td>`
+              + `<td class="${st}">${st}</td></tr>`;
+      }
+      html += '</table>';
+    }
+    document.getElementById('root').innerHTML = html || 'no state yet';
+  } catch (e) {
+    document.getElementById('root').innerHTML = 'fetch failed: ' + esc(e);
+  }
+}
+tick(); setInterval(tick, 2000);
+</script>
+</body></html>
+"""
+
+
+class DashboardServer:
+    """Threaded HTTP server over a StateAPI (default bind 127.0.0.1:8265 —
+    the reference dashboard's port)."""
+
+    def __init__(self, state: StateAPI, host: str = "127.0.0.1",
+                 port: int = 8265) -> None:
+        self.state = state
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to framework logger
+                logger.debug("dashboard: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                try:
+                    if self.path == "/" or self.path == "/index.html":
+                        self._send(200, _PAGE.encode(), "text/html")
+                    elif self.path == "/api/state":
+                        body = json.dumps(
+                            _to_jsonable(dashboard.state.summary())
+                        ).encode()
+                        self._send(200, body, "application/json")
+                    elif self.path == "/metrics":
+                        self._send(
+                            200, dashboard.state.metrics_text().encode(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif self.path == "/-/healthz":
+                        self._send(200, b"ok", "text/plain")
+                    else:
+                        self._send(404, b"not found", "text/plain")
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    logger.warning("dashboard handler error: %s", e)
+                    try:
+                        self._send(500, str(e).encode(), "text/plain")
+                    except Exception:  # noqa: BLE001 — client gone
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "DashboardServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dashboard", daemon=True
+        )
+        self._thread.start()
+        logger.info("dashboard listening on :%d", self.port)
+        return self
+
+    def stop(self) -> None:
+        # shutdown() blocks forever unless serve_forever() is running
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._server.server_close()

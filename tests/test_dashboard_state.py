@@ -1,0 +1,121 @@
+"""Dashboard + state API: list endpoints, HTML/JSON/metrics routes,
+terminal viewers (deterministic, iterations-bounded)."""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from ray_dynamic_batching_tpu.serve import DeploymentConfig, ServeController
+from ray_dynamic_batching_tpu.serve.dashboard import DashboardServer
+from ray_dynamic_batching_tpu.state import (
+    StateAPI,
+    main as state_main,
+    render_queue_table,
+    watch_metrics_file,
+)
+
+
+def double_batch(payloads):
+    return [p * 2 for p in payloads]
+
+
+@pytest.fixture
+def controller():
+    ctl = ServeController()
+    ctl.deploy(
+        DeploymentConfig(name="doubler", num_replicas=2),
+        factory=lambda: double_batch,
+    )
+    yield ctl
+    ctl.shutdown()
+
+
+class TestStateAPI:
+    def test_lists(self, controller):
+        api = StateAPI(controller=controller)
+        deps = api.list_deployments()
+        assert [d["name"] for d in deps] == ["doubler"]
+        assert deps[0]["running_replicas"] == 2
+        reps = api.list_replicas()
+        assert len(reps) == 2
+        assert all(r["healthy"] for r in reps)
+        summary = api.summary()
+        assert set(summary) == {
+            "deployments", "replicas", "queues", "scheduler", "slo_thresholds",
+        }
+        assert summary["slo_thresholds"] == {"good": 0.98, "warn": 0.95}
+
+    def test_empty_api(self):
+        api = StateAPI()
+        assert api.list_deployments() == []
+        assert api.list_replicas() == []
+        assert api.summary()["queues"] == {}
+
+
+class TestDashboard:
+    def test_routes(self, controller):
+        dash = DashboardServer(StateAPI(controller=controller), port=0).start()
+        base = f"http://127.0.0.1:{dash.port}"
+        try:
+            html = urllib.request.urlopen(base + "/").read().decode()
+            assert "rdb-tpu dashboard" in html
+            state = json.load(urllib.request.urlopen(base + "/api/state"))
+            assert state["deployments"][0]["name"] == "doubler"
+            assert len(state["replicas"]) == 2
+            metrics = urllib.request.urlopen(base + "/metrics").read().decode()
+            assert "# TYPE" in metrics or metrics == ""
+            health = urllib.request.urlopen(base + "/-/healthz").read()
+            assert health == b"ok"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(base + "/nope")
+        finally:
+            dash.stop()
+
+
+class TestViewers:
+    def test_render_queue_table_thresholds(self):
+        queues = {
+            "good": {"slo_compliance": 0.99, "latency_p95_ms": 5,
+                     "latency_p99_ms": 9, "depth": 1},
+            "warn": {"slo_compliance": 0.96, "latency_p95_ms": 20,
+                     "latency_p99_ms": 40, "depth": 5},
+            "bad": {"slo_compliance": 0.5, "latency_p95_ms": 900,
+                    "latency_p99_ms": 2000, "depth": 99},
+        }
+        text = render_queue_table(queues)
+        assert "ok" in text and "warning" in text and "CRITICAL" in text
+
+    def test_watch_metrics_file(self, tmp_path):
+        snap = {
+            "queues": {"m": {"slo_compliance": 0.99, "latency_p95_ms": 1,
+                             "latency_p99_ms": 2, "depth": 0}},
+            "rates_rps": {"m": 12.0},
+            "plan": [{"node": 0}],
+            "schedule_changes": 3,
+        }
+        path = tmp_path / "metrics.json"
+        path.write_text(json.dumps(snap))
+        out = io.StringIO()
+        watch_metrics_file(str(path), interval_s=0, iterations=1, out=out)
+        text = out.getvalue()
+        assert "m" in text and "12.0" in text and "1 node(s)" in text
+
+    def test_cli_watch(self, tmp_path, capsys):
+        path = tmp_path / "metrics.json"
+        path.write_text(json.dumps({"queues": {}, "rates_rps": {}}))
+        assert state_main(["--watch", str(path), "--iterations", "1"]) == 0
+
+    def test_cli_url(self, controller, capsys):
+        dash = DashboardServer(StateAPI(controller=controller), port=0).start()
+        try:
+            assert state_main(
+                [
+                    "--url", f"http://127.0.0.1:{dash.port}",
+                    "--iterations", "1",
+                ]
+            ) == 0
+            assert "doubler" in capsys.readouterr().out
+        finally:
+            dash.stop()
