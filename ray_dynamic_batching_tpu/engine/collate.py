@@ -10,6 +10,8 @@ Payload contracts by model family:
 - vision:           np.ndarray [H, W, C] float
 - text_classifier:  np.ndarray [T] int32 token ids (ragged across requests)
 - causal_lm:        np.ndarray [T] int32 prompt tokens (decode engine pads)
+- asr:              np.ndarray [T_frames, n_mels] float mel features
+                    (ragged; padded to duration buckets, models/asr.py)
 """
 
 from __future__ import annotations
@@ -51,6 +53,27 @@ def collate_text(
     return (tokens, mask), n
 
 
+def collate_asr(
+    model: ServableModel,
+    requests: List[Request],
+    batch_bucket: int,
+    text_bucket: int = 8,
+) -> Tuple[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray], int]:
+    """Ragged mel clips → duration-bucketed (mel, mask) plus a start-of-
+    transcript prompt per row, matching ASRModel.apply's signature."""
+    from ray_dynamic_batching_tpu.models.asr import collate_audio
+
+    n = len(requests)
+    mels = [np.asarray(r.payload, dtype=np.float32) for r in requests]
+    mel, mask = collate_audio(mels, batch_bucket)
+    tokens = np.zeros((batch_bucket, text_bucket), np.int32)
+    tokens[:, 0] = model.cfg.sot_token
+    text_mask = np.zeros((batch_bucket, text_bucket), np.int32)
+    text_mask[:, 0] = 1
+    mask[n:, 0] = 1  # padding rows: one valid frame keeps softmax well-formed
+    return (mel, mask, tokens, text_mask), n
+
+
 def collate(
     model: ServableModel,
     requests: List[Request],
@@ -65,4 +88,6 @@ def collate(
                 (len(np.atleast_1d(r.payload)) for r in requests), default=1
             )
         return collate_text(model, requests, batch_bucket, seq_bucket)
+    if model.family == "asr":
+        return collate_asr(model, requests, batch_bucket)
     raise ValueError(f"no collator for model family {model.family!r}")

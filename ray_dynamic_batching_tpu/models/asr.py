@@ -1,0 +1,519 @@
+"""Whisper-style streaming ASR — encoder-decoder with ragged audio batching.
+
+BASELINE.json config 5 ("Whisper-large-v3 streaming ASR, ragged
+variable-length batching") — a capability absent from the reference (its
+serving path is fixed-shape vision, SURVEY.md §5 long-context/ragged note).
+TPU-first decisions:
+
+- Audio lengths are RAGGED; shapes must be static for XLA. Mel inputs are
+  padded to *duration buckets* (``bucket_frames``) so each bucket compiles
+  once, and the encoder consumes a frame-validity mask — identical in spirit
+  to the text path's (batch, seq) buckets (engine/collate.py).
+- Encoder: two strided convs downsample mel frames 2x, then bidirectional
+  transformer layers on the MXU (bf16, static shapes).
+- Decoder: causal self-attention with the same explicit KV cache as the
+  causal LMs (decoder.py) plus cross-attention over encoder states; cross
+  K/V are computed once per utterance at prefill and reused every decode
+  step (they depend only on encoder output).
+- Streaming: :class:`StreamingASR` feeds fixed-size audio chunks through
+  encode+decode as they arrive, carrying the transcript prefix forward —
+  chunked inference with one compiled program per chunk bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ray_dynamic_batching_tpu.models.base import (
+    ModelSLO,
+    ServableModel,
+    register_model,
+)
+from ray_dynamic_batching_tpu.ops import attention as attn_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class ASRConfig:
+    vocab_size: int = 51866          # whisper-large-v3 vocab
+    n_mels: int = 80
+    d_model: int = 1280
+    enc_layers: int = 32
+    dec_layers: int = 32
+    num_heads: int = 20
+    mlp_dim: int = 5120
+    max_audio_frames: int = 3000     # 30 s of 10 ms mel frames
+    max_text_len: int = 448
+    sot_token: int = 50258           # start-of-transcript
+    eot_token: int = 50257           # end-of-transcript
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+def sinusoids(length: int, channels: int) -> jax.Array:
+    """Fixed sinusoidal positions (whisper-style encoder embedding)."""
+    log_timescale = math.log(10000.0) / (channels // 2 - 1)
+    inv = jnp.exp(-log_timescale * jnp.arange(channels // 2))
+    scaled = jnp.arange(length)[:, None] * inv[None, :]
+    return jnp.concatenate([jnp.sin(scaled), jnp.cos(scaled)], axis=1)
+
+
+class EncoderLayer(nn.Module):
+    cfg: ASRConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, frame_mask: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
+            feats, axis=axis, dtype=self.dtype, param_dtype=jnp.float32,
+            name=name,
+        )
+        y = nn.LayerNorm(dtype=jnp.float32, name="attn_norm")(x).astype(self.dtype)
+        q = dense((cfg.num_heads, cfg.head_dim), "q")(y)
+        k = dense((cfg.num_heads, cfg.head_dim), "k")(y)
+        v = dense((cfg.num_heads, cfg.head_dim), "v")(y)
+        # bidirectional over valid frames only (ragged padding masked)
+        attn = attn_ops.self_attention(q, k, v, frame_mask, causal=False)
+        x = x + dense(cfg.d_model, "o", axis=(-2, -1))(attn)
+        y = nn.LayerNorm(dtype=jnp.float32, name="mlp_norm")(x).astype(self.dtype)
+        y = nn.gelu(dense(cfg.mlp_dim, "mlp_up")(y))
+        x = x + dense(cfg.d_model, "mlp_down")(y)
+        return x
+
+
+class AudioEncoder(nn.Module):
+    cfg: ASRConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self, mel: jax.Array, frame_mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """mel [B, T, n_mels], frame_mask [B, T] -> (states [B, T//2, D],
+        state_mask [B, T//2])."""
+        cfg = self.cfg
+        x = nn.Conv(
+            cfg.d_model, kernel_size=(3,), padding=1, dtype=self.dtype,
+            param_dtype=jnp.float32, name="conv1",
+        )(mel.astype(self.dtype))
+        x = nn.gelu(x)
+        x = nn.Conv(
+            cfg.d_model, kernel_size=(3,), strides=(2,), padding=1,
+            dtype=self.dtype, param_dtype=jnp.float32, name="conv2",
+        )(x)
+        x = nn.gelu(x)
+        T2 = x.shape[1]
+        x = x + sinusoids(T2, cfg.d_model).astype(self.dtype)[None]
+        state_mask = frame_mask[:, ::2][:, :T2]
+        for i in range(cfg.enc_layers):
+            x = EncoderLayer(cfg, dtype=self.dtype, name=f"layer{i}")(
+                x, state_mask
+            )
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_norm")(x)
+        return x.astype(self.dtype), state_mask
+
+
+class CrossDecoderLayer(nn.Module):
+    """Causal self-attention (+KV cache) then cross-attention over encoder
+    states, as in whisper's text decoder."""
+
+    cfg: ASRConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,                # [B, T, D]
+        self_mask: jax.Array,        # [B, 1, T, S]
+        enc_states: jax.Array,       # [B, Te, D]
+        enc_mask: jax.Array,         # [B, Te]
+        positions: jax.Array,        # [B, T]
+        layer_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
+    ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
+        cfg = self.cfg
+        dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
+            feats, axis=axis, dtype=self.dtype, param_dtype=jnp.float32,
+            name=name,
+        )
+        # --- causal self-attention with explicit cache (decoder.py style) --
+        y = nn.LayerNorm(dtype=jnp.float32, name="self_norm")(x).astype(self.dtype)
+        q = dense((cfg.num_heads, cfg.head_dim), "self_q")(y)
+        k = dense((cfg.num_heads, cfg.head_dim), "self_k")(y)
+        v = dense((cfg.num_heads, cfg.head_dim), "self_v")(y)
+        new_cache = None
+        if layer_cache is not None:
+            k_cache, v_cache = layer_cache
+            B, T = positions.shape
+            if T == 1:
+                rows = jnp.arange(B)
+                idx = positions[:, 0]
+                k_cache = k_cache.at[rows, idx].set(k[:, 0], mode="drop")
+                v_cache = v_cache.at[rows, idx].set(v[:, 0], mode="drop")
+            else:
+                k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+            attn = attn_ops.dot_product_attention(q, k_cache, v_cache,
+                                                  mask=self_mask)
+            new_cache = (k_cache, v_cache)
+        else:
+            attn = attn_ops.dot_product_attention(q, k, v, mask=self_mask)
+        x = x + dense(cfg.d_model, "self_o", axis=(-2, -1))(attn)
+
+        # --- cross-attention over encoder states ---------------------------
+        y = nn.LayerNorm(dtype=jnp.float32, name="cross_norm")(x).astype(self.dtype)
+        qc = dense((cfg.num_heads, cfg.head_dim), "cross_q")(y)
+        kc = dense((cfg.num_heads, cfg.head_dim), "cross_k")(enc_states)
+        vc = dense((cfg.num_heads, cfg.head_dim), "cross_v")(enc_states)
+        cmask = enc_mask[:, None, None, :].astype(bool)
+        cattn = attn_ops.dot_product_attention(qc, kc, vc, mask=cmask)
+        x = x + dense(cfg.d_model, "cross_o", axis=(-2, -1))(cattn)
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="mlp_norm")(x).astype(self.dtype)
+        y = nn.gelu(dense(cfg.mlp_dim, "mlp_up")(y))
+        return x + dense(cfg.d_model, "mlp_down")(y), new_cache
+
+
+class TextDecoder(nn.Module):
+    cfg: ASRConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,           # [B, T]
+        positions: jax.Array,        # [B, T]
+        self_mask: jax.Array,        # [B, 1, T, S]
+        enc_states: jax.Array,
+        enc_mask: jax.Array,
+        cache: Optional["ASRCache"] = None,
+    ) -> Tuple[jax.Array, Optional["ASRCache"]]:
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=self.dtype,
+            param_dtype=jnp.float32, name="tok_embed",
+        )
+        pos_embed = nn.Embed(
+            cfg.max_text_len, cfg.d_model, dtype=self.dtype,
+            param_dtype=jnp.float32, name="pos_embed",
+        )
+        x = embed(tokens) + pos_embed(positions)
+        new_k, new_v = [], []
+        for i in range(cfg.dec_layers):
+            layer_cache = (
+                (cache.k[i], cache.v[i]) if cache is not None else None
+            )
+            x, updated = CrossDecoderLayer(
+                cfg, dtype=self.dtype, name=f"layer{i}"
+            )(x, self_mask, enc_states, enc_mask, positions, layer_cache)
+            if updated is not None:
+                new_k.append(updated[0])
+                new_v.append(updated[1])
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_norm")(x)
+        logits = embed.attend(x.astype(jnp.float32))  # tied head (whisper)
+        out_cache = None
+        if cache is not None:
+            out_cache = ASRCache(
+                k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+            )
+        return logits, out_cache
+
+
+from flax.struct import dataclass as pytree_dataclass  # noqa: E402
+
+
+@pytree_dataclass
+class ASRCache:
+    k: jax.Array        # [L, B, S, N, H]
+    v: jax.Array
+    lengths: jax.Array  # [B]
+
+    @staticmethod
+    def zeros(cfg: ASRConfig, batch_size: int, max_len: Optional[int] = None,
+              dtype=jnp.bfloat16) -> "ASRCache":
+        S = max_len or cfg.max_text_len
+        shape = (cfg.dec_layers, batch_size, S, cfg.num_heads, cfg.head_dim)
+        return ASRCache(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            lengths=jnp.zeros((batch_size,), jnp.int32),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+
+# --- ragged audio bucketing -------------------------------------------------
+
+AUDIO_BUCKETS = (200, 500, 1000, 1500, 3000)  # mel frames (2s..30s @10ms)
+
+
+def bucket_frames(n_frames: int,
+                  buckets: Tuple[int, ...] = AUDIO_BUCKETS) -> int:
+    """Smallest bucket holding n_frames (ragged lengths -> static shapes;
+    one XLA compile per bucket, like the text path's seq buckets)."""
+    for b in buckets:
+        if n_frames <= b:
+            return b
+    return buckets[-1]
+
+
+def collate_audio(
+    mels: List[np.ndarray], batch_bucket: int,
+    buckets: Tuple[int, ...] = AUDIO_BUCKETS,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Ragged [T_i, n_mels] list -> (mel [B, Tb, n_mels], mask [B, Tb]);
+    Tb = the duration bucket of the longest clip, B = batch bucket."""
+    if not mels:
+        raise ValueError("empty batch")
+    if len(mels) > batch_bucket:
+        raise ValueError(
+            f"{len(mels)} clips exceed batch bucket {batch_bucket}; "
+            "silently dropping audio is never acceptable"
+        )
+    n_mels = mels[0].shape[1]
+    Tb = bucket_frames(max(m.shape[0] for m in mels), buckets)
+    mel = np.zeros((batch_bucket, Tb, n_mels), np.float32)
+    mask = np.zeros((batch_bucket, Tb), np.int32)
+    for i, m_i in enumerate(mels):
+        t = min(m_i.shape[0], Tb)
+        mel[i, :t] = m_i[:t]
+        mask[i, :t] = 1
+    return mel, mask
+
+
+# --- servable model ---------------------------------------------------------
+
+class ASRModel(ServableModel):
+    family = "asr"
+
+    def __init__(self, cfg: ASRConfig, name: str, dtype=jnp.bfloat16):
+        super().__init__(dtype)
+        self.name = name
+        self.cfg = cfg
+        self.encoder = AudioEncoder(cfg, dtype=dtype)
+        self.decoder = TextDecoder(cfg, dtype=dtype)
+
+    # --- ServableModel (apply = full enc+dec teacher-forced pass) ---------
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        mel, mel_mask, tokens, text_mask = self.example_inputs(1, 16)
+        r1, r2 = jax.random.split(rng)
+        enc_params = self.encoder.init(r1, mel, mel_mask)
+        enc_states, enc_mask = self.encoder.apply(enc_params, mel, mel_mask)
+        positions = jnp.arange(tokens.shape[1])[None, :]
+        self_mask = _causal_mask(text_mask)
+        dec_params = self.decoder.init(
+            r2, tokens, positions, self_mask, enc_states, enc_mask
+        )
+        return {"encoder": enc_params, "decoder": dec_params}
+
+    def apply(self, params, mel, mel_mask, tokens, text_mask) -> jax.Array:
+        """Teacher-forced logits [B, T_text, V] (profiling + loss path)."""
+        enc_states, enc_mask = self.encoder.apply(
+            params["encoder"], mel, mel_mask
+        )
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1])[None, :], tokens.shape
+        )
+        logits, _ = self.decoder.apply(
+            params["decoder"], tokens, positions, _causal_mask(text_mask),
+            enc_states, enc_mask,
+        )
+        return logits
+
+    def example_inputs(self, batch_size: int, seq_len: Optional[int] = None):
+        T_text = seq_len or 16
+        T_audio = AUDIO_BUCKETS[0]
+        return (
+            jnp.zeros((batch_size, T_audio, self.cfg.n_mels), jnp.float32),
+            jnp.ones((batch_size, T_audio), jnp.int32),
+            jnp.zeros((batch_size, T_text), jnp.int32),
+            jnp.ones((batch_size, T_text), jnp.int32),
+        )
+
+    # --- encode / decode (serving path) -----------------------------------
+    def encode(self, params, mel, mel_mask):
+        return self.encoder.apply(params["encoder"], mel, mel_mask)
+
+    def prefill(self, params, tokens, text_mask, enc_states, enc_mask,
+                cache: ASRCache):
+        B, T = tokens.shape
+        S = cache.capacity
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        lengths = text_mask.sum(axis=1).astype(jnp.int32)
+        base = _causal_mask(text_mask)
+        if S > T:
+            pad = jnp.zeros((B, 1, T, S - T), bool)
+            mask = jnp.concatenate([base, pad], axis=-1)
+        else:
+            mask = base
+        logits, new_cache = self.decoder.apply(
+            params["decoder"], tokens, positions, mask, enc_states, enc_mask,
+            cache,
+        )
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]
+        return last, new_cache.replace(lengths=lengths)
+
+    def decode_step(self, params, tokens, enc_states, enc_mask,
+                    cache: ASRCache, active: jax.Array):
+        in_bounds = cache.lengths < cache.capacity
+        active = jnp.logical_and(active, in_bounds)
+        positions = cache.lengths[:, None]
+        pos = jnp.arange(cache.capacity)[None, None, None, :]
+        mask = pos <= cache.lengths[:, None, None, None]
+        logits, new_cache = self.decoder.apply(
+            params["decoder"], tokens, positions, mask, enc_states, enc_mask,
+            cache,
+        )
+        new_lengths = cache.lengths + active.astype(jnp.int32)
+        return logits[:, 0], new_cache.replace(lengths=new_lengths)
+
+    def make_cache(self, batch_size: int, max_len: Optional[int] = None):
+        return ASRCache.zeros(self.cfg, batch_size, max_len, dtype=self.dtype)
+
+    # --- planning ----------------------------------------------------------
+    def flops_per_sample(self, seq_len: Optional[int] = None) -> float:
+        c = self.cfg
+        Ta = (seq_len or AUDIO_BUCKETS[0]) // 2
+        Tt = 32
+        enc = c.enc_layers * Ta * (8 * c.d_model ** 2 + 4 * Ta * c.d_model)
+        dec = c.dec_layers * Tt * (
+            12 * c.d_model ** 2 + 4 * Tt * c.d_model + 4 * Ta * c.d_model
+        )
+        return float(enc + dec)
+
+    def sharding_rules(self):
+        return [
+            (r"/(self_|cross_)?[qkv]/kernel", P(None, "tp", None)),
+            (r"/(self_|cross_)?o/kernel", P("tp", None, None)),
+            (r"mlp_up/kernel", P(None, "tp")),
+            (r"mlp_down/kernel", P("tp", None)),
+            (r"tok_embed/embedding", P("tp", None)),
+        ]
+
+
+def _causal_mask(token_mask: jax.Array) -> jax.Array:
+    T = token_mask.shape[1]
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    valid = token_mask[:, None, None, :].astype(bool)
+    return causal[None, None, :, :] & valid
+
+
+# --- streaming -------------------------------------------------------------
+
+class StreamingASR:
+    """Chunked streaming transcription: feed audio incrementally; each
+    flush encodes the newest chunk bucket and greedily decodes, carrying
+    the transcript prefix forward (whisper-style streaming at chunk
+    granularity — one compiled program per (chunk bucket, text bucket))."""
+
+    def __init__(self, model: ASRModel, params, chunk_frames: int = 200,
+                 max_new_tokens: int = 32):
+        self.model = model
+        self.params = params
+        self.chunk_frames = chunk_frames
+        self.max_new_tokens = max_new_tokens
+        self._buffer: List[np.ndarray] = []
+        self._tokens: List[int] = [model.cfg.sot_token]
+        self._encode = jax.jit(model.encode)
+        self._prefill = jax.jit(model.prefill)
+        self._step = jax.jit(model.decode_step)
+
+    def feed(self, mel_frames: np.ndarray) -> Optional[List[int]]:
+        """Append [T, n_mels] frames; when a full chunk accumulates,
+        transcribe it and return the new token ids (else None)."""
+        self._buffer.append(np.asarray(mel_frames, np.float32))
+        total = sum(b.shape[0] for b in self._buffer)
+        if total < self.chunk_frames:
+            return None
+        return self.flush()
+
+    def flush(self) -> List[int]:
+        """Transcribe everything buffered; returns newly emitted tokens."""
+        if not self._buffer:
+            return []
+        audio = np.concatenate(self._buffer, axis=0)
+        self._buffer = []
+        mel, mask = collate_audio([audio], batch_bucket=1)
+        enc_states, enc_mask = self._encode(self.params, mel, mask)
+        cfg = self.model.cfg
+        prefix = self._tokens[-cfg.max_text_len // 2:]
+        new = self._greedy(enc_states, enc_mask, prefix)
+        self._tokens.extend(new)
+        return new
+
+    def _greedy(self, enc_states, enc_mask, prefix: List[int]) -> List[int]:
+        cfg = self.model.cfg
+        T = 16
+        while T < len(prefix):
+            T *= 2
+        tokens = np.zeros((1, T), np.int32)
+        tokens[0, :len(prefix)] = prefix
+        text_mask = np.zeros((1, T), np.int32)
+        text_mask[0, :len(prefix)] = 1
+        # cap at max_text_len: positions past the pos_embed table would
+        # clamp-gather entry max_text_len-1 and silently corrupt output;
+        # decode_step deactivates rows at cache capacity, so generation
+        # stops cleanly at the model's limit instead
+        cache = self.model.make_cache(
+            1, max_len=min(T + self.max_new_tokens, cfg.max_text_len)
+        )
+        logits, cache = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(text_mask),
+            enc_states, enc_mask, cache,
+        )
+        out: List[int] = []
+        active = jnp.ones((1,), bool)
+        for _ in range(self.max_new_tokens):
+            nxt = int(jnp.argmax(logits[0]))
+            if nxt == cfg.eot_token:
+                break
+            out.append(nxt)
+            logits, cache = self._step(
+                self.params, jnp.asarray([[nxt]], dtype=jnp.int32),
+                enc_states, enc_mask, cache, active,
+            )
+        return out
+
+    @property
+    def transcript(self) -> List[int]:
+        return list(self._tokens)
+
+
+WHISPER_LARGE_V3 = ASRConfig()
+
+WHISPER_TINY_TEST = ASRConfig(
+    vocab_size=256,
+    n_mels=16,
+    d_model=64,
+    enc_layers=2,
+    dec_layers=2,
+    num_heads=4,
+    mlp_dim=128,
+    max_audio_frames=400,
+    max_text_len=64,
+    sot_token=254,
+    eot_token=255,
+)
+
+
+@register_model("whisper_large_v3", slo=ModelSLO(latency_slo_ms=4000.0))
+def _whisper_large(**kwargs) -> ASRModel:
+    return ASRModel(WHISPER_LARGE_V3, name="whisper_large_v3", **kwargs)
+
+
+@register_model("whisper_tiny_test")
+def _whisper_tiny(**kwargs) -> ASRModel:
+    return ASRModel(WHISPER_TINY_TEST, name="whisper_tiny_test", **kwargs)

@@ -1,6 +1,7 @@
 """Import-all registry front door (ref: model_registry, scheduler.py:40-44)."""
 
 from ray_dynamic_batching_tpu.models import (  # noqa: F401
+    asr,
     causal_lm,
     distilbert,
     efficientnet,
