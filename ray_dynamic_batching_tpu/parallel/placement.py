@@ -1,0 +1,213 @@
+"""Placement groups — gang-scheduling chips for replicas and meshes.
+
+Re-creates the reference's placement groups (``python/ray/util/placement_group.py:145``
+— bundles of resources placed by strategy; native scheduling in
+``gcs_placement_group_scheduler.cc`` and the bundle-aware policies under
+``raylet/scheduling/policy/``) and the Serve deployment scheduler's
+spread/compact choice (``serve/_private/deployment_scheduler.py``), for TPU
+topology: a "node" is a host (process) and the resource is its chips.
+
+A bundle reserves ``chips`` on one node; a group places all its bundles by
+strategy:
+
+- ``PACK``         prefer few nodes (co-locate; best-effort)
+- ``SPREAD``       prefer distinct nodes (best-effort round-robin)
+- ``STRICT_PACK``  all bundles on ONE node, or the group fails
+- ``STRICT_SPREAD`` every bundle on a DIFFERENT node, or the group fails
+
+Placed bundles hand back real ``jax.Device`` lists, which plug straight
+into ``build_mesh(config, devices=pg.bundle_devices(i))`` — replica-to-chip
+pinning is mesh construction, not cgroup games.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("placement")
+
+PACK = "PACK"
+SPREAD = "SPREAD"
+STRICT_PACK = "STRICT_PACK"
+STRICT_SPREAD = "STRICT_SPREAD"
+_STRATEGIES = (PACK, SPREAD, STRICT_PACK, STRICT_SPREAD)
+
+
+class PlacementError(RuntimeError):
+    """Group infeasible under its strategy (ref: PG stays pending; here we
+    fail fast — the caller owns retry policy)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Bundle:
+    chips: int = 1
+
+
+@dataclasses.dataclass
+class PlacementGroup:
+    group_id: int
+    bundles: List[Bundle]
+    strategy: str
+    # parallel to bundles: the devices reserved for each
+    assignments: List[List[jax.Device]] = dataclasses.field(default_factory=list)
+
+    def bundle_devices(self, index: int) -> List[jax.Device]:
+        return list(self.assignments[index])
+
+    @property
+    def total_chips(self) -> int:
+        return sum(b.chips for b in self.bundles)
+
+
+class PlacementManager:
+    """Chip accounting + strategy placement over the visible devices.
+
+    Nodes are derived from ``device.process_index`` (one node per host —
+    exactly the reference's node granularity). A manager instance owns its
+    reservations; groups from the same manager never overlap chips.
+    """
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        self._nodes: Dict[int, List[jax.Device]] = {}
+        for d in devices:
+            self._nodes.setdefault(int(d.process_index), []).append(d)
+        self._free: Dict[int, List[jax.Device]] = {
+            n: list(ds) for n, ds in self._nodes.items()
+        }
+        self._groups: Dict[int, PlacementGroup] = {}
+        self._next_id = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # --- introspection ----------------------------------------------------
+    def nodes(self) -> Dict[int, int]:
+        """node id -> total chips."""
+        return {n: len(ds) for n, ds in self._nodes.items()}
+
+    def free_chips(self) -> Dict[int, int]:
+        with self._lock:
+            return {n: len(ds) for n, ds in self._free.items()}
+
+    def groups(self) -> List[PlacementGroup]:
+        with self._lock:
+            return list(self._groups.values())
+
+    # --- placement --------------------------------------------------------
+    def create(self, bundles: Sequence[Bundle],
+               strategy: str = PACK) -> PlacementGroup:
+        """Reserve chips for every bundle atomically (all-or-nothing, like
+        the reference's gang placement)."""
+        if strategy not in _STRATEGIES:
+            raise ValueError(
+                f"unknown strategy {strategy!r}; one of {_STRATEGIES}"
+            )
+        bundles = [
+            b if isinstance(b, Bundle) else Bundle(**b) for b in bundles
+        ]
+        if not bundles or any(b.chips <= 0 for b in bundles):
+            raise ValueError("bundles must be non-empty with chips > 0")
+        with self._lock:
+            assignments = self._place(bundles, strategy)
+            # commit
+            for devs in assignments:
+                for d in devs:
+                    self._free[int(d.process_index)].remove(d)
+            pg = PlacementGroup(
+                group_id=next(self._next_id),
+                bundles=list(bundles),
+                strategy=strategy,
+                assignments=assignments,
+            )
+            self._groups[pg.group_id] = pg
+            logger.info(
+                "placed group %d: %s over nodes %s", pg.group_id, strategy,
+                sorted({int(d.process_index) for a in assignments for d in a}),
+            )
+            return pg
+
+    def remove(self, pg: PlacementGroup) -> None:
+        """Release the group's chips (ref remove_placement_group)."""
+        with self._lock:
+            if self._groups.pop(pg.group_id, None) is None:
+                return
+            for devs in pg.assignments:
+                for d in devs:
+                    self._free[int(d.process_index)].append(d)
+
+    # --- strategies (lock held) -------------------------------------------
+    def _place(self, bundles: List[Bundle], strategy: str
+               ) -> List[List[jax.Device]]:
+        free = {n: list(ds) for n, ds in self._free.items()}
+
+        def take(node: int, k: int) -> List[jax.Device]:
+            out = free[node][:k]
+            free[node] = free[node][k:]
+            return out
+
+        if strategy == STRICT_PACK:
+            need = sum(b.chips for b in bundles)
+            for node in sorted(free, key=lambda n: len(free[n])):
+                if len(free[node]) >= need:
+                    return [take(node, b.chips) for b in bundles]
+            raise PlacementError(
+                f"STRICT_PACK: no node has {need} free chips "
+                f"(free: {self.free_chips()})"
+            )
+
+        if strategy == STRICT_SPREAD:
+            if len(bundles) > len(free):
+                raise PlacementError(
+                    f"STRICT_SPREAD: {len(bundles)} bundles > "
+                    f"{len(free)} nodes"
+                )
+            # largest bundles first onto the emptiest fitting nodes
+            order = sorted(range(len(bundles)),
+                           key=lambda i: -bundles[i].chips)
+            assignment: List[Optional[List[jax.Device]]] = [None] * len(bundles)
+            used = set()
+            for i in order:
+                fit = [n for n in free
+                       if n not in used and len(free[n]) >= bundles[i].chips]
+                if not fit:
+                    raise PlacementError(
+                        f"STRICT_SPREAD: no distinct node fits bundle "
+                        f"{bundles[i]} (free: {self.free_chips()})"
+                    )
+                node = max(fit, key=lambda n: len(free[n]))
+                used.add(node)
+                assignment[i] = take(node, bundles[i].chips)
+            return assignment  # type: ignore[return-value]
+
+        if strategy == PACK:
+            # fill the fullest-feasible node first (compact)
+            out = []
+            for b in bundles:
+                fit = [n for n in free if len(free[n]) >= b.chips]
+                if not fit:
+                    raise PlacementError(
+                        f"PACK: no node fits bundle {b} "
+                        f"(free: {self.free_chips()})"
+                    )
+                node = min(fit, key=lambda n: len(free[n]))
+                out.append(take(node, b.chips))
+            return out
+
+        # SPREAD: emptiest node first, best-effort distinctness
+        out = []
+        for b in bundles:
+            fit = [n for n in free if len(free[n]) >= b.chips]
+            if not fit:
+                raise PlacementError(
+                    f"SPREAD: no node fits bundle {b} "
+                    f"(free: {self.free_chips()})"
+                )
+            node = max(fit, key=lambda n: len(free[n]))
+            out.append(take(node, b.chips))
+        return out

@@ -1,0 +1,142 @@
+"""Placement groups: strategies, gang atomicity, release, mesh integration."""
+
+import dataclasses
+
+import jax
+import pytest
+
+from ray_dynamic_batching_tpu.parallel.mesh import MeshConfig, build_mesh
+from ray_dynamic_batching_tpu.parallel.placement import (
+    PACK,
+    SPREAD,
+    STRICT_PACK,
+    STRICT_SPREAD,
+    Bundle,
+    PlacementError,
+    PlacementGroup,
+    PlacementManager,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FakeDevice:
+    """Stand-in with the attribute placement reads (process_index); lets
+    strategy tests model multi-host topologies the fake cluster can't."""
+
+    id: int
+    process_index: int
+
+
+def _cluster(nodes: int, chips_per_node: int):
+    return [
+        FakeDevice(id=n * chips_per_node + c, process_index=n)
+        for n in range(nodes)
+        for c in range(chips_per_node)
+    ]
+
+
+class TestStrategies:
+    def test_strict_pack_one_node(self):
+        mgr = PlacementManager(_cluster(2, 4))
+        pg = mgr.create([Bundle(2), Bundle(2)], STRICT_PACK)
+        nodes = {d.process_index for a in pg.assignments for d in a}
+        assert len(nodes) == 1
+        assert [len(a) for a in pg.assignments] == [2, 2]
+
+    def test_strict_pack_infeasible(self):
+        mgr = PlacementManager(_cluster(2, 4))
+        with pytest.raises(PlacementError):
+            mgr.create([Bundle(3), Bundle(3)], STRICT_PACK)  # 6 > 4/node
+
+    def test_strict_spread_distinct_nodes(self):
+        mgr = PlacementManager(_cluster(3, 2))
+        pg = mgr.create([Bundle(1), Bundle(1), Bundle(2)], STRICT_SPREAD)
+        nodes = [
+            {d.process_index for d in a} for a in pg.assignments
+        ]
+        assert all(len(n) == 1 for n in nodes)
+        flat = [next(iter(n)) for n in nodes]
+        assert len(set(flat)) == 3  # all distinct
+
+    def test_strict_spread_infeasible(self):
+        mgr = PlacementManager(_cluster(2, 4))
+        with pytest.raises(PlacementError):
+            mgr.create([Bundle(1)] * 3, STRICT_SPREAD)  # 3 bundles, 2 nodes
+
+    def test_pack_compacts(self):
+        mgr = PlacementManager(_cluster(2, 4))
+        mgr.create([Bundle(3)], PACK)  # node A now has 1 free
+        pg = mgr.create([Bundle(1)], PACK)  # should fill node A, not B
+        free = mgr.free_chips()
+        assert sorted(free.values()) == [0, 4]
+        assert pg.total_chips == 1
+
+    def test_spread_balances(self):
+        mgr = PlacementManager(_cluster(2, 4))
+        pg = mgr.create([Bundle(1), Bundle(1)], SPREAD)
+        nodes = [a[0].process_index for a in pg.assignments]
+        assert len(set(nodes)) == 2  # went to different nodes
+
+    def test_unknown_strategy_and_bad_bundle(self):
+        mgr = PlacementManager(_cluster(1, 2))
+        with pytest.raises(ValueError):
+            mgr.create([Bundle(1)], "DIAGONAL")
+        with pytest.raises(ValueError):
+            mgr.create([], PACK)
+        with pytest.raises(ValueError):
+            mgr.create([Bundle(0)], PACK)
+
+
+class TestAccounting:
+    def test_gang_atomicity_on_failure(self):
+        """A failing group must reserve NOTHING (all-or-nothing)."""
+        mgr = PlacementManager(_cluster(2, 2))
+        before = mgr.free_chips()
+        with pytest.raises(PlacementError):
+            mgr.create([Bundle(2), Bundle(2), Bundle(2)], STRICT_SPREAD)
+        assert mgr.free_chips() == before
+
+    def test_remove_releases(self):
+        mgr = PlacementManager(_cluster(1, 4))
+        pg = mgr.create([Bundle(4)], PACK)
+        with pytest.raises(PlacementError):
+            mgr.create([Bundle(1)], PACK)  # exhausted
+        mgr.remove(pg)
+        assert sum(mgr.free_chips().values()) == 4
+        mgr.create([Bundle(4)], PACK)  # fits again
+        mgr.remove(pg)  # double-remove is a no-op
+        assert mgr.groups() != []
+
+    def test_groups_never_share_chips(self):
+        mgr = PlacementManager(_cluster(2, 4))
+        pgs = [mgr.create([Bundle(2)], PACK) for _ in range(4)]
+        seen = set()
+        for pg in pgs:
+            for d in pg.bundle_devices(0):
+                assert d.id not in seen
+                seen.add(d.id)
+        assert len(seen) == 8
+        with pytest.raises(PlacementError):
+            mgr.create([Bundle(1)], PACK)
+
+    def test_dict_bundles_accepted(self):
+        mgr = PlacementManager(_cluster(1, 4))
+        pg = mgr.create([{"chips": 2}], PACK)
+        assert pg.bundles[0].chips == 2
+
+
+class TestMeshIntegration:
+    def test_bundle_devices_build_mesh(self):
+        """Placed chips plug into build_mesh: a TP=2 replica mesh from a
+        bundle on the fake 8-chip cluster (real jax devices)."""
+        mgr = PlacementManager(jax.devices()[:8])
+        pg = mgr.create([Bundle(4), Bundle(4)], PACK)
+        for i in range(2):
+            mesh = build_mesh(
+                MeshConfig(dp=2, tp=2), devices=pg.bundle_devices(i)
+            )
+            assert mesh.devices.size == 4
+        # replicas got disjoint chips
+        a = {d.id for d in pg.bundle_devices(0)}
+        b = {d.id for d in pg.bundle_devices(1)}
+        assert not a & b
