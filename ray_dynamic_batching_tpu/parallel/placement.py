@@ -146,6 +146,18 @@ class PlacementManager:
                ) -> List[List[jax.Device]]:
         free = {n: list(ds) for n, ds in self._free.items()}
 
+        def free_desc() -> str:
+            # lock is held: errors must never call self.free_chips() (it
+            # re-acquires the non-reentrant lock -> deadlock). Report both
+            # the committed state and the working state mid-request, since
+            # nothing commits on failure and either alone misleads.
+            committed = {n: len(ds) for n, ds in self._free.items()}
+            working = {n: len(ds) for n, ds in free.items()}
+            if committed == working:
+                return f"free: {committed}"
+            return (f"free: {committed}, after earlier bundles of this "
+                    f"request: {working}")
+
         def take(node: int, k: int) -> List[jax.Device]:
             out = free[node][:k]
             free[node] = free[node][k:]
@@ -158,7 +170,7 @@ class PlacementManager:
                     return [take(node, b.chips) for b in bundles]
             raise PlacementError(
                 f"STRICT_PACK: no node has {need} free chips "
-                f"(free: {self.free_chips()})"
+                f"({free_desc()})"
             )
 
         if strategy == STRICT_SPREAD:
@@ -178,7 +190,7 @@ class PlacementManager:
                 if not fit:
                     raise PlacementError(
                         f"STRICT_SPREAD: no distinct node fits bundle "
-                        f"{bundles[i]} (free: {self.free_chips()})"
+                        f"{bundles[i]} (free: {free_desc()})"
                     )
                 node = max(fit, key=lambda n: len(free[n]))
                 used.add(node)
@@ -193,7 +205,7 @@ class PlacementManager:
                 if not fit:
                     raise PlacementError(
                         f"PACK: no node fits bundle {b} "
-                        f"(free: {self.free_chips()})"
+                        f"({free_desc()})"
                     )
                 node = min(fit, key=lambda n: len(free[n]))
                 out.append(take(node, b.chips))
@@ -206,7 +218,7 @@ class PlacementManager:
             if not fit:
                 raise PlacementError(
                     f"SPREAD: no node fits bundle {b} "
-                    f"(free: {self.free_chips()})"
+                    f"({free_desc()})"
                 )
             node = max(fit, key=lambda n: len(free[n]))
             out.append(take(node, b.chips))

@@ -24,7 +24,49 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import signal  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Per-test hang guard, mirroring the reference's default 3-minute per-test
+# timeout (``pytest.ini:15-16`` there). pytest-timeout isn't in the image, so
+# a SIGALRM watchdog: CPython delivers signals on the main thread even while
+# it is blocked on a lock acquire, so a deadlocked test fails loudly instead
+# of wedging the whole suite. Override per-test with @pytest.mark.timeout(N).
+_DEFAULT_TEST_TIMEOUT_S = 180
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout(seconds): per-test watchdog override"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _hang_guard(request):
+    if not hasattr(signal, "SIGALRM"):  # non-POSIX fallback: no guard
+        yield
+        return
+    marker = request.node.get_closest_marker("timeout")
+    seconds = _DEFAULT_TEST_TIMEOUT_S
+    if marker:
+        if marker.args:
+            seconds = int(marker.args[0])
+        elif "seconds" in marker.kwargs:
+            seconds = int(marker.kwargs["seconds"])
+
+    def _on_timeout(signum, frame):
+        raise TimeoutError(
+            f"test exceeded {seconds}s watchdog (likely hang/deadlock)"
+        )
+
+    old = signal.signal(signal.SIGALRM, _on_timeout)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture(autouse=True)
