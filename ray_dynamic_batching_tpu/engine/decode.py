@@ -23,6 +23,23 @@ The big cache is **donated** through both programs, so XLA updates it in
 place in HBM — zero realloc, zero copy per token (SURVEY.md §7 hard part (e)).
 Admission between steps pulls from the shared :class:`RequestQueue`, keeping
 the Nexus staleness-discard and SLO accounting on the decode path too.
+
+Two throughput levers on the hot loop:
+
+- **Decode horizon**: when the batch is full (or nothing is waiting), the
+  engine runs ``decode_horizon`` steps in ONE compiled ``lax.scan`` program
+  per host round-trip, so the per-token device→host sync (the dominant
+  non-FLOP cost of continuous batching) is amortized h-fold. Slots that hit
+  EOS mid-horizon produce discarded tokens for the remainder — bounded waste
+  traded for sync amortization. With free slots and a non-empty queue the
+  engine drops to single steps so admissions stay prompt.
+- **Admission cap**: at most ``max_admissions_per_step`` prefills run
+  between decode steps, so a burst of arrivals can no longer stall every
+  active slot behind a serial prefill train (head-of-line blocking).
+
+Streaming: requests carrying a :class:`~.request.TokenStream` get every
+token pushed as it reaches the host, before the sequence finishes (ref
+generator batches, ``serve/batching.py:209-276``).
 """
 
 from __future__ import annotations
@@ -104,6 +121,8 @@ class DecodeEngine:
         default_max_new_tokens: int = 64,
         idle_wait_s: float = 0.005,
         sample_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
+        decode_horizon: int = 8,
+        max_admissions_per_step: int = 2,
     ):
         self.model = model
         self.params = params
@@ -122,12 +141,20 @@ class DecodeEngine:
         self._tokens = np.zeros((num_slots, 1), dtype=np.int32)
         self._active_mask = np.zeros((num_slots,), dtype=bool)
 
+        self.decode_horizon = max(1, int(decode_horizon))
+        self.max_admissions_per_step = max(1, int(max_admissions_per_step))
         self._prefill_fns: Dict[int, Callable] = {}
-        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._decode_fn = jax.jit(
+            self._decode_impl, donate_argnums=(1,), static_argnums=(4,)
+        )
         self._thread: Optional[threading.Thread] = None
         self._run = threading.Event()
         self.steps = 0
         self.completed = 0
+        # Progress heartbeat for replica health checks: refreshed only by
+        # SUCCESSFUL loop iterations, so a perpetually-failing _step (device
+        # OOM, corrupt params) reads as a stall even though the thread lives.
+        self.last_heartbeat = time.monotonic()
 
     # --- compiled programs -------------------------------------------------
     def _prefill_impl(self, params, tokens, attn_mask, cache, slot):
@@ -144,16 +171,30 @@ class DecodeEngine:
         first = self._sample(last_logits)[0].astype(jnp.int32)
         return first, cache.replace(k=k, v=v, lengths=lengths)
 
-    def _decode_impl(self, params, cache, tokens, active):
-        # Rows already at capacity produce garbage logits (decode_step masks
-        # their scatter); fold the in-bounds check into the mask so their
-        # "sampled" token is never surfaced, and return the effective mask so
-        # the host knows which slots actually advanced.
-        advanced = jnp.logical_and(active, cache.lengths < cache.capacity)
-        logits, cache = self.model.decode_step(params, tokens, cache, advanced)
-        nxt = self._sample(logits).astype(jnp.int32)
-        nxt = jnp.where(advanced, nxt, tokens[:, 0])
-        return nxt, cache.lengths, advanced, cache
+    def _decode_impl(self, params, cache, tokens, active, horizon: int):
+        """``horizon`` chained decode steps in one program (one host sync).
+
+        Rows already at capacity produce garbage logits (decode_step masks
+        their scatter); fold the in-bounds check into the mask so their
+        "sampled" token is never surfaced, and return the per-substep
+        effective masks so the host knows which slots actually advanced.
+        Output shapes: tokens [h, B], advanced [h, B].
+        """
+
+        def substep(carry, _):
+            cache, tokens = carry
+            advanced = jnp.logical_and(active, cache.lengths < cache.capacity)
+            logits, cache = self.model.decode_step(
+                params, tokens, cache, advanced
+            )
+            nxt = self._sample(logits).astype(jnp.int32)
+            nxt = jnp.where(advanced, nxt, tokens[:, 0])
+            return (cache, nxt[:, None]), (nxt, advanced)
+
+        (cache, _), (toks, adv) = jax.lax.scan(
+            substep, (cache, tokens), None, length=horizon
+        )
+        return toks, adv, cache.lengths, cache
 
     def _prefill_fn(self, bucket: int) -> Callable:
         fn = self._prefill_fns.get(bucket)
@@ -172,13 +213,15 @@ class DecodeEngine:
                 self.params, tokens, mask, self._cache, jnp.int32(0)
             )
             first.block_until_ready()
-        nxt, _, _, self._cache = self._decode_fn(
-            self.params,
-            self._cache,
-            jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
-            jnp.zeros((self.num_slots,), dtype=bool),
-        )
-        nxt.block_until_ready()
+        for h in {1, self.decode_horizon}:
+            nxt, _, _, self._cache = self._decode_fn(
+                self.params,
+                self._cache,
+                jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
+                jnp.zeros((self.num_slots,), dtype=bool),
+                h,
+            )
+            nxt.block_until_ready()
         # Reset state dirtied by warmup runs.
         self._cache = self._cache.replace(
             lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
@@ -193,10 +236,13 @@ class DecodeEngine:
         return [i for i, s in enumerate(self._slots) if s.free]
 
     def _admit(self) -> int:
-        """Fill free slots from the queue (continuous batching join)."""
+        """Fill free slots from the queue (continuous batching join), at most
+        ``max_admissions_per_step`` at a time so prefills interleave with
+        decode steps instead of stalling every active slot."""
         free = self._free_slots()
         if not free:
             return 0
+        free = free[: self.max_admissions_per_step]
         batch = self.queue.get_batch(len(free), discard_stale=True)
         admitted = 0
         for req in batch:
@@ -251,6 +297,7 @@ class DecodeEngine:
 
         PREFILLS_TOTAL.inc(tags={"model": self.model.name})
         TTFT_MS.observe(t - req.arrival_ms, tags={"model": self.model.name})
+        req.stream_put(first_tok)
         # First token may already satisfy the stop conditions.
         if first_tok == self.eos_token_id or max_new <= 1:
             reason = "eos" if first_tok == self.eos_token_id else "length"
@@ -274,35 +321,53 @@ class DecodeEngine:
         self._active_mask[slot_idx] = False
         self.completed += 1
 
-    def _step(self) -> None:
-        nxt, lengths, advanced, self._cache = self._decode_fn(
+    def _pick_horizon(self) -> int:
+        """Long horizon only when no admission could happen during it:
+        batch full, or nothing waiting. Otherwise single steps keep TTFT low."""
+        if self.decode_horizon <= 1:
+            return 1
+        if not self._free_slots() or len(self.queue) == 0:
+            return self.decode_horizon
+        return 1
+
+    def _step(self, horizon: Optional[int] = None) -> None:
+        h = horizon if horizon is not None else self._pick_horizon()
+        toks, advanced, lengths, self._cache = self._decode_fn(
             self.params,
             self._cache,
             jnp.asarray(self._tokens),
             jnp.asarray(self._active_mask),
+            h,
         )
-        nxt_host = np.asarray(nxt)
-        lengths_host = np.asarray(lengths)
-        advanced_host = np.asarray(advanced)
-        self.steps += 1
-        DECODE_STEPS.inc(tags={"model": self.model.name})
+        toks_host = np.asarray(toks)              # [h, B]
+        advanced_host = np.asarray(advanced)      # [h, B]
+        lengths_host = np.asarray(lengths)        # [B] (post-horizon)
+        self.steps += h
+        DECODE_STEPS.inc(h, tags={"model": self.model.name})
         for i, slot in enumerate(self._slots):
             if slot.free or not self._active_mask[i]:
                 continue
-            if not advanced_host[i]:
-                # Cache was already full at step entry — no token produced.
-                self._finish(i, "capacity")
-                continue
-            tok = int(nxt_host[i])
-            slot.generated.append(tok)
-            slot.last_token = tok
-            self._tokens[i, 0] = tok
-            if self.eos_token_id is not None and tok == self.eos_token_id:
-                self._finish(i, "eos")
-            elif len(slot.generated) >= slot.max_new_tokens:
-                self._finish(i, "length")
-            elif lengths_host[i] >= self.max_len:
-                self._finish(i, "capacity")
+            for j in range(h):
+                if not advanced_host[j, i]:
+                    # Cache was already full at substep entry — no token.
+                    self._finish(i, "capacity")
+                    break
+                tok = int(toks_host[j, i])
+                slot.generated.append(tok)
+                slot.last_token = tok
+                self._tokens[i, 0] = tok
+                slot.request.stream_put(tok)
+                if self.eos_token_id is not None and tok == self.eos_token_id:
+                    # Substeps after EOS decoded garbage into this slot's
+                    # cache tail; prefill overwrites the whole row on reuse.
+                    self._finish(i, "eos")
+                    break
+                if len(slot.generated) >= slot.max_new_tokens:
+                    self._finish(i, "length")
+                    break
+            else:
+                if lengths_host[i] >= self.max_len:
+                    self._finish(i, "capacity")
 
     # --- loop --------------------------------------------------------------
     def run_until_idle(self, timeout_s: float = 60.0) -> None:
@@ -329,9 +394,20 @@ class DecodeEngine:
                     )
                 else:
                     self.queue.wait_for_requests(self.idle_wait_s)
+                self.last_heartbeat = time.monotonic()
             except Exception:  # noqa: BLE001 — engine must not die silently
                 logger.exception("%s: decode loop iteration failed", self.model.name)
                 time.sleep(0.05)
+
+    def abort_active(self, exc: Exception) -> None:
+        """Reject every request still occupying a slot (replica shutdown:
+        in-flight sequences must not leave futures/streams hanging). Call
+        only after the loop has stopped."""
+        for i, slot in enumerate(self._slots):
+            if not slot.free and slot.request is not None:
+                slot.request.reject(exc)
+                self._slots[i] = _Slot()
+                self._active_mask[i] = False
 
     def start(self) -> None:
         if self._thread is not None:

@@ -4,21 +4,145 @@ Analogue of the reference's ``BatchRequest``
 (``293-project/src/scheduler.py:181-188``: request_id, data, arrival_time,
 SLO). Result delivery is a ``concurrent.futures.Future`` so the asyncio
 ingress can await it while the engine hot loop stays a plain thread.
+
+Streaming delivery (ref generator batches, ``serve/batching.py:209-276``,
+and the streaming replica path, ``serve/_private/replica.py:515-544``) rides
+a :class:`TokenStream`: the producer (decode engine / generator callable)
+pushes chunks as they exist, the consumer (proxy, client) iterates them
+before the request completes. The future still resolves with the final
+result, so non-streaming callers are unaffected.
 """
 
 from __future__ import annotations
 
 import itertools
+import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 _req_counter = itertools.count(1)
 
 
 def now_ms() -> float:
     return time.monotonic() * 1000.0
+
+
+class StreamClosed(Exception):
+    """Raised by :meth:`TokenStream.get` after close + drain."""
+
+
+class TokenStream:
+    """Single-producer single-consumer chunk stream with blocking reads.
+
+    The producer calls :meth:`put` per chunk and exactly one of
+    :meth:`close` / :meth:`abort`; the consumer iterates (or calls
+    :meth:`get`) and stops at close. Thread-safe; the hot producer path is
+    one lock acquire + notify.
+    """
+
+    def __init__(self, max_buffer: int = 4096) -> None:
+        self._chunks: deque = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._error: Optional[Exception] = None
+        self._on_chunk = None
+        self._on_close = None
+        self.max_buffer = max_buffer
+
+    def put(self, chunk: Any) -> None:
+        with self._cond:
+            if self._closed:
+                return  # consumer gone / finished — drop quietly
+            if self._on_chunk is not None:
+                cb = self._on_chunk
+            else:
+                if len(self._chunks) >= self.max_buffer:
+                    # Slow consumer: drop oldest (token streams are advisory;
+                    # the future still carries the complete result).
+                    self._chunks.popleft()
+                self._chunks.append(chunk)
+                self._cond.notify()
+                return
+        cb(chunk)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            cb = self._on_close
+        if cb is not None:
+            cb(None)
+
+    def abort(self, exc: Exception) -> None:
+        with self._cond:
+            self._error = exc
+            self._closed = True
+            self._cond.notify_all()
+            cb = self._on_close
+        if cb is not None:
+            cb(exc)
+
+    def subscribe(self, on_chunk, on_close) -> None:
+        """Switch to push delivery: buffered chunks replay immediately, then
+        the producer invokes ``on_chunk(chunk)`` inline per put and exactly
+        one ``on_close(error_or_None)`` at the end. Callbacks must be cheap
+        and thread-safe (they run on the producer thread) — an asyncio
+        consumer bridges with ``loop.call_soon_threadsafe``. This removes
+        the blocked-reader thread a pull consumer would need."""
+        with self._cond:
+            # Backlog replays while the lock is held, BEFORE inline delivery
+            # becomes visible to put() — otherwise a concurrent put could
+            # deliver a new chunk ahead of older buffered ones.
+            for c in self._chunks:
+                on_chunk(c)
+            self._chunks.clear()
+            self._on_chunk = on_chunk
+            self._on_close = on_close
+            closed, err = self._closed, self._error
+        if closed:
+            on_close(err)
+
+    def get(self, timeout_s: Optional[float] = None) -> Any:
+        """Next chunk; raises :class:`StreamClosed` when drained+closed,
+        ``TimeoutError`` on timeout, or the producer's abort error."""
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        with self._cond:
+            while True:
+                if self._chunks:
+                    return self._chunks.popleft()
+                if self._closed:
+                    if self._error is not None:
+                        raise self._error
+                    raise StreamClosed()
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("TokenStream.get timed out")
+                self._cond.wait(remaining)
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            try:
+                yield self.get()
+            except StreamClosed:
+                return
+
+    def drain(self, timeout_s: float = 10.0) -> List[Any]:
+        """Collect every chunk until close (tests / non-incremental readers)."""
+        out: List[Any] = []
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                out.append(self.get(timeout_s=deadline - time.monotonic()))
+            except StreamClosed:
+                return out
 
 
 @dataclass
@@ -31,6 +155,9 @@ class Request:
     seq_len: int = 0                  # shape bucket hint for LLM inputs
     future: Future = field(default_factory=Future)
     trace_ctx: Dict[str, Any] = field(default_factory=dict)
+    # Present iff the caller asked for incremental delivery; producers that
+    # don't stream simply never touch it (future-only contract unchanged).
+    stream: Optional[TokenStream] = None
 
     def __post_init__(self) -> None:
         if not self.request_id:
@@ -44,12 +171,21 @@ class Request:
         return (now if now is not None else now_ms()) - self.arrival_ms
 
     def reject(self, exc: Exception) -> None:
+        if self.stream is not None:
+            self.stream.abort(exc)
         if not self.future.done():
             self.future.set_exception(exc)
 
     def fulfill(self, result: Any) -> None:
+        if self.stream is not None:
+            self.stream.close()
         if not self.future.done():
             self.future.set_result(result)
+
+    def stream_put(self, chunk: Any) -> None:
+        """Push one incremental chunk (no-op for non-streaming requests)."""
+        if self.stream is not None:
+            self.stream.put(chunk)
 
 
 class RequestDropped(Exception):

@@ -188,14 +188,21 @@ class ServeController:
         cfg = state.config
         rid = f"{cfg.name}#{state.next_replica_ordinal}"
         state.next_replica_ordinal += 1
-        replica = Replica(
-            replica_id=rid,
-            deployment=cfg.name,
-            fn=state.factory(),
-            max_batch_size=cfg.max_batch_size,
-            batch_wait_timeout_s=cfg.batch_wait_timeout_s,
-            max_ongoing_requests=cfg.max_ongoing_requests,
-        )
+        factory = state.factory
+        if hasattr(factory, "make_replica"):
+            # Deployment owns its replica class (e.g. serve.llm.LLMReplica
+            # wrapping a decode engine) — mirror of the reference where
+            # deployment target state carries the replica actor definition.
+            replica = factory.make_replica(rid, cfg)
+        else:
+            replica = Replica(
+                replica_id=rid,
+                deployment=cfg.name,
+                fn=factory(),
+                max_batch_size=cfg.max_batch_size,
+                batch_wait_timeout_s=cfg.batch_wait_timeout_s,
+                max_ongoing_requests=cfg.max_ongoing_requests,
+            )
         replica.start()
         logger.info("started replica %s", rid)
         return replica

@@ -10,9 +10,9 @@ caller (sync or asyncio via ``wrap_future``) awaits.
 from __future__ import annotations
 
 from concurrent.futures import Future
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
-from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.engine.request import Request, TokenStream
 from ray_dynamic_batching_tpu.serve.router import Router
 
 
@@ -46,6 +46,26 @@ class DeploymentHandle:
         )
         self.router.assign_request(request, locality_hint=locality_hint)
         return request.future
+
+    def remote_stream(
+        self,
+        payload: Any,
+        slo_ms: Optional[float] = None,
+        locality_hint: Optional[str] = None,
+    ) -> Tuple[TokenStream, Future]:
+        """Route one streaming request: chunks arrive on the returned
+        :class:`TokenStream` as the replica produces them, the future still
+        resolves with the final result (ref streaming handle path,
+        ``serve/_private/replica.py:515`` ``handle_request_streaming``)."""
+        stream = TokenStream()
+        request = Request(
+            model=self.deployment,
+            payload=payload,
+            slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
+            stream=stream,
+        )
+        self.router.assign_request(request, locality_hint=locality_hint)
+        return stream, request.future
 
     def options(self, slo_ms: Optional[float] = None) -> "DeploymentHandle":
         return DeploymentHandle(

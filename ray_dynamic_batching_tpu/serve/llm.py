@@ -1,0 +1,219 @@
+"""LLM serving deployment — continuous-batching decode behind the serve stack.
+
+This is the north-star wiring (BASELINE.json: "the per-replica ModelRunner's
+torch forward becomes a jax.jit call"): a deployment whose replicas each own
+a :class:`~ray_dynamic_batching_tpu.engine.decode.DecodeEngine` driving
+prefill + continuous-batching decode on one chip (or one mesh slice), fed
+through the standard proxy → router → handle path the reference uses for
+every deployment (``serve/_private/replica.py:515-544`` — the replica's
+``handle_request``/``_streaming`` entry points; here the request queue IS the
+engine's admission queue, so router assignment and engine admission compose
+without a second hop).
+
+The replica surface (queue_len / accepting / assign / healthy / stats) is
+inherited from :class:`~ray_dynamic_batching_tpu.serve.replica.Replica`, so
+the pow-2 router, autoscaler, and controller state machine treat LLM
+replicas exactly like batch replicas. Only the execution loop differs: the
+decode engine's own thread replaces the opportunistic-batch loop.
+
+Payload contract (JSON-safe, the proxy passes it straight through)::
+
+    {"tokens": [1, 2, 3],          # prompt token ids (required)
+     "max_new_tokens": 64,          # optional
+     "stream": true}                # optional: tokens stream incrementally
+
+Result: ``DecodeResult`` (tokens, finish_reason, ttft_ms, total_ms).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
+from ray_dynamic_batching_tpu.serve.replica import Replica
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.llm")
+
+
+class LLMReplica(Replica):
+    """One decode engine behind the standard replica surface.
+
+    ``engine_builder`` receives this replica's request queue and returns a
+    ready (constructed, un-started) :class:`DecodeEngine` — weights loaded
+    and sharded however the deployment wants (single chip, TP mesh slice).
+    Engine warmup (XLA compiles for every prompt bucket + both decode
+    horizons) runs at construction, mirroring how the controller treats slow
+    replica starts: a replica is registered with the router only after it
+    can serve its first request at full speed.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        deployment: str,
+        engine_builder: Callable[[RequestQueue], DecodeEngine],
+        max_ongoing_requests: int = 256,
+        warmup: bool = True,
+    ) -> None:
+        super().__init__(
+            replica_id=replica_id,
+            deployment=deployment,
+            fn=self._reject_batch_path,  # engine owns execution, not the loop
+            max_ongoing_requests=max_ongoing_requests,
+        )
+        self.engine = engine_builder(self.queue)
+        if warmup:
+            self.engine.warmup()
+
+    @staticmethod
+    def _reject_batch_path(payloads: List[Any]) -> Sequence[Any]:
+        raise RuntimeError("LLMReplica executes via its DecodeEngine")
+
+    # --- lifecycle: the engine loop replaces the batch loop ----------------
+    def start(self) -> None:
+        self.engine.start()
+
+    def stop(self, timeout_s: float = 5.0, drain: bool = True) -> None:
+        import time
+
+        self._stopped = True
+        if drain:
+            deadline = time.monotonic() + timeout_s
+            while self.queue_len() > 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        self.engine.stop(timeout_s)
+        self.queue.close()
+        # Requests still mid-decode in engine slots AND requests still
+        # queued both terminate with a rejection — futures/streams must
+        # never dangle past replica death.
+        exc = RequestDropped(f"{self.replica_id} stopped")
+        self.engine.abort_active(exc)
+        for req in self.drain_queue():
+            req.reject(exc)
+
+    # --- router-facing surface --------------------------------------------
+    def queue_len(self) -> int:
+        return len(self.queue) + self.engine.active_slots
+
+    def healthy(self, stall_timeout_s: float = 60.0) -> bool:
+        """Thread liveness + progress: the engine loop refreshes its
+        heartbeat only on successful iterations, so a perpetually-failing
+        or wedged _step reads unhealthy and the controller replaces the
+        replica (same stall contract as the base class)."""
+        import time
+
+        t = self.engine._thread
+        if t is None or not t.is_alive():
+            return False
+        return (time.monotonic() - self.engine.last_heartbeat) < stall_timeout_s
+
+    def reconfigure(
+        self,
+        max_batch_size: Optional[int] = None,
+        batch_wait_timeout_s: Optional[float] = None,
+        max_ongoing_requests: Optional[int] = None,
+    ) -> None:
+        # Slot count / buckets are compile-shape decisions and can't change
+        # on a live engine; only admission-side knobs apply.
+        if max_ongoing_requests is not None:
+            self.max_ongoing_requests = max_ongoing_requests
+            self.queue.max_len = max_ongoing_requests
+
+    def stats(self) -> dict:
+        s = self.queue.stats()
+        s["ongoing"] = float(self.queue_len())
+        s["active_slots"] = float(self.engine.active_slots)
+        s["decode_steps"] = float(self.engine.steps)
+        s["completed"] = float(self.engine.completed)
+        return s
+
+
+class LLMDeployment:
+    """Deployment factory the controller consumes via ``make_replica``.
+
+    Builds the model + params ONCE and shares them across replicas (weights
+    are immutable at inference; on a single host the HBM cost is paid once —
+    the reference reloads weights per worker because CUDA contexts don't
+    share, a constraint TPU+JAX doesn't have).
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        num_slots: int = 8,
+        max_len: int = 256,
+        prompt_buckets: Optional[Sequence[int]] = None,
+        eos_token_id: Optional[int] = None,
+        default_max_new_tokens: int = 64,
+        decode_horizon: int = 8,
+        max_admissions_per_step: int = 2,
+        dtype: Any = None,
+        params: Any = None,
+        model: Any = None,
+        warmup: bool = True,
+    ) -> None:
+        self.model_name = model_name
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.prompt_buckets = prompt_buckets
+        self.eos_token_id = eos_token_id
+        self.default_max_new_tokens = default_max_new_tokens
+        self.decode_horizon = decode_horizon
+        self.max_admissions_per_step = max_admissions_per_step
+        self.warmup = warmup
+        self._dtype = dtype
+        self._model = model
+        self._params = params
+        self._init_lock = threading.Lock()
+
+    def _ensure_model(self) -> None:
+        with self._init_lock:
+            if self._model is None:
+                from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+                from ray_dynamic_batching_tpu.models.base import get_model
+
+                kwargs = {"dtype": self._dtype} if self._dtype is not None else {}
+                self._model = get_model(self.model_name, **kwargs)
+            if self._params is None:
+                import jax
+
+                self._params = self._model.init(jax.random.PRNGKey(0))
+
+    def build_engine(self, queue: RequestQueue) -> DecodeEngine:
+        self._ensure_model()
+        return DecodeEngine(
+            self._model,
+            self._params,
+            queue,
+            num_slots=self.num_slots,
+            max_len=self.max_len,
+            prompt_buckets=self.prompt_buckets,
+            eos_token_id=self.eos_token_id,
+            default_max_new_tokens=self.default_max_new_tokens,
+            decode_horizon=self.decode_horizon,
+            max_admissions_per_step=self.max_admissions_per_step,
+        )
+
+    # Controller protocol: factories exposing make_replica own replica
+    # construction (the reference's deployment holds its replica class the
+    # same way — deployment_state builds ReplicaActor from the deployment's
+    # target state).
+    def make_replica(self, replica_id: str, config: Any) -> LLMReplica:
+        return LLMReplica(
+            replica_id=replica_id,
+            deployment=config.name,
+            engine_builder=self.build_engine,
+            max_ongoing_requests=config.max_ongoing_requests,
+            warmup=self.warmup,
+        )
+
+    # Legacy callable protocol (factory() -> fn) is not meaningful here.
+    def __call__(self) -> Callable[[List[Any]], Sequence[Any]]:
+        raise TypeError(
+            "LLMDeployment builds replicas via make_replica; register it "
+            "with the controller directly"
+        )

@@ -9,7 +9,10 @@ streams with a minimal HTTP/1.1 parser — the framework owns both sides of
 the socket, so a full ASGI stack buys nothing on the hot path.
 
 Routes:
-- ``POST /api/{deployment}``  body = JSON payload → handle result
+- ``POST /api/{deployment}``  body = JSON payload → handle result; a payload
+  with ``"stream": true`` gets a chunked NDJSON response — one line per
+  chunk as the replica produces it, then a final ``{"result": ...}`` line
+  (ref streaming proxy path ``_private/proxy.py:959``)
 - ``GET  /-/healthz``         liveness (ref proxy health checks)
 - ``GET  /-/status``          controller status snapshot
 - ``GET  /metrics``           Prometheus text exposition
@@ -153,10 +156,74 @@ class HTTPProxy:
         )
         return head.encode() + body
 
+    # --- streaming (ref HTTPProxy.send_request_to_replica, proxy.py:959) --
+    async def _stream_response(
+        self,
+        writer: asyncio.StreamWriter,
+        handle: DeploymentHandle,
+        payload: Any,
+    ) -> str:
+        """Chunked NDJSON: one line per streamed chunk, then a final
+        ``{"result": ...}`` (or ``{"error": ...}``) line. Returns the HTTP
+        code for metrics.
+
+        Delivery is push-based: the TokenStream's producer thread hands
+        chunks to this event loop via ``call_soon_threadsafe`` — no blocked
+        reader thread per connection, so concurrent streams scale with the
+        event loop, not with an executor pool.
+        """
+        stream, future = handle.remote_stream(payload)
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: application/x-ndjson\r\n"
+            b"Transfer-Encoding: chunked\r\n"
+            b"Connection: keep-alive\r\n\r\n"
+        )
+        loop = asyncio.get_running_loop()
+        aq: asyncio.Queue = asyncio.Queue()
+        _CLOSE = object()
+
+        def _push(item: Any) -> None:
+            try:
+                loop.call_soon_threadsafe(aq.put_nowait, item)
+            except RuntimeError:
+                pass  # loop shut down mid-stream; connection is dying anyway
+
+        stream.subscribe(
+            lambda chunk: _push(("chunk", chunk)),
+            lambda err: _push((_CLOSE, err)),
+        )
+
+        async def _write_line(obj: Any) -> None:
+            data = (json.dumps(_to_jsonable(obj)) + "\n").encode()
+            writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            await writer.drain()
+
+        while True:
+            kind, val = await asyncio.wait_for(
+                aq.get(), timeout=self.request_timeout_s
+            )
+            if kind is _CLOSE:
+                break
+            await _write_line({"chunk": val})
+        code = "200"
+        try:
+            result = await asyncio.wait_for(
+                asyncio.wrap_future(future), timeout=self.request_timeout_s
+            )
+            await _write_line({"result": result})
+        except Exception as e:  # noqa: BLE001 — surface on the trailer line
+            code = "500"
+            await _write_line({"error": str(e)})
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+        return code
+
     # --- request handling (ref GenericProxy.proxy_request, proxy.py:446) --
     async def _handle_one(
-        self, method: str, path: str, body: bytes
-    ) -> Tuple[bytes, str]:
+        self, method: str, path: str, body: bytes,
+        writer: Optional[asyncio.StreamWriter] = None,
+    ) -> Tuple[Optional[bytes], str]:
         if method == "GET" and path == "/-/healthz":
             return self._response(200, {"status": "ok"}), "healthz"
         if method == "GET" and path == "/-/status":
@@ -182,6 +249,15 @@ class HTTPProxy:
             payload = json.loads(body) if body else None
         except json.JSONDecodeError as e:
             return self._response(400, {"error": f"bad JSON: {e}"}), route
+
+        if (
+            writer is not None
+            and isinstance(payload, dict)
+            and payload.get("stream")
+        ):
+            code = await self._stream_response(writer, handle, payload)
+            # None marks "already written"; tag carries the code for metrics.
+            return None, f"{route}|{code}"
 
         future = handle.remote(payload)
         try:
@@ -211,7 +287,11 @@ class HTTPProxy:
                     writer.write(resp)
                     await writer.drain()
                     break
-                resp, route = await self._handle_one(method, path, body)
+                resp, route = await self._handle_one(method, path, body, writer)
+                if resp is None:  # streamed: already written, tag holds code
+                    route, _, code = route.rpartition("|")
+                    PROXY_REQUESTS.inc(tags={"route": route, "code": code})
+                    continue
                 code = resp.split(b" ", 2)[1].decode()
                 PROXY_REQUESTS.inc(tags={"route": route, "code": code})
                 writer.write(resp)

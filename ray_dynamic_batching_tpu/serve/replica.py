@@ -16,6 +16,7 @@ control, health, and stats, mirroring how Serve wraps arbitrary callables.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
@@ -99,6 +100,29 @@ class Replica:
         return self.queue.add_request(request, reject_on_full=False)
 
     # --- loop -------------------------------------------------------------
+    def _stream_generator_batch(
+        self, batch: List[Request], gen: Any
+    ) -> List[Any]:
+        """Generator batching (ref ``serve/batching.py:209-276``): the
+        callable yields, per step, a list of one chunk per request; each
+        chunk streams to its request immediately, and the per-request chunk
+        lists become the final results. A ``StopIteration``-style sentinel
+        of ``None`` skips a request for that step (ref's semantics for
+        unequal-length generator outputs)."""
+        collected: List[List[Any]] = [[] for _ in batch]
+        for step in gen:
+            if len(step) != len(batch):
+                raise ValueError(
+                    f"generator yielded {len(step)} chunks for "
+                    f"{len(batch)} requests"
+                )
+            for i, (req, chunk) in enumerate(zip(batch, step)):
+                if chunk is None:
+                    continue
+                collected[i].append(chunk)
+                req.stream_put(chunk)
+        return collected
+
     def _process_batch(self, batch: List[Request]) -> None:
         with self._ongoing_lock:
             self._ongoing += len(batch)
@@ -106,6 +130,8 @@ class Replica:
         try:
             chaos().maybe_fail("replica.process_batch")
             results = self.fn([r.payload for r in batch])
+            if inspect.isgenerator(results):
+                results = self._stream_generator_batch(batch, results)
             if len(results) != len(batch):
                 raise ValueError(
                     f"callable returned {len(results)} results for "

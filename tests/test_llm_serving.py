@@ -1,0 +1,273 @@
+"""End-to-end LLM decode serving: proxy → router → LLMReplica → DecodeEngine.
+
+The north-star wiring (VERDICT.md missing #1/#2): continuous-batching decode
+reachable through the exact path the reference serves every request
+(``serve/_private/replica.py:515-544`` → ``serve/batching.py:146``), plus
+token streaming end to end (ref ``serve/batching.py:209-276`` generator
+batches and the streaming proxy path ``_private/proxy.py:959``).
+"""
+
+import json
+import socket
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.engine.decode import DecodeResult
+from ray_dynamic_batching_tpu.serve.controller import (
+    DeploymentConfig,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
+from ray_dynamic_batching_tpu.serve.replica import Replica
+from ray_dynamic_batching_tpu.engine.request import Request, TokenStream
+
+
+@pytest.fixture(scope="module")
+def llm_stack():
+    """Controller serving llama_tiny decode on the CPU fake chip."""
+    controller = ServeController(control_interval_s=0.1)
+    deployment = LLMDeployment(
+        "llama_tiny",
+        num_slots=4,
+        max_len=64,
+        prompt_buckets=[8, 16],
+        default_max_new_tokens=8,
+        decode_horizon=4,
+        dtype=jnp.float32,
+    )
+    router = controller.deploy(
+        DeploymentConfig(name="llama_tiny", num_replicas=1),
+        factory=deployment,
+    )
+    controller.start()
+    handle = DeploymentHandle(router)
+    yield controller, handle
+    controller.shutdown()
+
+
+class TestLLMDeployment:
+    def test_handle_roundtrip(self, llm_stack):
+        _, handle = llm_stack
+        fut = handle.remote({"tokens": [1, 2, 3], "max_new_tokens": 5})
+        result = fut.result(timeout=30)
+        assert isinstance(result, DecodeResult)
+        assert len(result.tokens) == 5
+        assert result.finish_reason == "length"
+
+    def test_concurrent_requests_share_engine(self, llm_stack):
+        _, handle = llm_stack
+        futs = [
+            handle.remote({"tokens": [i + 1, i + 2], "max_new_tokens": 4})
+            for i in range(8)
+        ]
+        results = [f.result(timeout=30) for f in futs]
+        assert all(len(r.tokens) == 4 for r in results)
+
+    def test_streaming_through_handle(self, llm_stack):
+        _, handle = llm_stack
+        stream, fut = handle.remote_stream(
+            {"tokens": [1, 2, 3], "max_new_tokens": 6}
+        )
+        first = stream.get(timeout_s=30)   # must arrive pre-completion
+        rest = stream.drain(timeout_s=30)
+        result = fut.result(timeout=30)
+        assert [first] + rest == result.tokens
+
+    def test_controller_status_reports_engine(self, llm_stack):
+        controller, _ = llm_stack
+        status = controller.status()["llama_tiny"]
+        assert status["running_replicas"] == 1
+        replica_stats = next(iter(status["replicas"].values()))
+        assert "active_slots" in replica_stats
+        assert "decode_steps" in replica_stats
+
+
+class TestGeneratorBatching:
+    def test_generator_fn_streams_chunks(self):
+        """A generator callable yields per-request chunk lists; chunks must
+        reach streams incrementally and futures get the collected lists."""
+
+        def spell(payloads):
+            # yield each payload's characters one step at a time
+            longest = max(len(p) for p in payloads)
+            for i in range(longest):
+                yield [p[i] if i < len(p) else None for p in payloads]
+
+        replica = Replica("gen#0", "spell", spell, max_batch_size=4,
+                          batch_wait_timeout_s=0.01)
+        reqs = [
+            Request(model="spell", payload=word, slo_ms=5_000.0,
+                    stream=TokenStream())
+            for word in ("hi", "there")
+        ]
+        for r in reqs:
+            assert replica.assign(r)
+        replica.start()
+        try:
+            assert reqs[0].future.result(timeout=5) == ["h", "i"]
+            assert reqs[1].future.result(timeout=5) == list("there")
+            assert reqs[0].stream.drain() == ["h", "i"]
+            assert reqs[1].stream.drain() == list("there")
+        finally:
+            replica.stop()
+
+    def test_generator_wrong_width_rejects(self):
+        def bad(payloads):
+            yield [1]  # always one chunk regardless of batch size
+
+        replica = Replica("gen#1", "bad", bad, max_batch_size=4,
+                          batch_wait_timeout_s=0.01)
+        reqs = [
+            Request(model="bad", payload=i, slo_ms=5_000.0) for i in range(2)
+        ]
+        for r in reqs:
+            assert replica.assign(r)
+        replica.start()
+        try:
+            with pytest.raises(ValueError):
+                reqs[0].future.result(timeout=5)
+        finally:
+            replica.stop()
+
+
+def _http(sock_addr, method, path, body=None, timeout=30.0):
+    """Minimal HTTP client returning (code, headers, raw_body_bytes)."""
+    host, port = sock_addr
+    data = json.dumps(body).encode() if body is not None else b""
+    req = (
+        f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"
+        f"Content-Length: {len(data)}\r\nConnection: keep-alive\r\n\r\n"
+    ).encode() + data
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(req)
+        s.settimeout(timeout)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += s.recv(65536)
+        head, rest = buf.split(b"\r\n\r\n", 1)
+        lines = head.decode().split("\r\n")
+        code = int(lines[0].split(" ")[1])
+        headers = dict(
+            (k.strip().lower(), v.strip())
+            for k, v in (l.split(":", 1) for l in lines[1:] if ":" in l)
+        )
+        if "content-length" in headers:
+            want = int(headers["content-length"])
+            while len(rest) < want:
+                rest += s.recv(65536)
+            return code, headers, rest[:want]
+        # chunked: read until the 0-length terminator
+        while not rest.endswith(b"0\r\n\r\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            rest += chunk
+        return code, headers, rest
+
+
+def _dechunk(raw: bytes) -> bytes:
+    out = b""
+    while raw:
+        if b"\r\n" not in raw:
+            break
+        size_line, raw = raw.split(b"\r\n", 1)
+        size = int(size_line, 16)
+        if size == 0:
+            break
+        out += raw[:size]
+        raw = raw[size + 2:]  # skip payload + trailing CRLF
+    return out
+
+
+class TestProxyLLM:
+    @pytest.fixture(scope="class")
+    def proxy_stack(self, llm_stack):
+        _, handle = llm_stack
+        prouter = ProxyRouter()
+        prouter.set_route("/api/llama_tiny", handle)
+        proxy = HTTPProxy(prouter, port=0).start()
+        yield (proxy.host, proxy.port)
+        proxy.stop()
+
+    def test_buffered_request(self, proxy_stack):
+        code, _, body = _http(
+            proxy_stack, "POST", "/api/llama_tiny",
+            {"tokens": [1, 2, 3], "max_new_tokens": 4},
+        )
+        assert code == 200
+        result = json.loads(body)["result"]
+        assert len(result["tokens"]) == 4
+
+    def test_streaming_request(self, proxy_stack):
+        code, headers, raw = _http(
+            proxy_stack, "POST", "/api/llama_tiny",
+            {"tokens": [1, 2, 3], "max_new_tokens": 6, "stream": True},
+        )
+        assert code == 200
+        assert headers.get("transfer-encoding") == "chunked"
+        lines = [
+            json.loads(l) for l in _dechunk(raw).decode().splitlines() if l
+        ]
+        chunks = [l["chunk"] for l in lines if "chunk" in l]
+        finals = [l for l in lines if "result" in l]
+        assert len(finals) == 1
+        assert chunks == finals[0]["result"]["tokens"]
+        assert len(chunks) == 6  # every token arrived as its own line
+
+
+class TestLLMReplicaLifecycle:
+    def test_stop_aborts_active_slots(self):
+        """Replica death must reject in-flight decode requests — futures and
+        streams never dangle (ref: replicas drain-then-stop; undrained work
+        is rejected)."""
+        import jax.numpy as jnp
+        from ray_dynamic_batching_tpu.engine.request import RequestDropped
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+        dep = LLMDeployment(
+            "llama_tiny", num_slots=2, max_len=4096, prompt_buckets=[8],
+            default_max_new_tokens=8, dtype=jnp.float32,
+        )
+        cfg = DeploymentConfig(name="abort_test")
+        replica = dep.make_replica("abort#0", cfg)
+        req = Request(
+            model="abort_test",
+            payload={"tokens": [1, 2], "max_new_tokens": 500_000},
+            slo_ms=60_000.0,
+            stream=TokenStream(),
+        )
+        assert replica.assign(req)
+        replica.start()
+        req.stream.get(timeout_s=30)  # wait until it's mid-decode
+        replica.stop(timeout_s=0.2)   # drain can't finish: must abort
+        with pytest.raises(RequestDropped):
+            req.future.result(timeout=5)
+        with pytest.raises(RequestDropped):
+            req.stream.drain(timeout_s=5)
+
+    def test_healthy_detects_stalled_engine(self):
+        """A live thread that stops making progress must read unhealthy so
+        the controller replaces it (engine heartbeat contract)."""
+        import time
+        import jax.numpy as jnp
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+        dep = LLMDeployment(
+            "llama_tiny", num_slots=2, max_len=64, prompt_buckets=[8],
+            default_max_new_tokens=4, dtype=jnp.float32,
+        )
+        cfg = DeploymentConfig(name="stall_test")
+        replica = dep.make_replica("stall#0", cfg)
+        replica.start()
+        try:
+            time.sleep(0.05)
+            assert replica.healthy(stall_timeout_s=60.0)
+            # Simulate a wedged loop: freeze the heartbeat in the past.
+            replica.engine.last_heartbeat -= 120.0
+            assert not replica.healthy(stall_timeout_s=60.0)
+        finally:
+            replica.stop(timeout_s=0.5)
