@@ -47,7 +47,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -157,18 +157,33 @@ class DecodeEngine:
         self.last_heartbeat = time.monotonic()
 
     # --- compiled programs -------------------------------------------------
-    def _prefill_impl(self, params, tokens, attn_mask, cache, slot):
-        """Prompt → big cache row at ``slot`` + first sampled token.
+    def _prefill_impl(self, params, tokens, attn_mask, cache, slots):
+        """``nB`` prompts → cache rows at ``slots`` + first sampled tokens.
 
-        ``slot`` is a traced int32 scalar: one compiled program per prompt
-        bucket serves every slot (dynamic start index, static shapes).
+        tokens/attn_mask are [nB, T]; ``slots`` is a traced [nB] int32
+        vector: one compiled program per (prompt bucket, group size) serves
+        every slot combination (dynamic start indices, static shapes).
+        Batching admissions into one program means ONE host round-trip per
+        admission group instead of per request — on hosts where dispatch
+        latency dominates (e.g. a tunneled chip) this is the TTFT lever.
         """
-        row_cache = self.model.make_cache(1, self.max_len)
-        last_logits, row = self.model.prefill(params, tokens, attn_mask, row_cache)
-        k = jax.lax.dynamic_update_slice(cache.k, row.k, (0, slot, 0, 0, 0))
-        v = jax.lax.dynamic_update_slice(cache.v, row.v, (0, slot, 0, 0, 0))
-        lengths = jax.lax.dynamic_update_slice(cache.lengths, row.lengths, (slot,))
-        first = self._sample(last_logits)[0].astype(jnp.int32)
+        nB = tokens.shape[0]
+        row_cache = self.model.make_cache(nB, self.max_len)
+        last_logits, rows = self.model.prefill(
+            params, tokens, attn_mask, row_cache
+        )
+        k, v, lengths = cache.k, cache.v, cache.lengths
+        for i in range(nB):  # static unroll: nB is a compile-time constant
+            k = jax.lax.dynamic_update_slice(
+                k, rows.k[:, i : i + 1], (0, slots[i], 0, 0, 0)
+            )
+            v = jax.lax.dynamic_update_slice(
+                v, rows.v[:, i : i + 1], (0, slots[i], 0, 0, 0)
+            )
+            lengths = jax.lax.dynamic_update_slice(
+                lengths, rows.lengths[i : i + 1], (slots[i],)
+            )
+        first = self._sample(last_logits).astype(jnp.int32)  # [nB]
         return first, cache.replace(k=k, v=v, lengths=lengths)
 
     def _decode_impl(self, params, cache, tokens, active, horizon: int):
@@ -178,7 +193,10 @@ class DecodeEngine:
         their scatter); fold the in-bounds check into the mask so their
         "sampled" token is never surfaced, and return the per-substep
         effective masks so the host knows which slots actually advanced.
-        Output shapes: tokens [h, B], advanced [h, B].
+
+        Everything the host needs comes back PACKED in one int32 array
+        [2h+1, B] (h token rows, h advanced rows, 1 lengths row) so the
+        device→host boundary is crossed once per dispatch, not three times.
         """
 
         def substep(carry, _):
@@ -194,68 +212,71 @@ class DecodeEngine:
         (cache, _), (toks, adv) = jax.lax.scan(
             substep, (cache, tokens), None, length=horizon
         )
-        return toks, adv, cache.lengths, cache
+        packed = jnp.concatenate(
+            [toks, adv.astype(jnp.int32), cache.lengths[None, :]], axis=0
+        )
+        return packed, cache
 
-    def _prefill_fn(self, bucket: int) -> Callable:
-        fn = self._prefill_fns.get(bucket)
+    def _admit_group_sizes(self) -> List[int]:
+        """Compiled prefill group widths: powers of two up to the admission
+        cap, plus the cap itself when it isn't one — every chunk width
+        _admit can produce must round up to a width warmup compiled, or a
+        burst pays a 20-40s XLA compile mid-serving."""
+        sizes, s = [], 1
+        while s <= self.max_admissions_per_step:
+            sizes.append(s)
+            s *= 2
+        if sizes[-1] != self.max_admissions_per_step:
+            sizes.append(self.max_admissions_per_step)
+        return sizes
+
+    def _prefill_fn(self, bucket: int, group: int) -> Callable:
+        fn = self._prefill_fns.get((bucket, group))
         if fn is None:
             # Donate the big cache (arg 3) — updated in place in HBM.
             fn = jax.jit(self._prefill_impl, donate_argnums=(3,))
-            self._prefill_fns[bucket] = fn
+            self._prefill_fns[(bucket, group)] = fn
         return fn
 
     def warmup(self) -> None:
-        """Compile every prompt bucket + the decode step before serving."""
+        """Compile every (prompt bucket, group size) + both decode horizons
+        before serving."""
         for b in self.prompt_buckets:
-            tokens = jnp.zeros((1, b), dtype=jnp.int32)
-            mask = jnp.ones((1, b), dtype=jnp.int32)
-            first, self._cache = self._prefill_fn(b)(
-                self.params, tokens, mask, self._cache, jnp.int32(0)
-            )
-            first.block_until_ready()
+            for g in self._admit_group_sizes():
+                tokens = jnp.zeros((g, b), dtype=jnp.int32)
+                mask = jnp.ones((g, b), dtype=jnp.int32)
+                slots = jnp.arange(g, dtype=jnp.int32) % self.num_slots
+                first, self._cache = self._prefill_fn(b, g)(
+                    self.params, tokens, mask, self._cache, slots
+                )
+                first.block_until_ready()
         for h in {1, self.decode_horizon}:
-            nxt, _, _, self._cache = self._decode_fn(
+            packed, self._cache = self._decode_fn(
                 self.params,
                 self._cache,
                 jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
                 jnp.zeros((self.num_slots,), dtype=bool),
                 h,
             )
-            nxt.block_until_ready()
+            packed.block_until_ready()
         # Reset state dirtied by warmup runs.
         self._cache = self._cache.replace(
             lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
         )
         logger.info(
-            "%s: warmed %d prefill buckets + decode step",
-            self.model.name, len(self.prompt_buckets),
+            "%s: warmed %d prefill programs + decode horizons {1, %d}",
+            self.model.name, len(self._prefill_fns), self.decode_horizon,
         )
 
     # --- admission ---------------------------------------------------------
     def _free_slots(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s.free]
 
-    def _admit(self) -> int:
-        """Fill free slots from the queue (continuous batching join), at most
-        ``max_admissions_per_step`` at a time so prefills interleave with
-        decode steps instead of stalling every active slot."""
-        free = self._free_slots()
-        if not free:
-            return 0
-        free = free[: self.max_admissions_per_step]
-        batch = self.queue.get_batch(len(free), discard_stale=True)
-        admitted = 0
-        for req in batch:
-            slot_idx = free[admitted]
-            try:
-                self._start_request(slot_idx, req)
-            except Exception as e:  # noqa: BLE001 — bad prompt must not kill loop
-                req.reject(e)
-                continue
-            admitted += 1
-        return admitted
-
-    def _start_request(self, slot_idx: int, req: Request) -> None:
+    def _prep_prompt(self, req: Request) -> Tuple[np.ndarray, int, int]:
+        """Validate one request BEFORE it costs a dispatch; returns
+        (prompt ids, bucket, max_new_tokens) or raises. Every way a payload
+        can be malformed must surface here — past this point the request is
+        committed to a slot and only engine errors can reject it."""
         prompt = np.asarray(
             req.payload["tokens"] if isinstance(req.payload, dict) else req.payload,
             dtype=np.int32,
@@ -268,24 +289,95 @@ class DecodeEngine:
                 f"{req.request_id}: prompt length {prompt.size} exceeds "
                 f"largest bucket {self.prompt_buckets[-1]}"
             )
-        padded = np.zeros((1, bucket), dtype=np.int32)
-        padded[0, : prompt.size] = prompt
-        mask = np.zeros((1, bucket), dtype=np.int32)
-        mask[0, : prompt.size] = 1
-
-        first, self._cache = self._prefill_fn(bucket)(
-            self.params,
-            jnp.asarray(padded),
-            jnp.asarray(mask),
-            self._cache,
-            jnp.int32(slot_idx),
-        )
-        first_tok = int(first)
-        t = now_ms()
         max_new = self.default_max_new_tokens
         if isinstance(req.payload, dict):
             max_new = int(req.payload.get("max_new_tokens", max_new))
+        return prompt, bucket, max_new
 
+    def _admit(self) -> int:
+        """Fill free slots from the queue (continuous batching join), at most
+        ``max_admissions_per_step`` at a time so prefills interleave with
+        decode steps instead of stalling every active slot.
+
+        Same-bucket prompts prefill as ONE batched program call (group
+        padded to the next compiled power-of-two width by duplicating row 0
+        — the duplicate writes identical data to the same slot, which is
+        idempotent), so a burst of admissions costs one dispatch per bucket
+        rather than one per request.
+
+        The cap only applies while slots are actively decoding (it exists to
+        protect THEIR latency); an idle engine ramps by filling every free
+        slot at once — there is nothing to stall."""
+        free = self._free_slots()
+        if not free:
+            return 0
+        if self._active_mask.any():
+            free = free[: self.max_admissions_per_step]
+        batch = self.queue.get_batch(len(free), discard_stale=True)
+        by_bucket: Dict[int, List[Tuple[Request, np.ndarray, int]]] = {}
+        for req in batch:
+            try:
+                prompt, bucket, max_new = self._prep_prompt(req)
+            except Exception as e:  # noqa: BLE001 — bad prompt must not kill loop
+                req.reject(e)
+                continue
+            by_bucket.setdefault(bucket, []).append((req, prompt, max_new))
+        admitted = 0
+        cap = self.max_admissions_per_step
+        for bucket, items in by_bucket.items():
+            for off in range(0, len(items), cap):  # chunks round up to a
+                chunk = items[off : off + cap]     # compiled group width
+                slots = free[admitted : admitted + len(chunk)]
+                try:
+                    self._prefill_group(bucket, chunk, slots)
+                except Exception as e:  # noqa: BLE001 — dequeued requests
+                    # must never dangle: a failed group rejects its members
+                    logger.exception(
+                        "%s: prefill group failed", self.model.name
+                    )
+                    for req, _p, _m in chunk:
+                        req.reject(e)
+                    continue
+                admitted += len(chunk)
+        return admitted
+
+    def _prefill_group(
+        self,
+        bucket: int,
+        items: List[Tuple[Request, np.ndarray, int]],
+        slot_ids: List[int],
+    ) -> None:
+        n = len(items)
+        group = next(s for s in self._admit_group_sizes() if s >= n)
+        tokens = np.zeros((group, bucket), dtype=np.int32)
+        mask = np.zeros((group, bucket), dtype=np.int32)
+        slots = np.zeros((group,), dtype=np.int32)
+        for i, (req, prompt, _max_new) in enumerate(items):
+            tokens[i, : prompt.size] = prompt
+            mask[i, : prompt.size] = 1
+            slots[i] = slot_ids[i]
+        # Pad rows duplicate row 0 (same slot, same data — idempotent write).
+        for i in range(n, group):
+            tokens[i] = tokens[0]
+            mask[i] = mask[0]
+            slots[i] = slots[0]
+
+        first, self._cache = self._prefill_fn(bucket, group)(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(mask),
+            self._cache,
+            jnp.asarray(slots),
+        )
+        first_host = np.asarray(first)  # ONE fetch for the whole group
+        t = now_ms()
+        for i, (req, _prompt, max_new) in enumerate(items):
+            self._register(slot_ids[i], req, int(first_host[i]), max_new, t)
+
+    def _register(
+        self, slot_idx: int, req: Request, first_tok: int, max_new: int,
+        t: float,
+    ) -> None:
         slot = self._slots[slot_idx]
         slot.request = req
         slot.generated = [first_tok]
@@ -332,16 +424,17 @@ class DecodeEngine:
 
     def _step(self, horizon: Optional[int] = None) -> None:
         h = horizon if horizon is not None else self._pick_horizon()
-        toks, advanced, lengths, self._cache = self._decode_fn(
+        packed, self._cache = self._decode_fn(
             self.params,
             self._cache,
             jnp.asarray(self._tokens),
             jnp.asarray(self._active_mask),
             h,
         )
-        toks_host = np.asarray(toks)              # [h, B]
-        advanced_host = np.asarray(advanced)      # [h, B]
-        lengths_host = np.asarray(lengths)        # [B] (post-horizon)
+        packed_host = np.asarray(packed)          # ONE fetch per dispatch
+        toks_host = packed_host[:h]               # [h, B]
+        advanced_host = packed_host[h : 2 * h].astype(bool)   # [h, B]
+        lengths_host = packed_host[2 * h]         # [B] (post-horizon)
         self.steps += h
         DECODE_STEPS.inc(h, tags={"model": self.model.name})
         for i, slot in enumerate(self._slots):

@@ -181,7 +181,14 @@ class ReplicaEngine:
                         params, *example
                     ).compile()
                     if len(self._compile_cache) >= self._compile_cache_cap:
+                        # Evict LEAST-RECENTLY-USED, not oldest-inserted: a
+                        # hot executable recompiling mid-serving costs 20-40s
+                        # of blown SLOs on the chip.
                         self._compile_cache.pop(next(iter(self._compile_cache)))
+                    self._compile_cache[key] = compiled
+                else:
+                    # Hit refreshes recency (insertion order is the LRU order).
+                    self._compile_cache.pop(key)
                     self._compile_cache[key] = compiled
                 steps[name] = CompiledStep(
                     model_name=name,

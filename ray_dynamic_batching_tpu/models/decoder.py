@@ -124,8 +124,9 @@ class DecoderLayer(nn.Module):
         x: jax.Array,               # [B, T, D]
         positions: jax.Array,       # [B, T]
         mask: Optional[jax.Array],  # [B, 1, T, S_attended] True = attend
-        layer_cache: Optional[Tuple[jax.Array, jax.Array]] = None,  # k/v [B,S,K,H]
+        cache_kv: Optional[Tuple[jax.Array, jax.Array]] = None,  # k/v [L,B,S,K,H]
         token_mask: Optional[jax.Array] = None,  # [B, T] (no-cache path)
+        layer_idx: int = 0,
     ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
         cfg = self.cfg
         dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
@@ -144,8 +145,14 @@ class DecoderLayer(nn.Module):
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
 
-        if layer_cache is not None:
-            k_cache, v_cache = layer_cache
+        if cache_kv is not None:
+            # The layer scatters into the FULL stacked [L, B, S, K, H] cache
+            # at its own layer index and hands the whole buffer to the next
+            # layer. Never slice-out/re-stack per layer: rebuilding the
+            # stacked array every decode step forces XLA to materialize a
+            # fresh multi-GB copy per token (measured 15 ms/substep for
+            # GPT-2-medium at 32 slots vs ~2 ms with in-place updates).
+            k_full, v_full = cache_kv
             B, T = positions.shape
             if T == 1:
                 # Decode: scatter this token's k/v at its row position.
@@ -153,18 +160,24 @@ class DecoderLayer(nn.Module):
                 # instead of clamping onto (and corrupting) the last slot.
                 idx = positions[:, 0]
                 rows = jnp.arange(B)
-                k_cache = k_cache.at[rows, idx].set(k[:, 0], mode="drop")
-                v_cache = v_cache.at[rows, idx].set(v[:, 0], mode="drop")
+                k_full = k_full.at[layer_idx, rows, idx].set(
+                    k[:, 0], mode="drop"
+                )
+                v_full = v_full.at[layer_idx, rows, idx].set(
+                    v[:, 0], mode="drop"
+                )
             else:
                 # Prefill into an empty cache: contiguous write at offset 0.
-                k_cache = jax.lax.dynamic_update_slice(
-                    k_cache, k, (0, 0, 0, 0)
+                k_full = jax.lax.dynamic_update_slice(
+                    k_full, k[None], (layer_idx, 0, 0, 0, 0)
                 )
-                v_cache = jax.lax.dynamic_update_slice(
-                    v_cache, v, (0, 0, 0, 0)
+                v_full = jax.lax.dynamic_update_slice(
+                    v_full, v[None], (layer_idx, 0, 0, 0, 0)
                 )
-            attn_out = attn_ops.dot_product_attention(q, k_cache, v_cache, mask=mask)
-            new_cache = (k_cache, v_cache)
+            attn_out = attn_ops.dot_product_attention(
+                q, k_full[layer_idx], v_full[layer_idx], mask=mask
+            )
+            new_cache = (k_full, v_full)
         elif token_mask is not None:
             # Full-sequence self-attention: routes through ring attention
             # over the sp mesh axis under a sequence_parallel context.
@@ -234,17 +247,13 @@ class DecoderModule(nn.Module):
             )
             x = x + pos_embed(positions)
 
-        new_k, new_v = [], []
+        cache_kv = (cache.k, cache.v) if cache is not None else None
         for i in range(cfg.num_layers):
-            layer_cache = (
-                (cache.k[i], cache.v[i]) if cache is not None else None
-            )
             x, updated = DecoderLayer(cfg, dtype=self.dtype, name=f"layer{i}")(
-                x, positions, mask, layer_cache, token_mask
+                x, positions, mask, cache_kv, token_mask, layer_idx=i
             )
             if updated is not None:
-                new_k.append(updated[0])
-                new_v.append(updated[1])
+                cache_kv = updated
 
         if cfg.norm == "rms":
             x = RMSNorm(name="final_norm")(x)
@@ -265,7 +274,7 @@ class DecoderModule(nn.Module):
         out_cache = None
         if cache is not None:
             out_cache = KVCache(
-                k=jnp.stack(new_k), v=jnp.stack(new_v), lengths=cache.lengths
+                k=cache_kv[0], v=cache_kv[1], lengths=cache.lengths
             )
         return logits, out_cache
 

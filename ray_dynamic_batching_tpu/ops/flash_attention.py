@@ -89,15 +89,24 @@ def _attn_kernel(
         if causal:
             valid = jnp.logical_and(valid, k_pos <= q_pos + (kv_len - q_len))
         if mask_ref is not None:
+            # The streamed mask folds in ARITHMETICALLY (f32 multiply-add),
+            # not via boolean ops: an i1 vector derived from a VMEM-streamed
+            # tile trips a Mosaic relayout bug ("non-singleton logical
+            # dimension is replicated in destination but not in source") on
+            # v5 hardware; iota-derived booleans are fine.
             m_tile = mask_ref[0, :, pl.ds(jk * block_k, block_k)]
-            valid = jnp.logical_and(valid, m_tile != 0)
+            mf = m_tile.astype(jnp.float32)                  # 1 keep, 0 drop
+            s = s + (mf - 1.0) * (-NEG_INF)
         s = jnp.where(valid, s, NEG_INF)
 
         m_cur = jnp.max(s, axis=-1, keepdims=True)          # [block_q, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         # A fully-masked row has s == m_new == NEG_INF, where exp(s - m_new)
-        # would be 1 — zero those probs explicitly via the validity mask.
+        # would be 1 — zero those probs explicitly via the validity mask
+        # (and the f32 mask for rows masked only by mask_ref).
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)        # [block_q, block_k]
+        if mask_ref is not None:
+            p = p * mf
         corr = jnp.exp(m_prev - m_new)                       # [block_q, 1]
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(

@@ -199,19 +199,24 @@ class HTTPProxy:
             writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             await writer.drain()
 
-        while True:
-            kind, val = await asyncio.wait_for(
-                aq.get(), timeout=self.request_timeout_s
-            )
-            if kind is _CLOSE:
-                break
-            await _write_line({"chunk": val})
         code = "200"
         try:
+            while True:
+                kind, val = await asyncio.wait_for(
+                    aq.get(), timeout=self.request_timeout_s
+                )
+                if kind is _CLOSE:
+                    break
+                await _write_line({"chunk": val})
             result = await asyncio.wait_for(
                 asyncio.wrap_future(future), timeout=self.request_timeout_s
             )
             await _write_line({"result": result})
+        except asyncio.TimeoutError:
+            # The chunked header is already out — the error must arrive as a
+            # body line + clean terminator, never a truncated socket.
+            code = "504"
+            await _write_line({"error": "stream timed out"})
         except Exception as e:  # noqa: BLE001 — surface on the trailer line
             code = "500"
             await _write_line({"error": str(e)})

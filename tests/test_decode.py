@@ -236,19 +236,30 @@ class TestStreamingAndHorizon:
         assert len(dispatches) < 27
 
     def test_admission_cap_interleaves(self, lm):
-        """_admit must never start more than max_admissions_per_step
-        prefills per call, so decode steps interleave under bursts."""
+        """While slots are DECODING, _admit is capped (prefills must
+        interleave with decode steps); an idle engine ramps by filling every
+        free slot in one call (nothing to stall)."""
         engine, queue = make_engine(
             lm, num_slots=4, max_admissions_per_step=2
         )
+        # Idle ramp: all four queued requests admitted at once.
         for _ in range(4):
             submit(queue, [1, 2], max_new_tokens=4)
-        assert engine._admit() == 2
-        assert engine.active_slots == 2
-        assert engine._admit() == 2
+        assert engine._admit() == 4
         assert engine.active_slots == 4
         engine.run_until_idle()
         assert engine.completed == 4
+        # Active engine: the cap protects running slots — 3 slots are free
+        # and 3 requests wait, but only max_admissions_per_step=2 join.
+        first = submit(queue, [1, 2], max_new_tokens=6)
+        assert engine._admit() == 1          # idle again -> admitted
+        for _ in range(3):
+            submit(queue, [1, 2], max_new_tokens=4)
+        assert engine.active_slots == 1       # still decoding
+        assert engine._admit() == 2           # capped, despite 3 free slots
+        engine.run_until_idle()
+        assert engine.completed == 8
+        assert len(first.future.result(timeout=5).tokens) == 6
 
     def test_eos_mid_horizon(self, lm):
         """A slot hitting EOS inside a scan horizon stops exactly at EOS and
@@ -276,3 +287,23 @@ class TestStreamingAndHorizon:
         result = req.future.result(timeout=5)
         assert result.finish_reason == "eos"
         assert result.tokens == toks[: k + 1]
+
+
+class TestAdmissionErrors:
+    def test_bad_max_new_tokens_rejects_not_dangles(self, lm):
+        """A malformed payload discovered after dequeue must reject the
+        request's future, never leave it dangling (and must not poison the
+        rest of the admission batch)."""
+        engine, queue = make_engine(lm)
+        bad = Request(
+            model="llama_tiny",
+            payload={"tokens": np.asarray([1, 2], np.int32),
+                     "max_new_tokens": "ten"},
+            slo_ms=60_000.0,
+        )
+        queue.add_request(bad)
+        good = submit(queue, [3, 4], max_new_tokens=3)
+        engine.run_until_idle()
+        with pytest.raises(ValueError):
+            bad.future.result(timeout=5)
+        assert len(good.future.result(timeout=5).tokens) == 3
