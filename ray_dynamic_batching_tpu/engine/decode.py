@@ -123,8 +123,25 @@ class DecodeEngine:
         sample_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
         decode_horizon: int = 8,
         max_admissions_per_step: int = 2,
+        device: Optional[jax.Device] = None,
+        mesh: Optional[Any] = None,
     ):
         self.model = model
+        self.device = device
+        self.mesh = mesh
+        if mesh is not None:
+            # TP-sharded replica (BASELINE.json config 4): params sharded by
+            # the model's Megatron-style rules, KV cache sharded over kv
+            # heads (cache_pspec), decode collectives ride ICI via GSPMD —
+            # the serving analogue of the reference's NCCL allreduce swap.
+            from ray_dynamic_batching_tpu.parallel.mesh import shard_params
+
+            params = shard_params(mesh, model, params)
+        elif device is not None:
+            # Chip pinning (placement-group bundle): params live on the
+            # reserved chip; every dispatch runs under default_device so the
+            # cache and all uploads land there too.
+            params = jax.device_put(params, device)
         self.params = params
         self.queue = queue
         self.num_slots = num_slots
@@ -137,7 +154,15 @@ class DecodeEngine:
         self._sample = sample_fn or (lambda logits: jnp.argmax(logits, axis=-1))
 
         self._slots = [_Slot() for _ in range(num_slots)]
-        self._cache = model.make_cache(num_slots, max_len)
+        if mesh is not None and hasattr(model, "cache_pspec"):
+            from ray_dynamic_batching_tpu.parallel.mesh import (
+                make_sharded_cache,
+            )
+
+            self._cache = make_sharded_cache(mesh, model, num_slots, max_len)
+        else:
+            with self._device_ctx():
+                self._cache = model.make_cache(num_slots, max_len)
         self._tokens = np.zeros((num_slots, 1), dtype=np.int32)
         self._active_mask = np.zeros((num_slots,), dtype=bool)
 
@@ -155,6 +180,14 @@ class DecodeEngine:
         # SUCCESSFUL loop iterations, so a perpetually-failing _step (device
         # OOM, corrupt params) reads as a stall even though the thread lives.
         self.last_heartbeat = time.monotonic()
+
+    def _device_ctx(self):
+        """jax.default_device scope for the pinned chip (no-op unpinned)."""
+        import contextlib
+
+        if self.device is None:
+            return contextlib.nullcontext()
+        return jax.default_device(self.device)
 
     # --- compiled programs -------------------------------------------------
     def _prefill_impl(self, params, tokens, attn_mask, cache, slots):
@@ -241,6 +274,10 @@ class DecodeEngine:
     def warmup(self) -> None:
         """Compile every (prompt bucket, group size) + both decode horizons
         before serving."""
+        with self._device_ctx():
+            self._warmup_impl()
+
+    def _warmup_impl(self) -> None:
         for b in self.prompt_buckets:
             for g in self._admit_group_sizes():
                 tokens = jnp.zeros((g, b), dtype=jnp.int32)
@@ -467,30 +504,43 @@ class DecodeEngine:
         """Drive admissions + steps until queue and slots are empty (tests,
         offline batch generation)."""
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            admitted = self._admit()
-            if self._active_mask.any():
-                self._step()
-            elif not admitted and len(self.queue) == 0:
-                return
+        with self._device_ctx():
+            while time.monotonic() < deadline:
+                admitted = self._admit()
+                if self._active_mask.any():
+                    self._step()
+                elif not admitted and len(self.queue) == 0:
+                    return
         raise TimeoutError(f"{self.model.name}: decode did not drain")
 
     def _loop(self) -> None:
-        while self._run.is_set():
-            try:
-                self._admit()
-                if self._active_mask.any():
-                    self._step()
-                    ACTIVE_SLOTS.set(
-                        float(self._active_mask.sum()),
-                        tags={"model": self.model.name},
+        with self._device_ctx():
+            while self._run.is_set():
+                try:
+                    self._admit()
+                    if self._active_mask.any():
+                        self._step()
+                        ACTIVE_SLOTS.set(
+                            float(self._active_mask.sum()),
+                            tags={"model": self.model.name},
+                        )
+                    else:
+                        self.queue.wait_for_requests(self.idle_wait_s)
+                    self.last_heartbeat = time.monotonic()
+                except Exception:  # noqa: BLE001 — engine must not die silently
+                    logger.exception(
+                        "%s: decode loop iteration failed", self.model.name
                     )
-                else:
-                    self.queue.wait_for_requests(self.idle_wait_s)
-                self.last_heartbeat = time.monotonic()
-            except Exception:  # noqa: BLE001 — engine must not die silently
-                logger.exception("%s: decode loop iteration failed", self.model.name)
-                time.sleep(0.05)
+                    time.sleep(0.05)
+
+    def release_buffers(self) -> None:
+        """Drop the engine's HBM footprint (cache + params + compiled fns)
+        so a replacement replica can reuse the chip. Call only after the
+        loop has stopped; the engine is unusable afterwards."""
+        self._cache = None
+        self.params = None
+        self._prefill_fns.clear()
+        self._decode_fn = None
 
     def abort_active(self, exc: Exception) -> None:
         """Reject every request still occupying a slot (replica shutdown:
@@ -515,7 +565,16 @@ class DecodeEngine:
         self._run.clear()
         if self._thread is not None:
             self._thread.join(timeout_s)
-            self._thread = None
+            if self._thread.is_alive():
+                # Wedged in a device call: leave the handle so callers can
+                # see the thread still lives (buffer release must not happen
+                # under it).
+                logger.warning(
+                    "%s: loop thread did not exit within %.1fs",
+                    self.model.name, timeout_s,
+                )
+            else:
+                self._thread = None
 
     @property
     def active_slots(self) -> int:

@@ -158,6 +158,9 @@ class Request:
     # Present iff the caller asked for incremental delivery; producers that
     # don't stream simply never touch it (future-only contract unchanged).
     stream: Optional[TokenStream] = None
+    # Model-multiplexing hint (ref pow_2_scheduler.py:52): the router
+    # prefers replicas that already hold this model in HBM.
+    multiplexed_model_id: Optional[str] = None
 
     def __post_init__(self) -> None:
         if not self.request_id:

@@ -131,6 +131,28 @@ def shard_params(mesh: Mesh, model: ServableModel, params: Any) -> Any:
     return jax.device_put(params, shardings)
 
 
+def make_sharded_cache(
+    mesh: Mesh, model: Any, num_slots: int, max_len: Optional[int] = None
+) -> Any:
+    """Allocate a model's KV cache DIRECTLY onto the mesh per its
+    ``cache_pspec`` (kv heads over tp). The cache never materializes
+    unsharded on any single device — a cache sized to fit only when split
+    over the tp chips must not OOM chip 0 on the way in."""
+    shapes = jax.eval_shape(lambda: model.make_cache(num_slots, max_len))
+    spec = model.cache_pspec()
+    shardings = type(shapes)(
+        k=NamedSharding(mesh, _feasible_spec(spec.k, shapes.k.shape, mesh)),
+        v=NamedSharding(mesh, _feasible_spec(spec.v, shapes.v.shape, mesh)),
+        lengths=NamedSharding(
+            mesh, _feasible_spec(spec.lengths, shapes.lengths.shape, mesh)
+        ),
+    )
+    return jax.jit(
+        lambda: model.make_cache(num_slots, max_len),
+        out_shardings=shardings,
+    )()
+
+
 def replicate(mesh: Mesh, tree: Any) -> Any:
     sharding = NamedSharding(mesh, P())
     return jax.device_put(tree, sharding)

@@ -21,9 +21,13 @@ import json
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_dynamic_batching_tpu.engine.request import RequestDropped
+from ray_dynamic_batching_tpu.parallel.placement import (
+    PlacementError,
+    PlacementManager,
+)
 from ray_dynamic_batching_tpu.runtime.kv import KVStore
 from ray_dynamic_batching_tpu.serve.autoscaling import (
     AutoscalingConfig,
@@ -42,7 +46,14 @@ REPLICA_SET_KEY = "serve:replicas:{deployment}"
 
 @dataclass
 class DeploymentConfig:
-    """Deployment contract (ref @serve.deployment options + config.py)."""
+    """Deployment contract (ref @serve.deployment options + config.py).
+
+    ``chips_per_replica > 0`` makes every replica acquire its chips through
+    a placement group before starting (ref: Serve's deployment scheduler
+    places replica actors via PGs — ``_private/deployment_scheduler.py``,
+    ``gcs_placement_group_scheduler.cc``); ``placement_strategy`` is one of
+    PACK/SPREAD/STRICT_PACK/STRICT_SPREAD.
+    """
 
     name: str
     num_replicas: int = 1
@@ -52,6 +63,8 @@ class DeploymentConfig:
     max_restarts: int = 3
     autoscaling: Optional[AutoscalingConfig] = None
     user_config: Dict[str, Any] = field(default_factory=dict)
+    chips_per_replica: int = 0          # 0 = no chip reservation
+    placement_strategy: str = "PACK"
 
     def to_json(self) -> Dict[str, Any]:
         d = {
@@ -62,6 +75,8 @@ class DeploymentConfig:
             "max_ongoing_requests": self.max_ongoing_requests,
             "max_restarts": self.max_restarts,
             "user_config": self.user_config,
+            "chips_per_replica": self.chips_per_replica,
+            "placement_strategy": self.placement_strategy,
         }
         if self.autoscaling is not None:
             d["autoscaling"] = vars(self.autoscaling)
@@ -88,6 +103,8 @@ class _DeploymentState:
     restarts: int = 0
     next_replica_ordinal: int = 0
     unhealthy: bool = False  # restart budget spent; held until redeploy
+    # replica_id -> its placement group (only when chips_per_replica > 0)
+    pgroups: Dict[str, Any] = field(default_factory=dict)
 
 
 class ServeController:
@@ -98,9 +115,11 @@ class ServeController:
         kv: Optional[KVStore] = None,
         long_poll: Optional[LongPollHost] = None,
         control_interval_s: float = 0.5,
+        placement: Optional[PlacementManager] = None,
     ) -> None:
         self.kv = kv or KVStore()
         self.long_poll = long_poll or LongPollHost()
+        self.placement = placement
         self.control_interval_s = control_interval_s
         self._deployments: Dict[str, _DeploymentState] = {}
         self._factories: Dict[str, Callable] = {}
@@ -174,6 +193,7 @@ class ServeController:
             self._checkpoint()
         for r in victims:  # blocking drains outside the lock
             r.stop()
+            self._release_chips(state, r)
 
     def get_router(self, name: str) -> Router:
         with self._lock:
@@ -188,44 +208,79 @@ class ServeController:
         cfg = state.config
         rid = f"{cfg.name}#{state.next_replica_ordinal}"
         state.next_replica_ordinal += 1
-        factory = state.factory
-        if hasattr(factory, "make_replica"):
-            # Deployment owns its replica class (e.g. serve.llm.LLMReplica
-            # wrapping a decode engine) — mirror of the reference where
-            # deployment target state carries the replica actor definition.
-            replica = factory.make_replica(rid, cfg)
-        else:
-            replica = Replica(
-                replica_id=rid,
-                deployment=cfg.name,
-                fn=factory(),
-                max_batch_size=cfg.max_batch_size,
-                batch_wait_timeout_s=cfg.batch_wait_timeout_s,
-                max_ongoing_requests=cfg.max_ongoing_requests,
+        # Gang-acquire chips BEFORE building the replica (ref: the
+        # deployment scheduler waits on the PG, then places the actor in it
+        # — deployment_scheduler.py / gcs_placement_group_scheduler.cc).
+        pg = None
+        devices = None
+        if cfg.chips_per_replica > 0:
+            if self.placement is None:
+                raise RuntimeError(
+                    f"{cfg.name}: chips_per_replica={cfg.chips_per_replica} "
+                    "requires a PlacementManager on the controller"
+                )
+            from ray_dynamic_batching_tpu.parallel.placement import Bundle
+
+            pg = self.placement.create(
+                [Bundle(chips=cfg.chips_per_replica)],
+                strategy=cfg.placement_strategy,
             )
-        replica.start()
-        logger.info("started replica %s", rid)
+            devices = pg.bundle_devices(0)
+        try:
+            factory = state.factory
+            if hasattr(factory, "make_replica"):
+                # Deployment owns its replica class (e.g. serve.llm.LLMReplica
+                # wrapping a decode engine) — mirror of the reference where
+                # deployment target state carries the replica actor definition.
+                if devices is not None:
+                    replica = factory.make_replica(rid, cfg, devices=devices)
+                else:
+                    replica = factory.make_replica(rid, cfg)
+            else:
+                replica = Replica(
+                    replica_id=rid,
+                    deployment=cfg.name,
+                    fn=factory(),
+                    max_batch_size=cfg.max_batch_size,
+                    batch_wait_timeout_s=cfg.batch_wait_timeout_s,
+                    max_ongoing_requests=cfg.max_ongoing_requests,
+                )
+                if devices is not None:
+                    replica.devices = devices
+            replica.start()
+        except Exception:
+            if pg is not None:  # failed start must not leak reserved chips
+                self.placement.remove(pg)
+            raise
+        if pg is not None:
+            state.pgroups[rid] = pg
+        logger.info(
+            "started replica %s%s", rid,
+            f" on chips {[str(d) for d in devices]}" if devices else "",
+        )
         return replica
 
-    def _retire(
-        self, victim: Replica, replacement: Optional[Replica]
+    def _release_chips(self, state: _DeploymentState, replica: Replica) -> None:
+        pg = state.pgroups.pop(replica.replica_id, None)
+        if pg is not None and self.placement is not None:
+            self.placement.remove(pg)
+
+    def _redeliver(
+        self,
+        requests: List[Any],
+        targets: List[Replica],
+        victim_id: str,
     ) -> None:
-        """Stop a victim OUTSIDE the controller lock, salvaging its queued
-        requests onto the replacement (terminal rejection belongs to the
-        router, not the heal path)."""
-        if replacement is not None:
-            for req in victim.drain_queue():
-                if not replacement.assign(req):
-                    req.reject(
-                        RequestDropped(
-                            f"{victim.replica_id} retired and replacement "
-                            "saturated"
-                        )
+        """Salvage a retired replica's queued requests onto live replicas
+        (terminal rejection belongs to the router, not the heal path)."""
+        for req in requests:
+            if not any(t.assign(req) for t in targets if t.accepting()):
+                req.reject(
+                    RequestDropped(
+                        f"{victim_id} retired and no replica accepted its "
+                        "queued work"
                     )
-        # The victim's loop is dead or wedged (that's why it's being retired),
-        # so drain-waiting would just burn the full stop timeout before the
-        # leftover queue is rejected — stop immediately instead.
-        victim.stop(drain=False)
+                )
 
     def _reconcile(self, state: _DeploymentState) -> List[Callable[[], None]]:
         """Drive actual replica count to target; replace unhealthy.
@@ -243,11 +298,28 @@ class ServeController:
                 alive.append(r)
                 continue
             logger.warning("replica %s unhealthy; replacing", r.replica_id)
+            # Salvage queued work, then stop the victim INLINE (its loop is
+            # dead or wedged, so the join is bounded) — the replacement may
+            # land on the same chips, which must be genuinely free: chip
+            # reservation released AND, for engines, HBM buffers dropped
+            # (LLMReplica.stop releases them once the loop has exited).
+            salvaged = r.drain_queue()
+            r.stop(timeout_s=2.0, drain=False)
+            self._release_chips(state, r)
             replacement: Optional[Replica] = None
             if state.restarts < cfg.max_restarts:
                 state.restarts += 1
-                replacement = self._start_replica(state)
-                alive.append(replacement)
+                try:
+                    replacement = self._start_replica(state)
+                    alive.append(replacement)
+                except PlacementError as e:
+                    # Transient chip shortage is not a crash: hand the
+                    # restart back and let a later control step retry via
+                    # the scale-up loop below.
+                    state.restarts -= 1
+                    logger.warning(
+                        "%s: replacement blocked: %s", cfg.name, e
+                    )
             else:
                 state.unhealthy = True
                 logger.error(
@@ -255,19 +327,34 @@ class ServeController:
                     "unhealthy until redeployed",
                     cfg.name, cfg.max_restarts,
                 )
-            deferred.append(
-                lambda v=r, repl=replacement: self._retire(v, repl)
-            )
+            if salvaged:
+                targets = [replacement] if replacement is not None else []
+                deferred.append(
+                    lambda reqs=salvaged, t=targets, vid=r.replica_id: (
+                        self._redeliver(reqs, t or state.replicas, vid)
+                    )
+                )
         state.replicas = alive
         # Scale to target — but an exhausted restart budget stops the
         # crash-loop: no replacements until a fresh deploy() resets it
         # (ref gcs_actor_manager.cc:1361-1393 — actors stay DEAD once
         # max_restarts is spent).
         while len(state.replicas) < cfg.num_replicas and not state.unhealthy:
-            state.replicas.append(self._start_replica(state))
+            try:
+                state.replicas.append(self._start_replica(state))
+            except PlacementError as e:
+                # Not enough chips: hold at the current count and retry on
+                # later control steps (ref: the PG stays pending).
+                logger.warning("%s: scale-up blocked: %s", cfg.name, e)
+                break
         while len(state.replicas) > cfg.num_replicas:
             victim = state.replicas.pop()  # newest first, ref compact strategy
-            deferred.append(lambda v=victim: v.stop())
+            deferred.append(
+                lambda v=victim, st=state: (
+                    v.stop(),
+                    self._release_chips(st, v),
+                )
+            )
         # Publish only on membership change: every publish clears the
         # router's queue-len cache, so steady-state reconciles must be quiet.
         if [r.replica_id for r in state.replicas] != [
@@ -328,12 +415,13 @@ class ServeController:
             self._thread.join(timeout=5)
             self._thread = None
         with self._lock:
-            victims: List[Replica] = []
+            victims: List[Tuple[_DeploymentState, Replica]] = []
             for state in self._deployments.values():
-                victims.extend(state.replicas)
+                victims.extend((state, r) for r in state.replicas)
                 state.replicas = []
-        for r in victims:
+        for state, r in victims:
             r.stop()
+            self._release_chips(state, r)
 
     # --- checkpoint / recovery (ref controller.py:545, app_state:1096) ----
     def _checkpoint(self) -> None:

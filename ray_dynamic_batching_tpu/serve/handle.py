@@ -36,13 +36,17 @@ class DeploymentHandle:
         payload: Any,
         slo_ms: Optional[float] = None,
         locality_hint: Optional[str] = None,
+        multiplexed_model_id: Optional[str] = None,
     ) -> Future:
         """Route one request; the future resolves to the replica's result
-        (ref handle.py:821)."""
+        (ref handle.py:821). ``multiplexed_model_id`` steers routing toward
+        replicas already holding that model (ref handle
+        ``options(multiplexed_model_id=...)``)."""
         request = Request(
             model=self.deployment,
             payload=payload,
             slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
+            multiplexed_model_id=multiplexed_model_id,
         )
         self.router.assign_request(request, locality_hint=locality_hint)
         return request.future

@@ -94,6 +94,14 @@ class LLMReplica(Replica):
         self.engine.abort_active(exc)
         for req in self.drain_queue():
             req.reject(exc)
+        # Free HBM (params + cache) so a replacement on the same chip
+        # doesn't OOM against this replica's dead buffers — but only if the
+        # loop actually exited; a wedged device call may still be touching
+        # them, and dropping the references mid-flight trades a leak for a
+        # use-after-free-style crash.
+        t = self.engine._thread
+        if t is None or not t.is_alive():
+            self.engine.release_buffers()
 
     # --- router-facing surface --------------------------------------------
     def queue_len(self) -> int:
@@ -183,7 +191,9 @@ class LLMDeployment:
 
                 self._params = self._model.init(jax.random.PRNGKey(0))
 
-    def build_engine(self, queue: RequestQueue) -> DecodeEngine:
+    def build_engine(
+        self, queue: RequestQueue, device: Any = None, mesh: Any = None
+    ) -> DecodeEngine:
         self._ensure_model()
         return DecodeEngine(
             self._model,
@@ -196,20 +206,42 @@ class LLMDeployment:
             default_max_new_tokens=self.default_max_new_tokens,
             decode_horizon=self.decode_horizon,
             max_admissions_per_step=self.max_admissions_per_step,
+            device=device,
+            mesh=mesh,
         )
 
     # Controller protocol: factories exposing make_replica own replica
     # construction (the reference's deployment holds its replica class the
     # same way — deployment_state builds ReplicaActor from the deployment's
-    # target state).
-    def make_replica(self, replica_id: str, config: Any) -> LLMReplica:
-        return LLMReplica(
+    # target state). ``devices`` arrives from the replica's placement-group
+    # bundle when the deployment reserves chips.
+    def make_replica(
+        self, replica_id: str, config: Any, devices: Optional[Sequence] = None,
+    ) -> LLMReplica:
+        device = None
+        mesh = None
+        if devices and len(devices) > 1:
+            # Multi-chip bundle -> TP-sharded replica over its own mesh
+            # slice (replica = mesh slice, SURVEY.md §7 stage 6).
+            from ray_dynamic_batching_tpu.parallel.mesh import (
+                MeshConfig,
+                build_mesh,
+            )
+
+            mesh = build_mesh(MeshConfig(tp=len(devices)), list(devices))
+        elif devices:
+            device = devices[0]
+        replica = LLMReplica(
             replica_id=replica_id,
             deployment=config.name,
-            engine_builder=self.build_engine,
+            engine_builder=lambda q: self.build_engine(
+                q, device=device, mesh=mesh
+            ),
             max_ongoing_requests=config.max_ongoing_requests,
             warmup=self.warmup,
         )
+        replica.devices = list(devices) if devices else None
+        return replica
 
     # Legacy callable protocol (factory() -> fn) is not meaningful here.
     def __call__(self) -> Callable[[List[Any]], Sequence[Any]]:

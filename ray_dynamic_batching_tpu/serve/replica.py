@@ -73,6 +73,10 @@ class Replica:
         )
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
+        # Multiplexed models resident on this replica, most-recent last
+        # (ref replica multiplex LRU surfaced to the pow-2 scheduler).
+        self.loaded_models: List[str] = []
+        self.max_multiplexed_models = 8
         self._stopped = False
         self._run = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -97,7 +101,21 @@ class Replica:
         request stays retryable — the router owns terminal rejection."""
         if not self.accepting():
             return False
-        return self.queue.add_request(request, reject_on_full=False)
+        ok = self.queue.add_request(request, reject_on_full=False)
+        if ok and request.multiplexed_model_id:
+            self.record_multiplexed_model(request.multiplexed_model_id)
+        return ok
+
+    def record_multiplexed_model(self, model_id: str) -> None:
+        """Mark a multiplexed model resident here (LRU, bounded — evicting
+        the coldest mirrors the ref replica unloading its LRU model).
+        Locked: concurrent assigns of the same id race check-then-remove."""
+        with self._ongoing_lock:
+            if model_id in self.loaded_models:
+                self.loaded_models.remove(model_id)
+            self.loaded_models.append(model_id)
+            while len(self.loaded_models) > self.max_multiplexed_models:
+                self.loaded_models.pop(0)
 
     # --- loop -------------------------------------------------------------
     def _stream_generator_batch(

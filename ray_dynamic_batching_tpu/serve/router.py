@@ -88,12 +88,23 @@ class Router:
         return val
 
     def _choose(
-        self, candidates: List[Replica], locality_hint: Optional[str]
+        self,
+        candidates: List[Replica],
+        locality_hint: Optional[str],
+        multiplexed_model_id: Optional[str] = None,
     ) -> Optional[Replica]:
         if not candidates:
             return None
-        # Locality first: same-hint replicas tried as their own pool
-        # (ref locality-aware candidate ranking in pow_2_scheduler).
+        # Multiplexing first (ref pow_2_scheduler.py:52 candidate ranking):
+        # replicas already holding the model avoid a load/compile stall.
+        if multiplexed_model_id:
+            warm = [
+                r for r in candidates
+                if multiplexed_model_id in getattr(r, "loaded_models", ())
+            ]
+            if warm:
+                candidates = warm
+        # Locality next: same-hint replicas tried as their own pool.
         if locality_hint:
             local = [
                 r for r in candidates
@@ -118,7 +129,9 @@ class Router:
         backoff = BACKOFF_INITIAL_S
         while True:
             candidates = [r for r in self.replicas() if r.accepting()]
-            chosen = self._choose(candidates, locality_hint)
+            chosen = self._choose(
+                candidates, locality_hint, request.multiplexed_model_id
+            )
             # chaos: a dropped assignment RPC — falls into the normal
             # backoff/retry path, like a lost PushActorTask in the reference
             # (only burns budget when there was a real assignment to drop)

@@ -508,3 +508,53 @@ class TestKVStore:
         kv.put("k", "v")
         kv2 = FileKVStore(path)
         assert kv2.get("k") == "v"
+
+
+class TestMultiplexedRouting:
+    """Model-multiplex-aware pow-2 routing (ref pow_2_scheduler.py:52)."""
+
+    def _stack(self, n=2):
+        from ray_dynamic_batching_tpu.serve.replica import Replica
+        from ray_dynamic_batching_tpu.serve.router import Router
+        from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+
+        replicas = [
+            Replica(f"mux#{i}", "mux", lambda ps: ps, max_batch_size=4,
+                    batch_wait_timeout_s=0.005)
+            for i in range(n)
+        ]
+        for r in replicas:
+            r.start()
+        router = Router("mux", replicas=replicas)
+        return replicas, router, DeploymentHandle(router)
+
+    def test_warm_replica_preferred(self):
+        replicas, router, handle = self._stack()
+        try:
+            # Land model m1 somewhere; every later m1 request must follow it.
+            first = handle.remote("a", multiplexed_model_id="m1")
+            first.result(timeout=5)
+            warm = next(r for r in replicas if "m1" in r.loaded_models)
+            futs = [
+                handle.remote(f"x{i}", multiplexed_model_id="m1")
+                for i in range(8)
+            ]
+            for f in futs:
+                f.result(timeout=5)
+            cold = next(r for r in replicas if r is not warm)
+            assert "m1" not in cold.loaded_models
+        finally:
+            for r in replicas:
+                r.stop()
+
+    def test_lru_eviction_bounded(self):
+        from ray_dynamic_batching_tpu.serve.replica import Replica
+
+        r = Replica("mux#0", "mux", lambda ps: ps)
+        r.max_multiplexed_models = 3
+        for m in ["a", "b", "c", "d"]:
+            r.record_multiplexed_model(m)
+        assert r.loaded_models == ["b", "c", "d"]
+        r.record_multiplexed_model("b")  # refresh recency
+        r.record_multiplexed_model("e")
+        assert r.loaded_models == ["d", "b", "e"]

@@ -1,0 +1,120 @@
+"""TP-sharded decode serving parity (VERDICT.md weak #7 / next-round #8).
+
+The north star is a TP serving replica: llama_tiny prefill + continuous-
+batching decode under a tp>=2 mesh must produce EXACTLY the tokens of the
+single-device engine (greedy decode is deterministic; GSPMD partitioning
+must not change results), with params and KV cache actually sharded.
+Runs on the fake 8-chip CPU cluster.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+from ray_dynamic_batching_tpu.models.base import get_model
+from ray_dynamic_batching_tpu.parallel.mesh import MeshConfig, build_mesh
+from ray_dynamic_batching_tpu.serve.controller import DeploymentConfig
+from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+PROMPTS = [[5, 9, 2, 7], [3, 1, 4, 1, 5], [11, 13]]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model("llama_tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def run_engine(model, params, mesh=None, num_slots=4):
+    queue = RequestQueue(model.name, max_len=64)
+    engine = DecodeEngine(
+        model, params, queue,
+        num_slots=num_slots, max_len=64, prompt_buckets=[8],
+        default_max_new_tokens=8, decode_horizon=4, mesh=mesh,
+    )
+    reqs = []
+    for p in PROMPTS:
+        req = Request(
+            model=model.name,
+            payload={"tokens": np.asarray(p, np.int32), "max_new_tokens": 8},
+            slo_ms=60_000.0,
+        )
+        queue.add_request(req)
+        reqs.append(req)
+    engine.run_until_idle(timeout_s=120)
+    return [r.future.result(timeout=5).tokens for r in reqs]
+
+
+class TestTPDecodeParity:
+    def test_tp2_matches_single_device(self, lm, eight_devices):
+        model, params = lm
+        expect = run_engine(model, params)
+
+        mesh = build_mesh(MeshConfig(tp=2), eight_devices[:2])
+        got = run_engine(model, params, mesh=mesh)
+        assert got == expect
+
+    def test_tp2_params_and_cache_actually_sharded(self, lm, eight_devices):
+        model, params = lm
+        mesh = build_mesh(MeshConfig(tp=2), eight_devices[:2])
+        queue = RequestQueue(model.name, max_len=64)
+        engine = DecodeEngine(
+            model, params, queue,
+            num_slots=2, max_len=32, prompt_buckets=[8], mesh=mesh,
+        )
+        # At least one param leaf must be split (not fully replicated)
+        # across the two mesh devices.
+        split = [
+            leaf for leaf in jax.tree_util.tree_leaves(engine.params)
+            if len(leaf.devices()) == 2
+            and not leaf.sharding.is_fully_replicated
+        ]
+        assert split, "no parameter is TP-sharded"
+        # KV cache shards over kv heads (dim 3 of [L,B,S,K,H]).
+        assert not engine._cache.k.sharding.is_fully_replicated
+        shard_shape = engine._cache.k.sharding.shard_shape(
+            engine._cache.k.shape
+        )
+        assert shard_shape[3] == engine._cache.k.shape[3] // 2
+
+    def test_tp4_matches_single_device(self, lm, eight_devices):
+        """kv_heads=2 < tp=4: head sharding falls back feasibly, parity
+        must still hold."""
+        model, params = lm
+        expect = run_engine(model, params)
+        mesh = build_mesh(MeshConfig(tp=4), eight_devices[:4])
+        got = run_engine(model, params, mesh=mesh)
+        assert got == expect
+
+
+class TestTPDeploymentPath:
+    def test_multi_chip_bundle_builds_tp_replica(self, eight_devices):
+        """LLMDeployment with a 2-chip bundle serves through a TP mesh."""
+        dep = LLMDeployment(
+            "llama_tiny", num_slots=2, max_len=32, prompt_buckets=[8],
+            default_max_new_tokens=4, dtype=jnp.float32,
+        )
+        cfg = DeploymentConfig(name="tp_llm")
+        replica = dep.make_replica(
+            "tp#0", cfg, devices=list(eight_devices[:2])
+        )
+        replica.start()
+        try:
+            assert replica.engine.mesh is not None
+            assert replica.engine.mesh.shape["tp"] == 2
+            req = Request(
+                model="tp_llm",
+                payload={"tokens": np.asarray([1, 2, 3], np.int32),
+                         "max_new_tokens": 4},
+                slo_ms=60_000.0,
+            )
+            assert replica.assign(req)
+            assert len(req.future.result(timeout=60).tokens) == 4
+        finally:
+            replica.stop(timeout_s=1.0)

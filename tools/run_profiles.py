@@ -1,0 +1,49 @@
+"""Run the offline batch profiler on the local chip and commit the tables.
+
+Mirror of the reference's profiling runs whose committed CSVs are the
+scheduler's ground truth (``293-project/profiling/*_summary.csv``, consumed
+at ``293-project/src/scheduler.py:1019-1041``). Output lands in
+``profiles/<backend>/`` as <model>_summary.csv / _detailed.json /
+_report.txt.
+
+Usage: python tools/run_profiles.py [out_dir]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+from ray_dynamic_batching_tpu.models.base import get_model
+from ray_dynamic_batching_tpu.profiles.profiler import ModelProfiler
+
+# (model, batch buckets, seq buckets) — bucket lists sized so the full run
+# stays under ~15 min of mostly-compile time.
+PLAN = [
+    ("resnet50", [1, 8, 32, 64, 128, 256], (0,)),
+    ("shufflenet_v2", [1, 8, 32, 128, 256, 512], (0,)),
+    ("efficientnet_v2s", [1, 8, 32, 64, 128], (0,)),
+    ("vit_b_16", [1, 8, 16, 32, 64], (0,)),
+    ("distilbert_sst2", [1, 8, 32, 128], (64, 128)),
+    ("gpt2_medium", [1, 4, 8], (64, 128)),
+]
+
+
+def main(out_dir: str) -> None:
+    print(f"backend={jax.default_backend()} devices={jax.devices()}",
+          flush=True)
+    for name, batches, seqs in PLAN:
+        t0 = time.perf_counter()
+        model = get_model(name)
+        profiler = ModelProfiler(model)
+        profile = profiler.sweep(batch_buckets=batches, seq_buckets=seqs)
+        paths = profiler.write_outputs(profile, out_dir)
+        print(f"{name}: {len(profile.rows)} rows in "
+              f"{time.perf_counter() - t0:.0f}s -> {paths[0]}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "profiles/tpu_v5e")
