@@ -97,6 +97,23 @@ class ShmFrontend:
             raise RuntimeError("shm queue full: request dropped")
         return oid
 
+    def try_result(self, oid: int, delete: bool = True):
+        """Non-blocking result probe: (False, None) when not ready yet,
+        (True, value) when done; raises the engine-reported error. Lets a
+        single poller thread multiplex many outstanding oids instead of one
+        blocked ``get_result`` thread per request."""
+        result_oid = oid | _RESULT_BIT
+        data = self.store.get(result_oid)
+        if data is None:
+            return False, None
+        if delete:
+            self.store.delete(result_oid)
+            self.store.delete(oid)
+        value = _decode_value(data)
+        if isinstance(value, dict) and "__error__" in value:
+            raise RuntimeError(value["__error__"])
+        return True, value
+
     def get_result(self, oid: int, timeout_s: float = 30.0,
                    poll_s: float = 0.002, delete: bool = True) -> Any:
         """Poll the store for the result object; raises on timeout or if

@@ -320,6 +320,13 @@ class ServeController:
                     logger.warning(
                         "%s: replacement blocked: %s", cfg.name, e
                     )
+                except Exception:  # noqa: BLE001 — a failing start must not
+                    # abort the control step (deferred redeliveries of other
+                    # replicas would be dropped); the burned restart counts,
+                    # so a crash-looping factory still exhausts its budget.
+                    logger.exception(
+                        "%s: replacement start failed", cfg.name
+                    )
             else:
                 state.unhealthy = True
                 logger.error(
@@ -346,6 +353,9 @@ class ServeController:
                 # Not enough chips: hold at the current count and retry on
                 # later control steps (ref: the PG stays pending).
                 logger.warning("%s: scale-up blocked: %s", cfg.name, e)
+                break
+            except Exception:  # noqa: BLE001 — hold and retry next step
+                logger.exception("%s: replica start failed", cfg.name)
                 break
         while len(state.replicas) > cfg.num_replicas:
             victim = state.replicas.pop()  # newest first, ref compact strategy
@@ -388,7 +398,13 @@ class ServeController:
                             target, metrics["total_ongoing"],
                         )
                         state.config.num_replicas = target
-                deferred.extend(self._reconcile(state))
+                try:
+                    deferred.extend(self._reconcile(state))
+                except Exception:  # noqa: BLE001 — one deployment's failure
+                    # must not drop other deployments' deferred actions
+                    logger.exception(
+                        "%s: reconcile failed", state.config.name
+                    )
             self._checkpoint()
         for action in deferred:  # blocking stops run outside the lock
             action()

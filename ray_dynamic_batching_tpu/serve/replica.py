@@ -44,6 +44,20 @@ REPLICA_ERRORS = m.Counter(
 )
 
 
+def record_multiplexed_model_locked(
+    models: List[str], model_id: str, cap: int
+) -> None:
+    """Shared multiplex-LRU update (caller holds its own lock): refresh
+    recency, evict the coldest past ``cap`` — the ref replica unloading its
+    LRU model. Used by in-process replicas and process nodes alike so the
+    policy cannot diverge."""
+    if model_id in models:
+        models.remove(model_id)
+    models.append(model_id)
+    while len(models) > cap:
+        models.pop(0)
+
+
 class Replica:
     """One deployment replica: queue + batching loop around a user callable.
 
@@ -107,15 +121,12 @@ class Replica:
         return ok
 
     def record_multiplexed_model(self, model_id: str) -> None:
-        """Mark a multiplexed model resident here (LRU, bounded — evicting
-        the coldest mirrors the ref replica unloading its LRU model).
-        Locked: concurrent assigns of the same id race check-then-remove."""
+        """Mark a multiplexed model resident here. Locked: concurrent
+        assigns of the same id race check-then-remove."""
         with self._ongoing_lock:
-            if model_id in self.loaded_models:
-                self.loaded_models.remove(model_id)
-            self.loaded_models.append(model_id)
-            while len(self.loaded_models) > self.max_multiplexed_models:
-                self.loaded_models.pop(0)
+            record_multiplexed_model_locked(
+                self.loaded_models, model_id, self.max_multiplexed_models
+            )
 
     # --- loop -------------------------------------------------------------
     def _stream_generator_batch(

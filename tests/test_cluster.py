@@ -1,0 +1,161 @@
+"""Two-host control-plane demo (VERDICT.md missing #6 / next-round #10).
+
+Head process (this test) runs controller + router + HTTP ingress; worker
+"nodes" are REAL spawned processes serving over the C++ shm substrate (ref
+analogue: ``python/ray/cluster_utils.py:135`` — multiple raylets as local
+processes). Verifies cross-process serving, heartbeat-based failure
+detection, and replica failover through the UNCHANGED controller heal path.
+"""
+
+import json
+import signal
+import socket
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.runtime.cluster import (
+    ProcessDeployment,
+    ProcessReplica,
+)
+from ray_dynamic_batching_tpu.serve.controller import (
+    DeploymentConfig,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+
+ECHO = "ray_dynamic_batching_tpu.runtime.cluster:demo_echo_factory"
+DOUBLE = "ray_dynamic_batching_tpu.runtime.cluster:demo_double_factory"
+
+
+@pytest.mark.timeout(120)
+class TestProcessNode:
+    def test_cross_process_roundtrip(self, tmp_path):
+        replica = ProcessReplica(
+            "node#0", "echo", ECHO, str(tmp_path),
+        )
+        try:
+            from ray_dynamic_batching_tpu.engine.request import Request
+
+            assert replica.wait_ready(30)
+            req = Request(model="echo", payload=[1, 2, 3], slo_ms=10_000.0)
+            assert replica.assign(req)
+            assert req.future.result(timeout=15) == [1, 2, 3]
+            assert replica.healthy()
+        finally:
+            replica.stop(timeout_s=2.0)
+        assert not replica.healthy()
+
+    def test_killed_node_detected(self, tmp_path):
+        replica = ProcessReplica(
+            "node#1", "echo", ECHO, str(tmp_path),
+            heartbeat_stale_s=0.5,
+        )
+        try:
+            assert replica.wait_ready(30)
+            assert replica.healthy()
+            replica.process.kill()
+            deadline = time.monotonic() + 5
+            while replica.healthy() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert not replica.healthy()
+        finally:
+            replica.stop(timeout_s=1.0)
+
+
+@pytest.mark.timeout(180)
+class TestTwoHostServing:
+    def test_controller_serves_and_fails_over_across_processes(
+        self, tmp_path
+    ):
+        """Head: controller+router. Worker: separate process. SIGKILL the
+        worker mid-service; the controller's standard heal path replaces
+        the node and serving resumes."""
+        controller = ServeController(control_interval_s=0.1)
+        dep = ProcessDeployment(
+            DOUBLE, str(tmp_path), heartbeat_stale_s=0.5,
+            result_timeout_s=10.0,
+        )
+        router = controller.deploy(
+            DeploymentConfig(name="double", num_replicas=2, max_restarts=3),
+            factory=dep,
+        )
+        controller.start()
+        handle = DeploymentHandle(router, default_slo_ms=15_000.0)
+        try:
+            for r in controller._deployments["double"].replicas:
+                assert r.wait_ready(30)
+            futs = [handle.remote(i) for i in range(8)]
+            assert [f.result(timeout=20) for f in futs] == [
+                i * 2 for i in range(8)
+            ]
+            victims = list(controller._deployments["double"].replicas)
+            pids_before = {v.process.pid for v in victims}
+            # Hard-kill one node (SIGKILL: no cleanup, like a node crash).
+            victims[0].process.kill()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                status = controller.status()["double"]
+                live = controller._deployments["double"].replicas
+                if (
+                    status["running_replicas"] == 2
+                    and all(r.healthy() for r in live)
+                    and {r.process.pid for r in live} != pids_before
+                ):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("controller did not replace the killed node")
+            for r in controller._deployments["double"].replicas:
+                assert r.wait_ready(30)
+            futs = [handle.remote(i) for i in range(8)]
+            assert [f.result(timeout=20) for f in futs] == [
+                i * 2 for i in range(8)
+            ]
+        finally:
+            controller.shutdown()
+
+    def test_http_ingress_to_remote_node(self, tmp_path):
+        """Full two-host path: HTTP -> proxy -> router -> shm -> worker
+        process -> shm -> proxy -> HTTP."""
+        from ray_dynamic_batching_tpu.serve.proxy import (
+            HTTPProxy,
+            ProxyRouter,
+        )
+
+        controller = ServeController(control_interval_s=0.2)
+        dep = ProcessDeployment(ECHO, str(tmp_path), result_timeout_s=10.0)
+        router = controller.deploy(
+            DeploymentConfig(name="echo", num_replicas=1), factory=dep,
+        )
+        prouter = ProxyRouter()
+        prouter.set_route("/api/echo", DeploymentHandle(router))
+        proxy = HTTPProxy(prouter, port=0).start()
+        try:
+            for r in controller._deployments["echo"].replicas:
+                assert r.wait_ready(30)
+            body = json.dumps({"x": [1, 2]}).encode()
+            req = (
+                f"POST /api/echo HTTP/1.1\r\nHost: h\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode() + body
+            with socket.create_connection(
+                (proxy.host, proxy.port), timeout=20
+            ) as s:
+                s.sendall(req)
+                s.settimeout(20)
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    buf += s.recv(65536)
+                head, rest = buf.split(b"\r\n\r\n", 1)
+                want = int(
+                    [l for l in head.decode().split("\r\n")
+                     if l.lower().startswith("content-length")][0]
+                    .split(":")[1]
+                )
+                while len(rest) < want:
+                    rest += s.recv(65536)
+            assert json.loads(rest[:want])["result"] == {"x": [1, 2]}
+        finally:
+            proxy.stop()
+            controller.shutdown()

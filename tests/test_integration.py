@@ -136,3 +136,67 @@ class TestEndToEnd:
             client.close()
         finally:
             server.stop()
+
+
+class TestMeasuredProfileLoop:
+    @pytest.mark.timeout(300)
+    def test_profile_plan_serve_with_measured_tables(self, tmp_path):
+        """The FULL profile loop on real measurements (VERDICT next-round
+        #5): ModelProfiler sweeps the model (same code path as the
+        committed TPU tables), the measured BatchProfile round-trips
+        through the CSV contract, SquishyBinPacker plans from it, and the
+        planned schedule serves a Poisson load with SLO compliance
+        asserted (ref: committed profiling CSVs consumed at
+        293-project/src/scheduler.py:1019-1041)."""
+        from ray_dynamic_batching_tpu.profiles.profiler import ModelProfiler
+        from ray_dynamic_batching_tpu.models.base import get_model
+
+        set_config(RDBConfig.from_env(slo_safety_factor=1.0))
+        model = get_model("distilbert_tiny", dtype=jnp.float32)
+        profiler = ModelProfiler(model, timing_iters=3)
+        measured = profiler.sweep(batch_buckets=[1, 2, 4, 8],
+                                  seq_buckets=(16,))
+        assert len(measured.rows) == 4
+        # Persist + reload through the committed-table contract.
+        csv_path, _, _ = profiler.write_outputs(measured, str(tmp_path))
+        reloaded = BatchProfile.from_csv("distilbert_tiny", csv_path)
+        assert [r.batch_size for r in reloaded.rows] == [1, 2, 4, 8]
+
+        packer = SquishyBinPacker(
+            {"distilbert_tiny": reloaded}, hbm_budget_bytes=16 << 30
+        )
+        queues = QueueManager()
+        host = ModelHost(
+            model_kwargs={"distilbert_tiny": {"dtype": jnp.float32}}
+        )
+        engines = [ReplicaEngine(f"m{i}", queues, host) for i in range(2)]
+        sched = LiveScheduler(packer, engines, queues=queues)
+        slo_ms = max(200.0, 50 * reloaded.latency_ms(8, 16))
+        sched.register_model("distilbert_tiny", slo_ms=slo_ms, seq_len=16)
+        for e in engines:
+            e.start()
+        try:
+            sched.rebalance(rates={"distilbert_tiny": 30.0})
+            time.sleep(1.0)  # engine compiles the planned bucket
+            driver = WorkloadDriver(
+                submit_fn(sched),
+                model="distilbert_tiny",
+                pattern=RatePattern(kind="constant", base_rps=30),
+                duration_s=2.0,
+                poisson=True,
+            )
+            driver.start()
+            driver.join(timeout_s=30)
+            q = queues.queue("distilbert_tiny")
+            deadline = time.monotonic() + 20
+            while len(q) > 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            time.sleep(0.3)
+            stats = q.stats()
+            assert driver.sent > 20
+            assert stats["completed"] >= driver.sent * 0.9, stats
+            assert stats["slo_compliance"] >= 0.95, stats
+        finally:
+            for e in engines:
+                e.stop()
+            sched.stop_monitoring()
