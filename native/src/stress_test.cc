@@ -1,0 +1,245 @@
+// Threaded stress driver for the native substrate, built with and without
+// ThreadSanitizer (`make -C native stress tsan`). The reference runs its C++
+// under TSAN/ASAN in CI (SURVEY.md §4.2 — .bazelrc configs); this driver is
+// that race-detection pass for the shm queue, object store, KV+watch, actor
+// runtime, and health registry: many producer/consumer threads hammering
+// each component, with invariant checks on exit. Compiled TOGETHER with
+// rdb_native.cc so TSAN instruments the substrate itself.
+//
+// Exit code 0 = all invariants held (TSAN reports additionally fail the
+// run via its own non-zero exit under halt_on_error / default abort).
+
+#include <atomic>
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+// Implemented in rdb_native.cc (same binary; see extern "C" block there).
+struct rdb_queue;
+struct rdb_store;
+struct rdb_kv;
+struct rdb_actors;
+struct rdb_health;
+typedef int (*rdb_actor_fn)(uint64_t, const uint8_t*, uint32_t, void*);
+extern "C" {
+rdb_queue* rdb_queue_create(const char*, uint32_t, uint32_t);
+int rdb_queue_push(rdb_queue*, const uint8_t*, uint32_t);
+int rdb_queue_pop_batch(rdb_queue*, uint8_t*, uint32_t, uint32_t*, int);
+uint32_t rdb_queue_size(rdb_queue*);
+uint64_t rdb_queue_dropped(rdb_queue*);
+void rdb_queue_close(rdb_queue*, int);
+rdb_store* rdb_store_create(const char*, uint64_t, uint32_t);
+int64_t rdb_store_put(rdb_store*, uint64_t, const uint8_t*, uint64_t);
+int64_t rdb_store_get(rdb_store*, uint64_t, uint8_t*, uint64_t);
+int rdb_store_delete(rdb_store*, uint64_t);
+void rdb_store_close(rdb_store*, int);
+rdb_kv* rdb_kv_create();
+void rdb_kv_destroy(rdb_kv*);
+uint64_t rdb_kv_put(rdb_kv*, const char*, const uint8_t*, uint32_t);
+int64_t rdb_kv_get(rdb_kv*, const char*, uint8_t*, uint32_t, uint64_t*);
+uint64_t rdb_kv_watch(rdb_kv*, const char*, uint64_t, int);
+rdb_actors* rdb_actors_create(uint32_t);
+uint64_t rdb_actor_register(rdb_actors*, const char*, rdb_actor_fn, void*,
+                            uint32_t, uint32_t);
+int rdb_actor_post(rdb_actors*, uint64_t, const uint8_t*, uint32_t);
+int rdb_actors_drain(rdb_actors*, int);
+uint64_t rdb_actor_processed(rdb_actors*, uint64_t);
+void rdb_actors_destroy(rdb_actors*);
+rdb_health* rdb_health_create(double);
+void rdb_health_destroy(rdb_health*);
+void rdb_health_report(rdb_health*, const char*);
+int rdb_health_alive_count(rdb_health*);
+}
+
+namespace {
+
+constexpr int kProducers = 4;
+constexpr int kConsumers = 4;
+constexpr int kItemsPerProducer = 5000;
+
+int stress_queue() {
+  rdb_queue* q = rdb_queue_create("rdb-stress-q", 256, 64);
+  assert(q);
+  std::atomic<uint64_t> pushed{0}, popped{0};
+  std::atomic<bool> done{false};
+  std::vector<std::thread> ts;
+  for (int p = 0; p < kProducers; p++) {
+    ts.emplace_back([&, p] {
+      uint8_t buf[64];
+      for (int i = 0; i < kItemsPerProducer; i++) {
+        std::snprintf(reinterpret_cast<char*>(buf), sizeof buf, "p%d-%d", p, i);
+        while (rdb_queue_push(q, buf, 16) != 0) {
+          std::this_thread::yield();  // full: spin until a consumer drains
+        }
+        pushed++;
+      }
+    });
+  }
+  for (int c = 0; c < kConsumers; c++) {
+    ts.emplace_back([&] {
+      std::vector<uint8_t> out(32 * 64);
+      uint32_t lens[32];
+      while (!done.load() || rdb_queue_size(q) > 0) {
+        int n = rdb_queue_pop_batch(q, out.data(), 32, lens, 10);
+        if (n > 0) popped += n;
+      }
+    });
+  }
+  for (int p = 0; p < kProducers; p++) ts[p].join();
+  done = true;
+  for (size_t i = kProducers; i < ts.size(); i++) ts[i].join();
+  uint64_t want = uint64_t(kProducers) * kItemsPerProducer;
+  // dropped counts full-queue REJECTIONS (each retried by the producers),
+  // so it is informational; the invariant is exactly-once delivery.
+  bool ok = pushed == want && popped == want;
+  std::printf("queue: pushed=%lu popped=%lu dropped=%lu %s\n",
+              (unsigned long)pushed.load(), (unsigned long)popped.load(),
+              (unsigned long)rdb_queue_dropped(q), ok ? "OK" : "FAIL");
+  rdb_queue_close(q, 1);
+  return ok ? 0 : 1;
+}
+
+int stress_store() {
+  rdb_store* s = rdb_store_create("rdb-stress-s", 8 << 20, 4096);
+  assert(s);
+  std::atomic<uint64_t> puts{0}, hits{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 6; t++) {
+    ts.emplace_back([&, t] {
+      uint8_t val[512];
+      std::memset(val, 0x40 + t, sizeof val);
+      uint8_t out[512];
+      for (int i = 0; i < 3000; i++) {
+        uint64_t oid = uint64_t(t) * 1000000 + i;
+        if (rdb_store_put(s, oid, val, sizeof val) == (int64_t)sizeof val) puts++;
+        if (rdb_store_get(s, oid, out, sizeof out) == (int64_t)sizeof val) hits++;
+        if (i % 3 == 0) rdb_store_delete(s, oid);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  bool ok = puts > 0 && hits > 0;
+  std::printf("store: puts=%lu hits=%lu %s\n", (unsigned long)puts.load(),
+              (unsigned long)hits.load(), ok ? "OK" : "FAIL");
+  rdb_store_close(s, 1);
+  return ok ? 0 : 1;
+}
+
+int stress_kv() {
+  rdb_kv* kv = rdb_kv_create();
+  std::atomic<bool> done{false};
+  std::atomic<uint64_t> writes{0}, wakeups{0};
+  std::vector<std::thread> ts;
+  for (int w = 0; w < 3; w++) {
+    ts.emplace_back([&, w] {
+      char key[32];
+      uint8_t val[64];
+      for (int i = 0; i < 4000; i++) {
+        std::snprintf(key, sizeof key, "k%d", i % 16);
+        std::snprintf(reinterpret_cast<char*>(val), sizeof val, "w%d-%d", w, i);
+        rdb_kv_put(kv, key, val, 16);
+        writes++;
+      }
+    });
+  }
+  for (int r = 0; r < 3; r++) {
+    ts.emplace_back([&] {
+      uint64_t have = 0;
+      while (!done.load()) {
+        uint64_t v = rdb_kv_watch(kv, "k3", have, 50);
+        if (v > have) {
+          have = v;
+          wakeups++;
+        }
+      }
+    });
+  }
+  for (int w = 0; w < 3; w++) ts[w].join();
+  done = true;
+  for (size_t i = 3; i < ts.size(); i++) ts[i].join();
+  bool ok = writes == 12000 && wakeups > 0;
+  std::printf("kv: writes=%lu watch_wakeups=%lu %s\n",
+              (unsigned long)writes.load(), (unsigned long)wakeups.load(),
+              ok ? "OK" : "FAIL");
+  rdb_kv_destroy(kv);
+  return ok ? 0 : 1;
+}
+
+std::atomic<uint64_t> g_actor_calls{0};
+
+int actor_fn(uint64_t, const uint8_t*, uint32_t, void*) {
+  g_actor_calls++;
+  return 0;
+}
+
+int stress_actors() {
+  rdb_actors* rt = rdb_actors_create(4);
+  std::vector<uint64_t> ids;
+  for (int a = 0; a < 8; a++) {
+    char name[16];
+    std::snprintf(name, sizeof name, "a%d", a);
+    ids.push_back(rdb_actor_register(rt, name, actor_fn, nullptr, 128, 0));
+  }
+  std::atomic<uint64_t> posted{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 6; t++) {
+    ts.emplace_back([&, t] {
+      uint8_t msg[8] = {1};
+      for (int i = 0; i < 2000; i++) {
+        uint64_t id = ids[(t + i) % ids.size()];
+        while (rdb_actor_post(rt, id, msg, sizeof msg) != 0) {
+          std::this_thread::yield();  // mailbox full: backpressure
+        }
+        posted++;
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  int drained = rdb_actors_drain(rt, 10000);  // 0 == drained
+  uint64_t processed = 0;
+  for (uint64_t id : ids) processed += rdb_actor_processed(rt, id);
+  bool ok = drained == 0 && posted == 12000 && processed == 12000 &&
+            g_actor_calls == 12000;
+  std::printf("actors: posted=%lu processed=%lu calls=%lu %s\n",
+              (unsigned long)posted.load(), (unsigned long)processed,
+              (unsigned long)g_actor_calls.load(), ok ? "OK" : "FAIL");
+  rdb_actors_destroy(rt);
+  return ok ? 0 : 1;
+}
+
+int stress_health() {
+  rdb_health* h = rdb_health_create(5.0);
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++) {
+    ts.emplace_back([&, t] {
+      char node[16];
+      for (int i = 0; i < 2000; i++) {
+        std::snprintf(node, sizeof node, "n%d", (t + i) % 8);
+        rdb_health_report(h, node);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  bool ok = rdb_health_alive_count(h) == 8;
+  std::printf("health: alive=%d %s\n", rdb_health_alive_count(h),
+              ok ? "OK" : "FAIL");
+  rdb_health_destroy(h);
+  return ok ? 0 : 1;
+}
+
+}  // namespace
+
+int main() {
+  int rc = 0;
+  rc |= stress_queue();
+  rc |= stress_store();
+  rc |= stress_kv();
+  rc |= stress_actors();
+  rc |= stress_health();
+  std::printf(rc == 0 ? "ALL OK\n" : "FAILURES\n");
+  return rc;
+}

@@ -1,0 +1,233 @@
+"""gRPC ingress proxy — the second front door.
+
+Re-creates Ray Serve's ``gRPCProxy`` (``_private/proxy.py:558``): the same
+route table and deployment handles as the HTTP proxy, behind gRPC. The
+environment ships ``grpcio`` but no protoc codegen plugin, so the service
+is registered through grpc's generic-handler API with JSON messages —
+schema-light, but the full gRPC machinery (HTTP/2 transport, deadlines,
+streaming, status codes) is real:
+
+- ``/rdb.Serve/Predict``        unary-unary   {"deployment", "payload", ...}
+  → {"result": ...}
+- ``/rdb.Serve/PredictStream``  unary-stream  one message per streamed
+  chunk, then {"result": ...} (token streaming, ref proxy.py:959)
+- ``/rdb.Serve/Healthz``        unary-unary   liveness
+
+Deployment resolution reuses :class:`ProxyRouter` with the HTTP path
+convention (``/api/{deployment}``), so both proxies share one route table.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures as cf
+from typing import Any, Iterator, Optional
+
+from ray_dynamic_batching_tpu.engine.request import StreamClosed
+from ray_dynamic_batching_tpu.serve.proxy import ProxyRouter, _to_jsonable
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils import metrics as m
+
+logger = get_logger("grpc_proxy")
+
+GRPC_REQUESTS = m.Counter(
+    "rdb_grpc_requests_total", "gRPC requests", tag_keys=("method", "code")
+)
+
+try:  # grpcio is present in the image; gate anyway (env contract)
+    import grpc
+
+    HAVE_GRPC = True
+except ImportError:  # pragma: no cover - exercised only without grpcio
+    grpc = None
+    HAVE_GRPC = False
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class GRPCProxy:
+    """gRPC server bridging the shared route table to deployment handles."""
+
+    def __init__(
+        self,
+        router: ProxyRouter,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout_s: float = 60.0,
+        max_workers: int = 16,
+    ) -> None:
+        if not HAVE_GRPC:
+            raise RuntimeError("grpcio is not installed")
+        self.router = router
+        self.host = host
+        self.port = port
+        self.request_timeout_s = request_timeout_s
+        self._server: Optional["grpc.Server"] = None
+        self._max_workers = max_workers
+
+    # --- handlers ----------------------------------------------------------
+    def _resolve(self, body: dict):
+        deployment = body.get("deployment")
+        if not deployment:
+            return None, 'missing "deployment"'
+        matched = self.router.match(f"/api/{deployment}")
+        if matched is None:
+            return None, f"no route for deployment {deployment!r}"
+        return matched[1], None
+
+    def _predict(self, request: bytes, context) -> bytes:
+        try:
+            body = json.loads(request or b"{}")
+        except json.JSONDecodeError as e:
+            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "INVALID"})
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad JSON: {e}")
+        handle, err = self._resolve(body)
+        if handle is None:
+            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "NOT_FOUND"})
+            context.abort(grpc.StatusCode.NOT_FOUND, err)
+        future = handle.remote(
+            body.get("payload"),
+            slo_ms=body.get("slo_ms"),
+            multiplexed_model_id=body.get("multiplexed_model_id"),
+        )
+        timeout = min(
+            self.request_timeout_s,
+            max(0.001, context.time_remaining() or self.request_timeout_s),
+        )
+        try:
+            result = future.result(timeout=timeout)
+        except TimeoutError:
+            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "DEADLINE"})
+            context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out"
+            )
+        except Exception as e:  # noqa: BLE001 — replica errors -> INTERNAL
+            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "INTERNAL"})
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "OK"})
+        return json.dumps({"result": _to_jsonable(result)}).encode()
+
+    def _predict_stream(
+        self, request: bytes, context
+    ) -> Iterator[bytes]:
+        try:
+            body = json.loads(request or b"{}")
+        except json.JSONDecodeError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad JSON: {e}")
+        handle, err = self._resolve(body)
+        if handle is None:
+            GRPC_REQUESTS.inc(
+                tags={"method": "PredictStream", "code": "NOT_FOUND"}
+            )
+            context.abort(grpc.StatusCode.NOT_FOUND, err)
+        stream, future = handle.remote_stream(
+            body.get("payload"), slo_ms=body.get("slo_ms")
+        )
+        while True:
+            try:
+                chunk = stream.get(timeout_s=self.request_timeout_s)
+            except StreamClosed:
+                break
+            except Exception:  # noqa: BLE001 — error lands on the trailer
+                break
+            yield json.dumps({"chunk": _to_jsonable(chunk)}).encode()
+        try:
+            result = future.result(timeout=self.request_timeout_s)
+            yield json.dumps({"result": _to_jsonable(result)}).encode()
+            GRPC_REQUESTS.inc(tags={"method": "PredictStream", "code": "OK"})
+        except Exception as e:  # noqa: BLE001
+            GRPC_REQUESTS.inc(
+                tags={"method": "PredictStream", "code": "INTERNAL"}
+            )
+            yield json.dumps({"error": str(e)}).encode()
+
+    def _healthz(self, request: bytes, context) -> bytes:
+        return json.dumps({"status": "ok"}).encode()
+
+    # --- lifecycle ---------------------------------------------------------
+    def start(self) -> "GRPCProxy":
+        rpcs = {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                self._predict,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+            "PredictStream": grpc.unary_stream_rpc_method_handler(
+                self._predict_stream,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+            "Healthz": grpc.unary_unary_rpc_method_handler(
+                self._healthz,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+        }
+        self._server = grpc.server(
+            cf.ThreadPoolExecutor(
+                max_workers=self._max_workers,
+                thread_name_prefix="grpc-proxy",
+            )
+        )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler("rdb.Serve", rpcs),)
+        )
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        if self.port == 0:
+            raise RuntimeError("grpc proxy failed to bind")
+        self._server.start()
+        logger.info("grpc proxy listening on %s:%d", self.host, self.port)
+        return self
+
+    def stop(self, grace_s: float = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace_s).wait(grace_s + 1)
+            self._server = None
+
+
+class GRPCIngressClient:
+    """Minimal client for the generic service (tests, load generators)."""
+
+    def __init__(self, host: str, port: int):
+        if not HAVE_GRPC:
+            raise RuntimeError("grpcio is not installed")
+        self.channel = grpc.insecure_channel(f"{host}:{port}")
+        self._predict = self.channel.unary_unary(
+            "/rdb.Serve/Predict",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        self._predict_stream = self.channel.unary_stream(
+            "/rdb.Serve/PredictStream",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        self._healthz = self.channel.unary_unary(
+            "/rdb.Serve/Healthz",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+
+    def predict(self, deployment: str, payload: Any,
+                timeout_s: float = 30.0, **opts) -> Any:
+        body = json.dumps(
+            {"deployment": deployment, "payload": payload, **opts}
+        ).encode()
+        resp = self._predict(body, timeout=timeout_s)
+        return json.loads(resp)["result"]
+
+    def predict_stream(self, deployment: str, payload: Any,
+                       timeout_s: float = 30.0) -> Iterator[dict]:
+        body = json.dumps(
+            {"deployment": deployment, "payload": payload}
+        ).encode()
+        for msg in self._predict_stream(body, timeout=timeout_s):
+            yield json.loads(msg)
+
+    def healthz(self, timeout_s: float = 5.0) -> dict:
+        return json.loads(self._healthz(b"{}", timeout=timeout_s))
+
+    def close(self) -> None:
+        self.channel.close()

@@ -1,0 +1,76 @@
+"""gRPC ingress tests (VERDICT.md missing #4; ref gRPCProxy, proxy.py:558).
+
+Same route table as the HTTP proxy; unary and server-streaming paths,
+status-code mapping, and LLM token streaming end to end.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from ray_dynamic_batching_tpu.serve.controller import (  # noqa: E402
+    DeploymentConfig,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.grpc_proxy import (  # noqa: E402
+    GRPCIngressClient,
+    GRPCProxy,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle  # noqa: E402
+from ray_dynamic_batching_tpu.serve.llm import LLMDeployment  # noqa: E402
+from ray_dynamic_batching_tpu.serve.proxy import ProxyRouter  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def stack():
+    controller = ServeController(control_interval_s=0.2)
+    controller.deploy(
+        DeploymentConfig(name="echo"), factory=lambda: lambda ps: ps,
+    )
+    llm = LLMDeployment(
+        "llama_tiny", num_slots=2, max_len=32, prompt_buckets=[8],
+        default_max_new_tokens=4, dtype=jnp.float32,
+    )
+    controller.deploy(DeploymentConfig(name="lm"), factory=llm)
+    prouter = ProxyRouter()
+    prouter.set_route("/api/echo", DeploymentHandle(
+        controller.get_router("echo")))
+    prouter.set_route("/api/lm", DeploymentHandle(
+        controller.get_router("lm")))
+    proxy = GRPCProxy(prouter, port=0).start()
+    client = GRPCIngressClient(proxy.host, proxy.port)
+    yield client
+    client.close()
+    proxy.stop()
+    controller.shutdown()
+
+
+class TestGRPCProxy:
+    def test_healthz(self, stack):
+        assert stack.healthz() == {"status": "ok"}
+
+    def test_unary_predict(self, stack):
+        assert stack.predict("echo", {"a": [1, 2]}) == {"a": [1, 2]}
+
+    def test_unknown_deployment_not_found(self, stack):
+        with pytest.raises(grpc.RpcError) as e:
+            stack.predict("nope", 1)
+        assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_llm_unary(self, stack):
+        result = stack.predict(
+            "lm", {"tokens": [1, 2, 3], "max_new_tokens": 4}, timeout_s=60
+        )
+        assert len(result["tokens"]) == 4
+
+    def test_llm_streaming(self, stack):
+        msgs = list(stack.predict_stream(
+            "lm", {"tokens": [1, 2, 3], "max_new_tokens": 4},
+            timeout_s=60,
+        ))
+        chunks = [mm["chunk"] for mm in msgs if "chunk" in mm]
+        finals = [mm for mm in msgs if "result" in mm]
+        assert len(finals) == 1
+        assert chunks == finals[0]["result"]["tokens"]
+        assert len(chunks) == 4

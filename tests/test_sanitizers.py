@@ -1,0 +1,110 @@
+"""Race-detection passes (VERDICT.md weak #8; ref SURVEY §4.2 TSAN CI).
+
+1. The C++ substrate (shm queue / object store / KV+watch / actors /
+   health) under ThreadSanitizer via the native stress driver.
+2. A threaded Python stress of the serving control plane under
+   ``-X dev`` (PYTHONDEVMODE) + faulthandler.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+
+@pytest.mark.timeout(600)
+class TestNativeSanitizers:
+    def _build(self, target: str) -> str:
+        subprocess.run(
+            ["make", "-C", NATIVE, target],
+            check=True, capture_output=True, text=True,
+        )
+        return os.path.join(NATIVE, "build",
+                            "stress_test" if target == "stress"
+                            else "stress_test_tsan")
+
+    def test_stress_plain(self):
+        binary = self._build("stress")
+        proc = subprocess.run(
+            [binary], capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ALL OK" in proc.stdout
+
+    def test_stress_tsan(self):
+        """Threaded stress with every substrate component instrumented by
+        ThreadSanitizer; any data race fails the run."""
+        binary = self._build("tsan")
+        env = dict(os.environ, TSAN_OPTIONS="halt_on_error=1")
+        proc = subprocess.run(
+            [binary], capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ThreadSanitizer" not in proc.stderr, proc.stderr
+        assert "ALL OK" in proc.stdout
+
+
+PY_STRESS = r"""
+import faulthandler, threading, time
+faulthandler.enable()
+
+from ray_dynamic_batching_tpu.serve.controller import (
+    DeploymentConfig, ServeController,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+
+controller = ServeController(control_interval_s=0.05)
+router = controller.deploy(
+    DeploymentConfig(name="echo", num_replicas=3, max_batch_size=16),
+    factory=lambda: lambda ps: ps,
+)
+controller.start()
+handle = DeploymentHandle(router, default_slo_ms=30_000.0)
+errors = []
+
+def client(tid):
+    try:
+        for i in range(200):
+            fut = handle.remote({"t": tid, "i": i},
+                                multiplexed_model_id=f"m{i % 4}")
+            assert fut.result(timeout=20) == {"t": tid, "i": i}
+    except Exception as e:
+        errors.append(e)
+
+def churner():
+    # concurrent scale up/down while clients hammer the router
+    for n in (1, 4, 2, 3):
+        controller.deploy(DeploymentConfig(
+            name="echo", num_replicas=n, max_batch_size=16))
+        time.sleep(0.2)
+
+threads = [threading.Thread(target=client, args=(t,)) for t in range(8)]
+threads.append(threading.Thread(target=churner))
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(60)
+controller.shutdown()
+assert not errors, errors[:3]
+print("PY STRESS OK")
+"""
+
+
+@pytest.mark.timeout(300)
+class TestPythonDevModeStress:
+    def test_threaded_control_plane_under_devmode(self):
+        """8 client threads + a replica-churn thread against the live
+        controller, in a -X dev interpreter (extra runtime checks, warning
+        escalation) with faulthandler armed."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, "-X", "dev", "-c", PY_STRESS],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+        assert "PY STRESS OK" in proc.stdout
