@@ -20,6 +20,7 @@ convention (``/api/{deployment}``), so both proxies share one route table.
 from __future__ import annotations
 
 import json
+import time
 from concurrent import futures as cf
 from typing import Any, Iterator, Optional
 
@@ -92,10 +93,7 @@ class GRPCProxy:
             slo_ms=body.get("slo_ms"),
             multiplexed_model_id=body.get("multiplexed_model_id"),
         )
-        timeout = min(
-            self.request_timeout_s,
-            max(0.001, context.time_remaining() or self.request_timeout_s),
-        )
+        timeout = self._budget(context)
         try:
             result = future.result(timeout=timeout)
         except TimeoutError:
@@ -109,12 +107,24 @@ class GRPCProxy:
         GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "OK"})
         return json.dumps({"result": _to_jsonable(result)}).encode()
 
+    def _budget(self, context) -> float:
+        """Remaining time budget: client deadline capped by the server
+        timeout (an already-expired deadline is a tiny positive budget, NOT
+        'no deadline' — time_remaining() == 0.0 is falsy)."""
+        tr = context.time_remaining()
+        if tr is None:
+            return self.request_timeout_s
+        return min(self.request_timeout_s, max(0.001, tr))
+
     def _predict_stream(
         self, request: bytes, context
     ) -> Iterator[bytes]:
         try:
             body = json.loads(request or b"{}")
         except json.JSONDecodeError as e:
+            GRPC_REQUESTS.inc(
+                tags={"method": "PredictStream", "code": "INVALID"}
+            )
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad JSON: {e}")
         handle, err = self._resolve(body)
         if handle is None:
@@ -125,23 +135,46 @@ class GRPCProxy:
         stream, future = handle.remote_stream(
             body.get("payload"), slo_ms=body.get("slo_ms")
         )
+        # One budget covers the WHOLE stream (chunks + trailer), so a
+        # stalled replica can't pin a worker thread for 2x the timeout.
+        deadline = time.monotonic() + self._budget(context)
+
+        def remaining() -> float:
+            return deadline - time.monotonic()
+
+        error: Optional[Exception] = None
         while True:
             try:
-                chunk = stream.get(timeout_s=self.request_timeout_s)
+                chunk = stream.get(timeout_s=max(0.001, remaining()))
             except StreamClosed:
                 break
-            except Exception:  # noqa: BLE001 — error lands on the trailer
+            except Exception as e:  # noqa: BLE001 — status carries it below
+                error = e
                 break
             yield json.dumps({"chunk": _to_jsonable(chunk)}).encode()
-        try:
-            result = future.result(timeout=self.request_timeout_s)
-            yield json.dumps({"result": _to_jsonable(result)}).encode()
-            GRPC_REQUESTS.inc(tags={"method": "PredictStream", "code": "OK"})
-        except Exception as e:  # noqa: BLE001
+        if error is None:
+            try:
+                result = future.result(timeout=max(0.001, remaining()))
+                yield json.dumps({"result": _to_jsonable(result)}).encode()
+                GRPC_REQUESTS.inc(
+                    tags={"method": "PredictStream", "code": "OK"}
+                )
+                return
+            except Exception as e:  # noqa: BLE001
+                error = e
+        # Replica/timeout errors terminate the RPC with a real gRPC status
+        # (same mapping as Predict), not an OK stream with an error body.
+        if isinstance(error, TimeoutError):
             GRPC_REQUESTS.inc(
-                tags={"method": "PredictStream", "code": "INTERNAL"}
+                tags={"method": "PredictStream", "code": "DEADLINE"}
             )
-            yield json.dumps({"error": str(e)}).encode()
+            context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED, "stream timed out"
+            )
+        GRPC_REQUESTS.inc(
+            tags={"method": "PredictStream", "code": "INTERNAL"}
+        )
+        context.abort(grpc.StatusCode.INTERNAL, str(error))
 
     def _healthz(self, request: bytes, context) -> bytes:
         return json.dumps({"status": "ok"}).encode()
