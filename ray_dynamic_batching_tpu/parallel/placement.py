@@ -25,7 +25,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 
@@ -85,6 +85,17 @@ class PlacementManager:
         self._groups: Dict[int, PlacementGroup] = {}
         self._next_id = itertools.count(1)
         self._lock = threading.Lock()
+        # HBM totals are static per device: query the backend ONCE here,
+        # not per resource_view() poll under the lock.
+        self._node_hbm: Dict[int, int] = {}
+        for n, ds in self._nodes.items():
+            hbm = 0
+            for d in ds:
+                try:
+                    hbm += int(d.memory_stats().get("bytes_limit", 0))
+                except Exception:  # noqa: BLE001 — CPU devices: no HBM
+                    pass
+            self._node_hbm[n] = hbm
 
     # --- introspection ----------------------------------------------------
     def nodes(self) -> Dict[int, int]:
@@ -98,6 +109,36 @@ class PlacementManager:
     def groups(self) -> List[PlacementGroup]:
         with self._lock:
             return list(self._groups.values())
+
+    def resource_view(self) -> Dict[str, Any]:
+        """Cluster resource snapshot (ref ``gcs_resource_manager.cc`` — the
+        GCS-side node/resource view the dashboard and autoscaler read):
+        per-node chip totals, free counts, HBM where the backend reports
+        it, and live reservations."""
+        with self._lock:
+            nodes: Dict[str, Any] = {
+                str(n): {
+                    "chips_total": len(devs),
+                    "chips_free": len(self._free[n]),
+                    "platform": devs[0].platform if devs else "none",
+                    "hbm_bytes_total": self._node_hbm.get(n, 0),
+                }
+                for n, devs in self._nodes.items()
+            }
+            reservations = [
+                {
+                    "group_id": pg.group_id,
+                    "strategy": pg.strategy,
+                    "chips": pg.total_chips,
+                    # str keys, same namespace as the nodes map
+                    "nodes": sorted({
+                        str(int(d.process_index))
+                        for a in pg.assignments for d in a
+                    }),
+                }
+                for pg in self._groups.values()
+            ]
+        return {"nodes": nodes, "reservations": reservations}
 
     # --- placement --------------------------------------------------------
     def create(self, bundles: Sequence[Bundle],

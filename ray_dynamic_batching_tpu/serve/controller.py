@@ -473,7 +473,7 @@ class ServeController:
 
     def status(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out: Dict[str, Any] = {
                 name: {
                     "target_replicas": state.config.num_replicas,
                     "running_replicas": len(state.replicas),
@@ -485,3 +485,11 @@ class ServeController:
                 }
                 for name, state in self._deployments.items()
             }
+        return out
+
+    def resources(self) -> Dict[str, Any]:
+        """Cluster resource snapshot (separate from the by-name deployment
+        map so state/dashboard consumers never see a phantom deployment)."""
+        if self.placement is None:
+            return {"nodes": {}, "reservations": []}
+        return self.placement.resource_view()

@@ -1,0 +1,124 @@
+"""Job manager + resource view (closing the 'GCS server: partial' holes —
+ref gcs_job_manager.cc job table / gcs_resource_manager.cc node view)."""
+
+import sys
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.parallel.placement import (
+    Bundle,
+    PlacementManager,
+)
+from ray_dynamic_batching_tpu.runtime.jobs import (
+    FAILED,
+    JobManager,
+    LOST,
+    RUNNING,
+    STOPPED,
+    SUCCEEDED,
+    JobInfo,
+)
+from ray_dynamic_batching_tpu.runtime.kv import KVStore
+
+
+@pytest.fixture
+def jm(tmp_path):
+    return JobManager(kv=KVStore(), workdir=str(tmp_path))
+
+
+def py(code: str):
+    return [sys.executable, "-c", code]
+
+
+class TestJobManager:
+    def test_submit_succeeds_and_captures_logs(self, jm):
+        jid = jm.submit(py("print('hello from job')"))
+        info = jm.wait(jid, timeout_s=30)
+        assert info.status == SUCCEEDED
+        assert info.return_code == 0
+        assert "hello from job" in jm.logs(jid)
+
+    def test_failure_recorded(self, jm):
+        jid = jm.submit(py("import sys; print('boom'); sys.exit(3)"))
+        info = jm.wait(jid, timeout_s=30)
+        assert info.status == FAILED
+        assert info.return_code == 3
+        assert "boom" in jm.logs(jid)
+
+    def test_bad_entrypoint_fails_fast(self, jm):
+        with pytest.raises(OSError):
+            jm.submit(["/nonexistent/binary"])
+        jobs = jm.list_jobs()
+        assert len(jobs) == 1 and jobs[0].status == FAILED
+
+    def test_stop_kills_process_group(self, jm):
+        jid = jm.submit(py("import time; time.sleep(600)"))
+        assert jm.status(jid) == RUNNING
+        assert jm.stop(jid, grace_s=1.0)
+        info = jm.wait(jid, timeout_s=30)
+        assert info.status == STOPPED
+
+    def test_list_and_metadata(self, jm):
+        a = jm.submit(py("pass"), metadata={"kind": "profiler"})
+        b = jm.submit(py("pass"))
+        jm.wait(a, 30)
+        jm.wait(b, 30)
+        jobs = {j.job_id: j for j in jm.list_jobs()}
+        assert set(jobs) == {a, b}
+        assert jobs[a].metadata == {"kind": "profiler"}
+
+    def test_recover_marks_dead_running_jobs_lost(self, jm, tmp_path):
+        """A restarted manager reconciles its table: RUNNING entries whose
+        processes are gone become LOST (ref GCS job-table reconciliation)."""
+        jid = jm.submit(py("pass"))
+        jm.wait(jid, 30)
+        # Forge a RUNNING entry with a dead pid (simulates dying manager).
+        ghost = JobInfo(job_id="ghost", entrypoint=["x"], status=RUNNING,
+                        pid=2 ** 22 + 12345)
+        jm.kv.put("jobs:ghost", ghost.to_json())
+        fresh = JobManager(kv=jm.kv, workdir=str(tmp_path))
+        assert fresh.recover() == ["ghost"]
+        assert fresh.status("ghost") == LOST
+        assert fresh.status(jid) == SUCCEEDED  # terminal entries untouched
+
+
+class TestResourceView:
+    def test_snapshot_tracks_reservations(self, eight_devices):
+        manager = PlacementManager(eight_devices)
+        view = manager.resource_view()
+        assert sum(n["chips_total"] for n in view["nodes"].values()) == 8
+        assert sum(n["chips_free"] for n in view["nodes"].values()) == 8
+        assert view["reservations"] == []
+
+        pg = manager.create([Bundle(chips=4)], strategy="PACK")
+        view = manager.resource_view()
+        assert sum(n["chips_free"] for n in view["nodes"].values()) == 4
+        assert view["reservations"] == [{
+            "group_id": pg.group_id, "strategy": "PACK", "chips": 4,
+            "nodes": ["0"],
+        }]
+        manager.remove(pg)
+        view = manager.resource_view()
+        assert sum(n["chips_free"] for n in view["nodes"].values()) == 8
+
+    def test_controller_status_exposes_resources(self, eight_devices):
+        from ray_dynamic_batching_tpu.serve.controller import (
+            DeploymentConfig,
+            ServeController,
+        )
+
+        manager = PlacementManager(eight_devices)
+        controller = ServeController(placement=manager)
+        controller.deploy(
+            DeploymentConfig(name="echo", num_replicas=2,
+                             chips_per_replica=2),
+            factory=lambda: lambda ps: ps,
+        )
+        try:
+            assert "_resources" not in controller.status()
+            res = controller.resources()
+            assert sum(n["chips_free"] for n in res["nodes"].values()) == 4
+            assert len(res["reservations"]) == 2
+        finally:
+            controller.shutdown()
