@@ -37,6 +37,7 @@ VISION_BASELINES = {
     "vit_g_14": (112.1, (16, 32)),
 }
 NORTH_STAR_TOK_S = 1500.0  # BASELINE.json: ">=1500 tok/s/chip"
+PEAK_BF16_TFLOPS = 197.0   # TPU v5e chip peak (MXU, bf16)
 
 
 def _log(msg: str) -> None:
@@ -89,6 +90,7 @@ def bench_vision_model(name: str, baseline: float, batch_sizes,
                 "batch": b,
                 "latency_ms": round(dt * 1000, 2),
                 "tflops": round(flops / 1e12, 1),
+                "mfu": round(flops / 1e12 / PEAK_BF16_TFLOPS, 3),
             }
     if best["samples_per_s"]:
         best["vs_baseline"] = round(best["samples_per_s"] / baseline, 3)

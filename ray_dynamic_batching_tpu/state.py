@@ -29,9 +29,11 @@ class StateAPI:
     (the judge-facing analogue of ``ray.util.state``'s list_* calls)."""
 
     def __init__(self, controller=None, scheduler=None,
-                 registry: Optional[m.MetricsRegistry] = None) -> None:
+                 registry: Optional[m.MetricsRegistry] = None,
+                 jobs=None) -> None:
         self.controller = controller
         self.scheduler = scheduler
+        self.jobs = jobs
         self.registry = registry or m.default_registry()
 
     # --- list_* (ref util/state/api.py) -----------------------------------
@@ -71,6 +73,20 @@ class StateAPI:
     def scheduler_snapshot(self) -> Dict[str, Any]:
         return self.scheduler.snapshot() if self.scheduler else {}
 
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        """Job table (ref list_jobs in util/state/api.py)."""
+        if self.jobs is None:
+            return []
+        import dataclasses
+
+        return [dataclasses.asdict(j) for j in self.jobs.list_jobs()]
+
+    def resources(self) -> Dict[str, Any]:
+        """Cluster chip/HBM view (ref list_nodes / resource reporting)."""
+        if self.controller is None or not hasattr(self.controller, "resources"):
+            return {"nodes": {}, "reservations": []}
+        return self.controller.resources()
+
     def metrics_text(self) -> str:
         return self.registry.prometheus_text()
 
@@ -81,6 +97,8 @@ class StateAPI:
             "replicas": self.list_replicas(),
             "queues": self.list_queues(),
             "scheduler": self.scheduler_snapshot(),
+            "jobs": self.list_jobs(),
+            "resources": self.resources(),
             "slo_thresholds": {"good": good, "warn": warn},
         }
 

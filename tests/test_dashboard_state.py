@@ -43,7 +43,8 @@ class TestStateAPI:
         assert all(r["healthy"] for r in reps)
         summary = api.summary()
         assert set(summary) == {
-            "deployments", "replicas", "queues", "scheduler", "slo_thresholds",
+            "deployments", "replicas", "queues", "scheduler", "jobs",
+            "resources", "slo_thresholds",
         }
         assert summary["slo_thresholds"] == {"good": 0.98, "warn": 0.95}
 
