@@ -191,15 +191,55 @@ class LLMDeployment:
 
                 self._params = self._model.init(jax.random.PRNGKey(0))
 
+    def auto_num_slots(self, n_chips: int = 1) -> int:
+        """Size the continuous batch from the HBM budget (directive: slots
+        from profile/HBM, not a guess): per CHIP, subtract this chip's
+        weight shard, apply the planner's HBM fraction
+        (``RDB_HBM_PLAN_FRACTION`` — same knob the Nexus packer uses), and
+        fill the rest with this chip's KV-row shards. TP replicas shard
+        both weights and KV 1/n_chips, so per-chip terms divide through.
+        Rounded down to a power of two (aligns prefill group widths)."""
+        import jax
+        import numpy as np
+
+        from ray_dynamic_batching_tpu.utils.config import get_config
+
+        self._ensure_model()
+        cfg = get_config()
+        weights_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(self._params)
+            if hasattr(leaf, "size")
+        ) / max(1, n_chips)
+        budget = float(cfg.hbm_budget_bytes)
+        per_slot = float(
+            self._model.kv_bytes_per_slot(self.max_len)
+        ) / max(1, n_chips)
+        usable = (budget - weights_bytes) * cfg.hbm_plan_fraction
+        n = int(max(1.0, usable / max(per_slot, 1.0)))
+        n = min(n, 256)
+        n = 2 ** int(np.log2(n)) if n > 1 else 1
+        logger.info(
+            "%s: auto num_slots=%d (%d chip(s), weights %.0f MB/chip, "
+            "%.2f MB/slot/chip, budget %.0f GB/chip x %.2f)",
+            self.model_name, n, n_chips, weights_bytes / 1e6,
+            per_slot / 1e6, budget / 1e9, cfg.hbm_plan_fraction,
+        )
+        return n
+
     def build_engine(
         self, queue: RequestQueue, device: Any = None, mesh: Any = None
     ) -> DecodeEngine:
         self._ensure_model()
+        num_slots = self.num_slots
+        if num_slots <= 0:
+            n_chips = mesh.devices.size if mesh is not None else 1
+            num_slots = self.auto_num_slots(n_chips)
         return DecodeEngine(
             self._model,
             self._params,
             queue,
-            num_slots=self.num_slots,
+            num_slots=num_slots,
             max_len=self.max_len,
             prompt_buckets=self.prompt_buckets,
             eos_token_id=self.eos_token_id,

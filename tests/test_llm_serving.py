@@ -271,3 +271,32 @@ class TestLLMReplicaLifecycle:
             assert not replica.healthy(stall_timeout_s=60.0)
         finally:
             replica.stop(timeout_s=0.5)
+
+
+class TestAutoSlots:
+    def test_num_slots_sized_from_hbm_budget(self):
+        """num_slots<=0 derives the continuous-batch size from the HBM
+        budget minus weights, in KV-row units, rounded to a power of two."""
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+        from ray_dynamic_batching_tpu.utils.config import (
+            RDBConfig,
+            set_config,
+        )
+
+        set_config(RDBConfig.from_env(hbm_budget_bytes=1 << 30))  # 1 GB
+        dep = LLMDeployment(
+            "llama_tiny", num_slots=0, max_len=64, prompt_buckets=[8],
+            dtype=jnp.float32, warmup=False,
+        )
+        n1 = dep.auto_num_slots(1)
+        assert n1 >= 1
+        assert n1 & (n1 - 1) == 0  # power of two
+        # The chosen count must actually fit the budget.
+        kv_total = n1 * dep._model.kv_bytes_per_slot(64)
+        assert kv_total <= (1 << 30)
+        # A tighter budget yields fewer slots.
+        set_config(RDBConfig.from_env(hbm_budget_bytes=64 << 20))
+        assert dep.auto_num_slots(1) <= n1
+        # TP shards weights + KV per chip -> more slots fit per chip.
+        set_config(RDBConfig.from_env(hbm_budget_bytes=64 << 20))
+        assert dep.auto_num_slots(4) >= dep.auto_num_slots(1)
