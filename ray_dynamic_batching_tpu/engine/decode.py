@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -125,6 +126,7 @@ class DecodeEngine:
         max_admissions_per_step: int = 2,
         device: Optional[jax.Device] = None,
         mesh: Optional[Any] = None,
+        base_seed: int = 0,
     ):
         self.model = model
         self.device = device
@@ -151,7 +153,10 @@ class DecodeEngine:
         self.eos_token_id = eos_token_id
         self.default_max_new_tokens = default_max_new_tokens
         self.idle_wait_s = idle_wait_s
-        self._sample = sample_fn or (lambda logits: jnp.argmax(logits, axis=-1))
+        # Legacy whole-batch override; when None the parametric per-request
+        # sampler (temperature / top-k / seed) runs in-program.
+        self._sample_custom = sample_fn
+        self.base_seed = int(base_seed)
 
         self._slots = [_Slot() for _ in range(num_slots)]
         if mesh is not None and hasattr(model, "cache_pspec"):
@@ -165,6 +170,10 @@ class DecodeEngine:
                 self._cache = model.make_cache(num_slots, max_len)
         self._tokens = np.zeros((num_slots, 1), dtype=np.int32)
         self._active_mask = np.zeros((num_slots,), dtype=bool)
+        # Per-slot sampling params (temperature 0 == greedy).
+        self._temps = np.zeros((num_slots,), dtype=np.float32)
+        self._topk = np.zeros((num_slots,), dtype=np.int32)
+        self._seeds = np.zeros((num_slots,), dtype=np.int32)
 
         self.decode_horizon = max(1, int(decode_horizon))
         self.max_admissions_per_step = max(1, int(max_admissions_per_step))
@@ -190,7 +199,53 @@ class DecodeEngine:
         return jax.default_device(self.device)
 
     # --- compiled programs -------------------------------------------------
-    def _prefill_impl(self, params, tokens, attn_mask, cache, slots):
+    def _sample_tokens(self, logits, temps, topk, seeds, tok_idx):
+        """In-program per-request sampling: temperature 0 → greedy argmax;
+        otherwise top-k-masked categorical, keyed by (base_seed, request
+        seed, TOKEN INDEX within the request) — so a request's stream is
+        reproducible regardless of slot, batch neighbors, or how much
+        traffic the engine served before it, and no two positions of one
+        request reuse a key. One compiled program covers every sampling
+        configuration; a ``lax.cond`` skips the full-vocab sort + draws at
+        RUNTIME when the whole batch is greedy (the default hot path).
+
+        logits [B, V]; temps [B] f32; topk [B] i32; seeds [B] i32;
+        tok_idx [B] i32 (index of the token being sampled per request).
+        """
+        if self._sample_custom is not None:
+            return self._sample_custom(logits).astype(jnp.int32)
+        logits = logits.astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def draw(args):
+            lg, tm, tk, sd, ti = args
+            V = lg.shape[-1]
+            # top-k mask (k<=0 means no truncation)
+            k_eff = jnp.where(tk > 0, jnp.minimum(tk, V), V)
+            sorted_desc = -jnp.sort(-lg, axis=-1)
+            kth = jnp.take_along_axis(
+                sorted_desc, (k_eff - 1)[:, None], axis=-1
+            )
+            masked = jnp.where(lg < kth, -jnp.inf, lg)
+            scaled = masked / jnp.maximum(tm, 1e-6)[:, None]
+            base = jax.random.PRNGKey(self.base_seed)
+
+            def one(seed, idx, row):
+                key = jax.random.fold_in(jax.random.fold_in(base, seed), idx)
+                return jax.random.categorical(key, row)
+
+            return jax.vmap(one)(sd, ti, scaled).astype(jnp.int32)
+
+        sampled = jax.lax.cond(
+            jnp.any(temps > 0.0),
+            draw,
+            lambda args: greedy,
+            (logits, temps, topk, seeds, tok_idx),
+        )
+        return jnp.where(temps > 0.0, sampled, greedy)
+
+    def _prefill_impl(self, params, tokens, attn_mask, cache, slots,
+                      temps, topk, seeds, tok_idx):
         """``nB`` prompts → cache rows at ``slots`` + first sampled tokens.
 
         tokens/attn_mask are [nB, T]; ``slots`` is a traced [nB] int32
@@ -216,10 +271,13 @@ class DecodeEngine:
             lengths = jax.lax.dynamic_update_slice(
                 lengths, rows.lengths[i : i + 1], (slots[i],)
             )
-        first = self._sample(last_logits).astype(jnp.int32)  # [nB]
+        first = self._sample_tokens(
+            last_logits, temps, topk, seeds, tok_idx
+        )  # [nB]
         return first, cache.replace(k=k, v=v, lengths=lengths)
 
-    def _decode_impl(self, params, cache, tokens, active, horizon: int):
+    def _decode_impl(self, params, cache, tokens, active, horizon: int,
+                     temps, topk, seeds, tok_idx0):
         """``horizon`` chained decode steps in one program (one host sync).
 
         Rows already at capacity produce garbage logits (decode_step masks
@@ -232,18 +290,18 @@ class DecodeEngine:
         device→host boundary is crossed once per dispatch, not three times.
         """
 
-        def substep(carry, _):
+        def substep(carry, j):
             cache, tokens = carry
             advanced = jnp.logical_and(active, cache.lengths < cache.capacity)
             logits, cache = self.model.decode_step(
                 params, tokens, cache, advanced
             )
-            nxt = self._sample(logits).astype(jnp.int32)
+            nxt = self._sample_tokens(logits, temps, topk, seeds, tok_idx0 + j)
             nxt = jnp.where(advanced, nxt, tokens[:, 0])
             return (cache, nxt[:, None]), (nxt, advanced)
 
         (cache, _), (toks, adv) = jax.lax.scan(
-            substep, (cache, tokens), None, length=horizon
+            substep, (cache, tokens), jnp.arange(horizon, dtype=jnp.int32)
         )
         packed = jnp.concatenate(
             [toks, adv.astype(jnp.int32), cache.lengths[None, :]], axis=0
@@ -284,7 +342,11 @@ class DecodeEngine:
                 mask = jnp.ones((g, b), dtype=jnp.int32)
                 slots = jnp.arange(g, dtype=jnp.int32) % self.num_slots
                 first, self._cache = self._prefill_fn(b, g)(
-                    self.params, tokens, mask, self._cache, slots
+                    self.params, tokens, mask, self._cache, slots,
+                    jnp.zeros((g,), jnp.float32),
+                    jnp.zeros((g,), jnp.int32),
+                    jnp.zeros((g,), jnp.int32),
+                    jnp.zeros((g,), jnp.int32),
                 )
                 first.block_until_ready()
         for h in {1, self.decode_horizon}:
@@ -294,6 +356,10 @@ class DecodeEngine:
                 jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
                 jnp.zeros((self.num_slots,), dtype=bool),
                 h,
+                jnp.zeros((self.num_slots,), jnp.float32),
+                jnp.zeros((self.num_slots,), jnp.int32),
+                jnp.zeros((self.num_slots,), jnp.int32),
+                jnp.zeros((self.num_slots,), jnp.int32),
             )
             packed.block_until_ready()
         # Reset state dirtied by warmup runs.
@@ -309,11 +375,12 @@ class DecodeEngine:
     def _free_slots(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s.free]
 
-    def _prep_prompt(self, req: Request) -> Tuple[np.ndarray, int, int]:
+    def _prep_prompt(self, req: Request) -> Tuple[np.ndarray, int, Dict]:
         """Validate one request BEFORE it costs a dispatch; returns
-        (prompt ids, bucket, max_new_tokens) or raises. Every way a payload
-        can be malformed must surface here — past this point the request is
-        committed to a slot and only engine errors can reject it."""
+        (prompt ids, bucket, opts) where opts carries max_new / temperature
+        / top_k / seed — or raises. Every way a payload can be malformed
+        must surface here: past this point the request is committed to a
+        slot and only engine errors can reject it."""
         prompt = np.asarray(
             req.payload["tokens"] if isinstance(req.payload, dict) else req.payload,
             dtype=np.int32,
@@ -326,10 +393,27 @@ class DecodeEngine:
                 f"{req.request_id}: prompt length {prompt.size} exceeds "
                 f"largest bucket {self.prompt_buckets[-1]}"
             )
-        max_new = self.default_max_new_tokens
+        opts = {
+            "max_new": self.default_max_new_tokens,
+            "temperature": 0.0,   # greedy unless asked
+            "top_k": 0,
+            # Default seed derives from the request id via a STABLE hash
+            # (crc32; Python's hash() is salted per process), so a
+            # re-submitted request resamples the same way on any replica.
+            "seed": zlib.crc32(req.request_id.encode()) & 0x7FFFFFFF,
+        }
         if isinstance(req.payload, dict):
-            max_new = int(req.payload.get("max_new_tokens", max_new))
-        return prompt, bucket, max_new
+            p = req.payload
+            opts["max_new"] = int(p.get("max_new_tokens", opts["max_new"]))
+            opts["temperature"] = float(p.get("temperature", 0.0))
+            opts["top_k"] = int(p.get("top_k", 0))
+            if "seed" in p:
+                opts["seed"] = int(p["seed"]) & 0x7FFFFFFF
+            if opts["temperature"] < 0.0:
+                raise ValueError(
+                    f"{req.request_id}: temperature must be >= 0"
+                )
+        return prompt, bucket, opts
 
     def _admit(self) -> int:
         """Fill free slots from the queue (continuous batching join), at most
@@ -351,14 +435,14 @@ class DecodeEngine:
         if self._active_mask.any():
             free = free[: self.max_admissions_per_step]
         batch = self.queue.get_batch(len(free), discard_stale=True)
-        by_bucket: Dict[int, List[Tuple[Request, np.ndarray, int]]] = {}
+        by_bucket: Dict[int, List[Tuple[Request, np.ndarray, Dict]]] = {}
         for req in batch:
             try:
-                prompt, bucket, max_new = self._prep_prompt(req)
+                prompt, bucket, opts = self._prep_prompt(req)
             except Exception as e:  # noqa: BLE001 — bad prompt must not kill loop
                 req.reject(e)
                 continue
-            by_bucket.setdefault(bucket, []).append((req, prompt, max_new))
+            by_bucket.setdefault(bucket, []).append((req, prompt, opts))
         admitted = 0
         cap = self.max_admissions_per_step
         for bucket, items in by_bucket.items():
@@ -372,7 +456,7 @@ class DecodeEngine:
                     logger.exception(
                         "%s: prefill group failed", self.model.name
                     )
-                    for req, _p, _m in chunk:
+                    for req, _p, _o in chunk:
                         req.reject(e)
                     continue
                 admitted += len(chunk)
@@ -381,7 +465,7 @@ class DecodeEngine:
     def _prefill_group(
         self,
         bucket: int,
-        items: List[Tuple[Request, np.ndarray, int]],
+        items: List[Tuple[Request, np.ndarray, Dict]],
         slot_ids: List[int],
     ) -> None:
         n = len(items)
@@ -389,15 +473,24 @@ class DecodeEngine:
         tokens = np.zeros((group, bucket), dtype=np.int32)
         mask = np.zeros((group, bucket), dtype=np.int32)
         slots = np.zeros((group,), dtype=np.int32)
-        for i, (req, prompt, _max_new) in enumerate(items):
+        temps = np.zeros((group,), dtype=np.float32)
+        topk = np.zeros((group,), dtype=np.int32)
+        seeds = np.zeros((group,), dtype=np.int32)
+        for i, (req, prompt, opts) in enumerate(items):
             tokens[i, : prompt.size] = prompt
             mask[i, : prompt.size] = 1
             slots[i] = slot_ids[i]
+            temps[i] = opts["temperature"]
+            topk[i] = opts["top_k"]
+            seeds[i] = opts["seed"]
         # Pad rows duplicate row 0 (same slot, same data — idempotent write).
         for i in range(n, group):
             tokens[i] = tokens[0]
             mask[i] = mask[0]
             slots[i] = slots[0]
+            temps[i] = temps[0]
+            topk[i] = topk[0]
+            seeds[i] = seeds[0]
 
         first, self._cache = self._prefill_fn(bucket, group)(
             self.params,
@@ -405,16 +498,21 @@ class DecodeEngine:
             jnp.asarray(mask),
             self._cache,
             jnp.asarray(slots),
+            jnp.asarray(temps),
+            jnp.asarray(topk),
+            jnp.asarray(seeds),
+            jnp.zeros((group,), jnp.int32),  # prefill samples token 0
         )
         first_host = np.asarray(first)  # ONE fetch for the whole group
         t = now_ms()
-        for i, (req, _prompt, max_new) in enumerate(items):
-            self._register(slot_ids[i], req, int(first_host[i]), max_new, t)
+        for i, (req, _prompt, opts) in enumerate(items):
+            self._register(slot_ids[i], req, int(first_host[i]), opts, t)
 
     def _register(
-        self, slot_idx: int, req: Request, first_tok: int, max_new: int,
+        self, slot_idx: int, req: Request, first_tok: int, opts: Dict,
         t: float,
     ) -> None:
+        max_new = opts["max_new"]
         slot = self._slots[slot_idx]
         slot.request = req
         slot.generated = [first_tok]
@@ -423,6 +521,9 @@ class DecodeEngine:
         slot.last_token = first_tok
         self._tokens[slot_idx, 0] = first_tok
         self._active_mask[slot_idx] = True
+        self._temps[slot_idx] = opts["temperature"]
+        self._topk[slot_idx] = opts["top_k"]
+        self._seeds[slot_idx] = opts["seed"]
 
         PREFILLS_TOTAL.inc(tags={"model": self.model.name})
         TTFT_MS.observe(t - req.arrival_ms, tags={"model": self.model.name})
@@ -448,6 +549,9 @@ class DecodeEngine:
         TOKENS_TOTAL.inc(len(slot.generated), tags={"model": self.model.name})
         self._slots[slot_idx] = _Slot()
         self._active_mask[slot_idx] = False
+        self._temps[slot_idx] = 0.0
+        self._topk[slot_idx] = 0
+        self._seeds[slot_idx] = 0
         self.completed += 1
 
     def _pick_horizon(self) -> int:
@@ -461,12 +565,21 @@ class DecodeEngine:
 
     def _step(self, horizon: Optional[int] = None) -> None:
         h = horizon if horizon is not None else self._pick_horizon()
+        # Per-slot index of the NEXT token to sample (prefill was index 0).
+        tok_idx = np.asarray(
+            [len(s.generated) if not s.free else 0 for s in self._slots],
+            dtype=np.int32,
+        )
         packed, self._cache = self._decode_fn(
             self.params,
             self._cache,
             jnp.asarray(self._tokens),
             jnp.asarray(self._active_mask),
             h,
+            jnp.asarray(self._temps),
+            jnp.asarray(self._topk),
+            jnp.asarray(self._seeds),
+            jnp.asarray(tok_idx),
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch
         toks_host = packed_host[:h]               # [h, B]

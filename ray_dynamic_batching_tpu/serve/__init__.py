@@ -15,7 +15,9 @@ from ray_dynamic_batching_tpu.serve.controller import (
     ServeController,
 )
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+from ray_dynamic_batching_tpu.serve.llm import LLMDeployment, LLMReplica
 from ray_dynamic_batching_tpu.serve.long_poll import LongPollClient, LongPollHost
+from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.serve.router import Router
 
@@ -24,8 +26,12 @@ __all__ = [
     "AutoscalingPolicy",
     "DeploymentConfig",
     "DeploymentHandle",
+    "HTTPProxy",
+    "LLMDeployment",
+    "LLMReplica",
     "LongPollClient",
     "LongPollHost",
+    "ProxyRouter",
     "Replica",
     "Router",
     "ServeController",

@@ -20,6 +20,9 @@ Payload contract (JSON-safe, the proxy passes it straight through)::
 
     {"tokens": [1, 2, 3],          # prompt token ids (required)
      "max_new_tokens": 64,          # optional
+     "temperature": 0.8,            # optional: 0 (default) = greedy
+     "top_k": 40,                   # optional: 0 (default) = full vocab
+     "seed": 1234,                  # optional: reproducible sampling
      "stream": true}                # optional: tokens stream incrementally
 
 Result: ``DecodeResult`` (tokens, finish_reason, ttft_ms, total_ms).

@@ -217,7 +217,7 @@ class TestStreamingAndHorizon:
         dispatches = []
 
         def counting_fn(*args):
-            dispatches.append(args[-1])  # the static horizon argument
+            dispatches.append(args[4])  # the static horizon argument
             return real_fn(*args)
 
         multi._decode_fn = counting_fn
@@ -307,3 +307,68 @@ class TestAdmissionErrors:
         with pytest.raises(ValueError):
             bad.future.result(timeout=5)
         assert len(good.future.result(timeout=5).tokens) == 3
+
+
+class TestSampling:
+    def test_seeded_sampling_reproducible(self, lm):
+        """Same seed + temperature -> identical sequences across engines —
+        INCLUDING an engine with prior traffic (keys derive from the
+        request's own token indices, never global engine state); different
+        seeds -> (overwhelmingly) different sequences."""
+        outs = []
+        for i, seed in enumerate((7, 7, 99)):
+            engine, queue = make_engine(lm, num_slots=2)
+            if i == 1:
+                # Prior traffic: steps/admissions advance before the probe.
+                warm = submit(queue, [9, 8, 7], max_new_tokens=5,
+                              temperature=0.8, seed=1)
+                engine.run_until_idle()
+                assert len(warm.future.result(timeout=5).tokens) == 5
+            req = submit(queue, [1, 2, 3], max_new_tokens=12,
+                         temperature=1.0, seed=seed)
+            engine.run_until_idle()
+            outs.append(req.future.result(timeout=5).tokens)
+        assert outs[0] == outs[1]          # reproducible despite traffic
+        assert outs[0] != outs[2]          # seed-sensitive
+
+    def test_temperature_zero_is_greedy(self, lm):
+        engine, queue = make_engine(lm, num_slots=2)
+        greedy = submit(queue, [5, 9, 2], max_new_tokens=6)
+        explicit = submit(queue, [5, 9, 2], max_new_tokens=6,
+                          temperature=0.0, seed=123)
+        engine.run_until_idle()
+        assert (greedy.future.result(timeout=5).tokens
+                == explicit.future.result(timeout=5).tokens)
+
+    def test_top_k_one_equals_greedy(self, lm):
+        """top_k=1 leaves only the argmax in the support: any temperature
+        must reproduce greedy."""
+        engine, queue = make_engine(lm, num_slots=2)
+        greedy = submit(queue, [4, 8], max_new_tokens=8)
+        k1 = submit(queue, [4, 8], max_new_tokens=8,
+                    temperature=5.0, top_k=1, seed=42)
+        engine.run_until_idle()
+        assert (greedy.future.result(timeout=5).tokens
+                == k1.future.result(timeout=5).tokens)
+
+    def test_mixed_batch_sampling_isolated(self, lm):
+        """A sampled request and a greedy request share the batch; the
+        greedy one must be bit-identical to a solo greedy run."""
+        engine, queue = make_engine(lm, num_slots=2)
+        sampled = submit(queue, [1, 2, 3], max_new_tokens=8,
+                         temperature=1.3, seed=5)
+        greedy = submit(queue, [5, 9, 2, 7], max_new_tokens=8)
+        engine.run_until_idle()
+        solo_engine, solo_q = make_engine(lm, num_slots=1)
+        solo = submit(solo_q, [5, 9, 2, 7], max_new_tokens=8)
+        solo_engine.run_until_idle()
+        assert (greedy.future.result(timeout=5).tokens
+                == solo.future.result(timeout=5).tokens)
+        assert len(sampled.future.result(timeout=5).tokens) == 8
+
+    def test_negative_temperature_rejected(self, lm):
+        engine, queue = make_engine(lm)
+        req = submit(queue, [1, 2], temperature=-1.0)
+        engine.run_until_idle()
+        with pytest.raises(ValueError, match="temperature"):
+            req.future.result(timeout=5)
