@@ -96,6 +96,7 @@ class _Slot:
     max_new_tokens: int = 0
     prefill_done_ms: float = 0.0
     last_token: int = 0
+    stop: frozenset = frozenset()  # per-request stop token ids
 
     @property
     def free(self) -> bool:
@@ -401,6 +402,7 @@ class DecodeEngine:
             # (crc32; Python's hash() is salted per process), so a
             # re-submitted request resamples the same way on any replica.
             "seed": zlib.crc32(req.request_id.encode()) & 0x7FFFFFFF,
+            "stop": (),           # extra per-request stop token ids
         }
         if isinstance(req.payload, dict):
             p = req.payload
@@ -409,6 +411,9 @@ class DecodeEngine:
             opts["top_k"] = int(p.get("top_k", 0))
             if "seed" in p:
                 opts["seed"] = int(p["seed"]) & 0x7FFFFFFF
+            opts["stop"] = frozenset(
+                int(t) for t in p.get("stop_token_ids", ())
+            )
             if opts["temperature"] < 0.0:
                 raise ValueError(
                     f"{req.request_id}: temperature must be >= 0"
@@ -519,6 +524,7 @@ class DecodeEngine:
         slot.max_new_tokens = max_new
         slot.prefill_done_ms = t
         slot.last_token = first_tok
+        slot.stop = opts["stop"]
         self._tokens[slot_idx, 0] = first_tok
         self._active_mask[slot_idx] = True
         self._temps[slot_idx] = opts["temperature"]
@@ -529,9 +535,15 @@ class DecodeEngine:
         TTFT_MS.observe(t - req.arrival_ms, tags={"model": self.model.name})
         req.stream_put(first_tok)
         # First token may already satisfy the stop conditions.
-        if first_tok == self.eos_token_id or max_new <= 1:
-            reason = "eos" if first_tok == self.eos_token_id else "length"
+        if self._is_stop(slot, first_tok) or max_new <= 1:
+            reason = "eos" if self._is_stop(slot, first_tok) else "length"
             self._finish(slot_idx, reason)
+
+    def _is_stop(self, slot: _Slot, tok: int) -> bool:
+        return (
+            (self.eos_token_id is not None and tok == self.eos_token_id)
+            or tok in slot.stop
+        )
 
     # --- step + eviction ---------------------------------------------------
     def _finish(self, slot_idx: int, reason: str) -> None:
@@ -600,9 +612,9 @@ class DecodeEngine:
                 slot.last_token = tok
                 self._tokens[i, 0] = tok
                 slot.request.stream_put(tok)
-                if self.eos_token_id is not None and tok == self.eos_token_id:
-                    # Substeps after EOS decoded garbage into this slot's
-                    # cache tail; prefill overwrites the whole row on reuse.
+                if self._is_stop(slot, tok):
+                    # Substeps after EOS/stop decoded garbage into this
+                    # slot's cache tail; prefill overwrites the row on reuse.
                     self._finish(i, "eos")
                     break
                 if len(slot.generated) >= slot.max_new_tokens:

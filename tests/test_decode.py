@@ -372,3 +372,24 @@ class TestSampling:
         engine.run_until_idle()
         with pytest.raises(ValueError, match="temperature"):
             req.future.result(timeout=5)
+
+
+class TestStopTokens:
+    def test_per_request_stop_token_ids(self, lm):
+        """stop_token_ids finish a request exactly like EOS — but scoped to
+        that request only (its batch neighbor keeps decoding)."""
+        probe_engine, probe_q = make_engine(lm)
+        probe = submit(probe_q, [5, 9, 2, 7], max_new_tokens=8)
+        probe_engine.run_until_idle()
+        toks = probe.future.result(timeout=5).tokens
+        k = next(i for i in range(1, len(toks)) if toks[i] not in toks[:i])
+
+        engine, queue = make_engine(lm, num_slots=2)
+        stopped = submit(queue, [5, 9, 2, 7], max_new_tokens=8,
+                         stop_token_ids=[toks[k]])
+        neighbor = submit(queue, [5, 9, 2, 7], max_new_tokens=8)
+        engine.run_until_idle()
+        r = stopped.future.result(timeout=5)
+        assert r.finish_reason == "eos"
+        assert r.tokens == toks[: k + 1]
+        assert neighbor.future.result(timeout=5).tokens == toks  # unaffected
