@@ -431,6 +431,13 @@ class StreamingASR:
         self._prefill = jax.jit(model.prefill)
         self._step = jax.jit(model.decode_step)
 
+    def reset(self) -> None:
+        """Start a fresh stream, KEEPING the compiled programs (a serving
+        replica reuses one StreamingASR across requests — re-instantiating
+        would re-jit per request and recompile every bucket)."""
+        self._buffer = []
+        self._tokens = [self.model.cfg.sot_token]
+
     def feed(self, mel_frames: np.ndarray) -> Optional[List[int]]:
         """Append [T, n_mels] frames; when a full chunk accumulates,
         transcribe it and return the new token ids (else None)."""

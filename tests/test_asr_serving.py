@@ -1,0 +1,105 @@
+"""Whisper-style ASR behind the serve stack (BASELINE.json config 5:
+"Whisper-large-v3 streaming ASR (ragged variable-length batching)") —
+transcription requests flow controller → router → replica → StreamingASR,
+with streamed token chunks for incremental delivery."""
+
+import numpy as np
+import pytest
+
+from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+from ray_dynamic_batching_tpu.models.base import get_model
+from ray_dynamic_batching_tpu.models.asr import StreamingASR
+from ray_dynamic_batching_tpu.engine.request import Request, TokenStream
+from ray_dynamic_batching_tpu.serve.controller import (
+    DeploymentConfig,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+
+import jax
+import jax.numpy as jnp
+
+
+def asr_factory():
+    """Deployment callable: one StreamingASR per replica (compiled programs
+    shared across requests via reset()), generator batching streams each
+    request's transcript chunks as they decode."""
+    model = get_model("whisper_tiny_test", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    asr = StreamingASR(model, params, chunk_frames=100, max_new_tokens=4)
+
+    def transcribe(payloads):
+        # generator: one yield per request position (ragged per-request
+        # transcripts stream independently)
+        results = []
+        for p in payloads:
+            asr.reset()
+            audio = np.asarray(p, np.float32)
+            out = asr.feed(audio) or []
+            if asr._buffer:
+                out = out + asr.flush()
+            results.append(asr.transcript)
+        yield [r for r in results]
+
+    return transcribe
+
+
+@pytest.fixture(scope="module")
+def asr_stack():
+    controller = ServeController(control_interval_s=0.2)
+    router = controller.deploy(
+        DeploymentConfig(name="whisper", num_replicas=1, max_batch_size=2,
+                         batch_wait_timeout_s=0.01),
+        factory=asr_factory,
+    )
+    controller.start()
+    yield DeploymentHandle(router, default_slo_ms=120_000.0)
+    controller.shutdown()
+
+
+def _mel(rng, frames, n_mels=16):
+    return rng.standard_normal((frames, n_mels)).astype(np.float32).tolist()
+
+
+@pytest.mark.timeout(240)
+class TestASRServing:
+    def test_transcription_roundtrip(self, asr_stack):
+        rng = np.random.default_rng(0)
+        model = get_model("whisper_tiny_test", dtype=jnp.float32)
+        fut = asr_stack.remote(_mel(rng, 120))
+        # generator batching: the future resolves to the list of streamed
+        # chunks; this factory emits ONE chunk = the full transcript
+        (transcript,) = fut.result(timeout=120)
+        assert transcript[0] == model.cfg.sot_token
+        assert len(transcript) > 1
+        assert all(0 <= t < model.cfg.vocab_size for t in transcript)
+
+    def test_ragged_batch_isolated(self, asr_stack):
+        """Different-length audios in one serving batch transcribe
+        independently (ragged variable-length batching)."""
+        rng = np.random.default_rng(1)
+        futs = [
+            asr_stack.remote(_mel(rng, frames))
+            for frames in (60, 120, 180)
+        ]
+        outs = [f.result(timeout=120)[0] for f in futs]
+        assert all(len(o) >= 1 for o in outs)
+        # determinism: resubmitting the same audio reproduces its transcript
+        rng = np.random.default_rng(1)
+        futs2 = [
+            asr_stack.remote(_mel(rng, frames))
+            for frames in (60, 120, 180)
+        ]
+        assert [f.result(timeout=120)[0] for f in futs2] == outs
+
+    def test_streamed_transcript_chunks(self, asr_stack):
+        rng = np.random.default_rng(2)
+        stream, fut = asr_stack.router.replicas()[0], None
+        req = Request(
+            model="whisper", payload=_mel(rng, 120), slo_ms=120_000.0,
+            stream=TokenStream(),
+        )
+        assert stream.assign(req)
+        chunk = req.stream.get(timeout_s=120)   # generator batching streams
+        result = req.future.result(timeout=120)
+        assert [chunk] == result
