@@ -59,6 +59,7 @@ from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.profiles.table import bucket_up
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import tracer as _tracer
 
 logger = get_logger("decode")
 
@@ -557,6 +558,17 @@ class DecodeEngine:
             total_ms=t - req.arrival_ms,
         )
         req.fulfill(result)
+        if _tracer().enabled:
+            # Completion event joined to the caller's trace: carries the
+            # numbers an operator actually debugs with.
+            with _tracer().attach_context(req.trace_ctx, "decode.sequence") as sp:
+                if sp is not None:
+                    sp.attributes.update(
+                        tokens=len(slot.generated),
+                        finish_reason=reason,
+                        ttft_ms=round(result.ttft_ms, 1),
+                        total_ms=round(result.total_ms, 1),
+                    )
         self.queue.record_batch_completion([req], completed_at_ms=t)
         TOKENS_TOTAL.inc(len(slot.generated), tags={"model": self.model.name})
         self._slots[slot_idx] = _Slot()

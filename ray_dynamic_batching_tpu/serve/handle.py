@@ -14,6 +14,7 @@ from typing import Any, Optional, Tuple
 
 from ray_dynamic_batching_tpu.engine.request import Request, TokenStream
 from ray_dynamic_batching_tpu.serve.router import Router
+from ray_dynamic_batching_tpu.utils.tracing import tracer
 
 
 class DeploymentHandle:
@@ -42,13 +43,18 @@ class DeploymentHandle:
         (ref handle.py:821). ``multiplexed_model_id`` steers routing toward
         replicas already holding that model (ref handle
         ``options(multiplexed_model_id=...)``)."""
-        request = Request(
-            model=self.deployment,
-            payload=payload,
-            slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
-            multiplexed_model_id=multiplexed_model_id,
-        )
-        self.router.assign_request(request, locality_hint=locality_hint)
+        # Span around routing; context rides the request so the replica's
+        # execution span joins the same trace (ref task-metadata
+        # propagation, tracing_helper.py:165,293).
+        with tracer().span("handle.remote", deployment=self.deployment):
+            request = Request(
+                model=self.deployment,
+                payload=payload,
+                slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
+                multiplexed_model_id=multiplexed_model_id,
+                trace_ctx=tracer().inject_context(),
+            )
+            self.router.assign_request(request, locality_hint=locality_hint)
         return request.future
 
     def remote_stream(
@@ -62,13 +68,15 @@ class DeploymentHandle:
         resolves with the final result (ref streaming handle path,
         ``serve/_private/replica.py:515`` ``handle_request_streaming``)."""
         stream = TokenStream()
-        request = Request(
-            model=self.deployment,
-            payload=payload,
-            slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
-            stream=stream,
-        )
-        self.router.assign_request(request, locality_hint=locality_hint)
+        with tracer().span("handle.remote_stream", deployment=self.deployment):
+            request = Request(
+                model=self.deployment,
+                payload=payload,
+                slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
+                stream=stream,
+                trace_ctx=tracer().inject_context(),
+            )
+            self.router.assign_request(request, locality_hint=locality_hint)
         return stream, request.future
 
     def options(self, slo_ms: Optional[float] = None) -> "DeploymentHandle":

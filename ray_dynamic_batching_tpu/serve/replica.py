@@ -21,12 +21,15 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from contextlib import ExitStack
+
 from ray_dynamic_batching_tpu.engine.batching import OpportunisticBatch
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
 from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import tracer
 
 logger = get_logger("replica")
 
@@ -158,9 +161,20 @@ class Replica:
         self._batch_started_at = time.monotonic()
         try:
             chaos().maybe_fail("replica.process_batch")
-            results = self.fn([r.payload for r in batch])
-            if inspect.isgenerator(results):
-                results = self._stream_generator_batch(batch, results)
+            with ExitStack() as spans:
+                if tracer().enabled:
+                    # One execution span per request, joined to its caller's
+                    # trace via the propagated context (ref spans around
+                    # every actor call, tracing_helper.py:293).
+                    for r in batch:
+                        spans.enter_context(
+                            tracer().attach_context(
+                                r.trace_ctx, "replica.execute"
+                            )
+                        )
+                results = self.fn([r.payload for r in batch])
+                if inspect.isgenerator(results):
+                    results = self._stream_generator_batch(batch, results)
             if len(results) != len(batch):
                 raise ValueError(
                     f"callable returned {len(results)} results for "

@@ -300,3 +300,32 @@ class TestAutoSlots:
         # TP shards weights + KV per chip -> more slots fit per chip.
         set_config(RDBConfig.from_env(hbm_budget_bytes=64 << 20))
         assert dep.auto_num_slots(4) >= dep.auto_num_slots(1)
+
+
+class TestTracePropagation:
+    def test_spans_join_one_trace_across_the_serving_path(self, llm_stack):
+        """handle.remote -> replica/engine: spans propagate the caller's
+        trace id via request.trace_ctx (ref task-metadata propagation,
+        tracing_helper.py:165-411)."""
+        from ray_dynamic_batching_tpu.utils.tracing import tracer
+
+        exported = []
+        tracer().set_exporter(exported.append)
+        try:
+            _, handle = llm_stack
+            fut = handle.remote({"tokens": [1, 2, 3], "max_new_tokens": 3})
+            fut.result(timeout=30)
+            deadline = __import__("time").monotonic() + 5
+            while __import__("time").monotonic() < deadline:
+                names = {s.name for s in exported}
+                if {"handle.remote", "decode.sequence"} <= names:
+                    break
+            by_name = {s.name: s for s in exported}
+            client = by_name["handle.remote"]
+            seq = by_name["decode.sequence"]
+            assert seq.trace_id == client.trace_id
+            assert seq.parent_id == client.span_id
+            assert seq.attributes["tokens"] == 3
+            assert seq.attributes["finish_reason"] == "length"
+        finally:
+            tracer().reset()
