@@ -329,3 +329,45 @@ class TestTracePropagation:
             assert seq.attributes["finish_reason"] == "length"
         finally:
             tracer().reset()
+
+
+class TestLLMHeal:
+    @pytest.mark.timeout(240)
+    def test_wedged_engine_replaced_and_serving_resumes(self):
+        """The controller's standard heal path must recover an LLM
+        deployment whose engine loop wedges (engine heartbeat goes stale),
+        and requests after the replacement must serve normally."""
+        import time
+
+        controller = ServeController(control_interval_s=0.1)
+        dep = LLMDeployment(
+            "llama_tiny", num_slots=2, max_len=32, prompt_buckets=[8],
+            default_max_new_tokens=4, dtype=jnp.float32,
+        )
+        router = controller.deploy(
+            DeploymentConfig(name="healme", num_replicas=1, max_restarts=2),
+            factory=dep,
+        )
+        controller.start()
+        handle = DeploymentHandle(router, default_slo_ms=60_000.0)
+        try:
+            assert len(
+                handle.remote({"tokens": [1, 2]}).result(timeout=60).tokens
+            ) == 4
+            victim = controller._deployments["healme"].replicas[0]
+            # Wedge: stop the loop AND freeze its heartbeat in the past so
+            # healthy() (thread dead or stalled) goes false either way.
+            victim.engine._run.clear()
+            victim.engine.last_heartbeat -= 3600.0
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                reps = controller._deployments["healme"].replicas
+                if reps and reps[0] is not victim and reps[0].healthy():
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("wedged LLM replica was not replaced")
+            out = handle.remote({"tokens": [3, 4]}).result(timeout=60)
+            assert len(out.tokens) == 4
+        finally:
+            controller.shutdown()

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# One-command verification: the full pyramid the round-end driver samples.
+#   tools/ci.sh          everything (tests + native sanitizers + dryrun)
+#   tools/ci.sh fast     tests only
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== pytest (fake 8-chip CPU cluster) =="
+python -m pytest tests/ -q
+
+if [ "${1:-}" != "fast" ]; then
+  echo "== native stress + ThreadSanitizer =="
+  make -C native check
+
+  echo "== multichip dryrun (virtual 8-device mesh) =="
+  python __graft_entry__.py 8
+fi
+
+echo "CI OK"
