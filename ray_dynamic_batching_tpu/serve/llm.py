@@ -31,7 +31,8 @@ Result: ``DecodeResult`` (tokens, finish_reason, ttft_ms, total_ms).
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, List, Optional, Sequence
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
@@ -43,84 +44,169 @@ logger = get_logger("serve.llm")
 
 
 class LLMReplica(Replica):
-    """One decode engine behind the standard replica surface.
+    """One or more decode engines behind the standard replica surface.
 
-    ``engine_builder`` receives this replica's request queue and returns a
-    ready (constructed, un-started) :class:`DecodeEngine` — weights loaded
-    and sharded however the deployment wants (single chip, TP mesh slice).
+    ``engine_builders`` maps a KV-capacity bucket (max_len) to a builder
+    that receives that bucket's request queue and returns a ready
+    (constructed, un-started) :class:`DecodeEngine` — weights loaded and
+    sharded however the deployment wants (single chip, TP mesh slice).
+
+    **Capacity buckets are the TPU-first answer to paged KV**: decode
+    attention reads the FULL cache capacity every step (static shapes), so
+    a short request in a long cache pays long-cache bandwidth per token.
+    With several engines at different max_len, admission routes each
+    request to the smallest cache that fits prompt + max_new_tokens —
+    bandwidth per token scales with the request's own length class, no
+    gather-heavy paging kernels needed.
+
     Engine warmup (XLA compiles for every prompt bucket + both decode
-    horizons) runs at construction, mirroring how the controller treats slow
-    replica starts: a replica is registered with the router only after it
-    can serve its first request at full speed.
+    horizons) runs at construction, mirroring how the controller treats
+    slow replica starts: a replica is registered with the router only after
+    it can serve its first request at full speed.
     """
 
     def __init__(
         self,
         replica_id: str,
         deployment: str,
-        engine_builder: Callable[[RequestQueue], DecodeEngine],
+        engine_builders: Dict[int, Callable[[RequestQueue], DecodeEngine]],
         max_ongoing_requests: int = 256,
         warmup: bool = True,
+        default_max_new_tokens: int = 64,
     ) -> None:
         super().__init__(
             replica_id=replica_id,
             deployment=deployment,
-            fn=self._reject_batch_path,  # engine owns execution, not the loop
+            fn=self._reject_batch_path,  # engines own execution, not the loop
             max_ongoing_requests=max_ongoing_requests,
         )
-        self.engine = engine_builder(self.queue)
+        self.default_max_new_tokens = default_max_new_tokens
+        # The base class's queue carries no traffic here (admission routes
+        # straight to the per-bucket queues below); close it so nothing can
+        # mistake it for a live path.
+        self.queue.close()
+        self.engines: Dict[int, DecodeEngine] = {}
+        self._queues: Dict[int, RequestQueue] = {}
+        for bucket in sorted(engine_builders):
+            q = RequestQueue(
+                f"{deployment}:{bucket}", max_len=max_ongoing_requests
+            )
+            self._queues[bucket] = q
+            self.engines[bucket] = engine_builders[bucket](q)
         if warmup:
-            self.engine.warmup()
+            for engine in self.engines.values():
+                engine.warmup()
+
+    @property
+    def engine(self) -> DecodeEngine:
+        """The largest-capacity engine (single-engine deployments have
+        exactly one; multi-bucket callers should use :attr:`engines`)."""
+        return self.engines[max(self.engines)]
 
     @staticmethod
     def _reject_batch_path(payloads: List[Any]) -> Sequence[Any]:
-        raise RuntimeError("LLMReplica executes via its DecodeEngine")
+        raise RuntimeError("LLMReplica executes via its DecodeEngines")
 
-    # --- lifecycle: the engine loop replaces the batch loop ----------------
+    # --- admission: route by required KV capacity --------------------------
+    def _required_capacity(self, payload: Any) -> int:
+        max_new = self.default_max_new_tokens
+        tokens = payload
+        if isinstance(payload, dict):
+            tokens = payload.get("tokens", ())
+            max_new = int(payload.get("max_new_tokens", max_new))
+        try:
+            prompt_len = len(tokens)
+        except TypeError:
+            prompt_len = 1
+        return prompt_len + max_new
+
+    def _engine_for(self, payload: Any) -> int:
+        need = self._required_capacity(payload)
+        for bucket in sorted(self.engines):
+            if bucket >= need:
+                return bucket
+        # Oversized: the largest engine finishes it with reason=capacity
+        # (same contract as a single-engine replica).
+        return max(self.engines)
+
+    def assign(self, request: Request) -> bool:
+        if not self.accepting():
+            return False
+        q = self._queues[self._engine_for(request.payload)]
+        ok = q.add_request(request, reject_on_full=False)
+        if ok and request.multiplexed_model_id:
+            # Same contract as the base class: warm-model routing needs the
+            # LRU recorded on every accepted assignment.
+            self.record_multiplexed_model(request.multiplexed_model_id)
+        return ok
+
+    # --- lifecycle: the engine loops replace the batch loop ----------------
     def start(self) -> None:
-        self.engine.start()
+        for engine in self.engines.values():
+            engine.start()
 
     def stop(self, timeout_s: float = 5.0, drain: bool = True) -> None:
-        import time
-
         self._stopped = True
         if drain:
             deadline = time.monotonic() + timeout_s
             while self.queue_len() > 0 and time.monotonic() < deadline:
                 time.sleep(0.01)
-        self.engine.stop(timeout_s)
-        self.queue.close()
-        # Requests still mid-decode in engine slots AND requests still
-        # queued both terminate with a rejection — futures/streams must
-        # never dangle past replica death.
         exc = RequestDropped(f"{self.replica_id} stopped")
-        self.engine.abort_active(exc)
+        # Signal every loop BEFORE joining any, then join under one shared
+        # deadline — N wedged engines must cost ~timeout_s total, not
+        # N * timeout_s of control-plane stall.
+        for engine in self.engines.values():
+            engine._run.clear()
+        join_deadline = time.monotonic() + timeout_s
+        for engine in self.engines.values():
+            engine.stop(max(0.1, join_deadline - time.monotonic()))
+        for bucket, q in self._queues.items():
+            q.close()
+            # Requests still mid-decode in engine slots terminate with a
+            # rejection — futures/streams must never dangle past death.
+            self.engines[bucket].abort_active(exc)
         for req in self.drain_queue():
             req.reject(exc)
-        # Free HBM (params + cache) so a replacement on the same chip
+        # Free HBM (params + caches) so a replacement on the same chip
         # doesn't OOM against this replica's dead buffers — but only if the
         # loop actually exited; a wedged device call may still be touching
         # them, and dropping the references mid-flight trades a leak for a
         # use-after-free-style crash.
-        t = self.engine._thread
-        if t is None or not t.is_alive():
-            self.engine.release_buffers()
+        for engine in self.engines.values():
+            t = engine._thread
+            if t is None or not t.is_alive():
+                engine.release_buffers()
+
+    def drain_queue(self) -> List[Request]:
+        self._stopped = True
+        out: List[Request] = []
+        for q in self._queues.values():
+            while len(q) > 0:
+                out.extend(
+                    q.get_batch(self.max_ongoing_requests,
+                                discard_stale=False)
+                )
+        return out
 
     # --- router-facing surface --------------------------------------------
     def queue_len(self) -> int:
-        return len(self.queue) + self.engine.active_slots
+        return sum(
+            len(q) + self.engines[b].active_slots
+            for b, q in self._queues.items()
+        )
 
     def healthy(self, stall_timeout_s: float = 60.0) -> bool:
-        """Thread liveness + progress: the engine loop refreshes its
-        heartbeat only on successful iterations, so a perpetually-failing
-        or wedged _step reads unhealthy and the controller replaces the
-        replica (same stall contract as the base class)."""
-        import time
-
-        t = self.engine._thread
-        if t is None or not t.is_alive():
-            return False
-        return (time.monotonic() - self.engine.last_heartbeat) < stall_timeout_s
+        """Thread liveness + progress for EVERY engine: the loop refreshes
+        its heartbeat only on successful iterations, so a perpetually-
+        failing or wedged _step reads unhealthy and the controller replaces
+        the replica (same stall contract as the base class)."""
+        for engine in self.engines.values():
+            t = engine._thread
+            if t is None or not t.is_alive():
+                return False
+            if (time.monotonic() - engine.last_heartbeat) >= stall_timeout_s:
+                return False
+        return True
 
     def reconfigure(
         self,
@@ -132,14 +218,31 @@ class LLMReplica(Replica):
         # on a live engine; only admission-side knobs apply.
         if max_ongoing_requests is not None:
             self.max_ongoing_requests = max_ongoing_requests
-            self.queue.max_len = max_ongoing_requests
+            for q in self._queues.values():
+                q.max_len = max_ongoing_requests
 
     def stats(self) -> dict:
-        s = self.queue.stats()
+        s: dict = {}
+        if len(self._queues) == 1:
+            # Single-bucket replicas keep the flat queue-stat shape external
+            # monitors already read (depth, slo_compliance, latency pcts).
+            s.update(next(iter(self._queues.values())).stats())
+        for bucket, q in self._queues.items():
+            engine = self.engines[bucket]
+            s[f"bucket_{bucket}"] = {
+                **q.stats(),
+                "active_slots": float(engine.active_slots),
+                "decode_steps": float(engine.steps),
+                "completed": float(engine.completed),
+            }
         s["ongoing"] = float(self.queue_len())
-        s["active_slots"] = float(self.engine.active_slots)
-        s["decode_steps"] = float(self.engine.steps)
-        s["completed"] = float(self.engine.completed)
+        s["active_slots"] = float(
+            sum(e.active_slots for e in self.engines.values())
+        )
+        s["decode_steps"] = float(sum(e.steps for e in self.engines.values()))
+        s["completed"] = float(
+            sum(e.completed for e in self.engines.values())
+        )
         return s
 
 
@@ -166,6 +269,7 @@ class LLMDeployment:
         params: Any = None,
         model: Any = None,
         warmup: bool = True,
+        length_buckets: Optional[Sequence[int]] = None,
     ) -> None:
         self.model_name = model_name
         self.num_slots = num_slots
@@ -176,6 +280,11 @@ class LLMDeployment:
         self.decode_horizon = decode_horizon
         self.max_admissions_per_step = max_admissions_per_step
         self.warmup = warmup
+        # KV-capacity buckets: one engine per entry, requests routed to the
+        # smallest cache fitting prompt + max_new (LLMReplica docstring —
+        # the static-shape alternative to paged attention). Default: one
+        # engine at max_len.
+        self.length_buckets = sorted(length_buckets or [max_len])
         self._dtype = dtype
         self._model = model
         self._params = params
@@ -194,7 +303,9 @@ class LLMDeployment:
 
                 self._params = self._model.init(jax.random.PRNGKey(0))
 
-    def auto_num_slots(self, n_chips: int = 1) -> int:
+    def auto_num_slots(self, n_chips: int = 1,
+                       max_len: Optional[int] = None,
+                       budget_fraction: float = 1.0) -> int:
         """Size the continuous batch from the HBM budget (directive: slots
         from profile/HBM, not a guess): per CHIP, subtract this chip's
         weight shard, apply the planner's HBM fraction
@@ -216,9 +327,11 @@ class LLMDeployment:
         ) / max(1, n_chips)
         budget = float(cfg.hbm_budget_bytes)
         per_slot = float(
-            self._model.kv_bytes_per_slot(self.max_len)
+            self._model.kv_bytes_per_slot(max_len or self.max_len)
         ) / max(1, n_chips)
-        usable = (budget - weights_bytes) * cfg.hbm_plan_fraction
+        usable = (
+            (budget - weights_bytes) * cfg.hbm_plan_fraction * budget_fraction
+        )
         n = int(max(1.0, usable / max(per_slot, 1.0)))
         n = min(n, 256)
         n = 2 ** int(np.log2(n)) if n > 1 else 1
@@ -231,20 +344,29 @@ class LLMDeployment:
         return n
 
     def build_engine(
-        self, queue: RequestQueue, device: Any = None, mesh: Any = None
+        self, queue: RequestQueue, device: Any = None, mesh: Any = None,
+        max_len: Optional[int] = None,
     ) -> DecodeEngine:
         self._ensure_model()
+        max_len = max_len or self.max_len
         num_slots = self.num_slots
         if num_slots <= 0:
             n_chips = mesh.devices.size if mesh is not None else 1
-            num_slots = self.auto_num_slots(n_chips)
+            num_slots = self.auto_num_slots(
+                n_chips, max_len=max_len,
+                budget_fraction=1.0 / len(self.length_buckets),
+            )
+        prompt_buckets = self.prompt_buckets
+        if prompt_buckets is not None:
+            fitting = [b for b in prompt_buckets if b <= max_len]
+            prompt_buckets = fitting or [max_len]
         return DecodeEngine(
             self._model,
             self._params,
             queue,
             num_slots=num_slots,
-            max_len=self.max_len,
-            prompt_buckets=self.prompt_buckets,
+            max_len=max_len,
+            prompt_buckets=prompt_buckets,
             eos_token_id=self.eos_token_id,
             default_max_new_tokens=self.default_max_new_tokens,
             decode_horizon=self.decode_horizon,
@@ -274,14 +396,21 @@ class LLMDeployment:
             mesh = build_mesh(MeshConfig(tp=len(devices)), list(devices))
         elif devices:
             device = devices[0]
+        builders = {
+            bucket: (
+                lambda q, b=bucket: self.build_engine(
+                    q, device=device, mesh=mesh, max_len=b
+                )
+            )
+            for bucket in self.length_buckets
+        }
         replica = LLMReplica(
             replica_id=replica_id,
             deployment=config.name,
-            engine_builder=lambda q: self.build_engine(
-                q, device=device, mesh=mesh
-            ),
+            engine_builders=builders,
             max_ongoing_requests=config.max_ongoing_requests,
             warmup=self.warmup,
+            default_max_new_tokens=self.default_max_new_tokens,
         )
         replica.devices = list(devices) if devices else None
         return replica

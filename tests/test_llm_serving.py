@@ -371,3 +371,59 @@ class TestLLMHeal:
             assert len(out.tokens) == 4
         finally:
             controller.shutdown()
+
+
+class TestLengthBuckets:
+    @pytest.mark.timeout(240)
+    def test_requests_route_to_smallest_fitting_cache(self):
+        """Capacity-bucketed engines (the static-shape alternative to paged
+        KV): short requests decode in the small cache, long ones in the
+        large; oversized falls back to the largest and finishes by
+        capacity."""
+        controller = ServeController(control_interval_s=0.2)
+        dep = LLMDeployment(
+            "llama_tiny", num_slots=2, max_len=64, prompt_buckets=[8],
+            default_max_new_tokens=4, dtype=jnp.float32,
+            length_buckets=[16, 64],
+        )
+        router = controller.deploy(
+            DeploymentConfig(name="buckets", num_replicas=1), factory=dep,
+        )
+        handle = DeploymentHandle(router, default_slo_ms=60_000.0)
+        import time as _time
+
+        def wait_completed(engine, n, timeout=10.0):
+            # completed increments AFTER the future fulfills — poll briefly
+            deadline = _time.monotonic() + timeout
+            while engine.completed < n and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            assert engine.completed == n
+
+        try:
+            replica = controller._deployments["buckets"].replicas[0]
+            assert sorted(replica.engines) == [16, 64]
+            # prompt 3 + max_new 4 = 7 <= 16 -> small engine
+            short = handle.remote({"tokens": [1, 2, 3], "max_new_tokens": 4})
+            assert len(short.result(timeout=60).tokens) == 4
+            wait_completed(replica.engines[16], 1)
+            assert replica.engines[64].completed == 0
+            # prompt 6 + max_new 20 = 26 > 16 -> large engine
+            long = handle.remote(
+                {"tokens": [1, 2, 3, 4, 5, 6], "max_new_tokens": 20}
+            )
+            assert len(long.result(timeout=60).tokens) == 20
+            wait_completed(replica.engines[64], 1)
+            # oversized (needs 8 + 200 > 64): largest engine, capacity finish
+            over = handle.remote(
+                {"tokens": [1] * 8, "max_new_tokens": 200}
+            )
+            result = over.result(timeout=60)
+            assert result.finish_reason == "capacity"
+            wait_completed(replica.engines[64], 2)
+            # per-bucket stats surface
+            stats = replica.stats()
+            assert stats["bucket_16"]["completed"] == 1.0
+            assert stats["bucket_64"]["completed"] == 2.0
+            assert stats["completed"] == 3.0
+        finally:
+            controller.shutdown()
