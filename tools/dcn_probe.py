@@ -1,0 +1,74 @@
+"""Two-process JAX distributed probe: one global mesh over DCN (loopback).
+
+Each process owns 4 CPU devices; together they form an 8-device global
+mesh and run a cross-process psum — the data-plane analogue of the
+reference's NCCL multi-node allreduce, on JAX's distributed runtime.
+Usage: python tools/dcn_probe.py [port]
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+
+
+def worker(pid: int, port: int, q) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    try:
+        from ray_dynamic_batching_tpu.parallel.mesh import multihost_init
+
+        info = multihost_init(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=2,
+            process_id=pid,
+        )
+        import numpy as np
+        import jax.numpy as jnp  # noqa: F401
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()  # global view: 8 devices across 2 processes
+        mesh = Mesh(np.array(devs).reshape(8), ("dp",))
+        x = jax.make_array_from_callback(
+            (8,),
+            NamedSharding(mesh, P("dp")),
+            lambda idx: np.arange(8, dtype=np.float32)[idx],
+        )
+        total = jax.jit(
+            lambda a: a.sum(), out_shardings=NamedSharding(mesh, P())
+        )(x)
+        local = float(np.asarray(total.addressable_shards[0].data))
+        q.put((pid, info["process_count"], len(devs), local))
+    except Exception as e:  # noqa: BLE001 — probe reports, never raises
+        q.put((pid, -1, -1, f"{type(e).__name__}: {e}"))
+
+
+def main(port: int = 12399) -> int:
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    ps = [ctx.Process(target=worker, args=(i, port, q)) for i in range(2)]
+    for p in ps:
+        p.start()
+    results = []
+    try:
+        for _ in range(2):
+            results.append(q.get(timeout=150))
+    finally:
+        for p in ps:
+            p.join(10)
+            if p.is_alive():
+                p.kill()
+    ok = all(
+        r[1] == 2 and r[2] == 8 and r[3] == 28.0 for r in results
+    )
+    print(f"results: {sorted(results)}")
+    print("DCN PROBE OK" if ok else "DCN PROBE FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 12399))
