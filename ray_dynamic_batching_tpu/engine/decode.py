@@ -125,6 +125,7 @@ class DecodeEngine:
         idle_wait_s: float = 0.005,
         sample_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
         decode_horizon: int = 8,
+        ttft_horizon: Optional[int] = None,
         max_admissions_per_step: int = 2,
         device: Optional[jax.Device] = None,
         mesh: Optional[Any] = None,
@@ -178,6 +179,16 @@ class DecodeEngine:
         self._seeds = np.zeros((num_slots,), dtype=np.int32)
 
         self.decode_horizon = max(1, int(decode_horizon))
+        # Bound on admission latency while slots are free: an arrival during
+        # a compiled scan cannot be admitted until the scan returns, so the
+        # idle-queue horizon caps TTFT at ttft_horizon * per-step latency
+        # instead of decode_horizon * per-step latency (~4x shorter by
+        # default). Full horizon still runs when the batch is full, where
+        # admission is impossible anyway and throughput is the constraint.
+        if ttft_horizon is None:
+            ttft_horizon = max(1, self.decode_horizon // 4)
+        self.ttft_horizon = min(max(1, int(ttft_horizon)),
+                                self.decode_horizon)
         self.max_admissions_per_step = max(1, int(max_admissions_per_step))
         self._prefill_fns: Dict[int, Callable] = {}
         self._decode_fn = jax.jit(
@@ -351,7 +362,7 @@ class DecodeEngine:
                     jnp.zeros((g,), jnp.int32),
                 )
                 first.block_until_ready()
-        for h in {1, self.decode_horizon}:
+        for h in {1, self.ttft_horizon, self.decode_horizon}:
             packed, self._cache = self._decode_fn(
                 self.params,
                 self._cache,
@@ -369,8 +380,9 @@ class DecodeEngine:
             lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
         )
         logger.info(
-            "%s: warmed %d prefill programs + decode horizons {1, %d}",
-            self.model.name, len(self._prefill_fns), self.decode_horizon,
+            "%s: warmed %d prefill programs + decode horizons {1, %d, %d}",
+            self.model.name, len(self._prefill_fns), self.ttft_horizon,
+            self.decode_horizon,
         )
 
     # --- admission ---------------------------------------------------------
@@ -579,12 +591,17 @@ class DecodeEngine:
         self.completed += 1
 
     def _pick_horizon(self) -> int:
-        """Long horizon only when no admission could happen during it:
-        batch full, or nothing waiting. Otherwise single steps keep TTFT low."""
+        """Three-tier horizon: full scan only when the batch is full (no
+        admission possible — throughput-bound), single steps while requests
+        wait for a free slot (admit ASAP), and the short ``ttft_horizon``
+        when slots are free but nothing is queued — so an arrival during the
+        scan waits at most ttft_horizon substeps, not decode_horizon."""
         if self.decode_horizon <= 1:
             return 1
-        if not self._free_slots() or len(self.queue) == 0:
+        if not self._free_slots():
             return self.decode_horizon
+        if len(self.queue) == 0:
+            return self.ttft_horizon
         return 1
 
     def _step(self, horizon: Optional[int] = None) -> None:

@@ -264,6 +264,7 @@ class LLMDeployment:
         eos_token_id: Optional[int] = None,
         default_max_new_tokens: int = 64,
         decode_horizon: int = 8,
+        ttft_horizon: Optional[int] = None,
         max_admissions_per_step: int = 2,
         dtype: Any = None,
         params: Any = None,
@@ -278,6 +279,7 @@ class LLMDeployment:
         self.eos_token_id = eos_token_id
         self.default_max_new_tokens = default_max_new_tokens
         self.decode_horizon = decode_horizon
+        self.ttft_horizon = ttft_horizon
         self.max_admissions_per_step = max_admissions_per_step
         self.warmup = warmup
         # KV-capacity buckets: one engine per entry, requests routed to the
@@ -370,6 +372,7 @@ class LLMDeployment:
             eos_token_id=self.eos_token_id,
             default_max_new_tokens=self.default_max_new_tokens,
             decode_horizon=self.decode_horizon,
+            ttft_horizon=self.ttft_horizon,
             max_admissions_per_step=self.max_admissions_per_step,
             device=device,
             mesh=mesh,

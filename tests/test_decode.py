@@ -221,7 +221,8 @@ class TestStreamingAndHorizon:
             return real_fn(*args)
 
         multi._decode_fn = counting_fn
-        prompts = [[5, 9, 2, 7], [3, 1, 4], [11, 13]]
+        # Four prompts fill the 4-slot batch: the full horizon tier runs.
+        prompts = [[5, 9, 2, 7], [3, 1, 4], [11, 13], [6, 8, 10]]
         reqs1 = [submit(q1, p, max_new_tokens=9) for p in prompts]
         reqs2 = [submit(q2, p, max_new_tokens=9) for p in prompts]
         single.run_until_idle()
@@ -231,9 +232,38 @@ class TestStreamingAndHorizon:
             t2 = r2.future.result(timeout=5).tokens
             assert t1 == t2
         # The scan path must actually amortize: at least one multi-step
-        # dispatch, and fewer dispatches than tokens generated (27).
+        # dispatch, and fewer dispatches than tokens generated (36).
         assert any(h > 1 for h in dispatches)
-        assert len(dispatches) < 27
+        assert len(dispatches) < 36
+
+    def test_three_tier_horizon_policy(self, lm):
+        """Full scan only when the batch is full; the short ttft_horizon
+        while slots are free with an empty queue (bounds admission latency);
+        single steps while requests wait for a slot."""
+        engine, queue = make_engine(
+            lm, num_slots=2, decode_horizon=8, ttft_horizon=2
+        )
+        assert engine.ttft_horizon == 2
+        # Free slots + empty queue -> ttft tier.
+        r1 = submit(queue, [1, 2], max_new_tokens=16)
+        engine._admit()
+        assert engine._pick_horizon() == 2
+        # Batch full -> full horizon regardless of the queue.
+        r2 = submit(queue, [3, 4], max_new_tokens=16)
+        engine._admit()
+        assert not engine._free_slots()
+        assert engine._pick_horizon() == 8
+        # Free slot + waiting request -> single step (admit ASAP).
+        submit(queue, [5, 6], max_new_tokens=4)
+        engine._finish(0, "length")
+        assert engine._pick_horizon() == 1
+        engine.run_until_idle()
+        assert engine.completed == 3
+        # ttft_horizon is clamped to decode_horizon and derived when omitted.
+        derived, _ = make_engine(lm, decode_horizon=8)
+        assert derived.ttft_horizon == 2
+        clamped, _ = make_engine(lm, decode_horizon=2, ttft_horizon=64)
+        assert clamped.ttft_horizon == 2
 
     def test_admission_cap_interleaves(self, lm):
         """While slots are DECODING, _admit is capped (prefills must

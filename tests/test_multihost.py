@@ -12,41 +12,20 @@ file proves the tensor plane.
 """
 
 import multiprocessing as mp
-import os
+import socket
 
 import pytest
 
+from tools.dcn_probe import init_and_psum
+
 
 def _worker(pid: int, port: int, q) -> None:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
     try:
-        from ray_dynamic_batching_tpu.parallel.mesh import multihost_init
-
-        info = multihost_init(
-            coordinator_address=f"127.0.0.1:{port}",
-            num_processes=2,
-            process_id=pid,
-        )
+        # Shared with tools/dcn_probe.py: cluster join + global psum.
+        info, devs, psum_val = init_and_psum(pid, port)
+        import jax
         import numpy as np
         import jax.numpy as jnp
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-        devs = jax.devices()
-        # --- global psum across the process boundary ---------------------
-        mesh1 = Mesh(np.array(devs).reshape(8), ("dp",))
-        x = jax.make_array_from_callback(
-            (8,),
-            NamedSharding(mesh1, P("dp")),
-            lambda idx: np.arange(8, dtype=np.float32)[idx],
-        )
-        total = jax.jit(
-            lambda a: a.sum(), out_shardings=NamedSharding(mesh1, P())
-        )(x)
-        psum_val = float(np.asarray(total.addressable_shards[0].data))
 
         # --- TP forward spanning processes -------------------------------
         from ray_dynamic_batching_tpu.models import registry  # noqa: F401
@@ -94,7 +73,11 @@ class TestMultihostDataPlane:
     def test_global_mesh_psum_and_tp_forward_across_processes(self):
         ctx = mp.get_context("spawn")
         q = ctx.Queue()
-        port = 12477
+        # Ephemeral coordinator port: bind-then-release so concurrent suites
+        # (or a stale worker from a killed run) can't collide on a fixed one.
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
         procs = [
             ctx.Process(target=_worker, args=(i, port, q)) for i in range(2)
         ]

@@ -12,37 +12,54 @@ import multiprocessing as mp
 import os
 import sys
 
+# Spawned workers re-exec with the parent's sys.path, which for a direct
+# `python tools/dcn_probe.py` run starts at tools/ — make the repo root
+# importable in both the parent and every worker.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
-def worker(pid: int, port: int, q) -> None:
+
+def init_and_psum(pid: int, port: int):
+    """Join the 2-process cluster and run a global cross-process psum.
+
+    Shared by this probe and tests/test_multihost.py. Must be called
+    BEFORE any other jax initialization in the process. Returns
+    ``(init_info, global_devices, psum_value)``.
+    """
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 4)
+    from ray_dynamic_batching_tpu.parallel.mesh import multihost_init
+
+    info = multihost_init(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()  # global view: 8 devices across 2 processes
+    mesh = Mesh(np.array(devs).reshape(8), ("dp",))
+    x = jax.make_array_from_callback(
+        (8,),
+        NamedSharding(mesh, P("dp")),
+        lambda idx: np.arange(8, dtype=np.float32)[idx],
+    )
+    total = jax.jit(
+        lambda a: a.sum(), out_shardings=NamedSharding(mesh, P())
+    )(x)
+    psum_val = float(np.asarray(total.addressable_shards[0].data))
+    return info, devs, psum_val
+
+
+def worker(pid: int, port: int, q) -> None:
     try:
-        from ray_dynamic_batching_tpu.parallel.mesh import multihost_init
-
-        info = multihost_init(
-            coordinator_address=f"127.0.0.1:{port}",
-            num_processes=2,
-            process_id=pid,
-        )
-        import numpy as np
-        import jax.numpy as jnp  # noqa: F401
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-        devs = jax.devices()  # global view: 8 devices across 2 processes
-        mesh = Mesh(np.array(devs).reshape(8), ("dp",))
-        x = jax.make_array_from_callback(
-            (8,),
-            NamedSharding(mesh, P("dp")),
-            lambda idx: np.arange(8, dtype=np.float32)[idx],
-        )
-        total = jax.jit(
-            lambda a: a.sum(), out_shardings=NamedSharding(mesh, P())
-        )(x)
-        local = float(np.asarray(total.addressable_shards[0].data))
-        q.put((pid, info["process_count"], len(devs), local))
+        info, devs, psum_val = init_and_psum(pid, port)
+        q.put((pid, info["process_count"], len(devs), psum_val))
     except Exception as e:  # noqa: BLE001 — probe reports, never raises
         q.put((pid, -1, -1, f"{type(e).__name__}: {e}"))
 

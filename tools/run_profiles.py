@@ -11,8 +11,11 @@ Usage: python tools/run_profiles.py [out_dir]
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
