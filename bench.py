@@ -205,6 +205,77 @@ def bench_llm_serving(
     }
 
 
+def bench_asr_rtf(batch: int = 8, audio_s: float = 30.0,
+                  decode_tokens: int = 32, repeats: int = 3,
+                  model_name: str = "whisper_large_v3") -> dict:
+    """Whisper-large-v3 real-time factor: seconds of audio transcribed per
+    wall second. One compiled program runs encode + SOT prefill + a
+    ``decode_tokens``-step greedy scan for a full batch of 30 s clips; the
+    sampled tokens are host-fetched (the only honest completion signal on
+    the axon tunnel). The reference ships no ASR at all, so the baseline is
+    real time (RTF 1.0)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+    from ray_dynamic_batching_tpu.models.base import get_model
+
+    model = get_model(model_name)  # bf16
+    cfg = model.cfg
+    params = model.init(jax.random.PRNGKey(0))
+    frames = int(audio_s * 100)  # 10 ms mel frames
+
+    def transcribe(params, mel, mel_mask):
+        enc_states, enc_mask = model.encode(params, mel, mel_mask)
+        cache = model.make_cache(batch, max_len=decode_tokens + 8)
+        sot = jnp.full((batch, 1), cfg.sot_token, jnp.int32)
+        last, cache = model.prefill(
+            params, sot, jnp.ones_like(sot), enc_states, enc_mask, cache
+        )
+        tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+        def step(carry, _):
+            tok, cache = carry
+            logits, cache = model.decode_step(
+                params, tok[:, None], enc_states, enc_mask, cache,
+                jnp.ones((batch,), bool),
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, cache), nxt
+
+        (_, _), toks = jax.lax.scan(
+            step, (tok0, cache), None, length=decode_tokens - 1
+        )
+        return toks  # [decode_tokens-1, B]
+
+    fn = jax.jit(transcribe)
+    rng = np.random.default_rng(3)
+    mel = jnp.asarray(
+        rng.standard_normal((batch, frames, cfg.n_mels)), jnp.float32
+    )
+    mel_mask = jnp.ones((batch, frames), jnp.int32)
+    np.asarray(fn(params, mel, mel_mask))  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(fn(params, mel, mel_mask))  # fetch = completion
+        times.append(time.perf_counter() - t0)
+    dt = statistics.median(times)
+    rtf = batch * audio_s / dt
+    _log(f"{model_name} b{batch}x{audio_s:.0f}s: {dt * 1000:.0f} ms "
+         f"-> RTF {rtf:.1f}x real time (median of {repeats})")
+    return {
+        "model": model_name,
+        "rtf": round(rtf, 1),
+        "batch": batch,
+        "audio_s": audio_s,
+        "decode_tokens": decode_tokens,
+        "latency_ms": round(dt * 1000, 1),
+        "vs_baseline": round(rtf, 1),  # baseline = real time (RTF 1.0)
+    }
+
+
 def probe_device(timeout_s: float = 120.0) -> Optional[str]:
     """Run a tiny op in a SUBPROCESS with a hard timeout: a wedged
     accelerator tunnel must produce a diagnostic JSON line, not hang the
@@ -264,6 +335,18 @@ def main() -> dict:
             _log(f"{name} failed entirely: {e}")
             row = {"error": str(e)}
         vision[name] = row
+    try:
+        # Fast mode swaps in the tiny ASR config and short audio: the
+        # point is exercising the path, not timing a 1.6B-param encoder.
+        asr = bench_asr_rtf(
+            batch=2 if fast else 8,
+            audio_s=2.0 if fast else 30.0,
+            decode_tokens=8 if fast else 32,
+            model_name="whisper_tiny_test" if fast else "whisper_large_v3",
+        )
+    except Exception as e:  # noqa: BLE001 — ASR must not kill the bench
+        _log(f"asr failed entirely: {e}")
+        asr = {"error": str(e)}
     return {
         "metric": "llm_tok_s_per_chip",
         "value": llm["tok_s_per_chip"],
@@ -273,6 +356,7 @@ def main() -> dict:
         "ttft_p99_ms": llm["ttft_p99_ms"],
         "llm": llm,
         "vision": vision,
+        "asr": asr,
     }
 
 

@@ -180,12 +180,22 @@ class SquishyBinPacker:
         slo = self._effective_slo(session)
         rate = max(session.rate_rps, 1e-9)
         chosen = rows[0]
+        feasible = False
         for cand in rows:
             fill_ms = cand.batch_size / rate * 1000.0
             if worst_latency_ms(cand) + fill_ms <= slo:
                 chosen = cand
+                feasible = True
         wl = worst_latency_ms(chosen)
         duty = max(chosen.batch_size / rate * 1000.0, wl)
+        if not feasible:
+            # Even the smallest bucket cannot FILL within the SLO at this
+            # arrival rate (the ref's duty = batch/rate, nexus.py:263-268,
+            # would stretch the cycle past the deadline and every queued
+            # request would wait it out). Serve under-filled batches
+            # instead: bound the cycle by the SLO headroom so wait-one-
+            # cycle + compute still fits. Costs occupancy, holds the SLO.
+            duty = max(min(duty, slo - wl), wl)
         return NodePlan(
             placements=[
                 Placement(

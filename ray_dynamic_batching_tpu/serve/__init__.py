@@ -6,6 +6,16 @@ requests over replicas with power-of-two-choices; replicas run user
 callables with size-or-timeout batching; config changes flow over long poll.
 """
 
+from ray_dynamic_batching_tpu.serve.api import (
+    Application,
+    Deployment,
+    batch,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+)
 from ray_dynamic_batching_tpu.serve.autoscaling import (
     AutoscalingConfig,
     AutoscalingPolicy,
@@ -22,6 +32,14 @@ from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.serve.router import Router
 
 __all__ = [
+    "Application",
+    "Deployment",
+    "batch",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
     "AutoscalingConfig",
     "AutoscalingPolicy",
     "DeploymentConfig",

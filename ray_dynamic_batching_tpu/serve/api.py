@@ -1,0 +1,280 @@
+"""Developer-facing serve API: ``@deployment`` / ``bind`` / ``run`` /
+``@batch`` — the reference's headline surface
+(``python/ray/serve/api.py:463`` ``serve.run``, ``@serve.deployment``,
+``@serve.batch`` at ``serve/batching.py:530``), re-created over the
+TPU-native controller/router/replica stack.
+
+Semantics kept from the reference:
+
+- ``@deployment`` wraps a class or function into a :class:`Deployment`;
+  ``.options(**overrides)`` returns a modified copy (ref
+  ``Deployment.options``); ``.bind(*args, **kwargs)`` captures init args
+  into an :class:`Application` for :func:`run`.
+- User callables are PER-REQUEST by default — one payload in, one result
+  out. Opting into batch execution is explicit via ``@batch`` (ref: Serve
+  replicas call the user method per request unless ``@serve.batch``
+  aggregates them), and the batch wrapper may be a generator that yields
+  per-wave results for streaming (ref ``batching.py:209-276``).
+- ``run`` deploys onto a module-level controller (created on first use —
+  the singleton role of Serve's controller actor), returns a
+  :class:`DeploymentHandle`, and optionally publishes an HTTP route when
+  given a proxy (ref ``serve.run(..., route_prefix=...)``).
+
+Differences, by TPU-first design: deployments are threads + compiled XLA
+programs in one process (or process workers via ``runtime.cluster``), not
+Ray actors, so ``bind`` does not build a multi-node DAG — it captures
+constructor state for replica factories.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_dynamic_batching_tpu.serve.controller import (
+    DeploymentConfig,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.api")
+
+_BATCH_ATTR = "_rdb_batch_options"
+
+
+def batch(
+    _fn: Optional[Callable] = None,
+    *,
+    max_batch_size: int = 8,
+    batch_wait_timeout_s: float = 0.005,
+) -> Callable:
+    """Mark a callable as batch-executing (ref ``@serve.batch``,
+    ``serve/batching.py:530``): the replica hands it the whole collected
+    wave as a list and it returns one result per element (or yields lists
+    incrementally — generator batching). The size/timeout knobs become the
+    deployment's batching config, runtime-tunable exactly like the
+    reference's ``set_max_batch_size`` (``batching.py:369-386``) through
+    ``Replica.reconfigure``."""
+
+    def wrap(fn: Callable) -> Callable:
+        setattr(fn, _BATCH_ATTR, {
+            "max_batch_size": int(max_batch_size),
+            "batch_wait_timeout_s": float(batch_wait_timeout_s),
+        })
+        return fn
+
+    return wrap if _fn is None else wrap(_fn)
+
+
+class Application:
+    """A deployment bound to its constructor arguments (ref
+    ``Deployment.bind`` building an app graph node)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    @property
+    def name(self) -> str:
+        return self.deployment.name
+
+
+class Deployment:
+    """A user callable plus its deployment options (ref serve.Deployment)."""
+
+    def __init__(self, target: Callable, config: DeploymentConfig):
+        self._target = target
+        self._config = config
+        functools.update_wrapper(self, target, updated=())
+
+    @property
+    def name(self) -> str:
+        return self._config.name
+
+    def options(self, **overrides: Any) -> "Deployment":
+        """Copy with config overrides (ref Deployment.options)."""
+        cfg_fields = {f for f in DeploymentConfig.__dataclass_fields__}
+        bad = set(overrides) - cfg_fields
+        if bad:
+            raise TypeError(f"unknown deployment options: {sorted(bad)}")
+        merged = DeploymentConfig.from_json(
+            {**self._config.to_json(), **{
+                k: v for k, v in overrides.items() if k != "autoscaling"
+            }}
+        )
+        if "autoscaling" in overrides:
+            merged.autoscaling = overrides["autoscaling"]
+        return Deployment(self._target, merged)
+
+    def bind(self, *args: Any, **kwargs: Any) -> Application:
+        return Application(self, args, kwargs)
+
+    def _make_factory(
+        self, args: tuple, kwargs: dict
+    ) -> Callable[[], Callable[[List[Any]], Sequence[Any]]]:
+        """Replica factory: constructs the user callable per replica, then
+        adapts per-request callables to the replica's batch contract."""
+        target = self._target
+
+        def factory() -> Callable[[List[Any]], Sequence[Any]]:
+            if inspect.isclass(target):
+                instance = target(*args, **kwargs)
+                call = instance.__call__
+                # The batch marker may sit on the (unbound) class __call__.
+                marked = getattr(
+                    type(instance).__call__, _BATCH_ATTR,
+                    getattr(call, _BATCH_ATTR, None),
+                )
+            else:
+                if args or kwargs:
+                    call = functools.partial(target, *args, **kwargs)
+                else:
+                    call = target
+                marked = getattr(target, _BATCH_ATTR, None)
+
+            if marked is not None or inspect.isgeneratorfunction(
+                inspect.unwrap(getattr(call, "func", call))
+            ):
+                return call  # already list -> list (or generator)
+
+            def per_request(payloads: List[Any]) -> List[Any]:
+                return [call(p) for p in payloads]
+
+            return per_request
+
+        return factory
+
+    def batch_options(self) -> Optional[Dict[str, float]]:
+        target = self._target
+        if inspect.isclass(target):
+            return getattr(target.__call__, _BATCH_ATTR, None)
+        return getattr(target, _BATCH_ATTR, None)
+
+
+def deployment(
+    _target: Optional[Callable] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_ongoing_requests: int = 256,
+    max_restarts: int = 3,
+    autoscaling: Any = None,
+    user_config: Optional[Dict[str, Any]] = None,
+    chips_per_replica: int = 0,
+    placement_strategy: str = "PACK",
+) -> Callable:
+    """``@serve.deployment`` equivalent: turn a class or function into a
+    deployable unit. Batching is per-request unless the callable opts in
+    with ``@batch``."""
+
+    def wrap(target: Callable) -> Deployment:
+        cfg = DeploymentConfig(
+            name=name or target.__name__,
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            max_restarts=max_restarts,
+            autoscaling=autoscaling,
+            user_config=dict(user_config or {}),
+            chips_per_replica=chips_per_replica,
+            placement_strategy=placement_strategy,
+        )
+        return Deployment(target, cfg)
+
+    return wrap if _target is None else wrap(_target)
+
+
+# --- module-level controller (the singleton controller-actor role) ---------
+
+_state_lock = threading.Lock()
+_controller: Optional[ServeController] = None
+_proxy: Optional[HTTPProxy] = None
+
+
+def _get_controller() -> ServeController:
+    global _controller
+    with _state_lock:
+        if _controller is None:
+            _controller = ServeController()
+            _controller.start()
+        return _controller
+
+
+def run(
+    app: Application,
+    *,
+    route_prefix: Optional[str] = None,
+    controller: Optional[ServeController] = None,
+    default_slo_ms: float = 30_000.0,
+) -> DeploymentHandle:
+    """Deploy an application and return its handle (ref serve.run,
+    ``api.py:463``). With ``route_prefix`` the deployment is also published
+    on the module HTTP proxy (started on first use)."""
+    if not isinstance(app, Application):
+        raise TypeError(
+            "run() takes Deployment.bind(...); got "
+            f"{type(app).__name__} — decorate with @deployment and bind"
+        )
+    ctl = controller or _get_controller()
+    dep = app.deployment
+    cfg = dep._config
+    bopts = dep.batch_options()
+    if bopts is not None:
+        cfg = DeploymentConfig.from_json(cfg.to_json())
+        cfg.max_batch_size = int(bopts["max_batch_size"])
+        cfg.batch_wait_timeout_s = float(bopts["batch_wait_timeout_s"])
+    router = ctl.deploy(cfg, factory=dep._make_factory(app.args, app.kwargs))
+    handle = DeploymentHandle(router, default_slo_ms=default_slo_ms)
+    if route_prefix is not None:
+        proxy = _get_proxy()
+        proxy.router.set_route(route_prefix, handle)
+    return handle
+
+
+def _get_proxy() -> HTTPProxy:
+    global _proxy
+    with _state_lock:
+        if _proxy is None:
+            from ray_dynamic_batching_tpu.serve.proxy import ProxyRouter
+
+            _proxy = HTTPProxy(ProxyRouter(), port=0)
+            _proxy.start()
+        return _proxy
+
+
+def get_proxy() -> Optional[HTTPProxy]:
+    """The module proxy, if any route was published."""
+    return _proxy
+
+
+def get_deployment_handle(
+    name: str, default_slo_ms: float = 30_000.0
+) -> DeploymentHandle:
+    """Handle to an already-running deployment (ref
+    ``serve.get_deployment_handle``)."""
+    ctl = _get_controller()
+    return DeploymentHandle(
+        ctl.get_router(name), default_slo_ms=default_slo_ms
+    )
+
+
+def delete(name: str) -> None:
+    """Tear down one deployment (ref serve.delete)."""
+    _get_controller().delete_deployment(name)
+
+
+def shutdown() -> None:
+    """Stop the module controller and proxy (ref serve.shutdown)."""
+    global _controller, _proxy
+    with _state_lock:
+        ctl, proxy = _controller, _proxy
+        _controller = None
+        _proxy = None
+    if proxy is not None:
+        proxy.stop()
+    if ctl is not None:
+        ctl.shutdown()

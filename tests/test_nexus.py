@@ -72,6 +72,25 @@ class TestResidue:
         assert node.placements[0].batch_size <= 2
 
 
+    def test_low_rate_duty_capped_by_slo_headroom(self, packer):
+        """When even the smallest bucket cannot FILL within the SLO at the
+        arrival rate, the duty cycle is bounded by the SLO headroom (serve
+        under-filled batches) instead of stretching to batch/rate — a
+        queued request waiting one cycle must still make its deadline."""
+        # heavy: wl(b=1) ~= 22 ms; rate 0.5 rps -> fill time 2000 ms > SLO.
+        s = Session("heavy", slo_ms=500.0, rate_rps=0.5)
+        node = packer.residue_node(s)
+        wl = node.placements[0].latency_ms
+        assert node.duty_cycle_ms <= 500.0 - wl + 1e-9
+        assert node.duty_cycle_ms + wl <= 500.0 + 1e-9
+        # A feasible (higher-rate) session keeps the batch/rate duty.
+        s2 = Session("heavy", slo_ms=500.0, rate_rps=100.0)
+        node2 = packer.residue_node(s2)
+        assert node2.duty_cycle_ms == pytest.approx(
+            node2.placements[0].batch_size / 100.0 * 1000.0
+        )
+
+
 class TestMerge:
     def test_two_light_sessions_colocate(self, packer):
         # fast residue: duty 20ms (b=4 @ 200rps); fat: latency(1)=5.5ms fits

@@ -1,0 +1,25 @@
+"""Shared CLI plumbing for the tools/ scripts.
+
+Import AFTER the per-script repo-root sys.path bootstrap (the bootstrap
+cannot live here: it is what makes this module importable as ``tools.common``
+in the first place when a script runs as ``python tools/<name>.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def backend_args(
+    argv: List[str],
+    tpu_dir: str = "profiles/tpu_v5e",
+    cpu_dir: str = "profiles/cpu",
+) -> Tuple[List[str], str, bool]:
+    """Parse ``--cpu`` out of argv and pick the backend-matched default
+    profile directory: CPU runs must never read or write the TPU tables by
+    default (float32 CPU timings mislabeled as tpu_v5e ground truth would
+    poison every consumer of the committed CSVs)."""
+    cpu = "--cpu" in argv
+    rest = [a for a in argv if a != "--cpu"]
+    default_dir = cpu_dir if cpu else tpu_dir
+    return rest, default_dir, cpu

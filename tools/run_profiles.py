@@ -34,13 +34,28 @@ PLAN = [
     ("gpt2_medium", [1, 4, 8], (64, 128)),
 ]
 
+# CPU-backend plan (float32, small buckets): the same committed-table
+# contract exercised where no accelerator is reachable — CI fixture and
+# relay-outage fallback, not a performance claim.
+CPU_PLAN = [
+    ("resnet50", [1, 4, 8], (0,)),
+    ("shufflenet_v2", [1, 4, 16], (0,)),
+    ("vit_b_16", [1, 4, 8], (0,)),
+]
 
-def main(out_dir: str) -> None:
+
+def main(out_dir: str, cpu: bool = False) -> None:
+    import jax.numpy as jnp
+
+    if cpu:
+        jax.config.update("jax_platforms", "cpu")
     print(f"backend={jax.default_backend()} devices={jax.devices()}",
           flush=True)
-    for name, batches, seqs in PLAN:
+    plan = CPU_PLAN if cpu else PLAN
+    kwargs = {"dtype": jnp.float32} if cpu else {}
+    for name, batches, seqs in plan:
         t0 = time.perf_counter()
-        model = get_model(name)
+        model = get_model(name, **kwargs)
         profiler = ModelProfiler(model)
         profile = profiler.sweep(batch_buckets=batches, seq_buckets=seqs)
         paths = profiler.write_outputs(profile, out_dir)
@@ -49,4 +64,7 @@ def main(out_dir: str) -> None:
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "profiles/tpu_v5e")
+    from tools.common import backend_args
+
+    argv, default_dir, cpu = backend_args(sys.argv[1:])
+    main(argv[0] if argv else default_dir, cpu=cpu)
