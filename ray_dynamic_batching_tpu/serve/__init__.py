@@ -13,6 +13,7 @@ from ray_dynamic_batching_tpu.serve.api import (
     delete,
     deployment,
     get_deployment_handle,
+    multiplexed,
     run,
     shutdown,
 )
@@ -38,6 +39,7 @@ __all__ = [
     "delete",
     "deployment",
     "get_deployment_handle",
+    "multiplexed",
     "run",
     "shutdown",
     "AutoscalingConfig",

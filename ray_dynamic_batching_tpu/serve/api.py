@@ -70,6 +70,111 @@ def batch(
     return wrap if _fn is None else wrap(_fn)
 
 
+class _MultiplexedMethod:
+    """Descriptor produced by :func:`multiplexed`: a per-instance (= per
+    replica, since the factory constructs the user class once per replica)
+    LRU of loaded models, keyed by model id (ref
+    ``serve/multiplex.py`` ``_ModelMultiplexWrapper``: bounded LRU, evicted
+    models get their release hook called before being dropped)."""
+
+    def __init__(self, fn: Callable, max_models: int,
+                 unload: Optional[Callable[[Any], None]]):
+        self._fn = fn
+        self._max_models = max(1, int(max_models))
+        self._unload = unload
+        functools.update_wrapper(self, fn, updated=())
+
+    def __get__(self, instance: Any, owner: Any = None) -> Callable:
+        if instance is None:
+            return self
+        state_key = f"_rdb_mux_{self._fn.__name__}"
+        state = instance.__dict__.get(state_key)
+        if state is None:
+            state = {
+                "cache": {}, "order": [], "lock": threading.Lock(),
+                # model_id -> Event; presence = a load is in flight, so
+                # concurrent misses wait instead of loading a duplicate
+                # (a duplicate is a full model's HBM leaked until GC).
+                "inflight": {},
+            }
+            instance.__dict__[state_key] = state
+
+        def get_model(model_id: str) -> Any:
+            from ray_dynamic_batching_tpu.serve.replica import current_replica
+
+            while True:
+                with state["lock"]:
+                    if model_id in state["cache"]:
+                        state["order"].remove(model_id)
+                        state["order"].append(model_id)
+                        return state["cache"][model_id]
+                    waiter = state["inflight"].get(model_id)
+                    if waiter is None:
+                        state["inflight"][model_id] = threading.Event()
+                        break  # this thread is the loader
+                waiter.wait()  # loader finished (or failed) -> re-check
+
+            # Load OUTSIDE the lock: weight upload + XLA warmup can take
+            # tens of seconds and must not block cache hits.
+            evicted = None
+            try:
+                model = self._fn(instance, model_id)
+                with state["lock"]:
+                    state["cache"][model_id] = model
+                    state["order"].append(model_id)
+                    if len(state["order"]) > self._max_models:
+                        victim = state["order"].pop(0)
+                        evicted = (victim, state["cache"].pop(victim))
+            finally:
+                with state["lock"]:
+                    state["inflight"].pop(model_id).set()
+            # Ground-truth residency for the pow-2 router: advertise the
+            # load and retract the eviction on the replica running this
+            # callable (assign-time recording alone would keep steering
+            # traffic to replicas that already evicted the model).
+            replica = current_replica()
+            if replica is not None:
+                replica.record_multiplexed_model(model_id)
+                if evicted is not None:
+                    replica.remove_multiplexed_model(evicted[0])
+            if evicted is not None:
+                self._release(evicted[1])
+            return model
+
+        get_model.loaded_model_ids = lambda: list(state["order"])
+        return get_model
+
+    def _release(self, model: Any) -> None:
+        try:
+            if self._unload is not None:
+                self._unload(model)
+            elif hasattr(model, "unload"):
+                model.unload()
+            # else: dropping the last reference frees device buffers on GC
+        except Exception:  # noqa: BLE001 — eviction must not kill serving
+            logger.exception("multiplexed model release hook failed")
+
+
+def multiplexed(
+    _fn: Optional[Callable] = None,
+    *,
+    max_num_models_per_replica: int = 4,
+    unload: Optional[Callable[[Any], None]] = None,
+) -> Callable:
+    """``@serve.multiplexed`` equivalent (ref ``serve/multiplex.py``):
+    decorate a loader METHOD ``def get_model(self, model_id)`` of a
+    deployment class; calls become per-replica LRU-cached loads, bounded at
+    ``max_num_models_per_replica``, with evicted models released through
+    ``unload`` (or their own ``.unload()``). Pair with
+    ``handle.remote(..., multiplexed_model_id=...)`` so the pow-2 router
+    steers requests toward replicas already holding the model."""
+
+    def wrap(fn: Callable) -> _MultiplexedMethod:
+        return _MultiplexedMethod(fn, max_num_models_per_replica, unload)
+
+    return wrap if _fn is None else wrap(_fn)
+
+
 class Application:
     """A deployment bound to its constructor arguments (ref
     ``Deployment.bind`` building an app graph node)."""
@@ -87,9 +192,13 @@ class Application:
 class Deployment:
     """A user callable plus its deployment options (ref serve.Deployment)."""
 
-    def __init__(self, target: Callable, config: DeploymentConfig):
+    def __init__(self, target: Callable, config: DeploymentConfig,
+                 explicit: Optional[frozenset] = None):
         self._target = target
         self._config = config
+        # Field names the user set via options(): an explicit override must
+        # beat the @batch decorator's defaults in run().
+        self._explicit = explicit or frozenset()
         functools.update_wrapper(self, target, updated=())
 
     @property
@@ -109,7 +218,9 @@ class Deployment:
         )
         if "autoscaling" in overrides:
             merged.autoscaling = overrides["autoscaling"]
-        return Deployment(self._target, merged)
+        return Deployment(
+            self._target, merged, self._explicit | frozenset(overrides)
+        )
 
     def bind(self, *args: Any, **kwargs: Any) -> Application:
         return Application(self, args, kwargs)
@@ -120,26 +231,28 @@ class Deployment:
         """Replica factory: constructs the user callable per replica, then
         adapts per-request callables to the replica's batch contract."""
         target = self._target
+        raw = target.__call__ if inspect.isclass(target) else target
+        marked = getattr(raw, _BATCH_ATTR, None)
+        if marked is None and inspect.isgeneratorfunction(raw):
+            # The replica's generator contract is batch-shaped (yield one
+            # chunk list per wave); silently promoting an unmarked
+            # per-request generator would hand it a payload LIST and
+            # misread its yields. Fail at deploy time, not mid-request.
+            raise TypeError(
+                f"{self.name}: generator callables stream whole batches "
+                "and must opt in with @serve.batch"
+            )
 
         def factory() -> Callable[[List[Any]], Sequence[Any]]:
             if inspect.isclass(target):
                 instance = target(*args, **kwargs)
                 call = instance.__call__
-                # The batch marker may sit on the (unbound) class __call__.
-                marked = getattr(
-                    type(instance).__call__, _BATCH_ATTR,
-                    getattr(call, _BATCH_ATTR, None),
-                )
+            elif args or kwargs:
+                call = functools.partial(target, *args, **kwargs)
             else:
-                if args or kwargs:
-                    call = functools.partial(target, *args, **kwargs)
-                else:
-                    call = target
-                marked = getattr(target, _BATCH_ATTR, None)
+                call = target
 
-            if marked is not None or inspect.isgeneratorfunction(
-                inspect.unwrap(getattr(call, "func", call))
-            ):
+            if marked is not None:
                 return call  # already list -> list (or generator)
 
             def per_request(payloads: List[Any]) -> List[Any]:
@@ -222,11 +335,25 @@ def run(
     ctl = controller or _get_controller()
     dep = app.deployment
     cfg = dep._config
+    mux_bounds = [
+        v._max_models for v in vars(dep._target).values()
+        if isinstance(v, _MultiplexedMethod)
+    ] if inspect.isclass(dep._target) else []
+    if mux_bounds and "max_multiplexed_models" not in dep._explicit:
+        # Advertised residency must match the tightest real cache bound,
+        # or the router steers traffic to replicas that already evicted
+        # the model.
+        cfg = DeploymentConfig.from_json(cfg.to_json())
+        cfg.max_multiplexed_models = min(mux_bounds)
     bopts = dep.batch_options()
     if bopts is not None:
+        # @batch values are defaults; options() overrides win (both knobs
+        # are plain DeploymentConfig fields the user may have set).
         cfg = DeploymentConfig.from_json(cfg.to_json())
-        cfg.max_batch_size = int(bopts["max_batch_size"])
-        cfg.batch_wait_timeout_s = float(bopts["batch_wait_timeout_s"])
+        if "max_batch_size" not in dep._explicit:
+            cfg.max_batch_size = int(bopts["max_batch_size"])
+        if "batch_wait_timeout_s" not in dep._explicit:
+            cfg.batch_wait_timeout_s = float(bopts["batch_wait_timeout_s"])
     router = ctl.deploy(cfg, factory=dep._make_factory(app.args, app.kwargs))
     handle = DeploymentHandle(router, default_slo_ms=default_slo_ms)
     if route_prefix is not None:

@@ -65,6 +65,10 @@ class DeploymentConfig:
     user_config: Dict[str, Any] = field(default_factory=dict)
     chips_per_replica: int = 0          # 0 = no chip reservation
     placement_strategy: str = "PACK"
+    # Advertised multiplex-LRU size per replica; serve.run syncs this to a
+    # @multiplexed loader's bound so the router never steers traffic to a
+    # replica whose cache already evicted the model.
+    max_multiplexed_models: int = 8
 
     def to_json(self) -> Dict[str, Any]:
         d = {
@@ -77,6 +81,7 @@ class DeploymentConfig:
             "user_config": self.user_config,
             "chips_per_replica": self.chips_per_replica,
             "placement_strategy": self.placement_strategy,
+            "max_multiplexed_models": self.max_multiplexed_models,
         }
         if self.autoscaling is not None:
             d["autoscaling"] = vars(self.autoscaling)
@@ -245,6 +250,7 @@ class ServeController:
                     batch_wait_timeout_s=cfg.batch_wait_timeout_s,
                     max_ongoing_requests=cfg.max_ongoing_requests,
                 )
+                replica.max_multiplexed_models = cfg.max_multiplexed_models
                 if devices is not None:
                     replica.devices = devices
             replica.start()

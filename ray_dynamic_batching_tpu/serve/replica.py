@@ -47,6 +47,17 @@ REPLICA_ERRORS = m.Counter(
 )
 
 
+# Replica executing on the current thread (set around user-callable
+# execution): lets in-callable framework code — e.g. the @multiplexed
+# loader cache — report ground-truth model residency back to the replica
+# the router reads, without threading a handle through the user's code.
+_current = threading.local()
+
+
+def current_replica() -> Optional["Replica"]:
+    return getattr(_current, "replica", None)
+
+
 def record_multiplexed_model_locked(
     models: List[str], model_id: str, cap: int
 ) -> None:
@@ -131,6 +142,13 @@ class Replica:
                 self.loaded_models, model_id, self.max_multiplexed_models
             )
 
+    def remove_multiplexed_model(self, model_id: str) -> None:
+        """Drop a model from the advertised residency set (the loader cache
+        evicted it): the router must stop steering its traffic here."""
+        with self._ongoing_lock:
+            if model_id in self.loaded_models:
+                self.loaded_models.remove(model_id)
+
     # --- loop -------------------------------------------------------------
     def _stream_generator_batch(
         self, batch: List[Request], gen: Any
@@ -159,6 +177,7 @@ class Replica:
         with self._ongoing_lock:
             self._ongoing += len(batch)
         self._batch_started_at = time.monotonic()
+        _current.replica = self  # visible to in-callable framework hooks
         try:
             chaos().maybe_fail("replica.process_batch")
             with ExitStack() as spans:
@@ -198,6 +217,7 @@ class Replica:
             )
             logger.warning("%s: batch failed: %s", self.replica_id, e)
         finally:
+            _current.replica = None
             self._batch_started_at = None
             with self._ongoing_lock:
                 self._ongoing -= len(batch)

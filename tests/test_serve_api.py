@@ -84,6 +84,7 @@ class TestDeploymentDecorator:
     def test_generator_callable_streams_batch(self, controller):
         @serve.deployment(name="gen")
         class Chunker:
+            @serve.batch(max_batch_size=4)
             def __call__(self, xs):
                 # generator batching: one wave yielded in two halves
                 half = (len(xs) + 1) // 2
@@ -95,6 +96,110 @@ class TestDeploymentDecorator:
         # batching contract); a lone request sits in the first half.
         out = handle.remote(5).result(timeout=10)
         assert out == [("a", 5)]
+
+    def test_unmarked_generator_rejected_at_deploy(self, controller):
+        @serve.deployment(name="badgen")
+        def stream(x):
+            yield x
+
+        with pytest.raises(TypeError, match="@serve.batch"):
+            serve.run(stream.bind(), controller=controller)
+
+
+class TestMultiplexed:
+    def test_lru_bound_and_release_hook(self):
+        loads, releases = [], []
+
+        class Host:
+            @serve.multiplexed(max_num_models_per_replica=2,
+                               unload=lambda m: releases.append(m))
+            def get_model(self, model_id):
+                loads.append(model_id)
+                return f"model:{model_id}"
+
+        h = Host()
+        assert h.get_model("a") == "model:a"
+        assert h.get_model("b") == "model:b"
+        assert h.get_model("a") == "model:a"  # hit, refreshes LRU
+        assert loads == ["a", "b"]
+        h.get_model("c")                      # evicts b (a was refreshed)
+        assert releases == ["model:b"]
+        assert h.get_model.loaded_model_ids() == ["a", "c"]
+        h.get_model("b")                      # reload after eviction
+        assert loads == ["a", "b", "c", "b"]
+
+    def test_concurrent_misses_load_once(self):
+        """Racing misses on the same id must share ONE load (a losing
+        duplicate would leak a full model's device memory until GC)."""
+        gate = threading.Event()
+        loads = []
+
+        class Host:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                loads.append(model_id)
+                gate.wait(timeout=10)  # hold the load so both threads race
+                return object()
+
+        h = Host()
+        results = []
+        threads = [
+            threading.Thread(target=lambda: results.append(h.get_model("m")))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # all four either loading or parked on the event
+        gate.set()
+        for t in threads:
+            t.join(10)
+        assert loads == ["m"]                      # exactly one load
+        assert all(r is results[0] for r in results)  # everyone shares it
+
+    def test_options_beat_batch_decorator_defaults(self, controller):
+        @serve.deployment(name="opts")
+        class B:
+            @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.02)
+            def __call__(self, xs):
+                return [x for x in xs]
+
+        serve.run(B.options(max_batch_size=16).bind(), controller=controller)
+        cfg = controller._deployments["opts"].config
+        assert cfg.max_batch_size == 16          # explicit override wins
+        assert cfg.batch_wait_timeout_s == 0.02  # decorator default applies
+
+    def test_per_instance_caches_are_isolated(self):
+        class Host:
+            @serve.multiplexed(max_num_models_per_replica=1)
+            def get_model(self, model_id):
+                return object()
+
+        h1, h2 = Host(), Host()
+        m1 = h1.get_model("x")
+        assert h2.get_model("x") is not m1  # separate replica caches
+
+    def test_end_to_end_with_router_affinity(self, controller):
+        @serve.deployment(name="mux", num_replicas=2)
+        class Mux:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                return lambda x: (model_id, x * 2)
+
+            def __call__(self, payload):
+                model = self.get_model(payload["model"])
+                return model(payload["x"])
+
+        handle = serve.run(Mux.bind(), controller=controller)
+        futs = [
+            handle.remote({"model": "m1", "x": i}, multiplexed_model_id="m1")
+            for i in range(6)
+        ]
+        assert [f.result(timeout=10) for f in futs] == [
+            ("m1", 2 * i) for i in range(6)
+        ]
+        # The router recorded residency, steering later m1 traffic.
+        replicas = controller.get_router("mux").replicas()
+        assert any("m1" in r.loaded_models for r in replicas)
 
 
 class TestModuleLevelRun:
