@@ -131,6 +131,9 @@ class DecodeEngine:
         mesh: Optional[Any] = None,
         base_seed: int = 0,
     ):
+        from ray_dynamic_batching_tpu.utils.compile_cache import maybe_enable
+
+        maybe_enable()  # prefill/decode program compiles become disk hits
         self.model = model
         self.device = device
         self.mesh = mesh

@@ -91,6 +91,9 @@ class ModelProfiler:
         max_consecutive_errors: int = 3,
         donate: bool = False,
     ):
+        from ray_dynamic_batching_tpu.utils.compile_cache import maybe_enable
+
+        maybe_enable()  # sweep re-runs reuse compiled buckets from disk
         self.model = model
         self.params = params
         self.warmup_iters = warmup_iters

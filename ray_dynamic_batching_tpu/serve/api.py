@@ -90,14 +90,16 @@ class _MultiplexedMethod:
         state_key = f"_rdb_mux_{self._fn.__name__}"
         state = instance.__dict__.get(state_key)
         if state is None:
-            state = {
+            # setdefault is atomic under the GIL: concurrent first accesses
+            # must converge on ONE state dict or each would load its own
+            # duplicate model into an orphaned cache.
+            state = instance.__dict__.setdefault(state_key, {
                 "cache": {}, "order": [], "lock": threading.Lock(),
                 # model_id -> Event; presence = a load is in flight, so
                 # concurrent misses wait instead of loading a duplicate
                 # (a duplicate is a full model's HBM leaked until GC).
                 "inflight": {},
-            }
-            instance.__dict__[state_key] = state
+            })
 
         def get_model(model_id: str) -> Any:
             from ray_dynamic_batching_tpu.serve.replica import current_replica
@@ -138,7 +140,17 @@ class _MultiplexedMethod:
                 if evicted is not None:
                     replica.remove_multiplexed_model(evicted[0])
             if evicted is not None:
-                self._release(evicted[1])
+                victim = evicted[1]
+                if replica is not None:
+                    # Replica batches are serialized on one thread, so any
+                    # request still USING the victim belongs to the current
+                    # batch — release only after it completes, never under
+                    # a live forward pass.
+                    replica.add_post_batch_hook(
+                        lambda v=victim: self._release(v)
+                    )
+                else:
+                    self._release(victim)
             return model
 
         get_model.loaded_model_ids = lambda: list(state["order"])
@@ -233,7 +245,9 @@ class Deployment:
         target = self._target
         raw = target.__call__ if inspect.isclass(target) else target
         marked = getattr(raw, _BATCH_ATTR, None)
-        if marked is None and inspect.isgeneratorfunction(raw):
+        # unwrap: a logging/timing decorator with functools.wraps hides the
+        # generator-ness of the underlying callable.
+        if marked is None and inspect.isgeneratorfunction(inspect.unwrap(raw)):
             # The replica's generator contract is batch-shaped (yield one
             # chunk list per wave); silently promoting an unmarked
             # per-request generator would hand it a payload LIST and
@@ -336,7 +350,9 @@ def run(
     dep = app.deployment
     cfg = dep._config
     mux_bounds = [
-        v._max_models for v in vars(dep._target).values()
+        v._max_models
+        for klass in inspect.getmro(dep._target)
+        for v in vars(klass).values()
         if isinstance(v, _MultiplexedMethod)
     ] if inspect.isclass(dep._target) else []
     if mux_bounds and "max_multiplexed_models" not in dep._explicit:

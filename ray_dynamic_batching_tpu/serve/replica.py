@@ -108,6 +108,11 @@ class Replica:
         self._stopped = False
         self._run = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Drained after each batch completes: lets in-callable framework
+        # code (e.g. the @multiplexed cache) defer resource release until
+        # no request in the current batch can still be using it.
+        self._post_batch_hooks: List[Callable[[], None]] = []
+        self._hooks_lock = threading.Lock()
         self.last_heartbeat = time.monotonic()
         self.started_at = time.monotonic()
         self._batch_started_at: Optional[float] = None
@@ -148,6 +153,21 @@ class Replica:
         with self._ongoing_lock:
             if model_id in self.loaded_models:
                 self.loaded_models.remove(model_id)
+
+    def add_post_batch_hook(self, hook: Callable[[], None]) -> None:
+        """Run ``hook`` once the in-flight batch finishes (or immediately
+        if called outside batch execution, from the drain in finally)."""
+        with self._hooks_lock:
+            self._post_batch_hooks.append(hook)
+
+    def _drain_post_batch_hooks(self) -> None:
+        with self._hooks_lock:
+            hooks, self._post_batch_hooks = self._post_batch_hooks, []
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — a hook must not kill the loop
+                logger.exception("%s: post-batch hook failed", self.replica_id)
 
     # --- loop -------------------------------------------------------------
     def _stream_generator_batch(
@@ -221,6 +241,7 @@ class Replica:
             self._batch_started_at = None
             with self._ongoing_lock:
                 self._ongoing -= len(batch)
+            self._drain_post_batch_hooks()
 
     def _loop(self) -> None:
         while self._run.is_set():

@@ -1,0 +1,47 @@
+"""Persistent XLA compilation cache (SURVEY §7 hard-part (a)).
+
+Every new (model, batch, seq) bucket pays a 20-40 s XLA compile on the
+tunneled TPU; the reference never faces this because any CUDA batch size is
+instantly runnable (``293-project/profiling/ModelProfiler.py:46``). JAX's
+persistent compilation cache turns repeat compiles — across processes,
+restarts, and profile sweeps — into disk hits. This module is the single
+switch: every compile-heavy entry point (model host, decode engine,
+profiler, bench) calls :func:`maybe_enable` before its first jit.
+
+Enable with ``RDB_COMPILATION_CACHE_DIR=/path`` (or config override); ""
+keeps it off.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_dynamic_batching_tpu.utils.config import get_config
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("compile_cache")
+
+_lock = threading.Lock()
+_applied: str | None = None
+
+
+def maybe_enable() -> bool:
+    """Idempotently point JAX at the configured persistent cache dir.
+    Returns True when a cache is active. Safe to call before or after
+    backend initialization (the knobs are read at compile time)."""
+    global _applied
+    cache_dir = get_config().compilation_cache_dir
+    with _lock:
+        if not cache_dir or _applied == cache_dir:
+            return _applied is not None
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every program: the default min-entry-size/compile-time
+        # gates would skip exactly the small decode-step programs the
+        # serving path dispatches hottest.
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        _applied = cache_dir
+        logger.info("persistent compilation cache at %s", cache_dir)
+        return True

@@ -1,0 +1,30 @@
+"""Smoke the microbenchmark suite at tiny sizes: the benches double as
+API-drift canaries for the substrate surfaces they exercise (handle/router,
+HTTP proxy, shm queue, actor mailboxes, KV watch)."""
+
+import pytest
+
+from tools import microbench
+
+
+@pytest.mark.timeout(120)
+class TestMicrobenchSmoke:
+    def test_handle_throughput(self):
+        out = microbench.bench_handle_throughput(n=50, replicas=1)
+        assert out["calls_per_s"] > 0
+
+    def test_http_noop_latency(self):
+        out = microbench.bench_http_noop_latency(n=20)
+        assert out["p50_ms"] > 0 and out["p99_ms"] >= out["p50_ms"]
+
+    def test_native_queue(self):
+        out = microbench.bench_native_queue(n=2000)
+        assert out["ops_per_s"] > 0
+
+    def test_actor_calls(self):
+        out = microbench.bench_actor_calls(n=2000, actors=2)
+        assert out["calls_per_s"] > 0
+
+    def test_kv_watch_wakeup(self):
+        out = microbench.bench_kv_watch_wakeup(n=10)
+        assert out["p50_ms"] > 0

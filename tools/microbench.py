@@ -1,0 +1,211 @@
+"""Substrate microbenchmarks — the release/microbenchmark analogue.
+
+Mirrors the reference's perf suite shapes (``release/microbenchmark/
+run_microbenchmark.py`` → ``python/ray/_private/ray_perf.py:93`` actor-call
+throughput; Serve's ``_private/benchmarks/handle_throughput.py`` and
+``http_noop_latency.py``): no accelerator involved, these time the serving
+CONTROL plane and the C++ substrate, where Python/runtime overhead — not
+XLA — is the ceiling.
+
+Prints one JSON line; optionally writes it next to the committed profile
+tables. Usage: python tools/microbench.py [out_path]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_handle_throughput(n: int = 2000, replicas: int = 2) -> dict:
+    """No-op calls/s through handle -> pow-2 router -> replica batching
+    (ref handle_throughput.py)."""
+    from ray_dynamic_batching_tpu.serve.controller import (
+        DeploymentConfig,
+        ServeController,
+    )
+    from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+
+    ctl = ServeController(control_interval_s=1.0)
+    router = ctl.deploy(
+        DeploymentConfig(name="noop", num_replicas=replicas,
+                         max_batch_size=64, max_ongoing_requests=4096),
+        factory=lambda: (lambda payloads: payloads),
+    )
+    ctl.start()
+    handle = DeploymentHandle(router, default_slo_ms=60_000.0)
+    try:
+        handle.remote(0).result(timeout=10)  # warm path
+        t0 = time.perf_counter()
+        futs = [handle.remote(i) for i in range(n)]
+        for f in futs:
+            f.result(timeout=60)
+        dt = time.perf_counter() - t0
+    finally:
+        ctl.shutdown()
+    return {"calls_per_s": round(n / dt, 1), "n": n, "replicas": replicas}
+
+
+def bench_http_noop_latency(n: int = 300) -> dict:
+    """Sequential no-op POSTs over one keep-alive connection through the
+    HTTP proxy (ref http_noop_latency.py)."""
+    from ray_dynamic_batching_tpu.serve.controller import (
+        DeploymentConfig,
+        ServeController,
+    )
+    from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+    from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
+
+    ctl = ServeController(control_interval_s=1.0)
+    router = ctl.deploy(
+        DeploymentConfig(name="noop_http", num_replicas=1,
+                         batch_wait_timeout_s=0.0),
+        factory=lambda: (lambda payloads: payloads),
+    )
+    ctl.start()
+    proxy_router = ProxyRouter()
+    proxy_router.set_route("/noop", DeploymentHandle(router))
+    proxy = HTTPProxy(proxy_router, port=0).start()
+    lat_ms = []
+    try:
+        body = b'"x"'
+        req = (b"POST /noop HTTP/1.1\r\nHost: b\r\nContent-Length: "
+               + str(len(body)).encode() + b"\r\n\r\n" + body)
+        with socket.create_connection(("127.0.0.1", proxy.port),
+                                      timeout=30) as s:
+            s.settimeout(30)
+            for i in range(n + 5):
+                t0 = time.perf_counter()
+                s.sendall(req)
+                data = b""
+                while b"\r\n\r\n" not in data or not data.split(
+                    b"\r\n\r\n", 1
+                )[1]:
+                    data += s.recv(4096)
+                if i >= 5:  # warmup discard
+                    lat_ms.append((time.perf_counter() - t0) * 1000.0)
+    finally:
+        proxy.stop()
+        ctl.shutdown()
+    lat_ms.sort()
+    return {
+        "p50_ms": round(statistics.median(lat_ms), 3),
+        "p99_ms": round(lat_ms[int(len(lat_ms) * 0.99)], 3),
+        "n": n,
+    }
+
+
+def bench_native_queue(n: int = 50_000) -> dict:
+    """C++ shm queue push + batch-pop ops/s (the per-model request queue's
+    data path; single-call batch pop is the fix for the ref's per-item RPC
+    at 293-project/src/scheduler.py:277)."""
+    from ray_dynamic_batching_tpu.runtime.native import NativeQueue
+
+    q = NativeQueue(f"mb_q_{os.getpid()}", capacity=4096, item_size=64)
+    payload = b"x" * 48
+    try:
+        t0 = time.perf_counter()
+        pushed = popped = 0
+        while popped < n:
+            while pushed - popped < 4000 and pushed < n:
+                q.push(payload)
+                pushed += 1
+            popped += len(q.pop_batch(1024))
+        dt = time.perf_counter() - t0
+    finally:
+        q.close(unlink=True)
+    return {"ops_per_s": round(n / dt, 1), "n": n}
+
+
+def bench_actor_calls(n: int = 50_000, actors: int = 8) -> dict:
+    """C++ actor-mailbox post->execute throughput (ref ray_perf.py:93
+    actor calls; ordering per mailbox like actor_task_submitter.cc)."""
+    from ray_dynamic_batching_tpu.runtime.native import ActorPool
+
+    pool = ActorPool(n_threads=4)
+    ids = [
+        pool.register(f"mb_actor_{i}", lambda msg: None) for i in range(actors)
+    ]
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            while not pool.post(ids[i % actors], b"m"):
+                time.sleep(0)  # mailbox full -> yield and retry
+        assert pool.drain(timeout_ms=60_000)
+        dt = time.perf_counter() - t0
+    finally:
+        pool.close()
+    return {"calls_per_s": round(n / dt, 1), "n": n, "actors": actors}
+
+
+def bench_kv_watch_wakeup(n: int = 200) -> dict:
+    """Versioned-watch wakeup latency: put -> blocked watcher returns (the
+    long-poll push path, ref long_poll.py:177,242)."""
+    import threading
+
+    from ray_dynamic_batching_tpu.runtime.native import KVStore
+
+    kv = KVStore()
+    lat_ms = []
+    try:
+        kv.put("k", b"0")
+        for i in range(n):
+            got = {}
+
+            def watcher(version):
+                got["r"] = kv.watch("k", have_version=version,
+                                    timeout_ms=10_000)
+                got["t_wake"] = time.perf_counter()
+
+            _, ver = kv.get("k")
+            th = threading.Thread(target=watcher, args=(ver,))
+            th.start()
+            time.sleep(0.0005)  # let the watcher block
+            t_put = time.perf_counter()  # timer starts AT the put: the
+            kv.put("k", str(i).encode())  # scheduling sleep must not count
+            th.join(15)
+            assert got["r"] is not None
+            lat_ms.append((got["t_wake"] - t_put) * 1000.0)
+    finally:
+        kv.close()
+    lat_ms.sort()
+    return {
+        "p50_ms": round(statistics.median(lat_ms), 3),
+        "p99_ms": round(lat_ms[int(len(lat_ms) * 0.99)], 3),
+        "n": n,
+    }
+
+
+def main(out_path: str | None = None) -> dict:
+    results = {"metric": "microbench", "unit": "mixed"}
+    for name, fn in [
+        ("handle_throughput", bench_handle_throughput),
+        ("http_noop_latency", bench_http_noop_latency),
+        ("native_queue", bench_native_queue),
+        ("actor_calls", bench_actor_calls),
+        ("kv_watch_wakeup", bench_kv_watch_wakeup),
+    ]:
+        t0 = time.perf_counter()
+        try:
+            results[name] = fn()
+        except Exception as e:  # noqa: BLE001 — one bench must not kill the suite
+            results[name] = {"error": str(e)}
+        print(f"{name}: {results[name]} "
+              f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr,
+              flush=True)
+    line = json.dumps(results)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

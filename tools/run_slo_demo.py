@@ -161,13 +161,14 @@ def main(profiles_dir: str, duration_s: float = 20.0,
     for name, slo_ms, _ in WORKLOAD:
         stats = queues.queue(name).stats()
         sent = next(d.sent for d in drivers if d.model == name)
-        # Full-run compliance, not the queue's rolling window: the window
-        # (last 200 completions) would forget an early burst of violations
-        # and grade a bad run "good".
-        completed = stats["completed"]
-        compliance = (
-            1.0 - stats["violations"] / completed if completed else 1.0
-        )
+        # Full-run compliance, not the queue's rolling window (which would
+        # forget an early violation burst), with SHED load in the
+        # denominator: a stale-discarded or dropped request missed its SLO
+        # as surely as a late completion — a run that sheds half its
+        # traffic must not grade "good" on the half it kept.
+        accounted = stats["completed"] + stats["stale"] + stats["dropped"]
+        misses = stats["violations"] + stats["stale"] + stats["dropped"]
+        compliance = 1.0 - misses / accounted if accounted else 1.0
         worst = min(worst, compliance)
         record["models"][name] = {
             "offered_rps": round(rates[name], 2),
