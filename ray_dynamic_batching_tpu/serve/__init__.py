@@ -31,6 +31,12 @@ from ray_dynamic_batching_tpu.serve.long_poll import LongPollClient, LongPollHos
 from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.serve.router import Router
+from ray_dynamic_batching_tpu.serve.schema import (
+    ServeConfigSchema,
+    apply_config,
+    load_config,
+    run_config,
+)
 
 __all__ = [
     "Application",
@@ -54,5 +60,9 @@ __all__ = [
     "ProxyRouter",
     "Replica",
     "Router",
+    "ServeConfigSchema",
     "ServeController",
+    "apply_config",
+    "load_config",
+    "run_config",
 ]

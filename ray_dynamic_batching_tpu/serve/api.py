@@ -349,10 +349,12 @@ def run(
     ctl = controller or _get_controller()
     dep = app.deployment
     cfg = dep._config
+    # getattr resolves each name through the MRO, so a subclass override
+    # shadows its base's descriptor — only ACTIVE loaders count (an
+    # inactive base bound would under-advertise the cache size).
     mux_bounds = [
         v._max_models
-        for klass in inspect.getmro(dep._target)
-        for v in vars(klass).values()
+        for v in (getattr(dep._target, n, None) for n in dir(dep._target))
         if isinstance(v, _MultiplexedMethod)
     ] if inspect.isclass(dep._target) else []
     if mux_bounds and "max_multiplexed_models" not in dep._explicit:

@@ -49,3 +49,27 @@ def make_profiles():
         "fat": linear_profile("fat", base_ms=5.0, per_sample_ms=0.5,
                               weight_mb=4000, act_mb_per_sample=40.0),
     }
+
+
+# --- declarative-config targets (tests/test_serve_schema.py import paths) ---
+
+from ray_dynamic_batching_tpu.serve import api as _serve_api
+
+
+@_serve_api.deployment(name="cfg_echo")
+def cfg_echo(x):
+    return {"echo": x}
+
+
+# A pre-bound Application target (import_path: tests.fixtures:cfg_echo_app).
+cfg_echo_app = cfg_echo.bind()
+
+
+class CfgScaler:
+    """Bare class target: the schema wraps it with @deployment defaults."""
+
+    def __init__(self, factor=2):
+        self.factor = factor
+
+    def __call__(self, x):
+        return x * self.factor

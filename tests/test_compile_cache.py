@@ -27,6 +27,7 @@ def test_maybe_enable_populates_disk_cache(tmp_path):
     finally:
         set_config(RDBConfig.from_env(compilation_cache_dir=""))
         jax.config.update("jax_compilation_cache_dir", None)
+        compile_cache._applied = None  # later tests must not inherit "active"
 
 
 def test_disabled_by_default(tmp_path):

@@ -168,6 +168,27 @@ class TestMultiplexed:
         assert cfg.max_batch_size == 16          # explicit override wins
         assert cfg.batch_wait_timeout_s == 0.02  # decorator default applies
 
+    def test_subclass_override_bound_wins(self, controller):
+        """A subclass's @multiplexed override shadows the base loader; the
+        ACTIVE bound must be advertised, not the inactive base one."""
+        class Base:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, mid):
+                return mid
+
+        @serve.deployment(name="shadow")
+        class Sub(Base):
+            @serve.multiplexed(max_num_models_per_replica=6)
+            def get_model(self, mid):
+                return mid
+
+            def __call__(self, p):
+                return self.get_model(p)
+
+        serve.run(Sub.bind(), controller=controller)
+        cfg = controller._deployments["shadow"].config
+        assert cfg.max_multiplexed_models == 6
+
     def test_per_instance_caches_are_isolated(self):
         class Host:
             @serve.multiplexed(max_num_models_per_replica=1)

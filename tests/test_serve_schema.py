@@ -1,0 +1,147 @@
+"""Declarative config deploys (ref serve schema.py + `serve deploy`):
+JSON/YAML documents -> validated schema -> import-path resolution ->
+running deployments with routes, plus the built-in llm target."""
+
+import json
+
+import pytest
+
+from ray_dynamic_batching_tpu.serve.controller import ServeController
+from ray_dynamic_batching_tpu.serve.schema import (
+    ServeConfigSchema,
+    apply_config,
+    load_config,
+    run_config,
+)
+
+
+@pytest.fixture
+def controller():
+    ctl = ServeController(control_interval_s=0.1)
+    ctl.start()
+    yield ctl
+    ctl.shutdown()
+
+
+class TestSchemaValidation:
+    def test_rejects_empty_and_duplicates(self):
+        with pytest.raises(ValueError, match="no applications"):
+            ServeConfigSchema.from_dict({})
+        with pytest.raises(ValueError, match="duplicate"):
+            ServeConfigSchema.from_dict({"applications": [
+                {"name": "a", "deployments": [{"name": "d",
+                                               "import_path": "x:y"}]},
+                {"name": "a", "deployments": [{"name": "e",
+                                               "import_path": "x:y"}]},
+            ]})
+        with pytest.raises(ValueError, match="no deployments"):
+            ServeConfigSchema.from_dict(
+                {"applications": [{"name": "a"}]}
+            )
+
+    def test_rejects_unknown_deployment_fields(self):
+        with pytest.raises(ValueError, match="unknown fields"):
+            ServeConfigSchema.from_dict({"applications": [{
+                "name": "a",
+                "deployments": [{"name": "d", "import_path": "x:y",
+                                 "num_gpus": 1}],
+            }]})
+
+    def test_rejects_duplicate_deployment_names_across_apps(self):
+        with pytest.raises(ValueError, match="duplicate deployment"):
+            ServeConfigSchema.from_dict({"applications": [
+                {"name": "a", "deployments": [{"name": "d",
+                                               "import_path": "x:y"}]},
+                {"name": "b", "deployments": [{"name": "d",
+                                               "import_path": "x:z"}]},
+            ]})
+
+    def test_llm_rejects_init_args(self, controller):
+        cfg = ServeConfigSchema.from_dict({"applications": [{
+            "name": "a",
+            "deployments": [{"name": "d", "llm": {"model": "llama_tiny"},
+                             "init_kwargs": {"num_slots": 4}}],
+        }]})
+        with pytest.raises(ValueError, match="inside the llm mapping"):
+            apply_config(cfg, controller=controller)
+
+    def test_requires_exactly_one_target(self, controller):
+        cfg = ServeConfigSchema.from_dict({"applications": [{
+            "name": "a",
+            "deployments": [{"name": "d"}],
+        }]})
+        with pytest.raises(ValueError, match="exactly one"):
+            apply_config(cfg, controller=controller)
+
+
+class TestApplyConfig:
+    def test_deploy_bound_application_with_options(self, controller):
+        cfg = ServeConfigSchema.from_dict({"applications": [{
+            "name": "echo_app",
+            "deployments": [{
+                "name": "cfg_echo",
+                "import_path": "tests.fixtures:cfg_echo_app",
+                "num_replicas": 2,
+                "max_ongoing_requests": 64,
+            }],
+        }]})
+        handles = apply_config(cfg, controller=controller)
+        assert handles["cfg_echo"].remote("hi").result(timeout=10) == {
+            "echo": "hi"
+        }
+        dep_cfg = controller._deployments["cfg_echo"].config
+        assert dep_cfg.num_replicas == 2
+        assert dep_cfg.max_ongoing_requests == 64
+
+    def test_deploy_bare_class_with_init_kwargs(self, controller):
+        cfg = ServeConfigSchema.from_dict({"applications": [{
+            "name": "scale_app",
+            "deployments": [{
+                "name": "scaler",
+                "import_path": "tests.fixtures:CfgScaler",
+                "init_kwargs": {"factor": 5},
+            }],
+        }]})
+        handles = apply_config(cfg, controller=controller)
+        assert handles["scaler"].remote(4).result(timeout=10) == 20
+
+    def test_llm_builtin_target(self, controller):
+        import jax.numpy as jnp  # noqa: F401 — jax already CPU-forced
+
+        cfg = ServeConfigSchema.from_dict({"applications": [{
+            "name": "chat",
+            "deployments": [{
+                "name": "llama",
+                "llm": {"model": "llama_tiny", "num_slots": 2,
+                        "max_len": 32, "prompt_buckets": [8],
+                        "default_max_new_tokens": 4},
+            }],
+        }]})
+        handles = apply_config(cfg, controller=controller)
+        out = handles["llama"].remote(
+            {"tokens": [1, 2, 3], "max_new_tokens": 4}
+        ).result(timeout=120)
+        assert len(out.tokens) == 4
+
+    def test_run_config_from_files(self, controller, tmp_path):
+        doc = {"applications": [{
+            "name": "files",
+            "deployments": [{
+                "name": "cfg_echo2",
+                "import_path": "tests.fixtures:cfg_echo_app",
+            }],
+        }]}
+        jpath = tmp_path / "app.json"
+        jpath.write_text(json.dumps(doc))
+        handles = run_config(str(jpath), controller=controller)
+        assert handles["cfg_echo2"].remote(1).result(timeout=10) == {
+            "echo": 1
+        }
+        yaml = pytest.importorskip("yaml")
+        ypath = tmp_path / "app.yaml"
+        doc["applications"][0]["deployments"][0]["name"] = "cfg_echo3"
+        ypath.write_text(yaml.safe_dump(doc))
+        handles = run_config(str(ypath), controller=controller)
+        assert handles["cfg_echo3"].remote(2).result(timeout=10) == {
+            "echo": 2
+        }
