@@ -54,7 +54,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_dynamic_batching_tpu.engine.request import Request, now_ms
+from ray_dynamic_batching_tpu.engine.request import (
+    Request,
+    RequestDropped,
+    now_ms,
+)
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.profiles.table import bucket_up
 from ray_dynamic_batching_tpu.utils.logging import get_logger
@@ -406,10 +410,15 @@ class DecodeEngine:
             raise ValueError(f"{req.request_id}: empty prompt")
         bucket = bucket_up(int(prompt.size), self.prompt_buckets)
         if bucket is None:
-            raise ValueError(
-                f"{req.request_id}: prompt length {prompt.size} exceeds "
-                f"largest bucket {self.prompt_buckets[-1]}"
-            )
+            # Longer than every bucket: admit via CHUNKED prefill (bucket
+            # sentinel -1) as long as the cache can hold the prompt plus at
+            # least one generated token.
+            if prompt.size >= self.max_len:
+                raise ValueError(
+                    f"{req.request_id}: prompt length {prompt.size} "
+                    f"exceeds KV capacity {self.max_len}"
+                )
+            bucket = -1
         opts = {
             "max_new": self.default_max_new_tokens,
             "temperature": 0.0,   # greedy unless asked
@@ -466,6 +475,7 @@ class DecodeEngine:
             by_bucket.setdefault(bucket, []).append((req, prompt, opts))
         admitted = 0
         cap = self.max_admissions_per_step
+        long_items = by_bucket.pop(-1, [])
         for bucket, items in by_bucket.items():
             for off in range(0, len(items), cap):  # chunks round up to a
                 chunk = items[off : off + cap]     # compiled group width
@@ -481,6 +491,27 @@ class DecodeEngine:
                         req.reject(e)
                     continue
                 admitted += len(chunk)
+        for req, prompt, opts in long_items:
+            if admitted >= len(free):
+                # Ran out of slots this round — requeue untouched. A full
+                # or closed queue refuses WITHOUT rejecting (router-retry
+                # semantics), but here the engine holds the only reference:
+                # an unchecked drop would leave the future hanging forever.
+                if not self.queue.add_request(req, reject_on_full=False):
+                    req.reject(RequestDropped(
+                        f"{req.request_id}: queue refused requeue during "
+                        "chunked admission"
+                    ))
+                continue
+            try:
+                self._prefill_long(req, prompt, opts, free[admitted])
+            except Exception as e:  # noqa: BLE001 — same no-dangle rule
+                logger.exception(
+                    "%s: chunked prefill failed", self.model.name
+                )
+                req.reject(e)
+                continue
+            admitted += 1
         return admitted
 
     def _prefill_group(
@@ -528,6 +559,97 @@ class DecodeEngine:
         t = now_ms()
         for i, (req, _prompt, opts) in enumerate(items):
             self._register(slot_ids[i], req, int(first_host[i]), opts, t)
+
+    # --- chunked prefill (long prompts) ------------------------------------
+    def _prefill_chunk_impl(self, params, tokens, attn_mask, row_cache,
+                            start, take_idx):
+        return self.model.prefill_chunk(
+            params, tokens, attn_mask, row_cache, start, take_idx
+        )
+
+    def _commit_long_impl(self, cache, row_cache, slot, last_logits,
+                          temps, topk, seeds, tok_idx):
+        """Copy the finished row cache into the big cache at ``slot`` and
+        sample the first token — one dispatch closes the admission. The row
+        cache is a whole number of chunks, so it can be LONGER than the
+        shared cache; the static slice keeps only real capacity (positions
+        past ``lengths`` are garbage either way and never attended)."""
+        S = cache.capacity
+        k = jax.lax.dynamic_update_slice(
+            cache.k, row_cache.k[:, :, :S], (0, slot, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache.v, row_cache.v[:, :, :S], (0, slot, 0, 0, 0)
+        )
+        lengths = jax.lax.dynamic_update_slice(
+            cache.lengths, row_cache.lengths, (slot,)
+        )
+        first = self._sample_tokens(last_logits, temps, topk, seeds, tok_idx)
+        return first, cache.replace(k=k, v=v, lengths=lengths)
+
+    def _long_prefill_fns(self, chunk: int):
+        """Lazily compiled (chunk fn, commit fn) — long prompts may never
+        arrive, so their programs are not part of warmup; the persistent
+        compilation cache absorbs the first-hit cost across restarts."""
+        fns = self._prefill_fns.get(("long", chunk))
+        if fns is None:
+            fns = (
+                jax.jit(self._prefill_chunk_impl, donate_argnums=(3,)),
+                jax.jit(self._commit_long_impl, donate_argnums=(0, 1)),
+            )
+            self._prefill_fns[("long", chunk)] = fns
+        return fns
+
+    def _prefill_long(
+        self, req: Request, prompt: np.ndarray, opts: Dict, slot_idx: int
+    ) -> None:
+        """Admit one prompt longer than every bucket: prefill it in
+        ``chunk``-token compiled pieces into a private single-row cache,
+        running ONE decode step for the active batch between chunks so a
+        10k-token prompt stalls decoding by at most one chunk's latency
+        (chunked-prefill admission), then commit the row into the shared
+        cache. The reference has no analogue (single-shot vision)."""
+        C = self.prompt_buckets[-1]
+        chunk_fn, commit_fn = self._long_prefill_fns(C)
+        L = int(prompt.size)
+        n_chunks = (L + C - 1) // C
+        # Private row cache rounded UP to whole chunks — ONE static shape
+        # for every prompt length, so all long admissions share two
+        # compiled programs. Without the round-up, a final chunk whose
+        # write overruns max_len gets its start index CLAMPED by
+        # dynamic_update_slice and silently overwrites earlier positions;
+        # the commit slices back down to shared capacity.
+        row_cap = ((self.max_len + C - 1) // C) * C
+        row = self.model.make_cache(1, row_cap)
+        last = None
+        for ci in range(n_chunks):
+            piece = prompt[ci * C : (ci + 1) * C]
+            tokens = np.zeros((1, C), dtype=np.int32)
+            mask = np.zeros((1, C), dtype=np.int32)
+            tokens[0, : piece.size] = piece
+            mask[0, : piece.size] = 1
+            last, row = chunk_fn(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(mask),
+                row,
+                jnp.int32(ci * C),
+                jnp.int32(piece.size - 1),
+            )
+            if ci < n_chunks - 1 and self._active_mask.any():
+                self._step(horizon=1)  # bound the stall on active slots
+        first, self._cache = commit_fn(
+            self._cache,
+            row,
+            jnp.int32(slot_idx),
+            last,
+            jnp.asarray([opts["temperature"]], np.float32),
+            jnp.asarray([opts["top_k"]], np.int32),
+            jnp.asarray([opts["seed"]], np.int32),
+            jnp.zeros((1,), jnp.int32),
+        )
+        self._register(slot_idx, req, int(np.asarray(first)[0]), opts,
+                       now_ms())
 
     def _register(
         self, slot_idx: int, req: Request, first_tok: int, opts: Dict,

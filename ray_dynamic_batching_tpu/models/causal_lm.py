@@ -125,6 +125,43 @@ class CausalLM(ServableModel):
         )[:, 0]
         return last, new_cache.replace(lengths=lengths)
 
+    def prefill_chunk(
+        self,
+        params,
+        tokens: jax.Array,     # [B, C] one chunk (last chunk right-padded)
+        attn_mask: jax.Array,  # [B, C] 1 = real token
+        cache: KVCache,
+        start: jax.Array,      # scalar int32: global position of tokens[:,0]
+        take_idx: jax.Array,   # scalar int32: logits row to return
+    ) -> Tuple[jax.Array, KVCache]:
+        """One chunk of a long prompt: write k/v at [start, start+C), attend
+        to every cached position up to each token's own. ``start`` and
+        ``take_idx`` are TRACED, so one compiled program per chunk width C
+        serves every chunk of every prompt — the point is bounding how long
+        a single prefill dispatch can stall active decode slots (chunked
+        prefill; admission interleaving happens in the engine).
+
+        Caller contract: chunks arrive in order; all chunks are full except
+        the last. Padded tail positions write garbage k/v beyond the final
+        ``lengths``, which decode masks off exactly as it does for the
+        one-shot prefill path. Returns (logits at ``take_idx`` [B, V],
+        updated cache) — only the final chunk's call uses the logits.
+        """
+        B, C = tokens.shape
+        S = cache.capacity
+        positions = start + jnp.broadcast_to(jnp.arange(C)[None, :], (B, C))
+        # Query at global pos p attends cache slots [0, p]: earlier chunks
+        # are already resident, in-chunk attention stays causal, and slot 0
+        # is always visible so padded query rows keep a sane softmax.
+        s_idx = jnp.arange(S)[None, None, None, :]
+        mask = s_idx <= positions[:, None, :, None]
+        logits, new_cache = self.module.apply(
+            params, tokens, positions, mask, cache, write_start=start
+        )
+        new_lengths = cache.lengths + attn_mask.sum(axis=1).astype(jnp.int32)
+        taken = jax.lax.dynamic_slice_in_dim(logits, take_idx, 1, axis=1)
+        return taken[:, 0], new_cache.replace(lengths=new_lengths)
+
     def decode_step(
         self,
         params,

@@ -127,6 +127,7 @@ class DecoderLayer(nn.Module):
         cache_kv: Optional[Tuple[jax.Array, jax.Array]] = None,  # k/v [L,B,S,K,H]
         token_mask: Optional[jax.Array] = None,  # [B, T] (no-cache path)
         layer_idx: int = 0,
+        write_start: Optional[jax.Array] = None,  # scalar: chunk write offset
     ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
         cfg = self.cfg
         dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
@@ -167,12 +168,16 @@ class DecoderLayer(nn.Module):
                     v[:, 0], mode="drop"
                 )
             else:
-                # Prefill into an empty cache: contiguous write at offset 0.
+                # Prefill: contiguous write at offset 0, or — for chunked
+                # prefill of long prompts — at a TRACED start position, so
+                # one compiled program serves every chunk of the prompt
+                # (dynamic start, static chunk shape).
+                start = write_start if write_start is not None else 0
                 k_full = jax.lax.dynamic_update_slice(
-                    k_full, k[None], (layer_idx, 0, 0, 0, 0)
+                    k_full, k[None], (layer_idx, 0, start, 0, 0)
                 )
                 v_full = jax.lax.dynamic_update_slice(
-                    v_full, v[None], (layer_idx, 0, 0, 0, 0)
+                    v_full, v[None], (layer_idx, 0, start, 0, 0)
                 )
             attn_out = attn_ops.dot_product_attention(
                 q, k_full[layer_idx], v_full[layer_idx], mask=mask
@@ -227,6 +232,7 @@ class DecoderModule(nn.Module):
         mask: Optional[jax.Array],  # [B, 1, T, S]
         cache: Optional[KVCache] = None,
         token_mask: Optional[jax.Array] = None,  # [B, T] (no-cache path)
+        write_start: Optional[jax.Array] = None,  # scalar chunk offset
     ) -> Tuple[jax.Array, Optional[KVCache]]:
         cfg = self.cfg
         embed = nn.Embed(
@@ -250,7 +256,8 @@ class DecoderModule(nn.Module):
         cache_kv = (cache.k, cache.v) if cache is not None else None
         for i in range(cfg.num_layers):
             x, updated = DecoderLayer(cfg, dtype=self.dtype, name=f"layer{i}")(
-                x, positions, mask, cache_kv, token_mask, layer_idx=i
+                x, positions, mask, cache_kv, token_mask, layer_idx=i,
+                write_start=write_start,
             )
             if updated is not None:
                 cache_kv = updated

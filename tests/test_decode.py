@@ -153,10 +153,12 @@ class TestDecodeEngine:
         assert len(result.tokens) == 1
 
     def test_oversized_prompt_rejected(self, lm):
-        engine, queue = make_engine(lm, prompt_buckets=[8])
-        req = submit(queue, list(range(20)))
+        """Beyond-bucket prompts now admit via chunked prefill; only
+        beyond-CAPACITY prompts are rejected."""
+        engine, queue = make_engine(lm, prompt_buckets=[8])  # max_len=64
+        req = submit(queue, [t % 50 + 1 for t in range(70)])
         engine.run_until_idle()
-        with pytest.raises(ValueError, match="exceeds"):
+        with pytest.raises(ValueError, match="exceeds KV capacity"):
             req.future.result(timeout=5)
         assert engine.active_slots == 0
 
@@ -290,6 +292,69 @@ class TestStreamingAndHorizon:
         engine.run_until_idle()
         assert engine.completed == 8
         assert len(first.future.result(timeout=5).tokens) == 6
+
+    def test_long_prompt_chunked_parity(self, lm):
+        """A prompt longer than every bucket admits via chunked prefill and
+        must generate exactly the tokens of a one-shot-bucketed engine."""
+        long_prompt = [(i * 7) % 50 + 1 for i in range(21)]
+        chunked, q1 = make_engine(lm, prompt_buckets=[8], max_len=64)
+        oneshot, q2 = make_engine(lm, prompt_buckets=[32], max_len=64)
+        r1 = submit(q1, long_prompt, max_new_tokens=6)
+        r2 = submit(q2, long_prompt, max_new_tokens=6)
+        chunked.run_until_idle(timeout_s=120)
+        oneshot.run_until_idle(timeout_s=120)
+        t1 = r1.future.result(timeout=5).tokens
+        t2 = r2.future.result(timeout=5).tokens
+        assert t1 == t2
+        assert len(t1) == 6
+
+    def test_long_prompt_interleaves_decode(self, lm):
+        """Active slots must advance BETWEEN prefill chunks: a long
+        admission may stall decode by at most one chunk, not the whole
+        prompt."""
+        engine, queue = make_engine(
+            lm, num_slots=2, prompt_buckets=[8], max_len=64,
+            decode_horizon=1,
+        )
+        short = submit(queue, [1, 2, 3], max_new_tokens=40)
+        assert engine._admit() == 1
+        engine._step()  # short request actively decoding
+        decode_calls = []
+        real_decode = engine._decode_fn
+
+        def counting(*args):
+            decode_calls.append(1)
+            return real_decode(*args)
+
+        engine._decode_fn = counting
+        submit(queue, [(i * 3) % 40 + 1 for i in range(20)],
+               max_new_tokens=4)
+        assert engine._admit() == 1  # 20 tokens / 8-chunks = 3 chunks
+        # 2 inter-chunk decode steps ran while the long prompt prefilled.
+        assert len(decode_calls) >= 2
+        engine.run_until_idle(timeout_s=120)
+        assert len(short.future.result(timeout=5).tokens) == 40
+
+    def test_long_prompt_capacity_not_chunk_multiple(self, lm):
+        """max_len NOT a multiple of the chunk width: the final chunk's
+        write must not clamp backward and corrupt earlier cache positions
+        (row cache rounds up to whole chunks; commit slices down)."""
+        long_prompt = [(i * 7) % 50 + 1 for i in range(19)]
+        chunked, q1 = make_engine(lm, prompt_buckets=[8], max_len=20)
+        oneshot, q2 = make_engine(lm, prompt_buckets=[32], max_len=32)
+        r1 = submit(q1, long_prompt, max_new_tokens=1)
+        r2 = submit(q2, long_prompt, max_new_tokens=1)
+        chunked.run_until_idle(timeout_s=120)
+        oneshot.run_until_idle(timeout_s=120)
+        assert (r1.future.result(timeout=5).tokens
+                == r2.future.result(timeout=5).tokens)
+
+    def test_prompt_beyond_capacity_rejected(self, lm):
+        engine, queue = make_engine(lm, prompt_buckets=[8], max_len=16)
+        req = submit(queue, list(range(1, 18)), max_new_tokens=2)
+        engine._admit()
+        with pytest.raises(ValueError, match="exceeds KV capacity"):
+            req.future.result(timeout=5)
 
     def test_eos_mid_horizon(self, lm):
         """A slot hitting EOS inside a scan horizon stops exactly at EOS and

@@ -77,6 +77,16 @@ class TestLLMDeployment:
         result = fut.result(timeout=30)
         assert [first] + rest == result.tokens
 
+    def test_long_prompt_served_via_chunked_prefill(self, llm_stack):
+        """A prompt past every bucket (16) but within KV capacity (64)
+        flows through the full serving path via chunked admission."""
+        _, handle = llm_stack
+        prompt = [(i * 5) % 40 + 1 for i in range(30)]
+        fut = handle.remote({"tokens": prompt, "max_new_tokens": 4})
+        result = fut.result(timeout=120)
+        assert len(result.tokens) == 4
+        assert result.finish_reason == "length"
+
     def test_controller_status_reports_engine(self, llm_stack):
         controller, _ = llm_stack
         status = controller.status()["llama_tiny"]
