@@ -108,6 +108,60 @@ class _Slot:
         return self.request is None
 
 
+PREFIX_HITS = m.Counter(
+    "rdb_decode_prefix_hits_total", "Prompt-prefix KV cache hits",
+    tag_keys=("model",),
+)
+PREFIX_MISSES = m.Counter(
+    "rdb_decode_prefix_misses_total", "Prompt-prefix KV cache misses",
+    tag_keys=("model",),
+)
+
+
+class PrefixCache:
+    """Device-resident LRU of prompt-prefix KV segments.
+
+    Long prompts often share a fixed head (system prompt, few-shot
+    preamble). Each entry stores one chunk-width's worth of computed k/v
+    (``[L, 1, C, K, H]`` pair, device arrays) keyed by the EXACT first-C
+    token ids; a hit seeds the admission's row cache with a copy instead of
+    recomputing the chunk — pure HBM traffic versus a full forward pass.
+    Fixed segment width keeps every shape static (one compiled seed
+    program). vLLM-style paged prefix trees need dynamic block tables; this
+    is the static-shape TPU rendition, deliberately chunk-granular.
+    """
+
+    def __init__(self, capacity: int, width: int):
+        self.capacity = int(capacity)
+        self.width = int(width)
+        self._entries: Dict[bytes, Tuple[jax.Array, jax.Array]] = {}
+        self._order: List[bytes] = []
+
+    def _key(self, prompt: np.ndarray) -> bytes:
+        return np.ascontiguousarray(prompt[: self.width]).tobytes()
+
+    def lookup(self, prompt: np.ndarray) -> Optional[Tuple[jax.Array, jax.Array]]:
+        key = self._key(prompt)
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._order.remove(key)
+            self._order.append(key)
+        return entry
+
+    def insert(self, prompt: np.ndarray, k: jax.Array, v: jax.Array) -> None:
+        key = self._key(prompt)
+        if key in self._entries:
+            return
+        self._entries[key] = (k, v)
+        self._order.append(key)
+        while len(self._order) > self.capacity:
+            victim = self._order.pop(0)
+            del self._entries[victim]  # device buffers freed on GC
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
 class DecodeEngine:
     """Continuous-batching executor for one CausalLM on one chip/mesh slice.
 
@@ -131,6 +185,7 @@ class DecodeEngine:
         decode_horizon: int = 8,
         ttft_horizon: Optional[int] = None,
         max_admissions_per_step: int = 2,
+        prefix_cache_size: int = 0,
         device: Optional[jax.Device] = None,
         mesh: Optional[Any] = None,
         base_seed: int = 0,
@@ -197,6 +252,12 @@ class DecodeEngine:
         self.ttft_horizon = min(max(1, int(ttft_horizon)),
                                 self.decode_horizon)
         self.max_admissions_per_step = max(1, int(max_admissions_per_step))
+        # Prompt-prefix KV reuse for chunked admissions (0 = off).
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache_size > 0 and self.prompt_buckets:
+            self.prefix_cache = PrefixCache(
+                prefix_cache_size, self.prompt_buckets[-1]
+            )
         self._prefill_fns: Dict[int, Callable] = {}
         self._decode_fn = jax.jit(
             self._decode_impl, donate_argnums=(1,), static_argnums=(4,)
@@ -587,15 +648,35 @@ class DecodeEngine:
         first = self._sample_tokens(last_logits, temps, topk, seeds, tok_idx)
         return first, cache.replace(k=k, v=v, lengths=lengths)
 
+    def _seed_prefix_impl(self, row_cache, pk, pv):
+        """Copy a cached prefix segment into positions [0, C) of a fresh
+        row cache — the HBM-copy replacement for recomputing chunk 0."""
+        C = pk.shape[2]
+        k = jax.lax.dynamic_update_slice(row_cache.k, pk, (0, 0, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(row_cache.v, pv, (0, 0, 0, 0, 0))
+        lengths = jnp.full_like(row_cache.lengths, C)
+        return row_cache.replace(k=k, v=v, lengths=lengths)
+
+    def _extract_prefix_impl(self, row_cache, width: int):
+        """Static slice of the first ``width`` cache positions (the just-
+        computed chunk 0) for insertion into the prefix cache."""
+        return row_cache.k[:, :, :width], row_cache.v[:, :, :width]
+
     def _long_prefill_fns(self, chunk: int):
-        """Lazily compiled (chunk fn, commit fn) — long prompts may never
-        arrive, so their programs are not part of warmup; the persistent
-        compilation cache absorbs the first-hit cost across restarts."""
+        """Lazily compiled (chunk, commit, seed, extract) fns — long
+        prompts may never arrive, so their programs are not part of warmup;
+        the persistent compilation cache absorbs the first-hit cost across
+        restarts."""
         fns = self._prefill_fns.get(("long", chunk))
         if fns is None:
             fns = (
                 jax.jit(self._prefill_chunk_impl, donate_argnums=(3,)),
-                jax.jit(self._commit_long_impl, donate_argnums=(0, 1)),
+                # Only the shared cache (arg 0) can alias the output; the
+                # row cache's [L,1,row_cap,K,H] matches no output shape, so
+                # donating it buys nothing and warns on every compile.
+                jax.jit(self._commit_long_impl, donate_argnums=(0,)),
+                jax.jit(self._seed_prefix_impl, donate_argnums=(0,)),
+                jax.jit(self._extract_prefix_impl, static_argnums=(1,)),
             )
             self._prefill_fns[("long", chunk)] = fns
         return fns
@@ -610,7 +691,7 @@ class DecodeEngine:
         (chunked-prefill admission), then commit the row into the shared
         cache. The reference has no analogue (single-shot vision)."""
         C = self.prompt_buckets[-1]
-        chunk_fn, commit_fn = self._long_prefill_fns(C)
+        chunk_fn, commit_fn, seed_fn, extract_fn = self._long_prefill_fns(C)
         L = int(prompt.size)
         n_chunks = (L + C - 1) // C
         # Private row cache rounded UP to whole chunks — ONE static shape
@@ -622,7 +703,20 @@ class DecodeEngine:
         row_cap = ((self.max_len + C - 1) // C) * C
         row = self.model.make_cache(1, row_cap)
         last = None
-        for ci in range(n_chunks):
+        start_chunk = 0
+        insert_after_chunk0 = False
+        if self.prefix_cache is not None:
+            # Chunk 0 is full (n_chunks >= 2 on this path), so its k/v
+            # depend only on the first C token ids — exactly reusable.
+            hit = self.prefix_cache.lookup(prompt)
+            if hit is not None:
+                row = seed_fn(row, *hit)
+                start_chunk = 1
+                PREFIX_HITS.inc(tags={"model": self.model.name})
+            else:
+                insert_after_chunk0 = True
+                PREFIX_MISSES.inc(tags={"model": self.model.name})
+        for ci in range(start_chunk, n_chunks):
             piece = prompt[ci * C : (ci + 1) * C]
             tokens = np.zeros((1, C), dtype=np.int32)
             mask = np.zeros((1, C), dtype=np.int32)
@@ -636,6 +730,8 @@ class DecodeEngine:
                 jnp.int32(ci * C),
                 jnp.int32(piece.size - 1),
             )
+            if ci == 0 and insert_after_chunk0:
+                self.prefix_cache.insert(prompt, *extract_fn(row, C))
             if ci < n_chunks - 1 and self._active_mask.any():
                 self._step(horizon=1)  # bound the stall on active slots
         first, self._cache = commit_fn(
@@ -820,6 +916,10 @@ class DecodeEngine:
         self.params = None
         self._prefill_fns.clear()
         self._decode_fn = None
+        if self.prefix_cache is not None:
+            # Entries hold device k/v arrays — unreferenced = freed on GC.
+            self.prefix_cache._entries.clear()
+            self.prefix_cache._order.clear()
 
     def abort_active(self, exc: Exception) -> None:
         """Reject every request still occupying a slot (replica shutdown:

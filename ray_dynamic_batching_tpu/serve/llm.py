@@ -266,6 +266,7 @@ class LLMDeployment:
         decode_horizon: int = 8,
         ttft_horizon: Optional[int] = None,
         max_admissions_per_step: int = 2,
+        prefix_cache_size: int = 0,
         dtype: Any = None,
         params: Any = None,
         model: Any = None,
@@ -281,6 +282,7 @@ class LLMDeployment:
         self.decode_horizon = decode_horizon
         self.ttft_horizon = ttft_horizon
         self.max_admissions_per_step = max_admissions_per_step
+        self.prefix_cache_size = prefix_cache_size
         self.warmup = warmup
         # KV-capacity buckets: one engine per entry, requests routed to the
         # smallest cache fitting prompt + max_new (LLMReplica docstring —
@@ -374,6 +376,7 @@ class LLMDeployment:
             decode_horizon=self.decode_horizon,
             ttft_horizon=self.ttft_horizon,
             max_admissions_per_step=self.max_admissions_per_step,
+            prefix_cache_size=self.prefix_cache_size,
             device=device,
             mesh=mesh,
         )

@@ -349,6 +349,53 @@ class TestStreamingAndHorizon:
         assert (r1.future.result(timeout=5).tokens
                 == r2.future.result(timeout=5).tokens)
 
+    def test_prefix_cache_hit_parity_and_skip(self, lm):
+        """Two long prompts sharing the first chunk: the second admission
+        must reuse the cached prefix KV (one fewer chunk dispatch) and
+        generate exactly the tokens of a cache-off engine."""
+        shared = [(i * 7) % 50 + 1 for i in range(8)]      # = chunk width
+        p1 = shared + [(i * 3) % 40 + 1 for i in range(10)]
+        p2 = shared + [(i * 11) % 40 + 1 for i in range(7)]
+        cached, q1 = make_engine(lm, prompt_buckets=[8], max_len=64,
+                                 prefix_cache_size=4)
+        plain, q2 = make_engine(lm, prompt_buckets=[8], max_len=64)
+        chunk_calls = []
+        orig = cached._prefill_chunk_impl
+
+        def counting(*args):
+            chunk_calls.append(1)
+            return orig(*args)
+
+        cached._prefill_chunk_impl = counting
+        cached._prefill_fns.pop(("long", 8), None)  # re-jit over the probe
+        r1 = submit(q1, p1, max_new_tokens=4)
+        cached.run_until_idle(timeout_s=120)
+        first_calls = len(chunk_calls)   # miss: all 3 chunks computed
+        r2 = submit(q1, p2, max_new_tokens=4)
+        cached.run_until_idle(timeout_s=120)
+        assert len(chunk_calls) - first_calls == first_calls - 1  # skip c0
+        assert len(cached.prefix_cache) == 1
+        for p, r in ((p1, r1), (p2, r2)):
+            ref = submit(q2, p, max_new_tokens=4)
+            plain.run_until_idle(timeout_s=120)
+            assert r.future.result(timeout=5).tokens == \
+                ref.future.result(timeout=5).tokens
+
+    def test_prefix_cache_lru_eviction(self, lm):
+        from ray_dynamic_batching_tpu.engine.decode import PrefixCache
+        import numpy as np
+        pc = PrefixCache(capacity=2, width=4)
+        a = np.arange(8, dtype=np.int32)
+        b = a + 1
+        c = a + 2
+        pc.insert(a, jnp.zeros((1,)), jnp.zeros((1,)))
+        pc.insert(b, jnp.ones((1,)), jnp.ones((1,)))
+        assert pc.lookup(a) is not None      # refresh a
+        pc.insert(c, jnp.ones((1,)), jnp.ones((1,)))  # evicts b (LRU)
+        assert pc.lookup(b) is None
+        assert pc.lookup(a) is not None and pc.lookup(c) is not None
+        assert len(pc) == 2
+
     def test_prompt_beyond_capacity_rejected(self, lm):
         engine, queue = make_engine(lm, prompt_buckets=[8], max_len=16)
         req = submit(queue, list(range(1, 18)), max_new_tokens=2)
