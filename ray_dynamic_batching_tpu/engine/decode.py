@@ -108,6 +108,14 @@ class _Slot:
         return self.request is None
 
 
+SPEC_ROUNDS = m.Counter(
+    "rdb_decode_spec_rounds_total", "Speculative verify rounds",
+    tag_keys=("model",),
+)
+SPEC_ACCEPTED = m.Counter(
+    "rdb_decode_spec_accepted_total", "Draft tokens accepted by verify",
+    tag_keys=("model",),
+)
 PREFIX_HITS = m.Counter(
     "rdb_decode_prefix_hits_total", "Prompt-prefix KV cache hits",
     tag_keys=("model",),
@@ -116,6 +124,74 @@ PREFIX_MISSES = m.Counter(
     "rdb_decode_prefix_misses_total", "Prompt-prefix KV cache misses",
     tag_keys=("model",),
 )
+
+
+def copy_rows_into(cache, rows, slots):
+    """Scatter a row-cache's per-request rows into the shared cache at
+    ``slots`` (static unroll — row count is a compile-time constant).
+    Shared by the target and draft prefill programs so the write rule
+    cannot diverge between them."""
+    nB = rows.lengths.shape[0]
+    k, v, lengths = cache.k, cache.v, cache.lengths
+    for i in range(nB):
+        k = jax.lax.dynamic_update_slice(
+            k, rows.k[:, i : i + 1], (0, slots[i], 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            v, rows.v[:, i : i + 1], (0, slots[i], 0, 0, 0)
+        )
+        lengths = jax.lax.dynamic_update_slice(
+            lengths, rows.lengths[i : i + 1], (slots[i],)
+        )
+    return cache.replace(k=k, v=v, lengths=lengths)
+
+
+def commit_row(cache, row, slot):
+    """Copy a single finished row cache into the shared cache at ``slot``,
+    slicing the (whole-chunk-rounded, possibly longer) row down to shared
+    capacity. Shared by the target and draft chunked-prefill commits."""
+    S = cache.capacity
+    k = jax.lax.dynamic_update_slice(
+        cache.k, row.k[:, :, :S], (0, slot, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        cache.v, row.v[:, :, :S], (0, slot, 0, 0, 0)
+    )
+    lengths = jax.lax.dynamic_update_slice(
+        cache.lengths, row.lengths, (slot,)
+    )
+    return cache.replace(k=k, v=v, lengths=lengths)
+
+
+def run_chunked(chunk_fn, params, prompt, C, row, start_chunk=0,
+                between=None, after_first=None):
+    """Host loop driving a compiled chunk program over a long prompt:
+    full-width chunks, right-padded tail, optional ``between`` callback
+    after every non-final chunk (the decode-interleave hook) and
+    ``after_first`` on chunk 0 (the prefix-cache insert hook). Returns
+    (last_logits, row)."""
+    L = int(prompt.size)
+    n_chunks = (L + C - 1) // C
+    last = None
+    for ci in range(start_chunk, n_chunks):
+        piece = prompt[ci * C : (ci + 1) * C]
+        tokens = np.zeros((1, C), dtype=np.int32)
+        mask = np.zeros((1, C), dtype=np.int32)
+        tokens[0, : piece.size] = piece
+        mask[0, : piece.size] = 1
+        last, row = chunk_fn(
+            params,
+            jnp.asarray(tokens),
+            jnp.asarray(mask),
+            row,
+            jnp.int32(ci * C),
+            jnp.int32(piece.size - 1),
+        )
+        if ci == 0 and after_first is not None:
+            after_first(row)
+        if ci < n_chunks - 1 and between is not None:
+            between()
+    return last, row
 
 
 class PrefixCache:
@@ -186,6 +262,9 @@ class DecodeEngine:
         ttft_horizon: Optional[int] = None,
         max_admissions_per_step: int = 2,
         prefix_cache_size: int = 0,
+        draft_model: Optional[Any] = None,
+        draft_params: Optional[Any] = None,
+        spec_tokens: int = 4,
         device: Optional[jax.Device] = None,
         mesh: Optional[Any] = None,
         base_seed: int = 0,
@@ -262,6 +341,39 @@ class DecodeEngine:
         self._decode_fn = jax.jit(
             self._decode_impl, donate_argnums=(1,), static_argnums=(4,)
         )
+        # Speculative decoding (greedy rows only): a small draft proposes
+        # spec_tokens continuations per slot, the target verifies the whole
+        # window in ONE forward, and the accepted prefix + the target's
+        # correction land at once — n tokens per target dispatch instead of
+        # one, with EXACT greedy equivalence (rejected tails are garbage
+        # past ``lengths``, the same invariant every other path relies on).
+        self.draft_model = draft_model
+        self.spec_tokens = max(1, int(spec_tokens))
+        self._dcache = None
+        if draft_model is not None:
+            if draft_params is None:
+                raise ValueError("draft_model requires draft_params")
+            if mesh is not None:
+                from ray_dynamic_batching_tpu.parallel.mesh import (
+                    shard_params as _shard,
+                )
+
+                draft_params = _shard(mesh, draft_model, draft_params)
+            elif device is not None:
+                draft_params = jax.device_put(draft_params, device)
+            self.draft_params = draft_params
+            with self._device_ctx():
+                # Headroom past max_len: the draft drafts spec_tokens+1
+                # ahead of the verified length near the end of the cache.
+                self._dcache = draft_model.make_cache(
+                    num_slots, max_len + self.spec_tokens + 1
+                )
+            self._spec_fn = jax.jit(
+                self._spec_impl, donate_argnums=(1, 2)
+            )
+            self._draft_catchup_fn = jax.jit(
+                self._draft_catchup_impl, donate_argnums=(1,)
+            )
         self._thread: Optional[threading.Thread] = None
         self._run = threading.Event()
         self.steps = 0
@@ -341,21 +453,11 @@ class DecodeEngine:
         last_logits, rows = self.model.prefill(
             params, tokens, attn_mask, row_cache
         )
-        k, v, lengths = cache.k, cache.v, cache.lengths
-        for i in range(nB):  # static unroll: nB is a compile-time constant
-            k = jax.lax.dynamic_update_slice(
-                k, rows.k[:, i : i + 1], (0, slots[i], 0, 0, 0)
-            )
-            v = jax.lax.dynamic_update_slice(
-                v, rows.v[:, i : i + 1], (0, slots[i], 0, 0, 0)
-            )
-            lengths = jax.lax.dynamic_update_slice(
-                lengths, rows.lengths[i : i + 1], (slots[i],)
-            )
+        cache = copy_rows_into(cache, rows, slots)
         first = self._sample_tokens(
             last_logits, temps, topk, seeds, tok_idx
         )  # [nB]
-        return first, cache.replace(k=k, v=v, lengths=lengths)
+        return first, cache
 
     def _decode_impl(self, params, cache, tokens, active, horizon: int,
                      temps, topk, seeds, tok_idx0):
@@ -388,6 +490,97 @@ class DecodeEngine:
             [toks, adv.astype(jnp.int32), cache.lengths[None, :]], axis=0
         )
         return packed, cache
+
+    def _spec_impl(self, params, cache, dcache, tokens, active):
+        """One speculative round for the whole batch, greedy-exact.
+
+        Draft scans ``k+1`` single-token steps (proposing d_1..d_k and
+        keeping its own cache complete through d_k), the target scores the
+        [t0, d_1..d_k] window in one ``verify_step`` forward, and each row
+        accepts its longest matching draft prefix plus the target's own
+        next token — between 1 and k+1 tokens per round, never diverging
+        from what plain greedy decode would emit.
+
+        Returns ``(packed [k+3, B] int32, cache, dcache)``: k+1 output-token
+        rows, an n_out row, and a post-round lengths row — one host fetch.
+        """
+        k = self.spec_tokens
+        B = tokens.shape[0]
+        S = self.max_len  # shared-cache capacity
+
+        def dstep(carry, _):
+            dc, tok = carry
+            logits, dc = self.draft_model.decode_step(
+                self.draft_params, tok, dc, active
+            )
+            nxt = jnp.argmax(
+                logits.astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, tok[:, 0])
+            return (dc, nxt[:, None]), nxt
+
+        dlen0 = dcache.lengths
+        (dcache, _), drafts = jax.lax.scan(
+            dstep, (dcache, tokens), None, length=k + 1
+        )  # drafts [k+1, B]; the final proposal is drafted only to keep
+        # the draft cache complete — it is never verified.
+        d = drafts[:k].T  # [B, k]
+        window = jnp.concatenate([tokens, d], axis=1)  # [B, k+1]
+        logits, cache = self.model.verify_step(params, window, cache, active)
+        greedy = jnp.argmax(
+            logits.astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)  # [B, k+1]; greedy[:, j] follows window[:, j]
+        match = (d == greedy[:, :k]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # accepted drafts
+        n_out = m + 1
+        # Capacity clamp: only tokens whose k/v actually landed may count.
+        remaining = jnp.maximum(S - cache.lengths, 0)
+        n_out = jnp.where(active, jnp.minimum(n_out, remaining), 0)
+        j_idx = jnp.arange(k + 1)[None, :]
+        gm = jnp.take_along_axis(greedy, m[:, None], axis=1)  # [B, 1]
+        d_pad = jnp.concatenate(
+            [d, jnp.zeros((B, 1), jnp.int32)], axis=1
+        )
+        out = jnp.where(j_idx < m[:, None], d_pad, gm)  # [B, k+1]
+        adv = n_out.astype(jnp.int32)
+        cache = cache.replace(lengths=cache.lengths + adv)
+        # Draft cache tracked the SAME sequence: roll its lengths back to
+        # the verified prefix (its k/v for t0..d_k stay resident; garbage
+        # past the new length is overwritten before it is ever attended).
+        dcache = dcache.replace(lengths=dlen0 + adv)
+        packed = jnp.concatenate(
+            [out.T, n_out[None, :], cache.lengths[None, :]], axis=0
+        )
+        return packed, cache, dcache
+
+    def _draft_catchup_impl(self, dparams, dcache, window, active, counts):
+        """Write the draft k/v for tokens the TARGET just decoded plainly
+        (window [B, h] at each row's own draft length) and advance draft
+        lengths by the per-row advanced count — the draft stays in lockstep
+        with the sequence without influencing it."""
+        _, dcache = self.draft_model.verify_step(
+            dparams, window, dcache, active
+        )
+        return dcache.replace(
+            lengths=dcache.lengths + jnp.where(active, counts, 0)
+        )
+
+    def _draft_prefill_impl(self, dparams, tokens, attn_mask, dcache, slots):
+        """Mirror of ``_prefill_impl`` for the draft model: fill the draft
+        cache's rows for newly admitted prompts (no sampling — the draft
+        only ever proposes from its cache)."""
+        nB = tokens.shape[0]
+        row_cache = self.draft_model.make_cache(nB, dcache.capacity)
+        _, rows = self.draft_model.prefill(dparams, tokens, attn_mask,
+                                           row_cache)
+        return copy_rows_into(dcache, rows, slots)
+
+    def _draft_prefill_fn(self, bucket: int, group: int) -> Callable:
+        fn = self._prefill_fns.get(("draft", bucket, group))
+        if fn is None:
+            fn = jax.jit(self._draft_prefill_impl, donate_argnums=(3,))
+            self._prefill_fns[("draft", bucket, group)] = fn
+        return fn
 
     def _admit_group_sizes(self) -> List[int]:
         """Compiled prefill group widths: powers of two up to the admission
@@ -443,6 +636,27 @@ class DecodeEngine:
                 jnp.zeros((self.num_slots,), jnp.int32),
             )
             packed.block_until_ready()
+        if self._dcache is not None:
+            for b in self.prompt_buckets:
+                for g in self._admit_group_sizes():
+                    self._dcache = self._draft_prefill_fn(b, g)(
+                        self.draft_params,
+                        jnp.zeros((g, b), dtype=jnp.int32),
+                        jnp.ones((g, b), dtype=jnp.int32),
+                        self._dcache,
+                        jnp.arange(g, dtype=jnp.int32) % self.num_slots,
+                    )
+            packed, self._cache, self._dcache = self._spec_fn(
+                self.params,
+                self._cache,
+                self._dcache,
+                jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
+                jnp.zeros((self.num_slots,), dtype=bool),
+            )
+            packed.block_until_ready()
+            self._dcache = self._dcache.replace(
+                lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
+            )
         # Reset state dirtied by warmup runs.
         self._cache = self._cache.replace(
             lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
@@ -616,6 +830,15 @@ class DecodeEngine:
             jnp.asarray(seeds),
             jnp.zeros((group,), jnp.int32),  # prefill samples token 0
         )
+        if self._dcache is not None:
+            # The draft must see the same prompt: fill its cache rows too.
+            self._dcache = self._draft_prefill_fn(bucket, group)(
+                self.draft_params,
+                jnp.asarray(tokens),
+                jnp.asarray(mask),
+                self._dcache,
+                jnp.asarray(slots),
+            )
         first_host = np.asarray(first)  # ONE fetch for the whole group
         t = now_ms()
         for i, (req, _prompt, opts) in enumerate(items):
@@ -635,18 +858,9 @@ class DecodeEngine:
         cache is a whole number of chunks, so it can be LONGER than the
         shared cache; the static slice keeps only real capacity (positions
         past ``lengths`` are garbage either way and never attended)."""
-        S = cache.capacity
-        k = jax.lax.dynamic_update_slice(
-            cache.k, row_cache.k[:, :, :S], (0, slot, 0, 0, 0)
-        )
-        v = jax.lax.dynamic_update_slice(
-            cache.v, row_cache.v[:, :, :S], (0, slot, 0, 0, 0)
-        )
-        lengths = jax.lax.dynamic_update_slice(
-            cache.lengths, row_cache.lengths, (slot,)
-        )
+        cache = commit_row(cache, row_cache, slot)
         first = self._sample_tokens(last_logits, temps, topk, seeds, tok_idx)
-        return first, cache.replace(k=k, v=v, lengths=lengths)
+        return first, cache
 
     def _seed_prefix_impl(self, row_cache, pk, pv):
         """Copy a cached prefix segment into positions [0, C) of a fresh
@@ -702,9 +916,8 @@ class DecodeEngine:
         # the commit slices back down to shared capacity.
         row_cap = ((self.max_len + C - 1) // C) * C
         row = self.model.make_cache(1, row_cap)
-        last = None
         start_chunk = 0
-        insert_after_chunk0 = False
+        after_first = None
         if self.prefix_cache is not None:
             # Chunk 0 is full (n_chunks >= 2 on this path), so its k/v
             # depend only on the first C token ids — exactly reusable.
@@ -714,26 +927,20 @@ class DecodeEngine:
                 start_chunk = 1
                 PREFIX_HITS.inc(tags={"model": self.model.name})
             else:
-                insert_after_chunk0 = True
+                after_first = lambda r: self.prefix_cache.insert(  # noqa: E731
+                    prompt, *extract_fn(r, C)
+                )
                 PREFIX_MISSES.inc(tags={"model": self.model.name})
-        for ci in range(start_chunk, n_chunks):
-            piece = prompt[ci * C : (ci + 1) * C]
-            tokens = np.zeros((1, C), dtype=np.int32)
-            mask = np.zeros((1, C), dtype=np.int32)
-            tokens[0, : piece.size] = piece
-            mask[0, : piece.size] = 1
-            last, row = chunk_fn(
-                self.params,
-                jnp.asarray(tokens),
-                jnp.asarray(mask),
-                row,
-                jnp.int32(ci * C),
-                jnp.int32(piece.size - 1),
-            )
-            if ci == 0 and insert_after_chunk0:
-                self.prefix_cache.insert(prompt, *extract_fn(row, C))
-            if ci < n_chunks - 1 and self._active_mask.any():
+
+        def between():
+            if self._active_mask.any():
                 self._step(horizon=1)  # bound the stall on active slots
+
+        last, row = run_chunked(
+            chunk_fn, self.params, prompt, C, row,
+            start_chunk=start_chunk, between=between,
+            after_first=after_first,
+        )
         first, self._cache = commit_fn(
             self._cache,
             row,
@@ -744,8 +951,41 @@ class DecodeEngine:
             jnp.asarray([opts["seed"]], np.int32),
             jnp.zeros((1,), jnp.int32),
         )
+        if self._dcache is not None:
+            self._draft_long_fill(prompt, slot_idx, C)
         self._register(slot_idx, req, int(np.asarray(first)[0]), opts,
                        now_ms())
+
+    def _draft_long_fill(self, prompt: np.ndarray, slot_idx: int,
+                         C: int) -> None:
+        """Chunk the long prompt through the DRAFT model into its cache
+        row, interleaving decode steps between chunks like the target fill
+        — the chunked-prefill latency bound (one chunk's stall, not the
+        whole prompt) must hold for the draft pass too."""
+        fns = self._prefill_fns.get(("draft_long", C))
+        if fns is None:
+            def chunk_impl(dparams, tokens, attn_mask, row, start, take):
+                return self.draft_model.prefill_chunk(
+                    dparams, tokens, attn_mask, row, start, take
+                )
+
+            fns = (
+                jax.jit(chunk_impl, donate_argnums=(3,)),
+                jax.jit(commit_row, donate_argnums=(0,)),
+            )
+            self._prefill_fns[("draft_long", C)] = fns
+        chunk_fn, commit_fn = fns
+        dcap = self._dcache.capacity
+        row = self.draft_model.make_cache(1, ((dcap + C - 1) // C) * C)
+
+        def between():
+            if self._active_mask.any():
+                self._step(horizon=1)
+
+        _, row = run_chunked(
+            chunk_fn, self.draft_params, prompt, C, row, between=between
+        )
+        self._dcache = commit_fn(self._dcache, row, jnp.int32(slot_idx))
 
     def _register(
         self, slot_idx: int, req: Request, first_tok: int, opts: Dict,
@@ -825,18 +1065,75 @@ class DecodeEngine:
             return self.ttft_horizon
         return 1
 
+    def _use_spec(self) -> bool:
+        """Speculative rounds serve all-greedy batches only: sampled rows
+        need rejection sampling for exactness, so any temperature>0 row
+        drops the whole batch back to plain decode."""
+        return (
+            self._dcache is not None
+            and self._sample_custom is None
+            and bool(self._active_mask.any())
+            and float(self._temps[self._active_mask].max(initial=0.0)) == 0.0
+        )
+
+    def _spec_step(self) -> None:
+        k = self.spec_tokens
+        packed, self._cache, self._dcache = self._spec_fn(
+            self.params,
+            self._cache,
+            self._dcache,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._active_mask),
+        )
+        ph = np.asarray(packed)  # ONE fetch per round
+        out = ph[: k + 1]        # [k+1, B]
+        n_out = ph[k + 1]        # [B]
+        lengths = ph[k + 2]      # [B]
+        self.steps += 1
+        DECODE_STEPS.inc(tags={"model": self.model.name})
+        SPEC_ROUNDS.inc(tags={"model": self.model.name})
+        for i, slot in enumerate(self._slots):
+            if slot.free or not self._active_mask[i]:
+                continue
+            n = int(n_out[i])
+            if n == 0:
+                self._finish(i, "capacity")
+                continue
+            SPEC_ACCEPTED.inc(n - 1, tags={"model": self.model.name})
+            finished = False
+            for j in range(n):
+                tok = int(out[j, i])
+                slot.generated.append(tok)
+                slot.last_token = tok
+                self._tokens[i, 0] = tok
+                slot.request.stream_put(tok)
+                if self._is_stop(slot, tok):
+                    self._finish(i, "eos")
+                    finished = True
+                    break
+                if len(slot.generated) >= slot.max_new_tokens:
+                    self._finish(i, "length")
+                    finished = True
+                    break
+            if not finished and lengths[i] >= self.max_len:
+                self._finish(i, "capacity")
+
     def _step(self, horizon: Optional[int] = None) -> None:
+        if horizon is None and self._use_spec():
+            return self._spec_step()
         h = horizon if horizon is not None else self._pick_horizon()
         # Per-slot index of the NEXT token to sample (prefill was index 0).
         tok_idx = np.asarray(
             [len(s.generated) if not s.free else 0 for s in self._slots],
             dtype=np.int32,
         )
+        prev_tokens = self._tokens.copy()  # draft catch-up window head
+        active_at_dispatch = self._active_mask.copy()
         packed, self._cache = self._decode_fn(
             self.params,
             self._cache,
             jnp.asarray(self._tokens),
-            jnp.asarray(self._active_mask),
+            jnp.asarray(active_at_dispatch),
             h,
             jnp.asarray(self._temps),
             jnp.asarray(self._topk),
@@ -849,6 +1146,23 @@ class DecodeEngine:
         lengths_host = packed_host[2 * h]         # [B] (post-horizon)
         self.steps += h
         DECODE_STEPS.inc(h, tags={"model": self.model.name})
+        if self._dcache is not None:
+            # Keep the DRAFT cache tracking the sequence through plain
+            # decode intervals (sampled-row fallback, inter-chunk steps):
+            # without this, speculation resumes from a stale draft context
+            # and acceptance collapses. The tokens whose k/v landed at
+            # positions [len, len+h) are [pending, emitted[:-1]].
+            window = np.concatenate(
+                [prev_tokens, toks_host[: h - 1].T], axis=1
+            )  # [B, h]
+            counts = advanced_host.sum(axis=0).astype(np.int32)
+            self._dcache = self._draft_catchup_fn(
+                self.draft_params,
+                self._dcache,
+                jnp.asarray(window),
+                jnp.asarray(active_at_dispatch),
+                jnp.asarray(counts),
+            )
         for i, slot in enumerate(self._slots):
             if slot.free or not self._active_mask[i]:
                 continue
@@ -916,6 +1230,10 @@ class DecodeEngine:
         self.params = None
         self._prefill_fns.clear()
         self._decode_fn = None
+        self._dcache = None
+        if self.draft_model is not None:
+            self.draft_params = None
+            self._spec_fn = None
         if self.prefix_cache is not None:
             # Entries hold device k/v arrays — unreferenced = freed on GC.
             self.prefix_cache._entries.clear()

@@ -162,6 +162,35 @@ class CausalLM(ServableModel):
         taken = jax.lax.dynamic_slice_in_dim(logits, take_idx, 1, axis=1)
         return taken[:, 0], new_cache.replace(lengths=new_lengths)
 
+    def verify_step(
+        self,
+        params,
+        tokens: jax.Array,   # [B, T] pending token + proposed continuation
+        cache: KVCache,
+        active: jax.Array,   # [B] bool
+    ) -> Tuple[jax.Array, KVCache]:
+        """Score a T-token window per row in ONE forward (the speculative-
+        verify primitive): row b's window starts at its own ``lengths[b]``,
+        k/v scatter per row at those positions, and logits[b, j] scores the
+        token AFTER window position j. ``lengths`` are NOT advanced — the
+        caller accepts a per-row prefix and sets them. Inactive rows are
+        steered out of bounds (writes dropped, logits garbage)."""
+        B, T = tokens.shape
+        S = cache.capacity
+        base = cache.lengths[:, None]  # [B,1]
+        positions = base + jnp.arange(T)[None, :]
+        # Out-of-bounds positions for inactive/overflowing rows: their
+        # scatter is dropped and their outputs are never used.
+        positions = jnp.where(
+            active[:, None] & (positions < S), positions, S
+        )
+        s_idx = jnp.arange(S)[None, None, None, :]
+        mask = s_idx <= positions[:, None, :, None]
+        logits, new_cache = self.module.apply(
+            params, tokens, positions, mask, cache, scatter_writes=True
+        )
+        return logits, new_cache
+
     def decode_step(
         self,
         params,

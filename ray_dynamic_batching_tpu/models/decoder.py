@@ -128,6 +128,7 @@ class DecoderLayer(nn.Module):
         token_mask: Optional[jax.Array] = None,  # [B, T] (no-cache path)
         layer_idx: int = 0,
         write_start: Optional[jax.Array] = None,  # scalar: chunk write offset
+        scatter_writes: bool = False,  # per-row writes at ``positions``
     ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
         cfg = self.cfg
         dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
@@ -155,7 +156,19 @@ class DecoderLayer(nn.Module):
             # GPT-2-medium at 32 slots vs ~2 ms with in-place updates).
             k_full, v_full = cache_kv
             B, T = positions.shape
-            if T == 1:
+            if scatter_writes:
+                # Batched multi-token writes at PER-ROW positions (the
+                # speculative-verify path: each slot's window starts at its
+                # own length). mode="drop" voids rows steered out of
+                # bounds, exactly like the single-token decode scatter.
+                rows = jnp.arange(B)[:, None]
+                k_full = k_full.at[layer_idx, rows, positions].set(
+                    k, mode="drop"
+                )
+                v_full = v_full.at[layer_idx, rows, positions].set(
+                    v, mode="drop"
+                )
+            elif T == 1:
                 # Decode: scatter this token's k/v at its row position.
                 # mode="drop" makes a full row's out-of-bounds write a no-op
                 # instead of clamping onto (and corrupting) the last slot.
@@ -233,6 +246,7 @@ class DecoderModule(nn.Module):
         cache: Optional[KVCache] = None,
         token_mask: Optional[jax.Array] = None,  # [B, T] (no-cache path)
         write_start: Optional[jax.Array] = None,  # scalar chunk offset
+        scatter_writes: bool = False,  # per-row multi-token cache writes
     ) -> Tuple[jax.Array, Optional[KVCache]]:
         cfg = self.cfg
         embed = nn.Embed(
@@ -257,7 +271,7 @@ class DecoderModule(nn.Module):
         for i in range(cfg.num_layers):
             x, updated = DecoderLayer(cfg, dtype=self.dtype, name=f"layer{i}")(
                 x, positions, mask, cache_kv, token_mask, layer_idx=i,
-                write_start=write_start,
+                write_start=write_start, scatter_writes=scatter_writes,
             )
             if updated is not None:
                 cache_kv = updated

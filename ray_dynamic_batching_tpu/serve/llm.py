@@ -272,6 +272,9 @@ class LLMDeployment:
         model: Any = None,
         warmup: bool = True,
         length_buckets: Optional[Sequence[int]] = None,
+        draft_model_name: Optional[str] = None,
+        draft_params: Any = None,
+        spec_tokens: int = 4,
     ) -> None:
         self.model_name = model_name
         self.num_slots = num_slots
@@ -289,6 +292,12 @@ class LLMDeployment:
         # the static-shape alternative to paged attention). Default: one
         # engine at max_len.
         self.length_buckets = sorted(length_buckets or [max_len])
+        # Speculative decoding: a smaller registry model drafts, the target
+        # verifies (greedy-exact; see DecodeEngine._spec_impl).
+        self.draft_model_name = draft_model_name
+        self.spec_tokens = spec_tokens
+        self._draft_model = None
+        self._draft_params = draft_params
         self._dtype = dtype
         self._model = model
         self._params = params
@@ -306,6 +315,19 @@ class LLMDeployment:
                 import jax
 
                 self._params = self._model.init(jax.random.PRNGKey(0))
+            if self.draft_model_name is not None and self._draft_model is None:
+                from ray_dynamic_batching_tpu.models.base import get_model
+
+                kwargs = (
+                    {"dtype": self._dtype} if self._dtype is not None else {}
+                )
+                self._draft_model = get_model(self.draft_model_name, **kwargs)
+                if self._draft_params is None:
+                    import jax
+
+                    self._draft_params = self._draft_model.init(
+                        jax.random.PRNGKey(1)
+                    )
 
     def auto_num_slots(self, n_chips: int = 1,
                        max_len: Optional[int] = None,
@@ -377,6 +399,9 @@ class LLMDeployment:
             ttft_horizon=self.ttft_horizon,
             max_admissions_per_step=self.max_admissions_per_step,
             prefix_cache_size=self.prefix_cache_size,
+            draft_model=self._draft_model,
+            draft_params=self._draft_params,
+            spec_tokens=self.spec_tokens,
             device=device,
             mesh=mesh,
         )

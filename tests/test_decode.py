@@ -180,6 +180,119 @@ class TestDecodeEngine:
         assert len(req.future.result(timeout=5).tokens) == 3
 
 
+@pytest.fixture(scope="module")
+def draft_lm():
+    """A DIFFERENT tiny model as the draft: disagrees with the target often
+    enough to exercise partial acceptance."""
+    model = get_model("llama_tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(42))  # different weights
+    return model, params
+
+
+class TestSpeculativeDecode:
+    def _engines(self, lm, draft, **kw):
+        model, params = lm
+        dmodel, dparams = draft
+        q1 = RequestQueue(model.name, max_len=256)
+        q2 = RequestQueue(model.name, max_len=256)
+        base = dict(num_slots=4, max_len=64, prompt_buckets=[8, 16],
+                    default_max_new_tokens=8)
+        base.update(kw)
+        spec = DecodeEngine(model, params, q1, draft_model=dmodel,
+                            draft_params=dparams, spec_tokens=3, **base)
+        plain = DecodeEngine(model, params, q2, **base)
+        return spec, q1, plain, q2
+
+    def test_exact_greedy_with_divergent_draft(self, lm, draft_lm):
+        """A draft with different weights yields partial acceptance, but
+        verified output must still be EXACTLY plain greedy."""
+        spec, q1, plain, q2 = self._engines(lm, draft_lm)
+        prompts = [[5, 9, 2, 7], [3, 1, 4], [11, 13], [2, 4, 6, 8, 10]]
+        r1 = [submit(q1, p, max_new_tokens=12) for p in prompts]
+        r2 = [submit(q2, p, max_new_tokens=12) for p in prompts]
+        spec.run_until_idle(timeout_s=180)
+        plain.run_until_idle(timeout_s=180)
+        for a, b in zip(r1, r2):
+            assert (a.future.result(timeout=5).tokens
+                    == b.future.result(timeout=5).tokens)
+
+    def test_self_draft_accepts_everything(self, lm):
+        """draft == target: every proposal verifies, so each round lands
+        spec_tokens+1 tokens and the round count collapses."""
+        model, params = lm
+        q = RequestQueue(model.name, max_len=256)
+        spec = DecodeEngine(model, params, q, num_slots=2, max_len=64,
+                            prompt_buckets=[8], draft_model=model,
+                            draft_params=params, spec_tokens=3)
+        req = submit(q, [5, 9, 2, 7], max_new_tokens=12)
+        spec.run_until_idle(timeout_s=120)
+        assert len(req.future.result(timeout=5).tokens) == 12
+        # 12 tokens: 1 from prefill + rounds of 4 -> 3 spec rounds.
+        assert spec.steps == 3
+
+    def test_sampled_rows_fall_back_to_plain_decode(self, lm, draft_lm):
+        """temperature > 0 in the batch must bypass the speculative path
+        (exactness only holds for greedy)."""
+        spec, q1, _, _ = self._engines(lm, draft_lm)
+        req = submit(q1, [1, 2, 3], max_new_tokens=6, temperature=0.8,
+                     seed=7)
+        spec._admit()
+        assert not spec._use_spec()
+        spec.run_until_idle(timeout_s=120)
+        assert len(req.future.result(timeout=5).tokens) == 6
+
+    def test_draft_stays_synced_through_plain_intervals(self, lm):
+        """Plain decode steps (chunked-prefill interleave) must catch the
+        DRAFT cache up; with draft == target, speculation afterwards still
+        accepts EVERY proposal — a desynced draft would collapse to ~0."""
+        from ray_dynamic_batching_tpu.engine.decode import (
+            SPEC_ACCEPTED,
+            SPEC_ROUNDS,
+        )
+        model, params = lm
+        q = RequestQueue(model.name, max_len=256)
+        spec = DecodeEngine(model, params, q, num_slots=2, max_len=96,
+                            prompt_buckets=[8], draft_model=model,
+                            draft_params=params, spec_tokens=3)
+        # Greedy request decoding...
+        r1 = submit(q, [5, 9, 2, 7], max_new_tokens=30)
+        spec._admit()
+        spec._step()
+        # ...then a long admission forces plain interleave steps.
+        r2 = submit(q, [(i * 7) % 50 + 1 for i in range(20)],
+                    max_new_tokens=30)
+        rounds0 = SPEC_ROUNDS.get(tags={"model": model.name})
+        acc0 = SPEC_ACCEPTED.get(tags={"model": model.name})
+        spec.run_until_idle(timeout_s=180)
+        rounds = SPEC_ROUNDS.get(tags={"model": model.name}) - rounds0
+        acc = SPEC_ACCEPTED.get(tags={"model": model.name}) - acc0
+        assert len(r1.future.result(timeout=5).tokens) == 30
+        assert len(r2.future.result(timeout=5).tokens) == 30
+        # Self-draft: every verified round must accept all 3 proposals
+        # (per active row). With 2 rows active much of the time, accepted
+        # averages > 3 per round; a desynced draft would give ~0.
+        assert rounds > 0
+        assert acc >= rounds * 3, (acc, rounds)
+
+    def test_spec_with_long_prompt_and_eos(self, lm, draft_lm):
+        """Chunked admission fills the DRAFT cache too; stop tokens cut a
+        round's accepted run mid-window exactly like plain decode."""
+        spec, q1, plain, q2 = self._engines(lm, draft_lm)
+        long_prompt = [(i * 7) % 50 + 1 for i in range(20)]
+        probe = submit(q2, long_prompt, max_new_tokens=8)
+        plain.run_until_idle(timeout_s=180)
+        toks = probe.future.result(timeout=5).tokens
+        stop = toks[4]  # force a stop mid-generation
+        r1 = submit(q1, long_prompt, max_new_tokens=8,
+                    stop_token_ids=[stop])
+        r2 = submit(q2, long_prompt, max_new_tokens=8,
+                    stop_token_ids=[stop])
+        spec.run_until_idle(timeout_s=180)
+        plain.run_until_idle(timeout_s=180)
+        assert (r1.future.result(timeout=5).tokens
+                == r2.future.result(timeout=5).tokens)
+
+
 class TestStreamingAndHorizon:
     def test_tokens_stream_before_completion(self, lm):
         """Streaming contract (ref serve/batching.py:209-276): tokens must

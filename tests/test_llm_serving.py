@@ -87,6 +87,29 @@ class TestLLMDeployment:
         assert len(result.tokens) == 4
         assert result.finish_reason == "length"
 
+    def test_speculative_deployment_matches_plain(self, llm_stack):
+        """LLMDeployment(draft_model_name=...) serves greedy-identical
+        output through the full stack."""
+        _, plain_handle = llm_stack
+        controller = ServeController(control_interval_s=0.1)
+        dep = LLMDeployment(
+            "llama_tiny", num_slots=4, max_len=64, prompt_buckets=[8, 16],
+            default_max_new_tokens=8, dtype=jnp.float32,
+            draft_model_name="llama_tiny", spec_tokens=3,
+        )
+        router = controller.deploy(
+            DeploymentConfig(name="llama_spec"), factory=dep
+        )
+        controller.start()
+        try:
+            spec_handle = DeploymentHandle(router)
+            payload = {"tokens": [5, 9, 2, 7], "max_new_tokens": 10}
+            a = spec_handle.remote(dict(payload)).result(timeout=120)
+            b = plain_handle.remote(dict(payload)).result(timeout=120)
+            assert a.tokens == b.tokens
+        finally:
+            controller.shutdown()
+
     def test_controller_status_reports_engine(self, llm_stack):
         controller, _ = llm_stack
         status = controller.status()["llama_tiny"]
