@@ -654,6 +654,17 @@ class DecodeEngine:
                 jnp.zeros((self.num_slots,), dtype=bool),
             )
             packed.block_until_ready()
+            # The catch-up runs after every PLAIN step of a spec engine —
+            # one window shape per horizon; compile them now, not at the
+            # first sampled request mid-serving.
+            for h in {1, self.ttft_horizon, self.decode_horizon}:
+                self._dcache = self._draft_catchup_fn(
+                    self.draft_params,
+                    self._dcache,
+                    jnp.zeros((self.num_slots, h), dtype=jnp.int32),
+                    jnp.zeros((self.num_slots,), dtype=bool),
+                    jnp.zeros((self.num_slots,), dtype=jnp.int32),
+                )
             self._dcache = self._dcache.replace(
                 lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
             )
@@ -1234,6 +1245,7 @@ class DecodeEngine:
         if self.draft_model is not None:
             self.draft_params = None
             self._spec_fn = None
+            self._draft_catchup_fn = None
         if self.prefix_cache is not None:
             # Entries hold device k/v arrays — unreferenced = freed on GC.
             self.prefix_cache._entries.clear()

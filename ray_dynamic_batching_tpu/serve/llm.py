@@ -275,6 +275,8 @@ class LLMDeployment:
         draft_model_name: Optional[str] = None,
         draft_params: Any = None,
         spec_tokens: int = 4,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_step: Optional[int] = None,
     ) -> None:
         self.model_name = model_name
         self.num_slots = num_slots
@@ -298,6 +300,17 @@ class LLMDeployment:
         self.spec_tokens = spec_tokens
         self._draft_model = None
         self._draft_params = draft_params
+        # Real weights: restored from the checkpoint subsystem instead of a
+        # fresh init (the reference reloads torchvision weights per worker,
+        # scheduler.py:507-515; here orbax-style trees restore once and are
+        # shared across replicas).
+        if checkpoint_dir is not None and params is not None:
+            raise ValueError(
+                "pass either params or checkpoint_dir, not both — the "
+                "checkpoint would be silently ignored"
+            )
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_step = checkpoint_step
         self._dtype = dtype
         self._model = model
         self._params = params
@@ -315,6 +328,14 @@ class LLMDeployment:
                 import jax
 
                 self._params = self._model.init(jax.random.PRNGKey(0))
+                if self.checkpoint_dir is not None:
+                    from ray_dynamic_batching_tpu.runtime.checkpoint import (
+                        CheckpointManager,
+                    )
+
+                    self._params = CheckpointManager(
+                        self.checkpoint_dir
+                    ).restore(self._params, step=self.checkpoint_step)
             if self.draft_model_name is not None and self._draft_model is None:
                 from ray_dynamic_batching_tpu.models.base import get_model
 
@@ -346,15 +367,30 @@ class LLMDeployment:
 
         self._ensure_model()
         cfg = get_config()
-        weights_bytes = sum(
-            leaf.size * leaf.dtype.itemsize
-            for leaf in jax.tree_util.tree_leaves(self._params)
-            if hasattr(leaf, "size")
-        ) / max(1, n_chips)
+
+        def tree_bytes(tree) -> float:
+            return sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(tree)
+                if hasattr(leaf, "size")
+            )
+
+        weights_bytes = tree_bytes(self._params) / max(1, n_chips)
         budget = float(cfg.hbm_budget_bytes)
         per_slot = float(
             self._model.kv_bytes_per_slot(max_len or self.max_len)
         ) / max(1, n_chips)
+        if self._draft_model is not None:
+            # Speculative decoding doubles the residency story: the draft's
+            # weights leave the budget, and every slot also carries a draft
+            # KV row (with spec-token headroom) — omit either and the
+            # "fits" answer OOMs on the chip.
+            weights_bytes += tree_bytes(self._draft_params) / max(1, n_chips)
+            per_slot += float(
+                self._draft_model.kv_bytes_per_slot(
+                    (max_len or self.max_len) + self.spec_tokens + 1
+                )
+            ) / max(1, n_chips)
         usable = (
             (budget - weights_bytes) * cfg.hbm_plan_fraction * budget_fraction
         )
