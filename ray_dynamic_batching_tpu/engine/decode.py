@@ -102,6 +102,8 @@ class _Slot:
     prefill_done_ms: float = 0.0
     last_token: int = 0
     stop: frozenset = frozenset()  # per-request stop token ids
+    session_id: Optional[str] = None        # store row on finish
+    prompt_tokens: Optional[np.ndarray] = None  # session history head
 
     @property
     def free(self) -> bool:
@@ -164,11 +166,14 @@ def commit_row(cache, row, slot):
 
 
 def run_chunked(chunk_fn, params, prompt, C, row, start_chunk=0,
-                between=None, after_first=None):
-    """Host loop driving a compiled chunk program over a long prompt:
-    full-width chunks, right-padded tail, optional ``between`` callback
-    after every non-final chunk (the decode-interleave hook) and
-    ``after_first`` on chunk 0 (the prefix-cache insert hook). Returns
+                between=None, after_first=None, base=0):
+    """Host loop driving a compiled chunk program over a (tail of a)
+    prompt: full-width chunks, right-padded tail, optional ``between``
+    callback after every non-final chunk (the decode-interleave hook) and
+    ``after_first`` on chunk 0 (the prefix-cache insert hook). ``base`` is
+    the global position of ``prompt[0]`` — nonzero when earlier positions
+    were seeded from cached KV (session continuation), and need not be
+    chunk-aligned (the chunk program takes a traced start). Returns
     (last_logits, row)."""
     L = int(prompt.size)
     n_chunks = (L + C - 1) // C
@@ -184,7 +189,7 @@ def run_chunked(chunk_fn, params, prompt, C, row, start_chunk=0,
             jnp.asarray(tokens),
             jnp.asarray(mask),
             row,
-            jnp.int32(ci * C),
+            jnp.int32(base + ci * C),
             jnp.int32(piece.size - 1),
         )
         if ci == 0 and after_first is not None:
@@ -194,7 +199,38 @@ def run_chunked(chunk_fn, params, prompt, C, row, start_chunk=0,
     return last, row
 
 
-class PrefixCache:
+class _DeviceLRU:
+    """Bounded LRU whose values hold DEVICE arrays: dropping the last
+    reference on eviction frees the HBM on GC. Shared mechanics for the
+    prefix and session caches so the eviction/touch invariants cannot
+    diverge."""
+
+    def __init__(self, capacity: int):
+        from collections import OrderedDict
+
+        self.capacity = int(capacity)
+        self._entries = OrderedDict()
+
+    def _get(self, key):
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+        return entry
+
+    def _put(self, key, value) -> None:
+        self._entries[key] = value
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)  # device buffers freed on GC
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class PrefixCache(_DeviceLRU):
     """Device-resident LRU of prompt-prefix KV segments.
 
     Long prompts often share a fixed head (system prompt, few-shot
@@ -208,34 +244,59 @@ class PrefixCache:
     """
 
     def __init__(self, capacity: int, width: int):
-        self.capacity = int(capacity)
+        super().__init__(capacity)
         self.width = int(width)
-        self._entries: Dict[bytes, Tuple[jax.Array, jax.Array]] = {}
-        self._order: List[bytes] = []
 
     def _key(self, prompt: np.ndarray) -> bytes:
         return np.ascontiguousarray(prompt[: self.width]).tobytes()
 
     def lookup(self, prompt: np.ndarray) -> Optional[Tuple[jax.Array, jax.Array]]:
-        key = self._key(prompt)
-        entry = self._entries.get(key)
-        if entry is not None:
-            self._order.remove(key)
-            self._order.append(key)
-        return entry
+        return self._get(self._key(prompt))
 
     def insert(self, prompt: np.ndarray, k: jax.Array, v: jax.Array) -> None:
         key = self._key(prompt)
-        if key in self._entries:
-            return
-        self._entries[key] = (k, v)
-        self._order.append(key)
-        while len(self._order) > self.capacity:
-            victim = self._order.pop(0)
-            del self._entries[victim]  # device buffers freed on GC
+        if key not in self._entries:
+            self._put(key, (k, v))
 
-    def __len__(self) -> int:
-        return len(self._entries)
+
+SESSION_HITS = m.Counter(
+    "rdb_decode_session_hits_total", "Session KV continuations",
+    tag_keys=("model",),
+)
+SESSION_MISSES = m.Counter(
+    "rdb_decode_session_misses_total",
+    "Session requests without reusable KV", tag_keys=("model",),
+)
+
+
+class SessionCache(_DeviceLRU):
+    """Device-resident LRU of finished conversation turns, keyed by
+    session id.
+
+    Multi-turn chat resends the whole history each turn; KV depends only
+    on token ids, so the previous turn's cache row (prompt + generated
+    tokens) is exactly the prefix KV of the next turn's prompt. A hit
+    seeds the admission with the stored row and prefills ONLY the new
+    tail — turn-N TTFT stops scaling with conversation length. Entries
+    hold one full cache row ([L,1,S,K,H] k/v, device arrays) plus the
+    token history for the prefix check; sampling temperature is
+    irrelevant to reuse (KV is deterministic in the tokens)."""
+
+    def lookup(self, session_id: str, prompt: np.ndarray):
+        """Return (k, v, history_len) when the stored turn is a strict
+        prefix of ``prompt`` (leaving >= 1 tail token to prefill)."""
+        entry = self._get(session_id)
+        if entry is None:
+            return None
+        k, v, history = entry
+        n = int(history.size)
+        if n >= prompt.size or not np.array_equal(history, prompt[:n]):
+            return None
+        return k, v, n
+
+    def store(self, session_id: str, k: jax.Array, v: jax.Array,
+              history: np.ndarray) -> None:
+        self._put(session_id, (k, v, np.asarray(history, np.int32)))
 
 
 class DecodeEngine:
@@ -262,6 +323,7 @@ class DecodeEngine:
         ttft_horizon: Optional[int] = None,
         max_admissions_per_step: int = 2,
         prefix_cache_size: int = 0,
+        session_cache_size: int = 0,
         draft_model: Optional[Any] = None,
         draft_params: Optional[Any] = None,
         spec_tokens: int = 4,
@@ -337,6 +399,10 @@ class DecodeEngine:
             self.prefix_cache = PrefixCache(
                 prefix_cache_size, self.prompt_buckets[-1]
             )
+        # Multi-turn session KV continuation (0 = off).
+        self.session_cache: Optional[SessionCache] = None
+        if session_cache_size > 0:
+            self.session_cache = SessionCache(session_cache_size)
         self._prefill_fns: Dict[int, Callable] = {}
         self._decode_fn = jax.jit(
             self._decode_impl, donate_argnums=(1,), static_argnums=(4,)
@@ -714,6 +780,7 @@ class DecodeEngine:
             # re-submitted request resamples the same way on any replica.
             "seed": zlib.crc32(req.request_id.encode()) & 0x7FFFFFFF,
             "stop": (),           # extra per-request stop token ids
+            "session_id": None,   # multi-turn KV continuation key
         }
         if isinstance(req.payload, dict):
             p = req.payload
@@ -725,6 +792,9 @@ class DecodeEngine:
             opts["stop"] = frozenset(
                 int(t) for t in p.get("stop_token_ids", ())
             )
+            if p.get("session_id") is not None:
+                opts["session_id"] = str(p["session_id"])
+                opts["_prompt_tokens"] = prompt
             if opts["temperature"] < 0.0:
                 raise ValueError(
                     f"{req.request_id}: temperature must be >= 0"
@@ -752,12 +822,21 @@ class DecodeEngine:
             free = free[: self.max_admissions_per_step]
         batch = self.queue.get_batch(len(free), discard_stale=True)
         by_bucket: Dict[int, List[Tuple[Request, np.ndarray, Dict]]] = {}
+        session_items: List[Tuple[Request, np.ndarray, Dict, Tuple]] = []
         for req in batch:
             try:
                 prompt, bucket, opts = self._prep_prompt(req)
             except Exception as e:  # noqa: BLE001 — bad prompt must not kill loop
                 req.reject(e)
                 continue
+            if self.session_cache is not None and opts["session_id"]:
+                hit = self.session_cache.lookup(opts["session_id"], prompt)
+                if hit is not None:
+                    # Counted at admission (_prefill_session), not here: a
+                    # slot-starved requeue would re-look-up and double-count.
+                    session_items.append((req, prompt, opts, hit))
+                    continue
+                SESSION_MISSES.inc(tags={"model": self.model.name})
             by_bucket.setdefault(bucket, []).append((req, prompt, opts))
         admitted = 0
         cap = self.max_admissions_per_step
@@ -777,7 +856,15 @@ class DecodeEngine:
                         req.reject(e)
                     continue
                 admitted += len(chunk)
-        for req, prompt, opts in long_items:
+        singles = [
+            (self._prefill_long, (req, prompt, opts))
+            for req, prompt, opts in long_items
+        ] + [
+            (self._prefill_session, (req, prompt, opts, hit))
+            for req, prompt, opts, hit in session_items
+        ]
+        for fill, args in singles:
+            req = args[0]
             if admitted >= len(free):
                 # Ran out of slots this round — requeue untouched. A full
                 # or closed queue refuses WITHOUT rejecting (router-retry
@@ -790,7 +877,7 @@ class DecodeEngine:
                     ))
                 continue
             try:
-                self._prefill_long(req, prompt, opts, free[admitted])
+                fill(*args, free[admitted])
             except Exception as e:  # noqa: BLE001 — same no-dangle rule
                 logger.exception(
                     "%s: chunked prefill failed", self.model.name
@@ -906,6 +993,16 @@ class DecodeEngine:
             self._prefill_fns[("long", chunk)] = fns
         return fns
 
+    def _long_row_cap(self, C: int) -> int:
+        """Row-cache capacity for chunked fills: whole chunks covering
+        max_len PLUS one spare chunk. The spare absorbs the final chunk of
+        an UNALIGNED continuation (session base need not be a multiple of
+        C) — without it, dynamic_update_slice CLAMPS the overrunning start
+        index and silently overwrites earlier positions. One static shape
+        for every prompt length and base, so all fills share programs; the
+        commit slices back down to shared capacity."""
+        return ((self.max_len + C - 1) // C) * C + C
+
     def _prefill_long(
         self, req: Request, prompt: np.ndarray, opts: Dict, slot_idx: int
     ) -> None:
@@ -919,14 +1016,7 @@ class DecodeEngine:
         chunk_fn, commit_fn, seed_fn, extract_fn = self._long_prefill_fns(C)
         L = int(prompt.size)
         n_chunks = (L + C - 1) // C
-        # Private row cache rounded UP to whole chunks — ONE static shape
-        # for every prompt length, so all long admissions share two
-        # compiled programs. Without the round-up, a final chunk whose
-        # write overruns max_len gets its start index CLAMPED by
-        # dynamic_update_slice and silently overwrites earlier positions;
-        # the commit slices back down to shared capacity.
-        row_cap = ((self.max_len + C - 1) // C) * C
-        row = self.model.make_cache(1, row_cap)
+        row = self.model.make_cache(1, self._long_row_cap(C))
         start_chunk = 0
         after_first = None
         if self.prefix_cache is not None:
@@ -967,6 +1057,75 @@ class DecodeEngine:
         self._register(slot_idx, req, int(np.asarray(first)[0]), opts,
                        now_ms())
 
+    def _seed_session_impl(self, row_cache, ek, ev, elen):
+        """Copy a stored session row ([L,1,S,K,H]) into a fresh row cache
+        and mark ``elen`` positions valid."""
+        k = jax.lax.dynamic_update_slice(row_cache.k, ek, (0, 0, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(row_cache.v, ev, (0, 0, 0, 0, 0))
+        return row_cache.replace(
+            k=k, v=v, lengths=jnp.full_like(row_cache.lengths, elen)
+        )
+
+    def _extract_row_impl(self, cache, slot):
+        """Slice one slot's full cache row out of the shared cache (the
+        finished turn's KV, stored for the session's next turn)."""
+        k = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
+        v = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
+        return k, v
+
+    def _session_fns(self):
+        fns = self._prefill_fns.get("session")
+        if fns is None:
+            fns = (
+                jax.jit(self._seed_session_impl, donate_argnums=(0,)),
+                jax.jit(self._extract_row_impl),
+            )
+            self._prefill_fns["session"] = fns
+        return fns
+
+    def _prefill_session(
+        self, req: Request, prompt: np.ndarray, opts: Dict, hit: Tuple,
+        slot_idx: int,
+    ) -> None:
+        """Continue a conversation from its stored KV: seed the row cache
+        with the previous turn's row, chunk-prefill ONLY the new tail
+        (traced start — the base need not be chunk-aligned), and commit.
+        Turn-N admission cost scales with the new message, not the whole
+        history."""
+        ek, ev, elen = hit
+        SESSION_HITS.inc(tags={"model": self.model.name})
+        C = self.prompt_buckets[-1]
+        chunk_fn, commit_fn, _seed_prefix, _extract = \
+            self._long_prefill_fns(C)
+        seed_fn, _ = self._session_fns()
+        row = self.model.make_cache(1, self._long_row_cap(C))
+        row = seed_fn(row, ek, ev, jnp.int32(elen))
+        tail = prompt[elen:]
+
+        def between():
+            if self._active_mask.any():
+                self._step(horizon=1)
+
+        last, row = run_chunked(
+            chunk_fn, self.params, tail, C, row, between=between, base=elen
+        )
+        first, self._cache = commit_fn(
+            self._cache,
+            row,
+            jnp.int32(slot_idx),
+            last,
+            jnp.asarray([opts["temperature"]], np.float32),
+            jnp.asarray([opts["top_k"]], np.int32),
+            jnp.asarray([opts["seed"]], np.int32),
+            jnp.zeros((1,), jnp.int32),
+        )
+        if self._dcache is not None:
+            # The draft has no stored row; replay the whole prompt through
+            # it (cheap) so speculation starts synced.
+            self._draft_long_fill(prompt, slot_idx, C)
+        self._register(slot_idx, req, int(np.asarray(first)[0]), opts,
+                       now_ms())
+
     def _draft_long_fill(self, prompt: np.ndarray, slot_idx: int,
                          C: int) -> None:
         """Chunk the long prompt through the DRAFT model into its cache
@@ -986,6 +1145,8 @@ class DecodeEngine:
             )
             self._prefill_fns[("draft_long", C)] = fns
         chunk_fn, commit_fn = fns
+        # Chunk-aligned (base 0 always): the unaligned-base spare chunk of
+        # _long_row_cap is a target-path (session continuation) concern.
         dcap = self._dcache.capacity
         row = self.draft_model.make_cache(1, ((dcap + C - 1) // C) * C)
 
@@ -1010,6 +1171,8 @@ class DecodeEngine:
         slot.prefill_done_ms = t
         slot.last_token = first_tok
         slot.stop = opts["stop"]
+        slot.session_id = opts.get("session_id")
+        slot.prompt_tokens = opts.get("_prompt_tokens")
         self._tokens[slot_idx, 0] = first_tok
         self._active_mask[slot_idx] = True
         self._temps[slot_idx] = opts["temperature"]
@@ -1035,6 +1198,22 @@ class DecodeEngine:
         slot = self._slots[slot_idx]
         req = slot.request
         t = now_ms()
+        if (self.session_cache is not None and slot.session_id
+                and slot.prompt_tokens is not None):
+            # The cache row holds prompt + generated[:-1] (the final token
+            # is still pending, never fed). Store the row + that exact
+            # history so the session's next turn continues from it. Any
+            # cached positions past the history (spec rounds advance the
+            # cache through tokens the host truncated at a stop) sit
+            # beyond the stored length and are overwritten by the next
+            # turn's tail prefill before they can be attended.
+            _, extract_fn = self._session_fns()
+            k, v = extract_fn(self._cache, jnp.int32(slot_idx))
+            history = np.concatenate([
+                np.asarray(slot.prompt_tokens, np.int32),
+                np.asarray(slot.generated[:-1], np.int32),
+            ])
+            self.session_cache.store(slot.session_id, k, v, history)
         result = DecodeResult(
             tokens=list(slot.generated),
             finish_reason=reason,
@@ -1247,9 +1426,9 @@ class DecodeEngine:
             self._spec_fn = None
             self._draft_catchup_fn = None
         if self.prefix_cache is not None:
-            # Entries hold device k/v arrays — unreferenced = freed on GC.
-            self.prefix_cache._entries.clear()
-            self.prefix_cache._order.clear()
+            self.prefix_cache.clear()  # device k/v entries freed on GC
+        if self.session_cache is not None:
+            self.session_cache.clear()
 
     def abort_active(self, exc: Exception) -> None:
         """Reject every request still occupying a slot (replica shutdown:

@@ -17,6 +17,16 @@ from ray_dynamic_batching_tpu.serve.router import Router
 from ray_dynamic_batching_tpu.utils.tracing import tracer
 
 
+def _session_affinity(payload: Any) -> Optional[str]:
+    """Steer a session's turns to the replica holding its KV row: the
+    session cache is per-engine, so without affinity a multi-replica
+    deployment misses ~(n-1)/n of continuations. Rides the same
+    multiplex-awareness the pow-2 scheduler already ranks by."""
+    if isinstance(payload, dict) and payload.get("session_id") is not None:
+        return f"session:{payload['session_id']}"
+    return None
+
+
 class DeploymentHandle:
     """Lightweight, shareable; one per (caller, deployment)."""
 
@@ -43,6 +53,9 @@ class DeploymentHandle:
         (ref handle.py:821). ``multiplexed_model_id`` steers routing toward
         replicas already holding that model (ref handle
         ``options(multiplexed_model_id=...)``)."""
+        multiplexed_model_id = multiplexed_model_id or _session_affinity(
+            payload
+        )
         # Span around routing; context rides the request so the replica's
         # execution span joins the same trace (ref task-metadata
         # propagation, tracing_helper.py:165,293).
@@ -74,6 +87,7 @@ class DeploymentHandle:
                 payload=payload,
                 slo_ms=slo_ms if slo_ms is not None else self.default_slo_ms,
                 stream=stream,
+                multiplexed_model_id=_session_affinity(payload),
                 trace_ctx=tracer().inject_context(),
             )
             self.router.assign_request(request, locality_hint=locality_hint)

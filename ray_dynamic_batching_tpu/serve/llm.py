@@ -267,6 +267,7 @@ class LLMDeployment:
         ttft_horizon: Optional[int] = None,
         max_admissions_per_step: int = 2,
         prefix_cache_size: int = 0,
+        session_cache_size: int = 0,
         dtype: Any = None,
         params: Any = None,
         model: Any = None,
@@ -288,6 +289,12 @@ class LLMDeployment:
         self.ttft_horizon = ttft_horizon
         self.max_admissions_per_step = max_admissions_per_step
         self.prefix_cache_size = prefix_cache_size
+        # Session rows are PER ENGINE: handle-level affinity steers a
+        # session's turns back to the replica holding its row, but a
+        # conversation that outgrows its length bucket lands on a larger
+        # engine and re-prefills once (its old entry ages out via LRU) —
+        # with multiple length buckets each engine budgets its own cache.
+        self.session_cache_size = session_cache_size
         self.warmup = warmup
         # KV-capacity buckets: one engine per entry, requests routed to the
         # smallest cache fitting prompt + max_new (LLMReplica docstring —
@@ -391,6 +398,15 @@ class LLMDeployment:
                     (max_len or self.max_len) + self.spec_tokens + 1
                 )
             ) / max(1, n_chips)
+        if self.session_cache_size > 0:
+            # Each stored session turn pins a FULL kv row on device; the
+            # cache at capacity is that many phantom slots of residency.
+            weights_bytes += (
+                self.session_cache_size
+                * float(self._model.kv_bytes_per_slot(
+                    max_len or self.max_len
+                ))
+            ) / max(1, n_chips)
         usable = (
             (budget - weights_bytes) * cfg.hbm_plan_fraction * budget_fraction
         )
@@ -435,6 +451,7 @@ class LLMDeployment:
             ttft_horizon=self.ttft_horizon,
             max_admissions_per_step=self.max_admissions_per_step,
             prefix_cache_size=self.prefix_cache_size,
+            session_cache_size=self.session_cache_size,
             draft_model=self._draft_model,
             draft_params=self._draft_params,
             spec_tokens=self.spec_tokens,

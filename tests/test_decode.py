@@ -180,6 +180,70 @@ class TestDecodeEngine:
         assert len(req.future.result(timeout=5).tokens) == 3
 
 
+class TestSessionCache:
+    def test_multi_turn_parity_and_tail_only_prefill(self, lm):
+        """Turn 2 resends the whole history with the same session_id: the
+        engine must continue from the stored row (chunk dispatches cover
+        only the NEW tail) and generate exactly what a sessionless engine
+        does on the full prompt."""
+        sess, q1 = make_engine(lm, prompt_buckets=[8], max_len=96,
+                               session_cache_size=4)
+        plain, q2 = make_engine(lm, prompt_buckets=[8], max_len=96)
+        turn1 = [(i * 7) % 50 + 1 for i in range(6)]
+        r1 = submit(q1, turn1, max_new_tokens=5, session_id="chat-1")
+        sess.run_until_idle(timeout_s=120)
+        gen1 = r1.future.result(timeout=5).tokens
+        assert len(sess.session_cache) == 1
+        # Turn 2: history + reply + new user tokens (chat shape).
+        turn2 = turn1 + gen1 + [17, 23, 29]
+        chunk_calls = []
+        orig = sess._prefill_chunk_impl
+        sess._prefill_chunk_impl = (
+            lambda *a: (chunk_calls.append(1), orig(*a))[1]
+        )
+        sess._prefill_fns.pop(("long", 8), None)  # re-jit over the probe
+        r2 = submit(q1, turn2, max_new_tokens=5, session_id="chat-1")
+        ref = submit(q2, turn2, max_new_tokens=5)
+        sess.run_until_idle(timeout_s=120)
+        plain.run_until_idle(timeout_s=120)
+        assert (r2.future.result(timeout=5).tokens
+                == ref.future.result(timeout=5).tokens)
+        # Stored history = turn1 + gen1[:-1] (last token pending), so the
+        # tail is [gen1[-1], 17, 23, 29] = 4 tokens -> ONE 8-wide chunk.
+        assert len(chunk_calls) == 1, chunk_calls
+
+    def test_session_mismatched_history_falls_back(self, lm):
+        """Same session id but a DIFFERENT history prefix must miss (full
+        prefill) and still produce correct output."""
+        sess, q1 = make_engine(lm, prompt_buckets=[8], max_len=64,
+                               session_cache_size=4)
+        plain, q2 = make_engine(lm, prompt_buckets=[8], max_len=64)
+        r1 = submit(q1, [1, 2, 3, 4], max_new_tokens=4, session_id="s")
+        sess.run_until_idle(timeout_s=120)
+        r1.future.result(timeout=5)
+        divergent = [9, 9, 9, 9, 9, 9]  # not an extension of turn 1
+        r2 = submit(q1, divergent, max_new_tokens=4, session_id="s")
+        ref = submit(q2, divergent, max_new_tokens=4)
+        sess.run_until_idle(timeout_s=120)
+        plain.run_until_idle(timeout_s=120)
+        assert (r2.future.result(timeout=5).tokens
+                == ref.future.result(timeout=5).tokens)
+
+    def test_session_lru_eviction(self):
+        from ray_dynamic_batching_tpu.engine.decode import SessionCache
+        sc = SessionCache(capacity=2)
+        z = jnp.zeros((1,))
+        sc.store("a", z, z, np.asarray([1, 2], np.int32))
+        sc.store("b", z, z, np.asarray([3, 4], np.int32))
+        assert sc.lookup("a", np.asarray([1, 2, 5], np.int32)) is not None
+        sc.store("c", z, z, np.asarray([5, 6], np.int32))  # evicts b
+        assert sc.lookup("b", np.asarray([3, 4, 5], np.int32)) is None
+        assert len(sc) == 2
+        # Exact-length (no tail) and non-prefix lookups miss.
+        assert sc.lookup("a", np.asarray([1, 2], np.int32)) is None
+        assert sc.lookup("a", np.asarray([1, 9, 5], np.int32)) is None
+
+
 @pytest.fixture(scope="module")
 def draft_lm():
     """A DIFFERENT tiny model as the draft: disagrees with the target often

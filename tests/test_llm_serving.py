@@ -88,6 +88,37 @@ class TestLLMDeployment:
         assert len(result.tokens) == 4
         assert result.finish_reason == "length"
 
+    def test_session_continuation_through_stack(self, llm_stack):
+        """Multi-turn chat with session_id: turn 2 continues from stored
+        KV and matches the sessionless result for the full history."""
+        _, plain_handle = llm_stack
+        controller = ServeController(control_interval_s=0.1)
+        dep = LLMDeployment(
+            "llama_tiny", num_slots=2, max_len=96, prompt_buckets=[8],
+            default_max_new_tokens=5, dtype=jnp.float32,
+            session_cache_size=8,
+        )
+        router = controller.deploy(
+            DeploymentConfig(name="llama_sess"), factory=dep
+        )
+        controller.start()
+        try:
+            handle = DeploymentHandle(router)
+            turn1 = [5, 9, 2, 7, 11, 13]
+            r1 = handle.remote({
+                "tokens": turn1, "max_new_tokens": 5, "session_id": "c1",
+            }).result(timeout=120)
+            turn2 = turn1 + r1.tokens + [17, 23]
+            r2 = handle.remote({
+                "tokens": turn2, "max_new_tokens": 5, "session_id": "c1",
+            }).result(timeout=120)
+            ref = plain_handle.remote({
+                "tokens": turn2, "max_new_tokens": 5,
+            }).result(timeout=120)
+            assert r2.tokens == ref.tokens
+        finally:
+            controller.shutdown()
+
     def test_checkpoint_loaded_weights_serve(self, llm_stack, tmp_path):
         """LLMDeployment(checkpoint_dir=...) must serve with the RESTORED
         weights: output equals the checkpointed model's greedy decode, and

@@ -510,6 +510,33 @@ class TestKVStore:
         assert kv2.get("k") == "v"
 
 
+class TestSessionAffinity:
+    def test_handle_derives_affinity_from_session_id(self):
+        """Payloads carrying session_id must route with multiplex affinity
+        (the per-engine session KV row lives on ONE replica)."""
+        from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+
+        seen = {}
+
+        class StubRouter:
+            deployment = "m"
+
+            def assign_request(self, request, locality_hint=None):
+                seen["mux"] = request.multiplexed_model_id
+                request.fulfill("ok")
+
+        h = DeploymentHandle(StubRouter())
+        h.remote({"tokens": [1], "session_id": "abc"}).result(timeout=5)
+        assert seen["mux"] == "session:abc"
+        h.remote({"tokens": [1]}).result(timeout=5)
+        assert seen["mux"] is None  # no session -> no affinity
+        # Explicit multiplexed_model_id wins over the derived one.
+        h.remote({"session_id": "abc"}, multiplexed_model_id="m1").result(
+            timeout=5
+        )
+        assert seen["mux"] == "m1"
+
+
 class TestMultiplexedRouting:
     """Model-multiplex-aware pow-2 routing (ref pow_2_scheduler.py:52)."""
 
