@@ -836,7 +836,9 @@ class DecodeEngine:
                     # slot-starved requeue would re-look-up and double-count.
                     session_items.append((req, prompt, opts, hit))
                     continue
-                SESSION_MISSES.inc(tags={"model": self.model.name})
+                # Same hazard for misses (a missed LONG prompt can be
+                # requeued): mark now, count once at _register.
+                opts["_session_miss"] = True
             by_bucket.setdefault(bucket, []).append((req, prompt, opts))
         admitted = 0
         cap = self.max_admissions_per_step
@@ -1003,6 +1005,35 @@ class DecodeEngine:
         commit slices back down to shared capacity."""
         return ((self.max_len + C - 1) // C) * C + C
 
+    def _interleave_step(self) -> None:
+        """One plain decode step for the active batch between chunk
+        dispatches — the bound that keeps a long fill from stalling
+        in-flight requests for more than one chunk."""
+        if self._active_mask.any():
+            self._step(horizon=1)
+
+    def _commit_and_register(
+        self, req: Request, prompt: np.ndarray, opts: Dict, slot_idx: int,
+        commit_fn: Callable, row, last, C: int,
+    ) -> None:
+        """Shared tail of every chunked admission (long and session): one
+        commit dispatch (row -> shared cache + first-token sample), the
+        draft replay when speculation is on, then registration."""
+        first, self._cache = commit_fn(
+            self._cache,
+            row,
+            jnp.int32(slot_idx),
+            last,
+            jnp.asarray([opts["temperature"]], np.float32),
+            jnp.asarray([opts["top_k"]], np.int32),
+            jnp.asarray([opts["seed"]], np.int32),
+            jnp.zeros((1,), jnp.int32),
+        )
+        if self._dcache is not None:
+            self._draft_long_fill(prompt, slot_idx, C)
+        self._register(slot_idx, req, int(np.asarray(first)[0]), opts,
+                       now_ms())
+
     def _prefill_long(
         self, req: Request, prompt: np.ndarray, opts: Dict, slot_idx: int
     ) -> None:
@@ -1033,29 +1064,14 @@ class DecodeEngine:
                 )
                 PREFIX_MISSES.inc(tags={"model": self.model.name})
 
-        def between():
-            if self._active_mask.any():
-                self._step(horizon=1)  # bound the stall on active slots
-
         last, row = run_chunked(
             chunk_fn, self.params, prompt, C, row,
-            start_chunk=start_chunk, between=between,
+            start_chunk=start_chunk, between=self._interleave_step,
             after_first=after_first,
         )
-        first, self._cache = commit_fn(
-            self._cache,
-            row,
-            jnp.int32(slot_idx),
-            last,
-            jnp.asarray([opts["temperature"]], np.float32),
-            jnp.asarray([opts["top_k"]], np.int32),
-            jnp.asarray([opts["seed"]], np.int32),
-            jnp.zeros((1,), jnp.int32),
+        self._commit_and_register(
+            req, prompt, opts, slot_idx, commit_fn, row, last, C
         )
-        if self._dcache is not None:
-            self._draft_long_fill(prompt, slot_idx, C)
-        self._register(slot_idx, req, int(np.asarray(first)[0]), opts,
-                       now_ms())
 
     def _seed_session_impl(self, row_cache, ek, ev, elen):
         """Copy a stored session row ([L,1,S,K,H]) into a fresh row cache
@@ -1101,30 +1117,15 @@ class DecodeEngine:
         row = self.model.make_cache(1, self._long_row_cap(C))
         row = seed_fn(row, ek, ev, jnp.int32(elen))
         tail = prompt[elen:]
-
-        def between():
-            if self._active_mask.any():
-                self._step(horizon=1)
-
         last, row = run_chunked(
-            chunk_fn, self.params, tail, C, row, between=between, base=elen
+            chunk_fn, self.params, tail, C, row,
+            between=self._interleave_step, base=elen,
         )
-        first, self._cache = commit_fn(
-            self._cache,
-            row,
-            jnp.int32(slot_idx),
-            last,
-            jnp.asarray([opts["temperature"]], np.float32),
-            jnp.asarray([opts["top_k"]], np.int32),
-            jnp.asarray([opts["seed"]], np.int32),
-            jnp.zeros((1,), jnp.int32),
+        # The draft replay inside covers the WHOLE prompt (the draft has
+        # no stored row) so speculation starts synced.
+        self._commit_and_register(
+            req, prompt, opts, slot_idx, commit_fn, row, last, C
         )
-        if self._dcache is not None:
-            # The draft has no stored row; replay the whole prompt through
-            # it (cheap) so speculation starts synced.
-            self._draft_long_fill(prompt, slot_idx, C)
-        self._register(slot_idx, req, int(np.asarray(first)[0]), opts,
-                       now_ms())
 
     def _draft_long_fill(self, prompt: np.ndarray, slot_idx: int,
                          C: int) -> None:
@@ -1149,13 +1150,9 @@ class DecodeEngine:
         # _long_row_cap is a target-path (session continuation) concern.
         dcap = self._dcache.capacity
         row = self.draft_model.make_cache(1, ((dcap + C - 1) // C) * C)
-
-        def between():
-            if self._active_mask.any():
-                self._step(horizon=1)
-
         _, row = run_chunked(
-            chunk_fn, self.draft_params, prompt, C, row, between=between
+            chunk_fn, self.draft_params, prompt, C, row,
+            between=self._interleave_step,
         )
         self._dcache = commit_fn(self._dcache, row, jnp.int32(slot_idx))
 
@@ -1180,6 +1177,8 @@ class DecodeEngine:
         self._seeds[slot_idx] = opts["seed"]
 
         PREFILLS_TOTAL.inc(tags={"model": self.model.name})
+        if opts.get("_session_miss"):
+            SESSION_MISSES.inc(tags={"model": self.model.name})
         TTFT_MS.observe(t - req.arrival_ms, tags={"model": self.model.name})
         req.stream_put(first_tok)
         # First token may already satisfy the stop conditions.

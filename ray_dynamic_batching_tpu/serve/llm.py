@@ -400,9 +400,13 @@ class LLMDeployment:
             ) / max(1, n_chips)
         if self.session_cache_size > 0:
             # Each stored session turn pins a FULL kv row on device; the
-            # cache at capacity is that many phantom slots of residency.
+            # cache at capacity is that many phantom slots of residency —
+            # and EVERY length-bucket engine holds its own cache, while
+            # this call sees only a 1/n_buckets budget slice, so the whole
+            # deployment's session residency must come off the top here.
             weights_bytes += (
-                self.session_cache_size
+                len(self.length_buckets)
+                * self.session_cache_size
                 * float(self._model.kv_bytes_per_slot(
                     max_len or self.max_len
                 ))
@@ -497,6 +501,15 @@ class LLMDeployment:
             default_max_new_tokens=self.default_max_new_tokens,
         )
         replica.devices = list(devices) if devices else None
+        if self.session_cache_size > 0:
+            # Session-affinity ids ride the replica's advertised multiplex
+            # LRU; with the default bound of 8, more concurrent sessions
+            # than that would age each other (and genuine model ids) out
+            # of the routing view while their KV rows are still cached.
+            replica.max_multiplexed_models = max(
+                replica.max_multiplexed_models,
+                len(self.length_buckets) * self.session_cache_size + 8,
+            )
         return replica
 
     # Legacy callable protocol (factory() -> fn) is not meaningful here.

@@ -46,6 +46,17 @@ def submit(queue, prompt, slo_ms=60_000.0, **payload):
     return req
 
 
+def count_chunk_dispatches(engine, C=8):
+    """Wrap the COMPILED chunk fn so every dispatch counts (wrapping the
+    impl would count jit traces — one per shape — not dispatches)."""
+    calls = []
+    fns = list(engine._long_prefill_fns(C))
+    real = fns[0]
+    fns[0] = lambda *a: (calls.append(1), real(*a))[1]
+    engine._prefill_fns[("long", C)] = tuple(fns)
+    return calls
+
+
 class TestDecodeEngine:
     def test_single_request_generates(self, lm):
         engine, queue = make_engine(lm)
@@ -180,6 +191,42 @@ class TestDecodeEngine:
         assert len(req.future.result(timeout=5).tokens) == 3
 
 
+class TestMoEDecode:
+    def test_moe_decode_matches_teacher_forcing(self):
+        """A Mixture-of-Experts decoder serves through the SAME continuous-
+        batching engine (top-k routing runs per decode step); incremental
+        KV decode must equal full-prefix teacher forcing."""
+        model = get_model("moe_tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0))
+        queue = RequestQueue(model.name, max_len=64)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=32,
+            prompt_buckets=[8], default_max_new_tokens=6,
+        )
+        prompt = [5, 9, 2, 7]
+        req = Request(
+            model=model.name,
+            payload={"tokens": np.asarray(prompt, np.int32),
+                     "max_new_tokens": 6},
+            slo_ms=60_000.0,
+        )
+        queue.add_request(req)
+        engine.run_until_idle(timeout_s=120)
+        got = req.future.result(timeout=5).tokens
+
+        seq = list(prompt)
+        expect = []
+        for _ in range(6):
+            logits = model.apply(
+                params, jnp.asarray([seq]),
+                jnp.ones((1, len(seq)), jnp.int32),
+            )
+            nxt = int(jnp.argmax(logits[0, -1]))
+            expect.append(nxt)
+            seq.append(nxt)
+        assert got == expect
+
+
 class TestSessionCache:
     def test_multi_turn_parity_and_tail_only_prefill(self, lm):
         """Turn 2 resends the whole history with the same session_id: the
@@ -196,12 +243,7 @@ class TestSessionCache:
         assert len(sess.session_cache) == 1
         # Turn 2: history + reply + new user tokens (chat shape).
         turn2 = turn1 + gen1 + [17, 23, 29]
-        chunk_calls = []
-        orig = sess._prefill_chunk_impl
-        sess._prefill_chunk_impl = (
-            lambda *a: (chunk_calls.append(1), orig(*a))[1]
-        )
-        sess._prefill_fns.pop(("long", 8), None)  # re-jit over the probe
+        chunk_calls = count_chunk_dispatches(sess)
         r2 = submit(q1, turn2, max_new_tokens=5, session_id="chat-1")
         ref = submit(q2, turn2, max_new_tokens=5)
         sess.run_until_idle(timeout_s=120)
@@ -536,21 +578,15 @@ class TestStreamingAndHorizon:
         cached, q1 = make_engine(lm, prompt_buckets=[8], max_len=64,
                                  prefix_cache_size=4)
         plain, q2 = make_engine(lm, prompt_buckets=[8], max_len=64)
-        chunk_calls = []
-        orig = cached._prefill_chunk_impl
-
-        def counting(*args):
-            chunk_calls.append(1)
-            return orig(*args)
-
-        cached._prefill_chunk_impl = counting
-        cached._prefill_fns.pop(("long", 8), None)  # re-jit over the probe
+        chunk_calls = count_chunk_dispatches(cached)
         r1 = submit(q1, p1, max_new_tokens=4)
         cached.run_until_idle(timeout_s=120)
         first_calls = len(chunk_calls)   # miss: all 3 chunks computed
+        assert first_calls == 3          # p1 = 18 tokens / 8-chunks
         r2 = submit(q1, p2, max_new_tokens=4)
         cached.run_until_idle(timeout_s=120)
-        assert len(chunk_calls) - first_calls == first_calls - 1  # skip c0
+        # p2 = 15 tokens -> 2 chunks; the hit skips chunk 0 -> exactly 1.
+        assert len(chunk_calls) - first_calls == 1
         assert len(cached.prefix_cache) == 1
         for p, r in ((p1, r1), (p2, r2)):
             ref = submit(q2, p, max_new_tokens=4)

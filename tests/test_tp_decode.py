@@ -93,6 +93,65 @@ class TestTPDecodeParity:
         assert got == expect
 
 
+class TestTPChunkedAndSession:
+    def test_tp2_chunked_prefill_parity(self, lm, eight_devices):
+        """Chunked admission under a tp=2 mesh: the unsharded row cache
+        commits into the SHARDED shared cache; generated tokens must
+        equal the single-device engine's."""
+        model, params = lm
+        long_prompt = [(i * 7) % 50 + 1 for i in range(20)]
+
+        def run(mesh):
+            queue = RequestQueue(model.name, max_len=64)
+            engine = DecodeEngine(
+                model, params, queue, num_slots=2, max_len=64,
+                prompt_buckets=[8], default_max_new_tokens=6, mesh=mesh,
+            )
+            req = Request(
+                model=model.name,
+                payload={"tokens": np.asarray(long_prompt, np.int32),
+                         "max_new_tokens": 6},
+                slo_ms=60_000.0,
+            )
+            queue.add_request(req)
+            engine.run_until_idle(timeout_s=180)
+            return req.future.result(timeout=5).tokens
+
+        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        assert run(mesh) == run(None)
+
+    def test_tp2_session_continuation_parity(self, lm, eight_devices):
+        """Session store/seed round-trips SHARDED rows (extract slices a
+        sharded cache; seed writes into an unsharded row cache): turn-2
+        output must equal the sessionless full-prompt decode."""
+        model, params = lm
+        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        queue = RequestQueue(model.name, max_len=96)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=96,
+            prompt_buckets=[8], default_max_new_tokens=5, mesh=mesh,
+            session_cache_size=2,
+        )
+
+        def ask(tokens, sid=None):
+            payload = {"tokens": np.asarray(tokens, np.int32),
+                       "max_new_tokens": 5}
+            if sid:
+                payload["session_id"] = sid
+            req = Request(model=model.name, payload=payload,
+                          slo_ms=60_000.0)
+            queue.add_request(req)
+            engine.run_until_idle(timeout_s=180)
+            return req.future.result(timeout=5).tokens
+
+        turn1 = [5, 9, 2, 7, 11, 13]
+        gen1 = ask(turn1, sid="tp-chat")
+        turn2 = turn1 + gen1 + [17, 23]
+        continued = ask(turn2, sid="tp-chat")
+        fresh = ask(turn2)  # sessionless full prefill, same engine
+        assert continued == fresh
+
+
 class TestTPDeploymentPath:
     def test_multi_chip_bundle_builds_tp_replica(self, eight_devices):
         """LLMDeployment with a 2-chip bundle serves through a TP mesh."""
