@@ -327,6 +327,7 @@ class DecodeEngine:
         draft_model: Optional[Any] = None,
         draft_params: Optional[Any] = None,
         spec_tokens: int = 4,
+        quantize_weights: bool = False,
         device: Optional[jax.Device] = None,
         mesh: Optional[Any] = None,
         base_seed: int = 0,
@@ -337,6 +338,28 @@ class DecodeEngine:
         self.model = model
         self.device = device
         self.mesh = mesh
+        # Weight-only int8: decode streams the whole weight set per step,
+        # so weight BYTES set tokens/s; kernels live in HBM as int8 and
+        # dequantize inside each program (convert+scale fused into the
+        # consuming matmul by XLA).
+        self.quantized = bool(quantize_weights)
+        if self.quantized:
+            if mesh is not None:
+                raise ValueError(
+                    "quantize_weights with a TP mesh is not supported yet: "
+                    "sharding rules key on kernel paths, which quantization "
+                    "rewrites into QTensor q/scale leaves"
+                )
+            from ray_dynamic_batching_tpu.models.quant import (
+                is_quantized,
+                quantize_tree,
+            )
+
+            # A pre-quantized tree (the deployment quantizes ONCE and hands
+            # the same tree to every length-bucket engine) is shared as-is;
+            # re-quantizing would allocate a fresh int8 copy per engine.
+            if not is_quantized(params):
+                params = quantize_tree(params)
         if mesh is not None:
             # TP-sharded replica (BASELINE.json config 4): params sharded by
             # the model's Megatron-style rules, KV cache sharded over kv
@@ -458,6 +481,17 @@ class DecodeEngine:
         return jax.default_device(self.device)
 
     # --- compiled programs -------------------------------------------------
+    def _mp(self, params):
+        """Model-ready params: dequantize INSIDE the program when the
+        resident tree is int8 (no-op otherwise)."""
+        if not self.quantized:
+            return params
+        from ray_dynamic_batching_tpu.models.quant import dequantize_tree
+
+        return dequantize_tree(
+            params, getattr(self.model, "dtype", jnp.bfloat16)
+        )
+
     def _sample_tokens(self, logits, temps, topk, seeds, tok_idx):
         """In-program per-request sampling: temperature 0 → greedy argmax;
         otherwise top-k-masked categorical, keyed by (base_seed, request
@@ -514,6 +548,7 @@ class DecodeEngine:
         admission group instead of per request — on hosts where dispatch
         latency dominates (e.g. a tunneled chip) this is the TTFT lever.
         """
+        params = self._mp(params)
         nB = tokens.shape[0]
         row_cache = self.model.make_cache(nB, self.max_len)
         last_logits, rows = self.model.prefill(
@@ -542,8 +577,13 @@ class DecodeEngine:
         def substep(carry, j):
             cache, tokens = carry
             advanced = jnp.logical_and(active, cache.lengths < cache.capacity)
+            # Dequantize INSIDE the scan body: hoisted outside, the bf16
+            # tree becomes a loop-invariant XLA materializes once and
+            # re-streams every substep — the exact bandwidth the int8
+            # residency is supposed to save. In-body, the compiler may
+            # fuse each convert+scale into its consuming matmul.
             logits, cache = self.model.decode_step(
-                params, tokens, cache, advanced
+                self._mp(params), tokens, cache, advanced
             )
             nxt = self._sample_tokens(logits, temps, topk, seeds, tok_idx0 + j)
             nxt = jnp.where(advanced, nxt, tokens[:, 0])
@@ -570,6 +610,7 @@ class DecodeEngine:
         Returns ``(packed [k+3, B] int32, cache, dcache)``: k+1 output-token
         rows, an n_out row, and a post-round lengths row — one host fetch.
         """
+        params = self._mp(params)
         k = self.spec_tokens
         B = tokens.shape[0]
         S = self.max_len  # shared-cache capacity
@@ -948,7 +989,7 @@ class DecodeEngine:
     def _prefill_chunk_impl(self, params, tokens, attn_mask, row_cache,
                             start, take_idx):
         return self.model.prefill_chunk(
-            params, tokens, attn_mask, row_cache, start, take_idx
+            self._mp(params), tokens, attn_mask, row_cache, start, take_idx
         )
 
     def _commit_long_impl(self, cache, row_cache, slot, last_logits,

@@ -1,0 +1,141 @@
+"""Weight-only int8 quantization for serving params.
+
+Decode is HBM-bandwidth bound: every step streams the full weight set
+through the MXU for one token per slot, so weight bytes — not FLOPs — set
+tokens/s. Symmetric per-output-channel int8 on the matmul kernels halves
+that traffic vs bf16 (weights live in HBM as int8; the in-jit dequantize
+is a convert+scale XLA fuses into the consuming matmul, not a
+materialized bf16 copy). Embeddings, norms, and biases stay in their
+original dtype — they are a rounding-sensitive sliver of the bytes.
+
+The quantized tree is a drop-in params pytree whose kernel leaves are
+:class:`QTensor` nodes; ``dequantize_tree`` (called INSIDE jit by the
+engine) rebuilds a standard tree for the unmodified flax modules. No
+model-code changes, no custom matmul kernels: the compiler owns fusion,
+exactly the stance SURVEY §7 takes for everything else on this path.
+
+The reference has no quantization story (fp16 autocast only,
+``293-project/profiling/ModelProfiler.py``); this is a TPU-serving
+addition. Accuracy is the standard weight-only trade: logits drift by
+O(1/127) relative error per channel; greedy decodes of well-trained
+models rarely flip. Throughput claims require on-chip measurement —
+the knob ships measured-off by default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax.struct import dataclass as pytree_dataclass
+
+# Kernels with >= this many elements quantize; tiny leaves (norm scales,
+# biases) are not worth the metadata.
+_MIN_QUANT_ELEMS = 1024
+
+
+@pytree_dataclass
+class QTensor:
+    """Symmetric per-output-channel int8 weight: ``w ~= q * scale``.
+
+    ``q`` int8, same shape as the original kernel; ``scale`` float32,
+    shaped like the kernel with every axis but the LAST reduced to 1
+    (flax kernels put output features last)."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        return (self.q.astype(dtype) * self.scale.astype(dtype))
+
+
+def _quantize_leaf(w: jax.Array) -> QTensor:
+    reduce_axes = tuple(range(w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
+                     keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return QTensor(q=q.astype(jnp.int8), scale=scale.astype(jnp.float32))
+
+
+def _wants_quant(path: Tuple, leaf: Any) -> bool:
+    if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+        return False
+    if leaf.size < _MIN_QUANT_ELEMS:
+        return False
+    name = "/".join(str(getattr(p, "key", p)) for p in path).lower()
+    # Embedding tables feed gathers (dequant cannot fuse into a matmul)
+    # and positional tables are tiny relative to impact — skip both.
+    return "embed" not in name
+
+
+def is_quantized(params: Any) -> bool:
+    """True when the tree already carries QTensor leaves."""
+    found = False
+
+    def visit(leaf):
+        nonlocal found
+        if isinstance(leaf, QTensor):
+            found = True
+        return leaf
+
+    jax.tree_util.tree_map(
+        visit, params, is_leaf=lambda x: isinstance(x, QTensor)
+    )
+    return found
+
+
+def quantize_tree(params: Any) -> Any:
+    """Original params -> tree with matmul kernels as QTensor leaves."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (
+            _quantize_leaf(leaf) if _wants_quant(path, leaf) else leaf
+        ),
+        params,
+    )
+
+
+def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Quantized tree -> standard tree (call INSIDE jit: XLA fuses each
+    convert+scale into its consuming matmul instead of materializing)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: (
+            leaf.dequantize(dtype) if isinstance(leaf, QTensor) else leaf
+        ),
+        params,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )
+
+
+def tree_weight_bytes(params: Any) -> int:
+    """HBM bytes a (possibly quantized) params tree keeps resident."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        if hasattr(leaf, "size"):
+            total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def quantized_weight_bytes(params: Any) -> int:
+    """What :func:`quantize_tree` WOULD leave resident, computed without
+    materializing the quantized tree (planner-side budgeting)."""
+    total = 0
+
+    def visit(path, leaf):
+        nonlocal total
+        if not hasattr(leaf, "size"):
+            return leaf
+        if _wants_quant(path, leaf):
+            channels = leaf.shape[-1]
+            total += leaf.size * 1 + channels * 4  # int8 q + f32 scales
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return total

@@ -278,6 +278,7 @@ class LLMDeployment:
         spec_tokens: int = 4,
         checkpoint_dir: Optional[str] = None,
         checkpoint_step: Optional[int] = None,
+        quantize_weights: bool = False,
     ) -> None:
         self.model_name = model_name
         self.num_slots = num_slots
@@ -318,6 +319,9 @@ class LLMDeployment:
             )
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_step = checkpoint_step
+        # Weight-only int8 for the decode engines (engine-owned transform;
+        # TP meshes unsupported — see DecodeEngine).
+        self.quantize_weights = quantize_weights
         self._dtype = dtype
         self._model = model
         self._params = params
@@ -343,6 +347,17 @@ class LLMDeployment:
                     self._params = CheckpointManager(
                         self.checkpoint_dir
                     ).restore(self._params, step=self.checkpoint_step)
+            if self.quantize_weights:
+                from ray_dynamic_batching_tpu.models.quant import (
+                    is_quantized,
+                    quantize_tree,
+                )
+
+                # Quantize ONCE here: every length-bucket engine shares the
+                # same int8 tree (per-engine quantization would multiply
+                # resident weight copies by the bucket count).
+                if not is_quantized(self._params):
+                    self._params = quantize_tree(self._params)
             if self.draft_model_name is not None and self._draft_model is None:
                 from ray_dynamic_batching_tpu.models.base import get_model
 
@@ -382,6 +397,8 @@ class LLMDeployment:
                 if hasattr(leaf, "size")
             )
 
+        # _ensure_model already quantized self._params when requested, so a
+        # plain byte count is exact for both modes.
         weights_bytes = tree_bytes(self._params) / max(1, n_chips)
         budget = float(cfg.hbm_budget_bytes)
         per_slot = float(
@@ -401,14 +418,15 @@ class LLMDeployment:
         if self.session_cache_size > 0:
             # Each stored session turn pins a FULL kv row on device; the
             # cache at capacity is that many phantom slots of residency —
-            # and EVERY length-bucket engine holds its own cache, while
-            # this call sees only a 1/n_buckets budget slice, so the whole
-            # deployment's session residency must come off the top here.
+            # and EVERY length-bucket engine holds its own cache with rows
+            # sized by ITS bucket, while this call sees only a 1/n_buckets
+            # budget slice, so the whole deployment's session residency
+            # (summed over buckets) must come off the top here.
             weights_bytes += (
-                len(self.length_buckets)
-                * self.session_cache_size
-                * float(self._model.kv_bytes_per_slot(
-                    max_len or self.max_len
+                self.session_cache_size
+                * float(sum(
+                    self._model.kv_bytes_per_slot(b)
+                    for b in self.length_buckets
                 ))
             ) / max(1, n_chips)
         usable = (
@@ -459,6 +477,7 @@ class LLMDeployment:
             draft_model=self._draft_model,
             draft_params=self._draft_params,
             spec_tokens=self.spec_tokens,
+            quantize_weights=self.quantize_weights,
             device=device,
             mesh=mesh,
         )
@@ -473,6 +492,14 @@ class LLMDeployment:
     ) -> LLMReplica:
         device = None
         mesh = None
+        if devices and len(devices) > 1 and self.quantize_weights:
+            # Fail BEFORE the mesh/engine build (and before the placement
+            # group's chips are consumed by a doomed start).
+            raise ValueError(
+                f"{config.name}: quantize_weights is not supported for "
+                "multi-chip (TP) replicas yet — drop chips_per_replica or "
+                "the quantization flag"
+            )
         if devices and len(devices) > 1:
             # Multi-chip bundle -> TP-sharded replica over its own mesh
             # slice (replica = mesh slice, SURVEY.md §7 stage 6).

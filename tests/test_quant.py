@@ -1,0 +1,133 @@
+"""Weight-only int8 quantization: round-trip error bounds, byte
+accounting, logits drift, and serving through the decode engine (the
+reference has no quantization story — TPU bandwidth lever)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+from ray_dynamic_batching_tpu.models.base import get_model
+from ray_dynamic_batching_tpu.models.quant import (
+    QTensor,
+    dequantize_tree,
+    quantize_tree,
+    quantized_weight_bytes,
+    tree_weight_bytes,
+)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model("llama_tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+class TestQuantTree:
+    def test_roundtrip_error_bound(self, lm):
+        """Symmetric int8: every dequantized element is within half a
+        quantization step of the original."""
+        _, params = lm
+        q = quantize_tree(params)
+        leaves = [
+            leaf for leaf in jax.tree_util.tree_leaves(
+                q, is_leaf=lambda x: isinstance(x, QTensor)
+            )
+            if isinstance(leaf, QTensor)
+        ]
+        assert leaves, "no kernel was quantized"
+        deq = dequantize_tree(q, jnp.float32)
+        for (path, orig), (_, got) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(deq)[0],
+        ):
+            orig = np.asarray(orig, np.float32)
+            got = np.asarray(got, np.float32)
+            step = np.max(np.abs(orig), axis=tuple(range(orig.ndim - 1)),
+                          keepdims=True) / 127.0 if orig.ndim >= 2 else 0
+            assert np.all(np.abs(orig - got) <= np.maximum(step, 1e-7) * 0.5
+                          + 1e-7), path
+
+    def test_bytes_shrink_and_estimate_matches(self, lm):
+        _, params = lm
+        q = quantize_tree(params)
+        fp = tree_weight_bytes(params)
+        qq = tree_weight_bytes(q)
+        assert qq < 0.5 * fp  # f32 kernels -> int8 (+ small scales)
+        assert qq == quantized_weight_bytes(params)  # planner estimate exact
+
+    def test_embeddings_stay_unquantized(self, lm):
+        _, params = lm
+        q = quantize_tree(params)
+
+        def check(path, leaf):
+            name = "/".join(str(getattr(p, "key", p)) for p in path).lower()
+            if "embed" in name and hasattr(leaf, "dtype"):
+                assert leaf.dtype != jnp.int8, name
+            return leaf
+
+        jax.tree_util.tree_map_with_path(
+            check, q, is_leaf=lambda x: isinstance(x, QTensor)
+        )
+
+    def test_logits_drift_bounded(self, lm):
+        """Quantized forward stays close to fp: relative logits error well
+        under the softmax-relevant scale."""
+        model, params = lm
+        tokens = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+        mask = jnp.ones_like(tokens)
+        ref = np.asarray(model.apply(params, tokens, mask), np.float32)
+        deq = dequantize_tree(quantize_tree(params), jnp.float32)
+        got = np.asarray(model.apply(deq, tokens, mask), np.float32)
+        denom = np.maximum(np.abs(ref).max(), 1e-6)
+        assert np.abs(ref - got).max() / denom < 0.05
+
+
+class TestQuantizedServing:
+    def test_engine_serves_with_int8_weights(self, lm):
+        """The engine holds int8 weights resident and serves every decode
+        path (prefill group, scan horizon, chunked long prompt)."""
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=64)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=64,
+            prompt_buckets=[8], default_max_new_tokens=6,
+            quantize_weights=True,
+        )
+        # Resident tree is int8 where it counts.
+        int8_leaves = [
+            leaf for leaf in jax.tree_util.tree_leaves(engine.params)
+            if hasattr(leaf, "dtype") and leaf.dtype == jnp.int8
+        ]
+        assert int8_leaves
+        reqs = []
+        for prompt in ([1, 2, 3], [(i * 7) % 50 + 1 for i in range(20)]):
+            req = Request(
+                model=model.name,
+                payload={"tokens": np.asarray(prompt, np.int32),
+                         "max_new_tokens": 6},
+                slo_ms=60_000.0,
+            )
+            queue.add_request(req)
+            reqs.append(req)
+        engine.run_until_idle(timeout_s=180)
+        for r in reqs:
+            assert len(r.future.result(timeout=5).tokens) == 6
+
+    def test_mesh_rejected(self, lm):
+        model, params = lm
+
+        class FakeMesh:
+            pass
+
+        with pytest.raises(ValueError, match="not supported"):
+            DecodeEngine(
+                model, params, RequestQueue(model.name, max_len=16),
+                num_slots=1, max_len=16, prompt_buckets=[8],
+                quantize_weights=True, mesh=FakeMesh(),
+            )
