@@ -350,16 +350,12 @@ class DecodeEngine:
                     "sharding rules key on kernel paths, which quantization "
                     "rewrites into QTensor q/scale leaves"
                 )
-            from ray_dynamic_batching_tpu.models.quant import (
-                is_quantized,
-                quantize_tree,
-            )
+            from ray_dynamic_batching_tpu.models.quant import quantize_tree
 
-            # A pre-quantized tree (the deployment quantizes ONCE and hands
-            # the same tree to every length-bucket engine) is shared as-is;
-            # re-quantizing would allocate a fresh int8 copy per engine.
-            if not is_quantized(params):
-                params = quantize_tree(params)
+            # Idempotent: a pre-quantized tree (the deployment quantizes
+            # ONCE and hands the same tree to every length-bucket engine)
+            # passes through shared, no fresh int8 copy per engine.
+            params = quantize_tree(params)
         if mesh is not None:
             # TP-sharded replica (BASELINE.json config 4): params sharded by
             # the model's Megatron-style rules, KV cache sharded over kv
@@ -403,6 +399,14 @@ class DecodeEngine:
         self._temps = np.zeros((num_slots,), dtype=np.float32)
         self._topk = np.zeros((num_slots,), dtype=np.int32)
         self._seeds = np.zeros((num_slots,), dtype=np.int32)
+        # Per-slot sparse logit bias (OpenAI-style logit_bias; banned
+        # tokens ride as -inf bias): fixed K entries keep shapes static,
+        # padding rows are (id 0, value 0) — an add of 0, not a mask.
+        self.max_bias_entries = 16
+        self._bias_ids = np.zeros((num_slots, self.max_bias_entries),
+                                  dtype=np.int32)
+        self._bias_vals = np.zeros((num_slots, self.max_bias_entries),
+                                   dtype=np.float32)
 
         self.decode_horizon = max(1, int(decode_horizon))
         # Bound on admission latency while slots are free: an arrival during
@@ -492,7 +496,20 @@ class DecodeEngine:
             params, getattr(self.model, "dtype", jnp.bfloat16)
         )
 
-    def _sample_tokens(self, logits, temps, topk, seeds, tok_idx):
+    @staticmethod
+    def _apply_bias(logits, bias_ids, bias_vals):
+        """Sparse per-row logit bias: logits[b, ids[b, j]] += vals[b, j].
+        Padding entries are (0, 0.0) — a no-op add. Runs before BOTH
+        greedy argmax and sampling so biased greedy stays deterministic
+        (the speculative verify path applies the same bias)."""
+        B = logits.shape[0]
+        rows = jnp.arange(B)[:, None]
+        return logits.at[rows, bias_ids].add(
+            bias_vals.astype(logits.dtype)
+        )
+
+    def _sample_tokens(self, logits, temps, topk, seeds, tok_idx,
+                       bias_ids=None, bias_vals=None):
         """In-program per-request sampling: temperature 0 → greedy argmax;
         otherwise top-k-masked categorical, keyed by (base_seed, request
         seed, TOKEN INDEX within the request) — so a request's stream is
@@ -505,9 +522,13 @@ class DecodeEngine:
         logits [B, V]; temps [B] f32; topk [B] i32; seeds [B] i32;
         tok_idx [B] i32 (index of the token being sampled per request).
         """
+        logits = logits.astype(jnp.float32)
+        if bias_ids is not None:
+            # Before BOTH built-in and custom samplers: a ban the caller
+            # was told is enforced must bind regardless of sampler.
+            logits = self._apply_bias(logits, bias_ids, bias_vals)
         if self._sample_custom is not None:
             return self._sample_custom(logits).astype(jnp.int32)
-        logits = logits.astype(jnp.float32)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def draw(args):
@@ -538,7 +559,7 @@ class DecodeEngine:
         return jnp.where(temps > 0.0, sampled, greedy)
 
     def _prefill_impl(self, params, tokens, attn_mask, cache, slots,
-                      temps, topk, seeds, tok_idx):
+                      temps, topk, seeds, tok_idx, bias_ids, bias_vals):
         """``nB`` prompts → cache rows at ``slots`` + first sampled tokens.
 
         tokens/attn_mask are [nB, T]; ``slots`` is a traced [nB] int32
@@ -556,12 +577,12 @@ class DecodeEngine:
         )
         cache = copy_rows_into(cache, rows, slots)
         first = self._sample_tokens(
-            last_logits, temps, topk, seeds, tok_idx
+            last_logits, temps, topk, seeds, tok_idx, bias_ids, bias_vals
         )  # [nB]
         return first, cache
 
     def _decode_impl(self, params, cache, tokens, active, horizon: int,
-                     temps, topk, seeds, tok_idx0):
+                     temps, topk, seeds, tok_idx0, bias_ids, bias_vals):
         """``horizon`` chained decode steps in one program (one host sync).
 
         Rows already at capacity produce garbage logits (decode_step masks
@@ -585,7 +606,8 @@ class DecodeEngine:
             logits, cache = self.model.decode_step(
                 self._mp(params), tokens, cache, advanced
             )
-            nxt = self._sample_tokens(logits, temps, topk, seeds, tok_idx0 + j)
+            nxt = self._sample_tokens(logits, temps, topk, seeds,
+                                      tok_idx0 + j, bias_ids, bias_vals)
             nxt = jnp.where(advanced, nxt, tokens[:, 0])
             return (cache, nxt[:, None]), (nxt, advanced)
 
@@ -597,7 +619,8 @@ class DecodeEngine:
         )
         return packed, cache
 
-    def _spec_impl(self, params, cache, dcache, tokens, active):
+    def _spec_impl(self, params, cache, dcache, tokens, active,
+                   bias_ids, bias_vals):
         """One speculative round for the whole batch, greedy-exact.
 
         Draft scans ``k+1`` single-token steps (proposing d_1..d_k and
@@ -634,8 +657,17 @@ class DecodeEngine:
         d = drafts[:k].T  # [B, k]
         window = jnp.concatenate([tokens, d], axis=1)  # [B, k+1]
         logits, cache = self.model.verify_step(params, window, cache, active)
+        logits = logits.astype(jnp.float32)
+        # Same per-request bias as the plain path (ONE rule — _apply_bias —
+        # broadcast over the window) so biased greedy stays
+        # speculative-exact.
+        dense_bias = self._apply_bias(
+            jnp.zeros((B, logits.shape[-1]), jnp.float32),
+            bias_ids, bias_vals,
+        )
+        logits = logits + dense_bias[:, None, :]
         greedy = jnp.argmax(
-            logits.astype(jnp.float32), axis=-1
+            logits, axis=-1
         ).astype(jnp.int32)  # [B, k+1]; greedy[:, j] follows window[:, j]
         match = (d == greedy[:, :k]).astype(jnp.int32)
         m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # accepted drafts
@@ -728,6 +760,8 @@ class DecodeEngine:
                     jnp.zeros((g,), jnp.int32),
                     jnp.zeros((g,), jnp.int32),
                     jnp.zeros((g,), jnp.int32),
+                    jnp.zeros((g, self.max_bias_entries), jnp.int32),
+                    jnp.zeros((g, self.max_bias_entries), jnp.float32),
                 )
                 first.block_until_ready()
         for h in {1, self.ttft_horizon, self.decode_horizon}:
@@ -741,6 +775,8 @@ class DecodeEngine:
                 jnp.zeros((self.num_slots,), jnp.int32),
                 jnp.zeros((self.num_slots,), jnp.int32),
                 jnp.zeros((self.num_slots,), jnp.int32),
+                jnp.zeros((self.num_slots, self.max_bias_entries), jnp.int32),
+                jnp.zeros((self.num_slots, self.max_bias_entries), jnp.float32),
             )
             packed.block_until_ready()
         if self._dcache is not None:
@@ -759,6 +795,8 @@ class DecodeEngine:
                 self._dcache,
                 jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
                 jnp.zeros((self.num_slots,), dtype=bool),
+                jnp.zeros((self.num_slots, self.max_bias_entries), jnp.int32),
+                jnp.zeros((self.num_slots, self.max_bias_entries), jnp.float32),
             )
             packed.block_until_ready()
             # The catch-up runs after every PLAIN step of a spec engine —
@@ -822,6 +860,7 @@ class DecodeEngine:
             "seed": zlib.crc32(req.request_id.encode()) & 0x7FFFFFFF,
             "stop": (),           # extra per-request stop token ids
             "session_id": None,   # multi-turn KV continuation key
+            "logit_bias": {},     # token id -> additive logit bias
         }
         if isinstance(req.payload, dict):
             p = req.payload
@@ -836,11 +875,39 @@ class DecodeEngine:
             if p.get("session_id") is not None:
                 opts["session_id"] = str(p["session_id"])
                 opts["_prompt_tokens"] = prompt
+            bias = {
+                int(t): float(v)
+                for t, v in dict(p.get("logit_bias", {})).items()
+            }
+            for t in p.get("banned_tokens", ()):
+                bias[int(t)] = -1e9  # a ban is just a very negative bias
+            if len(bias) > self.max_bias_entries:
+                raise ValueError(
+                    f"{req.request_id}: {len(bias)} logit-bias entries "
+                    f"exceed the limit of {self.max_bias_entries}"
+                )
+            V = getattr(self.model.cfg, "vocab_size", None)
+            if V is not None and any(not 0 <= t < V for t in bias):
+                raise ValueError(
+                    f"{req.request_id}: logit-bias token id out of vocab"
+                )
+            opts["logit_bias"] = bias
             if opts["temperature"] < 0.0:
                 raise ValueError(
                     f"{req.request_id}: temperature must be >= 0"
                 )
         return prompt, bucket, opts
+
+    def _bias_arrays(self, opts: Dict):
+        """opts -> fixed-width (ids [K], vals [K]) padded with no-op
+        (0, 0.0) entries."""
+        K = self.max_bias_entries
+        ids = np.zeros((K,), dtype=np.int32)
+        vals = np.zeros((K,), dtype=np.float32)
+        for j, (t, v) in enumerate(opts.get("logit_bias", {}).items()):
+            ids[j] = t
+            vals[j] = v
+        return ids, vals
 
     def _admit(self) -> int:
         """Fill free slots from the queue (continuous batching join), at most
@@ -944,6 +1011,9 @@ class DecodeEngine:
         temps = np.zeros((group,), dtype=np.float32)
         topk = np.zeros((group,), dtype=np.int32)
         seeds = np.zeros((group,), dtype=np.int32)
+        bias_ids = np.zeros((group, self.max_bias_entries), dtype=np.int32)
+        bias_vals = np.zeros((group, self.max_bias_entries),
+                             dtype=np.float32)
         for i, (req, prompt, opts) in enumerate(items):
             tokens[i, : prompt.size] = prompt
             mask[i, : prompt.size] = 1
@@ -951,6 +1021,7 @@ class DecodeEngine:
             temps[i] = opts["temperature"]
             topk[i] = opts["top_k"]
             seeds[i] = opts["seed"]
+            bias_ids[i], bias_vals[i] = self._bias_arrays(opts)
         # Pad rows duplicate row 0 (same slot, same data — idempotent write).
         for i in range(n, group):
             tokens[i] = tokens[0]
@@ -959,6 +1030,8 @@ class DecodeEngine:
             temps[i] = temps[0]
             topk[i] = topk[0]
             seeds[i] = seeds[0]
+            bias_ids[i] = bias_ids[0]
+            bias_vals[i] = bias_vals[0]
 
         first, self._cache = self._prefill_fn(bucket, group)(
             self.params,
@@ -970,6 +1043,8 @@ class DecodeEngine:
             jnp.asarray(topk),
             jnp.asarray(seeds),
             jnp.zeros((group,), jnp.int32),  # prefill samples token 0
+            jnp.asarray(bias_ids),
+            jnp.asarray(bias_vals),
         )
         if self._dcache is not None:
             # The draft must see the same prompt: fill its cache rows too.
@@ -993,14 +1068,15 @@ class DecodeEngine:
         )
 
     def _commit_long_impl(self, cache, row_cache, slot, last_logits,
-                          temps, topk, seeds, tok_idx):
+                          temps, topk, seeds, tok_idx, bias_ids, bias_vals):
         """Copy the finished row cache into the big cache at ``slot`` and
         sample the first token — one dispatch closes the admission. The row
         cache is a whole number of chunks, so it can be LONGER than the
         shared cache; the static slice keeps only real capacity (positions
         past ``lengths`` are garbage either way and never attended)."""
         cache = commit_row(cache, row_cache, slot)
-        first = self._sample_tokens(last_logits, temps, topk, seeds, tok_idx)
+        first = self._sample_tokens(last_logits, temps, topk, seeds, tok_idx,
+                                    bias_ids, bias_vals)
         return first, cache
 
     def _seed_prefix_impl(self, row_cache, pk, pv):
@@ -1060,6 +1136,7 @@ class DecodeEngine:
         """Shared tail of every chunked admission (long and session): one
         commit dispatch (row -> shared cache + first-token sample), the
         draft replay when speculation is on, then registration."""
+        bids, bvals = self._bias_arrays(opts)
         first, self._cache = commit_fn(
             self._cache,
             row,
@@ -1069,6 +1146,8 @@ class DecodeEngine:
             jnp.asarray([opts["top_k"]], np.int32),
             jnp.asarray([opts["seed"]], np.int32),
             jnp.zeros((1,), jnp.int32),
+            jnp.asarray(bids[None]),
+            jnp.asarray(bvals[None]),
         )
         if self._dcache is not None:
             self._draft_long_fill(prompt, slot_idx, C)
@@ -1216,6 +1295,8 @@ class DecodeEngine:
         self._temps[slot_idx] = opts["temperature"]
         self._topk[slot_idx] = opts["top_k"]
         self._seeds[slot_idx] = opts["seed"]
+        self._bias_ids[slot_idx], self._bias_vals[slot_idx] = \
+            self._bias_arrays(opts)
 
         PREFILLS_TOTAL.inc(tags={"model": self.model.name})
         if opts.get("_session_miss"):
@@ -1279,6 +1360,8 @@ class DecodeEngine:
         self._temps[slot_idx] = 0.0
         self._topk[slot_idx] = 0
         self._seeds[slot_idx] = 0
+        self._bias_ids[slot_idx] = 0
+        self._bias_vals[slot_idx] = 0.0
         self.completed += 1
 
     def _pick_horizon(self) -> int:
@@ -1314,6 +1397,8 @@ class DecodeEngine:
             self._dcache,
             jnp.asarray(self._tokens),
             jnp.asarray(self._active_mask),
+            jnp.asarray(self._bias_ids),
+            jnp.asarray(self._bias_vals),
         )
         ph = np.asarray(packed)  # ONE fetch per round
         out = ph[: k + 1]        # [k+1, B]
@@ -1369,6 +1454,8 @@ class DecodeEngine:
             jnp.asarray(self._topk),
             jnp.asarray(self._seeds),
             jnp.asarray(tok_idx),
+            jnp.asarray(self._bias_ids),
+            jnp.asarray(self._bias_vals),
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch
         toks_host = packed_host[:h]               # [h, B]

@@ -91,12 +91,18 @@ def is_quantized(params: Any) -> bool:
 
 
 def quantize_tree(params: Any) -> Any:
-    """Original params -> tree with matmul kernels as QTensor leaves."""
+    """Original params -> tree with matmul kernels as QTensor leaves.
+    Idempotent: existing QTensor leaves pass through untouched (without
+    the is_leaf stop, tree_map would descend into them and re-quantize
+    the int8 q arrays)."""
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: (
-            _quantize_leaf(leaf) if _wants_quant(path, leaf) else leaf
+            leaf if isinstance(leaf, QTensor)
+            else _quantize_leaf(leaf) if _wants_quant(path, leaf)
+            else leaf
         ),
         params,
+        is_leaf=lambda x: isinstance(x, QTensor),
     )
 
 

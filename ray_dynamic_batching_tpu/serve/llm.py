@@ -349,15 +349,14 @@ class LLMDeployment:
                     ).restore(self._params, step=self.checkpoint_step)
             if self.quantize_weights:
                 from ray_dynamic_batching_tpu.models.quant import (
-                    is_quantized,
                     quantize_tree,
                 )
 
-                # Quantize ONCE here: every length-bucket engine shares the
-                # same int8 tree (per-engine quantization would multiply
-                # resident weight copies by the bucket count).
-                if not is_quantized(self._params):
-                    self._params = quantize_tree(self._params)
+                # Quantize ONCE here (idempotent): every length-bucket
+                # engine shares the same int8 tree — per-engine
+                # quantization would multiply resident copies by the
+                # bucket count.
+                self._params = quantize_tree(self._params)
             if self.draft_model_name is not None and self._draft_model is None:
                 from ray_dynamic_batching_tpu.models.base import get_model
 
@@ -390,12 +389,9 @@ class LLMDeployment:
         self._ensure_model()
         cfg = get_config()
 
-        def tree_bytes(tree) -> float:
-            return sum(
-                leaf.size * leaf.dtype.itemsize
-                for leaf in jax.tree_util.tree_leaves(tree)
-                if hasattr(leaf, "size")
-            )
+        from ray_dynamic_batching_tpu.models.quant import (
+            tree_weight_bytes as tree_bytes,
+        )
 
         # _ensure_model already quantized self._params when requested, so a
         # plain byte count is exact for both modes.

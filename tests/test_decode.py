@@ -191,6 +191,66 @@ class TestDecodeEngine:
         assert len(req.future.result(timeout=5).tokens) == 3
 
 
+class TestLogitBias:
+    def test_banned_tokens_never_generated(self, lm):
+        """Ban the tokens greedy WOULD pick: generation must route around
+        them on every path (prefill first token + decode steps)."""
+        probe, pq = make_engine(lm)
+        r = submit(pq, [5, 9, 2, 7], max_new_tokens=6)
+        probe.run_until_idle()
+        natural = r.future.result(timeout=5).tokens
+        banned = list(dict.fromkeys(natural))[:3]
+        engine, queue = make_engine(lm)
+        req = submit(queue, [5, 9, 2, 7], max_new_tokens=6,
+                     banned_tokens=banned)
+        engine.run_until_idle()
+        got = req.future.result(timeout=5).tokens
+        assert not set(got) & set(banned)
+        assert got != natural
+
+    def test_positive_bias_forces_token(self, lm):
+        """A +1e9 bias on one token makes greedy pick it everywhere."""
+        engine, queue = make_engine(lm)
+        req = submit(queue, [1, 2, 3], max_new_tokens=4,
+                     logit_bias={41: 1e9})
+        engine.run_until_idle()
+        assert req.future.result(timeout=5).tokens == [41, 41, 41, 41]
+
+    def test_bias_spec_exactness(self, lm):
+        """Biased greedy under SPECULATIVE decoding must equal biased
+        greedy under plain decoding (verify applies the same bias)."""
+        model, params = lm
+        q1 = RequestQueue(model.name, max_len=256)
+        q2 = RequestQueue(model.name, max_len=256)
+        common = dict(num_slots=2, max_len=64, prompt_buckets=[8],
+                      default_max_new_tokens=8)
+        spec = DecodeEngine(model, params, q1, draft_model=model,
+                            draft_params=params, spec_tokens=3, **common)
+        plain = DecodeEngine(model, params, q2, **common)
+        probe = submit(q2, [5, 9, 2, 7], max_new_tokens=8)
+        plain.run_until_idle()
+        ban = probe.future.result(timeout=5).tokens[2]
+        r1 = submit(q1, [5, 9, 2, 7], max_new_tokens=8,
+                    banned_tokens=[ban])
+        r2 = submit(q2, [5, 9, 2, 7], max_new_tokens=8,
+                    banned_tokens=[ban])
+        spec.run_until_idle(timeout_s=120)
+        plain.run_until_idle(timeout_s=120)
+        assert (r1.future.result(timeout=5).tokens
+                == r2.future.result(timeout=5).tokens)
+
+    def test_bias_validation(self, lm):
+        engine, queue = make_engine(lm)
+        req = submit(queue, [1, 2], logit_bias={i: 1.0 for i in range(40)})
+        engine._admit()
+        with pytest.raises(ValueError, match="exceed the limit"):
+            req.future.result(timeout=5)
+        req2 = submit(queue, [1, 2], logit_bias={10_000_000: 1.0})
+        engine._admit()
+        with pytest.raises(ValueError, match="out of vocab"):
+            req2.future.result(timeout=5)
+
+
 class TestMoEDecode:
     def test_moe_decode_matches_teacher_forcing(self):
         """A Mixture-of-Experts decoder serves through the SAME continuous-
