@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_dynamic_batching_tpu.engine.request import (
+    BadRequest,
     Request,
     RequestDropped,
     now_ms,
@@ -838,14 +839,14 @@ class DecodeEngine:
             dtype=np.int32,
         ).reshape(-1)
         if prompt.size == 0:
-            raise ValueError(f"{req.request_id}: empty prompt")
+            raise BadRequest(f"{req.request_id}: empty prompt")
         bucket = bucket_up(int(prompt.size), self.prompt_buckets)
         if bucket is None:
             # Longer than every bucket: admit via CHUNKED prefill (bucket
             # sentinel -1) as long as the cache can hold the prompt plus at
             # least one generated token.
             if prompt.size >= self.max_len:
-                raise ValueError(
+                raise BadRequest(
                     f"{req.request_id}: prompt length {prompt.size} "
                     f"exceeds KV capacity {self.max_len}"
                 )
@@ -882,18 +883,18 @@ class DecodeEngine:
             for t in p.get("banned_tokens", ()):
                 bias[int(t)] = -1e9  # a ban is just a very negative bias
             if len(bias) > self.max_bias_entries:
-                raise ValueError(
+                raise BadRequest(
                     f"{req.request_id}: {len(bias)} logit-bias entries "
                     f"exceed the limit of {self.max_bias_entries}"
                 )
             V = getattr(self.model.cfg, "vocab_size", None)
             if V is not None and any(not 0 <= t < V for t in bias):
-                raise ValueError(
+                raise BadRequest(
                     f"{req.request_id}: logit-bias token id out of vocab"
                 )
             opts["logit_bias"] = bias
             if opts["temperature"] < 0.0:
-                raise ValueError(
+                raise BadRequest(
                     f"{req.request_id}: temperature must be >= 0"
                 )
         return prompt, bucket, opts

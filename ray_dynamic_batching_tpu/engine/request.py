@@ -191,6 +191,12 @@ class Request:
             self.stream.put(chunk)
 
 
+class BadRequest(ValueError):
+    """The request payload itself is malformed — the CLIENT's fault. The
+    HTTP proxy maps this (and only this) to a 4xx; plain ValueError from
+    replica/engine internals stays a server error."""
+
+
 class RequestDropped(Exception):
     """Raised into a request's future when the queue drops it."""
 

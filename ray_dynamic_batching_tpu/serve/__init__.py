@@ -28,6 +28,7 @@ from ray_dynamic_batching_tpu.serve.controller import (
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 from ray_dynamic_batching_tpu.serve.llm import LLMDeployment, LLMReplica
 from ray_dynamic_batching_tpu.serve.long_poll import LongPollClient, LongPollHost
+from ray_dynamic_batching_tpu.serve.openai_api import CompletionsHandle
 from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.serve.router import Router
@@ -50,6 +51,7 @@ __all__ = [
     "shutdown",
     "AutoscalingConfig",
     "AutoscalingPolicy",
+    "CompletionsHandle",
     "DeploymentConfig",
     "DeploymentHandle",
     "HTTPProxy",

@@ -259,6 +259,10 @@ class HTTPProxy:
             writer is not None
             and isinstance(payload, dict)
             and payload.get("stream")
+            # Adapter handles without a streaming surface fall through to
+            # the unary path, whose validation can answer 400 — a missing
+            # attribute here would drop the connection with no response.
+            and hasattr(handle, "remote_stream")
         ):
             code = await self._stream_response(writer, handle, payload)
             # None marks "already written"; tag carries the code for metrics.
@@ -272,7 +276,17 @@ class HTTPProxy:
         except asyncio.TimeoutError:
             return self._response(504, {"error": "request timed out"}), route
         except Exception as e:  # noqa: BLE001 — replica-side errors surface as 500
-            code = 503 if "no replica" in str(e) else 500
+            from ray_dynamic_batching_tpu.engine.request import BadRequest
+
+            # Only the dedicated BadRequest type is the client's fault: a
+            # bare ValueError can come from replica/config bugs (e.g. a
+            # deployment callable returning the wrong count) and must stay
+            # a server error for retry logic and error-rate monitoring.
+            code = (
+                400 if isinstance(e, BadRequest)
+                else 503 if "no replica" in str(e)
+                else 500
+            )
             return self._response(code, {"error": str(e)}), route
         return self._response(200, {"result": result}), route
 

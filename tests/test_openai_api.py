@@ -1,0 +1,133 @@
+"""OpenAI-shaped completions adapter: translation both ways, proxy
+integration, and error mapping."""
+
+import json
+import socket
+
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.serve.controller import (
+    DeploymentConfig,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+from ray_dynamic_batching_tpu.serve.openai_api import (
+    CompletionsHandle,
+    translate_request,
+)
+from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
+
+
+@pytest.fixture(scope="module")
+def stack():
+    ctl = ServeController(control_interval_s=0.2)
+    dep = LLMDeployment(
+        "llama_tiny", num_slots=2, max_len=64, prompt_buckets=[8],
+        default_max_new_tokens=6, dtype=jnp.float32,
+    )
+    router = ctl.deploy(DeploymentConfig(name="llama_tiny"), factory=dep)
+    ctl.start()
+    completions = CompletionsHandle(
+        DeploymentHandle(router), model="llama_tiny",
+    )
+    proxy_router = ProxyRouter()
+    proxy_router.set_route("/v1/completions", completions)
+    proxy = HTTPProxy(proxy_router, port=0).start()
+    yield completions, proxy
+    proxy.stop()
+    ctl.shutdown()
+
+
+def _post(proxy, body: dict) -> tuple:
+    raw = json.dumps(body).encode()
+    req = (b"POST /v1/completions HTTP/1.1\r\nHost: x\r\nContent-Length: "
+           + str(len(raw)).encode() + b"\r\n\r\n" + raw)
+    with socket.create_connection(("127.0.0.1", proxy.port),
+                                  timeout=60) as s:
+        s.settimeout(60)
+        s.sendall(req)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            data += s.recv(4096)
+        head, body_bytes = data.split(b"\r\n\r\n", 1)
+        # Read to Content-Length: one early body byte is NOT the payload.
+        n = next(
+            int(line.split(b":", 1)[1])
+            for line in head.split(b"\r\n")
+            if line.lower().startswith(b"content-length")
+        )
+        while len(body_bytes) < n:
+            body_bytes += s.recv(4096)
+    code = int(data.split(b" ", 2)[1])
+    return code, json.loads(body_bytes)
+
+
+class TestTranslation:
+    def test_request_fields_map(self):
+        p = translate_request({
+            "prompt": [1, 2, 3], "max_tokens": 9, "temperature": 0.5,
+            "top_k": 40, "seed": 11, "stop": [7], "logit_bias": {"4": -5},
+            "session_id": "u1",
+        })
+        assert p == {
+            "tokens": [1, 2, 3], "max_new_tokens": 9, "temperature": 0.5,
+            "top_k": 40, "seed": 11, "stop_token_ids": [7],
+            "logit_bias": {4: -5.0}, "session_id": "u1",
+        }
+
+    def test_rejects_bad_shapes(self):
+        with pytest.raises(ValueError, match="token ids"):
+            translate_request({"prompt": "a string"})
+        with pytest.raises(ValueError, match="n > 1"):
+            translate_request({"prompt": [1], "n": 2})
+        with pytest.raises(ValueError, match="stream"):
+            translate_request({"prompt": [1], "stream": True})
+
+    def test_user_field_is_session_fallback(self):
+        p = translate_request({"prompt": [1], "user": "alice"})
+        assert p["session_id"] == "alice"
+        p = translate_request({"prompt": [1], "user": "alice",
+                               "session_id": "s9"})
+        assert p["session_id"] == "s9"  # explicit extension wins
+
+
+class TestOverHTTP:
+    def test_completion_roundtrip(self, stack):
+        _, proxy = stack
+        code, resp = _post(proxy, {"prompt": [5, 9, 2, 7], "max_tokens": 4})
+        assert code == 200
+        body = resp["result"]
+        assert body["object"] == "text_completion"
+        assert body["model"] == "llama_tiny"
+        assert len(body["choices"][0]["tokens"]) == 4
+        assert body["choices"][0]["finish_reason"] == "length"
+        assert body["usage"] == {
+            "prompt_tokens": 4, "completion_tokens": 4, "total_tokens": 8,
+        }
+
+    def test_stop_maps_to_finish_stop(self, stack):
+        _, proxy = stack
+        code, resp = _post(proxy, {"prompt": [5, 9, 2, 7], "max_tokens": 6})
+        first = resp["result"]["choices"][0]["tokens"][0]
+        code, resp = _post(proxy, {
+            "prompt": [5, 9, 2, 7], "max_tokens": 6, "stop": [first],
+        })
+        assert code == 200
+        assert resp["result"]["choices"][0]["finish_reason"] == "stop"
+
+    def test_stream_true_rejected_cleanly(self, stack):
+        """stream=true must answer 400 over HTTP, not drop the socket
+        (the adapter has no remote_stream; the proxy must fall through to
+        the unary path whose validation rejects it)."""
+        _, proxy = stack
+        code, resp = _post(proxy, {"prompt": [1, 2], "stream": True})
+        assert code == 400
+        assert "stream" in resp["error"]
+
+    def test_malformed_request_is_client_error(self, stack):
+        _, proxy = stack
+        code, resp = _post(proxy, {"prompt": "text prompts unsupported"})
+        assert code == 400  # client fault, not a replica error
+        assert "token ids" in resp["error"]
