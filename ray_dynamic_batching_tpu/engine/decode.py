@@ -834,10 +834,14 @@ class DecodeEngine:
         / top_k / seed — or raises. Every way a payload can be malformed
         must surface here: past this point the request is committed to a
         slot and only engine errors can reject it."""
-        prompt = np.asarray(
-            req.payload["tokens"] if isinstance(req.payload, dict) else req.payload,
-            dtype=np.int32,
-        ).reshape(-1)
+        try:
+            prompt = np.asarray(
+                req.payload["tokens"] if isinstance(req.payload, dict)
+                else req.payload,
+                dtype=np.int32,
+            ).reshape(-1)
+        except (TypeError, ValueError, KeyError) as e:
+            raise BadRequest(f"{req.request_id}: malformed tokens: {e}")
         if prompt.size == 0:
             raise BadRequest(f"{req.request_id}: empty prompt")
         bucket = bucket_up(int(prompt.size), self.prompt_buckets)
@@ -865,23 +869,33 @@ class DecodeEngine:
         }
         if isinstance(req.payload, dict):
             p = req.payload
-            opts["max_new"] = int(p.get("max_new_tokens", opts["max_new"]))
-            opts["temperature"] = float(p.get("temperature", 0.0))
-            opts["top_k"] = int(p.get("top_k", 0))
-            if "seed" in p:
-                opts["seed"] = int(p["seed"]) & 0x7FFFFFFF
-            opts["stop"] = frozenset(
-                int(t) for t in p.get("stop_token_ids", ())
-            )
-            if p.get("session_id") is not None:
-                opts["session_id"] = str(p["session_id"])
-                opts["_prompt_tokens"] = prompt
-            bias = {
-                int(t): float(v)
-                for t, v in dict(p.get("logit_bias", {})).items()
-            }
-            for t in p.get("banned_tokens", ()):
-                bias[int(t)] = -1e9  # a ban is just a very negative bias
+            try:
+                # Coercion failures on client-supplied fields are the
+                # CLIENT's fault (TypeError folds in: int(None) etc.) —
+                # they must classify as BadRequest, not server errors.
+                opts["max_new"] = int(
+                    p.get("max_new_tokens", opts["max_new"])
+                )
+                opts["temperature"] = float(p.get("temperature", 0.0))
+                opts["top_k"] = int(p.get("top_k", 0))
+                if "seed" in p:
+                    opts["seed"] = int(p["seed"]) & 0x7FFFFFFF
+                opts["stop"] = frozenset(
+                    int(t) for t in p.get("stop_token_ids", ())
+                )
+                if p.get("session_id") is not None:
+                    opts["session_id"] = str(p["session_id"])
+                    opts["_prompt_tokens"] = prompt
+                bias = {
+                    int(t): float(v)
+                    for t, v in dict(p.get("logit_bias", {})).items()
+                }
+                for t in p.get("banned_tokens", ()):
+                    bias[int(t)] = -1e9  # a ban = very negative bias
+            except (TypeError, ValueError) as e:
+                raise BadRequest(
+                    f"{req.request_id}: malformed field: {e}"
+                )
             if len(bias) > self.max_bias_entries:
                 raise BadRequest(
                     f"{req.request_id}: {len(bias)} logit-bias entries "

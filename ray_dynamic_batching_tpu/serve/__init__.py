@@ -16,6 +16,7 @@ from ray_dynamic_batching_tpu.serve.api import (
     multiplexed,
     run,
     shutdown,
+    status,
 )
 from ray_dynamic_batching_tpu.serve.autoscaling import (
     AutoscalingConfig,
@@ -49,6 +50,7 @@ __all__ = [
     "multiplexed",
     "run",
     "shutdown",
+    "status",
     "AutoscalingConfig",
     "AutoscalingPolicy",
     "CompletionsHandle",

@@ -407,6 +407,15 @@ def get_deployment_handle(
     )
 
 
+def status() -> Dict[str, Any]:
+    """Deployment/replica state of the module controller (ref
+    ``serve.status`` / the status CLI). Empty when nothing is running —
+    asking must not START a controller as a side effect."""
+    with _state_lock:
+        ctl = _controller
+    return ctl.status() if ctl is not None else {}
+
+
 def delete(name: str) -> None:
     """Tear down one deployment (ref serve.delete)."""
     _get_controller().delete_deployment(name)

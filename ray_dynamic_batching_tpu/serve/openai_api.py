@@ -59,25 +59,34 @@ def translate_request(body: Dict[str, Any],
         raise _bad("stream=true is not supported on /v1/completions; "
                    "use the deployment's native route with "
                    '{"stream": true}')
-    payload: Dict[str, Any] = {
-        "tokens": list(prompt),
-        "max_new_tokens": int(body.get("max_tokens", default_max_tokens)),
-    }
-    if "temperature" in body:
-        payload["temperature"] = float(body["temperature"])
-    if "top_k" in body:
-        payload["top_k"] = int(body["top_k"])
-    if "seed" in body:
-        payload["seed"] = int(body["seed"])
-    if "stop" in body:  # token ids, per the module contract
-        stop = body["stop"]
-        if not isinstance(stop, (list, tuple)):
-            stop = [stop]
-        payload["stop_token_ids"] = [int(t) for t in stop]
-    if "logit_bias" in body:
-        payload["logit_bias"] = {
-            int(t): float(v) for t, v in dict(body["logit_bias"]).items()
-        }
+    payload: Dict[str, Any] = {"tokens": list(prompt)}
+    # Coercion failures (int(None), dict([1,2]), float("hot")) are the
+    # CLIENT's malformed fields: fold TypeError in too, or they escape
+    # the BadRequest->400 path as server errors.
+    try:
+        payload["max_new_tokens"] = int(
+            body.get("max_tokens", default_max_tokens)
+        )
+        if "temperature" in body:
+            payload["temperature"] = float(body["temperature"])
+        if "top_k" in body:
+            payload["top_k"] = int(body["top_k"])
+        if "seed" in body:
+            payload["seed"] = int(body["seed"])
+        if "stop" in body:  # token ids, per the module contract
+            stop = body["stop"]
+            if not isinstance(stop, (list, tuple)):
+                stop = [stop]
+            payload["stop_token_ids"] = [int(t) for t in stop]
+        if "logit_bias" in body:
+            payload["logit_bias"] = {
+                int(t): float(v)
+                for t, v in dict(body["logit_bias"]).items()
+            }
+    except BadRequest:
+        raise
+    except (TypeError, ValueError) as e:
+        raise _bad(f"malformed field: {e}")
     # Session continuation key: prefer the explicit extension field,
     # fall back to OpenAI's standard `user` (stable per end-user, which
     # is exactly what conversation KV affinity wants).
@@ -134,8 +143,8 @@ class CompletionsHandle:
         out: Future = Future()
         try:
             payload = translate_request(body, self.default_max_tokens)
-        except ValueError as e:
-            out.set_exception(e)
+        except Exception as e:  # noqa: BLE001 — a synchronous raise would
+            out.set_exception(e)  # drop the HTTP connection responseless
             return out
         if self.default_slo_ms is not None:
             kwargs.setdefault("slo_ms", self.default_slo_ms)

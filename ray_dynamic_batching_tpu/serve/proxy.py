@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ray_dynamic_batching_tpu.engine.request import BadRequest
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -276,8 +277,6 @@ class HTTPProxy:
         except asyncio.TimeoutError:
             return self._response(504, {"error": "request timed out"}), route
         except Exception as e:  # noqa: BLE001 — replica-side errors surface as 500
-            from ray_dynamic_batching_tpu.engine.request import BadRequest
-
             # Only the dedicated BadRequest type is the client's fault: a
             # bare ValueError can come from replica/config bugs (e.g. a
             # deployment callable returning the wrong count) and must stay

@@ -224,6 +224,22 @@ class TestMultiplexed:
 
 
 class TestModuleLevelRun:
+    def test_status_reports_without_starting_controller(self):
+        assert serve.status() == {}  # no controller side effects
+
+    def test_status_after_run(self):
+        @serve.deployment(name="stat_d", num_replicas=2)
+        def f(x):
+            return x
+
+        try:
+            serve.run(f.bind())
+            st = serve.status()
+            assert st["stat_d"]["running_replicas"] == 2
+            assert st["stat_d"]["healthy"]
+        finally:
+            serve.shutdown()
+
     def test_run_route_prefix_and_handle_lookup(self):
         @serve.deployment(name="echo_api")
         def echo(x):
