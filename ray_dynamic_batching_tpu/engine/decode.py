@@ -44,6 +44,7 @@ generator batches, ``serve/batching.py:209-276``).
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 import zlib
@@ -400,6 +401,15 @@ class DecodeEngine:
         self._temps = np.zeros((num_slots,), dtype=np.float32)
         self._topk = np.zeros((num_slots,), dtype=np.int32)
         self._seeds = np.zeros((num_slots,), dtype=np.int32)
+        # Per-slot presence/frequency penalties over GENERATED tokens
+        # (repetition control; the prompt is not counted — documented
+        # variant of the OpenAI semantics). Counts live ON DEVICE so the
+        # horizon scan updates them in-carry without host syncs.
+        self._pres = np.zeros((num_slots,), dtype=np.float32)
+        self._freq = np.zeros((num_slots,), dtype=np.float32)
+        V = getattr(getattr(model, "cfg", None), "vocab_size", 0)
+        with self._device_ctx():
+            self._counts = jnp.zeros((num_slots, max(V, 1)), jnp.int32)
         # Per-slot sparse logit bias (OpenAI-style logit_bias; banned
         # tokens ride as -inf bias): fixed K entries keep shapes static,
         # padding rows are (id 0, value 0) — an add of 0, not a mask.
@@ -432,8 +442,11 @@ class DecodeEngine:
         if session_cache_size > 0:
             self.session_cache = SessionCache(session_cache_size)
         self._prefill_fns: Dict[int, Callable] = {}
+        # Donations: cache (arg 1) and counts (arg 11 — params=0,
+        # cache=1, tokens=2, active=3, horizon=4, temps=5, topk=6,
+        # seeds=7, tok_idx0=8, bias_ids=9, bias_vals=10, counts=11).
         self._decode_fn = jax.jit(
-            self._decode_impl, donate_argnums=(1,), static_argnums=(4,)
+            self._decode_impl, donate_argnums=(1, 11), static_argnums=(4,)
         )
         # Speculative decoding (greedy rows only): a small draft proposes
         # spec_tokens continuations per slot, the target verifies the whole
@@ -468,6 +481,18 @@ class DecodeEngine:
             self._draft_catchup_fn = jax.jit(
                 self._draft_catchup_impl, donate_argnums=(1,)
             )
+        def _reset_counts(counts, slot, first_tok):
+            # Fresh tenant: zero the reused row, then count the PREFILL-
+            # sampled first token (the scan only counts tokens it samples
+            # itself — without this, the first token repeats once free).
+            counts = jax.lax.dynamic_update_slice(
+                counts,
+                jnp.zeros((1, counts.shape[1]), jnp.int32),
+                (slot, 0),
+            )
+            return counts.at[slot, first_tok].set(1)
+
+        self._zero_counts_fn = jax.jit(_reset_counts, donate_argnums=(0,))
         self._thread: Optional[threading.Thread] = None
         self._run = threading.Event()
         self.steps = 0
@@ -583,7 +608,8 @@ class DecodeEngine:
         return first, cache
 
     def _decode_impl(self, params, cache, tokens, active, horizon: int,
-                     temps, topk, seeds, tok_idx0, bias_ids, bias_vals):
+                     temps, topk, seeds, tok_idx0, bias_ids, bias_vals,
+                     counts, pres, freq):
         """``horizon`` chained decode steps in one program (one host sync).
 
         Rows already at capacity produce garbage logits (decode_step masks
@@ -596,8 +622,10 @@ class DecodeEngine:
         device→host boundary is crossed once per dispatch, not three times.
         """
 
+        rows = jnp.arange(tokens.shape[0])
+
         def substep(carry, j):
-            cache, tokens = carry
+            cache, tokens, counts = carry
             advanced = jnp.logical_and(active, cache.lengths < cache.capacity)
             # Dequantize INSIDE the scan body: hoisted outside, the bf16
             # tree becomes a loop-invariant XLA materializes once and
@@ -607,18 +635,28 @@ class DecodeEngine:
             logits, cache = self.model.decode_step(
                 self._mp(params), tokens, cache, advanced
             )
+            # Repetition control: subtract presence (any prior emission)
+            # and frequency (per emission) penalties over the slot's
+            # generated-token counts. All-zero penalties make this an
+            # exact no-op on the hot path.
+            logits = logits.astype(jnp.float32) - (
+                pres[:, None] * (counts > 0)
+                + freq[:, None] * counts.astype(jnp.float32)
+            )
             nxt = self._sample_tokens(logits, temps, topk, seeds,
                                       tok_idx0 + j, bias_ids, bias_vals)
             nxt = jnp.where(advanced, nxt, tokens[:, 0])
-            return (cache, nxt[:, None]), (nxt, advanced)
+            counts = counts.at[rows, nxt].add(advanced.astype(jnp.int32))
+            return (cache, nxt[:, None], counts), (nxt, advanced)
 
-        (cache, _), (toks, adv) = jax.lax.scan(
-            substep, (cache, tokens), jnp.arange(horizon, dtype=jnp.int32)
+        (cache, _, counts), (toks, adv) = jax.lax.scan(
+            substep, (cache, tokens, counts),
+            jnp.arange(horizon, dtype=jnp.int32),
         )
         packed = jnp.concatenate(
             [toks, adv.astype(jnp.int32), cache.lengths[None, :]], axis=0
         )
-        return packed, cache
+        return packed, cache, counts
 
     def _spec_impl(self, params, cache, dcache, tokens, active,
                    bias_ids, bias_vals):
@@ -766,7 +804,7 @@ class DecodeEngine:
                 )
                 first.block_until_ready()
         for h in {1, self.ttft_horizon, self.decode_horizon}:
-            packed, self._cache = self._decode_fn(
+            packed, self._cache, self._counts = self._decode_fn(
                 self.params,
                 self._cache,
                 jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
@@ -778,6 +816,9 @@ class DecodeEngine:
                 jnp.zeros((self.num_slots,), jnp.int32),
                 jnp.zeros((self.num_slots, self.max_bias_entries), jnp.int32),
                 jnp.zeros((self.num_slots, self.max_bias_entries), jnp.float32),
+                self._counts,
+                jnp.zeros((self.num_slots,), jnp.float32),
+                jnp.zeros((self.num_slots,), jnp.float32),
             )
             packed.block_until_ready()
         if self._dcache is not None:
@@ -814,6 +855,9 @@ class DecodeEngine:
             self._dcache = self._dcache.replace(
                 lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
             )
+        self._counts = self._zero_counts_fn(
+            self._counts, jnp.int32(0), jnp.int32(0)
+        )
         # Reset state dirtied by warmup runs.
         self._cache = self._cache.replace(
             lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
@@ -866,6 +910,8 @@ class DecodeEngine:
             "stop": (),           # extra per-request stop token ids
             "session_id": None,   # multi-turn KV continuation key
             "logit_bias": {},     # token id -> additive logit bias
+            "presence_penalty": 0.0,   # subtract once per distinct token
+            "frequency_penalty": 0.0,  # subtract per emission
         }
         if isinstance(req.payload, dict):
             p = req.payload
@@ -878,6 +924,25 @@ class DecodeEngine:
                 )
                 opts["temperature"] = float(p.get("temperature", 0.0))
                 opts["top_k"] = int(p.get("top_k", 0))
+                opts["presence_penalty"] = float(
+                    p.get("presence_penalty", 0.0)
+                )
+                opts["frequency_penalty"] = float(
+                    p.get("frequency_penalty", 0.0)
+                )
+                if not (math.isfinite(opts["presence_penalty"])
+                        and math.isfinite(opts["frequency_penalty"])):
+                    # json.loads accepts Infinity/NaN; inf * 0 = NaN would
+                    # silently poison the row's logits.
+                    raise BadRequest(
+                        f"{req.request_id}: penalties must be finite"
+                    )
+                if ((opts["presence_penalty"] or opts["frequency_penalty"])
+                        and self._counts.shape[1] <= 1):
+                    raise BadRequest(
+                        f"{req.request_id}: penalties unsupported — model "
+                        "exposes no vocab_size for token counting"
+                    )
                 if "seed" in p:
                     opts["seed"] = int(p["seed"]) & 0x7FFFFFFF
                 opts["stop"] = frozenset(
@@ -1312,6 +1377,16 @@ class DecodeEngine:
         self._seeds[slot_idx] = opts["seed"]
         self._bias_ids[slot_idx], self._bias_vals[slot_idx] = \
             self._bias_arrays(opts)
+        self._pres[slot_idx] = opts.get("presence_penalty", 0.0)
+        self._freq[slot_idx] = opts.get("frequency_penalty", 0.0)
+        if self._pres[slot_idx] or self._freq[slot_idx]:
+            # Stale counts only matter to rows that USE them: zero the
+            # reused slot's row on demand (penalty-free admissions — the
+            # common case — skip the dispatch; their penalties multiply
+            # the stale counts by zero).
+            self._counts = self._zero_counts_fn(
+                self._counts, jnp.int32(slot_idx), jnp.int32(first_tok)
+            )
 
         PREFILLS_TOTAL.inc(tags={"model": self.model.name})
         if opts.get("_session_miss"):
@@ -1377,6 +1452,8 @@ class DecodeEngine:
         self._seeds[slot_idx] = 0
         self._bias_ids[slot_idx] = 0
         self._bias_vals[slot_idx] = 0.0
+        self._pres[slot_idx] = 0.0
+        self._freq[slot_idx] = 0.0
         self.completed += 1
 
     def _pick_horizon(self) -> int:
@@ -1397,11 +1474,17 @@ class DecodeEngine:
         """Speculative rounds serve all-greedy batches only: sampled rows
         need rejection sampling for exactness, so any temperature>0 row
         drops the whole batch back to plain decode."""
+        active = self._active_mask
         return (
             self._dcache is not None
             and self._sample_custom is None
-            and bool(self._active_mask.any())
-            and float(self._temps[self._active_mask].max(initial=0.0)) == 0.0
+            and bool(active.any())
+            and float(self._temps[active].max(initial=0.0)) == 0.0
+            # Penalties need the per-step count updates of the plain path
+            # — NEGATIVE penalties (valid per the API) count too, so test
+            # magnitude, not the signed max.
+            and float(np.abs(self._pres[active]).max(initial=0.0)) == 0.0
+            and float(np.abs(self._freq[active]).max(initial=0.0)) == 0.0
         )
 
     def _spec_step(self) -> None:
@@ -1459,7 +1542,7 @@ class DecodeEngine:
         )
         prev_tokens = self._tokens.copy()  # draft catch-up window head
         active_at_dispatch = self._active_mask.copy()
-        packed, self._cache = self._decode_fn(
+        packed, self._cache, self._counts = self._decode_fn(
             self.params,
             self._cache,
             jnp.asarray(self._tokens),
@@ -1471,6 +1554,9 @@ class DecodeEngine:
             jnp.asarray(tok_idx),
             jnp.asarray(self._bias_ids),
             jnp.asarray(self._bias_vals),
+            self._counts,
+            jnp.asarray(self._pres),
+            jnp.asarray(self._freq),
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch
         toks_host = packed_host[:h]               # [h, B]
@@ -1562,6 +1648,8 @@ class DecodeEngine:
         self.params = None
         self._prefill_fns.clear()
         self._decode_fn = None
+        self._counts = None
+        self._zero_counts_fn = None
         self._dcache = None
         if self.draft_model is not None:
             self.draft_params = None

@@ -73,6 +73,10 @@ def translate_request(body: Dict[str, Any],
             payload["top_k"] = int(body["top_k"])
         if "seed" in body:
             payload["seed"] = int(body["seed"])
+        if "presence_penalty" in body:
+            payload["presence_penalty"] = float(body["presence_penalty"])
+        if "frequency_penalty" in body:
+            payload["frequency_penalty"] = float(body["frequency_penalty"])
         if "stop" in body:  # token ids, per the module contract
             stop = body["stop"]
             if not isinstance(stop, (list, tuple)):
