@@ -400,6 +400,7 @@ class DecodeEngine:
         # Per-slot sampling params (temperature 0 == greedy).
         self._temps = np.zeros((num_slots,), dtype=np.float32)
         self._topk = np.zeros((num_slots,), dtype=np.int32)
+        self._topp = np.ones((num_slots,), dtype=np.float32)
         self._seeds = np.zeros((num_slots,), dtype=np.int32)
         # Per-slot presence/frequency penalties over GENERATED tokens
         # (repetition control; the prompt is not counted — documented
@@ -535,7 +536,7 @@ class DecodeEngine:
         )
 
     def _sample_tokens(self, logits, temps, topk, seeds, tok_idx,
-                       bias_ids=None, bias_vals=None):
+                       bias_ids=None, bias_vals=None, topp=None):
         """In-program per-request sampling: temperature 0 → greedy argmax;
         otherwise top-k-masked categorical, keyed by (base_seed, request
         seed, TOKEN INDEX within the request) — so a request's stream is
@@ -557,8 +558,11 @@ class DecodeEngine:
             return self._sample_custom(logits).astype(jnp.int32)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+        if topp is None:
+            topp = jnp.ones(logits.shape[:1], jnp.float32)
+
         def draw(args):
-            lg, tm, tk, sd, ti = args
+            lg, tm, tk, tp, sd, ti = args
             V = lg.shape[-1]
             # top-k mask (k<=0 means no truncation)
             k_eff = jnp.where(tk > 0, jnp.minimum(tk, V), V)
@@ -568,6 +572,25 @@ class DecodeEngine:
             )
             masked = jnp.where(lg < kth, -jnp.inf, lg)
             scaled = masked / jnp.maximum(tm, 1e-6)[:, None]
+            # top-p (nucleus): keep the smallest prefix of the sorted
+            # distribution whose mass reaches p; the cutoff token itself
+            # stays (cum - prob < p). p >= 1 or <= 0 disables. The sorted
+            # view derives from the top-k sort above (mask + positive
+            # scale are monotone) — no second full-vocab sort.
+            p_eff = jnp.where((tp > 0.0) & (tp < 1.0), tp, 1.0)[:, None]
+            ranks = jnp.arange(V)[None, :]
+            sorted_scaled = jnp.where(
+                ranks < k_eff[:, None], sorted_desc, -jnp.inf
+            ) / jnp.maximum(tm, 1e-6)[:, None]
+            probs = jax.nn.softmax(sorted_scaled, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = (cum - probs) < p_eff
+            # Smallest KEPT logit value = the nucleus threshold per row.
+            kept_min = jnp.min(
+                jnp.where(keep_sorted, sorted_scaled, jnp.inf), axis=-1,
+                keepdims=True,
+            )
+            scaled = jnp.where(scaled < kept_min, -jnp.inf, scaled)
             base = jax.random.PRNGKey(self.base_seed)
 
             def one(seed, idx, row):
@@ -580,12 +603,13 @@ class DecodeEngine:
             jnp.any(temps > 0.0),
             draw,
             lambda args: greedy,
-            (logits, temps, topk, seeds, tok_idx),
+            (logits, temps, topk, topp, seeds, tok_idx),
         )
         return jnp.where(temps > 0.0, sampled, greedy)
 
     def _prefill_impl(self, params, tokens, attn_mask, cache, slots,
-                      temps, topk, seeds, tok_idx, bias_ids, bias_vals):
+                      temps, topk, seeds, tok_idx, bias_ids, bias_vals,
+                      topp):
         """``nB`` prompts → cache rows at ``slots`` + first sampled tokens.
 
         tokens/attn_mask are [nB, T]; ``slots`` is a traced [nB] int32
@@ -603,13 +627,14 @@ class DecodeEngine:
         )
         cache = copy_rows_into(cache, rows, slots)
         first = self._sample_tokens(
-            last_logits, temps, topk, seeds, tok_idx, bias_ids, bias_vals
+            last_logits, temps, topk, seeds, tok_idx, bias_ids, bias_vals,
+            topp,
         )  # [nB]
         return first, cache
 
     def _decode_impl(self, params, cache, tokens, active, horizon: int,
                      temps, topk, seeds, tok_idx0, bias_ids, bias_vals,
-                     counts, pres, freq):
+                     counts, pres, freq, topp):
         """``horizon`` chained decode steps in one program (one host sync).
 
         Rows already at capacity produce garbage logits (decode_step masks
@@ -644,7 +669,8 @@ class DecodeEngine:
                 + freq[:, None] * counts.astype(jnp.float32)
             )
             nxt = self._sample_tokens(logits, temps, topk, seeds,
-                                      tok_idx0 + j, bias_ids, bias_vals)
+                                      tok_idx0 + j, bias_ids, bias_vals,
+                                      topp)
             nxt = jnp.where(advanced, nxt, tokens[:, 0])
             counts = counts.at[rows, nxt].add(advanced.astype(jnp.int32))
             return (cache, nxt[:, None], counts), (nxt, advanced)
@@ -801,6 +827,7 @@ class DecodeEngine:
                     jnp.zeros((g,), jnp.int32),
                     jnp.zeros((g, self.max_bias_entries), jnp.int32),
                     jnp.zeros((g, self.max_bias_entries), jnp.float32),
+                    jnp.ones((g,), jnp.float32),
                 )
                 first.block_until_ready()
         for h in {1, self.ttft_horizon, self.decode_horizon}:
@@ -819,6 +846,7 @@ class DecodeEngine:
                 self._counts,
                 jnp.zeros((self.num_slots,), jnp.float32),
                 jnp.zeros((self.num_slots,), jnp.float32),
+                jnp.ones((self.num_slots,), jnp.float32),
             )
             packed.block_until_ready()
         if self._dcache is not None:
@@ -912,6 +940,7 @@ class DecodeEngine:
             "logit_bias": {},     # token id -> additive logit bias
             "presence_penalty": 0.0,   # subtract once per distinct token
             "frequency_penalty": 0.0,  # subtract per emission
+            "top_p": 1.0,              # nucleus sampling (1.0 = off)
         }
         if isinstance(req.payload, dict):
             p = req.payload
@@ -924,6 +953,7 @@ class DecodeEngine:
                 )
                 opts["temperature"] = float(p.get("temperature", 0.0))
                 opts["top_k"] = int(p.get("top_k", 0))
+                opts["top_p"] = float(p.get("top_p", 1.0))
                 opts["presence_penalty"] = float(
                     p.get("presence_penalty", 0.0)
                 )
@@ -972,6 +1002,14 @@ class DecodeEngine:
                     f"{req.request_id}: logit-bias token id out of vocab"
                 )
             opts["logit_bias"] = bias
+            if not 0.0 <= opts["top_p"] <= 1.0:
+                raise BadRequest(
+                    f"{req.request_id}: top_p must be in [0, 1]"
+                )
+            if opts["top_p"] == 0.0:
+                # OpenAI's wire shape allows 0 (near-deterministic): the
+                # smallest non-empty nucleus is the argmax alone.
+                opts["top_p"] = 1e-9
             if opts["temperature"] < 0.0:
                 raise BadRequest(
                     f"{req.request_id}: temperature must be >= 0"
@@ -1090,6 +1128,7 @@ class DecodeEngine:
         slots = np.zeros((group,), dtype=np.int32)
         temps = np.zeros((group,), dtype=np.float32)
         topk = np.zeros((group,), dtype=np.int32)
+        topp = np.ones((group,), dtype=np.float32)
         seeds = np.zeros((group,), dtype=np.int32)
         bias_ids = np.zeros((group, self.max_bias_entries), dtype=np.int32)
         bias_vals = np.zeros((group, self.max_bias_entries),
@@ -1100,6 +1139,7 @@ class DecodeEngine:
             slots[i] = slot_ids[i]
             temps[i] = opts["temperature"]
             topk[i] = opts["top_k"]
+            topp[i] = opts.get("top_p", 1.0)
             seeds[i] = opts["seed"]
             bias_ids[i], bias_vals[i] = self._bias_arrays(opts)
         # Pad rows duplicate row 0 (same slot, same data — idempotent write).
@@ -1109,6 +1149,7 @@ class DecodeEngine:
             slots[i] = slots[0]
             temps[i] = temps[0]
             topk[i] = topk[0]
+            topp[i] = topp[0]
             seeds[i] = seeds[0]
             bias_ids[i] = bias_ids[0]
             bias_vals[i] = bias_vals[0]
@@ -1125,6 +1166,7 @@ class DecodeEngine:
             jnp.zeros((group,), jnp.int32),  # prefill samples token 0
             jnp.asarray(bias_ids),
             jnp.asarray(bias_vals),
+            jnp.asarray(topp),
         )
         if self._dcache is not None:
             # The draft must see the same prompt: fill its cache rows too.
@@ -1148,7 +1190,8 @@ class DecodeEngine:
         )
 
     def _commit_long_impl(self, cache, row_cache, slot, last_logits,
-                          temps, topk, seeds, tok_idx, bias_ids, bias_vals):
+                          temps, topk, seeds, tok_idx, bias_ids, bias_vals,
+                          topp):
         """Copy the finished row cache into the big cache at ``slot`` and
         sample the first token — one dispatch closes the admission. The row
         cache is a whole number of chunks, so it can be LONGER than the
@@ -1156,7 +1199,7 @@ class DecodeEngine:
         past ``lengths`` are garbage either way and never attended)."""
         cache = commit_row(cache, row_cache, slot)
         first = self._sample_tokens(last_logits, temps, topk, seeds, tok_idx,
-                                    bias_ids, bias_vals)
+                                    bias_ids, bias_vals, topp)
         return first, cache
 
     def _seed_prefix_impl(self, row_cache, pk, pv):
@@ -1228,6 +1271,7 @@ class DecodeEngine:
             jnp.zeros((1,), jnp.int32),
             jnp.asarray(bids[None]),
             jnp.asarray(bvals[None]),
+            jnp.asarray([opts["top_p"]], np.float32),
         )
         if self._dcache is not None:
             self._draft_long_fill(prompt, slot_idx, C)
@@ -1374,6 +1418,7 @@ class DecodeEngine:
         self._active_mask[slot_idx] = True
         self._temps[slot_idx] = opts["temperature"]
         self._topk[slot_idx] = opts["top_k"]
+        self._topp[slot_idx] = opts.get("top_p", 1.0)
         self._seeds[slot_idx] = opts["seed"]
         self._bias_ids[slot_idx], self._bias_vals[slot_idx] = \
             self._bias_arrays(opts)
@@ -1449,6 +1494,7 @@ class DecodeEngine:
         self._active_mask[slot_idx] = False
         self._temps[slot_idx] = 0.0
         self._topk[slot_idx] = 0
+        self._topp[slot_idx] = 1.0
         self._seeds[slot_idx] = 0
         self._bias_ids[slot_idx] = 0
         self._bias_vals[slot_idx] = 0.0
@@ -1557,6 +1603,7 @@ class DecodeEngine:
             self._counts,
             jnp.asarray(self._pres),
             jnp.asarray(self._freq),
+            jnp.asarray(self._topp),
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch
         toks_host = packed_host[:h]               # [h, B]

@@ -71,6 +71,8 @@ def translate_request(body: Dict[str, Any],
             payload["temperature"] = float(body["temperature"])
         if "top_k" in body:
             payload["top_k"] = int(body["top_k"])
+        if "top_p" in body:
+            payload["top_p"] = float(body["top_p"])
         if "seed" in body:
             payload["seed"] = int(body["seed"])
         if "presence_penalty" in body:

@@ -251,6 +251,63 @@ class TestLogitBias:
             req2.future.result(timeout=5)
 
 
+class TestTopP:
+    def test_tiny_nucleus_collapses_to_greedy(self, lm):
+        """top_p -> 0 keeps only the argmax in the nucleus: sampled output
+        must equal greedy despite temperature > 0."""
+        plain, q0 = make_engine(lm)
+        base = submit(q0, [5, 9, 2, 7], max_new_tokens=6)
+        plain.run_until_idle()
+        greedy = base.future.result(timeout=5).tokens
+        engine, queue = make_engine(lm)
+        r = submit(queue, [5, 9, 2, 7], max_new_tokens=6,
+                   temperature=1.5, top_p=1e-6, seed=3)
+        engine.run_until_idle()
+        assert r.future.result(timeout=5).tokens == greedy
+
+    def test_top_p_reproducible_and_diverse(self, lm):
+        """Same seed + same top_p -> identical stream; a wide nucleus with
+        high temperature must actually SAMPLE (differ from greedy for at
+        least one seed, or the nucleus collapsed)."""
+        plain, q0 = make_engine(lm)
+        base = submit(q0, [1, 2, 3], max_new_tokens=8)
+        plain.run_until_idle()
+        greedy = base.future.result(timeout=5).tokens
+        outs = []
+        for seed in (11, 11, 12, 13):
+            engine, queue = make_engine(lm)
+            r = submit(queue, [1, 2, 3], max_new_tokens=8,
+                       temperature=1.5, top_p=0.95, seed=seed)
+            engine.run_until_idle()
+            outs.append(r.future.result(timeout=5).tokens)
+        assert outs[0] == outs[1]                       # reproducible
+        assert any(o != greedy for o in outs)           # actually samples
+
+    def test_top_p_zero_is_near_deterministic(self, lm):
+        """OpenAI's wire shape allows top_p=0: the nucleus collapses to
+        the argmax, so output equals greedy even at high temperature."""
+        plain, q0 = make_engine(lm)
+        base = submit(q0, [5, 9, 2, 7], max_new_tokens=6)
+        plain.run_until_idle()
+        greedy = base.future.result(timeout=5).tokens
+        engine, queue = make_engine(lm)
+        r = submit(queue, [5, 9, 2, 7], max_new_tokens=6,
+                   temperature=2.0, top_p=0.0, seed=5)
+        engine.run_until_idle()
+        assert r.future.result(timeout=5).tokens == greedy
+
+    def test_top_p_validation(self, lm):
+        engine, queue = make_engine(lm)
+        req = submit(queue, [1, 2], top_p=1.5)
+        engine._admit()
+        with pytest.raises(ValueError, match="top_p"):
+            req.future.result(timeout=5)
+        req2 = submit(queue, [1, 2], top_p=-0.1)
+        engine._admit()
+        with pytest.raises(ValueError, match="top_p"):
+            req2.future.result(timeout=5)
+
+
 class TestPenalties:
     def test_frequency_penalty_breaks_repetition(self, lm):
         """Greedy llama_tiny repeats; a frequency penalty must force
