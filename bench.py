@@ -312,9 +312,13 @@ def main() -> dict:
             "vs_baseline": 0.0,
             "error": err,
             "note": (
-                "accelerator tunnel unreachable at bench time; last "
-                "measured on-chip: 1693 tok/s/chip (gpt2_medium, 64 "
-                "slots), resnet50 11253 samples/s — see README.md"
+                "accelerator tunnel unreachable at bench time (relay "
+                "listed devices but never executed an op this round); "
+                "last measured on-chip: 1693 tok/s/chip (gpt2_medium, 64 "
+                "slots), TTFT p50 197 ms, resnet50 11253 samples/s — and "
+                "TTFT was measured BEFORE the three-tier decode horizon "
+                "landed (admission now waits <= ttft_horizon substeps "
+                "instead of the full scan) — see README.md"
             ),
         }
     llm = bench_llm_serving(
