@@ -494,6 +494,12 @@ class DecodeEngine:
             return counts.at[slot, first_tok].set(1)
 
         self._zero_counts_fn = jax.jit(_reset_counts, donate_argnums=(0,))
+        # Device copies of the per-slot sampling arrays: they change only
+        # at admission/finish, but _step dispatches every few ms — without
+        # the cache every dispatch re-uploads seven small host arrays
+        # (temps/topk/topp/seeds/bias/pres/freq), pure per-step overhead
+        # on a tunneled chip.
+        self._sampling_dev = None
         self._thread: Optional[threading.Thread] = None
         self._run = threading.Event()
         self.steps = 0
@@ -1424,6 +1430,7 @@ class DecodeEngine:
             self._bias_arrays(opts)
         self._pres[slot_idx] = opts.get("presence_penalty", 0.0)
         self._freq[slot_idx] = opts.get("frequency_penalty", 0.0)
+        self._sampling_dev = None  # host arrays changed
         if self._pres[slot_idx] or self._freq[slot_idx]:
             # Stale counts only matter to rows that USE them: zero the
             # reused slot's row on demand (penalty-free admissions — the
@@ -1500,6 +1507,7 @@ class DecodeEngine:
         self._bias_vals[slot_idx] = 0.0
         self._pres[slot_idx] = 0.0
         self._freq[slot_idx] = 0.0
+        self._sampling_dev = None  # host arrays changed
         self.completed += 1
 
     def _pick_horizon(self) -> int:
@@ -1515,6 +1523,20 @@ class DecodeEngine:
         if len(self.queue) == 0:
             return self.ttft_horizon
         return 1
+
+    def _sampling_arrays(self):
+        if self._sampling_dev is None:
+            self._sampling_dev = (
+                jnp.asarray(self._temps),
+                jnp.asarray(self._topk),
+                jnp.asarray(self._topp),
+                jnp.asarray(self._seeds),
+                jnp.asarray(self._bias_ids),
+                jnp.asarray(self._bias_vals),
+                jnp.asarray(self._pres),
+                jnp.asarray(self._freq),
+            )
+        return self._sampling_dev
 
     def _use_spec(self) -> bool:
         """Speculative rounds serve all-greedy batches only: sampled rows
@@ -1535,14 +1557,16 @@ class DecodeEngine:
 
     def _spec_step(self) -> None:
         k = self.spec_tokens
+        (_t, _k, _p, _s, bias_ids_d, bias_vals_d, _pr, _fr) = \
+            self._sampling_arrays()
         packed, self._cache, self._dcache = self._spec_fn(
             self.params,
             self._cache,
             self._dcache,
             jnp.asarray(self._tokens),
             jnp.asarray(self._active_mask),
-            jnp.asarray(self._bias_ids),
-            jnp.asarray(self._bias_vals),
+            bias_ids_d,
+            bias_vals_d,
         )
         ph = np.asarray(packed)  # ONE fetch per round
         out = ph[: k + 1]        # [k+1, B]
@@ -1588,22 +1612,24 @@ class DecodeEngine:
         )
         prev_tokens = self._tokens.copy()  # draft catch-up window head
         active_at_dispatch = self._active_mask.copy()
+        (temps_d, topk_d, topp_d, seeds_d, bias_ids_d, bias_vals_d,
+         pres_d, freq_d) = self._sampling_arrays()
         packed, self._cache, self._counts = self._decode_fn(
             self.params,
             self._cache,
             jnp.asarray(self._tokens),
             jnp.asarray(active_at_dispatch),
             h,
-            jnp.asarray(self._temps),
-            jnp.asarray(self._topk),
-            jnp.asarray(self._seeds),
+            temps_d,
+            topk_d,
+            seeds_d,
             jnp.asarray(tok_idx),
-            jnp.asarray(self._bias_ids),
-            jnp.asarray(self._bias_vals),
+            bias_ids_d,
+            bias_vals_d,
             self._counts,
-            jnp.asarray(self._pres),
-            jnp.asarray(self._freq),
-            jnp.asarray(self._topp),
+            pres_d,
+            freq_d,
+            topp_d,
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch
         toks_host = packed_host[:h]               # [h, B]
@@ -1697,6 +1723,7 @@ class DecodeEngine:
         self._decode_fn = None
         self._counts = None
         self._zero_counts_fn = None
+        self._sampling_dev = None
         self._dcache = None
         if self.draft_model is not None:
             self.draft_params = None

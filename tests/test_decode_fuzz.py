@@ -45,10 +45,8 @@ def test_feature_matrix_fuzz(lm):
         draft_model=model, draft_params=params,
         prefix_cache_size=4, session_cache_size=4,
     )
-    ref_engine, ref_queue = None, None
-
     def make_payload(i):
-        kind = rng.integers(0, 6)
+        kind = rng.integers(0, 7)
         L = int(rng.integers(2, 7))
         if kind == 4:  # long prompt (chunked admission)
             L = int(rng.integers(20, 40))
@@ -66,6 +64,9 @@ def test_feature_matrix_fuzz(lm):
             payload.update(frequency_penalty=float(rng.uniform(0.5, 5.0)))
         elif kind == 5:  # session turns
             payload.update(session_id=f"fuzz-{int(rng.integers(0, 3))}")
+        elif kind == 6:  # stop tokens (may or may not trigger)
+            payload.update(stop_token_ids=rng.integers(
+                1, 50, size=2).tolist())
         return payload
 
     submitted = []
