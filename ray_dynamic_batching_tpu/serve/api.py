@@ -272,6 +272,13 @@ class Deployment:
             def per_request(payloads: List[Any]) -> List[Any]:
                 return [call(p) for p in payloads]
 
+            # The replica's user_config hook looks for `reconfigure` on
+            # the callable; surface the instance's through the wrapper.
+            instance_hook = getattr(
+                getattr(call, "__self__", None), "reconfigure", None
+            )
+            if callable(instance_hook):
+                per_request.reconfigure = instance_hook
             return per_request
 
         return factory

@@ -163,6 +163,11 @@ class ServeController:
                 )
                 self._deployments[config.name] = state
             else:
+                # Deliver user_config only when it CHANGED (including a
+                # change TO {} — clearing must reach the hook): the user's
+                # reconfigure can be expensive (weight reloads) and must
+                # not re-run because an unrelated knob moved.
+                prev_user = state.config.user_config
                 state.config = config
                 state.restarts = 0  # a fresh deploy resets the budget
                 state.unhealthy = False
@@ -174,6 +179,10 @@ class ServeController:
                         max_batch_size=config.max_batch_size,
                         batch_wait_timeout_s=config.batch_wait_timeout_s,
                         max_ongoing_requests=config.max_ongoing_requests,
+                        user_config=(
+                            config.user_config
+                            if config.user_config != prev_user else None
+                        ),
                     )
             if config.autoscaling is not None:
                 state.policy = AutoscalingPolicy(
@@ -253,6 +262,11 @@ class ServeController:
                 replica.max_multiplexed_models = cfg.max_multiplexed_models
                 if devices is not None:
                     replica.devices = devices
+            if cfg.user_config:
+                # Initial user_config applies BEFORE serving, for every
+                # replica kind (ref: reconfigure runs before the replica
+                # serves) — not just the plain-Replica branch.
+                replica.reconfigure(user_config=cfg.user_config)
             replica.start()
         except Exception:
             if pg is not None:  # failed start must not leak reserved chips

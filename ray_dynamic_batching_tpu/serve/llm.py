@@ -213,9 +213,12 @@ class LLMReplica(Replica):
         max_batch_size: Optional[int] = None,
         batch_wait_timeout_s: Optional[float] = None,
         max_ongoing_requests: Optional[int] = None,
+        user_config: Optional[dict] = None,
     ) -> None:
         # Slot count / buckets are compile-shape decisions and can't change
-        # on a live engine; only admission-side knobs apply.
+        # on a live engine; only admission-side knobs apply. user_config is
+        # accepted for base-contract compatibility (the controller passes
+        # it to every replica kind) but has no user callable to deliver to.
         if max_ongoing_requests is not None:
             self.max_ongoing_requests = max_ongoing_requests
             for q in self._queues.values():

@@ -311,9 +311,15 @@ class Replica:
         max_batch_size: Optional[int] = None,
         batch_wait_timeout_s: Optional[float] = None,
         max_ongoing_requests: Optional[int] = None,
+        user_config: Optional[dict] = None,
     ) -> None:
         """Apply new batching/concurrency knobs to a RUNNING replica (the
-        runtime-tunable contract of ``@serve.batch``, batching.py:369-386)."""
+        runtime-tunable contract of ``@serve.batch``, batching.py:369-386).
+        ``user_config`` flows to the USER callable's own ``reconfigure``
+        hook when it has one (ref: replicas call the user class's
+        reconfigure on deploy-time user_config updates, replica.py:810
+        UserCallableWrapper) — looked up on the callable, then on the
+        bound instance behind it."""
         if max_batch_size is not None:
             self.policy.set_max_batch_size(max_batch_size)
         if batch_wait_timeout_s is not None:
@@ -321,6 +327,13 @@ class Replica:
         if max_ongoing_requests is not None:
             self.max_ongoing_requests = max_ongoing_requests
             self.queue.max_len = max_ongoing_requests
+        if user_config is not None:
+            hook = getattr(self.fn, "reconfigure", None)
+            if hook is None:
+                target = getattr(self.fn, "__self__", None)
+                hook = getattr(target, "reconfigure", None)
+            if callable(hook):
+                hook(user_config)
 
     def stats(self) -> dict:
         s = self.queue.stats()

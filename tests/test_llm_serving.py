@@ -188,6 +188,19 @@ class TestLLMDeployment:
         finally:
             controller.shutdown()
 
+    def test_redeploy_reconfigures_running_llm_replica(self, llm_stack):
+        """Redeploying an LLM deployment must reconfigure live replicas
+        (base-contract kwargs incl. user_config) without a TypeError."""
+        controller, handle = llm_stack
+        router = controller.deploy(
+            DeploymentConfig(name="llama_tiny", max_ongoing_requests=128,
+                             user_config={"note": "redeploy"}),
+        )
+        replica = router.replicas()[0]
+        assert replica.max_ongoing_requests == 128
+        out = handle.remote({"tokens": [1, 2, 3], "max_new_tokens": 3})
+        assert len(out.result(timeout=60).tokens) == 3
+
     def test_controller_status_reports_engine(self, llm_stack):
         controller, _ = llm_stack
         status = controller.status()["llama_tiny"]

@@ -106,6 +106,52 @@ class TestDeploymentDecorator:
             serve.run(stream.bind(), controller=controller)
 
 
+class TestUserConfigReconfigure:
+    def test_user_config_reaches_callable_on_start_and_redeploy(
+        self, controller
+    ):
+        """The reference contract: the user class's reconfigure(user_config)
+        runs at replica start and again on deploy-time updates — even for
+        per-request callables behind the batch adapter."""
+        seen = []
+
+        @serve.deployment(name="cfgd", user_config={"scale": 2})
+        class Scaled:
+            def __init__(self):
+                self.scale = 1
+
+            def reconfigure(self, cfg):
+                seen.append(dict(cfg))
+                self.scale = cfg.get("scale", self.scale)
+
+            def __call__(self, x):
+                return x * self.scale
+
+        handle = serve.run(Scaled.bind(), controller=controller)
+        assert handle.remote(10).result(timeout=10) == 20  # startup config
+        assert seen == [{"scale": 2}]
+        serve.run(
+            Scaled.options(user_config={"scale": 5}).bind(),
+            controller=controller,
+        )
+        assert {"scale": 5} in seen  # live update, no replica restart
+        assert handle.remote(10).result(timeout=10) == 50
+        # Redeploy with UNCHANGED user_config: the (possibly expensive)
+        # user hook must not re-run for an unrelated knob change.
+        n_calls = len(seen)
+        serve.run(
+            Scaled.options(user_config={"scale": 5},
+                           max_ongoing_requests=64).bind(),
+            controller=controller,
+        )
+        assert len(seen) == n_calls
+        # Clearing TO {} must reach the hook (change, not truthiness).
+        serve.run(
+            Scaled.options(user_config={}).bind(), controller=controller
+        )
+        assert seen[-1] == {}
+
+
 class TestMultiplexed:
     def test_lru_bound_and_release_hook(self):
         loads, releases = [], []
