@@ -351,11 +351,17 @@ def main() -> dict:
     except Exception as e:  # noqa: BLE001 — ASR must not kill the bench
         _log(f"asr failed entirely: {e}")
         asr = {"error": str(e)}
+    import jax
+
     return {
         "metric": "llm_tok_s_per_chip",
         "value": llm["tok_s_per_chip"],
         "unit": "tok/s",
         "vs_baseline": round(llm["tok_s_per_chip"] / NORTH_STAR_TOK_S, 3),
+        # Which backend actually produced these numbers: consumers (the
+        # relay watchdog, the judge) must be able to tell an on-chip record
+        # from a CPU smoke run without trusting the directory it landed in.
+        "backend": jax.default_backend(),
         "ttft_p50_ms": llm["ttft_p50_ms"],
         "ttft_p99_ms": llm["ttft_p99_ms"],
         "llm": llm,

@@ -1,0 +1,335 @@
+"""Relay watchdog: capture on-chip artifacts the moment the TPU answers.
+
+The axon accelerator tunnel comes and goes (it has died mid-round in two of
+three rounds, zeroing BENCH_r0N.json). This watchdog removes the "builder
+must be watching when the relay is up" failure mode, mirroring the
+reference's committed-measured-ground-truth practice
+(``293-project/profiling/*_summary.csv`` consumed at
+``293-project/src/scheduler.py:1019-1041``): it loops a bounded-subprocess
+real-op probe (``jax.devices()`` HANGS, not fails, on a dead tunnel — only
+a real op with a hard timeout proves liveness), and the moment the relay
+answers it runs the full capture suite, committing records into
+``profiles/tpu_v5e/`` after every successful step:
+
+1. ``bench.py``                 -> ``profiles/tpu_v5e/bench_<ts>.json``
+2. ``tools/run_profiles.py``    -> ``profiles/tpu_v5e/*_summary.csv`` etc.
+3. ``tools/run_slo_demo.py``    -> ``profiles/tpu_v5e/slo_demo.json``
+
+Guard rails (each one a way a dead-or-flapping relay could otherwise
+poison the committed ground truth):
+
+- Every step re-verifies the BACKEND of the subprocess that produced its
+  output — a fresh JAX init can silently come up on CPU when the relay
+  drops between probe and step, and CPU float timings committed as
+  tpu_v5e tables would mislead every consumer of the CSVs
+  (``tools/common.py`` documents this hazard).
+- Commits are pathspec-scoped to ``profiles/tpu_v5e`` so a builder's
+  concurrently staged files are never swept into an artifact commit.
+- Logs, status, and failed-attempt records live OUTSIDE the repo
+  (``/tmp/tpu_watchdog``); only verified artifacts are committed.
+- Per-step attempt cap: a step failing deterministically while the relay
+  is alive (a code bug, not a relay flap) is retried a few times, then
+  abandoned instead of burning relay uptime forever.
+
+Steps that succeed are not re-attempted; the watchdog exits once every
+step has either landed (rc 0) or been given up (rc 1).
+
+Usage: python tools/tpu_watchdog.py [--interval 300] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "profiles", "tpu_v5e")
+STATE_DIR = os.environ.get("RDB_WATCHDOG_DIR", "/tmp/tpu_watchdog")
+STATUS_PATH = os.path.join(STATE_DIR, "status.json")
+LOG_PATH = os.path.join(STATE_DIR, "watchdog.log")
+
+PROBE_TIMEOUT_S = 180.0      # first on-chip compile can take ~40s
+BENCH_TIMEOUT_S = 45 * 60.0
+PROFILES_TIMEOUT_S = 60 * 60.0
+SLO_TIMEOUT_S = 30 * 60.0
+MAX_ATTEMPTS = 4             # per step, while the relay is alive
+
+# A matmul plus a HOST FETCH (block_until_ready alone returns early on the
+# tunnel; only a fetch observes completion), printing the backend that ran.
+PROBE_CODE = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((256, 256));"
+    "v = float((x @ x).sum());"
+    "assert abs(v - 256.0 ** 3) < 1e3, v;"
+    "print('probe ok', jax.default_backend())"
+)
+
+
+def _now() -> str:
+    return datetime.datetime.now().strftime("%Y%m%dT%H%M%S")
+
+
+def _log(msg: str) -> None:
+    line = f"[{_now()}] {msg}"
+    print(line, flush=True)
+    try:
+        os.makedirs(STATE_DIR, exist_ok=True)
+        with open(LOG_PATH, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+def _write_status(status: dict) -> None:
+    status["updated"] = _now()
+    try:
+        os.makedirs(STATE_DIR, exist_ok=True)
+        with open(STATUS_PATH, "w") as f:
+            json.dump(status, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass  # status is best-effort; a full /tmp must not end the vigil
+
+
+def _save_failure(name: str, payload: dict) -> None:
+    fail_dir = os.path.join(STATE_DIR, "failures")
+    os.makedirs(fail_dir, exist_ok=True)
+    with open(os.path.join(fail_dir, f"{name}_{_now()}.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+
+
+def probe(timeout_s: float = PROBE_TIMEOUT_S) -> bool:
+    """True iff a real op executed on a non-CPU backend within the bound."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    except Exception as exc:  # noqa: BLE001
+        _log(f"probe error: {exc!r}")
+        return False
+    out = proc.stdout.strip()
+    if proc.returncode != 0:
+        _log(f"probe rc={proc.returncode}: {proc.stderr.strip()[-200:]}")
+        return False
+    if "probe ok cpu" in out:
+        _log("probe answered but backend is cpu — not the chip; waiting")
+        return False
+    return "probe ok" in out
+
+
+def git_commit(message: str, retries: int = 5) -> bool:
+    """Commit ONLY profiles/tpu_v5e (pathspec-scoped: a builder's staged
+    files must never ride along); retry on index-lock races."""
+    for attempt in range(retries):
+        add = subprocess.run(
+            ["git", "-C", REPO, "add", "profiles/tpu_v5e"],
+            capture_output=True, text=True,
+        )
+        if add.returncode == 0:
+            diff = subprocess.run(
+                ["git", "-C", REPO, "diff", "--cached", "--quiet", "--",
+                 "profiles/tpu_v5e"],
+                capture_output=True,
+            )
+            if diff.returncode == 0:
+                return True  # nothing new under the pathspec
+            commit = subprocess.run(
+                ["git", "-C", REPO, "commit", "-m", message,
+                 "-m", "No-Verification-Needed: generated benchmark/profile"
+                 " artifacts, no source change",
+                 "--", "profiles/tpu_v5e"],
+                capture_output=True, text=True,
+            )
+            if commit.returncode == 0:
+                _log(f"committed: {message}")
+                return True
+            _log(f"git commit failed: {commit.stderr.strip()[-200:]}")
+        time.sleep(3.0 * (attempt + 1))
+    return False
+
+
+def run_step(name: str, cmd: list, timeout_s: float) -> dict:
+    """Run one capture step as a bounded subprocess; returns the FULL
+    stdout/stderr (success detection parses stdout — truncating first
+    would corrupt long JSON records)."""
+    t0 = time.time()
+    _log(f"step {name}: {' '.join(cmd)}")
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+        )
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as exc:
+        rc = -1
+        out = (exc.stdout or b"").decode() if isinstance(
+            exc.stdout, bytes) else (exc.stdout or "")
+        err = f"timed out after {timeout_s:.0f}s"
+    took = time.time() - t0
+    _log(f"step {name}: rc={rc} in {took:.0f}s")
+    return {"name": name, "rc": rc, "seconds": round(took, 1),
+            "stdout": out, "stderr": err}
+
+
+def _on_chip(backend) -> bool:
+    return isinstance(backend, str) and backend not in ("", "cpu")
+
+
+def capture_bench() -> bool:
+    rec = run_step("bench", [sys.executable, "bench.py"], BENCH_TIMEOUT_S)
+    # bench.py prints ONE JSON line on stdout (the last parseable line).
+    parsed = None
+    for ln in reversed([ln for ln in rec["stdout"].splitlines() if ln.strip()]):
+        try:
+            candidate = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(candidate, dict):  # stray scalar lines are not records
+            parsed = candidate
+            break
+    ok = (rec["rc"] == 0 and parsed is not None
+          and not parsed.get("error") and parsed.get("value", 0) > 0
+          and _on_chip(parsed.get("backend")))
+    ts = _now()
+    if not ok:
+        _save_failure("bench", {
+            "rc": rec["rc"], "seconds": rec["seconds"], "record": parsed,
+            "stdout_tail": rec["stdout"][-2000:],
+            "stderr_tail": rec["stderr"][-1000:],
+        })
+        return False
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, f"bench_{ts}.json"), "w") as f:
+        json.dump({"captured": ts, "seconds": rec["seconds"],
+                   "record": parsed}, f, indent=1)
+        f.write("\n")
+    return git_commit(f"tpu_v5e: on-chip bench capture {ts} "
+                      f"({parsed.get('metric')}={parsed.get('value')})")
+
+
+def capture_profiles() -> bool:
+    rec = run_step(
+        "profiles",
+        [sys.executable, "tools/run_profiles.py", "profiles/tpu_v5e"],
+        PROFILES_TIMEOUT_S,
+    )
+    # run_profiles.py prints "backend=<name> devices=..." before sweeping.
+    backend = next(
+        (ln.split("backend=", 1)[1].split()[0]
+         for ln in rec["stdout"].splitlines() if "backend=" in ln),
+        None,
+    )
+    ok = (rec["rc"] == 0 and _on_chip(backend)
+          and os.path.exists(os.path.join(OUT_DIR, "resnet50_summary.csv")))
+    if not ok:
+        _save_failure("profiles", {
+            "rc": rec["rc"], "seconds": rec["seconds"], "backend": backend,
+            "stdout_tail": rec["stdout"][-2000:],
+            "stderr_tail": rec["stderr"][-1000:],
+        })
+        return False
+    return git_commit(f"tpu_v5e: committed on-chip profile tables {_now()}")
+
+
+def capture_slo_demo() -> bool:
+    rec = run_step(
+        "slo_demo",
+        [sys.executable, "tools/run_slo_demo.py", "profiles/tpu_v5e", "60"],
+        SLO_TIMEOUT_S,
+    )
+    record_path = os.path.join(OUT_DIR, "slo_demo.json")
+    backend = None
+    if os.path.exists(record_path):
+        try:
+            with open(record_path) as f:
+                backend = json.load(f).get("backend")
+        except (OSError, ValueError):
+            pass
+    ok = rec["rc"] in (0, 2) and _on_chip(backend)
+    if not ok:
+        _save_failure("slo_demo", {
+            "rc": rec["rc"], "seconds": rec["seconds"], "backend": backend,
+            "stdout_tail": rec["stdout"][-2000:],
+            "stderr_tail": rec["stderr"][-1000:],
+        })
+        return False
+    return git_commit(f"tpu_v5e: on-chip SLO demo record {_now()}")
+
+
+STEPS = [
+    ("bench", capture_bench),
+    ("profiles", capture_profiles),
+    ("slo_demo", capture_slo_demo),
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between probes while the relay is dead")
+    ap.add_argument("--once", action="store_true",
+                    help="single probe+capture attempt, then exit")
+    args = ap.parse_args()
+
+    done = {name: False for name, _ in STEPS}
+    attempts = {name: 0 for name, _ in STEPS}
+    probes = 0
+    _log(f"watchdog started (pid {os.getpid()})")
+
+    def pending(name: str) -> bool:
+        return not done[name] and attempts[name] < MAX_ATTEMPTS
+
+    def status(alive: bool, **extra) -> None:
+        _write_status({"alive": alive, "probes": probes, "steps_done": done,
+                       "attempts": attempts, "pid": os.getpid(), **extra})
+
+    while True:
+        probes += 1
+        alive = probe()
+        status(alive)
+        if alive:
+            _log("RELAY ALIVE — starting capture suite")
+            for name, fn in STEPS:
+                if not pending(name):
+                    continue
+                attempts[name] += 1
+                try:
+                    done[name] = fn()
+                except Exception as exc:  # noqa: BLE001 — an unattended
+                    # vigil must outlive any single step's surprise
+                    _log(f"step {name}: unexpected error {exc!r}")
+                    _save_failure(name, {"error": repr(exc)})
+                    done[name] = False
+                status(True)
+                if not done[name]:
+                    if attempts[name] >= MAX_ATTEMPTS:
+                        _log(f"step {name}: giving up after "
+                             f"{attempts[name]} attempts")
+                    if not probe(60.0):
+                        _log("relay died mid-capture; back to probing")
+                        break
+            if all(done.values()):
+                status(True, complete=True)
+                _log("all captures complete; exiting")
+                return 0
+        if not any(pending(n) for n, _ in STEPS):
+            status(alive, gave_up=True)
+            _log("every remaining step exhausted its attempts; exiting")
+            return 1
+        if args.once:
+            return 0 if all(done.values()) else 1
+        # A step that failed while the relay stayed ALIVE gets retried after
+        # a short breather, not the full dead-relay interval: alive tunnel
+        # time is the scarce resource this tool exists to exploit.
+        time.sleep(15.0 if alive else args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
