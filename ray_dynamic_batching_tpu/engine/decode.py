@@ -44,6 +44,7 @@ generator batches, ``serve/batching.py:209-276``).
 
 from __future__ import annotations
 
+import collections
 import math
 import threading
 import time
@@ -80,6 +81,16 @@ PREFILLS_TOTAL = m.Counter(
 )
 TTFT_MS = m.Histogram(
     "rdb_decode_ttft_ms", "Time to first token", tag_keys=("model",)
+)
+TTFT_QUEUE_MS = m.Histogram(
+    "rdb_decode_ttft_queue_ms",
+    "Arrival->dequeue share of TTFT (includes waiting out in-flight scans)",
+    tag_keys=("model",),
+)
+TTFT_PREFILL_MS = m.Histogram(
+    "rdb_decode_ttft_prefill_ms",
+    "Dequeue->first-token share of TTFT",
+    tag_keys=("model",),
 )
 ACTIVE_SLOTS = m.Gauge(
     "rdb_decode_active_slots", "Slots currently decoding", tag_keys=("model",)
@@ -432,6 +443,16 @@ class DecodeEngine:
         self.ttft_horizon = min(max(1, int(ttft_horizon)),
                                 self.decode_horizon)
         self.max_admissions_per_step = max(1, int(max_admissions_per_step))
+        # TTFT decomposition: (queue_wait, scan_wait, prefill) per admission
+        # over a rolling window — queue_wait is arrival->dequeue (slot
+        # starvation + waiting out in-flight scans), scan_wait the portion
+        # of that spent inside the scan that was running at arrival, and
+        # prefill is dequeue->first token. Consumed by ttft_breakdown();
+        # the bench LLM row publishes it so an on-chip run shows where the
+        # TTFT milliseconds live (BASELINE.json north star: p50 < 150 ms).
+        self._scan_start_ms = 0.0
+        self._scan_end_ms = 0.0
+        self._ttft_parts: collections.deque = collections.deque(maxlen=1024)
         # Prompt-prefix KV reuse for chunked admissions (0 = off).
         self.prefix_cache: Optional[PrefixCache] = None
         if prefix_cache_size > 0 and self.prompt_buckets:
@@ -1053,6 +1074,11 @@ class DecodeEngine:
         if self._active_mask.any():
             free = free[: self.max_admissions_per_step]
         batch = self.queue.get_batch(len(free), discard_stale=True)
+        t_dequeue = now_ms()
+        for req in batch:
+            # Dequeue stamp for the TTFT decomposition; a slot-starved
+            # requeue gets re-stamped on its next (sticking) dequeue.
+            req.admit_ms = t_dequeue
         by_bucket: Dict[int, List[Tuple[Request, np.ndarray, Dict]]] = {}
         session_items: List[Tuple[Request, np.ndarray, Dict, Tuple]] = []
         for req in batch:
@@ -1444,6 +1470,19 @@ class DecodeEngine:
         if opts.get("_session_miss"):
             SESSION_MISSES.inc(tags={"model": self.model.name})
         TTFT_MS.observe(t - req.arrival_ms, tags={"model": self.model.name})
+        admit_ms = getattr(req, "admit_ms", None) or t
+        queue_wait = max(0.0, admit_ms - req.arrival_ms)
+        # The share of queue_wait spent inside the decode scan that was in
+        # flight when the request arrived: overlap of [arrival, dequeue]
+        # with the most recently completed scan window.
+        scan_wait = max(0.0, min(admit_ms, self._scan_end_ms)
+                        - max(req.arrival_ms, self._scan_start_ms))
+        prefill_ms = max(0.0, t - admit_ms)
+        self._ttft_parts.append(
+            (queue_wait, min(scan_wait, queue_wait), prefill_ms)
+        )
+        TTFT_QUEUE_MS.observe(queue_wait, tags={"model": self.model.name})
+        TTFT_PREFILL_MS.observe(prefill_ms, tags={"model": self.model.name})
         req.stream_put(first_tok)
         # First token may already satisfy the stop conditions.
         if self._is_stop(slot, first_tok) or max_new <= 1:
@@ -1524,6 +1563,31 @@ class DecodeEngine:
             return self.ttft_horizon
         return 1
 
+    def ttft_breakdown(self) -> Dict[str, float]:
+        """p50/p95 of the TTFT components over the rolling window:
+        ``queue_wait`` (arrival -> dequeue — slot starvation plus waiting
+        out in-flight scans), ``scan_wait`` (the in-flight-scan share of
+        queue_wait; bounded by ttft_horizon substeps while slots are free),
+        and ``prefill`` (dequeue -> first token). Published in the bench
+        LLM row so an on-chip run shows where the TTFT milliseconds live."""
+        parts = list(self._ttft_parts)
+        if not parts:
+            return {"n": 0}
+        out: Dict[str, float] = {"n": len(parts)}
+        for name, vals in zip(
+            ("queue_wait_ms", "scan_wait_ms", "prefill_ms"),
+            zip(*parts),
+        ):
+            s = sorted(vals)
+            out[f"{name}_p50"] = round(s[len(s) // 2], 2)
+            out[f"{name}_p95"] = round(s[min(len(s) - 1,
+                                             int(len(s) * 0.95))], 2)
+        return out
+
+    def reset_ttft_window(self) -> None:
+        """Drop the rolling TTFT window (benchmark phase boundaries)."""
+        self._ttft_parts.clear()
+
     def _sampling_arrays(self):
         if self._sampling_dev is None:
             self._sampling_dev = (
@@ -1559,6 +1623,7 @@ class DecodeEngine:
         k = self.spec_tokens
         (_t, _k, _p, _s, bias_ids_d, bias_vals_d, _pr, _fr) = \
             self._sampling_arrays()
+        self._scan_start_ms = now_ms()
         packed, self._cache, self._dcache = self._spec_fn(
             self.params,
             self._cache,
@@ -1569,6 +1634,7 @@ class DecodeEngine:
             bias_vals_d,
         )
         ph = np.asarray(packed)  # ONE fetch per round
+        self._scan_end_ms = now_ms()
         out = ph[: k + 1]        # [k+1, B]
         n_out = ph[k + 1]        # [B]
         lengths = ph[k + 2]      # [B]
@@ -1614,6 +1680,7 @@ class DecodeEngine:
         active_at_dispatch = self._active_mask.copy()
         (temps_d, topk_d, topp_d, seeds_d, bias_ids_d, bias_vals_d,
          pres_d, freq_d) = self._sampling_arrays()
+        self._scan_start_ms = now_ms()
         packed, self._cache, self._counts = self._decode_fn(
             self.params,
             self._cache,
@@ -1632,6 +1699,7 @@ class DecodeEngine:
             topp_d,
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch
+        self._scan_end_ms = now_ms()
         toks_host = packed_host[:h]               # [h, B]
         advanced_host = packed_host[h : 2 * h].astype(bool)   # [h, B]
         lengths_host = packed_host[2 * h]         # [B] (post-horizon)

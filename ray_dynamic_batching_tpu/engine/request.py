@@ -152,6 +152,10 @@ class Request:
     slo_ms: float
     request_id: str = ""
     arrival_ms: float = field(default_factory=now_ms)
+    # Stamped by the decode engine when the request is dequeued into a slot
+    # (TTFT = [arrival..admit: queue/scan wait] + [admit..first token:
+    # prefill]); None until an engine admits it.
+    admit_ms: Optional[float] = None
     seq_len: int = 0                  # shape bucket hint for LLM inputs
     future: Future = field(default_factory=Future)
     trace_ctx: Dict[str, Any] = field(default_factory=dict)
