@@ -185,16 +185,25 @@ class ModelProfiler:
     def write_outputs(self, profile: BatchProfile, out_dir: str) -> Tuple[str, str, str]:
         """Persist summary.csv / detailed.json / report.txt (reference contract,
         ``ModelProfiler.py:224-371``)."""
-        import os
+        return write_profile_outputs(profile, out_dir)
 
-        os.makedirs(out_dir, exist_ok=True)
-        base = os.path.join(out_dir, profile.model_name)
-        csv_path, json_path, report_path = (
-            base + "_summary.csv", base + "_detailed.json", base + "_report.txt",
-        )
-        profile.to_csv(csv_path)
-        with open(json_path, "w") as f:
-            f.write(profile.to_json())
-        with open(report_path, "w") as f:
-            f.write(profile.report())
-        return csv_path, json_path, report_path
+
+def write_profile_outputs(
+    profile: BatchProfile, out_dir: str
+) -> Tuple[str, str, str]:
+    """Shared writer for every profile family (forward-pass, decode,
+    prefill): summary.csv / detailed.json / report.txt keyed by the
+    profile's model_name."""
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    base = os.path.join(out_dir, profile.model_name)
+    csv_path, json_path, report_path = (
+        base + "_summary.csv", base + "_detailed.json", base + "_report.txt",
+    )
+    profile.to_csv(csv_path)
+    with open(json_path, "w") as f:
+        f.write(profile.to_json())
+    with open(report_path, "w") as f:
+        f.write(profile.report())
+    return csv_path, json_path, report_path

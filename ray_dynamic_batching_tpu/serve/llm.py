@@ -282,6 +282,9 @@ class LLMDeployment:
         checkpoint_dir: Optional[str] = None,
         checkpoint_step: Optional[int] = None,
         quantize_weights: bool = False,
+        profiles_dir: Optional[str] = None,
+        token_slo_ms: Optional[float] = None,
+        ttft_slo_ms: Optional[float] = None,
     ) -> None:
         self.model_name = model_name
         self.num_slots = num_slots
@@ -328,6 +331,17 @@ class LLMDeployment:
         self._dtype = dtype
         self._model = model
         self._params = params
+        # Measured-table control (ref nexus.py:129-296 — profiled-latency-
+        # driven planning): when ``profiles_dir`` holds committed
+        # ``<model>_decode_summary.csv`` / ``<model>_prefill_summary.csv``
+        # tables (tools/run_profiles.py --decode), single-chip engines
+        # derive num_slots (if not pinned), decode_horizon, and
+        # ttft_horizon from measurement + the token/TTFT SLOs instead of
+        # the analytic HBM model — see plan_from_tables.
+        self.profiles_dir = profiles_dir
+        self.token_slo_ms = token_slo_ms
+        self.ttft_slo_ms = ttft_slo_ms
+        self._table_plans: Dict[int, Dict[str, int]] = {}
         self._init_lock = threading.Lock()
 
     def _ensure_model(self) -> None:
@@ -442,6 +456,137 @@ class LLMDeployment:
         )
         return n
 
+    def plan_from_tables(
+        self,
+        decode_profile,
+        prefill_profile=None,
+        *,
+        max_len: Optional[int] = None,
+        token_slo_ms: Optional[float] = None,
+        ttft_slo_ms: Optional[float] = None,
+    ) -> Dict[str, int]:
+        """Derive (num_slots, decode_horizon, ttft_horizon) from MEASURED
+        decode tables + SLOs — the reference's profiled-latency control
+        theory (``293-project/src/nexus.py:129-296``: committed tables
+        drive admission/packing) applied to the decode phase, replacing
+        the analytic HBM model of :meth:`auto_num_slots`:
+
+        - **num_slots**: among measured (slots, capacity) configs whose
+          program fits the planner's HBM budget and whose per-substep
+          latency respects the token SLO, the one with the highest
+          full-occupancy token throughput.
+        - **decode_horizon**: tokens reach the host only at scan end, so a
+          full-batch scan of ``h`` substeps delivers bursts with gaps of
+          ``h x step_ms`` — the token-latency SLO bounds ``h``.
+        - **ttft_horizon**: an idle-queue arrival waits out at most one
+          ttft-tier scan, then prefills; the TTFT budget left after the
+          measured prefill latency (largest prompt bucket, group 1),
+          with 20% headroom for queue/dispatch, bounds the tier.
+        """
+        from ray_dynamic_batching_tpu.utils.config import get_config
+
+        cfg = get_config()
+        budget = cfg.hbm_budget_bytes * cfg.hbm_plan_fraction / max(
+            1, len(self.length_buckets)
+        )
+        max_len = max_len or self.max_len
+        token_slo_ms = token_slo_ms or self.token_slo_ms
+        ttft_slo_ms = ttft_slo_ms or self.ttft_slo_ms
+        rows = [
+            r for r in decode_profile.rows
+            if r.seq_len == max_len and 0 < r.hbm_bytes <= budget
+        ]
+        if token_slo_ms is not None:
+            fitting = [r for r in rows if r.latency_ms <= token_slo_ms]
+            if not fitting and rows:
+                # Nothing meets the SLO: serve with the fastest config
+                # rather than refusing (the SLO viewer will show red).
+                fitting = [min(rows, key=lambda r: r.latency_ms)]
+            rows = fitting
+        if not rows:
+            raise ValueError(
+                f"{self.model_name}: no measured decode config at "
+                f"capacity {max_len} fits the HBM budget "
+                f"({budget / 1e9:.1f} GB) — re-run the decode profiler"
+            )
+        best = max(rows, key=lambda r: r.batch_size / r.latency_ms)
+        step_ms = best.latency_ms
+        plan: Dict[str, int] = {"num_slots": int(best.batch_size)}
+        horizon = self.decode_horizon
+        if token_slo_ms is not None:
+            horizon = max(1, int(token_slo_ms // step_ms))
+            plan["decode_horizon"] = horizon
+        if ttft_slo_ms is not None:
+            prefill_ms = 0.0
+            if prefill_profile is not None and prefill_profile.rows:
+                largest = max(r.seq_len for r in prefill_profile.rows)
+                singles = [
+                    r for r in prefill_profile.rows
+                    if r.seq_len == largest and r.batch_size == 1
+                ] or [r for r in prefill_profile.rows
+                      if r.seq_len == largest]
+                prefill_ms = singles[0].latency_ms
+            scan_budget = 0.8 * ttft_slo_ms - prefill_ms
+            plan["ttft_horizon"] = int(
+                min(max(1, scan_budget // step_ms), horizon)
+            )
+        logger.info(
+            "%s: table plan at cap %d -> %s (step %.2f ms, %d candidate "
+            "rows)", self.model_name, max_len, plan, step_ms, len(rows),
+        )
+        return plan
+
+    def _table_plan(self, max_len: int) -> Optional[Dict[str, int]]:
+        """Load committed tables from ``profiles_dir`` once per capacity
+        bucket; None when the decode table is absent (callers fall back to
+        the analytic path)."""
+        import os
+
+        if self.profiles_dir is None:
+            return None
+        if max_len in self._table_plans:
+            return self._table_plans[max_len]
+        from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+
+        decode_csv = os.path.join(
+            self.profiles_dir, f"{self.model_name}_decode_summary.csv"
+        )
+        if not os.path.exists(decode_csv):
+            logger.warning(
+                "%s: profiles_dir=%s has no decode table — falling back "
+                "to the analytic HBM model", self.model_name,
+                self.profiles_dir,
+            )
+            return None
+        decode_profile = BatchProfile.from_csv(
+            f"{self.model_name}_decode", decode_csv
+        )
+        prefill_csv = os.path.join(
+            self.profiles_dir, f"{self.model_name}_prefill_summary.csv"
+        )
+        prefill_profile = None
+        if os.path.exists(prefill_csv):
+            prefill_profile = BatchProfile.from_csv(
+                f"{self.model_name}_prefill", prefill_csv
+            )
+        try:
+            plan = self.plan_from_tables(
+                decode_profile, prefill_profile, max_len=max_len
+            )
+        except ValueError as e:
+            # A table that exists but has no row at this capacity (swept at
+            # different max_lens) must degrade exactly like a missing
+            # table — raising here would crash-loop every replica start
+            # until the controller marks the deployment unhealthy.
+            logger.warning(
+                "%s: committed tables unusable at capacity %d (%s) — "
+                "falling back to the analytic HBM model",
+                self.model_name, max_len, e,
+            )
+            plan = None
+        self._table_plans[max_len] = plan
+        return plan
+
     def build_engine(
         self, queue: RequestQueue, device: Any = None, mesh: Any = None,
         max_len: Optional[int] = None,
@@ -449,7 +594,17 @@ class LLMDeployment:
         self._ensure_model()
         max_len = max_len or self.max_len
         num_slots = self.num_slots
-        if num_slots <= 0:
+        decode_horizon = self.decode_horizon
+        ttft_horizon = self.ttft_horizon
+        # Measured tables govern single-chip engines (they are per-chip
+        # measurements; a TP mesh shards the program they describe).
+        plan = self._table_plan(max_len) if mesh is None else None
+        if plan is not None:
+            if num_slots <= 0:
+                num_slots = plan["num_slots"]
+            decode_horizon = plan.get("decode_horizon", decode_horizon)
+            ttft_horizon = plan.get("ttft_horizon", ttft_horizon)
+        elif num_slots <= 0:
             n_chips = mesh.devices.size if mesh is not None else 1
             num_slots = self.auto_num_slots(
                 n_chips, max_len=max_len,
@@ -468,8 +623,8 @@ class LLMDeployment:
             prompt_buckets=prompt_buckets,
             eos_token_id=self.eos_token_id,
             default_max_new_tokens=self.default_max_new_tokens,
-            decode_horizon=self.decode_horizon,
-            ttft_horizon=self.ttft_horizon,
+            decode_horizon=decode_horizon,
+            ttft_horizon=ttft_horizon,
             max_admissions_per_step=self.max_admissions_per_step,
             prefix_cache_size=self.prefix_cache_size,
             session_cache_size=self.session_cache_size,

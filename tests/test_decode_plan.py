@@ -1,0 +1,171 @@
+"""Measured-table decode planning (VERDICT r3 #4: decode under the
+profile-driven control theory).
+
+The reference's committed profiler tables ARE the scheduler's input
+(``293-project/src/nexus.py:129-296``, ``scheduler.py:1019-1041``); here
+the same contract governs the decode phase: ``plan_from_tables`` derives
+num_slots / decode_horizon / ttft_horizon from measured (slots, capacity)
+step latencies + HBM and the token/TTFT SLOs. The core pin: CHANGING THE
+TABLE CHANGES THE CHOICES — the plan is measurement-driven, not analytic.
+"""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
+from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+GB = 1 << 30
+
+
+def row(slots, cap, step_ms, hbm_gb):
+    return ProfileRow(
+        batch_size=slots, seq_len=cap, latency_ms=step_ms,
+        latency_std_ms=0.0, hbm_bytes=int(hbm_gb * GB), compile_ms=100.0,
+    )
+
+
+def decode_table(step_scale=1.0):
+    # Throughput (slots/step): 4/5=0.8, 16/8=2.0, 64/20=3.2, 128/45=2.8
+    # per ms at scale 1 — 64 slots wins on throughput.
+    return BatchProfile("m_decode", [
+        row(4, 256, 5.0 * step_scale, 1.0),
+        row(16, 256, 8.0 * step_scale, 2.0),
+        row(64, 256, 20.0 * step_scale, 5.0),
+        row(128, 256, 45.0 * step_scale, 9.0),
+    ])
+
+
+def prefill_table(latency_ms=40.0):
+    return BatchProfile("m_prefill", [
+        ProfileRow(batch_size=1, seq_len=64, latency_ms=latency_ms,
+                   latency_std_ms=0.0, hbm_bytes=GB, compile_ms=50.0),
+        ProfileRow(batch_size=4, seq_len=64, latency_ms=latency_ms * 2,
+                   latency_std_ms=0.0, hbm_bytes=GB, compile_ms=50.0),
+    ])
+
+
+def deployment(**kw):
+    return LLMDeployment("llama_tiny", dtype=jnp.float32, warmup=False,
+                         max_len=256, **kw)
+
+
+class TestPlanFromTables:
+    def test_max_throughput_config_within_slo_wins(self):
+        plan = deployment().plan_from_tables(
+            decode_table(), token_slo_ms=30.0,
+        )
+        assert plan["num_slots"] == 64        # best tok/s among <=30 ms
+        assert plan["decode_horizon"] == 1    # 30 // 20
+
+    def test_token_slo_excludes_slow_configs(self):
+        # Tighten the SLO below the 64-slot step latency: 16 slots wins.
+        plan = deployment().plan_from_tables(
+            decode_table(), token_slo_ms=10.0,
+        )
+        assert plan["num_slots"] == 16
+        assert plan["decode_horizon"] == 1    # 10 // 8
+
+    def test_changing_the_table_changes_the_choice(self):
+        """The VERDICT 'done' criterion: same deployment, same SLOs —
+        different measurements, different plan."""
+        dep = deployment()
+        before = dep.plan_from_tables(decode_table(), token_slo_ms=30.0)
+        # Re-measure: the 64-slot config got 3x slower (say, a fixed
+        # regression or different hardware). 16 slots now wins.
+        slower = BatchProfile("m_decode", [
+            r if r.batch_size != 64 else row(64, 256, 60.0, 5.0)
+            for r in decode_table().rows
+        ])
+        after = dep.plan_from_tables(slower, token_slo_ms=30.0)
+        assert before["num_slots"] == 64
+        assert after["num_slots"] == 16
+        assert after != before
+
+    def test_hbm_budget_excludes_big_configs(self, monkeypatch):
+        monkeypatch.setenv("RDB_HBM_BUDGET_BYTES", str(3 * GB))
+        from ray_dynamic_batching_tpu.utils import config as config_mod
+
+        config_mod.reset_config()
+        try:
+            plan = deployment().plan_from_tables(
+                decode_table(), token_slo_ms=30.0,
+            )
+            # 64/128-slot programs (5/9 GB) no longer fit: 16 wins.
+            assert plan["num_slots"] == 16
+        finally:
+            monkeypatch.delenv("RDB_HBM_BUDGET_BYTES")
+            config_mod.reset_config()
+
+    def test_horizon_scales_with_token_slo(self):
+        plan = deployment().plan_from_tables(
+            decode_table(), token_slo_ms=160.0,
+        )
+        assert plan["num_slots"] == 64
+        assert plan["decode_horizon"] == 8    # 160 // 20
+
+    def test_ttft_horizon_from_prefill_budget(self):
+        plan = deployment().plan_from_tables(
+            decode_table(), prefill_table(latency_ms=40.0),
+            token_slo_ms=160.0, ttft_slo_ms=300.0,
+        )
+        # 0.8*300 - 40 = 200 ms of scan budget / 20 ms steps = 10,
+        # clamped to decode_horizon 8.
+        assert plan["ttft_horizon"] == 8
+        tighter = deployment().plan_from_tables(
+            decode_table(), prefill_table(latency_ms=40.0),
+            token_slo_ms=160.0, ttft_slo_ms=150.0,
+        )
+        # 0.8*150 - 40 = 80 / 20 = 4: the tier narrows with the SLO.
+        assert tighter["ttft_horizon"] == 4
+
+    def test_no_config_meets_slo_falls_back_to_fastest(self):
+        plan = deployment().plan_from_tables(
+            decode_table(), token_slo_ms=1.0,
+        )
+        assert plan["num_slots"] == 4         # fastest step wins
+        assert plan["decode_horizon"] == 1
+
+    def test_no_feasible_row_raises(self):
+        with pytest.raises(ValueError, match="re-run the decode profiler"):
+            deployment().plan_from_tables(
+                BatchProfile("m_decode", [row(4, 512, 5.0, 1.0)]),
+                token_slo_ms=30.0,  # no rows at capacity 256
+            )
+
+
+class TestTablesDriveTheEngine:
+    def test_build_engine_uses_committed_tables(self, tmp_path):
+        decode_table().to_csv(
+            os.path.join(tmp_path, "llama_tiny_decode_summary.csv")
+        )
+        prefill_table().to_csv(
+            os.path.join(tmp_path, "llama_tiny_prefill_summary.csv")
+        )
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+
+        dep = deployment(
+            num_slots=0, profiles_dir=str(tmp_path),
+            token_slo_ms=160.0, ttft_slo_ms=300.0,
+            prompt_buckets=[8],
+        )
+        engine = dep.build_engine(RequestQueue("llama_tiny", max_len=16))
+        try:
+            assert engine.num_slots == 64
+            assert engine.decode_horizon == 8
+            assert engine.ttft_horizon == 8
+        finally:
+            engine.release_buffers()
+
+    def test_missing_table_falls_back_to_analytic(self, tmp_path):
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+
+        dep = deployment(num_slots=4, profiles_dir=str(tmp_path),
+                         prompt_buckets=[8])
+        engine = dep.build_engine(RequestQueue("llama_tiny", max_len=16))
+        try:
+            assert engine.num_slots == 4  # pinned value survives
+        finally:
+            engine.release_buffers()

@@ -5,6 +5,8 @@ import os
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from ray_dynamic_batching_tpu.models import registry
 from ray_dynamic_batching_tpu.models.base import get_model
 from ray_dynamic_batching_tpu.profiles.profiler import ModelProfiler
@@ -103,3 +105,49 @@ class TestLiveProfiler:
         assert os.path.exists(csv_path)
         loaded = BatchProfile.from_csv(model.name, csv_path)
         assert len(loaded.rows) == 2
+
+
+class TestDecodeProfiler:
+    def test_decode_and_prefill_sweep_tiny(self, tmp_path):
+        """End-to-end: sweep llama_tiny's decode phase, write tables,
+        reload them, and feed them to LLMDeployment.plan_from_tables —
+        the committed-table contract extended to decode (VERDICT r3 #4)."""
+        from ray_dynamic_batching_tpu.profiles.decode_profiler import (
+            DecodeProfiler,
+        )
+        from ray_dynamic_batching_tpu.profiles.profiler import (
+            write_profile_outputs,
+        )
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+        model = get_model("llama_tiny", dtype=jnp.float32)
+        profiler = DecodeProfiler(model, timing_iters=2, warmup_iters=1)
+        decode, prefill = profiler.sweep(
+            slot_buckets=(2, 4), capacities=(64,),
+            prompt_buckets=(8,), group_sizes=(1, 2),
+        )
+        assert [r.batch_size for r in decode.rows] == [2, 4]
+        for row in decode.rows:
+            assert row.seq_len == 64
+            assert row.latency_ms > 0
+            assert row.hbm_bytes > 0
+        assert [(r.seq_len, r.batch_size) for r in prefill.rows] == [
+            (8, 1), (8, 2)
+        ]
+        d_csv, _, _ = write_profile_outputs(decode, str(tmp_path))
+        write_profile_outputs(prefill, str(tmp_path))
+        assert os.path.basename(d_csv) == "llama_tiny_decode_summary.csv"
+
+        dep = LLMDeployment(
+            "llama_tiny", dtype=jnp.float32, warmup=False, max_len=64,
+            num_slots=0, profiles_dir=str(tmp_path), token_slo_ms=10_000.0,
+        )
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+
+        engine = dep.build_engine(RequestQueue("llama_tiny", max_len=16))
+        try:
+            # The chosen slot count is one of the MEASURED configs, not
+            # the analytic HBM answer.
+            assert engine.num_slots in (2, 4)
+        finally:
+            engine.release_buffers()

@@ -23,29 +23,52 @@ from ray_dynamic_batching_tpu.models import registry  # noqa: F401
 from ray_dynamic_batching_tpu.models.base import get_model
 from ray_dynamic_batching_tpu.profiles.profiler import ModelProfiler
 
-# (model, batch buckets, seq buckets) — bucket lists sized so the full run
-# stays under ~15 min of mostly-compile time.
+# (model, batch buckets, seq buckets). Terminal buckets deliberately
+# overshoot the chip so the sweep is PROFILER-stopped (OOM / infeasible),
+# not config-stopped — the reference sweeps 1->512 per model until OOM
+# (``293-project/profiling/run_profiler.py:191-196``), and plan quality is
+# bounded by table resolution at the HBM edge.
 PLAN = [
-    ("resnet50", [1, 8, 32, 64, 128, 256], (0,)),
-    ("shufflenet_v2", [1, 8, 32, 128, 256, 512], (0,)),
-    ("efficientnet_v2s", [1, 8, 32, 64, 128], (0,)),
-    ("vit_b_16", [1, 8, 16, 32, 64], (0,)),
-    ("distilbert_sst2", [1, 8, 32, 128], (64, 128)),
-    ("gpt2_medium", [1, 4, 8], (64, 128)),
+    ("resnet50", [1, 8, 32, 64, 128, 256, 512, 1024], (0,)),
+    ("shufflenet_v2", [1, 8, 32, 128, 256, 512, 1024, 2048], (0,)),
+    ("efficientnet_v2s", [1, 8, 32, 64, 128, 256, 512], (0,)),
+    ("vit_b_16", [1, 8, 16, 32, 64, 128, 256], (0,)),
+    ("distilbert_sst2", [1, 8, 32, 128, 256, 512], (64, 128, 256)),
+    ("gpt2_medium", [1, 4, 8, 16, 32], (64, 128, 256)),
 ]
 
-# CPU-backend plan (float32, small buckets): the same committed-table
+# Decode-phase sweeps: (model, slot buckets, KV capacities, prompt
+# buckets, admission group widths) -> <model>_decode_summary.csv +
+# <model>_prefill_summary.csv, the tables LLMDeployment.plan_from_tables
+# consumes. Slot buckets overshoot HBM for the same profiler-stopped
+# contract.
+DECODE_PLAN = [
+    ("gpt2_medium", (8, 16, 32, 64, 128, 256), (256,), (16, 64), (1, 2, 4, 8)),
+]
+
+# CPU-backend plans (float32, small buckets): the same committed-table
 # contract exercised where no accelerator is reachable — CI fixture and
 # relay-outage fallback, not a performance claim.
 CPU_PLAN = [
-    ("resnet50", [1, 4, 8], (0,)),
-    ("shufflenet_v2", [1, 4, 16], (0,)),
-    ("vit_b_16", [1, 4, 8], (0,)),
+    ("resnet50", [1, 4, 8, 16], (0,)),
+    ("shufflenet_v2", [1, 4, 16, 32], (0,)),
+    ("vit_b_16", [1, 4, 8, 16], (0,)),
+]
+
+CPU_DECODE_PLAN = [
+    ("llama_tiny", (2, 4, 8), (64,), (8, 16), (1, 2)),
 ]
 
 
 def main(out_dir: str, cpu: bool = False) -> None:
     import jax.numpy as jnp
+
+    from ray_dynamic_batching_tpu.profiles.decode_profiler import (
+        DecodeProfiler,
+    )
+    from ray_dynamic_batching_tpu.profiles.profiler import (
+        write_profile_outputs,
+    )
 
     if cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -61,6 +84,20 @@ def main(out_dir: str, cpu: bool = False) -> None:
         paths = profiler.write_outputs(profile, out_dir)
         print(f"{name}: {len(profile.rows)} rows in "
               f"{time.perf_counter() - t0:.0f}s -> {paths[0]}", flush=True)
+    for name, slots, caps, buckets, groups in (
+        CPU_DECODE_PLAN if cpu else DECODE_PLAN
+    ):
+        t0 = time.perf_counter()
+        model = get_model(name, **kwargs)
+        decode, prefill = DecodeProfiler(model).sweep(
+            slot_buckets=slots, capacities=caps,
+            prompt_buckets=buckets, group_sizes=groups,
+        )
+        d_paths = write_profile_outputs(decode, out_dir)
+        p_paths = write_profile_outputs(prefill, out_dir)
+        print(f"{name} decode: {len(decode.rows)}+{len(prefill.rows)} rows "
+              f"in {time.perf_counter() - t0:.0f}s -> {d_paths[0]}, "
+              f"{p_paths[0]}", flush=True)
 
 
 if __name__ == "__main__":
