@@ -17,7 +17,9 @@ torch model loads, with compile time in place of load time.
 
 from __future__ import annotations
 
+import collections
 import json
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -65,6 +67,14 @@ class DeploymentConfig:
     user_config: Dict[str, Any] = field(default_factory=dict)
     chips_per_replica: int = 0          # 0 = no chip reservation
     placement_strategy: str = "PACK"
+    # Code/config version for ROLLING updates (ref deployment_state.py
+    # rollout: redeploying a new version gradually replaces replicas with
+    # both versions serving and bounded unavailability). "" = unversioned:
+    # redeploys reconfigure in place, never roll.
+    version: str = ""
+    # Fraction of num_replicas that may be down at once mid-rollout (ref
+    # Serve's 20% rollout rate); at least one replica always rolls.
+    rolling_max_unavailable_fraction: float = 0.2
     # Advertised multiplex-LRU size per replica; serve.run syncs this to a
     # @multiplexed loader's bound so the router never steers traffic to a
     # replica whose cache already evicted the model.
@@ -82,6 +92,9 @@ class DeploymentConfig:
             "chips_per_replica": self.chips_per_replica,
             "placement_strategy": self.placement_strategy,
             "max_multiplexed_models": self.max_multiplexed_models,
+            "version": self.version,
+            "rolling_max_unavailable_fraction":
+                self.rolling_max_unavailable_fraction,
         }
         if self.autoscaling is not None:
             d["autoscaling"] = vars(self.autoscaling)
@@ -168,22 +181,40 @@ class ServeController:
                 # reconfigure can be expensive (weight reloads) and must
                 # not re-run because an unrelated knob moved.
                 prev_user = state.config.user_config
+                prev_version = state.config.version
                 state.config = config
+                # A redeploy may carry NEW code: future replica starts
+                # (rollout replacements included) must build from the
+                # freshly registered factory, not the one captured at
+                # first deploy.
+                state.factory = self._factories[config.name]
                 state.restarts = 0  # a fresh deploy resets the budget
                 state.unhealthy = False
-                # Push changed batching/concurrency knobs to RUNNING
-                # replicas (otherwise re-deploys silently produce a
-                # mixed-config replica set).
-                for r in state.replicas:
-                    r.reconfigure(
-                        max_batch_size=config.max_batch_size,
-                        batch_wait_timeout_s=config.batch_wait_timeout_s,
-                        max_ongoing_requests=config.max_ongoing_requests,
-                        user_config=(
-                            config.user_config
-                            if config.user_config != prev_user else None
-                        ),
+                if config.version and config.version != prev_version:
+                    # Version change -> ROLLING update: old-version
+                    # replicas keep serving as-is until _reconcile retires
+                    # them in bounded batches (pushing the new config into
+                    # doomed replicas would run expensive reconfigures
+                    # twice and blur which version produced a response).
+                    logger.info(
+                        "%s: rolling update %r -> %r over %d replicas",
+                        config.name, prev_version, config.version,
+                        len(state.replicas),
                     )
+                else:
+                    # Push changed batching/concurrency knobs to RUNNING
+                    # replicas (otherwise re-deploys silently produce a
+                    # mixed-config replica set).
+                    for r in state.replicas:
+                        r.reconfigure(
+                            max_batch_size=config.max_batch_size,
+                            batch_wait_timeout_s=config.batch_wait_timeout_s,
+                            max_ongoing_requests=config.max_ongoing_requests,
+                            user_config=(
+                                config.user_config
+                                if config.user_config != prev_user else None
+                            ),
+                        )
             if config.autoscaling is not None:
                 state.policy = AutoscalingPolicy(
                     config.autoscaling, interval_s=self.control_interval_s
@@ -274,8 +305,12 @@ class ServeController:
             raise
         if pg is not None:
             state.pgroups[rid] = pg
+        # Stamp the config version the replica was BUILT from: the rollout
+        # stage retires replicas whose stamp differs from the target.
+        replica.version = cfg.version
         logger.info(
-            "started replica %s%s", rid,
+            "started replica %s%s%s", rid,
+            f" (version {cfg.version!r})" if cfg.version else "",
             f" on chips {[str(d) for d in devices]}" if devices else "",
         )
         return replica
@@ -362,6 +397,57 @@ class ServeController:
                     )
                 )
         state.replicas = alive
+        # Rolling update (ref deployment_state.py rollout): while replicas
+        # with a DIFFERENT version stamp exist, retire them in batches of
+        # at most ceil(rolling_max_unavailable_fraction * target) — and
+        # only as many as keep the serving set at or above
+        # target - batch, so both versions serve through the rollout and
+        # unavailability stays bounded. Retired replicas drain in the
+        # deferred stop (graceful: in-flight work finishes); the scale-up
+        # loop below starts their new-version replacements this same pass.
+        if cfg.version and not state.unhealthy:
+            outdated = [
+                r for r in state.replicas
+                if getattr(r, "version", "") != cfg.version
+            ]
+            if outdated:
+                batch = max(
+                    1, math.ceil(
+                        cfg.rolling_max_unavailable_fraction
+                        * cfg.num_replicas
+                    ),
+                )
+                floor = cfg.num_replicas - batch
+                can_stop = max(0, len(state.replicas) - floor)
+                for victim in outdated[: min(batch, can_stop)]:
+                    state.replicas.remove(victim)
+                    logger.info(
+                        "rolling out replica %s (version %r -> %r)",
+                        victim.replica_id,
+                        getattr(victim, "version", ""), cfg.version,
+                    )
+                    victim._stopped = True  # stale handles stop assigning
+                    # Same salvage discipline as the heal path: queued
+                    # (unstarted) requests move to surviving/new replicas
+                    # immediately instead of gambling on the victim's drain
+                    # window; only the in-flight batch finishes on the
+                    # victim, with a rollout-sized timeout (a busy LLM
+                    # replica's batch can legitimately run tens of
+                    # seconds — the default 5 s drain would reject it).
+                    salvaged = victim.drain_queue()
+                    if salvaged:
+                        deferred.append(
+                            lambda reqs=salvaged, st=state,
+                            vid=victim.replica_id: (
+                                self._redeliver(reqs, st.replicas, vid)
+                            )
+                        )
+                    deferred.append(
+                        lambda v=victim, st=state: (
+                            v.stop(timeout_s=60.0),
+                            self._release_chips(st, v),
+                        )
+                    )
         # Scale to target — but an exhausted restart budget stops the
         # crash-loop: no replacements until a fresh deploy() resets it
         # (ref gcs_actor_manager.cc:1361-1393 — actors stay DEAD once
@@ -502,6 +588,13 @@ class ServeController:
                     },
                     "restarts": state.restarts,
                     "healthy": not state.unhealthy,
+                    # Per-version replica counts: mid-rollout both the old
+                    # and the new version appear here (ref deployment_state
+                    # rollout status).
+                    "target_version": state.config.version,
+                    "versions": dict(collections.Counter(
+                        getattr(r, "version", "") for r in state.replicas
+                    )),
                 }
                 for name, state in self._deployments.items()
             }

@@ -105,6 +105,9 @@ class Replica:
         # (ref replica multiplex LRU surfaced to the pow-2 scheduler).
         self.loaded_models: List[str] = []
         self.max_multiplexed_models = 8
+        # Config version this replica was built from (stamped by the
+        # controller; rolling updates retire mismatched stamps).
+        self.version = ""
         self._stopped = False
         self._run = threading.Event()
         self._thread: Optional[threading.Thread] = None
