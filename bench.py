@@ -109,6 +109,7 @@ def bench_llm_serving(
     poisson_utilization: float = 0.6,
     decode_horizon: int = 32,
     max_admissions_per_step: int = 8,
+    deployment=None,
 ) -> dict:
     """North star: continuous-batching decode through the serving path.
 
@@ -129,15 +130,16 @@ def bench_llm_serving(
 
     rng = np.random.default_rng(0)
     t_build = time.perf_counter()
-    deployment = LLMDeployment(
-        model_name,
-        num_slots=num_slots,
-        max_len=max_len,
-        prompt_buckets=[prompt_len + 16],
-        default_max_new_tokens=max_new_tokens,
-        decode_horizon=decode_horizon,
-        max_admissions_per_step=max_admissions_per_step,
-    )
+    if deployment is None:
+        deployment = LLMDeployment(
+            model_name,
+            num_slots=num_slots,
+            max_len=max_len,
+            prompt_buckets=[prompt_len + 16],
+            default_max_new_tokens=max_new_tokens,
+            decode_horizon=decode_horizon,
+            max_admissions_per_step=max_admissions_per_step,
+        )
     replica = deployment.make_replica(
         f"{model_name}#bench",
         DeploymentConfig(name=model_name, max_ongoing_requests=4096),
@@ -146,6 +148,7 @@ def bench_llm_serving(
     router = Router(model_name, replicas=[replica], max_assign_timeout_s=30.0)
     handle = DeploymentHandle(router, default_slo_ms=300_000.0)
     vocab = deployment._model.cfg.vocab_size
+    num_slots = replica.engine.num_slots  # actual (auto-sizing may differ)
     _log(f"{model_name}: built + warmed in "
          f"{time.perf_counter() - t_build:.1f}s "
          f"(slots={num_slots}, max_len={max_len})")
@@ -170,6 +173,9 @@ def bench_llm_serving(
     # --- phase B: Poisson arrivals -> TTFT -------------------------------
     capacity_rps = tok_s / max_new_tokens
     offered_rps = max(0.5, capacity_rps * poisson_utilization)
+    # Fresh TTFT window: the breakdown must describe the Poisson phase
+    # (the north-star measurement), not the saturation ramp.
+    replica.engine.reset_ttft_window()
     poisson_futs = []
 
     def submit(_model: str, _offset: float) -> None:
@@ -189,20 +195,106 @@ def bench_llm_serving(
     ttfts = sorted(r.ttft_ms for r in poisson_results)
     p50 = statistics.median(ttfts)
     p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+    # Where the TTFT milliseconds live (queue wait / in-flight-scan wait /
+    # prefill), from the engine's own decomposition of the Poisson phase.
+    breakdown = replica.engine.ttft_breakdown()
     _log(f"poisson @{offered_rps:.1f} rps ({len(ttfts)} reqs): "
-         f"TTFT p50={p50:.0f} ms p99={p99:.0f} ms")
+         f"TTFT p50={p50:.0f} ms p99={p99:.0f} ms breakdown={breakdown}")
 
     replica.stop(timeout_s=2.0, drain=False)
     return {
         "tok_s_per_chip": round(tok_s, 1),
         "ttft_p50_ms": round(p50, 1),
         "ttft_p99_ms": round(p99, 1),
+        "ttft_breakdown": breakdown,
         "offered_rps": round(offered_rps, 2),
         "model": model_name,
         "num_slots": num_slots,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new_tokens,
     }
+
+
+def bench_llama3_8b(
+    max_len: int = 512,
+    prompt_len: int = 48,
+    max_new_tokens: int = 32,
+    saturation_requests: int = 16,
+    poisson_duration_s: float = 8.0,
+) -> dict:
+    """North-star MODEL row: int8 weight-only Llama-3-8B decode serving on
+    one chip (BASELINE.json config 4's model at its real size; int8 fits
+    ~8 GB of weights in a v5e's 16 GB HBM where bf16 cannot).
+
+    Guarded: runs only against a reachable accelerator with enough free
+    HBM; returns a skip record otherwise. Weights are initialized and
+    quantized ON THE HOST (an 8B bf16 init on-device would OOM the chip
+    before quantization could shrink it), then the int8 tree alone is
+    transferred."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+    from ray_dynamic_batching_tpu.models.base import get_model
+    from ray_dynamic_batching_tpu.models.quant import (
+        quantize_tree,
+        tree_weight_bytes,
+    )
+    from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return {"skipped": "no accelerator (cpu backend)"}
+    need = 10 << 30  # ~8 GB int8 weights + KV/activation headroom
+    try:
+        stats = dev.memory_stats() or {}
+        free = stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+        if stats.get("bytes_limit") and free < need:
+            return {"skipped": f"insufficient HBM: {free / 1e9:.1f} GB "
+                               f"free, need {need / 1e9:.0f} GB"}
+    except Exception:  # noqa: BLE001 — no stats API: attempt anyway
+        pass
+
+    t0 = time.perf_counter()
+    model = get_model("llama3_8b")
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        params = model.init(jax.random.PRNGKey(0))
+        qparams = quantize_tree(params)
+        del params
+    _log(f"llama3_8b: host init + int8 quantize in "
+         f"{time.perf_counter() - t0:.0f}s "
+         f"({tree_weight_bytes(qparams) / 1e9:.2f} GB quantized)")
+    t1 = time.perf_counter()
+    qparams = jax.device_put(qparams, dev)
+    jax.block_until_ready(qparams)
+    _log(f"llama3_8b: int8 tree -> chip in {time.perf_counter() - t1:.0f}s")
+
+    deployment = LLMDeployment(
+        "llama3_8b",
+        params=qparams,
+        # The tree is already int8, but the flag is what makes the ENGINE
+        # dequantize inside its programs (quantize_tree is idempotent, so
+        # the pre-quantized params pass through _ensure_model untouched).
+        quantize_weights=True,
+        num_slots=0,  # auto: fill HBM after the int8 weights
+        max_len=max_len,
+        prompt_buckets=[prompt_len + 16],
+        default_max_new_tokens=max_new_tokens,
+        decode_horizon=16,
+        max_admissions_per_step=4,
+    )
+    row = bench_llm_serving(
+        model_name="llama3_8b",
+        max_len=max_len,
+        prompt_len=prompt_len,
+        max_new_tokens=max_new_tokens,
+        saturation_requests=saturation_requests,
+        poisson_duration_s=poisson_duration_s,
+        deployment=deployment,
+    )
+    row["quantization"] = "int8 weight-only"
+    return row
 
 
 def bench_asr_rtf(batch: int = 8, audio_s: float = 30.0,
@@ -351,6 +443,14 @@ def main() -> dict:
     except Exception as e:  # noqa: BLE001 — ASR must not kill the bench
         _log(f"asr failed entirely: {e}")
         asr = {"error": str(e)}
+    if fast:
+        llama8b = {"skipped": "fast mode"}
+    else:
+        try:
+            llama8b = bench_llama3_8b()
+        except Exception as e:  # noqa: BLE001 — guarded row must not kill
+            _log(f"llama3_8b failed entirely: {e}")
+            llama8b = {"error": str(e)}
     import jax
 
     return {
@@ -365,6 +465,7 @@ def main() -> dict:
         "ttft_p50_ms": llm["ttft_p50_ms"],
         "ttft_p99_ms": llm["ttft_p99_ms"],
         "llm": llm,
+        "llama3_8b": llama8b,
         "vision": vision,
         "asr": asr,
     }

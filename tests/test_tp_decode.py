@@ -7,10 +7,14 @@ must not change results), with params and KV cache actually sharded.
 Runs on the fake 8-chip CPU cluster.
 """
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
 
 from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
@@ -177,3 +181,50 @@ class TestTPDeploymentPath:
             assert len(req.future.result(timeout=60).tokens) == 4
         finally:
             replica.stop(timeout_s=1.0)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(3600)
+@pytest.mark.skipif(
+    os.environ.get("RDB_RUN_8B") != "1",
+    reason="full-size Llama-3-8B parity: ~64 GB host RAM and tens of "
+    "minutes of single-core CPU compute — opt in with RDB_RUN_8B=1",
+)
+class TestLlama8BRealConfig:
+    """TP=4 decode parity at the REAL north-star config (BASELINE.json
+    config 4: Llama-3-8B, 32 layers, d_model 4096, kv_heads 8, vocab
+    128256) on the virtual 8-device mesh — the one configuration that had
+    zero coverage at its real size. Few tokens, tiny horizon: the point is
+    that GSPMD-partitioned decode of the actual tensor shapes produces
+    exactly the single-device tokens, not throughput."""
+
+    def test_tp4_matches_single_device_real_8b(self):
+        model = get_model("llama3_8b", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0))
+
+        def decode_tokens(mesh):
+            queue = RequestQueue(model.name, max_len=16)
+            engine = DecodeEngine(
+                model, params, queue,
+                num_slots=2, max_len=16, prompt_buckets=[8],
+                default_max_new_tokens=3, decode_horizon=1, mesh=mesh,
+            )
+            reqs = []
+            for p in ([5, 9, 2, 7], [3, 1, 4, 1, 5]):
+                req = Request(
+                    model=model.name,
+                    payload={"tokens": np.asarray(p, np.int32),
+                             "max_new_tokens": 3},
+                    slo_ms=3_600_000.0,
+                )
+                queue.add_request(req)
+                reqs.append(req)
+            engine.run_until_idle(timeout_s=3000)
+            out = [r.future.result(timeout=5).tokens for r in reqs]
+            engine.release_buffers()
+            return out
+
+        expect = decode_tokens(mesh=None)
+        mesh = build_mesh(MeshConfig(tp=4), jax.devices()[:4])
+        got = decode_tokens(mesh=mesh)
+        assert got == expect
