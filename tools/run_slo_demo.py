@@ -1,18 +1,28 @@
-"""Committed profiles -> SquishyBinPacker plan -> live serving, SLO asserted.
+"""Committed profiles -> SquishyBinPacker plan -> live serving through a
+RATE SHIFT, with schedule migration and per-phase SLO compliance recorded.
 
 The closing leg of the reference's profile loop: its committed profiler CSVs
 are the scheduler's ground truth (``293-project/profiling/*_summary.csv``,
-consumed at ``293-project/src/scheduler.py:1019-1041``) and the serving run
-is judged against the SLO thresholds of its metrics display (>=98% good,
->=95% warning — ``293-project/src/metrics_display.py:64-66``).
+consumed at ``293-project/src/scheduler.py:1019-1041``), its monitor
+rebalances live when measured rates drift >5% from the scheduled ones
+(``293-project/src/scheduler.py:763-801``, update ``:834-929``), and the
+serving run is judged against the SLO thresholds of its metrics display
+(>=98% good, >=95% warning — ``293-project/src/metrics_display.py:64-66``).
 
-Loads the committed tables from ``profiles/<backend>/``, plans duty-cycle
-schedules for the vision models, serves Poisson load on the local chip
-through the full stack (LiveScheduler -> ReplicaEngine), and prints ONE
-JSON line with per-model SLO compliance. Writes the same record next to the
-tables it consumed (``profiles/<backend>/slo_demo.json``).
+This demo exercises the headline capability end-to-end, not just a static
+plan: phase 1 serves Poisson load at profiled-capacity rates; halfway
+through, one model's offered rate DOUBLES (a step crossing the 5%
+threshold), the monitor detects the drift from its sliding-window rate
+estimate and live-migrates the schedule, and compliance is accounted PER
+PHASE — a run that rebalanced but missed its SLOs, or held SLOs without
+ever rebalancing, both fail loudly.
+
+Writes ``<profiles_dir>/slo_demo.json``: per-model per-phase compliance,
+the schedule log (every plan the scheduler installed), and a status that
+requires BOTH >=95% worst-phase compliance AND >=1 mid-run migration.
 
 Usage: python tools/run_slo_demo.py [profiles_dir] [duration_s]
+Exit: 0 good, 2 SLO missed, 3 no mid-run rebalance happened.
 """
 
 from __future__ import annotations
@@ -26,20 +36,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-# (model, slo_ms, utilization) — SLOs follow the reference's per-model
-# config (scheduler.py:28-35: resnet 2000 ms, shufflenet 1500 ms,
-# vit 4000 ms); offered rps = utilization x the model's PROFILED peak
-# throughput, so the same demo is honest on any backend the tables were
-# measured on (TPU chip or CPU CI).
+# (model, slo_ms, utilization, shift_multiplier) — SLOs follow the
+# reference's per-model config (scheduler.py:28-35: resnet 2000 ms,
+# shufflenet 1500 ms, vit 4000 ms); offered rps = utilization x the
+# model's PROFILED peak throughput. shift_multiplier scales the rate at
+# the phase boundary (1.0 = constant).
 WORKLOAD = [
-    ("resnet50", 2000.0, 0.010),
-    ("shufflenet_v2", 1500.0, 0.010),
-    ("vit_b_16", 4000.0, 0.010),
+    ("resnet50", 2000.0, 0.010, 2.0),
+    ("shufflenet_v2", 1500.0, 0.010, 1.0),
+    ("vit_b_16", 4000.0, 0.010, 1.0),
 ]
 MAX_RPS = 200.0  # cap so the ingress thread itself never becomes the bench
+COUNTER_FIELDS = ("completed", "violations", "stale", "dropped")
 
 
-def main(profiles_dir: str, duration_s: float = 20.0,
+def _phase_compliance(start: dict, end: dict) -> dict:
+    """Compliance over the counter DELTAS between two stats snapshots,
+    with shed load (stale discards + drops) in the denominator: a request
+    the queue dropped missed its SLO as surely as a late completion."""
+    d = {k: end[k] - start[k] for k in COUNTER_FIELDS}
+    accounted = d["completed"] + d["stale"] + d["dropped"]
+    misses = d["violations"] + d["stale"] + d["dropped"]
+    compliance = 1.0 - misses / accounted if accounted else 1.0
+    return {**d, "slo_compliance": round(compliance, 4)}
+
+
+def main(profiles_dir: str, duration_s: float = 60.0,
          cpu: bool = False) -> int:
     import jax
 
@@ -61,7 +83,7 @@ def main(profiles_dir: str, duration_s: float = 20.0,
     from ray_dynamic_batching_tpu.scheduler.nexus import SquishyBinPacker
 
     profiles = {}
-    for name, _, _ in WORKLOAD:
+    for name, _, _, _ in WORKLOAD:
         csv_path = os.path.join(profiles_dir, f"{name}_summary.csv")
         if not os.path.exists(csv_path):
             print(f"missing committed table: {csv_path} — run "
@@ -70,17 +92,28 @@ def main(profiles_dir: str, duration_s: float = 20.0,
         profiles[name] = BatchProfile.from_csv(name, csv_path)
 
     print(f"backend={jax.default_backend()}", file=sys.stderr, flush=True)
+    # The reference's SLOs assume accelerator-class latencies (resnet
+    # ~3 ms/im on an A6000); the CPU CI fallback runs the same models at
+    # ~80-420 ms/im, so grading those SLOs would measure the host, not the
+    # scheduler. Scale them by the hardware gap for the CPU record — the
+    # mechanism under test (profile->plan->shift->migration->per-phase
+    # accounting) is identical.
+    slo_scale = 3.0 if cpu else 1.0
+    workload = [
+        (name, slo_ms * slo_scale, util, mult)
+        for name, slo_ms, util, mult in WORKLOAD
+    ]
     packer = SquishyBinPacker(profiles, hbm_budget_bytes=12 << 30)
     queues = QueueManager()
     # One engine per workload model: at low offered rates the packer's duty
     # cycles stretch past the merge SLO-recheck, so the plan can legitimately
     # need one node per model; engines beyond the plan simply stay idle.
-    n_engines = len(WORKLOAD)
+    n_engines = len(workload)
     if cpu:
         import jax.numpy as jnp
 
         host = ModelHost(model_kwargs={
-            name: {"dtype": jnp.float32} for name, _, _ in WORKLOAD
+            name: {"dtype": jnp.float32} for name, _, _, _ in workload
         })
     else:
         host = ModelHost()
@@ -88,7 +121,7 @@ def main(profiles_dir: str, duration_s: float = 20.0,
         ReplicaEngine(f"chip{i}", queues, host) for i in range(n_engines)
     ]
     sched = LiveScheduler(packer, engines, queues=queues)
-    for name, slo_ms, _ in WORKLOAD:
+    for name, slo_ms, _, _ in workload:
         sched.register_model(name, slo_ms=slo_ms)
     for e in engines:
         e.start()
@@ -97,102 +130,140 @@ def main(profiles_dir: str, duration_s: float = 20.0,
     # load; the reference samples from a fixed cat-image directory).
     example = {
         name: np.asarray(get_model(name).example_inputs(1)[0][0])
-        for name, _, _ in WORKLOAD
+        for name, _, _, _ in workload
     }
-    slos = {name: slo_ms for name, slo_ms, _ in WORKLOAD}
+    slos = {name: slo_ms for name, slo_ms, _, _ in workload}
 
     def submit(model: str, _offset: float) -> None:
+        # Through the SCHEDULER (not the queue directly): submit_request
+        # records demand in the sliding-window rate registry the monitor
+        # reads — the signal that triggers the mid-run migration.
         sched.submit_request(Request(
             model=model, payload=example[model], slo_ms=slos[model],
         ))
 
-    rates = {
-        name: min(MAX_RPS, max(0.5, util * profiles[name].max_throughput()))
-        for name, _, util in WORKLOAD
+    # Floor keeps the demo alive on very slow backends, but must stay
+    # well under what one CPU core can serve (3 models x floor x ~0.7 s
+    # each, with one model doubling mid-run): 0.5 rps overloads the CI
+    # host and grades the run critical for reasons unrelated to the
+    # scheduler. On a real chip util x profiled throughput dominates.
+    base_rates = {
+        name: min(MAX_RPS, max(0.2, util * profiles[name].max_throughput()))
+        for name, _, util, _ in workload
     }
+    shift_at_s = duration_s / 2.0
     print(f"offered rps (from profiled capacity): "
-          f"{ {n: round(r, 1) for n, r in rates.items()} }",
+          f"{ {n: round(r, 1) for n, r in base_rates.items()} }; "
+          f"shifts at t={shift_at_s:.0f}s: "
+          f"{ {n: m for n, _, _, m in workload if m != 1.0} }",
           file=sys.stderr, flush=True)
 
+    record = {
+        "metric": "slo_demo",
+        "backend": jax.default_backend(),
+        "duration_s": duration_s,
+        "shift_at_s": shift_at_s,
+        "offered_rps": {n: round(r, 2) for n, r in base_rates.items()},
+        "models": {},
+    }
     try:
-        plans = sched.rebalance(rates=rates)
+        plans = sched.rebalance(rates=base_rates)
+        changes_baseline = sched.schedule_changes
         for p in plans:
             print(f"plan: {p.describe()}", file=sys.stderr, flush=True)
         # Engines are ready once the prepared schedule is swapped in
         # (prepare-then-swap compiles off the serving path).
         deadline = time.monotonic() + 300
-        want = {n for n, _, _ in WORKLOAD}
+        want = {n for n, _, _, _ in workload}
         while not want.issubset({m for e in engines for m in e.models}):
             if time.monotonic() > deadline:
                 print("engines never loaded the planned models",
                       file=sys.stderr)
                 return 1
             time.sleep(0.5)
+        # Live monitor: detects the measured-vs-scheduled rate drift the
+        # step pattern creates and migrates the schedule mid-run.
+        sched.start_monitoring()
         drivers = [
             WorkloadDriver(
                 submit, name,
-                RatePattern("constant", base_rps=rates[name]),
+                RatePattern(
+                    "step", base_rps=base_rates[name],
+                    amplitude=base_rates[name] * (mult - 1.0),
+                    step_at_s=shift_at_s,
+                ),
                 duration_s=duration_s, poisson=True, seed=17 + i,
             )
-            for i, (name, _, _) in enumerate(WORKLOAD)
+            for i, (name, _, _, mult) in enumerate(workload)
         ]
+        t0 = time.monotonic()
         for d in drivers:
             d.start()
+        # Phase-boundary snapshot: compliance is accounted per phase so a
+        # violation burst during the migration cannot hide in the mean.
+        time.sleep(max(0.0, shift_at_s - (time.monotonic() - t0)))
+        snap_mid = {
+            n: dict(queues.queue(n).stats()) for n, _, _, _ in workload
+        }
         for d in drivers:
             d.join(duration_s + 120)
         # Drain.
         deadline = time.monotonic() + 60
-        while (any(len(queues.queue(n)) > 0 for n, _, _ in WORKLOAD)
+        while (any(len(queues.queue(n)) > 0 for n, _, _, _ in workload)
                and time.monotonic() < deadline):
             time.sleep(0.1)
         time.sleep(0.5)
     finally:
+        sched.stop_monitoring()
         for e in engines:
             e.stop()
-        sched.stop_monitoring()
 
-    record = {
-        "metric": "slo_demo",
-        "backend": jax.default_backend(),
-        "duration_s": duration_s,
-        "models": {},
-    }
     worst = 1.0
-    for name, slo_ms, _ in WORKLOAD:
+    for name, slo_ms, _, mult in workload:
         stats = queues.queue(name).stats()
         sent = next(d.sent for d in drivers if d.model == name)
-        # Full-run compliance, not the queue's rolling window (which would
-        # forget an early violation burst), with SHED load in the
-        # denominator: a stale-discarded or dropped request missed its SLO
-        # as surely as a late completion — a run that sheds half its
-        # traffic must not grade "good" on the half it kept.
-        accounted = stats["completed"] + stats["stale"] + stats["dropped"]
-        misses = stats["violations"] + stats["stale"] + stats["dropped"]
-        compliance = 1.0 - misses / accounted if accounted else 1.0
-        worst = min(worst, compliance)
+        zero = {k: 0 for k in COUNTER_FIELDS}
+        p1 = _phase_compliance(zero, snap_mid[name])
+        p2 = _phase_compliance(snap_mid[name], stats)
+        worst = min(worst, p1["slo_compliance"], p2["slo_compliance"])
         record["models"][name] = {
-            "offered_rps": round(rates[name], 2),
+            "offered_rps": round(base_rates[name], 2),
+            "shift_multiplier": mult,
             "sent": sent,
             "completed": stats["completed"],
-            # Stale discards are load shedding, not success: requests the
-            # queue dropped because they could no longer make their SLO
-            # (ref scheduler.py:281-283). Surfaced so compliance-over-
-            # completions can't silently hide shed load.
             "dropped": stats["dropped"],
             "stale": stats["stale"],
             "slo_ms": slo_ms,
-            "slo_compliance": round(compliance, 4),
+            "phase1": p1,
+            "phase2": p2,
             "latency_p95_ms": round(stats["latency_p95_ms"], 1),
             "latency_p99_ms": round(stats["latency_p99_ms"], 1),
         }
-    # Reference display thresholds: >=98% good, >=95% warning.
-    record["status"] = ("good" if worst >= 0.98
-                        else "warning" if worst >= 0.95 else "critical")
+    # The migration evidence: every plan installed after the initial one,
+    # verbatim from the scheduler's own log (ref scheduler.py:834-929).
+    migrations = sched.schedule_log[changes_baseline:]
+    record["schedule_changes_mid_run"] = len(migrations)
+    record["schedule_log"] = [
+        {"t_s": round(m["ts"] - t0, 1),
+         "rates": {k: round(v, 2) for k, v in m["rates"].items()},
+         "nodes": m["nodes"]}
+        for m in migrations
+    ]
+    rebalanced = len(migrations) >= 1
+    # Reference display thresholds: >=98% good, >=95% warning — and the
+    # demo's whole point is the migration, so no-rebalance fails outright.
+    if not rebalanced:
+        record["status"] = "no_rebalance"
+    else:
+        record["status"] = ("good" if worst >= 0.98
+                            else "warning" if worst >= 0.95 else "critical")
     line = json.dumps(record)
     print(line)
     out_path = os.path.join(profiles_dir, "slo_demo.json")
     with open(out_path, "w") as f:
         f.write(line + "\n")
+    if not rebalanced:
+        return 3
     return 0 if worst >= 0.95 else 2
 
 
@@ -202,6 +273,6 @@ if __name__ == "__main__":
     argv, default_dir, _cpu = backend_args(sys.argv[1:])
     sys.exit(main(
         argv[0] if argv else default_dir,
-        float(argv[1]) if len(argv) > 1 else 20.0,
+        float(argv[1]) if len(argv) > 1 else 60.0,
         cpu=_cpu,
     ))
