@@ -50,6 +50,7 @@ class TestPytreeRoundtrip:
                 {"a": jnp.ones(2), "extra": jnp.ones(3)},
             )
 
+    @pytest.mark.slow  # sharded restore compiles
     def test_sharded_restore_onto_mesh(self, tmp_path):
         model = get_model("llama_tiny", dtype=jnp.float32)
         params = model.init(jax.random.PRNGKey(0))
@@ -89,6 +90,7 @@ class TestManager:
             mgr.restore({"x": jnp.zeros(1)})
 
 
+@pytest.mark.slow  # real train steps (XLA compiles)
 class TestTrainResume:
     def test_resume_continues_identically(self, tmp_path):
         """Train 2 steps, checkpoint, train 2 more; vs restore + 2 steps:
@@ -138,6 +140,7 @@ class TestTrainResume:
         np.testing.assert_allclose(resumed_losses, cont_losses, rtol=1e-6)
 
 
+@pytest.mark.slow  # pipelined train steps (XLA compiles)
 class TestPipelineInterchange:
     def test_checkpoint_flat_restore_pipelined(self, tmp_path):
         """Save flat model params, restore into the pipelined split layout

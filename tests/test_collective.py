@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from ray_dynamic_batching_tpu.parallel import collective as col
 from ray_dynamic_batching_tpu.parallel.mesh import MeshConfig, build_mesh
 

@@ -7,6 +7,9 @@ import os
 
 import jax
 import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
 
 from ray_dynamic_batching_tpu.utils import compile_cache
 from ray_dynamic_batching_tpu.utils.config import RDBConfig, set_config

@@ -126,6 +126,7 @@ class TestLiveScheduler:
         with pytest.raises(KeyError):
             r.future.result(timeout=1)
 
+    @pytest.mark.slow  # serves real models (XLA compiles)
     def test_rebalance_and_serve(self, system):
         sched, engines, queues = system
         plan = sched.rebalance(rates={"distilbert_tiny": 50.0})

@@ -118,6 +118,7 @@ class TestControllerPlacement:
             controller.shutdown()
         assert total_free(manager) == 8
 
+    @pytest.mark.slow  # builds a real decode engine (XLA compiles)
     def test_llm_replica_pinned_to_bundle_device(self, manager):
         """LLMDeployment replicas build their engine on the placement
         bundle's chip: params and cache land on that exact device."""

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from ray_dynamic_batching_tpu.engine.batching import (
     NexusFixedBatch,
     OpportunisticBatch,

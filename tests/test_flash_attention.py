@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from ray_dynamic_batching_tpu.ops import flash_attention as fa
 from ray_dynamic_batching_tpu.ops.attention import _xla_attention
 

@@ -7,6 +7,8 @@ status-code mapping, and LLM token streaming end to end.
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # LLM fixture / native stress (fast lane excludes)
+
 grpc = pytest.importorskip("grpc")
 
 from ray_dynamic_batching_tpu.serve.controller import (  # noqa: E402

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from ray_dynamic_batching_tpu.engine.host import ModelHost
 from ray_dynamic_batching_tpu.engine.ingress import IngressClient, SocketIngress
 from ray_dynamic_batching_tpu.engine.queue import QueueManager

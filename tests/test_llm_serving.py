@@ -15,6 +15,8 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from ray_dynamic_batching_tpu.engine.decode import DecodeResult
 from ray_dynamic_batching_tpu.serve.controller import (
     DeploymentConfig,

@@ -3,6 +3,8 @@
 import jax
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from ray_dynamic_batching_tpu.parallel.mesh import (
     AXIS_ORDER,
     MeshConfig,

@@ -4,6 +4,8 @@ HTTP proxy, shm queue, actor mailboxes, KV watch)."""
 
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from tools import microbench
 
 

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from ray_dynamic_batching_tpu.models import registry
 from ray_dynamic_batching_tpu.models.base import get_model, param_path_specs
 from ray_dynamic_batching_tpu.models.decoder import KVCache

@@ -16,6 +16,8 @@ import socket
 
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy (fast lane excludes)
+
 from tools.dcn_probe import init_and_psum
 
 

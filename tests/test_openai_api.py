@@ -7,6 +7,8 @@ import socket
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # LLM fixture / native stress (fast lane excludes)
+
 from ray_dynamic_batching_tpu.serve.controller import (
     DeploymentConfig,
     ServeController,

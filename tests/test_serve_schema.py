@@ -105,6 +105,7 @@ class TestApplyConfig:
         handles = apply_config(cfg, controller=controller)
         assert handles["scaler"].remote(4).result(timeout=10) == 20
 
+    @pytest.mark.slow  # builds a real decode engine (XLA compiles)
     def test_llm_builtin_target(self, controller):
         import jax.numpy as jnp  # noqa: F401 — jax already CPU-forced
 

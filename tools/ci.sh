@@ -1,14 +1,21 @@
 #!/usr/bin/env bash
 # One-command verification: the full pyramid the round-end driver samples.
-#   tools/ci.sh          everything (tests + native sanitizers + dryrun)
-#   tools/ci.sh fast     tests only
+#   tools/ci.sh          everything (all tests + native sanitizers + dryrun)
+#   tools/ci.sh fast     inner-loop lane: logic tests only (-m "not slow",
+#                        no XLA-compile-heavy files) — target <1 min
+#   tools/ci.sh tests    all tests, skip native/dryrun
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "fast" ]; then
+  echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
+  exec python -m pytest tests/ -q -m "not slow"
+fi
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q
 
-if [ "${1:-}" != "fast" ]; then
+if [ "${1:-}" != "tests" ]; then
   echo "== native stress + ThreadSanitizer =="
   make -C native check
 
