@@ -182,6 +182,24 @@ def _on_chip(backend) -> bool:
     return isinstance(backend, str) and backend not in ("", "cpu")
 
 
+def _discard_unverified_artifacts() -> None:
+    """Remove everything a FAILED step left under profiles/tpu_v5e: a
+    later successful step's pathspec commit would otherwise sweep the
+    residue (e.g. CPU-backend CSVs from a relay drop, a no-rebalance
+    slo_demo.json) in as ground truth. Untracked files are deleted and
+    tracked ones restored to their committed state — verified artifacts
+    were committed the moment they passed, so they survive."""
+    for cmd in (
+        ["git", "-C", REPO, "clean", "-fdq", "--", "profiles/tpu_v5e"],
+        ["git", "-C", REPO, "checkout", "-q", "--", "profiles/tpu_v5e"],
+    ):
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0 and "did not match any file" not in (
+            proc.stderr or ""
+        ):
+            _log(f"cleanup {cmd[3]} failed: {proc.stderr.strip()[-150:]}")
+
+
 def capture_bench() -> bool:
     rec = run_step("bench", [sys.executable, "bench.py"], BENCH_TIMEOUT_S)
     # bench.py prints ONE JSON line on stdout (the last parseable line).
@@ -204,6 +222,7 @@ def capture_bench() -> bool:
             "stdout_tail": rec["stdout"][-2000:],
             "stderr_tail": rec["stderr"][-1000:],
         })
+        _discard_unverified_artifacts()
         return False
     os.makedirs(OUT_DIR, exist_ok=True)
     with open(os.path.join(OUT_DIR, f"bench_{ts}.json"), "w") as f:
@@ -234,6 +253,7 @@ def capture_profiles() -> bool:
             "stdout_tail": rec["stdout"][-2000:],
             "stderr_tail": rec["stderr"][-1000:],
         })
+        _discard_unverified_artifacts()
         return False
     return git_commit(f"tpu_v5e: committed on-chip profile tables {_now()}")
 
@@ -259,6 +279,7 @@ def capture_slo_demo() -> bool:
             "stdout_tail": rec["stdout"][-2000:],
             "stderr_tail": rec["stderr"][-1000:],
         })
+        _discard_unverified_artifacts()
         return False
     return git_commit(f"tpu_v5e: on-chip SLO demo record {_now()}")
 
@@ -309,12 +330,19 @@ def main() -> int:
                     done[name] = False
                 status(True)
                 if not done[name]:
+                    if not probe(60.0):
+                        # The RELAY died mid-step, not the step: a flap
+                        # must not consume the attempt budget (the cap
+                        # exists for deterministic failures while the
+                        # relay is alive — a flapping tunnel is the very
+                        # thing this tool waits out).
+                        attempts[name] -= 1
+                        _log("relay died mid-capture; back to probing "
+                             "(attempt not charged)")
+                        break
                     if attempts[name] >= MAX_ATTEMPTS:
                         _log(f"step {name}: giving up after "
                              f"{attempts[name]} attempts")
-                    if not probe(60.0):
-                        _log("relay died mid-capture; back to probing")
-                        break
             if all(done.values()):
                 status(True, complete=True)
                 _log("all captures complete; exiting")
