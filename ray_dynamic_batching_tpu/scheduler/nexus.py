@@ -281,3 +281,148 @@ class SquishyBinPacker:
 
     def chips_required(self, sessions: List[Session]) -> int:
         return len(self.plan(sessions))
+
+
+# --- LLM decode colocation (the control theory applied to decode) ----------
+#
+# The duty-cycle packer above time-slices ONE compiled program per model
+# through a cycle; continuous-batching decode engines instead run all the
+# time, so their cost model is a COMPUTE FRACTION plus resident HBM:
+# an engine with a measured per-substep latency `step_ms` at `num_slots`
+# occupancy produces slots/step tokens per ms of chip time. Serving
+# R tokens/s therefore needs fraction f = R*step_ms/(1000*slots) of the
+# chip, and a co-tenant set fits iff fractions sum under a headroom and
+# resident footprints sum under the HBM budget — the same
+# admissibility-from-measured-tables discipline as the reference's
+# squishyBinPacking (293-project/src/nexus.py:129-296), with the decode
+# tables of profiles.decode_profiler as ground truth.
+
+
+@dataclass(frozen=True)
+class LLMSession:
+    """One LLM's decode serving contract (the decode analogue of
+    :class:`Session`)."""
+
+    model: str
+    rate_tok_s: float        # offered decode demand, tokens/s
+    token_slo_ms: float      # per-token latency SLO (inter-token gap)
+    # Minimum KV capacity (prompt + generation) a placement must hold:
+    # shorter-capacity rows are cheaper on every axis, so without this
+    # filter the picker would always "win" with caches too small for the
+    # workload's real conversations (mirror of Session.seq_len).
+    min_context: int = 0
+
+
+@dataclass(frozen=True)
+class LLMPlacement:
+    model: str
+    num_slots: int
+    capacity: int            # KV capacity (max_len) of the chosen config
+    step_ms: float
+    compute_fraction: float
+    hbm_bytes: int
+
+
+def _pick_llm_row(
+    session: LLMSession, profile: BatchProfile, headroom: float,
+    hbm_budget: float,
+) -> Optional[LLMPlacement]:
+    """The measured (slots, capacity) config serving this session's rate
+    within its token SLO at minimal COMPUTE FRACTION (ties: minimal HBM)
+    — compute is the binding resource for colocation density; a config
+    that halves the fraction for a few hundred KB of extra KV rows packs
+    strictly more co-tenants per chip.
+
+    Sharing stretches the observed inter-token gap to ~step_ms/f, so the
+    SLO requires f >= step_ms/slo on top of the capacity requirement
+    f >= rate*step/(1000*slots); a row is feasible iff that combined
+    fraction fits under the headroom, its program fits the HBM budget,
+    and its KV capacity covers the session's context. SLO feasibility
+    uses worst-case latency (mean + 2*std, ``worst_latency_ms``) — the
+    no-preemption discipline of the duty-cycle packer — while capacity
+    throughput uses the mean.
+    """
+    best: Optional[LLMPlacement] = None
+    for row in profile.rows:
+        if row.latency_ms <= 0 or row.hbm_bytes <= 0:
+            continue
+        if row.hbm_bytes > hbm_budget:
+            continue  # the budget filters per ROW, like saturate_row
+        if row.seq_len < session.min_context:
+            continue  # cache too small for the workload's conversations
+        worst_ms = worst_latency_ms(row)
+        if worst_ms > session.token_slo_ms:
+            continue  # even a dedicated chip would miss the SLO
+        f_capacity = (
+            session.rate_tok_s * row.latency_ms
+            / (1000.0 * row.batch_size)
+        )
+        f_slo = worst_ms / session.token_slo_ms
+        f = max(f_capacity, f_slo)
+        if f > headroom:
+            continue
+        cand = LLMPlacement(
+            model=session.model,
+            num_slots=row.batch_size,
+            capacity=row.seq_len,
+            step_ms=row.latency_ms,
+            compute_fraction=f,
+            hbm_bytes=row.hbm_bytes,
+        )
+        if (best is None
+                or (cand.compute_fraction, cand.hbm_bytes)
+                < (best.compute_fraction, best.hbm_bytes)):
+            best = cand
+    return best
+
+
+def pack_llm_engines(
+    sessions: List[LLMSession],
+    decode_profiles: Dict[str, BatchProfile],
+    hbm_budget_bytes: Optional[int] = None,
+    compute_headroom: float = 0.85,
+) -> List[List[LLMPlacement]]:
+    """First-fit-decreasing colocation of decode engines onto chips.
+
+    Returns one list of placements per chip. Raises ``ValueError`` when a
+    session has no feasible measured config (missing table, SLO tighter
+    than every measured step, or demand beyond a whole chip) — the caller
+    must re-profile or relax, exactly like the duty-cycle packer's
+    contract that only profiled shapes are schedulable.
+    """
+    cfg = get_config()
+    budget = float(
+        hbm_budget_bytes
+        if hbm_budget_bytes is not None
+        else cfg.hbm_budget_bytes * cfg.hbm_plan_fraction
+    )
+    placements: List[LLMPlacement] = []
+    for session in sessions:
+        profile = decode_profiles.get(session.model)
+        if profile is None:
+            raise ValueError(
+                f"{session.model}: no decode profile — run the decode "
+                "profiler (tools/run_profiles.py)"
+            )
+        placed = _pick_llm_row(session, profile, compute_headroom, budget)
+        if placed is None:
+            raise ValueError(
+                f"{session.model}: no measured decode config serves "
+                f"{session.rate_tok_s:.0f} tok/s within a "
+                f"{session.token_slo_ms:.0f} ms token SLO "
+                f"(min context {session.min_context}) under "
+                f"{budget / 1e9:.1f} GB on one chip"
+            )
+        placements.append(placed)
+    chips: List[List[LLMPlacement]] = []
+    for p in sorted(placements, key=lambda p: -p.compute_fraction):
+        for chip in chips:
+            if (sum(c.compute_fraction for c in chip) + p.compute_fraction
+                    <= compute_headroom
+                    and sum(c.hbm_bytes for c in chip) + p.hbm_bytes
+                    <= budget):
+                chip.append(p)
+                break
+        else:
+            chips.append([p])
+    return chips

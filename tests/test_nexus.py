@@ -180,3 +180,106 @@ class TestScaleSanity:
         low = packer.chips_required([Session("heavy", 300.0, 50.0)])
         high = packer.chips_required([Session("heavy", 300.0, 2000.0)])
         assert high > low
+
+
+class TestLLMColocation:
+    """Nexus control theory applied to decode engines (VERDICT r3 #4
+    stretch): multiple small LLMs pack onto one chip by PROFILED
+    occupancy, and the packing answers change when the tables change."""
+
+    @staticmethod
+    def profile(name, step_ms=10.0, hbm_gb=3.0):
+        from ray_dynamic_batching_tpu.profiles.table import (
+            BatchProfile,
+            ProfileRow,
+        )
+
+        rows = [
+            ProfileRow(batch_size=s, seq_len=256,
+                       latency_ms=step_ms * (1 + 0.05 * i),
+                       latency_std_ms=0.0,
+                       hbm_bytes=int((hbm_gb + i) * (1 << 30)),
+                       compile_ms=100.0)
+            for i, s in enumerate((8, 16, 32))
+        ]
+        return BatchProfile(f"{name}_decode", rows)
+
+    def test_two_llms_share_one_chip(self):
+        from ray_dynamic_batching_tpu.scheduler.nexus import (
+            LLMSession,
+            pack_llm_engines,
+        )
+
+        chips = pack_llm_engines(
+            [LLMSession("a", rate_tok_s=300.0, token_slo_ms=50.0),
+             LLMSession("b", rate_tok_s=300.0, token_slo_ms=50.0)],
+            {"a": self.profile("a"), "b": self.profile("b")},
+            hbm_budget_bytes=12 << 30,
+        )
+        assert len(chips) == 1
+        assert {p.model for p in chips[0]} == {"a", "b"}
+        # Each placement is a measured config, loaded under the headroom.
+        total_f = sum(p.compute_fraction for p in chips[0])
+        assert 0 < total_f <= 0.85
+        assert sum(p.hbm_bytes for p in chips[0]) <= 12 << 30
+
+    def test_changed_table_changes_the_packing(self):
+        from ray_dynamic_batching_tpu.scheduler.nexus import (
+            LLMSession,
+            pack_llm_engines,
+        )
+
+        sessions = [
+            LLMSession("a", rate_tok_s=300.0, token_slo_ms=50.0),
+            LLMSession("b", rate_tok_s=300.0, token_slo_ms=50.0),
+        ]
+        # Re-measured: model b's steps are 4x slower -> its compute
+        # fraction alone approaches the headroom, forcing a second chip.
+        chips = pack_llm_engines(
+            sessions,
+            {"a": self.profile("a"), "b": self.profile("b", step_ms=40.0)},
+            hbm_budget_bytes=12 << 30,
+        )
+        assert len(chips) == 2
+
+    def test_hbm_budget_forces_second_chip(self):
+        from ray_dynamic_batching_tpu.scheduler.nexus import (
+            LLMSession,
+            pack_llm_engines,
+        )
+
+        chips = pack_llm_engines(
+            [LLMSession("a", rate_tok_s=300.0, token_slo_ms=50.0),
+             LLMSession("b", rate_tok_s=300.0, token_slo_ms=50.0)],
+            {"a": self.profile("a", hbm_gb=4.0),
+             "b": self.profile("b", hbm_gb=4.0)},
+            hbm_budget_bytes=6 << 30,  # each fits alone, not together
+        )
+        assert len(chips) == 2
+
+    def test_infeasible_slo_raises(self):
+        import pytest
+
+        from ray_dynamic_batching_tpu.scheduler.nexus import (
+            LLMSession,
+            pack_llm_engines,
+        )
+
+        with pytest.raises(ValueError, match="no measured decode config"):
+            pack_llm_engines(
+                [LLMSession("a", rate_tok_s=10.0, token_slo_ms=5.0)],
+                {"a": self.profile("a", step_ms=10.0)},  # step > SLO
+            )
+
+    def test_missing_profile_raises(self):
+        import pytest
+
+        from ray_dynamic_batching_tpu.scheduler.nexus import (
+            LLMSession,
+            pack_llm_engines,
+        )
+
+        with pytest.raises(ValueError, match="no decode profile"):
+            pack_llm_engines(
+                [LLMSession("zz", rate_tok_s=1.0, token_slo_ms=100.0)], {},
+            )
