@@ -146,3 +146,40 @@ class TestApplyConfig:
         assert handles["cfg_echo3"].remote(2).result(timeout=10) == {
             "echo": 2
         }
+
+
+class TestVersionedConfig:
+    def test_version_flows_through_declarative_rollout(self):
+        """`version` and the rollout fraction are DeploymentConfig fields,
+        so a config document sets them directly; re-applying a config with
+        a bumped version rolls the deployment (mixed-version window) just
+        like an imperative redeploy. Controller deliberately NOT started:
+        a background control tick between apply and assert would finish
+        the rollout and flake the mixed-window check — reconciles are
+        driven by hand instead."""
+        from ray_dynamic_batching_tpu.serve.controller import (
+            ServeController,
+        )
+
+        controller = ServeController()
+        def doc(version):
+            return ServeConfigSchema.from_dict({"applications": [{
+                "name": "va",
+                "deployments": [{
+                    "name": "cfg_ver",
+                    "import_path": "tests.fixtures:cfg_echo_app",
+                    "num_replicas": 3,
+                    "version": version,
+                    "rolling_max_unavailable_fraction": 0.34,
+                }],
+            }]})
+
+        apply_config(doc("v1"), controller=controller)
+        assert controller.status()["cfg_ver"]["versions"] == {"v1": 3}
+        apply_config(doc("v2"), controller=controller)
+        v = controller.status()["cfg_ver"]["versions"]
+        # One reconcile pass has run: ceil(0.34*3) = 2 rolled, 1 old left.
+        assert v == {"v1": 1, "v2": 2}
+        for _ in range(5):
+            controller._control_step()
+        assert controller.status()["cfg_ver"]["versions"] == {"v2": 3}

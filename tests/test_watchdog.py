@@ -1,0 +1,170 @@
+"""Relay-watchdog capture pipeline against a sandbox git repo.
+
+The watchdog is the round's ground-truth capture mechanism
+(tools/tpu_watchdog.py) and its success path cannot run against the real
+relay in CI — so these tests drive the REAL capture functions (subprocess
+steps, backend verification, pathspec-scoped commits, failure-residue
+discard) inside a throwaway git repository with stub bench/profile/demo
+scripts, probe stubbed alive.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+
+import pytest
+
+
+def _git(repo, *args):
+    proc = subprocess.run(["git", "-C", repo, *args],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (args, proc.stderr)
+    return proc.stdout
+
+
+@pytest.fixture
+def sandbox(tmp_path, monkeypatch):
+    """A git repo with stub capture scripts + the watchdog module pointed
+    at it."""
+    repo = tmp_path / "repo"
+    (repo / "tools").mkdir(parents=True)
+    (repo / "profiles").mkdir()
+    _git(str(repo), "init", "-q")
+    _git(str(repo), "config", "user.email", "wd@test")
+    _git(str(repo), "config", "user.name", "wd")
+
+    (repo / "bench.py").write_text(
+        "import json\n"
+        "print('noise line')\n"
+        "print(json.dumps({'metric': 'llm_tok_s_per_chip', 'value': 1800.0,"
+        " 'unit': 'tok/s', 'vs_baseline': 1.2, 'backend': 'tpu',"
+        " 'pad': 'x' * 3000}))\n"
+    )
+    (repo / "tools" / "run_profiles.py").write_text(
+        "import os, sys\n"
+        "print('backend=tpu devices=[FakeTpu]')\n"
+        "out = sys.argv[1]\n"
+        "os.makedirs(out, exist_ok=True)\n"
+        "open(os.path.join(out, 'resnet50_summary.csv'), 'w')"
+        ".write('batch_size,latency_ms\\n1,0.5\\n')\n"
+    )
+    (repo / "tools" / "run_slo_demo.py").write_text(
+        "import json, os, sys\n"
+        "out = sys.argv[1]\n"
+        "os.makedirs(out, exist_ok=True)\n"
+        "open(os.path.join(out, 'slo_demo.json'), 'w').write(\n"
+        "    json.dumps({'metric': 'slo_demo', 'backend': 'tpu',"
+        " 'status': 'good'}))\n"
+    )
+    (repo / "README").write_text("sandbox\n")
+    _git(str(repo), "add", "-A")
+    _git(str(repo), "commit", "-q", "-m", "init")
+
+    spec = importlib.util.spec_from_file_location(
+        "wd_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "tpu_watchdog.py"),
+    )
+    wd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(wd)
+    wd.REPO = str(repo)
+    wd.OUT_DIR = str(repo / "profiles" / "tpu_v5e")
+    wd.STATE_DIR = str(tmp_path / "state")
+    wd.LOG_PATH = os.path.join(wd.STATE_DIR, "watchdog.log")
+    wd.STATUS_PATH = os.path.join(wd.STATE_DIR, "status.json")
+    return wd, str(repo)
+
+
+class TestCaptureSuccess:
+    def test_bench_capture_commits_verified_record(self, sandbox):
+        wd, repo = sandbox
+        assert wd.capture_bench() is True
+        log = _git(repo, "log", "--oneline")
+        assert "on-chip bench capture" in log
+        # Exactly the artifact, committed under the pathspec.
+        files = _git(repo, "show", "--stat", "--name-only",
+                     "--format=", "HEAD").split()
+        assert len(files) == 1 and files[0].startswith("profiles/tpu_v5e/")
+        rec = json.loads(
+            (_git(repo, "show", f"HEAD:{files[0]}"))
+        )
+        assert rec["record"]["value"] == 1800.0
+        assert rec["record"]["backend"] == "tpu"
+
+    def test_profiles_and_slo_demo_capture(self, sandbox):
+        wd, repo = sandbox
+        assert wd.capture_profiles() is True
+        assert wd.capture_slo_demo() is True
+        log = _git(repo, "log", "--oneline")
+        assert "profile tables" in log and "SLO demo" in log
+        tracked = _git(repo, "ls-files", "profiles/tpu_v5e").split()
+        assert "profiles/tpu_v5e/resnet50_summary.csv" in tracked
+        assert "profiles/tpu_v5e/slo_demo.json" in tracked
+
+    def test_builder_staged_files_not_swept(self, sandbox):
+        """The pathspec scoping: a concurrently staged builder file must
+        not ride along in an artifact commit."""
+        wd, repo = sandbox
+        with open(os.path.join(repo, "builder_wip.py"), "w") as f:
+            f.write("wip = True\n")
+        _git(repo, "add", "builder_wip.py")
+        assert wd.capture_bench() is True
+        files = _git(repo, "show", "--name-only", "--format=",
+                     "HEAD").split()
+        assert all(f.startswith("profiles/tpu_v5e/") for f in files)
+        # Still staged, still uncommitted — exactly as the builder left it.
+        assert "builder_wip.py" in _git(repo, "diff", "--cached",
+                                        "--name-only")
+
+
+class TestCaptureRejection:
+    def test_cpu_backend_record_rejected_and_not_committed(self, sandbox):
+        wd, repo = sandbox
+        with open(os.path.join(repo, "bench.py"), "w") as f:
+            f.write(
+                "import json\n"
+                "print(json.dumps({'metric': 'llm_tok_s_per_chip',"
+                " 'value': 900.0, 'backend': 'cpu'}))\n"
+            )
+        head = _git(repo, "rev-parse", "HEAD")
+        assert wd.capture_bench() is False
+        assert _git(repo, "rev-parse", "HEAD") == head  # nothing committed
+        # Failure recorded outside the repo for diagnosis.
+        fails = os.listdir(os.path.join(wd.STATE_DIR, "failures"))
+        assert any(f.startswith("bench") for f in fails)
+
+    def test_failed_step_residue_discarded(self, sandbox):
+        """CPU-tainted CSVs from a failed profiles step must not survive
+        to be swept into a later step's commit."""
+        wd, repo = sandbox
+        with open(os.path.join(repo, "tools", "run_profiles.py"), "w") as f:
+            f.write(
+                "import os, sys\n"
+                "print('backend=cpu devices=[Cpu]')\n"
+                "out = sys.argv[1]\n"
+                "os.makedirs(out, exist_ok=True)\n"
+                "open(os.path.join(out, 'resnet50_summary.csv'), 'w')"
+                ".write('tainted\\n')\n"
+            )
+        assert wd.capture_profiles() is False
+        assert not os.path.exists(
+            os.path.join(wd.OUT_DIR, "resnet50_summary.csv")
+        )
+        # ...but the residue is archived outside the repo, not destroyed.
+        assert os.path.exists(os.path.join(
+            wd.STATE_DIR, "salvage", "resnet50_summary.csv"
+        ))
+
+    def test_bench_error_record_rejected(self, sandbox):
+        wd, repo = sandbox
+        with open(os.path.join(repo, "bench.py"), "w") as f:
+            f.write(
+                "import json\n"
+                "print(json.dumps({'metric': 'llm_tok_s_per_chip',"
+                " 'value': 0.0, 'backend': 'tpu',"
+                " 'error': 'device probe timed out'}))\n"
+            )
+        head = _git(repo, "rev-parse", "HEAD")
+        assert wd.capture_bench() is False
+        assert _git(repo, "rev-parse", "HEAD") == head
