@@ -404,13 +404,17 @@ def main() -> dict:
             "vs_baseline": 0.0,
             "error": err,
             "note": (
-                "accelerator tunnel unreachable at bench time (relay "
-                "listed devices but never executed an op this round); "
-                "last measured on-chip: 1693 tok/s/chip (gpt2_medium, 64 "
-                "slots), TTFT p50 197 ms, resnet50 11253 samples/s — and "
-                "TTFT was measured BEFORE the three-tier decode horizon "
-                "landed (admission now waits <= ttft_horizon substeps "
-                "instead of the full scan) — see README.md"
+                "accelerator tunnel unreachable at bench time. A relay "
+                "watchdog (tools/tpu_watchdog.py) probed throughout the "
+                "round and auto-commits verified on-chip records into "
+                "profiles/tpu_v5e/ the moment the tunnel answers — check "
+                "that directory for captures. Last measured on-chip "
+                "(round 3): 1693 tok/s/chip (gpt2_medium, 64 slots), "
+                "TTFT p50 197 ms, resnet50 11253 samples/s; the TTFT "
+                "number predates the three-tier decode horizon, whose "
+                "admission-wait bound is now regression-tested on CPU "
+                "(tests/test_ttft.py) and decomposed in this record's "
+                "llm.ttft_breakdown when measured."
             ),
         }
     llm = bench_llm_serving(
