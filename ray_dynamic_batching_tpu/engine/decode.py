@@ -464,11 +464,11 @@ class DecodeEngine:
         if session_cache_size > 0:
             self.session_cache = SessionCache(session_cache_size)
         self._prefill_fns: Dict[int, Callable] = {}
-        # Donations: cache (arg 1) and counts (arg 11 — params=0,
-        # cache=1, tokens=2, active=3, horizon=4, temps=5, topk=6,
-        # seeds=7, tok_idx0=8, bias_ids=9, bias_vals=10, counts=11).
+        # Donations: cache (arg 1) and counts (arg 10 — params=0,
+        # cache=1, tokens=2, active=3, horizon=4, samp_f=5, samp_i=6,
+        # tok_idx0=7, bias_ids=8, bias_vals=9, counts=10).
         self._decode_fn = jax.jit(
-            self._decode_impl, donate_argnums=(1, 11), static_argnums=(4,)
+            self._decode_impl, donate_argnums=(1, 10), static_argnums=(4,)
         )
         # Speculative decoding (greedy rows only): a small draft proposes
         # spec_tokens continuations per slot, the target verifies the whole
@@ -634,18 +634,26 @@ class DecodeEngine:
         )
         return jnp.where(temps > 0.0, sampled, greedy)
 
-    def _prefill_impl(self, params, tokens, attn_mask, cache, slots,
-                      temps, topk, seeds, tok_idx, bias_ids, bias_vals,
-                      topp):
+    def _prefill_impl(self, params, tokmask, cache, meta_i, meta_f,
+                      bias_ids, bias_vals):
         """``nB`` prompts → cache rows at ``slots`` + first sampled tokens.
 
-        tokens/attn_mask are [nB, T]; ``slots`` is a traced [nB] int32
-        vector: one compiled program per (prompt bucket, group size) serves
-        every slot combination (dynamic start indices, static shapes).
-        Batching admissions into one program means ONE host round-trip per
+        Inputs arrive PACKED by dtype — ``tokmask`` [2, nB, T] stacks
+        tokens + attention mask, ``meta_i`` [4, nB] stacks
+        slots/top_k/seeds/tok_idx, ``meta_f`` [2, nB] stacks
+        temperature/top_p — so an admission group costs 5 host→device
+        transfers instead of 10; unpacking inside the program is free.
+        One compiled program per (prompt bucket, group size) serves every
+        slot combination (dynamic start indices, static shapes). Batching
+        admissions into one program means ONE host round-trip per
         admission group instead of per request — on hosts where dispatch
         latency dominates (e.g. a tunneled chip) this is the TTFT lever.
         """
+        tokens, attn_mask = tokmask[0], tokmask[1]
+        slots, topk, seeds, tok_idx = (
+            meta_i[0], meta_i[1], meta_i[2], meta_i[3]
+        )
+        temps, topp = meta_f[0], meta_f[1]
         params = self._mp(params)
         nB = tokens.shape[0]
         row_cache = self.model.make_cache(nB, self.max_len)
@@ -660,9 +668,14 @@ class DecodeEngine:
         return first, cache
 
     def _decode_impl(self, params, cache, tokens, active, horizon: int,
-                     temps, topk, seeds, tok_idx0, bias_ids, bias_vals,
-                     counts, pres, freq, topp):
+                     samp_f, samp_i, tok_idx0, bias_ids, bias_vals,
+                     counts):
         """``horizon`` chained decode steps in one program (one host sync).
+
+        Per-slot sampling state arrives packed by dtype — ``samp_f``
+        [4, B] stacks temperature/top_p/presence/frequency, ``samp_i``
+        [2, B] stacks top_k/seeds — so a sampling-state refresh costs two
+        transfers instead of eight (tunnel RTTs are the unit of cost).
 
         Rows already at capacity produce garbage logits (decode_step masks
         their scatter); fold the in-bounds check into the mask so their
@@ -673,7 +686,10 @@ class DecodeEngine:
         [2h+1, B] (h token rows, h advanced rows, 1 lengths row) so the
         device→host boundary is crossed once per dispatch, not three times.
         """
-
+        temps, topp, pres, freq = (
+            samp_f[0], samp_f[1], samp_f[2], samp_f[3]
+        )
+        topk, seeds = samp_i[0], samp_i[1]
         rows = jnp.arange(tokens.shape[0])
 
         def substep(carry, j):
@@ -796,10 +812,12 @@ class DecodeEngine:
             lengths=dcache.lengths + jnp.where(active, counts, 0)
         )
 
-    def _draft_prefill_impl(self, dparams, tokens, attn_mask, dcache, slots):
+    def _draft_prefill_impl(self, dparams, tokmask, dcache, meta_i):
         """Mirror of ``_prefill_impl`` for the draft model: fill the draft
         cache's rows for newly admitted prompts (no sampling — the draft
-        only ever proposes from its cache)."""
+        only ever proposes from its cache). Takes the target prefill's
+        packed device buffers verbatim — zero extra transfers."""
+        tokens, attn_mask, slots = tokmask[0], tokmask[1], meta_i[0]
         nB = tokens.shape[0]
         row_cache = self.draft_model.make_cache(nB, dcache.capacity)
         _, rows = self.draft_model.prefill(dparams, tokens, attn_mask,
@@ -809,7 +827,8 @@ class DecodeEngine:
     def _draft_prefill_fn(self, bucket: int, group: int) -> Callable:
         fn = self._prefill_fns.get(("draft", bucket, group))
         if fn is None:
-            fn = jax.jit(self._draft_prefill_impl, donate_argnums=(3,))
+            # Donate the draft cache (arg 2 in the packed signature).
+            fn = jax.jit(self._draft_prefill_impl, donate_argnums=(2,))
             self._prefill_fns[("draft", bucket, group)] = fn
         return fn
 
@@ -829,8 +848,8 @@ class DecodeEngine:
     def _prefill_fn(self, bucket: int, group: int) -> Callable:
         fn = self._prefill_fns.get((bucket, group))
         if fn is None:
-            # Donate the big cache (arg 3) — updated in place in HBM.
-            fn = jax.jit(self._prefill_impl, donate_argnums=(3,))
+            # Donate the big cache (arg 2) — updated in place in HBM.
+            fn = jax.jit(self._prefill_impl, donate_argnums=(2,))
             self._prefill_fns[(bucket, group)] = fn
         return fn
 
@@ -843,37 +862,47 @@ class DecodeEngine:
     def _warmup_impl(self) -> None:
         for b in self.prompt_buckets:
             for g in self._admit_group_sizes():
-                tokens = jnp.zeros((g, b), dtype=jnp.int32)
-                mask = jnp.ones((g, b), dtype=jnp.int32)
-                slots = jnp.arange(g, dtype=jnp.int32) % self.num_slots
-                first, self._cache = self._prefill_fn(b, g)(
-                    self.params, tokens, mask, self._cache, slots,
+                tokmask = jnp.stack([
+                    jnp.zeros((g, b), dtype=jnp.int32),
+                    jnp.ones((g, b), dtype=jnp.int32),
+                ])
+                meta_i = jnp.stack([
+                    jnp.arange(g, dtype=jnp.int32) % self.num_slots,
+                    jnp.zeros((g,), jnp.int32),
+                    jnp.zeros((g,), jnp.int32),
+                    jnp.zeros((g,), jnp.int32),
+                ])
+                meta_f = jnp.stack([
                     jnp.zeros((g,), jnp.float32),
-                    jnp.zeros((g,), jnp.int32),
-                    jnp.zeros((g,), jnp.int32),
-                    jnp.zeros((g,), jnp.int32),
+                    jnp.ones((g,), jnp.float32),
+                ])
+                first, self._cache = self._prefill_fn(b, g)(
+                    self.params, tokmask, self._cache, meta_i, meta_f,
                     jnp.zeros((g, self.max_bias_entries), jnp.int32),
                     jnp.zeros((g, self.max_bias_entries), jnp.float32),
-                    jnp.ones((g,), jnp.float32),
                 )
                 first.block_until_ready()
+        B = self.num_slots
+        warm_samp_f = jnp.stack([
+            jnp.zeros((B,), jnp.float32),
+            jnp.ones((B,), jnp.float32),
+            jnp.zeros((B,), jnp.float32),
+            jnp.zeros((B,), jnp.float32),
+        ])
+        warm_samp_i = jnp.zeros((2, B), jnp.int32)
         for h in {1, self.ttft_horizon, self.decode_horizon}:
             packed, self._cache, self._counts = self._decode_fn(
                 self.params,
                 self._cache,
-                jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
-                jnp.zeros((self.num_slots,), dtype=bool),
+                jnp.zeros((B, 1), dtype=jnp.int32),
+                jnp.zeros((B,), dtype=bool),
                 h,
-                jnp.zeros((self.num_slots,), jnp.float32),
-                jnp.zeros((self.num_slots,), jnp.int32),
-                jnp.zeros((self.num_slots,), jnp.int32),
-                jnp.zeros((self.num_slots,), jnp.int32),
-                jnp.zeros((self.num_slots, self.max_bias_entries), jnp.int32),
-                jnp.zeros((self.num_slots, self.max_bias_entries), jnp.float32),
+                warm_samp_f,
+                warm_samp_i,
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B, self.max_bias_entries), jnp.int32),
+                jnp.zeros((B, self.max_bias_entries), jnp.float32),
                 self._counts,
-                jnp.zeros((self.num_slots,), jnp.float32),
-                jnp.zeros((self.num_slots,), jnp.float32),
-                jnp.ones((self.num_slots,), jnp.float32),
             )
             packed.block_until_ready()
         if self._dcache is not None:
@@ -881,10 +910,17 @@ class DecodeEngine:
                 for g in self._admit_group_sizes():
                     self._dcache = self._draft_prefill_fn(b, g)(
                         self.draft_params,
-                        jnp.zeros((g, b), dtype=jnp.int32),
-                        jnp.ones((g, b), dtype=jnp.int32),
+                        jnp.stack([
+                            jnp.zeros((g, b), dtype=jnp.int32),
+                            jnp.ones((g, b), dtype=jnp.int32),
+                        ]),
                         self._dcache,
-                        jnp.arange(g, dtype=jnp.int32) % self.num_slots,
+                        jnp.stack([
+                            jnp.arange(g, dtype=jnp.int32) % self.num_slots,
+                            jnp.zeros((g,), jnp.int32),
+                            jnp.zeros((g,), jnp.int32),
+                            jnp.zeros((g,), jnp.int32),
+                        ]),
                     )
             packed, self._cache, self._dcache = self._spec_fn(
                 self.params,
@@ -1186,28 +1222,31 @@ class DecodeEngine:
             bias_ids[i] = bias_ids[0]
             bias_vals[i] = bias_vals[0]
 
+        # Dtype-packed uploads: 5 transfers per admission group instead
+        # of 10 (tok_idx is constant zero — prefill samples token 0 — so
+        # it rides the int pack), and the draft prefill reuses the SAME
+        # device buffers instead of re-uploading tokens/mask/slots.
+        tokmask_d = jnp.asarray(np.stack([tokens, mask]))
+        meta_i_d = jnp.asarray(np.stack([
+            slots, topk, seeds, np.zeros((group,), np.int32),
+        ]))
+        meta_f_d = jnp.asarray(np.stack([temps, topp]))
         first, self._cache = self._prefill_fn(bucket, group)(
             self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(mask),
+            tokmask_d,
             self._cache,
-            jnp.asarray(slots),
-            jnp.asarray(temps),
-            jnp.asarray(topk),
-            jnp.asarray(seeds),
-            jnp.zeros((group,), jnp.int32),  # prefill samples token 0
+            meta_i_d,
+            meta_f_d,
             jnp.asarray(bias_ids),
             jnp.asarray(bias_vals),
-            jnp.asarray(topp),
         )
         if self._dcache is not None:
             # The draft must see the same prompt: fill its cache rows too.
             self._dcache = self._draft_prefill_fn(bucket, group)(
                 self.draft_params,
-                jnp.asarray(tokens),
-                jnp.asarray(mask),
+                tokmask_d,
                 self._dcache,
-                jnp.asarray(slots),
+                meta_i_d,
             )
         first_host = np.asarray(first)  # ONE fetch for the whole group
         t = now_ms()
@@ -1221,17 +1260,20 @@ class DecodeEngine:
             self._mp(params), tokens, attn_mask, row_cache, start, take_idx
         )
 
-    def _commit_long_impl(self, cache, row_cache, slot, last_logits,
-                          temps, topk, seeds, tok_idx, bias_ids, bias_vals,
-                          topp):
+    def _commit_long_impl(self, cache, row_cache, meta_i, last_logits,
+                          meta_f, bias_ids, bias_vals):
         """Copy the finished row cache into the big cache at ``slot`` and
         sample the first token — one dispatch closes the admission. The row
         cache is a whole number of chunks, so it can be LONGER than the
         shared cache; the static slice keeps only real capacity (positions
-        past ``lengths`` are garbage either way and never attended)."""
-        cache = commit_row(cache, row_cache, slot)
-        first = self._sample_tokens(last_logits, temps, topk, seeds, tok_idx,
-                                    bias_ids, bias_vals, topp)
+        past ``lengths`` are garbage either way and never attended).
+        ``meta_i`` [3] packs slot/top_k/seed, ``meta_f`` [2] packs
+        temperature/top_p (tok_idx is always 0 for a first sample)."""
+        cache = commit_row(cache, row_cache, meta_i[0])
+        first = self._sample_tokens(
+            last_logits, meta_f[0:1], meta_i[1:2], meta_i[2:3],
+            jnp.zeros((1,), jnp.int32), bias_ids, bias_vals, meta_f[1:2],
+        )
         return first, cache
 
     def _seed_prefix_impl(self, row_cache, pk, pv):
@@ -1295,15 +1337,15 @@ class DecodeEngine:
         first, self._cache = commit_fn(
             self._cache,
             row,
-            jnp.int32(slot_idx),
+            jnp.asarray(np.asarray(
+                [slot_idx, opts["top_k"], opts["seed"]], np.int32
+            )),
             last,
-            jnp.asarray([opts["temperature"]], np.float32),
-            jnp.asarray([opts["top_k"]], np.int32),
-            jnp.asarray([opts["seed"]], np.int32),
-            jnp.zeros((1,), jnp.int32),
+            jnp.asarray(np.asarray(
+                [opts["temperature"], opts["top_p"]], np.float32
+            )),
             jnp.asarray(bids[None]),
             jnp.asarray(bvals[None]),
-            jnp.asarray([opts["top_p"]], np.float32),
         )
         if self._dcache is not None:
             self._draft_long_fill(prompt, slot_idx, C)
@@ -1546,7 +1588,12 @@ class DecodeEngine:
         self._bias_vals[slot_idx] = 0.0
         self._pres[slot_idx] = 0.0
         self._freq[slot_idx] = 0.0
-        self._sampling_dev = None  # host arrays changed
+        # NO device-array invalidation here: the freed slot's stale device
+        # values are masked (inactive rows' samples are discarded and add
+        # zero to counts), and _register refreshes the row before any
+        # reuse — invalidating on every completion forced a full re-upload
+        # of all eight sampling arrays per finished sequence, pure tunnel
+        # overhead at high completion churn.
         self.completed += 1
 
     def _pick_horizon(self) -> int:
@@ -1589,16 +1636,18 @@ class DecodeEngine:
         self._ttft_parts.clear()
 
     def _sampling_arrays(self):
+        """Device copies of the per-slot sampling state, PACKED by dtype:
+        (samp_f [4,B] = temps/topp/pres/freq, samp_i [2,B] = topk/seeds,
+        bias_ids [B,K], bias_vals [B,K]) — four transfers per refresh
+        instead of eight."""
         if self._sampling_dev is None:
             self._sampling_dev = (
-                jnp.asarray(self._temps),
-                jnp.asarray(self._topk),
-                jnp.asarray(self._topp),
-                jnp.asarray(self._seeds),
+                jnp.asarray(np.stack(
+                    [self._temps, self._topp, self._pres, self._freq]
+                )),
+                jnp.asarray(np.stack([self._topk, self._seeds])),
                 jnp.asarray(self._bias_ids),
                 jnp.asarray(self._bias_vals),
-                jnp.asarray(self._pres),
-                jnp.asarray(self._freq),
             )
         return self._sampling_dev
 
@@ -1621,7 +1670,7 @@ class DecodeEngine:
 
     def _spec_step(self) -> None:
         k = self.spec_tokens
-        (_t, _k, _p, _s, bias_ids_d, bias_vals_d, _pr, _fr) = \
+        (_samp_f, _samp_i, bias_ids_d, bias_vals_d) = \
             self._sampling_arrays()
         self._scan_start_ms = now_ms()
         packed, self._cache, self._dcache = self._spec_fn(
@@ -1641,11 +1690,15 @@ class DecodeEngine:
         self.steps += 1
         DECODE_STEPS.inc(tags={"model": self.model.name})
         SPEC_ROUNDS.inc(tags={"model": self.model.name})
-        for i, slot in enumerate(self._slots):
-            if not slot.free and self._active_mask[i] and n_out[i] > 0:
-                SPEC_ACCEPTED.inc(
-                    int(n_out[i]) - 1, tags={"model": self.model.name}
-                )
+        live = np.asarray([
+            not slot.free and self._active_mask[i] and n_out[i] > 0
+            for i, slot in enumerate(self._slots)
+        ])
+        if live.any():  # one summed increment, not one .inc() per slot
+            SPEC_ACCEPTED.inc(
+                int((n_out[live] - 1).sum()),
+                tags={"model": self.model.name},
+            )
         # Same harvest as the plain scan, with advanced = (j < n_out):
         # a short row is draft rejection, not cache capacity.
         self._harvest(
@@ -1667,8 +1720,7 @@ class DecodeEngine:
         )
         prev_tokens = self._tokens.copy()  # draft catch-up window head
         active_at_dispatch = self._active_mask.copy()
-        (temps_d, topk_d, topp_d, seeds_d, bias_ids_d, bias_vals_d,
-         pres_d, freq_d) = self._sampling_arrays()
+        samp_f, samp_i, bias_ids_d, bias_vals_d = self._sampling_arrays()
         self._scan_start_ms = now_ms()
         packed, self._cache, self._counts = self._decode_fn(
             self.params,
@@ -1676,16 +1728,12 @@ class DecodeEngine:
             jnp.asarray(self._tokens),
             jnp.asarray(active_at_dispatch),
             h,
-            temps_d,
-            topk_d,
-            seeds_d,
+            samp_f,
+            samp_i,
             jnp.asarray(tok_idx),
             bias_ids_d,
             bias_vals_d,
             self._counts,
-            pres_d,
-            freq_d,
-            topp_d,
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch
         self._scan_end_ms = now_ms()

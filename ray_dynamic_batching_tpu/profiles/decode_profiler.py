@@ -109,18 +109,18 @@ class DecodeProfiler:
         engine = self._engine(num_slots, max_len, prompt_bucket=8, group=1)
         try:
             B = num_slots
-            (temps, topk, topp, seeds, bias_ids, bias_vals, pres, freq) = \
+            (samp_f, samp_i, bias_ids, bias_vals) = \
                 engine._sampling_arrays()
             tokens = jnp.ones((B, 1), jnp.int32)
             active = jnp.ones((B,), bool)
             tok_idx = jnp.zeros((B,), jnp.int32)
             fn = jax.jit(
-                engine._decode_impl, donate_argnums=(1, 11),
+                engine._decode_impl, donate_argnums=(1, 10),
                 static_argnums=(4,),
             )
             args = (engine.params, engine._cache, tokens, active, 1,
-                    temps, topk, seeds, tok_idx, bias_ids, bias_vals,
-                    engine._counts, pres, freq, topp)
+                    samp_f, samp_i, tok_idx, bias_ids, bias_vals,
+                    engine._counts)
             t0 = time.perf_counter()
             compiled = fn.lower(*args).compile()
             compile_ms = (time.perf_counter() - t0) * 1000.0
@@ -128,8 +128,8 @@ class DecodeProfiler:
 
             cache, counts = engine._cache, engine._counts
             run_args = lambda: (engine.params, cache, tokens, active,  # noqa: E731
-                                temps, topk, seeds, tok_idx, bias_ids,
-                                bias_vals, counts, pres, freq, topp)
+                                samp_f, samp_i, tok_idx, bias_ids,
+                                bias_vals, counts)
             for _ in range(self.warmup_iters):
                 packed, cache, counts = compiled(*run_args())
             float(np.asarray(packed)[0, 0])
@@ -169,21 +169,27 @@ class DecodeProfiler:
         num_slots = max(2, group)
         engine = self._engine(num_slots, max_len, prompt_bucket, group)
         try:
-            tokens = jnp.ones((group, prompt_bucket), jnp.int32)
-            mask = jnp.ones((group, prompt_bucket), jnp.int32)
-            slots = jnp.arange(group, dtype=jnp.int32) % num_slots
-            temps = jnp.zeros((group,), jnp.float32)
-            topk = jnp.zeros((group,), jnp.int32)
-            topp = jnp.ones((group,), jnp.float32)
-            seeds = jnp.zeros((group,), jnp.int32)
-            tok_idx = jnp.zeros((group,), jnp.int32)
+            tokmask = jnp.stack([
+                jnp.ones((group, prompt_bucket), jnp.int32),
+                jnp.ones((group, prompt_bucket), jnp.int32),
+            ])
+            meta_i = jnp.stack([
+                jnp.arange(group, dtype=jnp.int32) % num_slots,
+                jnp.zeros((group,), jnp.int32),
+                jnp.zeros((group,), jnp.int32),
+                jnp.zeros((group,), jnp.int32),
+            ])
+            meta_f = jnp.stack([
+                jnp.zeros((group,), jnp.float32),
+                jnp.ones((group,), jnp.float32),
+            ])
             bias_ids = jnp.zeros((group, engine.max_bias_entries), jnp.int32)
             bias_vals = jnp.zeros(
                 (group, engine.max_bias_entries), jnp.float32
             )
-            fn = jax.jit(engine._prefill_impl, donate_argnums=(3,))
-            args = (engine.params, tokens, mask, engine._cache, slots,
-                    temps, topk, seeds, tok_idx, bias_ids, bias_vals, topp)
+            fn = jax.jit(engine._prefill_impl, donate_argnums=(2,))
+            args = (engine.params, tokmask, engine._cache, meta_i, meta_f,
+                    bias_ids, bias_vals)
             t0 = time.perf_counter()
             compiled = fn.lower(*args).compile()
             compile_ms = (time.perf_counter() - t0) * 1000.0
@@ -191,18 +197,16 @@ class DecodeProfiler:
 
             cache = engine._cache
             for _ in range(self.warmup_iters):
-                first, cache = compiled(engine.params, tokens, mask, cache,
-                                        slots, temps, topk, seeds, tok_idx,
-                                        bias_ids, bias_vals, topp)
+                first, cache = compiled(engine.params, tokmask, cache,
+                                        meta_i, meta_f, bias_ids, bias_vals)
             float(np.asarray(first)[0])
             samples = []
             for _ in range(3):
                 t0 = time.perf_counter()
                 for _ in range(self.timing_iters):
-                    first, cache = compiled(engine.params, tokens, mask,
-                                            cache, slots, temps, topk,
-                                            seeds, tok_idx, bias_ids,
-                                            bias_vals, topp)
+                    first, cache = compiled(engine.params, tokmask, cache,
+                                            meta_i, meta_f, bias_ids,
+                                            bias_vals)
                 float(np.asarray(first)[0])
                 samples.append(
                     (time.perf_counter() - t0) * 1000.0 / self.timing_iters
