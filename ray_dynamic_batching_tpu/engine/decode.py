@@ -464,11 +464,11 @@ class DecodeEngine:
         if session_cache_size > 0:
             self.session_cache = SessionCache(session_cache_size)
         self._prefill_fns: Dict[int, Callable] = {}
-        # Donations: cache (arg 1) and counts (arg 10 — params=0,
-        # cache=1, tokens=2, active=3, horizon=4, samp_f=5, samp_i=6,
-        # tok_idx0=7, bias_ids=8, bias_vals=9, counts=10).
+        # Donations: cache (arg 1) and counts (arg 8 — params=0,
+        # cache=1, step_state=2, horizon=3, samp_f=4, samp_i=5,
+        # bias_ids=6, bias_vals=7, counts=8).
         self._decode_fn = jax.jit(
-            self._decode_impl, donate_argnums=(1, 10), static_argnums=(4,)
+            self._decode_impl, donate_argnums=(1, 8), static_argnums=(3,)
         )
         # Speculative decoding (greedy rows only): a small draft proposes
         # spec_tokens continuations per slot, the target verifies the whole
@@ -667,15 +667,17 @@ class DecodeEngine:
         )  # [nB]
         return first, cache
 
-    def _decode_impl(self, params, cache, tokens, active, horizon: int,
-                     samp_f, samp_i, tok_idx0, bias_ids, bias_vals,
-                     counts):
+    def _decode_impl(self, params, cache, step_state, horizon: int,
+                     samp_f, samp_i, bias_ids, bias_vals, counts):
         """``horizon`` chained decode steps in one program (one host sync).
 
-        Per-slot sampling state arrives packed by dtype — ``samp_f``
-        [4, B] stacks temperature/top_p/presence/frequency, ``samp_i``
-        [2, B] stacks top_k/seeds — so a sampling-state refresh costs two
-        transfers instead of eight (tunnel RTTs are the unit of cost).
+        The per-DISPATCH state arrives as ONE packed [3, B] int32 upload —
+        rows = pending tokens / active mask / next sample index — instead
+        of three separate transfers; per-slot sampling state arrives
+        packed by dtype — ``samp_f`` [4, B] stacks
+        temperature/top_p/presence/frequency, ``samp_i`` [2, B] stacks
+        top_k/seeds — so a sampling-state refresh costs two transfers
+        instead of eight (tunnel RTTs are the unit of cost).
 
         Rows already at capacity produce garbage logits (decode_step masks
         their scatter); fold the in-bounds check into the mask so their
@@ -686,9 +688,16 @@ class DecodeEngine:
         [2h+1, B] (h token rows, h advanced rows, 1 lengths row) so the
         device→host boundary is crossed once per dispatch, not three times.
         """
-        temps, topp, pres, freq = (
-            samp_f[0], samp_f[1], samp_f[2], samp_f[3]
-        )
+        tokens = step_state[0][:, None]
+        active = step_state[1].astype(bool)
+        tok_idx0 = step_state[2]
+        # Mask sampling state to the ACTIVE rows in-program: freed slots
+        # keep stale device values (completions no longer re-upload), and
+        # a stale temperature>0 would otherwise hold _sample_tokens'
+        # runtime all-greedy lax.cond on the expensive branch for a whole
+        # traffic lull's worth of greedy-only dispatches.
+        temps = jnp.where(active, samp_f[0], 0.0)
+        topp, pres, freq = samp_f[1], samp_f[2], samp_f[3]
         topk, seeds = samp_i[0], samp_i[1]
         rows = jnp.arange(tokens.shape[0])
 
@@ -727,9 +736,11 @@ class DecodeEngine:
         )
         return packed, cache, counts
 
-    def _spec_impl(self, params, cache, dcache, tokens, active,
+    def _spec_impl(self, params, cache, dcache, step_state,
                    bias_ids, bias_vals):
         """One speculative round for the whole batch, greedy-exact.
+        ``step_state`` [2, B] int32 packs pending tokens + active mask
+        into the round's single per-dispatch upload.
 
         Draft scans ``k+1`` single-token steps (proposing d_1..d_k and
         keeping its own cache complete through d_k), the target scores the
@@ -742,6 +753,8 @@ class DecodeEngine:
         rows, an n_out row, and a post-round lengths row — one host fetch.
         """
         params = self._mp(params)
+        tokens = step_state[0][:, None]
+        active = step_state[1].astype(bool)
         k = self.spec_tokens
         B = tokens.shape[0]
         S = self.max_len  # shared-cache capacity
@@ -894,12 +907,10 @@ class DecodeEngine:
             packed, self._cache, self._counts = self._decode_fn(
                 self.params,
                 self._cache,
-                jnp.zeros((B, 1), dtype=jnp.int32),
-                jnp.zeros((B,), dtype=bool),
+                jnp.zeros((3, B), dtype=jnp.int32),
                 h,
                 warm_samp_f,
                 warm_samp_i,
-                jnp.zeros((B,), jnp.int32),
                 jnp.zeros((B, self.max_bias_entries), jnp.int32),
                 jnp.zeros((B, self.max_bias_entries), jnp.float32),
                 self._counts,
@@ -926,8 +937,7 @@ class DecodeEngine:
                 self.params,
                 self._cache,
                 self._dcache,
-                jnp.zeros((self.num_slots, 1), dtype=jnp.int32),
-                jnp.zeros((self.num_slots,), dtype=bool),
+                jnp.zeros((2, self.num_slots), dtype=jnp.int32),
                 jnp.zeros((self.num_slots, self.max_bias_entries), jnp.int32),
                 jnp.zeros((self.num_slots, self.max_bias_entries), jnp.float32),
             )
@@ -1677,8 +1687,10 @@ class DecodeEngine:
             self.params,
             self._cache,
             self._dcache,
-            jnp.asarray(self._tokens),
-            jnp.asarray(self._active_mask),
+            jnp.asarray(np.stack([
+                self._tokens[:, 0],
+                self._active_mask.astype(np.int32),
+            ])),
             bias_ids_d,
             bias_vals_d,
         )
@@ -1725,12 +1737,15 @@ class DecodeEngine:
         packed, self._cache, self._counts = self._decode_fn(
             self.params,
             self._cache,
-            jnp.asarray(self._tokens),
-            jnp.asarray(active_at_dispatch),
+            # ONE per-dispatch upload: tokens / active / sample index.
+            jnp.asarray(np.stack([
+                self._tokens[:, 0],
+                active_at_dispatch.astype(np.int32),
+                tok_idx,
+            ])),
             h,
             samp_f,
             samp_i,
-            jnp.asarray(tok_idx),
             bias_ids_d,
             bias_vals_d,
             self._counts,

@@ -111,24 +111,27 @@ class DecodeProfiler:
             B = num_slots
             (samp_f, samp_i, bias_ids, bias_vals) = \
                 engine._sampling_arrays()
-            tokens = jnp.ones((B, 1), jnp.int32)
-            active = jnp.ones((B,), bool)
-            tok_idx = jnp.zeros((B,), jnp.int32)
+            # Rows: pending tokens / active mask / sample index — the
+            # engine's single per-dispatch upload, all slots active.
+            step_state = jnp.stack([
+                jnp.ones((B,), jnp.int32),
+                jnp.ones((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+            ])
             fn = jax.jit(
-                engine._decode_impl, donate_argnums=(1, 10),
-                static_argnums=(4,),
+                engine._decode_impl, donate_argnums=(1, 8),
+                static_argnums=(3,),
             )
-            args = (engine.params, engine._cache, tokens, active, 1,
-                    samp_f, samp_i, tok_idx, bias_ids, bias_vals,
-                    engine._counts)
+            args = (engine.params, engine._cache, step_state, 1,
+                    samp_f, samp_i, bias_ids, bias_vals, engine._counts)
             t0 = time.perf_counter()
             compiled = fn.lower(*args).compile()
             compile_ms = (time.perf_counter() - t0) * 1000.0
             hbm_bytes = _program_hbm(compiled)
 
             cache, counts = engine._cache, engine._counts
-            run_args = lambda: (engine.params, cache, tokens, active,  # noqa: E731
-                                samp_f, samp_i, tok_idx, bias_ids,
+            run_args = lambda: (engine.params, cache, step_state,  # noqa: E731
+                                samp_f, samp_i, bias_ids,
                                 bias_vals, counts)
             for _ in range(self.warmup_iters):
                 packed, cache, counts = compiled(*run_args())

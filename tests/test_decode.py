@@ -604,7 +604,7 @@ class TestStreamingAndHorizon:
         dispatches = []
 
         def counting_fn(*args):
-            dispatches.append(args[4])  # the static horizon argument
+            dispatches.append(args[3])  # the static horizon argument
             return real_fn(*args)
 
         multi._decode_fn = counting_fn
