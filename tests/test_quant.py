@@ -133,3 +133,44 @@ class TestQuantizedServing:
                 num_slots=1, max_len=16, prompt_buckets=[8],
                 quantize_weights=True, mesh=FakeMesh(),
             )
+
+
+class TestHostQuantizedDeployment:
+    def test_prequantized_params_serve_through_deployment(self, lm):
+        """The exact mechanics of bench.py's guarded llama3_8b row at tiny
+        scale: init on the HOST, quantize there (an 8B bf16 on-device init
+        would OOM the chip), hand the int8 tree to
+        LLMDeployment(params=..., quantize_weights=True) — the flag makes
+        the ENGINE dequantize in-program while quantize_tree's idempotency
+        passes the pre-quantized tree through _ensure_model untouched."""
+        model, params = lm
+        qparams = quantize_tree(params)
+        from ray_dynamic_batching_tpu.serve.controller import (
+            DeploymentConfig,
+        )
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+        dep = LLMDeployment(
+            "llama_tiny", params=qparams, quantize_weights=True,
+            num_slots=2, max_len=64, prompt_buckets=[8],
+            default_max_new_tokens=5, dtype=jnp.float32, warmup=False,
+        )
+        replica = dep.make_replica(
+            "q8#0", DeploymentConfig(name="q8"),
+        )
+        replica.start()
+        try:
+            assert any(
+                hasattr(leaf, "dtype") and leaf.dtype == jnp.int8
+                for leaf in jax.tree_util.tree_leaves(replica.engine.params)
+            )
+            req = Request(
+                model="q8",
+                payload={"tokens": np.asarray([1, 2, 3], np.int32),
+                         "max_new_tokens": 5},
+                slo_ms=60_000.0,
+            )
+            assert replica.assign(req)
+            assert len(req.future.result(timeout=120).tokens) == 5
+        finally:
+            replica.stop(timeout_s=2.0)
