@@ -143,7 +143,7 @@ class TestHostQuantizedDeployment:
         LLMDeployment(params=..., quantize_weights=True) — the flag makes
         the ENGINE dequantize in-program while quantize_tree's idempotency
         passes the pre-quantized tree through _ensure_model untouched."""
-        model, params = lm
+        _, params = lm
         qparams = quantize_tree(params)
         from ray_dynamic_batching_tpu.serve.controller import (
             DeploymentConfig,
