@@ -55,7 +55,9 @@ LOG_PATH = os.path.join(STATE_DIR, "watchdog.log")
 
 PROBE_TIMEOUT_S = 180.0      # first on-chip compile can take ~40s
 BENCH_TIMEOUT_S = 45 * 60.0
-PROFILES_TIMEOUT_S = 60 * 60.0
+# The deepened sweep (profiler-stopped vision buckets + text seq buckets
+# + decode/prefill tables) can brush an hour of mostly-compile time.
+PROFILES_TIMEOUT_S = 90 * 60.0
 SLO_TIMEOUT_S = 30 * 60.0
 MAX_ATTEMPTS = 4             # per step, while the relay is alive
 
