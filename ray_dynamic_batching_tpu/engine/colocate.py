@@ -1,0 +1,274 @@
+"""Colocated decode execution — N continuous-batching engines on ONE chip.
+
+``scheduler.nexus.pack_llm_engines`` plans which decode engines share a
+chip by profiled compute fraction + resident HBM; this module is the
+execution side of that plan — the decode analogue of the duty-cycle
+executor (``engine/worker.py``), mirroring how the reference *executes*
+its packed schedules rather than only computing them
+(``293-project/src/scheduler.py:525-584``).
+
+One driver thread interleaves the co-resident engines at **horizon
+granularity**: each engine's turn is one admission pass plus one compiled
+scan (``DecodeEngine._step`` — ``decode_horizon`` substeps per dispatch).
+A compiled scan cannot be preempted mid-flight, so the scan IS the
+scheduling quantum, exactly like the duty-cycle packer's no-preemption
+occupancy discipline (``scheduler/nexus.py:86-88``): with round-robin
+turns, engine *i*'s share of chip time converges to
+``step_i / sum(step_j)`` over engines with active work, which is what the
+planner's ``compute_fraction`` admissibility assumes
+(``scheduler/nexus.py:326-376``). :meth:`busy_fractions` exposes the
+measured shares so tests can hold the model to the measurement.
+
+Engines attach/detach live (the LLM control loop migrates models between
+chips as token rates shift). Detach drains by default: the engine stops
+admitting immediately — its request queue is the *model's* shared queue,
+so new arrivals flow to wherever the model runs next — while in-flight
+sequences finish here; the engine's HBM (params + KV cache) is released
+only once its last slot completes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import nullcontext
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+from ray_dynamic_batching_tpu.engine.request import RequestDropped
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("colocate")
+
+
+@dataclass
+class HostedEngine:
+    """One co-resident engine plus its execution accounting."""
+
+    model: str
+    engine: DecodeEngine
+    placement: Any = None          # LLMPlacement the planner assigned (if any)
+    draining: bool = False
+    busy_ms: float = 0.0           # wall time spent inside this engine's turns
+    released: threading.Event = field(default_factory=threading.Event)
+
+
+class ColocatedLLMEngines:
+    """Round-robin interleaved execution of decode engines on one chip.
+
+    Engines must arrive *un-started* (their own loop thread replaced by
+    this executor's); all co-residents share the executor's device, so
+    the single ``jax.default_device`` scope covers every dispatch.
+    """
+
+    def __init__(
+        self,
+        device: Optional[Any] = None,
+        name: str = "chip0",
+        idle_wait_s: float = 0.002,
+    ) -> None:
+        self.device = device
+        self.name = name
+        self.idle_wait_s = idle_wait_s
+        self._hosted: Dict[str, HostedEngine] = {}
+        self._lock = threading.RLock()
+        self._run = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._wall_ms = 0.0
+
+    # --- membership (called by the control loop, any thread) ---------------
+    def attach(self, model: str, engine: DecodeEngine,
+               placement: Any = None) -> None:
+        if engine._thread is not None:
+            raise ValueError(
+                f"{model}: engine already runs its own loop — colocated "
+                "engines are stepped by the executor"
+            )
+        with self._lock:
+            if model in self._hosted and not self._hosted[model].draining:
+                raise ValueError(f"{model}: already hosted on {self.name}")
+            # A draining predecessor keeps finishing under a temporary key
+            # so its in-flight sequences aren't orphaned by the successor.
+            if model in self._hosted:
+                old = self._hosted.pop(model)
+                self._hosted[f"{model}@draining{id(old)}"] = old
+            self._hosted[model] = HostedEngine(model, engine, placement)
+        logger.info("%s: attached %s (slots=%d, cap=%d)", self.name, model,
+                    engine.num_slots, engine.max_len)
+
+    def detach(self, model: str, drain: bool = True) -> threading.Event:
+        """Stop admitting for ``model`` on this chip. With ``drain`` the
+        in-flight sequences finish first; the returned event is set once
+        the engine's buffers are released."""
+        with self._lock:
+            hosted = self._hosted.get(model)
+            if hosted is None:
+                ev = threading.Event()
+                ev.set()
+                return ev
+            hosted.draining = True
+            if not drain:
+                self._release(hosted)
+                self._hosted.pop(model, None)
+            return hosted.released
+
+    def _release(self, hosted: HostedEngine) -> None:
+        hosted.engine.abort_active(
+            RequestDropped(f"{hosted.model} detached from {self.name}")
+        )
+        hosted.engine.release_buffers()
+        hosted.released.set()
+        logger.info("%s: released %s", self.name, hosted.model)
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return [m for m, h in self._hosted.items() if not h.draining]
+
+    def placements(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                m: h.placement
+                for m, h in self._hosted.items() if not h.draining
+            }
+
+    def engine_for(self, model: str) -> Optional[DecodeEngine]:
+        with self._lock:
+            h = self._hosted.get(model)
+            return h.engine if h is not None and not h.draining else None
+
+    # --- execution ---------------------------------------------------------
+    def _turn(self, hosted: HostedEngine) -> bool:
+        """One scheduling quantum for one engine: admit (unless draining),
+        then at most one compiled scan. Returns True if compute ran."""
+        t0 = time.perf_counter()
+        engine = hosted.engine
+        stepped = False
+        with engine._device_ctx():
+            if not hosted.draining:
+                engine._admit()
+            if engine._active_mask.any():
+                engine._step()
+                stepped = True
+        engine.last_heartbeat = time.monotonic()
+        hosted.busy_ms += (time.perf_counter() - t0) * 1000.0
+        return stepped
+
+    def _pass(self) -> bool:
+        """One round-robin pass over every hosted engine."""
+        with self._lock:
+            hosted = list(self._hosted.items())
+        progressed = False
+        for key, h in hosted:
+            try:
+                progressed |= self._turn(h)
+            except Exception:  # noqa: BLE001 — one engine must not kill the chip
+                logger.exception("%s: turn failed for %s", self.name, h.model)
+                time.sleep(0.01)
+            if h.draining and h.engine.active_slots == 0:
+                with self._lock:
+                    self._release(h)
+                    # Pop by identity: a concurrent attach may have put a
+                    # REPLACEMENT engine under this snapshot's key (the
+                    # drained predecessor was renamed) — popping by key
+                    # alone would silently unhost the successor.
+                    if self._hosted.get(key) is h:
+                        self._hosted.pop(key, None)
+                    else:
+                        for k, v in list(self._hosted.items()):
+                            if v is h:
+                                self._hosted.pop(k, None)
+        return progressed
+
+    def step_once(self) -> bool:
+        """Test/driver hook: one pass without the thread."""
+        t0 = time.perf_counter()
+        progressed = self._pass()
+        self._wall_ms += (time.perf_counter() - t0) * 1000.0
+        return progressed
+
+    def run_until_idle(self, timeout_s: float = 60.0) -> None:
+        """Drive passes until every engine's queue and slots are empty."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            progressed = self.step_once()
+            with self._lock:
+                idle = all(
+                    h.engine.active_slots == 0 and len(h.engine.queue) == 0
+                    for h in self._hosted.values()
+                )
+            if idle and not progressed:
+                return
+        raise TimeoutError(f"{self.name}: colocated engines did not drain")
+
+    def _loop(self) -> None:
+        ctx = (
+            jax.default_device(self.device)
+            if self.device is not None else nullcontext()
+        )
+        with ctx:
+            while self._run.is_set():
+                t0 = time.perf_counter()
+                progressed = self._pass()
+                self._wall_ms += (time.perf_counter() - t0) * 1000.0
+                if not progressed:
+                    time.sleep(self.idle_wait_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._run.set()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"colocate-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._run.clear()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            if self._thread.is_alive():
+                logger.warning("%s: loop did not exit in %.1fs", self.name,
+                               timeout_s)
+            else:
+                self._thread = None
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        """Stop the loop and abort/release every hosted engine."""
+        self.stop(timeout_s)
+        with self._lock:
+            for h in list(self._hosted.values()):
+                self._release(h)
+            self._hosted.clear()
+
+    # --- accounting ---------------------------------------------------------
+    def busy_fractions(self) -> Dict[str, float]:
+        """Measured share of executor wall time each engine consumed —
+        the ground truth the planner's ``compute_fraction`` predicts."""
+        with self._lock:
+            wall = max(self._wall_ms, 1e-9)
+            return {m: h.busy_ms / wall for m, h in self._hosted.items()}
+
+    def reset_accounting(self) -> None:
+        with self._lock:
+            self._wall_ms = 0.0
+            for h in self._hosted.values():
+                h.busy_ms = 0.0
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return any(
+                h.engine.active_slots > 0 for h in self._hosted.values()
+            )
+
+    def describe(self) -> str:
+        with self._lock:
+            parts = ", ".join(
+                f"{m}(slots={h.engine.num_slots}, cap={h.engine.max_len}"
+                f"{', draining' if h.draining else ''})"
+                for m, h in self._hosted.items()
+            )
+        return f"{self.name}[{parts}]"

@@ -1,0 +1,341 @@
+"""Live LLM scheduler — token-rate monitoring, colocation replan, migration.
+
+The decode-side control plane: the LLM analogue of
+:class:`~ray_dynamic_batching_tpu.scheduler.control.LiveScheduler`
+(itself modeled on the reference's ``NexusScheduler`` monitor/rebalance
+loop, ``293-project/src/scheduler.py:602-929``). Per-model **token**
+rates (decode demand, tokens/s) feed the colocation planner
+(``scheduler.nexus.pack_llm_engines``); when a rate drifts past the
+threshold the plan is recomputed and applied with minimal movement:
+models keep their chip when their placement is unchanged, and a moved
+model's old engine *drains* (in-flight sequences finish where they
+started) while its successor admits from the model's shared queue on the
+new chip — the decode version of the reference's live rebalance
+(``293-project/src/scheduler.py:773-929``), with the drain discipline
+replacing its transfer of queued work.
+
+Execution rides :class:`~ray_dynamic_batching_tpu.engine.colocate.
+ColocatedLLMEngines` (one per chip). Engines are built by a caller-
+supplied factory so tests and deployments choose weights/sharding;
+:func:`deployment_engine_factory` adapts a dict of
+:class:`~ray_dynamic_batching_tpu.serve.llm.LLMDeployment` objects.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ray_dynamic_batching_tpu.engine.colocate import ColocatedLLMEngines
+from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+from ray_dynamic_batching_tpu.engine.queue import QueueManager, RequestQueue
+from ray_dynamic_batching_tpu.engine.rates import RateRegistry
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.scheduler.nexus import (
+    LLMPlacement,
+    LLMSession,
+    pack_llm_engines,
+)
+from ray_dynamic_batching_tpu.utils.config import get_config
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("llm_control")
+
+EngineFactory = Callable[[str, LLMPlacement, RequestQueue, object],
+                         DecodeEngine]
+
+
+@dataclass
+class LLMModelEntry:
+    """Registered decode serving contract (ref models_config,
+    ``293-project/src/scheduler.py:30-35`` — here per-token, not
+    per-request)."""
+
+    name: str
+    token_slo_ms: float
+    min_context: int = 0
+    # Demand estimate for requests that don't carry max_new_tokens: the
+    # rate registry counts TOKENS, so each submission records its decode
+    # demand up front (the monitor sees offered load, not completions —
+    # same inversion-avoidance as LiveScheduler.submit_request).
+    tokens_per_request: int = 64
+
+
+def deployment_engine_factory(
+    deployments: Dict[str, "object"],
+) -> EngineFactory:
+    """Adapt ``{model_name: LLMDeployment}`` to the factory protocol:
+    the planner's placement dictates (num_slots, capacity); the
+    deployment supplies weights, buckets, and horizons."""
+
+    def factory(model: str, placement: LLMPlacement,
+                queue: RequestQueue, device: object) -> DecodeEngine:
+        return deployments[model].build_engine(
+            queue, device=device, max_len=placement.capacity,
+            num_slots=placement.num_slots,
+        )
+
+    return factory
+
+
+class LLMLiveScheduler:
+    """The running decode control plane for a set of chips."""
+
+    def __init__(
+        self,
+        decode_profiles: Dict[str, BatchProfile],
+        chips: Sequence[ColocatedLLMEngines],
+        engine_factory: EngineFactory,
+        queues: Optional[QueueManager] = None,
+        rates: Optional[RateRegistry] = None,
+        compute_headroom: float = 0.85,
+        hbm_budget_bytes: Optional[int] = None,
+        metrics_path: Optional[str] = None,
+        clock=time.monotonic,
+    ) -> None:
+        cfg = get_config()
+        self.profiles = dict(decode_profiles)
+        self.chips = list(chips)
+        self.engine_factory = engine_factory
+        self.queues = queues or QueueManager(max_len=cfg.max_queue_len)
+        self.rates = rates or RateRegistry(window_s=cfg.rate_window_s,
+                                           clock=clock)
+        self.compute_headroom = compute_headroom
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.metrics_path = metrics_path
+        self.monitoring_interval_s = cfg.monitoring_interval_s
+        self.rate_threshold = cfg.rate_change_threshold
+        self.rate_decrease_multiplier = cfg.rate_decrease_multiplier
+        self._clock = clock
+        self._models: Dict[str, LLMModelEntry] = {}
+        self._current_plan: List[List[LLMPlacement]] = []
+        self._lock = threading.Lock()
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.schedule_changes = 0
+        self.migrations = 0
+        self.schedule_log: List[Dict] = []
+
+    # --- registration ------------------------------------------------------
+    def register_model(
+        self,
+        name: str,
+        token_slo_ms: float,
+        min_context: int = 0,
+        tokens_per_request: int = 64,
+    ) -> None:
+        if name not in self.profiles:
+            raise KeyError(f"no decode profile for model {name!r} — run "
+                           "the decode profiler (tools/run_profiles.py)")
+        self._models[name] = LLMModelEntry(
+            name, token_slo_ms, min_context, tokens_per_request
+        )
+
+    # --- ingress -----------------------------------------------------------
+    def submit_request(self, request: Request) -> bool:
+        entry = self._models.get(request.model)
+        if entry is None:
+            request.reject(
+                KeyError(f"model {request.model!r} not registered")
+            )
+            return False
+        tokens = entry.tokens_per_request
+        if isinstance(request.payload, dict):
+            tokens = int(
+                request.payload.get("max_new_tokens", tokens)
+            )
+        # Offered decode demand, recorded before the enqueue outcome
+        # (drops must not suppress the scale-up signal).
+        self.rates.record(request.model, n=max(1, tokens))
+        return self.queues.queue(request.model).add_request(request)
+
+    # --- planning ----------------------------------------------------------
+    def _sessions_for(
+        self, rates: Dict[str, float]
+    ) -> List[LLMSession]:
+        return [
+            LLMSession(
+                model=e.name,
+                rate_tok_s=rates.get(e.name, 0.0),
+                token_slo_ms=e.token_slo_ms,
+                min_context=e.min_context,
+            )
+            for e in self._models.values()
+            if rates.get(e.name, 0.0) > 0.0
+        ]
+
+    def _match_chips(
+        self, plan: List[List[LLMPlacement]]
+    ) -> List[Optional[List[LLMPlacement]]]:
+        """Assign planned chips to executors maximizing kept models
+        (minimal movement — the decode version of
+        ``control.match_plans_to_engines``'s objective; overlap count
+        stands in for transfer cost because every move costs a weight
+        upload + compile here too)."""
+        if len(plan) > len(self.chips):
+            logger.warning(
+                "plan needs %d chips but only %d executors; truncating "
+                "(capacity!)", len(plan), len(self.chips),
+            )
+            plan = plan[: len(self.chips)]
+        hosted = [set(c.models()) for c in self.chips]
+        assignment: List[Optional[List[LLMPlacement]]] = (
+            [None] * len(self.chips)
+        )
+        free = set(range(len(self.chips)))
+        # Largest chips pick first so a big overlap isn't stolen by a
+        # singleton plan.
+        for planned in sorted(plan, key=len, reverse=True):
+            names = {p.model for p in planned}
+            best = max(
+                free,
+                key=lambda i: (len(names & hosted[i]), -len(hosted[i])),
+            )
+            assignment[best] = planned
+            free.remove(best)
+        return assignment
+
+    def rebalance(
+        self, rates: Optional[Dict[str, float]] = None
+    ) -> List[List[LLMPlacement]]:
+        """Re-run colocation packing and migrate with minimal movement."""
+        with self._lock:
+            rates = rates if rates is not None else self.rates.rates()
+            sessions = self._sessions_for(rates)
+            try:
+                plan = pack_llm_engines(
+                    sessions,
+                    self.profiles,
+                    hbm_budget_bytes=self.hbm_budget_bytes,
+                    compute_headroom=self.compute_headroom,
+                ) if sessions else []
+            except ValueError as e:
+                # Infeasible demand: keep serving under the previous plan
+                # rather than tearing engines down (the SLO viewer shows
+                # red; the operator re-profiles or relaxes).
+                logger.warning("rebalance infeasible, keeping plan: %s", e)
+                return self._current_plan
+            assignment = self._match_chips(plan)
+            moved = self._apply(assignment)
+            self._current_plan = plan
+            self.rates.mark_scheduled(rates)
+            self.schedule_changes += 1
+            self.migrations += moved
+            self.schedule_log.append({
+                "ts": self._clock(),
+                "rates_tok_s": {k: round(v, 1) for k, v in rates.items()},
+                "chips": [
+                    [
+                        f"{p.model}(slots={p.num_slots}, cap={p.capacity}, "
+                        f"f={p.compute_fraction:.2f})"
+                        for p in (chip or [])
+                    ]
+                    for chip in assignment
+                ],
+                "moved_engines": moved,
+            })
+            logger.info(
+                "rebalance #%d: %d chips, %d engine moves for rates %s",
+                self.schedule_changes, len(plan), moved,
+                {k: round(v, 1) for k, v in rates.items()},
+            )
+            return plan
+
+    def _apply(
+        self, assignment: List[Optional[List[LLMPlacement]]]
+    ) -> int:
+        """Diff each chip's desired placement set against what it hosts;
+        drain leavers, build/attach joiners. Returns engines moved."""
+        moved = 0
+        desired_by_chip: List[Dict[str, LLMPlacement]] = [
+            {p.model: p for p in (chip or [])} for chip in assignment
+        ]
+        # Detach pass first: a model moving chips must stop admitting on
+        # its old chip before the new engine attaches, so the shared
+        # queue never feeds two admitting engines.
+        for chip, desired in zip(self.chips, desired_by_chip):
+            current = chip.placements()
+            for model in chip.models():
+                cur = current.get(model)
+                want = desired.get(model)
+                if want is None or not self._same_shape(cur, want):
+                    chip.detach(model, drain=True)
+                    moved += 1
+        for chip, desired in zip(self.chips, desired_by_chip):
+            hosted = set(chip.models())
+            for model, placement in desired.items():
+                if model in hosted:
+                    continue
+                engine = self.engine_factory(
+                    model, placement, self.queues.queue(model), chip.device
+                )
+                chip.attach(model, engine, placement)
+        return moved
+
+    @staticmethod
+    def _same_shape(cur: Optional[LLMPlacement],
+                    want: LLMPlacement) -> bool:
+        """An engine survives a replan iff its compiled shapes match the
+        new placement; fraction changes alone don't force a rebuild."""
+        return (
+            cur is not None
+            and cur.num_slots == want.num_slots
+            and cur.capacity == want.capacity
+        )
+
+    # --- monitor loop ------------------------------------------------------
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.monitoring_interval_s):
+            try:
+                changed = self.rates.changed_models(
+                    self.rate_threshold, self.rate_decrease_multiplier
+                )
+                if changed:
+                    logger.info("token-rate change detected: %s",
+                                {k: round(v, 1) for k, v in changed.items()})
+                    self.rebalance()
+                if self.metrics_path:
+                    self.write_metrics()
+            except Exception:  # noqa: BLE001
+                logger.exception("llm monitor iteration failed")
+
+    def start_monitoring(self) -> None:
+        if self._monitor is not None:
+            return
+        self._stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="rdb-llm-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    def stop_monitoring(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        self.stop_monitoring()
+        for chip in self.chips:
+            chip.shutdown(timeout_s)
+
+    # --- observability -----------------------------------------------------
+    def snapshot(self) -> Dict:
+        return {
+            "time": self._clock(),
+            "rates_tok_s": self.rates.rates(),
+            "scheduled_rates_tok_s": self.rates.scheduled_rates(),
+            "queues": self.queues.stats(),
+            "chips": [c.describe() for c in self.chips],
+            "busy_fractions": [c.busy_fractions() for c in self.chips],
+            "schedule_changes": self.schedule_changes,
+            "migrations": self.migrations,
+        }
+
+    def write_metrics(self) -> None:
+        with open(self.metrics_path, "w") as f:
+            json.dump(self.snapshot(), f, indent=2)

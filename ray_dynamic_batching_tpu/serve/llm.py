@@ -464,6 +464,7 @@ class LLMDeployment:
         max_len: Optional[int] = None,
         token_slo_ms: Optional[float] = None,
         ttft_slo_ms: Optional[float] = None,
+        num_slots: Optional[int] = None,
     ) -> Dict[str, int]:
         """Derive (num_slots, decode_horizon, ttft_horizon) from MEASURED
         decode tables + SLOs — the reference's profiled-latency control
@@ -482,6 +483,11 @@ class LLMDeployment:
           ttft-tier scan, then prefills; the TTFT budget left after the
           measured prefill latency (largest prompt bucket, group 1),
           with 20% headroom for queue/dispatch, bounds the tier.
+
+        ``num_slots`` pins the slot count (the colocation planner's
+        placement dictates it): horizons are then derived from THAT
+        config's measured step — horizons computed for a different batch
+        size would silently re-break the SLO the scan length encodes.
         """
         from ray_dynamic_batching_tpu.utils.config import get_config
 
@@ -492,10 +498,26 @@ class LLMDeployment:
         max_len = max_len or self.max_len
         token_slo_ms = token_slo_ms or self.token_slo_ms
         ttft_slo_ms = ttft_slo_ms or self.ttft_slo_ms
-        rows = [
+        candidates = [
             r for r in decode_profile.rows
-            if r.seq_len == max_len and 0 < r.hbm_bytes <= budget
+            if r.seq_len == max_len and r.hbm_bytes > 0
         ]
+        if num_slots is not None:
+            # Pin BEFORE the budget filter: a caller-pinned config (the
+            # colocation planner's placement) was already validated
+            # against the planner's own HBM budget — re-filtering it
+            # against the deployment's per-bucket slice would reject a
+            # measured row that exists and silently fall back to default
+            # horizons, the exact burst-SLO breach the pin prevents.
+            rows = [r for r in candidates if r.batch_size == num_slots]
+            if not rows:
+                raise ValueError(
+                    f"{self.model_name}: no measured decode row at "
+                    f"(slots={num_slots}, cap={max_len}) to derive "
+                    "horizons from"
+                )
+        else:
+            rows = [r for r in candidates if r.hbm_bytes <= budget]
         if token_slo_ms is not None:
             fitting = [r for r in rows if r.latency_ms <= token_slo_ms]
             if not fitting and rows:
@@ -536,16 +558,19 @@ class LLMDeployment:
         )
         return plan
 
-    def _table_plan(self, max_len: int) -> Optional[Dict[str, int]]:
-        """Load committed tables from ``profiles_dir`` once per capacity
-        bucket; None when the decode table is absent (callers fall back to
-        the analytic path)."""
+    def _table_plan(
+        self, max_len: int, num_slots: Optional[int] = None,
+    ) -> Optional[Dict[str, int]]:
+        """Load committed tables from ``profiles_dir`` once per
+        (capacity, pinned-slots) config; None when the decode table is
+        absent (callers fall back to the analytic path)."""
         import os
 
         if self.profiles_dir is None:
             return None
-        if max_len in self._table_plans:
-            return self._table_plans[max_len]
+        cache_key = (max_len, num_slots)
+        if cache_key in self._table_plans:
+            return self._table_plans[cache_key]
         from ray_dynamic_batching_tpu.profiles.table import BatchProfile
 
         decode_csv = os.path.join(
@@ -571,7 +596,8 @@ class LLMDeployment:
             )
         try:
             plan = self.plan_from_tables(
-                decode_profile, prefill_profile, max_len=max_len
+                decode_profile, prefill_profile, max_len=max_len,
+                num_slots=num_slots,
             )
         except ValueError as e:
             # A table that exists but has no row at this capacity (swept at
@@ -584,21 +610,34 @@ class LLMDeployment:
                 self.model_name, max_len, e,
             )
             plan = None
-        self._table_plans[max_len] = plan
+        self._table_plans[cache_key] = plan
         return plan
 
     def build_engine(
         self, queue: RequestQueue, device: Any = None, mesh: Any = None,
-        max_len: Optional[int] = None,
+        max_len: Optional[int] = None, num_slots: Optional[int] = None,
     ) -> DecodeEngine:
+        # ``num_slots`` override: the colocation control loop passes the
+        # planner's placement shape (scheduler/llm_control.py) — an
+        # explicit measured config outranks both the table plan and the
+        # analytic HBM model below.
         self._ensure_model()
         max_len = max_len or self.max_len
-        num_slots = self.num_slots
+        num_slots = num_slots if num_slots is not None else self.num_slots
         decode_horizon = self.decode_horizon
         ttft_horizon = self.ttft_horizon
         # Measured tables govern single-chip engines (they are per-chip
-        # measurements; a TP mesh shards the program they describe).
-        plan = self._table_plan(max_len) if mesh is None else None
+        # measurements; a TP mesh shards the program they describe). ANY
+        # pinned slot count — the caller's colocation placement or the
+        # deployment config's own num_slots — pins the plan to ITS
+        # measured row, so the horizons below always describe the config
+        # that actually runs, never the table's (different) best row.
+        plan = (
+            self._table_plan(
+                max_len, num_slots=num_slots if num_slots > 0 else None
+            )
+            if mesh is None else None
+        )
         if plan is not None:
             if num_slots <= 0:
                 num_slots = plan["num_slots"]

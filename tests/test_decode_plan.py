@@ -169,3 +169,52 @@ class TestTablesDriveTheEngine:
             assert engine.num_slots == 4  # pinned value survives
         finally:
             engine.release_buffers()
+
+    def test_pinned_slots_rederive_horizons_from_their_own_row(
+        self, tmp_path
+    ):
+        """A colocation placement pins num_slots; the horizons must come
+        from THAT config's measured step, not the table's own best row —
+        horizons sized for a faster config would deliver token bursts
+        past the SLO (code-review r5 finding)."""
+        decode_table().to_csv(
+            os.path.join(tmp_path, "llama_tiny_decode_summary.csv")
+        )
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+
+        dep = deployment(
+            num_slots=0, profiles_dir=str(tmp_path),
+            token_slo_ms=160.0, prompt_buckets=[8],
+        )
+        engine = dep.build_engine(
+            RequestQueue("llama_tiny", max_len=16), num_slots=128
+        )
+        try:
+            assert engine.num_slots == 128
+            # 160 // 45 (the 128-slot row's step) == 3, not 160 // 20 == 8
+            # (the unpinned plan's 64-slot step).
+            assert engine.decode_horizon == 3
+        finally:
+            engine.release_buffers()
+
+    def test_pinned_slots_without_a_row_fall_back_to_defaults(
+        self, tmp_path
+    ):
+        decode_table().to_csv(
+            os.path.join(tmp_path, "llama_tiny_decode_summary.csv")
+        )
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+
+        dep = deployment(
+            num_slots=0, profiles_dir=str(tmp_path),
+            token_slo_ms=160.0, prompt_buckets=[8], decode_horizon=6,
+        )
+        # 48 slots was never measured: no plan, deployment defaults hold.
+        engine = dep.build_engine(
+            RequestQueue("llama_tiny", max_len=16), num_slots=48
+        )
+        try:
+            assert engine.num_slots == 48
+            assert engine.decode_horizon == 6
+        finally:
+            engine.release_buffers()

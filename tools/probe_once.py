@@ -1,0 +1,34 @@
+"""One-shot bounded relay probe: prints BACKEND <platform> on success.
+
+``jax.devices()`` hangs (not fails) on a dead axon tunnel, so the real op
+runs in a bounded subprocess; only a completed matmul proves liveness.
+"""
+import subprocess
+import sys
+
+CHILD = (
+    "import jax, jax.numpy as jnp\n"
+    "x = jnp.ones((256, 256))\n"
+    "y = (x @ x).block_until_ready()\n"
+    "print('BACKEND', jax.devices()[0].platform, float(y[0, 0]))\n"
+)
+
+
+def main() -> int:
+    timeout = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", CHILD],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print("PROBE TIMEOUT after %.0fs" % timeout)
+        return 2
+    sys.stdout.write(r.stdout)
+    if r.returncode != 0:
+        sys.stdout.write((r.stderr or "")[-800:])
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
