@@ -216,9 +216,17 @@ class ColocatedLLMEngines:
                 if not progressed:
                     time.sleep(self.idle_wait_s)
 
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
     def start(self) -> None:
         if self._thread is not None:
-            return
+            if self._thread.is_alive():
+                return
+            # A previously wedged loop has since exited (stop() left the
+            # handle so callers could see it lived): safe to respawn.
+            self._thread = None
         self._run.set()
         self._thread = threading.Thread(
             target=self._loop, name=f"colocate-{self.name}", daemon=True
@@ -230,14 +238,28 @@ class ColocatedLLMEngines:
         if self._thread is not None:
             self._thread.join(timeout_s)
             if self._thread.is_alive():
+                # Wedged in a device call: leave the handle so callers can
+                # see the thread still lives (buffer release must not
+                # happen under it).
                 logger.warning("%s: loop did not exit in %.1fs", self.name,
                                timeout_s)
             else:
                 self._thread = None
 
     def shutdown(self, timeout_s: float = 5.0) -> None:
-        """Stop the loop and abort/release every hosted engine."""
+        """Stop the loop and abort/release every hosted engine. If the
+        loop is wedged in a device call the buffers are NOT released —
+        a still-running scan may be touching them, and dropping the
+        references mid-flight trades a leak for a use-after-free-style
+        crash (same discipline as LLMReplica.stop)."""
         self.stop(timeout_s)
+        if self.running:
+            logger.warning(
+                "%s: loop still alive after stop — leaking hosted "
+                "engines' buffers rather than releasing under a live "
+                "scan", self.name,
+            )
+            return
         with self._lock:
             for h in list(self._hosted.values()):
                 self._release(h)

@@ -113,6 +113,7 @@ class LLMLiveScheduler:
         self._clock = clock
         self._models: Dict[str, LLMModelEntry] = {}
         self._current_plan: List[List[LLMPlacement]] = []
+        self._closed = False
         self._lock = threading.Lock()
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -176,12 +177,6 @@ class LLMLiveScheduler:
         ``control.match_plans_to_engines``'s objective; overlap count
         stands in for transfer cost because every move costs a weight
         upload + compile here too)."""
-        if len(plan) > len(self.chips):
-            logger.warning(
-                "plan needs %d chips but only %d executors; truncating "
-                "(capacity!)", len(plan), len(self.chips),
-            )
-            plan = plan[: len(self.chips)]
         hosted = [set(c.models()) for c in self.chips]
         assignment: List[Optional[List[LLMPlacement]]] = (
             [None] * len(self.chips)
@@ -204,6 +199,8 @@ class LLMLiveScheduler:
     ) -> List[List[LLMPlacement]]:
         """Re-run colocation packing and migrate with minimal movement."""
         with self._lock:
+            if self._closed:
+                return self._current_plan
             rates = rates if rates is not None else self.rates.rates()
             sessions = self._sessions_for(rates)
             try:
@@ -218,6 +215,16 @@ class LLMLiveScheduler:
                 # rather than tearing engines down (the SLO viewer shows
                 # red; the operator re-profiles or relaxes).
                 logger.warning("rebalance infeasible, keeping plan: %s", e)
+                return self._current_plan
+            if len(plan) > len(self.chips):
+                # Over capacity: applying a truncated plan would DRAIN the
+                # dropped models while submit_request keeps accepting
+                # their traffic — keep the previous (serving) assignment
+                # instead, exactly like the infeasible branch above.
+                logger.warning(
+                    "plan needs %d chips but only %d executors — keeping "
+                    "previous plan (capacity!)", len(plan), len(self.chips),
+                )
                 return self._current_plan
             assignment = self._match_chips(plan)
             moved = self._apply(assignment)
@@ -257,19 +264,42 @@ class LLMLiveScheduler:
         # Detach pass first: a model moving chips must stop admitting on
         # its old chip before the new engine attaches, so the shared
         # queue never feeds two admitting engines.
-        for chip, desired in zip(self.chips, desired_by_chip):
+        drain_events: Dict[tuple, threading.Event] = {}
+        for ci, (chip, desired) in enumerate(
+            zip(self.chips, desired_by_chip)
+        ):
             current = chip.placements()
             for model in chip.models():
                 cur = current.get(model)
                 want = desired.get(model)
                 if want is None or not self._same_shape(cur, want):
-                    chip.detach(model, drain=True)
+                    drain_events[(ci, model)] = chip.detach(
+                        model, drain=True
+                    )
                     moved += 1
-        for chip, desired in zip(self.chips, desired_by_chip):
+        for ci, (chip, desired) in enumerate(
+            zip(self.chips, desired_by_chip)
+        ):
             hosted = set(chip.models())
             for model, placement in desired.items():
                 if model in hosted:
                     continue
+                # Same-chip shape change: wait for the predecessor's HBM
+                # to come back (drain completes, buffers released) before
+                # building the successor — a chip packed near the budget
+                # line cannot hold both copies of the weights + KV at
+                # once. Only meaningful when the executor loop is running
+                # to actually drive the drain; bounded so a stuck drain
+                # degrades to the transient double residency instead of
+                # deadlocking the control loop.
+                ev = drain_events.get((ci, model))
+                if ev is not None and chip.running:
+                    if not ev.wait(timeout=60.0):
+                        logger.warning(
+                            "%s: %s drain slow — attaching successor "
+                            "with predecessor still resident",
+                            chip.name, model,
+                        )
                 engine = self.engine_factory(
                     model, placement, self.queues.queue(model), chip.device
                 )
@@ -320,6 +350,13 @@ class LLMLiveScheduler:
 
     def shutdown(self, timeout_s: float = 5.0) -> None:
         self.stop_monitoring()
+        # Serialize with any in-flight rebalance (the monitor join above
+        # can time out mid-_apply): taking the lock waits it out, and the
+        # closed flag makes any later stragglers no-ops — otherwise a
+        # straggling _apply would attach fresh engines to chips whose
+        # loops are already stopped, leaking their HBM.
+        with self._lock:
+            self._closed = True
         for chip in self.chips:
             chip.shutdown(timeout_s)
 

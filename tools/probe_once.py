@@ -1,24 +1,25 @@
-"""One-shot bounded relay probe: prints BACKEND <platform> on success.
+"""One-shot bounded relay probe (CLI wrapper over the watchdog's probe).
 
-``jax.devices()`` hangs (not fails) on a dead axon tunnel, so the real op
-runs in a bounded subprocess; only a completed matmul proves liveness.
+``jax.devices()`` hangs (not fails) on a dead axon tunnel, so liveness is
+a real op in a bounded subprocess with a HOST FETCH — the single source
+of truth for that snippet is ``tools.tpu_watchdog.PROBE_CODE`` (shared so
+probe fixes reach both entry points).
+
+Exit 0: a real op ran on an accelerator backend. Exit 2: dead/CPU-only.
 """
+import os
 import subprocess
 import sys
 
-CHILD = (
-    "import jax, jax.numpy as jnp\n"
-    "x = jnp.ones((256, 256))\n"
-    "y = (x @ x).block_until_ready()\n"
-    "print('BACKEND', jax.devices()[0].platform, float(y[0, 0]))\n"
-)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_watchdog import PROBE_CODE  # noqa: E402
 
 
 def main() -> int:
     timeout = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
     try:
         r = subprocess.run(
-            [sys.executable, "-c", CHILD],
+            [sys.executable, "-c", PROBE_CODE],
             capture_output=True, text=True, timeout=timeout,
         )
     except subprocess.TimeoutExpired:
@@ -27,7 +28,11 @@ def main() -> int:
     sys.stdout.write(r.stdout)
     if r.returncode != 0:
         sys.stdout.write((r.stderr or "")[-800:])
-    return r.returncode
+        return 2
+    if "probe ok" not in r.stdout or "cpu" in r.stdout:
+        print("PROBE NOT ON ACCELERATOR")
+        return 2
+    return 0
 
 
 if __name__ == "__main__":
