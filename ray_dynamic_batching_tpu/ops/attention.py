@@ -102,6 +102,17 @@ def dot_product_attention(
     mask: broadcastable to [B, 1, Tq, Tk], True = attend.
     """
     if _use_pallas():
+        if q.shape[1] == 1 and not causal:
+            # Decode step (Tq == 1): the fused KV-scan kernel — GQA via
+            # layout (no jnp.repeat of the cache read), online softmax in
+            # VMEM (ops/decode_attention.py).
+            from ray_dynamic_batching_tpu.ops import decode_attention
+
+            out = decode_attention.decode_attention(
+                q, k, v, mask=mask, scale=scale
+            )
+            if out is not None:
+                return out
         from ray_dynamic_batching_tpu.ops import flash_attention
 
         out = flash_attention.flash_attention(

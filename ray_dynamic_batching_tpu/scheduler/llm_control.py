@@ -217,15 +217,27 @@ class LLMLiveScheduler:
                 logger.warning("rebalance infeasible, keeping plan: %s", e)
                 return self._current_plan
             if len(plan) > len(self.chips):
-                # Over capacity: applying a truncated plan would DRAIN the
-                # dropped models while submit_request keeps accepting
-                # their traffic — keep the previous (serving) assignment
-                # instead, exactly like the infeasible branch above.
+                if self._current_plan:
+                    # Over capacity: applying a truncated plan would DRAIN
+                    # the dropped models while submit_request keeps
+                    # accepting their traffic — keep the previous
+                    # (serving) assignment instead, exactly like the
+                    # infeasible branch above.
+                    logger.warning(
+                        "plan needs %d chips but only %d executors — "
+                        "keeping previous plan (capacity!)",
+                        len(plan), len(self.chips),
+                    )
+                    return self._current_plan
+                # Nothing is serving yet (first plan): a truncated plan
+                # that serves len(chips) chips' worth of models beats an
+                # empty one that serves nobody.
                 logger.warning(
-                    "plan needs %d chips but only %d executors — keeping "
-                    "previous plan (capacity!)", len(plan), len(self.chips),
+                    "plan needs %d chips but only %d executors — serving "
+                    "the first %d planned chips (capacity!)",
+                    len(plan), len(self.chips), len(self.chips),
                 )
-                return self._current_plan
+                plan = plan[: len(self.chips)]
             assignment = self._match_chips(plan)
             moved = self._apply(assignment)
             self._current_plan = plan
@@ -258,6 +270,7 @@ class LLMLiveScheduler:
         """Diff each chip's desired placement set against what it hosts;
         drain leavers, build/attach joiners. Returns engines moved."""
         moved = 0
+        apply_deadline = time.monotonic() + 60.0  # whole-pass drain budget
         desired_by_chip: List[Dict[str, LLMPlacement]] = [
             {p.model: p for p in (chip or [])} for chip in assignment
         ]
@@ -289,12 +302,19 @@ class LLMLiveScheduler:
                 # building the successor — a chip packed near the budget
                 # line cannot hold both copies of the weights + KV at
                 # once. Only meaningful when the executor loop is running
-                # to actually drive the drain; bounded so a stuck drain
-                # degrades to the transient double residency instead of
-                # deadlocking the control loop.
+                # to actually drive the drain. Bounded by ONE deadline
+                # across the whole apply pass (not per model — _apply
+                # runs under _lock, and shutdown/monitor block on that
+                # lock), and aborted early when shutdown signals _stop;
+                # on expiry it degrades to the transient double
+                # residency instead of freezing the control plane.
                 ev = drain_events.get((ci, model))
                 if ev is not None and chip.running:
-                    if not ev.wait(timeout=60.0):
+                    while (not ev.is_set()
+                           and not self._stop.is_set()
+                           and time.monotonic() < apply_deadline):
+                        ev.wait(timeout=0.25)
+                    if not ev.is_set():
                         logger.warning(
                             "%s: %s drain slow — attaching successor "
                             "with predecessor still resident",
