@@ -52,6 +52,17 @@ class RateTracker:
             span = max(1.0, min(self.window_s, sec - self._buckets[0][0] + 1))
             return self._total / span
 
+    def span_s(self) -> float:
+        """Seconds of window the estimate actually covers (0 = cold).
+        A 2-second-old tracker extrapolates one arrival to a full rate —
+        change detectors should know how much evidence backs the number."""
+        sec = int(self._clock())
+        with self._lock:
+            self._prune(sec)
+            if not self._buckets:
+                return 0.0
+            return min(self.window_s, sec - self._buckets[0][0] + 1)
+
 
 class RateRegistry:
     """Per-model trackers + significant-change detection for the control loop
@@ -80,15 +91,32 @@ class RateRegistry:
         return {m: t.rate_rps() for m, t in items}
 
     def changed_models(
-        self, threshold: float, decrease_multiplier: float = 2.0
+        self, threshold: float, decrease_multiplier: float = 2.0,
+        min_span_s: float = 0.0,
     ) -> Dict[str, float]:
         """Models whose rate moved beyond the threshold since the last
         accepted schedule; increases trip at `threshold`, decreases at
         `threshold * decrease_multiplier` (asymmetric — scaling down too
-        eagerly causes flapping, ref scheduler.py:794-801)."""
+        eagerly causes flapping, ref scheduler.py:794-801).
+
+        ``min_span_s`` ignores models whose sliding window covers less
+        than that many seconds: a cold tracker extrapolates its first
+        arrivals to up-to-2x-inflated rates, and replanning on that
+        evidence migrates engines for noise (observed: a colocation demo
+        split chips at t=5s on a 2.0 reading of a true 1.0 tok/s). Two
+        exemptions: a model with NO scheduled baseline (its first
+        scale-up has no engine to migrate, and holding its traffic
+        unserved for half a window is guaranteed SLO misses), and an
+        EMPTY window (span 0 means traffic stopped and the buckets
+        expired — a real scale-to-zero signal, not a cold start; a
+        guard there would pin the idle model's engine in HBM forever)."""
         out: Dict[str, float] = {}
         for model, rate in self.rates().items():
             base = self._last_scheduled.get(model)
+            if min_span_s > 0 and base:
+                span = self.tracker(model).span_s()
+                if 0 < span < min_span_s:
+                    continue
             if base is None:
                 if rate > 0:
                     out[model] = rate

@@ -201,7 +201,23 @@ class LLMLiveScheduler:
         with self._lock:
             if self._closed:
                 return self._current_plan
-            rates = rates if rates is not None else self.rates.rates()
+            if rates is None:
+                rates = dict(self.rates.rates())
+                # Cold-window readings are extrapolations (up to ~2x
+                # inflated); for models already under contract, plan from
+                # the last scheduled rate until the window has evidence —
+                # otherwise the packer resizes fractions on noise even
+                # though changed_models filtered the *trigger*. Models
+                # with no baseline keep the raw reading (first placement
+                # beats waiting half a window), and so does an EMPTY
+                # window (span 0 = traffic stopped: resurrecting the old
+                # contract would keep planning a dead model forever).
+                min_span = self.rates.window_s / 2.0
+                scheduled = self.rates.scheduled_rates()
+                for m in list(rates):
+                    span = self.rates.tracker(m).span_s()
+                    if scheduled.get(m) and 0 < span < min_span:
+                        rates[m] = scheduled[m]
             sessions = self._sessions_for(rates)
             try:
                 plan = pack_llm_engines(
@@ -342,7 +358,11 @@ class LLMLiveScheduler:
         while not self._stop.wait(self.monitoring_interval_s):
             try:
                 changed = self.rates.changed_models(
-                    self.rate_threshold, self.rate_decrease_multiplier
+                    self.rate_threshold, self.rate_decrease_multiplier,
+                    # Half a window of evidence before a replan: engine
+                    # migration is expensive (weight upload + compiles),
+                    # so cold-start extrapolation must not trigger it.
+                    min_span_s=self.rates.window_s / 2.0,
                 )
                 if changed:
                     logger.info("token-rate change detected: %s",

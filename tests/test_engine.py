@@ -78,6 +78,38 @@ class TestRates:
         rates = reg.rates()
         assert "m" in reg.changed_models(threshold=0.05)
 
+    def test_min_span_suppresses_cold_start_changes(self):
+        """A 2s-old window extrapolates its first arrivals to an inflated
+        rate; with min_span_s the change detector waits for evidence
+        (engine migrations must not fire on cold-start noise)."""
+        clock = FakeClock()
+        reg = RateRegistry(window_s=30.0, clock=clock)
+        reg.mark_scheduled({"m": 1.0})
+        reg.record("m", 4)  # one 4-token request, 1s of window
+        assert reg.rates()["m"] == pytest.approx(4.0)  # inflated 4x
+        assert "m" not in reg.changed_models(
+            threshold=0.05, min_span_s=15.0
+        )
+        # After half a window of the same offered load, the estimate has
+        # converged and the detector may speak.
+        for _ in range(4):
+            clock.advance(4.0)
+            reg.record("m", 4)
+        assert reg.tracker("m").span_s() >= 15.0
+        assert "m" in reg.changed_models(threshold=0.05, min_span_s=15.0)
+
+    def test_min_span_does_not_suppress_scale_to_zero(self):
+        """An EMPTY window (traffic stopped, buckets expired) is a real
+        decrease signal, not a cold start: the guard must let it through
+        or an idle model's engine stays resident forever."""
+        clock = FakeClock()
+        reg = RateRegistry(window_s=30.0, clock=clock)
+        reg.record("m", 100)
+        reg.mark_scheduled()
+        clock.advance(60.0)  # window fully expired: span 0, rate 0
+        assert reg.tracker("m").span_s() == 0.0
+        assert "m" in reg.changed_models(threshold=0.05, min_span_s=15.0)
+
 
 class TestQueue:
     def test_drop_when_full(self):

@@ -1,0 +1,235 @@
+"""LLM control-loop logic tests (fast lane — no XLA, fake engines).
+
+The slow-lane colocate tests prove real engines execute the plans; these
+pin the CONTROL decisions around them: chip matching keeps models where
+they already run, shape-stable placements survive replans, over-capacity
+and infeasible plans degrade to keep-serving, shutdown serializes with
+stragglers, and cold-start rate noise cannot trigger migrations.
+"""
+
+import threading
+
+import pytest
+
+from ray_dynamic_batching_tpu.engine.rates import RateRegistry
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
+from ray_dynamic_batching_tpu.scheduler.llm_control import LLMLiveScheduler
+from ray_dynamic_batching_tpu.scheduler.nexus import LLMPlacement
+
+GB = 1 << 30
+
+
+class FakeEngine:
+    """Duck-typed stand-in for DecodeEngine: only what the executor and
+    control loop touch."""
+
+    def __init__(self, model_name, num_slots, max_len):
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.active_slots = 0
+        self._thread = None
+        self.released = False
+        self.model = type("M", (), {"name": model_name})()
+
+    def abort_active(self, exc):
+        self.active_slots = 0
+
+    def release_buffers(self):
+        self.released = True
+
+
+class FakeChip:
+    """Mimics ColocatedLLMEngines' control surface without a loop."""
+
+    def __init__(self, name):
+        self.name = name
+        self.device = None
+        self.running = False
+        self._hosted = {}
+
+    def models(self):
+        return list(self._hosted)
+
+    def placements(self):
+        return {m: p for m, (e, p) in self._hosted.items()}
+
+    def attach(self, model, engine, placement=None):
+        self._hosted[model] = (engine, placement)
+
+    def detach(self, model, drain=True):
+        self._hosted.pop(model, None)
+        ev = threading.Event()
+        ev.set()
+        return ev
+
+    def shutdown(self, timeout_s=5.0):
+        self._hosted.clear()
+
+    def busy_fractions(self):
+        return {}
+
+    def describe(self):
+        return f"{self.name}{sorted(self._hosted)}"
+
+
+def profile(name, step_ms=10.0, hbm_gb=1.0):
+    return BatchProfile(f"{name}_decode", [
+        ProfileRow(batch_size=4, seq_len=128, latency_ms=step_ms,
+                   latency_std_ms=0.0, hbm_bytes=int(hbm_gb * GB),
+                   compile_ms=100.0),
+    ])
+
+
+def rate_for(prof, fraction):
+    row = prof.rows[0]
+    return fraction * 1000.0 * row.batch_size / row.latency_ms
+
+
+def make_sched(models=("a", "b"), n_chips=2, **kw):
+    profiles = {m: profile(m) for m in models}
+    chips = [FakeChip(f"chip{i}") for i in range(n_chips)]
+    built = []
+
+    def factory(model, placement, queue, device):
+        e = FakeEngine(model, placement.num_slots, placement.capacity)
+        built.append((model, placement))
+        return e
+
+    sched = LLMLiveScheduler(profiles, chips, factory, **kw)
+    for m in models:
+        sched.register_model(m, token_slo_ms=1000.0)
+    return sched, chips, profiles, built
+
+
+class TestRebalanceDecisions:
+    def test_colocates_then_splits_on_surge(self):
+        sched, chips, profiles, built = make_sched()
+        low = {m: rate_for(profiles[m], 0.3) for m in ("a", "b")}
+        plan = sched.rebalance(rates=low)
+        assert len(plan) == 1
+        assert sorted(chips[0].models()) == ["a", "b"]
+
+        surge = dict(low, a=rate_for(profiles["a"], 0.6))
+        plan2 = sched.rebalance(rates=surge)
+        assert len(plan2) == 2
+        hosts = {m: c.name for c in chips for m in c.models()}
+        assert hosts["a"] != hosts["b"]
+
+    def test_shape_stable_placement_keeps_engine(self):
+        sched, chips, profiles, built = make_sched()
+        low = {m: rate_for(profiles[m], 0.3) for m in ("a", "b")}
+        sched.rebalance(rates=low)
+        n_built = len(built)
+        # Fraction moves but the single measured config is unchanged:
+        # nothing rebuilds, nothing migrates.
+        sched.rebalance(rates={m: rate_for(profiles[m], 0.35)
+                               for m in ("a", "b")})
+        assert len(built) == n_built
+        assert sched.migrations == 0
+
+    def test_over_capacity_first_plan_serves_truncated(self):
+        sched, chips, profiles, built = make_sched(
+            models=("a", "b", "c"), n_chips=1,
+        )
+        # Three models each needing most of a chip: plan wants 3 chips.
+        high = {m: rate_for(profiles[m], 0.8) for m in ("a", "b", "c")}
+        plan = sched.rebalance(rates=high)
+        assert len(plan) == 1  # truncated to the chip set
+        assert len(chips[0].models()) == 1  # somebody serves
+
+    def test_over_capacity_later_keeps_previous_plan(self):
+        sched, chips, profiles, built = make_sched(
+            models=("a", "b", "c"), n_chips=2,
+        )
+        low = {m: rate_for(profiles[m], 0.3) for m in ("a", "b", "c")}
+        plan = sched.rebalance(rates=low)
+        served_before = {m for c in chips for m in c.models()}
+        assert served_before == {"a", "b", "c"}
+        # Demand explodes past the chip set: the serving assignment must
+        # survive (no model drained for a plan that can't be placed).
+        high = {m: rate_for(profiles[m], 0.8) for m in ("a", "b", "c")}
+        plan2 = sched.rebalance(rates=high)
+        assert plan2 == plan
+        assert {m for c in chips for m in c.models()} == served_before
+
+    def test_infeasible_rate_keeps_previous_plan(self):
+        sched, chips, profiles, built = make_sched()
+        low = {m: rate_for(profiles[m], 0.3) for m in ("a", "b")}
+        plan = sched.rebalance(rates=low)
+        # 2x a whole chip for one model: no measured config serves it.
+        plan2 = sched.rebalance(
+            rates=dict(low, a=rate_for(profiles["a"], 2.0))
+        )
+        assert plan2 == plan
+        assert sorted(chips[0].models() + chips[1].models()) == ["a", "b"]
+
+    def test_zero_rate_model_is_drained(self):
+        sched, chips, profiles, built = make_sched()
+        low = {m: rate_for(profiles[m], 0.3) for m in ("a", "b")}
+        sched.rebalance(rates=low)
+        sched.rebalance(rates={"a": low["a"], "b": 0.0})
+        hosted = {m for c in chips for m in c.models()}
+        assert hosted == {"a"}
+
+    def test_matching_prefers_incumbent_chip(self):
+        sched, chips, profiles, built = make_sched()
+        low = {m: rate_for(profiles[m], 0.3) for m in ("a", "b")}
+        sched.rebalance(rates=low)
+        incumbent = next(c for c in chips if c.models()).name
+        # Split, then merge back: the colocated pair should land on the
+        # chip already hosting the most of it each time.
+        sched.rebalance(rates=dict(low, a=rate_for(profiles["a"], 0.6)))
+        sched.rebalance(rates=low)
+        merged = next(c for c in chips if len(c.models()) == 2)
+        assert merged.name == incumbent
+
+
+class TestLifecycle:
+    def test_shutdown_closes_future_rebalances(self):
+        sched, chips, profiles, built = make_sched()
+        sched.shutdown()
+        plan = sched.rebalance(
+            rates={m: rate_for(profiles[m], 0.3) for m in ("a", "b")}
+        )
+        assert plan == []
+        assert all(not c.models() for c in chips)
+
+    def test_submit_unregistered_rejects(self):
+        from ray_dynamic_batching_tpu.engine.request import Request
+
+        sched, chips, profiles, built = make_sched()
+        req = Request(model="nope", payload={"tokens": [1]}, slo_ms=1000.0)
+        assert not sched.submit_request(req)
+        with pytest.raises(KeyError):
+            req.future.result(timeout=1)
+
+    def test_submit_records_token_demand(self):
+        from ray_dynamic_batching_tpu.engine.request import Request
+
+        fake = {"t": 1000.0}
+        sched, chips, profiles, built = make_sched(
+            rates=RateRegistry(window_s=10.0, clock=lambda: fake["t"]),
+            clock=lambda: fake["t"],
+        )
+        sched.submit_request(Request(
+            model="a", payload={"tokens": [1, 2], "max_new_tokens": 40},
+            slo_ms=1000.0,
+        ))
+        assert sched.rates.rates()["a"] == pytest.approx(40.0)
+
+    def test_monitor_ignores_cold_start_inflation(self):
+        fake = {"t": 1000.0}
+        reg = RateRegistry(window_s=30.0, clock=lambda: fake["t"])
+        sched, chips, profiles, built = make_sched(
+            rates=reg, clock=lambda: fake["t"],
+        )
+        low = {m: rate_for(profiles[m], 0.3) for m in ("a", "b")}
+        sched.rebalance(rates=low)
+        # One early arrival reads as a huge rate over a 1s span; the
+        # monitor's min-span guard must not migrate on it.
+        reg.record("a", int(low["a"] * 3))
+        changed = reg.changed_models(
+            sched.rate_threshold, sched.rate_decrease_multiplier,
+            min_span_s=reg.window_s / 2.0,
+        )
+        assert changed == {}

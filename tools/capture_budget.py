@@ -1,0 +1,139 @@
+"""Assemble the relay-window capture budget — the readiness proof that
+one window of realistic length yields the full on-chip artifact story.
+
+VERDICT r4 #1 makes readiness itself a deliverable: if the relay never
+opens, the committed evidence must show the capture suite FITS one
+window. This tool writes ``profiles/capture_budget.json`` from (a) the
+watchdog's per-step caps (imported, so the budget can't drift from the
+code), (b) step timings measured on CPU this round where a CPU mode
+exists, and (c) the priority ordering — the highest-value artifact
+(bench: the north-star LLM row + ttft breakdown + guarded 8B row) lands
+first, so even a window shorter than the worst case converts into the
+#1 missing item.
+
+Usage: python tools/capture_budget.py [--cpu-timings k=v,...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import tpu_watchdog as wd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "profiles", "capture_budget.json")
+
+# CPU-measured step timings (seconds), refreshed per round by the
+# builder's actual runs (sources noted per row). CPU bounds are LOWER
+# bounds on sweep content but UPPER-bound-ish on compile counts: the TPU
+# caps below add headroom for deeper sweeps + ~20-40s first compiles.
+CPU_MEASURED = {
+    # tools/run_profiles.py --cpu profiles/cpu (round 5): per-model sweep
+    # seconds summed from the run log.
+    "profiles": {
+        "seconds": 1346,
+        "source": "round-5 run: resnet50 227s + shufflenet 183s + "
+                  "vit 553s + llama_tiny decode 50s + gpt2_medium "
+                  "decode 333s",
+    },
+    # tools/run_slo_demo.py --cpu (60s serving + plan + drain).
+    "slo_demo": {
+        "seconds": 180,
+        "source": "round-4/5 CPU records: 60s duration + model builds "
+                  "+ drain",
+    },
+    # tools/run_llm_demo.py --cpu (360s serving + gpt2 init/warmup +
+    # drain; TPU runs 120s with dense rates).
+    "llm_demo": {
+        "seconds": 900,
+        "source": "round-5 CPU record: ~4min builds + 6min run + drain",
+    },
+    # bench.py has no CPU mode (its whole point is the accelerator), but
+    # its dominant rows are bounded by round-4 measurements: the 8B row's
+    # host-init+quantize path ran in 1159s standalone (ROUND4_NOTES),
+    # LLM Poisson phases are ~60s, vision sweeps + ASR a few minutes.
+    "bench": {
+        "seconds": 1800,
+        "source": "estimate: 8B host-quantize path 1159s (measured, "
+                  "round 4) + LLM/vision/ASR rows + compiles",
+    },
+}
+
+
+STEP_CAPS = {
+    "bench": wd.BENCH_TIMEOUT_S,
+    "profiles": wd.PROFILES_TIMEOUT_S,
+    "slo_demo": wd.SLO_TIMEOUT_S,
+    "llm_demo": wd.LLM_DEMO_TIMEOUT_S,
+}
+
+
+def main() -> int:
+    watchdog_order = [name for name, _ in wd.STEPS]
+    missing = [n for n in watchdog_order if n not in STEP_CAPS]
+    if missing:
+        # Budget rows derive from the watchdog's own step list so a new
+        # capture step can never silently drop out of the committed
+        # readiness deliverable — fail loudly instead.
+        raise SystemExit(
+            f"watchdog steps missing from the budget map: {missing} — "
+            "add their caps/timings to tools/capture_budget.py"
+        )
+    steps = [("probe", wd.PROBE_TIMEOUT_S, None)] + [
+        (name, STEP_CAPS[name], CPU_MEASURED.get(name))
+        for name in watchdog_order
+    ]
+    rows = []
+    cum_cap = 0.0
+    cum_expected = 0.0
+    for name, cap, measured in steps:
+        cum_cap += cap
+        expected = (measured or {}).get("seconds", cap)
+        cum_expected += expected
+        rows.append({
+            "step": name,
+            "cap_s": cap,
+            "expected_s": expected,
+            "cumulative_cap_s": cum_cap,
+            "cumulative_expected_s": cum_expected,
+            "basis": (measured or {}).get(
+                "source", "probe: bounded real-op matmul"
+            ),
+        })
+    budget = {
+        "metric": "capture_budget",
+        "watchdog_step_order": watchdog_order,
+        "per_step_attempt_cap": wd.MAX_ATTEMPTS,
+        "steps": rows,
+        "window_fit": {
+            "expected_total_s": cum_expected,
+            "expected_total_human": f"{cum_expected / 60:.0f} min",
+            "worst_case_total_s": cum_cap,
+            "worst_case_total_human": f"{cum_cap / 3600:.1f} h",
+            "note": (
+                "Steps commit independently the moment they verify "
+                "(pathspec-scoped), so a window of length T yields every "
+                "step whose cumulative expected time <= T; the bench "
+                "(north-star LLM row + ttft breakdown + guarded 8B row) "
+                "lands within ~30 min of the relay answering."
+            ),
+        },
+    }
+    with open(OUT, "w") as f:
+        json.dump(budget, f, indent=1)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "capture_budget",
+        "expected_total_min": round(cum_expected / 60),
+        "worst_case_h": round(cum_cap / 3600, 1),
+        "path": os.path.relpath(OUT, REPO),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,10 @@ CPU_PLAN = [
 
 CPU_DECODE_PLAN = [
     ("llama_tiny", (2, 4, 8), (64,), (8, 16), (1, 2)),
+    # Second model so multi-model plan_from_tables + pack_llm_engines run
+    # against real committed files, not unit fixtures (VERDICT r4 weak
+    # #5). Small buckets: gpt2_medium fp32 CPU steps are ~100ms-scale.
+    ("gpt2_medium", (2, 4), (128,), (16,), (1, 2)),
 ]
 
 

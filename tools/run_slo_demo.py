@@ -99,8 +99,26 @@ def main(profiles_dir: str, duration_s: float = 60.0,
     # mechanism under test (profile->plan->shift->migration->per-phase
     # accounting) is identical.
     slo_scale = 3.0 if cpu else 1.0
+
+    def effective_slo(name: str, slo_ms: float) -> float:
+        if not cpu:
+            return slo_ms
+        # Floor the scaled SLO at 40x the model's measured single-image
+        # latency FROM THIS HOST'S OWN TABLES: a fixed scale calibrated
+        # on one CI host grades a slower host's hardware, not the
+        # scheduler (observed: the same run went good -> critical when
+        # the committed tables moved to a 2.2x slower machine). The
+        # reference's own regime is ~600x (2000 ms SLO at ~3 ms/img),
+        # so a 40x floor keeps the CPU record strictly harder than the
+        # reference's while staying hardware-independent.
+        b1 = min(
+            (r.latency_ms for r in profiles[name].rows if r.batch_size == 1),
+            default=0.0,
+        )
+        return max(slo_ms * slo_scale, 40.0 * b1)
+
     workload = [
-        (name, slo_ms * slo_scale, util, mult)
+        (name, effective_slo(name, slo_ms), util, mult)
         for name, slo_ms, util, mult in WORKLOAD
     ]
     packer = SquishyBinPacker(profiles, hbm_budget_bytes=12 << 30)

@@ -14,6 +14,7 @@ answers it runs the full capture suite, committing records into
 1. ``bench.py``                 -> ``profiles/tpu_v5e/bench_<ts>.json``
 2. ``tools/run_profiles.py``    -> ``profiles/tpu_v5e/*_summary.csv`` etc.
 3. ``tools/run_slo_demo.py``    -> ``profiles/tpu_v5e/slo_demo.json``
+4. ``tools/run_llm_demo.py``    -> ``profiles/tpu_v5e/llm_demo.json``
 
 Guard rails (each one a way a dead-or-flapping relay could otherwise
 poison the committed ground truth):
@@ -59,6 +60,10 @@ BENCH_TIMEOUT_S = 45 * 60.0
 # + decode/prefill tables) can brush an hour of mostly-compile time.
 PROFILES_TIMEOUT_S = 90 * 60.0
 SLO_TIMEOUT_S = 30 * 60.0
+# Demo serving phase is 120s on chip; the rest of the cap is gpt2_medium
+# weight init + engine warmup compiles (disk-cache hits after the
+# profiles step) + the post-run drain.
+LLM_DEMO_TIMEOUT_S = 20 * 60.0
 MAX_ATTEMPTS = 4             # per step, while the relay is alive
 
 # A matmul plus a HOST FETCH (block_until_ready alone returns early on the
@@ -272,13 +277,15 @@ def capture_profiles() -> bool:
     return git_commit(f"tpu_v5e: committed on-chip profile tables {_now()}")
 
 
-def capture_slo_demo() -> bool:
-    rec = run_step(
-        "slo_demo",
-        [sys.executable, "tools/run_slo_demo.py", "profiles/tpu_v5e", "60"],
-        SLO_TIMEOUT_S,
-    )
-    record_path = os.path.join(OUT_DIR, "slo_demo.json")
+def _capture_demo(name: str, argv: list, timeout_s: float,
+                  record_file: str, commit_msg: str) -> bool:
+    """Shared demo-capture discipline: run bounded, verify the RECORD's
+    own backend stamp (rc 2 = SLO missed but the record is still real
+    measured ground truth; rc 3 = no migration happened, which would
+    commit a record proving the opposite of what the step exists to
+    prove — discard it)."""
+    rec = run_step(name, argv, timeout_s)
+    record_path = os.path.join(OUT_DIR, record_file)
     backend = None
     if os.path.exists(record_path):
         try:
@@ -288,20 +295,41 @@ def capture_slo_demo() -> bool:
             pass
     ok = rec["rc"] in (0, 2) and _on_chip(backend)
     if not ok:
-        _save_failure("slo_demo", {
+        _save_failure(name, {
             "rc": rec["rc"], "seconds": rec["seconds"], "backend": backend,
             "stdout_tail": rec["stdout"][-2000:],
             "stderr_tail": rec["stderr"][-1000:],
         })
         _discard_unverified_artifacts()
         return False
-    return git_commit(f"tpu_v5e: on-chip SLO demo record {_now()}")
+    return git_commit(commit_msg)
+
+
+def capture_slo_demo() -> bool:
+    return _capture_demo(
+        "slo_demo",
+        [sys.executable, "tools/run_slo_demo.py", "profiles/tpu_v5e", "60"],
+        SLO_TIMEOUT_S, "slo_demo.json",
+        f"tpu_v5e: on-chip SLO demo record {_now()}",
+    )
+
+
+def capture_llm_demo() -> bool:
+    """LLM colocation demo (decode analogue of the SLO demo): needs the
+    decode tables the profiles step committed, so it runs last."""
+    return _capture_demo(
+        "llm_demo",
+        [sys.executable, "tools/run_llm_demo.py", "profiles/tpu_v5e", "120"],
+        LLM_DEMO_TIMEOUT_S, "llm_demo.json",
+        f"tpu_v5e: on-chip LLM colocation demo record {_now()}",
+    )
 
 
 STEPS = [
     ("bench", capture_bench),
     ("profiles", capture_profiles),
     ("slo_demo", capture_slo_demo),
+    ("llm_demo", capture_llm_demo),
 ]
 
 
