@@ -39,9 +39,17 @@ import jax
 
 from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
 from ray_dynamic_batching_tpu.engine.request import RequestDropped
+from ray_dynamic_batching_tpu.utils import metrics as m
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("colocate")
+
+BUSY_FRACTION = m.Gauge(
+    "rdb_colocate_busy_fraction",
+    "Measured share of executor wall time per co-resident engine "
+    "(the ground truth the planner's compute_fraction predicts)",
+    tag_keys=("chip", "model"),
+)
 
 
 @dataclass
@@ -271,7 +279,10 @@ class ColocatedLLMEngines:
         the ground truth the planner's ``compute_fraction`` predicts."""
         with self._lock:
             wall = max(self._wall_ms, 1e-9)
-            return {m: h.busy_ms / wall for m, h in self._hosted.items()}
+            out = {mk: h.busy_ms / wall for mk, h in self._hosted.items()}
+        for mk, frac in out.items():
+            BUSY_FRACTION.set(frac, tags={"chip": self.name, "model": mk})
+        return out
 
     def reset_accounting(self) -> None:
         with self._lock:

@@ -102,17 +102,23 @@ def dot_product_attention(
     mask: broadcastable to [B, 1, Tq, Tk], True = attend.
     """
     if _use_pallas():
-        if q.shape[1] == 1 and not causal:
-            # Decode step (Tq == 1): the fused KV-scan kernel — GQA via
-            # layout (no jnp.repeat of the cache read), online softmax in
-            # VMEM (ops/decode_attention.py).
+        if not causal:
+            # Small query windows — plain decode (Tq == 1), speculative
+            # verify (Tq == k+1), small prefill buckets: the fused
+            # KV-scan kernel — GQA via layout (no jnp.repeat of the
+            # cache read), online softmax in VMEM
+            # (ops/decode_attention.py). Window semantics ride the
+            # explicit mask, so only non-causal calls qualify; the
+            # kernel itself owns the eligibility band and declines
+            # wider windows.
             from ray_dynamic_batching_tpu.ops import decode_attention
 
-            out = decode_attention.decode_attention(
-                q, k, v, mask=mask, scale=scale
-            )
-            if out is not None:
-                return out
+            if q.shape[1] <= decode_attention.MAX_WINDOW_FOR_KERNEL:
+                out = decode_attention.decode_attention(
+                    q, k, v, mask=mask, scale=scale
+                )
+                if out is not None:
+                    return out
         from ray_dynamic_batching_tpu.ops import flash_attention
 
         out = flash_attention.flash_attention(

@@ -1,4 +1,6 @@
-"""Pallas TPU decode attention — the KV-scan kernel for Tq == 1 steps.
+"""Pallas TPU decode attention — the KV-scan kernel for small query
+windows (plain decode Tq == 1, speculative-verify Tq == k+1, small
+prefill buckets).
 
 Decode is HBM-bandwidth-bound: every substep reads the full KV capacity
 (static shapes — see ``serve/llm.py``'s capacity-bucket rationale) to
@@ -8,23 +10,26 @@ costs on that scan (``ops/attention.py::_xla_attention``):
 - **GQA materialization**: ``jnp.repeat`` expands K/V to the full query
   head count before the einsum — N/K fresh copies of the cache read
   land in HBM every substep (llama-3 geometry: 4x).
-- **Logit round-trip**: the [B, N, 1, S] f32 logits + softmax
+- **Logit round-trip**: the [B, N, Tq, S] f32 logits + softmax
   intermediates materialize between two einsums instead of living in
   VMEM.
 
 This kernel fuses the scan FlashAttention-style: grid (B, K); each
 program owns one slot's one KV head, reads its [S, H] K/V slab exactly
-once, runs the online softmax over KV tiles in VMEM, and writes the
-[G, H] output for the G = N/K query heads sharing that KV head — GQA
-via layout, no repeat. Prefill stays on the flash kernel
+once (all Tq window rows and all G = N/K query heads sharing that KV
+head ride the same read), runs the online softmax over KV tiles in
+VMEM, and writes the [Tq*G, H] output — GQA via layout, no repeat.
+Large prefill tiles stay on the flash kernel
 (``ops/flash_attention.py``); this covers the decode half VERDICT r4 #8
 called out (the reference has no decode engine to compare against — its
 serving path is fixed-shape vision forwards,
 ``293-project/src/scheduler.py:435-452``).
 
-Masking: decode windows arrive as a [B, 1, 1, S] boolean (True =
-attend, ``models/decoder.py::decode_mask``), streamed as int8 [B, S] —
-one byte per KV row vs the 2H-byte K/V read it gates.
+Masking: windows arrive as a [B, 1, Tq, S] boolean (True = attend —
+``models/decoder.py::decode_mask`` for Tq == 1, ``verify_step``'s
+per-row scatter windows for the speculative path), streamed as int8
+[Tq, S] per row — Tq bytes per KV position vs the 2H-byte K/V read they
+gate.
 """
 
 from __future__ import annotations
@@ -38,21 +43,28 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
 
+# Windows past this ride the flash kernel (>= 16) or XLA (9..15): the
+# whole-KV-resident scan layout is sized for decode-shaped reads, not
+# prefill tiles.
+MAX_WINDOW_FOR_KERNEL = 8
+
 
 def _decode_kernel(
-    q_ref,      # [1, 1, G, H]
+    q_ref,      # [1, 1, Tq*G, H]   rows ordered (t, g)
     k_ref,      # [1, S, 1, H]
     v_ref,      # [1, S, 1, H]
-    mask_ref,   # [1, S] int8, or None
-    o_ref,      # [1, 1, G, H]
+    mask_ref,   # [1, Tq, S] int8, or None
+    o_ref,      # [1, 1, Tq*G, H]
     *,
     scale: float,
     block_k: int,
     kv_len: int,
+    window: int,
 ):
-    G = q_ref.shape[2]
+    R = q_ref.shape[2]          # Tq * G
     H = q_ref.shape[3]
-    q = q_ref[0, 0, :, :]  # [G, H]
+    G = R // window
+    q = q_ref[0, 0, :, :]       # [R, H]
     num_kb = pl.cdiv(kv_len, block_k)
 
     def body(jk, carry):
@@ -63,77 +75,83 @@ def _decode_kernel(
             q, k_tile,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [G, block_k] f32
+        ) * scale  # [R, block_k] f32
 
         k_pos = jk * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (G, block_k), 1
+            jnp.int32, (R, block_k), 1
         )
         valid = k_pos < kv_len  # tail tile past S
         if mask_ref is not None:
-            mvals = mask_ref[0, pl.ds(jk * block_k, block_k)] != 0
-            valid = jnp.logical_and(valid, mvals[None, :])
+            mvals = mask_ref[0, :, pl.ds(jk * block_k, block_k)] != 0
+            # [Tq, block_k] -> one row per (t, g): g shares t's window.
+            rows = jnp.broadcast_to(
+                mvals[:, None, :], (window, G, block_k)
+            ).reshape(R, block_k)
+            valid = jnp.logical_and(valid, rows)
         s = jnp.where(valid, s, NEG_INF)
 
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))  # [G]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))  # [R]
         alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur[:, None])  # [G, block_k]
+        p = jnp.exp(s - m_cur[:, None])  # [R, block_k]
         l_cur = l_prev * alpha + jnp.sum(p, axis=1)
         acc = acc_prev * alpha[:, None] + jax.lax.dot_general(
             p.astype(v_tile.dtype), v_tile,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [G, H]
+        )  # [R, H]
         return m_cur, l_cur, acc
 
-    m0 = jnp.full((G,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((G,), jnp.float32)
-    acc0 = jnp.zeros((G, H), jnp.float32)
+    m0 = jnp.full((R,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((R,), jnp.float32)
+    acc0 = jnp.zeros((R, H), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    # A fully-masked row (lengths=0 never happens in the engine, but be
-    # total): l == 0 -> emit zeros instead of NaN.
+    # A fully-masked row (inactive spec rows are steered out of bounds;
+    # their outputs are never consumed) -> zeros instead of NaN.
     l = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0, :, :] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_k", "interpret")
+    jax.jit, static_argnames=("scale", "block_k", "window", "interpret")
 )
 def _decode_attention(
-    q: jax.Array,      # [B, K, G, H]
+    q: jax.Array,      # [B, K, Tq*G, H]  rows ordered (t, g)
     k: jax.Array,      # [B, S, K, H]
     v: jax.Array,
-    mask: Optional[jax.Array],  # [B, S] int8, or None
+    mask: Optional[jax.Array],  # [B, Tq, S] int8, or None
     *,
     scale: float,
     block_k: int,
+    window: int,
     interpret: bool,
 ) -> jax.Array:
-    B, K, G, H = q.shape
+    B, K, R, H = q.shape
     S = k.shape[1]
     in_specs = [
-        pl.BlockSpec((1, 1, G, H), lambda b, j: (b, j, 0, 0)),
+        pl.BlockSpec((1, 1, R, H), lambda b, j: (b, j, 0, 0)),
         pl.BlockSpec((1, S, 1, H), lambda b, j: (b, 0, j, 0)),
         pl.BlockSpec((1, S, 1, H), lambda b, j: (b, 0, j, 0)),
     ]
-    args = [q.reshape(B, K, G, H), k, v]
+    args = [q, k, v]
     if mask is not None:
-        in_specs.append(pl.BlockSpec((1, S), lambda b, j: (b, 0)))
+        in_specs.append(pl.BlockSpec((1, window, S), lambda b, j: (b, 0, 0)))
         args.append(mask)
         kernel = functools.partial(
             _decode_kernel, scale=scale, block_k=block_k, kv_len=S,
+            window=window,
         )
     else:
         def kernel(q_ref, k_ref, v_ref, o_ref):
             _decode_kernel(
                 q_ref, k_ref, v_ref, None, o_ref,
-                scale=scale, block_k=block_k, kv_len=S,
+                scale=scale, block_k=block_k, kv_len=S, window=window,
             )
     return pl.pallas_call(
         kernel,
         grid=(B, K),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, G, H), lambda b, j: (b, j, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, K, G, H), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, R, H), lambda b, j: (b, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, K, R, H), q.dtype),
         interpret=interpret,
     )(*args)
 
@@ -148,29 +166,35 @@ def decode_attention(
     block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> Optional[jax.Array]:
-    """Fused single-token attention; returns None when the shapes aren't
-    the decode pattern (caller falls back to XLA, same contract as
+    """Fused small-window attention; returns None when the shapes aren't
+    the decode pattern (caller falls back to flash/XLA, same contract as
     ``flash_attention.flash_attention``).
 
-    q [B, 1, N, H]; k/v [B, S, K, H] with K dividing N; mask None or
-    broadcastable to [B, 1, 1, S] (True = attend). The KV-head grouping
-    matches ``_xla_attention``'s ``jnp.repeat`` semantics: query head n
-    reads kv head n // (N // K).
+    q [B, Tq, N, H] with Tq <= MAX_WINDOW_FOR_KERNEL; k/v [B, S, K, H]
+    with K dividing N; mask None or broadcastable to [B, 1, Tq, S]
+    (True = attend). The KV-head grouping matches ``_xla_attention``'s
+    ``jnp.repeat`` semantics: query head n reads kv head n // (N // K).
     """
-    if q.ndim != 4 or k.ndim != 4 or q.shape[1] != 1:
+    if q.ndim != 4 or k.ndim != 4:
         return None
-    B, _, N, H = q.shape
+    B, Tq, N, H = q.shape
     _, S, K, _ = k.shape
+    if not (1 <= Tq <= MAX_WINDOW_FOR_KERNEL):
+        return None
     if K == 0 or N % K != 0 or v.shape != k.shape:
         return None
+    G = N // K
     if mask is not None:
         if mask.shape[-1] != S:
             return None
         try:
             mask = jnp.broadcast_to(
-                mask.reshape(mask.shape[0], -1, S)[:, -1, :], (B, S)
-            ).astype(jnp.int8)
-        except TypeError:
+                mask, (B, 1, Tq, S)
+            ).reshape(B, Tq, S).astype(jnp.int8)
+        except (TypeError, ValueError):
+            # e.g. a per-head [B, N, Tq, S] mask: not this kernel's
+            # pattern — decline so the caller falls back to XLA, which
+            # handles arbitrary masks.
             return None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -182,8 +206,15 @@ def decode_attention(
     from ray_dynamic_batching_tpu.ops.flash_attention import _pick_block
 
     block_k = _pick_block(S, max(1, min(block_k, S)))
-    out = _decode_attention(
-        q.reshape(B, K, N // K, H), k, v, mask,
-        scale=float(scale), block_k=int(block_k), interpret=bool(interpret),
+    # Rows ordered (t, g) per kv head: [B, Tq, K, G, H] -> [B, K, Tq*G, H].
+    q_r = q.reshape(B, Tq, K, G, H).transpose(0, 2, 1, 3, 4).reshape(
+        B, K, Tq * G, H
     )
-    return out.reshape(B, 1, N, H)
+    out = _decode_attention(
+        q_r, k, v, mask,
+        scale=float(scale), block_k=int(block_k), window=int(Tq),
+        interpret=bool(interpret),
+    )
+    return out.reshape(B, K, Tq, G, H).transpose(0, 2, 1, 3, 4).reshape(
+        B, Tq, N, H
+    )

@@ -416,3 +416,10 @@ class LLMLiveScheduler:
     def write_metrics(self) -> None:
         with open(self.metrics_path, "w") as f:
             json.dump(self.snapshot(), f, indent=2)
+
+    def render_status(self) -> str:
+        """Terminal SLO status — the same table renderer the vision
+        loop, state CLI, and dashboard share (rates shown in tok/s)."""
+        from ray_dynamic_batching_tpu.state import render_queue_table
+
+        return render_queue_table(self.queues.stats(), self.rates.rates())

@@ -190,13 +190,22 @@ class TestColocatedExecution:
             for r in reqs:
                 assert r.future.result(timeout=5).finish_reason == "length"
 
-            # Surge tiny_a's offered token rate to a 0.6 fraction: with
-            # tiny_b at 0.25 the pair no longer fits one chip under the
-            # 0.85 headroom -> the plan must split.
-            rates.record("tiny_a", n=int(rate_for_fraction(row_a, 0.6)))
-            rates.record("tiny_b", n=int(low_b))
+            # Surge tiny_a's offered token rate to a 0.7 fraction: with
+            # tiny_b at 0.25 the pair (0.95) no longer fits one chip
+            # under the 0.85 headroom -> the plan must split. Spread the
+            # records across fake seconds (advancing BEFORE each record
+            # so covered span == record count and the window rate equals
+            # the offered rate exactly) — the control plane (correctly)
+            # refuses to migrate engines on a cold 1-second extrapolation.
+            surge_a = int(rate_for_fraction(row_a, 0.7))
+            for i in range(6):
+                if i:
+                    fake["t"] += 1.0
+                rates.record("tiny_a", n=surge_a)
+                rates.record("tiny_b", n=int(low_b))
             changed = rates.changed_models(
-                sched.rate_threshold, sched.rate_decrease_multiplier
+                sched.rate_threshold, sched.rate_decrease_multiplier,
+                min_span_s=rates.window_s / 2.0,
             )
             assert "tiny_a" in changed, "surge must trip the monitor test"
 
@@ -237,7 +246,9 @@ class TestOccupancyModelValidation:
     def _solo_pass_ms(lm, slots, cap, passes=30):
         """Measured cost of one executor turn (scan + harvest + host
         bookkeeping) for a saturated engine — the sharing model's inputs
-        must include the same overheads the colocated turns pay."""
+        must include the same overheads the colocated turns pay. Median
+        of per-pass timings: a background CPU burst must skew one pass,
+        not the whole estimate."""
         model, params = lm
         q = RequestQueue("probe", max_len=256)
         engine = DecodeEngine(
@@ -249,19 +260,31 @@ class TestOccupancyModelValidation:
         TestOccupancyModelValidation._saturate(engine, q, waves=3)
         for _ in range(5):  # warm: admissions + first compiles
             ex.step_once()
-        t0 = time.perf_counter()
+        samples = []
         done = 0
         while done < passes and engine.active_slots > 0:
+            t0 = time.perf_counter()
             ex.step_once()
+            samples.append((time.perf_counter() - t0) * 1000.0)
             done += 1
-        ms = (time.perf_counter() - t0) * 1000.0 / max(1, done)
         ex.shutdown()
-        return ms
+        assert samples
+        return float(np.median(samples))
 
     def test_fraction_model_brackets_measured_sharing(self, lm):
         model, params = lm
         s_a = self._solo_pass_ms(lm, 4, 64)
         s_b = self._solo_pass_ms(lm, 2, 32)
+        # Timing validation needs a quiet host: re-measure A and skip if
+        # the box moved under us (a shared CI machine's noise would fail
+        # the bracket for reasons unrelated to the sharing model).
+        s_a2 = self._solo_pass_ms(lm, 4, 64)
+        if abs(s_a2 - s_a) > 0.25 * max(s_a, s_a2):
+            pytest.skip(
+                f"host too noisy for timing validation: solo pass "
+                f"{s_a:.2f}ms vs {s_a2:.2f}ms on re-measure"
+            )
+        s_a = (s_a + s_a2) / 2.0
         pred_a = s_a / (s_a + s_b)
         pred_b = s_b / (s_a + s_b)
 

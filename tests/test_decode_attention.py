@@ -90,9 +90,50 @@ def test_bf16_inputs():
     _check(q, k, v, atol=2e-2)
 
 
+def test_spec_verify_window_per_row_masks():
+    """The speculative-verify shape: Tq = k+1 window per row, each row's
+    mask a staircase from its own base length (causal_lm.verify_step) —
+    including an INACTIVE row steered fully out of bounds (all-masked
+    rows must emit zeros, not NaN)."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    B, Tq, S = 3, 5, 64
+    q = _rand((B, Tq, 4, 16), ks[0])
+    k = _rand((B, S, 2, 16), ks[1])
+    v = _rand((B, S, 2, 16), ks[2])
+    base = jnp.asarray([0, 20, S])  # row 2: inactive, everything masked
+    positions = base[:, None] + jnp.arange(Tq)[None, :]
+    s_idx = jnp.arange(S)[None, None, None, :]
+    mask = s_idx <= jnp.where(
+        positions < S, positions, -1
+    )[:, None, :, None]
+    out = da.decode_attention(q, k, v, mask=mask, interpret=True)
+    assert out is not None
+    ref = _xla_attention(q, k, v, causal=False, mask=mask, scale=None)
+    # All rows match the oracle — including the fully-masked one, where
+    # the finite -1e30 sentinel makes both sides compute uniform
+    # attention (whose output is never consumed for inactive rows).
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-3, rtol=1e-3,
+    )
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_window_boundary_sizes():
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    k = _rand((2, 48, 2, 16), ks[1])
+    v = _rand((2, 48, 2, 16), ks[2])
+    mask = decode_mask(jnp.asarray([30, 47]), 48)
+    q8 = _rand((2, 8, 4, 16), ks[0])
+    _check(q8, k, v, mask=mask)  # Tq == MAX_WINDOW_FOR_KERNEL
+    q9 = _rand((2, 9, 4, 16), ks[0])
+    assert da.decode_attention(q9, k, v, mask=mask,
+                               interpret=True) is None
+
+
 def test_declines_non_decode_shapes():
     ks = jax.random.split(jax.random.PRNGKey(5), 3)
-    q = _rand((2, 8, 4, 16), ks[0])  # Tq != 1: prefill, not ours
+    q = _rand((2, 12, 4, 16), ks[0])  # window too wide: flash/XLA's job
     k = _rand((2, 64, 4, 16), ks[1])
     v = _rand((2, 64, 4, 16), ks[2])
     assert da.decode_attention(q, k, v, interpret=True) is None

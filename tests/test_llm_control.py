@@ -217,6 +217,17 @@ class TestLifecycle:
         ))
         assert sched.rates.rates()["a"] == pytest.approx(40.0)
 
+    def test_render_status_produces_slo_table(self):
+        from ray_dynamic_batching_tpu.engine.request import Request
+
+        sched, chips, profiles, built = make_sched()
+        sched.submit_request(Request(
+            model="a", payload={"tokens": [1], "max_new_tokens": 8},
+            slo_ms=1000.0,
+        ))
+        table = sched.render_status()
+        assert "model" in table and "a" in table
+
     def test_monitor_ignores_cold_start_inflation(self):
         fake = {"t": 1000.0}
         reg = RateRegistry(window_s=30.0, clock=lambda: fake["t"])

@@ -255,6 +255,9 @@ def main(profiles_dir: str, duration_s: float = 60.0,
             {m: round(f, 3) for m, f in c.busy_fractions().items()}
             for c in chips
         ]
+        # Terminal SLO table (the shared renderer the vision loop and
+        # state CLI use) — the operator-facing view of the same run.
+        print(sched.render_status(), file=sys.stderr, flush=True)
     finally:
         sched.shutdown()
 
