@@ -12,12 +12,18 @@ granularity**: each engine's turn is one admission pass plus one compiled
 scan (``DecodeEngine._step`` — ``decode_horizon`` substeps per dispatch).
 A compiled scan cannot be preempted mid-flight, so the scan IS the
 scheduling quantum, exactly like the duty-cycle packer's no-preemption
-occupancy discipline (``scheduler/nexus.py:86-88``): with round-robin
-turns, engine *i*'s share of chip time converges to
-``step_i / sum(step_j)`` over engines with active work, which is what the
-planner's ``compute_fraction`` admissibility assumes
-(``scheduler/nexus.py:326-376``). :meth:`busy_fractions` exposes the
-measured shares so tests can hold the model to the measurement.
+occupancy discipline (``scheduler/nexus.py:86-88``).
+
+Turns are **deficit-weighted by the planner's fractions**: each engine
+banks credit in proportion to its placement's ``compute_fraction`` as
+chip time elapses and pays its measured turn cost when it runs, so under
+sustained backlog engine *i*'s share of chip time converges to the
+fraction the plan ADMITTED it at (``scheduler/nexus.py:326-376``) — not
+to the accidental ``step_i / sum(step_j)`` ratio plain round-robin
+yields. Idle engines don't bank (their credit resets), so the executor
+stays work-conserving: an engine with the chip's only backlog takes the
+whole chip. :meth:`busy_fractions` exposes the measured shares so tests
+can hold the plan to the execution.
 
 Engines attach/detach live (the LLM control loop migrates models between
 chips as token rates shift). Detach drains by default: the engine stops
@@ -29,11 +35,13 @@ only once its last slot completes.
 
 from __future__ import annotations
 
+import collections
+import statistics
 import threading
 import time
 from contextlib import nullcontext
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
@@ -61,7 +69,20 @@ class HostedEngine:
     placement: Any = None          # LLMPlacement the planner assigned (if any)
     draining: bool = False
     busy_ms: float = 0.0           # wall time spent inside this engine's turns
+    credit_ms: float = 0.0         # deficit round-robin balance
     released: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def weight(self) -> float:
+        """Planned share of the chip: the placement's compute fraction,
+        or 1.0 (equal split after normalization) when unplanned."""
+        f = getattr(self.placement, "compute_fraction", None)
+        return float(f) if f else 1.0
+
+    def has_work(self) -> bool:
+        if self.engine.active_slots > 0:
+            return True
+        return not self.draining and len(self.engine.queue) > 0
 
 
 class ColocatedLLMEngines:
@@ -86,6 +107,17 @@ class ColocatedLLMEngines:
         self._run = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._wall_ms = 0.0
+        # Recent turn costs (median), for the credit clamp: a first-turn
+        # XLA compile can cost seconds — charged raw, the debtor would
+        # starve for hundreds of turns repaying chip time no tenant will
+        # miss. Bounding credits to a few TYPICAL turns keeps transients
+        # short while leaving long-run shares exactly weight-proportional.
+        self._recent_costs: collections.deque = collections.deque(maxlen=32)
+        # Between-chunk yields (long-prompt admissions): depth-1 guard +
+        # nested-cost ledger so the yielding engine isn't billed for the
+        # co-tenant scans that ran inside its turn.
+        self._yielding = False
+        self._nested_ms = 0.0
 
     # --- membership (called by the control loop, any thread) ---------------
     def attach(self, model: str, engine: DecodeEngine,
@@ -103,7 +135,12 @@ class ColocatedLLMEngines:
             if model in self._hosted:
                 old = self._hosted.pop(model)
                 self._hosted[f"{model}@draining{id(old)}"] = old
-            self._hosted[model] = HostedEngine(model, engine, placement)
+            hosted = HostedEngine(model, engine, placement)
+            self._hosted[model] = hosted
+            # Long-prompt admissions yield to co-tenants between chunks.
+            engine.interleave_hook = (
+                lambda h=hosted: self._yield_turn(h)
+            )
         logger.info("%s: attached %s (slots=%d, cap=%d)", self.name, model,
                     engine.num_slots, engine.max_len)
 
@@ -124,11 +161,16 @@ class ColocatedLLMEngines:
             return hosted.released
 
     def _release(self, hosted: HostedEngine) -> None:
+        hosted.engine.interleave_hook = None
         hosted.engine.abort_active(
             RequestDropped(f"{hosted.model} detached from {self.name}")
         )
         hosted.engine.release_buffers()
         hosted.released.set()
+        # A departed model must not keep reporting its last share.
+        BUSY_FRACTION.set(
+            0.0, tags={"chip": self.name, "model": hosted.model}
+        )
         logger.info("%s: released %s", self.name, hosted.model)
 
     def models(self) -> List[str]:
@@ -148,10 +190,13 @@ class ColocatedLLMEngines:
             return h.engine if h is not None and not h.draining else None
 
     # --- execution ---------------------------------------------------------
-    def _turn(self, hosted: HostedEngine) -> bool:
+    def _turn(self, hosted: HostedEngine) -> Tuple[bool, float]:
         """One scheduling quantum for one engine: admit (unless draining),
-        then at most one compiled scan. Returns True if compute ran."""
+        then at most one compiled scan. Returns (compute ran, cost ms) —
+        cost EXCLUDES co-tenant scans that ran via between-chunk yields
+        inside this turn (they bill their own engines)."""
         t0 = time.perf_counter()
+        nested0 = self._nested_ms
         engine = hosted.engine
         stepped = False
         with engine._device_ctx():
@@ -161,20 +206,48 @@ class ColocatedLLMEngines:
                 engine._step()
                 stepped = True
         engine.last_heartbeat = time.monotonic()
-        hosted.busy_ms += (time.perf_counter() - t0) * 1000.0
-        return stepped
+        cost = (time.perf_counter() - t0) * 1000.0
+        cost = max(0.0, cost - (self._nested_ms - nested0))
+        hosted.busy_ms += cost
+        return stepped, cost
 
-    def _pass(self) -> bool:
-        """One round-robin pass over every hosted engine."""
-        with self._lock:
-            hosted = list(self._hosted.items())
-        progressed = False
+    def _yield_turn(self, yielding: HostedEngine) -> None:
+        """Between-chunk yield from a long admission: ONE step-only scan
+        for the most-owed co-tenant with active work. Admission is not
+        run here (a co-tenant's own long fill inside the yield would
+        re-monopolize the chip); depth-1 guard stops recursion."""
+        if self._yielding:
+            return
+        self._yielding = True
+        try:
+            with self._lock:
+                others = [
+                    h for h in self._hosted.values()
+                    if h is not yielding and not h.released.is_set()
+                ]
+            workable = [h for h in others if h.engine.active_slots > 0]
+            if not workable:
+                return
+            chosen = max(workable, key=lambda h: h.credit_ms)
+            t0 = time.perf_counter()
+            with chosen.engine._device_ctx():
+                chosen.engine._step()
+            chosen.engine.last_heartbeat = time.monotonic()
+            cost = (time.perf_counter() - t0) * 1000.0
+            chosen.busy_ms += cost
+            self._nested_ms += cost
+            pool = workable + [yielding]
+            total_w = sum(h.weight for h in pool)
+            for h in pool:
+                h.credit_ms += cost * (h.weight / total_w)
+            chosen.credit_ms -= cost
+        except Exception:  # noqa: BLE001 — a co-tenant must not kill the fill
+            logger.exception("%s: yield turn failed", self.name)
+        finally:
+            self._yielding = False
+
+    def _finalize_drains(self, hosted) -> None:
         for key, h in hosted:
-            try:
-                progressed |= self._turn(h)
-            except Exception:  # noqa: BLE001 — one engine must not kill the chip
-                logger.exception("%s: turn failed for %s", self.name, h.model)
-                time.sleep(0.01)
             if h.draining and h.engine.active_slots == 0:
                 with self._lock:
                     self._release(h)
@@ -188,7 +261,54 @@ class ColocatedLLMEngines:
                         for k, v in list(self._hosted.items()):
                             if v is h:
                                 self._hosted.pop(k, None)
-        return progressed
+
+    def _pass(self) -> bool:
+        """One deficit-weighted quantum: run the most-owed engine that
+        has work, then distribute its measured cost as credit in
+        proportion to the backlogged engines' planned fractions."""
+        with self._lock:
+            hosted = list(self._hosted.items())
+        self._finalize_drains(hosted)
+        workable = []
+        for key, h in hosted:
+            if h.released.is_set():
+                continue
+            if h.has_work():
+                workable.append(h)
+            else:
+                # Idle engines don't bank credit: a tenant returning
+                # after a lull must not monopolize the chip repaying a
+                # debt nobody accrued against real work.
+                h.credit_ms = 0.0
+        if not workable:
+            return False
+        chosen = max(workable, key=lambda h: h.credit_ms)
+        try:
+            stepped, cost = self._turn(chosen)
+        except Exception:  # noqa: BLE001 — one engine must not kill the chip
+            logger.exception("%s: turn failed for %s", self.name,
+                             chosen.model)
+            # Charge the failed turn a typical cost: with credits
+            # untouched the max-credit pick would select the SAME broken
+            # engine forever and starve every co-tenant (round-robin's
+            # one virtue this scheduler must keep).
+            penalty = max(
+                statistics.median(self._recent_costs)
+                if self._recent_costs else 1.0,
+                1.0,
+            )
+            chosen.credit_ms -= penalty
+            time.sleep(0.01)
+            return False
+        total_w = sum(h.weight for h in workable)
+        for h in workable:
+            h.credit_ms += cost * (h.weight / total_w)
+        chosen.credit_ms -= cost
+        self._recent_costs.append(cost)
+        cap = 8.0 * max(statistics.median(self._recent_costs), 0.1)
+        for h in workable:
+            h.credit_ms = max(-cap, min(cap, h.credit_ms))
+        return stepped
 
     def step_once(self) -> bool:
         """Test/driver hook: one pass without the thread."""
@@ -276,12 +396,21 @@ class ColocatedLLMEngines:
     # --- accounting ---------------------------------------------------------
     def busy_fractions(self) -> Dict[str, float]:
         """Measured share of executor wall time each engine consumed —
-        the ground truth the planner's ``compute_fraction`` predicts."""
+        the ground truth the planner's ``compute_fraction`` predicts.
+        Only REAL model names export to the gauge: the synthetic
+        ``model@draining<id>`` keys minted per migration would grow the
+        metric's tag cardinality without bound on a long-running
+        deployment (and the gauge registry never evicts)."""
         with self._lock:
             wall = max(self._wall_ms, 1e-9)
             out = {mk: h.busy_ms / wall for mk, h in self._hosted.items()}
-        for mk, frac in out.items():
-            BUSY_FRACTION.set(frac, tags={"chip": self.name, "model": mk})
+            hosted = {
+                mk: h.model for mk, h in self._hosted.items()
+                if not h.draining
+            }
+        for mk, model in hosted.items():
+            BUSY_FRACTION.set(out[mk],
+                              tags={"chip": self.name, "model": model})
         return out
 
     def reset_accounting(self) -> None:
@@ -289,12 +418,14 @@ class ColocatedLLMEngines:
             self._wall_ms = 0.0
             for h in self._hosted.values():
                 h.busy_ms = 0.0
+                h.credit_ms = 0.0
 
     @property
     def active(self) -> bool:
         with self._lock:
             return any(
-                h.engine.active_slots > 0 for h in self._hosted.values()
+                getattr(h.engine, "busy", h.engine.active_slots > 0)
+                for h in self._hosted.values()
             )
 
     def describe(self) -> str:

@@ -521,6 +521,11 @@ class DecodeEngine:
         # (temps/topk/topp/seeds/bias/pres/freq), pure per-step overhead
         # on a tunneled chip.
         self._sampling_dev = None
+        # Installed by a colocation executor: called between chunk
+        # dispatches of long admissions so co-tenants aren't stalled.
+        self.interleave_hook: Optional[Callable[[], None]] = None
+        # Requests mid-admission (dequeued, not yet slotted) — see _admit.
+        self._admitting = 0
         self._thread: Optional[threading.Thread] = None
         self._run = threading.Event()
         self.steps = 0
@@ -1120,6 +1125,21 @@ class DecodeEngine:
         if self._active_mask.any():
             free = free[: self.max_admissions_per_step]
         batch = self.queue.get_batch(len(free), discard_stale=True)
+        # Mid-admission visibility: these requests are in NEITHER the
+        # queue nor a slot until their prefill registers (seconds for a
+        # cold/large program) — drain/idle checks that only look at
+        # queue depth + active slots would see "idle" in that window and
+        # a shutdown would abort a request that was seconds from its
+        # first token (observed: the colocation demo deterministically
+        # dropped its final tail request this way).
+        self._admitting = len(batch)
+        try:
+            return self._admit_batch(batch, free)
+        finally:
+            self._admitting = 0
+
+    def _admit_batch(self, batch: List[Request],
+                     free: List[int]) -> int:
         t_dequeue = now_ms()
         for req in batch:
             # Dequeue stamp for the TTFT decomposition; a slot-starved
@@ -1332,9 +1352,15 @@ class DecodeEngine:
     def _interleave_step(self) -> None:
         """One plain decode step for the active batch between chunk
         dispatches — the bound that keeps a long fill from stalling
-        in-flight requests for more than one chunk."""
+        in-flight requests for more than one chunk. When a colocation
+        executor hosts this engine it installs ``interleave_hook``, so
+        CO-TENANT engines get scans between chunks too — otherwise one
+        tenant's long-prompt admission would monopolize the shared chip
+        for the whole fill (engine/colocate.py)."""
         if self._active_mask.any():
             self._step(horizon=1)
+        if self.interleave_hook is not None:
+            self.interleave_hook()
 
     def _commit_and_register(
         self, req: Request, prompt: np.ndarray, opts: Dict, slot_idx: int,
@@ -1955,3 +1981,11 @@ class DecodeEngine:
     @property
     def active_slots(self) -> int:
         return int(self._active_mask.sum())
+
+    @property
+    def busy(self) -> bool:
+        """Work in flight: active slots OR requests mid-admission
+        (dequeued but not yet slotted — invisible to both queue depth
+        and ``active_slots``; drain logic that ignores this window
+        aborts requests seconds from their first token)."""
+        return self._admitting > 0 or bool(self._active_mask.any())

@@ -113,12 +113,11 @@ def dot_product_attention(
             # wider windows.
             from ray_dynamic_batching_tpu.ops import decode_attention
 
-            if q.shape[1] <= decode_attention.MAX_WINDOW_FOR_KERNEL:
-                out = decode_attention.decode_attention(
-                    q, k, v, mask=mask, scale=scale
-                )
-                if out is not None:
-                    return out
+            out = decode_attention.decode_attention(
+                q, k, v, mask=mask, scale=scale
+            )
+            if out is not None:
+                return out
         from ray_dynamic_batching_tpu.ops import flash_attention
 
         out = flash_attention.flash_attention(

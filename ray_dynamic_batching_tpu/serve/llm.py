@@ -192,6 +192,7 @@ class LLMReplica(Replica):
     def queue_len(self) -> int:
         return sum(
             len(q) + self.engines[b].active_slots
+            + self.engines[b]._admitting
             for b, q in self._queues.items()
         )
 

@@ -228,9 +228,12 @@ class TestColocatedExecution:
 
 
 class TestOccupancyModelValidation:
-    """VERDICT r4 #4: the fraction model's premise — co-resident engines
-    share chip time in proportion to their step costs — held against
-    measurement, so a drifting model fails here before production."""
+    """VERDICT r4 #4, strengthened by the deficit-weighted executor: the
+    planner admits engines by compute fraction, and under sustained
+    backlog the executor must DELIVER those fractions as measured chip
+    time — a drifting model or scheduler fails here before production.
+    Share ratios under identical load are robust to background noise
+    (contention slows both tenants together), unlike absolute timings."""
 
     @staticmethod
     def _saturate(engine, queue, waves=2):
@@ -243,81 +246,111 @@ class TestOccupancyModelValidation:
             ))
 
     @staticmethod
-    def _solo_pass_ms(lm, slots, cap, passes=30):
-        """Measured cost of one executor turn (scan + harvest + host
-        bookkeeping) for a saturated engine — the sharing model's inputs
-        must include the same overheads the colocated turns pay. Median
-        of per-pass timings: a background CPU burst must skew one pass,
-        not the whole estimate."""
-        model, params = lm
-        q = RequestQueue("probe", max_len=256)
-        engine = DecodeEngine(
-            model, params, q, num_slots=slots, max_len=cap,
-            prompt_buckets=[8], decode_horizon=1,
-        )
-        ex = ColocatedLLMEngines(name=f"solo{slots}x{cap}")
-        ex.attach("m", engine)
-        TestOccupancyModelValidation._saturate(engine, q, waves=3)
-        for _ in range(5):  # warm: admissions + first compiles
-            ex.step_once()
-        samples = []
-        done = 0
-        while done < passes and engine.active_slots > 0:
-            t0 = time.perf_counter()
-            ex.step_once()
-            samples.append((time.perf_counter() - t0) * 1000.0)
-            done += 1
-        ex.shutdown()
-        assert samples
-        return float(np.median(samples))
+    def _colocated_shares(lm, fractions, passes=250):
+        """Run two engines (different shapes, so different step costs)
+        saturated on one executor; return measured busy shares."""
+        from ray_dynamic_batching_tpu.scheduler.nexus import LLMPlacement
 
-    def test_fraction_model_brackets_measured_sharing(self, lm):
         model, params = lm
-        s_a = self._solo_pass_ms(lm, 4, 64)
-        s_b = self._solo_pass_ms(lm, 2, 32)
-        # Timing validation needs a quiet host: re-measure A and skip if
-        # the box moved under us (a shared CI machine's noise would fail
-        # the bracket for reasons unrelated to the sharing model).
-        s_a2 = self._solo_pass_ms(lm, 4, 64)
-        if abs(s_a2 - s_a) > 0.25 * max(s_a, s_a2):
-            pytest.skip(
-                f"host too noisy for timing validation: solo pass "
-                f"{s_a:.2f}ms vs {s_a2:.2f}ms on re-measure"
-            )
-        s_a = (s_a + s_a2) / 2.0
-        pred_a = s_a / (s_a + s_b)
-        pred_b = s_b / (s_a + s_b)
-
-        q_a = RequestQueue("a", max_len=256)
-        q_b = RequestQueue("b", max_len=256)
-        e_a = DecodeEngine(model, params, q_a, num_slots=4, max_len=64,
-                           prompt_buckets=[8], decode_horizon=1)
-        e_b = DecodeEngine(model, params, q_b, num_slots=2, max_len=32,
-                           prompt_buckets=[8], decode_horizon=1)
+        shapes = {"a": (4, 64), "b": (2, 32)}
         ex = ColocatedLLMEngines(name="shared")
-        ex.attach("a", e_a)
-        ex.attach("b", e_b)
-        # Enough waves that neither runs dry inside the measured window.
-        self._saturate(e_a, q_a, waves=3)
-        self._saturate(e_b, q_b, waves=6)
-        for _ in range(5):
+        engines = {}
+        for name, (slots, cap) in shapes.items():
+            q = RequestQueue(name, max_len=256)
+            e = DecodeEngine(model, params, q, num_slots=slots,
+                             max_len=cap, prompt_buckets=[8],
+                             decode_horizon=1)
+            placement = None
+            if fractions.get(name) is not None:
+                placement = LLMPlacement(
+                    model=name, num_slots=slots, capacity=cap,
+                    step_ms=1.0, compute_fraction=fractions[name],
+                    hbm_bytes=1,
+                )
+            ex.attach(name, e, placement)
+            engines[name] = (e, q)
+        for name, (e, q) in engines.items():
+            TestOccupancyModelValidation._saturate(e, q, waves=8)
+        for _ in range(8):  # warm: admissions + first compiles
             ex.step_once()
         ex.reset_accounting()
-        passes = 0
-        while passes < 200 and e_a.active_slots > 0 and e_b.active_slots > 0:
+        done = 0
+        while done < passes and all(
+            e.active_slots > 0 or len(q) > 0
+            for e, q in engines.values()
+        ):
             ex.step_once()
-            passes += 1
+            done += 1
         fr = ex.busy_fractions()
         ex.shutdown()
-        assert passes >= 20, "window too short to mean anything"
-        # The prediction must bracket the measurement: each engine's share
-        # of chip time within 0.15 absolute of step_i / sum(step_j), and
-        # the shares must account for (nearly) all the wall time — if
-        # either drifts, the planner's admissibility math is lying.
-        assert abs(fr["a"] - pred_a) <= 0.15, (
-            f"a: measured {fr['a']:.2f} vs predicted {pred_a:.2f}"
-        )
-        assert abs(fr["b"] - pred_b) <= 0.15, (
-            f"b: measured {fr['b']:.2f} vs predicted {pred_b:.2f}"
+        assert done >= 50, "window too short to mean anything"
+        return fr
+
+    def test_planned_fractions_are_delivered(self, lm):
+        """An asymmetric plan (0.7 / 0.3) must show up as chip-time
+        shares — regardless of the engines' own step costs."""
+        fr = self._colocated_shares(lm, {"a": 0.7, "b": 0.3})
+        share = fr["a"] / max(fr["a"] + fr["b"], 1e-9)
+        assert abs(share - 0.7) <= 0.12, (
+            f"a's planned 0.70 of chip time measured {share:.2f}"
         )
         assert 0.8 <= fr["a"] + fr["b"] <= 1.01
+
+    def test_long_prompt_fill_does_not_stall_cotenant(self, lm):
+        """A long chunked admission on tenant A must NOT monopolize the
+        shared chip: the between-chunk hook hands co-tenant B one scan
+        per chunk, so B keeps producing tokens through A's whole fill."""
+        model, params = lm
+        ex = ColocatedLLMEngines(name="isolation")
+        q_a = RequestQueue("a", max_len=64)
+        e_a = DecodeEngine(model, params, q_a, num_slots=2, max_len=256,
+                           prompt_buckets=[8], decode_horizon=1)
+        q_b = RequestQueue("b", max_len=64)
+        e_b = DecodeEngine(model, params, q_b, num_slots=2, max_len=128,
+                           prompt_buckets=[8], decode_horizon=1)
+        ex.attach("a", e_a)
+        ex.attach("b", e_b)
+        try:
+            # Prime B with long-running decodes so it has active work for
+            # the duration of A's fill.
+            for _ in range(2):
+                q_b.add_request(Request(
+                    model="llama_tiny",
+                    payload={"tokens": np.asarray([1, 2, 3], np.int32),
+                             "max_new_tokens": 120},
+                    slo_ms=600_000.0,
+                ))
+            while e_b.active_slots == 0:
+                ex.step_once()
+            # A's long prompt: 120 tokens over 8-wide chunks = 15 chunk
+            # dispatches in ONE admission call.
+            prompt = np.arange(1, 121, dtype=np.int32)
+            n_chunks = (len(prompt) + 7) // 8
+            q_a.add_request(Request(
+                model="llama_tiny",
+                payload={"tokens": prompt, "max_new_tokens": 4},
+                slo_ms=600_000.0,
+            ))
+            b_steps0 = None
+            while e_a.active_slots == 0:
+                b_steps0 = e_b.steps
+                assert ex.step_once(), "executor stalled before admission"
+            # The pass that admitted A ran its 15-chunk fill; B must have
+            # scanned between chunks (one yield per gap, minus slack for
+            # B's own-turn share of that same pass).
+            gained = e_b.steps - b_steps0
+            assert gained >= n_chunks - 3, (
+                f"co-tenant starved during long fill: B stepped {gained} "
+                f"times across a {n_chunks}-chunk admission"
+            )
+        finally:
+            ex.shutdown()
+
+    def test_unplanned_engines_split_evenly(self, lm):
+        """No placements: equal weights, equal TIME shares — even though
+        the (4,64) engine's scans cost more than the (2,32)'s."""
+        fr = self._colocated_shares(lm, {"a": None, "b": None})
+        share = fr["a"] / max(fr["a"] + fr["b"], 1e-9)
+        assert abs(share - 0.5) <= 0.12, (
+            f"equal split expected, a measured {share:.2f}"
+        )

@@ -177,3 +177,32 @@ def test_migration_storm_preserves_queued_work():
     served = [r.future.result(timeout=5) for r in reqs]
     assert len(served) == 30
     sched.shutdown()
+
+
+def test_failing_engine_does_not_starve_cotenants():
+    """A persistently-raising engine must not absorb every turn: the
+    scheduler charges failed turns so co-tenants keep being selected
+    (round-robin's liveness property, kept under deficit weighting)."""
+    from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+
+    class BrokenEngine(InstantEngine):
+        def _admit(self):
+            raise RuntimeError("device wedged")
+
+    chip = ColocatedLLMEngines(name="chip0")
+    q_bad = RequestQueue("bad", max_len=16)
+    q_bad.add_request(Request(model="bad", payload={"tokens": [1]},
+                              slo_ms=600_000.0))
+    chip.attach("bad", BrokenEngine("bad", 2, 64, q_bad))
+    q_ok = RequestQueue("ok", max_len=16)
+    reqs = []
+    for i in range(4):
+        r = Request(model="ok", payload={"tokens": [i]}, slo_ms=600_000.0)
+        q_ok.add_request(r)
+        reqs.append(r)
+    chip.attach("ok", InstantEngine("ok", 2, 64, q_ok))
+    for _ in range(12):
+        chip.step_once()
+    for r in reqs:
+        assert r.future.result(timeout=1)["served_by"] == "ok"
+    chip.shutdown()

@@ -914,3 +914,32 @@ class TestStopTokens:
         assert r.finish_reason == "eos"
         assert r.tokens == toks[: k + 1]
         assert neighbor.future.result(timeout=5).tokens == toks  # unaffected
+
+
+class TestMidAdmissionVisibility:
+    def test_admitting_requests_are_busy(self, lm):
+        """Between dequeue and slot registration a request is in NEITHER
+        the queue nor active_slots; `busy` must cover that window or
+        drain logic aborts requests mid-prefill (found by the colocation
+        demo deterministically dropping its final tail request)."""
+        engine, queue = make_engine(lm, num_slots=2)
+        try:
+            seen = {}
+            real = engine._prefill_group
+
+            def spy(bucket, chunk, slots):
+                seen["busy"] = engine.busy
+                seen["admitting"] = engine._admitting
+                return real(bucket, chunk, slots)
+
+            engine._prefill_group = spy
+            submit(queue, [1, 2, 3], max_new_tokens=2)
+            engine._admit()
+            assert seen == {"busy": True, "admitting": 1}
+            # Admission done: the ledger is clear, the slot carries it.
+            assert engine._admitting == 0
+            assert engine.busy and engine.active_slots == 1
+            engine.run_until_idle(timeout_s=60)
+            assert not engine.busy
+        finally:
+            engine.release_buffers()
