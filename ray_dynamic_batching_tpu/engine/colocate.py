@@ -118,6 +118,13 @@ class ColocatedLLMEngines:
         # co-tenant scans that ran inside its turn.
         self._yielding = False
         self._nested_ms = 0.0
+        # Deferred engine swaps (health-path replacement): applied at
+        # the next PASS BOUNDARY by the executor thread itself, so a
+        # wedged/failing engine is never released while a turn might be
+        # inside it. Timestamp of the last completed pass is the
+        # executor-liveness signal health checks key on.
+        self._pending_replacements: Dict[str, Tuple[DecodeEngine, Any]] = {}
+        self.last_pass_monotonic = time.monotonic()
 
     # --- membership (called by the control loop, any thread) ---------------
     def attach(self, model: str, engine: DecodeEngine,
@@ -144,20 +151,83 @@ class ColocatedLLMEngines:
         logger.info("%s: attached %s (slots=%d, cap=%d)", self.name, model,
                     engine.num_slots, engine.max_len)
 
+    def replace(self, model: str, engine: DecodeEngine,
+                placement: Any = None) -> None:
+        """Health-path swap: the READY successor (built + warmed by the
+        control loop) takes over at the next pass boundary — executed on
+        the executor thread, so the failing predecessor is released
+        outside any possible turn into it. Its in-flight requests are
+        rejected (heal semantics: a wedged engine's slots are lost, the
+        shared queue's backlog moves to the successor)."""
+        if engine._thread is not None:
+            raise ValueError(
+                f"{model}: replacement engine already runs its own loop"
+            )
+        with self._lock:
+            prior = self._pending_replacements.pop(model, None)
+            self._pending_replacements[model] = (engine, placement)
+        if prior is not None:
+            # A second pend before the pass boundary: the dropped
+            # successor's warm buffers must not leak.
+            prior[0].release_buffers()
+
+    def _apply_replacements(self) -> None:
+        with self._lock:
+            pending = self._pending_replacements
+            self._pending_replacements = {}
+        for model, (engine, placement) in pending.items():
+            with self._lock:
+                old = self._hosted.get(model)
+                if old is None or old.draining:
+                    # The model left this chip between pend and pass
+                    # boundary (rebalance migrated or drained it):
+                    # installing the successor would resurrect an
+                    # off-plan SECOND admitter against the shared queue.
+                    stale = engine
+                else:
+                    stale = None
+                    self._hosted.pop(model, None)
+                    self._release(old)
+                    hosted = HostedEngine(model, engine, placement)
+                    engine.interleave_hook = (
+                        lambda h=hosted: self._yield_turn(h)
+                    )
+                    self._hosted[model] = hosted
+            if stale is not None:
+                stale.release_buffers()
+                logger.warning(
+                    "%s: dropped stale replacement for %s (model no "
+                    "longer hosted here)", self.name, model,
+                )
+            else:
+                logger.warning(
+                    "%s: replaced %s (health path; slots=%d, cap=%d)",
+                    self.name, model, engine.num_slots, engine.max_len,
+                )
+
     def detach(self, model: str, drain: bool = True) -> threading.Event:
         """Stop admitting for ``model`` on this chip. With ``drain`` the
         in-flight sequences finish first; the returned event is set once
         the engine's buffers are released."""
         with self._lock:
+            pending = self._pending_replacements.pop(model, None)
             hosted = self._hosted.get(model)
             if hosted is None:
                 ev = threading.Event()
                 ev.set()
+                if pending is not None:
+                    pending[0].release_buffers()
                 return ev
             hosted.draining = True
             if not drain:
                 self._release(hosted)
                 self._hosted.pop(model, None)
+        if pending is not None:
+            # A detach cancels any queued health swap for the model —
+            # its successor must neither resurrect the model here nor
+            # leak its warm buffers.
+            pending[0].release_buffers()
+        with self._lock:
             return hosted.released
 
     def _release(self, hosted: HostedEngine) -> None:
@@ -266,6 +336,8 @@ class ColocatedLLMEngines:
         """One deficit-weighted quantum: run the most-owed engine that
         has work, then distribute its measured cost as credit in
         proportion to the backlogged engines' planned fractions."""
+        self._apply_replacements()
+        self.last_pass_monotonic = time.monotonic()
         with self._lock:
             hosted = list(self._hosted.items())
         self._finalize_drains(hosted)
@@ -392,6 +464,11 @@ class ColocatedLLMEngines:
             for h in list(self._hosted.values()):
                 self._release(h)
             self._hosted.clear()
+            pending = list(self._pending_replacements.values())
+            self._pending_replacements.clear()
+        for engine, _ in pending:
+            # Never-installed successors hold warm weights + KV.
+            engine.release_buffers()
 
     # --- accounting ---------------------------------------------------------
     def busy_fractions(self) -> Dict[str, float]:

@@ -119,6 +119,12 @@ class LLMLiveScheduler:
         self._stop = threading.Event()
         self.schedule_changes = 0
         self.migrations = 0
+        self.engine_replacements = 0
+        # Stalled-engine detection (the decode analogue of replica
+        # health replacement): an engine WITH WORK whose heartbeat
+        # hasn't moved in this long, on a chip whose executor is
+        # demonstrably passing, is failing its turns — rebuild it.
+        self.engine_stall_timeout_s = 60.0
         self.schedule_log: List[Dict] = []
 
     # --- registration ------------------------------------------------------
@@ -353,10 +359,65 @@ class LLMLiveScheduler:
             and cur.capacity == want.capacity
         )
 
+    # --- health: stalled-engine replacement --------------------------------
+    def check_engine_health(
+        self, stall_timeout_s: Optional[float] = None
+    ) -> int:
+        """Replace engines that have work but whose turns stopped
+        succeeding (heartbeat refreshes only on completed turns — a
+        repeatedly-raising engine reads stale while its queue rots).
+        Only chips whose executor loop is PROVABLY passing are
+        considered: a stale heartbeat on a non-passing chip means the
+        executor itself is stuck (possibly inside this engine's device
+        call) and releasing buffers under it would be a use-after-free —
+        that failure needs chip-level quarantine, not an engine swap.
+        The swap itself happens on the executor thread at a pass
+        boundary (``ColocatedLLMEngines.replace``), for the same reason.
+        Ref: the replica heal path's stall contract
+        (``serve/replica.py::healthy`` / controller replacement)."""
+        timeout = stall_timeout_s or self.engine_stall_timeout_s
+        now = time.monotonic()
+        replaced = 0
+        with self._lock:
+            if self._closed:
+                return 0
+            for chip in self.chips:
+                if not chip.running:
+                    continue
+                if now - chip.last_pass_monotonic > min(5.0, timeout):
+                    continue  # executor not passing: not safe to swap
+                placements = chip.placements()
+                for model in chip.models():
+                    engine = chip.engine_for(model)
+                    if engine is None:
+                        continue
+                    has_work = (
+                        engine.active_slots > 0
+                        or len(engine.queue) > 0
+                    )
+                    if not has_work:
+                        continue
+                    if now - engine.last_heartbeat < timeout:
+                        continue
+                    placement = placements.get(model)
+                    logger.warning(
+                        "%s on %s: stalled %.0fs with work — rebuilding",
+                        model, chip.name, now - engine.last_heartbeat,
+                    )
+                    successor = self.engine_factory(
+                        model, placement, self.queues.queue(model),
+                        chip.device,
+                    )
+                    chip.replace(model, successor, placement)
+                    replaced += 1
+                    self.engine_replacements += 1
+        return replaced
+
     # --- monitor loop ------------------------------------------------------
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.monitoring_interval_s):
             try:
+                self.check_engine_health()
                 changed = self.rates.changed_models(
                     self.rate_threshold, self.rate_decrease_multiplier,
                     # Half a window of evidence before a replan: engine
@@ -411,6 +472,7 @@ class LLMLiveScheduler:
             "busy_fractions": [c.busy_fractions() for c in self.chips],
             "schedule_changes": self.schedule_changes,
             "migrations": self.migrations,
+            "engine_replacements": self.engine_replacements,
         }
 
     def write_metrics(self) -> None:

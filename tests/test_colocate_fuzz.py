@@ -206,3 +206,82 @@ def test_failing_engine_does_not_starve_cotenants():
     for r in reqs:
         assert r.future.result(timeout=1)["served_by"] == "ok"
     chip.shutdown()
+
+
+def test_stalled_engine_is_replaced_and_backlog_served():
+    """Failure detection on the colocation path: an engine that keeps
+    failing its turns (stale heartbeat, work queued) is rebuilt by the
+    control loop's health check, the swap happens at a pass boundary on
+    the executor thread, and the shared queue's backlog flows to the
+    successor — the decode analogue of replica heal."""
+    import time
+
+    from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+
+    class BrokenEngine(InstantEngine):
+        def _admit(self):
+            raise RuntimeError("device wedged")
+
+    profiles = {"a": profile("a")}
+    chips = [ColocatedLLMEngines(name="chip0", idle_wait_s=0.001)]
+    built = []
+
+    def factory(model, placement, queue, device):
+        # First build is broken; the health-path rebuild works.
+        cls = BrokenEngine if not built else InstantEngine
+        e = cls(model, placement.num_slots, placement.capacity, queue)
+        built.append(e)
+        return e
+
+    sched = LLMLiveScheduler(profiles, chips, factory)
+    sched.register_model("a", token_slo_ms=1000.0)
+    try:
+        sched.rebalance(rates={"a": rate_for(0.3)})
+        reqs = []
+        for i in range(3):
+            r = Request(model="a", payload={"tokens": [i]},
+                        slo_ms=600_000.0)
+            sched.submit_request(r)
+            reqs.append(r)
+        chips[0].start()
+        time.sleep(0.3)  # broken turns accrue; heartbeat stays stale
+        assert sched.check_engine_health(stall_timeout_s=0.2) == 1
+        deadline = time.monotonic() + 5
+        for r in reqs:
+            res = r.future.result(timeout=max(0.1, deadline
+                                              - time.monotonic()))
+            assert res["served_by"] == "a"
+        assert built[0].released, "failed predecessor must be released"
+        assert sched.engine_replacements == 1
+    finally:
+        sched.shutdown()
+
+
+def test_stale_replacement_is_dropped_not_resurrected():
+    """A pending health swap whose model was migrated off the chip
+    before the pass boundary must be discarded (releasing its warm
+    buffers), not installed as a second admitter against the shared
+    queue; detach likewise cancels a queued swap."""
+    from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+
+    chip = ColocatedLLMEngines(name="chip0")
+    q = RequestQueue("a", max_len=16)
+    chip.attach("a", InstantEngine("a", 2, 64, q))
+    successor = InstantEngine("a", 2, 64, q)
+    chip.replace("a", successor)
+    # The model migrates away before any pass boundary runs the swap.
+    chip.detach("a", drain=False)
+    assert successor.released, "cancelled successor must release"
+    chip.step_once()
+    assert chip.models() == [], "stale successor must not resurrect"
+
+    # Overwritten pends release the dropped successor too.
+    chip.attach("a", InstantEngine("a", 2, 64, q))
+    s1 = InstantEngine("a", 2, 64, q)
+    s2 = InstantEngine("a", 2, 64, q)
+    chip.replace("a", s1)
+    chip.replace("a", s2)
+    assert s1.released and not s2.released
+    # And shutdown reclaims a never-installed pend.
+    chip.shutdown()
+    assert s2.released
