@@ -227,8 +227,7 @@ class ColocatedLLMEngines:
             # its successor must neither resurrect the model here nor
             # leak its warm buffers.
             pending[0].release_buffers()
-        with self._lock:
-            return hosted.released
+        return hosted.released
 
     def _release(self, hosted: HostedEngine) -> None:
         hosted.engine.interleave_hook = None
@@ -258,6 +257,28 @@ class ColocatedLLMEngines:
         with self._lock:
             h = self._hosted.get(model)
             return h.engine if h is not None and not h.draining else None
+
+    def hosted_engines(self) -> List[Tuple[str, DecodeEngine]]:
+        """EVERY resident engine — including draining predecessors,
+        whose in-flight slots a chip quarantine must still reject."""
+        with self._lock:
+            return [
+                (h.model, h.engine) for h in self._hosted.values()
+                if not h.released.is_set()
+            ]
+
+    def last_progress_monotonic(self) -> float:
+        """Most recent sign of life: pass starts OR completed engine
+        turns OR fresh attaches (engines stamp their heartbeat at
+        construction). Wedge detection keys on this rather than pass
+        starts alone, so a legitimately long first-turn compile on a
+        freshly built engine gets its full grace window instead of
+        reading as a wedge."""
+        with self._lock:
+            beats = [
+                h.engine.last_heartbeat for h in self._hosted.values()
+            ]
+        return max([self.last_pass_monotonic] + beats)
 
     # --- execution ---------------------------------------------------------
     def _turn(self, hosted: HostedEngine) -> Tuple[bool, float]:
@@ -411,7 +432,12 @@ class ColocatedLLMEngines:
         with ctx:
             while self._run.is_set():
                 t0 = time.perf_counter()
-                progressed = self._pass()
+                try:
+                    progressed = self._pass()
+                except Exception:  # noqa: BLE001 — loop must not die silently
+                    logger.exception("%s: pass failed", self.name)
+                    progressed = False
+                    time.sleep(0.05)
                 self._wall_ms += (time.perf_counter() - t0) * 1000.0
                 if not progressed:
                     time.sleep(self.idle_wait_s)

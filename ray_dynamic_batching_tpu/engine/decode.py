@@ -526,6 +526,7 @@ class DecodeEngine:
         self.interleave_hook: Optional[Callable[[], None]] = None
         # Requests mid-admission (dequeued, not yet slotted) — see _admit.
         self._admitting = 0
+        self._admitting_batch: List[Request] = []
         self._thread: Optional[threading.Thread] = None
         self._run = threading.Event()
         self.steps = 0
@@ -1133,10 +1134,16 @@ class DecodeEngine:
         # first token (observed: the colocation demo deterministically
         # dropped its final tail request this way).
         self._admitting = len(batch)
+        # The batch itself stays reachable while mid-admission: a chip
+        # quarantine must be able to reject these futures — they are in
+        # neither the queue nor a slot, and a wedged prefill dispatch
+        # would otherwise strand them forever.
+        self._admitting_batch = batch
         try:
             return self._admit_batch(batch, free)
         finally:
             self._admitting = 0
+            self._admitting_batch = []
 
     def _admit_batch(self, batch: List[Request],
                      free: List[int]) -> int:

@@ -33,7 +33,7 @@ from ray_dynamic_batching_tpu.engine.colocate import ColocatedLLMEngines
 from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
 from ray_dynamic_batching_tpu.engine.queue import QueueManager, RequestQueue
 from ray_dynamic_batching_tpu.engine.rates import RateRegistry
-from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile
 from ray_dynamic_batching_tpu.scheduler.nexus import (
     LLMPlacement,
@@ -114,17 +114,25 @@ class LLMLiveScheduler:
         self._models: Dict[str, LLMModelEntry] = {}
         self._current_plan: List[List[LLMPlacement]] = []
         self._closed = False
-        self._lock = threading.Lock()
+        # RLock: chip quarantine replans while already holding the lock.
+        self._lock = threading.RLock()
+        self.quarantined: List[ColocatedLLMEngines] = []
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.schedule_changes = 0
         self.migrations = 0
         self.engine_replacements = 0
+        self.chip_quarantines = 0
         # Stalled-engine detection (the decode analogue of replica
         # health replacement): an engine WITH WORK whose heartbeat
         # hasn't moved in this long, on a chip whose executor is
         # demonstrably passing, is failing its turns — rebuild it.
         self.engine_stall_timeout_s = 60.0
+        # Chip-level: an executor thread that stopped completing passes
+        # is wedged inside a device call — its HBM cannot be freed
+        # safely; the chip is quarantined and its models replanned onto
+        # the survivors.
+        self.chip_stall_timeout_s = 120.0
         self.schedule_log: List[Dict] = []
 
     # --- registration ------------------------------------------------------
@@ -149,6 +157,13 @@ class LLMLiveScheduler:
             request.reject(
                 KeyError(f"model {request.model!r} not registered")
             )
+            return False
+        if not self.chips:
+            # Every chip quarantined: accepting would enqueue into
+            # queues nothing can ever drain — fail fast instead.
+            request.reject(RequestDropped(
+                "no serving chips remain (all quarantined)"
+            ))
             return False
         tokens = entry.tokens_per_request
         if isinstance(request.payload, dict):
@@ -375,13 +390,27 @@ class LLMLiveScheduler:
         boundary (``ColocatedLLMEngines.replace``), for the same reason.
         Ref: the replica heal path's stall contract
         (``serve/replica.py::healthy`` / controller replacement)."""
-        timeout = stall_timeout_s or self.engine_stall_timeout_s
+        timeout = (stall_timeout_s if stall_timeout_s is not None
+                   else self.engine_stall_timeout_s)
         now = time.monotonic()
         replaced = 0
         with self._lock:
             if self._closed:
                 return 0
+            self._quarantine_wedged_chips(now)
             for chip in self.chips:
+                if chip._thread is not None and not chip.running:
+                    # The executor thread DIED (exited/crashed) rather
+                    # than wedging: engine state is intact and no device
+                    # call is in flight, so a restart is safe — without
+                    # it the chip would be invisible to both health
+                    # paths (they key on running executors).
+                    logger.error(
+                        "%s: executor thread died — restarting",
+                        chip.name,
+                    )
+                    chip.start()
+                    continue
                 if not chip.running:
                     continue
                 if now - chip.last_pass_monotonic > min(5.0, timeout):
@@ -412,6 +441,63 @@ class LLMLiveScheduler:
                     replaced += 1
                     self.engine_replacements += 1
         return replaced
+
+    def _quarantine_wedged_chips(self, now: float) -> None:
+        """A RUNNING executor that stopped completing passes is wedged
+        inside a device call: its engines' buffers can never be freed
+        safely (the call may still be touching them), so the chip is
+        written off — leaked deliberately, loudly — and its models
+        replan onto the surviving chips. In-flight slot futures are
+        rejected host-side (Request.reject/fulfill tolerate the wedged
+        call completing later); queued work lives in the SHARED queues
+        and flows to the replacements. Caller holds the lock."""
+        wedged = [
+            chip for chip in self.chips
+            if chip.running
+            and (now - chip.last_progress_monotonic()
+                 > self.chip_stall_timeout_s)
+        ]
+        for chip in wedged:
+            logger.error(
+                "%s: executor wedged (%.0fs since last pass) — "
+                "quarantining the chip; its HBM is written off",
+                chip.name, now - chip.last_pass_monotonic,
+            )
+            # Stop admissions if/when the wedged call ever returns: the
+            # loop checks _run before the next pass and exits, so the
+            # dead chip can never race its replacements for queue work.
+            chip.stop(timeout_s=0.1)
+            self.chips.remove(chip)
+            self.quarantined.append(chip)
+            self.chip_quarantines += 1
+            # EVERY resident engine, draining predecessors included —
+            # their drains can never finish on a wedged chip, and their
+            # slots hold real futures too.
+            for model, engine in chip.hosted_engines():
+                exc = RequestDropped(
+                    f"{model}: chip {chip.name} quarantined mid-flight"
+                )
+                for slot in getattr(engine, "_slots", []):
+                    req = getattr(slot, "request", None)
+                    if req is not None and not getattr(slot, "free", True):
+                        req.reject(exc)
+                # Requests the wedged _admit popped but never slotted —
+                # in neither the queue nor a slot; without this they
+                # hang forever (and the replacements can't serve them:
+                # they're gone from the shared queue).
+                for req in list(getattr(engine, "_admitting_batch", [])):
+                    req.reject(exc)
+        if wedged and not self._closed:
+            # The previous plan references dead chips — keeping it (the
+            # over-capacity / infeasible degradation branches) would
+            # blackhole their models while submit_request keeps
+            # accepting traffic. Invalidate UNCONDITIONALLY (even with
+            # zero survivors, a stale truthy plan would poison every
+            # later degradation branch), then replan onto whatever
+            # survives (truncated if need be).
+            self._current_plan = []
+            if self.chips:
+                self.rebalance()
 
     # --- monitor loop ------------------------------------------------------
     def _monitor_loop(self) -> None:
@@ -460,6 +546,10 @@ class LLMLiveScheduler:
             self._closed = True
         for chip in self.chips:
             chip.shutdown(timeout_s)
+        for chip in self.quarantined:
+            # Best-effort: a still-wedged loop keeps its buffers (the
+            # executor's own shutdown guard); an unwedged one cleans up.
+            chip.shutdown(timeout_s=0.5)
 
     # --- observability -----------------------------------------------------
     def snapshot(self) -> Dict:
@@ -473,6 +563,8 @@ class LLMLiveScheduler:
             "schedule_changes": self.schedule_changes,
             "migrations": self.migrations,
             "engine_replacements": self.engine_replacements,
+            "chip_quarantines": self.chip_quarantines,
+            "quarantined": [c.name for c in self.quarantined],
         }
 
     def write_metrics(self) -> None:

@@ -285,3 +285,100 @@ def test_stale_replacement_is_dropped_not_resurrected():
     # And shutdown reclaims a never-installed pend.
     chip.shutdown()
     assert s2.released
+
+
+def test_wedged_chip_is_quarantined_and_models_replan():
+    """Chip-level failure: an executor stuck inside a 'device call'
+    stops completing passes; the health check writes the chip off (its
+    HBM can't be freed safely), stops its admissions, and replans the
+    models onto surviving chips — queued work flows to the
+    replacements through the shared queues."""
+    import threading
+    import time
+
+    wedge = threading.Event()
+
+    class WedgedEngine(InstantEngine):
+        def _admit(self):
+            # Pop a request first: it is now in NEITHER the queue nor a
+            # slot (the mid-admission window) when the wedge hits.
+            self._admitting_batch = self.queue.get_batch(
+                1, discard_stale=False
+            )
+            wedge.wait()  # the 'device call' that never returns
+            return 0
+
+    profiles = {"a": profile("a")}
+    chips = [ColocatedLLMEngines(name=f"chip{i}", idle_wait_s=0.001)
+             for i in range(2)]
+    built = []
+
+    def factory(model, placement, queue, device):
+        cls = WedgedEngine if not built else InstantEngine
+        e = cls(model, placement.num_slots, placement.capacity, queue)
+        built.append(e)
+        return e
+
+    sched = LLMLiveScheduler(profiles, chips, factory)
+    sched.chip_stall_timeout_s = 0.3
+    sched.register_model("a", token_slo_ms=1000.0)
+    try:
+        sched.rebalance(rates={"a": rate_for(0.3)})
+        host = next(c for c in chips if c.models())
+        spare = next(c for c in chips if c is not host)
+        for c in chips:
+            c.start()
+        req = Request(model="a", payload={"tokens": [1]}, slo_ms=600_000.0)
+        sched.submit_request(req)
+        time.sleep(0.6)  # host's loop is stuck inside _admit
+        sched.check_engine_health()
+        assert sched.chip_quarantines == 1
+        assert host not in sched.chips and host in sched.quarantined
+        # The request the wedged _admit popped (neither queued nor
+        # slotted) must be rejected, not stranded forever.
+        with pytest.raises(Exception):
+            req.future.result(timeout=2)
+        # New traffic serves from the replacement on the spare.
+        req2 = Request(model="a", payload={"tokens": [2]},
+                       slo_ms=600_000.0)
+        sched.submit_request(req2)
+        assert req2.future.result(timeout=5)["served_by"] == "a"
+        assert "a" in spare.models()
+    finally:
+        wedge.set()  # un-wedge so the daemon thread exits
+        sched.shutdown()
+
+
+def test_dead_executor_thread_is_restarted():
+    """An executor thread that EXITS (crash path) leaves intact engine
+    state with no device call in flight: the health check restarts the
+    loop instead of quarantining the chip."""
+    import time
+
+    profiles = {"a": profile("a")}
+    chips = [ColocatedLLMEngines(name="chip0", idle_wait_s=0.001)]
+
+    def factory(model, placement, queue, device):
+        return InstantEngine(model, placement.num_slots,
+                             placement.capacity, queue)
+
+    sched = LLMLiveScheduler(profiles, chips, factory)
+    sched.register_model("a", token_slo_ms=1000.0)
+    try:
+        sched.rebalance(rates={"a": rate_for(0.3)})
+        chips[0].start()
+        # Kill the loop the way a crash would leave it: thread handle
+        # set, thread dead.
+        chips[0]._run.clear()
+        deadline = time.monotonic() + 5
+        while chips[0].running and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not chips[0].running and chips[0]._thread is not None
+        chips[0]._run.set()  # a crashed loop would leave _run set
+        sched.check_engine_health()
+        assert chips[0].running, "dead executor must be restarted"
+        req = Request(model="a", payload={"tokens": [1]}, slo_ms=600_000.0)
+        sched.submit_request(req)
+        assert req.future.result(timeout=5)["served_by"] == "a"
+    finally:
+        sched.shutdown()
