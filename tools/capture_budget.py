@@ -49,8 +49,11 @@ CPU_MEASURED = {
     # tools/run_llm_demo.py --cpu (360s serving + gpt2 init/warmup +
     # drain; TPU runs 120s with dense rates).
     "llm_demo": {
-        "seconds": 900,
-        "source": "round-5 CPU record: ~4min builds + 6min run + drain",
+        "seconds": 950,
+        "source": "round-5 CPU runs: ~4min gpt2 builds/warmup + 6min "
+                  "serving + drain (measured ~15min wall end-to-end; "
+                  "TPU runs 120s serving, builds compile-cache-hit "
+                  "after the profiles step)",
     },
     # bench.py has no CPU mode (its whole point is the accelerator), but
     # its dominant rows are bounded by round-4 measurements: the 8B row's
