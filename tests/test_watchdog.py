@@ -49,13 +49,29 @@ def sandbox(tmp_path, monkeypatch):
         "open(os.path.join(out, 'resnet50_summary.csv'), 'w')"
         ".write('batch_size,latency_ms\\n1,0.5\\n')\n"
     )
+    def demo_stub(record_file, metric):
+        """One parameterized demo stub serves both demo scripts: status
+        and backend are env-injectable, exit codes follow the real demo
+        contract (0 good/warning-with-compliance, 2 SLO missed, 3 no
+        migration/rebalance)."""
+        return (
+            "import json, os, sys\n"
+            "out = sys.argv[1]\n"
+            "os.makedirs(out, exist_ok=True)\n"
+            "status = os.environ.get('STUB_DEMO_STATUS', 'good')\n"
+            "backend = os.environ.get('STUB_DEMO_BACKEND', 'tpu')\n"
+            f"open(os.path.join(out, '{record_file}'), 'w').write(\n"
+            f"    json.dumps({{'metric': '{metric}',"
+            " 'backend': backend, 'status': status}))\n"
+            "sys.exit(3 if status in ('no_migration', 'no_rebalance')\n"
+            "         else 2 if status == 'critical' else 0)\n"
+        )
+
     (repo / "tools" / "run_slo_demo.py").write_text(
-        "import json, os, sys\n"
-        "out = sys.argv[1]\n"
-        "os.makedirs(out, exist_ok=True)\n"
-        "open(os.path.join(out, 'slo_demo.json'), 'w').write(\n"
-        "    json.dumps({'metric': 'slo_demo', 'backend': 'tpu',"
-        " 'status': 'good'}))\n"
+        demo_stub("slo_demo.json", "slo_demo")
+    )
+    (repo / "tools" / "run_llm_demo.py").write_text(
+        demo_stub("llm_demo.json", "llm_colocation_demo")
     )
     (repo / "README").write_text("sandbox\n")
     _git(str(repo), "add", "-A")
@@ -168,3 +184,43 @@ class TestCaptureRejection:
         head = _git(repo, "rev-parse", "HEAD")
         assert wd.capture_bench() is False
         assert _git(repo, "rev-parse", "HEAD") == head
+
+
+class TestLLMDemoCapture:
+    def test_llm_demo_capture_commits_verified_record(self, sandbox):
+        wd, repo = sandbox
+        assert wd.capture_llm_demo() is True
+        log = _git(repo, "log", "--oneline")
+        assert "LLM colocation demo record" in log
+        rec = json.loads(_git(
+            repo, "show", "HEAD:profiles/tpu_v5e/llm_demo.json"
+        ))
+        assert rec["backend"] == "tpu"
+
+    def test_slo_missed_record_still_committed(self, sandbox, monkeypatch):
+        """Exit 2 (SLO missed) is still real measured ground truth — the
+        asymmetric accept branch must keep committing it."""
+        wd, repo = sandbox
+        monkeypatch.setenv("STUB_DEMO_STATUS", "critical")
+        assert wd.capture_llm_demo() is True
+        rec = json.loads(_git(
+            repo, "show", "HEAD:profiles/tpu_v5e/llm_demo.json"
+        ))
+        assert rec["status"] == "critical"
+
+    def test_no_migration_record_discarded(self, sandbox, monkeypatch):
+        """Exit 3 (no migration) would commit a record proving the
+        OPPOSITE of what the step exists to prove — discard it."""
+        wd, repo = sandbox
+        monkeypatch.setenv("STUB_DEMO_STATUS", "no_migration")
+        assert wd.capture_llm_demo() is False
+        assert "LLM colocation" not in _git(repo, "log", "--oneline")
+        assert not os.path.exists(
+            os.path.join(wd.OUT_DIR, "llm_demo.json")
+        ), "failed-step residue must be discarded"
+
+    def test_cpu_masquerade_rejected(self, sandbox, monkeypatch):
+        wd, repo = sandbox
+        monkeypatch.setenv("STUB_DEMO_BACKEND", "cpu")
+        assert wd.capture_llm_demo() is False
+        assert "LLM colocation" not in _git(repo, "log", "--oneline")
