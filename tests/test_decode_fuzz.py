@@ -35,18 +35,37 @@ def lm():
     return model, params
 
 
-@pytest.mark.timeout(500)
-def test_feature_matrix_fuzz(lm):
+@pytest.mark.timeout(900)
+@pytest.mark.parametrize("backend,n_requests", [
+    ("xla", 40),
+    # The Pallas window kernel under the SAME randomized feature matrix
+    # (interpret mode on CPU): plain scans, speculative windows, chunked
+    # admissions, sessions — shapes the parity tests don't enumerate.
+    # Smaller scale: interpret mode multiplies per-dispatch cost.
+    ("pallas", 12),
+])
+def test_feature_matrix_fuzz(lm, backend, n_requests):
+    import contextlib
+
+    from ray_dynamic_batching_tpu.ops.attention import (
+        set_attention_backend,
+    )
+
+    @contextlib.contextmanager
+    def attention_backend(name):
+        # Guard the process-global backend for EVERY exit path (a pallas
+        # bug raising mid-fuzz is exactly what this hunts for; it must
+        # not leave later tests running the wrong kernel).
+        set_attention_backend(name)
+        try:
+            yield
+        finally:
+            set_attention_backend("auto")
+
     model, params = lm
     rng = np.random.default_rng(2026)
     queue = RequestQueue(model.name, max_len=512)
-    engine = DecodeEngine(
-        model, params, queue, num_slots=4, max_len=96,
-        prompt_buckets=[8, 16], default_max_new_tokens=6,
-        decode_horizon=4, spec_tokens=2,
-        draft_model=model, draft_params=params,
-        prefix_cache_size=4, session_cache_size=4,
-    )
+
     def make_payload(i):
         kind = rng.integers(0, 7)
         L = int(rng.integers(2, 7))
@@ -72,17 +91,25 @@ def test_feature_matrix_fuzz(lm):
         return payload
 
     submitted = []
-    for i in range(40):
-        payload = make_payload(i)
-        req = Request(model=model.name, payload=dict(payload),
-                      slo_ms=300_000.0)
-        queue.add_request(req)
-        submitted.append((payload, req))
-        if rng.random() < 0.4:  # interleave serving with arrivals
-            engine._admit()
-            if engine._active_mask.any():
-                engine._step()
-    engine.run_until_idle(timeout_s=300)
+    with attention_backend(backend):
+        engine = DecodeEngine(
+            model, params, queue, num_slots=4, max_len=96,
+            prompt_buckets=[8, 16], default_max_new_tokens=6,
+            decode_horizon=4, spec_tokens=2,
+            draft_model=model, draft_params=params,
+            prefix_cache_size=4, session_cache_size=4,
+        )
+        for i in range(n_requests):
+            payload = make_payload(i)
+            req = Request(model=model.name, payload=dict(payload),
+                          slo_ms=300_000.0)
+            queue.add_request(req)
+            submitted.append((payload, req))
+            if rng.random() < 0.4:  # interleave serving with arrivals
+                engine._admit()
+                if engine._active_mask.any():
+                    engine._step()
+        engine.run_until_idle(timeout_s=600)
 
     # --- invariants --------------------------------------------------------
     assert engine.active_slots == 0
@@ -99,24 +126,25 @@ def test_feature_matrix_fuzz(lm):
             pure_greedy.append((payload, res.tokens))
 
     # Greedy requests must be batch-neighbor-independent: replay them on a
-    # fresh plain engine and demand identical output.
+    # fresh plain engine (same backend) and demand identical output.
     assert pure_greedy, "fuzz mix produced no pure-greedy requests"
-    ref_queue = RequestQueue(model.name, max_len=512)
-    ref_engine = DecodeEngine(
-        model, params, ref_queue, num_slots=2, max_len=96,
-        prompt_buckets=[8, 16], default_max_new_tokens=6,
-    )
-    for payload, expect in pure_greedy:
-        req = Request(model=model.name, payload=dict(payload),
-                      slo_ms=300_000.0)
-        ref_queue.add_request(req)
-        ref_engine.run_until_idle(timeout_s=120)
-        assert req.future.result(timeout=5).tokens == expect
+    with attention_backend(backend):
+        ref_queue = RequestQueue(model.name, max_len=512)
+        ref_engine = DecodeEngine(
+            model, params, ref_queue, num_slots=2, max_len=96,
+            prompt_buckets=[8, 16], default_max_new_tokens=6,
+        )
+        for payload, expect in pure_greedy:
+            req = Request(model=model.name, payload=dict(payload),
+                          slo_ms=300_000.0)
+            ref_queue.add_request(req)
+            ref_engine.run_until_idle(timeout_s=120)
+            assert req.future.result(timeout=5).tokens == expect
 
-    # The engine serves again after draining (no state corruption).
-    again = Request(model=model.name,
-                    payload={"tokens": [1, 2, 3], "max_new_tokens": 4},
-                    slo_ms=300_000.0)
-    queue.add_request(again)
-    engine.run_until_idle(timeout_s=120)
-    assert len(again.future.result(timeout=5).tokens) == 4
+        # The engine serves again after draining (no state corruption).
+        again = Request(model=model.name,
+                        payload={"tokens": [1, 2, 3], "max_new_tokens": 4},
+                        slo_ms=300_000.0)
+        queue.add_request(again)
+        engine.run_until_idle(timeout_s=120)
+        assert len(again.future.result(timeout=5).tokens) == 4
