@@ -408,13 +408,18 @@ def main() -> dict:
                 "watchdog (tools/tpu_watchdog.py) probed throughout the "
                 "round and auto-commits verified on-chip records into "
                 "profiles/tpu_v5e/ the moment the tunnel answers — check "
-                "that directory for captures. Last measured on-chip "
-                "(round 3): 1693 tok/s/chip (gpt2_medium, 64 slots), "
-                "TTFT p50 197 ms, resnet50 11253 samples/s; the TTFT "
-                "number predates the three-tier decode horizon, whose "
-                "admission-wait bound is now regression-tested on CPU "
-                "(tests/test_ttft.py) and decomposed in this record's "
-                "llm.ttft_breakdown when measured."
+                "that directory for captures, and "
+                "profiles/capture_budget.json for the measured proof "
+                "that the full capture suite (bench -> tables -> SLO "
+                "demo -> LLM colocation demo) fits one ~74-minute relay "
+                "window, bench first. Last measured on-chip (round 3): "
+                "1693 tok/s/chip (gpt2_medium, 64 slots), TTFT p50 "
+                "197 ms, resnet50 11253 samples/s; the TTFT number "
+                "predates the three-tier decode horizon (bound now "
+                "regression-tested on CPU, tests/test_ttft.py), the "
+                "round-4 host-path series, and the round-5 Pallas "
+                "decode-attention kernel — all of which land in this "
+                "record's llm row when measured."
             ),
         }
     llm = bench_llm_serving(
