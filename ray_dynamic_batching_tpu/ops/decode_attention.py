@@ -14,11 +14,18 @@ costs on that scan (``ops/attention.py::_xla_attention``):
   intermediates materialize between two einsums instead of living in
   VMEM.
 
-This kernel fuses the scan FlashAttention-style: grid (B, K); each
-program owns one slot's one KV head, reads its [S, H] K/V slab exactly
-once (all Tq window rows and all G = N/K query heads sharing that KV
-head ride the same read), runs the online softmax over KV tiles in
-VMEM, and writes the [Tq*G, H] output — GQA via layout, no repeat.
+This kernel fuses the scan FlashAttention-style: grid (B, K // kb);
+each program owns one slot's block of ``kb`` KV heads, reads each
+[S, H] K/V slab exactly once (all Tq window rows and all G = N/K query
+heads sharing a KV head ride the same read), runs the online softmax
+over KV tiles in VMEM, and writes the [kb, Tq*G, H] output — GQA via
+layout, no repeat. Heads are blocked because the TPU lowering requires
+the trailing two block dims to be (8, 128)-tile-aligned or span the
+array: K/V live as [B, S, K, H], so a one-head block (trailing dims
+(1, H)) is illegal — ``kb`` is 8 when K divides into 8-groups, else the
+full K (span). A layout transpose instead would materialize a full
+KV-cache copy every substep, which is the exact HBM cost this kernel
+exists to avoid.
 Large prefill tiles stay on the flash kernel
 (``ops/flash_attention.py``); this covers the decode half VERDICT r4 #8
 called out (the reference has no decode engine to compare against — its
@@ -50,65 +57,92 @@ MAX_WINDOW_FOR_KERNEL = 8
 
 
 def _decode_kernel(
-    q_ref,      # [1, 1, Tq*G, H]   rows ordered (t, g)
-    k_ref,      # [1, S, 1, H]
-    v_ref,      # [1, S, 1, H]
+    q_ref,      # [1, kb, Tq*G, H]   rows ordered (t, g)
+    k_ref,      # [1, S, kb, H]
+    v_ref,      # [1, S, kb, H]
     mask_ref,   # [1, Tq, S] int8, or None
-    o_ref,      # [1, 1, Tq*G, H]
+    o_ref,      # [1, kb, Tq*G, H]
     *,
     scale: float,
     block_k: int,
     kv_len: int,
     window: int,
 ):
+    kb = q_ref.shape[1]
     R = q_ref.shape[2]          # Tq * G
     H = q_ref.shape[3]
     G = R // window
-    q = q_ref[0, 0, :, :]       # [R, H]
     num_kb = pl.cdiv(kv_len, block_k)
 
-    def body(jk, carry):
-        m_prev, l_prev, acc_prev = carry
-        k_tile = k_ref[0, pl.ds(jk * block_k, block_k), 0, :]  # [block_k, H]
-        v_tile = v_ref[0, pl.ds(jk * block_k, block_k), 0, :]
-        s = jax.lax.dot_general(
-            q, k_tile,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [R, block_k] f32
+    for h in range(kb):         # static unroll: this program's KV heads
+        q = q_ref[0, h, :, :]   # [R, H]
 
-        k_pos = jk * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (R, block_k), 1
-        )
-        valid = k_pos < kv_len  # tail tile past S
-        if mask_ref is not None:
-            mvals = mask_ref[0, :, pl.ds(jk * block_k, block_k)] != 0
-            # [Tq, block_k] -> one row per (t, g): g shares t's window.
-            rows = jnp.broadcast_to(
-                mvals[:, None, :], (window, G, block_k)
-            ).reshape(R, block_k)
-            valid = jnp.logical_and(valid, rows)
-        s = jnp.where(valid, s, NEG_INF)
+        def body(jk, carry):
+            m_prev, l_prev, acc_prev = carry
+            ds = pl.ds(jk * block_k, block_k)
+            k_tile = k_ref[0, ds, h, :]  # [block_k, H]
+            v_tile = v_ref[0, ds, h, :]
+            s = jax.lax.dot_general(
+                q, k_tile,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [R, block_k] f32
 
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))  # [R]
-        alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur[:, None])  # [R, block_k]
-        l_cur = l_prev * alpha + jnp.sum(p, axis=1)
-        acc = acc_prev * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v_tile.dtype), v_tile,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [R, H]
-        return m_cur, l_cur, acc
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (R, block_k), 1
+            )
+            valid = k_pos < kv_len  # tail tile past S
+            if mask_ref is not None:
+                mvals = mask_ref[0, :, ds] != 0
+                # [Tq, block_k] -> one row per (t, g): g shares t's window.
+                rows = jnp.broadcast_to(
+                    mvals[:, None, :], (window, G, block_k)
+                ).reshape(R, block_k)
+                valid = jnp.logical_and(valid, rows)
+            s = jnp.where(valid, s, NEG_INF)
 
-    m0 = jnp.full((R,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((R,), jnp.float32)
-    acc0 = jnp.zeros((R, H), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    # A fully-masked row (inactive spec rows are steered out of bounds;
-    # their outputs are never consumed) -> zeros instead of NaN.
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0, :, :] = (acc / l[:, None]).astype(o_ref.dtype)
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))  # [R]
+            alpha = jnp.exp(m_prev - m_cur)
+            p = jnp.exp(s - m_cur[:, None])  # [R, block_k]
+            l_cur = l_prev * alpha + jnp.sum(p, axis=1)
+            acc = acc_prev * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v_tile.dtype), v_tile,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [R, H]
+            return m_cur, l_cur, acc
+
+        m0 = jnp.full((R,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((R,), jnp.float32)
+        acc0 = jnp.zeros((R, H), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+        # A fully-masked row (inactive spec rows are steered out of
+        # bounds; their outputs are never consumed) -> zeros, not NaN.
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, h, :, :] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _pick_heads_block(K: int) -> int:
+    """Largest-tile-legal KV-head block: trailing-two block dims on the
+    [B, S, K, H] cache are (kb, H), so kb must be a multiple of 8 or span
+    K exactly (the TPU lowering's divisible-by-(8,128)-or-equal rule)."""
+    if K % 8 == 0 and K > 8:
+        return 8
+    return K
+
+
+# Decline-to-XLA ceiling for this call's VMEM-resident blocks (~16 MB
+# VMEM/core, double-buffered pipelining means blocks are live twice).
+VMEM_BLOCK_BUDGET_BYTES = 6 * 1024 * 1024
+
+
+def _block_bytes(S, K, H, R, window, kv_itemsize, q_itemsize,
+                 with_mask) -> int:
+    kb = _pick_heads_block(K)
+    kv = 2 * S * kb * H * kv_itemsize
+    qo = 2 * kb * R * H * q_itemsize
+    mask = window * S if with_mask else 0
+    return kv + qo + mask
 
 
 @functools.partial(
@@ -127,10 +161,11 @@ def _decode_attention(
 ) -> jax.Array:
     B, K, R, H = q.shape
     S = k.shape[1]
+    kb = _pick_heads_block(K)
     in_specs = [
-        pl.BlockSpec((1, 1, R, H), lambda b, j: (b, j, 0, 0)),
-        pl.BlockSpec((1, S, 1, H), lambda b, j: (b, 0, j, 0)),
-        pl.BlockSpec((1, S, 1, H), lambda b, j: (b, 0, j, 0)),
+        pl.BlockSpec((1, kb, R, H), lambda b, j: (b, j, 0, 0)),
+        pl.BlockSpec((1, S, kb, H), lambda b, j: (b, 0, j, 0)),
+        pl.BlockSpec((1, S, kb, H), lambda b, j: (b, 0, j, 0)),
     ]
     args = [q, k, v]
     if mask is not None:
@@ -148,9 +183,9 @@ def _decode_attention(
             )
     return pl.pallas_call(
         kernel,
-        grid=(B, K),
+        grid=(B, K // kb),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, R, H), lambda b, j: (b, j, 0, 0)),
+        out_specs=pl.BlockSpec((1, kb, R, H), lambda b, j: (b, j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, K, R, H), q.dtype),
         interpret=interpret,
     )(*args)
@@ -198,6 +233,14 @@ def decode_attention(
             return None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # Whole-KV-resident layout: a geometry whose per-program blocks would
+    # overflow VMEM (large capacity x wide heads, e.g. 8B at S >= 2k)
+    # falls back to XLA rather than failing to lower on chip.
+    if _block_bytes(
+        S, K, H, Tq * G, Tq, k.dtype.itemsize, q.dtype.itemsize,
+        mask is not None,
+    ) > VMEM_BLOCK_BUDGET_BYTES:
+        return None
     scale = scale if scale is not None else H ** -0.5
     # Block must DIVIDE the capacity (same rule as the flash kernel's
     # _pick_block): a ragged tail tile's ds() would CLAMP its start like
