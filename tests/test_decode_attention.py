@@ -82,6 +82,29 @@ def test_tail_kv_tiles():
     _check(q, k, v, mask=decode_mask(lengths, 70), block_k=32)
 
 
+def test_multi_tile_scan_carry():
+    """S split across multiple grid steps: the online-softmax state must
+    carry through VMEM scratch across sequential S tiles (block_k=128
+    forces a 4-tile scan at S=512) — including slots whose window ends
+    mid-scan and a slot whose window is empty."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    B, S = 4, 512
+    q = _rand((B, 1, 8, 32), ks[0])
+    k = _rand((B, S, 4, 32), ks[1])
+    v = _rand((B, S, 4, 32), ks[2])
+    lengths = jnp.asarray([0, 100, 300, S - 1])
+    _check(q, k, v, mask=decode_mask(lengths, S), block_k=128)
+
+
+def test_multi_tile_no_mask():
+    """Tiled scan without a mask (all positions attend)."""
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    q = _rand((2, 1, 4, 32), ks[0])
+    k = _rand((2, 256, 4, 32), ks[1])
+    v = _rand((2, 256, 4, 32), ks[2])
+    _check(q, k, v, block_k=128)
+
+
 def test_bf16_inputs():
     ks = jax.random.split(jax.random.PRNGKey(4), 3)
     q = _rand((2, 1, 4, 32), ks[0], jnp.bfloat16)

@@ -81,20 +81,28 @@ class TestDecodeKernelLowersForTPU:
         # K not a multiple of 8: the head block must span K exactly.
         _lower_decode(4, 1, 12, 64, 64, 12, dtype=jnp.float32)
 
-    def test_oversized_geometry_declines_to_xla(self):
-        # 8B-at-large-capacity would overflow VMEM under the
-        # whole-KV-resident layout: decode_attention must return None
-        # (XLA fallback), never emit an unloadable kernel.
-        q = jnp.zeros((8, 1, 32, 128), jnp.bfloat16)
-        k = jnp.zeros((8, 8192, 8, 128), jnp.bfloat16)
-        assert da.decode_attention(q, k, k, interpret=False) is None
+    def test_8b_large_capacity_tiles_and_lowers(self):
+        # llama-3-8B geometry at a 8k KV capacity: the S grid axis tiles
+        # the scan so the kernel's motivating workload (GQA without the
+        # jnp.repeat materialization) lowers instead of declining.
+        _lower_decode(8, 1, 32, 128, 8192, 8)
 
-    def test_vmem_budget_math_brackets_block_sizes(self):
-        # The decline predicate must track the real block footprint:
-        # kv blocks dominate, and the 8-head block halves them vs full K.
-        small = da._block_bytes(256, 16, 64, 1, 1, 2, 2, True)
-        big = da._block_bytes(4096, 8, 128, 1, 1, 2, 2, True)
-        assert small < da.VMEM_BLOCK_BUDGET_BYTES < big
+    def test_sb_picker_divides_and_fits(self):
+        for S in (8, 70, 256, 1024, 2048, 8192):
+            for kb, H in ((8, 64), (8, 128), (16, 64), (4, 64)):
+                sb = da._pick_sb(S, kb, H, 2, True)
+                assert sb > 0 and S % sb == 0
+                assert sb == S or sb % 128 == 0  # mask-tile-legal
+                # big geometries must tile below whole-S (VMEM-bound)
+                if 2 * 2 * S * kb * H * 2 > da.VMEM_BLOCK_BUDGET_BYTES:
+                    assert sb < S
+
+    def test_sb_picker_honors_test_cap(self):
+        # target caps the tile when a legal tile under it exists...
+        assert da._pick_sb(256, 4, 64, 2, True, target=128) == 128
+        # ...and is ignored when it doesn't (70 has no 128-multiple
+        # divisor, so the whole-S tile is the only legal choice).
+        assert da._pick_sb(70, 4, 64, 2, True, target=32) == 70
 
     def test_heads_block_legality(self):
         for K in (1, 2, 4, 8, 12, 16, 24, 32):
