@@ -73,6 +73,16 @@ def sandbox(tmp_path, monkeypatch):
     (repo / "tools" / "run_llm_demo.py").write_text(
         demo_stub("llm_demo.json", "llm_colocation_demo")
     )
+    (repo / "tools" / "run_kernel_ab.py").write_text(
+        "import json, os, sys\n"
+        "out = sys.argv[1]\n"
+        "os.makedirs(out, exist_ok=True)\n"
+        "backend = os.environ.get('STUB_AB_BACKEND', 'tpu')\n"
+        "open(os.path.join(out, 'kernel_ab.json'), 'w').write(\n"
+        "    json.dumps({'backend': backend, 'median_speedup': 1.4,\n"
+        "                'all_parity_ok': True}))\n"
+        "sys.exit(1 if backend == 'cpu' else 0)\n"
+    )
     (repo / "README").write_text("sandbox\n")
     _git(str(repo), "add", "-A")
     _git(str(repo), "commit", "-q", "-m", "init")
@@ -224,3 +234,97 @@ class TestLLMDemoCapture:
         monkeypatch.setenv("STUB_DEMO_BACKEND", "cpu")
         assert wd.capture_llm_demo() is False
         assert "LLM colocation" not in _git(repo, "log", "--oneline")
+
+
+PARTIAL_SWEEP_STUB = """\
+import os, sys
+print('backend=tpu devices=[FakeTpu]')
+out = sys.argv[1]
+os.makedirs(out, exist_ok=True)
+def emit(stem, rows='batch_size,latency_ms\\n1,0.5\\n'):
+    for suf in ('_summary.csv', '_detailed.json', '_report.txt'):
+        open(os.path.join(out, stem + suf), 'w').write(rows)
+emit('resnet50')
+print('resnet50: 4 rows in 10s -> ' + out + '/resnet50_summary.csv',
+      flush=True)
+emit('gpt2_medium_decode'); emit('gpt2_medium_prefill')
+print('gpt2_medium decode: 8+4 rows in 20s -> ' + out
+      + '/gpt2_medium_decode_summary.csv', flush=True)
+# mid-sweep flap: a partially-written model, then the tunnel dies
+open(os.path.join(out, 'vit_b_16_summary.csv'), 'w').write('partial')
+sys.exit(1)
+"""
+
+
+class TestPartialSweepSalvage:
+    def test_flap_commits_completed_models_only(self, sandbox):
+        """A relay flap mid-sweep must convert the completed models into
+        a commit (they are fully-written, backend-verified ground truth)
+        while the in-progress model's residue is discarded."""
+        wd, repo = sandbox
+        with open(os.path.join(repo, "tools", "run_profiles.py"), "w") as f:
+            f.write(PARTIAL_SWEEP_STUB)
+        assert wd.capture_profiles() is False  # step NOT done — retries
+        log = _git(repo, "log", "--oneline")
+        assert "partial on-chip profile tables" in log
+        committed = _git(repo, "ls-files", "profiles/tpu_v5e").split()
+        assert "profiles/tpu_v5e/resnet50_summary.csv" in committed
+        assert "profiles/tpu_v5e/gpt2_medium_decode_summary.csv" in committed
+        assert "profiles/tpu_v5e/gpt2_medium_prefill_report.txt" in committed
+        assert "profiles/tpu_v5e/vit_b_16_summary.csv" not in committed
+        # the partial file is gone from the worktree too
+        assert not os.path.exists(
+            os.path.join(wd.OUT_DIR, "vit_b_16_summary.csv"))
+
+    def test_cpu_flap_salvages_nothing(self, sandbox):
+        """Backend gate still wins: a CPU-fallback partial sweep commits
+        no tables at all."""
+        wd, repo = sandbox
+        with open(os.path.join(repo, "tools", "run_profiles.py"), "w") as f:
+            f.write(PARTIAL_SWEEP_STUB.replace(
+                "backend=tpu devices=[FakeTpu]", "backend=cpu devices=[Cpu]"
+            ))
+        head = _git(repo, "rev-parse", "HEAD")
+        assert wd.capture_profiles() is False
+        assert _git(repo, "rev-parse", "HEAD") == head
+
+    def test_resume_only_on_retries(self, sandbox, tmp_path):
+        """The FIRST attempt must re-sweep (stale tables from an earlier
+        round must not survive a code change as 'fresh' captures); only
+        retries after a flap pass --resume to skip the salvaged models."""
+        wd, repo = sandbox
+        argv_log = tmp_path / "argv.log"
+        with open(os.path.join(repo, "tools", "run_profiles.py"), "w") as f:
+            f.write(
+                "import os, sys\n"
+                f"open({str(argv_log)!r}, 'a').write("
+                "' '.join(sys.argv[1:]) + '\\n')\n"
+                "print('backend=tpu devices=[FakeTpu]')\n"
+                "out = sys.argv[1]\n"
+                "os.makedirs(out, exist_ok=True)\n"
+                "open(os.path.join(out, 'resnet50_summary.csv'), 'w')"
+                ".write('batch_size,latency_ms\\n1,0.5\\n')\n"
+            )
+        assert wd.capture_profiles() is True
+        assert wd.capture_profiles() is True
+        calls = argv_log.read_text().splitlines()
+        assert "--resume" not in calls[0]
+        assert "--resume" in calls[1]
+
+
+class TestKernelABCapture:
+    def test_kernel_ab_capture_commits_record(self, sandbox):
+        wd, repo = sandbox
+        assert wd.capture_kernel_ab() is True
+        rec = json.loads(_git(
+            repo, "show", "HEAD:profiles/tpu_v5e/kernel_ab.json"
+        ))
+        assert rec["backend"] == "tpu" and rec["all_parity_ok"] is True
+
+    def test_kernel_ab_cpu_rejected(self, sandbox, monkeypatch):
+        wd, repo = sandbox
+        monkeypatch.setenv("STUB_AB_BACKEND", "cpu")
+        assert wd.capture_kernel_ab() is False
+        assert "decode-kernel A/B" not in _git(repo, "log", "--oneline")
+        assert not os.path.exists(
+            os.path.join(wd.OUT_DIR, "kernel_ab.json"))

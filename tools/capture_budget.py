@@ -64,6 +64,13 @@ CPU_MEASURED = {
         "source": "estimate: 8B host-quantize path 1159s (measured, "
                   "round 4) + LLM/vision/ASR rows + compiles",
     },
+    # tools/run_kernel_ab.py: 5 geometries x 2 backends, one compile
+    # each (~40s worst on chip) + 3x20-iter timed loops + parity fetch.
+    "kernel_ab": {
+        "seconds": 480,
+        "source": "estimate: 10 compiles at ~40s dominate; timed loops "
+                  "are milliseconds-scale per step",
+    },
 }
 
 
@@ -72,6 +79,7 @@ STEP_CAPS = {
     "profiles": wd.PROFILES_TIMEOUT_S,
     "slo_demo": wd.SLO_TIMEOUT_S,
     "llm_demo": wd.LLM_DEMO_TIMEOUT_S,
+    "kernel_ab": wd.KERNEL_AB_TIMEOUT_S,
 }
 
 

@@ -6,7 +6,12 @@ at ``293-project/src/scheduler.py:1019-1041``). Output lands in
 ``profiles/<backend>/`` as <model>_summary.csv / _detailed.json /
 _report.txt.
 
-Usage: python tools/run_profiles.py [out_dir]
+Usage: python tools/run_profiles.py [out_dir] [--resume]
+
+``--resume`` skips models whose tables already exist in out_dir: the
+relay watchdog passes it so a sweep interrupted by a tunnel flap
+continues from the last completed model instead of re-paying every
+compile (each completed model's tables were committed at flap time).
 """
 
 from __future__ import annotations
@@ -64,7 +69,7 @@ CPU_DECODE_PLAN = [
 ]
 
 
-def main(out_dir: str, cpu: bool = False) -> None:
+def main(out_dir: str, cpu: bool = False, resume: bool = False) -> None:
     import jax.numpy as jnp
 
     from ray_dynamic_batching_tpu.profiles.decode_profiler import (
@@ -81,6 +86,10 @@ def main(out_dir: str, cpu: bool = False) -> None:
     plan = CPU_PLAN if cpu else PLAN
     kwargs = {"dtype": jnp.float32} if cpu else {}
     for name, batches, seqs in plan:
+        summary = os.path.join(out_dir, f"{name}_summary.csv")
+        if resume and os.path.exists(summary):
+            print(f"{name}: cached -> {summary}", flush=True)
+            continue
         t0 = time.perf_counter()
         model = get_model(name, **kwargs)
         profiler = ModelProfiler(model)
@@ -91,6 +100,12 @@ def main(out_dir: str, cpu: bool = False) -> None:
     for name, slots, caps, buckets, groups in (
         CPU_DECODE_PLAN if cpu else DECODE_PLAN
     ):
+        d_summary = os.path.join(out_dir, f"{name}_decode_summary.csv")
+        p_summary = os.path.join(out_dir, f"{name}_prefill_summary.csv")
+        if resume and os.path.exists(d_summary) and os.path.exists(
+                p_summary):
+            print(f"{name} decode: cached -> {d_summary}", flush=True)
+            continue
         t0 = time.perf_counter()
         model = get_model(name, **kwargs)
         decode, prefill = DecodeProfiler(model).sweep(
@@ -108,4 +123,6 @@ if __name__ == "__main__":
     from tools.common import backend_args
 
     argv, default_dir, cpu = backend_args(sys.argv[1:])
-    main(argv[0] if argv else default_dir, cpu=cpu)
+    resume = "--resume" in argv
+    argv = [a for a in argv if a != "--resume"]
+    main(argv[0] if argv else default_dir, cpu=cpu, resume=resume)
