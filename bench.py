@@ -411,8 +411,9 @@ def main() -> dict:
                 "that directory for captures, and "
                 "profiles/capture_budget.json for the measured proof "
                 "that the full capture suite (bench -> tables -> SLO "
-                "demo -> LLM colocation demo) fits one ~74-minute relay "
-                "window, bench first. Last measured on-chip (round 3): "
+                "demo -> LLM colocation demo -> decode-kernel A/B) fits "
+                "one ~82-minute relay window, bench first. Last "
+                "measured on-chip (round 3): "
                 "1693 tok/s/chip (gpt2_medium, 64 slots), TTFT p50 "
                 "197 ms, resnet50 11253 samples/s; the TTFT number "
                 "predates the three-tier decode horizon (bound now "
@@ -422,12 +423,20 @@ def main() -> dict:
                 "record's llm row when measured."
             ),
         }
-    llm = bench_llm_serving(
-        num_slots=8 if fast else 64,
-        saturation_requests=16 if fast else 192,
-        poisson_duration_s=5.0 if fast else 15.0,
-        decode_horizon=8 if fast else 32,
-    )
+    try:
+        llm = bench_llm_serving(
+            num_slots=8 if fast else 64,
+            saturation_requests=16 if fast else 192,
+            poisson_duration_s=5.0 if fast else 15.0,
+            decode_horizon=8 if fast else 32,
+        )
+    except Exception as e:  # noqa: BLE001 — the north-star row failing
+        # must not zero the whole record: the remaining rows are still
+        # measured ground truth (this exact failure mode burned the first
+        # relay window of round 5 via a kernel lowering error).
+        _log(f"llm serving row failed entirely: {e!r}")
+        llm = {"error": repr(e)[:500], "tok_s_per_chip": 0.0,
+               "ttft_p50_ms": None, "ttft_p99_ms": None}
     vision = {}
     targets = (
         {"resnet50": VISION_BASELINES["resnet50"]} if fast
@@ -464,9 +473,10 @@ def main() -> dict:
 
     return {
         "metric": "llm_tok_s_per_chip",
-        "value": llm["tok_s_per_chip"],
+        "value": llm.get("tok_s_per_chip", 0.0),
         "unit": "tok/s",
-        "vs_baseline": round(llm["tok_s_per_chip"] / NORTH_STAR_TOK_S, 3),
+        "vs_baseline": round(
+            llm.get("tok_s_per_chip", 0.0) / NORTH_STAR_TOK_S, 3),
         # Which backend actually produced these numbers: consumers (the
         # relay watchdog, the judge) must be able to tell an on-chip record
         # from a CPU smoke run without trusting the directory it landed in.

@@ -182,6 +182,30 @@ class TestCaptureRejection:
             wd.STATE_DIR, "salvage", "resnet50_summary.csv"
         ))
 
+    def test_llm_row_failure_commits_partial_bench_record(self, sandbox):
+        """bench.py fault-isolates its rows: a record whose north-star
+        llm row failed (value 0, no top-level error) but whose other
+        rows measured on chip must be committed under a partial name —
+        while the step stays NOT done so retries keep chasing the
+        north-star row."""
+        wd, repo = sandbox
+        with open(os.path.join(repo, "bench.py"), "w") as f:
+            f.write(
+                "import json\n"
+                "print(json.dumps({'metric': 'llm_tok_s_per_chip',"
+                " 'value': 0.0, 'backend': 'tpu',"
+                " 'llm': {'error': 'lowering failed'},"
+                " 'vision': {'resnet50': {'samples_per_s': 12000.0}}}))\n"
+            )
+        assert wd.capture_bench() is False  # step NOT done — retries
+        log = _git(repo, "log", "--oneline")
+        assert "partial bench capture" in log
+        files = _git(repo, "ls-files", "profiles/tpu_v5e").split()
+        partials = [f for f in files if "bench_partial_" in f]
+        assert len(partials) == 1
+        rec = json.loads(_git(repo, "show", f"HEAD:{partials[0]}"))
+        assert rec["record"]["vision"]["resnet50"]["samples_per_s"] == 12000.0
+
     def test_bench_error_record_rejected(self, sandbox):
         wd, repo = sandbox
         with open(os.path.join(repo, "bench.py"), "w") as f:
@@ -288,12 +312,19 @@ class TestPartialSweepSalvage:
         assert wd.capture_profiles() is False
         assert _git(repo, "rev-parse", "HEAD") == head
 
-    def test_resume_only_on_retries(self, sandbox, tmp_path):
-        """The FIRST attempt must re-sweep (stale tables from an earlier
-        round must not survive a code change as 'fresh' captures); only
-        retries after a flap pass --resume to skip the salvaged models."""
+    def test_retry_skips_exactly_the_salvaged_models(self, sandbox,
+                                                     tmp_path):
+        """The retry passes --skip with exactly the models salvaged THIS
+        process — an explicit list, not a file-exists check, because the
+        flap cleanup's git checkout restores stale prior-round tables to
+        the worktree and those must be re-measured."""
         wd, repo = sandbox
         argv_log = tmp_path / "argv.log"
+        # attempt 1: flap after resnet50 + gpt2_medium decode complete
+        with open(os.path.join(repo, "tools", "run_profiles.py"), "w") as f:
+            f.write(PARTIAL_SWEEP_STUB)
+        assert wd.capture_profiles() is False
+        # attempt 2: succeeds; records its argv for inspection
         with open(os.path.join(repo, "tools", "run_profiles.py"), "w") as f:
             f.write(
                 "import os, sys\n"
@@ -306,10 +337,9 @@ class TestPartialSweepSalvage:
                 ".write('batch_size,latency_ms\\n1,0.5\\n')\n"
             )
         assert wd.capture_profiles() is True
-        assert wd.capture_profiles() is True
         calls = argv_log.read_text().splitlines()
-        assert "--resume" not in calls[0]
-        assert "--resume" in calls[1]
+        assert len(calls) == 1
+        assert "--skip resnet50,gpt2_medium:decode" in calls[0]
 
 
 class TestKernelABCapture:

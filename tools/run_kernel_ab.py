@@ -146,7 +146,10 @@ def main() -> int:
         "rows_ok": len(ok_rows),
         "rows_total": len(rows),
     }), flush=True)
-    if backend == "cpu" or not ok_rows:
+    # All-or-nothing: a partially-failed A/B must not commit as if the
+    # kernel were verified across the serving geometries (the watchdog
+    # commits on rc 0 only).
+    if backend == "cpu" or len(ok_rows) != len(rows):
         return 1
     return 0
 

@@ -6,12 +6,16 @@ at ``293-project/src/scheduler.py:1019-1041``). Output lands in
 ``profiles/<backend>/`` as <model>_summary.csv / _detailed.json /
 _report.txt.
 
-Usage: python tools/run_profiles.py [out_dir] [--resume]
+Usage: python tools/run_profiles.py [out_dir] [--skip m1,m2:decode,...]
 
-``--resume`` skips models whose tables already exist in out_dir: the
-relay watchdog passes it so a sweep interrupted by a tunnel flap
-continues from the last completed model instead of re-paying every
-compile (each completed model's tables were committed at flap time).
+``--skip`` names models to leave out of the sweep (``name`` for a
+forward-pass sweep, ``name:decode`` for a decode/prefill sweep): the
+relay watchdog passes the models whose tables it already salvaged and
+committed from THIS window's interrupted attempts, so a retry resumes
+past them instead of re-paying every compile. An explicit list — not a
+does-the-file-exist check — because ``git checkout`` restores stale
+prior-round tables to the worktree after a flap, and those must be
+re-measured, not skipped.
 """
 
 from __future__ import annotations
@@ -69,7 +73,7 @@ CPU_DECODE_PLAN = [
 ]
 
 
-def main(out_dir: str, cpu: bool = False, resume: bool = False) -> None:
+def main(out_dir: str, cpu: bool = False, skip=()) -> None:
     import jax.numpy as jnp
 
     from ray_dynamic_batching_tpu.profiles.decode_profiler import (
@@ -86,9 +90,8 @@ def main(out_dir: str, cpu: bool = False, resume: bool = False) -> None:
     plan = CPU_PLAN if cpu else PLAN
     kwargs = {"dtype": jnp.float32} if cpu else {}
     for name, batches, seqs in plan:
-        summary = os.path.join(out_dir, f"{name}_summary.csv")
-        if resume and os.path.exists(summary):
-            print(f"{name}: cached -> {summary}", flush=True)
+        if name in skip:
+            print(f"{name}: skipped (salvaged this window)", flush=True)
             continue
         t0 = time.perf_counter()
         model = get_model(name, **kwargs)
@@ -100,11 +103,9 @@ def main(out_dir: str, cpu: bool = False, resume: bool = False) -> None:
     for name, slots, caps, buckets, groups in (
         CPU_DECODE_PLAN if cpu else DECODE_PLAN
     ):
-        d_summary = os.path.join(out_dir, f"{name}_decode_summary.csv")
-        p_summary = os.path.join(out_dir, f"{name}_prefill_summary.csv")
-        if resume and os.path.exists(d_summary) and os.path.exists(
-                p_summary):
-            print(f"{name} decode: cached -> {d_summary}", flush=True)
+        if f"{name}:decode" in skip:
+            print(f"{name} decode: skipped (salvaged this window)",
+                  flush=True)
             continue
         t0 = time.perf_counter()
         model = get_model(name, **kwargs)
@@ -123,6 +124,9 @@ if __name__ == "__main__":
     from tools.common import backend_args
 
     argv, default_dir, cpu = backend_args(sys.argv[1:])
-    resume = "--resume" in argv
-    argv = [a for a in argv if a != "--resume"]
-    main(argv[0] if argv else default_dir, cpu=cpu, resume=resume)
+    skip = ()
+    if "--skip" in argv:
+        i = argv.index("--skip")
+        skip = tuple(t for t in argv[i + 1].split(",") if t)
+        argv = argv[:i] + argv[i + 2:]
+    main(argv[0] if argv else default_dir, cpu=cpu, skip=skip)

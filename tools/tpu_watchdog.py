@@ -13,8 +13,8 @@ answers it runs the full capture suite, committing records into
 
 1. ``bench.py``                 -> ``profiles/tpu_v5e/bench_<ts>.json``
 2. ``tools/run_profiles.py``    -> ``profiles/tpu_v5e/*_summary.csv`` etc.
-   (``--resume``: a sweep interrupted by a flap commits each completed
-   model's tables and continues past them on the next attempt)
+   (a sweep interrupted by a flap commits each completed model's tables
+   and the retry ``--skip``s past exactly those)
 3. ``tools/run_slo_demo.py``    -> ``profiles/tpu_v5e/slo_demo.json``
 4. ``tools/run_llm_demo.py``    -> ``profiles/tpu_v5e/llm_demo.json``
 5. ``tools/run_kernel_ab.py``   -> ``profiles/tpu_v5e/kernel_ab.json``
@@ -249,6 +249,24 @@ def capture_bench() -> bool:
             "stderr_tail": rec["stderr"][-1000:],
         })
         _discard_unverified_artifacts()
+        # A record whose north-star row failed but whose OTHER rows
+        # measured on chip is still ground truth worth keeping (bench.py
+        # row fault-isolation): commit it under a partial name so the
+        # ~45 min of vision/ASR/8B measurements survive even if every
+        # retry hits the same llm-row failure. The step stays NOT done —
+        # retries continue chasing the north-star row.
+        if (rec["rc"] == 0 and parsed is not None
+                and _on_chip(parsed.get("backend"))
+                and not parsed.get("error")):
+            os.makedirs(OUT_DIR, exist_ok=True)
+            with open(os.path.join(
+                    OUT_DIR, f"bench_partial_{ts}.json"), "w") as f:
+                json.dump({"captured": ts, "seconds": rec["seconds"],
+                           "partial": "llm row failed; other rows "
+                           "measured", "record": parsed}, f, indent=1)
+                f.write("\n")
+            git_commit(f"tpu_v5e: partial bench capture {ts} "
+                       "(llm row failed; other rows measured)")
         return False
     os.makedirs(OUT_DIR, exist_ok=True)
     with open(os.path.join(OUT_DIR, f"bench_{ts}.json"), "w") as f:
@@ -259,19 +277,26 @@ def capture_bench() -> bool:
                       f"({parsed.get('metric')}={parsed.get('value')})")
 
 
-def _completed_profile_files(stdout: str) -> list:
-    """Files of models whose per-model completion line printed — each is
-    a fully-written table set (the line prints AFTER write_outputs)."""
+def _completed_profile_models(stdout: str) -> list:
+    """Skip tokens (``name`` / ``name:decode``) of models whose
+    per-model completion line printed — each line prints only AFTER
+    write_outputs, so their table sets are fully written."""
     import re
 
-    files = []
+    tokens = []
     for ln in stdout.splitlines():
         m = re.match(r"^(\w+)( decode)?: .*-> ", ln)
         if not m:
             continue
-        name, is_decode = m.group(1), bool(m.group(2))
-        stems = [f"{name}_decode", f"{name}_prefill"] if is_decode \
-            else [name]
+        tokens.append(m.group(1) + (":decode" if m.group(2) else ""))
+    return tokens
+
+
+def _profile_files_for(tokens: list) -> list:
+    files = []
+    for token in tokens:
+        name, _, kind = token.partition(":")
+        stems = [f"{name}_decode", f"{name}_prefill"] if kind else [name]
         for stem in stems:
             for suffix in ("_summary.csv", "_detailed.json", "_report.txt"):
                 path = os.path.join(OUT_DIR, stem + suffix)
@@ -281,15 +306,14 @@ def _completed_profile_files(stdout: str) -> list:
 
 
 def capture_profiles() -> bool:
-    # --resume only on RETRIES within this process: the first attempt
-    # must re-sweep tables left by earlier rounds (stale timings silently
-    # surviving a code change would poison the committed ground truth);
-    # a retry after a mid-sweep flap resumes past the models the salvage
-    # commit already banked.
+    # Retries skip exactly the models THIS process already salvaged and
+    # committed (an explicit list, not a file-exists check: the flap
+    # cleanup's git checkout restores stale prior-round tables to the
+    # worktree, and those must be re-measured, not trusted).
+    salvaged = getattr(capture_profiles, "_salvaged", [])
     cmd = [sys.executable, "tools/run_profiles.py", "profiles/tpu_v5e"]
-    if getattr(capture_profiles, "_ran_before", False):
-        cmd.append("--resume")
-    capture_profiles._ran_before = True
+    if salvaged:
+        cmd += ["--skip", ",".join(salvaged)]
     rec = run_step("profiles", cmd, PROFILES_TIMEOUT_S)
     # run_profiles.py prints "backend=<name> devices=..." before sweeping.
     backend = next(
@@ -303,16 +327,19 @@ def capture_profiles() -> bool:
         # A flap mid-sweep loses the relay, not the completed models:
         # every model whose completion line printed has fully-written,
         # backend-verified tables — commit exactly those, then discard
-        # the in-progress residue. The retry resumes past them
-        # (run_profiles --resume), so the sweep converges across flaps.
+        # the in-progress residue. The retry skips past them, so the
+        # sweep converges across flaps.
         if _on_chip(backend):
-            salvaged = _completed_profile_files(rec["stdout"])
-            if salvaged:
+            fresh = [t for t in _completed_profile_models(rec["stdout"])
+                     if t not in salvaged]
+            files = _profile_files_for(fresh)
+            if files:
                 git_commit(
                     f"tpu_v5e: partial on-chip profile tables "
-                    f"({len(salvaged)} files, interrupted sweep) {_now()}",
-                    paths=salvaged,
+                    f"({len(files)} files, interrupted sweep) {_now()}",
+                    paths=files,
                 )
+                capture_profiles._salvaged = salvaged + fresh
         _save_failure("profiles", {
             "rc": rec["rc"], "seconds": rec["seconds"], "backend": backend,
             "stdout_tail": rec["stdout"][-2000:],
