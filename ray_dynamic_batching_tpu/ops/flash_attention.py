@@ -129,8 +129,17 @@ def _attn_kernel(
 
 
 def _pick_block(t: int, target: int) -> int:
+    """Largest divisor of ``t`` at most ``target``, preferring
+    sublane-aligned (8-multiple) divisors: a non-dividing block's ds()
+    would clamp its start like dynamic_slice and silently re-read
+    shifted rows that the validity iota then mislabels, so blocks must
+    divide — and unaligned tiles both waste sublanes and trip Mosaic's
+    bf16 mixed-type broadcast bug."""
     if t <= target:
         return t
+    for cand in range(target, 0, -1):
+        if t % cand == 0 and cand % 8 == 0:
+            return cand
     for cand in range(target, 0, -1):
         if t % cand == 0:
             return cand
@@ -261,6 +270,18 @@ def flash_attention(
     scale = scale if scale is not None else H ** -0.5
     block_q = _pick_block(Tq, block_q)
     block_k = _pick_block(Tk, block_k)
+    # Sub-32-bit inputs with a sublane-unaligned query tile trip a
+    # Mosaic verifier bug (bf16 [197, H] dot under preferred f32 emits a
+    # mixed-type vector.broadcast — ViT's CLS+14x14=197 sequence found
+    # it); f32 lowers fine at any alignment, so only narrow shapes
+    # decline to XLA (pinned in tests/test_tpu_lowering.py).
+    if q.dtype.itemsize < 4 and block_q % 8 != 0:
+        return None
+    # Degenerate tiling (prime-ish sequence lengths -> width-<8 tiles at
+    # <=1/128 MXU utilization, e.g. ViT-G/14's 257) is not worth a
+    # kernel: XLA's fused attention handles these shapes well.
+    if block_q < 8 or block_k < 8:
+        return None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 

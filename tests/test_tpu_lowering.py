@@ -110,6 +110,15 @@ class TestDecodeKernelLowersForTPU:
             assert K % kb == 0
             assert kb == K or kb % 8 == 0
 
+    def test_whisper_decoder_geometry(self):
+        # whisper_large_v3: 20 MHA heads (not a multiple of 8 — the head
+        # block must span), 448-token decode capacity.
+        _lower_decode(8, 1, 20, 64, 448, 20)
+
+    def test_odd_capacity_whole_tile(self):
+        # A capacity with no 128-multiple divisor rides one whole-S tile.
+        _lower_decode(4, 1, 8, 64, 257, 4)
+
 
 class TestFlashKernelLowersForTPU:
     def test_prefill_bucket(self):
@@ -122,3 +131,25 @@ class TestFlashKernelLowersForTPU:
 
     def test_gqa_wide_head(self):
         _lower_flash(2, 256, 8, 128, 256, 2)
+
+    def test_vit_odd_sequence_declines(self):
+        # ViT-shaped self-attention (197 = CLS + 14x14 patches, prime):
+        # bf16's sublane-unaligned query tile trips a Mosaic verifier
+        # bug (mixed-type vector.broadcast in the f32-preferred dot),
+        # and any dtype's KV tiling degenerates to width-1 tiles — both
+        # must decline to XLA, never emit the kernel.
+        for dtype in (jnp.bfloat16, jnp.float32):
+            q = jnp.zeros((4, 197, 12, 64), dtype)
+            k = jnp.zeros((4, 197, 12, 64), dtype)
+            assert fa.flash_attention(
+                q, k, k, causal=False, interpret=False) is None
+
+    def test_unaligned_long_sequence_finds_aligned_subtile(self):
+        # Tq = Tk = 520 > the 512 target: the largest divisor (260) is
+        # not sublane-aligned, but _pick_block must prefer the 8-aligned
+        # 104 so bf16 stays on the kernel instead of declining.
+        _lower_flash(2, 520, 8, 64, 520, 8, causal=True)
+
+    def test_whisper_cross_attention(self):
+        # decoder cross-attention into the 1500-frame encoder output.
+        _lower_flash(2, 448, 20, 64, 1500, 20, causal=False)
