@@ -394,6 +394,11 @@ def probe_device(timeout_s: float = 120.0) -> Optional[str]:
 
 def main() -> dict:
     fast = os.environ.get("RDB_BENCH_FAST") == "1"
+    # llm scope: ONLY the north-star serving row (~8 min vs ~30+ for the
+    # full record — the 8B host-quantize row alone is ~20). The relay
+    # flaps in windows shorter than the full bench; this scope converts
+    # even a short window into the #1 missing artifact.
+    llm_only = os.environ.get("RDB_BENCH_SCOPE") == "llm"
     err = probe_device()
     if err is not None:
         _log(f"DEVICE UNREACHABLE: {err}")
@@ -410,10 +415,11 @@ def main() -> dict:
                 "profiles/tpu_v5e/ the moment the tunnel answers — check "
                 "that directory for captures, and "
                 "profiles/capture_budget.json for the measured proof "
-                "that the full capture suite (bench -> tables -> SLO "
-                "demo -> LLM colocation demo -> decode-kernel A/B) fits "
-                "one ~82-minute relay window, bench first. Last "
-                "measured on-chip (round 3): "
+                "that the full capture suite (llm-scoped bench -> full "
+                "bench -> tables -> SLO demo -> LLM colocation demo -> "
+                "decode-kernel A/B) fits one ~90-minute relay window, "
+                "with the north-star llm row landing in the first ~11 "
+                "minutes. Last measured on-chip (round 3): "
                 "1693 tok/s/chip (gpt2_medium, 64 slots), TTFT p50 "
                 "197 ms, resnet50 11253 samples/s; the TTFT number "
                 "predates the three-tier decode horizon (bound now "
@@ -439,7 +445,8 @@ def main() -> dict:
                "ttft_p50_ms": None, "ttft_p99_ms": None}
     vision = {}
     targets = (
-        {"resnet50": VISION_BASELINES["resnet50"]} if fast
+        {} if llm_only
+        else {"resnet50": VISION_BASELINES["resnet50"]} if fast
         else VISION_BASELINES
     )
     for name, (baseline, batches) in targets.items():
@@ -449,19 +456,25 @@ def main() -> dict:
             _log(f"{name} failed entirely: {e}")
             row = {"error": str(e)}
         vision[name] = row
-    try:
-        # Fast mode swaps in the tiny ASR config and short audio: the
-        # point is exercising the path, not timing a 1.6B-param encoder.
-        asr = bench_asr_rtf(
-            batch=2 if fast else 8,
-            audio_s=2.0 if fast else 30.0,
-            decode_tokens=8 if fast else 32,
-            model_name="whisper_tiny_test" if fast else "whisper_large_v3",
-        )
-    except Exception as e:  # noqa: BLE001 — ASR must not kill the bench
-        _log(f"asr failed entirely: {e}")
-        asr = {"error": str(e)}
-    if fast:
+    if llm_only:
+        asr = {"skipped": "llm scope"}
+    else:
+        try:
+            # Fast mode swaps in the tiny ASR config and short audio: the
+            # point is exercising the path, not timing a 1.6B-param encoder.
+            asr = bench_asr_rtf(
+                batch=2 if fast else 8,
+                audio_s=2.0 if fast else 30.0,
+                decode_tokens=8 if fast else 32,
+                model_name="whisper_tiny_test" if fast
+                else "whisper_large_v3",
+            )
+        except Exception as e:  # noqa: BLE001 — ASR must not kill the bench
+            _log(f"asr failed entirely: {e}")
+            asr = {"error": str(e)}
+    if llm_only:
+        llama8b = {"skipped": "llm scope"}
+    elif fast:
         llama8b = {"skipped": "fast mode"}
     else:
         try:
@@ -481,6 +494,7 @@ def main() -> dict:
         # relay watchdog, the judge) must be able to tell an on-chip record
         # from a CPU smoke run without trusting the directory it landed in.
         "backend": jax.default_backend(),
+        "scope": "llm" if llm_only else "fast" if fast else "full",
         "ttft_p50_ms": llm["ttft_p50_ms"],
         "ttft_p99_ms": llm["ttft_p99_ms"],
         "llm": llm,

@@ -35,11 +35,13 @@ def sandbox(tmp_path, monkeypatch):
     _git(str(repo), "config", "user.name", "wd")
 
     (repo / "bench.py").write_text(
-        "import json\n"
+        "import json, os\n"
+        "scope = 'llm' if os.environ.get('RDB_BENCH_SCOPE') == 'llm'"
+        " else 'full'\n"
         "print('noise line')\n"
         "print(json.dumps({'metric': 'llm_tok_s_per_chip', 'value': 1800.0,"
         " 'unit': 'tok/s', 'vs_baseline': 1.2, 'backend': 'tpu',"
-        " 'pad': 'x' * 3000}))\n"
+        " 'scope': scope, 'pad': 'x' * 3000}))\n"
     )
     (repo / "tools" / "run_profiles.py").write_text(
         "import os, sys\n"
@@ -182,6 +184,52 @@ class TestCaptureRejection:
             wd.STATE_DIR, "salvage", "resnet50_summary.csv"
         ))
 
+    def test_bench_llm_scope_commits_first_artifact(self, sandbox):
+        """The llm-scope step passes RDB_BENCH_SCOPE=llm and lands its
+        record under the bench_llm_ prefix — the fast first artifact a
+        short relay window must convert into."""
+        wd, repo = sandbox
+        with open(os.path.join(repo, "bench.py"), "w") as f:
+            f.write(
+                "import json, os\n"
+                "assert os.environ.get('RDB_BENCH_SCOPE') == 'llm'\n"
+                "print(json.dumps({'metric': 'llm_tok_s_per_chip',"
+                " 'value': 1700.0, 'backend': 'tpu', 'scope': 'llm'}))\n"
+            )
+        assert wd.capture_bench_llm() is True
+        files = _git(repo, "ls-files", "profiles/tpu_v5e").split()
+        assert any(f.startswith("profiles/tpu_v5e/bench_llm_")
+                   for f in files)
+
+    def test_scope_mismatch_rejected(self, sandbox):
+        """An llm-only record must never satisfy the FULL bench step —
+        it would mark the vision/ASR/8B ground truth done unmeasured."""
+        wd, repo = sandbox
+        with open(os.path.join(repo, "bench.py"), "w") as f:
+            f.write(
+                "import json\n"
+                "print(json.dumps({'metric': 'llm_tok_s_per_chip',"
+                " 'value': 1800.0, 'backend': 'tpu', 'scope': 'llm'}))\n"
+            )
+        head = _git(repo, "rev-parse", "HEAD")
+        assert wd.capture_bench() is False
+        assert _git(repo, "rev-parse", "HEAD") == head
+
+    def test_failed_llm_scope_never_commits_partial(self, sandbox):
+        """An llm-scope record with a dead north-star row has no other
+        measured rows — the partial-bench salvage must not commit it."""
+        wd, repo = sandbox
+        with open(os.path.join(repo, "bench.py"), "w") as f:
+            f.write(
+                "import json\n"
+                "print(json.dumps({'metric': 'llm_tok_s_per_chip',"
+                " 'value': 0.0, 'backend': 'tpu', 'scope': 'llm',"
+                " 'llm': {'error': 'boom'}}))\n"
+            )
+        head = _git(repo, "rev-parse", "HEAD")
+        assert wd.capture_bench_llm() is False
+        assert _git(repo, "rev-parse", "HEAD") == head
+
     def test_llm_row_failure_commits_partial_bench_record(self, sandbox):
         """bench.py fault-isolates its rows: a record whose north-star
         llm row failed (value 0, no top-level error) but whose other
@@ -193,7 +241,7 @@ class TestCaptureRejection:
             f.write(
                 "import json\n"
                 "print(json.dumps({'metric': 'llm_tok_s_per_chip',"
-                " 'value': 0.0, 'backend': 'tpu',"
+                " 'value': 0.0, 'backend': 'tpu', 'scope': 'full',"
                 " 'llm': {'error': 'lowering failed'},"
                 " 'vision': {'resnet50': {'samples_per_s': 12000.0}}}))\n"
             )

@@ -6,10 +6,10 @@ opens, the committed evidence must show the capture suite FITS one
 window. This tool writes ``profiles/capture_budget.json`` from (a) the
 watchdog's per-step caps (imported, so the budget can't drift from the
 code), (b) step timings measured on CPU this round where a CPU mode
-exists, and (c) the priority ordering — the highest-value artifact
-(bench: the north-star LLM row + ttft breakdown + guarded 8B row) lands
-first, so even a window shorter than the worst case converts into the
-#1 missing item.
+exists, and (c) the priority ordering — the highest-value artifact (the
+north-star LLM serving row + ttft breakdown, via the llm-scoped bench)
+lands first within minutes, then the full bench (vision/ASR/guarded 8B
+row), so even a short flap window converts into the #1 missing item.
 
 Usage: python tools/capture_budget.py [--cpu-timings k=v,...]
 """
@@ -59,6 +59,13 @@ CPU_MEASURED = {
     # its dominant rows are bounded by round-4 measurements: the 8B row's
     # host-init+quantize path ran in 1159s standalone (ROUND4_NOTES),
     # LLM Poisson phases are ~60s, vision sweeps + ASR a few minutes.
+    # bench.py RDB_BENCH_SCOPE=llm: engine build + warmup compiles +
+    # saturation + Poisson phases only.
+    "bench_llm": {
+        "seconds": 480,
+        "source": "estimate: gpt2_medium init + engine warmup compiles "
+                  "+ ~60s saturation + ~15s Poisson phase",
+    },
     "bench": {
         "seconds": 1800,
         "source": "estimate: 8B host-quantize path 1159s (measured, "
@@ -75,6 +82,7 @@ CPU_MEASURED = {
 
 
 STEP_CAPS = {
+    "bench_llm": wd.BENCH_LLM_TIMEOUT_S,
     "bench": wd.BENCH_TIMEOUT_S,
     "profiles": wd.PROFILES_TIMEOUT_S,
     "slo_demo": wd.SLO_TIMEOUT_S,
@@ -128,9 +136,11 @@ def main() -> int:
             "note": (
                 "Steps commit independently the moment they verify "
                 "(pathspec-scoped), so a window of length T yields every "
-                "step whose cumulative expected time <= T; the bench "
-                "(north-star LLM row + ttft breakdown + guarded 8B row) "
-                "lands within ~30 min of the relay answering."
+                "step whose cumulative expected time <= T; the "
+                "llm-scoped bench (north-star serving row + ttft "
+                "breakdown) lands within ~11 min of the relay "
+                "answering, the full bench (vision/ASR/guarded 8B row) "
+                "within ~41 min."
             ),
         },
     }

@@ -11,13 +11,16 @@ a real op with a hard timeout proves liveness), and the moment the relay
 answers it runs the full capture suite, committing records into
 ``profiles/tpu_v5e/`` after every successful step:
 
-1. ``bench.py``                 -> ``profiles/tpu_v5e/bench_<ts>.json``
-2. ``tools/run_profiles.py``    -> ``profiles/tpu_v5e/*_summary.csv`` etc.
+1. ``bench.py`` (llm scope)     -> ``profiles/tpu_v5e/bench_llm_<ts>.json``
+   (north-star row only, ~8 min: short flap windows still convert into
+   the #1 missing artifact)
+2. ``bench.py``                 -> ``profiles/tpu_v5e/bench_<ts>.json``
+3. ``tools/run_profiles.py``    -> ``profiles/tpu_v5e/*_summary.csv`` etc.
    (a sweep interrupted by a flap commits each completed model's tables
    and the retry ``--skip``s past exactly those)
-3. ``tools/run_slo_demo.py``    -> ``profiles/tpu_v5e/slo_demo.json``
-4. ``tools/run_llm_demo.py``    -> ``profiles/tpu_v5e/llm_demo.json``
-5. ``tools/run_kernel_ab.py``   -> ``profiles/tpu_v5e/kernel_ab.json``
+4. ``tools/run_slo_demo.py``    -> ``profiles/tpu_v5e/slo_demo.json``
+5. ``tools/run_llm_demo.py``    -> ``profiles/tpu_v5e/llm_demo.json``
+6. ``tools/run_kernel_ab.py``   -> ``profiles/tpu_v5e/kernel_ab.json``
 
 Guard rails (each one a way a dead-or-flapping relay could otherwise
 poison the committed ground truth):
@@ -59,6 +62,9 @@ LOG_PATH = os.path.join(STATE_DIR, "watchdog.log")
 
 PROBE_TIMEOUT_S = 180.0      # first on-chip compile can take ~40s
 BENCH_TIMEOUT_S = 45 * 60.0
+# North-star row only: engine build + warmup compiles + saturation +
+# Poisson phases — no vision/ASR/8B.
+BENCH_LLM_TIMEOUT_S = 20 * 60.0
 # The deepened sweep (profiler-stopped vision buckets + text seq buckets
 # + decode/prefill tables) can brush an hour of mostly-compile time.
 PROFILES_TIMEOUT_S = 90 * 60.0
@@ -170,7 +176,7 @@ def git_commit(message: str, retries: int = 5, paths=None) -> bool:
     return False
 
 
-def run_step(name: str, cmd: list, timeout_s: float) -> dict:
+def run_step(name: str, cmd: list, timeout_s: float, env=None) -> dict:
     """Run one capture step as a bounded subprocess; returns the FULL
     stdout/stderr (success detection parses stdout — truncating first
     would corrupt long JSON records)."""
@@ -178,7 +184,8 @@ def run_step(name: str, cmd: list, timeout_s: float) -> dict:
     _log(f"step {name}: {' '.join(cmd)}")
     try:
         proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            cwd=REPO, env=env,
         )
         rc, out, err = proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as exc:
@@ -226,8 +233,15 @@ def _discard_unverified_artifacts() -> None:
             _log(f"cleanup {cmd[3]} failed: {proc.stderr.strip()[-150:]}")
 
 
-def capture_bench() -> bool:
-    rec = run_step("bench", [sys.executable, "bench.py"], BENCH_TIMEOUT_S)
+def capture_bench(step_name: str = "bench", env_extra: dict = None,
+                  timeout_s: float = None, prefix: str = "bench",
+                  expected_scope: str = "full") -> bool:
+    env = dict(os.environ)
+    env.pop("RDB_BENCH_SCOPE", None)  # a leaked scope must not narrow
+    env.pop("RDB_BENCH_FAST", None)   # (or fast-mode) the full record
+    env.update(env_extra or {})
+    rec = run_step(step_name, [sys.executable, "bench.py"],
+                   timeout_s or BENCH_TIMEOUT_S, env=env)
     # bench.py prints ONE JSON line on stdout (the last parseable line).
     parsed = None
     for ln in reversed([ln for ln in rec["stdout"].splitlines() if ln.strip()]):
@@ -240,10 +254,14 @@ def capture_bench() -> bool:
             break
     ok = (rec["rc"] == 0 and parsed is not None
           and not parsed.get("error") and parsed.get("value", 0) > 0
-          and _on_chip(parsed.get("backend")))
+          and _on_chip(parsed.get("backend"))
+          # the record must be the scope this step exists to capture —
+          # an llm-only record committed as the full bench would mark
+          # the vision/ASR/8B ground truth "done" without measuring it
+          and parsed.get("scope") == expected_scope)
     ts = _now()
     if not ok:
-        _save_failure("bench", {
+        _save_failure(step_name, {
             "rc": rec["rc"], "seconds": rec["seconds"], "record": parsed,
             "stdout_tail": rec["stdout"][-2000:],
             "stderr_tail": rec["stderr"][-1000:],
@@ -257,10 +275,11 @@ def capture_bench() -> bool:
         # retries continue chasing the north-star row.
         if (rec["rc"] == 0 and parsed is not None
                 and _on_chip(parsed.get("backend"))
-                and not parsed.get("error")):
+                and not parsed.get("error")
+                and parsed.get("scope") != "llm"):
             os.makedirs(OUT_DIR, exist_ok=True)
             with open(os.path.join(
-                    OUT_DIR, f"bench_partial_{ts}.json"), "w") as f:
+                    OUT_DIR, f"{prefix}_partial_{ts}.json"), "w") as f:
                 json.dump({"captured": ts, "seconds": rec["seconds"],
                            "partial": "llm row failed; other rows "
                            "measured", "record": parsed}, f, indent=1)
@@ -269,12 +288,23 @@ def capture_bench() -> bool:
                        "(llm row failed; other rows measured)")
         return False
     os.makedirs(OUT_DIR, exist_ok=True)
-    with open(os.path.join(OUT_DIR, f"bench_{ts}.json"), "w") as f:
+    with open(os.path.join(OUT_DIR, f"{prefix}_{ts}.json"), "w") as f:
         json.dump({"captured": ts, "seconds": rec["seconds"],
                    "record": parsed}, f, indent=1)
         f.write("\n")
-    return git_commit(f"tpu_v5e: on-chip bench capture {ts} "
+    return git_commit(f"tpu_v5e: on-chip {step_name} capture {ts} "
                       f"({parsed.get('metric')}={parsed.get('value')})")
+
+
+def capture_bench_llm() -> bool:
+    """North-star-only bench (~8 min): the relay flaps in windows
+    shorter than the full bench, and the llm row is the #1 missing
+    artifact — it must land FIRST and fast."""
+    return capture_bench(
+        step_name="bench_llm", env_extra={"RDB_BENCH_SCOPE": "llm"},
+        timeout_s=BENCH_LLM_TIMEOUT_S, prefix="bench_llm",
+        expected_scope="llm",
+    )
 
 
 def _completed_profile_models(stdout: str) -> list:
@@ -414,6 +444,7 @@ def capture_kernel_ab() -> bool:
 
 
 STEPS = [
+    ("bench_llm", capture_bench_llm),
     ("bench", capture_bench),
     ("profiles", capture_profiles),
     ("slo_demo", capture_slo_demo),
