@@ -145,9 +145,12 @@ def copy_rows_into(cache, rows, slots):
     """Scatter a row-cache's per-request rows into the shared cache at
     ``slots`` (static unroll — row count is a compile-time constant).
     Shared by the target and draft prefill programs so the write rule
-    cannot diverge between them."""
+    cannot diverge between them. Quantized caches carry their scale
+    planes through the same scatter — dropping them would reconstruct
+    garbage KV for every admitted prompt."""
     nB = rows.lengths.shape[0]
     k, v, lengths = cache.k, cache.v, cache.lengths
+    ks, vs = cache.k_scale, cache.v_scale
     for i in range(nB):
         k = jax.lax.dynamic_update_slice(
             k, rows.k[:, i : i + 1], (0, slots[i], 0, 0, 0)
@@ -155,10 +158,18 @@ def copy_rows_into(cache, rows, slots):
         v = jax.lax.dynamic_update_slice(
             v, rows.v[:, i : i + 1], (0, slots[i], 0, 0, 0)
         )
+        if ks is not None:
+            ks = jax.lax.dynamic_update_slice(
+                ks, rows.k_scale[:, i : i + 1], (0, slots[i], 0, 0)
+            )
+            vs = jax.lax.dynamic_update_slice(
+                vs, rows.v_scale[:, i : i + 1], (0, slots[i], 0, 0)
+            )
         lengths = jax.lax.dynamic_update_slice(
             lengths, rows.lengths[i : i + 1], (slots[i],)
         )
-    return cache.replace(k=k, v=v, lengths=lengths)
+    return cache.replace(k=k, v=v, lengths=lengths,
+                         k_scale=ks, v_scale=vs)
 
 
 def commit_row(cache, row, slot):
@@ -172,10 +183,19 @@ def commit_row(cache, row, slot):
     v = jax.lax.dynamic_update_slice(
         cache.v, row.v[:, :, :S], (0, slot, 0, 0, 0)
     )
+    ks, vs = cache.k_scale, cache.v_scale
+    if ks is not None:
+        ks = jax.lax.dynamic_update_slice(
+            ks, row.k_scale[:, :, :S], (0, slot, 0, 0)
+        )
+        vs = jax.lax.dynamic_update_slice(
+            vs, row.v_scale[:, :, :S], (0, slot, 0, 0)
+        )
     lengths = jax.lax.dynamic_update_slice(
         cache.lengths, row.lengths, (slot,)
     )
-    return cache.replace(k=k, v=v, lengths=lengths)
+    return cache.replace(k=k, v=v, lengths=lengths,
+                         k_scale=ks, v_scale=vs)
 
 
 def run_chunked(chunk_fn, params, prompt, C, row, start_chunk=0,
@@ -463,6 +483,17 @@ class DecodeEngine:
         self.session_cache: Optional[SessionCache] = None
         if session_cache_size > 0:
             self.session_cache = SessionCache(session_cache_size)
+        if getattr(self._cache, "quantized", False) and (
+                self.prefix_cache is not None
+                or self.session_cache is not None):
+            # The row-copy paths (_seed/_extract_*) move k/v only; with
+            # a quantized cache they would silently drop the scales and
+            # reconstruct garbage KV. Fail loudly until they carry them.
+            raise ValueError(
+                "int8 KV cache is not yet compatible with "
+                "prefix_cache_size/session_cache_size — the row seed/"
+                "extract paths do not carry quantization scales"
+            )
         self._prefill_fns: Dict[int, Callable] = {}
         # Donations: cache (arg 1) and counts (arg 8 — params=0,
         # cache=1, step_state=2, horizon=3, samp_f=4, samp_i=5,

@@ -37,10 +37,15 @@ class CausalLM(ServableModel):
         cfg: DecoderConfig,
         name: str,
         dtype: jnp.dtype = jnp.bfloat16,
+        kv_dtype: Optional[jnp.dtype] = None,
     ):
         super().__init__(dtype)
         self.name = name
         self.cfg = cfg
+        # KV-cache storage dtype (None = activations dtype). int8 halves
+        # the decode scan's HBM traffic: codes + per-(token, head) f32
+        # scales, quantized at write (models/decoder.py::quantize_kv_rows).
+        self.kv_dtype = kv_dtype
         self.module = DecoderModule(cfg, dtype=dtype)
 
     # --- ServableModel interface (apply == prefill logits for profiling) ---
@@ -92,7 +97,9 @@ class CausalLM(ServableModel):
     def make_cache(
         self, batch_size: int, max_len: Optional[int] = None
     ) -> KVCache:
-        return KVCache.zeros(self.cfg, batch_size, max_len, dtype=self.dtype)
+        return KVCache.zeros(
+            self.cfg, batch_size, max_len, dtype=self.kv_dtype or self.dtype
+        )
 
     def prefill(
         self, params, tokens: jax.Array, attn_mask: jax.Array, cache: KVCache
@@ -229,8 +236,12 @@ class CausalLM(ServableModel):
     def kv_bytes_per_slot(self, max_len: Optional[int] = None) -> int:
         c = self.cfg
         S = max_len or c.max_seq_len
-        itemsize = jnp.dtype(self.dtype).itemsize
-        return 2 * c.num_layers * S * c.num_kv_heads * c.head_dim * itemsize
+        itemsize = jnp.dtype(self.kv_dtype or self.dtype).itemsize
+        per_row = c.head_dim * itemsize
+        if self.kv_dtype is not None and jnp.dtype(
+                self.kv_dtype) == jnp.dtype(jnp.int8):
+            per_row += 4  # one f32 scale per cached (token, head) row
+        return 2 * c.num_layers * S * c.num_kv_heads * per_row
 
     def sharding_rules(self):
         return [
@@ -250,10 +261,16 @@ class CausalLM(ServableModel):
 
     def cache_pspec(self) -> KVCache:
         """PartitionSpecs for the KV cache (kv heads sharded over tp)."""
+        scale_spec = None
+        if self.kv_dtype is not None and jnp.dtype(
+                self.kv_dtype) == jnp.dtype(jnp.int8):
+            scale_spec = P(None, None, None, "tp")
         return KVCache(
             k=P(None, None, None, "tp", None),   # type: ignore[arg-type]
             v=P(None, None, None, "tp", None),   # type: ignore[arg-type]
             lengths=P(None),                      # type: ignore[arg-type]
+            k_scale=scale_spec,                   # type: ignore[arg-type]
+            v_scale=scale_spec,                   # type: ignore[arg-type]
         )
 
 

@@ -138,15 +138,25 @@ def make_sharded_cache(
     ``cache_pspec`` (kv heads over tp). The cache never materializes
     unsharded on any single device — a cache sized to fit only when split
     over the tp chips must not OOM chip 0 on the way in."""
+    import dataclasses
+
     shapes = jax.eval_shape(lambda: model.make_cache(num_slots, max_len))
     spec = model.cache_pspec()
-    shardings = type(shapes)(
-        k=NamedSharding(mesh, _feasible_spec(spec.k, shapes.k.shape, mesh)),
-        v=NamedSharding(mesh, _feasible_spec(spec.v, shapes.v.shape, mesh)),
-        lengths=NamedSharding(
-            mesh, _feasible_spec(spec.lengths, shapes.lengths.shape, mesh)
-        ),
-    )
+
+    def _shard(field_spec, field_shape):
+        if field_shape is None:  # absent optional plane (e.g. scales)
+            return None
+        return NamedSharding(
+            mesh, _feasible_spec(field_spec, field_shape.shape, mesh)
+        )
+
+    # Field-generic so every cache plane — including a quantized cache's
+    # scale planes — gets a sharding; a hand-listed constructor here
+    # silently dropped new planes once already.
+    shardings = type(shapes)(**{
+        f.name: _shard(getattr(spec, f.name, None), getattr(shapes, f.name))
+        for f in dataclasses.fields(shapes)
+    })
     return jax.jit(
         lambda: model.make_cache(num_slots, max_len),
         out_shardings=shardings,

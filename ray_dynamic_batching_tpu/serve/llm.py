@@ -283,6 +283,7 @@ class LLMDeployment:
         checkpoint_dir: Optional[str] = None,
         checkpoint_step: Optional[int] = None,
         quantize_weights: bool = False,
+        quantize_kv: bool = False,
         profiles_dir: Optional[str] = None,
         token_slo_ms: Optional[float] = None,
         ttft_slo_ms: Optional[float] = None,
@@ -329,6 +330,12 @@ class LLMDeployment:
         # Weight-only int8 for the decode engines (engine-owned transform;
         # TP meshes unsupported — see DecodeEngine).
         self.quantize_weights = quantize_weights
+        # Int8 KV cache (codes + per-row scales, KVCache docstring):
+        # auto slot sizing sees the smaller kv_bytes_per_slot and fits
+        # ~2x the slots in the same HBM; the decode-scan bandwidth win
+        # additionally requires the dequant fused into the attention
+        # read (kernel path) — see KVCache.
+        self.quantize_kv = quantize_kv
         self._dtype = dtype
         self._model = model
         self._params = params
@@ -352,6 +359,10 @@ class LLMDeployment:
                 from ray_dynamic_batching_tpu.models.base import get_model
 
                 kwargs = {"dtype": self._dtype} if self._dtype is not None else {}
+                if self.quantize_kv:
+                    import jax.numpy as jnp
+
+                    kwargs["kv_dtype"] = jnp.int8
                 self._model = get_model(self.model_name, **kwargs)
             if self._params is None:
                 import jax
