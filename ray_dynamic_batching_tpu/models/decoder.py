@@ -116,7 +116,10 @@ def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 def dequantize_kv(codes: jax.Array, scale: jax.Array,
                   dtype: jnp.dtype) -> jax.Array:
-    """codes int8 [..., H] * scale [...] -> [..., H] in ``dtype``."""
+    """codes int8 [..., H] * scale [...] -> [..., H] in ``dtype``.
+    Single source of the dequant rule — the attention dispatcher's
+    fallback path uses this exact function, so kernel-vs-fallback
+    parity cannot drift."""
     return (codes.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
@@ -262,19 +265,19 @@ class DecoderLayer(nn.Module):
                     vs_full = jax.lax.dynamic_update_slice(
                         vs_full, v_s[None], (layer_idx, 0, start, 0)
                     )
+            # Quantized caches hand CODES + scales to the dispatcher:
+            # the decode kernel scans the 1-byte codes directly (the
+            # bandwidth win); non-kernel paths dequantize there.
+            scale_kwargs = {}
             if quantized:
-                k_attn = dequantize_kv(
-                    k_full[layer_idx], ks_full[layer_idx], self.dtype
-                )
-                v_attn = dequantize_kv(
-                    v_full[layer_idx], vs_full[layer_idx], self.dtype
-                )
+                scale_kwargs = {"k_scale": ks_full[layer_idx],
+                                "v_scale": vs_full[layer_idx]}
                 new_cache = (k_full, v_full, ks_full, vs_full)
             else:
-                k_attn, v_attn = k_full[layer_idx], v_full[layer_idx]
                 new_cache = (k_full, v_full)
             attn_out = attn_ops.dot_product_attention(
-                q, k_attn, v_attn, mask=mask
+                q, k_full[layer_idx], v_full[layer_idx], mask=mask,
+                **scale_kwargs,
             )
         elif token_mask is not None:
             # Full-sequence self-attention: routes through ring attention

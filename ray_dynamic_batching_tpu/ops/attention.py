@@ -94,12 +94,19 @@ def dot_product_attention(
     causal: bool = False,
     mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-head attention.
 
     Shapes: q [B, Tq, N, H], k/v [B, Tk, K, H] with K == N or K dividing N
     (grouped-query attention: each group of N//K query heads shares a kv head).
     mask: broadcastable to [B, 1, Tq, Tk], True = attend.
+
+    ``k_scale``/``v_scale`` [B, Tk, K]: k/v are int8 KV-cache codes
+    (models/decoder.py::KVCache). The decode kernel consumes the codes
+    directly (1-byte scan, scales applied inside the dots); every other
+    path dequantizes first and proceeds as usual.
     """
     if _use_pallas():
         if not causal:
@@ -114,10 +121,15 @@ def dot_product_attention(
             from ray_dynamic_batching_tpu.ops import decode_attention
 
             out = decode_attention.decode_attention(
-                q, k, v, mask=mask, scale=scale
+                q, k, v, mask=mask, scale=scale,
+                k_scale=k_scale, v_scale=v_scale,
             )
             if out is not None:
                 return out
+        if k_scale is not None:
+            k, v = _dequantize(k, k_scale, q.dtype), _dequantize(
+                v, v_scale, q.dtype)
+            k_scale = v_scale = None
         from ray_dynamic_batching_tpu.ops import flash_attention
 
         out = flash_attention.flash_attention(
@@ -125,7 +137,19 @@ def dot_product_attention(
         )
         if out is not None:
             return out
+    if k_scale is not None:
+        k, v = _dequantize(k, k_scale, q.dtype), _dequantize(
+            v, v_scale, q.dtype)
     return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+
+
+def _dequantize(codes: jax.Array, scales: jax.Array,
+                dtype) -> jax.Array:
+    # Deferred import (decoder imports this module): the dequant rule
+    # has exactly one definition, next to the quantizer it inverts.
+    from ray_dynamic_batching_tpu.models.decoder import dequantize_kv
+
+    return dequantize_kv(codes, scales, dtype)
 
 
 def _xla_attention(

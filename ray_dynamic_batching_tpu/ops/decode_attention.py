@@ -73,6 +73,8 @@ def _decode_kernel(
     k_ref,      # [1, Sb, kb, H]     this grid step's KV tile
     v_ref,      # [1, Sb, kb, H]
     mask_ref,   # [1, Tq, Sb] int8, or None
+    ks_ref,     # [1, kb, Sb] f32 per-row K scales (int8 cache), or None
+    vs_ref,     # [1, kb, Sb] f32 per-row V scales, or None
     o_ref,      # [1, kb, Tq*G, H]
     m_ref,      # VMEM scratch [kb, Tq*G] f32 — carried across S steps
     l_ref,      # VMEM scratch [kb, Tq*G] f32
@@ -87,6 +89,7 @@ def _decode_kernel(
     H = q_ref.shape[3]
     Sb = k_ref.shape[1]
     G = R // window
+    compute_dtype = q_ref.dtype  # int8 codes cast exactly (<= +-127)
     s_idx = pl.program_id(2)
 
     @pl.when(s_idx == 0)
@@ -111,11 +114,20 @@ def _decode_kernel(
         q = q_ref[0, h, :, :]        # [R, H]
         k_tile = k_ref[0, :, h, :]   # [Sb, H]
         v_tile = v_ref[0, :, h, :]
+        if ks_ref is not None:
+            # Int8 cache: the per-row scale factors OUT of both dots —
+            # scores scale per key column, and V's scale rides on p —
+            # so the kernel reads 1-byte codes and never materializes
+            # an H-wide dequantized tile (this is the bandwidth win).
+            k_tile = k_tile.astype(compute_dtype)
+            v_tile = v_tile.astype(compute_dtype)
         s = jax.lax.dot_general(
             q, k_tile,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [R, Sb] f32
+        if ks_ref is not None:
+            s = s * ks_ref[0, h, :][None, :]
         if valid is not None:
             s = jnp.where(valid, s, NEG_INF)
 
@@ -126,9 +138,11 @@ def _decode_kernel(
         p = jnp.exp(s - m_cur[:, None])  # [R, Sb]
         m_ref[h, :] = m_cur
         l_ref[h, :] = l_prev * alpha + jnp.sum(p, axis=1)
+        if vs_ref is not None:
+            p = p * vs_ref[0, h, :][None, :]
         acc_ref[h, :, :] = acc_ref[h, :, :] * alpha[:, None] + (
             jax.lax.dot_general(
-                p.astype(v_tile.dtype), v_tile,
+                p.astype(compute_dtype), v_tile,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
@@ -155,14 +169,17 @@ def _pick_heads_block(K: int) -> int:
     return K
 
 
-# Per-grid-step VMEM ceiling for this call's blocks (~16 MB VMEM/core;
-# double-buffered pipelining keeps two S tiles live, and the f32
-# accumulator scratch rides alongside).
-VMEM_BLOCK_BUDGET_BYTES = 6 * 1024 * 1024
+# Per-grid-step VMEM ceiling for this call's K/V+mask+scale blocks
+# (~16 MB VMEM/core): the budget counts PADDED tiles double-buffered
+# (the `2 *` in tile_bytes), so it can honestly run closer to the core
+# limit — the q/out blocks and f32 accumulator scratch riding alongside
+# are small (R <= window * G rows).
+VMEM_BLOCK_BUDGET_BYTES = 10 * 1024 * 1024
 
 
 def _pick_sb(S: int, kb: int, H: int, kv_itemsize: int,
-             with_mask: bool, target: Optional[int] = None) -> int:
+             with_mask: bool, target: Optional[int] = None,
+             with_scales: bool = False) -> int:
     """Largest KV tile Sb that (a) divides S, (b) is mask-tile-legal
     (a multiple of 128, or S itself — the mask block's trailing dim is
     Sb), and (c) fits the VMEM budget with double buffering. A
@@ -170,9 +187,15 @@ def _pick_sb(S: int, kb: int, H: int, kv_itemsize: int,
     (callers tune pipeline granularity; tests force multi-tile scans
     on small capacities)."""
     def tile_bytes(sb: int) -> int:
-        kv = 2 * sb * kb * H * kv_itemsize
-        mask_b = MAX_WINDOW_FOR_KERNEL * sb if with_mask else 0
-        return 2 * (kv + mask_b)
+        # Mosaic pads a block's SUBLANE (second-to-last) dim to the
+        # dtype's tile height (f32 8, bf16 16, int8 32) — the in-VMEM
+        # footprint is the padded one, not the logical one.
+        sublane = {4: 8, 2: 16, 1: 32}[kv_itemsize]
+        kv = 2 * sb * -(-kb // sublane) * sublane * H * kv_itemsize
+        mask_b = 32 * sb if with_mask else 0  # int8 window rows, padded
+        # scales ride as [1, kb, sb] f32 blocks: sublane = padded kb
+        scale_b = 2 * -(-kb // 8) * 8 * sb * 4 if with_scales else 0
+        return 2 * (kv + mask_b + scale_b)
 
     cands = [S] + [
         sb for sb in range((S // 128) * 128, 127, -128) if S % sb == 0
@@ -196,6 +219,8 @@ def _decode_attention(
     k: jax.Array,      # [B, S, K, H]
     v: jax.Array,
     mask: Optional[jax.Array],  # [B, Tq, S] int8, or None
+    k_scale: Optional[jax.Array],  # [B, S, K] f32 (int8 cache), or None
+    v_scale: Optional[jax.Array],
     *,
     scale: float,
     sb: int,
@@ -212,20 +237,41 @@ def _decode_attention(
         pl.BlockSpec((1, sb, kb, H), lambda b, j, s: (b, s, j, 0)),
     ]
     args = [q, k, v]
-    if mask is not None:
+    has_mask = mask is not None
+    has_scales = k_scale is not None
+    if has_mask:
         in_specs.append(
             pl.BlockSpec((1, window, sb), lambda b, j, s: (b, 0, s))
         )
         args.append(mask)
-        kernel = functools.partial(
-            _decode_kernel, scale=scale, num_s=num_s, window=window,
+    if has_scales:
+        # Scales travel as [B, K, S]: block (1, kb, sb) has trailing
+        # dims (kb -> 8-sublane pad, sb = lane multiple of 128) — pad
+        # free. A [B, S, K, 1] layout would be tile-legal but its
+        # (kb, 1) trailing dims pad to (8, 128): a ~128x VMEM blowup
+        # invisible to export-based lowering tests. The transpose copies
+        # only the S*K*4-byte scale plane (<0.1% of the cache read).
+        scale_spec = pl.BlockSpec(
+            (1, kb, sb), lambda b, j, s: (b, j, s)
         )
-    else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
-            _decode_kernel(
-                q_ref, k_ref, v_ref, None, o_ref, m_ref, l_ref, acc_ref,
-                scale=scale, num_s=num_s, window=window,
-            )
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale.transpose(0, 2, 1), v_scale.transpose(0, 2, 1)]
+
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        idx = 0
+        mask_ref = rest[idx] if has_mask else None
+        idx += 1 if has_mask else 0
+        ks_ref = rest[idx] if has_scales else None
+        vs_ref = rest[idx + 1] if has_scales else None
+        idx += 2 if has_scales else 0
+        o_ref, m_ref, l_ref, acc_ref = rest[idx:idx + 4]
+        _decode_kernel(
+            q_ref, k_ref, v_ref, mask_ref, ks_ref, vs_ref,
+            o_ref, m_ref, l_ref, acc_ref,
+            scale=scale, num_s=num_s, window=window,
+        )
+
+    out_dtype = q.dtype
     return pl.pallas_call(
         kernel,
         grid=(B, K // kb, num_s),
@@ -233,7 +279,7 @@ def _decode_attention(
         out_specs=pl.BlockSpec(
             (1, kb, R, H), lambda b, j, s: (b, j, 0, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct((B, K, R, H), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, K, R, H), out_dtype),
         scratch_shapes=[
             pltpu.VMEM((kb, R), jnp.float32),
             pltpu.VMEM((kb, R), jnp.float32),
@@ -254,6 +300,8 @@ def decode_attention(
     mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     block_k: Optional[int] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
 ) -> Optional[jax.Array]:
     """Fused small-window attention; returns None when the shapes aren't
@@ -264,6 +312,11 @@ def decode_attention(
     with K dividing N; mask None or broadcastable to [B, 1, Tq, S]
     (True = attend). The KV-head grouping matches ``_xla_attention``'s
     ``jnp.repeat`` semantics: query head n reads kv head n // (N // K).
+
+    ``k_scale``/``v_scale`` [B, S, K] enable the int8-cache path: k/v
+    hold codes, the kernel reads 1-byte tiles and applies the per-row
+    scales inside the dots (``KVCache`` docstring) — the decode scan's
+    bandwidth win.
     """
     if q.ndim != 4 or k.ndim != 4:
         return None
@@ -272,6 +325,11 @@ def decode_attention(
     if not (1 <= Tq <= MAX_WINDOW_FOR_KERNEL):
         return None
     if K == 0 or N % K != 0 or v.shape != k.shape:
+        return None
+    if (k_scale is None) != (v_scale is None):
+        return None
+    if k_scale is not None and (
+            k_scale.shape != (B, S, K) or v_scale.shape != (B, S, K)):
         return None
     G = N // K
     if mask is not None:
@@ -292,7 +350,8 @@ def decode_attention(
     # re-read shifted rows), be mask-tile-legal, and fit VMEM
     # double-buffered. 0 = no legal tile (pathological S) -> XLA.
     sb = _pick_sb(S, _pick_heads_block(K), H, k.dtype.itemsize,
-                  mask is not None, target=block_k)
+                  mask is not None, target=block_k,
+                  with_scales=k_scale is not None)
     if sb == 0:
         return None
     scale = scale if scale is not None else H ** -0.5
@@ -301,7 +360,7 @@ def decode_attention(
         B, K, Tq * G, H
     )
     out = _decode_attention(
-        q_r, k, v, mask,
+        q_r, k, v, mask, k_scale, v_scale,
         scale=float(scale), sb=int(sb), window=int(Tq),
         interpret=bool(interpret),
     )

@@ -364,6 +364,22 @@ class LLMDeployment:
 
                     kwargs["kv_dtype"] = jnp.int8
                 self._model = get_model(self.model_name, **kwargs)
+            elif self.quantize_kv:
+                import jax.numpy as jnp
+
+                if getattr(self._model, "kv_dtype", None) is None or (
+                        jnp.dtype(self._model.kv_dtype)
+                        != jnp.dtype(jnp.int8)):
+                    # An injected model instance owns its cache dtype;
+                    # silently serving a full-precision cache while the
+                    # operator believes int8 is on would skew every
+                    # HBM/slot-count decision downstream.
+                    raise ValueError(
+                        "quantize_kv=True but the injected model was not "
+                        "built with kv_dtype=int8 — construct it with "
+                        "CausalLM(..., kv_dtype=jnp.int8) or pass "
+                        "model_name and let the deployment build it"
+                    )
             if self._params is None:
                 import jax
 

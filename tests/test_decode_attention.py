@@ -105,6 +105,115 @@ def test_multi_tile_no_mask():
     _check(q, k, v, block_k=128)
 
 
+def _quantize(x):
+    from ray_dynamic_batching_tpu.models.decoder import quantize_kv_rows
+
+    return quantize_kv_rows(x)
+
+
+def test_int8_codes_match_dequantized_oracle():
+    """The kernel's in-dot scale application must equal dequantize-then-
+    attend exactly (the scales factor out algebraically)."""
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    B, S = 3, 96
+    q = _rand((B, 1, 8, 32), ks[0])
+    k = _rand((B, S, 4, 32), ks[1]) * 3.0
+    v = _rand((B, S, 4, 32), ks[2]) * 3.0
+    k8, kscale = _quantize(k)
+    v8, vscale = _quantize(v)
+    mask = decode_mask(jnp.asarray([10, 50, S - 1]), S)
+    out = da.decode_attention(
+        q, k8, v8, mask=mask, k_scale=kscale, v_scale=vscale,
+        interpret=True,
+    )
+    assert out is not None, "int8 path declined"
+    from ray_dynamic_batching_tpu.models.decoder import dequantize_kv
+
+    ref = _xla_attention(
+        q, dequantize_kv(k8, kscale, q.dtype),
+        dequantize_kv(v8, vscale, q.dtype),
+        causal=False, mask=mask, scale=None,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-3, rtol=1e-3,
+    )
+
+
+def test_int8_multi_tile_spec_window():
+    """Int8 scan across multiple S tiles with a speculative staircase
+    window — scales must track their tiles."""
+    ks = jax.random.split(jax.random.PRNGKey(10), 3)
+    B, S, Tq = 2, 256, 4
+    q = _rand((B, Tq, 8, 32), ks[0])
+    k = _rand((B, S, 8, 32), ks[1]) * 2.0
+    v = _rand((B, S, 8, 32), ks[2]) * 2.0
+    k8, kscale = _quantize(k)
+    v8, vscale = _quantize(v)
+    base = jnp.asarray([30, 200])
+    pos = jnp.arange(S)[None, None, None, :]
+    row = jnp.arange(Tq)[None, None, :, None]
+    mask = pos < (base[:, None, None, None] + row + 1)
+    out = da.decode_attention(
+        q, k8, v8, mask=mask, k_scale=kscale, v_scale=vscale,
+        block_k=128, interpret=True,
+    )
+    assert out is not None
+    from ray_dynamic_batching_tpu.models.decoder import dequantize_kv
+
+    ref = _xla_attention(
+        q, dequantize_kv(k8, kscale, q.dtype),
+        dequantize_kv(v8, vscale, q.dtype),
+        causal=False, mask=mask, scale=None,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-3, rtol=1e-3,
+    )
+
+
+def test_int8_dispatch_reaches_kernel_and_matches(monkeypatch):
+    """dot_product_attention with scales must route codes to the kernel
+    under the pallas backend (no dequant materialization) and still
+    match the dequantized oracle."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    B, S = 2, 48
+    q = _rand((B, 1, 4, 16), ks[0])
+    k = _rand((B, S, 4, 16), ks[1])
+    v = _rand((B, S, 4, 16), ks[2])
+    k8, kscale = _quantize(k)
+    v8, vscale = _quantize(v)
+    mask = decode_mask(jnp.asarray([10, 47]), S)
+    calls = []
+    real = da.decode_attention
+
+    def spy(*args, **kwargs):
+        out = real(*args, **kwargs)
+        calls.append(kwargs.get("k_scale") is not None and out is not None)
+        return out
+
+    monkeypatch.setattr(da, "decode_attention", spy)
+    set_attention_backend("pallas")
+    try:
+        out = dot_product_attention(
+            q, k8, v8, mask=mask, k_scale=kscale, v_scale=vscale
+        )
+    finally:
+        set_attention_backend("auto")
+    assert calls == [True], "int8 decode did not engage the kernel"
+    from ray_dynamic_batching_tpu.models.decoder import dequantize_kv
+
+    ref = _xla_attention(
+        q, dequantize_kv(k8, kscale, q.dtype),
+        dequantize_kv(v8, vscale, q.dtype),
+        causal=False, mask=mask, scale=None,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-3, rtol=1e-3,
+    )
+
+
 def test_bf16_inputs():
     ks = jax.random.split(jax.random.PRNGKey(4), 3)
     q = _rand((2, 1, 4, 32), ks[0], jnp.bfloat16)

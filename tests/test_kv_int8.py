@@ -193,6 +193,18 @@ class TestDecodeParity:
         for r in reqs:
             assert len(r.future.result(timeout=5).tokens) == 6
 
+    def test_injected_model_without_kv_dtype_rejected(self):
+        """quantize_kv with a model INSTANCE that wasn't built int8 must
+        fail loudly — silently serving a full-precision cache would skew
+        every HBM/slot-count decision downstream."""
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+        ref, _, params = _models()
+        dep = LLMDeployment("llama_tiny", num_slots=2, max_len=32,
+                            model=ref, params=params, quantize_kv=True)
+        with pytest.raises(ValueError, match="kv_dtype"):
+            dep._ensure_model()
+
     def test_quantized_cache_rejects_row_reuse_features(self):
         """The prefix/session row-copy paths do not carry scales yet —
         enabling them with an int8 cache must fail loudly, not corrupt."""
@@ -208,6 +220,58 @@ class TestDecodeParity:
             DecodeEngine(model, params, RequestQueue("llama_tiny"),
                          num_slots=2, max_len=32, prompt_buckets=[8],
                          session_cache_size=4)
+
+    def test_engine_under_pallas_backend_matches_xla_backend(self):
+        """The quantized cache must serve equivalent streams whether the
+        decode scan rides the int8 kernel (pallas backend, interpret on
+        CPU) or the dispatcher's dequantize-to-XLA path. The two paths
+        round differently (in-dot scaling + online softmax vs dense),
+        and random-init tiny-model logits are near-ties, so a rare
+        greedy flip is tolerated — wholesale divergence is not."""
+        import numpy as np
+        from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+        from ray_dynamic_batching_tpu.engine.request import Request
+        from ray_dynamic_batching_tpu.models.base import get_model
+        from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+        from ray_dynamic_batching_tpu.ops.attention import (
+            set_attention_backend,
+        )
+
+        model = get_model("llama_tiny", dtype=jnp.float32,
+                          kv_dtype=jnp.int8)
+        params = model.init(jax.random.PRNGKey(0))
+
+        def run(backend):
+            set_attention_backend(backend)
+            try:
+                queue = RequestQueue("llama_tiny", max_len=16)
+                eng = DecodeEngine(
+                    model, params, queue, num_slots=2, max_len=32,
+                    prompt_buckets=[8], default_max_new_tokens=6,
+                )
+                reqs = []
+                for prompt in ([1, 2, 3], [4, 5]):
+                    r = Request(
+                        model="llama_tiny",
+                        payload={"tokens": np.asarray(prompt, np.int32),
+                                 "max_new_tokens": 6},
+                        slo_ms=60_000.0)
+                    queue.add_request(r)
+                    reqs.append(r)
+                eng.run_until_idle(timeout_s=120)
+                return [r.future.result(timeout=5).tokens for r in reqs]
+            finally:
+                set_attention_backend("auto")
+
+        got_p, got_x = run("pallas"), run("xla")
+        assert [len(t) for t in got_p] == [len(t) for t in got_x]
+        agree = sum(
+            int(a == b)
+            for tp, tx in zip(got_p, got_x) for a, b in zip(tp, tx)
+        )
+        total = sum(len(t) for t in got_x)
+        assert agree >= int(0.75 * total), f"{agree}/{total} tokens agree"
 
     def test_tp_mesh_shards_scale_planes(self):
         """make_sharded_cache must shard the quantized cache's scale

@@ -115,6 +115,27 @@ class TestDecodeKernelLowersForTPU:
             assert K % kb == 0
             assert kb == K or kb % 8 == 0
 
+    def test_int8_cache_codes_and_scales(self):
+        # int8 KV cache: codes + [B, S, K, 1]-reshaped scale blocks must
+        # lower (the (sb, kb) trailing-dims layout is ILLEGAL for kb < K
+        # — this pins the reshape fix). gpt2_medium (kb=8 < K=16) and
+        # llama GQA (kb == K) both covered.
+        for (B, N, H, S, K) in ((8, 16, 64, 256, 16), (4, 32, 128, 512, 8)):
+            q = jnp.zeros((B, 1, N, H), jnp.bfloat16)
+            k = jnp.zeros((B, S, K, H), jnp.int8)
+            ksc = jnp.zeros((B, S, K), jnp.float32)
+            mask = jnp.ones((B, 1, 1, S), bool)
+
+            def f(q, k, ksc, mask):
+                out = da.decode_attention(
+                    q, k, k, mask=mask, k_scale=ksc, v_scale=ksc,
+                    interpret=False,
+                )
+                assert out is not None, "int8 path declined"
+                return out
+
+            export.export(jax.jit(f), platforms=["tpu"])(q, k, ksc, mask)
+
     def test_whisper_decoder_geometry(self):
         # whisper_large_v3: 20 MHA heads (not a multiple of 8 — the head
         # block must span), 448-token decode capacity.

@@ -32,17 +32,22 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-# Geometries: (tag, B slots, Tq, N q-heads, H, S capacity, K kv-heads)
+# Geometries: (tag, B slots, Tq, N q-heads, H, S capacity, K kv-heads,
+# int8_kv) — int8 rows time the quantized-cache scan (codes + scales in
+# the kernel) against the XLA dequantize-then-attend fallback.
 GEOMETRIES = [
-    ("bench_llm_row_gpt2m", 64, 1, 16, 64, 256, 16),
-    ("gqa_s512", 32, 1, 32, 128, 512, 8),
-    ("gqa_s2048", 32, 1, 32, 128, 2048, 8),
-    ("gqa_s8192", 8, 1, 32, 128, 8192, 8),
-    ("spec_window5", 16, 5, 16, 64, 512, 8),
+    ("bench_llm_row_gpt2m", 64, 1, 16, 64, 256, 16, False),
+    ("gqa_s512", 32, 1, 32, 128, 512, 8, False),
+    ("gqa_s2048", 32, 1, 32, 128, 2048, 8, False),
+    ("gqa_s8192", 8, 1, 32, 128, 8192, 8, False),
+    ("spec_window5", 16, 5, 16, 64, 512, 8, False),
+    ("bench_llm_row_int8kv", 64, 1, 16, 64, 256, 16, True),
+    ("gqa_s2048_int8kv", 32, 1, 32, 128, 2048, 8, True),
 ]
 
 
-def _time_attention(backend: str, q, k, v, mask, iters: int):
+def _time_attention(backend: str, q, k, v, mask, iters: int,
+                    k_scale=None, v_scale=None):
     """Median ms/step for the dispatched attention substep."""
     import jax
     import jax.numpy as jnp
@@ -52,7 +57,8 @@ def _time_attention(backend: str, q, k, v, mask, iters: int):
     attn.set_attention_backend(backend)
     try:
         fn = jax.jit(
-            lambda q, k, v, m: attn.dot_product_attention(q, k, v, mask=m)
+            lambda q, k, v, m: attn.dot_product_attention(
+                q, k, v, mask=m, k_scale=k_scale, v_scale=v_scale)
         )
         out = fn(q, k, v, mask)
         float(jnp.sum(out.astype(jnp.float32)))  # compile + fetch
@@ -83,11 +89,19 @@ def main() -> int:
 
     backend = jax.default_backend()
     rows = []
-    for tag, B, Tq, N, H, S, K in GEOMETRIES:
+    for tag, B, Tq, N, H, S, K, int8_kv in GEOMETRIES:
         ks = jax.random.split(jax.random.PRNGKey(0), 4)
         q = jax.random.normal(ks[0], (B, Tq, N, H), jnp.bfloat16)
         k = jax.random.normal(ks[1], (B, S, K, H), jnp.bfloat16)
         v = jax.random.normal(ks[2], (B, S, K, H), jnp.bfloat16)
+        kscale = vscale = None
+        if int8_kv:
+            from ray_dynamic_batching_tpu.models.decoder import (
+                quantize_kv_rows,
+            )
+
+            k, kscale = quantize_kv_rows(k)
+            v, vscale = quantize_kv_rows(v)
         lengths = jax.random.randint(ks[3], (B,), Tq, S - Tq)
         if Tq > 1:
             # Speculative-verify staircase: row r attends through its own
@@ -98,8 +112,12 @@ def main() -> int:
         else:
             mask = decode_mask(lengths, S)
         try:
-            xla_ms, xla_out = _time_attention("xla", q, k, v, mask, iters)
-            pl_ms, pl_out = _time_attention("pallas", q, k, v, mask, iters)
+            xla_ms, xla_out = _time_attention(
+                "xla", q, k, v, mask, iters,
+                k_scale=kscale, v_scale=vscale)
+            pl_ms, pl_out = _time_attention(
+                "pallas", q, k, v, mask, iters,
+                k_scale=kscale, v_scale=vscale)
             max_abs = float(
                 jnp.max(jnp.abs(pl_out.astype(jnp.float32)
                                 - xla_out.astype(jnp.float32)))
