@@ -516,6 +516,12 @@ class LLMDeployment:
         placement dictates it): horizons are then derived from THAT
         config's measured step — horizons computed for a different batch
         size would silently re-break the SLO the scan length encodes.
+
+        Tables are profiled at the model's default (bf16) cache. Planning
+        an int8-KV deployment (``quantize_kv=True``) from them is SAFE
+        but conservative: the quantized scan is faster and smaller than
+        the measured rows, so slot counts and horizons under-promise —
+        re-profile with the quantized model to plan at its true capacity.
         """
         from ray_dynamic_batching_tpu.utils.config import get_config
 
