@@ -193,6 +193,24 @@ class TestDecodeParity:
         for r in reqs:
             assert len(r.future.result(timeout=5).tokens) == 6
 
+    def test_auto_slot_sizing_sees_halved_kv_bytes(self, monkeypatch):
+        """The HBM planner must size the continuous batch from the
+        QUANTIZED cache's bytes — the capacity half of the int8 win.
+        A small budget makes HBM the binding constraint (the default
+        budget hits the slot cap for the tiny model either way)."""
+        monkeypatch.setenv("RDB_HBM_BUDGET_BYTES", str(20 * 1024 * 1024))
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+        def slots(quantize_kv):
+            dep = LLMDeployment(
+                "llama_tiny", max_len=2048, dtype=jnp.float32,
+                quantize_kv=quantize_kv,
+            )
+            return dep.auto_num_slots(max_len=2048)
+
+        bf16_slots, int8_slots = slots(False), slots(True)
+        assert int8_slots >= 2 * bf16_slots, (bf16_slots, int8_slots)
+
     def test_injected_model_without_kv_dtype_rejected(self):
         """quantize_kv with a model INSTANCE that wasn't built int8 must
         fail loudly — silently serving a full-precision cache would skew

@@ -4,10 +4,12 @@
 #   tools/ci.sh fast     inner-loop lane: logic tests only (-m "not slow",
 #                        no XLA-compile-heavy files) — target <1 min
 #   tools/ci.sh tests    all tests, skip native/dryrun
-#   tools/ci.sh 8b       slow lane: BOTH real-size Llama-3-8B proofs
-#                        (TP=4 fp32 parity + single-device int8 through the
-#                        bench mechanics) — ~40 min, ~60 GB host RAM; run
-#                        once per round so the 8B flows don't silently rot
+#   tools/ci.sh 8b       slow lane: ALL real-size Llama-3-8B proofs
+#                        (TP=4 fp32 parity; single-device int8 weights
+#                        through the bench mechanics; int8 weights +
+#                        int8 KV cache together) — ~75 min, ~60 GB host
+#                        RAM; run once per round so the 8B flows don't
+#                        silently rot
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -20,7 +22,8 @@ if [ "${1:-}" = "8b" ]; then
   echo "== Llama-3-8B real-size slow lane (RDB_RUN_8B=1) =="
   exec env RDB_RUN_8B=1 python -m pytest \
     "tests/test_tp_decode.py::TestLlama8BRealConfig" \
-    "tests/test_tp_decode.py::TestLlama8BInt8" -q
+    "tests/test_tp_decode.py::TestLlama8BInt8" \
+    "tests/test_tp_decode.py::TestLlama8BInt8KV" -q
 fi
 
 echo "== pytest (fake 8-chip CPU cluster) =="
