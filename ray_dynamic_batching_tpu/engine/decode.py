@@ -283,13 +283,15 @@ class PrefixCache(_DeviceLRU):
     def _key(self, prompt: np.ndarray) -> bytes:
         return np.ascontiguousarray(prompt[: self.width]).tobytes()
 
-    def lookup(self, prompt: np.ndarray) -> Optional[Tuple[jax.Array, jax.Array]]:
+    def lookup(self, prompt: np.ndarray) -> Optional[Tuple]:
+        """(k, v, k_scale, v_scale) — scales None for bf16 caches."""
         return self._get(self._key(prompt))
 
-    def insert(self, prompt: np.ndarray, k: jax.Array, v: jax.Array) -> None:
+    def insert(self, prompt: np.ndarray, k: jax.Array, v: jax.Array,
+               k_scale=None, v_scale=None) -> None:
         key = self._key(prompt)
         if key not in self._entries:
-            self._put(key, (k, v))
+            self._put(key, (k, v, k_scale, v_scale))
 
 
 SESSION_HITS = m.Counter(
@@ -316,20 +318,22 @@ class SessionCache(_DeviceLRU):
     irrelevant to reuse (KV is deterministic in the tokens)."""
 
     def lookup(self, session_id: str, prompt: np.ndarray):
-        """Return (k, v, history_len) when the stored turn is a strict
-        prefix of ``prompt`` (leaving >= 1 tail token to prefill)."""
+        """Return (k, v, k_scale, v_scale, history_len) when the stored
+        turn is a strict prefix of ``prompt`` (leaving >= 1 tail token
+        to prefill); scales are None for bf16 caches."""
         entry = self._get(session_id)
         if entry is None:
             return None
-        k, v, history = entry
+        seg, history = entry
         n = int(history.size)
         if n >= prompt.size or not np.array_equal(history, prompt[:n]):
             return None
-        return k, v, n
+        return (*seg, n)
 
-    def store(self, session_id: str, k: jax.Array, v: jax.Array,
+    def store(self, session_id: str, seg: Tuple,
               history: np.ndarray) -> None:
-        self._put(session_id, (k, v, np.asarray(history, np.int32)))
+        """``seg`` is _extract_row_impl's (k, v, k_scale, v_scale)."""
+        self._put(session_id, (seg, np.asarray(history, np.int32)))
 
 
 class DecodeEngine:
@@ -483,17 +487,6 @@ class DecodeEngine:
         self.session_cache: Optional[SessionCache] = None
         if session_cache_size > 0:
             self.session_cache = SessionCache(session_cache_size)
-        if getattr(self._cache, "quantized", False) and (
-                self.prefix_cache is not None
-                or self.session_cache is not None):
-            # The row-copy paths (_seed/_extract_*) move k/v only; with
-            # a quantized cache they would silently drop the scales and
-            # reconstruct garbage KV. Fail loudly until they carry them.
-            raise ValueError(
-                "int8 KV cache is not yet compatible with "
-                "prefix_cache_size/session_cache_size — the row seed/"
-                "extract paths do not carry quantization scales"
-            )
         self._prefill_fns: Dict[int, Callable] = {}
         # Donations: cache (arg 1) and counts (arg 8 — params=0,
         # cache=1, step_state=2, horizon=3, samp_f=4, samp_i=5,
@@ -1344,19 +1337,36 @@ class DecodeEngine:
         )
         return first, cache
 
-    def _seed_prefix_impl(self, row_cache, pk, pv):
+    def _seed_prefix_impl(self, row_cache, pk, pv, pks, pvs):
         """Copy a cached prefix segment into positions [0, C) of a fresh
-        row cache — the HBM-copy replacement for recomputing chunk 0."""
+        row cache — the HBM-copy replacement for recomputing chunk 0.
+        ``pks``/``pvs`` are the segment's scale planes (int8 caches) or
+        None; a quantized row reconstructed without them would be
+        garbage, so the segment tuple carries them everywhere."""
         C = pk.shape[2]
         k = jax.lax.dynamic_update_slice(row_cache.k, pk, (0, 0, 0, 0, 0))
         v = jax.lax.dynamic_update_slice(row_cache.v, pv, (0, 0, 0, 0, 0))
         lengths = jnp.full_like(row_cache.lengths, C)
-        return row_cache.replace(k=k, v=v, lengths=lengths)
+        scales = {}
+        if pks is not None:
+            scales = {
+                "k_scale": jax.lax.dynamic_update_slice(
+                    row_cache.k_scale, pks, (0, 0, 0, 0)),
+                "v_scale": jax.lax.dynamic_update_slice(
+                    row_cache.v_scale, pvs, (0, 0, 0, 0)),
+            }
+        return row_cache.replace(k=k, v=v, lengths=lengths, **scales)
 
     def _extract_prefix_impl(self, row_cache, width: int):
         """Static slice of the first ``width`` cache positions (the just-
-        computed chunk 0) for insertion into the prefix cache."""
-        return row_cache.k[:, :, :width], row_cache.v[:, :, :width]
+        computed chunk 0) for insertion into the prefix cache — codes,
+        and scale planes when the cache is quantized."""
+        ks = vs = None
+        if row_cache.quantized:
+            ks = row_cache.k_scale[:, :, :width]
+            vs = row_cache.v_scale[:, :, :width]
+        return (row_cache.k[:, :, :width], row_cache.v[:, :, :width],
+                ks, vs)
 
     def _long_prefill_fns(self, chunk: int):
         """Lazily compiled (chunk, commit, seed, extract) fns — long
@@ -1465,21 +1475,38 @@ class DecodeEngine:
             req, prompt, opts, slot_idx, commit_fn, row, last, C
         )
 
-    def _seed_session_impl(self, row_cache, ek, ev, elen):
+    def _seed_session_impl(self, row_cache, ek, ev, eks, evs, elen):
         """Copy a stored session row ([L,1,S,K,H]) into a fresh row cache
-        and mark ``elen`` positions valid."""
+        and mark ``elen`` positions valid. ``eks``/``evs`` are the row's
+        scale planes (int8 caches) or None."""
         k = jax.lax.dynamic_update_slice(row_cache.k, ek, (0, 0, 0, 0, 0))
         v = jax.lax.dynamic_update_slice(row_cache.v, ev, (0, 0, 0, 0, 0))
+        scales = {}
+        if eks is not None:
+            scales = {
+                "k_scale": jax.lax.dynamic_update_slice(
+                    row_cache.k_scale, eks, (0, 0, 0, 0)),
+                "v_scale": jax.lax.dynamic_update_slice(
+                    row_cache.v_scale, evs, (0, 0, 0, 0)),
+            }
         return row_cache.replace(
-            k=k, v=v, lengths=jnp.full_like(row_cache.lengths, elen)
+            k=k, v=v, lengths=jnp.full_like(row_cache.lengths, elen),
+            **scales,
         )
 
     def _extract_row_impl(self, cache, slot):
         """Slice one slot's full cache row out of the shared cache (the
-        finished turn's KV, stored for the session's next turn)."""
+        finished turn's KV, stored for the session's next turn) — codes
+        plus scale planes when the cache is quantized."""
         k = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
         v = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
-        return k, v
+        ks = vs = None
+        if cache.quantized:
+            ks = jax.lax.dynamic_slice_in_dim(
+                cache.k_scale, slot, 1, axis=1)
+            vs = jax.lax.dynamic_slice_in_dim(
+                cache.v_scale, slot, 1, axis=1)
+        return k, v, ks, vs
 
     def _session_fns(self):
         fns = self._prefill_fns.get("session")
@@ -1500,14 +1527,14 @@ class DecodeEngine:
         (traced start — the base need not be chunk-aligned), and commit.
         Turn-N admission cost scales with the new message, not the whole
         history."""
-        ek, ev, elen = hit
+        ek, ev, eks, evs, elen = hit
         SESSION_HITS.inc(tags={"model": self.model.name})
         C = self.prompt_buckets[-1]
         chunk_fn, commit_fn, _seed_prefix, _extract = \
             self._long_prefill_fns(C)
         seed_fn, _ = self._session_fns()
         row = self.model.make_cache(1, self._long_row_cap(C))
-        row = seed_fn(row, ek, ev, jnp.int32(elen))
+        row = seed_fn(row, ek, ev, eks, evs, jnp.int32(elen))
         tail = prompt[elen:]
         last, row = run_chunked(
             chunk_fn, self.params, tail, C, row,
@@ -1626,12 +1653,12 @@ class DecodeEngine:
             # beyond the stored length and are overwritten by the next
             # turn's tail prefill before they can be attended.
             _, extract_fn = self._session_fns()
-            k, v = extract_fn(self._cache, jnp.int32(slot_idx))
+            seg = extract_fn(self._cache, jnp.int32(slot_idx))
             history = np.concatenate([
                 np.asarray(slot.prompt_tokens, np.int32),
                 np.asarray(slot.generated[:-1], np.int32),
             ])
-            self.session_cache.store(slot.session_id, k, v, history)
+            self.session_cache.store(slot.session_id, seg, history)
         result = DecodeResult(
             tokens=list(slot.generated),
             finish_reason=reason,

@@ -68,9 +68,9 @@ class KVCache:
     dequant fuses into the attention read; the XLA fallback path
     materializes a dequantized operand, trading scan bandwidth for
     capacity. Scales are pytree fields: donation and sharding treat
-    them as part of the cache automatically; row seed/extract paths
-    must thread them explicitly (engine guard refuses configurations
-    that would drop them)."""
+    them as part of the cache automatically; the row seed/extract paths
+    (admission copies, prefix/session segments) thread them explicitly
+    as part of every stored segment tuple."""
 
     k: jax.Array
     v: jax.Array

@@ -441,10 +441,11 @@ class TestSessionCache:
         from ray_dynamic_batching_tpu.engine.decode import SessionCache
         sc = SessionCache(capacity=2)
         z = jnp.zeros((1,))
-        sc.store("a", z, z, np.asarray([1, 2], np.int32))
-        sc.store("b", z, z, np.asarray([3, 4], np.int32))
+        seg = (z, z, None, None)  # _extract_row_impl's (k, v, ks, vs)
+        sc.store("a", seg, np.asarray([1, 2], np.int32))
+        sc.store("b", seg, np.asarray([3, 4], np.int32))
         assert sc.lookup("a", np.asarray([1, 2, 5], np.int32)) is not None
-        sc.store("c", z, z, np.asarray([5, 6], np.int32))  # evicts b
+        sc.store("c", seg, np.asarray([5, 6], np.int32))  # evicts b
         assert sc.lookup("b", np.asarray([3, 4, 5], np.int32)) is None
         assert len(sc) == 2
         # Exact-length (no tail) and non-prefix lookups miss.

@@ -223,9 +223,7 @@ class TestDecodeParity:
         with pytest.raises(ValueError, match="kv_dtype"):
             dep._ensure_model()
 
-    def test_quantized_cache_rejects_row_reuse_features(self):
-        """The prefix/session row-copy paths do not carry scales yet —
-        enabling them with an int8 cache must fail loudly, not corrupt."""
+    def _int8_engine(self, **kwargs):
         from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
         from ray_dynamic_batching_tpu.engine.queue import RequestQueue
         from ray_dynamic_batching_tpu.models.base import get_model
@@ -234,10 +232,82 @@ class TestDecodeParity:
         model = get_model("llama_tiny", dtype=jnp.float32,
                           kv_dtype=jnp.int8)
         params = model.init(jax.random.PRNGKey(0))
-        with pytest.raises(ValueError, match="scales"):
-            DecodeEngine(model, params, RequestQueue("llama_tiny"),
-                         num_slots=2, max_len=32, prompt_buckets=[8],
-                         session_cache_size=4)
+        queue = RequestQueue("llama_tiny", max_len=32)
+        defaults = dict(num_slots=2, max_len=96, prompt_buckets=[8],
+                        default_max_new_tokens=5)
+        defaults.update(kwargs)
+        return DecodeEngine(model, params, queue, **defaults), queue
+
+    @staticmethod
+    def _submit(queue, prompt, **payload):
+        import numpy as np
+        from ray_dynamic_batching_tpu.engine.request import Request
+
+        req = Request(
+            model="llama_tiny",
+            payload={"tokens": np.asarray(prompt, np.int32), **payload},
+            slo_ms=60_000.0,
+        )
+        queue.add_request(req)
+        return req
+
+    def test_session_continuation_with_quantized_cache(self):
+        """Multi-turn chat over an int8 cache: the stored row's SCALE
+        planes must ride the extract/seed round trip — turn 2 continues
+        from the quantized row and matches a sessionless int8 engine on
+        the full history."""
+        sess, q1 = self._int8_engine(session_cache_size=4)
+        plain, q2 = self._int8_engine()
+        turn1 = [(i * 7) % 50 + 1 for i in range(6)]
+        r1 = self._submit(q1, turn1, max_new_tokens=5,
+                          session_id="chat-1")
+        sess.run_until_idle(timeout_s=120)
+        gen1 = r1.future.result(timeout=5).tokens
+        # the stored segment carries its scale planes
+        (seg, _hist) = next(iter(sess.session_cache._entries.values()))
+        assert seg[2] is not None and seg[3] is not None
+        turn2 = turn1 + gen1 + [17, 23, 29]
+        from tests.test_decode import count_chunk_dispatches
+
+        chunk_calls = count_chunk_dispatches(sess)
+        r2 = self._submit(q1, turn2, max_new_tokens=5,
+                          session_id="chat-1")
+        ref = self._submit(q2, turn2, max_new_tokens=5)
+        sess.run_until_idle(timeout_s=120)
+        plain.run_until_idle(timeout_s=120)
+        # the REUSE path ran: only the 4-token tail (one chunk) was
+        # prefilled — a silent cache miss would re-chunk the whole
+        # 14-token history (2+ chunks) and still match tokens.
+        assert len(chunk_calls) == 1, chunk_calls
+        assert (r2.future.result(timeout=5).tokens
+                == ref.future.result(timeout=5).tokens)
+
+    def test_prefix_cache_with_quantized_cache(self):
+        """Shared-prefix reuse over an int8 cache: the cached chunk's
+        codes AND scales seed the second admission, which must match a
+        prefix-cache-off int8 engine exactly."""
+        shared = [(i * 7) % 50 + 1 for i in range(8)]  # = chunk width
+        p1 = shared + [(i * 3) % 40 + 1 for i in range(10)]
+        p2 = shared + [(i * 11) % 40 + 1 for i in range(7)]
+        cached, q1 = self._int8_engine(max_len=64, prefix_cache_size=4)
+        plain, q2 = self._int8_engine(max_len=64)
+        from tests.test_decode import count_chunk_dispatches
+
+        chunk_calls = count_chunk_dispatches(cached)
+        r1 = self._submit(q1, p1, max_new_tokens=4)
+        cached.run_until_idle(timeout_s=120)
+        first_calls = len(chunk_calls)  # miss: all 3 chunks computed
+        (entry,) = cached.prefix_cache._entries.values()
+        assert entry[2] is not None and entry[3] is not None
+        r2 = self._submit(q1, p2, max_new_tokens=4)
+        cached.run_until_idle(timeout_s=120)
+        # the hit skipped chunk 0: p2 (15 tokens, 2 chunks) paid one.
+        assert len(chunk_calls) - first_calls == 1, chunk_calls
+        for p, r in ((p1, r1), (p2, r2)):
+            ref = self._submit(q2, p, max_new_tokens=4)
+            plain.run_until_idle(timeout_s=120)
+            assert r.future.result(timeout=5).tokens == \
+                ref.future.result(timeout=5).tokens
 
     def test_engine_under_pallas_backend_matches_xla_backend(self):
         """The quantized cache must serve equivalent streams whether the

@@ -230,13 +230,6 @@ class TestLlama8BRealConfig:
         assert got == expect
 
 
-@pytest.mark.slow
-@pytest.mark.timeout(3600)
-@pytest.mark.skipif(
-    os.environ.get("RDB_RUN_8B") != "1",
-    reason="full-size Llama-3-8B int8 decode: ~40 GB host RAM and tens of "
-    "minutes of single-core CPU compute — opt in with RDB_RUN_8B=1",
-)
 def _run_8b_int8_deployment(name: str, **dep_kwargs):
     """Shared mechanics of the real-size int8 8B proofs: host init +
     weight quantize (the exact bench_llama3_8b flow), HBM-fit assert,
@@ -277,6 +270,13 @@ def _run_8b_int8_deployment(name: str, **dep_kwargs):
     return replica.engine
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(3600)
+@pytest.mark.skipif(
+    os.environ.get("RDB_RUN_8B") != "1",
+    reason="full-size Llama-3-8B int8 decode: ~40 GB host RAM and tens of "
+    "minutes of single-core CPU compute — opt in with RDB_RUN_8B=1",
+)
 class TestLlama8BInt8:
     """The OTHER 8B serving mode (BASELINE.json config 4 / VERDICT r3 #3a):
     single-device decode with int8 weight-only quantization at the real
