@@ -1340,22 +1340,12 @@ class DecodeEngine:
     def _seed_prefix_impl(self, row_cache, pk, pv, pks, pvs):
         """Copy a cached prefix segment into positions [0, C) of a fresh
         row cache — the HBM-copy replacement for recomputing chunk 0.
-        ``pks``/``pvs`` are the segment's scale planes (int8 caches) or
-        None; a quantized row reconstructed without them would be
-        garbage, so the segment tuple carries them everywhere."""
-        C = pk.shape[2]
-        k = jax.lax.dynamic_update_slice(row_cache.k, pk, (0, 0, 0, 0, 0))
-        v = jax.lax.dynamic_update_slice(row_cache.v, pv, (0, 0, 0, 0, 0))
-        lengths = jnp.full_like(row_cache.lengths, C)
-        scales = {}
-        if pks is not None:
-            scales = {
-                "k_scale": jax.lax.dynamic_update_slice(
-                    row_cache.k_scale, pks, (0, 0, 0, 0)),
-                "v_scale": jax.lax.dynamic_update_slice(
-                    row_cache.v_scale, pvs, (0, 0, 0, 0)),
-            }
-        return row_cache.replace(k=k, v=v, lengths=lengths, **scales)
+        One seed implementation serves both reuse paths (a parallel copy
+        here once dropped the scale planes): the prefix segment's valid
+        length is simply its width."""
+        return self._seed_session_impl(
+            row_cache, pk, pv, pks, pvs, pk.shape[2]
+        )
 
     def _extract_prefix_impl(self, row_cache, width: int):
         """Static slice of the first ``width`` cache positions (the just-
