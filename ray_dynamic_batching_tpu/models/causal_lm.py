@@ -342,6 +342,16 @@ def _llama_tiny(**kwargs) -> CausalLM:
     return CausalLM(TINY_LM, name="llama_tiny", **kwargs)
 
 
+@register_model("llama_tiny_int8kv")
+def _llama_tiny_int8kv(**kwargs) -> CausalLM:
+    """llama_tiny with the int8 KV cache — a DISTINCT registry name so
+    its decode/prefill tables land beside (not over) the bf16 ones:
+    quantized engines must plan from tables measured at their own cache
+    dtype (plan_from_tables docstring)."""
+    kwargs.setdefault("kv_dtype", jnp.int8)
+    return CausalLM(TINY_LM, name="llama_tiny_int8kv", **kwargs)
+
+
 @register_model("moe_tiny")
 def _moe_tiny(**kwargs) -> CausalLM:
     return CausalLM(TINY_MOE, name="moe_tiny", **kwargs)

@@ -257,6 +257,27 @@ class TestCommittedMultiModelTables:
             )
             assert plan["num_slots"] in {r.batch_size for r in table.rows}
 
+    def test_int8_engine_plans_from_its_own_committed_table(self):
+        """The quantized-cache variant has its OWN committed tables
+        (profiles/cpu/llama_tiny_int8kv_*): an int8 deployment plans
+        from measurements taken at its cache dtype, closing the
+        'bf16 tables are conservative' loop with real files."""
+        table = self.load("llama_tiny_int8kv")
+        cap = max(r.seq_len for r in table.rows)
+        dep = LLMDeployment(
+            "llama_tiny_int8kv", dtype=jnp.float32, warmup=False,
+            max_len=cap, profiles_dir=self.PROFILES_DIR,
+        )
+        plan = dep.plan_from_tables(
+            table,
+            token_slo_ms=100.0 * max(r.latency_ms for r in table.rows),
+            max_len=cap,
+        )
+        assert plan["num_slots"] in {r.batch_size for r in table.rows}
+        # the deployment's engine really is int8-quantized
+        dep._ensure_model()
+        assert jnp.dtype(dep._model.kv_dtype) == jnp.dtype(jnp.int8)
+
     def test_pack_llm_engines_across_committed_models(self):
         from ray_dynamic_batching_tpu.scheduler.nexus import (
             LLMSession,

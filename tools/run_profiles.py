@@ -70,6 +70,11 @@ CPU_DECODE_PLAN = [
     # against real committed files, not unit fixtures (VERDICT r4 weak
     # #5). Small buckets: gpt2_medium fp32 CPU steps are ~100ms-scale.
     ("gpt2_medium", (2, 4), (128,), (16,), (1, 2)),
+    # Quantized-cache variant: int8 engines must plan from tables
+    # measured at THEIR cache dtype (bf16 tables are conservative —
+    # plan_from_tables docstring); a committed int8 table makes that
+    # loop real-file end to end.
+    ("llama_tiny_int8kv", (2, 4, 8), (64,), (8, 16), (1, 2)),
 ]
 
 
