@@ -35,10 +35,10 @@ CPU_MEASURED = {
     # tools/run_profiles.py --cpu profiles/cpu (round 5): per-model sweep
     # seconds summed from the run log.
     "profiles": {
-        "seconds": 1346,
-        "source": "round-5 run: resnet50 227s + shufflenet 183s + "
+        "seconds": 1426,
+        "source": "round-5 runs: resnet50 227s + shufflenet 183s + "
                   "vit 553s + llama_tiny decode 50s + gpt2_medium "
-                  "decode 333s",
+                  "decode 333s + llama_tiny_int8kv decode 80s",
     },
     # tools/run_slo_demo.py --cpu (60s serving + plan + drain).
     "slo_demo": {
@@ -67,9 +67,10 @@ CPU_MEASURED = {
                   "+ ~60s saturation + ~15s Poisson phase",
     },
     "bench": {
-        "seconds": 1800,
+        "seconds": 2300,
         "source": "estimate: 8B host-quantize path 1159s (measured, "
-                  "round 4) + LLM/vision/ASR rows + compiles",
+                  "round 4) + LLM row + int8-KV LLM variant + "
+                  "vision/ASR rows + compiles",
     },
     # tools/run_kernel_ab.py: 5 geometries x 2 backends, one compile
     # each (~40s worst on chip) + 3x20-iter timed loops + parity fetch.
@@ -89,6 +90,12 @@ STEP_CAPS = {
     "llm_demo": wd.LLM_DEMO_TIMEOUT_S,
     "kernel_ab": wd.KERNEL_AB_TIMEOUT_S,
 }
+
+
+def _cum_min(rows, step_name: str) -> int:
+    return round(next(
+        r["cumulative_expected_s"] for r in rows if r["step"] == step_name
+    ) / 60)
 
 
 def main() -> int:
@@ -133,14 +140,18 @@ def main() -> int:
             "expected_total_human": f"{cum_expected / 60:.0f} min",
             "worst_case_total_s": cum_cap,
             "worst_case_total_human": f"{cum_cap / 3600:.1f} h",
+            # Computed from the rows above — a hand-written total here
+            # drifted from its own file twice.
             "note": (
                 "Steps commit independently the moment they verify "
                 "(pathspec-scoped), so a window of length T yields every "
                 "step whose cumulative expected time <= T; the "
                 "llm-scoped bench (north-star serving row + ttft "
-                "breakdown) lands within ~11 min of the relay "
-                "answering, the full bench (vision/ASR/guarded 8B row) "
-                "within ~41 min."
+                "breakdown) lands within "
+                f"~{_cum_min(rows, 'bench_llm')} min of the relay "
+                "answering, the full bench (int8-KV variant + vision/"
+                f"ASR/guarded 8B rows) within ~{_cum_min(rows, 'bench')} "
+                "min."
             ),
         },
     }
