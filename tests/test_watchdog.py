@@ -308,6 +308,24 @@ class TestLLMDemoCapture:
         assert "LLM colocation" not in _git(repo, "log", "--oneline")
 
 
+class TestDeadline:
+    def test_deadline_stands_down_before_touching_the_chip(
+            self, sandbox, monkeypatch):
+        """Past the deadline the vigil must exit WITHOUT probing: the
+        watchdog outlives the builder session, and even a probe holding
+        the chip when the round-end driver benches would zero that
+        record."""
+        import sys as _sys
+
+        wd, repo = sandbox
+        probed = []
+        monkeypatch.setattr(wd, "probe",
+                            lambda *a, **k: probed.append(1) or True)
+        monkeypatch.setattr(_sys, "argv", ["wd", "--deadline-ts", "1.0"])
+        assert wd.main() == 0
+        assert probed == []
+
+
 PARTIAL_SWEEP_STUB = """\
 import os, sys
 print('backend=tpu devices=[FakeTpu]')

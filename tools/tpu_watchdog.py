@@ -459,12 +459,25 @@ def main() -> int:
                     help="seconds between probes while the relay is dead")
     ap.add_argument("--once", action="store_true",
                     help="single probe+capture attempt, then exit")
+    ap.add_argument("--deadline-ts", type=float, default=None,
+                    help="unix time after which the watchdog starts no "
+                    "new probe or step and exits — the watchdog outlives "
+                    "the builder session, and a capture (or even a probe) "
+                    "still holding the chip when the round-end driver "
+                    "runs its own bench would zero THAT record")
     args = ap.parse_args()
 
     done = {name: False for name, _ in STEPS}
     attempts = {name: 0 for name, _ in STEPS}
     probes = 0
     _log(f"watchdog started (pid {os.getpid()})")
+
+    def past_deadline() -> bool:
+        if args.deadline_ts is not None and time.time() > args.deadline_ts:
+            _log("deadline reached — standing down so the round-end "
+                 "driver gets the chip to itself")
+            return True
+        return False
 
     def pending(name: str) -> bool:
         return not done[name] and attempts[name] < MAX_ATTEMPTS
@@ -474,6 +487,9 @@ def main() -> int:
                        "attempts": attempts, "pid": os.getpid(), **extra})
 
     while True:
+        if past_deadline():
+            status(False, stood_down=True)
+            return 0
         probes += 1
         alive = probe()
         status(alive)
@@ -482,6 +498,9 @@ def main() -> int:
             for name, fn in STEPS:
                 if not pending(name):
                     continue
+                if past_deadline():
+                    status(True, stood_down=True)
+                    return 0
                 attempts[name] += 1
                 try:
                     done[name] = fn()
