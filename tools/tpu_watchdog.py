@@ -511,6 +511,11 @@ def main() -> int:
                     done[name] = False
                 status(True)
                 if not done[name]:
+                    if past_deadline():
+                        # The health RE-PROBE below touches the chip too
+                        # — past the deadline nothing may.
+                        status(True, stood_down=True)
+                        return 0
                     # Full-length probe: a 60 s bound can time out on a
                     # slow-but-alive relay (fresh JAX init + first
                     # compile), and a false "dead" here would refund the
@@ -540,8 +545,13 @@ def main() -> int:
             return 0 if all(done.values()) else 1
         # A step that failed while the relay stayed ALIVE gets retried after
         # a short breather, not the full dead-relay interval: alive tunnel
-        # time is the scarce resource this tool exists to exploit.
-        time.sleep(15.0 if alive else args.interval)
+        # time is the scarce resource this tool exists to exploit. The
+        # sleep never overshoots the deadline — the stand-down (and its
+        # status record) must not lag by up to a whole interval.
+        wait = 15.0 if alive else args.interval
+        if args.deadline_ts is not None:
+            wait = min(wait, max(0.0, args.deadline_ts - time.time()))
+        time.sleep(wait)
 
 
 if __name__ == "__main__":
