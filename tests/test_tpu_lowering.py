@@ -187,6 +187,18 @@ class TestRegisteredDecodersLowerForTPU:
                              with_mask=True, require_engaged=False)
 
 
+class TestDriverEntryLowersForTPU:
+    def test_entry_program_lowers(self):
+        """__graft_entry__.entry() is the program the round-end driver
+        compile-checks ON THE REAL CHIP — it must lower for TPU from the
+        CPU lane too, so a breakage is caught before the driver finds
+        it."""
+        import __graft_entry__ as graft
+
+        fn, args = graft.entry()
+        export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
 class TestFlashKernelLowersForTPU:
     def test_prefill_bucket(self):
         _lower_flash(1, 512, 16, 64, 512, 16)
