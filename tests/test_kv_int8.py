@@ -361,6 +361,50 @@ class TestDecodeParity:
         total = sum(len(t) for t in got_x)
         assert agree >= int(0.75 * total), f"{agree}/{total} tokens agree"
 
+    def test_colocated_int8_engines_serve_together(self):
+        """Two quantized engines share one chip through the colocation
+        executor (deficit-weighted turns treat engines opaquely — this
+        pins the cross-feature path actually serving)."""
+        import numpy as np
+        from ray_dynamic_batching_tpu.engine.colocate import (
+            ColocatedLLMEngines,
+        )
+        from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+        from ray_dynamic_batching_tpu.engine.request import Request
+        from ray_dynamic_batching_tpu.models.base import get_model
+        from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+
+        model = get_model("llama_tiny", dtype=jnp.float32,
+                          kv_dtype=jnp.int8)
+        params = model.init(jax.random.PRNGKey(0))
+        ex = ColocatedLLMEngines(name="int8chip")
+        reqs = []
+        try:
+            for name in ("a", "b"):
+                q = RequestQueue(name, max_len=32)
+                e = DecodeEngine(model, params, q, num_slots=2,
+                                 max_len=32, prompt_buckets=[8],
+                                 default_max_new_tokens=5,
+                                 decode_horizon=1)
+                assert e._cache.quantized
+                ex.attach(name, e, None)
+                r = Request(model=name,
+                            payload={"tokens": np.asarray([1, 2, 3],
+                                                          np.int32),
+                                     "max_new_tokens": 5},
+                            slo_ms=600_000.0)
+                q.add_request(r)
+                reqs.append(r)
+            for _ in range(300):
+                ex.step_once()
+                if all(r.future.done() for r in reqs):
+                    break
+            for r in reqs:
+                assert len(r.future.result(timeout=5).tokens) == 5
+        finally:
+            ex.shutdown()
+
     def test_tp_mesh_shards_scale_planes(self):
         """make_sharded_cache must shard the quantized cache's scale
         planes alongside k/v (a hand-listed constructor dropped them
