@@ -78,11 +78,15 @@ def sandbox(tmp_path, monkeypatch):
     (repo / "tools" / "run_kernel_ab.py").write_text(
         "import json, os, sys\n"
         "out = sys.argv[1]\n"
+        "name = (sys.argv[sys.argv.index('--out-name') + 1]\n"
+        "        if '--out-name' in sys.argv else 'kernel_ab.json')\n"
+        "only = (sys.argv[sys.argv.index('--only') + 1].split(',')\n"
+        "        if '--only' in sys.argv else [])\n"
         "os.makedirs(out, exist_ok=True)\n"
         "backend = os.environ.get('STUB_AB_BACKEND', 'tpu')\n"
-        "open(os.path.join(out, 'kernel_ab.json'), 'w').write(\n"
+        "open(os.path.join(out, name), 'w').write(\n"
         "    json.dumps({'backend': backend, 'median_speedup': 1.4,\n"
-        "                'all_parity_ok': True}))\n"
+        "                'only': only, 'all_parity_ok': True}))\n"
         "sys.exit(1 if backend == 'cpu' else 0)\n"
     )
     (repo / "README").write_text("sandbox\n")
@@ -409,6 +413,18 @@ class TestPartialSweepSalvage:
 
 
 class TestKernelABCapture:
+    def test_first_light_commits_quick_record(self, sandbox):
+        """The first-light step commits a distinct quick record from the
+        pinned two geometries — the shortest window's ground truth."""
+        wd, repo = sandbox
+        assert wd.capture_first_light() is True
+        rec = json.loads(_git(
+            repo, "show", "HEAD:profiles/tpu_v5e/kernel_ab_quick.json"
+        ))
+        assert rec["backend"] == "tpu"
+        assert rec["only"] == ["bench_llm_row_gpt2m",
+                               "bench_llm_row_int8kv"]
+
     def test_kernel_ab_capture_commits_record(self, sandbox):
         wd, repo = sandbox
         assert wd.capture_kernel_ab() is True

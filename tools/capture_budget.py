@@ -59,6 +59,12 @@ CPU_MEASURED = {
     # its dominant rows are bounded by round-4 measurements: the 8B row's
     # host-init+quantize path ran in 1159s standalone (ROUND4_NOTES),
     # LLM Poisson phases are ~60s, vision sweeps + ASR a few minutes.
+    # run_kernel_ab --only <2 geometries>: ~4 compiles + timed loops.
+    "first_light": {
+        "seconds": 200,
+        "source": "estimate: 4 compiles at ~40s + seconds of timed "
+                  "loops + parity fetches",
+    },
     # bench.py RDB_BENCH_SCOPE=llm: engine build + warmup compiles +
     # saturation + Poisson phases only.
     "bench_llm": {
@@ -72,17 +78,18 @@ CPU_MEASURED = {
                   "round 4) + LLM row + int8-KV LLM variant + "
                   "vision/ASR rows + compiles",
     },
-    # tools/run_kernel_ab.py: 5 geometries x 2 backends, one compile
+    # tools/run_kernel_ab.py: 7 geometries x 2 backends, one compile
     # each (~40s worst on chip) + 3x20-iter timed loops + parity fetch.
     "kernel_ab": {
-        "seconds": 480,
-        "source": "estimate: 10 compiles at ~40s dominate; timed loops "
+        "seconds": 640,
+        "source": "estimate: 14 compiles at ~40s dominate; timed loops "
                   "are milliseconds-scale per step",
     },
 }
 
 
 STEP_CAPS = {
+    "first_light": wd.FIRST_LIGHT_TIMEOUT_S,
     "bench_llm": wd.BENCH_LLM_TIMEOUT_S,
     "bench": wd.BENCH_TIMEOUT_S,
     "profiles": wd.PROFILES_TIMEOUT_S,

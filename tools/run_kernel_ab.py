@@ -17,8 +17,12 @@ completion), checks max-abs parity between the two backends on the same
 inputs, and writes one JSON record.
 
 Usage: python tools/run_kernel_ab.py [out_dir] [--iters N]
-Writes <out_dir>/kernel_ab.json (default profiles/tpu_v5e) and prints
-one JSON summary line. Exit 0 on success, 1 on failure/CPU backend.
+                                     [--only tag1,tag2] [--out-name F]
+Writes <out_dir>/<F> (default kernel_ab.json in profiles/tpu_v5e) and
+prints one JSON summary line. ``--only`` restricts to named geometries
+— the watchdog's first-light step uses it to convert a 3-4 minute
+relay window into committed timings. Exit 0 only when EVERY selected
+geometry succeeded on a non-CPU backend.
 """
 
 from __future__ import annotations
@@ -80,6 +84,21 @@ def main() -> int:
     iters = 20
     if "--iters" in sys.argv:
         iters = int(sys.argv[sys.argv.index("--iters") + 1])
+    geometries = GEOMETRIES
+    if "--only" in sys.argv:
+        # First-light mode: a couple of geometries (~2 compiles each)
+        # convert even a 3-4 minute relay window into committed on-chip
+        # ground truth before the longer steps get their chance.
+        tags = set(sys.argv[sys.argv.index("--only") + 1].split(","))
+        geometries = [g for g in GEOMETRIES if g[0] in tags]
+        if not geometries:
+            # Not assert: under -O an unmatched tag would run ZERO
+            # geometries, exit 0, and commit an empty record as
+            # verified ground truth.
+            raise SystemExit(f"--only matched nothing: {tags}")
+    out_name = "kernel_ab.json"
+    if "--out-name" in sys.argv:
+        out_name = sys.argv[sys.argv.index("--out-name") + 1]
 
     import jax
     import jax.numpy as jnp
@@ -89,7 +108,7 @@ def main() -> int:
 
     backend = jax.default_backend()
     rows = []
-    for tag, B, Tq, N, H, S, K, int8_kv in GEOMETRIES:
+    for tag, B, Tq, N, H, S, K, int8_kv in geometries:
         ks = jax.random.split(jax.random.PRNGKey(0), 4)
         q = jax.random.normal(ks[0], (B, Tq, N, H), jnp.bfloat16)
         k = jax.random.normal(ks[1], (B, S, K, H), jnp.bfloat16)
@@ -151,7 +170,7 @@ def main() -> int:
             [r["speedup"] for r in ok_rows]), 3) if ok_rows else None,
     }
     os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(out_dir, "kernel_ab.json")
+    path = os.path.join(out_dir, out_name)
     with open(path, "w") as f:
         json.dump(record, f, indent=1)
         f.write("\n")

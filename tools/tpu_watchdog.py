@@ -11,16 +11,17 @@ a real op with a hard timeout proves liveness), and the moment the relay
 answers it runs the full capture suite, committing records into
 ``profiles/tpu_v5e/`` after every successful step:
 
-1. ``bench.py`` (llm scope)     -> ``profiles/tpu_v5e/bench_llm_<ts>.json``
-   (north-star row only, ~8 min: short flap windows still convert into
-   the #1 missing artifact)
-2. ``bench.py``                 -> ``profiles/tpu_v5e/bench_<ts>.json``
-3. ``tools/run_profiles.py``    -> ``profiles/tpu_v5e/*_summary.csv`` etc.
+1. first-light kernel A/B       -> ``profiles/tpu_v5e/kernel_ab_quick.json``
+   (2 geometries, ~3 min: even the shortest window leaves ground truth)
+2. ``bench.py`` (llm scope)     -> ``profiles/tpu_v5e/bench_llm_<ts>.json``
+   (north-star row only, ~8 min)
+3. ``bench.py``                 -> ``profiles/tpu_v5e/bench_<ts>.json``
+4. ``tools/run_profiles.py``    -> ``profiles/tpu_v5e/*_summary.csv`` etc.
    (a sweep interrupted by a flap commits each completed model's tables
    and the retry ``--skip``s past exactly those)
-4. ``tools/run_slo_demo.py``    -> ``profiles/tpu_v5e/slo_demo.json``
-5. ``tools/run_llm_demo.py``    -> ``profiles/tpu_v5e/llm_demo.json``
-6. ``tools/run_kernel_ab.py``   -> ``profiles/tpu_v5e/kernel_ab.json``
+5. ``tools/run_slo_demo.py``    -> ``profiles/tpu_v5e/slo_demo.json``
+6. ``tools/run_llm_demo.py``    -> ``profiles/tpu_v5e/llm_demo.json``
+7. ``tools/run_kernel_ab.py``   -> ``profiles/tpu_v5e/kernel_ab.json``
 
 Guard rails (each one a way a dead-or-flapping relay could otherwise
 poison the committed ground truth):
@@ -73,8 +74,10 @@ SLO_TIMEOUT_S = 30 * 60.0
 # weight init + engine warmup compiles (disk-cache hits after the
 # profiles step) + the post-run drain.
 LLM_DEMO_TIMEOUT_S = 20 * 60.0
-# 5 geometries x 2 backends, one compile each (~40s worst) + timed loops.
+# 7 geometries x 2 backends, one compile each (~40s worst) + timed loops.
 KERNEL_AB_TIMEOUT_S = 15 * 60.0
+# First-light: 2 geometries x 2 backends.
+FIRST_LIGHT_TIMEOUT_S = 8 * 60.0
 MAX_ATTEMPTS = 4             # per step, while the relay is alive
 
 # A matmul plus a HOST FETCH (block_until_ready alone returns early on the
@@ -443,7 +446,24 @@ def capture_kernel_ab() -> bool:
     )
 
 
+def capture_first_light() -> bool:
+    """FIRST capture of any window: two A/B geometries (~4 compiles,
+    ~3 min) so even a flap window too short for the llm bench converts
+    into committed on-chip ground truth — decode-attention timings at
+    the bench's own geometry, bf16 and int8-KV."""
+    return _capture_demo(
+        "first_light",
+        [sys.executable, "tools/run_kernel_ab.py", "profiles/tpu_v5e",
+         "--only", "bench_llm_row_gpt2m,bench_llm_row_int8kv",
+         "--out-name", "kernel_ab_quick.json"],
+        FIRST_LIGHT_TIMEOUT_S, "kernel_ab_quick.json",
+        f"tpu_v5e: first-light on-chip kernel timings {_now()}",
+        ok_rcs=(0,),
+    )
+
+
 STEPS = [
+    ("first_light", capture_first_light),
     ("bench_llm", capture_bench_llm),
     ("bench", capture_bench),
     ("profiles", capture_profiles),
