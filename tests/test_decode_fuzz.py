@@ -35,16 +35,31 @@ def lm():
     return model, params
 
 
+@pytest.fixture(scope="module")
+def lm_int8(lm):
+    # Same weights, quantized cache: the int8 fuzz must differ from the
+    # bf16 one ONLY in KV storage.
+    _, params = lm
+    model = get_model("llama_tiny", dtype=jnp.float32, kv_dtype=jnp.int8)
+    return model, params
+
+
 @pytest.mark.timeout(900)
-@pytest.mark.parametrize("backend,n_requests", [
-    ("xla", 40),
+@pytest.mark.parametrize("backend,n_requests,int8_kv", [
+    ("xla", 40, False),
     # The Pallas window kernel under the SAME randomized feature matrix
     # (interpret mode on CPU): plain scans, speculative windows, chunked
     # admissions, sessions — shapes the parity tests don't enumerate.
     # Smaller scale: interpret mode multiplies per-dispatch cost.
-    ("pallas", 12),
+    ("pallas", 12, False),
+    # The int8 KV cache under the full matrix: quantized scatter in
+    # every write path, scale planes through prefix/session reuse and
+    # speculative verify — interactions no pairwise pin enumerates.
+    ("xla", 24, True),
+    ("pallas", 10, True),
 ])
-def test_feature_matrix_fuzz(lm, backend, n_requests):
+def test_feature_matrix_fuzz(lm, lm_int8, backend, n_requests, int8_kv):
+    lm = lm_int8 if int8_kv else lm
     import contextlib
 
     from ray_dynamic_batching_tpu.ops.attention import (
