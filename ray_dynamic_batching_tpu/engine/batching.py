@@ -22,7 +22,8 @@ import abc
 from typing import List, Optional
 
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
-from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.engine.request import Request, now_ms
+from ray_dynamic_batching_tpu.utils.tracing import link_to, tracer
 
 
 class BatchPolicy(abc.ABC):
@@ -78,11 +79,32 @@ class OpportunisticBatch(BatchPolicy):
     def next_batch(self, queue: RequestQueue) -> List[Request]:
         # Blocks on the queue's condition variable; deadline anchored at the
         # FIRST request's arrival, not at poll time.
+        wait_start = now_ms()
         queue.wait_for_batch(self.max_batch_size, self.batch_wait_timeout_s)
-        return queue.get_batch(
+        batch = queue.get_batch(
             self.max_batch_size,
             expected_latency_ms=self.expected_latency_ms,
         )
+        if batch and tracer().enabled:
+            # Membership decision as its own span: how long the size-or-
+            # timeout discipline held the batch open, linked to every
+            # member request (fan-in — parent/child cannot express it).
+            # Start is clamped to the FIRST member's enqueue: idle-queue
+            # time before any request existed is not formation hold.
+            first_in = min(
+                (r.enqueue_ms or r.arrival_ms) for r in batch
+            )
+            tracer().record_span(
+                "batch.form",
+                start_ms=max(wait_start, first_in),
+                end_ms=now_ms(),
+                links=[link_to(r.trace_ctx) for r in batch],
+                policy=self.describe(),
+                model=queue.model,
+                lane=queue.model,
+                size=len(batch),
+            )
+        return batch
 
     def describe(self) -> str:
         return (

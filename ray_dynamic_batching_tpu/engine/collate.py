@@ -22,6 +22,7 @@ import numpy as np
 
 from ray_dynamic_batching_tpu.engine.request import Request
 from ray_dynamic_batching_tpu.models.base import ServableModel
+from ray_dynamic_batching_tpu.utils.tracing import link_to, tracer
 
 
 def collate_vision(
@@ -79,6 +80,26 @@ def collate(
     requests: List[Request],
     batch_bucket: int,
     seq_bucket: int = 0,
+) -> Tuple[Tuple[np.ndarray, ...], int]:
+    if not tracer().enabled:  # keep the disabled hot path allocation-free
+        return _collate(model, requests, batch_bucket, seq_bucket)
+    with tracer().span(
+        "collate.batch",
+        links=[link_to(r.trace_ctx) for r in requests],
+        model=model.name,
+        lane=model.name,
+        family=model.family,
+        batch_bucket=batch_bucket,
+        n=len(requests),
+    ):
+        return _collate(model, requests, batch_bucket, seq_bucket)
+
+
+def _collate(
+    model: ServableModel,
+    requests: List[Request],
+    batch_bucket: int,
+    seq_bucket: int,
 ) -> Tuple[Tuple[np.ndarray, ...], int]:
     if model.family == "vision":
         return collate_vision(model, requests, batch_bucket)

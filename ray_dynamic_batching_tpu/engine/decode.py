@@ -66,6 +66,7 @@ from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.profiles.table import bucket_up
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import link_to as _link_to
 from ray_dynamic_batching_tpu.utils.tracing import tracer as _tracer
 
 logger = get_logger("decode")
@@ -1602,7 +1603,10 @@ class DecodeEngine:
         PREFILLS_TOTAL.inc(tags={"model": self.model.name})
         if opts.get("_session_miss"):
             SESSION_MISSES.inc(tags={"model": self.model.name})
-        TTFT_MS.observe(t - req.arrival_ms, tags={"model": self.model.name})
+        TTFT_MS.observe(
+            t - req.arrival_ms, tags={"model": self.model.name},
+            trace_id=(req.trace_ctx or {}).get("trace_id"),
+        )
         admit_ms = getattr(req, "admit_ms", None) or t
         queue_wait = max(0.0, admit_ms - req.arrival_ms)
         # The share of queue_wait spent inside the decode scan that was in
@@ -1616,6 +1620,20 @@ class DecodeEngine:
         )
         TTFT_QUEUE_MS.observe(queue_wait, tags={"model": self.model.name})
         TTFT_PREFILL_MS.observe(prefill_ms, tags={"model": self.model.name})
+        if _tracer().enabled:
+            # Retroactive prefill span (admit -> first token) in the
+            # request's trace: with the queue.wait span the pop emitted,
+            # the flight record now shows the full TTFT decomposition.
+            _tracer().record_span(
+                "decode.prefill",
+                ctx=req.trace_ctx,
+                start_ms=admit_ms,
+                end_ms=t,
+                model=self.model.name,
+                lane=self.model.name,
+                queue_wait_ms=round(queue_wait, 2),
+                scan_wait_ms=round(min(scan_wait, queue_wait), 2),
+            )
         req.stream_put(first_tok)
         # First token may already satisfy the stop conditions.
         if self._is_stop(slot, first_tok) or max_new <= 1:
@@ -1742,6 +1760,29 @@ class DecodeEngine:
             )
         return self._sampling_dev
 
+    def _record_turn_span(self, horizon: int, active_mask,
+                          spec: bool = False) -> None:
+        """One retroactive span per decode scan (dispatch -> host fetch),
+        linked to every sequence that was active in it: continuous
+        batching's fan-in, the decode analogue of the batch-execution
+        span. Bounded by num_slots links per turn."""
+        links = [
+            _link_to(slot.request.trace_ctx)
+            for i, slot in enumerate(self._slots)
+            if active_mask[i] and slot.request is not None
+        ]
+        _tracer().record_span(
+            "decode.turn",
+            start_ms=self._scan_start_ms,
+            end_ms=self._scan_end_ms,
+            links=links,
+            model=self.model.name,
+            lane=self.model.name,
+            horizon=int(horizon),
+            active=int(active_mask.sum()),
+            spec=spec,
+        )
+
     def _use_spec(self) -> bool:
         """Speculative rounds serve all-greedy batches only: sampled rows
         need rejection sampling for exactness, so any temperature>0 row
@@ -1777,6 +1818,8 @@ class DecodeEngine:
         )
         ph = np.asarray(packed)  # ONE fetch per round
         self._scan_end_ms = now_ms()
+        if _tracer().enabled:
+            self._record_turn_span(k, self._active_mask, spec=True)
         out = ph[: k + 1]        # [k+1, B]
         n_out = ph[k + 1]        # [B]
         lengths = ph[k + 2]      # [B]
@@ -1833,6 +1876,8 @@ class DecodeEngine:
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch
         self._scan_end_ms = now_ms()
+        if _tracer().enabled and active_at_dispatch.any():
+            self._record_turn_span(h, active_at_dispatch)
         toks_host = packed_host[:h]               # [h, B]
         advanced_host = packed_host[h : 2 * h].astype(bool)   # [h, B]
         lengths_host = packed_host[2 * h]         # [B] (post-horizon)

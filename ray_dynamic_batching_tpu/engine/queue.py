@@ -27,6 +27,7 @@ from ray_dynamic_batching_tpu.engine.request import (
     now_ms,
 )
 from ray_dynamic_batching_tpu.utils.metrics import RollingWindow
+from ray_dynamic_batching_tpu.utils.tracing import tracer
 
 SLO_WINDOW = 200  # completions tracked for compliance stats (ref :324)
 
@@ -70,6 +71,7 @@ class RequestQueue:
                         )
                     )
                 return False
+            request.enqueue_ms = now_ms()
             self._q.append(request)
             self.total_enqueued += 1
             self._not_empty.notify()
@@ -99,12 +101,29 @@ class RequestQueue:
                     continue
                 out.append(req)
             self.total_stale += len(stale)
+            depth_after = len(self._q)
         for req in stale:
             req.reject(
                 RequestStale(
                     f"{req.request_id}: deadline missed before execution"
                 )
             )
+        if out and tracer().enabled:
+            # Retroactive queue-wait span per popped request: enqueue ->
+            # this pop, joined to the request's trace (the recorder's
+            # "where did the milliseconds go" hop between routing and
+            # batch execution).
+            pop_ms = now_ms()
+            for req in out:
+                tracer().record_span(
+                    "queue.wait",
+                    ctx=req.trace_ctx,
+                    start_ms=req.enqueue_ms or req.arrival_ms,
+                    end_ms=pop_ms,
+                    model=self.model,
+                    lane=self.model,
+                    depth_after=depth_after,
+                )
         return out
 
     def wait_for_requests(self, timeout_s: float) -> bool:

@@ -156,6 +156,10 @@ class Request:
     # (TTFT = [arrival..admit: queue/scan wait] + [admit..first token:
     # prefill]); None until an engine admits it.
     admit_ms: Optional[float] = None
+    # Stamped by RequestQueue.add_request on the LAST enqueue (a request can
+    # be requeued on router retry / slot starvation): the queue-wait span
+    # measures from here, not from arrival — routing time is its own hop.
+    enqueue_ms: Optional[float] = None
     seq_len: int = 0                  # shape bucket hint for LLM inputs
     future: Future = field(default_factory=Future)
     trace_ctx: Dict[str, Any] = field(default_factory=dict)

@@ -40,6 +40,7 @@ from ray_dynamic_batching_tpu.engine.request import Request
 from ray_dynamic_batching_tpu.scheduler.nexus import NodePlan, Placement
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import link_to, tracer
 
 logger = get_logger("engine")
 
@@ -254,16 +255,33 @@ class ReplicaEngine:
         if not batch:
             return 0.0
         t0 = time.perf_counter()
+        # One compiled-step span per batch execution, tagged with the bucket
+        # the program was compiled for and LINKED to every member request's
+        # span (the fan-in parent/child cannot express); each member then
+        # gets a completion span linking BACK, so both directions navigate.
+        traced = tracer().enabled
+        member_links = [link_to(r.trace_ctx) for r in batch] if traced else None
+        step_start_ms = m.now_ms() if traced else 0.0
         try:
-            inputs, n_real = collate(
-                step.model, batch, step.batch_bucket, step.seq_bucket
-            )
-            out = step.fn(step.params, *inputs)
-            # np.asarray forces the device->host fetch, which is the only
-            # reliable completion signal on the axon tunnel (block_until_ready
-            # returns early there); the engine needs the results host-side
-            # anyway to fulfill futures.
-            results = np.asarray(out)[:n_real]
+            with tracer().span(
+                "engine.step",
+                links=member_links,
+                model=name,
+                engine=self.engine_id,
+                lane=self.engine_id,
+                batch_bucket=step.batch_bucket,
+                seq_bucket=step.seq_bucket,
+                n=len(batch),
+            ) as step_span:
+                inputs, n_real = collate(
+                    step.model, batch, step.batch_bucket, step.seq_bucket
+                )
+                out = step.fn(step.params, *inputs)
+                # np.asarray forces the device->host fetch, which is the only
+                # reliable completion signal on the axon tunnel
+                # (block_until_ready returns early there); the engine needs
+                # the results host-side anyway to fulfill futures.
+                results = np.asarray(out)[:n_real]
         except Exception as e:  # noqa: BLE001
             for req in batch:
                 req.reject(e)
@@ -273,11 +291,27 @@ class ReplicaEngine:
         elapsed_ms = (time.perf_counter() - t0) * 1000.0
         for req, res in zip(batch, results):
             req.fulfill(res)
+        if step_span is not None:
+            end_ms = m.now_ms()
+            for req in batch:
+                # Per-request execution span in the REQUEST's trace, linked
+                # to the batch step it rode.
+                tracer().record_span(
+                    "engine.request",
+                    ctx=req.trace_ctx,
+                    start_ms=step_start_ms,
+                    end_ms=end_ms,
+                    links=[link_to(step_span)],
+                    model=name,
+                    engine=self.engine_id,
+                    lane=self.engine_id,
+                )
         queue.record_batch_completion(batch)
         BATCHES_TOTAL.inc(tags={"engine": self.engine_id, "model": name})
         REQUESTS_TOTAL.inc(n_real, tags={"engine": self.engine_id, "model": name})
         STEP_LATENCY_MS.observe(
-            elapsed_ms, tags={"engine": self.engine_id, "model": name}
+            elapsed_ms, tags={"engine": self.engine_id, "model": name},
+            trace_id=step_span.trace_id if step_span is not None else None,
         )
         return elapsed_ms
 

@@ -27,6 +27,7 @@ from ray_dynamic_batching_tpu.engine.rates import RateRegistry
 from ray_dynamic_batching_tpu.engine.request import Request
 from ray_dynamic_batching_tpu.engine.worker import ReplicaEngine
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.scheduler.audit import AuditLog, plan_diff
 from ray_dynamic_batching_tpu.scheduler.nexus import (
     NodePlan,
     Session,
@@ -166,6 +167,9 @@ class LiveScheduler:
         self._stop = threading.Event()
         self.schedule_changes = 0
         self.schedule_log: List[Dict] = []
+        # Structured replan ring: trigger, observed rates, profile rows
+        # consulted, old->new diff, migration cost (scheduler/audit.py).
+        self.audit = AuditLog("nexus")
 
     # --- registration (ref models_config) ---------------------------------
     def register_model(self, name: str, slo_ms: float, seq_len: int = 0) -> None:
@@ -197,7 +201,11 @@ class LiveScheduler:
             for e in self._models.values()
         ]
 
-    def rebalance(self, rates: Optional[Dict[str, float]] = None) -> List[NodePlan]:
+    def rebalance(
+        self,
+        rates: Optional[Dict[str, float]] = None,
+        trigger: str = "manual",
+    ) -> List[NodePlan]:
         """Re-run bin packing and migrate with minimal movement
         (ref _update_schedule, scheduler.py:834-929)."""
         with self._lock:
@@ -208,6 +216,18 @@ class LiveScheduler:
             ]
             assignment = match_plans_to_engines(
                 engine_models, plan, self.packer.profiles
+            )
+            # Audit inputs BEFORE applying: the old assignment and the
+            # per-engine cost of moving to the new one (the matcher's own
+            # objective — compile_ms + weight-MB for models not resident).
+            old_models = [sorted(m) for m in engine_models]
+            new_models = [
+                sorted(n.models) if n is not None else [] for n in assignment
+            ]
+            migration_cost = sum(
+                transfer_cost(engine_models[e], n, self.packer.profiles)
+                for e, n in enumerate(assignment)
+                if n is not None
             )
             for engine, node_plan in zip(self.engines, assignment):
                 if node_plan is not None:
@@ -225,6 +245,25 @@ class LiveScheduler:
                     "nodes": [n.describe() for n in plan],
                 }
             )
+            self.audit.record(
+                trigger,
+                observed={"rates_rps": {k: round(v, 2)
+                                        for k, v in rates.items()}},
+                inputs={
+                    # The profile rows the packer committed to: per
+                    # placement, the (batch, latency) row that sized it.
+                    "placements": [
+                        {"model": p.session.model, "batch": p.batch_size,
+                         "latency_ms": round(p.latency_ms, 2),
+                         "occupancy": round(p.occupancy, 3)}
+                        for n in plan for p in n.placements
+                    ],
+                },
+                before=[", ".join(m) for m in old_models],
+                after=[", ".join(m) for m in new_models],
+                diff=plan_diff(old_models, new_models),
+                migration_cost=round(migration_cost, 1),
+            )
             logger.info(
                 "rebalance #%d: %d nodes for rates %s",
                 self.schedule_changes, len(plan),
@@ -241,7 +280,7 @@ class LiveScheduler:
                 )
                 if changed:
                     logger.info("rate change detected: %s", changed)
-                    self.rebalance()
+                    self.rebalance(trigger="rate_change")
                 if self.metrics_path:
                     self.write_metrics()
             except Exception:  # noqa: BLE001
@@ -272,6 +311,7 @@ class LiveScheduler:
             "plan": [n.describe() for n in self._current_plan],
             "engines": [e.describe() for e in self.engines],
             "schedule_changes": self.schedule_changes,
+            "audit": self.audit.to_dicts(last=20),
         }
 
     def write_metrics(self) -> None:

@@ -35,6 +35,7 @@ from ray_dynamic_batching_tpu.engine.queue import QueueManager, RequestQueue
 from ray_dynamic_batching_tpu.engine.rates import RateRegistry
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.scheduler.audit import AuditLog, plan_diff
 from ray_dynamic_batching_tpu.scheduler.nexus import (
     LLMPlacement,
     LLMSession,
@@ -134,6 +135,9 @@ class LLMLiveScheduler:
         # the survivors.
         self.chip_stall_timeout_s = 120.0
         self.schedule_log: List[Dict] = []
+        # Structured replan ring (scheduler/audit.py): every decode-plane
+        # decision — rate-triggered replans, quarantines, health rebuilds.
+        self.audit = AuditLog("llm")
 
     # --- registration ------------------------------------------------------
     def register_model(
@@ -216,7 +220,9 @@ class LLMLiveScheduler:
         return assignment
 
     def rebalance(
-        self, rates: Optional[Dict[str, float]] = None
+        self,
+        rates: Optional[Dict[str, float]] = None,
+        trigger: str = "manual",
     ) -> List[List[LLMPlacement]]:
         """Re-run colocation packing and migrate with minimal movement."""
         with self._lock:
@@ -252,6 +258,12 @@ class LLMLiveScheduler:
                 # rather than tearing engines down (the SLO viewer shows
                 # red; the operator re-profiles or relaxes).
                 logger.warning("rebalance infeasible, keeping plan: %s", e)
+                self.audit.record(
+                    trigger,
+                    observed={"rates_tok_s": {k: round(v, 1)
+                                              for k, v in rates.items()}},
+                    note=f"infeasible, kept previous plan: {e}",
+                )
                 return self._current_plan
             if len(plan) > len(self.chips):
                 if self._current_plan:
@@ -265,6 +277,14 @@ class LLMLiveScheduler:
                         "keeping previous plan (capacity!)",
                         len(plan), len(self.chips),
                     )
+                    self.audit.record(
+                        trigger,
+                        observed={"rates_tok_s": {
+                            k: round(v, 1) for k, v in rates.items()}},
+                        note=(f"over capacity ({len(plan)} chips needed, "
+                              f"{len(self.chips)} available), kept "
+                              "previous plan"),
+                    )
                     return self._current_plan
                 # Nothing is serving yet (first plan): a truncated plan
                 # that serves len(chips) chips' worth of models beats an
@@ -276,11 +296,35 @@ class LLMLiveScheduler:
                 )
                 plan = plan[: len(self.chips)]
             assignment = self._match_chips(plan)
+            hosted_before = [sorted(c.models()) for c in self.chips]
             moved = self._apply(assignment)
+            hosted_after = [
+                sorted(p.model for p in (chip or [])) for chip in assignment
+            ]
             self._current_plan = plan
             self.rates.mark_scheduled(rates)
             self.schedule_changes += 1
             self.migrations += moved
+            self.audit.record(
+                trigger,
+                observed={"rates_tok_s": {k: round(v, 1)
+                                          for k, v in rates.items()}},
+                inputs={
+                    # The committed decode-table rows the packer sized from.
+                    "placements": [
+                        {"model": p.model, "slots": p.num_slots,
+                         "capacity": p.capacity,
+                         "compute_fraction": round(p.compute_fraction, 3)}
+                        for chip in plan for p in chip
+                    ],
+                },
+                before=[", ".join(m) for m in hosted_before],
+                after=[", ".join(m) for m in hosted_after],
+                diff=plan_diff(hosted_before, hosted_after),
+                # Every engine move costs a weight upload + compiles; the
+                # moved count is the decode plane's migration cost unit.
+                migration_cost=float(moved),
+            )
             self.schedule_log.append({
                 "ts": self._clock(),
                 "rates_tok_s": {k: round(v, 1) for k, v in rates.items()},
@@ -440,6 +484,18 @@ class LLMLiveScheduler:
                     chip.replace(model, successor, placement)
                     replaced += 1
                     self.engine_replacements += 1
+                    self.audit.record(
+                        "health",
+                        key=model,
+                        observed={
+                            "stalled_s": round(
+                                now - engine.last_heartbeat, 1),
+                            "chip": chip.name,
+                        },
+                        diff={"engine_rebuilt": model},
+                        migration_cost=1.0,
+                        note="stalled engine with work rebuilt in place",
+                    )
         return replaced
 
     def _quarantine_wedged_chips(self, now: float) -> None:
@@ -470,6 +526,19 @@ class LLMLiveScheduler:
             self.chips.remove(chip)
             self.quarantined.append(chip)
             self.chip_quarantines += 1
+            self.audit.record(
+                "quarantine",
+                observed={
+                    "chip": chip.name,
+                    "stalled_s": round(
+                        now - chip.last_pass_monotonic, 1),
+                },
+                diff={"chip_quarantined": chip.name,
+                      "models_displaced": sorted(
+                          m for m, _ in chip.hosted_engines())},
+                note="wedged executor — HBM written off, models replanned "
+                     "onto survivors",
+            )
             # EVERY resident engine, draining predecessors included —
             # their drains can never finish on a wedged chip, and their
             # slots hold real futures too.
@@ -497,7 +566,7 @@ class LLMLiveScheduler:
             # survives (truncated if need be).
             self._current_plan = []
             if self.chips:
-                self.rebalance()
+                self.rebalance(trigger="quarantine")
 
     # --- monitor loop ------------------------------------------------------
     def _monitor_loop(self) -> None:
@@ -514,7 +583,7 @@ class LLMLiveScheduler:
                 if changed:
                     logger.info("token-rate change detected: %s",
                                 {k: round(v, 1) for k, v in changed.items()})
-                    self.rebalance()
+                    self.rebalance(trigger="rate_change")
                 if self.metrics_path:
                     self.write_metrics()
             except Exception:  # noqa: BLE001
@@ -565,6 +634,7 @@ class LLMLiveScheduler:
             "engine_replacements": self.engine_replacements,
             "chip_quarantines": self.chip_quarantines,
             "quarantined": [c.name for c in self.quarantined],
+            "audit": self.audit.to_dicts(last=20),
         }
 
     def write_metrics(self) -> None:

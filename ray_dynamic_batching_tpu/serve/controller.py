@@ -31,6 +31,7 @@ from ray_dynamic_batching_tpu.parallel.placement import (
     PlacementManager,
 )
 from ray_dynamic_batching_tpu.runtime.kv import KVStore
+from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
 from ray_dynamic_batching_tpu.serve.autoscaling import (
     AutoscalingConfig,
     AutoscalingPolicy,
@@ -145,6 +146,9 @@ class ServeController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_checkpoint: Optional[str] = None
+        # Structured decision ring (scheduler/audit.py): deploys, scale
+        # moves, heals, rollouts — surfaced per deployment in status().
+        self.audit = AuditLog("serve")
 
     # --- deploy API (ref serve.run / deploy) ------------------------------
     def register_factory(
@@ -221,6 +225,15 @@ class ServeController:
                 )
             else:
                 state.policy = None  # autoscaling removed -> pin num_replicas
+            self.audit.record(
+                "deploy",
+                key=config.name,
+                before={"replicas": len(state.replicas)},
+                after={"replicas": config.num_replicas,
+                       "version": config.version},
+                diff={"target_replicas": config.num_replicas,
+                      "version": config.version},
+            )
             deferred = self._reconcile(state)
             self._checkpoint()
         for action in deferred:  # blocking stops run outside the lock
@@ -236,6 +249,13 @@ class ServeController:
             state.replicas = []
             self._publish(state)
             self._checkpoint()
+            self.audit.record(
+                "delete",
+                key=name,
+                before={"replicas": len(victims)},
+                after={"replicas": 0},
+                diff={"stopped": [r.replica_id for r in victims]},
+            )
         for r in victims:  # blocking drains outside the lock
             r.stop()
             self._release_chips(state, r)
@@ -396,6 +416,19 @@ class ServeController:
                         self._redeliver(reqs, t or state.replicas, vid)
                     )
                 )
+            self.audit.record(
+                "heal",
+                key=cfg.name,
+                observed={"unhealthy": r.replica_id,
+                          "salvaged_requests": len(salvaged)},
+                diff={
+                    "replaced": r.replica_id,
+                    "replacement": (replacement.replica_id
+                                    if replacement is not None else None),
+                },
+                note=("" if replacement is not None
+                      else "restart budget exhausted or start failed"),
+            )
         state.replicas = alive
         # Rolling update (ref deployment_state.py rollout): while replicas
         # with a DIFFERENT version stamp exist, retire them in batches of
@@ -426,6 +459,13 @@ class ServeController:
                         victim.replica_id,
                         getattr(victim, "version", ""), cfg.version,
                     )
+                    self.audit.record(
+                        "rolling_update",
+                        key=cfg.name,
+                        before={"version": getattr(victim, "version", "")},
+                        after={"version": cfg.version},
+                        diff={"retired": victim.replica_id},
+                    )
                     victim._stopped = True  # stale handles stop assigning
                     # Same salvage discipline as the heal path: queued
                     # (unstarted) requests move to surviving/new replicas
@@ -452,6 +492,7 @@ class ServeController:
         # crash-loop: no replacements until a fresh deploy() resets it
         # (ref gcs_actor_manager.cc:1361-1393 — actors stay DEAD once
         # max_restarts is spent).
+        n_before_scale = len(state.replicas)
         while len(state.replicas) < cfg.num_replicas and not state.unhealthy:
             try:
                 state.replicas.append(self._start_replica(state))
@@ -470,6 +511,15 @@ class ServeController:
                     v.stop(),
                     self._release_chips(st, v),
                 )
+            )
+        if len(state.replicas) != n_before_scale:
+            self.audit.record(
+                "scale",
+                key=cfg.name,
+                observed={"target": cfg.num_replicas},
+                before={"replicas": n_before_scale},
+                after={"replicas": len(state.replicas)},
+                diff={"delta": len(state.replicas) - n_before_scale},
             )
         # Publish only on membership change: every publish clears the
         # router's queue-len cache, so steady-state reconciles must be quiet.
@@ -595,6 +645,11 @@ class ServeController:
                     "versions": dict(collections.Counter(
                         getattr(r, "version", "") for r in state.replicas
                     )),
+                    # Recent control-plane decisions about THIS deployment
+                    # (deploys, scale moves, heals, rollouts) from the
+                    # structured audit ring — filtered BEFORE slicing so a
+                    # busy co-deployed app cannot evict this one's view.
+                    "audit": self.audit.to_dicts(key=name, last=10),
                 }
                 for name, state in self._deployments.items()
             }

@@ -35,6 +35,13 @@ _PAGE = """<!doctype html>
  th { background: #222; }
  .ok { color: #7c4; } .warning { color: #fb3; } .CRITICAL { color: #f55; }
  h2 { color: #8ac; }
+ .timeline { position: relative; height: 22px; margin: 0.5em 0;
+             background: #1a1a1a; border: 1px solid #444; }
+ .tl { position: absolute; top: 2px; font-size: 14px; cursor: default; }
+ .tl.rate_change { color: #fb3; } .tl.quarantine, .tl.heal { color: #f55; }
+ .tl.manual, .tl.deploy, .tl.scale { color: #7c4; }
+ .tl.rolling_update, .tl.health { color: #8ac; }
+ td.diff { max-width: 40em; overflow-wrap: anywhere; }
 </style></head>
 <body>
 <h1>ray_dynamic_batching_tpu</h1>
@@ -81,6 +88,42 @@ async function tick() {
       }
       html += '</table>';
     }
+    const audit = s.audit ?? [];
+    if (audit.length) {
+      // Replan timeline: one marker per decision, positioned by wall time
+      // over the window the ring covers, colored by trigger.
+      html += '<h2>scheduler audit (replans &amp; control decisions)</h2>';
+      const t0 = audit[0].wall_time, t1 = audit[audit.length - 1].wall_time;
+      const span = Math.max(1e-9, t1 - t0);
+      html += '<div class="timeline">' + audit.map(a => {
+        const left = ((a.wall_time - t0) / span * 97).toFixed(2);
+        const tip = `${new Date(a.wall_time * 1000).toLocaleTimeString()} `
+                  + `${a.domain}/${a.trigger} ${a.key ?? ''}`;
+        return `<span class="tl ${esc(a.trigger)}" style="left:${left}%"`
+             + ` title="${esc(tip)}">&#9679;</span>`;
+      }).join('') + '</div>';
+      html += '<table><tr><th>time</th><th>domain</th><th>trigger</th>'
+            + '<th>key</th><th>cost</th><th>old &rarr; new</th></tr>';
+      for (const a of audit.slice(-12).reverse()) {
+        const d = a.diff ?? {};
+        let change;
+        if (d.engines_changed !== undefined) {
+          change = Object.entries(d.engines_changed).map(([e, c]) =>
+            `engine${e}: [${(c.old ?? []).join(' ')}] → `
+            + `[${(c.new ?? []).join(' ')}]`).join('; ')
+            || 'no movement';
+        } else {
+          change = Object.entries(d).map(([k, v]) =>
+            `${k}=${JSON.stringify(v)}`).join(', ') || (a.note ?? '');
+        }
+        html += `<tr><td>${new Date(a.wall_time * 1000).toLocaleTimeString()}`
+              + `</td><td>${esc(a.domain)}</td><td>${esc(a.trigger)}</td>`
+              + `<td>${esc(a.key ?? '')}</td>`
+              + `<td>${(a.migration_cost ?? 0).toFixed(1)}</td>`
+              + `<td class="diff">${esc(change)}</td></tr>`;
+      }
+      html += '</table>';
+    }
     document.getElementById('root').innerHTML = html || 'no state yet';
   } catch (e) {
     document.getElementById('root').innerHTML = 'fetch failed: ' + esc(e);
@@ -122,10 +165,22 @@ class DashboardServer:
                         ).encode()
                         self._send(200, body, "application/json")
                     elif self.path == "/metrics":
-                        self._send(
-                            200, dashboard.state.metrics_text().encode(),
-                            "text/plain; version=0.0.4",
-                        )
+                        # Same negotiation as the proxy: exemplars only on
+                        # the OpenMetrics grammar.
+                        accept = self.headers.get("Accept", "") or ""
+                        if "application/openmetrics-text" in accept:
+                            self._send(
+                                200,
+                                dashboard.state.registry
+                                .openmetrics_text().encode(),
+                                "application/openmetrics-text; "
+                                "version=1.0.0; charset=utf-8",
+                            )
+                        else:
+                            self._send(
+                                200, dashboard.state.metrics_text().encode(),
+                                "text/plain; version=0.0.4",
+                            )
                     elif self.path == "/-/healthz":
                         self._send(200, b"ok", "text/plain")
                     else:

@@ -28,6 +28,7 @@ from ray_dynamic_batching_tpu.engine.request import StreamClosed
 from ray_dynamic_batching_tpu.serve.proxy import ProxyRouter, _to_jsonable
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import parse_traceparent, tracer
 
 logger = get_logger("grpc_proxy")
 
@@ -88,11 +89,21 @@ class GRPCProxy:
         if handle is None:
             GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "NOT_FOUND"})
             context.abort(grpc.StatusCode.NOT_FOUND, err)
-        future = handle.remote(
-            body.get("payload"),
-            slo_ms=body.get("slo_ms"),
-            multiplexed_model_id=body.get("multiplexed_model_id"),
-        )
+        # Ingest span for the gRPC front door; a ``traceparent`` field in
+        # the JSON body (the generic-handler transport has no per-call
+        # metadata plumbing here) joins the caller's trace. Dispatch
+        # happens inside the span so the routed request inherits it; the
+        # result wait is accounted by the proxy-side future timeout.
+        with tracer().attach_context(
+            parse_traceparent(body.get("traceparent")),
+            "grpc.predict",
+            lane="grpc", deployment=body.get("deployment"),
+        ):
+            future = handle.remote(
+                body.get("payload"),
+                slo_ms=body.get("slo_ms"),
+                multiplexed_model_id=body.get("multiplexed_model_id"),
+            )
         timeout = self._budget(context)
         try:
             result = future.result(timeout=timeout)
@@ -132,9 +143,14 @@ class GRPCProxy:
                 tags={"method": "PredictStream", "code": "NOT_FOUND"}
             )
             context.abort(grpc.StatusCode.NOT_FOUND, err)
-        stream, future = handle.remote_stream(
-            body.get("payload"), slo_ms=body.get("slo_ms")
-        )
+        with tracer().attach_context(
+            parse_traceparent(body.get("traceparent")),
+            "grpc.predict_stream",
+            lane="grpc", deployment=body.get("deployment"),
+        ):
+            stream, future = handle.remote_stream(
+                body.get("payload"), slo_ms=body.get("slo_ms")
+            )
         # One budget covers the WHOLE stream (chunks + trailer), so a
         # stalled replica can't pin a worker thread for 2x the timeout.
         deadline = time.monotonic() + self._budget(context)

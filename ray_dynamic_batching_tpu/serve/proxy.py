@@ -32,11 +32,16 @@ from ray_dynamic_batching_tpu.engine.request import BadRequest
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import parse_traceparent, tracer
 
 logger = get_logger("proxy")
 
 PROXY_REQUESTS = m.Counter(
     "rdb_proxy_requests_total", "HTTP requests", tag_keys=("route", "code")
+)
+PROXY_LATENCY_MS = m.Histogram(
+    "rdb_proxy_request_latency_ms", "End-to-end HTTP request latency",
+    tag_keys=("route",),
 )
 
 MAX_BODY_BYTES = 64 * 1024 * 1024
@@ -229,6 +234,7 @@ class HTTPProxy:
     async def _handle_one(
         self, method: str, path: str, body: bytes,
         writer: Optional[asyncio.StreamWriter] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[Optional[bytes], str]:
         if method == "GET" and path == "/-/healthz":
             return self._response(200, {"status": "ok"}), "healthz"
@@ -236,6 +242,20 @@ class HTTPProxy:
             status = self.status_fn() if self.status_fn else {}
             return self._response(200, status), "status"
         if method == "GET" and path == "/metrics":
+            # Content negotiation: exemplars are OpenMetrics-only syntax —
+            # a classic Prometheus scraper gets the clean 0.0.4 text, a
+            # client Accept-ing application/openmetrics-text gets
+            # exemplars + `# EOF`.
+            accept = (headers or {}).get("accept", "")
+            if "application/openmetrics-text" in accept:
+                return (
+                    self._text_response(
+                        200, m.default_registry().openmetrics_text(),
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8",
+                    ),
+                    "metrics",
+                )
             return (
                 self._text_response(
                     200, m.default_registry().prometheus_text(),
@@ -297,7 +317,7 @@ class HTTPProxy:
                 req = await self._read_request(reader)
                 if req is None:
                     break
-                method, path, _headers, body = req
+                method, path, headers, body = req
                 if body is None:  # oversized: answer and drop the connection
                     resp = self._response(413, {"error": "body too large"},
                                           reason="Payload Too Large")
@@ -305,13 +325,32 @@ class HTTPProxy:
                     writer.write(resp)
                     await writer.drain()
                     break
-                resp, route = await self._handle_one(method, path, body, writer)
+                # The ingest span covers the whole hop (parse -> route ->
+                # await replica result). An inbound W3C ``traceparent``
+                # header joins the caller's trace; absent one, this span
+                # starts the trace every downstream hop inherits.
+                t_req = m.now_ms()
+                with tracer().attach_context(
+                    parse_traceparent(headers.get("traceparent")),
+                    "proxy.request",
+                    lane="http", method=method, path=path,
+                ) as psp:
+                    resp, route = await self._handle_one(
+                        method, path, body, writer, headers
+                    )
                 if resp is None:  # streamed: already written, tag holds code
                     route, _, code = route.rpartition("|")
-                    PROXY_REQUESTS.inc(tags={"route": route, "code": code})
-                    continue
-                code = resp.split(b" ", 2)[1].decode()
+                else:
+                    code = resp.split(b" ", 2)[1].decode()
+                if psp is not None:
+                    psp.attributes.update(route=route, code=code)
                 PROXY_REQUESTS.inc(tags={"route": route, "code": code})
+                PROXY_LATENCY_MS.observe(
+                    m.now_ms() - t_req, tags={"route": route},
+                    trace_id=psp.trace_id if psp is not None else None,
+                )
+                if resp is None:
+                    continue
                 writer.write(resp)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):

@@ -29,7 +29,7 @@ from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
 from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
-from ray_dynamic_batching_tpu.utils.tracing import tracer
+from ray_dynamic_batching_tpu.utils.tracing import link_to, tracer
 
 logger = get_logger("replica")
 
@@ -205,13 +205,30 @@ class Replica:
             chaos().maybe_fail("replica.process_batch")
             with ExitStack() as spans:
                 if tracer().enabled:
-                    # One execution span per request, joined to its caller's
-                    # trace via the propagated context (ref spans around
-                    # every actor call, tracing_helper.py:293).
+                    # One span for the BATCH execution, linked to every
+                    # member request's span (dynamic batching's fan-in:
+                    # parent/child cannot express N callers -> one step),
+                    # then one execution span per request joined to its
+                    # caller's trace via the propagated context (ref spans
+                    # around every actor call, tracing_helper.py:293) and
+                    # linked BACK to the batch span.
+                    batch_span = spans.enter_context(
+                        tracer().span(
+                            "replica.batch",
+                            links=[link_to(r.trace_ctx) for r in batch],
+                            deployment=self.deployment,
+                            replica=self.replica_id,
+                            lane=self.replica_id,
+                            size=len(batch),
+                        )
+                    )
                     for r in batch:
                         spans.enter_context(
                             tracer().attach_context(
-                                r.trace_ctx, "replica.execute"
+                                r.trace_ctx, "replica.execute",
+                                links=[link_to(batch_span)],
+                                replica=self.replica_id,
+                                lane=self.replica_id,
                             )
                         )
                 results = self.fn([r.payload for r in batch])

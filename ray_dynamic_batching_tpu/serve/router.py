@@ -24,6 +24,7 @@ from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import tracer
 
 logger = get_logger("router")
 
@@ -125,35 +126,51 @@ class Router:
     ) -> bool:
         """Route with pow-2 + backoff; reject after the assign timeout
         (ref fulfillment loop, pow_2_scheduler.py:673)."""
-        deadline = time.monotonic() + self.max_assign_timeout_s
-        backoff = BACKOFF_INITIAL_S
-        while True:
-            candidates = [r for r in self.replicas() if r.accepting()]
-            chosen = self._choose(
-                candidates, locality_hint, request.multiplexed_model_id
-            )
-            # chaos: a dropped assignment RPC — falls into the normal
-            # backoff/retry path, like a lost PushActorTask in the reference
-            # (only burns budget when there was a real assignment to drop)
-            if chosen is not None and chaos().should_fail("router.assign"):
-                chosen = None
-            if chosen is not None and chosen.assign(request):
-                # Invalidate the cache entry so bursts spread out.
-                self._len_cache.pop(chosen.replica_id, None)
-                self.total_routed += 1
-                ROUTED_TOTAL.inc(tags={"deployment": self.deployment})
-                return True
-            if time.monotonic() >= deadline:
-                ROUTER_REJECTED.inc(tags={"deployment": self.deployment})
-                request.reject(
-                    RequestDropped(
-                        f"{self.deployment}: no replica accepted within "
-                        f"{self.max_assign_timeout_s}s"
-                    )
+        # Assignment is its own traced hop: attempts > 1 means the request
+        # burned wall-clock in backoff against saturated replicas — the
+        # flight record shows that as router.assign duration, distinct
+        # from queue wait on the chosen replica.
+        with tracer().span(
+            "router.assign", deployment=self.deployment, lane=self.deployment
+        ) as sp:
+            attempts = 0
+            deadline = time.monotonic() + self.max_assign_timeout_s
+            backoff = BACKOFF_INITIAL_S
+            while True:
+                attempts += 1
+                candidates = [r for r in self.replicas() if r.accepting()]
+                chosen = self._choose(
+                    candidates, locality_hint, request.multiplexed_model_id
                 )
-                return False
-            time.sleep(backoff)
-            backoff = min(backoff * 2, BACKOFF_MAX_S)
+                # chaos: a dropped assignment RPC — falls into the normal
+                # backoff/retry path, like a lost PushActorTask in the
+                # reference (only burns budget when there was a real
+                # assignment to drop)
+                if chosen is not None and chaos().should_fail("router.assign"):
+                    chosen = None
+                if chosen is not None and chosen.assign(request):
+                    # Invalidate the cache entry so bursts spread out.
+                    self._len_cache.pop(chosen.replica_id, None)
+                    self.total_routed += 1
+                    ROUTED_TOTAL.inc(tags={"deployment": self.deployment})
+                    if sp is not None:
+                        sp.attributes.update(
+                            attempts=attempts, replica=chosen.replica_id
+                        )
+                    return True
+                if time.monotonic() >= deadline:
+                    ROUTER_REJECTED.inc(tags={"deployment": self.deployment})
+                    request.reject(
+                        RequestDropped(
+                            f"{self.deployment}: no replica accepted within "
+                            f"{self.max_assign_timeout_s}s"
+                        )
+                    )
+                    if sp is not None:
+                        sp.attributes.update(attempts=attempts, rejected=True)
+                    return False
+                time.sleep(backoff)
+                backoff = min(backoff * 2, BACKOFF_MAX_S)
 
     # --- autoscaler metrics (ref RouterMetricsManager) --------------------
     def demand_metrics(self) -> Dict[str, float]:

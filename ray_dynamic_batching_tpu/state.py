@@ -90,6 +90,20 @@ class StateAPI:
     def metrics_text(self) -> str:
         return self.registry.prometheus_text()
 
+    def list_audit(self, last: int = 50) -> List[Dict[str, Any]]:
+        """Merged control-plane decision log (newest last): the serve
+        controller's deploy/scale/heal/rollout records plus the scheduler's
+        replan records, ordered by wall time."""
+        out: List[Dict[str, Any]] = []
+        audit = getattr(self.controller, "audit", None)
+        if audit is not None:
+            out.extend(audit.to_dicts(last=last))
+        sched_audit = getattr(self.scheduler, "audit", None)
+        if sched_audit is not None:
+            out.extend(sched_audit.to_dicts(last=last))
+        out.sort(key=lambda r: r.get("wall_time", 0.0))
+        return out[-last:]
+
     def summary(self) -> Dict[str, Any]:
         good, warn = slo_thresholds()
         return {
@@ -99,6 +113,7 @@ class StateAPI:
             "scheduler": self.scheduler_snapshot(),
             "jobs": self.list_jobs(),
             "resources": self.resources(),
+            "audit": self.list_audit(),
             "slo_thresholds": {"good": good, "warn": warn},
         }
 
@@ -134,12 +149,41 @@ def render_queue_table(queues: Dict[str, Dict[str, float]],
     return "\n".join(lines)
 
 
+def render_audit_table(audit: List[Dict[str, Any]],
+                       last: int = 5) -> str:
+    """Recent scheduler/controller decisions, one line each (the terminal
+    face of the structured audit ring)."""
+    lines = [f"{'when':<10} {'domain':<6} {'trigger':<14} "
+             f"{'cost':>6} change"]
+    for rec in audit[-last:]:
+        diff = rec.get("diff") or {}
+        if "engines_changed" in diff:
+            change = "; ".join(
+                f"engine{e}: {c['old'] or ['-']} -> {c['new'] or ['-']}"
+                for e, c in diff["engines_changed"].items()
+            ) or "no movement"
+        else:
+            change = ", ".join(f"{k}={v}" for k, v in diff.items()) \
+                or rec.get("note", "")
+        when = time.strftime("%H:%M:%S",
+                             time.localtime(rec.get("wall_time", 0)))
+        lines.append(
+            f"{when:<10} {rec.get('domain', ''):<6} "
+            f"{rec.get('trigger', ''):<14} "
+            f"{rec.get('migration_cost', 0):>6.1f} {change}"
+        )
+    return "\n".join(lines)
+
+
 def render_snapshot(snap: Dict[str, Any]) -> str:
     parts = [render_queue_table(snap.get("queues", {}),
                                 snap.get("rates_rps", {}))]
     if snap.get("plan"):
         parts.append(f"plan: {len(snap['plan'])} node(s), "
                      f"{snap.get('schedule_changes', 0)} schedule change(s)")
+    if snap.get("audit"):
+        parts.append("recent replans:")
+        parts.append(render_audit_table(snap["audit"]))
     return "\n".join(parts)
 
 

@@ -46,7 +46,8 @@ class Metric:
                     f"declared: {sorted(self.tag_keys)}"
                 )
 
-    def _prom_lines(self) -> Iterable[str]:  # pragma: no cover - overridden
+    def _prom_lines(self, exemplars: bool = False) -> Iterable[str]:
+        # pragma: no cover - overridden
         return ()
 
 
@@ -69,7 +70,7 @@ class Counter(Metric):
         with self._lock:
             return self._values.get(_tags(tags), 0.0)
 
-    def _prom_lines(self) -> Iterable[str]:
+    def _prom_lines(self, exemplars: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.description}"
         yield f"# TYPE {self.name} counter"
         with self._lock:
@@ -102,7 +103,7 @@ class Gauge(Metric):
         with self._lock:
             return self._values.get(_tags(tags), 0.0)
 
-    def _prom_lines(self) -> Iterable[str]:
+    def _prom_lines(self, exemplars: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.description}"
         yield f"# TYPE {self.name} gauge"
         with self._lock:
@@ -115,8 +116,26 @@ DEFAULT_LATENCY_BOUNDARIES_MS = (
 )
 
 
+def _current_trace_id() -> Optional[str]:
+    """Trace id of the active span, if the tracer is recording (exemplar
+    auto-capture). Local import: metrics must stay importable before/without
+    the tracing module in degraded environments."""
+    try:
+        from ray_dynamic_batching_tpu.utils.tracing import tracer
+    except ImportError:  # pragma: no cover - only in stripped builds
+        return None
+    t = tracer()
+    return t.current_trace_id() if t.enabled else None
+
+
 class Histogram(Metric):
-    """Cumulative-bucket histogram (ref: util/metrics.py:187)."""
+    """Cumulative-bucket histogram (ref: util/metrics.py:187).
+
+    Buckets carry OpenMetrics **exemplars**: the last observation landing in
+    each bucket remembers the trace_id that produced it (from the active
+    span, or passed explicitly), so a slow ``/metrics`` bucket links
+    straight to the flight-record trace that landed in it.
+    """
 
     def __init__(
         self,
@@ -130,16 +149,31 @@ class Histogram(Metric):
         self._buckets: Dict[TagMap, list] = {}
         self._sum: Dict[TagMap, float] = {}
         self._count: Dict[TagMap, int] = {}
+        # Per (tags, bucket): (value, trace_id, unix_ts) of the most recent
+        # traced observation in that bucket.
+        self._exemplars: Dict[TagMap, list] = {}
 
-    def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+    def observe(
+        self,
+        value: float,
+        tags: Optional[Dict[str, str]] = None,
+        trace_id: Optional[str] = None,
+    ) -> None:
         self._check_tags(tags)
         key = _tags(tags)
         idx = bisect.bisect_left(self.boundaries, value)
+        if trace_id is None:
+            trace_id = _current_trace_id()
         with self._lock:
             buckets = self._buckets.setdefault(key, [0] * (len(self.boundaries) + 1))
             buckets[idx] += 1
             self._sum[key] = self._sum.get(key, 0.0) + value
             self._count[key] = self._count.get(key, 0) + 1
+            if trace_id:
+                ex = self._exemplars.setdefault(
+                    key, [None] * (len(self.boundaries) + 1)
+                )
+                ex[idx] = (value, trace_id, time.time())
 
     def percentile(self, p: float, tags: Optional[Dict[str, str]] = None) -> float:
         """Approximate percentile from bucket counts (upper bound of bucket)."""
@@ -157,19 +191,34 @@ class Histogram(Metric):
                 return self.boundaries[i] if i < len(self.boundaries) else float("inf")
         return float("inf")
 
-    def _prom_lines(self) -> Iterable[str]:
+    @staticmethod
+    def _exemplar_suffix(ex) -> str:
+        """OpenMetrics exemplar: `` # {trace_id="..."} value timestamp``."""
+        if ex is None:
+            return ""
+        value, trace_id, ts = ex
+        return f' # {{trace_id="{_escape_label(trace_id)}"}} {value} {ts:.3f}'
+
+    def _prom_lines(self, exemplars: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.description}"
         yield f"# TYPE {self.name} histogram"
         with self._lock:
             for key, buckets in self._buckets.items():
+                # Exemplar suffixes are OpenMetrics syntax — emitted only
+                # for OpenMetrics renders; the classic 0.0.4 text format
+                # (a stock Prometheus scraper) must stay suffix-free or
+                # the whole scrape fails to parse.
+                ex = self._exemplars.get(key) if exemplars else None
                 cum = 0
-                for b, c in zip(self.boundaries, buckets):
+                for i, (b, c) in enumerate(zip(self.boundaries, buckets)):
                     cum += c
                     t = key + (("le", str(b)),)
-                    yield f"{self.name}_bucket{_fmt_tags(t)} {cum}"
+                    yield (f"{self.name}_bucket{_fmt_tags(t)} {cum}"
+                           + self._exemplar_suffix(ex[i] if ex else None))
                 cum += buckets[-1]
                 t = key + (("le", "+Inf"),)
-                yield f"{self.name}_bucket{_fmt_tags(t)} {cum}"
+                yield (f"{self.name}_bucket{_fmt_tags(t)} {cum}"
+                       + self._exemplar_suffix(ex[-1] if ex else None))
                 yield f"{self.name}_sum{_fmt_tags(key)} {self._sum.get(key, 0.0)}"
                 yield f"{self.name}_count{_fmt_tags(key)} {self._count.get(key, 0)}"
 
@@ -241,11 +290,24 @@ class MetricsRegistry:
             return self._metrics.get(name)
 
     def prometheus_text(self) -> str:
+        """Classic Prometheus 0.0.4 text exposition (no exemplars)."""
         with self._lock:
             metrics = list(self._metrics.values())
         lines: list[str] = []
         for m in metrics:
             lines.extend(m._prom_lines())
+        return "\n".join(lines) + "\n"
+
+    def openmetrics_text(self) -> str:
+        """OpenMetrics exposition WITH exemplars and the `# EOF` trailer.
+        Served when the scraper negotiates ``application/openmetrics-text``
+        via Accept — only that grammar permits exemplar suffixes."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m._prom_lines(exemplars=True))
+        lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def clear(self) -> None:

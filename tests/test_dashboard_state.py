@@ -44,9 +44,14 @@ class TestStateAPI:
         summary = api.summary()
         assert set(summary) == {
             "deployments", "replicas", "queues", "scheduler", "jobs",
-            "resources", "slo_thresholds",
+            "resources", "audit", "slo_thresholds",
         }
         assert summary["slo_thresholds"] == {"good": 0.98, "warn": 0.95}
+        # The controller's decision ring surfaces: deploying 2 replicas
+        # recorded at least a deploy + a scale event for this deployment.
+        triggers = {a["trigger"] for a in summary["audit"]}
+        assert {"deploy", "scale"} <= triggers
+        assert deps[0]["audit"]  # per-deployment slice in status() too
 
     def test_empty_api(self):
         api = StateAPI()

@@ -102,6 +102,28 @@ class TestDecodeKernelLowersForTPU:
                 if 2 * 2 * S * kb * H * 2 > da.VMEM_BLOCK_BUDGET_BYTES:
                     assert sb < S
 
+    def test_sb_picker_pads_lane_dim_h64(self):
+        # VMEM budget must count the PADDED footprint on the lane dim too:
+        # Mosaic tiles VMEM in 128-lane units, so an H=64 K/V block
+        # occupies 128 lanes — budgeting raw H undercounts ~2x. The ADVICE
+        # geometry: bf16, S=1024, kb=16 (K=16), H=64 — the raw-H budget
+        # picked the whole-S tile (~8.4 MB budgeted, ~16.8 MB real,
+        # double-buffered); lane padding must reject it.
+        S, kb, H, itemsize = 1024, 16, 64, 2
+        sb = da._pick_sb(S, kb, H, itemsize, with_mask=True)
+        assert 0 < sb < S and S % sb == 0 and sb % 128 == 0
+        # Pin the padded math itself: the true double-buffered K/V block
+        # footprint at the chosen sb, with H padded to 128 lanes, must fit
+        # the budget — and the whole-S tile must not.
+        def padded_kv_bytes(tile):
+            lane_h = -(-H // 128) * 128   # 64 -> 128
+            return 2 * (2 * tile * kb * lane_h * itemsize)
+        assert padded_kv_bytes(sb) <= da.VMEM_BLOCK_BUDGET_BYTES
+        assert padded_kv_bytes(S) > da.VMEM_BLOCK_BUDGET_BYTES
+        # H=128 geometries were budgeted correctly before (lane-aligned):
+        # padding must not change their pick.
+        assert da._pick_sb(S, kb, 128, itemsize, True) == sb
+
     def test_sb_picker_honors_test_cap(self):
         # target caps the tile when a legal tile under it exists...
         assert da._pick_sb(256, 4, 64, 2, True, target=128) == 128
@@ -116,10 +138,12 @@ class TestDecodeKernelLowersForTPU:
             assert kb == K or kb % 8 == 0
 
     def test_int8_cache_codes_and_scales(self):
-        # int8 KV cache: codes + [B, S, K, 1]-reshaped scale blocks must
-        # lower (the (sb, kb) trailing-dims layout is ILLEGAL for kb < K
-        # — this pins the reshape fix). gpt2_medium (kb=8 < K=16) and
-        # llama GQA (kb == K) both covered.
+        # int8 KV cache: codes + scales transposed to [B, K, S] with
+        # (1, kb, sb) blocks must lower — trailing dims (kb, sb) are
+        # tile-legal (kb pads to 8 sublanes, sb is a 128-lane multiple),
+        # where the naive [B, S, K] layout's (sb, kb) trailing dims are
+        # ILLEGAL for kb < K. gpt2_medium (kb=8 < K=16) and llama GQA
+        # (kb == K) both covered.
         for (B, N, H, S, K) in ((8, 16, 64, 256, 16), (4, 32, 128, 512, 8)):
             q = jnp.zeros((B, 1, N, H), jnp.bfloat16)
             k = jnp.zeros((B, S, K, H), jnp.int8)

@@ -1,0 +1,271 @@
+"""End-to-end flight-recorder tests: one trace across the serving path,
+batch<->request span links, duration accounting, Chrome-trace export.
+
+Drives a real request through proxy -> handle -> router -> replica (batch
+execution) with the tracer enabled, and a second one through the
+queue -> NexusFixedBatch -> collate -> compiled-step engine path, then
+asserts the recorder's contract:
+
+(a) ONE trace id spans the whole path (honoring the client's traceparent),
+(b) the batch span links to every member request span (and members back),
+(c) hop durations nest inside the measured end-to-end latency,
+(d) the Chrome-trace export is valid JSON with the expected process/thread
+    lanes (the Perfetto shape).
+"""
+
+import http.client
+import json
+import time
+
+import numpy as np
+import pytest
+
+from ray_dynamic_batching_tpu.engine.batching import NexusFixedBatch
+from ray_dynamic_batching_tpu.engine.queue import QueueManager
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.serve import DeploymentHandle, Replica, Router
+from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
+from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import (
+    format_traceparent,
+    parse_traceparent,
+    tracer,
+)
+from ray_dynamic_batching_tpu.utils.trace_export import (
+    ChromeTraceCollector,
+    span_from_dict,
+    span_to_dict,
+    to_chrome_trace,
+    trace_summary,
+)
+
+CLIENT_TRACEPARENT = "00-" + "ab" * 16 + "-" + "12" * 8 + "-01"
+
+
+@pytest.fixture
+def collector():
+    c = ChromeTraceCollector()
+    tracer().set_exporter(c.export)
+    yield c
+    tracer().reset()
+
+
+def _spans_by_name(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s.name, []).append(s)
+    return out
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        ctx = parse_traceparent(CLIENT_TRACEPARENT)
+        assert ctx == {"trace_id": "ab" * 16,
+                       "parent_span_id": int("12" * 8, 16)}
+        assert format_traceparent(ctx) == CLIENT_TRACEPARENT
+
+    def test_malformed_headers_start_fresh(self):
+        for bad in (None, "", "zz", "00-short-bad-01",
+                    "ff-" + "ab" * 16 + "-" + "12" * 8 + "-01",
+                    # W3C-invalid all-zero ids: honoring them would merge
+                    # every unsampled client into one degenerate trace.
+                    "00-" + "0" * 32 + "-" + "12" * 8 + "-01",
+                    "00-" + "ab" * 16 + "-" + "0" * 16 + "-01"):
+            assert parse_traceparent(bad) == {}
+
+
+class TestServePathE2E:
+    """proxy -> handle -> router -> replica batch with a real HTTP hop."""
+
+    @pytest.fixture
+    def stack(self):
+        def fn(payloads):
+            time.sleep(0.002)  # a visible batch-execution duration
+            return [p * 2 for p in payloads]
+
+        replica = Replica("r0", "doubler", fn, max_batch_size=4,
+                          batch_wait_timeout_s=0.005)
+        replica.start()
+        router = Router("doubler", [replica])
+        handle = DeploymentHandle(router)
+        proxy_router = ProxyRouter()
+        proxy_router.set_route("/api/doubler", handle)
+        proxy = HTTPProxy(proxy_router, port=0, request_timeout_s=10.0)
+        proxy.start()
+        yield proxy
+        proxy.stop()
+        replica.stop()
+
+    def _post(self, port, payload, headers=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        t0 = time.monotonic()
+        conn.request("POST", "/api/doubler", json.dumps(payload),
+                     headers=headers or {})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        return resp.status, body, (time.monotonic() - t0) * 1000.0
+
+    def test_one_trace_spans_the_whole_path(self, collector, stack):
+        status, body, e2e_ms = self._post(
+            stack.port, 21, {"traceparent": CLIENT_TRACEPARENT}
+        )
+        assert status == 200 and body["result"] == 42
+
+        # Spans from the replica thread land asynchronously.
+        deadline = time.monotonic() + 5
+        want = {"proxy.request", "handle.remote", "router.assign",
+                "queue.wait", "replica.batch", "replica.execute"}
+        while time.monotonic() < deadline:
+            if want <= {s.name for s in collector.spans}:
+                break
+            time.sleep(0.01)
+        by_name = _spans_by_name(collector.spans)
+        assert want <= set(by_name), f"missing hops: {want - set(by_name)}"
+
+        # (a) the client's traceparent trace id reaches every request hop —
+        # >= 5 distinct hop spans in ONE trace.
+        client_trace = "ab" * 16
+        request_hops = ("proxy.request", "handle.remote", "router.assign",
+                        "queue.wait", "replica.execute")
+        for name in request_hops:
+            assert by_name[name][0].trace_id == client_trace, name
+        assert len(request_hops) >= 5
+
+        # (b) fan-in links both ways: the batch span links to the member
+        # request span, and the member's execute span links to the batch.
+        batch = by_name["replica.batch"][0]
+        handle_span = by_name["handle.remote"][0]
+        assert {"trace_id": client_trace, "span_id": handle_span.span_id} \
+            in batch.links
+        execute = by_name["replica.execute"][0]
+        assert {"trace_id": batch.trace_id, "span_id": batch.span_id} \
+            in execute.links
+
+        # (c) hop durations nest inside the measured end-to-end latency.
+        queue_wait = by_name["queue.wait"][0]
+        inner = queue_wait.duration_ms() + batch.duration_ms()
+        assert inner <= e2e_ms + 1.0, (inner, e2e_ms)
+        proxy_span = by_name["proxy.request"][0]
+        assert proxy_span.duration_ms() <= e2e_ms + 1.0
+        # The replica hops happened INSIDE the proxy window.
+        assert proxy_span.start_ms <= queue_wait.end_ms
+        assert batch.end_ms <= proxy_span.end_ms + 1.0
+
+        # Exemplar: the proxy latency histogram carries this trace id in
+        # the OpenMetrics render; the classic 0.0.4 text stays clean (a
+        # stock Prometheus scraper would fail the whole scrape on the
+        # suffix).
+        text = m.default_registry().openmetrics_text()
+        assert f'# {{trace_id="{client_trace}"}}' in text
+        assert text.rstrip().endswith("# EOF")
+        assert '# {trace_id="' not in m.default_registry().prometheus_text()
+
+    def test_chrome_export_lanes_and_flows(self, collector, stack):
+        status, _, _ = self._post(
+            stack.port, 1, {"traceparent": CLIENT_TRACEPARENT}
+        )
+        assert status == 200
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if {"replica.batch", "proxy.request"} <= {
+                s.name for s in collector.spans
+            }:
+                break
+            time.sleep(0.01)
+
+        # (d) export is valid JSON, with one process lane per component
+        # and thread lanes carrying the replica id.
+        doc = json.loads(json.dumps(collector.chrome_trace()))
+        events = doc["traceEvents"]
+        proc_names = {
+            e["args"]["name"] for e in events
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert {"proxy", "handle", "router", "queue", "replica"} <= proc_names
+        thread_names = {
+            e["args"]["name"] for e in events
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert "r0" in thread_names  # replica lane
+        xs = [e for e in events if e["ph"] == "X"]
+        assert all(e["dur"] >= 0 for e in xs)
+        assert any(e["name"] == "replica.batch" and e["args"].get("links")
+                   for e in xs)
+        # Link flow arrows come in matched s/f pairs.
+        starts = [e["id"] for e in events if e["ph"] == "s"]
+        finishes = [e["id"] for e in events if e["ph"] == "f"]
+        assert starts and sorted(starts) == sorted(finishes)
+
+
+class TestEnginePathSpans:
+    """queue -> NexusFixedBatch -> collate -> compiled step on a stub
+    vision model: the duty-cycle engine's side of the recorder."""
+
+    class _StubModel:
+        name = "stub_vision"
+        family = "vision"
+
+        def input_shapes(self, batch_size, seq_len=None):
+            import jax
+            return (jax.ShapeDtypeStruct((batch_size, 2, 2, 1), np.float32),)
+
+    def test_engine_spans_via_worker(self, collector):
+        import jax
+
+        from ray_dynamic_batching_tpu.engine.collate import collate
+        from ray_dynamic_batching_tpu.utils.tracing import link_to
+
+        model = self._StubModel()
+        queues = QueueManager()
+        queue = queues.queue("stub_vision")
+        reqs = [
+            Request(model="stub_vision",
+                    payload=np.full((2, 2, 1), float(i), np.float32),
+                    slo_ms=5000,
+                    trace_ctx={"trace_id": f"{i:032x}",
+                               "parent_span_id": 1000 + i})
+            for i in range(3)
+        ]
+        for r in reqs:
+            assert queue.add_request(r)
+        policy = NexusFixedBatch(4, expected_latency_ms=0.0)
+        batch = policy.next_batch(queue)
+        assert len(batch) == 3
+
+        # queue.wait emitted per popped request, in each request's trace.
+        waits = [s for s in collector.spans if s.name == "queue.wait"]
+        assert {s.trace_id for s in waits} == {f"{i:032x}" for i in range(3)}
+
+        # The compiled-step shape the engine hot loop runs: step span with
+        # member links around collate + the jitted program.
+        fn = jax.jit(lambda params, x: x * params).lower(
+            2.0, *[np.zeros((4, 2, 2, 1), np.float32)]
+        ).compile()
+        with tracer().span(
+            "engine.step",
+            links=[link_to(r.trace_ctx) for r in batch],
+            model="stub_vision", engine="chip0", lane="chip0",
+            batch_bucket=4, n=len(batch),
+        ) as step_span:
+            inputs, n_real = collate(model, batch, 4)
+            out = np.asarray(fn(2.0, *inputs))[:n_real]
+        assert out.shape[0] == 3 and step_span is not None
+        assert len(step_span.links) == 3
+
+        col = [s for s in collector.spans if s.name == "collate.batch"]
+        assert col and col[0].parent_id == step_span.span_id
+        assert len(col[0].links) == 3
+
+        # Round trip through the JSONL dict form preserves links.
+        rt = span_from_dict(span_to_dict(step_span))
+        assert rt.links == step_span.links
+
+        digest = trace_summary(collector.spans)
+        assert digest["links"] >= 6
+        doc = to_chrome_trace(collector.spans)
+        procs = {
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert {"queue", "collate", "engine"} <= procs

@@ -1,0 +1,241 @@
+"""Exposition-validity checker for the /metrics endpoint.
+
+A malformed metric line or exemplar ships silently — Prometheus drops the
+whole scrape and the operator learns during the incident. This tool parses
+the Prometheus/OpenMetrics text our registry renders and fails loudly on:
+
+- malformed metric names / label sets / values,
+- samples for a name with no preceding ``# TYPE``,
+- exemplars (``# {trace_id="..."} value [ts]``) on lines that cannot carry
+  them (OpenMetrics allows them on ``_bucket`` and ``_total`` samples only),
+- exemplar label sets over the 128-rune OpenMetrics cap,
+- histogram families missing ``+Inf`` buckets / ``_sum`` / ``_count`` or
+  with non-monotonic cumulative buckets.
+
+Usage:
+    python tools/check_openmetrics.py <file>    # validate a saved scrape
+    python tools/check_openmetrics.py -         # validate stdin
+    python tools/check_openmetrics.py --smoke   # end-to-end: build metrics
+        (including traced exemplars), serve them over a real HTTP proxy,
+        scrape /metrics, validate — the CI gate.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) ?(.*)$")
+_TYPE_RE = re.compile(
+    rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)$"
+)
+_LABELS = r"(?:\{(?P<labels>[^{}]*)\})?"
+_VALUE = r"(?P<value>[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))"
+_EXEMPLAR = (r"(?: # \{(?P<ex_labels>[^{}]*)\} "
+             r"(?P<ex_value>[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)"
+             r"(?: (?P<ex_ts>[0-9]+\.?[0-9]*))?)?")
+_SAMPLE_RE = re.compile(
+    rf"^(?P<name>{_NAME}){_LABELS} {_VALUE}"
+    rf"(?: (?P<ts>[0-9]+\.?[0-9]*))?{_EXEMPLAR}$"
+)
+_LABEL_PAIR_RE = re.compile(
+    rf'({_NAME})="((?:[^"\\]|\\["\\n])*)"'
+)
+
+
+def _parse_labels(raw: str, errors: List[str], where: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    if not raw:
+        return out
+    consumed = 0
+    for m in _LABEL_PAIR_RE.finditer(raw):
+        out[m.group(1)] = m.group(2)
+        consumed += len(m.group(0))
+    # Account for separators: n-1 commas (a trailing comma is legal in
+    # Prometheus text format, so allow n).
+    seps = raw.count(",")
+    if consumed + seps != len(raw) and consumed + seps + 1 != len(raw):
+        errors.append(f"{where}: unparseable label set {raw!r}")
+    return out
+
+
+def validate(text: str) -> List[str]:
+    """Returns a list of error strings (empty = valid)."""
+    errors: List[str] = []
+    typed: Dict[str, str] = {}
+    # histogram family -> {label-set-sans-le: [(le, cum_count)]}
+    buckets: Dict[str, Dict[Tuple, List[Tuple[float, float]]]] = {}
+    sums: Dict[str, set] = {}
+    counts: Dict[str, set] = {}
+
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            if not _HELP_RE.match(line):
+                errors.append(f"line {i}: malformed HELP: {line!r}")
+            continue
+        if line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            if m is None:
+                errors.append(f"line {i}: malformed TYPE: {line!r}")
+            else:
+                typed[m.group(1)] = m.group(2)
+            continue
+        if line == "# EOF":
+            continue  # OpenMetrics terminator
+        if line.startswith("#"):
+            errors.append(f"line {i}: unexpected comment: {line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {i}: malformed sample: {line!r}")
+            continue
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                base = name[: -len(suffix)]
+        if base not in typed:
+            errors.append(f"line {i}: sample {name!r} has no # TYPE")
+            continue
+        labels = _parse_labels(m.group("labels") or "", errors, f"line {i}")
+        if m.group("ex_labels") is not None:
+            # OpenMetrics: exemplars only on histogram buckets and
+            # counter _total samples.
+            ok_carrier = name.endswith("_bucket") or name.endswith("_total")
+            if not ok_carrier:
+                errors.append(
+                    f"line {i}: exemplar on non-bucket/total sample {name!r}"
+                )
+            ex_labels = _parse_labels(
+                m.group("ex_labels"), errors, f"line {i} (exemplar)"
+            )
+            runes = sum(len(k) + len(v) for k, v in ex_labels.items())
+            if runes > 128:
+                errors.append(
+                    f"line {i}: exemplar label set over 128 runes ({runes})"
+                )
+        if typed.get(base) == "histogram":
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"
+            ))
+            if name.endswith("_bucket"):
+                le = labels.get("le")
+                if le is None:
+                    errors.append(f"line {i}: bucket without le label")
+                else:
+                    le_f = float("inf") if le == "+Inf" else float(le)
+                    buckets.setdefault(base, {}).setdefault(key, []).append(
+                        (le_f, float(m.group("value")))
+                    )
+            elif name.endswith("_sum"):
+                sums.setdefault(base, set()).add(key)
+            elif name.endswith("_count"):
+                counts.setdefault(base, set()).add(key)
+
+    for fam, series in buckets.items():
+        for key, bs in series.items():
+            bs = sorted(bs)
+            if not bs or bs[-1][0] != float("inf"):
+                errors.append(f"{fam}{dict(key)}: no +Inf bucket")
+            vals = [c for _, c in bs]
+            if any(b > a for b, a in zip(vals, vals[1:])):
+                errors.append(
+                    f"{fam}{dict(key)}: non-monotonic cumulative buckets"
+                )
+            if key not in sums.get(fam, set()):
+                errors.append(f"{fam}{dict(key)}: missing _sum")
+            if key not in counts.get(fam, set()):
+                errors.append(f"{fam}{dict(key)}: missing _count")
+    return errors
+
+
+def _smoke() -> int:
+    """End-to-end gate: traced observations -> registry -> real HTTP proxy
+    -> scrape -> validate. Asserts at least one exemplar made it out."""
+    import urllib.request
+
+    from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
+    from ray_dynamic_batching_tpu.utils import metrics as m
+    from ray_dynamic_batching_tpu.utils.tracing import tracer
+
+    sink: list = []
+    tracer().set_exporter(sink.append)
+    try:
+        c = m.Counter("smoke_requests_total", "smoke requests",
+                      tag_keys=("route",))
+        c.inc(3, tags={"route": 'with"quote\\and\nnewline'})
+        g = m.Gauge("smoke_depth", "queue depth")
+        g.set(7)
+        h = m.Histogram("smoke_latency_ms", "smoke latency",
+                        tag_keys=("model",))
+        for v in (0.4, 3.0, 42.0, 900.0):
+            with tracer().span("smoke.request"):
+                h.observe(v, tags={"model": "m0"})
+        h.observe(5.0, tags={"model": "m1"})  # untraced: no exemplar
+        proxy = HTTPProxy(ProxyRouter(), port=0).start()
+        try:
+            url = f"http://127.0.0.1:{proxy.port}/metrics"
+            with urllib.request.urlopen(
+                urllib.request.Request(url, headers={
+                    "Accept": "application/openmetrics-text"
+                }), timeout=10,
+            ) as resp:
+                text = resp.read().decode()
+                ctype = resp.headers.get("Content-Type", "")
+            # Classic scrape must stay exemplar-free (stock Prometheus
+            # parses 0.0.4 text and fails the whole scrape on a suffix).
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                classic = resp.read().decode()
+        finally:
+            proxy.stop()
+    finally:
+        tracer().reset()
+    errors = validate(text)
+    if "openmetrics-text" not in ctype:
+        errors.append(f"Accept negotiation failed: got {ctype!r}")
+    if not text.rstrip().endswith("# EOF"):
+        errors.append("OpenMetrics render missing # EOF trailer")
+    if '# {trace_id="' in classic:
+        errors.append("exemplar leaked into the classic 0.0.4 exposition")
+    errors.extend(validate(classic))
+    n_exemplars = len(re.findall(r' # \{trace_id="', text))
+    if n_exemplars < 1:
+        errors.append("no exemplar line in the scrape "
+                      "(traced observations must surface trace_ids)")
+    if errors:
+        print("OPENMETRICS SMOKE FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    lines = len([l for l in text.splitlines() if l.strip()])
+    print(f"openmetrics smoke OK: {lines} lines, {n_exemplars} exemplar(s), "
+          "0 errors")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--smoke":
+        return _smoke()
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    text = (sys.stdin.read() if argv[0] == "-"
+            else open(argv[0]).read())
+    errors = validate(text)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if not errors:
+        print("ok")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,6 +14,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [ "${1:-}" = "fast" ]; then
+  echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
+  python tools/check_openmetrics.py --smoke
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
   exec python -m pytest tests/ -q -m "not slow"
 fi
@@ -25,6 +27,9 @@ if [ "${1:-}" = "8b" ]; then
     "tests/test_tp_decode.py::TestLlama8BInt8" \
     "tests/test_tp_decode.py::TestLlama8BInt8KV" -q
 fi
+
+echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
+python tools/check_openmetrics.py --smoke
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q

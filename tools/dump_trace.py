@@ -1,0 +1,73 @@
+"""Convert a recorded span JSONL into a Chrome-trace/Perfetto JSON.
+
+The flight recorder's durable sink (``FileSpanExporter``) appends one JSON
+object per finished span; this tool renders that capture as the trace-event
+JSON that https://ui.perfetto.dev (or ``chrome://tracing``) opens directly:
+process lanes per component, thread lanes per chip/replica, flow arrows for
+batch<->request span links.
+
+Usage:
+    python tools/dump_trace.py spans.jsonl -o trace.json
+    python tools/dump_trace.py spans.jsonl --summary        # digest only
+    python tools/dump_trace.py spans.jsonl --trace-id <id>  # one request
+
+Capture a JSONL during any run with:
+    from ray_dynamic_batching_tpu.utils.tracing import tracer
+    from ray_dynamic_batching_tpu.utils.trace_export import FileSpanExporter
+    tracer().set_exporter(FileSpanExporter("spans.jsonl").export)
+(or pass ``--trace`` to ``tools/run_slo_demo.py``, which writes both the
+JSONL and the converted ``trace.json`` for you).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_dynamic_batching_tpu.utils.trace_export import (  # noqa: E402
+    read_spans_jsonl,
+    to_chrome_trace,
+    trace_summary,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("spans", help="span JSONL written by FileSpanExporter")
+    parser.add_argument("-o", "--out", default=None,
+                        help="output Chrome-trace JSON (default: "
+                             "<spans>.trace.json)")
+    parser.add_argument("--trace-id", default=None,
+                        help="keep only spans of one trace (one request's "
+                             "flight record)")
+    parser.add_argument("--summary", action="store_true",
+                        help="print a digest instead of converting")
+    args = parser.parse_args(argv)
+
+    spans = read_spans_jsonl(args.spans)
+    if args.trace_id:
+        keep = {args.trace_id}
+        # Follow links one hop so a request's batch/turn spans come along.
+        keep |= {
+            s.trace_id for s in spans
+            if any(l.get("trace_id") in keep for l in s.links)
+        }
+        spans = [s for s in spans if s.trace_id in keep]
+    if args.summary:
+        print(json.dumps(trace_summary(spans), indent=2))
+        return 0
+    out = args.out or (args.spans + ".trace.json")
+    with open(out, "w") as f:
+        json.dump(to_chrome_trace(spans), f)
+    digest = trace_summary(spans)
+    print(f"wrote {out}: {digest['spans']} spans, {digest['traces']} traces, "
+          f"{digest['links']} links — open in https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
