@@ -391,7 +391,7 @@ class ColocatedLLMEngines:
                 1.0,
             )
             chosen.credit_ms -= penalty
-            time.sleep(0.01)
+            time.sleep(0.01)  # rdb-lint: disable=event-loop-blocking (failed-turn backoff on the colocation executor's own thread)
             return False
         total_w = sum(h.weight for h in workable)
         for h in workable:
@@ -437,10 +437,10 @@ class ColocatedLLMEngines:
                 except Exception:  # noqa: BLE001 — loop must not die silently
                     logger.exception("%s: pass failed", self.name)
                     progressed = False
-                    time.sleep(0.05)
+                    time.sleep(0.05)  # rdb-lint: disable=event-loop-blocking (pass error backoff on the colocation executor's own thread)
                 self._wall_ms += (time.perf_counter() - t0) * 1000.0
                 if not progressed:
-                    time.sleep(self.idle_wait_s)
+                    time.sleep(self.idle_wait_s)  # rdb-lint: disable=event-loop-blocking (idle wait on the colocation executor's own thread)
 
     @property
     def running(self) -> bool:

@@ -1816,7 +1816,7 @@ class DecodeEngine:
             bias_ids_d,
             bias_vals_d,
         )
-        ph = np.asarray(packed)  # ONE fetch per round
+        ph = np.asarray(packed)  # ONE fetch per round  # rdb-lint: disable=host-sync-in-hot-path (THE one fetch per spec round: ph carries tokens+counts+lengths packed)
         self._scan_end_ms = now_ms()
         if _tracer().enabled:
             self._record_turn_span(k, self._active_mask, spec=True)
@@ -1874,7 +1874,7 @@ class DecodeEngine:
             bias_vals_d,
             self._counts,
         )
-        packed_host = np.asarray(packed)          # ONE fetch per dispatch
+        packed_host = np.asarray(packed)          # ONE fetch per dispatch  # rdb-lint: disable=host-sync-in-hot-path (THE one fetch per dispatch: packed carries tokens+advanced+lengths)
         self._scan_end_ms = now_ms()
         if _tracer().enabled and active_at_dispatch.any():
             self._record_turn_span(h, active_at_dispatch)
@@ -1933,7 +1933,7 @@ class DecodeEngine:
         ]
         if not active_idx:
             return
-        cols = np.asarray(active_idx, dtype=np.int64)
+        cols = np.asarray(active_idx, dtype=np.int64)  # rdb-lint: disable=host-sync-in-hot-path (host-built python index list, no device value)
         toks = toks_host[:, cols]          # [h, n]
         adv = advanced_host[:, cols]       # [h, n]
         # First non-advanced substep (h if every substep advanced).
@@ -2021,7 +2021,7 @@ class DecodeEngine:
                     logger.exception(
                         "%s: decode loop iteration failed", self.model.name
                     )
-                    time.sleep(0.05)
+                    time.sleep(0.05)  # rdb-lint: disable=event-loop-blocking (decode-loop error backoff on the engine's own thread)
 
     def release_buffers(self) -> None:
         """Drop the engine's HBM footprint (cache + params + compiled fns)

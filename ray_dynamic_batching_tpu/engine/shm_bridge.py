@@ -130,7 +130,7 @@ class ShmFrontend:
                 if isinstance(value, dict) and "__error__" in value:
                     raise RuntimeError(value["__error__"])
                 return value
-            time.sleep(poll_s)
+            time.sleep(poll_s)  # rdb-lint: disable=event-loop-blocking (cross-process shm result poll on the frontend caller's thread)
         raise TimeoutError(f"no result for oid {oid} within {timeout_s}s")
 
     def close(self, unlink: Optional[bool] = None) -> None:

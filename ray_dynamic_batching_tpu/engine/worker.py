@@ -281,7 +281,7 @@ class ReplicaEngine:
                 # reliable completion signal on the axon tunnel
                 # (block_until_ready returns early there); the engine needs
                 # the results host-side anyway to fulfill futures.
-                results = np.asarray(out)[:n_real]
+                results = np.asarray(out)[:n_real]  # rdb-lint: disable=host-sync-in-hot-path (THE designed fetch: host results fulfill futures and signal axon completion)
         except Exception as e:  # noqa: BLE001
             for req in batch:
                 req.reject(e)
@@ -318,7 +318,7 @@ class ReplicaEngine:
     def _run_cycle(self) -> None:
         sched = self._schedule
         if not sched.placements:
-            time.sleep(self.idle_wait_s)
+            time.sleep(self.idle_wait_s)  # rdb-lint: disable=event-loop-blocking (idle wait on the engine's own thread)
             return
         cycle_start = time.perf_counter()
         for p in sched.placements:
@@ -330,12 +330,12 @@ class ReplicaEngine:
             slice_ms = p.occupancy * sched.duty_cycle_ms
             remaining_ms = slice_ms - elapsed_ms
             if remaining_ms > 0.05:
-                time.sleep(remaining_ms / 1000.0)
+                time.sleep(remaining_ms / 1000.0)  # rdb-lint: disable=event-loop-blocking (duty-cycle slice pacing on the engine's own thread; co-tenant shares depend on it)
         # Absorb any leftover duty-cycle time (unallocated occupancy).
         total_ms = (time.perf_counter() - cycle_start) * 1000.0
         leftover_ms = sched.duty_cycle_ms - total_ms
         if leftover_ms > 0.05:
-            time.sleep(leftover_ms / 1000.0)
+            time.sleep(leftover_ms / 1000.0)  # rdb-lint: disable=event-loop-blocking (duty-cycle leftover absorption on the engine's own thread)
         self._cycle_count += 1
 
     def _loop(self) -> None:
@@ -346,7 +346,7 @@ class ReplicaEngine:
             except Exception as e:  # noqa: BLE001 — engine must not die silently
                 self._last_error = e
                 logger.exception("%s: cycle failed", self.engine_id)
-                time.sleep(0.05)
+                time.sleep(0.05)  # rdb-lint: disable=event-loop-blocking (loop error backoff on the engine's own thread)
 
     # --- lifecycle --------------------------------------------------------
     def start(self) -> None:

@@ -123,7 +123,7 @@ class WorkloadDriver:
         ):
             delay = start + offset - time.monotonic()
             if delay > 0:
-                time.sleep(delay)
+                time.sleep(delay)  # rdb-lint: disable=event-loop-blocking (open-loop arrival pacing on the generator's own thread)
             try:
                 self.submit(self.model, offset)
                 self.sent += 1

@@ -59,6 +59,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ray_dynamic_batching_tpu.ops import tile_math
+from ray_dynamic_batching_tpu.ops.tile_math import VMEM_BLOCK_BUDGET_BYTES
+
 # jax renamed TPUCompilerParams -> CompilerParams across releases; accept
 # either so the kernel lowers on both sides of the rename.
 _COMPILER_PARAMS = getattr(pltpu, "CompilerParams",
@@ -174,15 +177,14 @@ def _pick_heads_block(K: int) -> int:
     return K
 
 
-# Per-grid-step VMEM ceiling for this call's K/V+mask+scale blocks
-# (~16 MB VMEM/core): tile_bytes counts the FULLY padded tiles (sublane
-# AND 128-lane dims) double-buffered (the `2 *`), so the budget honestly
-# bounds the in-VMEM footprint and can sit close to the core limit — the
-# q/out blocks and f32 accumulator scratch riding alongside are small
-# (R <= window * G rows). 15 MB keeps whisper's only legal tile (whole
-# S=448, ~14.7 MB true) while rejecting the H=64 whole-S tiles the old
-# raw-H budget wrongly accepted (~16.8 MB true).
-VMEM_BLOCK_BUDGET_BYTES = 15 * 1024 * 1024
+# The VMEM budget and the padded-footprint model live in
+# ops/tile_math.py, SHARED with the static vmem-budget checker
+# (tools/lint) — one implementation, so the static model and this
+# runtime picker cannot drift. H=64 geometries (gpt2_medium,
+# llama_tiny, whisper heads) double under 128-lane padding; budgeting
+# the raw H undercounted the K/V block ~2x and picked tiles whose true
+# double-buffered footprint blew the ~16 MB/core this file assumes —
+# the exact bug class the shared model (and its lint rule) pins down.
 
 
 def _pick_sb(S: int, kb: int, H: int, kv_itemsize: int,
@@ -195,26 +197,9 @@ def _pick_sb(S: int, kb: int, H: int, kv_itemsize: int,
     (callers tune pipeline granularity; tests force multi-tile scans
     on small capacities)."""
     def tile_bytes(sb: int) -> int:
-        # Mosaic pads a block's SUBLANE (second-to-last) dim to the
-        # dtype's tile height (f32 8, bf16 16, int8 32) AND its LANE
-        # (last) dim to a multiple of 128 — the in-VMEM footprint is the
-        # padded one on BOTH trailing dims, not the logical one. H=64
-        # geometries (gpt2_medium, llama_tiny, whisper heads) double
-        # under lane padding; budgeting the raw H undercounted the K/V
-        # block ~2x and picked tiles whose true double-buffered
-        # footprint blew the ~16 MB/core the file assumes.
-        sublane = {4: 8, 2: 16, 1: 32}[kv_itemsize]
-        lane_h = -(-H // 128) * 128
-        kv = 2 * sb * -(-kb // sublane) * sublane * lane_h * kv_itemsize
-        # mask block [1, window, sb]: int8 sublane pad 32, lane dim sb
-        # pads to a 128 multiple (only the whole-S tile of a ragged S
-        # is ever not one already).
-        lane_sb = -(-sb // 128) * 128
-        mask_b = 32 * lane_sb if with_mask else 0
-        # scales ride as [1, kb, sb] f32 blocks: sublane = padded kb,
-        # lane = padded sb.
-        scale_b = 2 * -(-kb // 8) * 8 * lane_sb * 4 if with_scales else 0
-        return 2 * (kv + mask_b + scale_b)
+        return tile_math.decode_tile_bytes(
+            sb, kb, H, kv_itemsize, with_mask, with_scales=with_scales
+        )
 
     cands = [S] + [
         sb for sb in range((S // 128) * 128, 127, -128) if S % sb == 0

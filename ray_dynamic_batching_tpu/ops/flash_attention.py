@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ray_dynamic_batching_tpu.ops import tile_math
+
 NEG_INF = -1e30
 
 # Query tiles below this aren't worth a kernel launch (decode steps).
@@ -281,6 +283,22 @@ def flash_attention(
     # <=1/128 MXU utilization, e.g. ViT-G/14's 257) is not worth a
     # kernel: XLA's fused attention handles these shapes well.
     if block_q < 8 or block_k < 8:
+        return None
+    # Per-grid-step VMEM guard sharing the runtime/static footprint model
+    # (ops/tile_math.py): the resident K/V pair, the q/out tiles, and the
+    # streamed int8 mask tile, all padded and double-buffered, must fit
+    # the block budget — the docstring's "K/V comfortably resident"
+    # assumption, now enforced instead of assumed. Over-budget shapes
+    # (e.g. masked multi-k seq where the [block_q, Tk] mask tile alone
+    # costs Tq*Tk bytes) decline to XLA like every other fallback.
+    blocks = (
+        2 * tile_math.padded_block_bytes((1, 1, Tk, H), k.dtype.itemsize)
+        + 2 * tile_math.padded_block_bytes((1, 1, block_q, H),
+                                           q.dtype.itemsize)
+    )
+    if mask is not None:
+        blocks += tile_math.padded_block_bytes((1, block_q, Tk), 1)
+    if tile_math.DOUBLE_BUFFER * blocks > tile_math.VMEM_BLOCK_BUDGET_BYTES:
         return None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -1,0 +1,108 @@
+"""Shared TPU tile-padding / VMEM-footprint math.
+
+Single source of truth for the padded-footprint model used by BOTH the
+runtime KV-tile picker (``ops/decode_attention.py::_pick_sb``) and the
+static ``vmem-budget`` checker (``tools/lint``). PR 1 fixed a real bug
+where the hand-computed double-buffered footprint undercounted lane
+padding (H=64 geometries looked ~2x smaller than their true in-VMEM
+size and busted the per-core budget); keeping one implementation here is
+what stops the static model and the runtime picker from drifting apart
+the same way.
+
+The model (Mosaic's VMEM tiling rules):
+
+- a block's SUBLANE (second-to-last) dim pads up to the dtype's tile
+  height — f32 8, bf16 16, int8 32 (``SUBLANE_PACK``);
+- its LANE (last) dim pads up to a multiple of 128;
+- leading dims multiply unpadded;
+- Pallas double-buffers streamed blocks (``DOUBLE_BUFFER``), so the
+  in-flight footprint of a grid step is twice the padded block sum.
+
+Deliberately dependency-free (no jax import): the linter loads this
+module standalone so ``python -m tools.lint`` stays fast and runs in
+environments without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+# Dtype tile height by itemsize: sublane packing halves as elements
+# shrink, so SUBLANE_PACK[itemsize] * itemsize == 32 bytes for every
+# supported dtype. (That identity is why f32 is the worst-case itemsize
+# for a padded footprint: ceil(n/8) >= ceil(n/16) >= ceil(n/32).)
+SUBLANE_PACK = {4: 8, 2: 16, 1: 32}
+
+LANE = 128
+
+# Pallas pipelines the next tile's HBM->VMEM copy behind the current
+# tile's compute: two buffers per streamed block are resident at once.
+DOUBLE_BUFFER = 2
+
+# Per-grid-step VMEM ceiling for a kernel call's streamed blocks
+# (~16 MB VMEM/core): footprints count the FULLY padded tiles (sublane
+# AND 128-lane dims) double-buffered, so the budget honestly bounds the
+# in-VMEM bytes and can sit close to the core limit — q/out blocks and
+# f32 accumulator scratch riding alongside are small. 15 MB keeps
+# whisper's only legal decode tile (whole S=448, ~14.7 MB true) while
+# rejecting the H=64 whole-S tiles the old raw-H budget wrongly
+# accepted (~16.8 MB true).
+VMEM_BLOCK_BUDGET_BYTES = 15 * 1024 * 1024
+
+
+def sublane_pack(itemsize: int) -> int:
+    """Dtype tile height (rows) for an itemsize; unknown itemsizes get
+    the f32 pack (f32 is the worst case per byte, see SUBLANE_PACK)."""
+    return SUBLANE_PACK.get(itemsize, 8)
+
+
+def pad_lane(n: int) -> int:
+    """Lane (last) dim padded up to a multiple of 128."""
+    return -(-n // LANE) * LANE
+
+
+def pad_sublane(n: int, itemsize: int) -> int:
+    """Sublane (second-to-last) dim padded up to the dtype tile height."""
+    pack = sublane_pack(itemsize)
+    return -(-n // pack) * pack
+
+
+def padded_block_bytes(block_shape: Sequence[int], itemsize: int) -> int:
+    """True in-VMEM bytes of ONE BlockSpec block: both trailing dims
+    padded (sublane to the dtype tile height, lane to 128), leading dims
+    multiplied unpadded. A 1-D block is a single lane row (sublane 1)."""
+    dims = [int(d) for d in block_shape]
+    if not dims:
+        return itemsize
+    lane = pad_lane(dims[-1])
+    sub = pad_sublane(dims[-2] if len(dims) >= 2 else 1, itemsize)
+    lead = 1
+    for d in dims[:-2]:
+        lead *= d
+    return lead * sub * lane * itemsize
+
+
+def decode_tile_bytes(
+    sb: int,
+    kb: int,
+    H: int,
+    kv_itemsize: int,
+    with_mask: bool,
+    with_scales: bool = False,
+    window: int = 1,
+) -> int:
+    """Double-buffered VMEM footprint of one decode-attention grid
+    step's streamed blocks — the exact model ``_pick_sb`` budgets
+    against (and the static checker re-evaluates):
+
+    - K and V tiles [1, sb, kb, H] at the cache itemsize (trailing dims
+      (kb, H): kb pads to the dtype tile height, H to 128 lanes — the
+      H=64 lane padding PR 1's fix made honest);
+    - optional mask tile [1, window, sb] int8 (window <= 8 pads to the
+      int8 tile height 32; sb is the lane dim);
+    - optional K/V scale tiles [1, kb, sb] f32.
+    """
+    kv = 2 * padded_block_bytes((1, sb, kb, H), kv_itemsize)
+    mask_b = padded_block_bytes((1, window, sb), 1) if with_mask else 0
+    scale_b = 2 * padded_block_bytes((1, kb, sb), 4) if with_scales else 0
+    return DOUBLE_BUFFER * (kv + mask_b + scale_b)

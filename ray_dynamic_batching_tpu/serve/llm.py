@@ -150,7 +150,7 @@ class LLMReplica(Replica):
         if drain:
             deadline = time.monotonic() + timeout_s
             while self.queue_len() > 0 and time.monotonic() < deadline:
-                time.sleep(0.01)
+                time.sleep(0.01)  # rdb-lint: disable=event-loop-blocking (control-plane stop() drain poll on the controller's thread; no event loop involved)
         exc = RequestDropped(f"{self.replica_id} stopped")
         # Signal every loop BEFORE joining any, then join under one shared
         # deadline — N wedged engines must cost ~timeout_s total, not

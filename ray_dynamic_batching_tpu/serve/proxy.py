@@ -22,8 +22,10 @@ Routes:
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -108,6 +110,25 @@ class HTTPProxy:
         self._server: Optional[asyncio.AbstractServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        self._route_pool: Optional[ThreadPoolExecutor] = None
+
+    async def _offload_routing(self, fn: Callable, *args: Any) -> Any:
+        """Run a synchronous routing call off the event loop.
+
+        handle.remote/remote_stream run assign_request's pow-2 + backoff
+        loop, which sleeps up to the assign timeout when every replica is
+        saturated — on the loop thread that would stall every live
+        connection. The proxy's OWN pool (not the loop's default
+        executor) absorbs those sleeps: parking up-to-1s backoffs on the
+        shared default pool would head-of-line-block unrelated work
+        (other deployments' routing, library callbacks) behind one
+        saturated deployment. contextvars copy keeps the routing span
+        inside this request's trace."""
+        loop = asyncio.get_running_loop()
+        ctx = contextvars.copy_context()
+        return await loop.run_in_executor(
+            self._route_pool, lambda: ctx.run(fn, *args)
+        )
 
     # --- HTTP plumbing ----------------------------------------------------
     async def _read_request(
@@ -178,7 +199,9 @@ class HTTPProxy:
         reader thread per connection, so concurrent streams scale with the
         event loop, not with an executor pool.
         """
-        stream, future = handle.remote_stream(payload)
+        stream, future = await self._offload_routing(
+            handle.remote_stream, payload
+        )
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
             b"Content-Type: application/x-ndjson\r\n"
@@ -289,7 +312,7 @@ class HTTPProxy:
             # None marks "already written"; tag carries the code for metrics.
             return None, f"{route}|{code}"
 
-        future = handle.remote(payload)
+        future = await self._offload_routing(handle.remote, payload)
         try:
             result = await asyncio.wait_for(
                 asyncio.wrap_future(future), timeout=self.request_timeout_s
@@ -394,6 +417,12 @@ class HTTPProxy:
         # restart report success before (or regardless of whether) we bind.
         self._started = threading.Event()
         self._start_error: Optional[BaseException] = None
+        # Sized for saturation, not throughput: routing threads spend
+        # their time in backoff sleeps, so 64 mostly-idle threads cover
+        # 64 concurrently-backing-off requests before anyone queues.
+        self._route_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="proxy-route"
+        )
         self._thread = threading.Thread(
             target=self._run, name="http-proxy", daemon=True
         )
@@ -429,3 +458,9 @@ class HTTPProxy:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._route_pool is not None:
+            # Don't wait: a routing call mid-backoff can hold its thread
+            # for up to the assign timeout; its request future resolves
+            # (rejected) independently of pool teardown.
+            self._route_pool.shutdown(wait=False)
+            self._route_pool = None

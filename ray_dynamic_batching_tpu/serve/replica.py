@@ -302,7 +302,7 @@ class Replica:
         if drain and self._thread is not None:
             deadline = time.monotonic() + timeout_s
             while self.queue_len() > 0 and time.monotonic() < deadline:
-                time.sleep(0.01)
+                time.sleep(0.01)  # rdb-lint: disable=event-loop-blocking (control-plane stop() drain poll on the controller's thread; no event loop involved)
         self._run.clear()
         self.queue.close()  # releases the loop's condition wait permanently
         if self._thread is not None:

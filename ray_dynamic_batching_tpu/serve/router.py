@@ -169,7 +169,7 @@ class Router:
                     if sp is not None:
                         sp.attributes.update(attempts=attempts, rejected=True)
                     return False
-                time.sleep(backoff)
+                time.sleep(backoff)  # rdb-lint: disable=event-loop-blocking (caller-thread backoff by contract: the asyncio proxy offloads handle.remote to its routing pool, so this never runs on the event loop)
                 backoff = min(backoff * 2, BACKOFF_MAX_S)
 
     # --- autoscaler metrics (ref RouterMetricsManager) --------------------

@@ -285,7 +285,7 @@ def _main() -> int:
     if "--block" in sys.argv:
         try:
             while True:
-                time.sleep(3600)
+                time.sleep(3600)  # rdb-lint: disable=event-loop-blocking (CLI --block foreground park; blocking is the point of the flag)
         except KeyboardInterrupt:
             pass
         from ray_dynamic_batching_tpu.serve.api import shutdown

@@ -1,0 +1,695 @@
+"""rdb-lint suite tests: per-rule fixtures (positive hit, clean
+negative, pragma suppression, baseline suppression), the PR-1 VMEM
+undercount regression fixture, and the shared-footprint-math pins that
+keep the static model and the runtime ``_pick_sb`` from drifting."""
+
+import json
+import textwrap
+
+import pytest
+
+from tools.lint import core as lint_core
+from tools.lint import load_baseline, run
+from tools.lint.__main__ import main as lint_main
+from tools.lint.vmem import tile_math_module
+
+from ray_dynamic_batching_tpu.ops import decode_attention as da
+from ray_dynamic_batching_tpu.ops import tile_math as tm
+
+
+def lint_fixture(tmp_path, relfile, source, baseline=None, rules=None):
+    path = tmp_path / relfile
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return run(paths=[tmp_path], root=tmp_path, baseline=baseline,
+               rules=rules)
+
+
+def rules_found(report):
+    return [f.rule for f in report.new]
+
+
+# --- vmem-budget ----------------------------------------------------------
+
+# The exact pattern PR 1 fixed in _pick_sb: a whole-S KV tile at H=64.
+# Raw-H math budgets the K/V pair at ~8.4 MB double-buffered; the honest
+# padded footprint (H -> 128 lanes) is ~2x that and busts the budget.
+PR1_UNDERCOUNT = """
+    from jax.experimental import pallas as pl
+
+    S = 1024
+    KB = 16
+    H = 64
+
+    def call(kernel, args):
+        return pl.pallas_call(
+            kernel,
+            grid=(1, 1, 1),
+            in_specs=[
+                pl.BlockSpec((1, S, KB, H), lambda b, j, s: (b, 0, j, 0)),
+                pl.BlockSpec((1, S, KB, H), lambda b, j, s: (b, 0, j, 0)),
+                pl.BlockSpec((1, 1, S), lambda b, j, s: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, KB, 8, H), lambda b, j, s: (b, j, 0, 0)
+            ),
+        )(*args)
+"""
+
+
+class TestVmemBudget:
+    def test_pr1_undercount_regression_is_flagged(self, tmp_path):
+        # (rules scoped: tile-alignment ALSO fires on H=64 — the very
+        # 2x lane pad that caused the undercount — tested separately.)
+        report = lint_fixture(tmp_path, "ops/kernel.py", PR1_UNDERCOUNT,
+                              rules={"vmem-budget"})
+        assert rules_found(report) == ["vmem-budget"]
+        f = report.new[0]
+        assert "exceeds" in f.message
+        assert "_pick_sb" in f.message  # names the bug class it guards
+
+    def test_tiled_version_of_same_kernel_is_clean(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "ops/kernel.py",
+            PR1_UNDERCOUNT.replace("S = 1024", "S = 1024\n    SB = 128")
+            .replace("(1, S, KB, H)", "(1, SB, KB, H)"),
+            rules={"vmem-budget"},
+        )
+        assert report.new == []
+
+    def test_static_math_agrees_with_runtime_picker(self, tmp_path):
+        # The flagged whole-S fixture is exactly a tile the runtime
+        # picker refuses: the static checker and _pick_sb share one
+        # model, so a geometry the checker rejects can never be picked.
+        assert tm.decode_tile_bytes(1024, 16, 64, 2, True) \
+            > tm.VMEM_BLOCK_BUDGET_BYTES
+        assert da._pick_sb(1024, 16, 64, 2, True) < 1024
+
+    def test_unresolvable_without_guard_is_flagged(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/dyn.py", """
+            from jax.experimental import pallas as pl
+
+            def call(kernel, args, sb, h):
+                return pl.pallas_call(
+                    kernel,
+                    in_specs=[pl.BlockSpec((1, sb, 8, h),
+                                           lambda b: (b, 0, 0, 0))],
+                    out_specs=pl.BlockSpec((1, sb, 8, h),
+                                           lambda b: (b, 0, 0, 0)),
+                )(*args)
+        """)
+        assert rules_found(report) == ["vmem-budget"]
+        assert "not statically resolvable" in report.new[0].message
+
+    def test_unresolvable_with_tile_math_guard_is_trusted(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/dyn.py", """
+            from jax.experimental import pallas as pl
+            from ray_dynamic_batching_tpu.ops import tile_math
+
+            def call(kernel, args, sb, h):
+                assert tile_math.decode_tile_bytes(sb, 8, h, 4, False) \\
+                    <= tile_math.VMEM_BLOCK_BUDGET_BYTES
+                return pl.pallas_call(
+                    kernel,
+                    in_specs=[pl.BlockSpec((1, sb, 8, h),
+                                           lambda b: (b, 0, 0, 0))],
+                    out_specs=pl.BlockSpec((1, sb, 8, h),
+                                           lambda b: (b, 0, 0, 0)),
+                )(*args)
+        """)
+        assert report.new == []
+
+    def test_param_shadows_module_constant(self, tmp_path):
+        # A runtime parameter named like a module constant must NOT
+        # resolve to the constant: that would stamp an unguarded dynamic
+        # kernel as 'statically verified'.
+        report = lint_fixture(tmp_path, "ops/shadow.py", """
+            from jax.experimental import pallas as pl
+
+            S = 128
+
+            def call(kernel, args, S):
+                return pl.pallas_call(
+                    kernel,
+                    in_specs=[pl.BlockSpec((1, S, 16, 64),
+                                           lambda b: (b, 0, 0, 0))],
+                    out_specs=pl.BlockSpec((1, S, 16, 64),
+                                           lambda b: (b, 0, 0, 0)),
+                )(*args)
+        """, rules={"vmem-budget"})
+        assert rules_found(report) == ["vmem-budget"]
+        assert "not statically resolvable" in report.new[0].message
+
+    def test_other_functions_locals_do_not_leak(self, tmp_path):
+        # `S = 64` inside an unrelated function is not visible here;
+        # the spec must count as unresolvable (and thus need a guard).
+        report = lint_fixture(tmp_path, "ops/leak.py", """
+            from jax.experimental import pallas as pl
+
+            def other():
+                S = 64
+                return S
+
+            def call(kernel, args):
+                S = compute()
+                return pl.pallas_call(
+                    kernel,
+                    in_specs=[pl.BlockSpec((1, S, 16, 64),
+                                           lambda b: (b, 0, 0, 0))],
+                    out_specs=pl.BlockSpec((1, S, 16, 64),
+                                           lambda b: (b, 0, 0, 0)),
+                )(*args)
+        """, rules={"vmem-budget"})
+        assert rules_found(report) == ["vmem-budget"]
+        assert "not statically resolvable" in report.new[0].message
+
+    def test_comment_mention_of_tile_math_does_not_suppress(
+            self, tmp_path):
+        # The escape hatch requires a real import; a comment or
+        # docstring mention must not satisfy it.
+        report = lint_fixture(tmp_path, "ops/dyn.py", """
+            # TODO: someday use tile_math / VMEM_BLOCK_BUDGET_BYTES here
+            from jax.experimental import pallas as pl
+
+            def call(kernel, args, sb):
+                return pl.pallas_call(
+                    kernel,
+                    in_specs=[pl.BlockSpec((1, sb, 8, 64),
+                                           lambda b: (b, 0, 0, 0))],
+                    out_specs=pl.BlockSpec((1, sb, 8, 64),
+                                           lambda b: (b, 0, 0, 0)),
+                )(*args)
+        """, rules={"vmem-budget"})
+        assert rules_found(report) == ["vmem-budget"]
+
+    def test_rule_only_applies_to_ops(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/kernel.py", PR1_UNDERCOUNT)
+        assert "vmem-budget" not in rules_found(report)
+
+
+# --- tile-alignment -------------------------------------------------------
+
+class TestTileAlignment:
+    def test_lane_dim_one_flags_the_128x_blowup(self, tmp_path):
+        # The documented (kb, 1) trailing-dims case from
+        # decode_attention.py: tile-legal, but pads (8, 128) — ~128x.
+        report = lint_fixture(tmp_path, "ops/scales.py", """
+            from jax.experimental import pallas as pl
+            KB = 8
+            SPEC = pl.BlockSpec((1, 64, KB, 1), lambda b: (b, 0, 0, 0))
+        """, rules={"tile-alignment"})
+        assert rules_found(report) == ["tile-alignment"]
+        assert "128x" in report.new[0].message
+
+    def test_unaligned_sublane_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/spec.py", """
+            from jax.experimental import pallas as pl
+            SPEC = pl.BlockSpec((1, 5, 128), lambda b: (b, 0, 0))
+        """, rules={"tile-alignment"})
+        assert rules_found(report) == ["tile-alignment"]
+        assert "sublane" in report.new[0].message
+
+    def test_aligned_spec_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/spec.py", """
+            from jax.experimental import pallas as pl
+            SPEC = pl.BlockSpec((1, 16, 256), lambda b: (b, 0, 0))
+        """, rules={"tile-alignment"})
+        assert report.new == []
+
+    def test_symbolic_dims_are_skipped(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/spec.py", """
+            from jax.experimental import pallas as pl
+
+            def make(sb, h):
+                return pl.BlockSpec((1, sb, h), lambda b: (b, 0, 0))
+        """, rules={"tile-alignment"})
+        assert report.new == []
+
+
+# --- event-loop-blocking --------------------------------------------------
+
+class TestEventLoopBlocking:
+    def test_sleep_in_async_def_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/app.py", """
+            import time
+
+            async def handler():
+                time.sleep(0.1)
+        """)
+        assert rules_found(report) == ["event-loop-blocking"]
+        assert "asyncio.sleep" in report.new[0].message
+
+    def test_await_asyncio_sleep_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/app.py", """
+            import asyncio
+
+            async def handler():
+                await asyncio.sleep(0.1)
+        """)
+        assert report.new == []
+
+    def test_future_result_in_async_def_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/app.py", """
+            async def handler(fut):
+                return fut.result()
+        """)
+        assert rules_found(report) == ["event-loop-blocking"]
+        assert "wrap_future" in report.new[0].message
+
+    def test_future_result_on_worker_thread_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/app.py", """
+            def servicer(fut):
+                return fut.result(timeout=1.0)
+        """)
+        assert report.new == []
+
+    def test_nested_sync_def_resets_async_scope(self, tmp_path):
+        # A sync callback defined inside async def runs wherever it is
+        # later invoked — not (necessarily) on the loop. Only the sleep
+        # is reported, and as the tier-wide variant, not the hard one.
+        report = lint_fixture(tmp_path, "serve/app.py", """
+            import time
+
+            async def handler():
+                def cb():
+                    time.sleep(0.1)
+                return cb
+        """)
+        assert rules_found(report) == ["event-loop-blocking"]
+        assert "worker-thread" in report.new[0].message
+
+    def test_blocking_io_in_async_def_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/io.py", """
+            import subprocess
+
+            async def handler(path):
+                with open(path) as f:
+                    data = f.read()
+                subprocess.run(["ls"])
+                return data
+        """)
+        assert sorted(rules_found(report)) == [
+            "event-loop-blocking", "event-loop-blocking"
+        ]
+
+    def test_tier_sleep_outside_async_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/loop.py", """
+            import time
+
+            def worker_loop():
+                time.sleep(0.05)
+        """)
+        assert rules_found(report) == ["event-loop-blocking"]
+
+    def test_rule_scoped_to_serving_tier(self, tmp_path):
+        report = lint_fixture(tmp_path, "runtime/loop.py", """
+            import time
+
+            def worker_loop():
+                time.sleep(0.05)
+        """)
+        assert report.new == []
+
+
+# --- host-sync-in-hot-path ------------------------------------------------
+
+class TestHostSync:
+    def test_hot_path_marker_plus_asarray_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import numpy as np
+
+            def _step(self, packed):  # rdb-lint: hot-path
+                return np.asarray(packed)
+        """)
+        assert rules_found(report) == ["host-sync-in-hot-path"]
+        assert "ONE fetch" in report.new[0].message
+
+    def test_host_literals_are_exempt(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import numpy as np
+
+            def _step(self, xs):  # rdb-lint: hot-path
+                a = np.asarray([1, 2, 3])
+                b = np.asarray([x for x in xs])
+                c = np.asarray(np.stack([a, b]))
+                return a, b, c
+        """)
+        assert report.new == []
+
+    def test_block_until_ready_in_hot_path_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            def _step(self, out):  # rdb-lint: hot-path
+                out.block_until_ready()
+        """)
+        assert rules_found(report) == ["host-sync-in-hot-path"]
+
+    def test_unmarked_function_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import numpy as np
+
+            def warmup(self, out):
+                return np.asarray(out)
+        """)
+        assert report.new == []
+
+    def test_if_on_traced_param_in_jitted_fn_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/k.py", """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                if x:
+                    return x
+                return x + n
+        """)
+        assert rules_found(report) == ["host-sync-in-hot-path"]
+        assert "traced parameter 'x'" in report.new[0].message
+
+    def test_static_and_is_none_branches_are_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/k.py", """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def f(x, mask, n):
+                if n:
+                    return x
+                if mask is None:
+                    return x
+                if x.ndim != 2:
+                    return x
+                return x + n
+        """)
+        assert report.new == []
+
+    def test_int_coercion_of_traced_param_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/k.py", """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return int(x)
+        """)
+        assert rules_found(report) == ["host-sync-in-hot-path"]
+
+
+# --- span-hygiene ---------------------------------------------------------
+
+class TestSpanHygiene:
+    def test_unentered_span_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/t.py", """
+            from ray_dynamic_batching_tpu.utils.tracing import tracer
+
+            def handler():
+                tracer().span("orphan")
+        """)
+        assert rules_found(report) == ["span-hygiene"]
+        assert "never runs" in report.new[0].message
+
+    def test_with_and_enter_context_are_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/t.py", """
+            from contextlib import ExitStack
+            from ray_dynamic_batching_tpu.utils.tracing import tracer
+
+            def handler():
+                with tracer().span("hop") as sp:
+                    with ExitStack() as spans:
+                        spans.enter_context(
+                            tracer().attach_context({}, "inner")
+                        )
+                return sp
+        """)
+        assert report.new == []
+
+    def test_exporter_call_outside_try_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "utils/tr.py", """
+            def _finish(self, s):
+                self._exporter(s)
+        """)
+        assert rules_found(report) == ["span-hygiene"]
+        assert "exporter" in report.new[0].message
+
+    def test_exporter_call_inside_try_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "utils/tr.py", """
+            def _finish(self, s):
+                try:
+                    self._exporter(s)
+                except Exception:
+                    pass
+        """)
+        assert report.new == []
+
+
+# --- pragmas --------------------------------------------------------------
+
+SLEEPY = """
+    import time
+
+    def worker_loop():
+        time.sleep(0.05){pragma}
+"""
+
+
+class TestPragmas:
+    def test_reasoned_pragma_suppresses(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/w.py",
+            SLEEPY.format(pragma="  # rdb-lint: disable="
+                          "event-loop-blocking (pacing thread)"),
+        )
+        assert report.new == []
+        assert report.pragma_suppressed == 1
+
+    def test_reasonless_pragma_suppresses_nothing_and_is_reported(
+            self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/w.py",
+            SLEEPY.format(pragma="  # rdb-lint: disable="
+                          "event-loop-blocking"),
+        )
+        assert sorted(rules_found(report)) == [
+            "event-loop-blocking", "pragma-hygiene"
+        ]
+
+    def test_unknown_rule_in_pragma_is_reported(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/w.py",
+            SLEEPY.format(pragma="  # rdb-lint: disable=no-such-rule "
+                          "(because)"),
+        )
+        assert "pragma-hygiene" in rules_found(report)
+
+    def test_unused_pragma_is_reported(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/w.py", """
+            def quiet():  # rdb-lint: disable=event-loop-blocking (stale)
+                return 1
+        """)
+        assert rules_found(report) == ["pragma-hygiene"]
+        assert "unused" in report.new[0].message
+
+
+# --- baseline ratchet -----------------------------------------------------
+
+def _baseline(entries):
+    return {"version": 1, "entries": entries}
+
+
+class TestBaseline:
+    def test_baselined_finding_does_not_fail(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/w.py", SLEEPY.format(pragma=""),
+            baseline=_baseline([{
+                "rule": "event-loop-blocking", "path": "engine/w.py",
+                "symbol": "worker_loop", "count": 1,
+                "reason": "legacy pacing loop; tracked for conversion",
+            }]),
+        )
+        assert report.new == [] and not report.failed
+        assert len(report.baselined) == 1
+
+    def test_growth_past_baseline_fails(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/w.py", """
+            import time
+
+            def worker_loop():
+                time.sleep(0.05)
+                time.sleep(0.06)
+            """,
+            baseline=_baseline([{
+                "rule": "event-loop-blocking", "path": "engine/w.py",
+                "symbol": "worker_loop", "count": 1, "reason": "legacy",
+            }]),
+        )
+        assert len(report.new) == 1 and report.failed
+
+    def test_stale_baseline_fails_the_ratchet(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/w.py", SLEEPY.format(pragma=""),
+            baseline=_baseline([{
+                "rule": "event-loop-blocking", "path": "engine/w.py",
+                "symbol": "worker_loop", "count": 2, "reason": "legacy",
+            }]),
+        )
+        assert report.failed
+        assert any("may only shrink" in e for e in report.errors)
+
+    def test_scoped_rules_run_does_not_trip_staleness(self, tmp_path):
+        # A --rules-scoped run never executed the entry's rule: "not
+        # scanned" must not be misread as "fixed" (the ratchet only
+        # judges entries the run could have re-found).
+        report = lint_fixture(
+            tmp_path, "engine/w.py", SLEEPY.format(pragma=""),
+            baseline=_baseline([{
+                "rule": "event-loop-blocking", "path": "engine/w.py",
+                "symbol": "worker_loop", "count": 1, "reason": "legacy",
+            }]),
+            rules={"vmem-budget"},
+        )
+        assert not report.failed, report.format_text()
+
+    def test_path_scoped_run_does_not_trip_staleness(self, tmp_path):
+        (tmp_path / "ops").mkdir()
+        (tmp_path / "ops" / "clean.py").write_text("X = 1\n")
+        (tmp_path / "engine").mkdir()
+        (tmp_path / "engine" / "w.py").write_text(
+            textwrap.dedent(SLEEPY.format(pragma=""))
+        )
+        report = run(
+            paths=[tmp_path / "ops"], root=tmp_path,
+            baseline=_baseline([{
+                "rule": "event-loop-blocking", "path": "engine/w.py",
+                "symbol": "worker_loop", "count": 1, "reason": "legacy",
+            }]),
+        )
+        assert not report.failed, report.format_text()
+
+    def test_unknown_rule_in_baseline_fails(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/w.py", SLEEPY.format(pragma=""),
+            baseline=_baseline([{
+                "rule": "no-such-rule", "path": "engine/w.py",
+                "symbol": "worker_loop", "count": 1, "reason": "typo",
+            }]),
+        )
+        assert any("unknown rule" in e for e in report.errors)
+
+    def test_reasonless_baseline_entry_fails(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/w.py", SLEEPY.format(pragma=""),
+            baseline=_baseline([{
+                "rule": "event-loop-blocking", "path": "engine/w.py",
+                "symbol": "worker_loop", "count": 1, "reason": "",
+            }]),
+        )
+        assert report.failed
+        assert any("no reason" in e for e in report.errors)
+
+
+# --- shared footprint math (the no-drift pins) ----------------------------
+
+class TestSharedTileMath:
+    def test_decode_tile_bytes_matches_legacy_inline_formula(self):
+        # The formula _pick_sb used to carry inline, replayed against
+        # the shared helper on the H=64 geometry PR 1 fixed (bf16,
+        # S=1024, kb=16) and a spread of others.
+        for sb in (128, 256, 448, 1024):
+            for kb in (4, 8, 16):
+                for H in (64, 128):
+                    for itemsize in (1, 2, 4):
+                        for with_mask in (False, True):
+                            for with_scales in (False, True):
+                                sublane = {4: 8, 2: 16, 1: 32}[itemsize]
+                                lane_h = -(-H // 128) * 128
+                                kv = (2 * sb * -(-kb // sublane) * sublane
+                                      * lane_h * itemsize)
+                                lane_sb = -(-sb // 128) * 128
+                                mask_b = 32 * lane_sb if with_mask else 0
+                                scale_b = (2 * -(-kb // 8) * 8 * lane_sb
+                                           * 4 if with_scales else 0)
+                                legacy = 2 * (kv + mask_b + scale_b)
+                                assert tm.decode_tile_bytes(
+                                    sb, kb, H, itemsize, with_mask,
+                                    with_scales=with_scales,
+                                ) == legacy
+
+    def test_runtime_picker_and_static_model_agree_on_h64(self):
+        # PR 1's geometry: the picked tile must satisfy the shared
+        # model and the whole-S tile must violate it — from BOTH sides.
+        S, kb, H, itemsize = 1024, 16, 64, 2
+        sb = da._pick_sb(S, kb, H, itemsize, True)
+        assert 0 < sb < S
+        assert tm.decode_tile_bytes(sb, kb, H, itemsize, True) \
+            <= tm.VMEM_BLOCK_BUDGET_BYTES
+        assert tm.decode_tile_bytes(S, kb, H, itemsize, True) \
+            > tm.VMEM_BLOCK_BUDGET_BYTES
+        assert da.VMEM_BLOCK_BUDGET_BYTES == tm.VMEM_BLOCK_BUDGET_BYTES
+
+    def test_no_duplicated_math_in_decode_attention(self):
+        src = open(da.__file__).read()
+        assert "decode_tile_bytes" in src
+        # the sublane-pack table lives ONLY in tile_math now
+        assert "{4: 8, 2: 16, 1: 32}" not in src
+
+    def test_linter_loads_the_same_model(self):
+        lm = tile_math_module()
+        assert lm.VMEM_BLOCK_BUDGET_BYTES == tm.VMEM_BLOCK_BUDGET_BYTES
+        assert lm.decode_tile_bytes(1024, 16, 64, 2, True) == \
+            tm.decode_tile_bytes(1024, 16, 64, 2, True)
+
+    def test_f32_is_worst_case_itemsize(self):
+        # The vmem-budget checker evaluates at itemsize 4; pin that this
+        # upper-bounds every narrower dtype for any block shape.
+        for shape in ((1, 1024, 16, 64), (1, 128, 8, 128), (1, 5, 3),
+                      (7,), (1, 448, 8, 64)):
+            f32 = tm.padded_block_bytes(shape, 4)
+            assert f32 >= tm.padded_block_bytes(shape, 2)
+            assert f32 >= tm.padded_block_bytes(shape, 1)
+
+
+# --- the shipped tree + CLI ----------------------------------------------
+
+class TestShippedTree:
+    def test_tree_is_clean_under_shipped_baseline(self):
+        report = run(baseline=load_baseline(lint_core.DEFAULT_BASELINE))
+        assert not report.failed, report.format_text()
+
+    def test_cli_list_rules(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("vmem-budget", "tile-alignment",
+                     "event-loop-blocking", "host-sync-in-hot-path",
+                     "span-hygiene"):
+            assert rule in out
+
+    def test_cli_json_output_and_exit_code(self, tmp_path, capsys):
+        path = tmp_path / "serve" / "app.py"
+        path.parent.mkdir(parents=True)
+        path.write_text(
+            "import time\n\nasync def h():\n    time.sleep(1)\n"
+        )
+        rc = lint_main([str(tmp_path), "--json", "--no-baseline"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1 and payload["failed"]
+        assert payload["new"][0]["rule"] == "event-loop-blocking"
+
+    def test_cli_rejects_unknown_rule(self):
+        assert lint_main(["--rules", "bogus"]) == 2
+
+    def test_missing_path_is_an_error_not_a_silent_clean(self, tmp_path):
+        report = run(paths=[tmp_path / "nope"], root=tmp_path)
+        assert report.failed
+        assert any("does not exist" in e for e in report.errors)
+
+    def test_rules_pragma_hygiene_still_scans_files(self, tmp_path):
+        # pragma-hygiene is not a Checker; a --rules run selecting only
+        # it must still collect files rather than report a false clean.
+        report = lint_fixture(
+            tmp_path, "engine/w.py",
+            SLEEPY.format(pragma="  # rdb-lint: disable="
+                          "event-loop-blocking"),
+            rules={"pragma-hygiene"},
+        )
+        assert report.files_scanned == 1
+        assert rules_found(report) == ["pragma-hygiene"]

@@ -14,6 +14,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [ "${1:-}" = "fast" ]; then
+  echo "== rdb-lint static analysis gate =="
+  python -m tools.lint
   echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
   python tools/check_openmetrics.py --smoke
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
@@ -27,6 +29,11 @@ if [ "${1:-}" = "8b" ]; then
     "tests/test_tp_decode.py::TestLlama8BInt8" \
     "tests/test_tp_decode.py::TestLlama8BInt8KV" -q
 fi
+
+echo "== rdb-lint static analysis gate =="
+# Fails on any non-baselined finding and on baseline growth/staleness;
+# the summary line keeps lint noise visible in CI logs either way.
+python -m tools.lint
 
 echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
 python tools/check_openmetrics.py --smoke

@@ -1,0 +1,33 @@
+"""rdb-lint — project-native static analysis for the framework.
+
+``python -m tools.lint`` runs five AST checkers over the package, each
+guarding an invariant generic linters cannot see:
+
+=====================  ==================================================
+rule                   invariant
+=====================  ==================================================
+vmem-budget            every Pallas call's padded, double-buffered block
+                       footprint fits VMEM_BLOCK_BUDGET_BYTES (shared
+                       model: ops/tile_math.py == runtime _pick_sb)
+tile-alignment         BlockSpec trailing dims don't silently pad
+                       (lane % 128, sublane % packing)
+event-loop-blocking    no blocking calls on the asyncio serving path;
+                       worker-thread sleeps carry reasoned pragmas
+host-sync-in-hot-path  decode/step loop syncs are explicit; no Python
+                       branches on traced values inside jitted fns
+span-hygiene           spans always enter/exit; exporter exceptions are
+                       contained off the request path
+=====================  ==================================================
+
+See tools/lint/core.py for pragmas (`# rdb-lint: disable=<rule>
+(reason)`, reason mandatory) and the baseline ratchet
+(tools/lint/baseline.json, may only shrink).
+"""
+
+from tools.lint.core import (  # noqa: F401
+    Finding,
+    Report,
+    known_rules,
+    load_baseline,
+    run,
+)

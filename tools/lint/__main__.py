@@ -1,0 +1,71 @@
+"""CLI for rdb-lint: ``python -m tools.lint [paths...] [options]``.
+
+Exit codes: 0 clean (baselined/pragma-suppressed findings are clean),
+1 new findings or baseline errors (ratchet growth/staleness), 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.lint.core import (
+    DEFAULT_BASELINE,
+    known_rules,
+    load_baseline,
+    run,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="Project-native static analysis (rdb-lint).",
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files/dirs to lint (default: ray_dynamic_batching_tpu/)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                        help="baseline ratchet file (default: %(default)s)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report everything)")
+    parser.add_argument("--rules",
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print known rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print("\n".join(known_rules()))
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(known_rules())
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    baseline = None
+    if not args.no_baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    report = run(paths=args.paths or None, baseline=baseline, rules=rules)
+    print(report.to_json() if args.json else report.format_text())
+    return 1 if report.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

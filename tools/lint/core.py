@@ -1,0 +1,474 @@
+"""rdb-lint core — the shared AST walk, pragmas, baseline ratchet, report.
+
+Project-native static analysis: every checker encodes an invariant the
+framework's correctness depends on but generic linters cannot see
+(VMEM budgets, TPU tile padding, event-loop discipline, host-sync
+points, span hygiene). The framework gives every rule the same
+machinery:
+
+- ONE parse + ONE recursive walk per file; checkers receive every node
+  along with the scope state (enclosing functions, async-ness,
+  try-protection, with-statement context expressions).
+- per-line suppression pragmas ``# rdb-lint: disable=<rule>[,<rule>]
+  (reason)`` — the reason string is MANDATORY; a reasonless pragma
+  suppresses nothing and is itself reported (``pragma-hygiene``), as
+  are unknown rule names and pragmas that suppress nothing.
+- a baseline ratchet (``tools/lint/baseline.json``): findings listed
+  there (with a written reason) don't fail CI, but the baseline may
+  only shrink — a stale entry (fewer findings than baselined) fails the
+  run until the baseline is re-written smaller.
+- text and ``--json`` output plus exit-code gating for CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_TARGET = REPO_ROOT / "ray_dynamic_batching_tpu"
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# Rules a pragma/baseline may name. ``pragma-hygiene`` findings are the
+# framework's own and can be neither suppressed nor baselined.
+RULE_PRAGMA_HYGIENE = "pragma-hygiene"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*rdb-lint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"\s*(?:\((.*)\))?\s*$"
+)
+HOT_PATH_MARK_RE = re.compile(r"#\s*rdb-lint:\s*hot-path\b")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    symbol: str = ""     # enclosing dotted def/class name — the baseline key
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message, "symbol": self.symbol,
+        }
+
+    def format(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}{sym}"
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+    used: Set[str] = field(default_factory=set)
+
+
+class FileCtx:
+    """Everything checkers share about one file: source, tree, pragmas,
+    with-statement context expressions, hot-path marks."""
+
+    def __init__(self, path: Path, relpath: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.pragmas: Dict[int, Pragma] = {}
+        self.hot_marked_lines: Set[int] = set()
+        for i, text in enumerate(self.lines, 1):
+            m = _PRAGMA_RE.search(text)
+            if m:
+                rules = tuple(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                self.pragmas[i] = Pragma(i, rules, (m.group(2) or "").strip())
+            if HOT_PATH_MARK_RE.search(text):
+                self.hot_marked_lines.add(i)
+        # Call nodes legitimately consumed as context managers: the
+        # context_expr of a with/async-with item, or the argument of an
+        # ExitStack.enter_context(...) call.
+        self.with_context_calls: Set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        self.with_context_calls.add(id(item.context_expr))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "enter_context"
+            ):
+                for arg in node.args:
+                    if isinstance(arg, ast.Call):
+                        self.with_context_calls.add(id(arg))
+
+
+class Scope:
+    """Mutable walk state the driver maintains; checkers read it."""
+
+    def __init__(self) -> None:
+        # (node, is_async) innermost-last; lambdas push (node, False).
+        self.func_stack: List[Tuple[ast.AST, bool]] = []
+        self.class_stack: List[str] = []
+        self.try_depth = 0  # enclosing try-bodies that have an except
+
+    @property
+    def in_async(self) -> bool:
+        """True when the nearest enclosing function is ``async def`` —
+        code here runs on the event loop (a nested sync def resets it:
+        that body runs wherever it is later called)."""
+        if not self.func_stack:
+            return False
+        return self.func_stack[-1][1]
+
+    def current_function(self) -> Optional[ast.AST]:
+        return self.func_stack[-1][0] if self.func_stack else None
+
+    def symbol(self) -> str:
+        parts = list(self.class_stack)
+        for node, _ in self.func_stack:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parts.append(node.name)
+            else:
+                parts.append("<lambda>")
+        return ".".join(parts) or "<module>"
+
+
+class Checker:
+    """Base checker: subclasses set ``rule`` and override hooks."""
+
+    rule: str = ""
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def begin_file(self, ctx: FileCtx) -> None:  # pragma: no cover - hook
+        pass
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        raise NotImplementedError
+
+    # Populated by the driver per run.
+    findings: List[Finding]
+
+    def report(self, ctx: FileCtx, node: ast.AST, message: str,
+               scope: Optional[Scope] = None) -> None:
+        self.findings.append(Finding(
+            rule=self.rule,
+            path=ctx.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            symbol=scope.symbol() if scope is not None else "",
+        ))
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None — the shared
+    call-target matcher for every checker."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _dir_parts(relpath: str) -> Set[str]:
+    return set(Path(relpath).parts[:-1])
+
+
+def in_dirs(relpath: str, names: Iterable[str]) -> bool:
+    """True when any directory component of ``relpath`` matches a name —
+    so rules scope the real tree (ray_dynamic_batching_tpu/ops/...) and
+    test fixture trees (ops/...) identically."""
+    return bool(_dir_parts(relpath) & set(names))
+
+
+class _Walker:
+    """The single shared recursive walk: maintains Scope, dispatches
+    every node to every applicable checker."""
+
+    def __init__(self, ctx: FileCtx, checkers: Sequence[Checker]) -> None:
+        self.ctx = ctx
+        self.checkers = checkers
+        self.scope = Scope()
+
+    def walk(self, node: ast.AST) -> None:
+        for checker in self.checkers:
+            checker.visit(node, self.ctx, self.scope)
+
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.scope.func_stack.append(
+                (node, isinstance(node, ast.AsyncFunctionDef))
+            )
+            for child in ast.iter_child_nodes(node):
+                self.walk(child)
+            self.scope.func_stack.pop()
+        elif isinstance(node, ast.Lambda):
+            self.scope.func_stack.append((node, False))
+            for child in ast.iter_child_nodes(node):
+                self.walk(child)
+            self.scope.func_stack.pop()
+        elif isinstance(node, ast.ClassDef):
+            self.scope.class_stack.append(node.name)
+            for child in ast.iter_child_nodes(node):
+                self.walk(child)
+            self.scope.class_stack.pop()
+        elif isinstance(node, ast.Try) and node.handlers:
+            self.scope.try_depth += 1
+            for child in node.body:
+                self.walk(child)
+            self.scope.try_depth -= 1
+            for part in (node.handlers, node.orelse, node.finalbody):
+                for child in part:
+                    self.walk(child)
+        else:
+            for child in ast.iter_child_nodes(node):
+                self.walk(child)
+
+
+@dataclass
+class Report:
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    pragma_suppressed: int = 0
+    files_scanned: int = 0
+    errors: List[str] = field(default_factory=list)
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.new) or bool(self.errors)
+
+    def summary(self) -> str:
+        return (
+            f"rdb-lint: {self.files_scanned} files, "
+            f"{len(self.new)} new finding(s), "
+            f"{len(self.baselined)} baselined, "
+            f"{self.pragma_suppressed} pragma-suppressed"
+            + (f", {len(self.errors)} error(s)" if self.errors else "")
+        )
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "new": [f.to_dict() for f in self.new],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "pragma_suppressed": self.pragma_suppressed,
+            "files_scanned": self.files_scanned,
+            "errors": self.errors,
+            "failed": self.failed,
+        }, indent=2)
+
+    def format_text(self) -> str:
+        out = [f.format() for f in self.new]
+        out += [f"error: {e}" for e in self.errors]
+        out.append(self.summary())
+        return "\n".join(out)
+
+
+def _all_checkers() -> List[Checker]:
+    # Imported here (not at module top) so ``core`` has no import cycle
+    # with the rule modules.
+    from tools.lint.event_loop import EventLoopBlockingChecker
+    from tools.lint.host_sync import HostSyncChecker
+    from tools.lint.spans import SpanHygieneChecker
+    from tools.lint.vmem import TileAlignmentChecker, VmemBudgetChecker
+
+    return [
+        VmemBudgetChecker(),
+        TileAlignmentChecker(),
+        EventLoopBlockingChecker(),
+        HostSyncChecker(),
+        SpanHygieneChecker(),
+    ]
+
+
+def known_rules() -> List[str]:
+    return [c.rule for c in _all_checkers()] + [RULE_PRAGMA_HYGIENE]
+
+
+def _collect_files(paths: Sequence[Path]) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def load_baseline(path: Path) -> Dict[str, Any]:
+    if not path.exists():
+        return {"version": 1, "entries": []}
+    return json.loads(path.read_text())
+
+
+def run(
+    paths: Optional[Sequence[Path]] = None,
+    baseline: Optional[Dict[str, Any]] = None,
+    rules: Optional[Set[str]] = None,
+    root: Optional[Path] = None,
+) -> Report:
+    """Lint ``paths`` (default: the whole package) and return a Report.
+
+    ``root`` anchors relative paths for rule scoping and baseline keys;
+    it defaults to the repo root (tests point it at fixture trees).
+    ``baseline`` is the parsed baseline dict (``load_baseline``), or
+    None for no baseline.
+    """
+    root = (root or REPO_ROOT).resolve()
+    target_paths = [Path(p) for p in (paths or [DEFAULT_TARGET])]
+    checkers = [
+        c for c in _all_checkers() if rules is None or c.rule in rules
+    ]
+    # pragma-hygiene is the framework's own pass, not a Checker: it must
+    # still collect files (a `--rules pragma-hygiene` audit that scanned
+    # nothing would report a false clean).
+    hygiene_active = rules is None or RULE_PRAGMA_HYGIENE in rules
+    report = Report()
+    all_findings: List[Finding] = []
+    contexts: Dict[str, FileCtx] = {}
+
+    for p in target_paths:
+        if not p.exists():
+            # A typo'd path must never gate CI as a silent 0-file clean.
+            report.errors.append(f"path does not exist: {p}")
+
+    for path in _collect_files(target_paths):
+        path = path.resolve()
+        try:
+            rel = path.relative_to(root).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        applicable = [c for c in checkers if c.applies(rel)]
+        if not applicable and not hygiene_active:
+            continue
+        try:
+            ctx = FileCtx(path, rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            report.errors.append(f"{rel}: unparseable: {e}")
+            continue
+        contexts[rel] = ctx
+        report.files_scanned += 1
+        for checker in applicable:
+            checker.findings = all_findings
+            checker.begin_file(ctx)
+        _Walker(ctx, applicable).walk(ctx.tree)
+
+    # --- pragma suppression (reason mandatory) ---------------------------
+    survivors: List[Finding] = []
+    for f in all_findings:
+        pragma = contexts.get(f.path) and contexts[f.path].pragmas.get(f.line)
+        if (
+            pragma
+            and f.rule in pragma.rules
+            and pragma.reason
+            and f.rule != RULE_PRAGMA_HYGIENE
+        ):
+            pragma.used.add(f.rule)
+            report.pragma_suppressed += 1
+        else:
+            survivors.append(f)
+
+    # --- pragma hygiene ---------------------------------------------------
+    valid_rules = set(known_rules())
+    for ctx in contexts.values() if hygiene_active else ():
+        for pragma in ctx.pragmas.values():
+            if not pragma.reason:
+                survivors.append(Finding(
+                    RULE_PRAGMA_HYGIENE, ctx.relpath, pragma.line, 0,
+                    "pragma has no reason — a suppression must say why "
+                    "(`# rdb-lint: disable=<rule> (reason)`); it "
+                    "suppresses nothing until it does",
+                ))
+                continue
+            for r in pragma.rules:
+                if r not in valid_rules:
+                    survivors.append(Finding(
+                        RULE_PRAGMA_HYGIENE, ctx.relpath, pragma.line, 0,
+                        f"pragma names unknown rule '{r}' "
+                        f"(known: {', '.join(sorted(valid_rules))})",
+                    ))
+                elif (
+                    r not in pragma.used
+                    and (rules is None or r in rules)
+                ):
+                    survivors.append(Finding(
+                        RULE_PRAGMA_HYGIENE, ctx.relpath, pragma.line, 0,
+                        f"unused suppression for '{r}' — the rule finds "
+                        "nothing on this line; delete the pragma",
+                    ))
+
+    # --- baseline ratchet -------------------------------------------------
+    if baseline:
+        remaining: Dict[Tuple[str, str, str], int] = {}
+        valid_baseline_rules = {c.rule for c in _all_checkers()}
+        for i, entry in enumerate(baseline.get("entries", [])):
+            key = (entry.get("rule", ""), entry.get("path", ""),
+                   entry.get("symbol", ""))
+            if not entry.get("reason", "").strip():
+                report.errors.append(
+                    f"baseline entry {i} {key} has no reason — every "
+                    "baselined finding must say why it is tolerated"
+                )
+            if entry.get("rule") == RULE_PRAGMA_HYGIENE:
+                report.errors.append(
+                    f"baseline entry {i} baselines '{RULE_PRAGMA_HYGIENE}'"
+                    " — fix the pragma instead"
+                )
+                continue
+            if entry.get("rule") not in valid_baseline_rules:
+                report.errors.append(
+                    f"baseline entry {i} names unknown rule "
+                    f"'{entry.get('rule')}'"
+                )
+                continue
+            remaining[key] = remaining.get(key, 0) + int(
+                entry.get("count", 1)
+            )
+        for f in survivors:
+            if remaining.get(f.key(), 0) > 0:
+                remaining[f.key()] -= 1
+                report.baselined.append(f)
+            else:
+                report.new.append(f)
+        # Staleness (the may-only-shrink ratchet) is judged ONLY for
+        # entries this run could actually have re-found: the entry's
+        # rule must be active and its file scanned by that rule. A
+        # path- or --rules-scoped invocation must not misread
+        # "not scanned" as "fixed".
+        active_rules = {c.rule for c in checkers}
+        for key, count in sorted(remaining.items()):
+            rule, path_, _sym = key
+            in_scope = (
+                count > 0
+                and rule in active_rules
+                and path_ in contexts
+                and any(
+                    c.rule == rule and c.applies(path_) for c in checkers
+                )
+            )
+            if in_scope:
+                report.errors.append(
+                    f"baseline is stale: {key[0]} at {key[1]} [{key[2]}] "
+                    f"over-budgets by {count} — the baseline may only "
+                    "shrink; rewrite it without the fixed finding(s)"
+                )
+    else:
+        report.new.extend(survivors)
+
+    report.new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report

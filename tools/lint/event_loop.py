@@ -1,0 +1,103 @@
+"""event-loop-blocking — no blocking calls on the asyncio serving path.
+
+The serving tier (``serve/``, ``engine/``) mixes an asyncio front door
+(the HTTP proxy's event loop) with worker threads (replica loops,
+decode engines). A blocking call on the EVENT LOOP stalls every live
+connection at once — the classic invisible-until-loaded bug. Two
+lexical tiers:
+
+- **hard** (inside ``async def``): ``time.sleep``, blocking file/socket
+  IO (``open``, ``socket.*``, ``urllib.request.urlopen``,
+  ``requests.*``), ``subprocess.run``-family, and
+  ``concurrent.futures.Future.result()`` — each has an async
+  counterpart (``await asyncio.sleep``, ``asyncio.to_thread``,
+  ``asyncio.wrap_future``). A nested sync ``def`` resets the scope (its
+  body runs wherever it is later called).
+- **tier-wide**: ``time.sleep`` ANYWHERE in serve/engine. Worker-thread
+  pacing loops are legitimate — but each one must say so with a
+  reasoned pragma, because the same helper is one refactor away from
+  running under the proxy's loop (exactly how the router's backoff
+  sleep used to reach the event loop through ``handle.remote``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.lint.core import (
+    Checker, FileCtx, Scope, dotted_name as _dotted, in_dirs,
+)
+
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output", "Popen"}
+
+
+class EventLoopBlockingChecker(Checker):
+    rule = "event-loop-blocking"
+
+    def applies(self, relpath: str) -> bool:
+        return in_dirs(relpath, {"serve", "engine"})
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        dotted = _dotted(node.func) or ""
+
+        if dotted == "time.sleep":
+            if scope.in_async:
+                self.report(
+                    ctx, node,
+                    "time.sleep inside `async def` blocks the event loop "
+                    "for every connection — use `await asyncio.sleep(...)`",
+                    scope,
+                )
+            else:
+                self.report(
+                    ctx, node,
+                    "blocking sleep in the serving tier: on the event "
+                    "loop this stalls every connection; a deliberate "
+                    "worker-thread pacing/poll loop must say so with "
+                    "`# rdb-lint: disable=event-loop-blocking (reason)`",
+                    scope,
+                )
+            return
+
+        if not scope.in_async:
+            return
+
+        head = dotted.split(".", 1)[0] if dotted else ""
+        if head == "subprocess" and dotted.split(".")[-1] in \
+                _SUBPROCESS_BLOCKING:
+            self.report(
+                ctx, node,
+                f"{dotted} inside `async def` blocks the loop for the "
+                "child's lifetime — use asyncio.create_subprocess_exec "
+                "or offload via asyncio.to_thread", scope,
+            )
+        elif dotted == "open":
+            self.report(
+                ctx, node,
+                "blocking file IO inside `async def` — offload via "
+                "asyncio.to_thread (disk stalls are event-loop stalls)",
+                scope,
+            )
+        elif head == "socket" or dotted in (
+            "urllib.request.urlopen", "urlopen"
+        ) or head == "requests":
+            self.report(
+                ctx, node,
+                f"blocking network IO ({dotted}) inside `async def` — "
+                "use asyncio streams or offload via asyncio.to_thread",
+                scope,
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "result"
+            and _dotted(node.func.value) != "asyncio"
+        ):
+            self.report(
+                ctx, node,
+                "Future.result() inside `async def` parks the event loop "
+                "until the future resolves — "
+                "`await asyncio.wrap_future(fut)` instead", scope,
+            )

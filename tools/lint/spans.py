@@ -1,0 +1,82 @@
+"""span-hygiene — every span closes; exporter failures stay contained.
+
+PR 1's flight recorder rests on two invariants this rule locks in:
+
+- ``tracer().span(...)`` / ``tracer().attach_context(...)`` are
+  @contextmanager generators: calling one WITHOUT entering it (a bare
+  ``tracer().span("x")`` statement or assignment) never runs the
+  generator — no span starts, none finishes, and the trace silently
+  loses a hop. Every such call must be the context expression of a
+  ``with`` (or fed to ``ExitStack.enter_context``); one-shot intervals
+  use ``record_span`` instead, which needs no closing.
+- the exporter sink is user/IO code running inside queue pops and
+  engine hot loops: an uncaught exporter exception there drops
+  already-popped requests on the floor. Any direct call to an
+  ``exporter`` / ``_exporter`` callable must sit inside a
+  ``try/except``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.lint.core import Checker, FileCtx, Scope
+
+
+def _mentions_tracer(node: ast.AST) -> bool:
+    """True when the receiver chain is rooted in a tracer: tracer(),
+    _tracer(), self._tracer, tracing.tracer(), or bare self inside
+    utils/tracing.py's own Tracer methods (handled by caller scope)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("tracer", "_tracer"):
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+            "tracer", "_tracer"
+        ):
+            return True
+    return False
+
+
+class SpanHygieneChecker(Checker):
+    rule = "span-hygiene"
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        fn = node.func
+
+        if isinstance(fn, ast.Attribute) and fn.attr in (
+            "span", "attach_context"
+        ):
+            receiver_is_tracer = _mentions_tracer(fn.value) or (
+                # Tracer's own methods open spans on self.
+                ctx.relpath.endswith("utils/tracing.py")
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"
+            )
+            if receiver_is_tracer and id(node) not in \
+                    ctx.with_context_calls:
+                self.report(
+                    ctx, node,
+                    f"tracer {fn.attr}(...) called outside a `with` / "
+                    "ExitStack.enter_context — the contextmanager never "
+                    "runs, so the span neither starts nor finishes and "
+                    "the trace silently drops this hop; wrap it in "
+                    "`with ... as sp:` or use record_span for "
+                    "already-measured intervals", scope,
+                )
+            return
+
+        callee: Optional[str] = None
+        if isinstance(fn, ast.Name):
+            callee = fn.id
+        elif isinstance(fn, ast.Attribute):
+            callee = fn.attr
+        if callee in ("exporter", "_exporter") and scope.try_depth == 0:
+            self.report(
+                ctx, node,
+                "span exporter invoked outside try/except — exporter "
+                "errors (disk full, closed sink) must degrade tracing, "
+                "never the request path that emitted the span", scope,
+            )
