@@ -15,6 +15,7 @@ outcomes without wall-clock flakiness.
 
 from __future__ import annotations
 
+import json
 import math
 import random
 import threading
@@ -96,7 +97,15 @@ def arrival_times(
 
 class WorkloadDriver:
     """Threaded driver: submits via callback at pattern-scheduled times
-    (one thread per model, ref request_simulator.py:29-42)."""
+    (one thread per model, ref request_simulator.py:29-42).
+
+    ``record_path`` appends one JSONL line ``{"t_s": offset, "model":
+    name}`` per submitted arrival — the replay format the what-if
+    simulator consumes (``sim/workload.load_recorded_arrivals``), so any
+    driven run becomes a reproducible simulation input. Drivers sharing
+    one path append line-buffered (each line lands whole); the CALLER
+    truncates the file once before starting its drivers.
+    """
 
     def __init__(
         self,
@@ -106,6 +115,7 @@ class WorkloadDriver:
         duration_s: float,
         poisson: bool = False,
         seed: int = 0,
+        record_path: Optional[str] = None,
     ) -> None:
         self.submit = submit
         self.model = model
@@ -113,22 +123,60 @@ class WorkloadDriver:
         self.duration_s = duration_s
         self.poisson = poisson
         self.seed = seed
+        self.record_path = record_path
         self.sent = 0
         self._thread: Optional[threading.Thread] = None
 
     def _run(self) -> None:
-        start = time.monotonic()
-        for offset in arrival_times(
-            self.pattern, self.duration_s, self.poisson, self.seed
-        ):
-            delay = start + offset - time.monotonic()
-            if delay > 0:
-                time.sleep(delay)  # rdb-lint: disable=event-loop-blocking (open-loop arrival pacing on the generator's own thread)
+        record = None
+        if self.record_path:
             try:
-                self.submit(self.model, offset)
-                self.sent += 1
-            except Exception:  # noqa: BLE001 — keep driving through errors
-                logger.exception("workload submit failed for %s", self.model)
+                record = open(self.record_path, "a", buffering=1)
+            except OSError:
+                # Recording is a side feature: an unwritable path must
+                # not kill the load-generation thread before it drives.
+                logger.exception(
+                    "cannot record arrivals to %s; driving unrecorded",
+                    self.record_path,
+                )
+        start = time.monotonic()
+        try:
+            for offset in arrival_times(
+                self.pattern, self.duration_s, self.poisson, self.seed
+            ):
+                delay = start + offset - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)  # rdb-lint: disable=event-loop-blocking (open-loop arrival pacing on the generator's own thread)
+                if record is not None:
+                    # Record BEFORE submitting: the trace is OFFERED
+                    # load, and a replay must see arrivals the live run
+                    # failed to deliver (else the recording inherits the
+                    # survivor bias span replays are warned about).
+                    try:
+                        record.write(json.dumps(
+                            {"t_s": round(offset, 6), "model": self.model}
+                        ) + "\n")
+                    except OSError:
+                        # Disk trouble mid-run: a truncated record is not
+                        # replayable — stop recording, keep driving, and
+                        # say which it was (not a submit failure).
+                        logger.exception(
+                            "arrival recording to %s failed; recording "
+                            "stopped, load generation continues",
+                            self.record_path,
+                        )
+                        record.close()
+                        record = None
+                try:
+                    self.submit(self.model, offset)
+                    self.sent += 1
+                except Exception:  # noqa: BLE001 — keep driving through errors
+                    logger.exception(
+                        "workload submit failed for %s", self.model
+                    )
+        finally:
+            if record is not None:
+                record.close()
 
     def start(self) -> "WorkloadDriver":
         self._thread = threading.Thread(

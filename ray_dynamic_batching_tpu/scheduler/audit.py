@@ -61,18 +61,26 @@ class AuditRecord:
 
 
 class AuditLog:
-    """Thread-safe bounded ring of :class:`AuditRecord`."""
+    """Thread-safe bounded ring of :class:`AuditRecord`.
 
-    def __init__(self, domain: str, capacity: int = 256) -> None:
+    ``now`` injects the decision timestamp source (default wall clock):
+    the what-if simulator (``sim/``) passes its virtual clock so replayed
+    replans carry VIRTUAL timestamps and the dashboard timeline renders a
+    simulated run identically to a live one. Live callers are unchanged.
+    """
+
+    def __init__(self, domain: str, capacity: int = 256,
+                 now=time.time) -> None:
         self.domain = domain
         self._ring: deque = deque(maxlen=capacity)
         self._seq = itertools.count(1)
         self._lock = threading.Lock()
+        self._now = now
 
     def record(self, trigger: str, **fields: Any) -> AuditRecord:
         rec = AuditRecord(
             seq=next(self._seq),
-            wall_time=time.time(),
+            wall_time=self._now(),
             domain=self.domain,
             trigger=trigger,
             **fields,

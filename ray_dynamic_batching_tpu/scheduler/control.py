@@ -15,126 +15,37 @@ instead of a flat transfer count (SURVEY.md §7 stage 5).
 
 from __future__ import annotations
 
-import itertools
 import json
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 from ray_dynamic_batching_tpu.engine.queue import QueueManager
 from ray_dynamic_batching_tpu.engine.rates import RateRegistry
 from ray_dynamic_batching_tpu.engine.request import Request
 from ray_dynamic_batching_tpu.engine.worker import ReplicaEngine
-from ray_dynamic_batching_tpu.profiles.table import BatchProfile
-from ray_dynamic_batching_tpu.scheduler.audit import AuditLog, plan_diff
+from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
 from ray_dynamic_batching_tpu.scheduler.nexus import (
     NodePlan,
     Session,
     SquishyBinPacker,
 )
+
+# The decide step is extracted to scheduler/replan.py (pure, clock-free,
+# jax-free) so the what-if simulator (sim/) consumes the SAME logic this
+# threaded path applies — re-exported here for existing importers.
+from ray_dynamic_batching_tpu.scheduler.replan import (  # noqa: F401
+    BRUTE_FORCE_LIMIT,
+    ModelEntry,
+    decide_replan,
+    match_plans_to_engines,
+    sessions_for,
+    transfer_cost,
+)
 from ray_dynamic_batching_tpu.utils.config import get_config
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("control")
-
-BRUTE_FORCE_LIMIT = 7  # assignment is brute-forced up to this many nodes
-
-
-@dataclass
-class ModelEntry:
-    """Registered model contract (ref models_config, scheduler.py:30-35)."""
-
-    name: str
-    slo_ms: float
-    seq_len: int = 0
-
-
-def transfer_cost(
-    engine_models: frozenset,
-    plan: NodePlan,
-    profiles: Dict[str, BatchProfile],
-) -> float:
-    """Cost of pointing an engine at ``plan``: for every model the engine
-    doesn't already host, charge weight bytes (upload) + compile time."""
-    cost = 0.0
-    for p in plan.placements:
-        name = p.session.model
-        if name in engine_models:
-            continue
-        prof = profiles.get(name)
-        if prof is None:
-            cost += 1.0
-            continue
-        row = prof.row_for(p.batch_size, p.session.seq_len) or prof.bucket_for(
-            p.batch_size, p.session.seq_len
-        )
-        compile_ms = row.compile_ms if row else 1000.0
-        weight_mb = prof.weights_hbm_bytes() / 1e6
-        cost += compile_ms + weight_mb  # ms-equivalent weighting
-    return cost
-
-
-def match_plans_to_engines(
-    engine_models: List[frozenset],
-    plans: List[NodePlan],
-    profiles: Dict[str, BatchProfile],
-) -> List[Optional[NodePlan]]:
-    """Assign new node plans to engines minimizing total transfer cost.
-
-    Brute-force over permutations for small counts (the reference's approach,
-    scheduler.py:857-891), greedy best-match beyond BRUTE_FORCE_LIMIT.
-    Returns, per engine, its new plan (None = engine idles).
-    """
-    n_engines = len(engine_models)
-    padded: List[Optional[NodePlan]] = list(plans) + [None] * max(
-        0, n_engines - len(plans)
-    )
-    if len(plans) > n_engines:
-        logger.warning(
-            "plan needs %d chips but only %d engines; truncating (capacity!)",
-            len(plans), n_engines,
-        )
-        padded = list(plans[:n_engines])
-
-    if n_engines <= BRUTE_FORCE_LIMIT:
-        best: Optional[Tuple[float, Tuple[int, ...]]] = None
-        for perm in itertools.permutations(range(n_engines)):
-            cost = sum(
-                transfer_cost(engine_models[e], padded[i], profiles)
-                for i, e in enumerate(perm)
-                if padded[i] is not None
-            )
-            if best is None or cost < best[0]:
-                best = (cost, perm)
-        assignment: List[Optional[NodePlan]] = [None] * n_engines
-        for i, e in enumerate(best[1]):
-            assignment[e] = padded[i]
-        return assignment
-
-    # Greedy: most expensive-to-move plans pick their cheapest engine first.
-    order = sorted(
-        [i for i, p in enumerate(padded) if p is not None],
-        key=lambda i: -max(
-            transfer_cost(m, padded[i], profiles) for m in engine_models
-        ),
-    )
-    free = set(range(n_engines))
-    assignment = [None] * n_engines
-    for i in order:
-        # Tie-break toward engines hosting fewer models so a zero-savings
-        # plan lands on an empty engine instead of displacing a warm one.
-        e = min(
-            free,
-            key=lambda e: (
-                transfer_cost(engine_models[e], padded[i], profiles),
-                len(engine_models[e]),
-                e,
-            ),
-        )
-        assignment[e] = padded[i]
-        free.remove(e)
-    return assignment
 
 
 class LiveScheduler:
@@ -158,6 +69,13 @@ class LiveScheduler:
         self.monitoring_interval_s = cfg.monitoring_interval_s
         self.rate_threshold = cfg.rate_change_threshold
         self.rate_decrease_multiplier = cfg.rate_decrease_multiplier
+        # Cold-window guard (rates.changed_models min_span_s): suppress
+        # replans while the sliding window covers fewer than this many
+        # seconds — a half-filled window under-reads rates by up to
+        # 1/span and a monitor acting on it scales DOWN during rampup
+        # (the inversion the LLM control loop already guards against).
+        # Default 0.0 preserves the historical always-react behavior.
+        self.rate_min_span_s = cfg.rate_min_span_s
         self._clock = clock
         self._models: Dict[str, ModelEntry] = {}
         self._current_plan: List[NodePlan] = []
@@ -191,15 +109,7 @@ class LiveScheduler:
 
     # --- scheduling -------------------------------------------------------
     def _sessions_for(self, rates: Dict[str, float]) -> List[Session]:
-        return [
-            Session(
-                model=e.name,
-                slo_ms=e.slo_ms,
-                rate_rps=rates.get(e.name, 0.0),
-                seq_len=e.seq_len,
-            )
-            for e in self._models.values()
-        ]
+        return sessions_for(self._models, rates)
 
     def rebalance(
         self,
@@ -207,76 +117,49 @@ class LiveScheduler:
         trigger: str = "manual",
     ) -> List[NodePlan]:
         """Re-run bin packing and migrate with minimal movement
-        (ref _update_schedule, scheduler.py:834-929)."""
+        (ref _update_schedule, scheduler.py:834-929). The DECISION —
+        bin-pack, minimal-movement match, audit payload — is the shared
+        pure function (``replan.decide_replan``); this method only reads
+        rates and APPLIES the result to the live engines."""
         with self._lock:
             rates = rates if rates is not None else self.rates.rates()
-            plan = self.packer.plan(self._sessions_for(rates))
-            engine_models = [
-                frozenset(e.models) for e in self.engines
-            ]
-            assignment = match_plans_to_engines(
-                engine_models, plan, self.packer.profiles
+            decision = decide_replan(
+                self.packer,
+                [frozenset(e.models) for e in self.engines],
+                self._sessions_for(rates),
+                rates,
             )
-            # Audit inputs BEFORE applying: the old assignment and the
-            # per-engine cost of moving to the new one (the matcher's own
-            # objective — compile_ms + weight-MB for models not resident).
-            old_models = [sorted(m) for m in engine_models]
-            new_models = [
-                sorted(n.models) if n is not None else [] for n in assignment
-            ]
-            migration_cost = sum(
-                transfer_cost(engine_models[e], n, self.packer.profiles)
-                for e, n in enumerate(assignment)
-                if n is not None
-            )
-            for engine, node_plan in zip(self.engines, assignment):
+            for engine, node_plan in zip(self.engines, decision.assignment):
                 if node_plan is not None:
                     engine.assign(node_plan)
                 elif engine.models:
                     engine.assign(NodePlan())  # idle this engine
-            self._current_plan = plan
-            self._assignment = assignment
+            self._current_plan = decision.plan
+            self._assignment = decision.assignment
             self.rates.mark_scheduled(rates)
             self.schedule_changes += 1
             self.schedule_log.append(
                 {
                     "ts": self._clock(),
                     "rates": dict(rates),
-                    "nodes": [n.describe() for n in plan],
+                    "nodes": [n.describe() for n in decision.plan],
                 }
             )
-            self.audit.record(
-                trigger,
-                observed={"rates_rps": {k: round(v, 2)
-                                        for k, v in rates.items()}},
-                inputs={
-                    # The profile rows the packer committed to: per
-                    # placement, the (batch, latency) row that sized it.
-                    "placements": [
-                        {"model": p.session.model, "batch": p.batch_size,
-                         "latency_ms": round(p.latency_ms, 2),
-                         "occupancy": round(p.occupancy, 3)}
-                        for n in plan for p in n.placements
-                    ],
-                },
-                before=[", ".join(m) for m in old_models],
-                after=[", ".join(m) for m in new_models],
-                diff=plan_diff(old_models, new_models),
-                migration_cost=round(migration_cost, 1),
-            )
+            self.audit.record(trigger, **decision.audit_fields())
             logger.info(
                 "rebalance #%d: %d nodes for rates %s",
-                self.schedule_changes, len(plan),
+                self.schedule_changes, len(decision.plan),
                 {k: round(v, 1) for k, v in rates.items()},
             )
-            return plan
+            return decision.plan
 
     # --- monitor loop (ref _monitor_request_rates, scheduler.py:763-801) --
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.monitoring_interval_s):
             try:
                 changed = self.rates.changed_models(
-                    self.rate_threshold, self.rate_decrease_multiplier
+                    self.rate_threshold, self.rate_decrease_multiplier,
+                    min_span_s=self.rate_min_span_s,
                 )
                 if changed:
                     logger.info("rate change detected: %s", changed)

@@ -1,0 +1,211 @@
+"""The decide-replan step — pure, clock-free, shared by live and simulated.
+
+``LiveScheduler.rebalance`` used to fuse three things: reading rates,
+DECIDING (bin-pack + minimal-movement matching + audit payload), and
+APPLYING (engine.assign, mark_scheduled, audit ring). The decision is a
+pure function of (packer, engine residency, sessions, rates) — no
+threads, no wall clock, no jax — so it lives here, consumed by BOTH the
+threaded live path (`scheduler/control.py`) and the what-if simulator
+(`sim/control.py`). The two callers must never fork this logic: a plan
+the simulator grades is only trustworthy if it is byte-for-byte the plan
+the live control loop would install (the no-drift pin in
+``tests/test_sim.py``, same pattern as ``ops/tile_math.py`` sharing the
+VMEM math between runtime picker and linter).
+
+Reference lineage: rate-triggered replan + minimal-movement matching,
+``293-project/src/scheduler.py:794-929``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.scheduler.audit import plan_diff
+from ray_dynamic_batching_tpu.scheduler.nexus import (
+    NodePlan,
+    Session,
+    SquishyBinPacker,
+)
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("replan")
+
+BRUTE_FORCE_LIMIT = 7  # assignment is brute-forced up to this many nodes
+
+
+@dataclass
+class ModelEntry:
+    """Registered model contract (ref models_config, scheduler.py:30-35)."""
+
+    name: str
+    slo_ms: float
+    seq_len: int = 0
+
+
+def sessions_for(
+    models: Dict[str, ModelEntry], rates: Dict[str, float]
+) -> List[Session]:
+    """Sessions at the observed rates — the packer's input."""
+    return [
+        Session(
+            model=e.name,
+            slo_ms=e.slo_ms,
+            rate_rps=rates.get(e.name, 0.0),
+            seq_len=e.seq_len,
+        )
+        for e in models.values()
+    ]
+
+
+def transfer_cost(
+    engine_models: frozenset,
+    plan: NodePlan,
+    profiles: Dict[str, BatchProfile],
+) -> float:
+    """Cost of pointing an engine at ``plan``: for every model the engine
+    doesn't already host, charge weight bytes (upload) + compile time."""
+    cost = 0.0
+    for p in plan.placements:
+        name = p.session.model
+        if name in engine_models:
+            continue
+        prof = profiles.get(name)
+        if prof is None:
+            cost += 1.0
+            continue
+        row = prof.row_for(p.batch_size, p.session.seq_len) or prof.bucket_for(
+            p.batch_size, p.session.seq_len
+        )
+        compile_ms = row.compile_ms if row else 1000.0
+        weight_mb = prof.weights_hbm_bytes() / 1e6
+        cost += compile_ms + weight_mb  # ms-equivalent weighting
+    return cost
+
+
+def match_plans_to_engines(
+    engine_models: List[frozenset],
+    plans: List[NodePlan],
+    profiles: Dict[str, BatchProfile],
+) -> List[Optional[NodePlan]]:
+    """Assign new node plans to engines minimizing total transfer cost.
+
+    Brute-force over permutations for small counts (the reference's approach,
+    scheduler.py:857-891), greedy best-match beyond BRUTE_FORCE_LIMIT.
+    Returns, per engine, its new plan (None = engine idles).
+    """
+    n_engines = len(engine_models)
+    padded: List[Optional[NodePlan]] = list(plans) + [None] * max(
+        0, n_engines - len(plans)
+    )
+    if len(plans) > n_engines:
+        logger.warning(
+            "plan needs %d chips but only %d engines; truncating (capacity!)",
+            len(plans), n_engines,
+        )
+        padded = list(plans[:n_engines])
+
+    if n_engines <= BRUTE_FORCE_LIMIT:
+        best: Optional[Tuple[float, Tuple[int, ...]]] = None
+        for perm in itertools.permutations(range(n_engines)):
+            cost = sum(
+                transfer_cost(engine_models[e], padded[i], profiles)
+                for i, e in enumerate(perm)
+                if padded[i] is not None
+            )
+            if best is None or cost < best[0]:
+                best = (cost, perm)
+        assignment: List[Optional[NodePlan]] = [None] * n_engines
+        for i, e in enumerate(best[1]):
+            assignment[e] = padded[i]
+        return assignment
+
+    # Greedy: most expensive-to-move plans pick their cheapest engine first.
+    order = sorted(
+        [i for i, p in enumerate(padded) if p is not None],
+        key=lambda i: -max(
+            transfer_cost(m, padded[i], profiles) for m in engine_models
+        ),
+    )
+    free = set(range(n_engines))
+    assignment = [None] * n_engines
+    for i in order:
+        # Tie-break toward engines hosting fewer models so a zero-savings
+        # plan lands on an empty engine instead of displacing a warm one.
+        e = min(
+            free,
+            key=lambda e: (
+                transfer_cost(engine_models[e], padded[i], profiles),
+                len(engine_models[e]),
+                e,
+            ),
+        )
+        assignment[e] = padded[i]
+        free.remove(e)
+    return assignment
+
+
+@dataclass
+class ReplanDecision:
+    """Everything one replan decided, before anything is applied."""
+
+    plan: List[NodePlan]
+    assignment: List[Optional[NodePlan]]   # per engine; None = idle
+    old_models: List[List[str]] = field(default_factory=list)
+    new_models: List[List[str]] = field(default_factory=list)
+    migration_cost: float = 0.0
+    rates: Dict[str, float] = field(default_factory=dict)
+
+    def audit_fields(self) -> Dict[str, Any]:
+        """The structured-audit payload (``scheduler/audit.py``), built
+        fresh per call so rings never alias a shared dict."""
+        return {
+            "observed": {"rates_rps": {k: round(v, 2)
+                                       for k, v in self.rates.items()}},
+            "inputs": {
+                # The profile rows the packer committed to: per
+                # placement, the (batch, latency) row that sized it.
+                "placements": [
+                    {"model": p.session.model, "batch": p.batch_size,
+                     "latency_ms": round(p.latency_ms, 2),
+                     "occupancy": round(p.occupancy, 3)}
+                    for n in self.plan for p in n.placements
+                ],
+            },
+            "before": [", ".join(m) for m in self.old_models],
+            "after": [", ".join(m) for m in self.new_models],
+            "diff": plan_diff(self.old_models, self.new_models),
+            "migration_cost": round(self.migration_cost, 1),
+        }
+
+
+def decide_replan(
+    packer: SquishyBinPacker,
+    engine_models: Sequence[frozenset],
+    sessions: List[Session],
+    rates: Dict[str, float],
+) -> ReplanDecision:
+    """One replan, decided but not applied: bin-pack the sessions, match
+    the resulting node plans onto the engines with minimal movement, and
+    price the migration (the matcher's own objective — compile_ms +
+    weight-MB for models not already resident)."""
+    engine_models = [frozenset(m) for m in engine_models]
+    plan = packer.plan(sessions)
+    assignment = match_plans_to_engines(engine_models, plan, packer.profiles)
+    migration_cost = sum(
+        transfer_cost(engine_models[e], n, packer.profiles)
+        for e, n in enumerate(assignment)
+        if n is not None
+    )
+    return ReplanDecision(
+        plan=plan,
+        assignment=assignment,
+        old_models=[sorted(m) for m in engine_models],
+        new_models=[
+            sorted(n.models) if n is not None else [] for n in assignment
+        ],
+        migration_cost=migration_cost,
+        rates=dict(rates),
+    )

@@ -1,0 +1,60 @@
+"""sim/ — deterministic discrete-event what-if simulator for the SLO
+scheduler.
+
+Replays a workload (synthetic ``RatePattern``s, a recorded arrivals
+JSONL, or arrivals reconstructed from a flight-recorder span dump)
+against the REAL planners (``scheduler/nexus.py`` +
+``scheduler/replan.decide_replan``) at a virtual clock, with the
+committed profile tables as the execution cost model. Answers "would
+this plan hold at 2x traffic?" / "can we drop a chip?" in milliseconds
+of wall time, byte-deterministically. CLI: ``tools/run_sim.py``.
+"""
+
+from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
+from ray_dynamic_batching_tpu.sim.control import SimScheduler
+from ray_dynamic_batching_tpu.sim.engine import SimEngine
+from ray_dynamic_batching_tpu.sim.queue import (
+    SimQueueManager,
+    SimRequest,
+    SimRequestQueue,
+)
+from ray_dynamic_batching_tpu.sim.report import (
+    compare_reports,
+    format_compare,
+    render_json,
+    slo_attainment,
+)
+from ray_dynamic_batching_tpu.sim.simulator import (
+    Scenario,
+    SimModelSpec,
+    Simulation,
+)
+from ray_dynamic_batching_tpu.sim.workload import (
+    arrivals_from_spans,
+    load_recorded_arrivals,
+    merge_arrivals,
+    scale_arrivals,
+    synthetic_arrivals,
+)
+
+__all__ = [
+    "EventLoop",
+    "VirtualClock",
+    "SimScheduler",
+    "SimEngine",
+    "SimQueueManager",
+    "SimRequest",
+    "SimRequestQueue",
+    "compare_reports",
+    "format_compare",
+    "render_json",
+    "slo_attainment",
+    "Scenario",
+    "SimModelSpec",
+    "Simulation",
+    "arrivals_from_spans",
+    "load_recorded_arrivals",
+    "merge_arrivals",
+    "scale_arrivals",
+    "synthetic_arrivals",
+]

@@ -1,0 +1,174 @@
+"""Simulated control plane — the REAL scheduler logic at virtual time.
+
+This is deliberately thin: the pieces that decide anything are the live
+modules themselves, reused through their clock seams —
+
+- rate estimation: ``engine/rates.py`` ``RateRegistry`` with
+  ``clock=VirtualClock.now_s`` (same sliding window, same asymmetric
+  change thresholds, same cold-start semantics);
+- the replan decision: ``scheduler/replan.decide_replan`` — the SAME
+  pure function ``LiveScheduler.rebalance`` applies (no-drift pin in
+  ``tests/test_sim.py``);
+- the audit trail: ``scheduler/audit.AuditLog`` with ``now=`` injected,
+  so a simulated run's decision records are shaped (and dashboard-
+  renderable) exactly like a live run's, just with virtual timestamps.
+
+Only the monitor thread is re-expressed: a recurring event at
+``monitoring_interval_s`` of VIRTUAL time instead of ``Event.wait``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_dynamic_batching_tpu.engine.rates import RateRegistry
+from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
+from ray_dynamic_batching_tpu.scheduler.nexus import (
+    NodePlan,
+    SquishyBinPacker,
+)
+from ray_dynamic_batching_tpu.scheduler.replan import (
+    ModelEntry,
+    decide_replan,
+    sessions_for,
+)
+from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
+from ray_dynamic_batching_tpu.sim.engine import SimEngine
+from ray_dynamic_batching_tpu.sim.queue import SimQueueManager, SimRequest
+
+
+class SimScheduler:
+    """The simulated scheduling domain (live ``LiveScheduler`` shape)."""
+
+    def __init__(
+        self,
+        packer: SquishyBinPacker,
+        engines: List[SimEngine],
+        queues: SimQueueManager,
+        loop: EventLoop,
+        clock: VirtualClock,
+        monitoring_interval_s: float = 5.0,
+        rate_threshold: float = 0.05,
+        rate_decrease_multiplier: float = 2.0,
+        rate_window_s: float = 10.0,
+        rate_min_span_s: float = 0.0,
+    ) -> None:
+        self.packer = packer
+        self.engines = list(engines)
+        self.queues = queues
+        self.loop = loop
+        self.clock = clock
+        self.monitoring_interval_s = monitoring_interval_s
+        self.rate_threshold = rate_threshold
+        self.rate_decrease_multiplier = rate_decrease_multiplier
+        self.rate_min_span_s = rate_min_span_s  # live cold-window guard
+        self.rates = RateRegistry(window_s=rate_window_s, clock=clock.now_s)
+        self.audit = AuditLog("sim", now=clock.now_s)
+        self._models: Dict[str, ModelEntry] = {}
+        self._current_plan: List[NodePlan] = []
+        self._monitor_until_ms = 0.0
+        self.schedule_changes = 0
+        self.schedule_log: List[Dict] = []
+
+    # --- registration (live register_model contract) ----------------------
+    def register_model(self, name: str, slo_ms: float,
+                       seq_len: int = 0) -> None:
+        if name not in self.packer.profiles:
+            raise KeyError(f"no batch profile for model {name!r}")
+        self._models[name] = ModelEntry(name, slo_ms, seq_len)
+
+    # --- ingress (live submit_request: demand recorded before enqueue) ----
+    def submit(self, model: str) -> bool:
+        entry = self._models.get(model)
+        if entry is None:
+            return False
+        self.rates.record(model)
+        return self.queues.queue(model).add_request(
+            SimRequest(
+                model=model,
+                arrival_ms=self.clock.now_ms(),
+                slo_ms=entry.slo_ms,
+                seq_len=entry.seq_len,
+            )
+        )
+
+    # --- scheduling: decide via the shared pure step, apply to sim engines
+    def rebalance(
+        self,
+        rates: Optional[Dict[str, float]] = None,
+        trigger: str = "manual",
+    ) -> List[NodePlan]:
+        rates = rates if rates is not None else self.rates.rates()
+        decision = decide_replan(
+            self.packer,
+            [frozenset(e.models) for e in self.engines],
+            sessions_for(self._models, rates),
+            rates,
+        )
+        for engine, node_plan in zip(self.engines, decision.assignment):
+            if node_plan is not None:
+                engine.assign(node_plan)
+            elif engine.models:
+                engine.assign(NodePlan())  # idle this engine
+        self._current_plan = decision.plan
+        self.rates.mark_scheduled(rates)
+        self.schedule_changes += 1
+        self.schedule_log.append(
+            {
+                "ts": self.clock.now_s(),
+                "rates": dict(rates),
+                "nodes": [n.describe() for n in decision.plan],
+            }
+        )
+        self.audit.record(trigger, **decision.audit_fields())
+        return decision.plan
+
+    # --- monitor loop as a recurring event --------------------------------
+    def start_monitoring(self, until_ms: float) -> None:
+        """Arm the recurring monitor. The first tick fires 1 ms BEFORE
+        the interval boundary: the rate window buckets by integer
+        second, so a monitor aligned exactly on second boundaries would
+        always read an empty partial bucket — a systematic ~1/window
+        under-read no live deployment (whose phase is arbitrary) is
+        pinned to. The -1 ms phase reads full buckets instead.
+
+        interval <= 0 means monitoring is DISABLED (only warm-start /
+        manual rebalances happen) — re-arming at zero delay would spin
+        the event loop at one virtual instant forever."""
+        if self.monitoring_interval_s <= 0:
+            return
+        self._monitor_until_ms = until_ms
+        self.loop.schedule_in(
+            max(self.monitoring_interval_s * 1000.0 - 1.0, 1.0),
+            self._on_monitor,
+        )
+
+    def _on_monitor(self) -> None:
+        changed = self.rates.changed_models(
+            self.rate_threshold, self.rate_decrease_multiplier,
+            min_span_s=self.rate_min_span_s,
+        )
+        if changed:
+            self.rebalance(trigger="rate_change")
+        if self.clock.now_ms() < self._monitor_until_ms:
+            self.loop.schedule_in(
+                max(self.monitoring_interval_s * 1000.0, 1.0),
+                self._on_monitor,
+            )
+
+    # --- observability (live snapshot shape) ------------------------------
+    # snapshot()/schedule_log mirror LiveScheduler's surface on purpose:
+    # they are the embedding API for dashboards/tools that render a
+    # simulated domain exactly like a live one, not internal plumbing
+    # (the report reads the audit ring directly).
+    def snapshot(self) -> Dict:
+        return {
+            "time": self.clock.now_s(),
+            "rates_rps": self.rates.rates(),
+            "scheduled_rates_rps": self.rates.scheduled_rates(),
+            "queues": self.queues.stats(),
+            "plan": [n.describe() for n in self._current_plan],
+            "engines": [e.describe() for e in self.engines],
+            "schedule_changes": self.schedule_changes,
+            "audit": self.audit.to_dicts(last=20),
+        }

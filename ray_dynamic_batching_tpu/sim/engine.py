@@ -1,0 +1,177 @@
+"""Simulated duty-cycle engine — ``engine/worker.py`` advanced by events.
+
+One :class:`SimEngine` is one chip. It re-enacts ``ReplicaEngine``'s hot
+loop against the virtual clock, with the committed profile tables as the
+execution cost model (Clockwork's premise: per-batch latency on static
+XLA buckets is predictable, so the table row IS the step):
+
+live ``ReplicaEngine``                  | here
+----------------------------------------|----------------------------------
+``assign()`` queues a plan; swap lands  | ``assign()`` stores a pending
+at a cycle boundary after off-thread    | plan; swapped at the next
+prepare                                 | slice-0 event (prepare is
+                                        | off-path live, so it costs the
+                                        | simulated timeline nothing)
+``_run_placement``: pop batch (fixed    | same pop against the sim queue
+size, staleness discard at profiled     | (same staleness rule), then the
+latency), run the compiled step         | step "runs" by advancing virtual
+                                        | time by the profile row latency
+slice sleep: co-tenant gets its         | slice advance =
+``occupancy * duty`` share              | max(step_ms, occupancy * duty)
+leftover duty-cycle absorption          | cycle end = max(cycle_start +
+                                        | duty, last slice end)
+idle engine sleeps ``idle_wait_s``      | idle event re-armed at
+                                        | ``idle_wait_ms``
+
+Each placement's slice is its OWN event (not one synchronous cycle), so
+arrivals that land mid-cycle are visible to later slices exactly as they
+are to the live pop at wall time.
+
+Step latency uses the row's MEAN (what a live run actually measures per
+step); optional seeded gaussian jitter (``latency_std_ms``) stays
+deterministic. The planner's occupancy math keeps using worst-case —
+that asymmetry is the live system's too.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.scheduler.nexus import NodePlan, Placement
+from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
+from ray_dynamic_batching_tpu.sim.queue import SimQueueManager
+
+
+class SimEngine:
+    """One simulated chip's duty-cycle executor."""
+
+    def __init__(
+        self,
+        engine_id: str,
+        queues: SimQueueManager,
+        profiles: Dict[str, BatchProfile],
+        loop: EventLoop,
+        clock: VirtualClock,
+        idle_wait_ms: float = 10.0,
+        jitter_rng: Optional[random.Random] = None,
+    ) -> None:
+        self.engine_id = engine_id
+        self.queues = queues
+        self.profiles = profiles
+        self.loop = loop
+        self.clock = clock
+        self.idle_wait_ms = idle_wait_ms
+        self.jitter_rng = jitter_rng  # None = exact mean latencies
+        self._plan = NodePlan()
+        self._pending: Optional[NodePlan] = None
+        self._cycle_start_ms = 0.0
+        self._started = False
+        # --- accounting ---
+        self.busy_ms = 0.0
+        self.batches = 0
+        self.requests = 0
+        self.cycle_count = 0
+        self.swap_count = 0
+
+    # --- scheduler-facing surface (duck-matches ReplicaEngine) -----------
+    @property
+    def models(self) -> List[str]:
+        return [p.session.model for p in self._plan.placements]
+
+    def assign(self, plan: NodePlan) -> None:
+        """Queue a new node plan; applied at the next cycle boundary
+        (live: background prepare, pointer swap at cycle boundary)."""
+        self._pending = plan
+
+    def describe(self) -> str:
+        return (
+            f"SimEngine({self.engine_id}, "
+            f"duty={self._plan.duty_cycle_ms:.1f}ms, "
+            f"models={sorted(self.models)})"
+        )
+
+    # --- event-driven hot loop -------------------------------------------
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self.loop.schedule_at(self.clock.now_ms(), self._on_cycle_start)
+
+    def _step_latency_ms(self, p: Placement) -> float:
+        """The cost model: the profile row for the placement's compiled
+        bucket. Falls back to the placement's planned latency when the
+        table lacks the row (the planner sized it from SOME row)."""
+        prof = self.profiles.get(p.session.model)
+        row = None
+        if prof is not None:
+            row = prof.row_for(p.batch_size, p.session.seq_len) \
+                or prof.bucket_for(p.batch_size, p.session.seq_len)
+        if row is None:
+            return p.latency_ms
+        mean = row.latency_ms
+        if self.jitter_rng is not None and row.latency_std_ms > 0:
+            return max(
+                0.1 * mean,
+                self.jitter_rng.gauss(mean, row.latency_std_ms),
+            )
+        return mean
+
+    def _on_cycle_start(self) -> None:
+        if self._pending is not None:
+            self._plan = self._pending
+            self._pending = None
+            self.swap_count += 1
+        if not self._plan.placements:
+            self.loop.schedule_in(self.idle_wait_ms, self._on_cycle_start)
+            return
+        self._cycle_start_ms = self.clock.now_ms()
+        self._on_slice(0)
+
+    def _on_slice(self, idx: int) -> None:
+        plan = self._plan
+        if idx >= len(plan.placements):  # plan shrank under us: new cycle
+            self._end_cycle()
+            return
+        p = plan.placements[idx]
+        queue = self.queues.queue(p.session.model)
+        # Live NexusFixedBatch: fixed scheduled size, never waits, stale
+        # discard priced at the placement's (worst-case) latency.
+        batch = queue.get_batch(
+            p.batch_size, expected_latency_ms=p.latency_ms
+        )
+        exec_ms = 0.0
+        if batch:
+            exec_ms = self._step_latency_ms(p)
+            queue.record_batch_completion(
+                batch, self.clock.now_ms() + exec_ms
+            )
+            self.busy_ms += exec_ms
+            self.batches += 1
+            self.requests += len(batch)
+        slice_ms = p.occupancy * plan.duty_cycle_ms
+        advance_ms = max(exec_ms, slice_ms)
+        if idx + 1 < len(plan.placements):
+            self.loop.schedule_in(
+                advance_ms, lambda: self._on_slice(idx + 1)
+            )
+        else:
+            # Floor the cycle at 0.5 ms of virtual time: a degenerate
+            # zero-duty plan must not stall the event loop's clock.
+            self.loop.schedule_at(
+                max(
+                    self._cycle_start_ms + max(plan.duty_cycle_ms, 0.5),
+                    self.clock.now_ms() + advance_ms,
+                ),
+                self._end_cycle,
+            )
+
+    def _end_cycle(self) -> None:
+        self.cycle_count += 1
+        self._on_cycle_start()
+
+    # --- accounting -------------------------------------------------------
+    def occupancy(self, elapsed_ms: float) -> float:
+        """Measured busy fraction over the run (the live engine's
+        ENGINE_OCCUPANCY gauge analogue, but measured not scheduled)."""
+        return self.busy_ms / elapsed_ms if elapsed_ms > 0 else 0.0

@@ -1,0 +1,166 @@
+"""Simulated per-model queues — the live queue's semantics at virtual time.
+
+Mirrors ``engine/queue.py`` (``RequestQueue``/``QueueManager``) exactly
+where the scheduler can observe behavior, against the injected
+:class:`~ray_dynamic_batching_tpu.sim.clock.VirtualClock`:
+
+- bounded add with drop-when-full (ref scheduler.py:238-254);
+- batch pop that discards requests which cannot finish inside their SLO
+  even if run right now (``deadline < now + expected_latency`` — the
+  staleness rule, ref :281-283);
+- per-request SLO-violation accounting on completion (ref :324-341) and
+  latency percentiles (exact over ALL completions here — a simulation
+  report wants the whole run, not a rolling window).
+
+No threads, no locks, no futures: the event loop serializes everything,
+and a completed request is just a counted outcome. ``stats()`` returns
+the same keys as the live queue so report code reads either side.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List
+
+from ray_dynamic_batching_tpu.sim.clock import VirtualClock
+
+SLO_WINDOW = 200  # live parity: recent-completion compliance window
+
+
+@dataclass
+class SimRequest:
+    """The simulator's request: arrival + contract, nothing else."""
+
+    model: str
+    arrival_ms: float
+    slo_ms: float
+    seq_len: int = 0
+
+    @property
+    def deadline_ms(self) -> float:
+        return self.arrival_ms + self.slo_ms
+
+
+def percentile(samples: List[float], p: float) -> float:
+    """The live ``RollingWindow.percentile`` rule (nearest-rank via
+    ceil), over an explicit sample list."""
+    if not samples:
+        return 0.0
+    data = sorted(samples)
+    idx = min(len(data) - 1, max(0, math.ceil(p * len(data)) - 1))
+    return data[idx]
+
+
+class SimRequestQueue:
+    """Bounded FIFO for one model, advanced by the event loop."""
+
+    def __init__(self, model: str, clock: VirtualClock,
+                 max_len: int = 4096) -> None:
+        self.model = model
+        self.clock = clock
+        self.max_len = max_len
+        self._q: Deque[SimRequest] = deque()
+        # --- stats (same counters as engine/queue.py) ---
+        self.latency_samples: List[float] = []
+        self._recent_outcomes: List[bool] = []
+        self.total_enqueued = 0
+        self.total_dropped = 0
+        self.total_stale = 0
+        self.total_completed = 0
+        self.total_violations = 0
+
+    # --- producer side ----------------------------------------------------
+    def add_request(self, request: SimRequest) -> bool:
+        if len(self._q) >= self.max_len:
+            self.total_dropped += 1
+            return False
+        self._q.append(request)
+        self.total_enqueued += 1
+        return True
+
+    # --- consumer side ----------------------------------------------------
+    def get_batch(
+        self,
+        batch_size: int,
+        expected_latency_ms: float = 0.0,
+        discard_stale: bool = True,
+    ) -> List[SimRequest]:
+        """Pop up to ``batch_size`` in one sweep at the CURRENT virtual
+        time, discarding requests that cannot meet their deadline given
+        the profiled batch latency (live ``get_batch`` rule)."""
+        now = self.clock.now_ms()
+        out: List[SimRequest] = []
+        while self._q and len(out) < batch_size:
+            req = self._q.popleft()
+            if discard_stale and req.deadline_ms < now + expected_latency_ms:
+                self.total_stale += 1
+                continue
+            out.append(req)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    # --- accounting (live record_batch_completion) ------------------------
+    def record_batch_completion(
+        self, batch: List[SimRequest], completed_at_ms: float
+    ) -> int:
+        violations = 0
+        for req in batch:
+            total_ms = completed_at_ms - req.arrival_ms
+            ok = total_ms <= req.slo_ms
+            violations += 0 if ok else 1
+            self.latency_samples.append(total_ms)
+            self._recent_outcomes.append(ok)
+        if len(self._recent_outcomes) > SLO_WINDOW:
+            del self._recent_outcomes[:-SLO_WINDOW]
+        self.total_completed += len(batch)
+        self.total_violations += violations
+        return violations
+
+    def slo_compliance(self) -> float:
+        if not self._recent_outcomes:
+            return 1.0
+        return sum(self._recent_outcomes) / len(self._recent_outcomes)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "depth": float(len(self)),
+            "enqueued": float(self.total_enqueued),
+            "dropped": float(self.total_dropped),
+            "stale": float(self.total_stale),
+            "completed": float(self.total_completed),
+            "violations": float(self.total_violations),
+            "slo_compliance": self.slo_compliance(),
+            "latency_p50_ms": percentile(self.latency_samples, 0.50),
+            "latency_p95_ms": percentile(self.latency_samples, 0.95),
+            "latency_p99_ms": percentile(self.latency_samples, 0.99),
+            # Live records queue delay at completion via
+            # queue_delay_ms(t) = t - arrival — numerically the same
+            # series as total latency, so derive rather than duplicate.
+            "queue_delay_p95_ms": percentile(self.latency_samples, 0.95),
+        }
+
+
+class SimQueueManager:
+    """Name → queue registry (live ``QueueManager`` shape)."""
+
+    def __init__(self, clock: VirtualClock, max_len: int = 4096) -> None:
+        self.clock = clock
+        self.max_len = max_len
+        self._queues: Dict[str, SimRequestQueue] = {}
+
+    def queue(self, model: str) -> SimRequestQueue:
+        if model not in self._queues:
+            self._queues[model] = SimRequestQueue(
+                model, self.clock, self.max_len
+            )
+        return self._queues[model]
+
+    def queues(self) -> Dict[str, SimRequestQueue]:
+        return dict(self._queues)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        return {m: q.stats() for m, q in self._queues.items()}

@@ -1,0 +1,126 @@
+"""Simulation reports — deterministic JSON + the A/B comparison.
+
+The report is the simulator's product: per-model SLO attainment (shed
+load counts as missed, same formula as ``tools/run_slo_demo.py``'s
+per-phase grading), latency percentiles, per-chip measured occupancy,
+drop/stale counts, migration count, and the full audit trail (virtual
+timestamps). ``render_json`` is byte-deterministic — sorted keys, floats
+rounded at fixed precision — so same-seed runs are ``diff``-clean and CI
+can ratchet on exact output.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+
+def slo_attainment(counters: Dict[str, float]) -> float:
+    """Fraction of accounted requests that met their SLO, counting shed
+    load (stale discards + drops) as misses — a dropped request missed
+    its SLO as surely as a late completion (run_slo_demo's rule)."""
+    accounted = (counters.get("completed", 0.0)
+                 + counters.get("stale", 0.0)
+                 + counters.get("dropped", 0.0))
+    misses = (counters.get("violations", 0.0)
+              + counters.get("stale", 0.0)
+              + counters.get("dropped", 0.0))
+    return 1.0 - misses / accounted if accounted else 1.0
+
+
+def _round(value: Any, nd: int = 4) -> Any:
+    if isinstance(value, float):
+        return round(value, nd)
+    if isinstance(value, dict):
+        return {k: _round(v, nd) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_round(v, nd) for v in value]
+    return value
+
+
+def render_json(report: Dict[str, Any]) -> str:
+    """Canonical bytes: sorted keys, fixed float precision, newline-
+    terminated. Two same-seed runs must produce IDENTICAL output."""
+    return json.dumps(_round(report), sort_keys=True, indent=2) + "\n"
+
+
+def compare_reports(a: Dict[str, Any], b: Dict[str, Any],
+                    label_a: str = "A", label_b: str = "B") -> Dict[str, Any]:
+    """The A/B harness: per-model attainment/p99 deltas, chip usage,
+    migrations — the decision surface for "can we drop a chip?" /
+    "would plan B hold the SLOs?"."""
+    models = sorted(set(a.get("models", {})) | set(b.get("models", {})))
+    per_model = {}
+    for m in models:
+        am = a.get("models", {}).get(m, {})
+        bm = b.get("models", {}).get(m, {})
+        per_model[m] = {
+            "slo_attainment": {
+                label_a: am.get("slo_attainment"),
+                label_b: bm.get("slo_attainment"),
+                "delta": (
+                    None
+                    if m not in a.get("models", {})
+                    or m not in b.get("models", {})
+                    else round(bm["slo_attainment"] - am["slo_attainment"], 4)
+                ),
+            },
+            "latency_p99_ms": {
+                label_a: am.get("latency_p99_ms"),
+                label_b: bm.get("latency_p99_ms"),
+            },
+            "shed": {
+                label_a: (am.get("dropped", 0) + am.get("stale", 0)),
+                label_b: (bm.get("dropped", 0) + bm.get("stale", 0)),
+            },
+        }
+    worst_a = min(
+        (m.get("slo_attainment", 1.0) for m in a.get("models", {}).values()),
+        default=1.0,
+    )
+    worst_b = min(
+        (m.get("slo_attainment", 1.0) for m in b.get("models", {}).values()),
+        default=1.0,
+    )
+    return {
+        "labels": [label_a, label_b],
+        "models": per_model,
+        "chips_used": {label_a: a.get("chips_used"),
+                       label_b: b.get("chips_used")},
+        "schedule_changes": {label_a: a.get("schedule_changes"),
+                             label_b: b.get("schedule_changes")},
+        "worst_slo_attainment": {label_a: round(worst_a, 4),
+                                 label_b: round(worst_b, 4)},
+        "winner": (label_a if worst_a > worst_b
+                   else label_b if worst_b > worst_a else "tie"),
+    }
+
+
+def format_compare(diff: Dict[str, Any]) -> str:
+    """Terminal table for the A/B diff."""
+    la, lb = diff["labels"]
+    lines = [
+        f"{'model':<20} {'attain ' + la:>12} {'attain ' + lb:>12} "
+        f"{'delta':>8} {'p99 ' + la:>10} {'p99 ' + lb:>10}",
+    ]
+    for m, row in sorted(diff["models"].items()):
+        att = row["slo_attainment"]
+        p99 = row["latency_p99_ms"]
+
+        def fmt(v: Optional[float], nd: int = 4) -> str:
+            return "-" if v is None else f"{v:.{nd}f}"
+
+        lines.append(
+            f"{m:<20} {fmt(att[la]):>12} {fmt(att[lb]):>12} "
+            f"{fmt(att['delta']):>8} {fmt(p99[la], 1):>10} "
+            f"{fmt(p99[lb], 1):>10}"
+        )
+    lines.append(
+        f"chips: {la}={diff['chips_used'][la]} {lb}={diff['chips_used'][lb]}"
+        f"  schedule_changes: {la}={diff['schedule_changes'][la]} "
+        f"{lb}={diff['schedule_changes'][lb]}  worst attainment: "
+        f"{la}={diff['worst_slo_attainment'][la]:.4f} "
+        f"{lb}={diff['worst_slo_attainment'][lb]:.4f}"
+        f"  winner: {diff['winner']}"
+    )
+    return "\n".join(lines)

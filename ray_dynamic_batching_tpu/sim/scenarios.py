@@ -1,0 +1,96 @@
+"""Built-in scenarios + synthetic profile fixtures.
+
+The smoke scenario is the CI gate's fixture (``tools/run_sim.py
+--smoke``): three models with distinct latency/memory shapes under a
+mid-run traffic spike on one of them — enough to exercise saturate +
+residue packing, a monitor-detected rate change, a live migration, and
+SLO accounting, in well under a second of wall time. The profile
+fixtures are synthetic (hermetic: the smoke must not move when committed
+CPU tables are re-measured); committed-table replays go through
+``tools/run_sim.py --profiles``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_dynamic_batching_tpu.engine.workload import RatePattern
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
+from ray_dynamic_batching_tpu.sim.simulator import Scenario, SimModelSpec
+
+MB = 1024 * 1024
+
+
+def linear_profile(
+    name: str,
+    base_ms: float,
+    per_sample_ms: float,
+    weight_mb: int = 100,
+    act_mb_per_sample: float = 1.0,
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+    compile_ms: float = 1000.0,
+    std_fraction: float = 0.0,
+) -> BatchProfile:
+    """Latency = base + per_sample*batch — the canonical accelerator
+    shape (same generator as ``tests/fixtures.py``, duplicated here so
+    shipped tools never import the test tree)."""
+    rows = [
+        ProfileRow(
+            batch_size=b,
+            seq_len=0,
+            latency_ms=base_ms + per_sample_ms * b,
+            latency_std_ms=std_fraction * (base_ms + per_sample_ms * b),
+            hbm_bytes=int((weight_mb + act_mb_per_sample * b) * MB),
+            compile_ms=compile_ms,
+        )
+        for b in buckets
+    ]
+    return BatchProfile(name, rows)
+
+
+def fixture_profiles() -> Dict[str, BatchProfile]:
+    """Three models with distinct latency/memory shapes: a shufflenet-
+    like sprinter, a steep burst-prone mid-tier (its SLO caps the
+    bucket at b=16 / ~116 rps per chip, so a real spike SATURATES a
+    chip), and a memory-fat heavyweight."""
+    return {
+        "fast": linear_profile("fast", base_ms=1.0, per_sample_ms=0.05,
+                               weight_mb=20, act_mb_per_sample=0.2),
+        "burst": linear_profile("burst", base_ms=10.0, per_sample_ms=8.0,
+                                weight_mb=300, act_mb_per_sample=2.0),
+        "fat": linear_profile("fat", base_ms=5.0, per_sample_ms=0.5,
+                              weight_mb=4000, act_mb_per_sample=40.0),
+    }
+
+
+def smoke_scenario(seed: int = 0) -> Scenario:
+    """60 virtual seconds, 3 chips, Poisson arrivals: ``burst`` spikes
+    30 -> 160 rps mid-run — past its ~116 rps single-chip SLO capacity —
+    so the monitor must catch the drift and migrate it across chips (and
+    scale back down after). Expected story: ``fast``/``fat`` hold their
+    SLOs throughout; ``burst`` sheds transiently during the detection
+    lag, then recovers on the migrated plan."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="fast", slo_ms=200.0,
+                pattern=RatePattern("constant", base_rps=60.0),
+            ),
+            SimModelSpec(
+                name="burst", slo_ms=500.0,
+                pattern=RatePattern(
+                    "spike", base_rps=30.0, amplitude=130.0,
+                    spike_at_s=25.0, spike_len_s=20.0,
+                ),
+            ),
+            SimModelSpec(
+                name="fat", slo_ms=800.0,
+                pattern=RatePattern("constant", base_rps=7.0),
+            ),
+        ],
+        duration_s=60.0,
+        drain_s=5.0,
+        n_engines=3,
+        seed=seed,
+        monitoring_interval_s=2.0,
+    )

@@ -1,0 +1,343 @@
+"""Scenario + Simulation — one what-if run, end to end.
+
+A :class:`Scenario` is everything a run needs besides the profile
+tables: the model contracts (SLO, seq bucket), the traffic (synthetic
+``RatePattern`` per model, or an explicit arrival list recorded from a
+live run), the cluster size, the control-loop knobs, and the seed.
+:class:`Simulation` wires the virtual-clock substrate under the REAL
+planner stack and runs the event loop to the horizon:
+
+    profiles -> SquishyBinPacker -> decide_replan     (live planner code)
+    RateRegistry(clock=virtual) -> changed_models     (live rate code)
+    SimQueueManager / SimEngine                       (live semantics, §sim/)
+    AuditLog(now=virtual)                             (live audit ring)
+
+The output is a plain dict; ``sim.report.render_json`` renders it
+byte-deterministically. Same profiles + same scenario => same bytes.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_dynamic_batching_tpu.engine.workload import RatePattern
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.scheduler.nexus import SquishyBinPacker
+from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
+from ray_dynamic_batching_tpu.sim.control import SimScheduler
+from ray_dynamic_batching_tpu.sim.engine import SimEngine
+from ray_dynamic_batching_tpu.sim.queue import SimQueueManager
+from ray_dynamic_batching_tpu.sim.report import slo_attainment
+from ray_dynamic_batching_tpu.sim.workload import (
+    Arrival,
+    merge_arrivals,
+    scale_arrivals,
+    synthetic_arrivals,
+)
+
+# RatePattern knobs a scenario dict may set (everything but kind/seed).
+_PATTERN_FIELDS = (
+    "base_rps", "slope", "amplitude", "period_s", "step_at_s",
+    "jitter", "spike_at_s", "spike_len_s",
+)
+
+
+# Keys a model entry may carry; anything else is a typo'd knob and a
+# silently-defaulted what-if is a confidently wrong one — reject loudly.
+_MODEL_KEYS = frozenset(
+    ("name", "slo_ms", "seq_len", "rate_rps", "pattern", "poisson")
+    + _PATTERN_FIELDS
+)
+
+
+@dataclass
+class SimModelSpec:
+    """One model's serving contract + its synthetic traffic shape."""
+
+    name: str
+    slo_ms: float
+    seq_len: int = 0
+    pattern: Optional[RatePattern] = None   # None when arrivals are explicit
+    poisson: bool = True
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], seed: int = 0) -> "SimModelSpec":
+        unknown = set(d) - _MODEL_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown model key(s) {sorted(unknown)} for "
+                f"{d.get('name', '<unnamed>')!r}; known: "
+                f"{sorted(_MODEL_KEYS)}"
+            )
+        pattern = None
+        if "rate_rps" in d or "pattern" in d:
+            kwargs = {k: d[k] for k in _PATTERN_FIELDS if k in d}
+            if "rate_rps" in d:
+                kwargs["base_rps"] = float(d["rate_rps"])
+            pattern = RatePattern(
+                kind=d.get("pattern", "constant"), seed=seed, **kwargs
+            )
+        return cls(
+            name=d["name"],
+            slo_ms=float(d["slo_ms"]),
+            seq_len=int(d.get("seq_len", 0)),
+            pattern=pattern,
+            poisson=bool(d.get("poisson", True)),
+        )
+
+
+@dataclass
+class Scenario:
+    """One simulated deployment under one traffic story."""
+
+    models: List[SimModelSpec]
+    duration_s: float = 60.0
+    drain_s: float = 5.0
+    n_engines: int = 2
+    seed: int = 0
+    rate_scale: float = 1.0          # the "at 2x traffic?" knob
+    max_queue_len: int = 4096
+    monitoring_interval_s: float = 5.0
+    rate_threshold: float = 0.05
+    rate_decrease_multiplier: float = 2.0
+    rate_window_s: float = 10.0
+    rate_min_span_s: float = 0.0     # cold-window replan guard (live knob)
+    hbm_budget_bytes: int = 12 << 30
+    # Planner knobs pinned IN the scenario (not read from ambient
+    # RDBConfig): a what-if report must not change because some other
+    # code in the process mutated the global config.
+    slo_safety_factor: float = 2.2   # live default (ref SLO_hack=2.2)
+    slo_compute_fraction: float = 0.5
+    hbm_plan_fraction: float = 0.9
+    warm_start: bool = True          # initial manual rebalance at t=0
+    latency_jitter: bool = False     # seeded gaussian around row means
+    arrivals: Optional[List[Arrival]] = field(default=None, repr=False)
+
+    # Loader-level keys (profiles/arrivals paths) ride in the same JSON
+    # object; everything else must be a real Scenario field.
+    _LOADER_KEYS = frozenset({"profiles", "profiles_dir", "arrivals",
+                              "_comment"})
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Scenario":
+        import dataclasses as _dc
+
+        known = {f.name for f in _dc.fields(cls)} | cls._LOADER_KEYS
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown scenario key(s) {sorted(unknown)}; known: "
+                f"{sorted(known - cls._LOADER_KEYS)}"
+            )
+        seed = int(d.get("seed", 0))
+        return cls(
+            models=[
+                SimModelSpec.from_dict(m, seed=seed + i)
+                for i, m in enumerate(d["models"])
+            ],
+            duration_s=float(d.get("duration_s", 60.0)),
+            drain_s=float(d.get("drain_s", 5.0)),
+            n_engines=int(d.get("n_engines", 2)),
+            seed=seed,
+            rate_scale=float(d.get("rate_scale", 1.0)),
+            max_queue_len=int(d.get("max_queue_len", 4096)),
+            monitoring_interval_s=float(d.get("monitoring_interval_s", 5.0)),
+            rate_threshold=float(d.get("rate_threshold", 0.05)),
+            rate_decrease_multiplier=float(
+                d.get("rate_decrease_multiplier", 2.0)
+            ),
+            rate_window_s=float(d.get("rate_window_s", 10.0)),
+            rate_min_span_s=float(d.get("rate_min_span_s", 0.0)),
+            hbm_budget_bytes=int(d.get("hbm_budget_bytes", 12 << 30)),
+            slo_safety_factor=float(d.get("slo_safety_factor", 2.2)),
+            slo_compute_fraction=float(d.get("slo_compute_fraction", 0.5)),
+            hbm_plan_fraction=float(d.get("hbm_plan_fraction", 0.9)),
+            warm_start=bool(d.get("warm_start", True)),
+            latency_jitter=bool(d.get("latency_jitter", False)),
+        )
+
+
+class Simulation:
+    """One run of one scenario against one set of profile tables."""
+
+    def __init__(self, profiles: Dict[str, BatchProfile],
+                 scenario: Scenario) -> None:
+        self.profiles = profiles
+        self.scenario = scenario
+
+    # --- workload ---------------------------------------------------------
+    def _arrivals(self) -> List[Arrival]:
+        sc = self.scenario
+        if sc.arrivals is not None:
+            return scale_arrivals(sc.arrivals, sc.rate_scale, seed=sc.seed)
+        streams = []
+        for i, spec in enumerate(sc.models):
+            if spec.pattern is None:
+                continue
+            pattern = spec.pattern
+            if sc.rate_scale != 1.0:
+                # Synthetic traffic scales at the SOURCE (rate, not trace).
+                pattern = RatePattern(
+                    kind=pattern.kind,
+                    base_rps=pattern.base_rps * sc.rate_scale,
+                    slope=pattern.slope * sc.rate_scale,
+                    amplitude=pattern.amplitude * sc.rate_scale,
+                    period_s=pattern.period_s,
+                    step_at_s=pattern.step_at_s,
+                    jitter=pattern.jitter,
+                    spike_at_s=pattern.spike_at_s,
+                    spike_len_s=pattern.spike_len_s,
+                    seed=pattern.seed,
+                )
+            streams.append(
+                synthetic_arrivals(
+                    spec.name, pattern, sc.duration_s,
+                    poisson=spec.poisson, seed=sc.seed * 8191 + i,
+                )
+            )
+        return merge_arrivals(streams)
+
+    def _warm_start_rates(self, arrivals: List[Arrival]) -> Dict[str, float]:
+        """The rates the t=0 manual rebalance plans for: the synthetic
+        base rates, or (for a recorded trace) the measured rate over the
+        first rate window."""
+        sc = self.scenario
+        if sc.arrivals is None:
+            return {
+                spec.name: spec.pattern.base_rps * sc.rate_scale
+                for spec in sc.models
+                if spec.pattern is not None
+            }
+        span = max(min(sc.rate_window_s, sc.duration_s), 1e-9)
+        counts: Dict[str, int] = {}
+        for t, model in arrivals:
+            if t <= span:
+                counts[model] = counts.get(model, 0) + 1
+        return {spec.name: counts.get(spec.name, 0) / span
+                for spec in sc.models}
+
+    # --- the run ----------------------------------------------------------
+    def run(self) -> Dict[str, Any]:
+        sc = self.scenario
+        clock = VirtualClock()
+        loop = EventLoop(clock)
+        queues = SimQueueManager(clock, max_len=sc.max_queue_len)
+        jitter_rng = (
+            random.Random(sc.seed * 7919 + 13) if sc.latency_jitter else None
+        )
+        engines = [
+            SimEngine(f"chip{i}", queues, self.profiles, loop, clock,
+                      jitter_rng=jitter_rng)
+            for i in range(sc.n_engines)
+        ]
+        packer = SquishyBinPacker(
+            self.profiles, hbm_budget_bytes=sc.hbm_budget_bytes
+        )
+        # Pin every planner knob from the scenario — the constructor read
+        # the ambient config, which is not part of a what-if's inputs.
+        packer.hbm_budget = int(sc.hbm_budget_bytes * sc.hbm_plan_fraction)
+        packer.slo_safety = sc.slo_safety_factor
+        packer.compute_fraction = sc.slo_compute_fraction
+        sched = SimScheduler(
+            packer, engines, queues, loop, clock,
+            monitoring_interval_s=sc.monitoring_interval_s,
+            rate_threshold=sc.rate_threshold,
+            rate_decrease_multiplier=sc.rate_decrease_multiplier,
+            rate_window_s=sc.rate_window_s,
+            rate_min_span_s=sc.rate_min_span_s,
+        )
+        for spec in sc.models:
+            sched.register_model(spec.name, slo_ms=spec.slo_ms,
+                                 seq_len=spec.seq_len)
+
+        # Only arrivals the horizon will actually fire count as offered
+        # load: a recorded trace longer than duration_s is TRUNCATED and
+        # says so, and arrivals for models the scenario never registered
+        # are IGNORED and say so — both silently inflating 'arrivals'
+        # would let capacity conclusions be drawn from a workload the
+        # run never carried.
+        known = {spec.name for spec in sc.models}
+        all_arrivals = self._arrivals()
+        arrivals: list = []
+        ignored_models: Dict[str, int] = {}
+        truncated = 0
+        for t_s, model in all_arrivals:
+            if model not in known:
+                ignored_models[model] = ignored_models.get(model, 0) + 1
+            elif t_s >= sc.duration_s:
+                truncated += 1
+            else:
+                arrivals.append((t_s, model))
+        arrival_counts: Dict[str, int] = {}
+        for t_s, model in arrivals:
+            arrival_counts[model] = arrival_counts.get(model, 0) + 1
+            loop.schedule_at(
+                t_s * 1000.0,
+                lambda m=model: sched.submit(m),
+            )
+
+        if sc.warm_start:
+            sched.rebalance(rates=self._warm_start_rates(arrivals),
+                            trigger="manual")
+        sched.start_monitoring(until_ms=sc.duration_s * 1000.0)
+        for e in engines:
+            e.start()
+
+        horizon_ms = (sc.duration_s + sc.drain_s) * 1000.0
+        events = loop.run_until(horizon_ms)
+        elapsed_ms = clock.now_ms()
+
+        # --- report -------------------------------------------------------
+        models: Dict[str, Any] = {}
+        for spec in sc.models:
+            stats = queues.queue(spec.name).stats()
+            models[spec.name] = {
+                "slo_ms": spec.slo_ms,
+                "arrivals": arrival_counts.get(spec.name, 0),
+                "completed": int(stats["completed"]),
+                "dropped": int(stats["dropped"]),
+                "stale": int(stats["stale"]),
+                "violations": int(stats["violations"]),
+                "pending": int(stats["depth"]),
+                "slo_attainment": slo_attainment(stats),
+                "latency_p50_ms": stats["latency_p50_ms"],
+                "latency_p95_ms": stats["latency_p95_ms"],
+                "latency_p99_ms": stats["latency_p99_ms"],
+            }
+        chips: Dict[str, Any] = {}
+        for e in engines:
+            chips[e.engine_id] = {
+                "busy_ms": e.busy_ms,
+                "occupancy": e.occupancy(elapsed_ms),
+                "batches": e.batches,
+                "requests": e.requests,
+                "cycles": e.cycle_count,
+                "swaps": e.swap_count,
+                "models": sorted(e.models),
+            }
+        audit = sched.audit.to_dicts()
+        migrations = sum(
+            1 for r in audit
+            if r["diff"].get("engines_changed") and any(r["before"] or [])
+        )
+        return {
+            "metric": "sim_report",
+            "seed": sc.seed,
+            "duration_s": sc.duration_s,
+            "drain_s": sc.drain_s,
+            "n_engines": sc.n_engines,
+            "rate_scale": sc.rate_scale,
+            "events": events,
+            "arrivals_total": len(arrivals),
+            "arrivals_truncated_past_horizon": truncated,
+            "arrivals_ignored_unregistered_model": ignored_models,
+            "models": models,
+            "chips": chips,
+            "chips_used": sum(1 for e in engines if e.batches > 0),
+            "schedule_changes": sched.schedule_changes,
+            "migrations": migrations,
+            "final_plan": [n.describe() for n in sched._current_plan],
+            "audit": audit,
+        }

@@ -55,6 +55,11 @@ class RDBConfig:
     monitoring_interval_s: float = 5.0
     # Sliding window for request-rate estimation (ref RequestTracker window).
     rate_window_s: float = 10.0
+    # Cold-window replan guard: suppress rate-change replans for models whose
+    # sliding window covers fewer than this many seconds (a half-filled window
+    # under-reads by up to 1/span and the monitor scales DOWN during rampup).
+    # 0.0 = react immediately (the reference's behavior).
+    rate_min_span_s: float = 0.0
 
     # --- batching / bucketing (TPU-first: XLA compiles per shape bucket) ---
     # Batch buckets are rounded up to the nearest of these (powers of two by

@@ -441,6 +441,109 @@ class TestSpanHygiene:
         assert report.new == []
 
 
+# --- sim-determinism ------------------------------------------------------
+
+class TestSimDeterminism:
+    def test_wall_clock_in_sim_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/engine.py", """
+            import time
+
+            def step():
+                return time.time()
+        """)
+        assert rules_found(report) == ["sim-determinism"]
+        assert "virtual clock" in report.new[0].message
+
+    def test_sleep_and_monotonic_flag(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/loop.py", """
+            import time
+
+            def pace():
+                time.sleep(0.1)
+                return time.monotonic()
+        """)
+        assert rules_found(report) == ["sim-determinism"] * 2
+
+    def test_global_random_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/workload.py", """
+            import random
+
+            def jitter():
+                return random.random() + random.uniform(0, 1)
+        """)
+        assert rules_found(report) == ["sim-determinism"] * 2
+        assert "process-global RNG" in report.new[0].message
+
+    def test_unseeded_random_instance_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/rng.py", """
+            import random
+
+            def make_rng():
+                return random.Random()
+        """)
+        assert rules_found(report) == ["sim-determinism"]
+        assert "seed" in report.new[0].message
+
+    def test_seeded_random_instance_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/rng.py", """
+            import random
+
+            def make_rng(seed):
+                return random.Random(seed * 7919 + 13)
+        """)
+        assert report.new == []
+
+    def test_numpy_global_rng_flags_seeded_generator_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/noise.py", """
+            import numpy as np
+
+            def noisy():
+                return np.random.normal()
+
+            def clean(seed):
+                return np.random.default_rng(seed).normal()
+        """)
+        assert rules_found(report) == ["sim-determinism"]
+
+    def test_datetime_now_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/report.py", """
+            import datetime
+
+            def stamp():
+                return datetime.datetime.now()
+        """)
+        assert rules_found(report) == ["sim-determinism"]
+
+    def test_rule_scoped_to_sim_only(self, tmp_path):
+        # The same wall-clock call outside sim/ is not this rule's
+        # business (the serving tier has its own rules).
+        report = lint_fixture(tmp_path, "scheduler/control.py", """
+            import time
+
+            def now():
+                return time.time()
+        """)
+        assert report.new == []
+
+    def test_reasoned_pragma_suppresses(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/bridge.py", """
+            import time
+
+            def wall_anchor():
+                return time.time()  # rdb-lint: disable=sim-determinism (report stamping happens outside the event loop)
+        """)
+        assert report.new == []
+        assert report.pragma_suppressed == 1
+
+    def test_shipped_sim_tree_is_clean(self):
+        report = run(
+            paths=[lint_core.REPO_ROOT / "ray_dynamic_batching_tpu" / "sim"],
+            rules={"sim-determinism"},
+        )
+        assert report.files_scanned >= 8
+        assert report.new == [], report.format_text()
+
+
 # --- pragmas --------------------------------------------------------------
 
 SLEEPY = """
@@ -660,7 +763,7 @@ class TestShippedTree:
         out = capsys.readouterr().out
         for rule in ("vmem-budget", "tile-alignment",
                      "event-loop-blocking", "host-sync-in-hot-path",
-                     "span-hygiene"):
+                     "span-hygiene", "sim-determinism"):
             assert rule in out
 
     def test_cli_json_output_and_exit_code(self, tmp_path, capsys):

@@ -1,0 +1,430 @@
+"""Simulator tests: event kernel, live-semantics queues, determinism,
+replay sources, the spike/migration story, the A/B harness, virtual-time
+audit records, and the decide-replan no-drift pin (live path and sim
+consume ONE pure decision function — same pattern as the tile_math pins
+in test_lint.py)."""
+
+import json
+
+from ray_dynamic_batching_tpu.engine.workload import (
+    RatePattern,
+    WorkloadDriver,
+)
+from ray_dynamic_batching_tpu.scheduler.nexus import SquishyBinPacker
+from ray_dynamic_batching_tpu.scheduler.replan import decide_replan
+from ray_dynamic_batching_tpu.sim import (
+    EventLoop,
+    Simulation,
+    VirtualClock,
+    compare_reports,
+    render_json,
+)
+from ray_dynamic_batching_tpu.sim.queue import SimRequest, SimRequestQueue
+from ray_dynamic_batching_tpu.sim.scenarios import (
+    fixture_profiles,
+    smoke_scenario,
+)
+from ray_dynamic_batching_tpu.sim.simulator import Scenario, SimModelSpec
+from ray_dynamic_batching_tpu.sim.workload import (
+    arrivals_from_spans,
+    load_recorded_arrivals,
+    scale_arrivals,
+    synthetic_arrivals,
+)
+
+
+class TestEventKernel:
+    def test_events_fire_in_time_order_with_insertion_ties(self):
+        clock = VirtualClock()
+        loop = EventLoop(clock)
+        fired = []
+        loop.schedule_at(20.0, lambda: fired.append(("b", clock.now_ms())))
+        loop.schedule_at(10.0, lambda: fired.append(("a", clock.now_ms())))
+        loop.schedule_at(20.0, lambda: fired.append(("c", clock.now_ms())))
+        n = loop.run_until(30.0)
+        assert n == 3
+        assert fired == [("a", 10.0), ("b", 20.0), ("c", 20.0)]
+        assert clock.now_ms() == 30.0
+
+    def test_events_scheduled_during_run_interleave(self):
+        clock = VirtualClock()
+        loop = EventLoop(clock)
+        fired = []
+
+        def recurring():
+            fired.append(clock.now_ms())
+            loop.schedule_in(10.0, recurring)
+
+        loop.schedule_at(0.0, recurring)
+        loop.run_until(35.0)
+        assert fired == [0.0, 10.0, 20.0, 30.0]
+
+    def test_past_schedules_clamp_to_now(self):
+        clock = VirtualClock()
+        loop = EventLoop(clock)
+        loop.run_until(50.0)
+        fired = []
+        loop.schedule_at(10.0, lambda: fired.append(clock.now_ms()))
+        loop.run_until(60.0)
+        assert fired == [50.0]
+
+
+class TestSimQueue:
+    """The live RequestQueue contract (engine/queue.py) at virtual time."""
+
+    def _q(self, max_len=4):
+        clock = VirtualClock()
+        return SimRequestQueue("m", clock, max_len=max_len), clock
+
+    def test_drop_when_full(self):
+        q, _ = self._q(max_len=2)
+        assert q.add_request(SimRequest("m", 0.0, 100.0))
+        assert q.add_request(SimRequest("m", 0.0, 100.0))
+        assert not q.add_request(SimRequest("m", 0.0, 100.0))
+        assert q.total_dropped == 1 and q.total_enqueued == 2
+
+    def test_stale_discard_at_profiled_latency(self):
+        # Live rule: deadline < now + expected_latency => discarded.
+        q, clock = self._q()
+        q.add_request(SimRequest("m", arrival_ms=0.0, slo_ms=100.0))
+        q.add_request(SimRequest("m", arrival_ms=90.0, slo_ms=100.0))
+        clock._now_ms = 95.0
+        batch = q.get_batch(8, expected_latency_ms=10.0)
+        # req1 deadline 100 < 95+10 -> stale; req2 deadline 190 survives
+        assert len(batch) == 1 and batch[0].arrival_ms == 90.0
+        assert q.total_stale == 1
+
+    def test_completion_accounting_and_percentiles(self):
+        q, clock = self._q()
+        reqs = [SimRequest("m", arrival_ms=0.0, slo_ms=50.0)
+                for _ in range(4)]
+        for r in reqs:
+            q.add_request(r)
+        clock._now_ms = 10.0
+        batch = q.get_batch(4, expected_latency_ms=5.0)
+        violations = q.record_batch_completion(batch, completed_at_ms=60.0)
+        assert violations == 4 and q.total_violations == 4
+        stats = q.stats()
+        assert stats["completed"] == 4.0
+        assert stats["latency_p99_ms"] == 60.0
+        assert stats["slo_compliance"] == 0.0
+
+
+def _packer():
+    packer = SquishyBinPacker(fixture_profiles(), hbm_budget_bytes=12 << 30)
+    packer.hbm_budget = int((12 << 30) * 0.9)
+    packer.slo_safety = 2.2
+    packer.compute_fraction = 0.5
+    return packer
+
+
+class TestDecideReplanNoDrift:
+    """Pin: LiveScheduler.rebalance and the sim consume the SAME pure
+    decision — plan, assignment, audit payload, migration cost. A fork
+    of the decide step in either caller fails this."""
+
+    class _FakeEngine:
+        def __init__(self):
+            self.assigned = []
+
+        @property
+        def models(self):
+            return (sorted(self.assigned[-1].models)
+                    if self.assigned else [])
+
+        def assign(self, plan):
+            self.assigned.append(plan)
+
+        def describe(self):
+            return "fake"
+
+    def _live(self):
+        from ray_dynamic_batching_tpu.scheduler.control import LiveScheduler
+
+        engines = [self._FakeEngine(), self._FakeEngine()]
+        sched = LiveScheduler(_packer(), engines)
+        sched.register_model("fast", slo_ms=200.0)
+        sched.register_model("burst", slo_ms=500.0)
+        return sched, engines
+
+    def test_live_rebalance_matches_pure_decision(self):
+        rates = {"fast": 60.0, "burst": 30.0}
+        sched, engines = self._live()
+        live_plan = sched.rebalance(rates=rates)
+        live_audit = sched.audit.records()[-1]
+
+        from ray_dynamic_batching_tpu.scheduler.replan import sessions_for
+
+        decision = decide_replan(
+            _packer(), [frozenset(), frozenset()],
+            sessions_for(sched._models, rates), rates,
+        )
+        assert [n.describe() for n in live_plan] == \
+               [n.describe() for n in decision.plan]
+        fields = decision.audit_fields()
+        assert live_audit.before == fields["before"]
+        assert live_audit.after == fields["after"]
+        assert live_audit.diff == fields["diff"]
+        assert live_audit.observed == fields["observed"]
+        assert live_audit.inputs == fields["inputs"]
+        assert live_audit.migration_cost == fields["migration_cost"]
+
+    def test_second_rebalance_sees_residency(self):
+        # The minimal-movement matcher prices residency; a second replan
+        # through the live path must equal the pure decision computed
+        # from the engines' post-first-replan residency.
+        sched, engines = self._live()
+        sched.rebalance(rates={"fast": 60.0, "burst": 30.0})
+        resident = [frozenset(e.models) for e in engines]
+
+        from ray_dynamic_batching_tpu.scheduler.replan import sessions_for
+
+        rates2 = {"fast": 60.0, "burst": 160.0}
+        decision = decide_replan(
+            _packer(), resident, sessions_for(sched._models, rates2),
+            rates2,
+        )
+        sched.rebalance(rates=rates2)
+        live_audit = sched.audit.records()[-1]
+        assert live_audit.diff == decision.audit_fields()["diff"]
+        assert live_audit.migration_cost == \
+               decision.audit_fields()["migration_cost"]
+
+
+class TestDeterminism:
+    def test_same_seed_byte_identical_reports(self):
+        a = render_json(Simulation(fixture_profiles(), smoke_scenario()).run())
+        b = render_json(Simulation(fixture_profiles(), smoke_scenario()).run())
+        assert a == b
+
+    def test_different_seed_differs(self):
+        a = render_json(
+            Simulation(fixture_profiles(), smoke_scenario(seed=0)).run()
+        )
+        b = render_json(
+            Simulation(fixture_profiles(), smoke_scenario(seed=1)).run()
+        )
+        assert a != b  # Poisson arrivals re-drawn
+
+    def test_latency_jitter_stays_deterministic(self):
+        sc1 = smoke_scenario()
+        sc1.latency_jitter = True
+        sc2 = smoke_scenario()
+        sc2.latency_jitter = True
+        profiles = fixture_profiles()
+        assert render_json(Simulation(profiles, sc1).run()) == \
+               render_json(Simulation(profiles, sc2).run())
+
+
+class TestSpikeScenario:
+    def test_spike_forces_migration_and_cotenants_hold(self):
+        report = Simulation(fixture_profiles(), smoke_scenario()).run()
+        assert report["migrations"] >= 1
+        assert report["chips_used"] >= 2
+        # Co-tenants ride through the spike; burst sheds only transiently.
+        assert report["models"]["fast"]["slo_attainment"] >= 0.93
+        assert report["models"]["fat"]["slo_attainment"] >= 0.99
+        assert report["models"]["burst"]["slo_attainment"] >= 0.80
+        assert report["models"]["burst"]["completed"] > 0
+        # The audit ring saw the rate_change decisions.
+        triggers = {r["trigger"] for r in report["audit"]}
+        assert "rate_change" in triggers
+
+    def test_what_if_more_chips_cannot_hurt(self):
+        sc2 = smoke_scenario()
+        sc2.n_engines = 1  # starve it instead: one chip for everything
+        starved = Simulation(fixture_profiles(), sc2).run()
+        full = Simulation(fixture_profiles(), smoke_scenario()).run()
+        worst_starved = min(
+            m["slo_attainment"] for m in starved["models"].values()
+        )
+        worst_full = min(
+            m["slo_attainment"] for m in full["models"].values()
+        )
+        assert worst_full >= worst_starved - 1e-9
+        diff = compare_reports(starved, full, "one_chip", "three_chips")
+        assert diff["winner"] in ("three_chips", "tie")
+
+    def test_rate_scale_what_if_degrades_attainment(self):
+        base = Simulation(fixture_profiles(), smoke_scenario()).run()
+        sc = smoke_scenario()
+        sc.rate_scale = 6.0
+        sc.n_engines = 1
+        heavy = Simulation(fixture_profiles(), sc).run()
+        assert heavy["arrivals_total"] > 4 * base["arrivals_total"]
+        assert (
+            min(m["slo_attainment"] for m in heavy["models"].values())
+            < min(m["slo_attainment"] for m in base["models"].values())
+        )
+
+
+class TestAuditVirtualTime:
+    def test_audit_records_carry_virtual_timestamps(self):
+        report = Simulation(fixture_profiles(), smoke_scenario()).run()
+        times = [r["wall_time"] for r in report["audit"]]
+        assert times, "no audit records"
+        # Virtual seconds within the run horizon, monotonically ordered.
+        assert all(0.0 <= t <= 65.0 for t in times)
+        assert times == sorted(times)
+        assert report["audit"][0]["trigger"] == "manual"
+        assert all(r["domain"] == "sim" for r in report["audit"])
+
+    def test_live_default_still_wall_clock(self):
+        import time
+
+        from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
+
+        rec = AuditLog("nexus").record("manual")
+        assert abs(rec.wall_time - time.time()) < 5.0
+
+
+class TestWorkloadSources:
+    def test_synthetic_matches_live_driver_offsets(self, tmp_path):
+        # The WorkloadDriver records EXACTLY the offsets the simulator
+        # synthesizes for the same (pattern, seed): record a real driven
+        # run, then check the replay list.
+        pattern = RatePattern("constant", base_rps=200.0)
+        path = tmp_path / "arrivals.jsonl"
+        path.write_text("")
+        driver = WorkloadDriver(
+            lambda model, offset: None, "m", pattern,
+            duration_s=0.2, poisson=True, seed=11,
+            record_path=str(path),
+        )
+        driver.start()
+        driver.join(10.0)
+        recorded = load_recorded_arrivals(str(path))
+        synthetic = synthetic_arrivals("m", pattern, 0.2,
+                                       poisson=True, seed=11)
+        assert driver.sent == len(synthetic)
+        assert [round(t, 6) for t, _ in synthetic] == \
+               [round(t, 6) for t, _ in recorded]
+
+    def test_recorded_replay_through_simulation(self, tmp_path):
+        arrivals = synthetic_arrivals(
+            "fast", RatePattern("constant", base_rps=50.0), 10.0,
+            poisson=True, seed=3,
+        )
+        path = tmp_path / "arr.jsonl"
+        path.write_text("".join(
+            json.dumps({"t_s": t, "model": m}) + "\n" for t, m in arrivals
+        ))
+        sc = Scenario(
+            models=[SimModelSpec("fast", slo_ms=200.0)],
+            duration_s=10.0, n_engines=1, seed=0,
+            monitoring_interval_s=2.0,
+            arrivals=load_recorded_arrivals(str(path)),
+        )
+        report = Simulation(fixture_profiles(), sc).run()
+        assert report["arrivals_total"] == len(arrivals)
+        m = report["models"]["fast"]
+        # Every recorded arrival is accounted for: served, shed, or
+        # still queued at the horizon (short runs shed on cold start).
+        assert m["completed"] + m["stale"] + m["dropped"] + m["pending"] \
+               == len(arrivals)
+        assert m["completed"] > 0.7 * len(arrivals)
+
+    def test_arrivals_from_span_dump(self, tmp_path):
+        spans = [
+            {"name": "queue.wait", "trace_id": "t1", "span_id": 1,
+             "parent_id": None, "start_ms": 1000.0, "end_ms": 1010.0,
+             "attributes": {"model": "fast"}, "links": []},
+            {"name": "engine.step", "trace_id": "t1", "span_id": 2,
+             "parent_id": None, "start_ms": 1010.0, "end_ms": 1020.0,
+             "attributes": {"model": "fast"}, "links": []},
+            {"name": "queue.wait", "trace_id": "t2", "span_id": 3,
+             "parent_id": None, "start_ms": 1500.0, "end_ms": 1600.0,
+             "attributes": {"model": "burst"}, "links": []},
+        ]
+        path = tmp_path / "spans.jsonl"
+        path.write_text("".join(json.dumps(s) + "\n" for s in spans))
+        arrivals = arrivals_from_spans(str(path))
+        assert arrivals == [(0.0, "fast"), (0.5, "burst")]
+
+    def test_truncated_and_unregistered_arrivals_are_reported(self):
+        # A trace longer than the horizon, plus a model the scenario
+        # never registered: neither silently counts as offered load.
+        arrivals = [(0.5, "fast"), (1.0, "ghost"), (2.0, "fast"),
+                    (9.0, "fast")]
+        sc = Scenario(
+            models=[SimModelSpec("fast", slo_ms=200.0)],
+            duration_s=5.0, n_engines=1, seed=0,
+            monitoring_interval_s=2.0, arrivals=arrivals,
+        )
+        report = Simulation(fixture_profiles(), sc).run()
+        assert report["arrivals_total"] == 2          # the two in-horizon fast
+        assert report["models"]["fast"]["arrivals"] == 2
+        assert report["arrivals_truncated_past_horizon"] == 1
+        assert report["arrivals_ignored_unregistered_model"] == {"ghost": 1}
+
+    def test_scale_arrivals_integer_and_fractional(self):
+        base = [(0.0, "m"), (1.0, "m"), (2.0, "m"), (3.0, "m")]
+        doubled = scale_arrivals(base, 2.0, seed=0)
+        assert len(doubled) == 8
+        assert scale_arrivals(base, 1.0) == base
+        assert scale_arrivals(base, 0.0) == []
+        one_and_half = scale_arrivals(base, 1.5, seed=0)
+        assert len(base) <= len(one_and_half) <= 2 * len(base)
+        assert one_and_half == scale_arrivals(base, 1.5, seed=0)
+
+
+class TestRunSimCLI:
+    def test_smoke_gate_passes(self, capsys):
+        from tools.run_sim import main
+
+        assert main(["--smoke"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] and out["deterministic"]
+
+    def test_report_bytes_stable_across_invocations(self, tmp_path, capsys):
+        from tools.run_sim import main
+
+        scenario = {
+            "profiles": "fixture",
+            "duration_s": 20, "n_engines": 2, "seed": 5,
+            "models": [
+                {"name": "fast", "slo_ms": 200, "rate_rps": 40},
+                {"name": "burst", "slo_ms": 500, "rate_rps": 20,
+                 "pattern": "spike", "amplitude": 100,
+                 "spike_at_s": 8, "spike_len_s": 6},
+            ],
+        }
+        path = tmp_path / "scenario.json"
+        path.write_text(json.dumps(scenario))
+        out_a, out_b = tmp_path / "a.json", tmp_path / "b.json"
+        assert main(["--scenario", str(path), "--out", str(out_a)]) == 0
+        assert main(["--scenario", str(path), "--out", str(out_b)]) == 0
+        assert out_a.read_bytes() == out_b.read_bytes()
+        report = json.loads(out_a.read_text())
+        assert report["metric"] == "sim_report"
+        assert set(report["models"]) == {"fast", "burst"}
+
+    def test_compare_mode(self, tmp_path, capsys):
+        from tools.run_sim import main
+
+        base = {
+            "profiles": "fixture",
+            "duration_s": 20, "n_engines": 3, "seed": 1,
+            "models": [
+                {"name": "burst", "slo_ms": 500, "rate_rps": 30,
+                 "pattern": "spike", "amplitude": 130,
+                 "spike_at_s": 8, "spike_len_s": 8},
+            ],
+        }
+        squeezed = dict(base, rate_scale=6.0, n_engines=1)
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        a.write_text(json.dumps(base))
+        b.write_text(json.dumps(squeezed))
+        out = tmp_path / "cmp.json"
+        assert main(["--compare", str(a), str(b),
+                     "--out", str(out)]) == 0
+        text = capsys.readouterr().out
+        assert "winner" in text
+        diff = json.loads(out.read_text())["compare"]
+        assert diff["winner"] == "a.json"  # 6x traffic on 1 chip loses
+
+    def test_usage_error_without_workload(self, capsys):
+        from tools.run_sim import main
+
+        assert main(["--model", "fast=200"]) == 2
+        assert main(["--model", "malformed"]) == 2

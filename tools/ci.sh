@@ -18,6 +18,8 @@ if [ "${1:-}" = "fast" ]; then
   python -m tools.lint
   echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
   python tools/check_openmetrics.py --smoke
+  echo "== what-if simulator smoke (deterministic, tools/sim_smoke.json floors) =="
+  python tools/run_sim.py --smoke
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
   exec python -m pytest tests/ -q -m "not slow"
 fi
@@ -37,6 +39,9 @@ python -m tools.lint
 
 echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
 python tools/check_openmetrics.py --smoke
+
+echo "== what-if simulator smoke (deterministic, tools/sim_smoke.json floors) =="
+python tools/run_sim.py --smoke
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q

@@ -282,6 +282,7 @@ class Report:
 def _all_checkers() -> List[Checker]:
     # Imported here (not at module top) so ``core`` has no import cycle
     # with the rule modules.
+    from tools.lint.determinism import SimDeterminismChecker
     from tools.lint.event_loop import EventLoopBlockingChecker
     from tools.lint.host_sync import HostSyncChecker
     from tools.lint.spans import SpanHygieneChecker
@@ -293,6 +294,7 @@ def _all_checkers() -> List[Checker]:
         EventLoopBlockingChecker(),
         HostSyncChecker(),
         SpanHygieneChecker(),
+        SimDeterminismChecker(),
     ]
 
 

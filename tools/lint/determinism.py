@@ -1,0 +1,106 @@
+"""sim-determinism — no wall clock, no unseeded randomness inside sim/.
+
+The simulator's whole contract is byte-identical reports for same-seed
+runs; ONE ``time.time()`` or global-RNG call anywhere in ``sim/`` breaks
+it silently (the report still looks plausible — it just stops being
+reproducible, and the CI ratchet floors stop meaning anything). The
+virtual clock (``sim/clock.py``) and explicitly-seeded ``random.Random``
+instances are the only legitimate time/randomness sources:
+
+- any ``time.*`` call is a finding (``time.time``, ``time.monotonic``,
+  ``time.perf_counter``, ``time.sleep``, ...): wall-clock reads leak
+  host timing into results, sleeps stall a virtual-time process;
+- ``datetime.now``/``utcnow``/``today`` likewise;
+- module-level ``random.<fn>()`` uses the process-global RNG whose state
+  depends on everything else that ran — a finding; ``random.Random()``
+  with NO seed argument seeds from the OS — a finding; only
+  ``random.Random(seed)`` passes;
+- ``numpy.random.*`` module-level calls likewise; ``default_rng(seed)``
+  passes, ``default_rng()`` does not.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import (
+    Checker, FileCtx, Scope, dotted_name as _dotted, in_dirs,
+)
+
+_DATETIME_WALL = {"now", "utcnow", "today"}
+
+
+class SimDeterminismChecker(Checker):
+    rule = "sim-determinism"
+
+    def applies(self, relpath: str) -> bool:
+        return in_dirs(relpath, {"sim"})
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        dotted = _dotted(node.func) or ""
+        if not dotted:
+            return
+        parts = dotted.split(".")
+        head = parts[0]
+
+        if head == "time":
+            self.report(
+                ctx, node,
+                f"wall clock in sim/ ({dotted}): the virtual clock "
+                "(sim/clock.VirtualClock) is the only time source — "
+                "wall-clock reads/sleeps break byte-deterministic replay",
+                scope,
+            )
+            return
+
+        if (head == "datetime" or (len(parts) >= 2 and
+                                   parts[-2] == "datetime")) \
+                and parts[-1] in _DATETIME_WALL:
+            self.report(
+                ctx, node,
+                f"wall clock in sim/ ({dotted}): stamp results from the "
+                "virtual clock or in the caller, not from datetime",
+                scope,
+            )
+            return
+
+        if dotted == "random.Random":
+            if not node.args and not node.keywords:
+                self.report(
+                    ctx, node,
+                    "random.Random() without a seed draws entropy from "
+                    "the OS — pass an explicit seed "
+                    "(random.Random(scenario.seed))",
+                    scope,
+                )
+            return
+
+        if head == "random":
+            self.report(
+                ctx, node,
+                f"module-level {dotted}() uses the process-global RNG — "
+                "its state depends on unrelated code; use a seeded "
+                "random.Random instance",
+                scope,
+            )
+            return
+
+        if (head in ("np", "numpy") and len(parts) >= 3
+                and parts[1] == "random"):
+            if parts[2] == "default_rng":
+                if not node.args and not node.keywords:
+                    self.report(
+                        ctx, node,
+                        "numpy default_rng() without a seed is "
+                        "OS-entropy-seeded — pass an explicit seed",
+                        scope,
+                    )
+            else:
+                self.report(
+                    ctx, node,
+                    f"module-level {dotted}() uses numpy's global RNG — "
+                    "use a seeded Generator (default_rng(seed))",
+                    scope,
+                )

@@ -1,0 +1,340 @@
+"""What-if simulator CLI — replay a workload against the real planners
+at a virtual clock, in milliseconds of wall time, byte-deterministically.
+
+Workload sources (exactly one):
+  --scenario FILE    scenario JSON (models, traffic, cluster, knobs)
+  --arrivals FILE    recorded arrivals JSONL (WorkloadDriver(record_path)
+                     / run_slo_demo's <profiles_dir>/arrivals.jsonl);
+                     model contracts via --model NAME=SLO_MS
+  --spans FILE       flight-recorder spans.jsonl (PR 1): arrivals
+                     reconstructed from queue.wait spans; --model as above
+  --pattern KIND     synthetic traffic for every --model NAME=SLO_MS:RPS
+                     (constant|linear|sinusoidal|step|random|spike)
+
+Modes:
+  (default)          run one simulation, print the report JSON
+  --compare A B      A/B two scenario files side by side; exit 0 always
+                     (the diff is the product), report JSON to --out
+  --smoke            CI gate: built-in fixture scenario, run TWICE,
+                     assert byte-identical reports + the SLO-attainment /
+                     migration floors in tools/sim_smoke.json. <10 s.
+
+What-if knobs: --rate-scale 2.0 ("would this plan hold at 2x traffic?"),
+--engines N ("can we drop a chip?"), --seed N.
+
+Examples:
+  python tools/run_slo_demo.py --cpu profiles/cpu 60   # records arrivals
+  python tools/run_sim.py --profiles profiles/cpu \\
+      --arrivals profiles/cpu/arrivals.jsonl \\
+      --model resnet50=2000 --model shufflenet_v2=1500 \\
+      --model vit_b_16=4000 --engines 3 --rate-scale 2.0
+  python tools/run_sim.py --compare plan_a.json plan_b.json
+
+Exit: 0 ok, 1 floors violated / nondeterminism (--smoke), 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RATCHET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "sim_smoke.json")
+
+
+def _load_profiles(profiles_dir: str, models):
+    from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+
+    profiles = {}
+    for name in models:
+        csv_path = os.path.join(profiles_dir, f"{name}_summary.csv")
+        if not os.path.exists(csv_path):
+            print(f"missing committed table: {csv_path} — run "
+                  f"tools/run_profiles.py first", file=sys.stderr)
+            return None
+        profiles[name] = BatchProfile.from_csv(name, csv_path)
+    return profiles
+
+
+def _parse_model_args(model_args):
+    """``NAME=SLO_MS`` or ``NAME=SLO_MS:RPS`` -> list of spec dicts."""
+    out = []
+    for spec in model_args or []:
+        try:
+            name, rest = spec.split("=", 1)
+            parts = rest.split(":")
+            entry = {"name": name, "slo_ms": float(parts[0])}
+            if len(parts) > 1:
+                entry["rate_rps"] = float(parts[1])
+            out.append(entry)
+        except (ValueError, IndexError):
+            print(f"bad --model spec {spec!r} (want NAME=SLO_MS[:RPS])",
+                  file=sys.stderr)
+            return None
+    return out
+
+
+def _scenario_from_file(path: str):
+    """Load a scenario JSON; returns (scenario, profiles) or None.
+    The file may name its own ``profiles_dir`` (committed tables) /
+    ``arrivals`` path; ``"profiles": "fixture"`` uses the built-in
+    synthetic tables."""
+    from ray_dynamic_batching_tpu.sim.scenarios import fixture_profiles
+    from ray_dynamic_batching_tpu.sim.simulator import Scenario
+    from ray_dynamic_batching_tpu.sim.workload import load_recorded_arrivals
+
+    with open(path) as f:
+        d = json.load(f)
+    try:
+        scenario = Scenario.from_dict(d)
+    except ValueError as e:
+        print(f"{path}: {e}", file=sys.stderr)
+        return None
+    if d.get("arrivals"):
+        arrivals_path = d["arrivals"]
+        if not os.path.isabs(arrivals_path):
+            arrivals_path = os.path.join(os.path.dirname(path), arrivals_path)
+        scenario.arrivals = load_recorded_arrivals(arrivals_path)
+    if d.get("profiles") == "fixture":
+        return scenario, fixture_profiles()
+    profiles_dir = d.get("profiles_dir", "profiles/cpu")
+    profiles = _load_profiles(profiles_dir, [m.name for m in scenario.models])
+    if profiles is None:
+        return None
+    return scenario, profiles
+
+
+def _run_smoke(out_path=None) -> int:
+    """The CI gate: fixture scenario twice -> identical bytes + floors."""
+    from ray_dynamic_batching_tpu.sim import Simulation, render_json
+    from ray_dynamic_batching_tpu.sim.scenarios import (
+        fixture_profiles,
+        smoke_scenario,
+    )
+
+    with open(RATCHET_PATH) as f:
+        ratchet = json.load(f)
+    text1 = render_json(Simulation(fixture_profiles(), smoke_scenario()).run())
+    text2 = render_json(Simulation(fixture_profiles(), smoke_scenario()).run())
+    failures = []
+    if text1 != text2:
+        failures.append("NONDETERMINISM: two same-seed runs differ")
+    report = json.loads(text1)
+    for model, floor in ratchet["floors"]["slo_attainment"].items():
+        got = report["models"][model]["slo_attainment"]
+        if got < floor:
+            failures.append(
+                f"{model}: slo_attainment {got:.4f} < floor {floor}"
+            )
+    if report["migrations"] < ratchet["floors"]["min_migrations"]:
+        failures.append(
+            f"migrations {report['migrations']} < "
+            f"{ratchet['floors']['min_migrations']}"
+        )
+    if report["chips_used"] < ratchet["floors"]["min_chips_used"]:
+        failures.append(
+            f"chips_used {report['chips_used']} < "
+            f"{ratchet['floors']['min_chips_used']}"
+        )
+    summary = {
+        "metric": "sim_smoke",
+        "deterministic": text1 == text2,
+        "slo_attainment": {
+            m: round(v["slo_attainment"], 4)
+            for m, v in report["models"].items()
+        },
+        "migrations": report["migrations"],
+        "chips_used": report["chips_used"],
+        "schedule_changes": report["schedule_changes"],
+        "ok": not failures,
+    }
+    print(json.dumps(summary))
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text1)
+    for f_ in failures:
+        print(f"sim smoke FAILED: {f_}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python tools/run_sim.py",
+        description="Deterministic what-if simulator for the SLO scheduler.",
+    )
+    parser.add_argument("--profiles", default="profiles/cpu",
+                        help="committed *_summary.csv dir (default: "
+                             "%(default)s)")
+    parser.add_argument("--scenario", help="scenario JSON file")
+    parser.add_argument("--arrivals", help="recorded arrivals JSONL")
+    parser.add_argument("--spans",
+                        help="flight-recorder spans.jsonl to replay")
+    parser.add_argument("--pattern", default=None,
+                        help="synthetic pattern kind for --model specs")
+    parser.add_argument("--model", action="append", dest="models",
+                        metavar="NAME=SLO_MS[:RPS]",
+                        help="model contract (repeatable)")
+    # What-if overrides default to None so a scenario file's values
+    # survive unless the flag is given explicitly (and an explicit
+    # --rate-scale 1.0 CAN reset a scenario's baked-in scale).
+    parser.add_argument("--duration", type=float, default=None,
+                        help="seconds of traffic (default: 60, or the "
+                             "scenario file's duration_s)")
+    parser.add_argument("--engines", type=int, default=None,
+                        help="chip count (default: 2, or the scenario "
+                             "file's n_engines)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="workload seed (default: 0, or the scenario "
+                             "file's seed)")
+    parser.add_argument("--rate-scale", type=float, default=None,
+                        help="traffic multiplier (what-if: 2.0 = 2x)")
+    parser.add_argument("--amplitude", type=float, default=0.0)
+    parser.add_argument("--spike-at", type=float, default=30.0)
+    parser.add_argument("--spike-len", type=float, default=5.0)
+    parser.add_argument("--step-at", type=float, default=30.0)
+    parser.add_argument("--out", help="write report JSON here too")
+    parser.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                        help="A/B two scenario JSON files")
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI gate: fixture scenario vs "
+                             "tools/sim_smoke.json floors")
+    args = parser.parse_args(argv)
+
+    sources = [f for f, v in (("--arrivals", args.arrivals),
+                              ("--spans", args.spans),
+                              ("--pattern", args.pattern),
+                              ("--scenario", args.scenario))
+               if v]
+    if len(sources) > 1:
+        # Silently preferring one source would grade the wrong workload.
+        print(f"exactly one workload source allowed, got: "
+              f"{', '.join(sources)}", file=sys.stderr)
+        return 2
+
+    if args.smoke:
+        return _run_smoke(args.out)
+
+    from ray_dynamic_batching_tpu.sim import (
+        Simulation,
+        compare_reports,
+        format_compare,
+        render_json,
+    )
+    from ray_dynamic_batching_tpu.sim.simulator import Scenario, SimModelSpec
+    from ray_dynamic_batching_tpu.sim.workload import (
+        arrivals_from_spans,
+        load_recorded_arrivals,
+    )
+
+    def _apply_overrides(scenario):
+        """The advertised what-if flags override any loaded scenario —
+        in --scenario mode and on BOTH sides of a --compare."""
+        if args.engines is not None:
+            scenario.n_engines = args.engines
+        if args.rate_scale is not None:
+            scenario.rate_scale = args.rate_scale
+        if args.seed is not None:
+            scenario.seed = args.seed
+        if args.duration is not None:
+            scenario.duration_s = args.duration
+        return scenario
+
+    def _warn_ignored(report):
+        ignored = report.get("arrivals_ignored_unregistered_model") or {}
+        if ignored:
+            print(f"warning: arrivals for unregistered model(s) ignored "
+                  f"(add --model/scenario entries): {ignored}",
+                  file=sys.stderr)
+        truncated = report.get("arrivals_truncated_past_horizon", 0)
+        if truncated:
+            print(f"warning: {truncated} recorded arrival(s) past the "
+                  f"--duration horizon were truncated", file=sys.stderr)
+
+    if args.compare:
+        loaded = [_scenario_from_file(p) for p in args.compare]
+        if any(x is None for x in loaded):
+            return 2
+        reports = [Simulation(profiles, _apply_overrides(scenario)).run()
+                   for scenario, profiles in loaded]
+        for r in reports:
+            _warn_ignored(r)
+        labels = [os.path.basename(p) for p in args.compare]
+        if labels[0] == labels[1]:
+            # baseline/plan.json vs candidate/plan.json: basenames
+            # collide and the A side would vanish from every dict.
+            labels = list(args.compare)
+        if labels[0] == labels[1]:
+            labels = [labels[0] + " (A)", labels[1] + " (B)"]
+        diff = compare_reports(reports[0], reports[1],
+                               label_a=labels[0], label_b=labels[1])
+        print(format_compare(diff))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(render_json(
+                    {"compare": diff,
+                     labels[0]: reports[0], labels[1]: reports[1]}
+                ))
+        return 0
+
+    if args.scenario:
+        loaded = _scenario_from_file(args.scenario)
+        if loaded is None:
+            return 2
+        scenario, profiles = loaded
+        _apply_overrides(scenario)
+    else:
+        seed = args.seed if args.seed is not None else 0
+        model_specs = _parse_model_args(args.models)
+        if not model_specs:
+            print("need --model NAME=SLO_MS[:RPS] (or --scenario/--smoke)",
+                  file=sys.stderr)
+            return 2
+        arrivals = None
+        if args.arrivals:
+            arrivals = load_recorded_arrivals(args.arrivals)
+        elif args.spans:
+            arrivals = arrivals_from_spans(args.spans)
+        elif args.pattern:
+            for spec in model_specs:
+                spec.setdefault("rate_rps", 10.0)
+                spec["pattern"] = args.pattern
+                spec["amplitude"] = args.amplitude
+                spec["spike_at_s"] = args.spike_at
+                spec["spike_len_s"] = args.spike_len
+                spec["step_at_s"] = args.step_at
+        else:
+            print("need a workload: --arrivals, --spans, or --pattern",
+                  file=sys.stderr)
+            return 2
+        scenario = Scenario(
+            models=[SimModelSpec.from_dict(m, seed=seed + i)
+                    for i, m in enumerate(model_specs)],
+            duration_s=(args.duration
+                        if args.duration is not None else 60.0),
+            n_engines=args.engines if args.engines is not None else 2,
+            seed=seed,
+            rate_scale=(args.rate_scale
+                        if args.rate_scale is not None else 1.0),
+            arrivals=arrivals,
+        )
+        profiles = _load_profiles(args.profiles,
+                                  [m.name for m in scenario.models])
+        if profiles is None:
+            return 2
+
+    report = Simulation(profiles, scenario).run()
+    _warn_ignored(report)
+    text = render_json(report)
+    print(text, end="")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

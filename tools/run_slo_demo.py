@@ -338,6 +338,12 @@ def main(profiles_dir: str, duration_s: float = 60.0,
         # Live monitor: detects the measured-vs-scheduled rate drift the
         # step pattern creates and migrates the schedule mid-run.
         sched.start_monitoring()
+        # Every demo run records its arrivals: <profiles_dir>/arrivals.jsonl
+        # replays through the what-if simulator (tools/run_sim.py
+        # --arrivals). Truncate up front — drivers append line-buffered.
+        arrivals_path = os.path.join(profiles_dir, "arrivals.jsonl")
+        open(arrivals_path, "w").close()
+        record["arrivals_jsonl"] = arrivals_path
         drivers = [
             WorkloadDriver(
                 submit, name,
@@ -347,6 +353,7 @@ def main(profiles_dir: str, duration_s: float = 60.0,
                     step_at_s=shift_at_s,
                 ),
                 duration_s=duration_s, poisson=True, seed=17 + i,
+                record_path=arrivals_path,
             )
             for i, (name, _, _, mult) in enumerate(workload)
         ]
