@@ -52,11 +52,17 @@ class TokenStream:
         self._on_chunk = None
         self._on_close = None
         self.max_buffer = max_buffer
+        # Chunks actually delivered toward the consumer (buffered or pushed).
+        # The failover layer reads this to enforce at-most-once-after-first-
+        # token: a streaming request that already emitted a chunk must NOT
+        # be transparently retried — the client has observed partial output.
+        self.emitted = 0
 
     def put(self, chunk: Any) -> None:
         with self._cond:
             if self._closed:
                 return  # consumer gone / finished — drop quietly
+            self.emitted += 1
             if self._on_chunk is not None:
                 cb = self._on_chunk
             else:
@@ -169,14 +175,29 @@ class Request:
     # Model-multiplexing hint (ref pow_2_scheduler.py:52): the router
     # prefers replicas that already hold this model in HBM.
     multiplexed_model_id: Optional[str] = None
+    # Dispatch count (router assignments, including failover re-dispatches).
+    # The failover layer bounds this with its attempt budget; it never
+    # resets on retry, so a bouncing request cannot circulate forever.
+    attempts: int = 0
+    # Frozen at admission (arrival + SLO). Retries budget against THIS
+    # deadline: a re-dispatched request gets no fresh SLO clock, exactly
+    # like the reference's shed accounting (a request either completes
+    # within its admitted deadline or is counted shed).
+    admission_deadline_ms: float = 0.0
 
     def __post_init__(self) -> None:
         if not self.request_id:
             self.request_id = f"{self.model}-{next(_req_counter)}"
+        if not self.admission_deadline_ms:
+            self.admission_deadline_ms = self.arrival_ms + self.slo_ms
 
     @property
     def deadline_ms(self) -> float:
-        return self.arrival_ms + self.slo_ms
+        return self.admission_deadline_ms
+
+    def remaining_ms(self, now: Optional[float] = None) -> float:
+        """Deadline budget left (negative = already past due)."""
+        return self.deadline_ms - (now if now is not None else now_ms())
 
     def queue_delay_ms(self, now: Optional[float] = None) -> float:
         return (now if now is not None else now_ms()) - self.arrival_ms

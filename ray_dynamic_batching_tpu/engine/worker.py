@@ -391,6 +391,17 @@ class ReplicaEngine:
     def cycle_count(self) -> int:
         return self._cycle_count
 
+    def healthy(self) -> bool:
+        """Liveness for the scheduler's heal path (mirror of
+        ``serve.Replica.healthy``): started, not stopped, and the duty-
+        cycle thread is actually alive — a crashed hot loop must drop
+        this engine out of the planner's candidate set."""
+        if self._closed:
+            return False
+        if self._thread is None:
+            return True  # not started yet — serves once started
+        return self._active.is_set() and self._thread.is_alive()
+
     @property
     def models(self) -> List[str]:
         return list(self._schedule.steps)

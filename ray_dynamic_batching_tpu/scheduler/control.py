@@ -79,7 +79,9 @@ class LiveScheduler:
         self._clock = clock
         self._models: Dict[str, ModelEntry] = {}
         self._current_plan: List[NodePlan] = []
-        self._assignment: List[Optional[NodePlan]] = [None] * len(self.engines)
+        # Engines the monitor has already seen dead: the heal replan fires
+        # once per death (a dead engine stays out of every later plan).
+        self._dead_engines: set = set()
         self._lock = threading.Lock()
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -111,6 +113,16 @@ class LiveScheduler:
     def _sessions_for(self, rates: Dict[str, float]) -> List[Session]:
         return sessions_for(self._models, rates)
 
+    @staticmethod
+    def _engine_alive(engine) -> bool:
+        """Duck-typed liveness: engines exposing ``healthy()`` (ReplicaEngine,
+        sim/test fakes) are consulted; anything else counts alive."""
+        probe = getattr(engine, "healthy", None)
+        return bool(probe()) if callable(probe) else True
+
+    def alive_engines(self) -> List[ReplicaEngine]:
+        return [e for e in self.engines if self._engine_alive(e)]
+
     def rebalance(
         self,
         rates: Optional[Dict[str, float]] = None,
@@ -120,22 +132,25 @@ class LiveScheduler:
         (ref _update_schedule, scheduler.py:834-929). The DECISION —
         bin-pack, minimal-movement match, audit payload — is the shared
         pure function (``replan.decide_replan``); this method only reads
-        rates and APPLIES the result to the live engines."""
+        rates and APPLIES the result to the live engines. Dead engines
+        are excluded from packing and assignment — their queued work is
+        in the shared per-model queues, so the surviving engines' new
+        plans pick it up without an explicit drain."""
         with self._lock:
             rates = rates if rates is not None else self.rates.rates()
+            alive = self.alive_engines()
             decision = decide_replan(
                 self.packer,
-                [frozenset(e.models) for e in self.engines],
+                [frozenset(e.models) for e in alive],
                 self._sessions_for(rates),
                 rates,
             )
-            for engine, node_plan in zip(self.engines, decision.assignment):
+            for engine, node_plan in zip(alive, decision.assignment):
                 if node_plan is not None:
                     engine.assign(node_plan)
                 elif engine.models:
                     engine.assign(NodePlan())  # idle this engine
             self._current_plan = decision.plan
-            self._assignment = decision.assignment
             self.rates.mark_scheduled(rates)
             self.schedule_changes += 1
             self.schedule_log.append(
@@ -153,15 +168,45 @@ class LiveScheduler:
             )
             return decision.plan
 
+    # --- engine heal (the controller's unhealthy-replacement discipline,
+    # applied to the scheduling domain: a dead engine's models migrate to
+    # survivors instead of silently starving their queues) ----------------
+    def check_engine_health(self) -> bool:
+        """Detect newly dead engines; replan over survivors when found.
+        Returns True when a heal replan fired. Heal bypasses the rate
+        cold-window guard — it is failure-driven, not rate-driven."""
+        newly_dead = [
+            e for e in self.engines
+            if e.engine_id not in self._dead_engines
+            and not self._engine_alive(e)
+        ]
+        if not newly_dead:
+            return False
+        for e in newly_dead:
+            self._dead_engines.add(e.engine_id)
+            logger.warning(
+                "engine %s dead; migrating its models to survivors",
+                e.engine_id,
+            )
+        self.audit.record(
+            "engine_dead",
+            observed={"dead_engines": sorted(self._dead_engines)},
+            diff={"removed": [e.engine_id for e in newly_dead]},
+            note="engine death detected by monitor; replan over survivors",
+        )
+        self.rebalance(trigger="heal")
+        return True
+
     # --- monitor loop (ref _monitor_request_rates, scheduler.py:763-801) --
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.monitoring_interval_s):
             try:
+                healed = self.check_engine_health()
                 changed = self.rates.changed_models(
                     self.rate_threshold, self.rate_decrease_multiplier,
                     min_span_s=self.rate_min_span_s,
                 )
-                if changed:
+                if changed and not healed:  # heal already replanned
                     logger.info("rate change detected: %s", changed)
                     self.rebalance(trigger="rate_change")
                 if self.metrics_path:

@@ -26,6 +26,7 @@ from ray_dynamic_batching_tpu.profiles.table import BatchProfile
 from ray_dynamic_batching_tpu.scheduler.audit import plan_diff
 from ray_dynamic_batching_tpu.scheduler.nexus import (
     NodePlan,
+    Placement,
     Session,
     SquishyBinPacker,
 )
@@ -85,6 +86,49 @@ def transfer_cost(
     return cost
 
 
+def merge_overflow_nodes(
+    plans: List[NodePlan], n_engines: int
+) -> List[NodePlan]:
+    """Fold a plan that needs more chips than exist onto the chips that
+    do exist (degraded latency, never starvation).
+
+    When the packer wants ``len(plans) > n_engines`` — typical right
+    after an engine death shrinks the cluster — simply truncating would
+    SILENTLY drop every model exclusive to the overflow nodes: their
+    shared queues starve with no shed accounting (requests neither
+    complete nor reject). Instead each overflow node is merged into the
+    least-occupied retained node: duty cycles add, and occupancies are
+    rescaled (``occ * old_duty / new_duty``) so every placement keeps
+    its absolute slice milliseconds — each model still runs every
+    ``new_duty`` ms, trading latency for coverage, which the SLO
+    accounting then prices honestly as violations/sheds rather than
+    hangs."""
+    if n_engines <= 0 or len(plans) <= n_engines:
+        return list(plans)
+    merged = [
+        NodePlan(placements=list(n.placements),
+                 duty_cycle_ms=n.duty_cycle_ms)
+        for n in plans[:n_engines]
+    ]
+    for extra in plans[n_engines:]:
+        host = min(range(len(merged)), key=lambda i: merged[i].occupancy)
+        target = merged[host]
+        new_duty = target.duty_cycle_ms + extra.duty_cycle_ms
+        if new_duty <= 0:
+            target.placements.extend(extra.placements)
+            continue
+        rescaled = []
+        for node in (target, extra):
+            scale = node.duty_cycle_ms / new_duty
+            rescaled.extend(
+                Placement(p.session, p.batch_size, p.latency_ms,
+                          p.occupancy * scale, p.hbm_bytes)
+                for p in node.placements
+            )
+        merged[host] = NodePlan(placements=rescaled, duty_cycle_ms=new_duty)
+    return merged
+
+
 def match_plans_to_engines(
     engine_models: List[frozenset],
     plans: List[NodePlan],
@@ -102,10 +146,11 @@ def match_plans_to_engines(
     )
     if len(plans) > n_engines:
         logger.warning(
-            "plan needs %d chips but only %d engines; truncating (capacity!)",
+            "plan needs %d chips but only %d engines; merging overflow "
+            "nodes (degraded latency; capacity!)",
             len(plans), n_engines,
         )
-        padded = list(plans[:n_engines])
+        padded = merge_overflow_nodes(plans, n_engines)
 
     if n_engines <= BRUTE_FORCE_LIMIT:
         best: Optional[Tuple[float, Tuple[int, ...]]] = None

@@ -26,6 +26,16 @@ from ray_dynamic_batching_tpu.serve.controller import (
     DeploymentConfig,
     ServeController,
 )
+from ray_dynamic_batching_tpu.serve.failover import (
+    DrainEvicted,
+    FailoverManager,
+    FailoverPolicy,
+    ReplicaDeadError,
+    RetriesExhausted,
+    RetryableSystemError,
+    is_retryable,
+    is_shed,
+)
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 from ray_dynamic_batching_tpu.serve.llm import LLMDeployment, LLMReplica
 from ray_dynamic_batching_tpu.serve.long_poll import LongPollClient, LongPollHost
@@ -56,6 +66,14 @@ __all__ = [
     "CompletionsHandle",
     "DeploymentConfig",
     "DeploymentHandle",
+    "DrainEvicted",
+    "FailoverManager",
+    "FailoverPolicy",
+    "ReplicaDeadError",
+    "RetriesExhausted",
+    "RetryableSystemError",
+    "is_retryable",
+    "is_shed",
     "HTTPProxy",
     "LLMDeployment",
     "LLMReplica",

@@ -25,7 +25,6 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from ray_dynamic_batching_tpu.engine.request import RequestDropped
 from ray_dynamic_batching_tpu.parallel.placement import (
     PlacementError,
     PlacementManager,
@@ -178,6 +177,10 @@ class ServeController:
                     factory=self._factories[config.name],
                     router=Router(config.name),
                 )
+                # Breaker trip/recover events are control-plane decisions:
+                # they share the controller's audit ring with heals and
+                # scale moves (one timeline per deployment).
+                state.router.audit = self.audit
                 self._deployments[config.name] = state
             else:
                 # Deliver user_config only when it CHANGED (including a
@@ -248,6 +251,7 @@ class ServeController:
             victims = state.replicas
             state.replicas = []
             self._publish(state)
+            state.router.failover.close()
             self._checkpoint()
             self.audit.record(
                 "delete",
@@ -342,20 +346,17 @@ class ServeController:
 
     def _redeliver(
         self,
+        router: Router,
         requests: List[Any],
-        targets: List[Replica],
         victim_id: str,
+        dead: bool = False,
     ) -> None:
-        """Salvage a retired replica's queued requests onto live replicas
-        (terminal rejection belongs to the router, not the heal path)."""
-        for req in requests:
-            if not any(t.assign(req) for t in targets if t.accepting()):
-                req.reject(
-                    RequestDropped(
-                        f"{victim_id} retired and no replica accepted its "
-                        "queued work"
-                    )
-                )
+        """Salvage a retired replica's queued requests through the
+        failover path: deadline-budgeted re-dispatch to a different
+        replica, shed accounting when hopeless (terminal rejection
+        belongs to the failover layer, not the heal path). ``dead``
+        marks a crashed/wedged victim (heal) vs a planned rollout."""
+        router.requeue_drained(requests, victim_id, dead=dead)
 
     def _reconcile(self, state: _DeploymentState) -> List[Callable[[], None]]:
         """Drive actual replica count to target; replace unhealthy.
@@ -410,10 +411,9 @@ class ServeController:
                     cfg.name, cfg.max_restarts,
                 )
             if salvaged:
-                targets = [replacement] if replacement is not None else []
                 deferred.append(
-                    lambda reqs=salvaged, t=targets, vid=r.replica_id: (
-                        self._redeliver(reqs, t or state.replicas, vid)
+                    lambda reqs=salvaged, rt=state.router, vid=r.replica_id: (
+                        self._redeliver(rt, reqs, vid, dead=True)
                     )
                 )
             self.audit.record(
@@ -477,9 +477,9 @@ class ServeController:
                     salvaged = victim.drain_queue()
                     if salvaged:
                         deferred.append(
-                            lambda reqs=salvaged, st=state,
+                            lambda reqs=salvaged, rt=state.router,
                             vid=victim.replica_id: (
-                                self._redeliver(reqs, st.replicas, vid)
+                                self._redeliver(rt, reqs, vid)
                             )
                         )
                     deferred.append(
@@ -591,6 +591,7 @@ class ServeController:
             for state in self._deployments.values():
                 victims.extend((state, r) for r in state.replicas)
                 state.replicas = []
+                state.router.failover.close()
         for state, r in victims:
             r.stop()
             self._release_chips(state, r)
@@ -638,6 +639,11 @@ class ServeController:
                     },
                     "restarts": state.restarts,
                     "healthy": not state.unhealthy,
+                    # Per-replica circuit-breaker state + the failover
+                    # layer's retry/shed accounting (serve/failover.py) —
+                    # the observable half of request-level fault tolerance.
+                    "breakers": state.router.breaker_states(),
+                    "failover": state.router.failover.stats(),
                     # Per-version replica counts: mid-rollout both the old
                     # and the new version appear here (ref deployment_state
                     # rollout status).

@@ -1,0 +1,323 @@
+"""Request failover — one failure taxonomy, deadline-budgeted re-dispatch.
+
+The reference substrate makes actor death survivable: tasks are retried
+from ownership metadata (Ray, OSDI '18 lineage) and the Nexus-style SLO
+planner assumes an admitted request either completes within deadline or
+is counted SHED — never a spurious client-visible 500. This module is
+the recovery half of that contract for the serve tier, shared by every
+consumer of the taxonomy (replica, router, controller drain path, proxy
+error mapping, sim re-enactment, chaos soak):
+
+- **Taxonomy**: :func:`is_retryable` classifies a rejection into
+  retryable *system* failures (chaos injection, replica death, drain
+  evictions) vs. non-retryable *user* errors (``BadRequest``, callable
+  bugs) and terminal *shed* outcomes (``RequestStale``,
+  ``RequestDropped`` — deadline economics, not faults).
+- **Deadline-budgeted retries**: :class:`FailoverManager` re-dispatches
+  a retryable failure to a DIFFERENT replica with capped exponential
+  backoff + seeded jitter, but only while the attempt budget holds and
+  ``remaining_deadline >= profiled batch latency`` — otherwise the
+  request is counted shed, exactly like the queue's stale discard.
+- **At-most-once after first token**: a streaming request that already
+  emitted a chunk is never retried (the client saw partial output);
+  the failure surfaces as-is.
+
+The circuit breaker lives in ``serve/router.py`` (it is a routing
+concern); the manager feeds it per-replica failure/success signals so
+the breaker, the retry decision, and the audit trail agree on one
+taxonomy.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+from ray_dynamic_batching_tpu.engine.request import (
+    Request,
+    RequestDropped,
+    RequestStale,
+    now_ms,
+)
+from ray_dynamic_batching_tpu.utils.chaos import ChaosInjected
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils import metrics as m
+
+logger = get_logger("failover")
+
+FAILOVER_RETRIES = m.Counter(
+    "rdb_failover_retries_total", "Requests re-dispatched after a "
+    "retryable system failure", tag_keys=("deployment",),
+)
+FAILOVER_SHED = m.Counter(
+    "rdb_failover_shed_total", "Requests shed by the failover layer",
+    tag_keys=("deployment", "reason"),
+)
+
+
+class RetryableSystemError(RuntimeError):
+    """Base for failures the framework caused and may transparently
+    retry on another replica — never the client's fault."""
+
+
+class ReplicaDeadError(RetryableSystemError):
+    """The serving replica died (loop crash, wedged callable) with this
+    request in flight or queued."""
+
+
+class DrainEvicted(RetryableSystemError):
+    """The request was evicted from a draining replica's queue (heal /
+    rolling update / plan migration) and must be re-routed."""
+
+
+class RetriesExhausted(Exception):
+    """Terminal: a retryable system failure burned its attempt budget.
+    Maps to 503 + Retry-After (gRPC UNAVAILABLE) — the client may retry;
+    the payload was never the problem."""
+
+    def __init__(self, message: str, cause: Optional[Exception] = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """True for system failures the failover layer may re-dispatch.
+
+    ``ChaosInjected`` is the test-harness stand-in for every injected
+    fault (dropped RPC, killed batch) and classifies retryable;
+    ``RequestStale``/``RequestDropped`` are shed outcomes (terminal by
+    design); everything else — ``BadRequest``, user-callable exceptions,
+    contract violations — is a non-retryable user/server error whose
+    retry would just fail again."""
+    return isinstance(exc, (RetryableSystemError, ChaosInjected))
+
+
+def is_shed(exc: BaseException) -> bool:
+    """True for deadline-economics outcomes the SLO accounting counts as
+    shed rather than errors (the planner's admitted-or-shed contract)."""
+    return isinstance(exc, (RequestStale, RequestDropped))
+
+
+@dataclass
+class FailoverPolicy:
+    """Retry knobs — deadline is the real bound, attempts the backstop."""
+
+    # Total dispatches (first send included). Sized above any plausible
+    # consecutive-failure streak a bounded chaos budget can aim at one
+    # request; the deadline budget is what actually stops hopeless work.
+    max_attempts: int = 5
+    backoff_initial_s: float = 0.002
+    backoff_max_s: float = 0.05
+    jitter: float = 0.5            # fraction of the backoff randomized
+    seed: int = 0                  # jitter RNG seed (deterministic tests)
+
+
+class FailoverManager:
+    """Deadline-budgeted re-dispatch for one deployment's router.
+
+    Replicas hand failed batches here (``on_batch_failure``); drained
+    queues arrive via ``requeue``; both paths re-route each request to a
+    different replica through ``router.assign_request(exclude=...)`` on
+    a dedicated worker thread (a replica's hot loop must never block in
+    another replica's backoff). Shed decisions reject with
+    :class:`RequestStale` so every accounting surface — queue stats,
+    soak, sim — reads them identically to a stale discard.
+    """
+
+    def __init__(self, router: Any,
+                 policy: Optional[FailoverPolicy] = None) -> None:
+        self.router = router
+        self.policy = policy or FailoverPolicy()
+        self._rng = random.Random(self.policy.seed)
+        self._seq = itertools.count()
+        # (due_monotonic_ms, seq, request, excluded_replica_id)
+        self._heap: List[Tuple[float, int, Request, str]] = []
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        # --- accounting (surfaced via stats() -> router -> status()) ---
+        self.retries = 0
+        self.shed_deadline = 0
+        self.shed_attempts = 0
+        self.stream_aborted = 0
+
+    # --- replica-facing sink ---------------------------------------------
+    def on_batch_failure(self, replica: Any, batch: List[Request],
+                         exc: Exception) -> None:
+        """A replica's batch died on a retryable system failure: feed the
+        breaker, then re-dispatch every request that may still be retried."""
+        self.router.record_replica_failure(replica.replica_id)
+        for req in batch:
+            if req.stream is not None and req.stream.emitted > 0:
+                # At-most-once after first token: the client consumed
+                # partial output; a transparent replay would duplicate it.
+                self.stream_aborted += 1
+                req.reject(exc)
+                continue
+            self.submit(req, exc, exclude_replica=replica.replica_id)
+
+    def on_batch_success(self, replica: Any) -> None:
+        self.router.record_replica_success(replica.replica_id)
+
+    # --- retry scheduling --------------------------------------------------
+    def submit(self, request: Request, exc: Exception,
+               exclude_replica: str = "", immediate: bool = False) -> bool:
+        """Queue one re-dispatch (True) or reject terminally (False).
+
+        ``immediate`` skips the backoff delay — drain evictions are not
+        replica faults, so they re-route without penalty (still deadline-
+        and attempt-budgeted)."""
+        deployment = self.router.deployment
+        if request.attempts >= self.policy.max_attempts:
+            self.shed_attempts += 1
+            FAILOVER_SHED.inc(
+                tags={"deployment": deployment, "reason": "attempts"}
+            )
+            request.reject(RetriesExhausted(
+                f"{request.request_id}: {request.attempts} attempts "
+                f"exhausted (last failure: {exc})", cause=exc,
+            ))
+            return False
+        delay_ms = 0.0 if immediate else self._backoff_ms(request.attempts)
+        # Retry only if the request can still plausibly complete: the
+        # queue's stale-discard rule (deadline < now + expected latency)
+        # applied BEFORE burning a backoff + batch on a lost cause.
+        if request.remaining_ms() < self._expected_latency_ms() + delay_ms:
+            self.shed_deadline += 1
+            FAILOVER_SHED.inc(
+                tags={"deployment": deployment, "reason": "deadline"}
+            )
+            request.reject(RequestStale(
+                f"{request.request_id}: deadline unreachable after system "
+                f"failure ({exc})"
+            ))
+            return False
+        with self._cond:
+            # _stopped is authoritative only under the lock: a submit
+            # racing close() past an unlocked check would push AFTER the
+            # heap drain and leave a client future that never resolves.
+            if not self._stopped:
+                heapq.heappush(
+                    self._heap,
+                    (m.now_ms() + delay_ms, next(self._seq), request,
+                     exclude_replica),
+                )
+                self._ensure_worker()
+                self._cond.notify()
+                scheduled = True
+            else:
+                scheduled = False
+        if not scheduled:
+            # Teardown: no worker to run the retry and no replica set to
+            # land it on — terminal, not a silently resurrected thread.
+            request.reject(RequestDropped(
+                f"{deployment}: shutting down ({exc})"
+            ))
+            return False
+        self.retries += 1
+        FAILOVER_RETRIES.inc(tags={"deployment": deployment})
+        return True
+
+    def requeue(self, requests: List[Request], victim_id: str,
+                dead: bool = False) -> None:
+        """Drain-and-requeue: a retired/unhealthy replica's queued work
+        re-enters routing through the failover path (no backoff — the
+        victim failed, not the request). ``dead=True`` marks the heal
+        path (the replica crashed/wedged: :class:`ReplicaDeadError`);
+        planned retirements (rolling update, scale-down salvage) stay
+        :class:`DrainEvicted`."""
+        for req in requests:
+            exc: RetryableSystemError = (
+                ReplicaDeadError(f"{victim_id} died with request queued")
+                if dead else DrainEvicted(f"drained from {victim_id}")
+            )
+            self.submit(req, exc, exclude_replica=victim_id, immediate=True)
+
+    # --- internals ----------------------------------------------------------
+    def _backoff_ms(self, attempts: int) -> float:
+        base = min(
+            self.policy.backoff_initial_s * (2 ** max(attempts - 1, 0)),
+            self.policy.backoff_max_s,
+        )
+        return base * (1.0 + self.policy.jitter * self._rng.random()) * 1000.0
+
+    def _expected_latency_ms(self) -> float:
+        """Profiled cost of one more attempt: the worst recent p50 across
+        the replica set (total request latency, so queue wait is priced
+        in). 0.0 before any completion — never block the first retries."""
+        worst = 0.0
+        for r in self.router.replicas():
+            queue = getattr(r, "queue", None)
+            if queue is None:
+                continue
+            try:
+                worst = max(worst, queue.latency_window.percentile(0.5))
+            except Exception:  # noqa: BLE001 — stats must not break retries
+                continue
+        return worst
+
+    def _ensure_worker(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker,
+                name=f"failover-{self.router.deployment}", daemon=True,
+            )
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopped and (
+                    not self._heap or self._heap[0][0] > m.now_ms()
+                ):
+                    timeout = None
+                    if self._heap:
+                        timeout = max(
+                            (self._heap[0][0] - m.now_ms()) / 1000.0, 0.0
+                        )
+                    self._cond.wait(timeout)
+                if self._stopped:
+                    return
+                _due, _seq, request, excluded = heapq.heappop(self._heap)
+            try:
+                # assign_request owns terminal rejection (RequestDropped
+                # after its capped backoff window) — capped further by the
+                # request's remaining deadline so a retry can never sleep
+                # past the budget it was admitted under.
+                self.router.assign_request(
+                    request,
+                    exclude={excluded} if excluded else None,
+                    timeout_s=max(request.remaining_ms() / 1000.0, 0.001),
+                )
+            except Exception:  # noqa: BLE001 — one bad dispatch must not
+                # kill the worker; the request's future still resolves
+                # through assign_request's own rejection path.
+                logger.exception(
+                    "%s: failover dispatch failed", self.router.deployment
+                )
+
+    def close(self) -> None:
+        """Stop the worker and terminally reject every retry still
+        waiting out its backoff — an abandoned heap entry would be a
+        client future that never resolves."""
+        with self._cond:
+            self._stopped = True
+            pending, self._heap = list(self._heap), []
+            self._cond.notify_all()
+        for _due, _seq, request, _excluded in pending:
+            request.reject(RequestDropped(
+                f"{self.router.deployment}: shutting down with retry pending"
+            ))
+
+    def stats(self) -> dict:
+        return {
+            "retries": float(self.retries),
+            "shed_deadline": float(self.shed_deadline),
+            "shed_attempts": float(self.shed_attempts),
+            "stream_aborted": float(self.stream_aborted),
+            "pending": float(len(self._heap)),
+        }

@@ -24,7 +24,8 @@ import time
 from concurrent import futures as cf
 from typing import Any, Iterator, Optional
 
-from ray_dynamic_batching_tpu.engine.request import StreamClosed
+from ray_dynamic_batching_tpu.engine.request import BadRequest, StreamClosed
+from ray_dynamic_batching_tpu.serve.failover import RetriesExhausted, is_shed
 from ray_dynamic_batching_tpu.serve.proxy import ProxyRouter, _to_jsonable
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -112,11 +113,24 @@ class GRPCProxy:
             context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out"
             )
-        except Exception as e:  # noqa: BLE001 — replica errors -> INTERNAL
-            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "INTERNAL"})
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        except Exception as e:  # noqa: BLE001 — status mapping below
+            code, status = self._error_status(e)
+            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": code})
+            context.abort(status, str(e))
         GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "OK"})
         return json.dumps({"result": _to_jsonable(result)}).encode()
+
+    @staticmethod
+    def _error_status(e: Exception):
+        """Taxonomy-aligned status mapping (mirror of the HTTP proxy's):
+        exhausted failover budgets and shed outcomes are UNAVAILABLE —
+        the gRPC code retrying clients key on — while user errors keep
+        INVALID_ARGUMENT and genuine bugs stay INTERNAL."""
+        if isinstance(e, BadRequest):
+            return "INVALID", grpc.StatusCode.INVALID_ARGUMENT
+        if isinstance(e, RetriesExhausted) or is_shed(e):
+            return "UNAVAILABLE", grpc.StatusCode.UNAVAILABLE
+        return "INTERNAL", grpc.StatusCode.INTERNAL
 
     def _budget(self, context) -> float:
         """Remaining time budget: client deadline capped by the server
@@ -187,10 +201,9 @@ class GRPCProxy:
             context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED, "stream timed out"
             )
-        GRPC_REQUESTS.inc(
-            tags={"method": "PredictStream", "code": "INTERNAL"}
-        )
-        context.abort(grpc.StatusCode.INTERNAL, str(error))
+        code, status = self._error_status(error)
+        GRPC_REQUESTS.inc(tags={"method": "PredictStream", "code": code})
+        context.abort(status, str(error))
 
     def _healthz(self, request: bytes, context) -> bytes:
         return json.dumps({"status": "ok"}).encode()

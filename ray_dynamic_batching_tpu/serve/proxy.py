@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from ray_dynamic_batching_tpu.engine.request import BadRequest
+from ray_dynamic_batching_tpu.serve.failover import RetriesExhausted, is_shed
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -159,15 +160,20 @@ class HTTPProxy:
         return method, target, headers, body
 
     @staticmethod
-    def _response(code: int, payload: Any, reason: str = "") -> bytes:
+    def _response(code: int, payload: Any, reason: str = "",
+                  headers: Optional[Dict[str, str]] = None) -> bytes:
         body = json.dumps(_to_jsonable(payload)).encode()
         status = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   500: "Internal Server Error", 503: "Service Unavailable",
                   504: "Gateway Timeout"}.get(code, reason or "Error")
+        extra = "".join(
+            f"{k}: {v}\r\n" for k, v in (headers or {}).items()
+        )
         head = (
             f"HTTP/1.1 {code} {status}\r\n"
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n"
+            f"{extra}"
             f"Connection: keep-alive\r\n\r\n"
         )
         return head.encode() + body
@@ -247,7 +253,15 @@ class HTTPProxy:
             code = "504"
             await _write_line({"error": "stream timed out"})
         except Exception as e:  # noqa: BLE001 — surface on the trailer line
-            code = "500"
+            # Same taxonomy as the unary path (the 200 header is already
+            # out, so `code` is the metrics classification): shed and
+            # budget-exhausted outcomes must not read as server errors.
+            if isinstance(e, BadRequest):
+                code = "400"
+            elif isinstance(e, RetriesExhausted) or is_shed(e):
+                code = "503"
+            else:
+                code = "500"
             await _write_line({"error": str(e)})
         writer.write(b"0\r\n\r\n")
         await writer.drain()
@@ -324,12 +338,24 @@ class HTTPProxy:
             # bare ValueError can come from replica/config bugs (e.g. a
             # deployment callable returning the wrong count) and must stay
             # a server error for retry logic and error-rate monitoring.
-            code = (
-                400 if isinstance(e, BadRequest)
-                else 503 if "no replica" in str(e)
-                else 500
-            )
-            return self._response(code, {"error": str(e)}), route
+            # Exhausted failover budgets and shed outcomes (queue drops,
+            # stale discards) are transient capacity events, not server
+            # bugs: 503 + Retry-After so well-behaved clients back off
+            # and retry instead of alarming on 500s.
+            if isinstance(e, BadRequest):
+                code = 400
+            elif (
+                isinstance(e, RetriesExhausted)
+                or is_shed(e)
+                or "no replica" in str(e)
+            ):
+                code = 503
+            else:
+                code = 500
+            return self._response(
+                code, {"error": str(e)},
+                headers={"Retry-After": "1"} if code == 503 else None,
+            ), route
         return self._response(200, {"result": result}), route
 
     async def _serve_conn(

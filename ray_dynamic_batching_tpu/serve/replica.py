@@ -26,6 +26,7 @@ from contextlib import ExitStack
 from ray_dynamic_batching_tpu.engine.batching import OpportunisticBatch
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
+from ray_dynamic_batching_tpu.serve.failover import is_retryable
 from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -119,6 +120,11 @@ class Replica:
         self.last_heartbeat = time.monotonic()
         self.started_at = time.monotonic()
         self._batch_started_at: Optional[float] = None
+        # Failover sink (serve/failover.FailoverManager), wired by the
+        # router on registration: retryable system failures hand their
+        # batch here for re-dispatch instead of poisoning the futures.
+        # None (bare replicas in tests / engine tier) = reject as before.
+        self.failure_sink = None
 
     # --- router-facing surface -------------------------------------------
     def queue_len(self) -> int:
@@ -242,6 +248,9 @@ class Replica:
             for req, res in zip(batch, results):
                 req.fulfill(res)
             self.queue.record_batch_completion(batch)
+            sink = self.failure_sink
+            if sink is not None:
+                sink.on_batch_success(self)  # closes a half-open breaker
             REPLICA_BATCHES.inc(
                 tags={"deployment": self.deployment, "replica": self.replica_id}
             )
@@ -250,8 +259,21 @@ class Replica:
                 tags={"deployment": self.deployment, "replica": self.replica_id},
             )
         except Exception as e:  # noqa: BLE001 — user errors flow to futures
-            for req in batch:
-                req.reject(e)
+            sink = self.failure_sink
+            if sink is not None and is_retryable(e):
+                # System failure (chaos, replica death, drain eviction):
+                # the failover layer re-dispatches to a different replica
+                # under the admission deadline; user errors below stay
+                # terminal — retrying a bad payload just fails again.
+                sink.on_batch_failure(self, batch, e)
+            else:
+                for req in batch:
+                    req.reject(e)
+                if sink is not None:
+                    # A user error is terminal for the REQUEST but proof
+                    # of life for the REPLICA (it executed the callable):
+                    # it must close a half-open breaker, not wedge it.
+                    sink.on_batch_success(self)
             REPLICA_ERRORS.inc(
                 tags={"deployment": self.deployment, "replica": self.replica_id}
             )

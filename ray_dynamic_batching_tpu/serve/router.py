@@ -8,6 +8,17 @@ candidate is saturated. Queue lengths come from a short-TTL cache refreshed
 on use (ref queue-len cache in the same file), and routing prefers
 ``locality_hint`` replicas when available (locality/multiplex awareness).
 
+Fault tolerance rides on two layers here:
+
+- a per-replica **circuit breaker**: N consecutive system failures trip
+  the breaker and the replica leaves the pow-2 candidate pool; after a
+  cooldown one half-open probe request tests it, success closes the
+  breaker (ref: Serve routers deprioritizing replicas with failed health
+  probes). Trip/recover events land in the controller's audit ring.
+- a per-deployment :class:`~ray_dynamic_batching_tpu.serve.failover.
+  FailoverManager` re-dispatching retryable batch failures and drained
+  queues to a different replica under the request's admission deadline.
+
 The router also aggregates per-deployment demand metrics for the autoscaler
 (ref ``RouterMetricsManager``, ``serve/_private/router.py:43``).
 """
@@ -17,9 +28,13 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
+from ray_dynamic_batching_tpu.serve.failover import (
+    FailoverManager,
+    FailoverPolicy,
+)
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
@@ -32,13 +47,127 @@ ROUTED_TOTAL = m.Counter(
     "rdb_router_routed_total", "Requests routed", tag_keys=("deployment",)
 )
 ROUTER_REJECTED = m.Counter(
-    "rdb_router_rejected_total", "Requests rejected after backoff",
-    tag_keys=("deployment",),
+    "rdb_router_rejected_total",
+    "Requests rejected (reason: backoff_exhausted | breaker_open)",
+    tag_keys=("deployment", "reason"),
 )
 
 QUEUE_LEN_CACHE_TTL_S = 0.1          # ref pow_2_scheduler queue-len cache
 BACKOFF_INITIAL_S = 0.002
 BACKOFF_MAX_S = 0.1
+
+BREAKER_FAILURE_THRESHOLD = 3        # consecutive system failures to trip
+BREAKER_COOLDOWN_S = 1.0             # open -> half-open probe delay
+
+
+class CircuitBreaker:
+    """Per-replica trip state: closed -> open -> half-open -> closed.
+
+    Counts CONSECUTIVE system failures (the failover taxonomy's
+    retryables — user errors never feed it); at ``threshold`` the
+    replica leaves the candidate pool. After ``cooldown_s`` exactly one
+    probe request is admitted (half-open); its outcome closes or
+    re-opens the breaker. Thread-safe; reads are one lock acquire.
+    """
+
+    def __init__(self, threshold: int = BREAKER_FAILURE_THRESHOLD,
+                 cooldown_s: float = BREAKER_COOLDOWN_S,
+                 clock=time.monotonic) -> None:
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._half_open_at = 0.0
+        self.trip_count = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _probe_expired_locked(self) -> bool:
+        """A probe whose verdict never arrived (the probed request was
+        stale-discarded in the queue, or the replica stopped before the
+        batch ran) must not wedge the breaker half-open forever: after a
+        cooldown's worth of silence the slot is forfeit and the next
+        request may probe."""
+        return (
+            self._state == "half_open"
+            and self._clock() - self._half_open_at >= self.cooldown_s
+        )
+
+    def eligible(self) -> bool:
+        """Read-only: may this replica be a routing CANDIDATE right now?
+        (closed, or open with the cooldown elapsed — probe-eligible).
+        Candidacy must not consume the probe slot: pow-2 may still route
+        the request elsewhere."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                return self._clock() - self._opened_at >= self.cooldown_s
+            return self._probe_expired_locked()
+
+    def acquire(self) -> bool:
+        """Claim the right to dispatch to this replica. In the open
+        state past cooldown this admits exactly ONE half-open probe;
+        further dispatches wait for the probe's verdict (or its expiry)."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if (
+                self._state == "open"
+                and self._clock() - self._opened_at >= self.cooldown_s
+            ) or self._probe_expired_locked():
+                self._state = "half_open"
+                self._half_open_at = self._clock()
+                return True
+            return False
+
+    def release(self) -> None:
+        """The acquired probe was never dispatched (the replica declined
+        the assign): hand the slot back so the next request can probe."""
+        with self._lock:
+            if self._state == "half_open":
+                self._state = "open"  # _opened_at unchanged: still eligible
+
+    def record_failure(self) -> Optional[int]:
+        """Count one system failure. On the trip edge (this failure
+        OPENED the breaker) returns the actual consecutive-failure count
+        — a failed half-open probe re-trips at 1, not at ``threshold`` —
+        else None."""
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == "half_open" or (
+                self._state == "closed"
+                and self._consecutive_failures >= self.threshold
+            ):
+                self._state = "open"
+                self._opened_at = self._clock()
+                self.trip_count += 1
+                return self._consecutive_failures
+            return None
+
+    def record_success(self) -> bool:
+        """Count one success; True when it CLOSED an open/half-open
+        breaker (recovery edge)."""
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state != "closed":
+                self._state = "closed"
+                return True
+            return False
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "trips": self.trip_count,
+            }
 
 
 class _CachedLen:
@@ -57,6 +186,9 @@ class Router:
         deployment: str,
         replicas: Optional[Sequence[Replica]] = None,
         max_assign_timeout_s: float = 1.0,
+        failover_policy: Optional[FailoverPolicy] = None,
+        breaker_threshold: int = BREAKER_FAILURE_THRESHOLD,
+        breaker_cooldown_s: float = BREAKER_COOLDOWN_S,
     ) -> None:
         self.deployment = deployment
         self.max_assign_timeout_s = max_assign_timeout_s
@@ -64,12 +196,34 @@ class Router:
         self._lock = threading.Lock()
         self._len_cache: Dict[str, _CachedLen] = {}
         self.total_routed = 0
+        # Per-replica breakers persist across replica-set updates: a
+        # half-open replica keeps its probe state through an unrelated
+        # scale event (entries for retired replicas are pruned).
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
+        self.failover = FailoverManager(self, policy=failover_policy)
+        # Optional decision ring (the controller shares its own): breaker
+        # trip/recover events are control-plane decisions and belong next
+        # to heals and scale moves.
+        self.audit = None
+        for r in self._replicas:
+            self._wire(r)
+
+    def _wire(self, replica: Replica) -> None:
+        if hasattr(replica, "failure_sink"):
+            replica.failure_sink = self.failover
 
     # --- replica-set updates (pushed via long poll) -----------------------
     def update_replicas(self, replicas: Sequence[Replica]) -> None:
         with self._lock:
             self._replicas = list(replicas)
             self._len_cache.clear()
+            live = {r.replica_id for r in replicas}
+            for rid in [b for b in self._breakers if b not in live]:
+                del self._breakers[rid]
+        for r in replicas:
+            self._wire(r)
         logger.info(
             "%s: replica set -> %s",
             self.deployment, [r.replica_id for r in replicas],
@@ -78,6 +232,62 @@ class Router:
     def replicas(self) -> List[Replica]:
         with self._lock:
             return list(self._replicas)
+
+    # --- circuit breaker (fed by the failover taxonomy) -------------------
+    def _breaker(self, replica_id: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(replica_id)
+            if br is None:
+                br = self._breakers[replica_id] = CircuitBreaker(
+                    self._breaker_threshold, self._breaker_cooldown_s
+                )
+            return br
+
+    def record_replica_failure(self, replica_id: str) -> None:
+        br = self._breaker(replica_id)
+        tripped_at = br.record_failure()
+        if tripped_at is not None:
+            logger.warning(
+                "%s: circuit breaker OPEN for %s after %d consecutive "
+                "system failures", self.deployment, replica_id, tripped_at,
+            )
+            if self.audit is not None:
+                self.audit.record(
+                    "breaker_trip",
+                    key=self.deployment,
+                    observed={"replica": replica_id,
+                              "consecutive_failures": tripped_at},
+                    after={"state": "open"},
+                    diff={"excluded": replica_id},
+                )
+
+    def record_replica_success(self, replica_id: str) -> None:
+        br = self._breaker(replica_id)
+        if br.record_success():
+            logger.info(
+                "%s: circuit breaker closed for %s (probe succeeded)",
+                self.deployment, replica_id,
+            )
+            if self.audit is not None:
+                self.audit.record(
+                    "breaker_recover",
+                    key=self.deployment,
+                    observed={"replica": replica_id},
+                    after={"state": "closed"},
+                    diff={"readmitted": replica_id},
+                )
+
+    def breaker_states(self) -> Dict[str, Dict[str, object]]:
+        with self._lock:
+            return {rid: br.snapshot() for rid, br in self._breakers.items()}
+
+    def requeue_drained(self, requests: List[Request], victim_id: str,
+                        dead: bool = False) -> None:
+        """Re-route a retired/unhealthy replica's drained queue through
+        the failover path (deadline-budgeted, different replica) instead
+        of erroring it back to callers. ``dead`` distinguishes a crashed
+        replica (heal) from a planned retirement (rollout)."""
+        self.failover.requeue(requests, victim_id, dead=dead)
 
     # --- pow-2 choice -----------------------------------------------------
     def _queue_len(self, replica: Replica, now: float) -> int:
@@ -122,10 +332,20 @@ class Router:
         return chosen
 
     def assign_request(
-        self, request: Request, locality_hint: Optional[str] = None
+        self,
+        request: Request,
+        locality_hint: Optional[str] = None,
+        exclude: Optional[Set[str]] = None,
+        timeout_s: Optional[float] = None,
     ) -> bool:
         """Route with pow-2 + backoff; reject after the assign timeout
-        (ref fulfillment loop, pow_2_scheduler.py:673)."""
+        (ref fulfillment loop, pow_2_scheduler.py:673).
+
+        ``exclude`` removes replicas by id from the candidate pool (the
+        failover path re-dispatching away from the replica that just
+        failed); ``timeout_s`` caps this call's backoff window below the
+        router default (retries budget against the request's remaining
+        admission deadline)."""
         # Assignment is its own traced hop: attempts > 1 means the request
         # burned wall-clock in backoff against saturated replicas — the
         # flight record shows that as router.assign duration, distinct
@@ -134,11 +354,35 @@ class Router:
             "router.assign", deployment=self.deployment, lane=self.deployment
         ) as sp:
             attempts = 0
-            deadline = time.monotonic() + self.max_assign_timeout_s
+            window_s = min(
+                timeout_s if timeout_s is not None else
+                self.max_assign_timeout_s,
+                self.max_assign_timeout_s,
+            )
+            deadline = time.monotonic() + window_s
             backoff = BACKOFF_INITIAL_S
+            breaker_excluded_last = False
             while True:
                 attempts += 1
-                candidates = [r for r in self.replicas() if r.accepting()]
+                accepting = [r for r in self.replicas() if r.accepting()]
+                if exclude:
+                    preferred = [
+                        r for r in accepting if r.replica_id not in exclude
+                    ]
+                    # Soft exclusion: on a sole-replica deployment, a
+                    # failover retry back to the same (possibly transiently
+                    # failed) replica beats dropping the request.
+                    if preferred:
+                        accepting = preferred
+                # Breaker gate: open-breaker replicas leave the pow-2 pool
+                # (read-only eligibility — the probe slot is claimed only
+                # at dispatch, below, so an unchosen candidate never
+                # wedges the breaker in half-open).
+                candidates = [
+                    r for r in accepting
+                    if self._breaker(r.replica_id).eligible()
+                ]
+                breaker_excluded_last = bool(accepting) and not candidates
                 chosen = self._choose(
                     candidates, locality_hint, request.multiplexed_model_id
                 )
@@ -148,26 +392,43 @@ class Router:
                 # assignment to drop)
                 if chosen is not None and chaos().should_fail("router.assign"):
                     chosen = None
-                if chosen is not None and chosen.assign(request):
-                    # Invalidate the cache entry so bursts spread out.
-                    self._len_cache.pop(chosen.replica_id, None)
-                    self.total_routed += 1
-                    ROUTED_TOTAL.inc(tags={"deployment": self.deployment})
-                    if sp is not None:
-                        sp.attributes.update(
-                            attempts=attempts, replica=chosen.replica_id
-                        )
-                    return True
+                if chosen is not None:
+                    breaker = self._breaker(chosen.replica_id)
+                    if not breaker.acquire():
+                        chosen = None  # lost the half-open probe race
+                if chosen is not None:
+                    if chosen.assign(request):
+                        # Invalidate the cache entry so bursts spread out.
+                        self._len_cache.pop(chosen.replica_id, None)
+                        request.attempts += 1
+                        self.total_routed += 1
+                        ROUTED_TOTAL.inc(tags={"deployment": self.deployment})
+                        if sp is not None:
+                            sp.attributes.update(
+                                attempts=attempts, replica=chosen.replica_id
+                            )
+                        return True
+                    breaker.release()  # declined assign frees the probe slot
                 if time.monotonic() >= deadline:
-                    ROUTER_REJECTED.inc(tags={"deployment": self.deployment})
+                    # The metric distinguishes "every live replica was
+                    # breaker-excluded" from plain saturation backoff.
+                    reason = (
+                        "breaker_open" if breaker_excluded_last
+                        else "backoff_exhausted"
+                    )
+                    ROUTER_REJECTED.inc(
+                        tags={"deployment": self.deployment, "reason": reason}
+                    )
                     request.reject(
                         RequestDropped(
                             f"{self.deployment}: no replica accepted within "
-                            f"{self.max_assign_timeout_s}s"
+                            f"{window_s:.3f}s ({reason})"
                         )
                     )
                     if sp is not None:
-                        sp.attributes.update(attempts=attempts, rejected=True)
+                        sp.attributes.update(
+                            attempts=attempts, rejected=True, reason=reason
+                        )
                     return False
                 time.sleep(backoff)  # rdb-lint: disable=event-loop-blocking (caller-thread backoff by contract: the asyncio proxy offloads handle.remote to its routing pool, so this never runs on the event loop)
                 backoff = min(backoff * 2, BACKOFF_MAX_S)

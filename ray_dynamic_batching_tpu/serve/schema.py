@@ -284,7 +284,7 @@ def _main() -> int:
     }))
     if "--block" in sys.argv:
         try:
-            while True:
+            while True:  # rdb-lint: disable=unbounded-retry (CLI --block foreground park, not a retry loop; the only exit is KeyboardInterrupt by design)
                 time.sleep(3600)  # rdb-lint: disable=event-loop-blocking (CLI --block foreground park; blocking is the point of the flag)
         except KeyboardInterrupt:
             pass

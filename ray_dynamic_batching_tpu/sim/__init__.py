@@ -25,6 +25,7 @@ from ray_dynamic_batching_tpu.sim.report import (
     slo_attainment,
 )
 from ray_dynamic_batching_tpu.sim.simulator import (
+    EngineFailure,
     Scenario,
     SimModelSpec,
     Simulation,
@@ -49,6 +50,7 @@ __all__ = [
     "format_compare",
     "render_json",
     "slo_attainment",
+    "EngineFailure",
     "Scenario",
     "SimModelSpec",
     "Simulation",

@@ -67,6 +67,7 @@ class SimScheduler:
         self._models: Dict[str, ModelEntry] = {}
         self._current_plan: List[NodePlan] = []
         self._monitor_until_ms = 0.0
+        self._dead_engines: set = set()
         self.schedule_changes = 0
         self.schedule_log: List[Dict] = []
 
@@ -99,13 +100,14 @@ class SimScheduler:
         trigger: str = "manual",
     ) -> List[NodePlan]:
         rates = rates if rates is not None else self.rates.rates()
+        alive = [e for e in self.engines if e.healthy()]
         decision = decide_replan(
             self.packer,
-            [frozenset(e.models) for e in self.engines],
+            [frozenset(e.models) for e in alive],
             sessions_for(self._models, rates),
             rates,
         )
-        for engine, node_plan in zip(self.engines, decision.assignment):
+        for engine, node_plan in zip(alive, decision.assignment):
             if node_plan is not None:
                 engine.assign(node_plan)
             elif engine.models:
@@ -143,18 +145,47 @@ class SimScheduler:
             self._on_monitor,
         )
 
+    def check_engine_health(self) -> bool:
+        """Mirror of ``LiveScheduler.check_engine_health``: detect newly
+        dead engines at the monitor tick (same detection lag the live
+        control loop pays) and replan over survivors — failure-driven,
+        so it bypasses the rate cold-window guard."""
+        newly_dead = [
+            e for e in self.engines
+            if e.engine_id not in self._dead_engines and not e.healthy()
+        ]
+        if not newly_dead:
+            return False
+        for e in newly_dead:
+            self._dead_engines.add(e.engine_id)
+        self.audit.record(
+            "engine_dead",
+            observed={"dead_engines": sorted(self._dead_engines)},
+            diff={"removed": [e.engine_id for e in newly_dead]},
+            note="engine death detected by monitor; replan over survivors",
+        )
+        self.rebalance(trigger="heal")
+        return True
+
     def _on_monitor(self) -> None:
+        # Horizon check at FIRE time, not re-arm time: a tick armed just
+        # before duration_s would otherwise land in the drain phase and
+        # replan on decaying rates — live runs stop their monitor at the
+        # workload's end, and with a dead engine such a drain replan can
+        # truncate a model off the shrunken cluster and strand its queue.
+        if self.clock.now_ms() >= self._monitor_until_ms:
+            return
+        healed = self.check_engine_health()
         changed = self.rates.changed_models(
             self.rate_threshold, self.rate_decrease_multiplier,
             min_span_s=self.rate_min_span_s,
         )
-        if changed:
+        if changed and not healed:  # heal already replanned this tick
             self.rebalance(trigger="rate_change")
-        if self.clock.now_ms() < self._monitor_until_ms:
-            self.loop.schedule_in(
-                max(self.monitoring_interval_s * 1000.0, 1.0),
-                self._on_monitor,
-            )
+        self.loop.schedule_in(
+            max(self.monitoring_interval_s * 1000.0, 1.0),
+            self._on_monitor,
+        )
 
     # --- observability (live snapshot shape) ------------------------------
     # snapshot()/schedule_log mirror LiveScheduler's surface on purpose:

@@ -68,6 +68,11 @@ class SimEngine:
         self._pending: Optional[NodePlan] = None
         self._cycle_start_ms = 0.0
         self._started = False
+        # Scenario failure injection: a dead engine stops popping work at
+        # its next event (queued requests live in the SHARED per-model
+        # queues, so they wait for the heal replan, exactly as live).
+        self.alive = True
+        self.failed_at_ms: Optional[float] = None
         # --- accounting ---
         self.busy_ms = 0.0
         self.batches = 0
@@ -84,6 +89,21 @@ class SimEngine:
         """Queue a new node plan; applied at the next cycle boundary
         (live: background prepare, pointer swap at cycle boundary)."""
         self._pending = plan
+
+    def healthy(self) -> bool:
+        """Same liveness surface the live schedulers consult
+        (``ReplicaEngine.healthy`` / test fakes)."""
+        return self.alive
+
+    def fail(self) -> None:
+        """Kill this engine at the current virtual time (a ``Scenario``
+        failure event): every already-scheduled cycle/slice event becomes
+        a no-op, so the engine executes nothing past this instant. The
+        scheduler's monitor detects the death at its next tick — the same
+        detection lag a live control loop pays."""
+        if self.alive:
+            self.alive = False
+            self.failed_at_ms = self.clock.now_ms()
 
     def describe(self) -> str:
         return (
@@ -118,6 +138,8 @@ class SimEngine:
         return mean
 
     def _on_cycle_start(self) -> None:
+        if not self.alive:
+            return
         if self._pending is not None:
             self._plan = self._pending
             self._pending = None
@@ -129,6 +151,8 @@ class SimEngine:
         self._on_slice(0)
 
     def _on_slice(self, idx: int) -> None:
+        if not self.alive:
+            return
         plan = self._plan
         if idx >= len(plan.placements):  # plan shrank under us: new cycle
             self._end_cycle()

@@ -16,7 +16,11 @@ from typing import Dict
 
 from ray_dynamic_batching_tpu.engine.workload import RatePattern
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
-from ray_dynamic_batching_tpu.sim.simulator import Scenario, SimModelSpec
+from ray_dynamic_batching_tpu.sim.simulator import (
+    EngineFailure,
+    Scenario,
+    SimModelSpec,
+)
 
 MB = 1024 * 1024
 
@@ -93,4 +97,33 @@ def smoke_scenario(seed: int = 0) -> Scenario:
         n_engines=3,
         seed=seed,
         monitoring_interval_s=2.0,
+    )
+
+
+def chaos_scenario(seed: int = 0) -> Scenario:
+    """The chaos conformance fixture (``tools/run_chaos_soak.py --sim``):
+    two comfortably-provisioned models on 3 chips, one engine KILLED
+    mid-run. Expected story: the monitor detects the death at its next
+    tick, a heal replan migrates the dead chip's models to survivors,
+    and — because capacity still covers demand — queued work completes
+    within SLO: the failure costs at most a detection-window of sheds,
+    never a silent stall. Roomy SLOs keep the accounting robust so the
+    conformance gate grades the HEAL story, not knife-edge shedding."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="fast", slo_ms=2000.0,
+                pattern=RatePattern("constant", base_rps=50.0),
+            ),
+            SimModelSpec(
+                name="fat", slo_ms=4000.0,
+                pattern=RatePattern("constant", base_rps=6.0),
+            ),
+        ],
+        duration_s=30.0,
+        drain_s=5.0,
+        n_engines=3,
+        seed=seed,
+        monitoring_interval_s=2.0,
+        failures=[EngineFailure(at_s=10.0, engine=0)],
     )

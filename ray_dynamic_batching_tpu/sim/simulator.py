@@ -89,6 +89,27 @@ class SimModelSpec:
 
 
 @dataclass
+class EngineFailure:
+    """One injected engine death: the engine indexed ``engine`` dies at
+    virtual time ``at_s`` (the sim analogue of an injected
+    ``replica.loop`` crash / a chaos-killed worker). The scheduler's
+    monitor detects it at its next tick and replans over survivors."""
+
+    at_s: float
+    engine: int
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EngineFailure":
+        unknown = set(d) - {"at_s", "engine"}
+        if unknown:
+            raise ValueError(
+                f"unknown failure key(s) {sorted(unknown)}; "
+                "known: ['at_s', 'engine']"
+            )
+        return cls(at_s=float(d["at_s"]), engine=int(d["engine"]))
+
+
+@dataclass
 class Scenario:
     """One simulated deployment under one traffic story."""
 
@@ -113,6 +134,9 @@ class Scenario:
     hbm_plan_fraction: float = 0.9
     warm_start: bool = True          # initial manual rebalance at t=0
     latency_jitter: bool = False     # seeded gaussian around row means
+    # Injected engine deaths (chaos conformance): each kills one sim
+    # engine at virtual time t; the monitor heals over survivors.
+    failures: List[EngineFailure] = field(default_factory=list)
     arrivals: Optional[List[Arrival]] = field(default=None, repr=False)
 
     # Loader-level keys (profiles/arrivals paths) ride in the same JSON
@@ -156,6 +180,9 @@ class Scenario:
             hbm_plan_fraction=float(d.get("hbm_plan_fraction", 0.9)),
             warm_start=bool(d.get("warm_start", True)),
             latency_jitter=bool(d.get("latency_jitter", False)),
+            failures=[
+                EngineFailure.from_dict(f) for f in d.get("failures", [])
+            ],
         )
 
 
@@ -278,6 +305,16 @@ class Simulation:
                 lambda m=model: sched.submit(m),
             )
 
+        for f in sc.failures:
+            if not 0 <= f.engine < sc.n_engines:
+                raise ValueError(
+                    f"failure names engine {f.engine} but the scenario has "
+                    f"{sc.n_engines} engine(s)"
+                )
+            loop.schedule_at(
+                f.at_s * 1000.0, lambda e=engines[f.engine]: e.fail()
+            )
+
         if sc.warm_start:
             sched.rebalance(rates=self._warm_start_rates(arrivals),
                             trigger="manual")
@@ -316,6 +353,8 @@ class Simulation:
                 "cycles": e.cycle_count,
                 "swaps": e.swap_count,
                 "models": sorted(e.models),
+                "alive": e.alive,
+                "failed_at_ms": e.failed_at_ms,
             }
         audit = sched.audit.to_dicts()
         migrations = sum(
@@ -333,6 +372,9 @@ class Simulation:
             "arrivals_total": len(arrivals),
             "arrivals_truncated_past_horizon": truncated,
             "arrivals_ignored_unregistered_model": ignored_models,
+            "failures": [
+                {"at_s": f.at_s, "engine": f.engine} for f in sc.failures
+            ],
             "models": models,
             "chips": chips,
             "chips_used": sum(1 for e in engines if e.batches > 0),

@@ -30,19 +30,32 @@ class ChaosInjected(RuntimeError):
 
 
 class ChaosInjector:
-    def __init__(self, spec: Optional[str] = None) -> None:
+    def __init__(self, spec: Optional[str] = None,
+                 seed: Optional[int] = None) -> None:
         self._lock = threading.Lock()
         self._budgets: Dict[str, int] = {}
         self._probs: Dict[str, float] = {}
         self._fired: Dict[str, int] = {}
-        self._rng = random.Random(0)
+        # Probabilistic injections draw from a dedicated seeded RNG so a
+        # chaos run replays deterministically; the seed comes from
+        # ``config.chaos_seed`` (RDB_CHAOS_SEED) unless given explicitly.
+        self._seed = seed if seed is not None else self._config_seed()
+        self._rng = random.Random(self._seed)
         self._active = False  # unlocked fast-path flag for hot callers
         self.configure(spec if spec is not None else os.environ.get(ENV_VAR, ""))
 
-    def configure(self, spec: str) -> None:
+    @staticmethod
+    def _config_seed() -> int:
+        from ray_dynamic_batching_tpu.utils.config import get_config
+
+        return get_config().chaos_seed
+
+    def configure(self, spec: str, seed: Optional[int] = None) -> None:
         """Parse ``point=N[:pP],point2=M`` (reference rpc_chaos.cc:32).
         Parses fully before swapping state, so an invalid spec leaves the
-        previous configuration untouched."""
+        previous configuration untouched. Every (re)configure reseeds the
+        injection RNG — same spec + same seed replays the same failure
+        schedule (``seed`` overrides the configured default)."""
         budgets: Dict[str, int] = {}
         probs: Dict[str, float] = {}
         for part in filter(None, (p.strip() for p in spec.split(","))):
@@ -59,6 +72,9 @@ class ChaosInjector:
             self._budgets = budgets
             self._probs = probs
             self._fired = {}
+            if seed is not None:
+                self._seed = seed
+            self._rng = random.Random(self._seed)
             self._active = bool(budgets)
 
     def should_fail(self, point: str) -> bool:
@@ -106,8 +122,10 @@ def chaos() -> ChaosInjector:
     return _GLOBAL
 
 
-def reset_chaos(spec: str = "") -> ChaosInjector:
-    """Re-configure the global injector (tests)."""
+def reset_chaos(spec: str = "", seed: Optional[int] = None) -> ChaosInjector:
+    """Re-configure (and optionally reseed) the global injector (tests /
+    soak harnesses): ``reset_chaos(spec, seed=N)`` pins the probabilistic
+    failure schedule for a deterministic replay."""
     inj = chaos()
-    inj.configure(spec)
+    inj.configure(spec, seed=seed)
     return inj

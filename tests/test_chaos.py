@@ -77,6 +77,36 @@ class TestInjector:
     def test_inactive_by_default(self):
         assert not chaos().active
 
+    def test_config_chaos_seed_is_honored(self):
+        """The ``chaos_seed`` knob drives the probabilistic-injection RNG
+        (it used to be dead — the injector hardcoded seed 0)."""
+        from ray_dynamic_batching_tpu.utils.config import (
+            RDBConfig,
+            set_config,
+        )
+
+        def schedule(seed):
+            set_config(RDBConfig.from_env(chaos_seed=seed))
+            inj = ChaosInjector("p.q=-1:p0.5")
+            return [inj.should_fail("p.q") for _ in range(64)]
+
+        assert schedule(7) == schedule(7)       # deterministic per seed
+        assert schedule(7) != schedule(1234)    # and the seed matters
+
+    def test_reset_chaos_reseeds_deterministically(self):
+        inj = reset_chaos("p.q=-1:p0.5", seed=42)
+        first = [inj.should_fail("p.q") for _ in range(64)]
+        reset_chaos("p.q=-1:p0.5", seed=42)
+        assert [inj.should_fail("p.q") for _ in range(64)] == first
+        reset_chaos("p.q=-1:p0.5", seed=43)
+        assert [inj.should_fail("p.q") for _ in range(64)] != first
+
+    def test_explicit_seed_beats_config(self):
+        inj_a = ChaosInjector("p.q=-1:p0.5", seed=9)
+        inj_b = ChaosInjector("p.q=-1:p0.5", seed=9)
+        assert [inj_a.should_fail("p.q") for _ in range(64)] == \
+            [inj_b.should_fail("p.q") for _ in range(64)]
+
 
 class TestReplicaChaos:
     def test_batch_failures_flow_to_futures_then_recover(self):

@@ -544,6 +544,117 @@ class TestSimDeterminism:
         assert report.new == [], report.format_text()
 
 
+# --- unbounded-retry ------------------------------------------------------
+
+# The bug class: while-True backoff with no deadline/attempt exit.
+UNBOUNDED_RETRY = """
+    import time
+
+    def fetch(replica, req):
+        backoff = 0.002
+        while True:
+            if replica.assign(req):
+                return True
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.1)
+"""
+
+# The compliant exemplar shape (Router.assign_request): a Compare-guarded
+# return bounds the loop by a deadline.
+BOUNDED_RETRY = """
+    import time
+
+    def fetch(replica, req, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        backoff = 0.002
+        while True:
+            if replica.assign(req):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.1)
+"""
+
+
+class TestUnboundedRetry:
+    def test_unbounded_backoff_loop_is_flagged(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/r.py", UNBOUNDED_RETRY,
+                              rules={"unbounded-retry"})
+        assert rules_found(report) == ["unbounded-retry"]
+        assert "deadline or attempt-budget" in report.new[0].message
+
+    def test_deadline_guarded_loop_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/r.py", BOUNDED_RETRY,
+                              rules={"unbounded-retry"})
+        assert report.new == []
+
+    def test_attempt_budget_break_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/r.py", """
+            import time
+
+            def fetch(replica, req, max_attempts):
+                attempts = 0
+                while True:
+                    attempts += 1
+                    if replica.assign(req):
+                        return True
+                    if attempts >= max_attempts:
+                        break
+                    time.sleep(0.01)
+                return False
+        """, rules={"unbounded-retry"})
+        assert report.new == []
+
+    def test_condition_bounded_loop_not_a_retry_loop(self, tmp_path):
+        # An event-pacing loop (`while not stop:`) is bounded by its
+        # condition — out of scope even though it sleeps.
+        report = lint_fixture(tmp_path, "engine/pacer.py", """
+            import time
+
+            def pace(stop):
+                while not stop.is_set():
+                    time.sleep(0.05)
+        """, rules={"unbounded-retry"})
+        assert report.new == []
+
+    def test_sleepless_while_true_is_not_flagged(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/poll.py", """
+            def drain(q):
+                while True:
+                    item = q.pop()
+                    if item is None:
+                        return
+        """, rules={"unbounded-retry"})
+        assert report.new == []
+
+    def test_outside_serving_tier_is_out_of_scope(self, tmp_path):
+        report = lint_fixture(tmp_path, "models/loader.py",
+                              UNBOUNDED_RETRY, rules={"unbounded-retry"})
+        assert report.new == []
+
+    def test_reasoned_pragma_suppresses(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "serve/r.py",
+            UNBOUNDED_RETRY.replace(
+                "while True:",
+                "while True:  # rdb-lint: disable=unbounded-retry "
+                "(caller enforces the deadline)",
+            ),
+            rules={"unbounded-retry"},
+        )
+        assert report.new == []
+        assert report.pragma_suppressed == 1
+
+    def test_router_exemplar_is_compliant(self):
+        report = run(
+            paths=[lint_core.REPO_ROOT / "ray_dynamic_batching_tpu"
+                   / "serve" / "router.py"],
+            rules={"unbounded-retry"},
+        )
+        assert report.new == [], report.format_text()
+
+
 # --- pragmas --------------------------------------------------------------
 
 SLEEPY = """

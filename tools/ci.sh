@@ -20,6 +20,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/check_openmetrics.py --smoke
   echo "== what-if simulator smoke (deterministic, tools/sim_smoke.json floors) =="
   python tools/run_sim.py --smoke
+  echo "== chaos conformance (sim: injected engine death, heal + accounting) =="
+  python tools/run_chaos_soak.py --sim
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
   exec python -m pytest tests/ -q -m "not slow"
 fi
@@ -42,6 +44,12 @@ python tools/check_openmetrics.py --smoke
 
 echo "== what-if simulator smoke (deterministic, tools/sim_smoke.json floors) =="
 python tools/run_sim.py --smoke
+
+echo "== chaos conformance (sim: injected engine death, heal + accounting) =="
+python tools/run_chaos_soak.py --sim
+
+echo "== chaos conformance (live soak: injected failures, zero system errors) =="
+python tools/run_chaos_soak.py --live --smoke
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q

@@ -285,6 +285,7 @@ def _all_checkers() -> List[Checker]:
     from tools.lint.determinism import SimDeterminismChecker
     from tools.lint.event_loop import EventLoopBlockingChecker
     from tools.lint.host_sync import HostSyncChecker
+    from tools.lint.retry import UnboundedRetryChecker
     from tools.lint.spans import SpanHygieneChecker
     from tools.lint.vmem import TileAlignmentChecker, VmemBudgetChecker
 
@@ -295,6 +296,7 @@ def _all_checkers() -> List[Checker]:
         HostSyncChecker(),
         SpanHygieneChecker(),
         SimDeterminismChecker(),
+        UnboundedRetryChecker(),
     ]
 
 

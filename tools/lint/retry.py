@@ -1,0 +1,92 @@
+"""unbounded-retry — retry/backoff loops must budget deadline or attempts.
+
+The failover layer's whole contract is that a re-dispatched request
+cannot circulate forever: every retry decision checks the admission
+deadline and an attempt cap. A ``while True`` loop that sleeps (the
+lexical shape of a retry/backoff loop) with NO comparison-guarded exit
+is the bug class this rule exists for — it looks fine under light load
+and spins a thread (or worse, re-dispatches a request) forever once the
+condition it waits for stops arriving. ``Router.assign_request`` is the
+compliant exemplar: ``while True`` + backoff sleep, with
+``if time.monotonic() >= deadline: ... return`` bounding it.
+
+A loop is a finding when, in ``serve/`` or ``engine/``:
+
+- its test is constant-true (``while True:`` / ``while 1:``), AND
+- its body (lexically, any nesting) calls a sleep
+  (``time.sleep`` / ``asyncio.sleep`` / bare ``sleep``), AND
+- no conditional exit exists: no ``if``/``while`` in the body whose
+  test contains a comparison and whose subtree contains
+  ``break``/``return``/``raise``.
+
+Event-pacing loops (``while not stop.is_set():``, ``while active:``)
+have a non-constant test and are out of scope — they are bounded by
+their condition, not by a budget.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import (
+    Checker, FileCtx, Scope, dotted_name as _dotted, in_dirs,
+)
+
+_SLEEP_CALLS = {"time.sleep", "asyncio.sleep", "sleep"}
+
+
+def _is_constant_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _contains_sleep(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and (
+            (_dotted(sub.func) or "") in _SLEEP_CALLS
+        ):
+            return True
+    return False
+
+
+def _has_budgeted_exit(loop: ast.While) -> bool:
+    """A conditional (If / nested While) whose test compares something
+    and whose subtree breaks, returns, or raises — the lexical shape of
+    ``if now >= deadline: reject(); return`` / ``if attempts > cap:``."""
+    for sub in ast.walk(loop):
+        if sub is loop or not isinstance(sub, (ast.If, ast.While)):
+            continue
+        has_compare = any(
+            isinstance(t, ast.Compare) for t in ast.walk(sub.test)
+        )
+        if not has_compare:
+            continue
+        for inner in ast.walk(sub):
+            if isinstance(inner, (ast.Break, ast.Return, ast.Raise)):
+                return True
+    return False
+
+
+class UnboundedRetryChecker(Checker):
+    rule = "unbounded-retry"
+
+    def applies(self, relpath: str) -> bool:
+        return in_dirs(relpath, {"serve", "engine"})
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        if not isinstance(node, ast.While):
+            return
+        if not _is_constant_true(node.test):
+            return
+        if not _contains_sleep(node):
+            return
+        if _has_budgeted_exit(node):
+            return
+        self.report(
+            ctx, node,
+            "unbounded retry/backoff loop: `while True` with a sleep "
+            "needs a deadline or attempt-budget exit (compare against "
+            "a deadline/attempt cap, then break/return/raise — see "
+            "Router.assign_request); without one it spins forever once "
+            "the awaited condition stops arriving",
+            scope,
+        )

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Chaos conformance gate — inject failures, assert nobody sees a 500.
+
+The contract under test is the failover layer's (serve/failover.py):
+with bounded chaos budgets on the instrumented failure points, every
+ADMITTED request either completes successfully or is counted SHED
+(deadline economics) — zero client-visible *system* errors. Two modes:
+
+  --live   (default) a real ServeController + 2-replica deployment on
+           threads, driven at --rps for --requests requests while
+           ``RDB_TESTING_FAILURE`` budgets fire on replica.process_batch,
+           replica.loop, and router.assign. Asserts:
+             - system_errors == 0 (every non-shed request completed)
+             - the chaos budgets actually FIRED (a soak that injected
+               nothing proves nothing)
+             - loop-kill recovery: the controller replaced the crashed
+               replica (heal audit record present)
+  --sim    the deterministic counterpart: the chaos fixture scenario
+           (sim/scenarios.chaos_scenario — an engine killed at virtual
+           t=10s) run TWICE, asserting byte-identical reports, exact
+           accounting conservation (arrivals == completed+stale+dropped+
+           pending per model), a heal audit record, and the attainment
+           floor. Milliseconds of wall time — the CI fast lane's gate.
+
+Exit: 0 conformant, 1 violation, 2 usage.
+
+Examples:
+  python tools/run_chaos_soak.py --sim
+  python tools/run_chaos_soak.py --live --smoke
+  python tools/run_chaos_soak.py --live --requests 2000 --rps 400 \\
+      --chaos "replica.process_batch=10,replica.loop=2,router.assign=5"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_CHAOS = "replica.process_batch=3,replica.loop=1,router.assign=2"
+
+SIM_ATTAINMENT_FLOOR = 0.90
+
+
+def run_sim(seed: int = 0) -> int:
+    from ray_dynamic_batching_tpu.sim import Simulation, render_json
+    from ray_dynamic_batching_tpu.sim.scenarios import (
+        chaos_scenario,
+        fixture_profiles,
+    )
+
+    reports = [
+        Simulation(fixture_profiles(), chaos_scenario(seed=seed)).run()
+        for _ in range(2)
+    ]
+    blobs = [render_json(r) for r in reports]
+    failures = []
+    if blobs[0] != blobs[1]:
+        failures.append("nondeterministic: same seed produced different "
+                        "report bytes")
+    report = reports[0]
+    for name, s in report["models"].items():
+        accounted = (s["completed"] + s["stale"] + s["dropped"] + s["pending"])
+        if s["arrivals"] != accounted:
+            failures.append(
+                f"{name}: accounting leak — {s['arrivals']} arrivals vs "
+                f"{accounted} accounted (completed+stale+dropped+pending); "
+                "a failure made requests vanish"
+            )
+        if s["slo_attainment"] < SIM_ATTAINMENT_FLOOR:
+            failures.append(
+                f"{name}: attainment {s['slo_attainment']:.3f} < floor "
+                f"{SIM_ATTAINMENT_FLOOR} — the heal replan did not recover "
+                "the dead engine's traffic"
+            )
+    triggers = [a["trigger"] for a in report["audit"]]
+    if "engine_dead" not in triggers or "heal" not in triggers:
+        failures.append(
+            f"no engine_dead/heal audit records (saw {sorted(set(triggers))})"
+            " — the monitor never detected the injected death"
+        )
+    dead = [c for c, v in report["chips"].items() if not v["alive"]]
+    if len(dead) != 1:
+        failures.append(f"expected exactly 1 dead chip, saw {dead}")
+    summary = {
+        "mode": "sim",
+        "deterministic": blobs[0] == blobs[1],
+        "models": {
+            name: {k: s[k] for k in ("arrivals", "completed", "stale",
+                                     "dropped", "pending", "slo_attainment")}
+            for name, s in report["models"].items()
+        },
+        "dead_chips": dead,
+        "violations": failures,
+    }
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 1 if failures else 0
+
+
+def run_live(chaos_spec: str, n_requests: int, rps: float,
+             slo_ms: float) -> int:
+    from ray_dynamic_batching_tpu.serve import (
+        DeploymentConfig,
+        DeploymentHandle,
+        ServeController,
+        is_shed,
+    )
+    from ray_dynamic_batching_tpu.utils.chaos import chaos, reset_chaos
+
+    def work(payloads):
+        time.sleep(0.001)  # a visible (but tiny) batch cost
+        return [p * 2 for p in payloads]
+
+    ctl = ServeController(control_interval_s=0.05)
+    router = ctl.deploy(
+        DeploymentConfig(
+            name="soak", num_replicas=2, max_batch_size=4,
+            batch_wait_timeout_s=0.002, max_restarts=8,
+        ),
+        factory=lambda: work,
+    )
+    ctl.start()
+    handle = DeploymentHandle(router, default_slo_ms=slo_ms)
+    spec = chaos_spec if chaos_spec is not None else os.environ.get(
+        "RDB_TESTING_FAILURE", DEFAULT_CHAOS
+    )
+    points = [p.split("=")[0] for p in spec.split(",") if p]
+    violations = []
+    try:
+        # Warmup proves the path before injection starts.
+        assert handle.remote(1).result(timeout=10) == 2
+        reset_chaos(spec)
+        futures = []
+        interval = 1.0 / rps if rps > 0 else 0.0
+        for i in range(n_requests):
+            futures.append((i, handle.remote(i)))
+            if interval:
+                time.sleep(interval)
+        completed = shed = system_errors = 0
+        first_error = None
+        for i, fut in futures:
+            try:
+                result = fut.result(timeout=30)
+                if result != i * 2:
+                    system_errors += 1
+                    first_error = first_error or f"wrong result for {i}"
+                else:
+                    completed += 1
+            except Exception as e:  # noqa: BLE001 — classification is the test
+                if is_shed(e):
+                    shed += 1
+                else:
+                    system_errors += 1
+                    first_error = first_error or f"{type(e).__name__}: {e}"
+        fired = {p: chaos().fired(p) for p in points}
+        if system_errors:
+            violations.append(
+                f"{system_errors} client-visible system error(s); first: "
+                f"{first_error}"
+            )
+        for p, n in fired.items():
+            if n == 0:
+                violations.append(
+                    f"chaos point {p} never fired — the soak proved nothing"
+                )
+        heals = [a for a in ctl.audit.to_dicts() if a["trigger"] == "heal"]
+        if "replica.loop" in points and not heals:
+            violations.append(
+                "replica.loop fired but no heal audit record — the "
+                "controller never replaced the crashed replica"
+            )
+        status = ctl.status()["soak"]
+        summary = {
+            "mode": "live",
+            "chaos": spec,
+            "requests": n_requests,
+            "completed": completed,
+            "shed": shed,
+            "system_errors": system_errors,
+            "chaos_fired": fired,
+            "failover": status["failover"],
+            "breakers": status["breakers"],
+            "heal_records": len(heals),
+            "violations": violations,
+        }
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    finally:
+        reset_chaos("")
+        ctl.shutdown()
+    return 1 if violations else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--sim", action="store_true",
+                      help="deterministic sim conformance (CI fast lane)")
+    mode.add_argument("--live", action="store_true",
+                      help="threaded soak against a real controller")
+    ap.add_argument("--smoke", action="store_true",
+                    help="live: shrink to a quick CI-sized soak")
+    ap.add_argument("--chaos", default=None,
+                    help=f"failure spec (default: $RDB_TESTING_FAILURE or "
+                         f"'{DEFAULT_CHAOS}')")
+    ap.add_argument("--requests", type=int, default=500)
+    ap.add_argument("--rps", type=float, default=250.0)
+    ap.add_argument("--slo-ms", type=float, default=15_000.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.sim:
+        return run_sim(seed=args.seed)
+    n = 150 if args.smoke else args.requests
+    return run_live(args.chaos, n, args.rps, args.slo_ms)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
