@@ -232,7 +232,7 @@ class ColocatedLLMEngines:
     def _release(self, hosted: HostedEngine) -> None:
         hosted.engine.interleave_hook = None
         hosted.engine.abort_active(
-            RequestDropped(f"{hosted.model} detached from {self.name}")
+            RequestDropped(f"{hosted.model} detached from {self.name}")  # rdb-lint: disable=shed-accounting (detach is a replan decision already recorded in the scheduler audit ring; abort_active resolves each slot future, and the decode engine's slot stats count the aborts)
         )
         hosted.engine.release_buffers()
         hosted.released.set()

@@ -1228,7 +1228,11 @@ class DecodeEngine:
                 # or closed queue refuses WITHOUT rejecting (router-retry
                 # semantics), but here the engine holds the only reference:
                 # an unchecked drop would leave the future hanging forever.
-                if not self.queue.add_request(req, reject_on_full=False):
+                if not self.queue.add_request(req, reject_on_full=False,
+                                              requeue=True):
+                    self.queue.count_external_drop(
+                        req, reason="requeue_refused"
+                    )
                     req.reject(RequestDropped(
                         f"{req.request_id}: queue refused requeue during "
                         "chunked admission"

@@ -30,6 +30,31 @@ def now_ms() -> float:
     return time.monotonic() * 1000.0
 
 
+# --- QoS classes (Shepherd-style priority tiers, ROADMAP item 4) -----------
+# Rank orders DEQUEUE priority (lower = served first) and the inverse shed
+# order (highest rank sheds first). Weights price an SLO miss for the
+# planner's weighted attainment (scheduler/replan.weighted_attainment):
+# an interactive miss costs 4x a best-effort one.
+QOS_CLASSES = ("interactive", "standard", "best_effort")
+QOS_RANK = {"interactive": 0, "standard": 1, "best_effort": 2}
+QOS_WEIGHTS = {"interactive": 4.0, "standard": 2.0, "best_effort": 1.0}
+DEFAULT_QOS_CLASS = "standard"
+DEFAULT_TENANT = "default"
+
+
+def normalize_qos(value: Optional[str]) -> str:
+    """Validate a client-supplied class name; unknown values are the
+    CLIENT's fault (BadRequest -> 4xx), never a silent default — a typo'd
+    'interactve' must not quietly serve at best-effort shed priority."""
+    if value is None or value == "":
+        return DEFAULT_QOS_CLASS
+    if value not in QOS_RANK:
+        raise BadRequest(
+            f"unknown qos_class {value!r} (one of: {', '.join(QOS_CLASSES)})"
+        )
+    return value
+
+
 class StreamClosed(Exception):
     """Raised by :meth:`TokenStream.get` after close + drain."""
 
@@ -184,12 +209,21 @@ class Request:
     # like the reference's shed accounting (a request either completes
     # within its admitted deadline or is counted shed).
     admission_deadline_ms: float = 0.0
+    # Multi-tenant QoS (ROADMAP item 4): who sent it and at which service
+    # tier. Both ride the request through every retry/requeue — failover
+    # re-dispatches the SAME object, so class and tenant survive failover
+    # by construction (pinned in tests/test_qos.py).
+    tenant: str = DEFAULT_TENANT
+    qos_class: str = DEFAULT_QOS_CLASS
 
     def __post_init__(self) -> None:
         if not self.request_id:
             self.request_id = f"{self.model}-{next(_req_counter)}"
         if not self.admission_deadline_ms:
             self.admission_deadline_ms = self.arrival_ms + self.slo_ms
+        self.qos_class = normalize_qos(self.qos_class)
+        if not self.tenant:
+            self.tenant = DEFAULT_TENANT
 
     @property
     def deadline_ms(self) -> float:

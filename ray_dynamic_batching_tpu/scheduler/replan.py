@@ -22,6 +22,7 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ray_dynamic_batching_tpu.engine.request import QOS_WEIGHTS
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile
 from ray_dynamic_batching_tpu.scheduler.audit import plan_diff
 from ray_dynamic_batching_tpu.scheduler.nexus import (
@@ -44,6 +45,36 @@ class ModelEntry:
     name: str
     slo_ms: float
     seq_len: int = 0
+
+
+def weighted_attainment(
+    class_counters: Dict[str, Dict[str, float]],
+    weights: Optional[Dict[str, float]] = None,
+) -> float:
+    """Class-weighted SLO attainment — the planner's pricing of a miss.
+
+    Plain attainment treats every shed request equally; the QoS contract
+    does not: an interactive miss costs :data:`QOS_WEIGHTS` (4x) a
+    best-effort one. This is the SHARED pricing function (sim reports,
+    the overload-soak grade, live snapshots) so "did degradation stay
+    graceful?" is answered by one formula on both sides — same no-drift
+    discipline as ``decide_replan`` itself. Shed load (stale + dropped)
+    counts as missed, exactly like ``sim/report.slo_attainment``.
+
+    ``class_counters`` is per class ``{completed, violations, stale,
+    dropped}`` (the queue's ``class_stats()`` shape). 1.0 when idle."""
+    weights = weights if weights is not None else QOS_WEIGHTS
+    w_accounted = 0.0
+    w_missed = 0.0
+    for cls, c in class_counters.items():
+        w = weights.get(cls, 1.0)
+        accounted = (c.get("completed", 0.0) + c.get("stale", 0.0)
+                     + c.get("dropped", 0.0))
+        missed = (c.get("violations", 0.0) + c.get("stale", 0.0)
+                  + c.get("dropped", 0.0))
+        w_accounted += w * accounted
+        w_missed += w * missed
+    return 1.0 - w_missed / w_accounted if w_accounted else 1.0
 
 
 def sessions_for(

@@ -18,6 +18,12 @@ from ray_dynamic_batching_tpu.serve.api import (
     shutdown,
     status,
 )
+from ray_dynamic_batching_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionPolicy,
+    AdmissionRejected,
+    TokenBucket,
+)
 from ray_dynamic_batching_tpu.serve.autoscaling import (
     AutoscalingConfig,
     AutoscalingPolicy,
@@ -35,6 +41,7 @@ from ray_dynamic_batching_tpu.serve.failover import (
     RetryableSystemError,
     is_retryable,
     is_shed,
+    reject_disposition,
 )
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 from ray_dynamic_batching_tpu.serve.llm import LLMDeployment, LLMReplica
@@ -51,6 +58,11 @@ from ray_dynamic_batching_tpu.serve.schema import (
 )
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionPolicy",
+    "AdmissionRejected",
+    "TokenBucket",
+    "reject_disposition",
     "Application",
     "Deployment",
     "batch",

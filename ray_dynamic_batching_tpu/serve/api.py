@@ -380,9 +380,14 @@ def run(
         if "batch_wait_timeout_s" not in dep._explicit:
             cfg.batch_wait_timeout_s = float(bopts["batch_wait_timeout_s"])
     router = ctl.deploy(cfg, factory=dep._make_factory(app.args, app.kwargs))
-    handle = DeploymentHandle(router, default_slo_ms=default_slo_ms)
+    handle = DeploymentHandle(router, default_slo_ms=default_slo_ms,
+                              default_qos_class=cfg.default_qos_class)
     if route_prefix is not None:
         proxy = _get_proxy()
+        # The proxy's admission checks must grade against THIS
+        # controller's policy table/governor state (one shared instance,
+        # so the control loop's degrade decisions bind the front door).
+        proxy.admission = ctl.admission
         proxy.router.set_route(route_prefix, handle)
     return handle
 

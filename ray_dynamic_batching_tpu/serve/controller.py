@@ -31,6 +31,10 @@ from ray_dynamic_batching_tpu.parallel.placement import (
 )
 from ray_dynamic_batching_tpu.runtime.kv import KVStore
 from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
+from ray_dynamic_batching_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionPolicy,
+)
 from ray_dynamic_batching_tpu.serve.autoscaling import (
     AutoscalingConfig,
     AutoscalingPolicy,
@@ -79,6 +83,14 @@ class DeploymentConfig:
     # @multiplexed loader's bound so the router never steers traffic to a
     # replica whose cache already evicted the model.
     max_multiplexed_models: int = 8
+    # --- multi-tenant QoS (serve/admission.py) ---
+    # Service tier for requests that declare none (interactive | standard
+    # | best_effort) — the deployment's contract, stamped by the handle.
+    default_qos_class: str = "standard"
+    # Per-(tenant, class) token-bucket admission rate consulted by the
+    # proxies BEFORE queueing; 0 = no admission control (admit all).
+    admission_rate_rps: float = 0.0
+    admission_burst: float = 0.0       # 0 -> defaults to the rate
 
     def to_json(self) -> Dict[str, Any]:
         d = {
@@ -95,6 +107,9 @@ class DeploymentConfig:
             "version": self.version,
             "rolling_max_unavailable_fraction":
                 self.rolling_max_unavailable_fraction,
+            "default_qos_class": self.default_qos_class,
+            "admission_rate_rps": self.admission_rate_rps,
+            "admission_burst": self.admission_burst,
         }
         if self.autoscaling is not None:
             d["autoscaling"] = vars(self.autoscaling)
@@ -148,6 +163,12 @@ class ServeController:
         # Structured decision ring (scheduler/audit.py): deploys, scale
         # moves, heals, rollouts — surfaced per deployment in status().
         self.audit = AuditLog("serve")
+        # Token-bucket admission + overload governor (serve/admission.py):
+        # the proxies consult it pre-queue; this control loop feeds it
+        # queue-depth/compliance signals each step, and its governor
+        # transitions land in the SAME audit ring as heals and replans.
+        self.admission = AdmissionController()
+        self.admission.audit = self.audit
 
     # --- deploy API (ref serve.run / deploy) ------------------------------
     def register_factory(
@@ -228,6 +249,12 @@ class ServeController:
                 )
             else:
                 state.policy = None  # autoscaling removed -> pin num_replicas
+            self.admission.configure(
+                config.name,
+                AdmissionPolicy(rate_rps=config.admission_rate_rps,
+                                burst=config.admission_burst)
+                if config.admission_rate_rps > 0 else None,
+            )
             self.audit.record(
                 "deploy",
                 key=config.name,
@@ -248,6 +275,7 @@ class ServeController:
             state = self._deployments.pop(name, None)
             if state is None:
                 return
+            self.admission.configure(name, None)
             victims = state.replicas
             state.replicas = []
             self._publish(state)
@@ -538,10 +566,29 @@ class ServeController:
         )
 
     # --- control loop -----------------------------------------------------
+    def _observe_admission(self, state: "_DeploymentState") -> None:
+        """Feed the overload governor this deployment's congestion
+        signals: worst replica queue-fill fraction + worst recent SLO
+        compliance. Hysteresis and the degrade/recover decision live in
+        the AdmissionController; every transition is audited."""
+        if self.admission.policy(state.config.name) is None:
+            return
+        depth_frac = 0.0
+        compliance = 1.0
+        for r in state.replicas:
+            cap = max(1, getattr(r, "max_ongoing_requests", 1))
+            try:
+                depth_frac = max(depth_frac, r.queue_len() / cap)
+                compliance = min(compliance, r.slo_compliance())
+            except Exception:  # noqa: BLE001 — stats must not stop control
+                continue
+        self.admission.observe(state.config.name, depth_frac, compliance)
+
     def _control_step(self) -> None:
         deferred: List[Callable[[], None]] = []
         with self._lock:
             for state in list(self._deployments.values()):
+                self._observe_admission(state)
                 if state.policy is not None:
                     metrics = state.router.demand_metrics()
                     target = state.policy.step(
@@ -644,6 +691,9 @@ class ServeController:
                     # the observable half of request-level fault tolerance.
                     "breakers": state.router.breaker_states(),
                     "failover": state.router.failover.stats(),
+                    # Admission governor state (serve/admission.py):
+                    # normal vs degraded + whether a policy is installed.
+                    "admission": self.admission.snapshot(name),
                     # Per-version replica counts: mid-rollout both the old
                     # and the new version appear here (ref deployment_state
                     # rollout status).

@@ -102,6 +102,63 @@ def is_shed(exc: BaseException) -> bool:
     return isinstance(exc, (RequestStale, RequestDropped))
 
 
+@dataclass(frozen=True)
+class RejectDisposition:
+    """How one rejection surfaces to a client — ONE table shared by the
+    HTTP proxy and ``grpc_proxy._error_status`` so the two front doors
+    can never disagree on what a shed is."""
+
+    kind: str                      # "user" | "capacity" | "system" | "internal"
+    http_status: int
+    grpc_code: str                 # grpc.StatusCode attribute name
+    retry_after_s: Optional[float] = None
+
+
+def reject_disposition(exc: BaseException) -> RejectDisposition:
+    """Classify a request failure for the client surface.
+
+    - **capacity** (429 / RESOURCE_EXHAUSTED + computed ``Retry-After``):
+      admission rejects and queue sheds (full-queue drops, displacement,
+      stale discards) — the system is healthy and saying "not now"; the
+      retry hint comes from the rejecting layer (bucket refill time /
+      queue drain estimate) with a 1 s floor-less fallback.
+    - **system** (503 / UNAVAILABLE + ``Retry-After``): retryable system
+      failures and exhausted failover budgets — the payload was never the
+      problem; a different moment (heal, breaker close) may serve it.
+    - **user** (400 / INVALID_ARGUMENT): the payload itself.
+    - **internal** (500 / INTERNAL): genuine bugs — must alarm, never
+      invite a retry."""
+    from ray_dynamic_batching_tpu.engine.request import BadRequest
+    from ray_dynamic_batching_tpu.serve.admission import AdmissionRejected
+
+    if isinstance(exc, BadRequest):
+        return RejectDisposition("user", 400, "INVALID_ARGUMENT")
+    if getattr(exc, "reason", "") == "breaker_open":
+        # Router terminal reject because EVERY live replica's breaker was
+        # open: the system is failing, not merely full — 503, not 429.
+        return RejectDisposition("system", 503, "UNAVAILABLE",
+                                 retry_after_s=1.0)
+    if isinstance(exc, AdmissionRejected) or is_shed(exc):
+        return RejectDisposition(
+            "capacity", 429, "RESOURCE_EXHAUSTED",
+            retry_after_s=float(getattr(exc, "retry_after_s", 0.0) or 1.0),
+        )
+    if isinstance(exc, RetriesExhausted) or is_retryable(exc):
+        return RejectDisposition("system", 503, "UNAVAILABLE",
+                                 retry_after_s=1.0)
+    return RejectDisposition("internal", 500, "INTERNAL")
+
+
+def retry_after_header(disposition: RejectDisposition) -> Optional[str]:
+    """HTTP ``Retry-After`` value (integer seconds, ceil'd — the header
+    grammar takes no fractions; sub-second hints round up to 1)."""
+    if disposition.retry_after_s is None:
+        return None
+    import math
+
+    return str(max(1, math.ceil(disposition.retry_after_s)))
+
+
 @dataclass
 class FailoverPolicy:
     """Retry knobs — deadline is the real bound, attempts the backstop."""
@@ -309,6 +366,8 @@ class FailoverManager:
             pending, self._heap = list(self._heap), []
             self._cond.notify_all()
         for _due, _seq, request, _excluded in pending:
+            FAILOVER_SHED.inc(tags={"deployment": self.router.deployment,
+                                    "reason": "shutdown"})
             request.reject(RequestDropped(
                 f"{self.router.deployment}: shutting down with retry pending"
             ))

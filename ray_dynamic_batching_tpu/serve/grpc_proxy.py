@@ -24,8 +24,14 @@ import time
 from concurrent import futures as cf
 from typing import Any, Iterator, Optional
 
-from ray_dynamic_batching_tpu.engine.request import BadRequest, StreamClosed
-from ray_dynamic_batching_tpu.serve.failover import RetriesExhausted, is_shed
+from ray_dynamic_batching_tpu.engine.request import (
+    BadRequest,
+    DEFAULT_QOS_CLASS,
+    DEFAULT_TENANT,
+    StreamClosed,
+    normalize_qos,
+)
+from ray_dynamic_batching_tpu.serve.failover import reject_disposition
 from ray_dynamic_batching_tpu.serve.proxy import ProxyRouter, _to_jsonable
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -60,9 +66,14 @@ class GRPCProxy:
         port: int = 0,
         request_timeout_s: float = 60.0,
         max_workers: int = 16,
+        admission=None,
     ) -> None:
         if not HAVE_GRPC:
             raise RuntimeError("grpcio is not installed")
+        # Optional serve.admission.AdmissionController — same instance
+        # (and therefore the same buckets/governor state) as the HTTP
+        # proxy's, so a tenant cannot dodge its budget by switching doors.
+        self.admission = admission
         self.router = router
         self.host = host
         self.port = port
@@ -90,6 +101,9 @@ class GRPCProxy:
         if handle is None:
             GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "NOT_FOUND"})
             context.abort(grpc.StatusCode.NOT_FOUND, err)
+        tenant, qos = self._identity(body, context, "Predict")
+        self._admit(body.get("deployment"), tenant,
+                    self._effective_qos(handle, qos), context, "Predict")
         # Ingest span for the gRPC front door; a ``traceparent`` field in
         # the JSON body (the generic-handler transport has no per-call
         # metadata plumbing here) joins the caller's trace. Dispatch
@@ -104,6 +118,8 @@ class GRPCProxy:
                 body.get("payload"),
                 slo_ms=body.get("slo_ms"),
                 multiplexed_model_id=body.get("multiplexed_model_id"),
+                tenant=tenant,
+                qos_class=qos,
             )
         timeout = self._budget(context)
         try:
@@ -120,17 +136,69 @@ class GRPCProxy:
         GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "OK"})
         return json.dumps({"result": _to_jsonable(result)}).encode()
 
+    def _identity(self, body: dict, context, method: str):
+        """(tenant, declared qos_class or None) from the request body —
+        top-level fields win, then fields embedded in the payload dict
+        (the handle reads those too, so the admitter must grade the SAME
+        identity the request will serve at). An unknown class is the
+        client's fault (INVALID_ARGUMENT), validated HERE so it cannot
+        escape handle.remote as an unhandled servicer error. None means
+        "undeclared" — the handle's per-deployment default applies."""
+        payload = body.get("payload")
+        nested = payload if isinstance(payload, dict) else {}
+        tenant = (body.get("tenant") or nested.get("tenant")
+                  or DEFAULT_TENANT)
+        declared = body.get("qos_class") or nested.get("qos_class")
+        if not declared:
+            return tenant, None
+        try:
+            return tenant, normalize_qos(declared)
+        except BadRequest as e:
+            GRPC_REQUESTS.inc(
+                tags={"method": method, "code": "INVALID_ARGUMENT"}
+            )
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    @staticmethod
+    def _effective_qos(handle, qos):
+        """The class admission grades when none was declared: the
+        handle's deployment default (what the Request will serve at)."""
+        if qos is not None:
+            return qos
+        return getattr(handle, "default_qos_class", DEFAULT_QOS_CLASS)
+
+    def _admit(self, deployment: str, tenant: str, qos: str,
+               context, method: str) -> None:
+        """Token-bucket admission BEFORE routing: a reject costs the
+        client this RPC and a computed retry hint (trailing metadata
+        ``retry-after-s``), not a queue slot."""
+        if self.admission is None:
+            return
+        ok, retry_after_s = self.admission.admit(deployment, tenant, qos)
+        if ok:
+            return
+        GRPC_REQUESTS.inc(
+            tags={"method": method, "code": "RESOURCE_EXHAUSTED"}
+        )
+        context.set_trailing_metadata(
+            (("retry-after-s", f"{retry_after_s:.3f}"),)
+        )
+        context.abort(
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            f"admission rate exceeded (tenant {tenant!r}, class {qos!r}); "
+            f"retry after {retry_after_s:.3f}s",
+        )
+
     @staticmethod
     def _error_status(e: Exception):
-        """Taxonomy-aligned status mapping (mirror of the HTTP proxy's):
-        exhausted failover budgets and shed outcomes are UNAVAILABLE —
-        the gRPC code retrying clients key on — while user errors keep
-        INVALID_ARGUMENT and genuine bugs stay INTERNAL."""
-        if isinstance(e, BadRequest):
-            return "INVALID", grpc.StatusCode.INVALID_ARGUMENT
-        if isinstance(e, RetriesExhausted) or is_shed(e):
-            return "UNAVAILABLE", grpc.StatusCode.UNAVAILABLE
-        return "INTERNAL", grpc.StatusCode.INTERNAL
+        """Status mapping from the ONE shared table
+        (``serve/failover.reject_disposition``, also the HTTP proxy's):
+        capacity sheds (admission rejects, queue-full drops, stale
+        discards) are RESOURCE_EXHAUSTED, retryable system failures and
+        exhausted failover budgets are UNAVAILABLE, user errors
+        INVALID_ARGUMENT, genuine bugs INTERNAL."""
+        disp = reject_disposition(e)
+        return disp.grpc_code, getattr(grpc.StatusCode, disp.grpc_code)
 
     def _budget(self, context) -> float:
         """Remaining time budget: client deadline capped by the server
@@ -157,13 +225,18 @@ class GRPCProxy:
                 tags={"method": "PredictStream", "code": "NOT_FOUND"}
             )
             context.abort(grpc.StatusCode.NOT_FOUND, err)
+        tenant, qos = self._identity(body, context, "PredictStream")
+        self._admit(body.get("deployment"), tenant,
+                    self._effective_qos(handle, qos), context,
+                    "PredictStream")
         with tracer().attach_context(
             parse_traceparent(body.get("traceparent")),
             "grpc.predict_stream",
             lane="grpc", deployment=body.get("deployment"),
         ):
             stream, future = handle.remote_stream(
-                body.get("payload"), slo_ms=body.get("slo_ms")
+                body.get("payload"), slo_ms=body.get("slo_ms"),
+                tenant=tenant, qos_class=qos,
             )
         # One budget covers the WHOLE stream (chunks + trailer), so a
         # stalled replica can't pin a worker thread for 2x the timeout.
@@ -210,6 +283,16 @@ class GRPCProxy:
 
     # --- lifecycle ---------------------------------------------------------
     def start(self) -> "GRPCProxy":
+        if self.admission is None:
+            # Default to the module controller's admission table (the
+            # same instance the HTTP proxy grades against) so a tenant
+            # cannot dodge its budget by switching doors; pass
+            # ``admission=`` explicitly to bind a different controller.
+            from ray_dynamic_batching_tpu.serve import api as _api
+
+            ctl = getattr(_api, "_controller", None)
+            if ctl is not None:
+                self.admission = ctl.admission
         rpcs = {
             "Predict": grpc.unary_unary_rpc_method_handler(
                 self._predict,

@@ -166,6 +166,11 @@ class LLMReplica(Replica):
             # rejection — futures/streams must never dangle past death.
             self.engines[bucket].abort_active(exc)
         for req in self.drain_queue():
+            # Shed accounting conserves through teardown: drained work is
+            # a counted drop, not a vanished request.
+            self._queues[self._engine_for(req.payload)].count_external_drop(
+                req, reason="closed"
+            )
             req.reject(exc)
         # Free HBM (params + caches) so a replacement on the same chip
         # doesn't OOM against this replica's dead buffers — but only if the
@@ -187,6 +192,14 @@ class LLMReplica(Replica):
                                 discard_stale=False)
                 )
         return out
+
+    def slo_compliance(self) -> float:
+        """Worst recent compliance across the bucket queues that carry
+        this replica's traffic (the base class's queue is closed here, so
+        its idle 1.0 would blind the overload governor's compliance
+        signal)."""
+        qs = list(self._queues.values())
+        return min((q.slo_compliance() for q in qs), default=1.0)
 
     # --- router-facing surface --------------------------------------------
     def queue_len(self) -> int:

@@ -25,7 +25,7 @@ import uuid
 from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
-from ray_dynamic_batching_tpu.engine.request import BadRequest
+from ray_dynamic_batching_tpu.engine.request import BadRequest, normalize_qos
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 
 _FINISH_MAP = {
@@ -99,6 +99,14 @@ def translate_request(body: Dict[str, Any],
     session = body.get("session_id", body.get("user"))
     if session is not None:
         payload["session_id"] = str(session)
+    # QoS extension fields: `tenant` names the paying account, `qos_class`
+    # the service tier — both ride the native payload so the handle stamps
+    # them onto the Request (admission at the proxy graded them already).
+    # An unknown class is a 400, mirror of the native doors.
+    if body.get("tenant") is not None:
+        payload["tenant"] = str(body["tenant"])
+    if body.get("qos_class") is not None:
+        payload["qos_class"] = normalize_qos(str(body["qos_class"]))
     return payload
 
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import functools
 import json
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -30,8 +31,16 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from ray_dynamic_batching_tpu.engine.request import BadRequest
-from ray_dynamic_batching_tpu.serve.failover import RetriesExhausted, is_shed
+from ray_dynamic_batching_tpu.engine.request import (
+    BadRequest,
+    DEFAULT_TENANT,
+    normalize_qos,
+)
+from ray_dynamic_batching_tpu.serve.failover import (
+    RejectDisposition,
+    reject_disposition,
+    retry_after_header,
+)
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -101,12 +110,18 @@ class HTTPProxy:
         port: int = 8265,
         status_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         request_timeout_s: float = 60.0,
+        admission: Optional[Any] = None,
     ) -> None:
         self.router = router
         self.host = host
         self.port = port
         self.status_fn = status_fn
         self.request_timeout_s = request_timeout_s
+        # Optional serve.admission.AdmissionController: consulted BEFORE
+        # any routing or queueing (the whole point of admission control —
+        # a reject costs one HTTP round trip, not a queue slot). Wired by
+        # serve.api when the module controller publishes a route.
+        self.admission = admission
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -164,6 +179,7 @@ class HTTPProxy:
                   headers: Optional[Dict[str, str]] = None) -> bytes:
         body = json.dumps(_to_jsonable(payload)).encode()
         status = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  429: "Too Many Requests",
                   500: "Internal Server Error", 503: "Service Unavailable",
                   504: "Gateway Timeout"}.get(code, reason or "Error")
         extra = "".join(
@@ -253,15 +269,11 @@ class HTTPProxy:
             code = "504"
             await _write_line({"error": "stream timed out"})
         except Exception as e:  # noqa: BLE001 — surface on the trailer line
-            # Same taxonomy as the unary path (the 200 header is already
-            # out, so `code` is the metrics classification): shed and
-            # budget-exhausted outcomes must not read as server errors.
-            if isinstance(e, BadRequest):
-                code = "400"
-            elif isinstance(e, RetriesExhausted) or is_shed(e):
-                code = "503"
-            else:
-                code = "500"
+            # Same shared table as the unary path (the 200 header is
+            # already out, so `code` is the metrics classification):
+            # capacity sheds read as 429, system failures as 503 — never
+            # server errors.
+            code = str(reject_disposition(e).http_status)
             await _write_line({"error": str(e)})
         writer.write(b"0\r\n\r\n")
         await writer.drain()
@@ -313,6 +325,60 @@ class HTTPProxy:
         except json.JSONDecodeError as e:
             return self._response(400, {"error": f"bad JSON: {e}"}), route
 
+        # --- QoS identity + admission (BEFORE any routing/queueing) ------
+        # Headers win over payload fields (a gateway stamping classes must
+        # override whatever the client self-declared); unknown classes are
+        # the client's fault (400), never a silent default. Undeclared
+        # identity grades at the HANDLE's per-deployment default — the
+        # admitter and the queue must see the same class.
+        hdrs = headers or {}
+        body_dict = payload if isinstance(payload, dict) else {}
+        tenant = (hdrs.get("x-rdb-tenant") or body_dict.get("tenant")
+                  or DEFAULT_TENANT)
+        declared_qos = hdrs.get("x-rdb-qos") or body_dict.get("qos_class")
+        try:
+            qos = (normalize_qos(declared_qos) if declared_qos
+                   else getattr(handle, "default_qos_class", None)
+                   or normalize_qos(None))
+        except BadRequest as e:
+            return self._response(400, {"error": str(e)}), route
+        identity_kwargs: Dict[str, Any] = {}
+        if isinstance(payload, dict):
+            # The handle builds the Request from the payload: HEADER-
+            # declared identity must ride it so spans/queues/audit see
+            # the same class the admitter graded. Only explicitly-sent
+            # values are written — injecting defaults would mutate every
+            # user payload visibly (an echo deployment would reflect
+            # keys the client never sent).
+            if hdrs.get("x-rdb-tenant"):
+                payload["tenant"] = tenant
+            if hdrs.get("x-rdb-qos"):
+                payload["qos_class"] = qos
+        elif isinstance(handle, DeploymentHandle) and (
+            hdrs.get("x-rdb-tenant") or hdrs.get("x-rdb-qos")
+        ):
+            # Non-dict payload: identity can't ride the payload, so pass
+            # it as kwargs (only to the native handle, whose signature
+            # takes them — adapter handles get dict payloads anyway).
+            identity_kwargs = {"tenant": tenant, "qos_class": qos}
+        if self.admission is not None:
+            ok, retry_after_s = self.admission.admit(
+                getattr(handle, "deployment", route), tenant, qos
+            )
+            if not ok:
+                # Same header grammar as every other capacity reject
+                # (failover.retry_after_header), just pre-dispatch.
+                ra = retry_after_header(RejectDisposition(
+                    "capacity", 429, "RESOURCE_EXHAUSTED",
+                    retry_after_s=retry_after_s,
+                ))
+                return self._response(
+                    429,
+                    {"error": f"admission rate exceeded (tenant "
+                              f"{tenant!r}, class {qos!r})"},
+                    headers={"Retry-After": ra},
+                ), route
+
         if (
             writer is not None
             and isinstance(payload, dict)
@@ -326,7 +392,9 @@ class HTTPProxy:
             # None marks "already written"; tag carries the code for metrics.
             return None, f"{route}|{code}"
 
-        future = await self._offload_routing(handle.remote, payload)
+        future = await self._offload_routing(
+            functools.partial(handle.remote, payload, **identity_kwargs)
+        )
         try:
             result = await asyncio.wait_for(
                 asyncio.wrap_future(future), timeout=self.request_timeout_s
@@ -334,27 +402,23 @@ class HTTPProxy:
         except asyncio.TimeoutError:
             return self._response(504, {"error": "request timed out"}), route
         except Exception as e:  # noqa: BLE001 — replica-side errors surface as 500
-            # Only the dedicated BadRequest type is the client's fault: a
-            # bare ValueError can come from replica/config bugs (e.g. a
-            # deployment callable returning the wrong count) and must stay
-            # a server error for retry logic and error-rate monitoring.
-            # Exhausted failover budgets and shed outcomes (queue drops,
-            # stale discards) are transient capacity events, not server
-            # bugs: 503 + Retry-After so well-behaved clients back off
-            # and retry instead of alarming on 500s.
-            if isinstance(e, BadRequest):
-                code = 400
-            elif (
-                isinstance(e, RetriesExhausted)
-                or is_shed(e)
-                or "no replica" in str(e)
-            ):
-                code = 503
-            else:
-                code = 500
+            # One shared table (serve/failover.reject_disposition) decides
+            # how a failure surfaces: capacity sheds are 429 + a COMPUTED
+            # Retry-After (bucket refill / queue drain estimate), retryable
+            # system failures and exhausted failover budgets are 503 +
+            # Retry-After, user payloads 400, genuine bugs 500. The gRPC
+            # front door maps the same table so the two can never disagree.
+            disp = reject_disposition(e)
+            if disp.kind == "internal" and "no replica" in str(e):
+                # Untyped routing-layer saturation message: transient, not
+                # a bug — keep the historical 503 classification.
+                return self._response(
+                    503, {"error": str(e)}, headers={"Retry-After": "1"}
+                ), route
+            ra = retry_after_header(disp)
             return self._response(
-                code, {"error": str(e)},
-                headers={"Retry-After": "1"} if code == 503 else None,
+                disp.http_status, {"error": str(e)},
+                headers={"Retry-After": ra} if ra is not None else None,
             ), route
         return self._response(200, {"result": result}), route
 

@@ -330,8 +330,10 @@ class Replica:
         if self._thread is not None:
             self._thread.join(timeout_s)
             self._thread = None
-        # Reject everything left, however much reconfigure() shrank max_len.
+        # Reject everything left, however much reconfigure() shrank max_len
+        # — counted as drops so shed accounting conserves through teardown.
         for req in self.drain_queue():
+            self.queue.count_external_drop(req, reason="closed")
             req.reject(RequestDropped(f"{self.replica_id} stopped"))
 
     def healthy(self, stall_timeout_s: float = 60.0) -> bool:
@@ -376,6 +378,13 @@ class Replica:
                 hook = getattr(target, "reconfigure", None)
             if callable(hook):
                 hook(user_config)
+
+    def slo_compliance(self) -> float:
+        """Recent-completion SLO compliance — the governor's degrade
+        signal. Subclasses whose traffic bypasses the base queue
+        (LLMReplica's per-bucket queues) override to read the queues
+        that actually carry requests."""
+        return self.queue.slo_compliance()
 
     def stats(self) -> dict:
         s = self.queue.stats()

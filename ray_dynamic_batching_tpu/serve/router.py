@@ -213,6 +213,12 @@ class Router:
     def _wire(self, replica: Replica) -> None:
         if hasattr(replica, "failure_sink"):
             replica.failure_sink = self.failover
+        # Class-aware displacement sheds are control-plane decisions: the
+        # replica's queue records them into the same ring as heals,
+        # breaker trips and governor transitions.
+        queue = getattr(replica, "queue", None)
+        if queue is not None and self.audit is not None:
+            queue.audit = self.audit
 
     # --- replica-set updates (pushed via long poll) -----------------------
     def update_replicas(self, replicas: Sequence[Replica]) -> None:
@@ -419,12 +425,16 @@ class Router:
                     ROUTER_REJECTED.inc(
                         tags={"deployment": self.deployment, "reason": reason}
                     )
-                    request.reject(
-                        RequestDropped(
-                            f"{self.deployment}: no replica accepted within "
-                            f"{window_s:.3f}s ({reason})"
-                        )
+                    exc = RequestDropped(
+                        f"{self.deployment}: no replica accepted within "
+                        f"{window_s:.3f}s ({reason})"
                     )
+                    # The client surface keys on this: saturation backoff
+                    # is a capacity shed (429), but every-replica-breaker-
+                    # open is a SYSTEM condition (503/UNAVAILABLE) — see
+                    # failover.reject_disposition.
+                    exc.reason = reason
+                    request.reject(exc)
                     if sp is not None:
                         sp.attributes.update(
                             attempts=attempts, rejected=True, reason=reason

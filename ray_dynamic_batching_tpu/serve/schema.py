@@ -21,6 +21,9 @@ applications:
     deployments:
       - name: llama
         llm: {model: llama_tiny, num_slots: 8}
+        # Multi-tenant QoS (DeploymentConfig fields flow straight through):
+        default_qos_class: interactive     # tier for undeclared requests
+        admission_rate_rps: 500.0          # per-(tenant, class) bucket
 ```
 """
 
@@ -248,9 +251,16 @@ def apply_config(
                 cfg = DeploymentConfig(name=spec.name, **cfg_kwargs)
                 ctl = controller or _get_controller()
                 router = ctl.deploy(cfg, factory=built)
-                handles[spec.name] = DeploymentHandle(router)
+                handles[spec.name] = DeploymentHandle(
+                    router, default_qos_class=cfg.default_qos_class
+                )
                 if route is not None:
-                    _get_proxy().router.set_route(route, handles[spec.name])
+                    proxy = _get_proxy()
+                    # Same wiring as serve.api.run: the front door must
+                    # grade against THIS controller's admission table or
+                    # a YAML-configured admission_rate_rps is a no-op.
+                    proxy.admission = ctl.admission
+                    proxy.router.set_route(route, handles[spec.name])
         logger.info(
             "application %s: deployed %s",
             app.name, [d.name for d in app.deployments],

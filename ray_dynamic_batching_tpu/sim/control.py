@@ -19,9 +19,13 @@ Only the monitor thread is re-expressed: a recurring event at
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ray_dynamic_batching_tpu.engine.rates import RateRegistry
+from ray_dynamic_batching_tpu.engine.request import (
+    DEFAULT_QOS_CLASS,
+    DEFAULT_TENANT,
+)
 from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
 from ray_dynamic_batching_tpu.scheduler.nexus import (
     NodePlan,
@@ -70,6 +74,15 @@ class SimScheduler:
         self._dead_engines: set = set()
         self.schedule_changes = 0
         self.schedule_log: List[Dict] = []
+        # Optional serve.admission.AdmissionController built on the
+        # VIRTUAL clock (the live module, reused — not re-expressed):
+        # submit() consults it pre-queue exactly like the live proxies,
+        # and the monitor tick feeds its governor the same depth/
+        # compliance signals ServeController._control_step does.
+        self.admission = None
+        # (model, qos_class) -> rejected-at-admission count (the third
+        # accounting category: offered = rejected + enqueued outcomes).
+        self.admission_rejected: Dict[Tuple[str, str], int] = {}
 
     # --- registration (live register_model contract) ----------------------
     def register_model(self, name: str, slo_ms: float,
@@ -79,10 +92,24 @@ class SimScheduler:
         self._models[name] = ModelEntry(name, slo_ms, seq_len)
 
     # --- ingress (live submit_request: demand recorded before enqueue) ----
-    def submit(self, model: str) -> bool:
+    def submit(self, model: str, qos_class: str = DEFAULT_QOS_CLASS,
+               tenant: str = DEFAULT_TENANT) -> bool:
         entry = self._models.get(model)
         if entry is None:
             return False
+        if self.admission is not None:
+            ok, _retry_after_s = self.admission.admit(
+                model, tenant, qos_class
+            )
+            if not ok:
+                # Turned away pre-queue: no demand signal either — the
+                # planner plans for admitted load, mirroring the live
+                # proxy-before-scheduler order.
+                key = (model, qos_class)
+                self.admission_rejected[key] = (
+                    self.admission_rejected.get(key, 0) + 1
+                )
+                return False
         self.rates.record(model)
         return self.queues.queue(model).add_request(
             SimRequest(
@@ -90,6 +117,8 @@ class SimScheduler:
                 arrival_ms=self.clock.now_ms(),
                 slo_ms=entry.slo_ms,
                 seq_len=entry.seq_len,
+                qos_class=qos_class,
+                tenant=tenant,
             )
         )
 
@@ -175,6 +204,13 @@ class SimScheduler:
         # truncate a model off the shrunken cluster and strand its queue.
         if self.clock.now_ms() >= self._monitor_until_ms:
             return
+        if self.admission is not None:
+            # Same congestion signals the live controller feeds the
+            # governor: queue-fill fraction + recent SLO compliance.
+            for name, q in self.queues.queues().items():
+                self.admission.observe(
+                    name, len(q) / max(1, q.max_len), q.slo_compliance()
+                )
         healed = self.check_engine_health()
         changed = self.rates.changed_models(
             self.rate_threshold, self.rate_decrease_multiplier,

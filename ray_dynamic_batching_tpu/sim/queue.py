@@ -4,26 +4,35 @@ Mirrors ``engine/queue.py`` (``RequestQueue``/``QueueManager``) exactly
 where the scheduler can observe behavior, against the injected
 :class:`~ray_dynamic_batching_tpu.sim.clock.VirtualClock`:
 
-- bounded add with drop-when-full (ref scheduler.py:238-254);
-- batch pop that discards requests which cannot finish inside their SLO
-  even if run right now (``deadline < now + expected_latency`` — the
-  staleness rule, ref :281-283);
+- bounded add with class-aware shed-when-full (best-effort displaced
+  first; equal class drops the newcomer — ref scheduler.py:238-254);
+- batch pop ordered class-then-deadline with the SAME pinned
+  anti-starvation stride as live (the ordering core,
+  ``engine/queue.ClassBuckets``, is imported, not re-expressed — the two
+  sides cannot drift);
+- stale discard at profiled latency (``deadline < now + expected_latency``
+  — the staleness rule, ref :281-283);
 - per-request SLO-violation accounting on completion (ref :324-341) and
   latency percentiles (exact over ALL completions here — a simulation
-  report wants the whole run, not a rolling window).
+  report wants the whole run, not a rolling window), sliced per QoS class.
 
 No threads, no locks, no futures: the event loop serializes everything,
-and a completed request is just a counted outcome. ``stats()`` returns
-the same keys as the live queue so report code reads either side.
+and a completed request is just a counted outcome. ``stats()`` /
+``class_stats()`` return the same keys as the live queue so report code
+reads either side.
 """
 
 from __future__ import annotations
 
 import math
-from collections import deque
 from dataclasses import dataclass
-from typing import Deque, Dict, List
+from typing import Dict, List
 
+from ray_dynamic_batching_tpu.engine.queue import ClassBuckets, ClassCounters
+from ray_dynamic_batching_tpu.engine.request import (
+    DEFAULT_QOS_CLASS,
+    DEFAULT_TENANT,
+)
 from ray_dynamic_batching_tpu.sim.clock import VirtualClock
 
 SLO_WINDOW = 200  # live parity: recent-completion compliance window
@@ -37,6 +46,8 @@ class SimRequest:
     arrival_ms: float
     slo_ms: float
     seq_len: int = 0
+    qos_class: str = DEFAULT_QOS_CLASS
+    tenant: str = DEFAULT_TENANT
 
     @property
     def deadline_ms(self) -> float:
@@ -54,14 +65,19 @@ def percentile(samples: List[float], p: float) -> float:
 
 
 class SimRequestQueue:
-    """Bounded FIFO for one model, advanced by the event loop."""
+    """Bounded class-then-deadline queue for one model, advanced by the
+    event loop."""
 
     def __init__(self, model: str, clock: VirtualClock,
                  max_len: int = 4096) -> None:
         self.model = model
         self.clock = clock
         self.max_len = max_len
-        self._q: Deque[SimRequest] = deque()
+        self._buckets = ClassBuckets()
+        # Optional decision ring (wired to the SimScheduler's AuditLog so
+        # class-aware displacement sheds land in the same timeline live
+        # queues feed).
+        self.audit = None
         # --- stats (same counters as engine/queue.py) ---
         self.latency_samples: List[float] = []
         self._recent_outcomes: List[bool] = []
@@ -70,14 +86,41 @@ class SimRequestQueue:
         self.total_stale = 0
         self.total_completed = 0
         self.total_violations = 0
+        # Shared per-class accounting (engine/queue.ClassCounters — the
+        # live queue's implementation, imported like ClassBuckets).
+        self._classes = ClassCounters()
+
+    def _cls(self, qos: str) -> Dict[str, float]:
+        return self._classes.cls(qos)
 
     # --- producer side ----------------------------------------------------
     def add_request(self, request: SimRequest) -> bool:
-        if len(self._q) >= self.max_len:
+        if len(self._buckets) >= self.max_len:
+            victim = self._buckets.shed_victim(request)
+            if victim is None:
+                self.total_dropped += 1
+                c = self._cls(request.qos_class)
+                # Per-class "enqueued" counts offered-at-door (live queue
+                # rule) so conservation holds through door-drops too.
+                c["enqueued"] += 1
+                c["dropped"] += 1
+                return False
             self.total_dropped += 1
-            return False
-        self._q.append(request)
+            self._cls(victim.qos_class)["dropped"] += 1
+            if self.audit is not None:
+                self.audit.record(
+                    "qos_shed",
+                    key=self.model,
+                    observed={"victim_qos": victim.qos_class,
+                              "victim_tenant": victim.tenant,
+                              "for_qos": request.qos_class},
+                    diff={"displaced": victim.qos_class},
+                    note="full queue: lowest-class latest-deadline "
+                         "displaced",
+                )
+        self._buckets.push(request)
         self.total_enqueued += 1
+        self._cls(request.qos_class)["enqueued"] += 1
         return True
 
     # --- consumer side ----------------------------------------------------
@@ -88,20 +131,22 @@ class SimRequestQueue:
         discard_stale: bool = True,
     ) -> List[SimRequest]:
         """Pop up to ``batch_size`` in one sweep at the CURRENT virtual
-        time, discarding requests that cannot meet their deadline given
-        the profiled batch latency (live ``get_batch`` rule)."""
+        time — class then deadline, live anti-starvation stride —
+        discarding requests that cannot meet their deadline given the
+        profiled batch latency (live ``get_batch`` rule)."""
         now = self.clock.now_ms()
         out: List[SimRequest] = []
-        while self._q and len(out) < batch_size:
-            req = self._q.popleft()
+        while len(self._buckets) and len(out) < batch_size:
+            req = self._buckets.pop()
             if discard_stale and req.deadline_ms < now + expected_latency_ms:
                 self.total_stale += 1
+                self._cls(req.qos_class)["stale"] += 1
                 continue
             out.append(req)
         return out
 
     def __len__(self) -> int:
-        return len(self._q)
+        return len(self._buckets)
 
     # --- accounting (live record_batch_completion) ------------------------
     def record_batch_completion(
@@ -114,6 +159,9 @@ class SimRequestQueue:
             violations += 0 if ok else 1
             self.latency_samples.append(total_ms)
             self._recent_outcomes.append(ok)
+            c = self._cls(req.qos_class)
+            c["completed"] += 1
+            c["violations"] += 0 if ok else 1
         if len(self._recent_outcomes) > SLO_WINDOW:
             del self._recent_outcomes[:-SLO_WINDOW]
         self.total_completed += len(batch)
@@ -143,6 +191,10 @@ class SimRequestQueue:
             "queue_delay_p95_ms": percentile(self.latency_samples, 0.95),
         }
 
+    def class_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-class counter slices + live depth (live queue key set)."""
+        return self._classes.stats(self._buckets.depth_by_class())
+
 
 class SimQueueManager:
     """Name → queue registry (live ``QueueManager`` shape)."""
@@ -150,13 +202,16 @@ class SimQueueManager:
     def __init__(self, clock: VirtualClock, max_len: int = 4096) -> None:
         self.clock = clock
         self.max_len = max_len
+        # Shared decision ring handed to every queue created from here
+        # (set by the simulation before traffic starts).
+        self.audit = None
         self._queues: Dict[str, SimRequestQueue] = {}
 
     def queue(self, model: str) -> SimRequestQueue:
         if model not in self._queues:
-            self._queues[model] = SimRequestQueue(
-                model, self.clock, self.max_len
-            )
+            q = SimRequestQueue(model, self.clock, self.max_len)
+            q.audit = self.audit
+            self._queues[model] = q
         return self._queues[model]
 
     def queues(self) -> Dict[str, SimRequestQueue]:

@@ -28,6 +28,28 @@ def slo_attainment(counters: Dict[str, float]) -> float:
     return 1.0 - misses / accounted if accounted else 1.0
 
 
+def shed_by_class(model_report: Dict[str, Any]) -> Dict[str, float]:
+    """Per-class shed volume (queue sheds: stale + dropped) from one
+    model's report entry. Rejected-at-admission is deliberately NOT shed
+    — it is its own accounting category (offered = admission_rejected +
+    enqueued; enqueued = completed + shed + pending)."""
+    out: Dict[str, float] = {}
+    for cls, c in (model_report.get("classes") or {}).items():
+        out[cls] = float(c.get("stale", 0)) + float(c.get("dropped", 0))
+    return out
+
+
+def shed_fraction(model_report: Dict[str, Any], qos_class: str) -> float:
+    """Fraction of the model's total shed volume carried by ``qos_class``
+    (1.0 when nothing shed — an empty shed trivially satisfies any
+    "class X absorbs the shed" floor)."""
+    sheds = shed_by_class(model_report)
+    total = sum(sheds.values())
+    if total <= 0:
+        return 1.0
+    return sheds.get(qos_class, 0.0) / total
+
+
 def _round(value: Any, nd: int = 4) -> Any:
     if isinstance(value, float):
         return round(value, nd)

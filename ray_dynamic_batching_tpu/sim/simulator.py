@@ -22,6 +22,10 @@ import random
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ray_dynamic_batching_tpu.engine.request import (
+    DEFAULT_TENANT,
+    QOS_RANK,
+)
 from ray_dynamic_batching_tpu.engine.workload import RatePattern
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile
 from ray_dynamic_batching_tpu.scheduler.nexus import SquishyBinPacker
@@ -29,9 +33,15 @@ from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
 from ray_dynamic_batching_tpu.sim.control import SimScheduler
 from ray_dynamic_batching_tpu.sim.engine import SimEngine
 from ray_dynamic_batching_tpu.sim.queue import SimQueueManager
+from ray_dynamic_batching_tpu.scheduler.replan import weighted_attainment
+from ray_dynamic_batching_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionPolicy,
+)
 from ray_dynamic_batching_tpu.sim.report import slo_attainment
 from ray_dynamic_batching_tpu.sim.workload import (
     Arrival,
+    draw_qos,
     merge_arrivals,
     scale_arrivals,
     synthetic_arrivals,
@@ -47,8 +57,15 @@ _PATTERN_FIELDS = (
 # Keys a model entry may carry; anything else is a typo'd knob and a
 # silently-defaulted what-if is a confidently wrong one — reject loudly.
 _MODEL_KEYS = frozenset(
-    ("name", "slo_ms", "seq_len", "rate_rps", "pattern", "poisson")
+    ("name", "slo_ms", "seq_len", "rate_rps", "pattern", "poisson",
+     "class_mix", "tenant")
     + _PATTERN_FIELDS
+)
+
+# AdmissionPolicy knobs a scenario's "admission" object may set.
+_ADMISSION_KEYS = frozenset(
+    ("rate_rps", "burst", "degraded_class_fractions", "depth_high",
+     "depth_low", "compliance_low", "compliance_high", "max_tenants")
 )
 
 
@@ -61,6 +78,25 @@ class SimModelSpec:
     seq_len: int = 0
     pattern: Optional[RatePattern] = None   # None when arrivals are explicit
     poisson: bool = True
+    # QoS traffic mix: class -> fraction of this model's arrivals (empty =
+    # everything at the default class). Tagging is seeded per model, so
+    # the same scenario always produces the same per-request classes.
+    class_mix: Dict[str, float] = None
+    tenant: str = DEFAULT_TENANT
+
+    def __post_init__(self) -> None:
+        if self.class_mix is None:
+            self.class_mix = {}
+        unknown = set(self.class_mix) - set(QOS_RANK)
+        if unknown:
+            raise ValueError(
+                f"{self.name}: unknown qos class(es) {sorted(unknown)} in "
+                f"class_mix (known: {sorted(QOS_RANK)})"
+            )
+        if self.class_mix and sum(self.class_mix.values()) <= 0:
+            raise ValueError(
+                f"{self.name}: class_mix fractions must sum > 0"
+            )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any], seed: int = 0) -> "SimModelSpec":
@@ -85,6 +121,9 @@ class SimModelSpec:
             seq_len=int(d.get("seq_len", 0)),
             pattern=pattern,
             poisson=bool(d.get("poisson", True)),
+            class_mix={k: float(v)
+                       for k, v in dict(d.get("class_mix", {})).items()},
+            tenant=str(d.get("tenant", DEFAULT_TENANT)),
         )
 
 
@@ -137,7 +176,28 @@ class Scenario:
     # Injected engine deaths (chaos conformance): each kills one sim
     # engine at virtual time t; the monitor heals over survivors.
     failures: List[EngineFailure] = field(default_factory=list)
+    # Token-bucket admission + overload governor, applied per model
+    # (serve/admission.AdmissionPolicy knobs; None = admit everything).
+    # The LIVE AdmissionController runs here on the virtual clock.
+    admission: Optional[Dict[str, Any]] = None
     arrivals: Optional[List[Arrival]] = field(default=None, repr=False)
+
+    def admission_policy(self) -> Optional[AdmissionPolicy]:
+        if self.admission is None:
+            return None
+        unknown = set(self.admission) - _ADMISSION_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown admission key(s) {sorted(unknown)}; known: "
+                f"{sorted(_ADMISSION_KEYS)}"
+            )
+        kwargs = dict(self.admission)
+        if "degraded_class_fractions" in kwargs:
+            kwargs["degraded_class_fractions"] = {
+                k: float(v)
+                for k, v in dict(kwargs["degraded_class_fractions"]).items()
+            }
+        return AdmissionPolicy(**kwargs)
 
     # Loader-level keys (profiles/arrivals paths) ride in the same JSON
     # object; everything else must be a real Scenario field.
@@ -183,6 +243,7 @@ class Scenario:
             failures=[
                 EngineFailure.from_dict(f) for f in d.get("failures", [])
             ],
+            admission=d.get("admission"),
         )
 
 
@@ -239,8 +300,9 @@ class Simulation:
             }
         span = max(min(sc.rate_window_s, sc.duration_s), 1e-9)
         counts: Dict[str, int] = {}
-        for t, model in arrivals:
-            if t <= span:
+        for arrival in arrivals:  # plain or class-tagged tuples
+            if arrival[0] <= span:
+                model = arrival[1]
                 counts[model] = counts.get(model, 0) + 1
         return {spec.name: counts.get(spec.name, 0) / span
                 for spec in sc.models}
@@ -279,6 +341,19 @@ class Simulation:
             sched.register_model(spec.name, slo_ms=spec.slo_ms,
                                  seq_len=spec.seq_len)
 
+        # Admission control at virtual time: the LIVE controller module
+        # with the virtual clock injected (deterministic buckets), wired
+        # into the scheduler's audit ring so governor transitions land in
+        # the same timeline as replans and heals.
+        policy = sc.admission_policy()
+        if policy is not None:
+            admission = AdmissionController(clock=clock.now_s)
+            admission.audit = sched.audit
+            for spec in sc.models:
+                admission.configure(spec.name, policy)
+            sched.admission = admission
+        queues.audit = sched.audit  # displacement sheds are audited too
+
         # Only arrivals the horizon will actually fire count as offered
         # load: a recorded trace longer than duration_s is TRUNCATED and
         # says so, and arrivals for models the scenario never registered
@@ -290,19 +365,48 @@ class Simulation:
         arrivals: list = []
         ignored_models: Dict[str, int] = {}
         truncated = 0
-        for t_s, model in all_arrivals:
+        for arrival in all_arrivals:
+            t_s, model = arrival[0], arrival[1]
             if model not in known:
                 ignored_models[model] = ignored_models.get(model, 0) + 1
             elif t_s >= sc.duration_s:
                 truncated += 1
             else:
-                arrivals.append((t_s, model))
+                arrivals.append(arrival)
+        # QoS class tagging: explicit 3-tuple arrivals keep their class;
+        # untagged ones draw from the model's class_mix with a per-model
+        # seeded stream (deterministic, independent of interleaving).
+        specs = {spec.name: spec for spec in sc.models}
+        class_rngs = {
+            spec.name: random.Random(sc.seed * 4099 + 17 * i)
+            for i, spec in enumerate(sc.models)
+        }
+
         arrival_counts: Dict[str, int] = {}
-        for t_s, model in arrivals:
+        class_offered: Dict[str, Dict[str, int]] = {}
+        for arrival in arrivals:
+            t_s, model = arrival[0], arrival[1]
+            if len(arrival) > 2:
+                # Explicitly-tagged trace entry: validate like the live
+                # doors do — a typo'd class in a recorded JSONL must not
+                # silently serve at beyond-last priority.
+                qos = arrival[2]
+                if qos not in QOS_RANK:
+                    raise ValueError(
+                        f"arrival for {model!r} carries unknown qos class "
+                        f"{qos!r} (known: {sorted(QOS_RANK)})"
+                    )
+            else:
+                qos = draw_qos(class_rngs[model],
+                               specs[model].class_mix)
             arrival_counts[model] = arrival_counts.get(model, 0) + 1
+            per_cls = class_offered.setdefault(model, {})
+            per_cls[qos] = per_cls.get(qos, 0) + 1
             loop.schedule_at(
                 t_s * 1000.0,
-                lambda m=model: sched.submit(m),
+                lambda m=model, q=qos, t=specs[model].tenant: (
+                    sched.submit(m, qos_class=q, tenant=t)
+                ),
             )
 
         for f in sc.failures:
@@ -329,16 +433,48 @@ class Simulation:
         # --- report -------------------------------------------------------
         models: Dict[str, Any] = {}
         for spec in sc.models:
-            stats = queues.queue(spec.name).stats()
+            queue = queues.queue(spec.name)
+            stats = queue.stats()
+            rejected_total = sum(
+                n for (mdl, _cls), n in sched.admission_rejected.items()
+                if mdl == spec.name
+            )
+            classes: Dict[str, Any] = {}
+            class_counters = queue.class_stats()
+            for cls in sorted(
+                set(class_counters)
+                | set(class_offered.get(spec.name, {}))
+            ):
+                c = class_counters.get(cls, {})
+                rejected = sched.admission_rejected.get(
+                    (spec.name, cls), 0
+                )
+                classes[cls] = {
+                    "offered": class_offered.get(spec.name, {}).get(cls, 0),
+                    "admission_rejected": rejected,
+                    "enqueued": int(c.get("enqueued", 0)),
+                    "completed": int(c.get("completed", 0)),
+                    "dropped": int(c.get("dropped", 0)),
+                    "stale": int(c.get("stale", 0)),
+                    "violations": int(c.get("violations", 0)),
+                    "pending": int(c.get("depth", 0)),
+                    "slo_attainment": slo_attainment(c),
+                }
             models[spec.name] = {
                 "slo_ms": spec.slo_ms,
                 "arrivals": arrival_counts.get(spec.name, 0),
+                "admission_rejected": rejected_total,
                 "completed": int(stats["completed"]),
                 "dropped": int(stats["dropped"]),
                 "stale": int(stats["stale"]),
                 "violations": int(stats["violations"]),
                 "pending": int(stats["depth"]),
                 "slo_attainment": slo_attainment(stats),
+                # Class-weighted attainment: the planner's pricing of a
+                # miss (scheduler/replan.weighted_attainment — interactive
+                # misses cost 4x best-effort ones).
+                "weighted_attainment": weighted_attainment(class_counters),
+                "classes": classes,
                 "latency_p50_ms": stats["latency_p50_ms"],
                 "latency_p95_ms": stats["latency_p95_ms"],
                 "latency_p99_ms": stats["latency_p99_ms"],
@@ -375,6 +511,17 @@ class Simulation:
             "failures": [
                 {"at_s": f.at_s, "engine": f.engine} for f in sc.failures
             ],
+            "admission": (
+                None if sched.admission is None else {
+                    **sched.admission.stats(),
+                    "final_state": {
+                        spec.name: sched.admission.snapshot(
+                            spec.name
+                        )["state"]
+                        for spec in sc.models
+                    },
+                }
+            ),
             "models": models,
             "chips": chips,
             "chips_used": sum(1 for e in engines if e.batches > 0),

@@ -23,14 +23,61 @@ from __future__ import annotations
 
 import json
 import random
-from typing import Iterable, List, Tuple
+from typing import Dict, Iterable, List, Tuple
 
+from ray_dynamic_batching_tpu.engine.request import (
+    DEFAULT_QOS_CLASS,
+    QOS_RANK,
+)
 from ray_dynamic_batching_tpu.engine.workload import (
     RatePattern,
     arrival_times,
 )
 
 Arrival = Tuple[float, str]  # (offset seconds, model)
+# Class-tagged arrival: (offset seconds, model, qos_class). Workload
+# plumbing accepts either shape — untagged arrivals serve at the default
+# class — so pre-QoS recordings stay replayable.
+ClassArrival = Tuple[float, str, str]
+
+
+def draw_qos(rng: random.Random, class_mix: Dict[str, float]) -> str:
+    """One seeded weighted class draw from a mix — THE tagging primitive
+    shared by :func:`assign_qos_classes` and the simulator's per-model
+    streams (one implementation, no drift). An empty mix is the default
+    class; unknown classes and non-positive totals are rejected loudly
+    (a silently-mistagged what-if is a confidently wrong one)."""
+    if not class_mix:
+        return DEFAULT_QOS_CLASS
+    unknown = set(class_mix) - set(QOS_RANK)
+    if unknown:
+        raise ValueError(
+            f"unknown qos class(es) in mix: {sorted(unknown)} "
+            f"(known: {sorted(QOS_RANK)})"
+        )
+    classes = sorted(class_mix)  # deterministic draw order
+    total = sum(class_mix[c] for c in classes)
+    if total <= 0:
+        raise ValueError("class_mix fractions must sum > 0")
+    x = rng.random() * total
+    acc = 0.0
+    for c in classes:
+        acc += class_mix[c]
+        if x < acc:
+            return c
+    return classes[-1]
+
+
+def assign_qos_classes(
+    arrivals: List[Arrival],
+    class_mix: Dict[str, float],
+    seed: int = 0,
+) -> List[ClassArrival]:
+    """Tag each arrival with a QoS class drawn from ``class_mix``
+    (fractions, normalized) by seeded draw — same trace + mix + seed =>
+    byte-identical tags. An empty mix tags everything default-class."""
+    rng = random.Random(seed)
+    return [(t, m, draw_qos(rng, class_mix)) for t, m in arrivals]
 
 
 def synthetic_arrivals(
@@ -47,10 +94,11 @@ def synthetic_arrivals(
     ]
 
 
-def merge_arrivals(streams: Iterable[List[Arrival]]) -> List[Arrival]:
+def merge_arrivals(streams: Iterable[List]) -> List:
     """One time-ordered list; ties keep stream order (stable sort) so
-    the event sequence is canonical."""
-    out: List[Arrival] = []
+    the event sequence is canonical. Accepts plain or class-tagged
+    arrivals (mixing is fine — the consumer defaults untagged ones)."""
+    out: List = []
     for s in streams:
         out.extend(s)
     out.sort(key=lambda a: a[0])
@@ -118,10 +166,11 @@ def scale_arrivals(
     rng = random.Random(seed)
     whole = int(scale)
     frac = scale - whole
-    out: List[Arrival] = []
-    for t, model in arrivals:
+    out: List = []
+    for arrival in arrivals:
+        t, rest = arrival[0], arrival[1:]
         copies = whole + (1 if rng.random() < frac else 0)
         for i in range(copies):
-            out.append((t + i * 1e-4, model))
+            out.append((t + i * 1e-4, *rest))
     out.sort(key=lambda a: a[0])
     return out

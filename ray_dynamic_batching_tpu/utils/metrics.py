@@ -18,18 +18,61 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 TagMap = Tuple[Tuple[str, str], ...]
 
+# Overflow bucket for bounded tag keys: once a key has minted its cap of
+# distinct values, every further value collapses here — client-controlled
+# identifiers (tenants) must not mint unbounded series cardinality.
+OTHER_LABEL = "__other__"
+
+# Default top-K for tenant labels (override per metric via bounded_tags).
+DEFAULT_TENANT_TOP_K = 16
+
 
 def _tags(tags: Optional[Dict[str, str]]) -> TagMap:
     return tuple(sorted((tags or {}).items()))
 
 
 class Metric:
-    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = (),
+                 bounded_tags: Optional[Dict[str, int]] = None):
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
+        # tag key -> max distinct values; first-come keeps its own series,
+        # overflow collapses to OTHER_LABEL (top-K by arrival order — the
+        # stable tenants of a deployment register early and stay named).
+        self.bounded_tags = dict(bounded_tags or {})
+        self._bounded_seen: Dict[str, set] = {}
         self._lock = threading.Lock()
         _default_registry.register(self)
+
+    def _normalize_tags(
+        self, tags: Optional[Dict[str, str]], claim: bool = True
+    ) -> Optional[Dict[str, str]]:
+        """Collapse over-cap values of bounded tag keys to OTHER_LABEL.
+        Applied on every write AND read so an overflowed value always
+        addresses the same (overflow) series. Only WRITES claim a named
+        top-K slot (``claim=True``); a read for a never-written value
+        must not consume a slot a real series could still take."""
+        if not self.bounded_tags or not tags:
+            return tags
+        out = None
+        for key, cap in self.bounded_tags.items():
+            value = (out or tags).get(key)
+            if value is None or value == OTHER_LABEL:
+                continue
+            with self._lock:
+                seen = self._bounded_seen.setdefault(key, set())
+                if value in seen:
+                    continue
+                if len(seen) < cap:
+                    if claim:
+                        seen.add(value)
+                    continue
+            if out is None:
+                out = dict(tags)
+            out[key] = OTHER_LABEL
+        return out if out is not None else tags
 
     def _check_tags(self, tags: Optional[Dict[str, str]]) -> None:
         # Declared tag_keys are enforced both ways (ref: ray.util.metrics API):
@@ -54,21 +97,24 @@ class Metric:
 class Counter(Metric):
     """Monotonically increasing counter (ref: util/metrics.py:137)."""
 
-    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
-        super().__init__(name, description, tag_keys)
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = (),
+                 bounded_tags: Optional[Dict[str, int]] = None):
+        super().__init__(name, description, tag_keys, bounded_tags)
         self._values: Dict[TagMap, float] = {}
 
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
         if value < 0:
             raise ValueError("Counter.inc requires value >= 0")
         self._check_tags(tags)
-        key = _tags(tags)
+        key = _tags(self._normalize_tags(tags))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
 
     def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        key = _tags(self._normalize_tags(tags, claim=False))
         with self._lock:
-            return self._values.get(_tags(tags), 0.0)
+            return self._values.get(key, 0.0)
 
     def _prom_lines(self, exemplars: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.description}"
@@ -81,18 +127,21 @@ class Counter(Metric):
 class Gauge(Metric):
     """Point-in-time value (ref: util/metrics.py:262)."""
 
-    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
-        super().__init__(name, description, tag_keys)
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = (),
+                 bounded_tags: Optional[Dict[str, int]] = None):
+        super().__init__(name, description, tag_keys, bounded_tags)
         self._values: Dict[TagMap, float] = {}
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
         self._check_tags(tags)
+        key = _tags(self._normalize_tags(tags))
         with self._lock:
-            self._values[_tags(tags)] = float(value)
+            self._values[key] = float(value)
 
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
         self._check_tags(tags)
-        key = _tags(tags)
+        key = _tags(self._normalize_tags(tags))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
 
@@ -100,8 +149,9 @@ class Gauge(Metric):
         self.inc(-value, tags)
 
     def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        key = _tags(self._normalize_tags(tags, claim=False))
         with self._lock:
-            return self._values.get(_tags(tags), 0.0)
+            return self._values.get(key, 0.0)
 
     def _prom_lines(self, exemplars: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.description}"
@@ -143,8 +193,9 @@ class Histogram(Metric):
         description: str = "",
         boundaries: Sequence[float] = DEFAULT_LATENCY_BOUNDARIES_MS,
         tag_keys: Sequence[str] = (),
+        bounded_tags: Optional[Dict[str, int]] = None,
     ):
-        super().__init__(name, description, tag_keys)
+        super().__init__(name, description, tag_keys, bounded_tags)
         self.boundaries = tuple(sorted(boundaries))
         self._buckets: Dict[TagMap, list] = {}
         self._sum: Dict[TagMap, float] = {}
@@ -160,7 +211,7 @@ class Histogram(Metric):
         trace_id: Optional[str] = None,
     ) -> None:
         self._check_tags(tags)
-        key = _tags(tags)
+        key = _tags(self._normalize_tags(tags))
         idx = bisect.bisect_left(self.boundaries, value)
         if trace_id is None:
             trace_id = _current_trace_id()
@@ -177,7 +228,7 @@ class Histogram(Metric):
 
     def percentile(self, p: float, tags: Optional[Dict[str, str]] = None) -> float:
         """Approximate percentile from bucket counts (upper bound of bucket)."""
-        key = _tags(tags)
+        key = _tags(self._normalize_tags(tags, claim=False))
         with self._lock:
             buckets = self._buckets.get(key)
             total = self._count.get(key, 0)

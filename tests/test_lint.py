@@ -907,3 +907,125 @@ class TestShippedTree:
         )
         assert report.files_scanned == 1
         assert rules_found(report) == ["pragma-hygiene"]
+
+
+# --- shed-accounting --------------------------------------------------------
+
+
+UNACCOUNTED_SHED = """
+    from ray_dynamic_batching_tpu.engine.request import RequestDropped
+
+    def drop_on_full(queue, request):
+        if queue.full():
+            request.reject(RequestDropped("queue full"))
+            return False
+        return True
+"""
+
+COUNTER_ACCOUNTED_SHED = """
+    from ray_dynamic_batching_tpu.engine.request import RequestDropped
+
+    SHED_TOTAL = object()
+
+    def drop_on_full(queue, request):
+        if queue.full():
+            SHED_TOTAL.inc(tags={"reason": "full"})
+            request.reject(RequestDropped("queue full"))
+            return False
+        return True
+"""
+
+
+class TestShedAccounting:
+    def test_unaccounted_reject_is_flagged(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/q.py", UNACCOUNTED_SHED,
+                              rules={"shed-accounting"})
+        assert rules_found(report) == ["shed-accounting"]
+        assert "offered == completed + shed" in report.new[0].message
+
+    def test_unaccounted_raise_is_flagged(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/a.py", """
+            from ray_dynamic_batching_tpu.serve.admission import (
+                AdmissionRejected,
+            )
+
+            def gate(bucket):
+                if not bucket.ok():
+                    raise AdmissionRejected("no tokens")
+        """, rules={"shed-accounting"})
+        assert rules_found(report) == ["shed-accounting"]
+
+    def test_shed_counter_inc_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/q.py",
+                              COUNTER_ACCOUNTED_SHED,
+                              rules={"shed-accounting"})
+        assert report.new == []
+
+    def test_attribute_counter_increment_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/q.py", """
+            from ray_dynamic_batching_tpu.engine.request import RequestStale
+
+            def sweep(self, req):
+                self.total_stale += 1
+                req.reject(RequestStale("deadline missed"))
+        """, rules={"shed-accounting"})
+        assert report.new == []
+
+    def test_subscript_counter_increment_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/q.py", """
+            from ray_dynamic_batching_tpu.engine.request import RequestStale
+
+            def sweep(counters, req):
+                counters["stale"] += 1
+                req.reject(RequestStale("deadline missed"))
+        """, rules={"shed-accounting"})
+        assert report.new == []
+
+    def test_audit_record_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/q.py", """
+            from ray_dynamic_batching_tpu.engine.request import (
+                RequestDropped,
+            )
+
+            def displace(self, victim):
+                self.audit.record("qos_shed", key=self.model)
+                victim.reject(RequestDropped("displaced"))
+        """, rules={"shed-accounting"})
+        assert report.new == []
+
+    def test_count_external_drop_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/r.py", """
+            from ray_dynamic_batching_tpu.engine.request import (
+                RequestDropped,
+            )
+
+            def stop(self):
+                for req in self.drain_queue():
+                    self.queue.count_external_drop(req, reason="closed")
+                    req.reject(RequestDropped("stopped"))
+        """, rules={"shed-accounting"})
+        assert report.new == []
+
+    def test_out_of_scope_dirs_are_ignored(self, tmp_path):
+        report = lint_fixture(tmp_path, "runtime/q.py", UNACCOUNTED_SHED,
+                              rules={"shed-accounting"})
+        assert report.new == []
+
+    def test_reasoned_pragma_suppresses(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/a.py", """
+            from ray_dynamic_batching_tpu.serve.admission import (
+                AdmissionRejected,
+            )
+
+            def gate(self, bucket):
+                if not bucket.ok():
+                    raise AdmissionRejected("no tokens")  # rdb-lint: disable=shed-accounting (admit() already counted this reject)
+        """, rules={"shed-accounting"})
+        assert report.new == []
+        assert report.pragma_suppressed == 1
+
+    def test_shipped_tree_is_clean(self):
+        from tools.lint.core import DEFAULT_TARGET
+
+        report = run(paths=[DEFAULT_TARGET], rules={"shed-accounting"})
+        assert report.new == [], [f.format() for f in report.new]

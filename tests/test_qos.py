@@ -1,0 +1,661 @@
+"""Multi-tenant QoS: class-aware queues, admission control, governor,
+error mapping, and sim/live parity.
+
+The contracts pinned here (PR 6 acceptance):
+- dequeue order is class-then-deadline; best-effort sheds strictly first;
+  the anti-starvation stride bound holds under interactive saturation;
+- sim and live queues run the SAME ordering core on a seeded mixed-class
+  workload (no drift);
+- token buckets compute exact Retry-After hints; the overload governor
+  has hysteresis both ways, never recovers while rejects continue, and
+  every transition lands in the audit ring;
+- capacity rejects surface as 429 + Retry-After; tenant/qos ride spans,
+  audit records, and failover re-dispatches.
+"""
+
+import asyncio
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.engine.queue import (
+    ANTI_STARVATION_STRIDE,
+    RequestQueue,
+)
+from ray_dynamic_batching_tpu.engine.request import (
+    BadRequest,
+    Request,
+    RequestDropped,
+    normalize_qos,
+    now_ms,
+)
+from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
+from ray_dynamic_batching_tpu.scheduler.replan import weighted_attainment
+from ray_dynamic_batching_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionPolicy,
+    AdmissionRejected,
+    TokenBucket,
+)
+from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+from ray_dynamic_batching_tpu.sim.clock import VirtualClock
+from ray_dynamic_batching_tpu.sim.queue import SimRequest, SimRequestQueue
+from ray_dynamic_batching_tpu.utils.tracing import tracer
+
+
+def req(qos="standard", slo_ms=10_000.0, arrival_ms=None, tenant="default",
+        model="m"):
+    return Request(
+        model=model, payload=None, slo_ms=slo_ms, qos_class=qos,
+        tenant=tenant,
+        **({"arrival_ms": arrival_ms} if arrival_ms is not None else {}),
+    )
+
+
+# --- class-then-deadline ordering ------------------------------------------
+
+
+class TestClassOrdering:
+    def test_dequeue_is_class_then_deadline(self):
+        q = RequestQueue("m")
+        base = now_ms()
+        # Shuffled insert order; deadlines chosen so the correct output
+        # is unambiguous per class.
+        entries = [
+            ("best_effort", 50), ("interactive", 900), ("standard", 10),
+            ("interactive", 100), ("best_effort", 5), ("standard", 700),
+        ]
+        reqs = {}
+        for i, (cls, slo) in enumerate(entries):
+            r = req(qos=cls, slo_ms=slo, arrival_ms=base)
+            reqs[i] = r
+            q.add_request(r)
+        out = q.get_batch(10, discard_stale=False)
+        got = [(r.qos_class, r.slo_ms) for r in out]
+        assert got == [
+            ("interactive", 100), ("interactive", 900),
+            ("standard", 10), ("standard", 700),
+            ("best_effort", 5), ("best_effort", 50),
+        ]
+
+    def test_single_class_keeps_fifo(self):
+        # Equal SLO + monotone arrivals: deadline order IS arrival order,
+        # so the pre-QoS FIFO behavior is unchanged (sim-parity pin).
+        q = RequestQueue("m")
+        rs = [req(slo_ms=500.0, arrival_ms=1000.0 + i) for i in range(8)]
+        for r in rs:
+            q.add_request(r)
+        out = q.get_batch(8, discard_stale=False)
+        assert [r.request_id for r in out] == [r.request_id for r in rs]
+
+    def test_anti_starvation_bound(self):
+        """Under sustained interactive saturation, queued best-effort
+        still drains: one pop in every (STRIDE+1) serves the starved
+        class, so K best-effort requests drain within K*(STRIDE+1)
+        pops."""
+        q = RequestQueue("m")
+        base = now_ms()
+        K = 3
+        for i in range(K):
+            q.add_request(req(qos="best_effort", arrival_ms=base + i))
+        served_be = 0
+        pops = 0
+        # Keep interactive pressure constant: the queue never runs out
+        # of higher-priority work.
+        for i in range(K * (ANTI_STARVATION_STRIDE + 1)):
+            q.add_request(req(qos="interactive", arrival_ms=base + 100 + i))
+            q.add_request(req(qos="interactive", arrival_ms=base + 100 + i))
+            out = q.get_batch(1, discard_stale=False)
+            pops += 1
+            if out and out[0].qos_class == "best_effort":
+                served_be += 1
+            if served_be == K:
+                break
+        assert served_be == K, (
+            f"best_effort starved: only {served_be}/{K} served in "
+            f"{pops} pops (bound: {K * (ANTI_STARVATION_STRIDE + 1)})"
+        )
+
+    def test_never_full_queue_does_not_accrete_dead_entries(self):
+        # Lazy deletion must compact: a healthy (never-full) queue pops
+        # from the forward heaps only, so rev/arrival entries die as
+        # tombstones — 5k served requests must not retain 5k dead tuples
+        # (review regression: unbounded RSS in the serving hot path).
+        from ray_dynamic_batching_tpu.engine.queue import ClassBuckets
+
+        b = ClassBuckets()
+        for i in range(5000):
+            b.push(req(qos="standard", arrival_ms=float(i)))
+            assert b.pop() is not None
+        dead = (
+            sum(len(h) for h in b._rev_heaps.values())
+            + len(b._arrival_heap)
+            + len(b._gone_fwd) + len(b._gone_rev) + len(b._gone_arr)
+        )
+        assert dead <= 4 * 64 + 8, f"{dead} dead entries retained"
+
+    def test_unknown_class_is_bad_request(self):
+        with pytest.raises(BadRequest):
+            req(qos="interactve")  # typo'd class must fail loudly
+        assert normalize_qos(None) == "standard"
+        assert normalize_qos("best_effort") == "best_effort"
+
+
+# --- shed priority ----------------------------------------------------------
+
+
+class TestShedPriority:
+    def test_best_effort_displaced_first(self):
+        q = RequestQueue("m", max_len=3)
+        base = now_ms()
+        victims = [req(qos="best_effort", slo_ms=100 + i, arrival_ms=base)
+                   for i in range(2)]
+        keeper = req(qos="standard", arrival_ms=base)
+        for r in victims + [keeper]:
+            assert q.add_request(r)
+        incoming = req(qos="interactive", arrival_ms=base)
+        assert q.add_request(incoming)  # displaces, not drops
+        # The LATEST-deadline best_effort went; the earlier one stayed.
+        assert isinstance(victims[1].future.exception(0.5), RequestDropped)
+        assert victims[0].future.done() is False
+        stats = q.class_stats()
+        assert stats["best_effort"]["dropped"] == 1
+        assert q.total_dropped == 1
+        out = q.get_batch(10, discard_stale=False)
+        assert [r.qos_class for r in out] == [
+            "interactive", "standard", "best_effort"
+        ]
+
+    def test_lowest_class_arrival_drops_itself(self):
+        q = RequestQueue("m", max_len=2)
+        base = now_ms()
+        for _ in range(2):
+            assert q.add_request(req(qos="interactive", arrival_ms=base))
+        incoming = req(qos="best_effort", arrival_ms=base)
+        assert not q.add_request(incoming)
+        exc = incoming.future.exception(0.5)
+        assert isinstance(exc, RequestDropped)
+        assert exc.retry_after_s > 0  # computed hint rides the reject
+
+    def test_equal_class_keeps_drop_newcomer_semantics(self):
+        q = RequestQueue("m", max_len=1)
+        assert q.add_request(req(qos="standard"))
+        newcomer = req(qos="standard")
+        assert not q.add_request(newcomer)
+        assert isinstance(newcomer.future.exception(0.5), RequestDropped)
+
+    def test_displacement_is_audited(self):
+        audit = AuditLog("test")
+        q = RequestQueue("m", max_len=1)
+        q.audit = audit
+        victim = req(qos="best_effort")
+        q.add_request(victim)
+        q.add_request(req(qos="interactive", tenant="acme"))
+        recs = [r for r in audit.to_dicts() if r["trigger"] == "qos_shed"]
+        assert len(recs) == 1
+        assert recs[0]["observed"]["victim_qos"] == "best_effort"
+        assert recs[0]["observed"]["for_qos"] == "interactive"
+        assert recs[0]["key"] == "m"
+
+    def test_door_drop_keeps_class_conservation(self):
+        # A full queue with no lower-class victim drops the NEWCOMER:
+        # per-class "enqueued" counts offered-at-door, so the invariant
+        # holds through door-drops too (review regression).
+        q = RequestQueue("m", max_len=1)
+        q.add_request(req(qos="best_effort"))
+        q.add_request(req(qos="best_effort"))  # door-drop (equal class)
+        c = q.class_stats()["best_effort"]
+        assert c["enqueued"] == 2 and c["dropped"] == 1 and c["depth"] == 1
+        assert c["enqueued"] == (
+            c["completed"] + c["stale"] + c["dropped"] + c["depth"]
+        )
+        clock = VirtualClock()
+        sq = SimRequestQueue("m", clock, max_len=1)
+        sq.add_request(SimRequest("m", 0.0, 100.0, qos_class="best_effort"))
+        sq.add_request(SimRequest("m", 1.0, 100.0, qos_class="best_effort"))
+        sc = sq.class_stats()["best_effort"]
+        assert sc["enqueued"] == 2 and sc["dropped"] == 1
+
+    def test_class_conservation(self):
+        q = RequestQueue("m", max_len=16)
+        rng = random.Random(7)
+        classes = ("interactive", "standard", "best_effort")
+        for i in range(120):
+            q.add_request(req(qos=rng.choice(classes)))
+            if i % 3 == 0:
+                batch = q.get_batch(4, discard_stale=False)
+                q.record_batch_completion(batch)
+        for cls, c in q.class_stats().items():
+            assert c["enqueued"] == (
+                c["completed"] + c["stale"] + c["dropped"] + c["depth"]
+            ), (cls, c)
+
+
+# --- sim/live queue parity ---------------------------------------------------
+
+
+class TestSimLiveParity:
+    def test_same_workload_same_order_and_counters(self):
+        """The ordering core is SHARED (engine.queue.ClassBuckets), so a
+        seeded mixed-class workload must produce the identical pop
+        sequence and per-class counters on both sides."""
+        rng = random.Random(42)
+        classes = ("interactive", "standard", "best_effort")
+        workload = [
+            (float(i), rng.choice(classes), rng.choice((500.0, 900.0)))
+            for i in range(200)
+        ]
+        live = RequestQueue("m", max_len=48)
+        clock = VirtualClock()
+        sim = SimRequestQueue("m", clock, max_len=48)
+        live_order, sim_order = [], []
+        for i, (t, cls, slo) in enumerate(workload):
+            live.add_request(
+                req(qos=cls, slo_ms=slo, arrival_ms=1_000_000.0 + t)
+            )
+            sim.add_request(SimRequest(
+                model="m", arrival_ms=1_000_000.0 + t, slo_ms=slo,
+                qos_class=cls,
+            ))
+            if i % 5 == 4:
+                live_order += [
+                    (r.qos_class, r.arrival_ms, r.slo_ms)
+                    for r in live.get_batch(3, discard_stale=False)
+                ]
+                sim_order += [
+                    (r.qos_class, r.arrival_ms, r.slo_ms)
+                    for r in sim.get_batch(3, discard_stale=False)
+                ]
+        while True:
+            batch = live.get_batch(3, discard_stale=False)
+            if not batch:
+                break
+            live_order += [(r.qos_class, r.arrival_ms, r.slo_ms)
+                           for r in batch]
+        while True:
+            batch = sim.get_batch(3, discard_stale=False)
+            if not batch:
+                break
+            sim_order += [(r.qos_class, r.arrival_ms, r.slo_ms)
+                          for r in batch]
+        assert live_order == sim_order
+        live_stats = {c: {k: v for k, v in s.items() if k != "depth"}
+                      for c, s in live.class_stats().items()}
+        sim_stats = {c: {k: v for k, v in s.items() if k != "depth"}
+                     for c, s in sim.class_stats().items()}
+        assert live_stats == sim_stats
+
+
+# --- token buckets + governor -----------------------------------------------
+
+
+class TestAdmission:
+    def test_bucket_refill_and_retry_hint(self):
+        t = [0.0]
+        b = TokenBucket(rate_rps=10.0, burst=2.0, clock=lambda: t[0])
+        assert b.try_acquire() == (True, 0.0)
+        assert b.try_acquire() == (True, 0.0)
+        ok, retry = b.try_acquire()
+        assert not ok and retry == pytest.approx(0.1)
+        t[0] += retry  # waiting the hint out admits exactly one
+        assert b.try_acquire()[0]
+        assert not b.try_acquire()[0]
+
+    def test_unconfigured_deployment_admits_everything(self):
+        ctl = AdmissionController()
+        assert ctl.admit("anything") == (True, 0.0)
+
+    def test_admit_or_raise_carries_retry_hint(self):
+        t = [0.0]
+        ctl = AdmissionController(clock=lambda: t[0])
+        ctl.configure("d", AdmissionPolicy(rate_rps=5.0, burst=1.0))
+        ctl.admit_or_raise("d")
+        with pytest.raises(AdmissionRejected) as ei:
+            ctl.admit_or_raise("d")
+        assert ei.value.retry_after_s == pytest.approx(0.2)
+
+    def test_governor_hysteresis_and_audit(self):
+        t = [0.0]
+        ctl = AdmissionController(clock=lambda: t[0])
+        audit = AuditLog("test")
+        ctl.audit = audit
+        ctl.configure("d", AdmissionPolicy(
+            rate_rps=100.0, burst=1.0,
+            degraded_class_fractions={"best_effort": 0.1},
+            depth_high=0.5, depth_low=0.1,
+        ))
+        # Healthy signals: no transition.
+        assert ctl.observe("d", 0.05, 1.0) is None
+        # Congestion: degrade (audited, with the observed signals).
+        assert ctl.observe("d", 0.6, 1.0) == "degrade"
+        assert ctl.degraded("d")
+        # Degraded best_effort rate = 10 rps: burn the 1-token burst,
+        # then verify the retry hint reflects the DEGRADED rate.
+        assert ctl.admit("d", qos_class="best_effort")[0]
+        ok, retry = ctl.admit("d", qos_class="best_effort")
+        assert not ok and retry == pytest.approx(1.0 / 10.0)
+        # Interactive keeps the full rate (fraction defaults to 1.0).
+        assert ctl.admit("d", qos_class="interactive")[0]
+        # Healthy-looking queue but rejects happened since last tick:
+        # recovery must NOT fire (the flood is still arriving).
+        assert ctl.observe("d", 0.0, 1.0) is None
+        assert ctl.degraded("d")
+        # A quiet tick (no rejects since observe): recover.
+        assert ctl.observe("d", 0.0, 1.0) == "recover"
+        assert not ctl.degraded("d")
+        recs = [r for r in audit.to_dicts()
+                if r["trigger"] == "admission_governor"]
+        assert [r["after"]["state"] for r in recs] == ["degraded", "normal"]
+        assert recs[0]["observed"]["depth_frac"] == 0.6
+
+    def test_hysteresis_validation(self):
+        with pytest.raises(ValueError):
+            AdmissionPolicy(rate_rps=1.0, depth_high=0.1, depth_low=0.5)
+
+    def test_tenant_rotation_cannot_mint_fresh_buckets(self):
+        # Tenant is unauthenticated client input: beyond the top-K, every
+        # made-up tenant shares ONE overflow bucket — rotating the header
+        # neither bypasses admission nor grows state without bound.
+        t = [0.0]
+        ctl = AdmissionController(clock=lambda: t[0])
+        ctl.configure("d", AdmissionPolicy(rate_rps=1.0, burst=2.0,
+                                           max_tenants=2))
+        assert ctl.admit("d", tenant="a")[0]
+        assert ctl.admit("d", tenant="b")[0]
+        # 40 rotating tenants share the overflow bucket's 2-token burst:
+        admitted = sum(
+            1 for i in range(40) if ctl.admit("d", tenant=f"rot-{i}")[0]
+        )
+        assert admitted == 2, "rotation minted fresh burst tokens"
+        assert ctl.snapshot("d")["buckets"] <= 3  # a, b, __other__
+
+
+# --- tenant/qos identity threading ------------------------------------------
+
+
+class _CapturingRouter:
+    deployment = "dep"
+
+    def __init__(self):
+        self.requests = []
+
+    def assign_request(self, request, **kwargs):
+        self.requests.append(request)
+        request.fulfill("ok")
+        return True
+
+
+class TestIdentityThreading:
+    def test_handle_resolution_order(self):
+        router = _CapturingRouter()
+        h = DeploymentHandle(router, default_qos_class="best_effort")
+        h.remote({"x": 1})
+        assert router.requests[-1].qos_class == "best_effort"  # default
+        h.remote({"qos_class": "interactive", "tenant": "acme"})
+        assert router.requests[-1].qos_class == "interactive"
+        assert router.requests[-1].tenant == "acme"
+        h.remote({"qos_class": "interactive"}, qos_class="standard",
+                 tenant="kwarg-wins")
+        assert router.requests[-1].qos_class == "standard"
+        assert router.requests[-1].tenant == "kwarg-wins"
+        with pytest.raises(BadRequest):
+            h.remote({"qos_class": "platinum"})
+
+    def test_spans_carry_tenant_and_class(self):
+        spans = []
+        tracer().set_exporter(spans.append)
+        try:
+            router = _CapturingRouter()
+            h = DeploymentHandle(router)
+            h.remote({"qos_class": "interactive", "tenant": "acme"})
+            q = RequestQueue("m")
+            q.add_request(req(qos="best_effort", tenant="bulk"))
+            q.get_batch(1, discard_stale=False)
+        finally:
+            tracer().reset()
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        hs = by_name["handle.remote"][0]
+        assert hs.attributes["tenant"] == "acme"
+        assert hs.attributes["qos_class"] == "interactive"
+        qs = by_name["queue.wait"][0]
+        assert qs.attributes["tenant"] == "bulk"
+        assert qs.attributes["qos_class"] == "best_effort"
+
+    def test_failover_redispatch_preserves_identity(self):
+        from ray_dynamic_batching_tpu.serve.failover import (
+            FailoverManager,
+            ReplicaDeadError,
+        )
+
+        captured = []
+        done = threading.Event()
+
+        class _Router:
+            deployment = "dep"
+
+            def replicas(self):
+                return []
+
+            def assign_request(self, request, **kwargs):
+                captured.append(request)
+                done.set()
+                return True
+
+        fm = FailoverManager(_Router())
+        try:
+            r = req(qos="interactive", tenant="acme", slo_ms=60_000.0)
+            assert fm.submit(r, ReplicaDeadError("x"), immediate=True)
+            assert done.wait(5)
+            assert captured[0] is r  # the SAME object re-routes:
+            assert captured[0].qos_class == "interactive"
+            assert captured[0].tenant == "acme"
+        finally:
+            fm.close()
+
+    def test_openai_adapter_extracts_identity(self):
+        from ray_dynamic_batching_tpu.serve.openai_api import (
+            translate_request,
+        )
+
+        payload = translate_request({
+            "prompt": [1, 2, 3], "tenant": "acme",
+            "qos_class": "interactive",
+        })
+        assert payload["tenant"] == "acme"
+        assert payload["qos_class"] == "interactive"
+        with pytest.raises(BadRequest):
+            translate_request({"prompt": [1], "qos_class": "gold"})
+
+
+# --- HTTP proxy: admission + 429 mapping ------------------------------------
+
+
+class _OkHandle:
+    deployment = "dep"
+
+    def __init__(self):
+        self.payloads = []
+
+    def remote(self, payload, **kwargs):
+        from concurrent.futures import Future
+
+        self.payloads.append(payload)
+        f = Future()
+        f.set_result("served")
+        return f
+
+
+class TestProxyAdmission:
+    def _proxy(self, admission=None):
+        from ray_dynamic_batching_tpu.serve.proxy import (
+            HTTPProxy,
+            ProxyRouter,
+        )
+
+        router = ProxyRouter()
+        handle = _OkHandle()
+        router.set_route("/api/dep", handle)
+        return HTTPProxy(router, admission=admission), handle
+
+    def _call(self, proxy, body, headers=None):
+        resp, _route = asyncio.run(proxy._handle_one(
+            "POST", "/api/dep", json.dumps(body).encode(), None, headers
+        ))
+        head, payload = resp.split(b"\r\n\r\n", 1)
+        return head.decode(), json.loads(payload)
+
+    def test_reject_is_429_with_computed_retry_after(self):
+        t = [0.0]
+        ctl = AdmissionController(clock=lambda: t[0])
+        ctl.configure("dep", AdmissionPolicy(rate_rps=10.0, burst=1.0))
+        proxy, handle = self._proxy(admission=ctl)
+        head, body = self._call(proxy, {"v": 1})
+        assert " 200 " in head.splitlines()[0]
+        head, body = self._call(proxy, {"v": 2})
+        assert " 429 " in head.splitlines()[0]
+        assert "Retry-After: 1" in head
+        assert "admission rate exceeded" in body["error"]
+        assert len(handle.payloads) == 1  # the reject never routed
+
+    def test_header_identity_wins_and_rides_payload(self):
+        proxy, handle = self._proxy()
+        self._call(proxy, {"v": 1, "qos_class": "best_effort"},
+                   headers={"x-rdb-qos": "interactive",
+                            "x-rdb-tenant": "acme"})
+        assert handle.payloads[0]["qos_class"] == "interactive"
+        assert handle.payloads[0]["tenant"] == "acme"
+
+    def test_unknown_class_is_400(self):
+        proxy, _handle = self._proxy()
+        head, body = self._call(proxy, {"qos_class": "platinum"})
+        assert " 400 " in head.splitlines()[0]
+        assert "unknown qos_class" in body["error"]
+
+    def test_undeclared_class_grades_at_deployment_default(self):
+        # Admission must grade the SAME class the request will serve at:
+        # an undeclared class uses the handle's per-deployment default,
+        # not the global 'standard' (review regression).
+        ctl = AdmissionController()
+        ctl.configure("dep", AdmissionPolicy(rate_rps=10.0, burst=100.0))
+        proxy, handle = self._proxy(admission=ctl)
+        handle.default_qos_class = "interactive"
+        self._call(proxy, {"v": 1})
+        from ray_dynamic_batching_tpu.serve.admission import (
+            ADMISSION_TOTAL,
+        )
+
+        assert ADMISSION_TOTAL.get(tags={
+            "deployment": "dep", "tenant": "default",
+            "qos": "interactive", "outcome": "admit",
+        }) >= 1.0
+
+
+# --- controller wiring -------------------------------------------------------
+
+
+class TestControllerWiring:
+    def test_deploy_configures_admission_and_status(self):
+        from ray_dynamic_batching_tpu.serve.controller import (
+            DeploymentConfig,
+            ServeController,
+        )
+
+        ctl = ServeController()
+        try:
+            ctl.deploy(
+                DeploymentConfig(name="d", num_replicas=1,
+                                 admission_rate_rps=50.0,
+                                 default_qos_class="interactive"),
+                factory=lambda: (lambda payloads: payloads),
+            )
+            policy = ctl.admission.policy("d")
+            assert policy is not None and policy.rate_rps == 50.0
+            status = ctl.status()["d"]
+            assert status["admission"]["configured"]
+            assert status["admission"]["state"] == "normal"
+            # Governor transitions land in the controller's audit ring.
+            ctl.admission.observe("d", 0.9, 0.5)
+            govs = [a for a in ctl.audit.to_dicts()
+                    if a["trigger"] == "admission_governor"]
+            assert govs and govs[0]["key"] == "d"
+            # Checkpoint round-trips the QoS fields.
+            cfg2 = DeploymentConfig.from_json(
+                DeploymentConfig(name="x", admission_rate_rps=9.0,
+                                 default_qos_class="best_effort").to_json()
+            )
+            assert cfg2.admission_rate_rps == 9.0
+            assert cfg2.default_qos_class == "best_effort"
+        finally:
+            ctl.shutdown()
+
+    def test_replica_stop_accounts_drained_work(self):
+        from ray_dynamic_batching_tpu.serve.replica import Replica
+
+        replica = Replica("r#0", "dep", lambda p: p, max_batch_size=4)
+        # Never started: queued work must be rejected AND counted at stop.
+        r = req(qos="best_effort")
+        assert replica.assign(r)
+        replica.stop(timeout_s=0.1)
+        assert isinstance(r.future.exception(0.5), RequestDropped)
+        assert replica.queue.total_dropped == 1
+        assert replica.queue.class_stats()["best_effort"]["dropped"] == 1
+
+
+# --- planner pricing ---------------------------------------------------------
+
+
+class TestWeightedAttainment:
+    def test_interactive_misses_cost_more(self):
+        # 10 accounted per class; best_effort misses 5, interactive 0.
+        counters = {
+            "interactive": {"completed": 10.0, "violations": 0.0,
+                            "stale": 0.0, "dropped": 0.0},
+            "best_effort": {"completed": 5.0, "violations": 0.0,
+                            "stale": 5.0, "dropped": 0.0},
+        }
+        # weights 4:1 -> (4*10 + 1*10 accounted, 1*5 missed) = 1 - 5/50
+        assert weighted_attainment(counters) == pytest.approx(0.9)
+        # Mirror image: the same misses on interactive price 4x worse.
+        flipped = {
+            "interactive": counters["best_effort"],
+            "best_effort": counters["interactive"],
+        }
+        assert weighted_attainment(flipped) == pytest.approx(1 - 20 / 50)
+        assert weighted_attainment({}) == 1.0
+
+
+# --- sim: the overload story end to end -------------------------------------
+
+
+class TestSimOverloadStory:
+    def test_governor_and_floors_in_miniature(self):
+        from ray_dynamic_batching_tpu.sim import Simulation, render_json
+        from ray_dynamic_batching_tpu.sim.report import shed_fraction
+        from ray_dynamic_batching_tpu.sim.scenarios import (
+            fixture_profiles,
+            overload_scenario,
+        )
+
+        sc = overload_scenario(rate_scale=5.0)
+        sc.duration_s, sc.drain_s = 10.0, 3.0
+        reports = [
+            Simulation(fixture_profiles(), sc).run() for _ in range(2)
+        ]
+        assert render_json(reports[0]) == render_json(reports[1])
+        m = reports[0]["models"]["burst"]
+        assert m["classes"]["interactive"]["slo_attainment"] >= 0.99
+        assert shed_fraction(m, "best_effort") >= 0.9
+        assert m["admission_rejected"] > 0
+        govs = [a for a in reports[0]["audit"]
+                if a["trigger"] == "admission_governor"]
+        assert govs, "overload never tripped the governor"
+        for cls, c in m["classes"].items():
+            assert c["offered"] == c["admission_rejected"] + c["enqueued"]
+            assert c["enqueued"] == (
+                c["completed"] + c["stale"] + c["dropped"] + c["pending"]
+            )

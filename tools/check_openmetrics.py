@@ -10,14 +10,19 @@ the Prometheus/OpenMetrics text our registry renders and fails loudly on:
   them (OpenMetrics allows them on ``_bucket`` and ``_total`` samples only),
 - exemplar label sets over the 128-rune OpenMetrics cap,
 - histogram families missing ``+Inf`` buckets / ``_sum`` / ``_count`` or
-  with non-monotonic cumulative buckets.
+  with non-monotonic cumulative buckets,
+- metric families whose series cardinality exceeds a cap (``--max-series``;
+  enforced in the smoke): client-controlled label values (tenants) must
+  collapse into the registry's ``__other__`` bucket, not mint unbounded
+  series that blow up the scrape and the TSDB behind it.
 
 Usage:
     python tools/check_openmetrics.py <file>    # validate a saved scrape
-    python tools/check_openmetrics.py -         # validate stdin
+    python tools/check_openmetrics.py - --max-series 100   # stdin + cap
     python tools/check_openmetrics.py --smoke   # end-to-end: build metrics
-        (including traced exemplars), serve them over a real HTTP proxy,
-        scrape /metrics, validate — the CI gate.
+        (including traced exemplars + an over-cap tenant label), serve
+        them over a real HTTP proxy, scrape /metrics, validate — the CI
+        gate.
 """
 
 from __future__ import annotations
@@ -64,14 +69,18 @@ def _parse_labels(raw: str, errors: List[str], where: str) -> Dict[str, str]:
     return out
 
 
-def validate(text: str) -> List[str]:
-    """Returns a list of error strings (empty = valid)."""
+def validate(text: str, max_series: int = 0) -> List[str]:
+    """Returns a list of error strings (empty = valid). ``max_series``
+    > 0 additionally fails any family exposing more than that many
+    distinct series (label sets, ``le`` excluded — histogram buckets are
+    bounded by construction; it is the OTHER labels that explode)."""
     errors: List[str] = []
     typed: Dict[str, str] = {}
     # histogram family -> {label-set-sans-le: [(le, cum_count)]}
     buckets: Dict[str, Dict[Tuple, List[Tuple[float, float]]]] = {}
     sums: Dict[str, set] = {}
     counts: Dict[str, set] = {}
+    series: Dict[str, set] = {}
 
     for i, line in enumerate(text.splitlines(), start=1):
         if not line.strip():
@@ -105,6 +114,9 @@ def validate(text: str) -> List[str]:
             errors.append(f"line {i}: sample {name!r} has no # TYPE")
             continue
         labels = _parse_labels(m.group("labels") or "", errors, f"line {i}")
+        series.setdefault(base, set()).add(tuple(sorted(
+            (k, v) for k, v in labels.items() if k != "le"
+        )))
         if m.group("ex_labels") is not None:
             # OpenMetrics: exemplars only on histogram buckets and
             # counter _total samples.
@@ -153,12 +165,29 @@ def validate(text: str) -> List[str]:
                 errors.append(f"{fam}{dict(key)}: missing _sum")
             if key not in counts.get(fam, set()):
                 errors.append(f"{fam}{dict(key)}: missing _count")
+    if max_series > 0:
+        for fam, keys in sorted(series.items()):
+            if len(keys) > max_series:
+                errors.append(
+                    f"{fam}: {len(keys)} series exceeds the cardinality "
+                    f"cap ({max_series}) — bound the offending label "
+                    "(bounded_tags= on the metric collapses overflow to "
+                    "__other__)"
+                )
     return errors
+
+
+# Smoke cardinality cap: generous vs the bounded-tag top-K defaults, so a
+# legitimately-tagged family never trips it, but any unbounded
+# client-value label (the bug class) blows through within one burst.
+SMOKE_MAX_SERIES = 64
 
 
 def _smoke() -> int:
     """End-to-end gate: traced observations -> registry -> real HTTP proxy
-    -> scrape -> validate. Asserts at least one exemplar made it out."""
+    -> scrape -> validate. Asserts at least one exemplar made it out, and
+    that an over-top-K tenant label collapses into ``__other__`` instead
+    of minting unbounded series."""
     import urllib.request
 
     from ray_dynamic_batching_tpu.serve.proxy import HTTPProxy, ProxyRouter
@@ -173,6 +202,12 @@ def _smoke() -> int:
         c.inc(3, tags={"route": 'with"quote\\and\nnewline'})
         g = m.Gauge("smoke_depth", "queue depth")
         g.set(7)
+        # A flood of distinct tenant values against a top-K=4 bound: only
+        # 4 named series + __other__ may reach the exposition.
+        t = m.Counter("smoke_tenant_total", "tenant-tagged smoke",
+                      tag_keys=("tenant",), bounded_tags={"tenant": 4})
+        for i in range(40):
+            t.inc(tags={"tenant": f"tenant-{i}"})
         h = m.Histogram("smoke_latency_ms", "smoke latency",
                         tag_keys=("model",))
         for v in (0.4, 3.0, 42.0, 900.0):
@@ -197,14 +232,24 @@ def _smoke() -> int:
             proxy.stop()
     finally:
         tracer().reset()
-    errors = validate(text)
+    errors = validate(text, max_series=SMOKE_MAX_SERIES)
     if "openmetrics-text" not in ctype:
         errors.append(f"Accept negotiation failed: got {ctype!r}")
     if not text.rstrip().endswith("# EOF"):
         errors.append("OpenMetrics render missing # EOF trailer")
     if '# {trace_id="' in classic:
         errors.append("exemplar leaked into the classic 0.0.4 exposition")
-    errors.extend(validate(classic))
+    errors.extend(validate(classic, max_series=SMOKE_MAX_SERIES))
+    if 'smoke_tenant_total{tenant="__other__"} 36' not in text:
+        errors.append(
+            "tenant label flood did not collapse into __other__ "
+            "(expected 36 overflow increments in one series)"
+        )
+    if sum(1 for l in text.splitlines()
+           if l.startswith("smoke_tenant_total{")) != 5:
+        errors.append(
+            "expected exactly 4 named tenant series + __other__"
+        )
     n_exemplars = len(re.findall(r' # \{trace_id="', text))
     if n_exemplars < 1:
         errors.append("no exemplar line in the scrape "
@@ -224,12 +269,21 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "--smoke":
         return _smoke()
+    max_series = 0
+    if "--max-series" in argv:
+        i = argv.index("--max-series")
+        try:
+            max_series = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--max-series takes an integer", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     text = (sys.stdin.read() if argv[0] == "-"
             else open(argv[0]).read())
-    errors = validate(text)
+    errors = validate(text, max_series=max_series)
     for e in errors:
         print(e, file=sys.stderr)
     if not errors:

@@ -286,6 +286,7 @@ def _all_checkers() -> List[Checker]:
     from tools.lint.event_loop import EventLoopBlockingChecker
     from tools.lint.host_sync import HostSyncChecker
     from tools.lint.retry import UnboundedRetryChecker
+    from tools.lint.shed import ShedAccountingChecker
     from tools.lint.spans import SpanHygieneChecker
     from tools.lint.vmem import TileAlignmentChecker, VmemBudgetChecker
 
@@ -297,6 +298,7 @@ def _all_checkers() -> List[Checker]:
         SpanHygieneChecker(),
         SimDeterminismChecker(),
         UnboundedRetryChecker(),
+        ShedAccountingChecker(),
     ]
 
 

@@ -1,0 +1,127 @@
+"""shed-accounting — every dropped/rejected request must be counted.
+
+The QoS/SLO accounting contract (engine/queue.py, serve/admission.py,
+the overload- and chaos-soak conservation gates) is that offered load
+always decomposes: ``offered = completed + shed + rejected-at-admission``
+— a code path that sheds a request WITHOUT recording it makes that
+equation lie, and the lie surfaces as a soak gate "accounting leak" long
+after the offending path shipped. This rule catches it at lint time.
+
+A finding is raised when, in ``serve/`` or ``engine/``, a function:
+
+- constructs or raises one of the shed/reject exception types
+  (``RequestDropped``, ``RequestStale``, ``AdmissionRejected``) — the
+  lexical shape of a drop decision, whether raised directly or handed to
+  ``request.reject(...)``, AND
+- contains NO accounting in the same function body, where accounting is
+  any of:
+
+  - ``<COUNTER>.inc(...)`` on a metric whose name mentions
+    SHED/REJECT/DROP/ADMISSION (``SHED_TOTAL``, ``FAILOVER_SHED``,
+    ``ROUTER_REJECTED``, ``ADMISSION_TOTAL``, ...);
+  - ``<...>audit<...>.record(...)`` — a structured audit-ring entry;
+  - an augmented increment of a counter whose name (attribute, subscript
+    key, or variable) mentions shed/dropped/stale/rejected
+    (``self.total_dropped += 1``, ``c["stale"] += 1``, ...);
+  - ``RequestQueue.count_external_drop(...)`` — the shared helper for
+    drops decided outside the queue (teardown/drain paths).
+
+Known accounting-boundary exceptions carry reasoned pragmas
+(``# rdb-lint: disable=shed-accounting (<why the count lives
+elsewhere>)``) — e.g. ``AdmissionController.admit_or_raise``, whose
+reject was already counted by ``admit()``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict
+
+from tools.lint.core import (
+    Checker, FileCtx, Scope, dotted_name as _dotted, in_dirs,
+)
+
+_SHED_TYPES = {"RequestDropped", "RequestStale", "AdmissionRejected"}
+_METRIC_NAME_RE = re.compile(r"(SHED|REJECT|DROP|ADMISSION)", re.IGNORECASE)
+_COUNTER_KEY_RE = re.compile(r"(shed|dropped|stale|rejected)", re.IGNORECASE)
+
+
+def _is_shed_event(node: ast.AST) -> bool:
+    """A construction of a shed exception type (``RequestDropped(...)``) —
+    covers ``raise X(...)``, ``request.reject(X(...))`` and the
+    ``exc = X(...)`` staging idiom — or a re-raise of a bare name."""
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func) or ""
+        return name.rsplit(".", 1)[-1] in _SHED_TYPES
+    if isinstance(node, ast.Raise) and isinstance(node.exc, ast.Name):
+        return node.exc.id in _SHED_TYPES
+    return False
+
+
+def _target_mentions_counter(target: ast.AST) -> bool:
+    if isinstance(target, ast.Attribute):
+        return bool(_COUNTER_KEY_RE.search(target.attr)) or \
+            _target_mentions_counter(target.value)
+    if isinstance(target, ast.Subscript):
+        sl = target.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str) and \
+                _COUNTER_KEY_RE.search(sl.value):
+            return True
+        return _target_mentions_counter(target.value)
+    if isinstance(target, ast.Name):
+        return bool(_COUNTER_KEY_RE.search(target.id))
+    return False
+
+
+def _is_accounting(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        owner = _dotted(node.func.value) or ""
+        if attr == "inc" and _METRIC_NAME_RE.search(owner):
+            return True
+        if attr == "record" and "audit" in owner.lower():
+            return True
+        if attr == "count_external_drop":
+            return True
+    if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+        return _target_mentions_counter(node.target)
+    return False
+
+
+class ShedAccountingChecker(Checker):
+    rule = "shed-accounting"
+
+    def applies(self, relpath: str) -> bool:
+        return in_dirs(relpath, {"serve", "engine"})
+
+    def begin_file(self, ctx: FileCtx) -> None:
+        # Function subtree -> does it account? Computed lazily per
+        # enclosing function when a shed event is seen.
+        self._accounts: Dict[int, bool] = {}
+
+    def _function_accounts(self, fn: ast.AST) -> bool:
+        cached = self._accounts.get(id(fn))
+        if cached is None:
+            cached = any(_is_accounting(sub) for sub in ast.walk(fn))
+            self._accounts[id(fn)] = cached
+        return cached
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        if not _is_shed_event(node):
+            return
+        fn = scope.current_function()
+        if fn is not None and self._function_accounts(fn):
+            return
+        self.report(
+            ctx, node,
+            "request-shedding path without accounting: a "
+            "RequestDropped/RequestStale/AdmissionRejected here must be "
+            "matched, in the same function, by a reason-tagged shed "
+            "counter (.inc on a SHED/REJECT/DROP/ADMISSION metric), an "
+            "audit record, a shed/dropped/stale/rejected counter "
+            "increment, or RequestQueue.count_external_drop — an "
+            "unaccounted shed breaks offered == completed + shed + "
+            "rejected and the soak conservation gates lie",
+            scope,
+        )

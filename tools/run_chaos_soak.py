@@ -130,6 +130,14 @@ def run_live(chaos_spec: str, n_requests: int, rps: float,
     )
     points = [p.split("=")[0] for p in spec.split(",") if p]
     violations = []
+    # Classes enabled: the soak drives a mixed-class population so the
+    # failover machinery (retries, breakers, drain-and-requeue) is proven
+    # to carry tenant/qos_class through every re-dispatch, and shed
+    # accounting conserves PER CLASS (offered = completed + shed +
+    # errors, client-side).
+    classes = ("interactive", "standard", "best_effort")
+    per_class = {c: {"offered": 0, "completed": 0, "shed": 0,
+                     "system_errors": 0} for c in classes}
     try:
         # Warmup proves the path before injection starts.
         assert handle.remote(1).result(timeout=10) == 2
@@ -137,24 +145,32 @@ def run_live(chaos_spec: str, n_requests: int, rps: float,
         futures = []
         interval = 1.0 / rps if rps > 0 else 0.0
         for i in range(n_requests):
-            futures.append((i, handle.remote(i)))
+            cls = classes[i % len(classes)]
+            per_class[cls]["offered"] += 1
+            futures.append((i, cls, handle.remote(
+                i, qos_class=cls, tenant=f"tenant-{i % 2}"
+            )))
             if interval:
                 time.sleep(interval)
         completed = shed = system_errors = 0
         first_error = None
-        for i, fut in futures:
+        for i, cls, fut in futures:
             try:
                 result = fut.result(timeout=30)
                 if result != i * 2:
                     system_errors += 1
+                    per_class[cls]["system_errors"] += 1
                     first_error = first_error or f"wrong result for {i}"
                 else:
                     completed += 1
+                    per_class[cls]["completed"] += 1
             except Exception as e:  # noqa: BLE001 — classification is the test
                 if is_shed(e):
                     shed += 1
+                    per_class[cls]["shed"] += 1
                 else:
                     system_errors += 1
+                    per_class[cls]["system_errors"] += 1
                     first_error = first_error or f"{type(e).__name__}: {e}"
         fired = {p: chaos().fired(p) for p in points}
         if system_errors:
@@ -166,6 +182,13 @@ def run_live(chaos_spec: str, n_requests: int, rps: float,
             if n == 0:
                 violations.append(
                     f"chaos point {p} never fired — the soak proved nothing"
+                )
+        for cls, c in per_class.items():
+            accounted = c["completed"] + c["shed"] + c["system_errors"]
+            if c["offered"] != accounted:
+                violations.append(
+                    f"{cls}: offered {c['offered']} != accounted "
+                    f"{accounted} — per-class shed accounting leaked"
                 )
         heals = [a for a in ctl.audit.to_dicts() if a["trigger"] == "heal"]
         if "replica.loop" in points and not heals:
@@ -180,6 +203,7 @@ def run_live(chaos_spec: str, n_requests: int, rps: float,
             "requests": n_requests,
             "completed": completed,
             "shed": shed,
+            "per_class": per_class,
             "system_errors": system_errors,
             "chaos_fired": fired,
             "failover": status["failover"],
