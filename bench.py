@@ -111,6 +111,7 @@ def bench_llm_serving(
     max_admissions_per_step: int = 8,
     deployment=None,
     quantize_kv: bool = False,
+    paged: bool = False,
 ) -> dict:
     """North star: continuous-batching decode through the serving path.
 
@@ -141,6 +142,7 @@ def bench_llm_serving(
             decode_horizon=decode_horizon,
             max_admissions_per_step=max_admissions_per_step,
             quantize_kv=quantize_kv,
+            paged=paged,
         )
     replica = deployment.make_replica(
         f"{model_name}#bench",
@@ -203,6 +205,11 @@ def bench_llm_serving(
     _log(f"poisson @{offered_rps:.1f} rps ({len(ttfts)} reqs): "
          f"TTFT p50={p50:.0f} ms p99={p99:.0f} ms breakdown={breakdown}")
 
+    # Decode KV residency (the paged pool's occupancy win, measured at
+    # the end of the Poisson phase): useful cached tokens over reserved
+    # KV positions — slabs reserve everything up front, pages only what
+    # is live.
+    kv_occupancy = round(replica.engine.kv_occupancy(), 4)
     replica.stop(timeout_s=2.0, drain=False)
     return {
         "tok_s_per_chip": round(tok_s, 1),
@@ -214,6 +221,8 @@ def bench_llm_serving(
         "num_slots": num_slots,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new_tokens,
+        "paged": paged,
+        "kv_occupancy": kv_occupancy,
     }
 
 
@@ -434,11 +443,16 @@ def main() -> dict:
         }
     # One config dict feeds BOTH llm rows: the int8-KV variant must
     # measure the same configuration as the bf16 row it is compared to.
+    # --paged on (RDB_BENCH_PAGED=1) runs the SAME configuration on the
+    # paged KV pool — the A/B axis against the slab record; the arm is
+    # stamped into every row ("paged") so captures can't be confused.
+    paged = os.environ.get("RDB_BENCH_PAGED") == "1"
     llm_kwargs = dict(
         num_slots=8 if fast else 64,
         saturation_requests=16 if fast else 192,
         poisson_duration_s=5.0 if fast else 15.0,
         decode_horizon=8 if fast else 32,
+        paged=paged,
     )
     try:
         llm = bench_llm_serving(**llm_kwargs)
@@ -513,6 +527,7 @@ def main() -> dict:
         # from a CPU smoke run without trusting the directory it landed in.
         "backend": jax.default_backend(),
         "scope": "llm" if llm_only else "fast" if fast else "full",
+        "paged": paged,
         "ttft_p50_ms": llm["ttft_p50_ms"],
         "ttft_p99_ms": llm["ttft_p99_ms"],
         "llm": llm,
@@ -524,4 +539,15 @@ def main() -> dict:
 
 
 if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--paged", choices=("on", "off"), default=None,
+        help="run the llm serving rows on the paged KV pool (the A/B "
+             "axis vs the slab record; also RDB_BENCH_PAGED=1)",
+    )
+    cli = ap.parse_args()
+    if cli.paged is not None:
+        os.environ["RDB_BENCH_PAGED"] = "1" if cli.paged == "on" else "0"
     print(json.dumps(main()))

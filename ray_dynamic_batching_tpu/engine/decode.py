@@ -62,7 +62,18 @@ from ray_dynamic_batching_tpu.engine.request import (
     RequestDropped,
     now_ms,
 )
+from ray_dynamic_batching_tpu.engine.paging import (
+    OutOfPages,
+    PageAllocator,
+    PagedPrefixCache,
+    PagedSessionCache,
+    table_array,
+)
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.ops.tile_math import (
+    lane_aligned_page,
+    pages_for,
+)
 from ray_dynamic_batching_tpu.profiles.table import bucket_up
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -118,6 +129,11 @@ class _Slot:
     stop: frozenset = frozenset()  # per-request stop token ids
     session_id: Optional[str] = None        # store row on finish
     prompt_tokens: Optional[np.ndarray] = None  # session history head
+    # Paged mode: physical page ids in logical order; the first
+    # ``shared_pages`` of them are borrowed (refcounted) from a
+    # prefix/session entry and are never written by this slot.
+    pages: List[int] = field(default_factory=list)
+    shared_pages: int = 0
 
     @property
     def free(self) -> bool:
@@ -134,10 +150,25 @@ SPEC_ACCEPTED = m.Counter(
 )
 PREFIX_HITS = m.Counter(
     "rdb_decode_prefix_hits_total", "Prompt-prefix KV cache hits",
-    tag_keys=("model",),
+    # granularity: "chunk" = slab whole-segment byte equality, "page" =
+    # paged longest-shared-page-prefix (ISSUE 7 satellite).
+    tag_keys=("model", "granularity"),
 )
 PREFIX_MISSES = m.Counter(
     "rdb_decode_prefix_misses_total", "Prompt-prefix KV cache misses",
+    tag_keys=("model", "granularity"),
+)
+KV_PAGES_FREE = m.Gauge(
+    "rdb_decode_kv_pages_free", "Free pages in the paged KV pool",
+    tag_keys=("model",),
+)
+KV_PAGE_OCCUPANCY = m.Gauge(
+    "rdb_decode_kv_page_occupancy",
+    "Allocated fraction of the paged KV pool", tag_keys=("model",),
+)
+PAGE_EVICTIONS = m.Counter(
+    "rdb_decode_page_evictions_total",
+    "Slots capacity-finished to reclaim pages (over-subscribed pool)",
     tag_keys=("model",),
 )
 
@@ -195,6 +226,42 @@ def commit_row(cache, row, slot):
     lengths = jax.lax.dynamic_update_slice(
         cache.lengths, row.lengths, (slot,)
     )
+    return cache.replace(k=k, v=v, lengths=lengths,
+                         k_scale=ks, v_scale=vs)
+
+
+def _row_as_pages(arr, S: int, ps: int):
+    """[L, nB, rowcap, ...] row-cache array -> [L, nB*NP, ps, ...] page
+    stack covering the first ``S`` positions (rowcap >= S by the paged
+    row-capacity rule; S is a whole number of pages)."""
+    L, nB = arr.shape[0], arr.shape[1]
+    sliced = arr[:, :, :S]
+    return sliced.reshape((L, nB * (S // ps), ps) + arr.shape[3:])
+
+
+def copy_rows_into_paged(cache, rows, slots, write_pids):
+    """Scatter per-request row caches into the PAGED pool: each row is
+    cut into page-size pieces and lands at the physical pages
+    ``write_pids`` names ([nB, NP] int32; sentinel entries — shared
+    CoW pages and unallocated tail — steer out of bounds and DROP, so
+    a borrowed prefix page is never rewritten). ``slots`` places the
+    per-slot lengths. The paged analogue of :func:`copy_rows_into`;
+    duplicate pad rows write identical data to identical pages, which
+    stays idempotent."""
+    S = cache.page_table.shape[1] * cache.page_size
+    ps = cache.page_size
+    flat = write_pids.reshape(-1)
+    k = cache.k.at[:, flat].set(_row_as_pages(rows.k, S, ps), mode="drop")
+    v = cache.v.at[:, flat].set(_row_as_pages(rows.v, S, ps), mode="drop")
+    ks, vs = cache.k_scale, cache.v_scale
+    if ks is not None:
+        ks = ks.at[:, flat].set(
+            _row_as_pages(rows.k_scale, S, ps), mode="drop"
+        )
+        vs = vs.at[:, flat].set(
+            _row_as_pages(rows.v_scale, S, ps), mode="drop"
+        )
+    lengths = cache.lengths.at[slots].set(rows.lengths)
     return cache.replace(k=k, v=v, lengths=lengths,
                          k_scale=ks, v_scale=vs)
 
@@ -369,6 +436,9 @@ class DecodeEngine:
         device: Optional[jax.Device] = None,
         mesh: Optional[Any] = None,
         base_seed: int = 0,
+        paged: bool = False,
+        page_size: int = 128,
+        kv_pool_pages: Optional[int] = None,
     ):
         from ray_dynamic_batching_tpu.utils.compile_cache import maybe_enable
 
@@ -422,7 +492,66 @@ class DecodeEngine:
         self.base_seed = int(base_seed)
 
         self._slots = [_Slot() for _ in range(num_slots)]
-        if mesh is not None and hasattr(model, "cache_pspec"):
+        # Host mirror of per-slot cache lengths (updated from each scan's
+        # packed result): drives paged page-headroom math and the
+        # kv_occupancy() residency metric in BOTH modes.
+        self._len_host = np.zeros((num_slots,), dtype=np.int32)
+        # --- paged KV pool (ISSUE 7 tentpole) ---------------------------
+        # Slab mode gives every slot a private max_len run; paged mode
+        # backs all slots with one pool of lane-aligned pages gathered
+        # through per-slot page tables, so HBM occupancy follows cached
+        # tokens (freed at EOS mid-cycle) and prefix/session reuse
+        # shares pages copy-on-write instead of copying rows.
+        self.paged = bool(paged)
+        self.page_size = int(page_size)
+        if self.paged:
+            if draft_model is not None:
+                raise ValueError(
+                    "speculative decoding over the paged KV pool is not "
+                    "supported: the draft cache would need its own page "
+                    "tables — run spec engines on the slab path"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "paged KV with a TP mesh is not supported yet: the "
+                    "page pool's sharding story (pages x kv-head shards) "
+                    "is ROADMAP item 2 territory"
+                )
+            if not lane_aligned_page(self.page_size):
+                raise ValueError(
+                    f"page_size {self.page_size} must be a 128-lane "
+                    "multiple (ops/tile_math.lane_aligned_page): the int8 "
+                    "scale tile streams the page as its lane dim"
+                )
+            # Logical per-slot capacity: whole pages covering max_len.
+            # The engine still enforces max_len (token-exactness vs the
+            # slab path); the partial last page is headroom that is
+            # never attended past max_len.
+            self._n_table_entries = pages_for(max_len, self.page_size)
+            self._paged_capacity = self._n_table_entries * self.page_size
+            full_backing = num_slots * self._n_table_entries
+            self.num_pages = int(kv_pool_pages or full_backing)
+            # The pool may be over-subscribed (num_pages < full backing:
+            # the occupancy win) but must hold at least one slot's worth
+            # or nothing can ever decode.
+            if self.num_pages < self._n_table_entries:
+                raise ValueError(
+                    f"kv_pool_pages {self.num_pages} cannot back even one "
+                    f"slot ({self._n_table_entries} pages at page_size "
+                    f"{self.page_size}, max_len {max_len})"
+                )
+            self._allocator = PageAllocator(self.num_pages)
+            self._table_host = np.full(
+                (num_slots, self._n_table_entries), self.num_pages,
+                dtype=np.int32,
+            )
+            self._table_dirty = True
+            with self._device_ctx():
+                self._cache = model.make_paged_cache(
+                    num_slots, self.num_pages, self.page_size,
+                    self._paged_capacity,
+                )
+        elif mesh is not None and hasattr(model, "cache_pspec"):
             from ray_dynamic_batching_tpu.parallel.mesh import (
                 make_sharded_cache,
             )
@@ -478,16 +607,32 @@ class DecodeEngine:
         self._scan_start_ms = 0.0
         self._scan_end_ms = 0.0
         self._ttft_parts: collections.deque = collections.deque(maxlen=1024)
-        # Prompt-prefix KV reuse for chunked admissions (0 = off).
+        # Prompt-prefix KV reuse for chunked admissions (0 = off). Paged
+        # engines reuse by page REFERENCE (longest shared page-prefix,
+        # copy-on-write at the partial boundary page); slab engines keep
+        # the chunk-granular device-copy caches.
         self.prefix_cache: Optional[PrefixCache] = None
+        self.paged_prefix: Optional[PagedPrefixCache] = None
         if prefix_cache_size > 0 and self.prompt_buckets:
-            self.prefix_cache = PrefixCache(
-                prefix_cache_size, self.prompt_buckets[-1]
-            )
-        # Multi-turn session KV continuation (0 = off).
+            if self.paged:
+                self.paged_prefix = PagedPrefixCache(
+                    prefix_cache_size, self.page_size, self._allocator
+                )
+            else:
+                self.prefix_cache = PrefixCache(
+                    prefix_cache_size, self.prompt_buckets[-1]
+                )
+        # Multi-turn session KV continuation (0 = off). Paged store pins
+        # the finished slot's pages (O(1), no row copy).
         self.session_cache: Optional[SessionCache] = None
+        self.paged_sessions: Optional[PagedSessionCache] = None
         if session_cache_size > 0:
-            self.session_cache = SessionCache(session_cache_size)
+            if self.paged:
+                self.paged_sessions = PagedSessionCache(
+                    session_cache_size, self.page_size, self._allocator
+                )
+            else:
+                self.session_cache = SessionCache(session_cache_size)
         self._prefill_fns: Dict[int, Callable] = {}
         # Donations: cache (arg 1) and counts (arg 8 — params=0,
         # cache=1, step_state=2, horizon=3, samp_f=4, samp_i=5,
@@ -698,6 +843,32 @@ class DecodeEngine:
         )  # [nB]
         return first, cache
 
+    def _prefill_paged_impl(self, params, tokmask, cache, meta_i, meta_f,
+                            bias_ids, bias_vals, write_pids):
+        """Paged mirror of :meth:`_prefill_impl`: the prompt runs on a
+        private row cache exactly as on the slab path (prefill math is
+        untouched), then the row is cut into pages and scattered at the
+        physical pages ``write_pids`` names — sentinel entries (shared
+        CoW pages, unallocated tail) drop. Same packed-transfer layout,
+        same sampling."""
+        tokens, attn_mask = tokmask[0], tokmask[1]
+        slots, topk, seeds, tok_idx = (
+            meta_i[0], meta_i[1], meta_i[2], meta_i[3]
+        )
+        temps, topp = meta_f[0], meta_f[1]
+        params = self._mp(params)
+        nB = tokens.shape[0]
+        row_cache = self.model.make_cache(nB, self._paged_capacity)
+        last_logits, rows = self.model.prefill(
+            params, tokens, attn_mask, row_cache
+        )
+        cache = copy_rows_into_paged(cache, rows, slots, write_pids)
+        first = self._sample_tokens(
+            last_logits, temps, topk, seeds, tok_idx, bias_ids, bias_vals,
+            topp,
+        )
+        return first, cache
+
     def _decode_impl(self, params, cache, step_state, horizon: int,
                      samp_f, samp_i, bias_ids, bias_vals, counts):
         """``horizon`` chained decode steps in one program (one host sync).
@@ -734,13 +905,21 @@ class DecodeEngine:
 
         def substep(carry, j):
             cache, tokens, counts = carry
-            advanced = jnp.logical_and(active, cache.lengths < cache.capacity)
+            # Paged pools round capacity up to whole pages; the engine's
+            # max_len stays the generation bound so paged and slab runs
+            # block (and capacity-finish) at the SAME length — the
+            # token-exactness contract. For slab caches the two bounds
+            # coincide (make_cache allocates exactly max_len).
+            limit = self.max_len if self.paged else cache.capacity
+            advanced = jnp.logical_and(active, cache.lengths < limit)
             # Dequantize INSIDE the scan body: hoisted outside, the bf16
             # tree becomes a loop-invariant XLA materializes once and
             # re-streams every substep — the exact bandwidth the int8
             # residency is supposed to save. In-body, the compiler may
             # fuse each convert+scale into its consuming matmul.
-            logits, cache = self.model.decode_step(
+            step_fn = (self.model.decode_step_paged if self.paged
+                       else self.model.decode_step)
+            logits, cache = step_fn(
                 self._mp(params), tokens, cache, advanced
             )
             # Repetition control: subtract presence (any prior emission)
@@ -893,7 +1072,9 @@ class DecodeEngine:
         fn = self._prefill_fns.get((bucket, group))
         if fn is None:
             # Donate the big cache (arg 2) — updated in place in HBM.
-            fn = jax.jit(self._prefill_impl, donate_argnums=(2,))
+            impl = (self._prefill_paged_impl if self.paged
+                    else self._prefill_impl)
+            fn = jax.jit(impl, donate_argnums=(2,))
             self._prefill_fns[(bucket, group)] = fn
         return fn
 
@@ -920,10 +1101,20 @@ class DecodeEngine:
                     jnp.zeros((g,), jnp.float32),
                     jnp.ones((g,), jnp.float32),
                 ])
+                extra = ()
+                if self.paged:
+                    # All-sentinel write pids: every page write drops, so
+                    # warmup compiles the full scatter without touching a
+                    # single real page (the table is still all-sentinel).
+                    extra = (jnp.full(
+                        (g, self._n_table_entries), self.num_pages,
+                        jnp.int32,
+                    ),)
                 first, self._cache = self._prefill_fn(b, g)(
                     self.params, tokmask, self._cache, meta_i, meta_f,
                     jnp.zeros((g, self.max_bias_entries), jnp.int32),
                     jnp.zeros((g, self.max_bias_entries), jnp.float32),
+                    *extra,
                 )
                 first.block_until_ready()
         B = self.num_slots
@@ -1032,6 +1223,7 @@ class DecodeEngine:
                 )
             bucket = -1
         opts = {
+            "_cache_len": int(prompt.size),  # post-commit cache lengths
             "max_new": self.default_max_new_tokens,
             "temperature": 0.0,   # greedy unless asked
             "top_k": 0,
@@ -1179,22 +1371,40 @@ class DecodeEngine:
             req.admit_ms = t_dequeue
         by_bucket: Dict[int, List[Tuple[Request, np.ndarray, Dict]]] = {}
         session_items: List[Tuple[Request, np.ndarray, Dict, Tuple]] = []
+        sessions = (self.paged_sessions if self.paged
+                    else self.session_cache)
         for req in batch:
             try:
                 prompt, bucket, opts = self._prep_prompt(req)
             except Exception as e:  # noqa: BLE001 — bad prompt must not kill loop
                 req.reject(e)
                 continue
-            if self.session_cache is not None and opts["session_id"]:
-                hit = self.session_cache.lookup(opts["session_id"], prompt)
-                if hit is not None:
-                    # Counted at admission (_prefill_session), not here: a
-                    # slot-starved requeue would re-look-up and double-count.
-                    session_items.append((req, prompt, opts, hit))
-                    continue
-                # Same hazard for misses (a missed LONG prompt can be
-                # requeued): mark now, count once at _register.
-                opts["_session_miss"] = True
+            hit = None
+            if sessions is not None and opts["session_id"]:
+                hit = sessions.lookup(opts["session_id"], prompt)
+                if hit is not None and self.paged:
+                    # Seed-read hold, taken AT LOOKUP: a long fill
+                    # admitted earlier in this same round interleaves
+                    # decode steps, whose finishes can store new session
+                    # turns and EVICT this entry — without the hold its
+                    # pages could be freed and rewritten before the seed
+                    # gather reads them. The hold also lets the
+                    # reservation below cover only the NON-shared tail.
+                    self._allocator.incref(hit[0])
+                    opts["_session_hold"] = list(hit[0])
+                    opts["_session_share"] = hit[1] // self.page_size
+                if hit is None:
+                    # Misses can be requeued (a missed LONG prompt):
+                    # mark now, count once at _register.
+                    opts["_session_miss"] = True
+            if self.paged and not self._alloc_admission_pages(
+                    req, prompt, opts):
+                continue  # page-starved: requeued (or shed) inside
+            if hit is not None:
+                # Counted at admission (_prefill_session), not here: a
+                # slot-starved requeue would re-look-up and double-count.
+                session_items.append((req, prompt, opts, hit))
+                continue
             by_bucket.setdefault(bucket, []).append((req, prompt, opts))
         admitted = 0
         cap = self.max_admissions_per_step
@@ -1210,15 +1420,18 @@ class DecodeEngine:
                     logger.exception(
                         "%s: prefill group failed", self.model.name
                     )
-                    for req, _p, _o in chunk:
+                    for req, _p, opts in chunk:
+                        self._release_pages(opts)
                         req.reject(e)
                     continue
                 admitted += len(chunk)
+        session_fill = (self._prefill_session_paged if self.paged
+                        else self._prefill_session)
         singles = [
             (self._prefill_long, (req, prompt, opts))
             for req, prompt, opts in long_items
         ] + [
-            (self._prefill_session, (req, prompt, opts, hit))
+            (session_fill, (req, prompt, opts, hit))
             for req, prompt, opts, hit in session_items
         ]
         for fill, args in singles:
@@ -1228,6 +1441,7 @@ class DecodeEngine:
                 # or closed queue refuses WITHOUT rejecting (router-retry
                 # semantics), but here the engine holds the only reference:
                 # an unchecked drop would leave the future hanging forever.
+                self._release_pages(args[2])  # re-allocated on re-admission
                 if not self.queue.add_request(req, reject_on_full=False,
                                               requeue=True):
                     self.queue.count_external_drop(
@@ -1244,10 +1458,75 @@ class DecodeEngine:
                 logger.exception(
                     "%s: chunked prefill failed", self.model.name
                 )
+                self._release_pages(args[2])
                 req.reject(e)
                 continue
             admitted += 1
         return admitted
+
+    # --- paged admission bookkeeping ---------------------------------------
+    def _alloc_admission_pages(self, req: Request, prompt: np.ndarray,
+                               opts: Dict) -> bool:
+        """Reserve the pages an admission needs (prompt + the first
+        generated token's KV, MINUS any session pages the CoW borrow
+        already covers — a long-history continuation must not demand its
+        whole prompt's worth of free pages). Under pressure, cache pins
+        (prefix/session entries) are shed before giving up. Page
+        starvation is slot starvation's twin: the request goes back to
+        the queue untouched and waits for EOS frees, exactly like a
+        slot-starved single — never silently dropped."""
+        need = max(0, pages_for(int(prompt.size) + 1, self.page_size)
+                   - int(opts.get("_session_share", 0)))
+        while True:
+            try:
+                opts["_pages"] = self._allocator.alloc(need)
+                return True
+            except OutOfPages:
+                if self._reclaim_cache_pins():
+                    continue
+                break
+        hold = opts.pop("_session_hold", None)
+        opts.pop("_session_share", None)
+        if hold:
+            self._allocator.decref(hold)
+        if not self.queue.add_request(req, reject_on_full=False,
+                                      requeue=True):
+            self.queue.count_external_drop(req, reason="requeue_refused")
+            req.reject(RequestDropped(
+                f"{req.request_id}: queue refused requeue during "
+                "page-starved admission"
+            ))
+        return False
+
+    def _reclaim_cache_pins(self) -> bool:
+        """Shed one LRU cache pin under pool pressure — prefix entries
+        first (pure recompute cost), then session turns (a re-prefill
+        next turn). Cache pins are optimizations; live streams are not:
+        this runs before any capacity-finish eviction. Returns True if
+        an entry was dropped (its pages free unless a borrower still
+        holds them — callers loop)."""
+        for cache in (self.paged_prefix, self.paged_sessions):
+            if cache is not None and cache.evict_lru():
+                return True
+        return False
+
+    def _release_pages(self, opts: Dict) -> None:
+        """Undo an admission's page reservation (failed/requeued before
+        a slot took ownership). Decrefs the whole list — borrowed CoW
+        pages release their borrow, private pages free — plus any
+        outstanding session seed-read hold (whole, or its post-swap
+        tail)."""
+        if not self.paged:
+            return
+        pages = opts.pop("_pages", None)
+        opts.pop("_shared_pages", None)
+        opts.pop("_session_share", None)
+        if pages:
+            self._allocator.decref(pages)
+        for key in ("_session_hold", "_hold_tail"):
+            hold = opts.pop(key, None)
+            if hold:
+                self._allocator.decref(hold)
 
     def _prefill_group(
         self,
@@ -1297,6 +1576,19 @@ class DecodeEngine:
             slots, topk, seeds, np.zeros((group,), np.int32),
         ]))
         meta_f_d = jnp.asarray(np.stack([temps, topp]))
+        extra = ()
+        if self.paged:
+            # Physical destination pages per admitted row (sentinel
+            # tail); pad rows duplicate row 0's pages — identical data
+            # to identical pages, idempotent like the slot duplicate.
+            pids = np.full((group, self._n_table_entries), self.num_pages,
+                           dtype=np.int32)
+            for i, (_req, _prompt, opts) in enumerate(items):
+                pids[i] = table_array(opts["_pages"],
+                                      self._n_table_entries, self.num_pages)
+            for i in range(n, group):
+                pids[i] = pids[0]
+            extra = (jnp.asarray(pids),)
         first, self._cache = self._prefill_fn(bucket, group)(
             self.params,
             tokmask_d,
@@ -1305,6 +1597,7 @@ class DecodeEngine:
             meta_f_d,
             jnp.asarray(bias_ids),
             jnp.asarray(bias_vals),
+            *extra,
         )
         if self._dcache is not None:
             # The draft must see the same prompt: fill its cache rows too.
@@ -1363,6 +1656,64 @@ class DecodeEngine:
         return (row_cache.k[:, :, :width], row_cache.v[:, :, :width],
                 ks, vs)
 
+    def _commit_long_paged_impl(self, cache, row_cache, meta_i,
+                                last_logits, meta_f, bias_ids, bias_vals,
+                                write_pids):
+        """Paged mirror of :meth:`_commit_long_impl`: the finished row is
+        page-cut and scattered at ``write_pids`` [1, NP] (sentinel for
+        borrowed CoW pages — the shared prefix is never rewritten — and
+        the unallocated tail), then the first token samples."""
+        cache = copy_rows_into_paged(cache, row_cache, meta_i[0:1],
+                                     write_pids)
+        first = self._sample_tokens(
+            last_logits, meta_f[0:1], meta_i[1:2], meta_i[2:3],
+            jnp.zeros((1,), jnp.int32), bias_ids, bias_vals, meta_f[1:2],
+        )
+        return first, cache
+
+    def _seed_paged_impl(self, row_cache, cache, table_row, elen):
+        """Gather a page run (``table_row`` [NP] int32, sentinel-padded)
+        into positions [0, S) of a fresh row cache and mark ``elen``
+        valid — how a CoW borrower sees its shared prefix KV during the
+        tail prefill. Sentinel entries clamp to a real page; everything
+        past ``elen`` is garbage the tail fill overwrites or the mask
+        never attends (the standard invariant)."""
+        P = cache.k.shape[1]
+        safe = jnp.minimum(table_row, P - 1)
+        S = self._paged_capacity
+
+        def logical(arr):
+            g = arr[:, safe]  # [L, NP, ps, ...]
+            return g.reshape((arr.shape[0], 1, S) + arr.shape[3:])
+
+        k = jax.lax.dynamic_update_slice(
+            row_cache.k, logical(cache.k), (0, 0, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            row_cache.v, logical(cache.v), (0, 0, 0, 0, 0)
+        )
+        scales = {}
+        if cache.k_scale is not None:
+            scales = {
+                "k_scale": jax.lax.dynamic_update_slice(
+                    row_cache.k_scale, logical(cache.k_scale), (0, 0, 0, 0)
+                ),
+                "v_scale": jax.lax.dynamic_update_slice(
+                    row_cache.v_scale, logical(cache.v_scale), (0, 0, 0, 0)
+                ),
+            }
+        return row_cache.replace(
+            k=k, v=v, lengths=jnp.full_like(row_cache.lengths, elen),
+            **scales,
+        )
+
+    def _paged_seed_fn(self) -> Callable:
+        fn = self._prefill_fns.get("paged_seed")
+        if fn is None:
+            fn = jax.jit(self._seed_paged_impl, donate_argnums=(0,))
+            self._prefill_fns["paged_seed"] = fn
+        return fn
+
     def _long_prefill_fns(self, chunk: int):
         """Lazily compiled (chunk, commit, seed, extract) fns — long
         prompts may never arrive, so their programs are not part of warmup;
@@ -1375,7 +1726,8 @@ class DecodeEngine:
                 # Only the shared cache (arg 0) can alias the output; the
                 # row cache's [L,1,row_cap,K,H] matches no output shape, so
                 # donating it buys nothing and warns on every compile.
-                jax.jit(self._commit_long_impl, donate_argnums=(0,)),
+                jax.jit(self._commit_long_paged_impl if self.paged
+                        else self._commit_long_impl, donate_argnums=(0,)),
                 jax.jit(self._seed_prefix_impl, donate_argnums=(0,)),
                 jax.jit(self._extract_prefix_impl, static_argnums=(1,)),
             )
@@ -1389,8 +1741,11 @@ class DecodeEngine:
         C) — without it, dynamic_update_slice CLAMPS the overrunning start
         index and silently overwrites earlier positions. One static shape
         for every prompt length and base, so all fills share programs; the
-        commit slices back down to shared capacity."""
-        return ((self.max_len + C - 1) // C) * C + C
+        commit slices back down to shared capacity. Paged engines
+        additionally cover the page-rounded logical capacity, so the
+        commit's page cut always has whole pages to slice."""
+        cap = self._paged_capacity if self.paged else self.max_len
+        return ((cap + C - 1) // C) * C + C
 
     def _interleave_step(self) -> None:
         """One plain decode step for the active batch between chunk
@@ -1408,11 +1763,27 @@ class DecodeEngine:
     def _commit_and_register(
         self, req: Request, prompt: np.ndarray, opts: Dict, slot_idx: int,
         commit_fn: Callable, row, last, C: int,
+        after_commit: Optional[Callable[[], None]] = None,
     ) -> None:
         """Shared tail of every chunked admission (long and session): one
         commit dispatch (row -> shared cache + first-token sample), the
-        draft replay when speculation is on, then registration."""
+        draft replay when speculation is on, then registration.
+        ``after_commit`` runs between commit and registration — the
+        paged prefix-publish hook, which must see committed pages but
+        must run BEFORE a stop-on-first-token registration can free
+        them."""
         bids, bvals = self._bias_arrays(opts)
+        extra = ()
+        if self.paged:
+            shared = int(opts.get("_shared_pages", 0))
+            wp = list(opts["_pages"])
+            # Borrowed CoW pages: steered to the sentinel so the commit
+            # scatter cannot rewrite them (first divergent position lands
+            # in the first PRIVATE page by the share-length rule).
+            wp[:shared] = [self.num_pages] * shared
+            extra = (jnp.asarray(table_array(
+                wp, self._n_table_entries, self.num_pages
+            )[None]),)
         first, self._cache = commit_fn(
             self._cache,
             row,
@@ -1425,7 +1796,10 @@ class DecodeEngine:
             )),
             jnp.asarray(bids[None]),
             jnp.asarray(bvals[None]),
+            *extra,
         )
+        if after_commit is not None:
+            after_commit()
         if self._dcache is not None:
             self._draft_long_fill(prompt, slot_idx, C)
         self._register(slot_idx, req, int(np.asarray(first)[0]), opts,
@@ -1446,28 +1820,58 @@ class DecodeEngine:
         n_chunks = (L + C - 1) // C
         row = self.model.make_cache(1, self._long_row_cap(C))
         start_chunk = 0
+        base = 0
         after_first = None
-        if self.prefix_cache is not None:
+        after_commit = None
+        if self.paged and self.paged_prefix is not None:
+            # Page-granular reuse: borrow the longest shared page-prefix
+            # by reference (CoW — the boundary partial page and the tail
+            # recompute into PRIVATE pages via the row), and publish this
+            # prompt's own full-page prefixes once they are committed.
+            hit = self.paged_prefix.lookup(prompt)
+            if hit is not None:
+                shared_ids, shared_len = hit
+                self._swap_in_shared(opts, shared_ids)
+                row = self._paged_seed_fn()(
+                    row, self._cache,
+                    jnp.asarray(table_array(
+                        shared_ids, self._n_table_entries, self.num_pages
+                    )),
+                    jnp.int32(shared_len),
+                )
+                base = shared_len
+                PREFIX_HITS.inc(tags={"model": self.model.name,
+                                      "granularity": "page"})
+            else:
+                PREFIX_MISSES.inc(tags={"model": self.model.name,
+                                        "granularity": "page"})
+            after_commit = lambda: self.paged_prefix.insert(  # noqa: E731
+                prompt, opts["_pages"]
+            )
+        elif self.prefix_cache is not None:
             # Chunk 0 is full (n_chunks >= 2 on this path), so its k/v
             # depend only on the first C token ids — exactly reusable.
             hit = self.prefix_cache.lookup(prompt)
             if hit is not None:
                 row = seed_fn(row, *hit)
                 start_chunk = 1
-                PREFIX_HITS.inc(tags={"model": self.model.name})
+                PREFIX_HITS.inc(tags={"model": self.model.name,
+                                      "granularity": "chunk"})
             else:
                 after_first = lambda r: self.prefix_cache.insert(  # noqa: E731
                     prompt, *extract_fn(r, C)
                 )
-                PREFIX_MISSES.inc(tags={"model": self.model.name})
+                PREFIX_MISSES.inc(tags={"model": self.model.name,
+                                        "granularity": "chunk"})
 
         last, row = run_chunked(
-            chunk_fn, self.params, prompt, C, row,
+            chunk_fn, self.params, prompt[base:], C, row,
             start_chunk=start_chunk, between=self._interleave_step,
-            after_first=after_first,
+            after_first=after_first, base=base,
         )
         self._commit_and_register(
-            req, prompt, opts, slot_idx, commit_fn, row, last, C
+            req, prompt, opts, slot_idx, commit_fn, row, last, C,
+            after_commit=after_commit,
         )
 
     def _seed_session_impl(self, row_cache, ek, ev, eks, evs, elen):
@@ -1541,6 +1945,66 @@ class DecodeEngine:
             req, prompt, opts, slot_idx, commit_fn, row, last, C
         )
 
+    def _swap_in_shared(self, opts: Dict, shared_ids: List[int]) -> None:
+        """CoW borrow at admission: pin the shared pages (incref), hand
+        back the equivalent leading PRIVATE pages reserved at admission,
+        and splice — ``opts['_pages']`` stays the slot's full logical
+        run, with ``_shared_pages`` marking the borrowed (never-written)
+        head. Incref-before-decref so nothing transits refcount 0."""
+        n = len(shared_ids)
+        pages = opts["_pages"]
+        self._allocator.incref(shared_ids)
+        self._allocator.decref(pages[:n])
+        opts["_pages"] = list(shared_ids) + pages[n:]
+        opts["_shared_pages"] = n
+
+    def _prefill_session_paged(
+        self, req: Request, prompt: np.ndarray, opts: Dict, hit: Tuple,
+        slot_idx: int,
+    ) -> None:
+        """Paged session continuation: borrow the stored turn's FULL
+        pages by reference, seed the row cache from the whole stored run
+        (the partial boundary page's content rides into the row — its
+        private copy is made by the commit, which is the copy-on-write),
+        chunk-prefill only the new tail, and commit tail pages as
+        private."""
+        shared_ids, stored_len = hit
+        SESSION_HITS.inc(tags={"model": self.model.name})
+        C = self.prompt_buckets[-1]
+        chunk_fn, commit_fn, _seed, _extract = self._long_prefill_fns(C)
+        # Only COMPLETE pages are borrowed: the boundary page would be
+        # written by the borrower (positions >= stored_len) and must
+        # diverge into a private copy. The admission hold (taken at
+        # lookup) pins ALL stored pages, and the admission reserved only
+        # the NON-shared tail: transfer the full-page head of the hold
+        # into the slot's borrow, keep the hold's tail pinned until the
+        # seed has read it and the commit has written its private copy.
+        n_share = stored_len // self.page_size
+        opts.pop("_session_hold", None)  # split into borrow + tail below
+        opts.pop("_session_share", None)
+        opts["_pages"] = list(shared_ids[:n_share]) + opts["_pages"]
+        opts["_shared_pages"] = n_share
+        opts["_hold_tail"] = list(shared_ids[n_share:])
+        row = self.model.make_cache(1, self._long_row_cap(C))
+        row = self._paged_seed_fn()(
+            row, self._cache,
+            jnp.asarray(table_array(
+                shared_ids, self._n_table_entries, self.num_pages
+            )),
+            jnp.int32(stored_len),
+        )
+        tail = prompt[stored_len:]
+        last, row = run_chunked(
+            chunk_fn, self.params, tail, C, row,
+            between=self._interleave_step, base=stored_len,
+        )
+        self._commit_and_register(
+            req, prompt, opts, slot_idx, commit_fn, row, last, C
+        )
+        hold_tail = opts.pop("_hold_tail", None)
+        if hold_tail:
+            self._allocator.decref(hold_tail)
+
     def _draft_long_fill(self, prompt: np.ndarray, slot_idx: int,
                          C: int) -> None:
         """Chunk the long prompt through the DRAFT model into its cache
@@ -1584,6 +2048,17 @@ class DecodeEngine:
         slot.stop = opts["stop"]
         slot.session_id = opts.get("session_id")
         slot.prompt_tokens = opts.get("_prompt_tokens")
+        self._len_host[slot_idx] = int(opts.get("_cache_len", 0))
+        if self.paged:
+            # Ownership handoff: the slot now holds the admission's page
+            # reservation; the host table mirror maps it for the next
+            # dispatch's refresh.
+            slot.pages = list(opts.get("_pages", ()))
+            slot.shared_pages = int(opts.get("_shared_pages", 0))
+            self._table_host[slot_idx] = table_array(
+                slot.pages, self._n_table_entries, self.num_pages
+            )
+            self._table_dirty = True
         self._tokens[slot_idx, 0] = first_tok
         self._active_mask[slot_idx] = True
         self._temps[slot_idx] = opts["temperature"]
@@ -1655,6 +2130,22 @@ class DecodeEngine:
         slot = self._slots[slot_idx]
         req = slot.request
         t = now_ms()
+        if self.paged and slot.pages:
+            if (self.paged_sessions is not None and slot.session_id
+                    and slot.prompt_tokens is not None):
+                # O(1) session store: pin the pages covering the turn's
+                # history (prompt + generated[:-1] — same stored-history
+                # rule as the slab path) instead of copying the row out.
+                # Incref (store) strictly before the slot's decref below,
+                # so the pages never transit the free list.
+                history = np.concatenate([
+                    np.asarray(slot.prompt_tokens, np.int32),
+                    np.asarray(slot.generated[:-1], np.int32),
+                ])
+                self.paged_sessions.store(
+                    slot.session_id, slot.pages, history
+                )
+            self._free_slot_pages(slot_idx)
         if (self.session_cache is not None and slot.session_id
                 and slot.prompt_tokens is not None):
             # The cache row holds prompt + generated[:-1] (the final token
@@ -1693,6 +2184,7 @@ class DecodeEngine:
         TOKENS_TOTAL.inc(len(slot.generated), tags={"model": self.model.name})
         self._slots[slot_idx] = _Slot()
         self._active_mask[slot_idx] = False
+        self._len_host[slot_idx] = 0
         self._temps[slot_idx] = 0.0
         self._topk[slot_idx] = 0
         self._topp[slot_idx] = 1.0
@@ -1708,6 +2200,93 @@ class DecodeEngine:
         # of all eight sampling arrays per finished sequence, pure tunnel
         # overhead at high completion churn.
         self.completed += 1
+
+    # --- page-pool management (paged mode) --------------------------------
+    def _free_slot_pages(self, slot_idx: int) -> None:
+        """Return a finished slot's page references to the pool — EOS
+        frees pages immediately mid-cycle: this runs inside ``_harvest``,
+        before the next admission check, so a burst waiting on pages can
+        admit the moment a stream ends instead of at slab granularity.
+        The device table row goes to sentinel at the next refresh, which
+        happens before any dispatch could write through it."""
+        slot = self._slots[slot_idx]
+        if slot.pages:
+            self._allocator.decref(slot.pages)
+            slot.pages = []
+            slot.shared_pages = 0
+        self._table_host[slot_idx] = self.num_pages
+        self._len_host[slot_idx] = 0
+        self._table_dirty = True
+
+    def _refresh_table(self) -> None:
+        """Upload the host page-table mirror when it changed (admission,
+        finish, growth). The device table has exactly ONE writer — this
+        upload; compiled programs treat it as read-only — so the mirror
+        can never drift from what the kernel gathers through."""
+        if self._table_dirty:
+            with self._device_ctx():
+                self._cache = self._cache.replace(
+                    page_table=jnp.asarray(self._table_host)
+                )
+            self._table_dirty = False
+
+    def _ensure_page_headroom(self, horizon: int) -> None:
+        """Grow active slots' page runs to cover the next ``horizon``
+        substeps before the scan dispatches (a scan cannot allocate
+        mid-flight — shapes are static and the allocator is host state).
+
+        Over-subscribed pools can run dry here; the documented policy is
+        to CAPACITY-FINISH the most recently admitted other slot (its
+        caller gets a complete-but-truncated result, the same contract
+        as cache exhaustion) and reuse its pages — newest-first eviction
+        keeps long-running streams, which have the most sunk cost,
+        alive. Full-backing pools (the default) never enter the eviction
+        branch."""
+        for i in np.flatnonzero(self._active_mask):
+            slot = self._slots[i]
+            if slot.free:
+                continue
+            need = pages_for(
+                min(int(self._len_host[i]) + horizon, self.max_len),
+                self.page_size,
+            )
+            delta = need - len(slot.pages)
+            if delta <= 0:
+                continue
+            while not self._allocator.can_alloc(delta):
+                # Shed cache pins first: a pool pinned by prefix/session
+                # entries must never truncate a live stream to grow
+                # another (the entries are pure optimizations and, being
+                # the only non-slot owners, are what makes slot eviction
+                # reclaim nothing).
+                if self._reclaim_cache_pins():
+                    continue
+                victim = self._eviction_victim(exclude=int(i))
+                if victim is None:
+                    break
+                PAGE_EVICTIONS.inc(tags={"model": self.model.name})
+                self._finish(victim, "capacity")
+            if not self._allocator.can_alloc(delta):
+                # Not even eviction could cover this slot: truncate IT.
+                PAGE_EVICTIONS.inc(tags={"model": self.model.name})
+                self._finish(int(i), "capacity")
+                continue
+            slot.pages.extend(self._allocator.alloc(delta))
+            self._table_host[i] = table_array(
+                slot.pages, self._n_table_entries, self.num_pages
+            )
+            self._table_dirty = True
+
+    def _eviction_victim(self, exclude: int) -> Optional[int]:
+        """Most recently admitted active slot other than ``exclude``
+        (newest-first eviction), or None."""
+        best, best_t = None, -1.0
+        for j, s in enumerate(self._slots):
+            if j == exclude or s.free or not self._active_mask[j]:
+                continue
+            if s.prefill_done_ms > best_t:
+                best, best_t = j, s.prefill_done_ms
+        return best
 
     def _pick_horizon(self) -> int:
         """Three-tier horizon: full scan only when the batch is full (no
@@ -1853,6 +2432,12 @@ class DecodeEngine:
         if horizon is None and self._use_spec():
             return self._spec_step()
         h = horizon if horizon is not None else self._pick_horizon()
+        if self.paged:
+            # Pages for every position this scan can write, allocated
+            # host-side before the dispatch (static shapes can't grow
+            # mid-scan), then one tiny [B, NP] table upload when dirty.
+            self._ensure_page_headroom(h)
+            self._refresh_table()
         # Per-slot index of the NEXT token to sample (prefill was index 0).
         tok_idx = np.asarray(
             [len(s.generated) if not s.free else 0 for s in self._slots],
@@ -1938,6 +2523,9 @@ class DecodeEngine:
         if not active_idx:
             return
         cols = np.asarray(active_idx, dtype=np.int64)  # rdb-lint: disable=host-sync-in-hot-path (host-built python index list, no device value)
+        # Host mirror of cache lengths (page-headroom math + the
+        # kv_occupancy metric); finished slots re-zero in _finish.
+        self._len_host[cols] = lengths_host[cols]
         toks = toks_host[:, cols]          # [h, n]
         adv = advanced_host[:, cols]       # [h, n]
         # First non-advanced substep (h if every substep advanced).
@@ -2018,6 +2606,16 @@ class DecodeEngine:
                             float(self._active_mask.sum()),
                             tags={"model": self.model.name},
                         )
+                        if self.paged:
+                            KV_PAGES_FREE.set(
+                                float(self._allocator.free_pages),
+                                tags={"model": self.model.name},
+                            )
+                            KV_PAGE_OCCUPANCY.set(
+                                self._allocator.allocated_pages
+                                / self.num_pages,
+                                tags={"model": self.model.name},
+                            )
                     else:
                         self.queue.wait_for_requests(self.idle_wait_s)
                     self.last_heartbeat = time.monotonic()
@@ -2047,6 +2645,14 @@ class DecodeEngine:
             self.prefix_cache.clear()  # device k/v entries freed on GC
         if self.session_cache is not None:
             self.session_cache.clear()
+        if self.paged:
+            # Drop cache pins first (clean decrefs), then the pool state.
+            if self.paged_prefix is not None:
+                self.paged_prefix.clear()
+            if self.paged_sessions is not None:
+                self.paged_sessions.clear()
+            self._allocator = None
+            self._table_host = None
 
     def abort_active(self, exc: Exception) -> None:
         """Reject every request still occupying a slot (replica shutdown:
@@ -2055,6 +2661,8 @@ class DecodeEngine:
         for i, slot in enumerate(self._slots):
             if not slot.free and slot.request is not None:
                 slot.request.reject(exc)
+                if self.paged and self._allocator is not None:
+                    self._free_slot_pages(i)
                 self._slots[i] = _Slot()
                 self._active_mask[i] = False
 
@@ -2081,6 +2689,24 @@ class DecodeEngine:
                 )
             else:
                 self._thread = None
+
+    def kv_occupancy(self) -> float:
+        """Useful fraction of RESERVED KV positions — the decode
+        slot-occupancy metric the paged pool exists to raise. A slab
+        engine reserves ``num_slots * max_len`` up front (a slot's tail
+        tokens hold a whole slab whether it caches 3 tokens or 300); a
+        paged engine reserves only allocated pages, so at equal traffic
+        its value is >= the slab configuration's by construction —
+        pinned by the paged-vs-slab engine test. 1.0 when nothing is
+        reserved."""
+        used = float(self._len_host.sum())
+        if self.paged:
+            reserved = float(
+                self._allocator.allocated_pages * self.page_size
+            ) if self._allocator is not None else 0.0
+        else:
+            reserved = float(self.num_slots * self.max_len)
+        return used / reserved if reserved > 0 else 1.0
 
     @property
     def active_slots(self) -> int:

@@ -1,0 +1,320 @@
+"""Paged-KV bookkeeping: free-list page allocator + copy-on-write stores.
+
+Host-side half of the paged KV cache (ISSUE 7 / ROADMAP open item 1).
+The DEVICE half is a fixed pool of lane-aligned HBM pages
+(``models/decoder.py::PagedKVCache``: k/v ``[L, P, page_size, K, H]``)
+gathered through per-slot page tables; THIS module owns which pages
+belong to whom:
+
+- :class:`PageAllocator` — a free list with refcounts. A page is either
+  free (refcount 0, on the list) or held by 1+ owners; ``decref``
+  returns it to the list only when the last owner lets go. Conservation
+  (``free + allocated == num_pages``) is an invariant the allocator can
+  assert about itself at any point (``check()``), and the property test
+  drives 10k random op sequences against it.
+- :class:`PagedPrefixCache` — page-granular prompt-prefix reuse: every
+  FULL page of an admitted prompt is published under the hash of the
+  token prefix it covers, so a later prompt shares its *longest common
+  page-prefix* (vLLM's prefix tree, rendered static-shape: sharing is
+  whole pages, the partial boundary page is copied — that copy IS the
+  copy-on-write, performed at admission where the divergence point is
+  already known because decode only ever appends).
+- :class:`PagedSessionCache` — multi-turn continuation by reference:
+  storing a finished turn pins the slot's pages (an incref) instead of
+  copying the KV row out, so session residency costs ~zero extra HBM
+  and store is O(1). Eviction drops only the cache's own ref — pages
+  still shared into an active slot survive until that slot finishes
+  (the evict-while-pinned rule the regression test pins).
+
+Deliberately jax-free (numpy only): allocator invariants are tested at
+pure-Python speed, and ``sim/`` can price page occupancy from the same
+arithmetic without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_dynamic_batching_tpu.ops.tile_math import pages_for
+
+
+class OutOfPages(Exception):
+    """The pool cannot supply the requested pages (over-subscribed KV
+    pool under load). The engine's policy on this is documented at the
+    raise site — never silent."""
+
+
+class PageAllocator:
+    """Fixed pool of KV pages: free list + per-page refcounts.
+
+    Allocation is all-or-nothing (a half-allocated prompt is useless and
+    would leak on the error path). ``incref`` adds an owner to an
+    already-held page (prefix/session sharing); ``decref`` removes one
+    and frees the page when the count hits zero. FIFO reuse (a deque,
+    not a LIFO stack) maximizes the time a freed page's contents stay
+    intact — harmless either way for correctness (pages are always
+    fully rewritten before they are attended), but it makes
+    use-after-free bugs loud in tests instead of accidentally reading
+    fresh identical data.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages <= 0:
+            raise ValueError(f"num_pages must be positive, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free: collections.deque = collections.deque(
+            range(self.num_pages)
+        )
+        self.refcount: List[int] = [0] * self.num_pages
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` fresh pages (refcount 1 each); all-or-nothing."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            raise OutOfPages(
+                f"need {n} pages, {len(self._free)}/{self.num_pages} free"
+            )
+        out = [self._free.popleft() for _ in range(n)]
+        for p in out:
+            self.refcount[p] = 1
+        return out
+
+    def incref(self, pages: Sequence[int]) -> None:
+        """Add an owner to pages that are already held (sharing). An
+        incref of a FREE page is a bug (its contents are reusable by
+        anyone) — refuse loudly rather than resurrect it."""
+        for p in pages:
+            if self.refcount[p] <= 0:
+                raise ValueError(
+                    f"incref of free page {p} — share must happen while "
+                    "the original owner still holds it"
+                )
+        for p in pages:
+            self.refcount[p] += 1
+
+    def decref(self, pages: Sequence[int]) -> List[int]:
+        """Drop one ownership per page; returns the pages actually freed
+        (refcount reached zero — back on the free list)."""
+        freed: List[int] = []
+        for p in pages:
+            if self.refcount[p] <= 0:
+                raise ValueError(f"decref of free page {p} (double free)")
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+    def check(self) -> None:
+        """Assert the conservation invariants (cheap; tests call it
+        after every op of the random 10k-op sequence):
+
+        - free + allocated == num_pages, with no page on the free list
+          twice;
+        - refcount is never negative;
+        - a page is on the free list iff its refcount is zero.
+        """
+        free = list(self._free)
+        if len(set(free)) != len(free):
+            raise AssertionError(f"free list holds duplicates: {free}")
+        if len(free) + self.allocated_pages != self.num_pages:
+            raise AssertionError(
+                f"conservation broken: {len(free)} free + "
+                f"{self.allocated_pages} allocated != {self.num_pages}"
+            )
+        free_set = set(free)
+        for p, rc in enumerate(self.refcount):
+            if rc < 0:
+                raise AssertionError(f"page {p} refcount {rc} < 0")
+            if (rc == 0) != (p in free_set):
+                raise AssertionError(
+                    f"page {p} refcount {rc} but "
+                    f"{'on' if p in free_set else 'off'} the free list"
+                )
+
+
+class _PinnedLRU:
+    """Bounded LRU whose values hold PINNED page ids: insertion increfs,
+    eviction/replacement decrefs — the cache's own reference, distinct
+    from any slot's. Shared mechanics for the prefix and session stores
+    so pin/unpin symmetry cannot diverge between them."""
+
+    def __init__(self, capacity: int, allocator: PageAllocator):
+        self.capacity = int(capacity)
+        self.allocator = allocator
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+
+    def _pages_of(self, value) -> Sequence[int]:
+        raise NotImplementedError
+
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-used entry (page-pressure reclaim:
+        cache pins are optimizations, and under pool pressure the engine
+        sheds them before truncating live streams). Returns False when
+        empty. Note the decref may free nothing if a borrower still
+        holds the pages — the caller loops."""
+        if not self._entries:
+            return False
+        _, evicted = self._entries.popitem(last=False)
+        self.allocator.decref(self._pages_of(evicted))
+        return True
+
+    def _get(self, key):
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+        return entry
+
+    def _put(self, key, value) -> None:
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.allocator.decref(self._pages_of(old))
+        self.allocator.incref(self._pages_of(value))
+        self._entries[key] = value
+        while len(self._entries) > self.capacity:
+            _, evicted = self._entries.popitem(last=False)
+            # Evict-while-pinned: this drops ONLY the cache's ref. Pages
+            # still shared into a live slot keep that slot's refcount and
+            # stay resident until it finishes — freeing them here would
+            # hand an in-use page to the next admission (the refcount
+            # leak class the regression test pins).
+            self.allocator.decref(self._pages_of(evicted))
+
+    def clear(self) -> None:
+        while self._entries:
+            _, evicted = self._entries.popitem(last=False)
+            self.allocator.decref(self._pages_of(evicted))
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class PagedPrefixCache(_PinnedLRU):
+    """Page-granular prompt-prefix index (the paged successor of the
+    chunk-granular ``decode.PrefixCache``).
+
+    Insertion publishes EVERY full-page prefix of an admitted prompt:
+    level ``j`` is keyed by a digest CHAIN — level j's key is
+    ``blake2b(page_j_tokens + key_{j-1})`` — so computing all L/ps level
+    keys of a prompt costs one O(L) pass (each token byte is hashed
+    once), not the O(L^2/ps) of re-serializing every prefix, and the
+    store retains 16-byte digests instead of whole prefix byte-strings.
+    Lookup probes from the longest possible level down, so a hit is the
+    *longest shared page-prefix* — byte-equality of whole prompts is no
+    longer required (satellite: page-granular keying). A hit must leave
+    >= 1 token to prefill (the tail drives the first sampled logits),
+    hence the strict ``< prompt_len`` bound.
+    """
+
+    def __init__(self, capacity: int, page_size: int,
+                 allocator: PageAllocator):
+        super().__init__(capacity, allocator)
+        self.page_size = int(page_size)
+
+    def _pages_of(self, value) -> Sequence[int]:
+        return value
+
+    def _level_keys(self, prompt: np.ndarray, max_n: int) -> List[bytes]:
+        """Chained level keys: keys[j-1] covers pages [0, j). One pass
+        over the prompt bytes total."""
+        import hashlib
+
+        keys: List[bytes] = []
+        prev = b""
+        ps = self.page_size
+        for n in range(1, max_n + 1):
+            page = np.ascontiguousarray(
+                prompt[(n - 1) * ps: n * ps]
+            ).tobytes()
+            prev = hashlib.blake2b(page + prev, digest_size=16).digest()
+            keys.append(prev)
+        return keys
+
+    def lookup(self, prompt: np.ndarray) -> Optional[Tuple[List[int], int]]:
+        """Longest shared page-prefix: ``(page_ids, shared_len)`` with
+        ``shared_len == len(page_ids) * page_size < prompt.size``, or
+        None."""
+        max_n = (int(prompt.size) - 1) // self.page_size
+        keys = self._level_keys(prompt, max_n)
+        for n in range(max_n, 0, -1):
+            entry = self._get(keys[n - 1])
+            if entry is not None:
+                return list(entry), n * self.page_size
+        return None
+
+    def insert(self, prompt: np.ndarray, page_ids: Sequence[int]) -> None:
+        """Publish every full-page prefix of ``prompt`` whose pages are
+        in ``page_ids`` (the admitting slot's table, still held by the
+        slot — incref happens per level inside ``_put``)."""
+        n_full = min(int(prompt.size) // self.page_size, len(page_ids))
+        for n, key in enumerate(self._level_keys(prompt, n_full), start=1):
+            if key not in self._entries:
+                self._put(key, tuple(page_ids[:n]))
+
+
+class PagedSessionCache(_PinnedLRU):
+    """Session-id -> pinned page run of the finished turn.
+
+    ``store`` pins the pages covering the stored history instead of
+    copying the KV row out of the cache (the slab SessionCache's
+    per-turn full-row device copy disappears); ``lookup`` returns the
+    page run + history length when the stored turn is a strict prefix
+    of the next prompt, exactly the slab semantics."""
+
+    def __init__(self, capacity: int, page_size: int,
+                 allocator: PageAllocator):
+        super().__init__(capacity, allocator)
+        self.page_size = int(page_size)
+
+    def _pages_of(self, value) -> Sequence[int]:
+        return value[0]
+
+    def lookup(self, session_id: str, prompt: np.ndarray
+               ) -> Optional[Tuple[List[int], int]]:
+        """``(page_ids, stored_len)`` when the stored turn strictly
+        prefixes ``prompt`` (>= 1 tail token left to prefill)."""
+        entry = self._get(session_id)
+        if entry is None:
+            return None
+        pages, history = entry
+        n = int(history.size)
+        if n >= prompt.size or not np.array_equal(history, prompt[:n]):
+            return None
+        return list(pages), n
+
+    def store(self, session_id: str, page_ids: Sequence[int],
+              history: np.ndarray) -> None:
+        """Pin the pages covering ``history`` under ``session_id``.
+        Call while the finishing slot still holds its pages (incref
+        before the slot's decref — the pages must never transit
+        refcount 0)."""
+        n = pages_for(int(history.size), self.page_size)
+        self._put(session_id,
+                  (tuple(page_ids[:n]), np.asarray(history, np.int32)))
+
+
+def table_array(pages: Sequence[int], n_entries: int,
+                sentinel: int) -> np.ndarray:
+    """A slot's page list as a fixed-width int32 row for the device
+    table: unallocated tail entries carry ``sentinel`` (= pool size, one
+    past the last valid page) so device-side writes through them DROP
+    and gathers clamp into masked-off territory."""
+    out = np.full((n_entries,), sentinel, dtype=np.int32)
+    k = min(len(pages), n_entries)
+    out[:k] = pages[:k]
+    return out

@@ -24,6 +24,7 @@ from ray_dynamic_batching_tpu.models.decoder import (
     DecoderConfig,
     DecoderModule,
     KVCache,
+    PagedKVCache,
     decode_mask,
     prefill_mask,
 )
@@ -218,6 +219,43 @@ class CausalLM(ServableModel):
         positions = cache.lengths[:, None]
         mask = decode_mask(cache.lengths, cache.capacity)
         logits, new_cache = self.module.apply(params, tokens, positions, mask, cache)
+        new_lengths = cache.lengths + active.astype(jnp.int32)
+        return logits[:, 0], new_cache.replace(lengths=new_lengths)
+
+    def make_paged_cache(
+        self, batch_size: int, num_pages: int, page_size: int,
+        max_len: int,
+    ) -> PagedKVCache:
+        """A paged KV pool: ``num_pages`` fixed HBM pages + a
+        ``[batch_size, max_len // page_size]`` page table (engine-owned
+        allocation — ``engine/paging.py``)."""
+        return PagedKVCache.zeros(
+            self.cfg, batch_size, num_pages, page_size, max_len,
+            dtype=self.kv_dtype or self.dtype,
+        )
+
+    def decode_step_paged(
+        self,
+        params,
+        tokens: jax.Array,   # [B, 1] current token per slot
+        cache: PagedKVCache,
+        active: jax.Array,   # [B] bool — which slots advance
+    ) -> Tuple[jax.Array, PagedKVCache]:
+        """One decode step against the paged pool — the exact
+        :meth:`decode_step` contract (force-deactivation at logical
+        capacity, lengths advance only for active rows, garbage logits
+        on inactive rows) with writes and reads routed through the page
+        table. Token-exact vs the slab step by construction: the write
+        rule maps the same logical position to a physical (page,
+        offset), and attention sees the same positions <= lengths window
+        through the dispatcher's paged gather/kernel."""
+        in_bounds = cache.lengths < cache.capacity
+        active = jnp.logical_and(active, in_bounds)
+        positions = cache.lengths[:, None]
+        logits, new_cache = self.module.apply(
+            params, tokens, positions, None, cache,
+            page_table=cache.page_table, kv_lengths=cache.lengths,
+        )
         new_lengths = cache.lengths + active.astype(jnp.int32)
         return logits[:, 0], new_cache.replace(lengths=new_lengths)
 

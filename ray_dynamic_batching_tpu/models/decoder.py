@@ -103,6 +103,78 @@ class KVCache:
         return self.k_scale is not None
 
 
+@pytree_dataclass
+class PagedKVCache:
+    """Paged KV pool: k/v ``[L, P, page_size, K, H]`` fixed HBM pages,
+    gathered per slot through ``page_table`` ``[B, NP]`` int32 (entry j
+    names the physical page backing logical positions
+    ``[j*page_size, (j+1)*page_size)`` of that slot; unallocated entries
+    carry the sentinel ``P`` — one past the last page — so writes
+    through them drop and gathers clamp into masked territory).
+
+    The slab cache gives every slot a private ``max_len`` KV run whether
+    it uses 3 tokens or 300; here HBM occupancy follows *actual* cached
+    tokens at page granularity, prefix/session reuse shares pages by
+    refcount instead of copying rows (``engine/paging.py``), and EOS
+    returns pages to the free list mid-cycle. Shapes stay fully static —
+    continuous batching still varies contents, never shapes — so the
+    one-compiled-program-per-stream property of the slab path survives.
+
+    Quantized pools mirror the slab layout: k/v hold int8 codes,
+    ``k_scale``/``v_scale`` ``[L, P, page_size, K]`` hold the per-row
+    f32 scales, paged with the SAME page table."""
+
+    k: jax.Array
+    v: jax.Array
+    page_table: jax.Array  # [B, NP] int32, sentinel P = unallocated
+    lengths: jax.Array     # [B] valid logical prefix per slot
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @staticmethod
+    def zeros(
+        cfg: DecoderConfig, batch_size: int, num_pages: int,
+        page_size: int, max_len: int,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "PagedKVCache":
+        if max_len % page_size != 0:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of page_size "
+                f"{page_size} (logical capacity is whole pages)"
+            )
+        n_entries = max_len // page_size
+        shape = (cfg.num_layers, num_pages, page_size,
+                 cfg.num_kv_heads, cfg.head_dim)
+        quantized = jnp.dtype(dtype) == jnp.dtype(jnp.int8)
+        return PagedKVCache(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            page_table=jnp.full((batch_size, n_entries), num_pages,
+                                dtype=jnp.int32),
+            lengths=jnp.zeros((batch_size,), dtype=jnp.int32),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32) if quantized else None,
+            v_scale=jnp.zeros(shape[:-1], jnp.float32) if quantized else None,
+        )
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        """Per-slot LOGICAL capacity (page_table width x page size) —
+        the same contract as ``KVCache.capacity``."""
+        return self.page_table.shape[1] * self.k.shape[2]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
 def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-(token, head) absmax int8 quantization: x [..., H] ->
     (codes int8 [..., H], scale f32 [...])."""
@@ -171,6 +243,8 @@ class DecoderLayer(nn.Module):
         layer_idx: int = 0,
         write_start: Optional[jax.Array] = None,  # scalar: chunk write offset
         scatter_writes: bool = False,  # per-row writes at ``positions``
+        page_table: Optional[jax.Array] = None,  # [B, NP]: paged decode
+        kv_lengths: Optional[jax.Array] = None,  # [B] paged validity bound
     ) -> Tuple[jax.Array, Optional[Tuple[jax.Array, jax.Array]]]:
         cfg = self.cfg
         dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
@@ -208,7 +282,44 @@ class DecoderLayer(nn.Module):
                 ks_full = vs_full = None
                 k_w, v_w = k, v
             B, T = positions.shape
-            if scatter_writes:
+            if page_table is not None:
+                # Paged decode (T == 1 only): the cache arrays are page
+                # POOLS [L, P, ps, K, H]; the token's logical position
+                # maps through the slot's page-table row to a physical
+                # (page, offset). Unallocated entries carry the sentinel
+                # P, and logically-overflowing rows are steered to it
+                # too, so mode="drop" voids exactly the writes the slab
+                # path's out-of-bounds scatter voids.
+                if T != 1 or scatter_writes:
+                    raise NotImplementedError(
+                        "paged cache writes support single-token decode "
+                        "only; prefill/verify run on row caches and "
+                        "commit through the engine's page scatter"
+                    )
+                P = k_full.shape[1]
+                ps = k_full.shape[2]
+                n_entries = page_table.shape[1]
+                idx = positions[:, 0]
+                rows = jnp.arange(B)
+                pidx = jnp.minimum(idx // ps, n_entries - 1)
+                pid = jnp.where(
+                    idx < n_entries * ps, page_table[rows, pidx], P
+                )
+                off = idx % ps
+                k_full = k_full.at[layer_idx, pid, off].set(
+                    k_w[:, 0], mode="drop"
+                )
+                v_full = v_full.at[layer_idx, pid, off].set(
+                    v_w[:, 0], mode="drop"
+                )
+                if quantized:
+                    ks_full = ks_full.at[layer_idx, pid, off].set(
+                        k_s[:, 0], mode="drop"
+                    )
+                    vs_full = vs_full.at[layer_idx, pid, off].set(
+                        v_s[:, 0], mode="drop"
+                    )
+            elif scatter_writes:
                 # Batched multi-token writes at PER-ROW positions (the
                 # speculative-verify path: each slot's window starts at its
                 # own length). mode="drop" voids rows steered out of
@@ -275,6 +386,13 @@ class DecoderLayer(nn.Module):
                 new_cache = (k_full, v_full, ks_full, vs_full)
             else:
                 new_cache = (k_full, v_full)
+            if page_table is not None:
+                # Paged read: k/v are the page pools; the dispatcher
+                # gathers through the table (fused in the Pallas paged
+                # kernel; an explicit gather + the shared decode mask on
+                # the fallback — one mask rule, token-exact either way).
+                scale_kwargs.update(page_table=page_table,
+                                    kv_lengths=kv_lengths)
             attn_out = attn_ops.dot_product_attention(
                 q, k_full[layer_idx], v_full[layer_idx], mask=mask,
                 **scale_kwargs,
@@ -330,6 +448,8 @@ class DecoderModule(nn.Module):
         token_mask: Optional[jax.Array] = None,  # [B, T] (no-cache path)
         write_start: Optional[jax.Array] = None,  # scalar chunk offset
         scatter_writes: bool = False,  # per-row multi-token cache writes
+        page_table: Optional[jax.Array] = None,  # paged decode (T == 1)
+        kv_lengths: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, Optional[KVCache]]:
         cfg = self.cfg
         embed = nn.Embed(
@@ -360,6 +480,7 @@ class DecoderModule(nn.Module):
             x, updated = DecoderLayer(cfg, dtype=self.dtype, name=f"layer{i}")(
                 x, positions, mask, cache_kv, token_mask, layer_idx=i,
                 write_start=write_start, scatter_writes=scatter_writes,
+                page_table=page_table, kv_lengths=kv_lengths,
             )
             if updated is not None:
                 cache_kv = updated
@@ -382,11 +503,20 @@ class DecoderModule(nn.Module):
 
         out_cache = None
         if cache is not None:
-            out_cache = KVCache(
-                k=cache_kv[0], v=cache_kv[1], lengths=cache.lengths,
+            scales = dict(
                 k_scale=cache_kv[2] if len(cache_kv) == 4 else None,
                 v_scale=cache_kv[3] if len(cache_kv) == 4 else None,
             )
+            if page_table is not None:
+                out_cache = PagedKVCache(
+                    k=cache_kv[0], v=cache_kv[1], page_table=page_table,
+                    lengths=cache.lengths, **scales,
+                )
+            else:
+                out_cache = KVCache(
+                    k=cache_kv[0], v=cache_kv[1], lengths=cache.lengths,
+                    **scales,
+                )
         return logits, out_cache
 
 

@@ -96,6 +96,8 @@ def dot_product_attention(
     scale: Optional[float] = None,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    page_table: Optional[jax.Array] = None,
+    kv_lengths: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-head attention.
 
@@ -107,7 +109,21 @@ def dot_product_attention(
     (models/decoder.py::KVCache). The decode kernel consumes the codes
     directly (1-byte scan, scales applied inside the dots); every other
     path dequantizes first and proceeds as usual.
+
+    ``page_table`` [B, NP] + ``kv_lengths`` [B] switch to the PAGED
+    decode read: k/v (and scales) are page POOLS ([P, ps, K, H] /
+    [P, ps, K]) shared by all slots, and each slot's logical KV run is
+    the table-ordered gather of its pages. The Pallas paged kernel
+    fuses that gather into the KV scan (no logical-view materialization
+    in HBM); everywhere else an explicit gather rebuilds the slab view
+    and re-enters this function — one mask/dequant rule, so paged and
+    slab reads are token-exact against each other.
     """
+    if page_table is not None:
+        return _paged_attention(
+            q, k, v, page_table, kv_lengths, mask=mask, scale=scale,
+            k_scale=k_scale, v_scale=v_scale,
+        )
     if _use_pallas():
         if not causal:
             # Small query windows — plain decode (Tq == 1), speculative
@@ -141,6 +157,62 @@ def dot_product_attention(
         k, v = _dequantize(k, k_scale, q.dtype), _dequantize(
             v, v_scale, q.dtype)
     return _xla_attention(q, k, v, causal=causal, mask=mask, scale=scale)
+
+
+def _paged_attention(
+    q: jax.Array,
+    k: jax.Array,              # [P, ps, K, H] page pool (one layer)
+    v: jax.Array,
+    page_table: jax.Array,     # [B, NP] int32, sentinel P = unallocated
+    kv_lengths: jax.Array,     # [B] valid logical prefix (attend <= len)
+    *,
+    mask: Optional[jax.Array],
+    scale: Optional[float],
+    k_scale: Optional[jax.Array],   # [P, ps, K] or None
+    v_scale: Optional[jax.Array],
+) -> jax.Array:
+    """Paged decode read: fused page-table KV scan on the Pallas path,
+    explicit gather back to the slab view otherwise (the token-exact
+    fallback — identical values land in identical logical positions, and
+    the shared ``decode_mask`` rule bounds what is attended)."""
+    if mask is not None:
+        raise ValueError(
+            "paged attention derives its window from kv_lengths; an "
+            "explicit mask on this path means a caller mixed the slab "
+            "and paged conventions"
+        )
+    if _use_pallas():
+        from ray_dynamic_batching_tpu.ops import decode_attention
+
+        out = decode_attention.paged_decode_attention(
+            q, k, v, page_table, kv_lengths, scale=scale,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+        if out is not None:
+            return out
+    # Gather fallback: rebuild each slot's logical KV run [B, S, K, H]
+    # (S = NP * ps) and re-enter the slab path. Sentinel/garbage pages
+    # clamp to a real page, then the length mask voids their positions —
+    # the same never-attended-garbage invariant the slab cache relies on.
+    from ray_dynamic_batching_tpu.models.decoder import decode_mask
+
+    P = k.shape[0]
+    safe = jnp.minimum(page_table, P - 1)
+    B, NP = page_table.shape
+    ps = k.shape[1]
+
+    def logical(pages):
+        g = pages[safe]  # [B, NP, ps, ...]
+        return g.reshape((B, NP * ps) + pages.shape[2:])
+
+    k_g, v_g = logical(k), logical(v)
+    ks_g = vs_g = None
+    if k_scale is not None:
+        ks_g, vs_g = logical(k_scale), logical(v_scale)
+    win = decode_mask(kv_lengths, NP * ps)  # [B, 1, 1, S]
+    return dot_product_attention(
+        q, k_g, v_g, mask=win, scale=scale, k_scale=ks_g, v_scale=vs_g,
+    )
 
 
 def _dequantize(codes: jax.Array, scales: jax.Array,

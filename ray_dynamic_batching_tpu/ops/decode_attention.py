@@ -92,19 +92,9 @@ def _decode_kernel(
     num_s: int,
     window: int,
 ):
-    kb = q_ref.shape[1]
     R = q_ref.shape[2]          # Tq * G
-    H = q_ref.shape[3]
     Sb = k_ref.shape[1]
     G = R // window
-    compute_dtype = q_ref.dtype  # int8 codes cast exactly (<= +-127)
-    s_idx = pl.program_id(2)
-
-    @pl.when(s_idx == 0)
-    def _init():
-        m_ref[...] = jnp.full((kb, R), NEG_INF, jnp.float32)
-        l_ref[...] = jnp.zeros((kb, R), jnp.float32)
-        acc_ref[...] = jnp.zeros((kb, R, H), jnp.float32)
 
     # Head-invariant per-tile validity: every head block shares the
     # per-(t, g)-row window. Sb divides S (``_pick_sb``), so there is no
@@ -117,6 +107,33 @@ def _decode_kernel(
         ).reshape(R, Sb)
     else:
         valid = None
+    _scan_tile(
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref,
+        acc_ref, valid=valid, scale=scale, num_s=num_s,
+    )
+
+
+def _scan_tile(
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, valid, scale: float, num_s: int,
+):
+    """One KV tile of the online-softmax scan — the body shared by the
+    slab kernel (S-axis tiles, mask-derived ``valid``) and the paged
+    kernel (page-table tiles, length-derived ``valid``): init scratch at
+    tile 0, accumulate this tile per head, finalize into the output on
+    the last tile. The math being ONE function is what keeps the paged
+    and slab kernels numerically identical."""
+    kb = q_ref.shape[1]
+    R = q_ref.shape[2]
+    H = q_ref.shape[3]
+    compute_dtype = q_ref.dtype  # int8 codes cast exactly (<= +-127)
+    s_idx = pl.program_id(2)
+
+    @pl.when(s_idx == 0)
+    def _init():
+        m_ref[...] = jnp.full((kb, R), NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros((kb, R), jnp.float32)
+        acc_ref[...] = jnp.zeros((kb, R, H), jnp.float32)
 
     for h in range(kb):         # static unroll: this program's KV heads
         q = q_ref[0, h, :, :]        # [R, H]
@@ -294,6 +311,164 @@ def _decode_attention(
         ),
         interpret=interpret,
     )(*args)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret")
+)
+def _paged_decode_attention(
+    q: jax.Array,          # [B, K, G, H]  (Tq == 1)
+    k: jax.Array,          # [P, ps, K, H] page pool
+    v: jax.Array,
+    page_table: jax.Array,  # [B, NP] int32, sentinel P
+    lengths: jax.Array,     # [B] int32 — attend positions <= lengths[b]
+    k_scale: Optional[jax.Array],  # [P, K, ps] f32 (int8 pool), or None
+    v_scale: Optional[jax.Array],
+    *,
+    scale: float,
+    interpret: bool,
+) -> jax.Array:
+    B, K, G, H = q.shape
+    P, ps = k.shape[0], k.shape[1]
+    NP = page_table.shape[1]
+    kb = _pick_heads_block(K)
+    has_scales = k_scale is not None
+
+    # The page axis IS the KV tiling: grid step (b, j, p) streams slot
+    # b's p-th page — whichever physical page the PREFETCHED table names
+    # (sentinel/garbage entries clamp to a real page; the length bound
+    # masks everything they could contribute). Pages replace the slab
+    # kernel's S-axis tiles one-for-one, so the online-softmax scratch
+    # carry works unchanged.
+    def kv_index(b, j, p, pt, ln):
+        return (jnp.minimum(pt[b, p], P - 1), 0, j, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, kb, G, H), lambda b, j, p, pt, ln: (b, j, 0, 0)),
+        pl.BlockSpec((1, ps, kb, H), kv_index),
+        pl.BlockSpec((1, ps, kb, H), kv_index),
+    ]
+    args = [q, k, v]
+    if has_scales:
+        scale_spec = pl.BlockSpec(
+            (1, kb, ps),
+            lambda b, j, p, pt, ln: (jnp.minimum(pt[b, p], P - 1), j, 0),
+        )
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+
+    def kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest):
+        ks_ref = rest[0] if has_scales else None
+        vs_ref = rest[1] if has_scales else None
+        o_ref, m_ref, l_ref, acc_ref = rest[2 if has_scales else 0:][:4]
+        b = pl.program_id(0)
+        p = pl.program_id(2)
+        # In-kernel validity from the prefetched lengths: page p covers
+        # logical positions [p*ps, (p+1)*ps); decode attends <= lengths
+        # (the slab decode_mask rule). No mask array is streamed at all.
+        pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (G, ps), 1)
+        valid = pos <= len_ref[b]
+        _scan_tile(
+            q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref,
+            acc_ref, valid=valid, scale=scale, num_s=NP,
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K // kb, NP),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, kb, G, H), lambda b, j, p, pt, ln: (b, j, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((kb, G), jnp.float32),
+            pltpu.VMEM((kb, G), jnp.float32),
+            pltpu.VMEM((kb, G, H), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, K, G, H), q.dtype),
+        compiler_params=_COMPILER_PARAMS(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table, lengths, *args)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    page_table: jax.Array,
+    kv_lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+) -> Optional[jax.Array]:
+    """Fused page-table decode attention; returns None when the shapes
+    aren't the paged decode pattern (caller falls back to the explicit
+    gather — same decline contract as :func:`decode_attention`).
+
+    q [B, 1, N, H]; k/v [P, ps, K, H] page pools with K dividing N;
+    page_table [B, NP] int32 (sentinel P = unallocated); kv_lengths [B]
+    (attend logical positions <= kv_lengths[b], the ``decode_mask``
+    rule). ``k_scale``/``v_scale`` [P, ps, K] enable the int8-pool path.
+
+    Eligibility is the lane-alignment + VMEM-budget contract of
+    ``ops/tile_math.py``: the page IS the KV tile, so its streamed
+    footprint (``paged_tile_bytes``) must fit the shared budget
+    double-buffered, and the page size must be a 128-lane multiple (the
+    int8 scale tile's lane dim is the page). The static ``vmem-budget``
+    lint rule re-evaluates this same model over the BlockSpecs above.
+    """
+    if q.ndim != 4 or k.ndim != 4 or q.shape[1] != 1:
+        return None
+    B, Tq, N, H = q.shape
+    P, ps, K, Hk = k.shape
+    if Hk != H or v.shape != k.shape or K == 0 or N % K != 0:
+        return None
+    if page_table.ndim != 2 or page_table.shape[0] != B:
+        return None
+    if kv_lengths.shape != (B,):
+        return None
+    if (k_scale is None) != (v_scale is None):
+        return None
+    if k_scale is not None and (
+            k_scale.shape != (P, ps, K) or v_scale.shape != (P, ps, K)):
+        return None
+    if not tile_math.lane_aligned_page(ps):
+        return None
+    kb = _pick_heads_block(K)
+    if tile_math.paged_tile_bytes(
+            ps, kb, H, k.dtype.itemsize,
+            with_scales=k_scale is not None) > VMEM_BLOCK_BUDGET_BYTES:
+        return None  # page too fat for VMEM double-buffering: gather path
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else H ** -0.5
+    G = N // K
+    # Rows ordered per kv head: [B, 1, K, G, H] -> [B, K, G, H].
+    q_r = q.reshape(B, K, G, H)
+    ks = vs = None
+    if k_scale is not None:
+        # [P, ps, K] -> [P, K, ps]: the page becomes the (lane) trailing
+        # dim of the scale tile — pad-free because pages are lane-aligned
+        # (the [B, S, K, 1]-layout ~128x blowup documented on the slab
+        # path is the same trap this transpose avoids).
+        ks = k_scale.transpose(0, 2, 1)
+        vs = v_scale.transpose(0, 2, 1)
+    out = _paged_decode_attention(
+        q_r, k, v, page_table.astype(jnp.int32),
+        kv_lengths.astype(jnp.int32), ks, vs,
+        scale=float(scale), interpret=bool(interpret),
+    )
+    return out.reshape(B, K, 1, G, H).transpose(0, 2, 1, 3, 4).reshape(
+        B, 1, N, H
+    )
 
 
 def decode_attention(

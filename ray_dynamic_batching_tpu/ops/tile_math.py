@@ -82,6 +82,48 @@ def padded_block_bytes(block_shape: Sequence[int], itemsize: int) -> int:
     return lead * sub * lane * itemsize
 
 
+def pages_for(length: int, page_size: int) -> int:
+    """Pages needed to hold ``length`` cached positions (ceil division).
+    Shared by the engine's allocator bookkeeping and the sim's occupancy
+    accounting so the two can never disagree about footprint."""
+    if length <= 0:
+        return 0
+    return -(-int(length) // int(page_size))
+
+
+def lane_aligned_page(page_size: int) -> bool:
+    """A KV page is tile-legal iff its size is a LANE multiple: the int8
+    scale tile streams as [1, kb, page_size] with the page as its lane
+    dim, so an unaligned page silently pads every scale tile in VMEM."""
+    return page_size > 0 and page_size % LANE == 0
+
+
+def paged_tile_bytes(
+    page_size: int,
+    kb: int,
+    H: int,
+    kv_itemsize: int,
+    with_scales: bool = False,
+) -> int:
+    """Double-buffered VMEM footprint of one PAGED decode-attention grid
+    step's streamed blocks — the model the paged kernel's runtime guard
+    budgets against and the static ``vmem-budget`` checker re-evaluates
+    (the paged analogue of :func:`decode_tile_bytes`):
+
+    - K and V page tiles [1, page_size, kb, H] at the cache itemsize
+      (trailing dims (kb, H), same padding story as the slab tile);
+    - optional K/V scale tiles [1, kb, page_size] f32 (page_size is the
+      LANE dim — hence :func:`lane_aligned_page`);
+    - NO mask tile: validity is computed in-kernel from the prefetched
+      per-slot lengths, so the paged path streams no mask at all.
+    """
+    kv = 2 * padded_block_bytes((1, page_size, kb, H), kv_itemsize)
+    scale_b = (
+        2 * padded_block_bytes((1, kb, page_size), 4) if with_scales else 0
+    )
+    return DOUBLE_BUFFER * (kv + scale_b)
+
+
 def decode_tile_bytes(
     sb: int,
     kb: int,

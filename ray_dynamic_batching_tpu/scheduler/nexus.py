@@ -104,6 +104,29 @@ class SquishyBinPacker:
         )
         self.slo_safety = cfg.slo_safety_factor
         self.compute_fraction = cfg.slo_compute_fraction
+        # Turn-cost pricing (ISSUE 7): "batch" charges every duty-cycle
+        # slice the FULL bucket latency regardless of expected fill —
+        # correct for slab/shape-bucketed decode, where a 3-request turn
+        # in a 16-slot program costs the whole step. "slot" prices the
+        # slice at its expected fill (continuous batching on the paged
+        # pool: turn cost ~ floor + (1 - floor) * fill), so residue
+        # merges pack partially-full decode turns instead of whole-batch
+        # steps. SLO admission stays worst-case (a full turn can still
+        # happen); only CAPACITY pricing changes. Default "batch" — the
+        # sim pins it per scenario; live control opts in with the paged
+        # engines.
+        self.occupancy_pricing = "batch"
+        self.occupancy_floor = 0.35
+
+    def _turn_cost_ms(self, wl: float, fill: float) -> float:
+        """Expected cost of one duty-cycle turn at ``fill`` (0..1] of the
+        bucket: the fill-invariant floor is the weight stream every
+        decode turn pays, the proportional part the per-slot KV scan."""
+        if self.occupancy_pricing != "slot":
+            return wl
+        fill = min(max(fill, 0.0), 1.0)
+        return wl * (self.occupancy_floor
+                     + (1.0 - self.occupancy_floor) * fill)
 
     # --- admissible batch selection (ref nexus.py:145-165) ----------------
     def _effective_slo(self, session: Session) -> float:
@@ -196,13 +219,17 @@ class SquishyBinPacker:
             # instead: bound the cycle by the SLO headroom so wait-one-
             # cycle + compute still fits. Costs occupancy, holds the SLO.
             duty = max(min(duty, slo - wl), wl)
+        # Expected fill of one cycle's turn at this duty: under-filled
+        # cycles (the not-feasible branch above) cost less than a full
+        # step under slot pricing.
+        fill = duty * rate / 1000.0 / chosen.batch_size
         return NodePlan(
             placements=[
                 Placement(
                     session=session,
                     batch_size=chosen.batch_size,
                     latency_ms=wl,
-                    occupancy=min(wl / duty, 1.0),
+                    occupancy=min(self._turn_cost_ms(wl, fill) / duty, 1.0),
                     hbm_bytes=chosen.hbm_bytes,
                 )
             ],
@@ -234,7 +261,11 @@ class SquishyBinPacker:
             wl = worst_latency_ms(row)
             if wl + duty > self._effective_slo(s):
                 return None  # wait-one-cycle + compute would blow the SLO
-            occ = wl / duty
+            # Capacity pricing at the EXPECTED turn fill (need requests
+            # arrive per cycle; the bucket rounded up past it): slab
+            # pricing charges the full step, slot pricing the fill-scaled
+            # turn — the packing lever continuous batching unlocks.
+            occ = self._turn_cost_ms(wl, need / row.batch_size) / duty
             occ_total += occ
             hbm_total += row.hbm_bytes
             if occ_total > 1.0 + 1e-9 or hbm_total > self.hbm_budget:

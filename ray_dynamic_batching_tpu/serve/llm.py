@@ -300,6 +300,9 @@ class LLMDeployment:
         profiles_dir: Optional[str] = None,
         token_slo_ms: Optional[float] = None,
         ttft_slo_ms: Optional[float] = None,
+        paged: bool = False,
+        page_size: int = 128,
+        kv_pool_pages: Optional[int] = None,
     ) -> None:
         self.model_name = model_name
         self.num_slots = num_slots
@@ -349,6 +352,19 @@ class LLMDeployment:
         # additionally requires the dequant fused into the attention
         # read (kernel path) — see KVCache.
         self.quantize_kv = quantize_kv
+        # Paged KV pool (ISSUE 7): per-engine free-list pages replace the
+        # per-slot slabs — HBM occupancy follows cached tokens, admission
+        # waits on pages not slabs, prefix/session reuse is by reference
+        # (CoW). Incompatible with draft models (raised here) and TP
+        # meshes (raised loudly at engine build).
+        self.paged = bool(paged)
+        self.page_size = int(page_size)
+        self.kv_pool_pages = kv_pool_pages
+        if self.paged and draft_model_name is not None:
+            raise ValueError(
+                "paged=True with a draft model is not supported "
+                "(speculative decoding runs on the slab path)"
+            )
         self._dtype = dtype
         self._model = model
         self._params = params
@@ -700,6 +716,17 @@ class LLMDeployment:
         if prompt_buckets is not None:
             fitting = [b for b in prompt_buckets if b <= max_len]
             prompt_buckets = fitting or [max_len]
+        if self.paged and mesh is not None:
+            # Loud, like the draft-model conflict: silently serving the
+            # slab path under a paged=True deployment would mislabel
+            # every measurement stamped from the deployment config
+            # (e.g. a bench A/B arm).
+            raise ValueError(
+                f"{self.model_name}: paged=True is not supported on "
+                "multi-chip (TP) replicas yet — drop chips_per_replica "
+                "or the paged flag (sharded page pools are ROADMAP "
+                "item 2 territory)"
+            )
         return DecodeEngine(
             self._model,
             self._params,
@@ -720,6 +747,9 @@ class LLMDeployment:
             quantize_weights=self.quantize_weights,
             device=device,
             mesh=mesh,
+            paged=self.paged,
+            page_size=self.page_size,
+            kv_pool_pages=self.kv_pool_pages,
         )
 
     # Controller protocol: factories exposing make_replica own replica

@@ -56,7 +56,14 @@ class SimEngine:
         clock: VirtualClock,
         idle_wait_ms: float = 10.0,
         jitter_rng: Optional[random.Random] = None,
+        occupancy_model: str = "batch",
+        occupancy_floor: float = 0.35,
     ) -> None:
+        if occupancy_model not in ("batch", "slot"):
+            raise ValueError(
+                f"unknown occupancy_model {occupancy_model!r} "
+                "(want 'batch' or 'slot')"
+            )
         self.engine_id = engine_id
         self.queues = queues
         self.profiles = profiles
@@ -64,6 +71,18 @@ class SimEngine:
         self.clock = clock
         self.idle_wait_ms = idle_wait_ms
         self.jitter_rng = jitter_rng  # None = exact mean latencies
+        # Decode cost model (ISSUE 7): "batch" prices every pop at the
+        # profile row regardless of fill — the slab/shape-bucketed story,
+        # where a 3-request pop in a 16-slot bucket pays the full step.
+        # "slot" prices a partially-full pop at
+        #   row_latency * (floor + (1 - floor) * fill)
+        # — the paged/continuous-batching story: the floor is the
+        # fill-invariant share (the weight stream a decode step pays no
+        # matter how many slots are live), the proportional part the
+        # per-slot KV traffic. Occupancy is ACCOUNTED in both modes (the
+        # report's slot_occupancy) so slab-vs-paged what-ifs compare it.
+        self.occupancy_model = occupancy_model
+        self.occupancy_floor = float(occupancy_floor)
         self._plan = NodePlan()
         self._pending: Optional[NodePlan] = None
         self._cycle_start_ms = 0.0
@@ -79,6 +98,11 @@ class SimEngine:
         self.requests = 0
         self.cycle_count = 0
         self.swap_count = 0
+        # Slot-occupancy accounting: filled vs offered slots over every
+        # EXECUTED batch (empty pops don't count — an idle engine is not
+        # a half-empty one).
+        self.slots_filled = 0
+        self.slots_offered = 0
 
     # --- scheduler-facing surface (duck-matches ReplicaEngine) -----------
     @property
@@ -167,6 +191,18 @@ class SimEngine:
         exec_ms = 0.0
         if batch:
             exec_ms = self._step_latency_ms(p)
+            fill = len(batch) / max(1, p.batch_size)
+            if self.occupancy_model == "slot":
+                # Continuous/paged pricing: a partially-full decode turn
+                # costs its fill-scaled share above the fixed floor —
+                # the batch-formation stall's cost (full-step pricing of
+                # near-empty batches) disappears.
+                exec_ms *= (
+                    self.occupancy_floor
+                    + (1.0 - self.occupancy_floor) * min(1.0, fill)
+                )
+            self.slots_filled += len(batch)
+            self.slots_offered += max(1, p.batch_size)
             queue.record_batch_completion(
                 batch, self.clock.now_ms() + exec_ms
             )
@@ -199,3 +235,12 @@ class SimEngine:
         """Measured busy fraction over the run (the live engine's
         ENGINE_OCCUPANCY gauge analogue, but measured not scheduled)."""
         return self.busy_ms / elapsed_ms if elapsed_ms > 0 else 0.0
+
+    def slot_occupancy(self) -> float:
+        """Filled fraction of offered batch slots over executed batches
+        (the live engine's ACTIVE_SLOTS / num_slots analogue): what share
+        of the decode turns' slot capacity carried real work. 1.0 when
+        the engine never ran a batch (an idle engine wastes nothing)."""
+        if self.slots_offered == 0:
+            return 1.0
+        return self.slots_filled / self.slots_offered

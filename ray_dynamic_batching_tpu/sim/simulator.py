@@ -173,6 +173,12 @@ class Scenario:
     hbm_plan_fraction: float = 0.9
     warm_start: bool = True          # initial manual rebalance at t=0
     latency_jitter: bool = False     # seeded gaussian around row means
+    # Decode cost model (sim/engine.py): "batch" = slab pricing (every
+    # pop costs the full profile row), "slot" = paged/continuous pricing
+    # (partially-full turns cost their fill-scaled share above the
+    # fill-invariant floor). Slot occupancy is reported in BOTH modes.
+    decode_occupancy_model: str = "batch"
+    occupancy_floor: float = 0.35
     # Injected engine deaths (chaos conformance): each kills one sim
     # engine at virtual time t; the monitor heals over survivors.
     failures: List[EngineFailure] = field(default_factory=list)
@@ -240,6 +246,10 @@ class Scenario:
             hbm_plan_fraction=float(d.get("hbm_plan_fraction", 0.9)),
             warm_start=bool(d.get("warm_start", True)),
             latency_jitter=bool(d.get("latency_jitter", False)),
+            decode_occupancy_model=str(
+                d.get("decode_occupancy_model", "batch")
+            ),
+            occupancy_floor=float(d.get("occupancy_floor", 0.35)),
             failures=[
                 EngineFailure.from_dict(f) for f in d.get("failures", [])
             ],
@@ -318,7 +328,9 @@ class Simulation:
         )
         engines = [
             SimEngine(f"chip{i}", queues, self.profiles, loop, clock,
-                      jitter_rng=jitter_rng)
+                      jitter_rng=jitter_rng,
+                      occupancy_model=sc.decode_occupancy_model,
+                      occupancy_floor=sc.occupancy_floor)
             for i in range(sc.n_engines)
         ]
         packer = SquishyBinPacker(
@@ -329,6 +341,11 @@ class Simulation:
         packer.hbm_budget = int(sc.hbm_budget_bytes * sc.hbm_plan_fraction)
         packer.slo_safety = sc.slo_safety_factor
         packer.compute_fraction = sc.slo_compute_fraction
+        # Slot pricing reaches BOTH halves of the what-if: the planner
+        # packs fill-priced turns, and the sim engines execute them at
+        # the same fill-scaled cost — plan and timeline stay consistent.
+        packer.occupancy_pricing = sc.decode_occupancy_model
+        packer.occupancy_floor = sc.occupancy_floor
         sched = SimScheduler(
             packer, engines, queues, loop, clock,
             monitoring_interval_s=sc.monitoring_interval_s,
@@ -484,6 +501,7 @@ class Simulation:
             chips[e.engine_id] = {
                 "busy_ms": e.busy_ms,
                 "occupancy": e.occupancy(elapsed_ms),
+                "slot_occupancy": e.slot_occupancy(),
                 "batches": e.batches,
                 "requests": e.requests,
                 "cycles": e.cycle_count,
@@ -504,6 +522,7 @@ class Simulation:
             "drain_s": sc.drain_s,
             "n_engines": sc.n_engines,
             "rate_scale": sc.rate_scale,
+            "decode_occupancy_model": sc.decode_occupancy_model,
             "events": events,
             "arrivals_total": len(arrivals),
             "arrivals_truncated_past_horizon": truncated,

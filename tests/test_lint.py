@@ -189,6 +189,96 @@ class TestVmemBudget:
 
 # --- tile-alignment -------------------------------------------------------
 
+GRID_SPEC_OVER_BUDGET = """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S = 1024
+    KB = 16
+    H = 64
+
+    def call(kernel, pt, lens, args):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(1, 1, 1),
+            in_specs=[
+                pl.BlockSpec((1, S, KB, H), lambda b, j, s, pt, ln: (0, 0, 0, 0)),
+                pl.BlockSpec((1, S, KB, H), lambda b, j, s, pt, ln: (0, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, KB, 8, H), lambda b, j, s, pt, ln: (0, 0, 0, 0)
+            ),
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+        )(pt, lens, *args)
+"""
+
+
+class TestGridSpecCollection:
+    def test_over_budget_inside_grid_spec_is_flagged(self, tmp_path):
+        # ISSUE 7: moving the BlockSpecs into a PrefetchScalarGridSpec
+        # (the page-table kernel's form) must not exempt a kernel from
+        # the budget — the checker resolves page-indexed specs through
+        # the grid_spec kwarg, inline or Name-bound.
+        report = lint_fixture(tmp_path, "ops/paged.py",
+                              GRID_SPEC_OVER_BUDGET,
+                              rules=["vmem-budget"])
+        assert rules_found(report) == ["vmem-budget"]
+
+    def test_unresolvable_grid_spec_without_guard_is_flagged(
+            self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/paged_dyn.py", """
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def call(kernel, ps, kb, h, args):
+                return pl.pallas_call(
+                    kernel,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1,
+                        grid=(1, 1, 1),
+                        in_specs=[
+                            pl.BlockSpec((1, ps, kb, h),
+                                         lambda b, j, s, pt: (0, 0, 0, 0)),
+                        ],
+                        out_specs=pl.BlockSpec(
+                            (1, kb, 8, h), lambda b, j, s, pt: (0, 0, 0, 0)
+                        ),
+                    ),
+                )(*args)
+        """, rules=["vmem-budget"])
+        assert rules_found(report) == ["vmem-budget"]
+        assert "tile_math" in report.new[0].message
+
+    def test_guarded_grid_spec_is_trusted(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/paged_ok.py", """
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+            from ray_dynamic_batching_tpu.ops import tile_math
+
+            def call(kernel, ps, kb, h, args):
+                assert tile_math.paged_tile_bytes(ps, kb, h, 4) \\
+                    <= tile_math.VMEM_BLOCK_BUDGET_BYTES
+                return pl.pallas_call(
+                    kernel,
+                    grid_spec=pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=1,
+                        grid=(1, 1, 1),
+                        in_specs=[
+                            pl.BlockSpec((1, ps, kb, h),
+                                         lambda b, j, s, pt: (0, 0, 0, 0)),
+                        ],
+                        out_specs=pl.BlockSpec(
+                            (1, kb, 8, h), lambda b, j, s, pt: (0, 0, 0, 0)
+                        ),
+                    ),
+                )(*args)
+        """, rules=["vmem-budget"])
+        assert rules_found(report) == []
+
+
 class TestTileAlignment:
     def test_lane_dim_one_flags_the_128x_blowup(self, tmp_path):
         # The documented (kb, 1) trailing-dims case from
@@ -851,6 +941,46 @@ class TestSharedTileMath:
         assert lm.VMEM_BLOCK_BUDGET_BYTES == tm.VMEM_BLOCK_BUDGET_BYTES
         assert lm.decode_tile_bytes(1024, 16, 64, 2, True) == \
             tm.decode_tile_bytes(1024, 16, 64, 2, True)
+
+    def test_paged_model_agreement_pin(self):
+        # ISSUE 7: the page-table kernel budgets pages with
+        # paged_tile_bytes; the standalone-loaded lint copy must be the
+        # SAME model (runtime picker <-> linter agreement, the PR-2
+        # discipline applied to the paged path).
+        lm = tile_math_module()
+        for ps in (128, 256):
+            for kb in (4, 8, 16):
+                for H in (64, 128):
+                    for itemsize in (1, 2, 4):
+                        for ws in (False, True):
+                            assert lm.paged_tile_bytes(
+                                ps, kb, H, itemsize, with_scales=ws
+                            ) == tm.paged_tile_bytes(
+                                ps, kb, H, itemsize, with_scales=ws
+                            )
+        # A page is one KV tile without the mask: the two models must
+        # coincide where they describe the same bytes.
+        assert tm.paged_tile_bytes(128, 8, 64, 2, with_scales=True) == \
+            tm.decode_tile_bytes(128, 8, 64, 2, False, with_scales=True)
+        assert lm.lane_aligned_page(128) and not lm.lane_aligned_page(100)
+
+    def test_paged_runtime_guard_declines_fat_pages(self):
+        # The runtime eligibility check is the same budget the linter
+        # re-evaluates: a geometry whose single-page footprint busts
+        # VMEM must make the kernel DECLINE (gather fallback), not lower.
+        import jax.numpy as jnp
+        import numpy as np
+
+        H = 4096  # (1, 128, 8, 4096) f32 double-buffered >> 15 MB
+        assert tm.paged_tile_bytes(128, 8, H, 4) \
+            > tm.VMEM_BLOCK_BUDGET_BYTES
+        q = jnp.zeros((1, 1, 8, H), jnp.float32)
+        k = jnp.zeros((4, 128, 8, H), jnp.float32)
+        pt = jnp.zeros((1, 2), jnp.int32)
+        lens = jnp.asarray(np.asarray([5]), jnp.int32)
+        assert da.paged_decode_attention(
+            q, k, k, pt, lens, interpret=True
+        ) is None
 
     def test_f32_is_worst_case_itemsize(self):
         # The vmem-budget checker evaluates at itemsize 4; pin that this

@@ -1,0 +1,510 @@
+"""Paged KV decode vs the slab path — token-exactness + pool behavior.
+
+The paged pool's contract is byte-identical tokens: the same logical KV
+positions land in pages instead of a slab row, the same decode-mask
+window bounds attention, the same dequant rule reads int8 codes — so a
+seeded workload must produce EXACTLY the slab path's tokens, f32 and
+int8-KV, through the XLA gather fallback AND through the
+CPU-interpreted Pallas page-table kernel (ISSUE 7 acceptance; tier-1).
+
+The tiny-model engine tests here stay un-marked (tier-1): llama_tiny
+compiles in seconds and the paged plane is exactly the code the rest of
+the PR stands on. The chunked/long-prompt CoW paths ride the `slow`
+mark with the rest of the compile-heavy decode suites.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+from ray_dynamic_batching_tpu.models.base import get_model
+from ray_dynamic_batching_tpu.models.decoder import decode_mask, dequantize_kv
+from ray_dynamic_batching_tpu.ops import decode_attention as da
+from ray_dynamic_batching_tpu.ops.attention import (
+    _xla_attention,
+    set_attention_backend,
+)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model("llama_tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm_int8(lm):
+    model = get_model("llama_tiny_int8kv", dtype=jnp.float32)
+    # Same weights as the f32 fixture: only the cache dtype differs, so
+    # slab-vs-paged comparisons isolate the paging change.
+    return model, lm[1]
+
+
+def _workload(queue, model_name, seed=7, n=6, sampled_row=True):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        plen = int(rng.integers(3, 30))
+        payload = {
+            "tokens": rng.integers(1, 500, plen).tolist(),
+            "max_new_tokens": int(rng.integers(4, 12)),
+        }
+        if sampled_row and i == n - 1:
+            # One sampled row keeps the per-request sampler (seeded) on
+            # the exactness contract too — not just greedy argmax.
+            payload.update(temperature=0.8, top_k=16, seed=123)
+        req = Request(model=model_name, payload=payload, slo_ms=60_000.0)
+        queue.add_request(req)
+        reqs.append(req)
+    return reqs
+
+
+def _run(model, params, paged, **kw):
+    queue = RequestQueue(model.name, max_len=256)
+    defaults = dict(
+        num_slots=4, max_len=64, prompt_buckets=[8, 16], eos_token_id=None,
+        default_max_new_tokens=8, decode_horizon=4,
+        paged=paged, page_size=128,
+    )
+    defaults.update(kw)
+    engine = DecodeEngine(model, params, queue, **defaults)
+    reqs = _workload(queue, model.name)
+    engine.run_until_idle(timeout_s=180)
+    tokens = [tuple(r.future.result(timeout=5).tokens) for r in reqs]
+    return tokens, engine
+
+
+class TestTokenExactness:
+    def test_paged_matches_slab_f32(self, lm):
+        model, params = lm
+        slab, _ = _run(model, params, paged=False)
+        paged, engine = _run(model, params, paged=True)
+        assert slab == paged
+        # Drained engine: every page either free or pinned by a cache
+        # (none configured here -> all free), invariants intact.
+        engine._allocator.check()
+        assert engine._allocator.free_pages == engine.num_pages
+
+    def test_paged_matches_slab_int8_kv(self, lm_int8):
+        model, params = lm_int8
+        slab, _ = _run(model, params, paged=False)
+        paged, _ = _run(model, params, paged=True)
+        assert slab == paged
+
+    def test_paged_pallas_kernel_matches_slab(self, lm):
+        """The page-table Pallas kernel (CPU interpret mode) must emit
+        the same tokens as the slab path — the fused gather is a pure
+        layout change."""
+        model, params = lm
+        set_attention_backend("pallas")
+        try:
+            paged, _ = _run(model, params, paged=True)
+        finally:
+            set_attention_backend("auto")
+        slab, _ = _run(model, params, paged=False)
+        assert slab == paged
+
+
+class TestPagedKernel:
+    def _pool(self, dtype, seed=0):
+        rng = np.random.default_rng(seed)
+        B, N, K, H, P, ps, NP = 3, 8, 4, 32, 10, 128, 2
+        q = jnp.asarray(rng.standard_normal((B, 1, N, H)), jnp.float32)
+        if dtype == jnp.int8:
+            k = jnp.asarray(rng.integers(-127, 127, (P, ps, K, H)), jnp.int8)
+            v = jnp.asarray(rng.integers(-127, 127, (P, ps, K, H)), jnp.int8)
+            ks = jnp.asarray(rng.uniform(0.01, 0.1, (P, ps, K)), jnp.float32)
+            vs = jnp.asarray(rng.uniform(0.01, 0.1, (P, ps, K)), jnp.float32)
+        else:
+            k = jnp.asarray(rng.standard_normal((P, ps, K, H)), jnp.float32)
+            v = jnp.asarray(rng.standard_normal((P, ps, K, H)), jnp.float32)
+            ks = vs = None
+        # Slot 1 has one allocated page (sentinel tail), slot 2 a short
+        # window — exercises clamping + in-kernel length masking.
+        pt = jnp.asarray([[3, 7], [1, P], [5, 0]], jnp.int32)
+        lens = jnp.asarray([200, 100, 37], jnp.int32)
+        return q, k, v, ks, vs, pt, lens, (B, NP, ps, K, H, P)
+
+    def _gather_ref(self, q, k, v, ks, vs, pt, lens, dims):
+        B, NP, ps, K, H, P = dims
+        safe = jnp.minimum(pt, P - 1)
+        kg = k[safe].reshape(B, NP * ps, K, H)
+        vg = v[safe].reshape(B, NP * ps, K, H)
+        if ks is not None:
+            kg = dequantize_kv(
+                kg, ks[safe].reshape(B, NP * ps, K), jnp.float32)
+            vg = dequantize_kv(
+                vg, vs[safe].reshape(B, NP * ps, K), jnp.float32)
+        return _xla_attention(
+            q, kg, vg, causal=False, mask=decode_mask(lens, NP * ps),
+            scale=None,
+        )
+
+    def test_kernel_matches_gather_f32(self):
+        q, k, v, ks, vs, pt, lens, dims = self._pool(jnp.float32)
+        out = da.paged_decode_attention(q, k, v, pt, lens, interpret=True)
+        assert out is not None
+        ref = self._gather_ref(q, k, v, ks, vs, pt, lens, dims)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-3, rtol=1e-3
+        )
+
+    def test_kernel_matches_gather_int8(self):
+        q, k, v, ks, vs, pt, lens, dims = self._pool(jnp.int8)
+        out = da.paged_decode_attention(
+            q, k, v, pt, lens, k_scale=ks, v_scale=vs, interpret=True
+        )
+        assert out is not None
+        ref = self._gather_ref(q, k, v, ks, vs, pt, lens, dims)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-2, rtol=1e-2
+        )
+
+    def test_kernel_declines_unaligned_page(self):
+        q, k, v, _ks, _vs, pt, lens, _ = self._pool(jnp.float32)
+        # 100-position pages are not lane-aligned: decline, don't lower.
+        assert da.paged_decode_attention(
+            q, k[:, :100], v[:, :100], pt, lens, interpret=True
+        ) is None
+
+    def test_kernel_declines_wide_window(self):
+        q, k, v, _ks, _vs, pt, lens, _ = self._pool(jnp.float32)
+        q2 = jnp.concatenate([q, q], axis=1)  # Tq == 2: not paged decode
+        assert da.paged_decode_attention(
+            q2, k, v, pt, lens, interpret=True
+        ) is None
+
+
+class TestPoolBehavior:
+    def test_kv_occupancy_paged_beats_slab(self, lm):
+        """The decode slot-occupancy criterion, measured at the engine:
+        mid-stream, the paged pool's reserved KV (allocated pages) holds
+        a higher useful fraction than the slab reservation
+        (num_slots x max_len) on the SAME traffic."""
+        model, params = lm
+        occ = {}
+        for paged in (False, True):
+            queue = RequestQueue(model.name, max_len=256)
+            # max_len must exceed the page size for pages to be the
+            # FINER reservation (the realistic serving geometry: slabs
+            # of 256+ positions vs 128-position pages).
+            engine = DecodeEngine(
+                model, params, queue, num_slots=4, max_len=256,
+                prompt_buckets=[8, 16], eos_token_id=None,
+                default_max_new_tokens=32, decode_horizon=2,
+                paged=paged, page_size=128,
+            )
+            rng = np.random.default_rng(11)
+            reqs = []
+            for _ in range(3):  # 3 of 4 slots live: slabs idle, pages don't
+                r = Request(model=model.name, payload={
+                    "tokens": rng.integers(1, 500, 6).tolist(),
+                    "max_new_tokens": 32,
+                }, slo_ms=60_000.0)
+                queue.add_request(r)
+                reqs.append(r)
+            engine._admit()
+            for _ in range(4):
+                engine._step(horizon=1)
+            occ[paged] = engine.kv_occupancy()
+            engine.run_until_idle(timeout_s=120)
+            for r in reqs:
+                r.future.result(timeout=5)
+        assert occ[True] > occ[False]
+        assert occ[True] >= 0.05  # useful fraction of one 128-page/slot
+
+    def test_eos_frees_pages_mid_cycle(self, lm):
+        """A finished stream's pages return to the free list inside the
+        harvest (before the next admission), not at drain."""
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=64,
+            prompt_buckets=[8], eos_token_id=None,
+            default_max_new_tokens=3, decode_horizon=1,
+            paged=True, page_size=128,
+        )
+        r = Request(model=model.name, payload={
+            "tokens": [1, 2, 3], "max_new_tokens": 3,
+        }, slo_ms=60_000.0)
+        queue.add_request(r)
+        engine._admit()
+        assert engine._allocator.allocated_pages == 1
+        while not engine._slots[0].free:
+            engine._step(horizon=1)
+        # The finish happened inside _step's harvest; pages already free.
+        assert engine._allocator.allocated_pages == 0
+        assert r.future.result(timeout=5).finish_reason == "length"
+
+    def test_page_starved_admission_requeues_and_drains(self, lm):
+        """An over-subscribed pool (3 pages for 4 slots' worth of
+        demand) admits what fits, requeues the rest, and drains as EOS
+        frees pages — nobody is dropped, conservation holds."""
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=4, max_len=192,
+            prompt_buckets=[8, 16], eos_token_id=None,
+            default_max_new_tokens=5, decode_horizon=2,
+            paged=True, page_size=128, kv_pool_pages=3,
+        )
+        rng = np.random.default_rng(5)
+        reqs = []
+        for _ in range(5):
+            r = Request(model=model.name, payload={
+                "tokens": rng.integers(1, 500, 10).tolist(),
+                "max_new_tokens": 5,
+            }, slo_ms=60_000.0)
+            queue.add_request(r)
+            reqs.append(r)
+        engine.run_until_idle(timeout_s=120)
+        results = [r.future.result(timeout=5) for r in reqs]
+        assert all(len(x.tokens) == 5 for x in results)
+        engine._allocator.check()
+        assert engine._allocator.free_pages == 3
+
+    def test_cache_pins_shed_under_pool_pressure(self, lm):
+        """Review regression: a pool pinned by session-store entries
+        must shed those pins to admit new work — not requeue-spin while
+        capacity-finishing live streams. 2-page pool, 6 session-tagged
+        requests: every finish pins a page; without LRU pin reclaim the
+        3rd admission starves forever."""
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=128,
+            prompt_buckets=[8], eos_token_id=None,
+            default_max_new_tokens=4, decode_horizon=1,
+            paged=True, page_size=128, kv_pool_pages=2,
+            session_cache_size=8,
+        )
+        reqs = []
+        for i in range(6):
+            r = Request(model=model.name, payload={
+                "tokens": [1 + i, 2, 3], "max_new_tokens": 4,
+                "session_id": f"sess{i}",
+            }, slo_ms=60_000.0)
+            queue.add_request(r)
+            reqs.append(r)
+        engine.run_until_idle(timeout_s=120)
+        results = [r.future.result(timeout=5) for r in reqs]
+        assert all(x.finish_reason == "length" and len(x.tokens) == 4
+                   for x in results)
+        engine._allocator.check()
+
+    def test_session_reservation_covers_only_the_tail(self, lm):
+        """Review regression: a continuation whose history is cached
+        must not demand the whole prompt's worth of free pages — with
+        the history's page shared, a 1-page-free pool still admits."""
+        from ray_dynamic_batching_tpu.engine.decode import SESSION_HITS
+
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=256,
+            prompt_buckets=[8, 16], eos_token_id=None,
+            default_max_new_tokens=3, decode_horizon=1,
+            paged=True, page_size=128, kv_pool_pages=2,
+            session_cache_size=4,
+        )
+        # Turn 1: grows past one page (126 prompt + 3 generated = 129).
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(1, 500, 126).tolist()
+        r1 = Request(model=model.name, payload={
+            "tokens": prompt, "max_new_tokens": 3, "session_id": "t",
+        }, slo_ms=60_000.0)
+        queue.add_request(r1)
+        engine.run_until_idle(timeout_s=180)
+        t1 = r1.future.result(timeout=5).tokens
+        # Stored turn (128-token history) pins one page; 1 page free.
+        # Turn 2's prompt is 131 tokens (pages_for(132) = 2 total) but
+        # shares the stored full page — the single free page suffices
+        # iff the reservation covers only the non-shared tail.
+        assert engine._allocator.free_pages == 1
+        before = SESSION_HITS.get(tags={"model": model.name})
+        r2 = Request(model=model.name, payload={
+            "tokens": prompt + t1 + [9, 8], "max_new_tokens": 3,
+            "session_id": "t",
+        }, slo_ms=60_000.0)
+        queue.add_request(r2)
+        engine.run_until_idle(timeout_s=180)
+        assert len(r2.future.result(timeout=5).tokens) == 3
+        # The HIT path served it (a full-size reservation would have
+        # starved, shed the pin, and re-admitted as a miss).
+        assert SESSION_HITS.get(tags={"model": model.name}) == before + 1
+        engine._allocator.check()
+
+    def test_paged_rejects_draft_and_mesh(self, lm):
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=16)
+        with pytest.raises(ValueError, match="speculative"):
+            DecodeEngine(model, params, queue, paged=True,
+                         draft_model=model, draft_params=params)
+        with pytest.raises(ValueError, match="128-lane"):
+            DecodeEngine(model, params, queue, paged=True, page_size=100)
+        with pytest.raises(ValueError, match="cannot back"):
+            DecodeEngine(model, params, queue, max_len=256, paged=True,
+                         page_size=128, kv_pool_pages=1)
+
+
+@pytest.mark.slow  # full serving stack build
+class TestPagedServing:
+    def test_llm_deployment_paged_roundtrip(self, lm):
+        """serve/llm.py wiring: paged/page_size/kv_pool_pages reach the
+        engine, and a request round-trips through replica + router."""
+        from ray_dynamic_batching_tpu.serve.controller import (
+            DeploymentConfig,
+        )
+        from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+        from ray_dynamic_batching_tpu.serve.router import Router
+
+        model, params = lm
+        dep = LLMDeployment(
+            "llama_tiny", model=model, params=params, num_slots=4,
+            max_len=128, prompt_buckets=[16], warmup=False,
+            paged=True, page_size=128,
+        )
+        replica = dep.make_replica(
+            "llama_tiny#p", DeploymentConfig(name="llama_tiny"))
+        replica.start()
+        try:
+            assert replica.engine.paged
+            assert replica.engine.page_size == 128
+            router = Router("llama_tiny", replicas=[replica])
+            handle = DeploymentHandle(router, default_slo_ms=60_000.0)
+            out = handle.remote(
+                {"tokens": [3, 1, 4, 1, 5], "max_new_tokens": 4}
+            ).result(timeout=60)
+            assert len(out.tokens) == 4
+        finally:
+            replica.stop(timeout_s=2.0, drain=False)
+
+    def test_paged_with_draft_raises_at_deployment(self):
+        from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
+
+        with pytest.raises(ValueError, match="paged"):
+            LLMDeployment("llama_tiny", paged=True,
+                          draft_model_name="llama_tiny")
+
+
+@pytest.mark.slow  # chunked-prefill paths compile several extra programs
+class TestPagedCoW:
+    """Copy-on-write sharing through the chunked admission paths: paged
+    prefix (longest shared page-prefix, by reference) and session
+    continuation (O(1) store pinning the finished turn's pages) must
+    stay token-exact vs the slab equivalents AND leave the allocator
+    conserved with only cache pins outstanding."""
+
+    def _engines(self, lm, paged, model=None, params=None):
+        model_, params_ = lm
+        model = model or model_
+        params = params if params is not None else params_
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=4, max_len=192,
+            prompt_buckets=[16, 32, 64, 128], eos_token_id=None,
+            default_max_new_tokens=6, decode_horizon=4,
+            paged=paged, page_size=128,
+            prefix_cache_size=8, session_cache_size=4,
+        )
+        return engine, queue
+
+    def _prompts(self):
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 500, n).tolist()
+                   for n in (5, 40, 150, 160, 150, 20)]
+        prompts[3][:128] = prompts[2][:128]  # shared 1-page prefix
+        prompts[4] = list(prompts[2])        # identical long prompt
+        return prompts
+
+    def _run(self, engine, queue, model_name, prompts):
+        reqs = []
+        for i, p in enumerate(prompts):
+            r = Request(model=model_name, payload={
+                "tokens": p, "max_new_tokens": 6,
+                "session_id": f"s{i % 2}" if i >= 4 else None,
+            }, slo_ms=60_000.0)
+            queue.add_request(r)
+            reqs.append(r)
+        engine.run_until_idle(timeout_s=300)
+        return [tuple(r.future.result(timeout=5).tokens) for r in reqs]
+
+    def test_long_prefix_session_exact_and_conserved(self, lm):
+        from ray_dynamic_batching_tpu.engine.decode import PREFIX_HITS
+
+        model, _ = lm
+        prompts = self._prompts()
+        e_slab, q_slab = self._engines(lm, paged=False)
+        slab = self._run(e_slab, q_slab, model.name, prompts)
+        before = PREFIX_HITS.get(
+            tags={"model": model.name, "granularity": "page"})
+        e_paged, q_paged = self._engines(lm, paged=True)
+        paged = self._run(e_paged, q_paged, model.name, prompts)
+        assert slab == paged
+        # The shared 128-token head actually shared: page-granular hits
+        # fired (prompts 3 and 4 reuse prompt 2's first page).
+        after = PREFIX_HITS.get(
+            tags={"model": model.name, "granularity": "page"})
+        assert after - before >= 2
+        # Conservation with live cache pins: every non-free page is
+        # pinned by the prefix/session caches, none by slots.
+        e_paged._allocator.check()
+        assert all(s.free for s in e_paged._slots)
+        pinned = e_paged._allocator.allocated_pages
+        assert pinned > 0  # caches hold the published prefixes/turns
+        e_paged.paged_prefix.clear()
+        e_paged.paged_sessions.clear()
+        assert e_paged._allocator.free_pages == e_paged.num_pages
+
+    def test_int8_long_paths_exact(self, lm):
+        model8 = get_model("llama_tiny_int8kv", dtype=jnp.float32)
+        params = lm[1]
+        prompts = self._prompts()
+        e_slab, q_slab = self._engines(lm, False, model8, params)
+        e_paged, q_paged = self._engines(lm, True, model8, params)
+        assert self._run(e_slab, q_slab, model8.name, prompts) == \
+            self._run(e_paged, q_paged, model8.name, prompts)
+
+    def test_session_store_is_by_reference(self, lm):
+        """A finished session turn pins the slot's pages instead of
+        copying a row: the stored entry's page ids are exactly the
+        pages the slot held."""
+        model, _ = lm
+        engine, queue = self._engines(lm, paged=True)
+        rng = np.random.default_rng(9)
+        prompt = rng.integers(1, 500, 140).tolist()
+        r = Request(model=model.name, payload={
+            "tokens": prompt, "max_new_tokens": 4, "session_id": "ref",
+        }, slo_ms=60_000.0)
+        queue.add_request(r)
+        engine.run_until_idle(timeout_s=300)
+        turn1 = r.future.result(timeout=5).tokens
+        # Turn 2 resends the whole conversation (prompt + assistant
+        # tokens) plus the new user message — the stored history must
+        # strictly prefix it.
+        turn2_prompt = prompt + turn1 + [7, 8, 9]
+        entry = engine.paged_sessions.lookup(
+            "ref", np.asarray(turn2_prompt, np.int32)
+        )
+        assert entry is not None
+        pages, stored_len = entry
+        assert stored_len == 140 + 4 - 1  # prompt + generated[:-1]
+        for p in pages:
+            assert engine._allocator.refcount[p] >= 1
+        # Turn 2 continues from the stored pages (session-hit path) and
+        # borrows the full page by reference.
+        r2 = Request(model=model.name, payload={
+            "tokens": turn2_prompt, "max_new_tokens": 4,
+            "session_id": "ref",
+        }, slo_ms=60_000.0)
+        queue.add_request(r2)
+        engine.run_until_idle(timeout_s=300)
+        assert len(r2.future.result(timeout=5).tokens) == 4
+        engine._allocator.check()

@@ -1,0 +1,242 @@
+"""Paged-KV bookkeeping invariants (pure host state — no jax).
+
+The allocator carries the whole paged design's safety story: a page
+must be exactly one of {free, held-by-N-owners}, conservation must hold
+after EVERY operation, and sharing (prefix/session CoW) must be
+impossible without a refcount that proves it. The property test drives
+a seeded 10k-op random sequence of admit/finish/share/evict against a
+shadow model and checks the allocator's own invariants at every step
+(ISSUE 7 acceptance). The evict-while-pinned regression pins the one
+bug class the refcounted LRU stores exist to prevent: an eviction
+freeing pages a live slot still reads.
+"""
+
+import numpy as np
+import pytest
+
+from ray_dynamic_batching_tpu.engine.paging import (
+    OutOfPages,
+    PageAllocator,
+    PagedPrefixCache,
+    PagedSessionCache,
+    table_array,
+)
+from ray_dynamic_batching_tpu.ops.tile_math import pages_for
+
+
+class TestPageAllocator:
+    def test_alloc_free_conservation(self):
+        a = PageAllocator(8)
+        pages = a.alloc(5)
+        assert len(pages) == len(set(pages)) == 5
+        assert a.free_pages == 3 and a.allocated_pages == 5
+        a.check()
+        freed = a.decref(pages)
+        assert sorted(freed) == sorted(pages)
+        assert a.free_pages == 8
+        a.check()
+
+    def test_alloc_is_all_or_nothing(self):
+        a = PageAllocator(4)
+        a.alloc(3)
+        with pytest.raises(OutOfPages):
+            a.alloc(2)
+        # The failed alloc must not have consumed the remaining page.
+        assert a.free_pages == 1
+        a.check()
+
+    def test_sharing_needs_refcounts(self):
+        a = PageAllocator(4)
+        pages = a.alloc(2)
+        a.incref(pages)  # second owner
+        assert a.decref(pages) == []  # first owner lets go: nothing freed
+        a.check()
+        assert sorted(a.decref(pages)) == sorted(pages)  # last owner frees
+        a.check()
+
+    def test_double_free_raises(self):
+        a = PageAllocator(2)
+        pages = a.alloc(1)
+        a.decref(pages)
+        with pytest.raises(ValueError):
+            a.decref(pages)
+
+    def test_incref_of_free_page_raises(self):
+        a = PageAllocator(2)
+        with pytest.raises(ValueError):
+            a.incref([0])
+
+    def test_random_10k_op_sequence_conserves(self):
+        """Seeded 10k random admit/finish/share/unshare ops against a
+        shadow owner model: after every op, free + allocated == pool,
+        refcounts match the shadow's owner counts exactly (so no page is
+        reachable from two owners without refcount >= 2), and nothing
+        ever goes negative."""
+        rng = np.random.default_rng(0)
+        a = PageAllocator(64)
+        owners = {}  # owner id -> list of pages (one ref each)
+        next_id = 0
+        for _ in range(10_000):
+            op = rng.integers(0, 4)
+            if op == 0:  # admit: allocate 1..8 pages for a new owner
+                n = int(rng.integers(1, 9))
+                try:
+                    owners[next_id] = a.alloc(n)
+                    next_id += 1
+                except OutOfPages:
+                    assert a.free_pages < n
+            elif op == 1 and owners:  # finish: drop one owner entirely
+                k = list(owners)[int(rng.integers(0, len(owners)))]
+                a.decref(owners.pop(k))
+            elif op == 2 and owners:  # share: new owner borrows a prefix
+                k = list(owners)[int(rng.integers(0, len(owners)))]
+                take = int(rng.integers(1, len(owners[k]) + 1))
+                borrowed = owners[k][:take]
+                a.incref(borrowed)
+                owners[next_id] = list(borrowed)
+                next_id += 1
+            elif op == 3 and owners:  # partial release (eviction)
+                k = list(owners)[int(rng.integers(0, len(owners)))]
+                take = int(rng.integers(1, len(owners[k]) + 1))
+                a.decref(owners[k][:take])
+                owners[k] = owners[k][take:]
+                if not owners[k]:
+                    del owners[k]
+            a.check()
+            # Shadow-model agreement: refcount == number of owner lists
+            # holding the page.
+            counts = {}
+            for pages in owners.values():
+                for p in pages:
+                    counts[p] = counts.get(p, 0) + 1
+            for p in range(a.num_pages):
+                assert a.refcount[p] == counts.get(p, 0)
+        for pages in owners.values():
+            a.decref(pages)
+        assert a.free_pages == a.num_pages
+        a.check()
+
+
+class TestPagedPrefixCache:
+    def _prompt(self, n, seed=0):
+        return np.random.default_rng(seed).integers(
+            1, 500, n
+        ).astype(np.int32)
+
+    def test_longest_shared_page_prefix(self):
+        a = PageAllocator(16)
+        cache = PagedPrefixCache(capacity=8, page_size=4, allocator=a)
+        prompt = self._prompt(11)
+        pages = a.alloc(3)  # covers ceil(11/4)
+        cache.insert(prompt, pages)  # publishes levels 1 (4 tok), 2 (8 tok)
+        # Identical head, divergent tail past page 1: longest shared
+        # page-prefix is ONE page, not byte-equality of the whole prompt.
+        other = prompt.copy()
+        other[6] += 1
+        hit = cache.lookup(np.concatenate([other, other[:4]]))
+        assert hit is not None
+        page_ids, shared_len = hit
+        assert shared_len == 4 and page_ids == [pages[0]]
+        # Full two-page match wins the longer level.
+        hit2 = cache.lookup(np.concatenate([prompt, prompt[:4]]))
+        assert hit2 == ([pages[0], pages[1]], 8)
+        # A hit must leave >= 1 token to prefill: an exactly-two-page
+        # prompt may only share one page.
+        hit3 = cache.lookup(prompt[:8])
+        assert hit3 == ([pages[0]], 4)
+
+    def test_insert_pins_and_evict_unpins(self):
+        a = PageAllocator(16)
+        cache = PagedPrefixCache(capacity=2, page_size=4, allocator=a)
+        p1, g1 = self._prompt(9, 1), None
+        pages1 = a.alloc(3)
+        cache.insert(p1, pages1)  # two levels -> cache at capacity
+        assert a.refcount[pages1[0]] == 3  # slot + 2 levels
+        a.decref(pages1)  # the admitting slot finishes
+        assert a.free_pages == 16 - 2  # page 2 freed; 0/1 pinned by cache
+        # A second insert evicts the LRU levels and frees their pins.
+        p2 = self._prompt(9, 2)
+        pages2 = a.alloc(3)
+        cache.insert(p2, pages2)
+        a.decref(pages2)
+        a.check()
+        assert cache.lookup(p1) is None  # evicted
+        assert cache.lookup(p2) is not None
+
+    def test_evict_while_pinned_regression(self):
+        """THE regression (ISSUE 7 satellite): evicting an entry whose
+        pages a live slot borrowed must drop only the cache's ref — the
+        borrower keeps reading valid pages, and the pages free only when
+        the borrower finishes. A buggy evict that force-freed would hand
+        the page to the next admission while still mapped."""
+        a = PageAllocator(16)
+        cache = PagedPrefixCache(capacity=1, page_size=4, allocator=a)
+        p1 = self._prompt(6, 3)
+        pages1 = a.alloc(2)
+        cache.insert(p1, pages1)
+        # A borrower slot takes the shared page (admission CoW borrow).
+        hit = cache.lookup(np.concatenate([p1[:4], p1[:3]]))
+        assert hit is not None
+        borrowed, _ = hit
+        a.incref(borrowed)
+        a.decref(pages1)  # original slot finishes
+        # Evict the entry while the borrower still holds the page.
+        p2 = self._prompt(6, 4)
+        pages2 = a.alloc(2)
+        cache.insert(p2, pages2)
+        assert cache.lookup(p1) is None
+        # Borrowed page survived the eviction (refcount 1, NOT free).
+        assert a.refcount[borrowed[0]] == 1
+        a.check()
+        # The borrower finishing is what frees it.
+        assert a.decref(borrowed) == borrowed
+        a.check()
+
+
+class TestPagedSessionCache:
+    def test_store_pins_lookup_prefix_rule(self):
+        a = PageAllocator(8)
+        cache = PagedSessionCache(capacity=2, page_size=4, allocator=a)
+        history = np.arange(1, 8, dtype=np.int32)  # 7 tokens -> 2 pages
+        pages = a.alloc(2)
+        cache.store("s1", pages, history)
+        assert a.refcount[pages[0]] == 2
+        a.decref(pages)  # finishing slot lets go; store's pin remains
+        assert a.free_pages == 6
+        # Strict-prefix rule: the next prompt must extend the history.
+        assert cache.lookup("s1", history) is None
+        nxt = np.concatenate([history, [9, 10]]).astype(np.int32)
+        got = cache.lookup("s1", nxt)
+        assert got == (list(pages), 7)
+        # Divergent history -> miss.
+        bad = nxt.copy()
+        bad[2] += 1
+        assert cache.lookup("s1", bad) is None
+
+    def test_restore_replaces_and_unpins_old_turn(self):
+        a = PageAllocator(8)
+        cache = PagedSessionCache(capacity=2, page_size=4, allocator=a)
+        h1 = np.arange(1, 5, dtype=np.int32)
+        pages1 = a.alloc(1)
+        cache.store("s", pages1, h1)
+        a.decref(pages1)
+        pages2 = a.alloc(2)
+        cache.store("s", pages2, np.arange(1, 9, dtype=np.int32))
+        a.decref(pages2)
+        a.check()
+        assert a.refcount[pages1[0]] == 0  # old turn's pin released
+        assert a.free_pages == 8 - 2
+
+
+def test_table_array_sentinel_fill():
+    row = table_array([5, 2, 9], 6, sentinel=64)
+    assert row.dtype == np.int32
+    assert row.tolist() == [5, 2, 9, 64, 64, 64]
+    assert table_array([1, 2, 3, 4], 2, sentinel=9).tolist() == [1, 2]
+
+
+def test_pages_for():
+    assert pages_for(0, 128) == 0
+    assert pages_for(1, 128) == 1
+    assert pages_for(128, 128) == 1
+    assert pages_for(129, 128) == 2

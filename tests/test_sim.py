@@ -368,6 +368,75 @@ class TestWorkloadSources:
         assert one_and_half == scale_arrivals(base, 1.5, seed=0)
 
 
+class TestOccupancyModel:
+    """ISSUE 7: slot (paged/continuous) turn pricing — the planner packs
+    fill-priced decode turns and the engines execute at the same
+    fill-scaled cost. Contract: deterministic, and never worse than the
+    slab (batch) pricing at equal traffic."""
+
+    def _run(self, kind):
+        import dataclasses
+
+        sc = dataclasses.replace(
+            smoke_scenario(), decode_occupancy_model=kind
+        )
+        return Simulation(fixture_profiles(), sc).run()
+
+    def test_slot_pricing_deterministic_and_no_worse(self):
+        batch = self._run("batch")
+        slot = self._run("slot")
+        slot2 = self._run("slot")
+        assert render_json(slot) == render_json(slot2)
+        for m in batch["models"]:
+            assert slot["models"][m]["slo_attainment"] \
+                >= batch["models"][m]["slo_attainment"] - 1e-9
+        done_b = sum(v["completed"] for v in batch["models"].values())
+        done_s = sum(v["completed"] for v in slot["models"].values())
+        assert done_s >= done_b
+        for chip in slot["chips"].values():
+            assert 0.0 <= chip["slot_occupancy"] <= 1.0
+
+    def test_batch_mode_canon_untouched(self):
+        # The default pricing must reproduce the PR-3 canon exactly:
+        # adding the knob cannot move a single historical number.
+        report = self._run("batch")
+        got = {m: round(v["slo_attainment"], 4)
+               for m, v in report["models"].items()}
+        assert got == {"fast": 0.9559, "burst": 0.8463, "fat": 1.0}
+        assert report["migrations"] == 5
+
+    def test_turn_cost_pricing_math(self):
+        packer = SquishyBinPacker(fixture_profiles())
+        wl = 100.0
+        assert packer._turn_cost_ms(wl, 0.5) == wl  # default: batch
+        packer.occupancy_pricing = "slot"
+        packer.occupancy_floor = 0.4
+        assert packer._turn_cost_ms(wl, 1.0) == wl
+        assert packer._turn_cost_ms(wl, 0.0) == 40.0
+        assert packer._turn_cost_ms(wl, 0.5) == 70.0
+        assert packer._turn_cost_ms(wl, 2.0) == wl  # clamped
+
+    def test_scenario_from_dict_knobs(self):
+        sc = Scenario.from_dict({
+            "models": [{"name": "fast", "slo_ms": 100.0,
+                        "rate_rps": 5.0}],
+            "decode_occupancy_model": "slot",
+            "occupancy_floor": 0.5,
+        })
+        assert sc.decode_occupancy_model == "slot"
+        assert sc.occupancy_floor == 0.5
+
+    def test_unknown_occupancy_model_rejected(self):
+        import pytest
+
+        from ray_dynamic_batching_tpu.sim.engine import SimEngine
+
+        clock = VirtualClock()
+        with pytest.raises(ValueError, match="occupancy_model"):
+            SimEngine("c0", None, {}, EventLoop(clock), clock,
+                      occupancy_model="paged")
+
+
 class TestRunSimCLI:
     def test_smoke_gate_passes(self, capsys):
         from tools.run_sim import main

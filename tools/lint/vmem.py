@@ -290,13 +290,43 @@ class VmemBudgetChecker(_BlockSpecMixin):
         """BlockSpec calls reachable from in_specs/out_specs kwargs:
         literal lists inline; a Name resolves through every list
         assignment/append/extend in the enclosing function (an
-        over-approximation — conservative for a budget)."""
+        over-approximation — conservative for a budget). A
+        ``grid_spec=`` kwarg (``PrefetchScalarGridSpec`` — the
+        page-table-indexed decode kernel's form — or a plain
+        ``GridSpec``) is transparent: its own in_specs/out_specs are
+        collected as if passed directly, so moving specs into a grid
+        spec cannot silently exempt a kernel from the budget."""
         specs: List[ast.Call] = []
         for kw in call.keywords:
-            if kw.arg not in ("in_specs", "out_specs"):
-                continue
-            specs.extend(self._specs_from(kw.value, scope))
+            if kw.arg in ("in_specs", "out_specs"):
+                specs.extend(self._specs_from(kw.value, scope))
+            elif kw.arg == "grid_spec":
+                specs.extend(self._specs_from_grid_spec(kw.value, scope))
         return specs
+
+    def _specs_from_grid_spec(self, node: ast.AST, scope: Scope
+                              ) -> List[ast.Call]:
+        """in_specs/out_specs inside a grid-spec constructor call — the
+        call may be inline or reached through a Name bound in the
+        enclosing function (same over-approximation as _specs_from)."""
+        out: List[ast.Call] = []
+        calls: List[ast.Call] = []
+        if isinstance(node, ast.Call):
+            calls.append(node)
+        elif isinstance(node, ast.Name):
+            fn = scope.current_function()
+            if fn is not None:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == node.id
+                        for t in sub.targets
+                    ) and isinstance(sub.value, ast.Call):
+                        calls.append(sub.value)
+        for c in calls:
+            for kw in c.keywords:
+                if kw.arg in ("in_specs", "out_specs"):
+                    out.extend(self._specs_from(kw.value, scope))
+        return out
 
     def _specs_from(self, node: ast.AST, scope: Scope,
                     seen: Optional[set] = None) -> List[ast.Call]:

@@ -109,7 +109,13 @@ def _scenario_from_file(path: str):
 
 
 def _run_smoke(out_path=None) -> int:
-    """The CI gate: fixture scenario twice -> identical bytes + floors."""
+    """The CI gate: fixture scenario twice -> identical bytes + floors,
+    plus the occupancy-model entry — the SAME scenario re-planned and
+    re-executed under slot (paged/continuous) turn pricing must be
+    deterministic and at-least-as-good per model as the slab (batch)
+    canon, so the new cost model cannot silently regress attainment."""
+    import dataclasses
+
     from ray_dynamic_batching_tpu.sim import Simulation, render_json
     from ray_dynamic_batching_tpu.sim.scenarios import (
         fixture_profiles,
@@ -140,6 +146,54 @@ def _run_smoke(out_path=None) -> int:
             f"chips_used {report['chips_used']} < "
             f"{ratchet['floors']['min_chips_used']}"
         )
+
+    # --- occupancy-model entry (ISSUE 7) -------------------------------
+    def slot_scenario():
+        return dataclasses.replace(
+            smoke_scenario(), decode_occupancy_model="slot"
+        )
+
+    occ_cfg = ratchet["floors"].get("occupancy", {})
+    slot_text1 = render_json(
+        Simulation(fixture_profiles(), slot_scenario()).run()
+    )
+    slot_text2 = render_json(
+        Simulation(fixture_profiles(), slot_scenario()).run()
+    )
+    if slot_text1 != slot_text2:
+        failures.append(
+            "NONDETERMINISM: two same-seed slot-priced runs differ"
+        )
+    slot_report = json.loads(slot_text1)
+    for model, floor in occ_cfg.get("slot_attainment_floors", {}).items():
+        got = slot_report["models"][model]["slo_attainment"]
+        if got < floor:
+            failures.append(
+                f"slot-priced {model}: slo_attainment {got:.4f} "
+                f"< floor {floor}"
+            )
+        if occ_cfg.get("slot_vs_batch_no_worse") and (
+                got + 1e-9 < report["models"][model]["slo_attainment"]):
+            failures.append(
+                f"slot-priced {model}: attainment {got:.4f} regressed "
+                f"below the slab arm's "
+                f"{report['models'][model]['slo_attainment']:.4f} — "
+                "fill-priced turns must never serve worse at equal "
+                "traffic"
+            )
+    ratio = occ_cfg.get("min_completed_ratio")
+    if ratio is not None:
+        done_b = sum(v["completed"] for v in report["models"].values())
+        done_s = sum(
+            v["completed"] for v in slot_report["models"].values()
+        )
+        if done_s < ratio * done_b:
+            failures.append(
+                f"slot-priced completions {done_s} < {ratio} x slab "
+                f"{done_b} (the stall-elimination pricing should serve "
+                "at least as many requests)"
+            )
+
     summary = {
         "metric": "sim_smoke",
         "deterministic": text1 == text2,
@@ -150,6 +204,17 @@ def _run_smoke(out_path=None) -> int:
         "migrations": report["migrations"],
         "chips_used": report["chips_used"],
         "schedule_changes": report["schedule_changes"],
+        "occupancy_model": {
+            "deterministic": slot_text1 == slot_text2,
+            "slot_attainment": {
+                m: round(v["slo_attainment"], 4)
+                for m, v in slot_report["models"].items()
+            },
+            "slot_occupancy_min": round(
+                min(v["slot_occupancy"]
+                    for v in slot_report["chips"].values()), 4
+            ),
+        },
         "ok": not failures,
     }
     print(json.dumps(summary))

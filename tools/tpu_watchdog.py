@@ -242,6 +242,7 @@ def capture_bench(step_name: str = "bench", env_extra: dict = None,
     env = dict(os.environ)
     env.pop("RDB_BENCH_SCOPE", None)  # a leaked scope must not narrow
     env.pop("RDB_BENCH_FAST", None)   # (or fast-mode) the full record
+    env.pop("RDB_BENCH_PAGED", None)  # nor flip the A/B arm
     env.update(env_extra or {})
     rec = run_step(step_name, [sys.executable, "bench.py"],
                    timeout_s or BENCH_TIMEOUT_S, env=env)
@@ -306,6 +307,20 @@ def capture_bench_llm() -> bool:
     return capture_bench(
         step_name="bench_llm", env_extra={"RDB_BENCH_SCOPE": "llm"},
         timeout_s=BENCH_LLM_TIMEOUT_S, prefix="bench_llm",
+        expected_scope="llm",
+    )
+
+
+def capture_bench_llm_paged() -> bool:
+    """The paged-KV arm of the llm A/B (bench.py --paged on): same
+    configuration as the bench_llm step on the paged pool, so the next
+    on-chip window captures BOTH arms against the round-3 1693
+    tok/s/chip record — the ISSUE-7 win condition is unmeasurable
+    without the pair."""
+    return capture_bench(
+        step_name="bench_llm_paged",
+        env_extra={"RDB_BENCH_SCOPE": "llm", "RDB_BENCH_PAGED": "1"},
+        timeout_s=BENCH_LLM_TIMEOUT_S, prefix="bench_llm_paged",
         expected_scope="llm",
     )
 
@@ -465,6 +480,7 @@ def capture_first_light() -> bool:
 STEPS = [
     ("first_light", capture_first_light),
     ("bench_llm", capture_bench_llm),
+    ("bench_llm_paged", capture_bench_llm_paged),
     ("bench", capture_bench),
     ("profiles", capture_profiles),
     ("slo_demo", capture_slo_demo),
