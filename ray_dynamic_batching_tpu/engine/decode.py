@@ -67,6 +67,7 @@ from ray_dynamic_batching_tpu.engine.paging import (
     PageAllocator,
     PagedPrefixCache,
     PagedSessionCache,
+    PageEventJournal,
     table_array,
 )
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
@@ -504,6 +505,7 @@ class DecodeEngine:
         # shares pages copy-on-write instead of copying rows.
         self.paged = bool(paged)
         self.page_size = int(page_size)
+        self._page_journal: Optional[PageEventJournal] = None
         if self.paged:
             if draft_model is not None:
                 raise ValueError(
@@ -540,7 +542,15 @@ class DecodeEngine:
                     f"slot ({self._n_table_entries} pages at page_size "
                     f"{self.page_size}, max_len {max_len})"
                 )
-            self._allocator = PageAllocator(self.num_pages)
+            # Allocator event journal (bounded ring): alloc/free land
+            # from the allocator itself, CoW borrows / cache reclaims /
+            # capacity evictions from their decision sites below —
+            # rendered as Perfetto instant events + a page-occupancy
+            # counter track by utils/trace_export, surfaced by
+            # ``snapshot()``.
+            self._page_journal = PageEventJournal()
+            self._allocator = PageAllocator(self.num_pages,
+                                            journal=self._page_journal)
             self._table_host = np.full(
                 (num_slots, self._n_table_entries), self.num_pages,
                 dtype=np.int32,
@@ -1505,8 +1515,13 @@ class DecodeEngine:
         this runs before any capacity-finish eviction. Returns True if
         an entry was dropped (its pages free unless a borrower still
         holds them — callers loop)."""
-        for cache in (self.paged_prefix, self.paged_sessions):
+        for which, cache in (("prefix", self.paged_prefix),
+                             ("session", self.paged_sessions)):
             if cache is not None and cache.evict_lru():
+                self._page_journal.record(
+                    "cache_reclaim", 0, self._allocator.allocated_pages,
+                    cache=which,
+                )
                 return True
         return False
 
@@ -1957,6 +1972,9 @@ class DecodeEngine:
         self._allocator.decref(pages[:n])
         opts["_pages"] = list(shared_ids) + pages[n:]
         opts["_shared_pages"] = n
+        self._page_journal.record(
+            "cow_copy", n, self._allocator.allocated_pages, source="prefix"
+        )
 
     def _prefill_session_paged(
         self, req: Request, prompt: np.ndarray, opts: Dict, hit: Tuple,
@@ -1985,6 +2003,10 @@ class DecodeEngine:
         opts["_pages"] = list(shared_ids[:n_share]) + opts["_pages"]
         opts["_shared_pages"] = n_share
         opts["_hold_tail"] = list(shared_ids[n_share:])
+        self._page_journal.record(
+            "cow_copy", n_share, self._allocator.allocated_pages,
+            source="session",
+        )
         row = self.model.make_cache(1, self._long_row_cap(C))
         row = self._paged_seed_fn()(
             row, self._cache,
@@ -2265,10 +2287,18 @@ class DecodeEngine:
                 if victim is None:
                     break
                 PAGE_EVICTIONS.inc(tags={"model": self.model.name})
+                self._page_journal.record(
+                    "eviction", len(self._slots[victim].pages),
+                    self._allocator.allocated_pages, slot=int(victim),
+                )
                 self._finish(victim, "capacity")
             if not self._allocator.can_alloc(delta):
                 # Not even eviction could cover this slot: truncate IT.
                 PAGE_EVICTIONS.inc(tags={"model": self.model.name})
+                self._page_journal.record(
+                    "eviction", len(slot.pages),
+                    self._allocator.allocated_pages, slot=int(i),
+                )
                 self._finish(int(i), "capacity")
                 continue
             slot.pages.extend(self._allocator.alloc(delta))
@@ -2707,6 +2737,35 @@ class DecodeEngine:
         else:
             reserved = float(self.num_slots * self.max_len)
         return used / reserved if reserved > 0 else 1.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Operator-facing state dump (the engine analogue of
+        ``LiveScheduler.snapshot()``): slot/KV occupancy plus — in paged
+        mode — the allocator event journal (bounded ring; ``events``
+        carries the retained tail, ``journal_total``/``journal_rotated``
+        say how much history the ring has seen/shed, so a consumer can
+        tell a quiet pool from a ring that wrapped). The journal feeds
+        ``utils/trace_export.to_chrome_trace(spans, journal=...)`` for a
+        Perfetto lane time-aligned with decode-turn spans."""
+        out: Dict[str, Any] = {
+            "model": self.model.name,
+            "paged": self.paged,
+            "num_slots": self.num_slots,
+            "active_slots": self.active_slots,
+            "kv_occupancy": self.kv_occupancy(),
+            "ttft": self.ttft_breakdown(),
+        }
+        if self.paged:
+            out["page_size"] = self.page_size
+            out["num_pages"] = self.num_pages
+            out["free_pages"] = self._allocator.free_pages
+            out["allocated_pages"] = self._allocator.allocated_pages
+            out["page_journal"] = {
+                "events": self._page_journal.snapshot(),
+                "journal_total": self._page_journal.total,
+                "journal_rotated": self._page_journal.rotated_out,
+            }
+        return out
 
     @property
     def active_slots(self) -> int:

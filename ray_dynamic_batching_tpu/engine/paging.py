@@ -47,6 +47,58 @@ class OutOfPages(Exception):
     raise site — never silent."""
 
 
+class PageEventJournal:
+    """Bounded ring of allocator events — the paged pool's flight
+    recorder. Placement and paging decisions (allocs, EOS frees, CoW
+    borrows, cache-pin reclaims, capacity evictions) spend milliseconds
+    that are invisible between a decode-turn span's start and end; the
+    journal stamps each one with the SAME monotonic-ms clock the tracer
+    uses, so ``utils/trace_export.py`` renders them as Perfetto instant
+    events + a page-occupancy counter track time-aligned with the spans.
+
+    Bounded (ring) but never silent about it: ``total`` counts every
+    event ever recorded, so ``total - len(ring)`` is exactly how many
+    rotated out. Thread-compat: the decode engine records from its own
+    single thread; ``snapshot()`` copies under the GIL (deque slicing is
+    atomic enough for a monitoring read).
+    """
+
+    KINDS = ("alloc", "free", "cow_copy", "cache_reclaim", "eviction")
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self.total = 0
+
+    def record(self, kind: str, pages: int, pages_in_use: int,
+               t_ms: Optional[float] = None, **detail) -> None:
+        if kind not in self.KINDS:
+            raise ValueError(
+                f"unknown journal event kind {kind!r} (known: {self.KINDS})"
+            )
+        if t_ms is None:
+            import time
+
+            t_ms = time.monotonic() * 1000.0
+        ev = {"t_ms": float(t_ms), "kind": kind, "pages": int(pages),
+              "pages_in_use": int(pages_in_use)}
+        ev.update(detail)
+        self._ring.append(ev)
+        self.total += 1
+
+    def snapshot(self) -> List[dict]:
+        return list(self._ring)
+
+    @property
+    def rotated_out(self) -> int:
+        return self.total - len(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
 class PageAllocator:
     """Fixed pool of KV pages: free list + per-page refcounts.
 
@@ -61,7 +113,8 @@ class PageAllocator:
     fresh identical data.
     """
 
-    def __init__(self, num_pages: int):
+    def __init__(self, num_pages: int,
+                 journal: Optional[PageEventJournal] = None):
         if num_pages <= 0:
             raise ValueError(f"num_pages must be positive, got {num_pages}")
         self.num_pages = int(num_pages)
@@ -69,6 +122,11 @@ class PageAllocator:
             range(self.num_pages)
         )
         self.refcount: List[int] = [0] * self.num_pages
+        # Optional event journal: alloc/free are recorded HERE (the one
+        # place that knows them); semantic events (CoW borrows, cache
+        # reclaims, capacity evictions) are recorded by the engine at
+        # their decision sites.
+        self.journal = journal
 
     @property
     def free_pages(self) -> int:
@@ -92,6 +150,8 @@ class PageAllocator:
         out = [self._free.popleft() for _ in range(n)]
         for p in out:
             self.refcount[p] = 1
+        if self.journal is not None and out:
+            self.journal.record("alloc", len(out), self.allocated_pages)
         return out
 
     def incref(self, pages: Sequence[int]) -> None:
@@ -118,6 +178,8 @@ class PageAllocator:
             if self.refcount[p] == 0:
                 self._free.append(p)
                 freed.append(p)
+        if self.journal is not None and freed:
+            self.journal.record("free", len(freed), self.allocated_pages)
         return freed
 
     def check(self) -> None:

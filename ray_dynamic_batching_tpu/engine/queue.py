@@ -40,7 +40,7 @@ from ray_dynamic_batching_tpu.engine.request import (
     RequestStale,
     now_ms,
 )
-from ray_dynamic_batching_tpu.utils.metrics import RollingWindow
+from ray_dynamic_batching_tpu.utils.sketch import RollingSketch
 from ray_dynamic_batching_tpu.utils import metrics as m
 from ray_dynamic_batching_tpu.utils.tracing import tracer
 
@@ -266,8 +266,17 @@ class RequestQueue:
         # router/controller wires its ring here (None = unaudited).
         self.audit = None
         # --- stats (ref :324-372) ---
-        self.latency_window = RollingWindow(1000)
-        self.queue_delay_window = RollingWindow(1000)
+        # Rolling quantile SKETCHES (PR 8): the compliance signals the
+        # router/failover/governor read (`_retry_hint_s`, `stats()`
+        # percentiles) hold a guaranteed relative error (default 1%)
+        # and read in O(bins) instead of an O(n log n) sort under the
+        # queue lock per stats() call. RECENCY is preserved: epochs
+        # rotate every 1000 observations, so a read reflects at most
+        # the last ~2000 completions — a retry hint must describe the
+        # queue NOW, not a whole healthy morning. Same observe/
+        # percentile surface as the deprecated RollingWindow(1000).
+        self.latency_window = RollingSketch(1000)
+        self.queue_delay_window = RollingSketch(1000)
         self._recent_outcomes = []
         self.total_enqueued = 0
         self.total_dropped = 0

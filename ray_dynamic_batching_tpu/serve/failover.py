@@ -46,6 +46,7 @@ from ray_dynamic_batching_tpu.engine.request import (
 from ray_dynamic_batching_tpu.utils.chaos import ChaosInjected
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.tracing import tracer
 
 logger = get_logger("failover")
 
@@ -191,8 +192,9 @@ class FailoverManager:
         self.policy = policy or FailoverPolicy()
         self._rng = random.Random(self.policy.seed)
         self._seq = itertools.count()
-        # (due_monotonic_ms, seq, request, excluded_replica_id)
-        self._heap: List[Tuple[float, int, Request, str]] = []
+        # (due_monotonic_ms, seq, request, excluded_replica_id,
+        #  submitted_ms — the failover hop span's start)
+        self._heap: List[Tuple[float, int, Request, str, float]] = []
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
@@ -258,10 +260,11 @@ class FailoverManager:
             # racing close() past an unlocked check would push AFTER the
             # heap drain and leave a client future that never resolves.
             if not self._stopped:
+                submitted_ms = m.now_ms()
                 heapq.heappush(
                     self._heap,
-                    (m.now_ms() + delay_ms, next(self._seq), request,
-                     exclude_replica),
+                    (submitted_ms + delay_ms, next(self._seq), request,
+                     exclude_replica, submitted_ms),
                 )
                 self._ensure_worker()
                 self._cond.notify()
@@ -339,7 +342,8 @@ class FailoverManager:
                     self._cond.wait(timeout)
                 if self._stopped:
                     return
-                _due, _seq, request, excluded = heapq.heappop(self._heap)
+                (_due, _seq, request, excluded,
+                 submitted_ms) = heapq.heappop(self._heap)
             try:
                 # assign_request owns terminal rejection (RequestDropped
                 # after its capped backoff window) — capped further by the
@@ -350,6 +354,22 @@ class FailoverManager:
                     exclude={excluded} if excluded else None,
                     timeout_s=max(request.remaining_ms() / 1000.0, 0.001),
                 )
+                if tracer().enabled:
+                    # The ledger's `failover` hop: submit -> re-dispatch,
+                    # backoff included, joined to the request's trace. It
+                    # OUTRANKS router.assign in the hop taxonomy, so the
+                    # retry's inner assign attributes here — a regression
+                    # in failover latency names failover, not the router.
+                    tracer().record_span(
+                        "failover.redispatch",
+                        ctx=request.trace_ctx,
+                        start_ms=submitted_ms,
+                        end_ms=m.now_ms(),
+                        deployment=self.router.deployment,
+                        lane=self.router.deployment,
+                        attempts=request.attempts,
+                        excluded=excluded,
+                    )
             except Exception:  # noqa: BLE001 — one bad dispatch must not
                 # kill the worker; the request's future still resolves
                 # through assign_request's own rejection path.
@@ -365,7 +385,7 @@ class FailoverManager:
             self._stopped = True
             pending, self._heap = list(self._heap), []
             self._cond.notify_all()
-        for _due, _seq, request, _excluded in pending:
+        for _due, _seq, request, _excluded, _submitted in pending:
             FAILOVER_SHED.inc(tags={"deployment": self.router.deployment,
                                     "reason": "shutdown"})
             request.reject(RequestDropped(

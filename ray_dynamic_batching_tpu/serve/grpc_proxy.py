@@ -102,18 +102,22 @@ class GRPCProxy:
             GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "NOT_FOUND"})
             context.abort(grpc.StatusCode.NOT_FOUND, err)
         tenant, qos = self._identity(body, context, "Predict")
-        self._admit(body.get("deployment"), tenant,
-                    self._effective_qos(handle, qos), context, "Predict")
         # Ingest span for the gRPC front door; a ``traceparent`` field in
         # the JSON body (the generic-handler transport has no per-call
-        # metadata plumbing here) joins the caller's trace. Dispatch
-        # happens inside the span so the routed request inherits it; the
-        # result wait is accounted by the proxy-side future timeout.
+        # metadata plumbing here) joins the caller's trace. Admission AND
+        # dispatch happen inside the span: the admission.check child must
+        # nest under the request trace (an orphan-trace hop never shows
+        # in the request's budget ledger), and the routed request
+        # inherits the context; the result wait is accounted by the
+        # proxy-side future timeout.
         with tracer().attach_context(
             parse_traceparent(body.get("traceparent")),
             "grpc.predict",
             lane="grpc", deployment=body.get("deployment"),
         ):
+            self._admit(body.get("deployment"), tenant,
+                        self._effective_qos(handle, qos), context,
+                        "Predict")
             future = handle.remote(
                 body.get("payload"),
                 slo_ms=body.get("slo_ms"),
@@ -174,7 +178,10 @@ class GRPCProxy:
         ``retry-after-s``), not a queue slot."""
         if self.admission is None:
             return
-        ok, retry_after_s = self.admission.admit(deployment, tenant, qos)
+        # Same ledger hop as the HTTP door (admission.check).
+        with tracer().span("admission.check", lane="grpc",
+                           tenant=tenant, qos_class=qos):
+            ok, retry_after_s = self.admission.admit(deployment, tenant, qos)
         if ok:
             return
         GRPC_REQUESTS.inc(
@@ -226,14 +233,16 @@ class GRPCProxy:
             )
             context.abort(grpc.StatusCode.NOT_FOUND, err)
         tenant, qos = self._identity(body, context, "PredictStream")
-        self._admit(body.get("deployment"), tenant,
-                    self._effective_qos(handle, qos), context,
-                    "PredictStream")
+        # Admission inside the request span, same as Predict: the
+        # admission.check hop must join this trace to be budgetable.
         with tracer().attach_context(
             parse_traceparent(body.get("traceparent")),
             "grpc.predict_stream",
             lane="grpc", deployment=body.get("deployment"),
         ):
+            self._admit(body.get("deployment"), tenant,
+                        self._effective_qos(handle, qos), context,
+                        "PredictStream")
             stream, future = handle.remote_stream(
                 body.get("payload"), slo_ms=body.get("slo_ms"),
                 tenant=tenant, qos_class=qos,

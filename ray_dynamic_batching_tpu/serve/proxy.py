@@ -362,9 +362,15 @@ class HTTPProxy:
             # takes them — adapter handles get dict payloads anyway).
             identity_kwargs = {"tenant": tenant, "qos_class": qos}
         if self.admission is not None:
-            ok, retry_after_s = self.admission.admit(
-                getattr(handle, "deployment", route), tenant, qos
-            )
+            # Its own ledger hop (admission.check): bucket math is
+            # microseconds, but a contended admission lock or governor
+            # flap shows up here — and an invisible hop can never be
+            # named guilty by the budget gate.
+            with tracer().span("admission.check", lane="http",
+                               tenant=tenant, qos_class=qos):
+                ok, retry_after_s = self.admission.admit(
+                    getattr(handle, "deployment", route), tenant, qos
+                )
             if not ok:
                 # Same header grammar as every other capacity reject
                 # (failover.retry_after_header), just pre-dispatch.

@@ -21,6 +21,8 @@ from ray_dynamic_batching_tpu.sim.queue import (
 from ray_dynamic_batching_tpu.sim.report import (
     compare_reports,
     format_compare,
+    hop_drift_report,
+    merged_hop_sketches,
     render_json,
     slo_attainment,
 )
@@ -48,6 +50,8 @@ __all__ = [
     "SimRequestQueue",
     "compare_reports",
     "format_compare",
+    "hop_drift_report",
+    "merged_hop_sketches",
     "render_json",
     "slo_attainment",
     "EngineFailure",

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ray_dynamic_batching_tpu.engine.queue import ClassBuckets, ClassCounters
 from ray_dynamic_batching_tpu.engine.request import (
@@ -34,6 +34,13 @@ from ray_dynamic_batching_tpu.engine.request import (
     DEFAULT_TENANT,
 )
 from ray_dynamic_batching_tpu.sim.clock import VirtualClock
+from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
+
+# The simulator's slice of the live hop taxonomy (utils/hops.HOP_ORDER):
+# the sim has no proxy/handle/router front end — a request exists from
+# its (virtual) enqueue — so exactly two hops are observable, and the
+# sim<->live drift report compares exactly these.
+SIM_HOPS = ("queue.wait", "engine.step")
 
 SLO_WINDOW = 200  # live parity: recent-completion compliance window
 
@@ -48,6 +55,9 @@ class SimRequest:
     seq_len: int = 0
     qos_class: str = DEFAULT_QOS_CLASS
     tenant: str = DEFAULT_TENANT
+    # Stamped at dequeue: the boundary between the sim's two ledger hops
+    # (queue.wait = arrival -> pop, engine.step = pop -> completion).
+    popped_ms: Optional[float] = None
 
     @property
     def deadline_ms(self) -> float:
@@ -89,6 +99,12 @@ class SimRequestQueue:
         # Shared per-class accounting (engine/queue.ClassCounters — the
         # live queue's implementation, imported like ClassBuckets).
         self._classes = ClassCounters()
+        # Per-hop latency sketches (virtual-event hop ledger): the SAME
+        # sketch + hop names the live decomposer aggregates with, so the
+        # sim<->live hop-drift report compares like with like.
+        self.hop_sketches: Dict[str, QuantileSketch] = {
+            hop: QuantileSketch() for hop in SIM_HOPS
+        }
 
     def _cls(self, qos: str) -> Dict[str, float]:
         return self._classes.cls(qos)
@@ -142,6 +158,7 @@ class SimRequestQueue:
                 self.total_stale += 1
                 self._cls(req.qos_class)["stale"] += 1
                 continue
+            req.popped_ms = now
             out.append(req)
         return out
 
@@ -158,6 +175,17 @@ class SimRequestQueue:
             ok = total_ms <= req.slo_ms
             violations += 0 if ok else 1
             self.latency_samples.append(total_ms)
+            # Virtual-event hop ledger: arrival -> pop -> completion
+            # tiles the request's whole sim lifetime (residual == 0 by
+            # construction — the sim has no instrumentation gaps).
+            popped = req.popped_ms if req.popped_ms is not None \
+                else completed_at_ms
+            self.hop_sketches["queue.wait"].observe(
+                max(0.0, popped - req.arrival_ms)
+            )
+            self.hop_sketches["engine.step"].observe(
+                max(0.0, completed_at_ms - popped)
+            )
             self._recent_outcomes.append(ok)
             c = self._cls(req.qos_class)
             c["completed"] += 1
@@ -194,6 +222,14 @@ class SimRequestQueue:
     def class_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-class counter slices + live depth (live queue key set)."""
         return self._classes.stats(self._buckets.depth_by_class())
+
+    def hop_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-hop {count, p50_ms, p95_ms} from the virtual-event
+        ledger (report surface; the raw sketches stay mergeable)."""
+        return {
+            hop: sk.summary(quantiles=(0.5, 0.95))
+            for hop, sk in self.hop_sketches.items()
+        }
 
 
 class SimQueueManager:

@@ -50,6 +50,72 @@ def shed_fraction(model_report: Dict[str, Any], qos_class: str) -> float:
     return sheds.get(qos_class, 0.0) / total
 
 
+def merged_hop_sketches(queues) -> Dict[str, Any]:
+    """One mergeable sketch per hop across every model's sim queue
+    (sketch merge is exact — this is the aggregation the live side's
+    ``utils.hops.hop_sketches`` produces, so drift compares align)."""
+    from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
+
+    groups: Dict[str, list] = {}
+    for q in queues.queues().values():
+        for hop, sk in q.hop_sketches.items():
+            groups.setdefault(hop, []).append(sk)
+    return {hop: QuantileSketch.merged(sks) for hop, sks in groups.items()}
+
+
+def hop_drift_report(
+    live: Dict[str, Any],
+    sim: Dict[str, Any],
+    tolerance: float = 0.5,
+    quantiles=(0.5, 0.95),
+    min_count: int = 5,
+) -> Dict[str, Any]:
+    """Name the hops where the simulator's cost model diverges from a
+    live trace beyond ``tolerance`` (relative, per quantile).
+
+    ``live``/``sim`` map hop -> QuantileSketch (or anything exposing
+    ``quantile``/``count``). Only hops observed on BOTH sides with at
+    least ``min_count`` samples are graded — a hop the sim cannot
+    express (proxy/handle/router) is listed under ``ungraded``, never
+    silently skipped. PR 3's parity pin said "the sim agrees in
+    aggregate"; this says WHICH hop's pricing drifted when it stops
+    agreeing."""
+    graded: Dict[str, Any] = {}
+    drifting = []
+    ungraded = {}
+    for hop in sorted(set(live) | set(sim)):
+        a, b = live.get(hop), sim.get(hop)
+        if a is None or b is None or min(a.count, b.count) < min_count:
+            ungraded[hop] = {
+                "live_count": 0 if a is None else a.count,
+                "sim_count": 0 if b is None else b.count,
+            }
+            continue
+        entry: Dict[str, Any] = {"live_count": a.count, "sim_count": b.count}
+        worst = 0.0
+        for q in quantiles:
+            lv, sv = a.quantile(q), b.quantile(q)
+            denom = max(abs(lv), 1e-9)
+            drift = abs(sv - lv) / denom
+            entry[f"p{round(q * 100):d}"] = {
+                "live_ms": lv, "sim_ms": sv, "drift": drift,
+            }
+            worst = max(worst, drift)
+        entry["worst_drift"] = worst
+        entry["ok"] = worst <= tolerance
+        if not entry["ok"]:
+            drifting.append(hop)
+        graded[hop] = entry
+    return {
+        "metric": "hop_drift",
+        "tolerance": tolerance,
+        "hops": graded,
+        "ungraded": ungraded,
+        "drifting_hops": drifting,
+        "ok": not drifting,
+    }
+
+
 def _round(value: Any, nd: int = 4) -> Any:
     if isinstance(value, float):
         return round(value, nd)

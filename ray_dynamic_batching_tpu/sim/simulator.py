@@ -446,6 +446,10 @@ class Simulation:
         horizon_ms = (sc.duration_s + sc.drain_s) * 1000.0
         events = loop.run_until(horizon_ms)
         elapsed_ms = clock.now_ms()
+        # Kept for post-run consumers that need the raw (mergeable) hop
+        # sketches rather than the report's rendered quantiles — the
+        # hop-drift CLI merges these against a live capture's.
+        self.last_queues = queues
 
         # --- report -------------------------------------------------------
         models: Dict[str, Any] = {}
@@ -495,6 +499,9 @@ class Simulation:
                 "latency_p50_ms": stats["latency_p50_ms"],
                 "latency_p95_ms": stats["latency_p95_ms"],
                 "latency_p99_ms": stats["latency_p99_ms"],
+                # Virtual-event hop ledger (sim slice of the live hop
+                # taxonomy): feeds tools/run_sim.py --hop-drift.
+                "hops": queue.hop_stats(),
             }
         chips: Dict[str, Any] = {}
         for e in engines:

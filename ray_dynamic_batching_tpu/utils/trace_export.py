@@ -26,7 +26,23 @@ import json
 import threading
 from typing import Any, Dict, Iterable, List, Optional
 
+from ray_dynamic_batching_tpu.utils import metrics as m
 from ray_dynamic_batching_tpu.utils.tracing import Span
+
+# Spans a sink refused (cap reached, sink closed): counted per sink, and
+# every export surface stamps ``truncated`` so a capped capture can never
+# masquerade as a complete one (repo rule: no silent caps).
+TRACE_DROPPED = m.Counter(
+    "rdb_trace_dropped_spans_total",
+    "Finished spans an export sink dropped (cap reached / sink closed)",
+    tag_keys=("sink",),
+)
+
+# JSONL header sentinel key (first line of a FileSpanExporter capture).
+_HEADER_KEY = "_rdb_export"
+# Fixed header width: the line is written at open and REWRITTEN in place
+# at close with the final counts, so it must occupy constant bytes.
+_HEADER_WIDTH = 96
 
 # Span-name prefix -> process lane. Unknown prefixes get their own lane
 # appended after these, so new components never collapse into one row.
@@ -66,8 +82,45 @@ def span_from_dict(d: Dict[str, Any]) -> Span:
     )
 
 
-def to_chrome_trace(spans: Iterable[Span]) -> Dict[str, Any]:
-    """Render spans as a Chrome trace-event JSON document."""
+def journal_to_chrome_events(
+    events: Iterable[Dict[str, Any]],
+    pid: int,
+    lane: str = "paging",
+) -> List[Dict[str, Any]]:
+    """Paged-KV allocator journal entries (``engine/paging.
+    PageEventJournal``) as Chrome trace events: one INSTANT event
+    (``ph: "i"``) per alloc/free/CoW-copy/cache-reclaim/eviction, plus a
+    ``kv_pages_in_use`` COUNTER track (``ph: "C"``) sampled at every
+    event — time-aligned with the decode-turn spans because the journal
+    stamps the same monotonic-ms clock the tracer uses."""
+    out: List[Dict[str, Any]] = [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+         "args": {"name": lane}},
+    ]
+    for ev in events:
+        args = {k: v for k, v in ev.items() if k != "t_ms"}
+        out.append({
+            "ph": "i", "s": "p", "name": ev["kind"], "cat": "paging",
+            "pid": pid, "tid": 0,
+            "ts": float(ev["t_ms"]) * 1000.0,
+            "args": args,
+        })
+        if "pages_in_use" in ev:
+            out.append({
+                "ph": "C", "name": "kv_pages_in_use", "pid": pid, "tid": 0,
+                "ts": float(ev["t_ms"]) * 1000.0,
+                "args": {"pages": ev["pages_in_use"]},
+            })
+    return out
+
+
+def to_chrome_trace(
+    spans: Iterable[Span],
+    journal: Optional[Iterable[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Render spans as a Chrome trace-event JSON document. ``journal``
+    optionally appends a paged-KV allocator event lane
+    (:func:`journal_to_chrome_events`) after the component lanes."""
     spans = [s for s in spans if s.end_ms is not None]
     components: List[str] = [
         c for c in _COMPONENT_ORDER
@@ -140,6 +193,10 @@ def to_chrome_trace(spans: Iterable[Span]) -> Dict[str, Any]:
                 "pid": pid_of[c], "tid": tid_of[key],
                 "ts": s.start_ms * 1000.0 + 0.001,
             })
+    if journal is not None:
+        events.extend(
+            journal_to_chrome_events(journal, pid=len(components) + 1)
+        )
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -147,33 +204,51 @@ class ChromeTraceCollector:
     """In-process exporter: buffer finished spans, write one Chrome trace.
 
     Usage: ``tracer().set_exporter(collector.export)`` ... ``collector.
-    write(path)``.
+    write(path)``. Spans past ``cap`` are dropped — COUNTED in
+    ``rdb_trace_dropped_spans_total{sink="collector"}`` and stamped into
+    the trace header (``truncated``/``dropped_spans``), never silently.
     """
 
     def __init__(self, cap: int = 100_000) -> None:
         self._spans: List[Span] = []
         self._cap = cap
+        self._dropped = 0
         self._lock = threading.Lock()
 
     def export(self, span: Span) -> None:
         with self._lock:
             if len(self._spans) < self._cap:
                 self._spans.append(span)
+            else:
+                self._dropped += 1
+                TRACE_DROPPED.inc(tags={"sink": "collector"})
 
     @property
     def spans(self) -> List[Span]:
         with self._lock:
             return list(self._spans)
 
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
     def chrome_trace(self) -> Dict[str, Any]:
-        return to_chrome_trace(self.spans)
+        with self._lock:
+            spans, dropped = list(self._spans), self._dropped
+        doc = to_chrome_trace(spans)
+        # Top-level metadata rides the trace JSON (Perfetto ignores
+        # unknown keys): a capped capture says so in its own header.
+        doc["metadata"] = {"truncated": dropped > 0,
+                           "dropped_spans": dropped}
+        return doc
 
     def write(self, path: str) -> int:
         """Write the Chrome trace JSON; returns the span count."""
-        spans = self.spans
+        doc = self.chrome_trace()
         with open(path, "w") as f:
-            json.dump(to_chrome_trace(spans), f)
-        return len(spans)
+            json.dump(doc, f)
+        return len(self.spans)
 
 
 class FileSpanExporter:
@@ -186,21 +261,56 @@ class FileSpanExporter:
     The file is TRUNCATED per exporter instance: span timestamps are
     process-monotonic, so mixing captures from different runs would
     render a garbled timeline.
+
+    The first line is a fixed-width export header
+    (``{"_rdb_export": {...}}``), rewritten in place at close with the
+    final span/dropped counts and a ``truncated`` flag: spans refused
+    past ``max_spans`` (disk-bound runs) are counted there and in
+    ``rdb_trace_dropped_spans_total{sink="jsonl"}`` — a capped capture
+    announces itself to every downstream reader. Spans arriving AFTER
+    close (straggling threads) are counted in the metric and the
+    ``dropped`` property only: the on-disk header is final at close and
+    cannot reflect them.
     """
 
-    def __init__(self, path: str, flush_every: int = 64) -> None:
+    def __init__(self, path: str, flush_every: int = 64,
+                 max_spans: int = 1_000_000) -> None:
         self.path = path
         self.flush_every = flush_every
+        self.max_spans = max_spans
         self._lock = threading.Lock()
         self._f = open(path, "w")
+        self._written = 0
+        self._dropped = 0
         self._pending = 0
+        self._f.write(self._header_line())
+
+    def _header_line(self) -> str:
+        body = json.dumps({_HEADER_KEY: {
+            "truncated": self._dropped > 0,
+            "spans": self._written,
+            "dropped": self._dropped,
+        }})
+        if len(body) > _HEADER_WIDTH:  # pragma: no cover - counts are ints
+            raise ValueError("export header overflowed its fixed width")
+        return body + " " * (_HEADER_WIDTH - len(body)) + "\n"
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
 
     def export(self, span: Span) -> None:
         line = json.dumps(span_to_dict(span))
         with self._lock:
-            if self._f.closed:
-                return  # late span from a straggling thread after close
+            if self._f.closed or self._written >= self.max_spans:
+                # Late span from a straggling thread, or cap reached:
+                # counted, stamped at close — never silent.
+                self._dropped += 1
+                TRACE_DROPPED.inc(tags={"sink": "jsonl"})
+                return
             self._f.write(line + "\n")
+            self._written += 1
             self._pending += 1
             if self._pending >= self.flush_every:
                 self._f.flush()
@@ -209,7 +319,25 @@ class FileSpanExporter:
     def close(self) -> None:
         with self._lock:
             if not self._f.closed:
+                # Rewrite the fixed-width header with the final counts.
+                self._f.flush()
+                self._f.seek(0)
+                self._f.write(self._header_line())
                 self._f.close()
+
+
+def read_export_header(path: str) -> Optional[Dict[str, Any]]:
+    """The capture's export header ({truncated, spans, dropped}), or
+    None for legacy/foreign captures without one."""
+    with open(path) as f:
+        first = f.readline().strip()
+    if not first:
+        return None
+    try:
+        d = json.loads(first)
+    except ValueError:
+        return None
+    return d.get(_HEADER_KEY) if isinstance(d, dict) else None
 
 
 def read_spans_jsonl(path: str) -> List[Span]:
@@ -219,7 +347,10 @@ def read_spans_jsonl(path: str) -> List[Span]:
             line = line.strip()
             if not line:
                 continue
-            out.append(span_from_dict(json.loads(line)))
+            d = json.loads(line)
+            if _HEADER_KEY in d:
+                continue  # export header/trailer, not a span
+            out.append(span_from_dict(d))
     return out
 
 

@@ -340,6 +340,52 @@ class TestPoolBehavior:
         assert SESSION_HITS.get(tags={"model": model.name}) == before + 1
         engine._allocator.check()
 
+    def test_snapshot_surfaces_allocator_journal(self, lm):
+        """ISSUE 8: the allocator event journal rides the engine's
+        snapshot() — allocs/frees from a real decode run, page counts
+        consistent with the allocator, and the journal renders into the
+        same Chrome trace as the decode spans."""
+        from ray_dynamic_batching_tpu.utils.trace_export import (
+            to_chrome_trace,
+        )
+
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=64,
+            prompt_buckets=[8], eos_token_id=None,
+            default_max_new_tokens=3, decode_horizon=1,
+            paged=True, page_size=128,
+        )
+        r = Request(model=model.name, payload={
+            "tokens": [1, 2, 3], "max_new_tokens": 3,
+        }, slo_ms=60_000.0)
+        queue.add_request(r)
+        engine.run_until_idle(timeout_s=120)
+        r.future.result(timeout=5)
+        snap = engine.snapshot()
+        assert snap["paged"] is True and snap["model"] == model.name
+        assert snap["free_pages"] == engine._allocator.free_pages
+        journal = snap["page_journal"]
+        kinds = [e["kind"] for e in journal["events"]]
+        assert "alloc" in kinds and "free" in kinds
+        assert journal["journal_total"] == len(journal["events"])
+        assert journal["journal_rotated"] == 0
+        # In-use gauge returns to zero after drain (free follows alloc).
+        assert journal["events"][-1]["pages_in_use"] == 0
+        doc = to_chrome_trace([], journal=journal["events"])
+        assert any(e["ph"] == "C" for e in doc["traceEvents"])
+
+    def test_slab_snapshot_has_no_journal(self, lm):
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=16)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=64,
+            prompt_buckets=[8], eos_token_id=None, paged=False,
+        )
+        snap = engine.snapshot()
+        assert snap["paged"] is False and "page_journal" not in snap
+
     def test_paged_rejects_draft_and_mesh(self, lm):
         model, params = lm
         queue = RequestQueue(model.name, max_len=16)

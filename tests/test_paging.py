@@ -240,3 +240,75 @@ def test_pages_for():
     assert pages_for(1, 128) == 1
     assert pages_for(128, 128) == 1
     assert pages_for(129, 128) == 2
+
+
+class TestPageEventJournal:
+    """ISSUE 8: the allocator event journal — bounded ring, loud about
+    rotation, alloc/free recorded by the allocator itself, rendered as
+    Perfetto instant events + a page-occupancy counter track."""
+
+    def test_alloc_and_free_are_journaled(self):
+        from ray_dynamic_batching_tpu.engine.paging import PageEventJournal
+
+        j = PageEventJournal()
+        a = PageAllocator(8, journal=j)
+        pages = a.alloc(3)
+        a.incref(pages)
+        assert a.decref(pages) == []        # nothing freed: no event
+        a.decref(pages)                     # last owner: freed
+        kinds = [e["kind"] for e in j.snapshot()]
+        assert kinds == ["alloc", "free"]
+        alloc_ev, free_ev = j.snapshot()
+        assert alloc_ev["pages"] == 3 and alloc_ev["pages_in_use"] == 3
+        assert free_ev["pages"] == 3 and free_ev["pages_in_use"] == 0
+        # Timestamps ride the tracer's clock (monotonic ms): ordered.
+        assert free_ev["t_ms"] >= alloc_ev["t_ms"]
+
+    def test_ring_bounds_and_counts_rotation(self):
+        from ray_dynamic_batching_tpu.engine.paging import PageEventJournal
+
+        j = PageEventJournal(capacity=4)
+        for i in range(10):
+            j.record("alloc", 1, i, t_ms=float(i))
+        assert len(j) == 4
+        assert j.total == 10 and j.rotated_out == 6
+        assert [e["t_ms"] for e in j.snapshot()] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_unknown_kind_refused(self):
+        from ray_dynamic_batching_tpu.engine.paging import PageEventJournal
+
+        with pytest.raises(ValueError, match="unknown journal event"):
+            PageEventJournal().record("defrag", 1, 0)
+
+    def test_semantic_kinds_accepted(self):
+        from ray_dynamic_batching_tpu.engine.paging import PageEventJournal
+
+        j = PageEventJournal()
+        j.record("cow_copy", 2, 5, source="prefix")
+        j.record("cache_reclaim", 0, 5, cache="session")
+        j.record("eviction", 3, 2, slot=1)
+        assert [e["kind"] for e in j.snapshot()] == [
+            "cow_copy", "cache_reclaim", "eviction",
+        ]
+        assert j.snapshot()[0]["source"] == "prefix"
+
+    def test_chrome_trace_rendering(self):
+        from ray_dynamic_batching_tpu.engine.paging import PageEventJournal
+        from ray_dynamic_batching_tpu.utils.trace_export import (
+            to_chrome_trace,
+        )
+
+        j = PageEventJournal()
+        a = PageAllocator(8, journal=j)
+        pages = a.alloc(4)
+        a.decref(pages)
+        doc = to_chrome_trace([], journal=j.snapshot())
+        instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert [e["name"] for e in instants] == ["alloc", "free"]
+        assert all(e["name"] == "kv_pages_in_use" for e in counters)
+        assert [e["args"]["pages"] for e in counters] == [4, 0]
+        # Same clock domain as spans: ts is us, t_ms * 1000.
+        assert instants[0]["ts"] == pytest.approx(
+            j.snapshot()[0]["t_ms"] * 1000.0
+        )

@@ -1,0 +1,337 @@
+"""Quantile sketch (utils/sketch) + the Sketch metric family.
+
+The latency budget ledger's numeric substrate: the DDSketch must hold
+its advertised relative-error bound against exact nearest-rank
+percentiles, merge associatively and byte-deterministically (integer
+bucket adds), serialize round-trip, and bound its memory loudly
+(collapse keeps count conservation). The metric family renders the
+OpenMetrics summary grammar the exposition checker validates, and the
+queue's hot-path swap (RollingWindow -> sketch) is pinned to agree with
+exact percentiles within the configured accuracy.
+"""
+
+import json
+import math
+import random
+import warnings
+
+import pytest
+
+from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
+
+
+def exact_percentile(samples, p):
+    """The live queue's historical rule: nearest-rank via ceil."""
+    data = sorted(samples)
+    idx = min(len(data) - 1, max(0, math.ceil(p * len(data)) - 1))
+    return data[idx]
+
+
+class TestQuantileSketch:
+    def test_relative_error_bound_lognormal(self):
+        rng = random.Random(7)
+        vals = [rng.lognormvariate(3.0, 1.2) for _ in range(50_000)]
+        sk = QuantileSketch(relative_accuracy=0.01)
+        for v in vals:
+            sk.observe(v)
+        for p in (0.1, 0.5, 0.9, 0.95, 0.99, 0.999):
+            exact = exact_percentile(vals, p)
+            got = sk.quantile(p)
+            # Rank quantization adds a hair on top of the bucket bound at
+            # extreme tails; 2*alpha is still 25x tighter than one
+            # histogram bucket.
+            assert abs(got - exact) <= 0.02 * exact + 1e-9, (p, got, exact)
+
+    def test_empty_and_single_value(self):
+        sk = QuantileSketch()
+        assert sk.quantile(0.5) == 0.0 and len(sk) == 0
+        sk.observe(42.0)
+        # Clamped to observed extremes: one value reads back exactly.
+        assert sk.quantile(0.5) == 42.0
+        assert sk.mean() == 42.0
+
+    def test_sub_min_values_count_as_zero(self):
+        sk = QuantileSketch(min_value=1e-3)
+        for _ in range(10):
+            sk.observe(0.0)
+        sk.observe(100.0)
+        assert sk.count == 11
+        assert sk.quantile(0.5) == 0.0
+        assert sk.quantile(1.0) == 100.0
+
+    def test_negative_and_nonfinite_refused(self):
+        sk = QuantileSketch()
+        for bad in (-1.0, float("nan"), float("inf")):
+            with pytest.raises(ValueError):
+                sk.observe(bad)
+
+    def test_merge_associative_and_byte_deterministic(self):
+        rng = random.Random(17)
+        vals = [rng.expovariate(0.01) for _ in range(9_000)]
+        parts = [QuantileSketch() for _ in range(3)]
+        for i, v in enumerate(vals):
+            parts[i % 3].observe(v)
+
+        def canon(sk):
+            return json.dumps(sk.to_dict(), sort_keys=True)
+
+        a, b, c = parts
+        left = QuantileSketch().merge(a).merge(b).merge(c)
+        right = QuantileSketch().merge(c).merge(b).merge(a)
+        # Bucket counts are integers: merge order cannot change them.
+        assert left.to_dict()["bins"] == right.to_dict()["bins"]
+        assert left.count == right.count == len(vals)
+        # Same merge ORDER twice = byte-identical state.
+        again = QuantileSketch().merge(a).merge(b).merge(c)
+        assert canon(again) == canon(left)
+        # Merged quantiles == observe-everything quantiles (exact bins).
+        whole = QuantileSketch()
+        for v in vals:
+            whole.observe(v)
+        for p in (0.5, 0.95, 0.99):
+            assert left.quantile(p) == whole.quantile(p)
+
+    def test_merge_rejects_mismatched_parameters(self):
+        with pytest.raises(ValueError, match="error bound"):
+            QuantileSketch(relative_accuracy=0.01).merge(
+                QuantileSketch(relative_accuracy=0.05)
+            )
+
+    def test_serialization_roundtrip(self):
+        sk = QuantileSketch()
+        for v in (0.5, 3.0, 3.0, 900.0, 0.0):
+            sk.observe(v)
+        back = QuantileSketch.from_dict(sk.to_dict())
+        assert json.dumps(back.to_dict(), sort_keys=True) == \
+            json.dumps(sk.to_dict(), sort_keys=True)
+        assert back.quantile(0.5) == sk.quantile(0.5)
+
+    def test_collapse_bounds_memory_and_conserves_count(self):
+        sk = QuantileSketch(max_bins=16)
+        rng = random.Random(3)
+        vals = [10.0 ** rng.uniform(-2, 5) for _ in range(5_000)]
+        for v in vals:
+            sk.observe(v)
+        assert len(sk.to_dict()["bins"]) <= 16
+        assert sk.count == len(vals)
+        # High quantiles keep full accuracy (collapse folds LOW bins).
+        exact = exact_percentile(vals, 0.99)
+        assert abs(sk.quantile(0.99) - exact) <= 0.02 * exact
+
+    def test_summary_block(self):
+        sk = QuantileSketch()
+        for v in range(1, 101):
+            sk.observe(float(v))
+        s = sk.summary()
+        assert s["count"] == 100.0
+        assert abs(s["p50_ms"] - 50.0) <= 1.5
+        assert abs(s["p95_ms"] - 95.0) <= 2.5
+
+
+class TestSketchMetricFamily:
+    def test_summary_exposition_shape(self):
+        reg = m.MetricsRegistry()
+        try:
+            orig, m._default_registry = m._default_registry, reg
+            s = m.Sketch("test_hop_ms", "hop sketch", tag_keys=("hop",))
+            for v in (1.0, 2.0, 5.0, 100.0):
+                s.observe(v, tags={"hop": "queue.wait"})
+            text = reg.prometheus_text()
+        finally:
+            m._default_registry = orig
+        assert "# TYPE test_hop_ms summary" in text
+        assert 'test_hop_ms{hop="queue.wait",quantile="0.5"}' in text
+        assert 'test_hop_ms_sum{hop="queue.wait"} 108.0' in text
+        assert 'test_hop_ms_count{hop="queue.wait"} 4' in text
+        # And the exposition checker accepts the summary grammar.
+        import tools.check_openmetrics as com
+
+        assert com.validate(text) == []
+
+    def test_quantile_monotonicity_violation_caught(self):
+        import tools.check_openmetrics as com
+
+        bad = (
+            "# TYPE x summary\n"
+            'x{quantile="0.5"} 10\n'
+            'x{quantile="0.9"} 5\n'
+            "x_sum 15\nx_count 2\n"
+        )
+        errs = com.validate(bad)
+        assert any("decrease" in e for e in errs)
+        # quantile label out of range is its own error
+        errs = com.validate('# TYPE x summary\nx{quantile="1.5"} 1\n'
+                            "x_sum 1\nx_count 1\n")
+        assert any("not a float in [0, 1]" in e for e in errs)
+        # missing _sum/_count
+        errs = com.validate('# TYPE x summary\nx{quantile="0.5"} 1\n')
+        assert any("_sum" in e for e in errs)
+        assert any("_count" in e for e in errs)
+
+    def test_quantile_label_excluded_from_series_cap(self):
+        import tools.check_openmetrics as com
+
+        lines = ["# TYPE y summary"]
+        for q in ("0.5", "0.9", "0.95", "0.99"):
+            lines.append(f'y{{quantile="{q}"}} 1')
+        lines += ["y_sum 4", "y_count 4"]
+        # 4 quantile lines are ONE series; a cap of 1 must pass.
+        assert com.validate("\n".join(lines) + "\n", max_series=1) == []
+
+    def test_mergeable_state_across_instances(self):
+        reg = m.MetricsRegistry()
+        try:
+            orig, m._default_registry = m._default_registry, reg
+            a = m.Sketch("proc_a_ms", "a")
+            b = m.Sketch("proc_b_ms", "b")
+            for v in (1.0, 2.0, 3.0):
+                a.observe(v)
+            for v in (100.0, 200.0):
+                b.observe(v)
+            state = a.sketch_state()
+            b.merge_state(state)
+            assert b.count() == 5
+            assert b.quantile(0.2) <= 3.1  # a's values made it in
+        finally:
+            m._default_registry = orig
+
+
+class TestRollingWindowDeprecation:
+    def test_shim_warns_once_per_construction_and_still_works(self):
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            w = m.RollingWindow(maxlen=10)
+            assert any(issubclass(c.category, DeprecationWarning)
+                       for c in caught)
+        for v in (1.0, 2.0, 3.0):
+            w.observe(v)
+        assert w.percentile(0.5) == 2.0
+
+
+class TestQueueSketchSwap:
+    """The hot-path call sites (queue latency/delay windows, failover's
+    p50 read) now ride the sketch: agreement with exact percentiles is
+    pinned within the configured relative error."""
+
+    def test_queue_percentiles_agree_with_exact(self):
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+        from ray_dynamic_batching_tpu.engine.request import Request
+
+        q = RequestQueue("m0")
+        rng = random.Random(5)
+        lat = []
+        t0 = 1000.0
+        for _ in range(500):
+            ms = rng.lognormvariate(4.0, 0.8)
+            lat.append(ms)
+            req = Request(model="m0", payload=None, slo_ms=1e9)
+            req.arrival_ms = t0
+            q.record_batch_completion([req], completed_at_ms=t0 + ms)
+        stats = q.stats()
+        for key, p in (("latency_p50_ms", 0.5), ("latency_p95_ms", 0.95),
+                       ("latency_p99_ms", 0.99)):
+            exact = exact_percentile(lat, p)
+            # 2x the sketch alpha: rank quantization on 500 samples.
+            assert abs(stats[key] - exact) <= 0.025 * exact + 1e-9, key
+
+    def test_failover_p50_read_still_works(self):
+        from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+        from ray_dynamic_batching_tpu.engine.request import Request
+
+        q = RequestQueue("m0")
+        req = Request(model="m0", payload=None, slo_ms=1e9)
+        req.arrival_ms = 0.0
+        q.record_batch_completion([req], completed_at_ms=250.0)
+        # serve/failover._expected_latency_ms reads this exact surface.
+        assert abs(q.latency_window.percentile(0.5) - 250.0) <= 2.5
+        assert q._retry_hint_s() > 0.0
+
+
+class TestRollingSketch:
+    """The queue's compliance windows ride RollingSketch: epoch rotation
+    every ``window`` observations bounds staleness to ~2*window samples,
+    so the retry hint / failover p50 describe the queue NOW — a
+    cumulative sketch would report a healthy morning long into an
+    overload."""
+
+    def test_overload_is_visible_after_rotation(self):
+        from ray_dynamic_batching_tpu.utils.sketch import RollingSketch
+
+        rs = RollingSketch(window=100)
+        for _ in range(100):
+            rs.observe(10.0)      # hours of healthy traffic, compressed
+        for _ in range(200):
+            rs.observe(1000.0)    # overload begins
+        # The all-healthy epoch has rotated out of the read view: the
+        # p50 reflects the incident, not the cumulative past.
+        assert rs.percentile(0.5) == pytest.approx(1000.0, rel=0.03)
+        assert rs.total == 300
+        assert rs.count <= 200    # view is recency-bounded
+
+    def test_read_view_merges_current_and_previous_epoch(self):
+        from ray_dynamic_batching_tpu.utils.sketch import RollingSketch
+
+        rs = RollingSketch(window=100)
+        for _ in range(100):
+            rs.observe(10.0)
+        for _ in range(50):
+            rs.observe(1000.0)
+        # Previous epoch still in view: low quantiles show the old mode,
+        # high quantiles the new one — no cliff at the rotation edge.
+        assert rs.percentile(0.25) == pytest.approx(10.0, rel=0.03)
+        assert rs.percentile(0.95) == pytest.approx(1000.0, rel=0.03)
+        assert len(rs) == 150
+        assert rs.mean() == pytest.approx((100 * 10 + 50 * 1000) / 150,
+                                          rel=0.03)
+
+    def test_rejects_nonpositive_window(self):
+        from ray_dynamic_batching_tpu.utils.sketch import RollingSketch
+
+        with pytest.raises(ValueError):
+            RollingSketch(window=0)
+
+    def test_concurrent_observe_and_reads_do_not_race(self):
+        """The exact production topology: the engine thread observes
+        completions while failover/monitoring threads read percentiles
+        with no shared lock. Unlocked, the reader's sorted-bin walk
+        races the writer's dict insert ("dictionary changed size") —
+        RollingSketch must lock internally like RollingWindow did."""
+        import threading
+        import time as _time
+
+        from ray_dynamic_batching_tpu.utils.sketch import RollingSketch
+
+        rs = RollingSketch(window=200)
+        sk_family = m.Sketch("test_race_ms", "race hammer")
+        stop = threading.Event()
+        errors = []
+
+        def write():
+            i = 0
+            while not stop.is_set():
+                rs.observe(1.0 + (i % 997))
+                sk_family.observe(1.0 + (i % 997))
+                i += 1
+
+        def read():
+            try:
+                while not stop.is_set():
+                    rs.percentile(0.5)
+                    rs.mean()
+                    len(rs)
+                    sk_family.quantile(0.95)
+                    list(sk_family._prom_lines())
+            except Exception as e:  # noqa: BLE001 — the assertion
+                errors.append(e)
+
+        threads = [threading.Thread(target=write),
+                   threading.Thread(target=read),
+                   threading.Thread(target=read)]
+        for t in threads:
+            t.start()
+        _time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, errors

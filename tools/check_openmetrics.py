@@ -11,6 +11,10 @@ the Prometheus/OpenMetrics text our registry renders and fails loudly on:
 - exemplar label sets over the 128-rune OpenMetrics cap,
 - histogram families missing ``+Inf`` buckets / ``_sum`` / ``_count`` or
   with non-monotonic cumulative buckets,
+- summary families (the quantile-sketch exposition) with malformed
+  ``quantile`` labels (not a float in [0, 1]), quantile values that
+  DECREASE as the quantile increases (impossible for a real
+  distribution — a sketch bug), or missing ``_sum`` / ``_count``,
 - metric families whose series cardinality exceeds a cap (``--max-series``;
   enforced in the smoke): client-controlled label values (tenants) must
   collapse into the registry's ``__other__`` bucket, not mint unbounded
@@ -78,6 +82,8 @@ def validate(text: str, max_series: int = 0) -> List[str]:
     typed: Dict[str, str] = {}
     # histogram family -> {label-set-sans-le: [(le, cum_count)]}
     buckets: Dict[str, Dict[Tuple, List[Tuple[float, float]]]] = {}
+    # summary family -> {label-set-sans-quantile: [(q, value)]}
+    quantiles: Dict[str, Dict[Tuple, List[Tuple[float, float]]]] = {}
     sums: Dict[str, set] = {}
     counts: Dict[str, set] = {}
     series: Dict[str, set] = {}
@@ -114,8 +120,11 @@ def validate(text: str, max_series: int = 0) -> List[str]:
             errors.append(f"line {i}: sample {name!r} has no # TYPE")
             continue
         labels = _parse_labels(m.group("labels") or "", errors, f"line {i}")
+        # le (histogram) and quantile (summary) are structural labels,
+        # bounded by construction — the OTHER labels explode cardinality.
         series.setdefault(base, set()).add(tuple(sorted(
-            (k, v) for k, v in labels.items() if k != "le"
+            (k, v) for k, v in labels.items()
+            if k not in ("le", "quantile")
         )))
         if m.group("ex_labels") is not None:
             # OpenMetrics: exemplars only on histogram buckets and
@@ -150,6 +159,48 @@ def validate(text: str, max_series: int = 0) -> List[str]:
                 sums.setdefault(base, set()).add(key)
             elif name.endswith("_count"):
                 counts.setdefault(base, set()).add(key)
+        elif typed.get(base) == "summary":
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "quantile"
+            ))
+            if name == base:
+                q_raw = labels.get("quantile")
+                if q_raw is None:
+                    errors.append(
+                        f"line {i}: summary sample without quantile label"
+                    )
+                else:
+                    try:
+                        q = float(q_raw)
+                    except ValueError:
+                        q = -1.0
+                    if not 0.0 <= q <= 1.0:
+                        errors.append(
+                            f"line {i}: quantile label {q_raw!r} is not "
+                            "a float in [0, 1]"
+                        )
+                    else:
+                        quantiles.setdefault(base, {}).setdefault(
+                            key, []
+                        ).append((q, float(m.group("value"))))
+            elif name.endswith("_sum"):
+                sums.setdefault(base, set()).add(key)
+            elif name.endswith("_count"):
+                counts.setdefault(base, set()).add(key)
+
+    for fam, qseries in quantiles.items():
+        for key, qs in qseries.items():
+            qs = sorted(qs)
+            vals = [v for _, v in qs]
+            if any(b < a for a, b in zip(vals, vals[1:])):
+                errors.append(
+                    f"{fam}{dict(key)}: quantile values decrease as the "
+                    "quantile increases (impossible distribution)"
+                )
+            if key not in sums.get(fam, set()):
+                errors.append(f"{fam}{dict(key)}: missing _sum")
+            if key not in counts.get(fam, set()):
+                errors.append(f"{fam}{dict(key)}: missing _count")
 
     for fam, series in buckets.items():
         for key, bs in series.items():
@@ -214,6 +265,14 @@ def _smoke() -> int:
             with tracer().span("smoke.request"):
                 h.observe(v, tags={"model": "m0"})
         h.observe(5.0, tags={"model": "m1"})  # untraced: no exemplar
+        # The sketch family (PR 8): summary exposition with quantile
+        # labels — validated for quantile monotonicity + _sum/_count,
+        # and its quantile label must not count against the series cap.
+        s = m.Sketch("smoke_hop_ms", "smoke hop ledger sketch",
+                     tag_keys=("hop",))
+        for i in range(200):
+            s.observe(1.0 + (i % 37), tags={"hop": "queue.wait"})
+            s.observe(10.0 + (i % 11), tags={"hop": "engine.step"})
         proxy = HTTPProxy(ProxyRouter(), port=0).start()
         try:
             url = f"http://127.0.0.1:{proxy.port}/metrics"
@@ -254,6 +313,9 @@ def _smoke() -> int:
     if n_exemplars < 1:
         errors.append("no exemplar line in the scrape "
                       "(traced observations must surface trace_ids)")
+    if 'smoke_hop_ms{hop="queue.wait",quantile="0.5"}' not in text:
+        errors.append("sketch family missing its quantile series "
+                      "(summary exposition did not render)")
     if errors:
         print("OPENMETRICS SMOKE FAILED:", file=sys.stderr)
         for e in errors:

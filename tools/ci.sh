@@ -18,6 +18,8 @@ if [ "${1:-}" = "fast" ]; then
   python -m tools.lint
   echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
   python tools/check_openmetrics.py --smoke
+  echo "== latency budget gate (hop ledger vs tools/budgets/ttft.json, seeded run_slo_demo --trace capture) =="
+  python tools/check_budgets.py tools/budgets/fixture_spans.jsonl
   echo "== what-if simulator smoke (deterministic, tools/sim_smoke.json floors) =="
   python tools/run_sim.py --smoke
   echo "== chaos conformance (sim: injected engine death, heal + accounting) =="
@@ -43,6 +45,9 @@ python -m tools.lint
 
 echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
 python tools/check_openmetrics.py --smoke
+
+echo "== latency budget gate (hop ledger vs tools/budgets/ttft.json, seeded run_slo_demo --trace capture) =="
+python tools/check_budgets.py tools/budgets/fixture_spans.jsonl
 
 echo "== what-if simulator smoke (deterministic, tools/sim_smoke.json floors) =="
 python tools/run_sim.py --smoke

@@ -10,6 +10,9 @@ Usage:
     python tools/dump_trace.py spans.jsonl -o trace.json
     python tools/dump_trace.py spans.jsonl --summary        # digest only
     python tools/dump_trace.py spans.jsonl --trace-id <id>  # one request
+    python tools/dump_trace.py spans.jsonl --hops           # per-request
+        latency budget ledger table (utils/hops decomposition: one row
+        per request, one column per hop + the unattributed residual)
 
 Capture a JSONL during any run with:
     from ray_dynamic_batching_tpu.utils.tracing import tracer
@@ -46,6 +49,9 @@ def main(argv=None) -> int:
                              "flight record)")
     parser.add_argument("--summary", action="store_true",
                         help="print a digest instead of converting")
+    parser.add_argument("--hops", action="store_true",
+                        help="print the per-request hop ledger table "
+                             "instead of converting")
     args = parser.parse_args(argv)
 
     spans = read_spans_jsonl(args.spans)
@@ -57,6 +63,23 @@ def main(argv=None) -> int:
             if any(l.get("trace_id") in keep for l in s.links)
         }
         spans = [s for s in spans if s.trace_id in keep]
+    if args.hops:
+        from ray_dynamic_batching_tpu.utils.hops import (
+            format_ledger_table,
+            request_ledgers,
+        )
+
+        ledgers, skipped = request_ledgers(spans)
+        if not ledgers:
+            print(f"no front-door request traces in {args.spans} "
+                  f"({len(spans)} spans, {skipped} other traces)",
+                  file=sys.stderr)
+            return 1
+        print(format_ledger_table(ledgers))
+        print(f"{len(ledgers)} request ledger(s); {skipped} non-request "
+              f"trace(s) skipped; every row conserves "
+              "(sum(hops) + unattributed == e2e)")
+        return 0
     if args.summary:
         print(json.dumps(trace_summary(spans), indent=2))
         return 0

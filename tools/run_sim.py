@@ -18,6 +18,13 @@ Modes:
   --smoke            CI gate: built-in fixture scenario, run TWICE,
                      assert byte-identical reports + the SLO-attainment /
                      migration floors in tools/sim_smoke.json. <10 s.
+  --hop-drift FILE   sim<->live hop attribution: replay FILE's arrivals
+                     through the simulator, decompose the SAME capture
+                     with the live hop ledger (utils/hops), and name the
+                     hops (queue.wait / engine.step) where the sim's
+                     cost model diverges beyond --tolerance — PR 3's
+                     aggregate parity pin, turned per-hop. Needs --model
+                     specs like --spans. Exit 1 on drift.
 
 What-if knobs: --rate-scale 2.0 ("would this plan hold at 2x traffic?"),
 --engines N ("can we drop a chip?"), --seed N.
@@ -226,6 +233,113 @@ def _run_smoke(out_path=None) -> int:
     return 1 if failures else 0
 
 
+def _live_hop_sketches(spans) -> dict:
+    """Live per-hop duration sketches from one capture.
+
+    Front-door request traces go through the conserving ledger
+    decomposition (``utils.hops``). Every OTHER trace's mapped spans —
+    load-generator ``queue.wait`` singletons, engine-only traces —
+    contribute their RAW durations: a root span does not cover its own
+    ledger window, so a singleton-only capture would otherwise grade
+    nothing at all, and raw per-hop cost is exactly what the sim's
+    model prices."""
+    from ray_dynamic_batching_tpu.utils.hops import (
+        SPAN_TO_HOP,
+        hop_sketches,
+        request_ledgers,
+    )
+    from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
+
+    ledgers, _skipped = request_ledgers(spans)
+    live = hop_sketches(ledgers)
+    in_ledgers = {l.trace_id for l in ledgers}
+    # Spans the ledger join already attributed: anything in a ledger
+    # trace, AND any batch/turn span LINKING into one (those live in
+    # their own traces by design; re-observing their raw duration here
+    # would double-count every batched execution).
+    ledger_span_ids = {
+        s.span_id for s in spans if s.trace_id in in_ledgers
+    }
+    for s in spans:
+        if s.trace_id in in_ledgers or s.end_ms is None:
+            continue
+        if any(l.get("span_id") in ledger_span_ids for l in s.links):
+            continue
+        hop = SPAN_TO_HOP.get(s.name)
+        if hop is None:
+            continue
+        sk = live.get(hop)
+        if sk is None:
+            sk = live[hop] = QuantileSketch()
+        sk.observe(max(0.0, s.end_ms - s.start_ms))
+    return live
+
+
+def _run_hop_drift(args) -> int:
+    """sim<->live per-hop attribution over ONE capture: the live side is
+    the flight record's own hop ledger, the sim side replays the SAME
+    arrivals through the cost model — so every divergence is the model,
+    never the workload."""
+    from ray_dynamic_batching_tpu.sim import (
+        Simulation,
+        hop_drift_report,
+        merged_hop_sketches,
+    )
+    from ray_dynamic_batching_tpu.sim.simulator import Scenario, SimModelSpec
+    from ray_dynamic_batching_tpu.sim.workload import arrivals_from_spans
+    from ray_dynamic_batching_tpu.utils.trace_export import read_spans_jsonl
+
+    model_specs = _parse_model_args(args.models)
+    if not model_specs:
+        print("--hop-drift needs --model NAME=SLO_MS (the sim's serving "
+              "contracts)", file=sys.stderr)
+        return 2
+    spans = read_spans_jsonl(args.hop_drift)
+    live = _live_hop_sketches(spans)
+    arrivals = arrivals_from_spans(args.hop_drift)
+    if not arrivals:
+        print(f"{args.hop_drift}: no queue.wait spans to replay",
+              file=sys.stderr)
+        return 2
+    seed = args.seed if args.seed is not None else 0
+    horizon = max(t for t, _ in arrivals) + 1.0
+    scenario = Scenario(
+        models=[SimModelSpec.from_dict(m, seed=seed + i)
+                for i, m in enumerate(model_specs)],
+        duration_s=(args.duration if args.duration is not None
+                    else horizon),
+        n_engines=args.engines if args.engines is not None else 2,
+        seed=seed,
+        arrivals=arrivals,
+    )
+    profiles = _load_profiles(args.profiles,
+                              [m.name for m in scenario.models])
+    if profiles is None:
+        return 2
+    simulation = Simulation(profiles, scenario)
+    simulation.run()
+    sim_sketches = merged_hop_sketches(simulation.last_queues)
+    diff = hop_drift_report(live, sim_sketches, tolerance=args.tolerance)
+    text = json.dumps(diff, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if not diff["hops"]:
+        # "ok" with zero graded hops would be a success report about
+        # nothing — a capture/model mismatch is a usage error, not parity.
+        print("hop drift: NO hop had enough samples on both sides — "
+              f"nothing was graded (ungraded: {sorted(diff['ungraded'])})",
+              file=sys.stderr)
+        return 2
+    for hop in diff["drifting_hops"]:
+        worst = diff["hops"][hop]["worst_drift"]
+        print(f"hop drift: {hop} diverges {worst:.0%} (> "
+              f"{args.tolerance:.0%}) — the sim's cost model misprices "
+              "this hop", file=sys.stderr)
+    return 0 if diff["ok"] else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python tools/run_sim.py",
@@ -267,12 +381,20 @@ def main(argv=None) -> int:
     parser.add_argument("--smoke", action="store_true",
                         help="CI gate: fixture scenario vs "
                              "tools/sim_smoke.json floors")
+    parser.add_argument("--hop-drift", metavar="SPANS",
+                        help="flight-recorder spans.jsonl: per-hop "
+                             "sim-vs-live drift report (needs --model)")
+    parser.add_argument("--tolerance", type=float, default=0.75,
+                        help="relative per-hop drift tolerance for "
+                             "--hop-drift (default %(default)s — CPU "
+                             "captures are noisy; tighten on-chip)")
     args = parser.parse_args(argv)
 
     sources = [f for f, v in (("--arrivals", args.arrivals),
                               ("--spans", args.spans),
                               ("--pattern", args.pattern),
-                              ("--scenario", args.scenario))
+                              ("--scenario", args.scenario),
+                              ("--hop-drift", args.hop_drift))
                if v]
     if len(sources) > 1:
         # Silently preferring one source would grade the wrong workload.
@@ -282,6 +404,9 @@ def main(argv=None) -> int:
 
     if args.smoke:
         return _run_smoke(args.out)
+
+    if args.hop_drift:
+        return _run_hop_drift(args)
 
     from ray_dynamic_batching_tpu.sim import (
         Simulation,

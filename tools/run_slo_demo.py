@@ -132,6 +132,11 @@ def _run_traced_requests(port: int, models, ok_traces,
 
 def _flight_record_report(spans, ok_traces, metrics_text, audit):
     """Evaluate the observability acceptance contract over the capture."""
+    from ray_dynamic_batching_tpu.utils.hops import (
+        LedgerError,
+        request_ledgers,
+    )
+
     by_trace = {}
     for s in spans:
         by_trace.setdefault(s.trace_id, []).append(s)
@@ -148,17 +153,37 @@ def _flight_record_report(spans, ok_traces, metrics_text, audit):
             best_hops = hops
     linked = sum(len(s.links) for s in spans)
     n_exemplars = metrics_text.count('# {trace_id="')
+    # Latency budget ledger self-check: every front-door trace in the
+    # capture decomposes into a CONSERVING per-hop ledger (sum(hops) +
+    # unattributed == end-to-end, asserted inside request_ledgers) —
+    # the same decomposition tools/check_budgets.py gates on.
+    try:
+        ledgers, _ = request_ledgers(spans)
+        ledger_report = {
+            "requests": len(ledgers),
+            "conserving": True,
+            "mean_unattributed_ms": round(
+                sum(l.unattributed_ms for l in ledgers) / len(ledgers), 2
+            ) if ledgers else 0.0,
+        }
+    except LedgerError as e:
+        ledgers = []
+        ledger_report = {"requests": 0, "conserving": False,
+                         "error": str(e)}
     return {
         "traced_requests_ok": len(ok_traces),
         "hops_in_one_trace": sorted(best_hops),
         "span_links": linked,
         "metrics_exemplars": n_exemplars,
         "audit_records": len(audit),
+        "hop_ledger": ledger_report,
         "ok": (
             len(best_hops) >= 5
             and linked > 0
             and n_exemplars >= 1
             and len(audit) >= 1
+            and ledger_report["conserving"]
+            and len(ledgers) >= 1
         ),
     }
 

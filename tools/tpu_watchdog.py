@@ -400,12 +400,14 @@ def capture_profiles() -> bool:
 
 def _capture_demo(name: str, argv: list, timeout_s: float,
                   record_file: str, commit_msg: str,
-                  ok_rcs=(0, 2)) -> bool:
+                  ok_rcs=(0, 2), post_record=None) -> bool:
     """Shared record-capture discipline: run bounded, verify the RECORD's
     own backend stamp. For the demos rc 2 = SLO missed but the record is
     still real measured ground truth; rc 3 = no migration happened,
     which would commit a record proving the opposite of what the step
-    exists to prove — discard it."""
+    exists to prove — discard it. ``post_record`` runs after the record
+    verifies and before the commit (derived artifacts ride the same
+    commit); its failure never discards the verified record."""
     rec = run_step(name, argv, timeout_s)
     record_path = os.path.join(OUT_DIR, record_file)
     backend = None
@@ -424,15 +426,48 @@ def _capture_demo(name: str, argv: list, timeout_s: float,
         })
         _discard_unverified_artifacts()
         return False
+    if post_record is not None:
+        try:
+            post_record()
+        except Exception as e:  # noqa: BLE001 — derived report only
+            _log(f"{name}: post-record hook failed: {e}")
     return git_commit(commit_msg)
+
+
+def _budget_report() -> None:
+    """Per-hop TTFT budget report over the on-chip flight record the
+    traced SLO demo just wrote: the budget gate's verdict (guilty hops
+    included) lands in profiles/tpu_v5e/budget_report.json alongside
+    the bench, so the next window's capture grades the ROADMAP-5 TTFT
+    work hop by hop. Report-only here — a budget miss on chip is signal
+    to commit, not a reason to discard the measured record (the CI gate
+    on the seeded CPU capture is the enforcing copy)."""
+    spans_path = os.path.join(OUT_DIR, "spans.jsonl")
+    if not os.path.exists(spans_path):
+        _log("budget report: no spans.jsonl (traced demo did not write "
+             "a capture)")
+        return
+    rec = run_step("budget_report", [
+        sys.executable, "tools/check_budgets.py", spans_path,
+        "--report", os.path.join(OUT_DIR, "budget_report.json"),
+        "--allow-empty",
+    ], 120.0)
+    _log(f"budget report rc={rec['rc']}")
 
 
 def capture_slo_demo() -> bool:
     return _capture_demo(
         "slo_demo",
-        [sys.executable, "tools/run_slo_demo.py", "profiles/tpu_v5e", "60"],
+        [sys.executable, "tools/run_slo_demo.py", "profiles/tpu_v5e", "60",
+         "--trace"],
         SLO_TIMEOUT_S, "slo_demo.json",
-        f"tpu_v5e: on-chip SLO demo record {_now()}",
+        f"tpu_v5e: on-chip SLO demo record + per-hop budget report "
+        f"{_now()}",
+        # rc 4 = flight-record self-checks failed: the SLO record is
+        # still real measured ground truth (and the budget report will
+        # say what the capture was missing) — commit, don't discard.
+        ok_rcs=(0, 2, 4),
+        post_record=_budget_report,
     )
 
 
