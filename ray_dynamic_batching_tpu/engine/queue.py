@@ -55,7 +55,7 @@ ANTI_STARVATION_STRIDE = 8
 SHED_TOTAL = m.Counter(
     "rdb_shed_total",
     "Requests shed by a queue (reason: full | displaced | stale | closed "
-    "| requeue_refused)",
+    "| requeue_refused | cancelled)",
     tag_keys=("model", "qos", "reason"),
 )
 
@@ -392,9 +392,20 @@ class RequestQueue:
         now = now_ms()
         out: List[Request] = []
         stale: List[Request] = []
+        cancelled: List[Request] = []
         with self._lock:
             while len(self._buckets) and len(out) < batch_size:
                 req = self._buckets.pop()
+                if getattr(req, "cancelled", False):
+                    # Hedge-race loser: its outcome was already delivered
+                    # by the winning dispatch. Free the slot and account
+                    # it EXACTLY once (dropped/cancelled) so enqueued ==
+                    # completed + stale + dropped + depth conserves; the
+                    # future is already resolved, so no reject.
+                    cancelled.append(req)
+                    self.total_dropped += 1
+                    self._cls(req.qos_class)["dropped"] += 1
+                    continue
                 if (
                     discard_stale
                     and req.deadline_ms < now + expected_latency_ms
@@ -405,6 +416,10 @@ class RequestQueue:
                 out.append(req)
             self.total_stale += len(stale)
             depth_after = len(self._buckets)
+        for req in cancelled:
+            SHED_TOTAL.inc(tags={"model": self.model,
+                                 "qos": req.qos_class,
+                                 "reason": "cancelled"})
         for req in stale:
             SHED_TOTAL.inc(tags={"model": self.model,
                                  "qos": req.qos_class, "reason": "stale"})

@@ -82,12 +82,39 @@ class TokenStream:
         # token: a streaming request that already emitted a chunk must NOT
         # be transparently retried — the client has observed partial output.
         self.emitted = 0
+        # Invoked exactly once, on the emitted 0 -> 1 edge (producer
+        # thread, outside the lock). The hedge race claims first-winner
+        # here: the instant a primary emits its first token, the hedge
+        # is cancelled — the at-most-once-after-first-token boundary,
+        # enforced at the token source rather than by polling. A hook
+        # that returns ``False`` VETOES delivery of the triggering chunk
+        # (the producer lost the claim while this chunk was in flight;
+        # ``emitted`` still counts it — the winner's grafted chunks keep
+        # the stream's observed-output contract honest).
+        self.on_first_emit = None
 
-    def put(self, chunk: Any) -> None:
+    def put(self, chunk: Any, drop_if=None) -> None:
+        """``drop_if`` (checked under the lock, at entry AND delivery)
+        lets a producer make its own suppression atomic with delivery:
+        the hedge loser passes its ``cancelled`` flag so a chunk that
+        passed an earlier check cannot land after the race resolves."""
+        first_emit_cb = None
         with self._cond:
             if self._closed:
                 return  # consumer gone / finished — drop quietly
+            if drop_if is not None and drop_if():
+                return  # producer suppressed (lost the hedge race)
             self.emitted += 1
+            if self.emitted == 1 and self.on_first_emit is not None:
+                first_emit_cb = self.on_first_emit
+        if first_emit_cb is not None:
+            if first_emit_cb() is False:
+                return  # race hook vetoed this producer's chunk
+        with self._cond:
+            if self._closed:
+                return  # the first-emit hook may have closed us
+            if drop_if is not None and drop_if():
+                return  # race resolved against this producer mid-put
             if self._on_chunk is not None:
                 cb = self._on_chunk
             else:
@@ -215,6 +242,14 @@ class Request:
     # by construction (pinned in tests/test_qos.py).
     tenant: str = DEFAULT_TENANT
     qos_class: str = DEFAULT_QOS_CLASS
+    # Set by the hedge race's loser-cancellation: a cancelled request
+    # still QUEUED is discarded at pop time (counted once, reason
+    # "cancelled" — its outcome was already delivered by the winner); a
+    # cancelled request already mid-execution finishes harmlessly (its
+    # fulfill/reject no-op against the resolved future).
+    cancelled: bool = False
+    # True for a hedge shadow (never armed for a further hedge itself).
+    is_hedge: bool = False
 
     def __post_init__(self) -> None:
         if not self.request_id:
@@ -236,22 +271,40 @@ class Request:
     def queue_delay_ms(self, now: Optional[float] = None) -> float:
         return (now if now is not None else now_ms()) - self.arrival_ms
 
-    def reject(self, exc: Exception) -> None:
+    def reject(self, exc: Exception, force: bool = False) -> None:
+        """``force=True`` is the hedge winner's delivery path: a
+        cancelled request's own (late, lost) execution must not touch
+        the client — only the race winner resolves it."""
+        if self.cancelled and not force:
+            return
         if self.stream is not None:
             self.stream.abort(exc)
         if not self.future.done():
             self.future.set_exception(exc)
 
-    def fulfill(self, result: Any) -> None:
+    def fulfill(self, result: Any, force: bool = False) -> None:
+        if self.cancelled and not force:
+            return
         if self.stream is not None:
             self.stream.close()
         if not self.future.done():
             self.future.set_result(result)
 
     def stream_put(self, chunk: Any) -> None:
-        """Push one incremental chunk (no-op for non-streaming requests)."""
-        if self.stream is not None:
-            self.stream.put(chunk)
+        """Push one incremental chunk (no-op for non-streaming requests).
+        A cancelled dispatch's chunks are dropped at the source — and
+        re-checked under the stream lock at delivery (``drop_if``), so a
+        chunk in flight when the hedge race resolves cannot interleave
+        with the winner's grafted stream."""
+        if self.stream is not None and not self.cancelled:
+            self.stream.put(chunk, drop_if=lambda: self.cancelled)
+
+    def cancel(self) -> None:
+        """Mark this dispatch redundant (the hedge race was won by the
+        other arm). Queues discard it at pop; a running execution keeps
+        computing but its chunks, result, and errors are suppressed —
+        the winner owns the client-visible outcome."""
+        self.cancelled = True
 
 
 class BadRequest(ValueError):

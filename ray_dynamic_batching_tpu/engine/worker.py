@@ -116,6 +116,13 @@ class ReplicaEngine:
         self._compile_cache: Dict[Tuple[str, int, int], Any] = {}
         self._compile_cache_cap = 64
         self._closed = False
+        # Observed/expected step-latency ratios for the gray-failure
+        # detector (LiveScheduler.enable_gray_monitoring — the live twin
+        # of SimEngine.track_ratios): a healthy engine reads ~1.0
+        # whatever it hosts, a 10x-throttled chip reads ~10. Armed only
+        # when gray monitoring is on; drained per monitor tick.
+        self.track_ratios = False
+        self._fresh_ratios: list = []
 
     # --- schedule handoff (ref update_queues.put, scheduler.py:906-929) ---
     def assign(self, plan: NodePlan) -> None:
@@ -289,6 +296,10 @@ class ReplicaEngine:
             logger.error("%s/%s: step failed: %s", self.engine_id, name, e)
             return (time.perf_counter() - t0) * 1000.0
         elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        if self.track_ratios and p.latency_ms > 0:
+            # Engine-thread append, monitor-thread drain (GIL-atomic
+            # list swap in drain_ratios — same contract as SimEngine).
+            self._fresh_ratios.append(elapsed_ms / p.latency_ms)
         for req, res in zip(batch, results):
             req.fulfill(res)
         if step_span is not None:
@@ -390,6 +401,13 @@ class ReplicaEngine:
     @property
     def cycle_count(self) -> int:
         return self._cycle_count
+
+    def drain_ratios(self) -> list:
+        """Observed/expected step ratios since the last drain (the gray
+        monitor's per-tick observation window; GIL-atomic list swap —
+        engine thread appends, monitor thread drains)."""
+        out, self._fresh_ratios = self._fresh_ratios, []
+        return out
 
     def healthy(self) -> bool:
         """Liveness for the scheduler's heal path (mirror of

@@ -90,6 +90,17 @@ class LiveScheduler:
         # Structured replan ring: trigger, observed rates, profile rows
         # consulted, old->new diff, migration cost (scheduler/audit.py).
         self.audit = AuditLog("nexus")
+        # Optional gray-health pricing hook (ISSUE 9): a callable
+        # returning engine_id -> capacity factor (1.0 = full chip,
+        # probation < 1, ejected 0 — but ejected engines should simply
+        # report unhealthy). None = every alive engine is a full chip.
+        # ``enable_gray_monitoring()`` wires it to a live detector fed
+        # by per-batch step ratios; callers may install their own.
+        self.capacity_factors = None
+        self.gray = None
+        self._gray_ejected: set = set()
+        self._gray_window_ticks = 3
+        self._gray_windows: Dict[str, List[List[float]]] = {}
 
     # --- registration (ref models_config) ---------------------------------
     def register_model(self, name: str, slo_ms: float, seq_len: int = 0) -> None:
@@ -139,11 +150,16 @@ class LiveScheduler:
         with self._lock:
             rates = rates if rates is not None else self.rates.rates()
             alive = self.alive_engines()
+            factors = None
+            if self.capacity_factors is not None:
+                by_id = self.capacity_factors()
+                factors = [by_id.get(e.engine_id, 1.0) for e in alive]
             decision = decide_replan(
                 self.packer,
                 [frozenset(e.models) for e in alive],
                 self._sessions_for(rates),
                 rates,
+                capacity_factors=factors,
             )
             for engine, node_plan in zip(alive, decision.assignment):
                 if node_plan is not None:
@@ -197,11 +213,79 @@ class LiveScheduler:
         self.rebalance(trigger="heal")
         return True
 
+    # --- gray-failure detection (ISSUE 9: the LIVE producer for the
+    # capacity_factors hook — the sim twin is SimScheduler.check_gray_health,
+    # same detector, same grading rule, no drift) --------------------------
+    def enable_gray_monitoring(self, policy=None,
+                               window_ticks: int = 3) -> None:
+        """Arm engine-level gray detection: per-batch observed/expected
+        step ratios (ReplicaEngine.track_ratios) feed a GrayHealthMonitor
+        each monitor tick, and ``capacity_factors`` auto-wires to its
+        pricing unless the caller installed their own hook. Probation
+        relies on the fractional plan keeping SOME load on the engine so
+        ratios keep flowing (a folded-empty probationed engine holds its
+        state until the packer hands it load again)."""
+        from ray_dynamic_batching_tpu.serve.grayhealth import (
+            GrayHealthMonitor,
+        )
+
+        self.gray = GrayHealthMonitor("scheduler", policy=policy)
+        self.gray.audit = self.audit
+        self._gray_window_ticks = int(window_ticks)
+        self._gray_windows = {}
+        for e in self.engines:
+            e.track_ratios = True
+        if self.capacity_factors is None:
+            self.capacity_factors = lambda: {
+                e.engine_id: self.gray.capacity_factor(e.engine_id)
+                for e in self.engines
+            }
+
+    def check_gray_health(self) -> bool:
+        """Grade one monitor tick's step ratios and replan when a
+        verdict changed the planner's pricing (probation = fractional
+        chip, ejection = reclaim). Returns True when a gray replan
+        fired. Mirrors SimScheduler.check_gray_health."""
+        if self.gray is None:
+            return False
+        # The SAME window/grade rule the sim twin runs — no drift. No
+        # probes map: live has no ground truth to synthesize for an
+        # idled probationed engine (see enable_gray_monitoring).
+        from ray_dynamic_batching_tpu.serve.grayhealth import (
+            ratio_observations,
+        )
+
+        drained_by_id = {
+            e.engine_id: e.drain_ratios()
+            for e in self.engines
+            if e.engine_id not in self._dead_engines
+            and e.engine_id not in self._gray_ejected
+        }
+        obs = ratio_observations(
+            drained_by_id, self._gray_windows, self._gray_window_ticks
+        )
+        transitions = self.gray.tick(obs)
+        repricing = [t for t in transitions
+                     if "probation" in (t["from"], t["to"])
+                     or t["to"] == "ejected"]
+        if not repricing:
+            return False
+        for t in repricing:
+            if t["to"] == "ejected":
+                self._gray_ejected.add(t["replica"])
+                for e in self.engines:
+                    if e.engine_id == t["replica"]:
+                        e.assign(NodePlan())  # idle the reclaimed chip
+        self.rebalance(trigger="gray")
+        return True
+
     # --- monitor loop (ref _monitor_request_rates, scheduler.py:763-801) --
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.monitoring_interval_s):
             try:
                 healed = self.check_engine_health()
+                if not healed:
+                    healed = self.check_gray_health()
                 changed = self.rates.changed_models(
                     self.rate_threshold, self.rate_decrease_multiplier,
                     min_span_s=self.rate_min_span_s,

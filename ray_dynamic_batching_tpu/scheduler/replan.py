@@ -117,6 +117,27 @@ def transfer_cost(
     return cost
 
 
+def fold_node_plans(target: NodePlan, extra: NodePlan) -> NodePlan:
+    """Merge two node plans onto one chip: duty cycles add, occupancies
+    rescale (``occ * old_duty / new_duty``) so every placement keeps its
+    absolute slice milliseconds — degraded latency, never starvation."""
+    new_duty = target.duty_cycle_ms + extra.duty_cycle_ms
+    if new_duty <= 0:
+        return NodePlan(
+            placements=list(target.placements) + list(extra.placements),
+            duty_cycle_ms=new_duty,
+        )
+    rescaled = []
+    for node in (target, extra):
+        scale = node.duty_cycle_ms / new_duty
+        rescaled.extend(
+            Placement(p.session, p.batch_size, p.latency_ms,
+                      p.occupancy * scale, p.hbm_bytes)
+            for p in node.placements
+        )
+    return NodePlan(placements=rescaled, duty_cycle_ms=new_duty)
+
+
 def merge_overflow_nodes(
     plans: List[NodePlan], n_engines: int
 ) -> List[NodePlan]:
@@ -143,21 +164,57 @@ def merge_overflow_nodes(
     ]
     for extra in plans[n_engines:]:
         host = min(range(len(merged)), key=lambda i: merged[i].occupancy)
-        target = merged[host]
-        new_duty = target.duty_cycle_ms + extra.duty_cycle_ms
-        if new_duty <= 0:
-            target.placements.extend(extra.placements)
-            continue
-        rescaled = []
-        for node in (target, extra):
-            scale = node.duty_cycle_ms / new_duty
-            rescaled.extend(
-                Placement(p.session, p.batch_size, p.latency_ms,
-                          p.occupancy * scale, p.hbm_bytes)
-                for p in node.placements
-            )
-        merged[host] = NodePlan(placements=rescaled, duty_cycle_ms=new_duty)
+        merged[host] = fold_node_plans(merged[host], extra)
     return merged
+
+
+def derate_for_capacity(
+    assignment: List[Optional[NodePlan]],
+    capacity_factors: Sequence[float],
+) -> Dict[int, Dict[str, int]]:
+    """Price degraded engines as FRACTIONAL capacity (gray-failure
+    probation, ISSUE 9) instead of alive/dead. Mutates ``assignment``
+    in place; returns per-engine notes for the audit payload.
+
+    An engine with ``factor < 1`` may only carry a plan whose occupancy
+    fits the factor. First choice: SWAP its plan with the lightest
+    fitting plan held by a full-capacity engine — the probationed chip
+    keeps serving (its traffic doubles as the probe stream that makes a
+    heal observable) while the heavy work moves to healthy hardware.
+    Fallback: FOLD the whole plan onto the least-occupied full-capacity
+    engine (degraded latency there, honest shed accounting — never a
+    starved queue). With no full-capacity engine at all, the plan stays:
+    slow beats starved."""
+    moved: Dict[int, Dict[str, int]] = {}
+    full = [j for j, f in enumerate(capacity_factors) if f >= 1.0 - 1e-9]
+    for e, factor in enumerate(capacity_factors):
+        plan = assignment[e]
+        if (factor >= 1.0 - 1e-9 or plan is None
+                or plan.occupancy <= factor + 1e-9):
+            continue
+        swaps = [
+            j for j in full
+            if assignment[j] is not None
+            and assignment[j].occupancy <= factor + 1e-9
+            and assignment[j].occupancy < plan.occupancy
+        ]
+        if swaps:
+            j = min(swaps, key=lambda j: (assignment[j].occupancy, j))
+            assignment[e], assignment[j] = assignment[j], assignment[e]
+            moved[e] = {"swapped_with": j}
+            continue
+        hosts = [j for j in full if j != e]
+        if not hosts:
+            continue
+        j = min(hosts, key=lambda j: (
+            assignment[j].occupancy if assignment[j] is not None else 0.0,
+            j,
+        ))
+        assignment[j] = (fold_node_plans(assignment[j], plan)
+                         if assignment[j] is not None else plan)
+        assignment[e] = None
+        moved[e] = {"folded_into": j}
+    return moved
 
 
 def match_plans_to_engines(
@@ -233,13 +290,29 @@ class ReplanDecision:
     new_models: List[List[str]] = field(default_factory=list)
     migration_cost: float = 0.0
     rates: Dict[str, float] = field(default_factory=dict)
+    # Gray-failure pricing (ISSUE 9): the per-engine capacity factors the
+    # decision was made under (None = every engine priced as a full chip)
+    # and what the derate pass moved because of them.
+    capacity_factors: Optional[List[float]] = None
+    derated: Dict[int, Dict[str, int]] = field(default_factory=dict)
 
     def audit_fields(self) -> Dict[str, Any]:
         """The structured-audit payload (``scheduler/audit.py``), built
         fresh per call so rings never alias a shared dict."""
+        observed: Dict[str, Any] = {
+            "rates_rps": {k: round(v, 2) for k, v in self.rates.items()},
+        }
+        if self.capacity_factors is not None and any(
+            f < 1.0 for f in self.capacity_factors
+        ):
+            observed["capacity_factors"] = [
+                round(f, 3) for f in self.capacity_factors
+            ]
+            observed["derated"] = {
+                str(k): v for k, v in sorted(self.derated.items())
+            }
         return {
-            "observed": {"rates_rps": {k: round(v, 2)
-                                       for k, v in self.rates.items()}},
+            "observed": observed,
             "inputs": {
                 # The profile rows the packer committed to: per
                 # placement, the (batch, latency) row that sized it.
@@ -262,14 +335,31 @@ def decide_replan(
     engine_models: Sequence[frozenset],
     sessions: List[Session],
     rates: Dict[str, float],
+    capacity_factors: Optional[Sequence[float]] = None,
 ) -> ReplanDecision:
     """One replan, decided but not applied: bin-pack the sessions, match
     the resulting node plans onto the engines with minimal movement, and
     price the migration (the matcher's own objective — compile_ms +
-    weight-MB for models not already resident)."""
+    weight-MB for models not already resident).
+
+    ``capacity_factors`` (aligned with ``engine_models``; default all
+    1.0) prices gray-degraded engines as FRACTIONAL chips: after
+    matching, plans that overfill a derated engine are swapped with or
+    folded onto full-capacity peers (:func:`derate_for_capacity`) — the
+    probation story between alive and dead."""
     engine_models = [frozenset(m) for m in engine_models]
     plan = packer.plan(sessions)
     assignment = match_plans_to_engines(engine_models, plan, packer.profiles)
+    derated: Dict[int, Dict[str, int]] = {}
+    factors: Optional[List[float]] = None
+    if capacity_factors is not None:
+        factors = [float(f) for f in capacity_factors]
+        if len(factors) != len(engine_models):
+            raise ValueError(
+                f"capacity_factors has {len(factors)} entries for "
+                f"{len(engine_models)} engines"
+            )
+        derated = derate_for_capacity(assignment, factors)
     migration_cost = sum(
         transfer_cost(engine_models[e], n, packer.profiles)
         for e, n in enumerate(assignment)
@@ -284,4 +374,6 @@ def decide_replan(
         ],
         migration_cost=migration_cost,
         rates=dict(rates),
+        capacity_factors=factors,
+        derated=derated,
     )

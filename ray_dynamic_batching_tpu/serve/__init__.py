@@ -36,12 +36,18 @@ from ray_dynamic_batching_tpu.serve.failover import (
     DrainEvicted,
     FailoverManager,
     FailoverPolicy,
+    HedgeManager,
+    HedgePolicy,
     ReplicaDeadError,
     RetriesExhausted,
     RetryableSystemError,
     is_retryable,
     is_shed,
     reject_disposition,
+)
+from ray_dynamic_batching_tpu.serve.grayhealth import (
+    GrayHealthMonitor,
+    GrayHealthPolicy,
 )
 from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
 from ray_dynamic_batching_tpu.serve.llm import LLMDeployment, LLMReplica
@@ -81,6 +87,10 @@ __all__ = [
     "DrainEvicted",
     "FailoverManager",
     "FailoverPolicy",
+    "GrayHealthMonitor",
+    "GrayHealthPolicy",
+    "HedgeManager",
+    "HedgePolicy",
     "ReplicaDeadError",
     "RetriesExhausted",
     "RetryableSystemError",

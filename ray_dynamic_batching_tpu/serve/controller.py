@@ -91,6 +91,17 @@ class DeploymentConfig:
     # proxies BEFORE queueing; 0 = no admission control (admit all).
     admission_rate_rps: float = 0.0
     admission_burst: float = 0.0       # 0 -> defaults to the rate
+    # --- gray-failure defense (serve/grayhealth.py) ---
+    # Hedged dispatch for interactive-class requests ("The Tail at
+    # Scale"): when a primary dispatch exceeds the deployment's profiled
+    # p95 with no output, re-dispatch to a different replica and let the
+    # first winner cancel the loser. Per-deployment opt-in — the extra
+    # dispatches are the wrong trade under queue-bound overload.
+    hedge_interactive: bool = False
+    # Probation ticks of sustained slowness before a straggler replica
+    # is EJECTED (replaced like a dead one, chip reclaimed). 0 = detect
+    # and probation only, never auto-eject.
+    gray_eject_after: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         d = {
@@ -110,6 +121,8 @@ class DeploymentConfig:
             "default_qos_class": self.default_qos_class,
             "admission_rate_rps": self.admission_rate_rps,
             "admission_burst": self.admission_burst,
+            "hedge_interactive": self.hedge_interactive,
+            "gray_eject_after": self.gray_eject_after,
         }
         if self.autoscaling is not None:
             d["autoscaling"] = vars(self.autoscaling)
@@ -191,12 +204,28 @@ class ServeController:
                 self.register_factory(config.name, factory)
             if config.name not in self._factories:
                 raise KeyError(f"no factory registered for {config.name!r}")
+            from ray_dynamic_batching_tpu.serve.failover import (
+                HedgeManager,
+                HedgePolicy,
+            )
+            from ray_dynamic_batching_tpu.serve.grayhealth import (
+                GrayHealthPolicy,
+            )
+
             state = self._deployments.get(config.name)
             if state is None:
                 state = _DeploymentState(
                     config=config,
                     factory=self._factories[config.name],
-                    router=Router(config.name),
+                    router=Router(
+                        config.name,
+                        gray_policy=GrayHealthPolicy(
+                            eject_after=config.gray_eject_after
+                        ),
+                        hedge_policy=(HedgePolicy()
+                                      if config.hedge_interactive
+                                      else None),
+                    ),
                 )
                 # Breaker trip/recover events are control-plane decisions:
                 # they share the controller's audit ring with heals and
@@ -211,6 +240,20 @@ class ServeController:
                 prev_user = state.config.user_config
                 prev_version = state.config.version
                 state.config = config
+                # Gray/hedge knobs live on the ROUTER, not the replicas:
+                # a redeploy must reprice them here or status() reports
+                # the new config while the router keeps enforcing the
+                # old policy until the next controller restart.
+                router = state.router
+                if config.gray_eject_after != router.gray.policy.eject_after:
+                    router.gray.policy = GrayHealthPolicy(
+                        eject_after=config.gray_eject_after
+                    )
+                if config.hedge_interactive and router.hedge is None:
+                    router.hedge = HedgeManager(router, HedgePolicy())
+                elif not config.hedge_interactive and router.hedge is not None:
+                    router.hedge.close()
+                    router.hedge = None
                 # A redeploy may carry NEW code: future replica starts
                 # (rollout replacements included) must build from the
                 # freshly registered factory, not the one captured at
@@ -279,7 +322,7 @@ class ServeController:
             victims = state.replicas
             state.replicas = []
             self._publish(state)
-            state.router.failover.close()
+            state.router.close()
             self._checkpoint()
             self.audit.record(
                 "delete",
@@ -395,13 +438,20 @@ class ServeController:
         cfg = state.config
         deferred: List[Callable[[], None]] = []
         # Heal: replace dead replicas up to max_restarts
-        # (ref gcs_actor_manager.cc:1361-1393 restart budget).
+        # (ref gcs_actor_manager.cc:1361-1393 restart budget). A replica
+        # the gray-health monitor EJECTED (sustained straggling through
+        # its whole probation) rides the same path: replaced like a dead
+        # one, so the planner reclaims the chip from gray failures too.
         alive: List[Replica] = []
         for r in state.replicas:
-            if r.healthy():
+            ejected = state.router.gray.state(r.replica_id) == "ejected"
+            if r.healthy() and not ejected:
                 alive.append(r)
                 continue
-            logger.warning("replica %s unhealthy; replacing", r.replica_id)
+            logger.warning(
+                "replica %s %s; replacing", r.replica_id,
+                "gray-ejected (straggler)" if ejected else "unhealthy",
+            )
             # Salvage queued work, then stop the victim INLINE (its loop is
             # dead or wedged, so the join is bounded) — the replacement may
             # land on the same chips, which must be genuinely free: chip
@@ -448,6 +498,7 @@ class ServeController:
                 "heal",
                 key=cfg.name,
                 observed={"unhealthy": r.replica_id,
+                          "gray_ejected": ejected,
                           "salvaged_requests": len(salvaged)},
                 diff={
                     "replaced": r.replica_id,
@@ -566,6 +617,21 @@ class ServeController:
         )
 
     # --- control loop -----------------------------------------------------
+    def _observe_gray(self, state: "_DeploymentState") -> None:
+        """Tick the deployment's gray-health monitor with per-replica
+        recent-latency sketches (PR 8's RollingSketch — recency-bounded,
+        so the consensus describes the replica NOW). The monitor grades
+        only replicas with enough samples and enough graded peers; the
+        state machine's hysteresis does the rest."""
+        obs = {}
+        for r in state.replicas:
+            try:
+                obs[r.replica_id] = r.latency_observation()
+            except Exception:  # noqa: BLE001 — stats must not stop control
+                continue
+        if len(obs) >= 2:
+            state.router.gray.tick(obs)
+
     def _observe_admission(self, state: "_DeploymentState") -> None:
         """Feed the overload governor this deployment's congestion
         signals: worst replica queue-fill fraction + worst recent SLO
@@ -588,6 +654,7 @@ class ServeController:
         deferred: List[Callable[[], None]] = []
         with self._lock:
             for state in list(self._deployments.values()):
+                self._observe_gray(state)
                 self._observe_admission(state)
                 if state.policy is not None:
                     metrics = state.router.demand_metrics()
@@ -638,7 +705,7 @@ class ServeController:
             for state in self._deployments.values():
                 victims.extend((state, r) for r in state.replicas)
                 state.replicas = []
-                state.router.failover.close()
+                state.router.close()
         for state, r in victims:
             r.stop()
             self._release_chips(state, r)
@@ -691,6 +758,11 @@ class ServeController:
                     # the observable half of request-level fault tolerance.
                     "breakers": state.router.breaker_states(),
                     "failover": state.router.failover.stats(),
+                    # Gray-health verdicts + hedge accounting (ISSUE 9):
+                    # the straggler-defense half of fault tolerance.
+                    "gray": state.router.gray.snapshot(),
+                    "hedge": (state.router.hedge.stats()
+                              if state.router.hedge is not None else None),
                     # Admission governor state (serve/admission.py):
                     # normal vs degraded + whether a policy is installed.
                     "admission": self.admission.snapshot(name),

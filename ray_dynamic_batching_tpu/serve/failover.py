@@ -43,6 +43,7 @@ from ray_dynamic_batching_tpu.engine.request import (
     RequestStale,
     now_ms,
 )
+from ray_dynamic_batching_tpu.serve.grayhealth import median_or_zero
 from ray_dynamic_batching_tpu.utils.chaos import ChaosInjected
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -57,6 +58,14 @@ FAILOVER_RETRIES = m.Counter(
 FAILOVER_SHED = m.Counter(
     "rdb_failover_shed_total", "Requests shed by the failover layer",
     tag_keys=("deployment", "reason"),
+)
+HEDGE_TOTAL = m.Counter(
+    "rdb_hedge_total",
+    "Hedge timer outcomes (won: the hedge dispatch delivered the result; "
+    "lost: the primary beat a dispatched hedge or the hedge arm failed; "
+    "late: the timer fired but no hedge was dispatched — request already "
+    "done, first token emitted, deadline too tight, or no second replica)",
+    tag_keys=("deployment", "outcome"),
 )
 
 
@@ -400,3 +409,403 @@ class FailoverManager:
             "stream_aborted": float(self.stream_aborted),
             "pending": float(len(self._heap)),
         }
+
+
+# --- hedged dispatch (gray-failure mitigation, ISSUE 9) ---------------------
+#
+# Detection (serve/grayhealth.py) converges over monitor ticks; the requests
+# dispatched onto a straggler in the meantime still miss their deadlines.
+# Hedging mitigates per-request ("The Tail at Scale": defer the hedge until
+# the p95, cap it at one extra dispatch — 5% added load for most of the tail
+# win): when a hedge-eligible request has produced NOTHING by the
+# deployment's profiled p95, re-dispatch it to a DIFFERENT replica and let
+# the first winner cancel the loser. The PR-4 at-most-once-after-first-token
+# rule is the hard boundary: a request whose stream emitted a chunk is never
+# hedged, and the race claim fires on the first-token edge itself
+# (TokenStream.on_first_emit), so the client can never observe two sources.
+
+
+@dataclass
+class HedgePolicy:
+    """Hedge knobs. Hedging is per-deployment OPT-IN (a Router built
+    without a policy never hedges): the extra dispatches are the wrong
+    trade under overload, where the queue — not a straggler — is the
+    bottleneck."""
+
+    # Service tiers eligible for hedging (interactive is the contract
+    # whose tail the hedge exists to protect).
+    qos_classes: tuple = ("interactive",)
+    # Hedge delay = factor x the deployment's profiled p95 (peer-median
+    # across replicas so a straggler cannot inflate its own hedge bar).
+    threshold_factor: float = 1.0
+    # Floor under the computed delay: below this, the hedge would race
+    # healthy jitter instead of stragglers.
+    min_threshold_ms: float = 10.0
+    # How long a computed threshold stays cached. The peer-median p95
+    # moves on monitor-tick timescales; recomputing it (a locked sketch
+    # walk per replica) on EVERY interactive dispatch is hot-path waste.
+    threshold_refresh_ms: float = 100.0
+
+
+
+
+class _HedgeRace:
+    """First-winner resolution between a primary dispatch and its hedge.
+
+    Exactly one of ``primary`` / ``hedge`` claims; the loser is
+    cancelled. The outcome settles exactly once (``won``/``lost``) no
+    matter how many callbacks observe the finish."""
+
+    __slots__ = ("primary", "shadow", "primary_replica", "_lock",
+                 "winner", "settled", "dispatched")
+
+    def __init__(self, primary: Request, shadow: Request,
+                 primary_replica: str) -> None:
+        self.primary = primary
+        self.shadow = shadow
+        self.primary_replica = primary_replica
+        self._lock = threading.Lock()
+        self.winner: Optional[str] = None
+        self.settled = False
+        self.dispatched = False
+
+    def claim(self, who: str) -> bool:
+        with self._lock:
+            if self.winner is None:
+                self.winner = who
+                # The loser's cancellation is visible BEFORE claim
+                # returns: its next stream_put / fulfill / reject — on
+                # any thread — already sees it, closing the window where
+                # a loser's in-flight chunk lands after the claim.
+                loser = self.shadow if who == "primary" else self.primary
+                loser.cancelled = True
+                return True
+            return False
+
+    def try_dispatch(self) -> bool:
+        """Atomically decide the shadow may go out: False when either
+        arm already claimed (the fire-time checks raced a finish). The
+        shared lock with :meth:`claim` closes the window where a
+        primary finish lands between the check and the dispatch —
+        whichever acquires first, exactly one side owns the outcome."""
+        with self._lock:
+            if self.winner is not None:
+                return False
+            self.dispatched = True
+            return True
+
+    def was_dispatched(self) -> bool:
+        with self._lock:
+            return self.dispatched
+
+    def settle(self) -> bool:
+        """True exactly once — the caller owns recording the outcome."""
+        with self._lock:
+            if self.settled:
+                return False
+            self.settled = True
+            return True
+
+
+class HedgeManager:
+    """Deadline-budgeted hedged dispatch for one deployment's router.
+
+    ``arm()`` is called by the router after every successful PRIMARY
+    assign of an eligible request; a worker thread fires each timer at
+    ``now + profiled p95`` and — if the request has produced nothing —
+    dispatches a shadow copy to a different replica through the same
+    ``assign_request`` machinery failover uses. Outcome accounting
+    conserves: ``fired == dispatched + late`` and, once races settle,
+    ``dispatched == won + lost`` (asserted by the straggler soak)."""
+
+    def __init__(self, router: Any, policy: HedgePolicy) -> None:
+        self.router = router
+        self.policy = policy
+        self._seq = itertools.count()
+        # (due_monotonic_ms, seq, request, primary_replica_id)
+        self._heap: List[Tuple[float, int, Request, str]] = []
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._threshold_cache: Tuple[float, float] = (0.0, float("-inf"))
+        self._stats_lock = threading.Lock()
+        self.armed = 0
+        self.fired = 0
+        self.dispatched = 0
+        self.won = 0
+        self.lost = 0
+        self.late = 0
+
+    # --- arming (router hot path: one eligibility check + heap push) ------
+    def eligible(self, request: Request) -> bool:
+        return (
+            not request.is_hedge
+            and not getattr(request, "_hedge_armed", False)
+            and request.qos_class in self.policy.qos_classes
+        )
+
+    def threshold_ms(self) -> float:
+        """The deployment's profiled p95: peer-MEDIAN across replicas
+        (a straggler's own inflated tail must not raise its hedge bar),
+        floored so healthy jitter never races itself. Cached for
+        ``threshold_refresh_ms`` — the sweep walks a locked sketch per
+        replica, too heavy for the per-dispatch arm path."""
+        now = m.now_ms()
+        cached_val, cached_at = self._threshold_cache
+        if now - cached_at < self.policy.threshold_refresh_ms:
+            return cached_val
+        p95s = []
+        for r in self.router.replicas():
+            try:
+                v = r.latency_observation()[1]
+            except Exception:  # noqa: BLE001 — stats must not break routing
+                continue
+            if v > 0:
+                p95s.append(v)
+        value = max(
+            self.policy.min_threshold_ms,
+            self.policy.threshold_factor * median_or_zero(p95s),
+        )
+        self._threshold_cache = (value, now)  # atomic tuple swap
+        return value
+
+    def arm(self, request: Request, replica_id: str) -> bool:
+        if not self.eligible(request):
+            return False
+        if len(self.router.replicas()) < 2:
+            return False  # nobody to hedge onto
+        request._hedge_armed = True  # one hedge per request, ever
+        due = m.now_ms() + self.threshold_ms()
+        with self._cond:
+            if self._stopped:
+                return False
+            heapq.heappush(
+                self._heap, (due, next(self._seq), request, replica_id)
+            )
+            self._ensure_worker()
+            self._cond.notify()
+        with self._stats_lock:
+            self.armed += 1
+        return True
+
+    # --- firing -----------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker,
+                name=f"hedge-{self.router.deployment}", daemon=True,
+            )
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopped and (
+                    not self._heap or self._heap[0][0] > m.now_ms()
+                ):
+                    timeout = None
+                    if self._heap:
+                        timeout = max(
+                            (self._heap[0][0] - m.now_ms()) / 1000.0, 0.0
+                        )
+                    self._cond.wait(timeout)
+                if self._stopped:
+                    return
+                _due, _seq, request, primary_replica = heapq.heappop(
+                    self._heap
+                )
+            try:
+                self._fire(request, primary_replica)
+            except Exception:  # noqa: BLE001 — one bad hedge must not kill
+                # the worker; the primary dispatch is unaffected either way.
+                logger.exception(
+                    "%s: hedge dispatch failed", self.router.deployment
+                )
+
+    def _outcome(self, outcome: str) -> None:
+        with self._stats_lock:
+            setattr(self, outcome, getattr(self, outcome) + 1)
+        HEDGE_TOTAL.inc(tags={"deployment": self.router.deployment,
+                              "outcome": outcome})
+
+    def _fire(self, request: Request, primary_replica: str) -> None:
+        with self._stats_lock:
+            self.fired += 1
+        # A failover re-dispatch may have moved the request since arm
+        # time: strike and exclude the replica CURRENTLY holding it, not
+        # the one captured at arm — else the shadow can land on the very
+        # replica the primary is stuck on (racing itself) while a dead
+        # peer's breaker takes the slow strike.
+        primary_replica = getattr(
+            request, "_assigned_replica", primary_replica
+        )
+        # The at-most-once-after-first-token pin, checked at the source:
+        # a request that completed, failed, was cancelled, or emitted a
+        # chunk is never hedged.
+        if (
+            request.cancelled
+            or request.future.done()
+            or (request.stream is not None and request.stream.emitted > 0)
+        ):
+            self._outcome("late")
+            return
+        others = [r for r in self.router.replicas()
+                  if r.replica_id != primary_replica]
+        remaining = request.remaining_ms()
+        if not others or remaining < self.router.failover._expected_latency_ms():
+            # No second replica / no deadline budget for a second
+            # dispatch: the hedge would only add load, never save the
+            # request.
+            self._outcome("late")
+            return
+        # The primary exceeded the deployment's profiled p95 with nothing
+        # to show — a deadline-exceeded dispatch. Strike its breaker
+        # (capped + audited there) so a persistent straggler cannot hold
+        # a breaker closed with slow successes.
+        self.router.record_replica_slow(primary_replica)
+        shadow = Request(
+            model=request.model,
+            payload=request.payload,
+            slo_ms=request.slo_ms,
+            request_id=f"{request.request_id}#hedge",
+            seq_len=request.seq_len,
+            trace_ctx=dict(request.trace_ctx),
+            multiplexed_model_id=request.multiplexed_model_id,
+            tenant=request.tenant,
+            qos_class=request.qos_class,
+            is_hedge=True,
+        )
+        # The shadow races the PRIMARY's admission deadline — a hedge
+        # never buys a fresh SLO clock.
+        shadow.admission_deadline_ms = request.admission_deadline_ms
+        race = _HedgeRace(request, shadow, primary_replica)
+        if request.stream is not None:
+            from ray_dynamic_batching_tpu.engine.request import TokenStream
+
+            shadow.stream = TokenStream()
+            shadow.stream.on_first_emit = (
+                lambda: self._shadow_first_token(race)
+            )
+            request.stream.on_first_emit = (
+                lambda: self._primary_first_token(race)
+            )
+            if request.stream.emitted > 0:
+                # Token raced the hook installation: the pin wins.
+                self._primary_finished(race)
+        request.future.add_done_callback(
+            lambda _f: self._primary_finished(race)
+        )
+        shadow.future.add_done_callback(
+            lambda f: self._shadow_done(race, f)
+        )
+        if not race.try_dispatch():
+            # The primary finished between the eligibility checks and
+            # here — no shadow went out, the timer just fired late.
+            self._outcome("late")
+            return
+        with self._stats_lock:
+            self.dispatched += 1
+        # assign_request owns terminal rejection: even a refused hedge
+        # resolves the shadow future, so won + lost always reconciles
+        # against dispatched.
+        self.router.assign_request(
+            shadow,
+            exclude={primary_replica},
+            timeout_s=max(remaining / 1000.0, 0.001),
+        )
+        if tracer().enabled:
+            tracer().record_span(
+                "hedge.dispatch",
+                ctx=request.trace_ctx,
+                start_ms=m.now_ms(),
+                end_ms=m.now_ms(),
+                deployment=self.router.deployment,
+                lane=self.router.deployment,
+                primary_replica=primary_replica,
+            )
+
+    # --- race callbacks ---------------------------------------------------
+    def _primary_finished(self, race: _HedgeRace) -> None:
+        """The primary produced something (first token, result, or a
+        terminal rejection): cancel the hedge arm. A primary future
+        resolved BY the hedge arrives here too — the claim check keeps
+        that from cancelling the winner."""
+        if race.claim("primary"):
+            race.shadow.cancel()
+            # Only a DISPATCHED shadow settles here ("lost"): if the
+            # claim beat try_dispatch, _fire records "late" instead. A
+            # cancelled-in-queue shadow is discarded without resolving
+            # its future, so this is the loser's one accounting site.
+            if race.was_dispatched() and race.settle():
+                self._outcome("lost")
+
+    def _primary_first_token(self, race: _HedgeRace) -> Optional[bool]:
+        """First-emit hook on the PRIMARY's stream: resolve the race,
+        then tell the stream whether the triggering chunk may deliver —
+        ``False`` (veto) when the shadow claimed while this chunk was in
+        flight; the grafted winner owns the client stream."""
+        self._primary_finished(race)
+        return race.winner != "hedge"
+
+    def _shadow_first_token(self, race: _HedgeRace) -> None:
+        """The hedge produced the FIRST token of the whole request:
+        claim, cancel the primary, and graft the shadow's stream onto
+        the client's (buffered chunks replay in order, then inline)."""
+        if not race.claim("hedge"):
+            return  # primary won: shadow chunks drop into the void
+        race.primary.cancel()
+        primary_stream = race.primary.stream
+        # The race is resolved: detach the primary's first-emit hook so
+        # the WINNER's grafted chunks (which also ride this stream) are
+        # not vetoed by it.
+        primary_stream.on_first_emit = None
+        race.shadow.stream.subscribe(
+            primary_stream.put,
+            lambda err: (primary_stream.abort(err) if err is not None
+                         else primary_stream.close()),
+        )
+
+    def _shadow_done(self, race: _HedgeRace, fut) -> None:
+        exc = fut.exception()
+        if exc is not None:
+            # The hedge arm failed (shed, retries exhausted, refused):
+            # the primary keeps racing its own deadline untouched —
+            # UNLESS the shadow already claimed on its first token and
+            # cancelled the primary. A cancelled primary is discarded at
+            # queue pop without resolving its future, so the claimed-
+            # then-failed shadow is the client's last chance at an
+            # answer: reject (aborts the grafted stream too, idempotent
+            # if the straggler's own late completion raced us).
+            if race.winner == "hedge":
+                race.primary.reject(exc, force=True)
+            if race.settle():
+                self._outcome("lost")
+            return
+        won = race.claim("hedge") or race.winner == "hedge"
+        if won:
+            race.primary.cancel()
+            race.primary.fulfill(fut.result(), force=True)
+            if race.settle():
+                self._outcome("won")
+        else:
+            if race.settle():
+                self._outcome("lost")
+
+    # --- lifecycle / stats ------------------------------------------------
+    def close(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._heap = []
+            self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            return {
+                "armed": float(self.armed),
+                "fired": float(self.fired),
+                "dispatched": float(self.dispatched),
+                "won": float(self.won),
+                "lost": float(self.lost),
+                "late": float(self.late),
+                "pending": float(len(self._heap)),
+            }

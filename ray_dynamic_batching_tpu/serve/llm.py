@@ -201,6 +201,19 @@ class LLMReplica(Replica):
         qs = list(self._queues.values())
         return min((q.slo_compliance() for q in qs), default=1.0)
 
+    def latency_observation(self) -> tuple:
+        """Merged recent-latency sketch across the bucket queues (the
+        closed base queue would leave this replica permanently ungraded
+        by the gray detector and pin the hedge bar at its floor —
+        exactly the blindness :meth:`slo_compliance` fixes for the
+        governor)."""
+        from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
+
+        views = [q.latency_window.view() for q in self._queues.values()]
+        merged = QuantileSketch.merged(views)
+        return (merged.percentile(0.5), merged.percentile(0.95),
+                len(merged))
+
     # --- router-facing surface --------------------------------------------
     def queue_len(self) -> int:
         return sum(

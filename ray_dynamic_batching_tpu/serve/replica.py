@@ -209,6 +209,17 @@ class Replica:
         _current.replica = self  # visible to in-callable framework hooks
         try:
             chaos().maybe_fail("replica.process_batch")
+            # Gray-failure injection (ISSUE 9): a seeded slowdown verdict
+            # makes THIS batch degrade — stall before any output, run a
+            # latency multiple, or withhold EOS — without erroring, which
+            # is exactly the failure class the breaker used to miss.
+            slowdown = chaos().slowdown(
+                "replica.process_batch", instance=self.replica_id
+            )
+            if (slowdown is not None
+                    and slowdown.mode == "stall_before_first_token"):
+                time.sleep(slowdown.ms / 1000.0)  # rdb-lint: disable=event-loop-blocking (chaos-injected stall on the replica's own worker thread; no event loop involved)
+            exec_started = time.monotonic()
             with ExitStack() as spans:
                 if tracer().enabled:
                     # One span for the BATCH execution, linked to every
@@ -245,6 +256,17 @@ class Replica:
                     f"callable returned {len(results)} results for "
                     f"{len(batch)} requests"
                 )
+            if slowdown is not None:
+                if slowdown.mode == "latency_multiplier":
+                    # The batch "runs" factor x as long as it measured —
+                    # chunks (if any) already streamed, completion drags.
+                    extra_s = max(0.0, (slowdown.factor - 1.0)
+                                  * (time.monotonic() - exec_started))
+                    time.sleep(extra_s)  # rdb-lint: disable=event-loop-blocking (chaos-injected slowdown on the replica's own worker thread; no event loop involved)
+                elif slowdown.mode == "stuck_stream":
+                    # Output exists, EOS never arrives: the stream stays
+                    # open for ms of dead air before fulfill closes it.
+                    time.sleep(slowdown.ms / 1000.0)  # rdb-lint: disable=event-loop-blocking (chaos-injected stuck stream on the replica's own worker thread; no event loop involved)
             for req, res in zip(batch, results):
                 req.fulfill(res)
             self.queue.record_batch_completion(batch)
@@ -385,6 +407,17 @@ class Replica:
         (LLMReplica's per-bucket queues) override to read the queues
         that actually carry requests."""
         return self.queue.slo_compliance()
+
+    def latency_observation(self) -> tuple:
+        """``(p50_ms, p95_ms, n)`` over this replica's recent batch
+        completions — the gray detector's per-tick observation and the
+        hedge bar's p95 source share this one accessor. Subclasses whose
+        traffic bypasses the base queue (LLMReplica) override it, for
+        the same reason as :meth:`slo_compliance`: the closed base
+        queue's empty sketch would leave the replica permanently
+        ungraded and the hedge bar at its floor."""
+        win = self.queue.latency_window
+        return (win.percentile(0.5), win.percentile(0.95), len(win))
 
     def stats(self) -> dict:
         s = self.queue.stats()

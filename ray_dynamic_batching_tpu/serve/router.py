@@ -34,6 +34,12 @@ from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
 from ray_dynamic_batching_tpu.serve.failover import (
     FailoverManager,
     FailoverPolicy,
+    HedgeManager,
+    HedgePolicy,
+)
+from ray_dynamic_batching_tpu.serve.grayhealth import (
+    GrayHealthMonitor,
+    GrayHealthPolicy,
 )
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.utils.chaos import chaos
@@ -58,6 +64,12 @@ BACKOFF_MAX_S = 0.1
 
 BREAKER_FAILURE_THRESHOLD = 3        # consecutive system failures to trip
 BREAKER_COOLDOWN_S = 1.0             # open -> half-open probe delay
+# Slow strikes (deadline-exceeded / hedge-lost dispatches) needed to trip a
+# breaker on a replica that is slow-but-SUCCEEDING. Deliberately above the
+# failure threshold (slowness is softer evidence than an error), and NOT
+# reset by ordinary successes — that reset is exactly how a persistent
+# straggler used to hold its breaker closed forever.
+BREAKER_SLOW_THRESHOLD = 5
 
 
 class CircuitBreaker:
@@ -72,13 +84,16 @@ class CircuitBreaker:
 
     def __init__(self, threshold: int = BREAKER_FAILURE_THRESHOLD,
                  cooldown_s: float = BREAKER_COOLDOWN_S,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic,
+                 slow_threshold: int = BREAKER_SLOW_THRESHOLD) -> None:
         self.threshold = threshold
         self.cooldown_s = cooldown_s
+        self.slow_threshold = slow_threshold
         self._clock = clock
         self._lock = threading.Lock()
         self._state = "closed"
         self._consecutive_failures = 0
+        self._slow_strikes = 0
         self._opened_at = 0.0
         self._half_open_at = 0.0
         self.trip_count = 0
@@ -151,13 +166,37 @@ class CircuitBreaker:
                 return self._consecutive_failures
             return None
 
+    def record_slow(self) -> Optional[int]:
+        """Count one slow strike (a deadline-exceeded or hedge-lost
+        dispatch — the request SUCCEEDED, too late). Strikes accumulate
+        across ordinary successes (a straggler's slow successes must not
+        keep resetting the evidence) and are CAPPED two ways: only a
+        closed breaker accrues them (no stacking while open/half-open),
+        and a half-open probe's success clears them (genuine recovery
+        starts clean). Returns the strike count on the trip edge, else
+        None."""
+        with self._lock:
+            if self._state != "closed":
+                return None
+            self._slow_strikes += 1
+            if self._slow_strikes < self.slow_threshold:
+                return None
+            self._state = "open"
+            self._opened_at = self._clock()
+            self.trip_count += 1
+            tripped_at = self._slow_strikes
+            self._slow_strikes = 0
+            return tripped_at
+
     def record_success(self) -> bool:
         """Count one success; True when it CLOSED an open/half-open
-        breaker (recovery edge)."""
+        breaker (recovery edge — which also clears slow strikes: the
+        probe proved the replica healthy again)."""
         with self._lock:
             self._consecutive_failures = 0
             if self._state != "closed":
                 self._state = "closed"
+                self._slow_strikes = 0
                 return True
             return False
 
@@ -166,6 +205,7 @@ class CircuitBreaker:
             return {
                 "state": self._state,
                 "consecutive_failures": self._consecutive_failures,
+                "slow_strikes": self._slow_strikes,
                 "trips": self.trip_count,
             }
 
@@ -189,6 +229,9 @@ class Router:
         failover_policy: Optional[FailoverPolicy] = None,
         breaker_threshold: int = BREAKER_FAILURE_THRESHOLD,
         breaker_cooldown_s: float = BREAKER_COOLDOWN_S,
+        breaker_slow_threshold: int = BREAKER_SLOW_THRESHOLD,
+        gray_policy: Optional[GrayHealthPolicy] = None,
+        hedge_policy: Optional[HedgePolicy] = None,
     ) -> None:
         self.deployment = deployment
         self.max_assign_timeout_s = max_assign_timeout_s
@@ -202,13 +245,34 @@ class Router:
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._breaker_threshold = breaker_threshold
         self._breaker_cooldown_s = breaker_cooldown_s
+        self._breaker_slow_threshold = breaker_slow_threshold
         self.failover = FailoverManager(self, policy=failover_policy)
+        # Gray-failure detection (serve/grayhealth.py): the controller
+        # ticks it with per-replica latency sketches; routing consults it
+        # — probationed replicas leave the pow-2 pool except for probes.
+        self.gray = GrayHealthMonitor(deployment, policy=gray_policy)
+        # Hedged dispatch (serve/failover.HedgeManager), per-deployment
+        # opt-in: None = never hedge.
+        self.hedge = (HedgeManager(self, hedge_policy)
+                      if hedge_policy is not None else None)
         # Optional decision ring (the controller shares its own): breaker
         # trip/recover events are control-plane decisions and belong next
         # to heals and scale moves.
-        self.audit = None
+        self._audit = None
         for r in self._replicas:
             self._wire(r)
+
+    @property
+    def audit(self):
+        return self._audit
+
+    @audit.setter
+    def audit(self, ring) -> None:
+        # One ring for every routing-layer decision family: breaker
+        # trips, gray transitions, and (via _wire) queue displacement
+        # sheds all land in the controller's shared timeline.
+        self._audit = ring
+        self.gray.audit = ring
 
     def _wire(self, replica: Replica) -> None:
         if hasattr(replica, "failure_sink"):
@@ -228,6 +292,7 @@ class Router:
             live = {r.replica_id for r in replicas}
             for rid in [b for b in self._breakers if b not in live]:
                 del self._breakers[rid]
+        self.gray.prune(live)
         for r in replicas:
             self._wire(r)
         logger.info(
@@ -245,7 +310,8 @@ class Router:
             br = self._breakers.get(replica_id)
             if br is None:
                 br = self._breakers[replica_id] = CircuitBreaker(
-                    self._breaker_threshold, self._breaker_cooldown_s
+                    self._breaker_threshold, self._breaker_cooldown_s,
+                    slow_threshold=self._breaker_slow_threshold,
                 )
             return br
 
@@ -265,6 +331,31 @@ class Router:
                               "consecutive_failures": tripped_at},
                     after={"state": "open"},
                     diff={"excluded": replica_id},
+                )
+
+    def record_replica_slow(self, replica_id: str) -> None:
+        """One slow strike (deadline-exceeded / hedge-lost dispatch)
+        against this replica's breaker. Soft evidence with its own
+        higher threshold — but it accumulates across successes, so a
+        slow-but-succeeding straggler eventually trips (PR-4 bugfix)."""
+        br = self._breaker(replica_id)
+        tripped_at = br.record_slow()
+        if tripped_at is not None:
+            logger.warning(
+                "%s: circuit breaker OPEN for %s after %d slow strikes "
+                "(deadline-exceeded/hedge-lost dispatches)",
+                self.deployment, replica_id, tripped_at,
+            )
+            if self.audit is not None:
+                self.audit.record(
+                    "breaker_trip",
+                    key=self.deployment,
+                    observed={"replica": replica_id,
+                              "slow_strikes": tripped_at},
+                    after={"state": "open"},
+                    diff={"excluded": replica_id},
+                    note="slow-but-succeeding straggler (hedge/deadline "
+                         "strikes)",
                 )
 
     def record_replica_success(self, replica_id: str) -> None:
@@ -384,11 +475,26 @@ class Router:
                 # (read-only eligibility — the probe slot is claimed only
                 # at dispatch, below, so an unchosen candidate never
                 # wedges the breaker in half-open).
-                candidates = [
+                graded = [
                     r for r in accepting
                     if self._breaker(r.replica_id).eligible()
                 ]
-                breaker_excluded_last = bool(accepting) and not candidates
+                breaker_excluded_last = bool(accepting) and not graded
+                # Gray gate: probationed replicas are DRAINED from the
+                # pow-2 pool except when their probe window is due (the
+                # half-open arm, generalized to slowness); ejected ones
+                # never serve. A verdict that would empty the pool falls
+                # back to the non-ejected set — a wrong gray call must
+                # degrade latency, never blackhole the deployment.
+                candidates = [
+                    r for r in graded
+                    if self.gray.is_candidate(r.replica_id)
+                ]
+                if not candidates:
+                    candidates = [
+                        r for r in graded
+                        if self.gray.state(r.replica_id) != "ejected"
+                    ] or graded
                 chosen = self._choose(
                     candidates, locality_hint, request.multiplexed_model_id
                 )
@@ -407,8 +513,17 @@ class Router:
                         # Invalidate the cache entry so bursts spread out.
                         self._len_cache.pop(chosen.replica_id, None)
                         request.attempts += 1
+                        # The hedge fire path reads this: a failover
+                        # re-dispatch moves the request, and the timer
+                        # armed at first assign must follow it.
+                        request._assigned_replica = chosen.replica_id
                         self.total_routed += 1
                         ROUTED_TOTAL.inc(tags={"deployment": self.deployment})
+                        # A dispatch onto a probationed replica IS its
+                        # probe: start the next probe window.
+                        self.gray.mark_probe(chosen.replica_id)
+                        if self.hedge is not None:
+                            self.hedge.arm(request, chosen.replica_id)
                         if sp is not None:
                             sp.attributes.update(
                                 attempts=attempts, replica=chosen.replica_id
@@ -442,6 +557,13 @@ class Router:
                     return False
                 time.sleep(backoff)  # rdb-lint: disable=event-loop-blocking (caller-thread backoff by contract: the asyncio proxy offloads handle.remote to its routing pool, so this never runs on the event loop)
                 backoff = min(backoff * 2, BACKOFF_MAX_S)
+
+    def close(self) -> None:
+        """Stop the failover and hedge workers (terminal rejection of
+        anything still pending belongs to the failover layer)."""
+        self.failover.close()
+        if self.hedge is not None:
+            self.hedge.close()
 
     # --- autoscaler metrics (ref RouterMetricsManager) --------------------
     def demand_metrics(self) -> Dict[str, float]:

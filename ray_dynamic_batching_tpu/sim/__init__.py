@@ -21,12 +21,15 @@ from ray_dynamic_batching_tpu.sim.queue import (
 from ray_dynamic_batching_tpu.sim.report import (
     compare_reports,
     format_compare,
+    format_gray_timeline,
+    gray_timeline,
     hop_drift_report,
     merged_hop_sketches,
     render_json,
     slo_attainment,
 )
 from ray_dynamic_batching_tpu.sim.simulator import (
+    EngineDegradation,
     EngineFailure,
     Scenario,
     SimModelSpec,
@@ -50,10 +53,13 @@ __all__ = [
     "SimRequestQueue",
     "compare_reports",
     "format_compare",
+    "format_gray_timeline",
+    "gray_timeline",
     "hop_drift_report",
     "merged_hop_sketches",
     "render_json",
     "slo_attainment",
+    "EngineDegradation",
     "EngineFailure",
     "Scenario",
     "SimModelSpec",

@@ -36,9 +36,17 @@ from ray_dynamic_batching_tpu.scheduler.replan import (
     decide_replan,
     sessions_for,
 )
+from ray_dynamic_batching_tpu.serve.grayhealth import (
+    GrayHealthMonitor,
+    GrayHealthPolicy,
+    ratio_observations,
+)
 from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
 from ray_dynamic_batching_tpu.sim.engine import SimEngine
-from ray_dynamic_batching_tpu.sim.queue import SimQueueManager, SimRequest
+from ray_dynamic_batching_tpu.sim.queue import (
+    SimQueueManager,
+    SimRequest,
+)
 
 
 class SimScheduler:
@@ -56,6 +64,7 @@ class SimScheduler:
         rate_decrease_multiplier: float = 2.0,
         rate_window_s: float = 10.0,
         rate_min_span_s: float = 0.0,
+        gray_policy: Optional[GrayHealthPolicy] = None,
     ) -> None:
         self.packer = packer
         self.engines = list(engines)
@@ -72,6 +81,29 @@ class SimScheduler:
         self._current_plan: List[NodePlan] = []
         self._monitor_until_ms = 0.0
         self._dead_engines: set = set()
+        # Gray-failure monitoring (the SAME detector the serve tier
+        # runs — serve/grayhealth.py — on the virtual clock, fed with
+        # observed/expected step-latency ratios instead of ms so a
+        # multi-model engine grades model-agnostically). None = disabled:
+        # canon scenarios stay byte-identical.
+        self.gray: Optional[GrayHealthMonitor] = None
+        if gray_policy is not None:
+            self.gray = GrayHealthMonitor(
+                "sim", policy=gray_policy, clock=clock.now_s
+            )
+            self.gray.audit = self.audit
+            for e in self.engines:
+                e.track_ratios = True
+        self._gray_ejected: set = set()
+        # Per-engine ratio window over the last N monitor TICKS: a
+        # 10x-slowed engine finishes ~10x FEWER batches per tick, so
+        # grading only each tick's drain would starve detection of the
+        # very samples that prove the slowdown — while a sample-count
+        # window would go stale the moment a probation replan idles the
+        # engine. Tick-bounding gives both: slow evidence stays visible
+        # across ticks, and a heal flushes within window_ticks.
+        self._gray_window_ticks = 3
+        self._gray_windows: Dict[str, List[List[float]]] = {}
         self.schedule_changes = 0
         self.schedule_log: List[Dict] = []
         # Optional serve.admission.AdmissionController built on the
@@ -129,12 +161,23 @@ class SimScheduler:
         trigger: str = "manual",
     ) -> List[NodePlan]:
         rates = rates if rates is not None else self.rates.rates()
-        alive = [e for e in self.engines if e.healthy()]
+        # A gray-EJECTED engine leaves planning exactly like a dead one
+        # (the chip is reclaimed); probation prices as fractional
+        # capacity via decide_replan's derate pass.
+        alive = [
+            e for e in self.engines
+            if e.healthy() and e.engine_id not in self._gray_ejected
+        ]
+        factors = None
+        if self.gray is not None:
+            factors = [self.gray.capacity_factor(e.engine_id)
+                       for e in alive]
         decision = decide_replan(
             self.packer,
             [frozenset(e.models) for e in alive],
             sessions_for(self._models, rates),
             rates,
+            capacity_factors=factors,
         )
         for engine, node_plan in zip(alive, decision.assignment):
             if node_plan is not None:
@@ -196,6 +239,49 @@ class SimScheduler:
         self.rebalance(trigger="heal")
         return True
 
+    def check_gray_health(self) -> bool:
+        """The gray analogue of :meth:`check_engine_health`: tick the
+        detector with each engine's fresh observed/expected step ratios
+        and replan when any verdict changed (probation reprices the
+        engine as fractional capacity; ejection reclaims it like a
+        death). Returns True when a gray replan fired."""
+        if self.gray is None:
+            return False
+        live = [e for e in self.engines
+                if e.healthy() and e.engine_id not in self._gray_ejected]
+        # Synthetic probation probes: the probation replan may have
+        # emptied an engine's plan; the LIVE router still probes a
+        # probationed replica (one request per probe window). The sim
+        # twin: one probe per tick reading the engine's current cost
+        # ratio (stall included — a stall-only straggler must not grade
+        # healthy), so a heal stays observable.
+        probes = {
+            e.engine_id: e.probe_ratio() for e in live
+            if self.gray.state(e.engine_id) == "probation"
+        }
+        obs = ratio_observations(
+            {e.engine_id: e.drain_ratios() for e in live},
+            self._gray_windows, self._gray_window_ticks, probes=probes,
+        )
+        transitions = self.gray.tick(obs)
+        # Replan only on transitions that change the planner's PRICING
+        # (into/out of probation, or ejection): healthy<->suspect leaves
+        # every capacity factor at 1.0, so a replan would re-pack the
+        # identical inputs and emit audit noise.
+        repricing = [t for t in transitions
+                     if "probation" in (t["from"], t["to"])
+                     or t["to"] == "ejected"]
+        if not repricing:
+            return False
+        for t in repricing:
+            if t["to"] == "ejected":
+                self._gray_ejected.add(t["replica"])
+                for e in self.engines:
+                    if e.engine_id == t["replica"]:
+                        e.assign(NodePlan())  # idle the reclaimed chip
+        self.rebalance(trigger="gray")
+        return True
+
     def _on_monitor(self) -> None:
         # Horizon check at FIRE time, not re-arm time: a tick armed just
         # before duration_s would otherwise land in the drain phase and
@@ -212,11 +298,12 @@ class SimScheduler:
                     name, len(q) / max(1, q.max_len), q.slo_compliance()
                 )
         healed = self.check_engine_health()
+        grayed = self.check_gray_health()
         changed = self.rates.changed_models(
             self.rate_threshold, self.rate_decrease_multiplier,
             min_span_s=self.rate_min_span_s,
         )
-        if changed and not healed:  # heal already replanned this tick
+        if changed and not healed and not grayed:  # those already replanned
             self.rebalance(trigger="rate_change")
         self.loop.schedule_in(
             max(self.monitoring_interval_s * 1000.0, 1.0),

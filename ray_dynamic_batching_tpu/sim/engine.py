@@ -92,6 +92,25 @@ class SimEngine:
         # queues, so they wait for the heal replan, exactly as live).
         self.alive = True
         self.failed_at_ms: Optional[float] = None
+        # Gray degradation (EngineDegradation): step latency multiplies
+        # by slow_factor and gains stall_ms of dead air — the sim twin of
+        # the live chaos slowdown modes. The engine stays "healthy()":
+        # gray failures are exactly the ones liveness checks miss.
+        self.slow_factor = 1.0
+        self.stall_ms = 0.0
+        self.degraded_at_ms: Optional[float] = None
+        # Observed/expected step-latency ratios for the LAST executed
+        # batches (model-agnostic: a healthy engine reads ~1.0 whatever
+        # it hosts, a 10x straggler reads ~10). The gray monitor's sim
+        # observations come from here; drained per monitor tick so a
+        # heal is visible the tick after it happens. Armed only when a
+        # scenario enables gray monitoring (no silent growth otherwise).
+        self.track_ratios = False
+        self._fresh_ratios: list = []
+        # Last pre-degradation step cost: the synthetic probation
+        # probe's baseline (an idled probationed engine executes no
+        # batches, so it remembers what a step SHOULD cost).
+        self._last_expected_ms = 10.0
         # --- accounting ---
         self.busy_ms = 0.0
         self.batches = 0
@@ -128,6 +147,40 @@ class SimEngine:
         if self.alive:
             self.alive = False
             self.failed_at_ms = self.clock.now_ms()
+
+    def degrade(self, factor: float = 1.0, stall_ms: float = 0.0) -> None:
+        """Apply a gray degradation (an ``EngineDegradation`` event):
+        every later step costs ``factor x`` its profiled latency plus
+        ``stall_ms`` of dead air. ``healthy()`` keeps answering True —
+        detection is the gray monitor's job, not liveness's."""
+        self.slow_factor = float(factor)
+        self.stall_ms = float(stall_ms)
+        self.degraded_at_ms = self.clock.now_ms()
+
+    def heal_degradation(self) -> None:
+        """The chip recovers (thermal event over): later steps cost the
+        profile row again; the gray monitor sees ratios normalize."""
+        self.slow_factor = 1.0
+        self.stall_ms = 0.0
+
+    @property
+    def degraded(self) -> bool:
+        return self.slow_factor != 1.0 or self.stall_ms != 0.0
+
+    def drain_ratios(self) -> list:
+        """Observed/expected step ratios since the last drain (the gray
+        monitor's per-tick observation window)."""
+        out, self._fresh_ratios = self._fresh_ratios, []
+        return out
+
+    def probe_ratio(self) -> float:
+        """One synthetic probation probe: the observed/expected ratio a
+        step would score under the CURRENT degradation, stall included —
+        based on the last expected step cost so a stall-only straggler
+        (factor 1.0, stall_ms > 0) still grades as an outlier instead of
+        being prematurely readmitted. 1.0 once healed."""
+        base = max(self._last_expected_ms, 1e-9)
+        return (base * self.slow_factor + self.stall_ms) / base
 
     def describe(self) -> str:
         return (
@@ -201,6 +254,18 @@ class SimEngine:
                     self.occupancy_floor
                     + (1.0 - self.occupancy_floor) * min(1.0, fill)
                 )
+            if self.degraded or self.track_ratios:
+                expected_ms = exec_ms
+                self._last_expected_ms = expected_ms
+                if self.degraded:
+                    # Gray degradation prices on top of everything the
+                    # healthy cost model charges (jitter, slot fill):
+                    # a 10x straggler is 10x whatever it SHOULD cost.
+                    exec_ms = exec_ms * self.slow_factor + self.stall_ms
+                if self.track_ratios:
+                    self._fresh_ratios.append(
+                        exec_ms / max(expected_ms, 1e-9)
+                    )
             self.slots_filled += len(batch)
             self.slots_offered += max(1, p.batch_size)
             queue.record_batch_completion(

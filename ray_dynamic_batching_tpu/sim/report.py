@@ -116,6 +116,41 @@ def hop_drift_report(
     }
 
 
+def gray_timeline(report: Dict[str, Any]) -> Dict[str, list]:
+    """Per-replica gray_state timeline from one report: engine id ->
+    ordered ``[{at, from, to, p50_ms, p95_ms}, ...]``. Empty when the
+    scenario ran without gray detection. The straggler soak reads this
+    to grade detection latency (degradation onset -> first probation
+    entry) and the reclaim edge (heal -> back to healthy)."""
+    gray = report.get("gray") or {}
+    out: Dict[str, list] = {}
+    for t in gray.get("timeline", []):
+        out.setdefault(t["replica"], []).append(
+            {k: t[k] for k in ("at", "from", "to", "p50_ms", "p95_ms")
+             if k in t}
+        )
+    return out
+
+
+def format_gray_timeline(report: Dict[str, Any]) -> str:
+    """Terminal block for the per-replica gray_state timeline."""
+    timeline = gray_timeline(report)
+    if not timeline:
+        return "gray: detection disabled or no transitions"
+    lines = [f"{'replica':<10} {'t(s)':>8}  transition"]
+    for rid in sorted(timeline):
+        for t in timeline[rid]:
+            lines.append(
+                f"{rid:<10} {t['at']:>8.2f}  {t['from']} -> {t['to']}"
+            )
+    final = (report.get("gray") or {}).get("final_states", {})
+    if final:
+        lines.append("final: " + ", ".join(
+            f"{rid}={st}" for rid, st in sorted(final.items())
+        ))
+    return "\n".join(lines)
+
+
 def _round(value: Any, nd: int = 4) -> Any:
     if isinstance(value, float):
         return round(value, nd)

@@ -17,6 +17,7 @@ from typing import Dict
 from ray_dynamic_batching_tpu.engine.workload import RatePattern
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
 from ray_dynamic_batching_tpu.sim.simulator import (
+    EngineDegradation,
     EngineFailure,
     Scenario,
     SimModelSpec,
@@ -174,4 +175,101 @@ def chaos_scenario(seed: int = 0) -> Scenario:
         seed=seed,
         monitoring_interval_s=2.0,
         failures=[EngineFailure(at_s=10.0, engine=0)],
+    )
+
+
+def straggler_scenario(seed: int = 0) -> Scenario:
+    """The gray-failure conformance fixture (``tools/
+    run_straggler_soak.py --sim``; first installment of ROADMAP item 3's
+    slow-drip-straggler matrix): a 3-chip deployment at steady traffic,
+    one chip running 10x SLOW (not dead — ``healthy()`` keeps lying)
+    from t=8s until it heals at t=20s.
+
+    Expected story: the gray monitor's ratio consensus flags chip0
+    within a few 1 s ticks (suspect at 2 consecutive outlier ticks,
+    probation 2 ticks later), the probation replan reprices it to
+    fractional capacity — the heavy ``burst`` load moves to healthy
+    chips while the light ``fast`` node keeps the straggler probed — and
+    after the heal the clear-streak readmits it to full capacity.
+    ``fast`` carries the interactive mix whose attainment the gate
+    floors; ``burst`` is the load that HURTS while it sits on a 10x
+    chip, so the detection window is visible in its attainment without
+    sinking the gate."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="fast", slo_ms=200.0,
+                pattern=RatePattern("constant", base_rps=60.0),
+                class_mix={"interactive": 0.5, "standard": 0.5},
+            ),
+            # Past burst's ~116 rps single-chip SLO capacity: the packer
+            # MUST spread the deployment over multiple chips, which is
+            # what gives the gray monitor executing peers to form its
+            # consensus from (a one-chip plan has nobody to compare).
+            SimModelSpec(
+                name="burst", slo_ms=2000.0,
+                pattern=RatePattern("constant", base_rps=150.0),
+            ),
+        ],
+        duration_s=35.0,
+        drain_s=5.0,
+        n_engines=3,
+        seed=seed,
+        monitoring_interval_s=1.0,
+        degradations=[
+            EngineDegradation(at_s=8.0, engine=0, factor=10.0,
+                              heal_at_s=20.0),
+        ],
+        gray={
+            # Ratio-space observations (observed/expected ~1.0 healthy):
+            # 3x the peer median is decisive, min_abs_ms below 1.0 keeps
+            # healthy engines (ratio exactly 1.0) ungradeable as
+            # outliers by construction. min_samples=2: sim ratios are
+            # EXACT (no measurement noise — the hysteresis ticks are the
+            # noise filter), and a lightly-loaded chip may only run a
+            # couple of batches per 1 s tick. min_peers=1: ratio space
+            # is model-agnostic, so a single healthy executing peer is a
+            # valid consensus.
+            "p50_ratio": 3.0,
+            "p95_ratio": 3.0,
+            "min_abs_ms": 0.5,
+            "min_samples": 2,
+            "min_peers": 1,
+            "suspect_after": 2,
+            "probation_after": 2,
+            "heal_after": 2,
+            "probation_capacity": 0.4,
+        },
+    )
+
+
+def correlated_failure_scenario(seed: int = 0) -> Scenario:
+    """Correlated deaths (ROADMAP item 3's matrix, second entry): two of
+    four chips die 400 ms apart — one rack event, not independent
+    failures — under comfortable provisioning. Expected story: the
+    monitor sees BOTH deaths (same tick or consecutive ticks), the heal
+    replan(s) fold four chips' load onto two survivors, and because
+    capacity still covers demand every model recovers: the event costs
+    detection-window sheds, never a starved queue. Roomy SLOs keep the
+    gate grading the heal story."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="fast", slo_ms=2000.0,
+                pattern=RatePattern("constant", base_rps=60.0),
+            ),
+            SimModelSpec(
+                name="fat", slo_ms=4000.0,
+                pattern=RatePattern("constant", base_rps=6.0),
+            ),
+        ],
+        duration_s=30.0,
+        drain_s=5.0,
+        n_engines=4,
+        seed=seed,
+        monitoring_interval_s=2.0,
+        failures=[
+            EngineFailure(at_s=10.0, engine=0),
+            EngineFailure(at_s=10.4, engine=1),
+        ],
     )

@@ -149,6 +149,52 @@ class EngineFailure:
 
 
 @dataclass
+class EngineDegradation:
+    """One injected GRAY failure: from ``at_s`` the engine runs every
+    step at ``factor x`` its profiled latency plus ``stall_ms`` of dead
+    air, while still answering ``healthy()`` — the sim twin of the live
+    ``RDB_TESTING_SLOWDOWN`` modes (a thermally throttled chip, a slow
+    HBM lane). ``heal_at_s`` ends the episode (None = degraded to the
+    horizon), so probation-then-reclaim stories are expressible. The
+    gray monitor — not liveness — must catch it."""
+
+    at_s: float
+    engine: int
+    factor: float = 1.0
+    stall_ms: float = 0.0
+    heal_at_s: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.factor < 1.0:
+            raise ValueError(
+                f"degradation factor must be >= 1, got {self.factor}"
+            )
+        if self.heal_at_s is not None and self.heal_at_s <= self.at_s:
+            raise ValueError(
+                f"heal_at_s ({self.heal_at_s}) must be after at_s "
+                f"({self.at_s})"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EngineDegradation":
+        known = {"at_s", "engine", "factor", "stall_ms", "heal_at_s"}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown degradation key(s) {sorted(unknown)}; known: "
+                f"{sorted(known)}"
+            )
+        return cls(
+            at_s=float(d["at_s"]),
+            engine=int(d["engine"]),
+            factor=float(d.get("factor", 1.0)),
+            stall_ms=float(d.get("stall_ms", 0.0)),
+            heal_at_s=(None if d.get("heal_at_s") is None
+                       else float(d["heal_at_s"])),
+        )
+
+
+@dataclass
 class Scenario:
     """One simulated deployment under one traffic story."""
 
@@ -182,6 +228,29 @@ class Scenario:
     # Injected engine deaths (chaos conformance): each kills one sim
     # engine at virtual time t; the monitor heals over survivors.
     failures: List[EngineFailure] = field(default_factory=list)
+    # Injected GRAY failures (straggler conformance): slowdowns the gray
+    # monitor — not liveness — must catch.
+    degradations: List[EngineDegradation] = field(default_factory=list)
+    # Gray-detection knobs (serve/grayhealth.GrayHealthPolicy fields).
+    # None = detection disabled: canon scenarios stay byte-identical.
+    gray: Optional[Dict[str, Any]] = None
+
+    def gray_policy(self):
+        from ray_dynamic_batching_tpu.serve.grayhealth import (
+            GrayHealthPolicy,
+        )
+        import dataclasses as _dc
+
+        if self.gray is None:
+            return None
+        known = {f.name for f in _dc.fields(GrayHealthPolicy)}
+        unknown = set(self.gray) - known
+        if unknown:
+            raise ValueError(
+                f"unknown gray key(s) {sorted(unknown)}; known: "
+                f"{sorted(known)}"
+            )
+        return GrayHealthPolicy(**self.gray)
     # Token-bucket admission + overload governor, applied per model
     # (serve/admission.AdmissionPolicy knobs; None = admit everything).
     # The LIVE AdmissionController runs here on the virtual clock.
@@ -253,6 +322,11 @@ class Scenario:
             failures=[
                 EngineFailure.from_dict(f) for f in d.get("failures", [])
             ],
+            degradations=[
+                EngineDegradation.from_dict(g)
+                for g in d.get("degradations", [])
+            ],
+            gray=d.get("gray"),
             admission=d.get("admission"),
         )
 
@@ -353,6 +427,7 @@ class Simulation:
             rate_decrease_multiplier=sc.rate_decrease_multiplier,
             rate_window_s=sc.rate_window_s,
             rate_min_span_s=sc.rate_min_span_s,
+            gray_policy=sc.gray_policy(),
         )
         for spec in sc.models:
             sched.register_model(spec.name, slo_ms=spec.slo_ms,
@@ -436,6 +511,24 @@ class Simulation:
                 f.at_s * 1000.0, lambda e=engines[f.engine]: e.fail()
             )
 
+        for g in sc.degradations:
+            if not 0 <= g.engine < sc.n_engines:
+                raise ValueError(
+                    f"degradation names engine {g.engine} but the scenario "
+                    f"has {sc.n_engines} engine(s)"
+                )
+            loop.schedule_at(
+                g.at_s * 1000.0,
+                lambda e=engines[g.engine], d=g: e.degrade(
+                    d.factor, d.stall_ms
+                ),
+            )
+            if g.heal_at_s is not None:
+                loop.schedule_at(
+                    g.heal_at_s * 1000.0,
+                    lambda e=engines[g.engine]: e.heal_degradation(),
+                )
+
         if sc.warm_start:
             sched.rebalance(rates=self._warm_start_rates(arrivals),
                             trigger="manual")
@@ -517,6 +610,11 @@ class Simulation:
                 "alive": e.alive,
                 "failed_at_ms": e.failed_at_ms,
             }
+            if sched.gray is not None:
+                chips[e.engine_id]["gray_state"] = sched.gray.state(
+                    e.engine_id
+                )
+                chips[e.engine_id]["degraded"] = e.degraded
         audit = sched.audit.to_dicts()
         migrations = sum(
             1 for r in audit
@@ -537,6 +635,20 @@ class Simulation:
             "failures": [
                 {"at_s": f.at_s, "engine": f.engine} for f in sc.failures
             ],
+            "degradations": [
+                {"at_s": g.at_s, "engine": g.engine, "factor": g.factor,
+                 "stall_ms": g.stall_ms, "heal_at_s": g.heal_at_s}
+                for g in sc.degradations
+            ],
+            # Per-replica gray_state timeline (sim/report.gray_timeline
+            # slices it per engine): every detector transition with its
+            # virtual timestamp, plus the final verdicts.
+            "gray": (
+                None if sched.gray is None else {
+                    "timeline": [dict(t) for t in sched.gray.transitions],
+                    "final_states": sched.gray.states(),
+                }
+            ),
             "admission": (
                 None if sched.admission is None else {
                     **sched.admission.stats(),

@@ -13,6 +13,24 @@ optional ``:p<float>`` suffix makes injection probabilistic
 (``point=5:p0.5`` — up to 5 failures, each opportunity failing with
 probability 0.5). Injection is a no-op unless configured, so production
 paths pay one dict lookup.
+
+**Gray-failure (slowdown) modes** (ISSUE 9): binary death misses the
+failures that actually erode SLO attainment — a replica running 5-10x
+slow, a stall before the first token, a stream that never EOSes. A
+second spec injects those, same grammar plus a mode suffix:
+
+    RDB_TESTING_SLOWDOWN="replica.process_batch=-1:mult10"
+    RDB_TESTING_SLOWDOWN="replica.process_batch=3:stall50:p0.5"
+    RDB_TESTING_SLOWDOWN="replica.process_batch@soak#0=-1:mult10"
+
+Modes: ``mult<F>`` (latency_multiplier — the batch takes F x as long),
+``stall<MS>`` (stall_before_first_token — MS ms dead air before
+execution), ``stuck<MS>`` (stuck_stream — output produced, EOS withheld
+for MS ms). A ``point@instance`` key targets ONE replica/engine (the
+straggler soak slows one replica of three); instance-less keys hit every
+caller of the point. Probabilistic draws use the same seeded RNG
+discipline as failures (``config.chaos_seed``), so a slowdown schedule
+replays byte-identically.
 """
 
 from __future__ import annotations
@@ -20,9 +38,40 @@ from __future__ import annotations
 import os
 import random
 import threading
+from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 ENV_VAR = "RDB_TESTING_FAILURE"
+SLOWDOWN_ENV_VAR = "RDB_TESTING_SLOWDOWN"
+
+SLOWDOWN_MODES = (
+    "latency_multiplier", "stall_before_first_token", "stuck_stream",
+)
+
+
+@dataclass(frozen=True)
+class Slowdown:
+    """One degradation verdict: HOW to be slow (the degradation
+    taxonomy shared with ``sim.simulator.EngineDegradation``)."""
+
+    mode: str                  # one of SLOWDOWN_MODES
+    factor: float = 1.0        # latency_multiplier: execution time x F
+    ms: float = 0.0            # stall/stuck: milliseconds of dead air
+
+
+def _parse_slowdown_mode(token: str) -> Slowdown:
+    if token.startswith("mult"):
+        factor = float(token[4:])
+        if factor < 1.0:
+            raise ValueError(f"mult factor must be >= 1, got {factor}")
+        return Slowdown("latency_multiplier", factor=factor)
+    if token.startswith("stall"):
+        return Slowdown("stall_before_first_token", ms=float(token[5:]))
+    if token.startswith("stuck"):
+        return Slowdown("stuck_stream", ms=float(token[5:]))
+    raise ValueError(
+        f"bad slowdown mode {token!r} (want mult<F>|stall<MS>|stuck<MS>)"
+    )
 
 
 class ChaosInjected(RuntimeError):
@@ -42,7 +91,15 @@ class ChaosInjector:
         self._seed = seed if seed is not None else self._config_seed()
         self._rng = random.Random(self._seed)
         self._active = False  # unlocked fast-path flag for hot callers
+        # Slowdown (gray-failure) injection state: its own budgets, fired
+        # counts, seeded RNG and fast-path flag — a failure budget and a
+        # slowdown budget on the same point are independent.
+        self._slow: Dict[str, Tuple[int, float, Slowdown]] = {}
+        self._slow_fired: Dict[str, int] = {}
+        self._slow_rng = random.Random(self._seed)
+        self._slow_active = False
         self.configure(spec if spec is not None else os.environ.get(ENV_VAR, ""))
+        self.configure_slowdowns(os.environ.get(SLOWDOWN_ENV_VAR, ""))
 
     @staticmethod
     def _config_seed() -> int:
@@ -102,6 +159,75 @@ class ChaosInjector:
         with self._lock:
             return self._fired.get(point, 0)
 
+    # --- slowdown (gray-failure) injection --------------------------------
+    def configure_slowdowns(self, spec: str,
+                            seed: Optional[int] = None) -> None:
+        """Parse ``point[@instance]=N:mode[:pP],...``. Same all-or-
+        nothing swap and reseed-on-configure discipline as
+        :meth:`configure`: same spec + same seed replays the same
+        slowdown schedule byte-identically (the seeded-replay pin)."""
+        table: Dict[str, Tuple[int, float, Slowdown]] = {}
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            if "=" not in part:
+                raise ValueError(f"bad slowdown spec entry {part!r}")
+            point, rhs = part.split("=", 1)
+            prob = 1.0
+            tokens = rhs.split(":")
+            if len(tokens) < 2:
+                raise ValueError(
+                    f"slowdown entry {part!r} needs a mode "
+                    "(point=N:mult<F>|stall<MS>|stuck<MS>[:pP])"
+                )
+            if len(tokens) > 2:
+                if not tokens[2].startswith("p"):
+                    raise ValueError(
+                        f"bad slowdown suffix {tokens[2]!r} (want p<float>)"
+                    )
+                prob = float(tokens[2][1:])
+            table[point.strip()] = (
+                int(tokens[0]), prob, _parse_slowdown_mode(tokens[1])
+            )
+        with self._lock:
+            self._slow = table
+            self._slow_fired = {}
+            if seed is not None:
+                self._seed = seed
+            self._slow_rng = random.Random(self._seed)
+            self._slow_active = bool(table)
+
+    def slowdown(self, point: str,
+                 instance: Optional[str] = None) -> Optional[Slowdown]:
+        """The degradation to apply at this point right now, or None.
+        ``point@instance`` entries outrank bare ``point`` entries so a
+        spec can slow exactly one replica of a fleet. Consumes one unit
+        of the matched entry's budget. Free when unconfigured: one
+        unlocked attribute read."""
+        if not self._slow_active:
+            return None
+        keys = ([f"{point}@{instance}"] if instance is not None else [])
+        keys.append(point)
+        with self._lock:
+            for key in keys:
+                entry = self._slow.get(key)
+                if entry is None:
+                    continue
+                budget, prob, verdict = entry
+                if budget == 0:
+                    continue
+                if prob < 1.0 and self._slow_rng.random() >= prob:
+                    return None  # this opportunity drew a pass
+                if budget > 0:
+                    self._slow[key] = (budget - 1, prob, verdict)
+                self._slow_fired[key] = self._slow_fired.get(key, 0) + 1
+                return verdict
+            return None
+
+    def slowdown_fired(self, point: str,
+                       instance: Optional[str] = None) -> int:
+        key = f"{point}@{instance}" if instance is not None else point
+        with self._lock:
+            return self._slow_fired.get(key, 0)
+
     @property
     def active(self) -> bool:
         return self._active
@@ -122,10 +248,14 @@ def chaos() -> ChaosInjector:
     return _GLOBAL
 
 
-def reset_chaos(spec: str = "", seed: Optional[int] = None) -> ChaosInjector:
+def reset_chaos(spec: str = "", seed: Optional[int] = None,
+                slowdown: str = "") -> ChaosInjector:
     """Re-configure (and optionally reseed) the global injector (tests /
     soak harnesses): ``reset_chaos(spec, seed=N)`` pins the probabilistic
-    failure schedule for a deterministic replay."""
+    failure schedule for a deterministic replay. ``slowdown`` carries the
+    gray-failure spec — cleared by default, so every existing
+    ``reset_chaos("")`` teardown also disarms slowdowns."""
     inj = chaos()
     inj.configure(spec, seed=seed)
+    inj.configure_slowdowns(slowdown, seed=seed)
     return inj

@@ -310,6 +310,17 @@ class RollingSketch:
         merged.merge(self._cur)
         return merged
 
+    def view(self) -> QuantileSketch:
+        """A point-in-time COPY of the recency-bounded read view — safe
+        to hold, read, or :meth:`QuantileSketch.merged` across instances
+        (cross-queue aggregation) without this sketch's lock."""
+        with self._lock:
+            out = QuantileSketch(**self._params)
+            out.merge(self._cur)
+            if self._prev is not None:
+                out.merge(self._prev)
+            return out
+
     @property
     def count(self) -> int:
         """Observations in the current read view (recency-bounded);

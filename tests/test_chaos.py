@@ -210,3 +210,204 @@ class TestRouterChaos:
             assert chaos().fired("router.assign") == 3
         finally:
             rep.stop()
+
+
+class TestSlowdownInjector:
+    """Gray-failure (slowdown) injection: same grammar + seeded-replay
+    discipline as failures, plus a mode suffix and @instance targeting
+    (seeded-replay pins sit next to the PR-4 chaos-seed pins above)."""
+
+    def test_spec_parse_modes_and_budget(self):
+        inj = ChaosInjector()
+        inj.configure_slowdowns(
+            "a.b=2:mult10,c.d=-1:stall50,e.f=1:stuck250"
+        )
+        v = inj.slowdown("a.b")
+        assert v.mode == "latency_multiplier" and v.factor == 10.0
+        assert inj.slowdown("a.b") is not None
+        assert inj.slowdown("a.b") is None        # budget of 2 spent
+        for _ in range(20):
+            assert inj.slowdown("c.d").ms == 50.0  # unlimited
+        assert inj.slowdown("e.f").mode == "stuck_stream"
+        assert inj.slowdown("e.f") is None
+        assert inj.slowdown("unknown") is None
+        assert inj.slowdown_fired("a.b") == 2
+
+    def test_instance_targeting_outranks_bare_point(self):
+        inj = ChaosInjector()
+        inj.configure_slowdowns("p@r0=-1:mult10,p=-1:mult2")
+        assert inj.slowdown("p", instance="r0").factor == 10.0
+        assert inj.slowdown("p", instance="r1").factor == 2.0
+        assert inj.slowdown("p").factor == 2.0
+
+    def test_instance_only_spec_spares_the_fleet(self):
+        inj = ChaosInjector()
+        inj.configure_slowdowns("p@r0=-1:stall25")
+        assert inj.slowdown("p", instance="r0").ms == 25.0
+        assert inj.slowdown("p", instance="r1") is None
+        assert inj.slowdown("p") is None
+
+    def test_bad_specs_rejected(self):
+        inj = ChaosInjector()
+        for bad in ("a.b=3", "a.b=3:warp9", "a.b=3:mult0.5",
+                    "a.b=3:mult2:q0.5", "nonsense"):
+            with pytest.raises(ValueError):
+                inj.configure_slowdowns(bad)
+
+    def test_bad_spec_leaves_config_untouched(self):
+        inj = ChaosInjector()
+        inj.configure_slowdowns("a.b=5:mult3")
+        with pytest.raises(ValueError):
+            inj.configure_slowdowns("a.b=1:mult3,c.d=oops")
+        assert inj.slowdown("a.b").factor == 3.0
+        assert inj.slowdown_fired("a.b") == 1
+
+    def test_seeded_replay_is_byte_identical(self):
+        """The seeded-replay pin (the PR-4 chaos-seed contract, extended
+        to slowdowns): same spec + same seed -> the same schedule of
+        fire/pass draws, so a sim straggler run replays exactly."""
+        def schedule(seed):
+            inj = ChaosInjector("")
+            inj.configure_slowdowns("p.q=-1:stall10:p0.5", seed=seed)
+            return [inj.slowdown("p.q") is not None for _ in range(64)]
+
+        assert schedule(7) == schedule(7)
+        assert schedule(7) != schedule(1234)
+
+    def test_config_chaos_seed_drives_slowdown_rng(self):
+        from ray_dynamic_batching_tpu.utils.config import (
+            RDBConfig,
+            set_config,
+        )
+
+        def schedule(seed):
+            set_config(RDBConfig.from_env(chaos_seed=seed))
+            inj = ChaosInjector("")
+            inj.configure_slowdowns("p.q=-1:mult2:p0.5")
+            return [inj.slowdown("p.q") is not None for _ in range(64)]
+
+        try:
+            assert schedule(11) == schedule(11)
+            assert schedule(11) != schedule(17)
+        finally:
+            set_config(RDBConfig.from_env())
+
+    def test_reset_chaos_clears_and_reseeds_slowdowns(self):
+        inj = reset_chaos("", seed=42, slowdown="p.q=-1:stall5:p0.5")
+        first = [inj.slowdown("p.q") is not None for _ in range(64)]
+        reset_chaos("", seed=42, slowdown="p.q=-1:stall5:p0.5")
+        assert [inj.slowdown("p.q") is not None
+                for _ in range(64)] == first
+        reset_chaos("")                            # default disarms
+        assert inj.slowdown("p.q") is None
+
+    def test_env_configured(self, monkeypatch):
+        import ray_dynamic_batching_tpu.utils.chaos as chaos_mod
+
+        monkeypatch.setenv(chaos_mod.SLOWDOWN_ENV_VAR, "from.env=1:mult4")
+        fresh = ChaosInjector()
+        assert fresh.slowdown("from.env").factor == 4.0
+
+    def test_failure_and_slowdown_budgets_are_independent(self):
+        inj = reset_chaos("x=1", slowdown="x=1:mult2")
+        assert inj.slowdown("x") is not None
+        assert inj.should_fail("x")                # failure budget intact
+        assert inj.slowdown("x") is None
+        assert not inj.should_fail("x")
+
+
+class TestReplicaSlowdown:
+    def _one(self, fn=double_batch):
+        rep = Replica("r0", "d", fn, max_batch_size=4,
+                      batch_wait_timeout_s=0.002)
+        rep.start()
+        return rep
+
+    def _timed(self, rep, payload=1):
+        req = Request(model="d", payload=payload, slo_ms=30_000)
+        t0 = time.monotonic()
+        assert rep.assign(req)
+        result = req.future.result(timeout=10)
+        return result, (time.monotonic() - t0) * 1000.0
+
+    def test_stall_before_first_token_delays_the_batch(self):
+        rep = self._one()
+        try:
+            reset_chaos("", slowdown="replica.process_batch=1:stall80")
+            result, ms = self._timed(rep)
+            assert result == 2 and ms >= 80.0
+            _, ms = self._timed(rep)               # budget spent: fast again
+            assert ms < 80.0
+        finally:
+            rep.stop()
+
+    def test_latency_multiplier_stretches_execution(self):
+        def slowish(payloads):
+            time.sleep(0.04)
+            return [p * 2 for p in payloads]
+
+        rep = self._one(slowish)
+        try:
+            reset_chaos("", slowdown="replica.process_batch=1:mult3")
+            result, ms = self._timed(rep)
+            # 40 ms of real work stretched ~3x
+            assert result == 2 and ms >= 100.0
+        finally:
+            rep.stop()
+
+    def test_stuck_stream_withholds_eos_not_tokens(self):
+        def gen(payloads):
+            yield ["tok0" for _ in payloads]
+
+        rep = self._one(gen)
+        try:
+            reset_chaos("", slowdown="replica.process_batch=1:stuck80")
+            from ray_dynamic_batching_tpu.engine.request import TokenStream
+
+            req = Request(model="d", payload=1, slo_ms=30_000)
+            req.stream = TokenStream()
+            t0 = time.monotonic()
+            assert rep.assign(req)
+            chunk = next(iter(req.stream))
+            first_token_ms = (time.monotonic() - t0) * 1000.0
+            req.future.result(timeout=10)
+            eos_ms = (time.monotonic() - t0) * 1000.0
+            assert chunk == "tok0"
+            assert first_token_ms < 80.0           # output flowed on time
+            assert eos_ms >= 80.0                  # ...the close dragged
+        finally:
+            rep.stop()
+
+    def test_instance_targeted_slowdown_hits_one_replica(self):
+        r0 = Replica("r0", "d", double_batch, max_batch_size=4,
+                     batch_wait_timeout_s=0.002)
+        r1 = Replica("r1", "d", double_batch, max_batch_size=4,
+                     batch_wait_timeout_s=0.002)
+        r0.start()
+        r1.start()
+        try:
+            reset_chaos(
+                "", slowdown="replica.process_batch@r0=-1:stall60"
+            )
+            _, slow_ms = self._timed(r0)
+            _, fast_ms = self._timed(r1)
+            assert slow_ms >= 60.0 and fast_ms < 60.0
+        finally:
+            r0.stop()
+            r1.stop()
+
+    def test_slow_batches_still_succeed(self):
+        """The defining property of a gray failure: every request
+        completes — no error for the breaker's failure counter to see."""
+        rep = self._one()
+        try:
+            reset_chaos("", slowdown="replica.process_batch=-1:mult2")
+            reqs = [Request(model="d", payload=i, slo_ms=30_000)
+                    for i in range(4)]
+            for r in reqs:
+                assert rep.assign(r)
+            assert [r.future.result(timeout=10) for r in reqs] == [
+                0, 2, 4, 6
+            ]
+        finally:
+            rep.stop()

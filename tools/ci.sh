@@ -24,6 +24,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_sim.py --smoke
   echo "== chaos conformance (sim: injected engine death, heal + accounting) =="
   python tools/run_chaos_soak.py --sim
+  echo "== straggler conformance (sim: 10x gray slowdown, probation + reclaim, tools/straggler_smoke.json) =="
+  python tools/run_straggler_soak.py --sim
   echo "== overload conformance (sim: 5x saturation, QoS floors, tools/overload_smoke.json) =="
   python tools/run_overload_soak.py --sim
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
@@ -57,6 +59,10 @@ python tools/run_chaos_soak.py --sim
 
 echo "== chaos conformance (live soak: injected failures, zero system errors) =="
 python tools/run_chaos_soak.py --live --smoke
+
+echo "== straggler conformance (sim + live: one replica 10x slow, probation then reclaim, hedge conservation) =="
+python tools/run_straggler_soak.py --sim
+python tools/run_straggler_soak.py --live --smoke
 
 echo "== overload conformance (sim 5x + live mixed-class soak, only 200s/429s) =="
 python tools/run_overload_soak.py --sim

@@ -1,0 +1,452 @@
+#!/usr/bin/env python
+"""Straggler (gray-failure) conformance gate — slow a chip, prove the
+defense.
+
+PR-4's chaos soak proves binary death is survivable; this gate proves
+the GRAY spectrum is (ISSUE 9): a replica running 10x slow while
+``healthy()`` keeps answering True. The contract under test spans
+serve/grayhealth.py (peer-consensus detection, the healthy -> suspect ->
+probation -> ejected machine), the router's probation drain + hedged
+dispatch, the breaker's slow strikes, and scheduler/replan's fractional
+capacity pricing. Two arms:
+
+  --sim    (default; the CI fast lane) the deterministic fixtures from
+           sim/scenarios.py, each run TWICE for byte-identical reports,
+           graded against tools/straggler_smoke.json:
+             - straggler_scenario: one chip of three 10x slow from
+               virtual t=8s, healed at t=20s. Asserts the straggler
+               reaches `probation` within the ratcheted tick budget,
+               only the straggler transitions, a gray replan repriced it
+               as fractional capacity, the heal readmits it to
+               `healthy`, interactive attainment holds its floor, and
+               accounting conserves (arrivals == completed + stale +
+               dropped + pending per model).
+             - correlated_failure_scenario: two of four chips die 400 ms
+               apart (one rack event); the heal folds onto survivors
+               with every model above its floor and zero leaks.
+  --live   a real ServeController + 3-replica deployment on threads,
+           hedging enabled for interactive traffic, with
+           ``replica.process_batch@<replica>=-1:mult10`` injected via
+           the chaos slowdown spec on exactly one replica. Asserts the
+           straggler is probationed within the ratcheted wall-clock
+           budget, readmitted to healthy after the injection clears,
+           ZERO client-visible system errors, the slowdown actually
+           fired, and hedge accounting conserves (fired == dispatched +
+           late; dispatched == won + lost once races settle) — the
+           metric-level face of the at-most-once-after-first-token pin.
+
+Exit: 0 conformant, 1 violation, 2 usage.
+
+Examples:
+  python tools/run_straggler_soak.py --sim
+  python tools/run_straggler_soak.py --live --smoke
+  python tools/run_straggler_soak.py --live --requests 2000 --rps 400
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RATCHET = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "straggler_smoke.json")
+
+
+def _load_floors() -> dict:
+    with open(RATCHET) as f:
+        return json.load(f)["floors"]
+
+
+def _conservation(report: dict, failures: list, arm: str) -> None:
+    for name, s in report["models"].items():
+        accounted = (s["completed"] + s["stale"] + s["dropped"]
+                     + s["pending"])
+        if s["arrivals"] != accounted:
+            failures.append(
+                f"{arm}/{name}: accounting leak — {s['arrivals']} arrivals "
+                f"vs {accounted} accounted; a degradation made requests "
+                "vanish"
+            )
+
+
+def run_sim(seed: int = 0) -> int:
+    from ray_dynamic_batching_tpu.sim import (
+        Simulation,
+        format_gray_timeline,
+        gray_timeline,
+        render_json,
+    )
+    from ray_dynamic_batching_tpu.sim.scenarios import (
+        correlated_failure_scenario,
+        fixture_profiles,
+        straggler_scenario,
+    )
+
+    floors = _load_floors()
+    failures: list = []
+
+    # --- straggler arm ----------------------------------------------------
+    reports = [
+        Simulation(fixture_profiles(), straggler_scenario(seed=seed)).run()
+        for _ in range(2)
+    ]
+    blobs = [render_json(r) for r in reports]
+    if blobs[0] != blobs[1]:
+        failures.append("straggler: nondeterministic — same seed produced "
+                        "different report bytes")
+    report = reports[0]
+    f = floors["straggler"]
+    _conservation(report, failures, "straggler")
+    sc = straggler_scenario(seed=seed)
+    onset_s = sc.degradations[0].at_s
+    heal_s = sc.degradations[0].heal_at_s
+    tick_s = sc.monitoring_interval_s
+    straggler_id = f"chip{sc.degradations[0].engine}"
+    timeline = gray_timeline(report)
+    if sorted(timeline) != [straggler_id]:
+        failures.append(
+            f"straggler: expected only {straggler_id} to transition, saw "
+            f"{sorted(timeline)} — a healthy chip was defamed"
+        )
+    first = {}
+    for t in timeline.get(straggler_id, []):
+        first.setdefault(t["to"], t["at"])
+    detect_ticks = None
+    if "probation" not in first:
+        failures.append("straggler: the 10x chip never reached probation")
+    else:
+        detect_ticks = (first["probation"] - onset_s) / tick_s
+        if detect_ticks > f["detect_tick_budget"]:
+            failures.append(
+                f"straggler: probation took {detect_ticks:.0f} monitor "
+                f"ticks from onset (budget {f['detect_tick_budget']})"
+            )
+    if first.get("healthy", 0.0) <= heal_s:
+        failures.append(
+            "straggler: no healthy readmission after the injected heal "
+            f"(t={heal_s}s) — probation never reclaimed the chip"
+        )
+    final = (report.get("gray") or {}).get("final_states", {})
+    if any(st != "healthy" for st in final.values()):
+        failures.append(f"straggler: final gray states {final} != all "
+                        "healthy")
+    gray_replans = [a for a in report["audit"] if a["trigger"] == "gray"]
+    repriced = any(
+        min(a["observed"].get("capacity_factors", [1.0])) < 1.0
+        for a in gray_replans
+    )
+    if not repriced:
+        failures.append("straggler: no gray replan priced the probationed "
+                        "chip as fractional capacity")
+    interactive = (report["models"]["fast"]["classes"]["interactive"]
+                   ["slo_attainment"])
+    if interactive < f["interactive_attainment"]:
+        failures.append(
+            f"straggler: interactive attainment {interactive:.4f} < floor "
+            f"{f['interactive_attainment']} — the detection window leaked "
+            "into the protected tier"
+        )
+    for name, floor in f["slo_attainment"].items():
+        got = report["models"][name]["slo_attainment"]
+        if got < floor:
+            failures.append(
+                f"straggler/{name}: attainment {got:.4f} < floor {floor}"
+            )
+
+    # --- correlated-failure arm -------------------------------------------
+    cblobs = [
+        render_json(Simulation(fixture_profiles(),
+                               correlated_failure_scenario(seed=seed)).run())
+        for _ in range(2)
+    ]
+    if cblobs[0] != cblobs[1]:
+        failures.append("correlated: nondeterministic report bytes")
+    creport = json.loads(cblobs[0])
+    fc = floors["correlated"]
+    _conservation(creport, failures, "correlated")
+    dead = sorted(c for c, v in creport["chips"].items() if not v["alive"])
+    if len(dead) != 2:
+        failures.append(f"correlated: expected 2 dead chips, saw {dead}")
+    heals = sum(1 for a in creport["audit"] if a["trigger"] == "heal")
+    if heals < fc["min_heals"]:
+        failures.append(f"correlated: {heals} heal replans < "
+                        f"{fc['min_heals']} — the rack event went unhealed")
+    for name, floor in fc["slo_attainment"].items():
+        got = creport["models"][name]["slo_attainment"]
+        if got < floor:
+            failures.append(
+                f"correlated/{name}: attainment {got:.4f} < floor {floor}"
+            )
+
+    summary = {
+        "mode": "sim",
+        "deterministic": blobs[0] == blobs[1] and cblobs[0] == cblobs[1],
+        "straggler": {
+            "detect_ticks": detect_ticks,
+            "timeline": format_gray_timeline(report).split("\n"),
+            "interactive_attainment": round(interactive, 4),
+            "models": {
+                name: round(s["slo_attainment"], 4)
+                for name, s in report["models"].items()
+            },
+        },
+        "correlated": {
+            "dead_chips": dead,
+            "heals": heals,
+            "models": {
+                name: round(s["slo_attainment"], 4)
+                for name, s in creport["models"].items()
+            },
+        },
+        "violations": failures,
+    }
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 1 if failures else 0
+
+
+def _wait_for(predicate, timeout_s: float, interval_s: float = 0.02):
+    """Poll until predicate() is truthy; returns (value, elapsed_s) or
+    (None, elapsed) on timeout."""
+    start = time.monotonic()
+    while True:
+        value = predicate()
+        elapsed = time.monotonic() - start
+        if value:
+            return value, elapsed
+        if elapsed >= timeout_s:
+            return None, elapsed
+        time.sleep(interval_s)
+
+
+def run_live(n_requests: int, rps: float, slo_ms: float,
+             factor: float) -> int:
+    from ray_dynamic_batching_tpu.serve import (
+        DeploymentConfig,
+        DeploymentHandle,
+        GrayHealthPolicy,
+        ServeController,
+        is_shed,
+    )
+    from ray_dynamic_batching_tpu.utils.chaos import chaos, reset_chaos
+
+    floors = _load_floors()["live"]
+
+    def work(payloads):
+        time.sleep(0.001)  # a visible (but tiny) batch cost
+        return [p * 2 for p in payloads]
+
+    ctl = ServeController(control_interval_s=0.05)
+    router = ctl.deploy(
+        DeploymentConfig(
+            name="soak", num_replicas=3, max_batch_size=4,
+            batch_wait_timeout_s=0.002, hedge_interactive=True,
+        ),
+        factory=lambda: work,
+    )
+    # Soak-speed gray policy: the detection MATH is the deployed default
+    # (3x the peer median, 2+2 consecutive ticks); only the probe cadence
+    # is cranked so the probationed replica's rolling sketch refreshes
+    # fast enough for the heal edge to land inside a CI smoke. p95
+    # grading is disabled because the straggler's sketch keeps slow
+    # samples in its tail for ~2 window rotations after the heal — p50 is
+    # the honest live recovery signal.
+    router.gray.policy = GrayHealthPolicy(
+        p95_ratio=1e9, probe_interval_s=0.02,
+    )
+    ctl.start()
+    handle = DeploymentHandle(router, default_slo_ms=slo_ms)
+    straggler = router.replicas()[0].replica_id
+    slowdown_spec = f"replica.process_batch@{straggler}=-1:mult{factor:g}"
+    violations: list = []
+    classes = ("interactive", "standard")
+    per_class = {c: {"offered": 0, "completed": 0, "shed": 0,
+                     "system_errors": 0, "slo_met": 0} for c in classes}
+    detect_s = heal_s = None
+    futures = []
+    done_at: dict = {}
+    interval = 1.0 / rps if rps > 0 else 0.0
+    seq = iter(range(1 << 30))
+
+    def send_one():
+        i = next(seq)
+        cls = classes[i % len(classes)]
+        per_class[cls]["offered"] += 1
+        submitted = time.monotonic()
+        fut = handle.remote(i, qos_class=cls)
+        fut.add_done_callback(
+            lambda _f, i=i, t=submitted:
+            done_at.__setitem__(i, time.monotonic() - t)
+        )
+        futures.append((i, cls, fut))
+        if interval:
+            time.sleep(interval)
+
+    try:
+        # Warmup puts >= min_samples completions on EVERY replica so the
+        # consensus can grade all three before the injection starts.
+        warm = [handle.remote(i) for i in range(60)]
+        for i, fut in enumerate(warm):
+            assert fut.result(timeout=10) == i * 2
+        reset_chaos("", slowdown=slowdown_spec)
+        injected_at = time.monotonic()
+
+        # Degraded phase: steady traffic while one replica runs slow.
+        # Detection must land while requests flow — the monitor grades
+        # the sketches the traffic itself refreshes.
+        for _ in range(n_requests):
+            send_one()
+            if detect_s is None and router.gray.state(straggler) == "probation":
+                detect_s = time.monotonic() - injected_at
+        while (detect_s is None
+               and time.monotonic() - injected_at < floors["detect_s_budget"]):
+            send_one()
+            if router.gray.state(straggler) == "probation":
+                detect_s = time.monotonic() - injected_at
+        if detect_s is None:
+            violations.append(
+                f"straggler {straggler} never reached probation within "
+                f"{floors['detect_s_budget']}s of a {factor:g}x slowdown "
+                f"(state={router.gray.state(straggler)})"
+            )
+        # The fired count must be read BEFORE the heal reconfigure — a
+        # configure_slowdowns() swap resets it with the budgets.
+        fired = chaos().slowdown_fired("replica.process_batch",
+                                       instance=straggler)
+
+        # Heal phase: clear the injection and KEEP DRIVING — probation
+        # probes ride real dispatches, and only fresh fast samples can
+        # pull the straggler's sketch back under the consensus bar.
+        reset_chaos("", slowdown="")
+        heal_started = time.monotonic()
+        while time.monotonic() - heal_started < floors["heal_s_budget"]:
+            send_one()
+            if router.gray.state(straggler) == "healthy":
+                heal_s = time.monotonic() - heal_started
+                break
+        if heal_s is None:
+            violations.append(
+                f"straggler {straggler} not readmitted to healthy within "
+                f"{floors['heal_s_budget']}s of the heal "
+                f"(state={router.gray.state(straggler)})"
+            )
+
+        completed = shed = system_errors = 0
+        first_error = None
+        for i, cls, fut in futures:
+            try:
+                result = fut.result(timeout=30)
+                if result != i * 2:
+                    system_errors += 1
+                    per_class[cls]["system_errors"] += 1
+                    first_error = first_error or f"wrong result for {i}"
+                else:
+                    completed += 1
+                    per_class[cls]["completed"] += 1
+                    if done_at.get(i, float("inf")) * 1000.0 <= slo_ms:
+                        per_class[cls]["slo_met"] += 1
+            except Exception as e:  # noqa: BLE001 — classification is the test
+                if is_shed(e):
+                    shed += 1
+                    per_class[cls]["shed"] += 1
+                else:
+                    system_errors += 1
+                    per_class[cls]["system_errors"] += 1
+                    first_error = first_error or f"{type(e).__name__}: {e}"
+        if system_errors:
+            violations.append(
+                f"{system_errors} client-visible system error(s); first: "
+                f"{first_error}"
+            )
+        if fired == 0:
+            violations.append("the slowdown never fired — the soak proved "
+                              "nothing")
+        inter = per_class["interactive"]
+        attainment = (inter["slo_met"] / inter["offered"]
+                      if inter["offered"] else 0.0)
+        if attainment < floors["interactive_attainment"]:
+            violations.append(
+                f"interactive attainment {attainment:.4f} < floor "
+                f"{floors['interactive_attainment']}"
+            )
+        # Hedge conservation — the metric face of the at-most-once pin:
+        # every fired timer is dispatched or late, every dispatched
+        # shadow settles exactly one of won/lost.
+        hedge, _ = _wait_for(
+            lambda: (lambda s: s if (
+                s["fired"] == s["dispatched"] + s["late"]
+                and s["dispatched"] == s["won"] + s["lost"]
+            ) else None)(router.hedge.stats()),
+            timeout_s=5.0,
+        )
+        hedge = hedge or router.hedge.stats()
+        if hedge["fired"] != hedge["dispatched"] + hedge["late"]:
+            violations.append(
+                f"hedge leak: fired {hedge['fired']} != dispatched "
+                f"{hedge['dispatched']} + late {hedge['late']}"
+            )
+        if hedge["dispatched"] != hedge["won"] + hedge["lost"]:
+            violations.append(
+                f"hedge race leak: dispatched {hedge['dispatched']} != "
+                f"won {hedge['won']} + lost {hedge['lost']}"
+            )
+        grays = [a for a in ctl.audit.to_dicts()
+                 if a["trigger"].startswith("gray_")]
+        if not any(a["trigger"] == "gray_probation" for a in grays):
+            violations.append("no gray_probation audit record — the "
+                              "verdict left no decision trail")
+        summary = {
+            "mode": "live",
+            "straggler": straggler,
+            "slowdown": slowdown_spec,
+            "slowdown_fired": fired,
+            "detect_s": None if detect_s is None else round(detect_s, 3),
+            "heal_s": None if heal_s is None else round(heal_s, 3),
+            "requests": len(futures),
+            "completed": completed,
+            "shed": shed,
+            "system_errors": system_errors,
+            "interactive_attainment": round(attainment, 4),
+            "per_class": per_class,
+            "hedge": hedge,
+            "gray_transitions": [
+                {k: a[k] for k in ("trigger", "key")} for a in grays
+            ],
+            "breakers": router.breaker_states(),
+            "violations": violations,
+        }
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    finally:
+        reset_chaos("", slowdown="")
+        ctl.shutdown()
+    return 1 if violations else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--sim", action="store_true",
+                      help="deterministic sim conformance (CI fast lane)")
+    mode.add_argument("--live", action="store_true",
+                      help="threaded soak against a real controller")
+    ap.add_argument("--smoke", action="store_true",
+                    help="live: shrink to a quick CI-sized soak")
+    ap.add_argument("--requests", type=int, default=600)
+    ap.add_argument("--rps", type=float, default=250.0)
+    ap.add_argument("--slo-ms", type=float, default=2_000.0)
+    ap.add_argument("--factor", type=float, default=10.0,
+                    help="live: slowdown multiplier on the straggler")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.live:
+        n = 300 if args.smoke else args.requests
+        return run_live(n, args.rps, args.slo_ms, args.factor)
+    return run_sim(seed=args.seed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
