@@ -112,12 +112,20 @@ def bench_llm_serving(
     deployment=None,
     quantize_kv: bool = False,
     paged: bool = False,
+    mesh: int = 1,
 ) -> dict:
     """North star: continuous-batching decode through the serving path.
 
     Phase A saturates the engine to measure peak tok/s/chip; phase B offers
     Poisson arrivals at ``poisson_utilization`` of measured capacity and
     reports p50/p99 TTFT (the BASELINE.json measurement axes).
+
+    ``mesh`` > 1 serves through a TP slice of that many chips (ROADMAP
+    item 2's A/B axis): the replica gets a ``mesh``-chip device bundle,
+    so the engine runs GSPMD-sharded decode — over the sharded page
+    pool when ``paged`` — and ``tok_s_per_chip`` normalizes by the
+    slice width (whole-slice tokens / chips), the planner's
+    per-chip-throughput convention for mesh profile rows.
     """
     import numpy as np
 
@@ -144,9 +152,34 @@ def bench_llm_serving(
             quantize_kv=quantize_kv,
             paged=paged,
         )
+    devices = None
+    slice_pg = slice_mgr = None
+    if mesh > 1:
+        # Reserve the chip gang through pin_slice, not a bare
+        # jax.devices() prefix: STRICT_PACK fails loudly when no single
+        # host holds the gang, so a multi-host relay can never commit a
+        # "per-chip" TP row whose collectives secretly crossed DCN.
+        from ray_dynamic_batching_tpu.parallel.placement import (
+            PlacementError,
+            PlacementManager,
+            pin_slice,
+        )
+
+        slice_mgr = PlacementManager()
+        try:
+            slice_pg, _ = pin_slice(slice_mgr, f"1x{mesh}")
+        except PlacementError as e:
+            return {
+                "skipped": f"mesh={mesh}: {e}",
+                "mesh": mesh,
+                "tok_s_per_chip": 0.0,
+                "ttft_p50_ms": None, "ttft_p99_ms": None,
+            }
+        devices = slice_pg.bundle_devices(0)
     replica = deployment.make_replica(
         f"{model_name}#bench",
         DeploymentConfig(name=model_name, max_ongoing_requests=4096),
+        devices=devices,
     )
     replica.start()
     router = Router(model_name, replicas=[replica], max_assign_timeout_s=30.0)
@@ -169,13 +202,17 @@ def bench_llm_serving(
     results = [f.result(timeout=600) for f in futs]
     elapsed = time.perf_counter() - t0
     total_tokens = sum(len(r.tokens) for r in results)
-    tok_s = total_tokens / elapsed
+    # Per-CHIP normalization: a TP slice's whole-slice tok/s divided by
+    # its width — the same convention as mesh profile rows, so slab vs
+    # paged vs TP arms are directly comparable.
+    tok_s = total_tokens / elapsed / max(1, mesh)
     _log(f"saturation: {total_tokens} tokens / {elapsed:.1f}s = "
-         f"{tok_s:.0f} tok/s/chip "
+         f"{tok_s:.0f} tok/s/chip over {mesh} chip(s) "
          f"({saturation_requests} reqs x {max_new_tokens} new tokens)")
 
     # --- phase B: Poisson arrivals -> TTFT -------------------------------
-    capacity_rps = tok_s / max_new_tokens
+    # Whole-UNIT capacity: the slice serves mesh x the per-chip rate.
+    capacity_rps = tok_s * max(1, mesh) / max_new_tokens
     offered_rps = max(0.5, capacity_rps * poisson_utilization)
     # Fresh TTFT window: the breakdown must describe the Poisson phase
     # (the north-star measurement), not the saturation ramp.
@@ -211,6 +248,8 @@ def bench_llm_serving(
     # is live.
     kv_occupancy = round(replica.engine.kv_occupancy(), 4)
     replica.stop(timeout_s=2.0, drain=False)
+    if slice_mgr is not None:
+        slice_mgr.remove(slice_pg)
     return {
         "tok_s_per_chip": round(tok_s, 1),
         "ttft_p50_ms": round(p50, 1),
@@ -222,6 +261,7 @@ def bench_llm_serving(
         "prompt_len": prompt_len,
         "max_new_tokens": max_new_tokens,
         "paged": paged,
+        "mesh": mesh,
         "kv_occupancy": kv_occupancy,
     }
 
@@ -447,12 +487,18 @@ def main() -> dict:
     # paged KV pool — the A/B axis against the slab record; the arm is
     # stamped into every row ("paged") so captures can't be confused.
     paged = os.environ.get("RDB_BENCH_PAGED") == "1"
+    # --mesh N (RDB_BENCH_MESH) serves the llm rows through an N-chip TP
+    # slice — ROADMAP item 2's A/B axis (1 = the classic single-chip
+    # record). Composes with --paged: the TP-paged arm is the
+    # mesh-native serving configuration the planner prices.
+    mesh = int(os.environ.get("RDB_BENCH_MESH", "1") or 1)
     llm_kwargs = dict(
         num_slots=8 if fast else 64,
         saturation_requests=16 if fast else 192,
         poisson_duration_s=5.0 if fast else 15.0,
         decode_horizon=8 if fast else 32,
         paged=paged,
+        mesh=mesh,
     )
     try:
         llm = bench_llm_serving(**llm_kwargs)
@@ -528,6 +574,7 @@ def main() -> dict:
         "backend": jax.default_backend(),
         "scope": "llm" if llm_only else "fast" if fast else "full",
         "paged": paged,
+        "mesh": mesh,
         "ttft_p50_ms": llm["ttft_p50_ms"],
         "ttft_p99_ms": llm["ttft_p99_ms"],
         "llm": llm,
@@ -547,7 +594,15 @@ if __name__ == "__main__":
         help="run the llm serving rows on the paged KV pool (the A/B "
              "axis vs the slab record; also RDB_BENCH_PAGED=1)",
     )
+    ap.add_argument(
+        "--mesh", type=int, choices=(1, 2, 4), default=None,
+        help="serve the llm rows through an N-chip TP slice (the mesh "
+             "placement A/B axis, ROADMAP item 2; also "
+             "RDB_BENCH_MESH=N; composes with --paged)",
+    )
     cli = ap.parse_args()
     if cli.paged is not None:
         os.environ["RDB_BENCH_PAGED"] = "1" if cli.paged == "on" else "0"
+    if cli.mesh is not None:
+        os.environ["RDB_BENCH_MESH"] = str(cli.mesh)
     print(json.dumps(main()))

@@ -513,12 +513,6 @@ class DecodeEngine:
                     "supported: the draft cache would need its own page "
                     "tables — run spec engines on the slab path"
                 )
-            if mesh is not None:
-                raise ValueError(
-                    "paged KV with a TP mesh is not supported yet: the "
-                    "page pool's sharding story (pages x kv-head shards) "
-                    "is ROADMAP item 2 territory"
-                )
             if not lane_aligned_page(self.page_size):
                 raise ValueError(
                     f"page_size {self.page_size} must be a 128-lane "
@@ -556,11 +550,44 @@ class DecodeEngine:
                 dtype=np.int32,
             )
             self._table_dirty = True
-            with self._device_ctx():
-                self._cache = model.make_paged_cache(
-                    num_slots, self.num_pages, self.page_size,
-                    self._paged_capacity,
+            if mesh is not None and not hasattr(model, "paged_cache_pspec"):
+                # Loud, like the draft-model conflict: silently
+                # allocating the pool on ONE chip under a TP mesh would
+                # reshard it through ICI every step and mislabel every
+                # measurement stamped from the config (the PR-7 silent-
+                # fallback class).
+                raise ValueError(
+                    f"{getattr(model, 'name', type(model).__name__)}: "
+                    "paged=True on a TP mesh needs the model to define "
+                    "paged_cache_pspec (the pool's sharding layout) — "
+                    "see CausalLM.paged_cache_pspec"
                 )
+            if mesh is not None:
+                # TP serving slice over the paged pool (ROADMAP item 2):
+                # pages shard on the kv-head dim exactly like the slab
+                # TP cache (codes + scales planes included); the page
+                # table, lengths, and the host-side free-list allocator
+                # stay replica-global — page indices are shard-
+                # invariant. The decode kernel runs per-shard head
+                # slices under the mesh (ops/attention.tensor_parallel
+                # -> paged_decode_attention's shard_map wrapper); the
+                # CPU/XLA gather fallback partitions from the pool's
+                # NamedSharding under plain GSPMD, so both read paths
+                # stay token-exact vs the single-chip pool.
+                from ray_dynamic_batching_tpu.parallel.mesh import (
+                    make_sharded_paged_cache,
+                )
+
+                self._cache = make_sharded_paged_cache(
+                    mesh, model, num_slots, self.num_pages,
+                    self.page_size, self._paged_capacity,
+                )
+            else:
+                with self._device_ctx():
+                    self._cache = model.make_paged_cache(
+                        num_slots, self.num_pages, self.page_size,
+                        self._paged_capacity,
+                    )
         elif mesh is not None and hasattr(model, "cache_pspec"):
             from ray_dynamic_batching_tpu.parallel.mesh import (
                 make_sharded_cache,
@@ -900,6 +927,25 @@ class DecodeEngine:
         [2h+1, B] (h token rows, h advanced rows, 1 lengths row) so the
         device→host boundary is crossed once per dispatch, not three times.
         """
+        if self.paged and self.mesh is not None:
+            # TP paged decode: bake the slice into the trace so the
+            # Pallas paged kernel runs per-shard under shard_map (GSPMD
+            # cannot partition a pallas_call). Entered inside the traced
+            # function, the sequence_parallel contract.
+            from ray_dynamic_batching_tpu.ops.attention import (
+                tensor_parallel,
+            )
+
+            with tensor_parallel(self.mesh):
+                return self._decode_body(params, cache, step_state,
+                                         horizon, samp_f, samp_i,
+                                         bias_ids, bias_vals, counts)
+        return self._decode_body(params, cache, step_state, horizon,
+                                 samp_f, samp_i, bias_ids, bias_vals,
+                                 counts)
+
+    def _decode_body(self, params, cache, step_state, horizon: int,
+                     samp_f, samp_i, bias_ids, bias_vals, counts):
         tokens = step_state[0][:, None]
         active = step_state[1].astype(bool)
         tok_idx0 = step_state[2]

@@ -311,6 +311,29 @@ class CausalLM(ServableModel):
             v_scale=scale_spec,                   # type: ignore[arg-type]
         )
 
+    def paged_cache_pspec(self) -> PagedKVCache:
+        """PartitionSpecs for the PAGED KV pool (ROADMAP item 2): pages
+        shard on the kv-head dim exactly like the slab cache — the pool
+        is ``[L, P, ps, K, H]``, so K sits at the same index 3 and a
+        shard owns the full page set for its head slice. The page table
+        and lengths REPLICATE: page indices are shard-invariant (every
+        shard's slice of page ``p`` backs the same logical positions),
+        which is what lets the host-side ``PageAllocator`` stay
+        replica-global. Scale planes (``[L, P, ps, K]``) shard with
+        their heads."""
+        scale_spec = None
+        if self.kv_dtype is not None and jnp.dtype(
+                self.kv_dtype) == jnp.dtype(jnp.int8):
+            scale_spec = P(None, None, None, "tp")
+        return PagedKVCache(
+            k=P(None, None, None, "tp", None),   # type: ignore[arg-type]
+            v=P(None, None, None, "tp", None),   # type: ignore[arg-type]
+            page_table=P(None, None),             # type: ignore[arg-type]
+            lengths=P(None),                      # type: ignore[arg-type]
+            k_scale=scale_spec,                   # type: ignore[arg-type]
+            v_scale=scale_spec,                   # type: ignore[arg-type]
+        )
+
 
 GPT2_MEDIUM = DecoderConfig(
     vocab_size=50257,

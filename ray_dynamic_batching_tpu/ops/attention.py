@@ -24,6 +24,15 @@ _BACKEND = "auto"  # "auto" | "xla" | "pallas"
 _SP_CTX: contextvars.ContextVar[Optional[Tuple]] = contextvars.ContextVar(
     "sequence_parallel_ctx", default=None
 )
+# (mesh, axis) when a TP serving slice is active (ROADMAP item 2): the
+# paged decode kernel must run per-shard under shard_map — GSPMD cannot
+# partition a pallas_call on its own — so the engine names its slice
+# here and the dispatcher threads it into the kernel wrapper. Same
+# ContextVar discipline (and the same enter-inside-the-traced-function
+# contract) as the sequence-parallel context above.
+_TP_CTX: contextvars.ContextVar[Optional[Tuple]] = contextvars.ContextVar(
+    "tensor_parallel_ctx", default=None
+)
 
 
 def set_attention_backend(backend: str) -> None:
@@ -44,6 +53,24 @@ def sequence_parallel(mesh, axis: str = "sp"):
         yield
     finally:
         _SP_CTX.reset(token)
+
+
+@contextlib.contextmanager
+def tensor_parallel(mesh, axis: str = "tp"):
+    """While active (including during jit tracing), the PAGED decode
+    read routes the Pallas kernel through its per-shard ``shard_map``
+    wrapper over the mesh's ``axis`` (``paged_decode_attention``'s
+    ``mesh`` parameter): q and the page pools split on the kv-head dim,
+    page table and lengths stay replicated — page indices are
+    shard-invariant. The non-kernel paths need no context: the gather
+    fallback is plain jnp, which GSPMD partitions from the pool's
+    NamedSharding. Enter it inside the jitted step function, exactly
+    like :func:`sequence_parallel`."""
+    token = _TP_CTX.set((mesh, axis))
+    try:
+        yield
+    finally:
+        _TP_CTX.reset(token)
 
 
 def self_attention(
@@ -184,9 +211,15 @@ def _paged_attention(
     if _use_pallas():
         from ray_dynamic_batching_tpu.ops import decode_attention
 
+        tp_ctx = _TP_CTX.get()
+        mesh_kwargs = {}
+        if tp_ctx is not None:
+            tp_mesh, tp_axis = tp_ctx
+            if tp_mesh.shape.get(tp_axis, 1) > 1:
+                mesh_kwargs = {"mesh": tp_mesh, "mesh_axis": tp_axis}
         out = decode_attention.paged_decode_attention(
             q, k, v, page_table, kv_lengths, scale=scale,
-            k_scale=k_scale, v_scale=v_scale,
+            k_scale=k_scale, v_scale=v_scale, **mesh_kwargs,
         )
         if out is not None:
             return out

@@ -52,7 +52,7 @@ gate.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -408,6 +408,8 @@ def paged_decode_attention(
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
+    mesh: Optional[Any] = None,
+    mesh_axis: str = "tp",
 ) -> Optional[jax.Array]:
     """Fused page-table decode attention; returns None when the shapes
     aren't the paged decode pattern (caller falls back to the explicit
@@ -424,6 +426,17 @@ def paged_decode_attention(
     double-buffered, and the page size must be a 128-lane multiple (the
     int8 scale tile's lane dim is the page). The static ``vmem-budget``
     lint rule re-evaluates this same model over the BlockSpecs above.
+
+    ``mesh`` (a TP serving slice; ROADMAP item 2) runs the SAME kernel
+    per shard under ``shard_map`` over ``mesh_axis``: q and the pools
+    split on the kv-head axis (the slab TP layout — pages are
+    shard-invariant, so the page table and lengths replicate), each
+    shard scans its own head slice with the shared ``_scan_tile`` body,
+    and the VMEM guard budgets the PER-SHARD block
+    (``tile_math.shard_heads`` — a head-sharded kernel's bytes divide
+    by the TP degree). Declines (None) when the head axis does not
+    divide — replicated heads fall back to the gather path, which GSPMD
+    partitions from the pool's NamedSharding.
     """
     if q.ndim != 4 or k.ndim != 4 or q.shape[1] != 1:
         return None
@@ -442,7 +455,15 @@ def paged_decode_attention(
         return None
     if not tile_math.lane_aligned_page(ps):
         return None
-    kb = _pick_heads_block(K)
+    tp = 1
+    if mesh is not None:
+        tp = int(mesh.shape.get(mesh_axis, 1))
+        if tp > 1 and (K % tp != 0 or N % tp != 0):
+            return None  # heads replicate under this mesh: gather path
+    # Per-shard footprint: each shard owns K/tp kv heads, so the guard
+    # budgets the block the kernel will ACTUALLY stream on one core.
+    k_local = tile_math.shard_heads(K, tp)
+    kb = _pick_heads_block(k_local)
     if tile_math.paged_tile_bytes(
             ps, kb, H, k.dtype.itemsize,
             with_scales=k_scale is not None) > VMEM_BLOCK_BUDGET_BYTES:
@@ -461,14 +482,63 @@ def paged_decode_attention(
         # path is the same trap this transpose avoids).
         ks = k_scale.transpose(0, 2, 1)
         vs = v_scale.transpose(0, 2, 1)
-    out = _paged_decode_attention(
-        q_r, k, v, page_table.astype(jnp.int32),
-        kv_lengths.astype(jnp.int32), ks, vs,
-        scale=float(scale), interpret=bool(interpret),
-    )
+    if tp > 1:
+        out = _paged_decode_attention_tp(
+            mesh, mesh_axis, q_r, k, v, page_table.astype(jnp.int32),
+            kv_lengths.astype(jnp.int32), ks, vs,
+            scale=float(scale), interpret=bool(interpret),
+        )
+    else:
+        out = _paged_decode_attention(
+            q_r, k, v, page_table.astype(jnp.int32),
+            kv_lengths.astype(jnp.int32), ks, vs,
+            scale=float(scale), interpret=bool(interpret),
+        )
     return out.reshape(B, K, 1, G, H).transpose(0, 2, 1, 3, 4).reshape(
         B, 1, N, H
     )
+
+
+def _paged_decode_attention_tp(
+    mesh, axis: str, q_r, k, v, page_table, kv_lengths, ks, vs,
+    *, scale: float, interpret: bool,
+):
+    """The TP wrapper: ``shard_map`` the paged kernel over the mesh's
+    ``axis`` with q/pools split on the kv-head dim and the page
+    table/lengths replicated (page indices are shard-invariant). Each
+    shard's call is the ordinary single-device kernel on its head
+    slice — numerics are per-head, so the sharded result is exactly the
+    unsharded one re-laid-out."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    args = [q_r, k, v, page_table, kv_lengths]
+    in_specs = [
+        P(None, axis, None, None),   # q rows split by kv head
+        P(None, None, axis, None),   # k pool: heads split, pages whole
+        P(None, None, axis, None),
+        P(None, None),               # page table: replica-global
+        P(None),                     # lengths: replica-global
+    ]
+    has_scales = ks is not None
+    if has_scales:
+        args += [ks, vs]
+        in_specs += [P(None, axis, None), P(None, axis, None)]
+
+    def local(q_l, k_l, v_l, pt, ln, *rest):
+        ks_l = rest[0] if has_scales else None
+        vs_l = rest[1] if has_scales else None
+        return _paged_decode_attention(
+            q_l, k_l, v_l, pt, ln, ks_l, vs_l,
+            scale=scale, interpret=interpret,
+        )
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P(None, axis, None, None),
+        check_rep=False,
+    )(*args)
 
 
 def decode_attention(

@@ -91,6 +91,20 @@ def pages_for(length: int, page_size: int) -> int:
     return -(-int(length) // int(page_size))
 
 
+def shard_heads(num_kv_heads: int, tp: int) -> int:
+    """Per-shard KV-head count under ``tp``-way tensor-parallel head
+    sharding: K/tp when tp divides K, else K — an indivisible head axis
+    REPLICATES instead of sharding (``parallel/mesh._feasible_spec``),
+    so every shard still streams the full head set. Shared by the
+    runtime kernel guards (a head-sharded paged kernel's VMEM bytes
+    divide by the TP degree) and the standalone-loaded vmem-budget
+    lint model, with an agreement pin test so the two cannot drift."""
+    tp = int(tp or 1)
+    if tp > 1 and num_kv_heads % tp == 0:
+        return num_kv_heads // tp
+    return num_kv_heads
+
+
 def lane_aligned_page(page_size: int) -> bool:
     """A KV page is tile-legal iff its size is a LANE multiple: the int8
     scale tile streams as [1, kb, page_size] with the page as its lane

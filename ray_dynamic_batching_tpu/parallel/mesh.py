@@ -131,17 +131,14 @@ def shard_params(mesh: Mesh, model: ServableModel, params: Any) -> Any:
     return jax.device_put(params, shardings)
 
 
-def make_sharded_cache(
-    mesh: Mesh, model: Any, num_slots: int, max_len: Optional[int] = None
-) -> Any:
-    """Allocate a model's KV cache DIRECTLY onto the mesh per its
-    ``cache_pspec`` (kv heads over tp). The cache never materializes
-    unsharded on any single device — a cache sized to fit only when split
-    over the tp chips must not OOM chip 0 on the way in."""
+def _sharded_alloc(mesh: Mesh, make_fn, spec) -> Any:
+    """Allocate a cache pytree DIRECTLY onto the mesh per its pspec
+    dataclass. The buffers never materialize unsharded on any single
+    device — a pool sized to fit only when split over the tp chips must
+    not OOM chip 0 on the way in."""
     import dataclasses
 
-    shapes = jax.eval_shape(lambda: model.make_cache(num_slots, max_len))
-    spec = model.cache_pspec()
+    shapes = jax.eval_shape(make_fn)
 
     def _shard(field_spec, field_shape):
         if field_shape is None:  # absent optional plane (e.g. scales)
@@ -157,10 +154,36 @@ def make_sharded_cache(
         f.name: _shard(getattr(spec, f.name, None), getattr(shapes, f.name))
         for f in dataclasses.fields(shapes)
     })
-    return jax.jit(
-        lambda: model.make_cache(num_slots, max_len),
-        out_shardings=shardings,
-    )()
+    return jax.jit(make_fn, out_shardings=shardings)()
+
+
+def make_sharded_cache(
+    mesh: Mesh, model: Any, num_slots: int, max_len: Optional[int] = None
+) -> Any:
+    """Allocate a model's KV cache onto the mesh per its ``cache_pspec``
+    (kv heads over tp)."""
+    return _sharded_alloc(
+        mesh, lambda: model.make_cache(num_slots, max_len),
+        model.cache_pspec(),
+    )
+
+
+def make_sharded_paged_cache(
+    mesh: Mesh, model: Any, num_slots: int, num_pages: int,
+    page_size: int, max_len: int,
+) -> Any:
+    """Allocate a model's PAGED KV pool onto the mesh per its
+    ``paged_cache_pspec`` (ROADMAP item 2): page planes split on the
+    kv-head dim like the slab cache, page table + lengths replicated —
+    page indices are shard-invariant, so the host-side free-list
+    allocator stays replica-global and untouched."""
+    return _sharded_alloc(
+        mesh,
+        lambda: model.make_paged_cache(
+            num_slots, num_pages, page_size, max_len
+        ),
+        model.paged_cache_pspec(),
+    )
 
 
 def replicate(mesh: Mesh, tree: Any) -> Any:

@@ -66,6 +66,38 @@ class PlacementGroup:
         return sum(b.chips for b in self.bundles)
 
 
+def pin_slice(
+    manager: "PlacementManager",
+    mesh_shape: str,
+    strategy: str = STRICT_PACK,
+):
+    """Pin one ``(model, mesh_shape)`` schedulable unit to silicon
+    (ROADMAP item 2): reserve a ``mesh_chips(mesh_shape)``-wide chip SET
+    as a single gang bundle and build its TP mesh from exactly those
+    devices — the bridge between the planner's mesh-shape string
+    (``scheduler/nexus.Session.mesh_shape``) and the devices a
+    ``DecodeEngine(mesh=...)`` replica actually runs on.
+
+    ``STRICT_PACK`` by default: a TP slice's collectives ride ICI, so
+    the gang must land on ONE host or fail loudly — never silently
+    straddle DCN. Returns ``(group, mesh)``; ``mesh`` is None for a
+    1-chip shape (callers pin the single device instead — the classic
+    path). Release the reservation with ``manager.remove(group)`` when
+    the slice dies or the replica is torn down."""
+    from ray_dynamic_batching_tpu.profiles.table import mesh_chips
+
+    chips = mesh_chips(mesh_shape)
+    pg = manager.create([Bundle(chips=chips)], strategy=strategy)
+    if chips == 1:
+        return pg, None
+    from ray_dynamic_batching_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    return pg, build_mesh(MeshConfig(tp=chips), pg.bundle_devices(0))
+
+
 class PlacementManager:
     """Chip accounting + strategy placement over the visible devices.
 

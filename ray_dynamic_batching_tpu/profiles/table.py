@@ -12,7 +12,15 @@ TPU-first differences from the reference CSVs:
 - each row carries ``hbm_bytes`` (total program footprint incl. weights) and
   ``compile_ms`` so the planner can budget HBM and amortize compiles;
 - a ``seq_len`` column generalizes the table to shape-bucketed LLM prefill
-  (0 = fixed-shape vision input).
+  (0 = fixed-shape vision input);
+- a ``mesh`` column generalizes the table to mesh-sliced placements
+  (ROADMAP item 2): ``"1x4"`` rows describe the model compiled over a
+  4-chip TP slice — ``latency_ms`` is the whole-slice step latency,
+  ``hbm_bytes`` the PER-CHIP footprint (what each chip's budget must
+  absorb: weights/tp + its activation shard), and throughput the whole
+  slice's. Single-chip rows are ``mesh="1x1"``, the loader default, so
+  every committed table reads unchanged and every lookup that doesn't
+  ask for a mesh keeps seeing exactly the rows it always did.
 """
 
 from __future__ import annotations
@@ -34,6 +42,7 @@ class ProfileRow:
     hbm_bytes: int               # total device footprint (weights+activations)
     compile_ms: float            # one-time XLA compile cost for this bucket
     throughput_sps: float = 0.0  # batch_size / latency
+    mesh: str = "1x1"            # mesh shape this row was measured at
 
     def with_throughput(self) -> "ProfileRow":
         tput = self.batch_size / (self.latency_ms / 1000.0) if self.latency_ms else 0.0
@@ -45,7 +54,25 @@ class ProfileRow:
             self.hbm_bytes,
             self.compile_ms,
             tput,
+            self.mesh,
         )
+
+
+def mesh_chips(mesh: str) -> int:
+    """Chip count of a mesh-shape string (``"1x4"`` -> 4). The shared
+    parse — the packer's chip-set sizing, the replan matcher's width
+    compatibility, and the sim's slice accounting all go through here so
+    a malformed shape fails identically everywhere."""
+    try:
+        dims = [int(d) for d in str(mesh).lower().split("x")]
+    except ValueError:
+        dims = []
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"malformed mesh shape {mesh!r} (want e.g. '1x4')")
+    n = 1
+    for d in dims:
+        n *= d
+    return n
 
 
 CSV_FIELDS = [
@@ -56,6 +83,7 @@ CSV_FIELDS = [
     "hbm_bytes",
     "compile_ms",
     "throughput_sps",
+    "mesh",
 ]
 
 
@@ -66,42 +94,53 @@ class BatchProfile:
         self.model_name = model_name
         self.rows: List[ProfileRow] = sorted(
             (r.with_throughput() for r in rows),
-            key=lambda r: (r.seq_len, r.batch_size),
+            key=lambda r: (r.seq_len, r.batch_size, r.mesh),
         )
 
     # --- construction -----------------------------------------------------
     def add(self, row: ProfileRow) -> None:
         self.rows.append(row.with_throughput())
-        self.rows.sort(key=lambda r: (r.seq_len, r.batch_size))
+        self.rows.sort(key=lambda r: (r.seq_len, r.batch_size, r.mesh))
 
     # --- lookups (always round batch UP to a profiled bucket) -------------
-    def _seq_rows(self, seq_len: int = 0) -> List[ProfileRow]:
-        rows = [r for r in self.rows if r.seq_len == seq_len]
-        if not rows and self.rows:
+    def _seq_rows(self, seq_len: int = 0, mesh: str = "1x1"
+                  ) -> List[ProfileRow]:
+        pool = [r for r in self.rows if r.mesh == mesh]
+        rows = [r for r in pool if r.seq_len == seq_len]
+        if not rows and pool:
             # fall back to nearest profiled seq bucket >= requested
-            seqs = sorted({r.seq_len for r in self.rows})
+            seqs = sorted({r.seq_len for r in pool})
             chosen = next((s for s in seqs if s >= seq_len), seqs[-1])
-            rows = [r for r in self.rows if r.seq_len == chosen]
+            rows = [r for r in pool if r.seq_len == chosen]
         return rows
 
-    def buckets(self, seq_len: int = 0) -> List[int]:
-        return [r.batch_size for r in self._seq_rows(seq_len)]
+    def meshes(self) -> List[str]:
+        """Mesh shapes this table has rows for, smallest slice first —
+        the degrade ladder ``scheduler/replan.degrade_sessions`` walks
+        when a model's preferred slice width no longer exists."""
+        return sorted({r.mesh for r in self.rows}, key=mesh_chips)
 
-    def bucket_for(self, batch_size: int, seq_len: int = 0) -> Optional[ProfileRow]:
+    def buckets(self, seq_len: int = 0, mesh: str = "1x1") -> List[int]:
+        return [r.batch_size for r in self._seq_rows(seq_len, mesh)]
+
+    def bucket_for(self, batch_size: int, seq_len: int = 0,
+                   mesh: str = "1x1") -> Optional[ProfileRow]:
         """Smallest profiled bucket >= batch_size (None if beyond the table)."""
-        for r in self._seq_rows(seq_len):
+        for r in self._seq_rows(seq_len, mesh):
             if r.batch_size >= batch_size:
                 return r
         return None
 
-    def row_for(self, batch_size: int, seq_len: int = 0) -> Optional[ProfileRow]:
-        for r in self._seq_rows(seq_len):
+    def row_for(self, batch_size: int, seq_len: int = 0,
+                mesh: str = "1x1") -> Optional[ProfileRow]:
+        for r in self._seq_rows(seq_len, mesh):
             if r.batch_size == batch_size:
                 return r
         return None
 
-    def latency_ms(self, batch_size: int, seq_len: int = 0) -> float:
-        row = self.bucket_for(batch_size, seq_len)
+    def latency_ms(self, batch_size: int, seq_len: int = 0,
+                   mesh: str = "1x1") -> float:
+        row = self.bucket_for(batch_size, seq_len, mesh)
         if row is None:
             raise KeyError(
                 f"{self.model_name}: no profiled bucket >= batch {batch_size}"
@@ -110,24 +149,40 @@ class BatchProfile:
 
     def largest_within_latency(
         self, max_latency_ms: float, seq_len: int = 0,
-        hbm_budget_bytes: Optional[int] = None,
+        hbm_budget_bytes: Optional[int] = None, mesh: str = "1x1",
     ) -> Optional[ProfileRow]:
         """Largest bucket whose latency (and HBM) fit — the Nexus 'saturate'
         selection rule (ref nexus.py:154-165), against measured buckets."""
         best = None
-        for r in self._seq_rows(seq_len):
+        for r in self._seq_rows(seq_len, mesh):
             if r.latency_ms <= max_latency_ms and (
                 hbm_budget_bytes is None or r.hbm_bytes <= hbm_budget_bytes
             ):
                 best = r
         return best
 
-    def max_throughput(self, seq_len: int = 0) -> float:
-        rows = self._seq_rows(seq_len)
+    def max_throughput(self, seq_len: int = 0, mesh: str = "1x1") -> float:
+        rows = self._seq_rows(seq_len, mesh)
         return max((r.throughput_sps for r in rows), default=0.0)
 
-    def weights_hbm_bytes(self) -> int:
-        """Lower bound on resident footprint: min over rows (≈ weights)."""
+    def weights_hbm_bytes(self, mesh: Optional[str] = None) -> int:
+        """Lower bound on resident footprint: min over rows (≈ weights).
+
+        ``mesh`` restricts to rows measured at that shape — necessary
+        on mixed-mesh tables, where per-chip footprints differ by slice
+        width (a 1x2 row carries twice the weight shard of a 1x4 row)
+        and the unrestricted min would always answer with the WIDEST
+        mesh's shard, underpricing uploads to narrower shapes. Falls
+        back to the all-rows min when the table has no rows at the
+        requested shape (the pre-mesh behavior, and the safe lower
+        bound when a shape is missing)."""
+        if mesh is not None:
+            at_mesh = min(
+                (r.hbm_bytes for r in self.rows if r.mesh == mesh),
+                default=0,
+            )
+            if at_mesh > 0:
+                return at_mesh
         return min((r.hbm_bytes for r in self.rows), default=0)
 
     # --- persistence (the CSV/JSON contract) ------------------------------
@@ -160,6 +215,8 @@ class BatchProfile:
                     latency_std_ms=float(rec.get("latency_std_ms", 0) or 0),
                     hbm_bytes=int(float(rec.get("hbm_bytes", 0) or 0)),
                     compile_ms=float(rec.get("compile_ms", 0) or 0),
+                    # Pre-mesh tables have no column: single-chip rows.
+                    mesh=str(rec.get("mesh") or "1x1"),
                 )
             )
         return cls(model_name, rows)

@@ -103,10 +103,15 @@ class LiveScheduler:
         self._gray_windows: Dict[str, List[List[float]]] = {}
 
     # --- registration (ref models_config) ---------------------------------
-    def register_model(self, name: str, slo_ms: float, seq_len: int = 0) -> None:
+    def register_model(self, name: str, slo_ms: float, seq_len: int = 0,
+                       mesh_shape: str = "1x1") -> None:
+        """``mesh_shape`` is the model's preferred serving slice
+        ("1x4" = a 4-chip TP replica priced from its mesh profile
+        rows); replans degrade it to surviving geometry when the wide
+        slices are gone (scheduler/replan.degrade_sessions)."""
         if name not in self.packer.profiles:
             raise KeyError(f"no batch profile for model {name!r}")
-        self._models[name] = ModelEntry(name, slo_ms, seq_len)
+        self._models[name] = ModelEntry(name, slo_ms, seq_len, mesh_shape)
 
     # --- ingress path (ref submit_request, scheduler.py:734-751) ----------
     def submit_request(self, request: Request) -> bool:
@@ -154,12 +159,26 @@ class LiveScheduler:
             if self.capacity_factors is not None:
                 by_id = self.capacity_factors()
                 factors = [by_id.get(e.engine_id, 1.0) for e in alive]
+            # Mesh-sliced engines advertise their chip-set width (an
+            # engine without the attribute is one chip — the classic
+            # domain, where these lists are all-1/"1x1" and the decision
+            # is byte-identical to the pre-mesh planner). A slice death
+            # removes its width here, so the heal replan runs over the
+            # SURVIVING geometry and degrade_sessions re-shapes TP
+            # models to the slices still standing.
+            widths = [int(getattr(e, "width", 1) or 1) for e in alive]
+            meshes = [
+                str(getattr(e, "mesh_shape", "") or f"1x{w}")
+                for e, w in zip(alive, widths)
+            ]
             decision = decide_replan(
                 self.packer,
                 [frozenset(e.models) for e in alive],
                 self._sessions_for(rates),
                 rates,
                 capacity_factors=factors,
+                engine_widths=widths,
+                engine_meshes=meshes,
             )
             for engine, node_plan in zip(alive, decision.assignment):
                 if node_plan is not None:
@@ -204,9 +223,21 @@ class LiveScheduler:
                 "engine %s dead; migrating its models to survivors",
                 e.engine_id,
             )
+        observed: Dict = {"dead_engines": sorted(self._dead_engines)}
+        # Slice semantics (serve/failover.SliceDeadError): a multi-chip
+        # engine dying means one chip in its gang took the whole slice
+        # down — the audit names the lost width so the heal replan's
+        # degraded shapes are explainable.
+        slices = {
+            e.engine_id: {"width": int(getattr(e, "width", 1) or 1)}
+            for e in newly_dead
+            if int(getattr(e, "width", 1) or 1) > 1
+        }
+        if slices:
+            observed["dead_slices"] = slices
         self.audit.record(
             "engine_dead",
-            observed={"dead_engines": sorted(self._dead_engines)},
+            observed=observed,
             diff={"removed": [e.engine_id for e in newly_dead]},
             note="engine death detected by monitor; replan over survivors",
         )

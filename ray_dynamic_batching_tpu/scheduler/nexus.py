@@ -30,7 +30,11 @@ import math
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
-from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
+from ray_dynamic_batching_tpu.profiles.table import (
+    BatchProfile,
+    ProfileRow,
+    mesh_chips,
+)
 from ray_dynamic_batching_tpu.utils.config import get_config
 
 
@@ -43,6 +47,15 @@ class Session:
     slo_ms: float
     rate_rps: float
     seq_len: int = 0  # shape bucket for LLM prefill; 0 = fixed-shape
+    # Mesh shape this model serves at (ROADMAP item 2): the packer prices
+    # it from the profile rows measured at this shape and emits node
+    # plans over mesh_chips(mesh_shape)-wide chip SETS. "1x1" = the
+    # classic single-chip duty-cycle placement.
+    mesh_shape: str = "1x1"
+
+    @property
+    def chips(self) -> int:
+        return mesh_chips(self.mesh_shape)
 
 
 @dataclass
@@ -58,10 +71,21 @@ class Placement:
 
 @dataclass
 class NodePlan:
-    """One chip's duty-cycle schedule (ref: node, nexus.py:75)."""
+    """One schedulable unit's duty-cycle schedule (ref: node, nexus.py:75).
+
+    A unit is one chip (``mesh_shape == "1x1"``) or one mesh SLICE: a
+    gang of ``chips`` chips running the co-located models' programs
+    GSPMD-partitioned over the slice. ``hbm_bytes`` stays per-chip
+    (mesh profile rows record per-chip footprints), so the chip budget
+    check is shape-invariant."""
 
     placements: List[Placement] = field(default_factory=list)
     duty_cycle_ms: float = 0.0
+    mesh_shape: str = "1x1"
+
+    @property
+    def chips(self) -> int:
+        return mesh_chips(self.mesh_shape)
 
     @property
     def occupancy(self) -> float:
@@ -80,7 +104,8 @@ class NodePlan:
             f"{p.session.model}(b={p.batch_size}, occ={p.occupancy:.2f})"
             for p in self.placements
         )
-        return f"NodePlan(duty={self.duty_cycle_ms:.1f}ms, [{parts}])"
+        mesh = "" if self.mesh_shape == "1x1" else f"mesh={self.mesh_shape}, "
+        return f"NodePlan(duty={self.duty_cycle_ms:.1f}ms, {mesh}[{parts}])"
 
 
 def worst_latency_ms(row: ProfileRow) -> float:
@@ -134,11 +159,13 @@ class SquishyBinPacker:
 
     def saturate_row(self, session: Session) -> Optional[ProfileRow]:
         """Largest profiled bucket with worst_latency <= compute share of SLO
-        and footprint within the chip budget."""
+        and footprint within the chip budget. Rows come from the
+        session's MESH SHAPE (per-slice latency, per-chip footprint), so
+        a TP placement is priced from its own measured tables."""
         prof = self.profiles[session.model]
         budget_ms = self._effective_slo(session) * self.compute_fraction
         best = None
-        for row in prof._seq_rows(session.seq_len):
+        for row in prof._seq_rows(session.seq_len, session.mesh_shape):
             if (
                 worst_latency_ms(row) <= budget_ms
                 and row.hbm_bytes <= self.hbm_budget
@@ -161,9 +188,12 @@ class SquishyBinPacker:
                 # No bucket fits the SLO: serve at the smallest bucket anyway
                 # (degraded), one request-rate's worth of nodes.
                 prof = self.profiles[session.model]
-                rows = prof._seq_rows(session.seq_len)
+                rows = prof._seq_rows(session.seq_len, session.mesh_shape)
                 if not rows:
-                    raise KeyError(f"no profile rows for {session.model}")
+                    raise KeyError(
+                        f"no profile rows for {session.model} at mesh "
+                        f"{session.mesh_shape}"
+                    )
                 row = rows[0]
             wl = worst_latency_ms(row)
             max_throughput = row.batch_size / (wl / 1000.0)
@@ -182,6 +212,7 @@ class SquishyBinPacker:
                             )
                         ],
                         duty_cycle_ms=wl,
+                        mesh_shape=session.mesh_shape,
                     )
                 )
             if residue_rate > 1e-9:
@@ -196,7 +227,7 @@ class SquishyBinPacker:
         duty = batch/rate*1000, occupancy = latency/duty (ref nexus.py:263-268).
         """
         prof = self.profiles[session.model]
-        rows = prof._seq_rows(session.seq_len)
+        rows = prof._seq_rows(session.seq_len, session.mesh_shape)
         rows = [r for r in rows if r.hbm_bytes <= self.hbm_budget]
         if not rows:
             return None
@@ -234,6 +265,7 @@ class SquishyBinPacker:
                 )
             ],
             duty_cycle_ms=duty,
+            mesh_shape=session.mesh_shape,
         )
 
     # --- merge (ref mergeNodes, nexus.py:202-228) --------------------------
@@ -246,7 +278,13 @@ class SquishyBinPacker:
         (ref nexus.py:218), summed HBM fits (ref nexus.py:222-226, gpu_mem →
         HBM budget), and — TPU addition — each re-derived bucket still meets
         its session's SLO end-to-end (bucket rounding can pick a bigger
-        program than the exact batch the reference would run)."""
+        program than the exact batch the reference would run). Mesh
+        addition: co-location is WITHIN a slice shape only — a 1x4
+        slice's duty cycle can host another 1x4 program, but folding a
+        single-chip program onto a slice (or vice versa) would change
+        the chip set under a compiled program."""
+        if a.mesh_shape != b.mesh_shape:
+            return None
         duty = min(a.duty_cycle_ms, b.duty_cycle_ms)
         placements: List[Placement] = []
         hbm_total = 0
@@ -255,7 +293,7 @@ class SquishyBinPacker:
             s = p.session
             need = max(math.ceil(duty * s.rate_rps / 1000.0), 1)
             prof = self.profiles[s.model]
-            row = prof.bucket_for(need, s.seq_len)
+            row = prof.bucket_for(need, s.seq_len, s.mesh_shape)
             if row is None:
                 return None  # rate too high for any compiled bucket at this duty
             wl = worst_latency_ms(row)
@@ -279,7 +317,8 @@ class SquishyBinPacker:
                     hbm_bytes=row.hbm_bytes,
                 )
             )
-        return NodePlan(placements=placements, duty_cycle_ms=duty)
+        return NodePlan(placements=placements, duty_cycle_ms=duty,
+                        mesh_shape=a.mesh_shape)
 
     def merge_residues(self, nodes: List[NodePlan]) -> List[NodePlan]:
         """Best-fit decreasing: walk residue nodes by descending occupancy and
@@ -311,7 +350,9 @@ class SquishyBinPacker:
         return saturated + self.merge_residues(residue_nodes)
 
     def chips_required(self, sessions: List[Session]) -> int:
-        return len(self.plan(sessions))
+        """Physical chips the plan consumes: each node costs its slice
+        width (1 for classic single-chip nodes — unchanged there)."""
+        return sum(n.chips for n in self.plan(sessions))
 
 
 # --- LLM decode colocation (the control theory applied to decode) ----------

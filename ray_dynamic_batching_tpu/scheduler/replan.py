@@ -19,11 +19,11 @@ Reference lineage: rate-triggered replan + minimal-movement matching,
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_dynamic_batching_tpu.engine.request import QOS_WEIGHTS
-from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.profiles.table import BatchProfile, mesh_chips
 from ray_dynamic_batching_tpu.scheduler.audit import plan_diff
 from ray_dynamic_batching_tpu.scheduler.nexus import (
     NodePlan,
@@ -37,6 +37,14 @@ logger = get_logger("replan")
 
 BRUTE_FORCE_LIMIT = 7  # assignment is brute-forced up to this many nodes
 
+# Cross-mesh-shape migration premium (ms-equivalent per weight MB, on
+# top of the load+compile the matcher already charges): re-laying a
+# resident weight set over a different slice geometry moves every
+# parameter byte through an all-gather + re-slice over ICI — roughly a
+# read plus a write of the weights — where a same-shape move is a plain
+# upload. One constant shared by matcher objective and audit pricing.
+RESHARD_MB_FACTOR = 2.0
+
 
 @dataclass
 class ModelEntry:
@@ -45,6 +53,9 @@ class ModelEntry:
     name: str
     slo_ms: float
     seq_len: int = 0
+    # Preferred serving mesh shape: "1x1" = single chip (the default,
+    # and the only shape pre-mesh deployments ever register).
+    mesh_shape: str = "1x1"
 
 
 def weighted_attainment(
@@ -87,18 +98,84 @@ def sessions_for(
             slo_ms=e.slo_ms,
             rate_rps=rates.get(e.name, 0.0),
             seq_len=e.seq_len,
+            mesh_shape=e.mesh_shape,
         )
         for e in models.values()
     ]
+
+
+def degrade_sessions(
+    sessions: List[Session],
+    engine_widths: Optional[Sequence[int]],
+    profiles: Dict[str, BatchProfile],
+) -> Tuple[List[Session], Dict[str, Dict[str, str]]]:
+    """Clamp each session's mesh shape to the SURVIVING slice geometry.
+
+    A TP=4 model whose 4-chip slice just died must not demand a slice
+    that no longer exists — it degrades to the largest profiled shape a
+    surviving slice can carry (TP=4 -> the TP=2 row when only a
+    half-slice remains), and upgrades back the moment a wide slice
+    reappears (the same clamp, run at every decision, is the heal).
+    Returns (sessions, {model: {"from": .., "to": ..}}) — the second
+    half feeds the replan audit so a degraded placement is never
+    silent. Pure: same inputs, same outputs, live and sim share it."""
+    widths = {int(w) for w in (engine_widths if engine_widths else [1])}
+    out: List[Session] = []
+    degraded: Dict[str, Dict[str, str]] = {}
+    for s in sessions:
+        prof = profiles.get(s.model)
+        shapes = prof.meshes() if prof is not None else ["1x1"]
+        if s.chips in widths and s.mesh_shape in shapes:
+            out.append(s)
+            continue
+        fitting = [sh for sh in shapes if mesh_chips(sh) in widths]
+        best = None
+        for sh in fitting:  # meshes() is ascending in chips
+            if mesh_chips(sh) <= s.chips:
+                best = sh
+        if best is None and fitting:
+            best = fitting[0]  # nothing smaller profiled: smallest fit
+        if best is None or best == s.mesh_shape:
+            out.append(s)  # nowhere to degrade to — starve loudly below
+            continue
+        degraded[s.model] = {"from": s.mesh_shape, "to": best}
+        out.append(replace(s, mesh_shape=best))
+    return out, degraded
+
+
+def reshard_cost(
+    model: str,
+    from_mesh: str,
+    to_mesh: str,
+    profiles: Dict[str, BatchProfile],
+) -> float:
+    """Premium for moving a resident model BETWEEN mesh shapes: every
+    weight byte transits an all-gather + re-slice over ICI on top of the
+    plain upload the matcher already prices. 0 for a same-shape move.
+    Priced at the DESTINATION shape's per-chip shard (the bytes each
+    chip of the new slice must end up holding) — on mixed-mesh tables
+    the unrestricted weights min is the widest mesh's shard, which
+    would underprice every narrowing reshard."""
+    if from_mesh == to_mesh:
+        return 0.0
+    prof = profiles.get(model)
+    weight_mb = (prof.weights_hbm_bytes(to_mesh) / 1e6
+                 if prof is not None else 1.0)
+    return RESHARD_MB_FACTOR * weight_mb
 
 
 def transfer_cost(
     engine_models: frozenset,
     plan: NodePlan,
     profiles: Dict[str, BatchProfile],
+    resident_meshes: Optional[Dict[str, str]] = None,
 ) -> float:
     """Cost of pointing an engine at ``plan``: for every model the engine
-    doesn't already host, charge weight bytes (upload) + compile time."""
+    doesn't already host, charge weight bytes (upload) + compile time —
+    plus the reshard premium when the model is currently resident
+    SOMEWHERE in the domain at a different mesh shape than the plan's
+    (``resident_meshes``: model -> hosted shape; None = classic
+    single-chip pricing, byte-identical to the pre-mesh matcher)."""
     cost = 0.0
     for p in plan.placements:
         name = p.session.model
@@ -108,24 +185,35 @@ def transfer_cost(
         if prof is None:
             cost += 1.0
             continue
-        row = prof.row_for(p.batch_size, p.session.seq_len) or prof.bucket_for(
-            p.batch_size, p.session.seq_len
-        )
+        row = prof.row_for(
+            p.batch_size, p.session.seq_len, plan.mesh_shape
+        ) or prof.bucket_for(p.batch_size, p.session.seq_len, plan.mesh_shape)
         compile_ms = row.compile_ms if row else 1000.0
-        weight_mb = prof.weights_hbm_bytes() / 1e6
+        # Upload priced at the PLAN's shape: each chip of the slice
+        # uploads its own weight shard (mixed-mesh tables differ per
+        # shape; single-shape tables are unchanged).
+        weight_mb = prof.weights_hbm_bytes(plan.mesh_shape) / 1e6
         cost += compile_ms + weight_mb  # ms-equivalent weighting
+        if resident_meshes is not None and name in resident_meshes:
+            cost += reshard_cost(
+                name, resident_meshes[name], plan.mesh_shape, profiles
+            )
     return cost
 
 
 def fold_node_plans(target: NodePlan, extra: NodePlan) -> NodePlan:
     """Merge two node plans onto one chip: duty cycles add, occupancies
     rescale (``occ * old_duty / new_duty``) so every placement keeps its
-    absolute slice milliseconds — degraded latency, never starvation."""
+    absolute slice milliseconds — degraded latency, never starvation.
+    The fold keeps the TARGET's mesh shape (callers only fold
+    same-shape plans — a program compiled for one slice geometry cannot
+    time-slice on another)."""
     new_duty = target.duty_cycle_ms + extra.duty_cycle_ms
     if new_duty <= 0:
         return NodePlan(
             placements=list(target.placements) + list(extra.placements),
             duty_cycle_ms=new_duty,
+            mesh_shape=target.mesh_shape,
         )
     rescaled = []
     for node in (target, extra):
@@ -135,7 +223,8 @@ def fold_node_plans(target: NodePlan, extra: NodePlan) -> NodePlan:
                       p.occupancy * scale, p.hbm_bytes)
             for p in node.placements
         )
-    return NodePlan(placements=rescaled, duty_cycle_ms=new_duty)
+    return NodePlan(placements=rescaled, duty_cycle_ms=new_duty,
+                    mesh_shape=target.mesh_shape)
 
 
 def merge_overflow_nodes(
@@ -159,7 +248,8 @@ def merge_overflow_nodes(
         return list(plans)
     merged = [
         NodePlan(placements=list(n.placements),
-                 duty_cycle_ms=n.duty_cycle_ms)
+                 duty_cycle_ms=n.duty_cycle_ms,
+                 mesh_shape=n.mesh_shape)
         for n in plans[:n_engines]
     ]
     for extra in plans[n_engines:]:
@@ -168,9 +258,49 @@ def merge_overflow_nodes(
     return merged
 
 
+def fit_plans_to_geometry(
+    plans: List[NodePlan], engine_widths: Sequence[int]
+) -> List[NodePlan]:
+    """Shrink a plan list onto a WIDTH-TYPED engine set: plans group by
+    slice width, each group folds down (``merge_overflow_nodes``) to the
+    number of engines of that width, and a group whose width has no
+    engine at all is dropped with a loud capacity warning (its models
+    re-enter planning next tick — typically degraded to a surviving
+    shape by ``degrade_sessions`` — instead of silently starving behind
+    an unassignable plan)."""
+    from collections import Counter
+
+    cap = Counter(int(w) for w in engine_widths)
+    by_width: Dict[int, List[NodePlan]] = {}
+    for p in plans:
+        by_width.setdefault(p.chips, []).append(p)
+    out: List[NodePlan] = []
+    for width in sorted(by_width):
+        group = by_width[width]
+        have = cap.get(width, 0)
+        if have == 0:
+            logger.warning(
+                "no %d-chip slice exists for %d node plan(s) (%s); "
+                "dropping — geometry cannot carry this shape (capacity!)",
+                width, len(group),
+                sorted({m for n in group for m in n.models}),
+            )
+            continue
+        if len(group) > have:
+            logger.warning(
+                "plan needs %d %d-chip slices but only %d exist; merging "
+                "overflow nodes (degraded latency; capacity!)",
+                len(group), width, have,
+            )
+            group = merge_overflow_nodes(group, have)
+        out.extend(group)
+    return out
+
+
 def derate_for_capacity(
     assignment: List[Optional[NodePlan]],
     capacity_factors: Sequence[float],
+    engine_widths: Optional[Sequence[int]] = None,
 ) -> Dict[int, Dict[str, int]]:
     """Price degraded engines as FRACTIONAL capacity (gray-failure
     probation, ISSUE 9) instead of alive/dead. Mutates ``assignment``
@@ -184,8 +314,12 @@ def derate_for_capacity(
     Fallback: FOLD the whole plan onto the least-occupied full-capacity
     engine (degraded latency there, honest shed accounting — never a
     starved queue). With no full-capacity engine at all, the plan stays:
-    slow beats starved."""
+    slow beats starved. Width-typed engine sets (mesh slices) swap and
+    fold only between SAME-WIDTH engines — a slice program cannot land
+    on a chip set of a different width."""
     moved: Dict[int, Dict[str, int]] = {}
+    widths = ([int(w) for w in engine_widths] if engine_widths is not None
+              else [1] * len(capacity_factors))
     full = [j for j, f in enumerate(capacity_factors) if f >= 1.0 - 1e-9]
     for e, factor in enumerate(capacity_factors):
         plan = assignment[e]
@@ -194,7 +328,8 @@ def derate_for_capacity(
             continue
         swaps = [
             j for j in full
-            if assignment[j] is not None
+            if widths[j] == widths[e]
+            and assignment[j] is not None
             and assignment[j].occupancy <= factor + 1e-9
             and assignment[j].occupancy < plan.occupancy
         ]
@@ -203,7 +338,7 @@ def derate_for_capacity(
             assignment[e], assignment[j] = assignment[j], assignment[e]
             moved[e] = {"swapped_with": j}
             continue
-        hosts = [j for j in full if j != e]
+        hosts = [j for j in full if j != e and widths[j] == widths[e]]
         if not hosts:
             continue
         j = min(hosts, key=lambda j: (
@@ -221,36 +356,62 @@ def match_plans_to_engines(
     engine_models: List[frozenset],
     plans: List[NodePlan],
     profiles: Dict[str, BatchProfile],
+    engine_widths: Optional[Sequence[int]] = None,
+    resident_meshes: Optional[Dict[str, str]] = None,
 ) -> List[Optional[NodePlan]]:
     """Assign new node plans to engines minimizing total transfer cost.
 
     Brute-force over permutations for small counts (the reference's approach,
     scheduler.py:857-891), greedy best-match beyond BRUTE_FORCE_LIMIT.
     Returns, per engine, its new plan (None = engine idles).
-    """
+
+    ``engine_widths`` types each engine as a chip SET (a mesh slice):
+    a node plan may only land on an engine of exactly its width — a
+    4-chip TP program cannot run on a single chip, and a single-chip
+    duty cycle does not time-slice a gang-scheduled slice. None (the
+    classic callers) = every engine is one chip, byte-identical
+    behavior. ``resident_meshes`` threads the reshard premium into the
+    matcher's own objective (see :func:`transfer_cost`)."""
     n_engines = len(engine_models)
-    padded: List[Optional[NodePlan]] = list(plans) + [None] * max(
-        0, n_engines - len(plans)
-    )
-    if len(plans) > n_engines:
-        logger.warning(
-            "plan needs %d chips but only %d engines; merging overflow "
-            "nodes (degraded latency; capacity!)",
-            len(plans), n_engines,
+    if engine_widths is None:
+        padded: List[Optional[NodePlan]] = list(plans) + [None] * max(
+            0, n_engines - len(plans)
         )
-        padded = merge_overflow_nodes(plans, n_engines)
+        if len(plans) > n_engines:
+            logger.warning(
+                "plan needs %d chips but only %d engines; merging overflow "
+                "nodes (degraded latency; capacity!)",
+                len(plans), n_engines,
+            )
+            padded = merge_overflow_nodes(plans, n_engines)
+        widths = [1] * n_engines
+    else:
+        widths = [int(w) for w in engine_widths]
+        fitted = fit_plans_to_geometry(plans, widths)
+        padded = list(fitted) + [None] * (n_engines - len(fitted))
+
+    def compatible(plan: Optional[NodePlan], e: int) -> bool:
+        return plan is None or plan.chips == widths[e]
 
     if n_engines <= BRUTE_FORCE_LIMIT:
         best: Optional[Tuple[float, Tuple[int, ...]]] = None
         for perm in itertools.permutations(range(n_engines)):
+            if not all(
+                compatible(padded[i], e) for i, e in enumerate(perm)
+            ):
+                continue
             cost = sum(
-                transfer_cost(engine_models[e], padded[i], profiles)
+                transfer_cost(engine_models[e], padded[i], profiles,
+                              resident_meshes)
                 for i, e in enumerate(perm)
                 if padded[i] is not None
             )
             if best is None or cost < best[0]:
                 best = (cost, perm)
         assignment: List[Optional[NodePlan]] = [None] * n_engines
+        if best is None:  # fit_plans_to_geometry makes this unreachable
+            logger.warning("no width-compatible assignment exists")
+            return assignment
         for i, e in enumerate(best[1]):
             assignment[e] = padded[i]
         return assignment
@@ -259,18 +420,27 @@ def match_plans_to_engines(
     order = sorted(
         [i for i, p in enumerate(padded) if p is not None],
         key=lambda i: -max(
-            transfer_cost(m, padded[i], profiles) for m in engine_models
+            transfer_cost(m, padded[i], profiles, resident_meshes)
+            for m in engine_models
         ),
     )
     free = set(range(n_engines))
     assignment = [None] * n_engines
     for i in order:
+        fits = [e for e in free if compatible(padded[i], e)]
+        if not fits:  # fit_plans_to_geometry makes this unreachable
+            logger.warning(
+                "no free %d-chip engine for plan %s",
+                padded[i].chips, padded[i].describe(),
+            )
+            continue
         # Tie-break toward engines hosting fewer models so a zero-savings
         # plan lands on an empty engine instead of displacing a warm one.
         e = min(
-            free,
+            fits,
             key=lambda e: (
-                transfer_cost(engine_models[e], padded[i], profiles),
+                transfer_cost(engine_models[e], padded[i], profiles,
+                              resident_meshes),
                 len(engine_models[e]),
                 e,
             ),
@@ -295,6 +465,12 @@ class ReplanDecision:
     # and what the derate pass moved because of them.
     capacity_factors: Optional[List[float]] = None
     derated: Dict[int, Dict[str, int]] = field(default_factory=dict)
+    # Mesh geometry (ROADMAP item 2): the slice widths the decision was
+    # made over, and any sessions clamped to a surviving shape
+    # (``degrade_sessions``). Empty/None on classic single-chip domains
+    # so pre-mesh audit payloads stay byte-identical.
+    engine_widths: Optional[List[int]] = None
+    mesh_degraded: Dict[str, Dict[str, str]] = field(default_factory=dict)
 
     def audit_fields(self) -> Dict[str, Any]:
         """The structured-audit payload (``scheduler/audit.py``), built
@@ -311,17 +487,29 @@ class ReplanDecision:
             observed["derated"] = {
                 str(k): v for k, v in sorted(self.derated.items())
             }
+        if self.engine_widths is not None and any(
+            w != 1 for w in self.engine_widths
+        ):
+            observed["engine_widths"] = list(self.engine_widths)
+        if self.mesh_degraded:
+            observed["mesh_degraded"] = {
+                k: dict(v) for k, v in sorted(self.mesh_degraded.items())
+            }
+        placements = []
+        for n in self.plan:
+            for p in n.placements:
+                entry = {"model": p.session.model, "batch": p.batch_size,
+                         "latency_ms": round(p.latency_ms, 2),
+                         "occupancy": round(p.occupancy, 3)}
+                if n.mesh_shape != "1x1":
+                    entry["mesh"] = n.mesh_shape
+                placements.append(entry)
         return {
             "observed": observed,
             "inputs": {
                 # The profile rows the packer committed to: per
                 # placement, the (batch, latency) row that sized it.
-                "placements": [
-                    {"model": p.session.model, "batch": p.batch_size,
-                     "latency_ms": round(p.latency_ms, 2),
-                     "occupancy": round(p.occupancy, 3)}
-                    for n in self.plan for p in n.placements
-                ],
+                "placements": placements,
             },
             "before": [", ".join(m) for m in self.old_models],
             "after": [", ".join(m) for m in self.new_models],
@@ -336,20 +524,57 @@ def decide_replan(
     sessions: List[Session],
     rates: Dict[str, float],
     capacity_factors: Optional[Sequence[float]] = None,
+    engine_widths: Optional[Sequence[int]] = None,
+    engine_meshes: Optional[Sequence[str]] = None,
 ) -> ReplanDecision:
     """One replan, decided but not applied: bin-pack the sessions, match
     the resulting node plans onto the engines with minimal movement, and
     price the migration (the matcher's own objective — compile_ms +
-    weight-MB for models not already resident).
+    weight-MB for models not already resident, plus the reshard premium
+    for cross-mesh-shape moves).
 
     ``capacity_factors`` (aligned with ``engine_models``; default all
     1.0) prices gray-degraded engines as FRACTIONAL chips: after
     matching, plans that overfill a derated engine are swapped with or
     folded onto full-capacity peers (:func:`derate_for_capacity`) — the
-    probation story between alive and dead."""
+    probation story between alive and dead.
+
+    ``engine_widths`` / ``engine_meshes`` (aligned with
+    ``engine_models``) make the schedulable unit a chip SET: sessions
+    degrade to the surviving slice geometry (:func:`degrade_sessions` —
+    a TP=4 model falls back to its TP=2 row when only a half-slice
+    remains), plans land only on width-matching engines, and moving a
+    resident model between shapes is priced as a weight-reshard. None =
+    the classic one-chip-per-engine domain, byte-identical decisions."""
     engine_models = [frozenset(m) for m in engine_models]
+    widths: Optional[List[int]] = None
+    mesh_degraded: Dict[str, Dict[str, str]] = {}
+    resident_meshes: Optional[Dict[str, str]] = None
+    if engine_widths is not None:
+        widths = [int(w) for w in engine_widths]
+        if len(widths) != len(engine_models):
+            raise ValueError(
+                f"engine_widths has {len(widths)} entries for "
+                f"{len(engine_models)} engines"
+            )
+        sessions, mesh_degraded = degrade_sessions(
+            sessions, widths, packer.profiles
+        )
+    if engine_meshes is not None:
+        if len(engine_meshes) != len(engine_models):
+            raise ValueError(
+                f"engine_meshes has {len(engine_meshes)} entries for "
+                f"{len(engine_models)} engines"
+            )
+        resident_meshes = {}
+        for mesh, models in zip(engine_meshes, engine_models):
+            for m in models:
+                resident_meshes.setdefault(m, str(mesh))
     plan = packer.plan(sessions)
-    assignment = match_plans_to_engines(engine_models, plan, packer.profiles)
+    assignment = match_plans_to_engines(
+        engine_models, plan, packer.profiles,
+        engine_widths=widths, resident_meshes=resident_meshes,
+    )
     derated: Dict[int, Dict[str, int]] = {}
     factors: Optional[List[float]] = None
     if capacity_factors is not None:
@@ -359,9 +584,11 @@ def decide_replan(
                 f"capacity_factors has {len(factors)} entries for "
                 f"{len(engine_models)} engines"
             )
-        derated = derate_for_capacity(assignment, factors)
+        derated = derate_for_capacity(assignment, factors,
+                                      engine_widths=widths)
     migration_cost = sum(
-        transfer_cost(engine_models[e], n, packer.profiles)
+        transfer_cost(engine_models[e], n, packer.profiles,
+                      resident_meshes)
         for e, n in enumerate(assignment)
         if n is not None
     )
@@ -376,4 +603,6 @@ def decide_replan(
         rates=dict(rates),
         capacity_factors=factors,
         derated=derated,
+        engine_widths=widths,
+        mesh_degraded=mesh_degraded,
     )

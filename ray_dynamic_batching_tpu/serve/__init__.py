@@ -39,6 +39,7 @@ from ray_dynamic_batching_tpu.serve.failover import (
     HedgeManager,
     HedgePolicy,
     ReplicaDeadError,
+    SliceDeadError,
     RetriesExhausted,
     RetryableSystemError,
     is_retryable,
@@ -92,6 +93,7 @@ __all__ = [
     "HedgeManager",
     "HedgePolicy",
     "ReplicaDeadError",
+    "SliceDeadError",
     "RetriesExhausted",
     "RetryableSystemError",
     "is_retryable",

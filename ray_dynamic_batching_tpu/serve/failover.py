@@ -79,6 +79,23 @@ class ReplicaDeadError(RetryableSystemError):
     request in flight or queued."""
 
 
+class SliceDeadError(ReplicaDeadError):
+    """A chip in the replica's MESH SLICE died, taking the whole slice:
+    a TP replica's programs gang-schedule every chip in the gang, so
+    losing one loses the collective — there is no partial survival at
+    the replica level (ROADMAP item 2). Retryable like any replica
+    death (the request re-dispatches elsewhere); the RECOVERY half is
+    the scheduler's: the heal replan runs over the surviving geometry,
+    re-forms the good chips into narrower slices, and degrades the
+    model to the mesh-shape profile row that still fits
+    (``scheduler/replan.degrade_sessions``). ``chip_index`` names the
+    chip that took the slice down, for the audit trail."""
+
+    def __init__(self, message: str, chip_index: Optional[int] = None):
+        super().__init__(message)
+        self.chip_index = chip_index
+
+
 class DrainEvicted(RetryableSystemError):
     """The request was evicted from a draining replica's queue (heal /
     rolling update / plan migration) and must be re-routed."""

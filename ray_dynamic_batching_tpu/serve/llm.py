@@ -368,8 +368,10 @@ class LLMDeployment:
         # Paged KV pool (ISSUE 7): per-engine free-list pages replace the
         # per-slot slabs — HBM occupancy follows cached tokens, admission
         # waits on pages not slabs, prefix/session reuse is by reference
-        # (CoW). Incompatible with draft models (raised here) and TP
-        # meshes (raised loudly at engine build).
+        # (CoW). Incompatible with draft models (raised here). On a
+        # multi-chip (TP) replica the pool shards over the mesh's kv-head
+        # axis with a replica-global page table/allocator (ROADMAP item
+        # 2 — see DecodeEngine and ARCHITECTURE "Mesh placements").
         self.paged = bool(paged)
         self.page_size = int(page_size)
         self.kv_pool_pages = kv_pool_pages
@@ -729,17 +731,6 @@ class LLMDeployment:
         if prompt_buckets is not None:
             fitting = [b for b in prompt_buckets if b <= max_len]
             prompt_buckets = fitting or [max_len]
-        if self.paged and mesh is not None:
-            # Loud, like the draft-model conflict: silently serving the
-            # slab path under a paged=True deployment would mislabel
-            # every measurement stamped from the deployment config
-            # (e.g. a bench A/B arm).
-            raise ValueError(
-                f"{self.model_name}: paged=True is not supported on "
-                "multi-chip (TP) replicas yet — drop chips_per_replica "
-                "or the paged flag (sharded page pools are ROADMAP "
-                "item 2 territory)"
-            )
         return DecodeEngine(
             self._model,
             self._params,

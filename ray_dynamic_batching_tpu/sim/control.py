@@ -118,10 +118,10 @@ class SimScheduler:
 
     # --- registration (live register_model contract) ----------------------
     def register_model(self, name: str, slo_ms: float,
-                       seq_len: int = 0) -> None:
+                       seq_len: int = 0, mesh_shape: str = "1x1") -> None:
         if name not in self.packer.profiles:
             raise KeyError(f"no batch profile for model {name!r}")
-        self._models[name] = ModelEntry(name, slo_ms, seq_len)
+        self._models[name] = ModelEntry(name, slo_ms, seq_len, mesh_shape)
 
     # --- ingress (live submit_request: demand recorded before enqueue) ----
     def submit(self, model: str, qos_class: str = DEFAULT_QOS_CLASS,
@@ -172,12 +172,19 @@ class SimScheduler:
         if self.gray is not None:
             factors = [self.gray.capacity_factor(e.engine_id)
                        for e in alive]
+        # Slice geometry (same surface LiveScheduler reads): widths/
+        # shapes of the surviving schedulable units, so the shared
+        # decide step degrades TP sessions and matches by width.
+        widths = [e.width for e in alive]
+        meshes = [e.mesh_shape for e in alive]
         decision = decide_replan(
             self.packer,
             [frozenset(e.models) for e in alive],
             sessions_for(self._models, rates),
             rates,
             capacity_factors=factors,
+            engine_widths=widths,
+            engine_meshes=meshes,
         )
         for engine, node_plan in zip(alive, decision.assignment):
             if node_plan is not None:
@@ -221,23 +228,81 @@ class SimScheduler:
         """Mirror of ``LiveScheduler.check_engine_health``: detect newly
         dead engines at the monitor tick (same detection lag the live
         control loop pays) and replan over survivors — failure-driven,
-        so it bypasses the rate cold-window guard."""
+        so it bypasses the rate cold-window guard.
+
+        Slice deaths additionally RE-FORM: a dead chip takes its whole
+        slice (SliceDeadError semantics), but the other chips in the
+        gang are good silicon — they come back as the widest
+        power-of-two sub-slices that fit (a broken 1x4 re-forms as a
+        1x2 + a 1x1), so the heal replan runs over the TRUE surviving
+        geometry and ``degrade_sessions`` can re-shape a TP=4 model to
+        its TP=2 profile row on the re-formed half-slice."""
         newly_dead = [
             e for e in self.engines
             if e.engine_id not in self._dead_engines and not e.healthy()
         ]
         if not newly_dead:
             return False
+        observed: Dict = {}
+        slices: Dict = {}
         for e in newly_dead:
             self._dead_engines.add(e.engine_id)
+            if e.width <= 1:
+                continue
+            reformed = self._reform_slices(e)
+            slices[e.engine_id] = {
+                "width": e.width,
+                "dead_chip": e.failed_chip,
+                "reformed": [
+                    {"engine": n.engine_id, "width": n.width}
+                    for n in reformed
+                ],
+            }
+        observed["dead_engines"] = sorted(self._dead_engines)
+        if slices:
+            observed["dead_slices"] = slices
         self.audit.record(
             "engine_dead",
-            observed={"dead_engines": sorted(self._dead_engines)},
+            observed=observed,
             diff={"removed": [e.engine_id for e in newly_dead]},
             note="engine death detected by monitor; replan over survivors",
         )
         self.rebalance(trigger="heal")
         return True
+
+    def _reform_slices(self, dead: SimEngine) -> List[SimEngine]:
+        """Regroup a dead slice's surviving chips into the widest
+        power-of-two sub-slices and enroll them as fresh schedulable
+        units (started, gray-tracked when monitoring is armed). The
+        next rebalance — fired by the caller — places over them."""
+        survivors = dead.surviving_chips()
+        reformed: List[SimEngine] = []
+        serial = 0
+        while survivors:
+            w = 1
+            while w * 2 <= len(survivors):
+                w *= 2
+            chips, survivors = survivors[:w], survivors[w:]
+            engine = SimEngine(
+                f"{dead.engine_id}r{serial}",
+                self.queues,
+                self.packer.profiles,
+                self.loop,
+                self.clock,
+                idle_wait_ms=dead.idle_wait_ms,
+                jitter_rng=dead.jitter_rng,
+                occupancy_model=dead.occupancy_model,
+                occupancy_floor=dead.occupancy_floor,
+                width=w,
+                chip_ids=chips,
+            )
+            serial += 1
+            if self.gray is not None:
+                engine.track_ratios = True
+            self.engines.append(engine)
+            engine.start()
+            reformed.append(engine)
+        return reformed
 
     def check_gray_health(self) -> bool:
         """The gray analogue of :meth:`check_engine_health`: tick the

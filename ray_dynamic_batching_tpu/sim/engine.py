@@ -58,6 +58,8 @@ class SimEngine:
         jitter_rng: Optional[random.Random] = None,
         occupancy_model: str = "batch",
         occupancy_floor: float = 0.35,
+        width: int = 1,
+        chip_ids: Optional[List[str]] = None,
     ) -> None:
         if occupancy_model not in ("batch", "slot"):
             raise ValueError(
@@ -71,6 +73,17 @@ class SimEngine:
         self.clock = clock
         self.idle_wait_ms = idle_wait_ms
         self.jitter_rng = jitter_rng  # None = exact mean latencies
+        # Mesh slice (ROADMAP item 2): one SimEngine is one SCHEDULABLE
+        # UNIT — a single chip (width 1, the classic domain) or a
+        # gang-scheduled TP slice of ``width`` chips. A slice executes
+        # node plans priced from its mesh profile rows; a single dead
+        # chip fails the WHOLE slice (``fail_chip`` — the sim twin of
+        # serve/failover.SliceDeadError), and the scheduler re-forms the
+        # survivors into narrower slices at the heal tick.
+        self.width = max(1, int(width))
+        self.chip_ids = list(chip_ids) if chip_ids else [engine_id]
+        self.dead_chips: set = set()
+        self.failed_chip: Optional[int] = None
         # Decode cost model (ISSUE 7): "batch" prices every pop at the
         # profile row regardless of fill — the slab/shape-bucketed story,
         # where a 3-request pop in a 16-slot bucket pays the full step.
@@ -138,6 +151,11 @@ class SimEngine:
         (``ReplicaEngine.healthy`` / test fakes)."""
         return self.alive
 
+    @property
+    def mesh_shape(self) -> str:
+        """The slice's mesh-shape string (the planner's width key)."""
+        return f"1x{self.width}"
+
     def fail(self) -> None:
         """Kill this engine at the current virtual time (a ``Scenario``
         failure event): every already-scheduled cycle/slice event becomes
@@ -147,6 +165,33 @@ class SimEngine:
         if self.alive:
             self.alive = False
             self.failed_at_ms = self.clock.now_ms()
+
+    def fail_chip(self, chip: int) -> None:
+        """One chip of the slice dies -> the WHOLE slice fails (its
+        compiled programs gang-schedule every chip; losing one loses the
+        collective — the SliceDeadError semantics). The surviving chips
+        stay healthy silicon: ``surviving_chips`` hands them to the
+        scheduler's re-form pass at the heal tick."""
+        if not 0 <= chip < self.width:
+            raise ValueError(
+                f"{self.engine_id}: chip {chip} out of range for a "
+                f"width-{self.width} slice"
+            )
+        # Record the dead chip UNCONDITIONALLY: a second chip of an
+        # already-dead slice (correlated rack event) must not be handed
+        # back to _reform_slices as healthy silicon. Only the
+        # slice-kill itself is once-only (fail() guards).
+        self.dead_chips.add(int(chip))
+        if self.failed_chip is None:
+            self.failed_chip = int(chip)
+        self.fail()
+
+    def surviving_chips(self) -> List[str]:
+        """Chip ids of this (dead) slice that are still good silicon."""
+        return [
+            c for i, c in enumerate(self.chip_ids)
+            if i not in self.dead_chips
+        ]
 
     def degrade(self, factor: float = 1.0, stall_ms: float = 0.0) -> None:
         """Apply a gray degradation (an ``EngineDegradation`` event):
@@ -202,8 +247,13 @@ class SimEngine:
         prof = self.profiles.get(p.session.model)
         row = None
         if prof is not None:
-            row = prof.row_for(p.batch_size, p.session.seq_len) \
-                or prof.bucket_for(p.batch_size, p.session.seq_len)
+            # Keyed by the session's mesh shape: a TP placement's cost
+            # comes from its own slice rows (a default "1x1" lookup
+            # would miss them and flatten every TP step to planned
+            # worst-case latency, jitter-free).
+            mesh = p.session.mesh_shape
+            row = prof.row_for(p.batch_size, p.session.seq_len, mesh) \
+                or prof.bucket_for(p.batch_size, p.session.seq_len, mesh)
         if row is None:
             return p.latency_ms
         mean = row.latency_ms

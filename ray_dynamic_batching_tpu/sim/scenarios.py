@@ -35,10 +35,13 @@ def linear_profile(
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
     compile_ms: float = 1000.0,
     std_fraction: float = 0.0,
+    mesh: str = "1x1",
 ) -> BatchProfile:
     """Latency = base + per_sample*batch — the canonical accelerator
     shape (same generator as ``tests/fixtures.py``, duplicated here so
-    shipped tools never import the test tree)."""
+    shipped tools never import the test tree). ``mesh`` stamps the rows
+    as measured over that slice shape (per-slice latency, per-chip
+    footprint — the ProfileRow mesh-axis contract)."""
     rows = [
         ProfileRow(
             batch_size=b,
@@ -47,6 +50,7 @@ def linear_profile(
             latency_std_ms=std_fraction * (base_ms + per_sample_ms * b),
             hbm_bytes=int((weight_mb + act_mb_per_sample * b) * MB),
             compile_ms=compile_ms,
+            mesh=mesh,
         )
         for b in buckets
     ]
@@ -240,6 +244,87 @@ def straggler_scenario(seed: int = 0) -> Scenario:
             "heal_after": 2,
             "probation_capacity": 0.4,
         },
+    )
+
+
+def mesh_profiles() -> Dict[str, BatchProfile]:
+    """The mesh-placement fixtures (ROADMAP item 2): the single-chip
+    trio plus ``tp_llm``, a model with NO single-chip rows — it only
+    exists as a 4-chip TP slice (fast steps) or a 2-chip half-slice
+    (~2.2x slower per step, the collective-vs-compute tax of the
+    narrower mesh). Per the ProfileRow mesh contract, hbm_bytes are
+    PER-CHIP: the 1x2 rows carry twice the weight shard of the 1x4
+    rows."""
+    profiles = dict(fixture_profiles())
+    tp4 = linear_profile(
+        "tp_llm", base_ms=6.0, per_sample_ms=1.0, weight_mb=2500,
+        act_mb_per_sample=4.0, mesh="1x4",
+    )
+    tp2 = linear_profile(
+        "tp_llm", base_ms=13.0, per_sample_ms=2.2, weight_mb=5000,
+        act_mb_per_sample=8.0, mesh="1x2",
+    )
+    profiles["tp_llm"] = BatchProfile("tp_llm", tp4.rows + tp2.rows)
+    return profiles
+
+
+def mesh_scenario(seed: int = 0) -> Scenario:
+    """Mesh-sharded placement fixture (``tools/run_mesh_soak.py``): a
+    cluster of one 4-chip TP slice, one 2-chip half-slice, and two
+    single chips serving ``tp_llm`` (a model that only exists at mesh
+    shapes 1x4/1x2) next to single-chip ``fast`` traffic. Expected
+    story: the planner prices tp_llm from its 1x4 rows and pins it to
+    the wide slice, fast packs onto the singles, and both hold their
+    SLOs — the (model, mesh_shape) schedulable unit working end to
+    end."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="tp_llm", slo_ms=400.0, mesh_shape="1x4",
+                pattern=RatePattern("constant", base_rps=120.0),
+                class_mix={"interactive": 0.5, "standard": 0.5},
+            ),
+            SimModelSpec(
+                name="fast", slo_ms=200.0,
+                pattern=RatePattern("constant", base_rps=60.0),
+            ),
+        ],
+        duration_s=30.0,
+        drain_s=5.0,
+        n_engines=4,
+        engine_widths=[4, 2, 1, 1],
+        seed=seed,
+        monitoring_interval_s=2.0,
+    )
+
+
+def slice_failure_scenario(seed: int = 0) -> Scenario:
+    """Slice-death fixture (the mesh half of the chaos story): same
+    cluster as :func:`mesh_scenario`, but chip 1 of the 4-chip slice
+    dies at t=10s. One dead chip fails the WHOLE slice (SliceDeadError
+    semantics); the monitor detects it at the next tick, the surviving
+    3 chips re-form as a 1x2 half-slice + a single, and the heal replan
+    DEGRADES tp_llm to its 1x2 profile row on a surviving half-slice —
+    slower steps, but the queue never starves. Roomy SLO so the gate
+    grades the heal/degrade story, not knife-edge shedding."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="tp_llm", slo_ms=2500.0, mesh_shape="1x4",
+                pattern=RatePattern("constant", base_rps=60.0),
+            ),
+            SimModelSpec(
+                name="fast", slo_ms=2000.0,
+                pattern=RatePattern("constant", base_rps=40.0),
+            ),
+        ],
+        duration_s=30.0,
+        drain_s=5.0,
+        n_engines=4,
+        engine_widths=[4, 2, 1, 1],
+        seed=seed,
+        monitoring_interval_s=2.0,
+        failures=[EngineFailure(at_s=10.0, engine=0, chip=1)],
     )
 
 

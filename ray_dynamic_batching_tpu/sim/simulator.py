@@ -58,7 +58,7 @@ _PATTERN_FIELDS = (
 # silently-defaulted what-if is a confidently wrong one — reject loudly.
 _MODEL_KEYS = frozenset(
     ("name", "slo_ms", "seq_len", "rate_rps", "pattern", "poisson",
-     "class_mix", "tenant")
+     "class_mix", "tenant", "mesh_shape")
     + _PATTERN_FIELDS
 )
 
@@ -83,6 +83,10 @@ class SimModelSpec:
     # the same scenario always produces the same per-request classes.
     class_mix: Dict[str, float] = None
     tenant: str = DEFAULT_TENANT
+    # Preferred serving mesh shape ("1x4" = a 4-chip TP slice priced
+    # from the profile table's mesh rows; ROADMAP item 2). "1x1" keeps
+    # the classic single-chip contract.
+    mesh_shape: str = "1x1"
 
     def __post_init__(self) -> None:
         if self.class_mix is None:
@@ -124,6 +128,7 @@ class SimModelSpec:
             class_mix={k: float(v)
                        for k, v in dict(d.get("class_mix", {})).items()},
             tenant=str(d.get("tenant", DEFAULT_TENANT)),
+            mesh_shape=str(d.get("mesh_shape", "1x1")),
         )
 
 
@@ -132,20 +137,27 @@ class EngineFailure:
     """One injected engine death: the engine indexed ``engine`` dies at
     virtual time ``at_s`` (the sim analogue of an injected
     ``replica.loop`` crash / a chaos-killed worker). The scheduler's
-    monitor detects it at its next tick and replans over survivors."""
+    monitor detects it at its next tick and replans over survivors.
+
+    ``chip`` (slice scenarios only) names WHICH chip of a multi-chip
+    slice dies: the whole slice fails (SliceDeadError semantics), and
+    the surviving chips re-form as narrower slices at the heal tick."""
 
     at_s: float
     engine: int
+    chip: Optional[int] = None
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "EngineFailure":
-        unknown = set(d) - {"at_s", "engine"}
+        unknown = set(d) - {"at_s", "engine", "chip"}
         if unknown:
             raise ValueError(
                 f"unknown failure key(s) {sorted(unknown)}; "
-                "known: ['at_s', 'engine']"
+                "known: ['at_s', 'engine', 'chip']"
             )
-        return cls(at_s=float(d["at_s"]), engine=int(d["engine"]))
+        return cls(at_s=float(d["at_s"]), engine=int(d["engine"]),
+                   chip=(None if d.get("chip") is None
+                         else int(d["chip"])))
 
 
 @dataclass
@@ -202,6 +214,10 @@ class Scenario:
     duration_s: float = 60.0
     drain_s: float = 5.0
     n_engines: int = 2
+    # Slice widths per schedulable unit (ROADMAP item 2): [4, 2, 1, 1]
+    # = one 4-chip TP slice, one half-slice, two single chips
+    # (len == n_engines). None = the classic all-singles cluster.
+    engine_widths: Optional[List[int]] = None
     seed: int = 0
     rate_scale: float = 1.0          # the "at 2x traffic?" knob
     max_queue_len: int = 4096
@@ -299,6 +315,10 @@ class Scenario:
             duration_s=float(d.get("duration_s", 60.0)),
             drain_s=float(d.get("drain_s", 5.0)),
             n_engines=int(d.get("n_engines", 2)),
+            engine_widths=(
+                None if d.get("engine_widths") is None
+                else [int(w) for w in d["engine_widths"]]
+            ),
             seed=seed,
             rate_scale=float(d.get("rate_scale", 1.0)),
             max_queue_len=int(d.get("max_queue_len", 4096)),
@@ -400,13 +420,32 @@ class Simulation:
         jitter_rng = (
             random.Random(sc.seed * 7919 + 13) if sc.latency_jitter else None
         )
-        engines = [
-            SimEngine(f"chip{i}", queues, self.profiles, loop, clock,
-                      jitter_rng=jitter_rng,
-                      occupancy_model=sc.decode_occupancy_model,
-                      occupancy_floor=sc.occupancy_floor)
-            for i in range(sc.n_engines)
-        ]
+        if sc.engine_widths is not None and \
+                len(sc.engine_widths) != sc.n_engines:
+            raise ValueError(
+                f"engine_widths has {len(sc.engine_widths)} entries for "
+                f"{sc.n_engines} engines"
+            )
+        engines = []
+        chip_base = 0
+        for i in range(sc.n_engines):
+            width = (sc.engine_widths[i]
+                     if sc.engine_widths is not None else 1)
+            # Classic clusters keep the historic chip{i} ids (canon);
+            # width-typed clusters name units slice{i} over chip ids.
+            if sc.engine_widths is None:
+                eid, chips = f"chip{i}", None
+            else:
+                eid = f"slice{i}"
+                chips = [f"chip{chip_base + j}" for j in range(width)]
+                chip_base += width
+            engines.append(
+                SimEngine(eid, queues, self.profiles, loop, clock,
+                          jitter_rng=jitter_rng,
+                          occupancy_model=sc.decode_occupancy_model,
+                          occupancy_floor=sc.occupancy_floor,
+                          width=width, chip_ids=chips)
+            )
         packer = SquishyBinPacker(
             self.profiles, hbm_budget_bytes=sc.hbm_budget_bytes
         )
@@ -431,7 +470,8 @@ class Simulation:
         )
         for spec in sc.models:
             sched.register_model(spec.name, slo_ms=spec.slo_ms,
-                                 seq_len=spec.seq_len)
+                                 seq_len=spec.seq_len,
+                                 mesh_shape=spec.mesh_shape)
 
         # Admission control at virtual time: the LIVE controller module
         # with the virtual clock injected (deterministic buckets), wired
@@ -507,9 +547,39 @@ class Simulation:
                     f"failure names engine {f.engine} but the scenario has "
                     f"{sc.n_engines} engine(s)"
                 )
-            loop.schedule_at(
-                f.at_s * 1000.0, lambda e=engines[f.engine]: e.fail()
-            )
+            if f.chip is not None:
+                if not 0 <= f.chip < engines[f.engine].width:
+                    raise ValueError(
+                        f"failure names chip {f.chip} of engine "
+                        f"{f.engine}, a width-"
+                        f"{engines[f.engine].width} unit"
+                    )
+
+                def _fail_chip(original=engines[f.engine], c=f.chip):
+                    # Resolve the PHYSICAL chip to whichever unit owns
+                    # it AT FIRE TIME: after a slice death + re-form,
+                    # the chip belongs to a re-formed sub-slice (a
+                    # fresh engine the scheduler enrolled mid-run) —
+                    # failing the original dead object would let the
+                    # sub-slice keep serving on dead hardware in a
+                    # correlated rack event.
+                    chip_id = original.chip_ids[c]
+                    for e in sched.engines:
+                        if e.alive and chip_id in e.chip_ids:
+                            e.fail_chip(e.chip_ids.index(chip_id))
+                            return
+                    # Owner already dead: keep the bookkeeping honest
+                    # so a LATER re-form can never resurrect the chip.
+                    for e in sched.engines:
+                        if chip_id in e.chip_ids:
+                            e.dead_chips.add(e.chip_ids.index(chip_id))
+                            return
+
+                loop.schedule_at(f.at_s * 1000.0, _fail_chip)
+            else:
+                loop.schedule_at(
+                    f.at_s * 1000.0, lambda e=engines[f.engine]: e.fail()
+                )
 
         for g in sc.degradations:
             if not 0 <= g.engine < sc.n_engines:
@@ -597,6 +667,10 @@ class Simulation:
                 "hops": queue.hop_stats(),
             }
         chips: Dict[str, Any] = {}
+        # sched.engines, not the construction list: slice re-formation
+        # (SimScheduler._reform_slices) enrolls fresh units mid-run and
+        # their execution must be accounted like anyone else's.
+        engines = list(sched.engines)
         for e in engines:
             chips[e.engine_id] = {
                 "busy_ms": e.busy_ms,
@@ -610,6 +684,11 @@ class Simulation:
                 "alive": e.alive,
                 "failed_at_ms": e.failed_at_ms,
             }
+            if sc.engine_widths is not None:
+                chips[e.engine_id]["width"] = e.width
+                chips[e.engine_id]["chip_ids"] = list(e.chip_ids)
+                chips[e.engine_id]["mesh_shape"] = e.mesh_shape
+                chips[e.engine_id]["failed_chip"] = e.failed_chip
             if sched.gray is not None:
                 chips[e.engine_id]["gray_state"] = sched.gray.state(
                     e.engine_id
@@ -626,6 +705,8 @@ class Simulation:
             "duration_s": sc.duration_s,
             "drain_s": sc.drain_s,
             "n_engines": sc.n_engines,
+            **({"engine_widths": list(sc.engine_widths)}
+               if sc.engine_widths is not None else {}),
             "rate_scale": sc.rate_scale,
             "decode_occupancy_model": sc.decode_occupancy_model,
             "events": events,
@@ -633,7 +714,9 @@ class Simulation:
             "arrivals_truncated_past_horizon": truncated,
             "arrivals_ignored_unregistered_model": ignored_models,
             "failures": [
-                {"at_s": f.at_s, "engine": f.engine} for f in sc.failures
+                ({"at_s": f.at_s, "engine": f.engine} if f.chip is None
+                 else {"at_s": f.at_s, "engine": f.engine, "chip": f.chip})
+                for f in sc.failures
             ],
             "degradations": [
                 {"at_s": g.at_s, "engine": g.engine, "factor": g.factor,

@@ -982,6 +982,59 @@ class TestSharedTileMath:
             q, k, k, pt, lens, interpret=True
         ) is None
 
+    def test_shard_heads_agreement_pin(self):
+        # ROADMAP item 2: the per-shard footprint rule (a head-sharded
+        # paged kernel budgets K/tp heads; an indivisible head axis
+        # REPLICATES, so every shard still streams all K) is part of the
+        # shared model — the standalone-loaded lint copy must agree with
+        # the runtime's on the whole grid, or the static checker and the
+        # mesh guard in paged_decode_attention drift.
+        lm = tile_math_module()
+        for K in (2, 4, 6, 8, 12, 16, 32):
+            for tp in (1, 2, 4, 8):
+                assert lm.shard_heads(K, tp) == tm.shard_heads(K, tp)
+                if tp > 1 and K % tp == 0:
+                    assert tm.shard_heads(K, tp) == K // tp
+                else:
+                    assert tm.shard_heads(K, tp) == K
+        # The division shows up in BYTES where the head block crosses a
+        # sublane boundary: K=12 spans kb=12 (pads to 16) unsharded,
+        # kb=6 (pads to 8) per tp=2 shard — half the block.
+        full = tm.paged_tile_bytes(128, 12, 512, 4)
+        shard = tm.paged_tile_bytes(128, tm.shard_heads(12, 2), 512, 4)
+        assert shard * 2 == full
+
+    def test_mesh_guard_budgets_per_shard_block(self):
+        # The runtime guard under a mesh evaluates the PER-SHARD block:
+        # a K=12/H=512 pool busts the budget unsharded (the kernel
+        # declines) but fits per tp=2 shard (the kernel lowers through
+        # its shard_map wrapper) — same shared model both sides.
+        import jax
+        import jax.numpy as jnp
+
+        from ray_dynamic_batching_tpu.parallel.mesh import (
+            MeshConfig,
+            build_mesh,
+        )
+
+        K, N, H = 12, 24, 512
+        assert tm.paged_tile_bytes(128, K, H, 4) \
+            > tm.VMEM_BLOCK_BUDGET_BYTES
+        assert tm.paged_tile_bytes(128, tm.shard_heads(K, 2), H, 4) \
+            <= tm.VMEM_BLOCK_BUDGET_BYTES
+        q = jnp.zeros((1, 1, N, H), jnp.float32)
+        k = jnp.zeros((4, 128, K, H), jnp.float32)
+        pt = jnp.zeros((1, 2), jnp.int32)
+        lens = jnp.ones((1,), jnp.int32)
+        assert da.paged_decode_attention(
+            q, k, k, pt, lens, interpret=True
+        ) is None
+        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        out = da.paged_decode_attention(
+            q, k, k, pt, lens, interpret=True, mesh=mesh
+        )
+        assert out is not None and out.shape == (1, 1, N, H)
+
     def test_f32_is_worst_case_itemsize(self):
         # The vmem-budget checker evaluates at itemsize 4; pin that this
         # upper-bounds every narrower dtype for any block shape.

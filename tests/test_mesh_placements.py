@@ -1,0 +1,369 @@
+"""Mesh-sharded serving placements — the scheduler half (ROADMAP item 2).
+
+``(model, mesh_shape)`` is the schedulable unit end to end: profile
+tables carry a mesh axis (single-chip rows are ``1x1`` so legacy tables
+load unchanged), the squishy bin-packer prices TP sessions from their
+own mesh rows and emits chip-SET node plans, the replan matcher types
+engines by width and prices cross-shape moves as weight reshards,
+``degrade_sessions`` clamps a TP model to the surviving slice geometry,
+and the sim fails whole slices on one dead chip (SliceDeadError
+semantics) then re-forms survivors. The end-to-end story is graded by
+``tools/run_mesh_soak.py``; these are the unit pins under it.
+"""
+
+import pytest
+
+from ray_dynamic_batching_tpu.profiles.table import (
+    BatchProfile,
+    ProfileRow,
+    mesh_chips,
+)
+from ray_dynamic_batching_tpu.scheduler.nexus import (
+    NodePlan,
+    Session,
+    SquishyBinPacker,
+)
+from ray_dynamic_batching_tpu.scheduler.replan import (
+    ModelEntry,
+    decide_replan,
+    degrade_sessions,
+    fit_plans_to_geometry,
+    match_plans_to_engines,
+    reshard_cost,
+    sessions_for,
+    transfer_cost,
+)
+from ray_dynamic_batching_tpu.sim.scenarios import (
+    linear_profile,
+    mesh_profiles,
+)
+
+GB = 1024 ** 3
+
+
+def tp_packer(hbm_gb: float = 16.0) -> SquishyBinPacker:
+    return SquishyBinPacker(
+        mesh_profiles(), hbm_budget_bytes=int(hbm_gb * GB)
+    )
+
+
+class TestMeshProfileTable:
+    def test_mesh_chips_parse(self):
+        assert mesh_chips("1x1") == 1
+        assert mesh_chips("1x4") == 4
+        assert mesh_chips("2x2") == 4
+        assert mesh_chips("2x4") == 8
+        for bad in ("", "huge", "0x4", "1x-2"):
+            with pytest.raises(ValueError, match="malformed"):
+                mesh_chips(bad)
+
+    def test_legacy_rows_default_to_1x1(self):
+        # A pre-mesh ProfileRow has no mesh argument at its call sites;
+        # the default stamps it single-chip and every default lookup
+        # sees exactly the rows it always did.
+        prof = linear_profile("m", base_ms=2.0, per_sample_ms=0.5)
+        assert all(r.mesh == "1x1" for r in prof.rows)
+        assert prof.buckets() == prof.buckets(mesh="1x1")
+        assert prof.meshes() == ["1x1"]
+
+    def test_mesh_lookups_are_keyed(self):
+        prof = mesh_profiles()["tp_llm"]
+        assert prof.meshes() == ["1x2", "1x4"]  # ascending in chips
+        r4 = prof.bucket_for(8, mesh="1x4")
+        r2 = prof.bucket_for(8, mesh="1x2")
+        assert r4 is not None and r2 is not None
+        assert r4.latency_ms < r2.latency_ms  # the wide slice is faster
+        # No single-chip rows at all: the default lookup finds nothing.
+        assert prof.bucket_for(8) is None
+
+
+class TestMeshPacker:
+    def test_tp_session_plans_over_chip_sets(self):
+        packer = tp_packer()
+        plan = packer.plan([
+            Session("tp_llm", slo_ms=400.0, rate_rps=50.0,
+                    mesh_shape="1x4"),
+        ])
+        assert plan
+        for node in plan:
+            assert node.mesh_shape == "1x4"
+            assert node.chips == 4
+        # chips_required counts SILICON, not node plans.
+        assert packer.chips_required(
+            [Session("tp_llm", slo_ms=400.0, rate_rps=50.0,
+                     mesh_shape="1x4")]
+        ) == 4 * len(plan)
+
+    def test_merge_refuses_cross_shape(self):
+        packer = tp_packer()
+        [n4] = packer.plan([Session("tp_llm", slo_ms=400.0, rate_rps=20.0,
+                                    mesh_shape="1x4")])
+        [n1] = packer.plan([Session("fast", slo_ms=200.0, rate_rps=20.0)])
+        assert packer.try_merge(n4, n1) is None
+        assert packer.try_merge(n1, n4) is None
+        # Same shape still merges when occupancy/HBM/SLO admit it.
+        [a] = packer.plan([Session("tp_llm", slo_ms=400.0, rate_rps=5.0,
+                                   mesh_shape="1x4")])
+        [b] = packer.plan([Session("tp_llm", slo_ms=400.0, rate_rps=5.0,
+                                   mesh_shape="1x4")])
+        merged = packer.try_merge(a, b)
+        if merged is not None:
+            assert merged.mesh_shape == "1x4"
+
+
+class TestDegradeSessions:
+    def _sessions(self, shape="1x4"):
+        return [Session("tp_llm", slo_ms=400.0, rate_rps=10.0,
+                        mesh_shape=shape),
+                Session("fast", slo_ms=200.0, rate_rps=10.0)]
+
+    def test_degrades_to_surviving_geometry(self):
+        out, degraded = degrade_sessions(
+            self._sessions(), [2, 1, 1], mesh_profiles()
+        )
+        by_name = {s.model: s for s in out}
+        assert by_name["tp_llm"].mesh_shape == "1x2"
+        assert by_name["fast"].mesh_shape == "1x1"
+        assert degraded == {"tp_llm": {"from": "1x4", "to": "1x2"}}
+
+    def test_upgrades_back_when_wide_slice_returns(self):
+        # The same clamp run at every decision IS the heal: a 1x2-
+        # degraded registration re-shapes up the moment a 4-wide slice
+        # exists again... but ONLY if the registration still prefers
+        # 1x4 — degrade_sessions never mutates ModelEntry, so the
+        # preferred shape re-enters each call.
+        out, degraded = degrade_sessions(
+            self._sessions(), [4, 2, 1], mesh_profiles()
+        )
+        assert {s.model: s.mesh_shape for s in out}["tp_llm"] == "1x4"
+        assert degraded == {}
+
+    def test_no_smaller_shape_starves_loudly(self):
+        # Only single chips survive and tp_llm has no 1x1 rows: the
+        # session keeps its shape (the planner will drop its plan with a
+        # capacity warning) instead of silently inventing a profile.
+        out, degraded = degrade_sessions(
+            self._sessions(), [1, 1], mesh_profiles()
+        )
+        assert {s.model: s.mesh_shape for s in out}["tp_llm"] == "1x4"
+        assert degraded == {}
+
+
+class TestWidthTypedMatching:
+    def test_plans_land_only_on_matching_width(self):
+        packer = tp_packer()
+        sessions = [
+            Session("tp_llm", slo_ms=400.0, rate_rps=20.0,
+                    mesh_shape="1x4"),
+            Session("fast", slo_ms=200.0, rate_rps=20.0),
+        ]
+        plans = packer.plan(sessions)
+        widths = [1, 4, 1]
+        assignment = match_plans_to_engines(
+            [frozenset(), frozenset(), frozenset()], plans,
+            packer.profiles, engine_widths=widths,
+        )
+        for w, a in zip(widths, assignment):
+            if a is not None:
+                assert a.chips == w
+        placed = {m for a in assignment if a for m in a.models}
+        assert "tp_llm" in placed and "fast" in placed
+
+    def test_fit_drops_unplaceable_width(self):
+        packer = tp_packer()
+        [n4] = packer.plan([Session("tp_llm", slo_ms=400.0, rate_rps=20.0,
+                                    mesh_shape="1x4")])
+        fitted = fit_plans_to_geometry([n4], [1, 1])
+        assert fitted == []  # no 4-wide slice exists: dropped loudly
+
+    def test_fit_merges_overflow_within_width(self):
+        packer = tp_packer()
+        plans = []
+        for _ in range(3):
+            plans += packer.plan([
+                Session("fast", slo_ms=200.0, rate_rps=20.0)
+            ])
+        fitted = fit_plans_to_geometry(plans, [1, 1, 4])
+        assert len(fitted) == 2  # folded down to the two single chips
+        assert all(p.chips == 1 for p in fitted)
+
+    def test_reshard_premium_prices_cross_shape_moves(self):
+        profiles = mesh_profiles()
+        assert reshard_cost("tp_llm", "1x4", "1x4", profiles) == 0.0
+        premium = reshard_cost("tp_llm", "1x4", "1x2", profiles)
+        assert premium > 0.0
+        # Priced at the DESTINATION shape's per-chip shard: narrowing
+        # to 1x2 re-lays 2x the per-chip bytes of widening to 1x4
+        # (mesh_profiles: 5000 MB/chip at 1x2 vs 2500 MB/chip at 1x4).
+        # The old all-rows min answered 2500 for both directions.
+        assert premium == pytest.approx(
+            2.0 * reshard_cost("tp_llm", "1x2", "1x4", profiles)
+        )
+        prof = profiles["tp_llm"]
+        assert prof.weights_hbm_bytes("1x2") \
+            == 2 * prof.weights_hbm_bytes("1x4")
+        # Missing shape falls back to the all-rows lower bound.
+        assert prof.weights_hbm_bytes("1x8") == prof.weights_hbm_bytes()
+        [plan] = tp_packer().plan([
+            Session("tp_llm", slo_ms=400.0, rate_rps=5.0,
+                    mesh_shape="1x2"),
+        ])
+        base = transfer_cost(frozenset(), plan, profiles)
+        with_reshard = transfer_cost(
+            frozenset(), plan, profiles,
+            resident_meshes={"tp_llm": "1x4"},
+        )
+        assert with_reshard == pytest.approx(base + premium)
+
+    def test_classic_domain_is_byte_identical(self):
+        # engine_widths=None (every pre-mesh caller) and an explicit
+        # all-singles geometry must produce the same decision.
+        from tests.fixtures import make_profiles
+
+        packer = SquishyBinPacker(make_profiles(),
+                                  hbm_budget_bytes=16 * GB)
+        models = {
+            "fast": ModelEntry("fast", slo_ms=200.0),
+            "heavy": ModelEntry("heavy", slo_ms=400.0),
+        }
+        rates = {"fast": 100.0, "heavy": 10.0}
+        sessions = sessions_for(models, rates)
+        engines = [frozenset({"fast"}), frozenset({"heavy"})]
+        classic = decide_replan(packer, engines, sessions, rates)
+        widthed = decide_replan(
+            packer, engines, sessions, rates,
+            engine_widths=[1, 1], engine_meshes=["1x1", "1x1"],
+        )
+        assert ([p.describe() for p in classic.plan]
+                == [p.describe() for p in widthed.plan])
+        assert classic.migration_cost == widthed.migration_cost
+        assert widthed.mesh_degraded == {}
+        # The audit payload stays byte-identical on all-singles domains.
+        assert classic.audit_fields() == widthed.audit_fields()
+
+    def test_decide_replan_audits_mesh_geometry(self):
+        packer = tp_packer()
+        models = {
+            "tp_llm": ModelEntry("tp_llm", slo_ms=400.0,
+                                 mesh_shape="1x4"),
+            "fast": ModelEntry("fast", slo_ms=200.0),
+        }
+        rates = {"tp_llm": 20.0, "fast": 20.0}
+        decision = decide_replan(
+            packer, [frozenset(), frozenset()],
+            sessions_for(models, rates), rates,
+            engine_widths=[2, 1], engine_meshes=["1x2", "1x1"],
+        )
+        fields = decision.audit_fields()
+        assert fields["observed"]["engine_widths"] == [2, 1]
+        assert fields["observed"]["mesh_degraded"] == {
+            "tp_llm": {"from": "1x4", "to": "1x2"}
+        }
+        meshes = {p.get("mesh") for p in fields["inputs"]["placements"]}
+        assert "1x2" in meshes
+
+
+class TestSimSliceSemantics:
+    def _engine(self, width=4):
+        from ray_dynamic_batching_tpu.engine.queue import QueueManager
+        from ray_dynamic_batching_tpu.sim.engine import SimEngine
+        from ray_dynamic_batching_tpu.sim.clock import (
+            EventLoop,
+            VirtualClock,
+        )
+
+        clock = VirtualClock()
+        loop = EventLoop(clock)
+        return SimEngine(
+            "slice0", QueueManager(), mesh_profiles(), loop, clock,
+            width=width, chip_ids=[f"chip{i}" for i in range(width)],
+        )
+
+    def test_one_dead_chip_fails_the_whole_slice(self):
+        e = self._engine()
+        assert e.mesh_shape == "1x4"
+        e.fail_chip(1)
+        assert not e.alive
+        assert e.failed_chip == 1
+        assert e.surviving_chips() == ["chip0", "chip2", "chip3"]
+
+    def test_fail_chip_bounds_checked(self):
+        e = self._engine(width=2)
+        with pytest.raises(ValueError, match="out of range"):
+            e.fail_chip(5)
+
+    def test_correlated_chip_deaths_all_recorded(self):
+        # A second chip dying AFTER the slice is already down (one rack
+        # event) must still be excluded from the re-form pool — only
+        # the slice kill is once-only, not the chip bookkeeping.
+        e = self._engine()
+        e.fail_chip(1)
+        e.fail_chip(3)
+        assert e.failed_chip == 1  # first death named in the audit
+        assert e.surviving_chips() == ["chip0", "chip2"]
+
+    def test_chip_failure_after_reform_kills_the_reformed_slice(self):
+        # Correlated rack event across a re-form boundary: chip 1 of
+        # the 4-slice dies at t=10 (slice fails, survivors re-form as
+        # slice0r0=[chip0,chip2] + slice0r1=[chip3] at the ~t=12 heal
+        # tick), then chip 2 dies at t=20 — the failure must resolve to
+        # the RE-FORMED unit that owns the physical chip at fire time,
+        # not the long-dead original, or the sim serves on dead silicon.
+        import dataclasses
+
+        from ray_dynamic_batching_tpu.sim import Simulation
+        from ray_dynamic_batching_tpu.sim.scenarios import (
+            slice_failure_scenario,
+        )
+        from ray_dynamic_batching_tpu.sim.simulator import EngineFailure
+
+        sc = dataclasses.replace(
+            slice_failure_scenario(seed=0),
+            failures=[EngineFailure(at_s=10.0, engine=0, chip=1),
+                      EngineFailure(at_s=20.0, engine=0, chip=2)],
+        )
+        report = Simulation(mesh_profiles(), sc).run()
+        chips = report["chips"]
+        owner = [cid for cid, c in chips.items()
+                 if "chip2" in c.get("chip_ids", []) and cid != "slice0"]
+        assert owner, chips.keys()  # a re-formed unit took chip2 over
+        assert not chips[owner[0]]["alive"]
+        # ...and ITS survivor re-formed again rather than vanishing.
+        assert any(
+            c["alive"] and c.get("chip_ids") == ["chip0"]
+            for c in chips.values()
+        ), chips.keys()
+
+    def test_slice_failure_scenario_degrades_and_reforms(self):
+        from ray_dynamic_batching_tpu.sim import Simulation
+        from ray_dynamic_batching_tpu.sim.scenarios import (
+            slice_failure_scenario,
+        )
+
+        report = Simulation(
+            mesh_profiles(), slice_failure_scenario(seed=0)
+        ).run()
+        dead = [a for a in report["audit"]
+                if a["trigger"] == "engine_dead"]
+        assert dead and "dead_slices" in dead[0]["observed"]
+        slices = dead[0]["observed"]["dead_slices"]["slice0"]
+        assert slices["width"] == 4 and slices["dead_chip"] == 1
+        # 3 surviving chips re-form as a 1x2 + a 1x1.
+        assert sorted(r["width"] for r in slices["reformed"]) == [1, 2]
+        degr = [a["observed"]["mesh_degraded"] for a in report["audit"]
+                if a["observed"].get("mesh_degraded")]
+        assert any(d.get("tp_llm", {}).get("to") == "1x2" for d in degr)
+
+
+class TestSliceDeadError:
+    def test_taxonomy(self):
+        from ray_dynamic_batching_tpu.serve.failover import (
+            ReplicaDeadError,
+            SliceDeadError,
+            is_retryable,
+        )
+
+        err = SliceDeadError("chip 2 of slice0 died", chip_index=2)
+        assert isinstance(err, ReplicaDeadError)
+        assert is_retryable(err)
+        assert err.chip_index == 2

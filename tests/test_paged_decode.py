@@ -386,7 +386,9 @@ class TestPoolBehavior:
         snap = engine.snapshot()
         assert snap["paged"] is False and "page_journal" not in snap
 
-    def test_paged_rejects_draft_and_mesh(self, lm):
+    def test_paged_rejects_bad_config(self, lm):
+        # (TP meshes no longer reject: ROADMAP item 2 shards the pool —
+        # see tests/test_tp_paged_decode.py.)
         model, params = lm
         queue = RequestQueue(model.name, max_len=16)
         with pytest.raises(ValueError, match="speculative"):

@@ -140,3 +140,42 @@ class TestMeshIntegration:
         a = {d.id for d in pg.bundle_devices(0)}
         b = {d.id for d in pg.bundle_devices(1)}
         assert not a & b
+
+
+class TestPinSlice:
+    """pin_slice: the planner's (model, mesh_shape) unit onto silicon."""
+
+    def test_pin_tp_slice_builds_mesh(self):
+        from ray_dynamic_batching_tpu.parallel.placement import pin_slice
+
+        mgr = PlacementManager(jax.devices()[:8])
+        pg, mesh = pin_slice(mgr, "1x4")
+        assert pg.total_chips == 4
+        assert mesh is not None and mesh.shape["tp"] == 4
+        # The mesh runs on EXACTLY the reserved gang.
+        assert {d.id for d in mesh.devices.flatten()} == {
+            d.id for d in pg.bundle_devices(0)
+        }
+        mgr.remove(pg)
+        assert sum(mgr.free_chips().values()) == 8
+
+    def test_pin_single_chip_shape(self):
+        from ray_dynamic_batching_tpu.parallel.placement import pin_slice
+
+        mgr = PlacementManager(jax.devices()[:2])
+        pg, mesh = pin_slice(mgr, "1x1")
+        assert mesh is None and pg.total_chips == 1
+
+    def test_strict_pack_refuses_straddling_hosts(self):
+        from ray_dynamic_batching_tpu.parallel.placement import pin_slice
+
+        mgr = PlacementManager(_cluster(2, 2))  # 2 hosts x 2 chips
+        with pytest.raises(PlacementError):
+            pin_slice(mgr, "1x4")  # no host holds a 4-gang
+
+    def test_malformed_shape_rejected(self):
+        from ray_dynamic_batching_tpu.parallel.placement import pin_slice
+
+        mgr = PlacementManager(_cluster(1, 4))
+        with pytest.raises(ValueError, match="malformed"):
+            pin_slice(mgr, "huge")

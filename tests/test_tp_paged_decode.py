@@ -1,0 +1,180 @@
+"""TP-mesh paged decode — the PR 7 exclusion lifted (ROADMAP item 2).
+
+``DecodeEngine(paged=True, mesh=...)`` shards the page pool over the
+mesh's kv-head (tp) axis — codes AND int8 scale planes — while the page
+table, lengths, and the host-side free-list allocator stay
+replica-global (page indices are shard-invariant). The contract is the
+same byte-identical-tokens bar every other cache layout meets: a seeded
+workload (greedy rows + one seeded sampled row) through a TP=2 paged
+engine must emit EXACTLY the tokens of (a) the single-chip paged engine
+and (b) the TP=2 slab engine, f32 and int8-KV, on the forced-8-device
+CPU host (tier-1 — the fake-chip cluster runs the real GSPMD paths).
+
+Kept un-marked (tier-1) like the rest of test_paged_decode's tiny-model
+engine runs: llama_tiny compiles in seconds and this is exactly the
+serving configuration the mesh-placement planner hands out.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+from ray_dynamic_batching_tpu.models.base import get_model
+from ray_dynamic_batching_tpu.parallel.mesh import MeshConfig, build_mesh
+
+from tests.test_paged_decode import _workload
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model("llama_tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm_int8(lm):
+    model = get_model("llama_tiny_int8kv", dtype=jnp.float32)
+    # Same weights as the f32 fixture: only the cache dtype differs.
+    return model, lm[1]
+
+
+def tp2_mesh():
+    return build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+
+
+def _run(model, params, paged, mesh=None):
+    queue = RequestQueue(model.name, max_len=256)
+    engine = DecodeEngine(
+        model, params, queue,
+        num_slots=4, max_len=64, prompt_buckets=[8, 16],
+        default_max_new_tokens=8, decode_horizon=4,
+        paged=paged, page_size=128, mesh=mesh,
+    )
+    reqs = _workload(queue, model.name)
+    engine.run_until_idle(timeout_s=180)
+    tokens = [tuple(r.future.result(timeout=5).tokens) for r in reqs]
+    return tokens, engine
+
+
+class TestTPPagedTokenExactness:
+    def test_tp2_paged_matches_single_chip_paged_f32(self, lm,
+                                                     eight_devices):
+        model, params = lm
+        single, _ = _run(model, params, paged=True)
+        tp, engine = _run(model, params, paged=True, mesh=tp2_mesh())
+        assert tp == single
+        # The replica-global allocator's conservation invariants hold
+        # under the sharded pool, and a drained engine returns every
+        # page (no cache configured -> nothing pinned).
+        engine._allocator.check()
+        assert engine._allocator.free_pages == engine.num_pages
+
+    def test_tp2_paged_matches_tp_slab_f32(self, lm, eight_devices):
+        """Same mesh, page pool vs slab: paging is a pure layout change
+        under TP exactly as it is on one chip."""
+        model, params = lm
+        mesh = tp2_mesh()
+        slab, _ = _run(model, params, paged=False, mesh=mesh)
+        paged, _ = _run(model, params, paged=True, mesh=mesh)
+        assert paged == slab
+
+    def test_tp2_paged_int8_kv_matches_both(self, lm_int8, eight_devices):
+        """int8-KV pool under TP: codes and scale planes shard together;
+        tokens match the single-chip paged AND the TP slab engines."""
+        model, params = lm_int8
+        single, _ = _run(model, params, paged=True)
+        mesh = tp2_mesh()
+        tp_paged, _ = _run(model, params, paged=True, mesh=mesh)
+        tp_slab, _ = _run(model, params, paged=False, mesh=mesh)
+        assert tp_paged == single
+        assert tp_paged == tp_slab
+
+
+class TestTPPagedKernel:
+    """The shard_map wrapper around the Pallas page-table kernel
+    (interpret mode — the CPU-runnable half of the TPU lowering):
+    per-shard head slices through the same ``_scan_tile`` body must
+    reproduce the unsharded kernel bit-for-bit, f32 and int8."""
+
+    def _mesh_out(self, dtype, eight_devices):
+        import numpy as np
+
+        from tests.test_paged_decode import TestPagedKernel
+        from ray_dynamic_batching_tpu.ops import decode_attention as da
+
+        pool = TestPagedKernel()
+        q, k, v, ks, vs, pt, lens, dims = pool._pool(dtype)
+        base = da.paged_decode_attention(
+            q, k, v, pt, lens, k_scale=ks, v_scale=vs, interpret=True
+        )
+        mesh = tp2_mesh()
+        out = da.paged_decode_attention(
+            q, k, v, pt, lens, k_scale=ks, v_scale=vs, interpret=True,
+            mesh=mesh,
+        )
+        assert out is not None and base is not None
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+    def test_tp2_kernel_matches_unsharded_f32(self, eight_devices):
+        self._mesh_out(jnp.float32, eight_devices)
+
+    def test_tp2_kernel_matches_unsharded_int8(self, eight_devices):
+        self._mesh_out(jnp.int8, eight_devices)
+
+    def test_kernel_declines_indivisible_heads(self, eight_devices):
+        """K=4 heads under tp=8 cannot split: the kernel declines and
+        the dispatcher falls back to the GSPMD-partitioned gather."""
+        from tests.test_paged_decode import TestPagedKernel
+        from ray_dynamic_batching_tpu.ops import decode_attention as da
+        from ray_dynamic_batching_tpu.parallel.mesh import (
+            MeshConfig,
+            build_mesh,
+        )
+
+        q, k, v, _ks, _vs, pt, lens, _ = TestPagedKernel()._pool(
+            jnp.float32)
+        mesh = build_mesh(MeshConfig(tp=8), jax.devices()[:8])
+        assert da.paged_decode_attention(
+            q, k, v, pt, lens, interpret=True, mesh=mesh
+        ) is None
+
+
+class TestTPPagedPoolLayout:
+    def test_pool_sharded_table_replicated(self, lm_int8, eight_devices):
+        """The pool's k/v (and scale) planes split on the kv-head dim
+        (index 3 of [L, P, ps, K, H]); the page table replicates — the
+        shard-invariant-page-indices contract that keeps the allocator
+        host-side and replica-global."""
+        model, params = lm_int8
+        queue = RequestQueue(model.name, max_len=16)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=128,
+            prompt_buckets=[8], paged=True, page_size=128,
+            mesh=tp2_mesh(),
+        )
+        cache = engine._cache
+        K = cache.k.shape[3]
+        for plane in (cache.k, cache.v):
+            assert not plane.sharding.is_fully_replicated
+            assert plane.sharding.shard_shape(plane.shape)[3] == K // 2
+        for plane in (cache.k_scale, cache.v_scale):
+            assert plane.sharding.shard_shape(plane.shape)[3] == K // 2
+        assert cache.page_table.sharding.is_fully_replicated
+        assert cache.lengths.sharding.is_fully_replicated
+
+    def test_indivisible_heads_replicate(self, lm, eight_devices):
+        """kv_heads=2 under tp=4: the feasible-spec rule replicates the
+        head axis instead of erroring, and the engine still builds."""
+        model, params = lm
+        mesh = build_mesh(MeshConfig(tp=4), jax.devices()[:4])
+        queue = RequestQueue(model.name, max_len=16)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=128,
+            prompt_buckets=[8], paged=True, page_size=128, mesh=mesh,
+        )
+        k = engine._cache.k
+        assert k.sharding.shard_shape(k.shape)[3] == k.shape[3]

@@ -72,6 +72,20 @@ CPU_MEASURED = {
         "source": "estimate: gpt2_medium init + engine warmup compiles "
                   "+ ~60s saturation + ~15s Poisson phase",
     },
+    # Same llm scope on the paged pool / the 2-chip TP-paged slice
+    # (ISSUE 7 / ROADMAP item 2 A/B arms): same phases, plus the pool
+    # or GSPMD compiles on top of a warm compile cache.
+    "bench_llm_paged": {
+        "seconds": 520,
+        "source": "estimate: bench_llm phases + paged-pool program "
+                  "compiles (cache-warm after the bench_llm step)",
+    },
+    "bench_llm_tp": {
+        "seconds": 560,
+        "source": "estimate: bench_llm phases + GSPMD-sharded program "
+                  "compiles for the 2-chip slice (cache-warm weights "
+                  "init; skip record when the relay exposes < 2 chips)",
+    },
     "bench": {
         "seconds": 2300,
         "source": "estimate: 8B host-quantize path 1159s (measured, "
@@ -91,6 +105,8 @@ CPU_MEASURED = {
 STEP_CAPS = {
     "first_light": wd.FIRST_LIGHT_TIMEOUT_S,
     "bench_llm": wd.BENCH_LLM_TIMEOUT_S,
+    "bench_llm_paged": wd.BENCH_LLM_TIMEOUT_S,
+    "bench_llm_tp": wd.BENCH_LLM_TIMEOUT_S,
     "bench": wd.BENCH_TIMEOUT_S,
     "profiles": wd.PROFILES_TIMEOUT_S,
     "slo_demo": wd.SLO_TIMEOUT_S,

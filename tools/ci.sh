@@ -26,6 +26,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_chaos_soak.py --sim
   echo "== straggler conformance (sim: 10x gray slowdown, probation + reclaim, tools/straggler_smoke.json) =="
   python tools/run_straggler_soak.py --sim
+  echo "== mesh-placement conformance (sim: TP slices as schedulable units, slice death + degrade, tools/mesh_smoke.json) =="
+  python tools/run_mesh_soak.py --sim
   echo "== overload conformance (sim: 5x saturation, QoS floors, tools/overload_smoke.json) =="
   python tools/run_overload_soak.py --sim
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
@@ -63,6 +65,9 @@ python tools/run_chaos_soak.py --live --smoke
 echo "== straggler conformance (sim + live: one replica 10x slow, probation then reclaim, hedge conservation) =="
 python tools/run_straggler_soak.py --sim
 python tools/run_straggler_soak.py --live --smoke
+
+echo "== mesh-placement conformance (sim: TP slices as schedulable units, slice death + degrade) =="
+python tools/run_mesh_soak.py --sim
 
 echo "== overload conformance (sim 5x + live mixed-class soak, only 200s/429s) =="
 python tools/run_overload_soak.py --sim

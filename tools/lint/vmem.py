@@ -24,6 +24,16 @@ implementation the static model and ``_pick_sb`` cannot drift apart.
   VMEM — e.g. a ``(kb, 1)`` trailing pair pads to ``(8, 128)``, a ~128x
   blowup invisible to export-based lowering tests
   (``ops/decode_attention.py`` documents the real case).
+
+Mesh shards (ROADMAP item 2): a head-sharded paged kernel streams
+``tile_math.shard_heads(K, tp)`` kv heads per core, so its true VMEM
+block divides by the TP degree where the head block spans the axis.
+The TP degree is a runtime property the static pass cannot see, so the
+checker's role is the escape-hatch discipline above — mesh-shaped
+kernels resolve their blocks through the runtime guard in
+``paged_decode_attention``, which budgets the per-shard block with the
+SAME standalone-loaded model (``shard_heads`` agreement pinned by
+``tests/test_lint.py::TestSharedTileMath``).
 """
 
 from __future__ import annotations

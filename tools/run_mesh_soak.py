@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Mesh-placement conformance gate — TP slices as schedulable units.
+
+ROADMAP item 2's planner half, proven in the simulator: the squishy
+bin-packer places ``(model, mesh_shape)`` over chip SETS, a dead chip
+fails its whole slice (``serve/failover.SliceDeadError`` semantics),
+survivors re-form as narrower slices, and the heal replan DEGRADES the
+TP model to the profile row of the geometry that still exists. Two
+deterministic fixtures from ``sim/scenarios.py``, each run TWICE for
+byte-identical reports, graded against ``tools/mesh_smoke.json``:
+
+  - mesh_scenario: a [4, 2, 1, 1]-width cluster serving ``tp_llm`` (a
+    model with ONLY 1x4/1x2 profile rows) next to single-chip ``fast``
+    traffic. Asserts tp_llm lands on the 4-chip slice (never a single
+    chip), fast never lands on the TP slice's chips, both hold their
+    attainment floors, and accounting conserves.
+  - slice_failure_scenario: chip 1 of the 4-chip slice dies at t=10s.
+    Asserts the whole slice fails, the audit names the dead slice and
+    its re-formed sub-slices, the replan records tp_llm degrading
+    1x4 -> 1x2 (``mesh_degraded``), a surviving half-slice actually
+    executes tp_llm batches after the death, floors hold, and
+    accounting conserves (no request vanishes across the failover).
+
+Sim-only (the CI fast lane): the live mesh plane is pinned by the
+tier-1 TP-paged token-exactness tests and the LiveScheduler slice
+tests; this gate buys the *scheduler story* at traffic no test rig
+produces.
+
+Exit: 0 conformant, 1 violation, 2 usage.
+
+Examples:
+  python tools/run_mesh_soak.py --sim
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RATCHET = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "mesh_smoke.json")
+
+
+def _load_floors() -> dict:
+    with open(RATCHET) as f:
+        return json.load(f)["floors"]
+
+
+def _conservation(report: dict, failures: list, arm: str) -> None:
+    for name, s in report["models"].items():
+        accounted = (s["completed"] + s["stale"] + s["dropped"]
+                     + s["pending"])
+        if s["arrivals"] != accounted:
+            failures.append(
+                f"{arm}/{name}: accounting leak — {s['arrivals']} arrivals "
+                f"vs {accounted} accounted; a slice event made requests "
+                "vanish"
+            )
+
+
+def _attainment_floors(report: dict, floors: dict, failures: list,
+                       arm: str) -> None:
+    for name, floor in floors.get("slo_attainment", {}).items():
+        got = report["models"][name]["slo_attainment"]
+        if got < floor:
+            failures.append(
+                f"{arm}/{name}: attainment {got:.4f} under floor {floor}"
+            )
+
+
+def run_sim(seed: int = 0) -> int:
+    from ray_dynamic_batching_tpu.sim import Simulation, render_json
+    from ray_dynamic_batching_tpu.sim.scenarios import (
+        mesh_profiles,
+        mesh_scenario,
+        slice_failure_scenario,
+    )
+
+    floors = _load_floors()
+    failures: list = []
+
+    # --- placement arm ----------------------------------------------------
+    reports = [
+        Simulation(mesh_profiles(), mesh_scenario(seed=seed)).run()
+        for _ in range(2)
+    ]
+    if render_json(reports[0]) != render_json(reports[1]):
+        failures.append("mesh: nondeterministic — same seed produced "
+                        "different report bytes")
+    report = mesh_report = reports[0]
+    f = floors["mesh"]
+    _conservation(report, failures, "mesh")
+    _attainment_floors(report, f, failures, "mesh")
+    tp_hosts = [
+        (cid, c) for cid, c in report["chips"].items()
+        if c["requests"] > 0 and "tp_llm" in c["models"]
+    ]
+    if not tp_hosts:
+        failures.append("mesh: tp_llm executed nowhere")
+    for cid, c in tp_hosts:
+        if c["width"] < f["tp_slice_width"]:
+            failures.append(
+                f"mesh: tp_llm placed on {cid} (width {c['width']}) — the "
+                f"planner must pin it to a {f['tp_slice_width']}-chip slice"
+            )
+        if "fast" in c["models"]:
+            failures.append(
+                f"mesh: single-chip 'fast' co-located onto TP slice {cid} "
+                "— duty cycles must not cross slice shapes"
+            )
+
+    # --- slice-failure arm ------------------------------------------------
+    reports = [
+        Simulation(mesh_profiles(),
+                   slice_failure_scenario(seed=seed)).run()
+        for _ in range(2)
+    ]
+    if render_json(reports[0]) != render_json(reports[1]):
+        failures.append("slice_failure: nondeterministic — same seed "
+                        "produced different report bytes")
+    report = reports[0]
+    f = floors["slice_failure"]
+    _conservation(report, failures, "slice_failure")
+    _attainment_floors(report, f, failures, "slice_failure")
+    audit = report["audit"]
+    dead = [a for a in audit if a["trigger"] == "engine_dead"]
+    if not dead or "dead_slices" not in dead[0]["observed"]:
+        failures.append(
+            "slice_failure: no audited slice death — a chip died but the "
+            "audit never named the lost slice"
+        )
+    else:
+        slices = dead[0]["observed"]["dead_slices"]
+        reformed = sum(len(s["reformed"]) for s in slices.values())
+        if reformed < f["min_reformed_units"]:
+            failures.append(
+                f"slice_failure: only {reformed} re-formed unit(s) — "
+                "surviving chips of the dead slice were thrown away"
+            )
+    degr = [
+        a["observed"].get("mesh_degraded", {}).get("tp_llm")
+        for a in audit
+        if a["observed"].get("mesh_degraded")
+    ]
+    if not any(d and d["to"] == f["degraded_to"] for d in degr):
+        failures.append(
+            f"slice_failure: no replan degraded tp_llm to "
+            f"{f['degraded_to']} — the model cannot be serving on the "
+            "surviving geometry"
+        )
+    served_after = [
+        cid for cid, c in report["chips"].items()
+        if c["alive"] and c["width"] == 2 and "tp_llm" in c["models"]
+        and c["requests"] > 0
+    ]
+    if not served_after:
+        failures.append(
+            "slice_failure: no surviving half-slice executed tp_llm — "
+            "the degrade decided but never ran"
+        )
+
+    summary = {
+        "metric": "mesh_soak",
+        "ok": not failures,
+        "mesh": {
+            name: mesh_report["models"][name]["slo_attainment"]
+            for name in mesh_report["models"]
+        },
+        "slice_failure": {
+            name: report["models"][name]["slo_attainment"]
+            for name in report["models"]
+        },
+        "violations": failures,
+    }
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    if failures:
+        for v in failures:
+            print(f"mesh soak FAILED: {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--sim", action="store_true", default=True,
+                        help="run the deterministic sim arm (default)")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+    return run_sim(seed=args.seed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -325,6 +325,24 @@ def capture_bench_llm_paged() -> bool:
     )
 
 
+def capture_bench_llm_tp() -> bool:
+    """The TP-paged arm of the llm A/B (bench.py --mesh 2 --paged on):
+    ROADMAP item 2's mesh-placement serving configuration — the page
+    pool sharded over a 2-chip TP slice — measured against the
+    single-chip slab/paged records from the same window. Per-chip
+    normalization (whole-slice tokens / width) makes the three arms
+    directly comparable; the row lands only when the relay exposes >= 2
+    chips (bench returns a skip record otherwise, which parses as a
+    0-value llm row and is not committed)."""
+    return capture_bench(
+        step_name="bench_llm_tp",
+        env_extra={"RDB_BENCH_SCOPE": "llm", "RDB_BENCH_PAGED": "1",
+                   "RDB_BENCH_MESH": "2"},
+        timeout_s=BENCH_LLM_TIMEOUT_S, prefix="bench_llm_tp",
+        expected_scope="llm",
+    )
+
+
 def _completed_profile_models(stdout: str) -> list:
     """Skip tokens (``name`` / ``name:decode``) of models whose
     per-model completion line printed — each line prints only AFTER
@@ -516,6 +534,7 @@ STEPS = [
     ("first_light", capture_first_light),
     ("bench_llm", capture_bench_llm),
     ("bench_llm_paged", capture_bench_llm_paged),
+    ("bench_llm_tp", capture_bench_llm_tp),
     ("bench", capture_bench),
     ("profiles", capture_profiles),
     ("slo_demo", capture_slo_demo),
