@@ -63,11 +63,13 @@ from ray_dynamic_batching_tpu.engine.request import (
     now_ms,
 )
 from ray_dynamic_batching_tpu.engine.paging import (
+    HostSpillTier,
     OutOfPages,
     PageAllocator,
     PagedPrefixCache,
     PagedSessionCache,
     PageEventJournal,
+    digest_chain,
     table_array,
 )
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
@@ -440,6 +442,7 @@ class DecodeEngine:
         paged: bool = False,
         page_size: int = 128,
         kv_pool_pages: Optional[int] = None,
+        host_spill_pages: int = 0,
     ):
         from ray_dynamic_batching_tpu.utils.compile_cache import maybe_enable
 
@@ -659,6 +662,17 @@ class DecodeEngine:
                 self.prefix_cache = PrefixCache(
                     prefix_cache_size, self.prompt_buckets[-1]
                 )
+        # HBM -> host-RAM spill tier (0 = off): prefix-cache entries shed
+        # under pool pressure spill their page CONTENTS to host RAM and
+        # reload on the next matching prompt — hot system prompts survive
+        # pool churn instead of recomputing (ISSUE 11; paged-only, and
+        # pointless without a prefix cache to spill from).
+        self.host_spill: Optional[HostSpillTier] = None
+        if host_spill_pages > 0 and self.paged_prefix is not None:
+            self.host_spill = HostSpillTier(
+                host_spill_pages, self._read_pages, self._write_pages,
+                journal=self._page_journal,
+            )
         # Multi-turn session KV continuation (0 = off). Paged store pins
         # the finished slot's pages (O(1), no row copy).
         self.session_cache: Optional[SessionCache] = None
@@ -1554,13 +1568,95 @@ class DecodeEngine:
             ))
         return False
 
+    def _read_pages(self, page_ids: List[int]) -> Dict[str, np.ndarray]:
+        """Gather the listed pages' contents to host (spill). The pages
+        are pinned (prefix-cache refs) and never rewritten after
+        publication (CoW invariant), so this read races nothing."""
+        idx = np.asarray(page_ids, np.int32)
+        out = {"k": np.asarray(self._cache.k[:, idx]),
+               "v": np.asarray(self._cache.v[:, idx])}
+        if self._cache.quantized:
+            out["k_scale"] = np.asarray(self._cache.k_scale[:, idx])
+            out["v_scale"] = np.asarray(self._cache.v_scale[:, idx])
+        return out
+
+    def _write_pages(self, page_ids: List[int],
+                     payload: Dict[str, np.ndarray]) -> None:
+        """Scatter spilled contents into freshly allocated pages
+        (reload). Functional update — the pool array has one logical
+        writer (this engine thread), like the page-table upload."""
+        with self._device_ctx():
+            idx = jnp.asarray(np.asarray(page_ids, np.int32))
+            repl = {
+                "k": self._cache.k.at[:, idx].set(
+                    jnp.asarray(payload["k"], self._cache.k.dtype)),
+                "v": self._cache.v.at[:, idx].set(
+                    jnp.asarray(payload["v"], self._cache.v.dtype)),
+            }
+            if self._cache.quantized:
+                repl["k_scale"] = self._cache.k_scale.at[:, idx].set(
+                    jnp.asarray(payload["k_scale"], jnp.float32))
+                repl["v_scale"] = self._cache.v_scale.at[:, idx].set(
+                    jnp.asarray(payload["v_scale"], jnp.float32))
+            self._cache = self._cache.replace(**repl)
+
+    def _reload_spilled_prefix(
+        self, prompt: np.ndarray
+    ) -> Optional[Tuple[List[int], int]]:
+        """Probe the host-RAM spill tier for the longest spilled
+        page-prefix of ``prompt``; on a hit the pages come back into
+        fresh HBM, get republished in the prefix cache, and the caller
+        proceeds exactly as on an HBM hit. Returns the (page_ids,
+        shared_len) borrow or None (absent, or no free pages for the
+        reload — recompute then, never deepen the pressure)."""
+        max_n = (int(prompt.size) - 1) // self.page_size
+        if max_n <= 0 or len(self.host_spill) == 0:
+            return None
+        keys = digest_chain(prompt, self.page_size, max_n)
+        for n in range(max_n, 0, -1):
+            if keys[n - 1] not in self.host_spill:
+                continue
+            pids = self.host_spill.reload(keys[n - 1], self._allocator)
+            if pids is None:
+                return None
+            # Republish (the cache pins them), then drop the reload's
+            # own hold — pin symmetry identical to a slot publishing.
+            self.paged_prefix.insert(prompt[: n * self.page_size], pids)
+            self._allocator.decref(pids)
+            return self.paged_prefix.lookup(prompt)
+        return None
+
+    def prefix_digests(self, limit: int = 128) -> Optional[Dict[str, Any]]:
+        """Bounded digest publication for cluster-wide prefix routing:
+        HBM prefix-cache entries plus spilled entries (both servable
+        here — one reload vs a full recompute elsewhere), as
+        ``{"page_size": ..., "digests": {hex: chain_len}}``."""
+        if self.paged_prefix is None:
+            return None
+        digests = self.paged_prefix.digests(limit)
+        if self.host_spill is not None and len(digests) < limit:
+            for key, n in self.host_spill.digests(
+                limit - len(digests)
+            ).items():
+                digests.setdefault(key, n)
+        return {"page_size": self.page_size, "digests": digests}
+
     def _reclaim_cache_pins(self) -> bool:
         """Shed one LRU cache pin under pool pressure — prefix entries
         first (pure recompute cost), then session turns (a re-prefill
         next turn). Cache pins are optimizations; live streams are not:
-        this runs before any capacity-finish eviction. Returns True if
-        an entry was dropped (its pages free unless a borrower still
-        holds them — callers loop)."""
+        this runs before any capacity-finish eviction. With a spill tier
+        the shed prefix entry's page CONTENTS move to host RAM first, so
+        the 'recompute cost' becomes 'one reload'. Returns True if an
+        entry was dropped (its pages free unless a borrower still holds
+        them — callers loop)."""
+        if self.paged_prefix is not None and self.host_spill is not None:
+            lru = self.paged_prefix.peek_lru()
+            if lru is not None:
+                key, pages = lru
+                self.host_spill.spill(
+                    key, list(pages), self._allocator.allocated_pages
+                )
         for which, cache in (("prefix", self.paged_prefix),
                              ("session", self.paged_sessions)):
             if cache is not None and cache.evict_lru():
@@ -1890,6 +1986,10 @@ class DecodeEngine:
             # recompute into PRIVATE pages via the row), and publish this
             # prompt's own full-page prefixes once they are committed.
             hit = self.paged_prefix.lookup(prompt)
+            if hit is None and self.host_spill is not None:
+                # Host-RAM spill tier: a prefix shed under pool pressure
+                # reloads instead of recomputing (journaled as "reload").
+                hit = self._reload_spilled_prefix(prompt)
             if hit is not None:
                 shared_ids, shared_len = hit
                 self._swap_in_shared(opts, shared_ids)
@@ -2727,6 +2827,8 @@ class DecodeEngine:
                 self.paged_prefix.clear()
             if self.paged_sessions is not None:
                 self.paged_sessions.clear()
+            if self.host_spill is not None:
+                self.host_spill.clear()  # host copies die with the pool
             self._allocator = None
             self._table_host = None
 

@@ -34,11 +34,36 @@ arithmetic without an accelerator stack.
 from __future__ import annotations
 
 import collections
-from typing import List, Optional, Sequence, Tuple
+import hashlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ray_dynamic_batching_tpu.ops.tile_math import pages_for
+
+
+def digest_chain(prompt: np.ndarray, page_size: int,
+                 max_n: Optional[int] = None) -> List[bytes]:
+    """Chained page-digest keys for ``prompt``: ``keys[j-1]`` covers
+    pages ``[0, j)`` and is ``blake2b(page_j_tokens + keys[j-2])`` — one
+    O(L) pass over the prompt bytes, 16 bytes retained per level.
+
+    This is THE prefix identity of the whole stack: the per-engine
+    :class:`PagedPrefixCache` keys its entries with it, the host-RAM
+    spill tier keys spilled page runs with it, and the router's digest
+    directory matches request prompts against replica publications with
+    it — one function, so the three can never disagree on what "same
+    prefix" means."""
+    if max_n is None:
+        max_n = int(prompt.size) // int(page_size)
+    keys: List[bytes] = []
+    prev = b""
+    ps = int(page_size)
+    for n in range(1, max_n + 1):
+        page = np.ascontiguousarray(prompt[(n - 1) * ps: n * ps]).tobytes()
+        prev = hashlib.blake2b(page + prev, digest_size=16).digest()
+        keys.append(prev)
+    return keys
 
 
 class OutOfPages(Exception):
@@ -63,7 +88,8 @@ class PageEventJournal:
     atomic enough for a monitoring read).
     """
 
-    KINDS = ("alloc", "free", "cow_copy", "cache_reclaim", "eviction")
+    KINDS = ("alloc", "free", "cow_copy", "cache_reclaim", "eviction",
+             "spill", "reload")
 
     def __init__(self, capacity: int = 4096) -> None:
         if capacity <= 0:
@@ -236,6 +262,15 @@ class _PinnedLRU:
         self.allocator.decref(self._pages_of(evicted))
         return True
 
+    def peek_lru(self):
+        """(key, value) of the entry :meth:`evict_lru` would drop next,
+        or None — the spill tier reads the victim's pages BEFORE the
+        eviction releases the cache's pin on them."""
+        if not self._entries:
+            return None
+        key = next(iter(self._entries))
+        return key, self._entries[key]
+
     def _get(self, key):
         entry = self._entries.get(key)
         if entry is not None:
@@ -293,19 +328,22 @@ class PagedPrefixCache(_PinnedLRU):
 
     def _level_keys(self, prompt: np.ndarray, max_n: int) -> List[bytes]:
         """Chained level keys: keys[j-1] covers pages [0, j). One pass
-        over the prompt bytes total."""
-        import hashlib
+        over the prompt bytes total (module-level :func:`digest_chain` —
+        shared with the spill tier and the router's digest directory)."""
+        return digest_chain(prompt, self.page_size, max_n)
 
-        keys: List[bytes] = []
-        prev = b""
-        ps = self.page_size
-        for n in range(1, max_n + 1):
-            page = np.ascontiguousarray(
-                prompt[(n - 1) * ps: n * ps]
-            ).tobytes()
-            prev = hashlib.blake2b(page + prev, digest_size=16).digest()
-            keys.append(prev)
-        return keys
+    def digests(self, limit: int = 128) -> Dict[str, int]:
+        """Bounded digest publication for cluster-wide prefix routing:
+        the ``limit`` most-recently-used entries as ``{digest_hex:
+        chain_len}``. O(1) per entry (the 16-byte level key IS the
+        identity — no token bytes leave the replica), recency-bounded so
+        a replica advertises what its pool actually still holds."""
+        out: Dict[str, int] = {}
+        for key in reversed(self._entries):
+            if len(out) >= limit:
+                break
+            out[key.hex()] = len(self._entries[key])
+        return out
 
     def lookup(self, prompt: np.ndarray) -> Optional[Tuple[List[int], int]]:
         """Longest shared page-prefix: ``(page_ids, shared_len)`` with
@@ -368,6 +406,130 @@ class PagedSessionCache(_PinnedLRU):
         n = pages_for(int(history.size), self.page_size)
         self._put(session_id,
                   (tuple(page_ids[:n]), np.asarray(history, np.int32)))
+
+
+class HostSpillTier:
+    """HBM → host-RAM eviction tier for prefix pages (ISSUE 11).
+
+    When pool pressure sheds a prefix-cache pin, the entry's page
+    CONTENTS move to host RAM (keyed by the same chained digest as the
+    HBM entry) instead of vanishing — a later prompt sharing that prefix
+    reloads the pages into freshly allocated HBM and skips the prefill
+    recompute. Hot system prompts therefore survive pool churn AND
+    replica churn: the digest keys a replica publishes to the router
+    include its spilled entries, so cluster-wide prefix routing keeps
+    steering matching prompts here.
+
+    Page IO is injected (``read_pages(page_ids) -> payload``,
+    ``write_pages(page_ids, payload)``) so this stays numpy-only and
+    testable without a device; the engine binds them to gather/scatter
+    on its device page pool. Every spill and reload is journaled like
+    any other allocator event — the tier is part of the page pool's
+    flight record, not a side channel.
+
+    Bounded by ``capacity_pages`` of host residency, LRU within the
+    bound. An entry is REMOVED on reload (its pages are back in HBM and
+    the prefix cache re-publishes them); re-spilling on the next
+    pressure wave re-reads the then-current contents.
+    """
+
+    def __init__(
+        self,
+        capacity_pages: int,
+        read_pages: Callable[[List[int]], Dict[str, np.ndarray]],
+        write_pages: Callable[[List[int], Dict[str, np.ndarray]], None],
+        journal: Optional[PageEventJournal] = None,
+    ) -> None:
+        if capacity_pages <= 0:
+            raise ValueError(
+                f"capacity_pages must be positive, got {capacity_pages}"
+            )
+        self.capacity_pages = int(capacity_pages)
+        self._read = read_pages
+        self._write = write_pages
+        self.journal = journal
+        # digest key (bytes) -> (payload, n_pages), LRU order.
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self.pages_held = 0
+        self.spills = 0
+        self.reloads = 0
+        self.dropped = 0  # entries LRU-evicted from the tier itself
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def spill(self, key: bytes, page_ids: Sequence[int],
+              pages_in_use: int) -> bool:
+        """Copy ``page_ids``' contents to host under ``key``. Call
+        BEFORE the HBM eviction drops the pin (the pages must still be
+        intact). Returns False when the key is already spilled (the
+        caller may proceed straight to the eviction)."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return False
+        n = len(page_ids)
+        if n > self.capacity_pages:
+            return False  # one oversized entry cannot fit; don't thrash
+        payload = self._read(list(page_ids))
+        self._entries[key] = (payload, n)
+        self.pages_held += n
+        self.spills += 1
+        if self.journal is not None:
+            self.journal.record("spill", n, pages_in_use,
+                                digest=key.hex())
+        while self.pages_held > self.capacity_pages:
+            _, (_, n_drop) = self._entries.popitem(last=False)
+            self.pages_held -= n_drop
+            self.dropped += 1
+        return True
+
+    def reload(self, key: bytes,
+               allocator: PageAllocator) -> Optional[List[int]]:
+        """Allocate fresh pages and copy the spilled contents back into
+        HBM; returns the page ids (refcount 1, owned by the caller) or
+        None when the key is absent or the pool cannot supply the pages
+        right now (the caller falls back to recompute — a reload must
+        never deepen the pressure that caused the spill)."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        payload, n = entry
+        if not allocator.can_alloc(n):
+            return None
+        page_ids = allocator.alloc(n)
+        self._write(page_ids, payload)
+        del self._entries[key]
+        self.pages_held -= n
+        self.reloads += 1
+        if self.journal is not None:
+            self.journal.record("reload", n, allocator.allocated_pages,
+                                digest=key.hex())
+        return page_ids
+
+    def digests(self, limit: int = 128) -> Dict[str, int]:
+        """Spilled entries as ``{digest_hex: chain_len}`` — published to
+        the router alongside the HBM prefix cache's digests, because a
+        spilled prefix is still servable here (one reload vs a full
+        prefill recompute elsewhere)."""
+        out: Dict[str, int] = {}
+        for key in reversed(self._entries):
+            if len(out) >= limit:
+                break
+            out[key.hex()] = self._entries[key][1]
+        return out
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.pages_held = 0
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._entries),
+                "pages_held": self.pages_held,
+                "spills": self.spills, "reloads": self.reloads,
+                "dropped": self.dropped}
 
 
 def table_array(pages: Sequence[int], n_entries: int,

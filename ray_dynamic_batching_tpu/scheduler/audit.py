@@ -30,9 +30,11 @@ class AuditRecord:
 
     seq: int                    # monotonically increasing per ring
     wall_time: float            # time.time() at the decision
-    domain: str                 # "nexus" | "llm" | "serve"
+    domain: str                 # "nexus" | "llm" | "serve" | "frontdoor"
     trigger: str                # "manual" | "rate_change" | "quarantine" |
-                                # "heal" | "rolling_update" | "scale" | ...
+                                # "heal" | "rolling_update" | "scale" |
+                                # "store_fenced" | "failover_adopt" |
+                                # "admission_drift" | ...
     key: str = ""               # deployment/model the decision is about
                                 # ("" = domain-wide, e.g. a full replan)
     observed: Dict[str, Any] = field(default_factory=dict)   # inputs seen

@@ -32,6 +32,21 @@ from ray_dynamic_batching_tpu.serve.controller import (
     DeploymentConfig,
     ServeController,
 )
+from ray_dynamic_batching_tpu.serve.frontdoor import (
+    FrontDoor,
+    FrontDoorShard,
+    GlobalBudget,
+    HashRing,
+)
+from ray_dynamic_batching_tpu.serve.store import (
+    ControllerStore,
+    InMemoryStore,
+    LeaderLease,
+    ReplicaCatalog,
+    ReplicatedStore,
+    StaleEpochError,
+    StoreLog,
+)
 from ray_dynamic_batching_tpu.serve.failover import (
     DrainEvicted,
     FailoverManager,
@@ -83,9 +98,20 @@ __all__ = [
     "AutoscalingConfig",
     "AutoscalingPolicy",
     "CompletionsHandle",
+    "ControllerStore",
     "DeploymentConfig",
     "DeploymentHandle",
     "DrainEvicted",
+    "FrontDoor",
+    "FrontDoorShard",
+    "GlobalBudget",
+    "HashRing",
+    "InMemoryStore",
+    "LeaderLease",
+    "ReplicaCatalog",
+    "ReplicatedStore",
+    "StaleEpochError",
+    "StoreLog",
     "FailoverManager",
     "FailoverPolicy",
     "GrayHealthMonitor",

@@ -216,6 +216,16 @@ class AdmissionController:
         with self._lock:
             return self._degraded.get(deployment, False)
 
+    def force_state(self, deployment: str, degraded: bool) -> None:
+        """Restore the governor state from a durable mirror (controller
+        failover: the successor's fresh controller must keep enforcing
+        the degraded-mode contract the old leader declared, not re-admit
+        the flood until its own hysteresis re-detects it). Bucket rates
+        re-derive lazily at the next admit, as with observe()."""
+        with self._lock:
+            if deployment in self._policies:
+                self._degraded[deployment] = bool(degraded)
+
     # --- the admission decision -------------------------------------------
     def admit(
         self,

@@ -9,6 +9,19 @@ where it left off; the deployment state machine scaling replicas up/down and
 replacing unhealthy ones (``deployment_state.py``); replica-set changes
 pushed to routers over long poll (SURVEY.md §2.3).
 
+Control-plane scale-out (ISSUE 11): all controller-owned mutable state is
+written through the :mod:`~ray_dynamic_batching_tpu.serve.store`
+transaction API — the GCS move. With the default :class:`InMemoryStore`
+nothing changes operationally; with a :class:`ReplicatedStore` every
+transaction lands in a shared epoch-fenced log, a standby controller
+replays it and takes over when the leader's lease lapses, and the deposed
+leader's next write raises :class:`StaleEpochError` instead of corrupting
+state it no longer owns. Live data-plane objects (replicas, routers)
+survive the failover through a :class:`ReplicaCatalog`; clients' handles
+keep routing throughout because the ROUTER they hold is adopted, never
+replaced. The ``store-discipline`` lint rule (tools/lint/store.py) holds
+this file to the transaction API.
+
 TPU-first note: replica startup can imply weight upload + XLA warmup, so the
 state machine starts replicas *before* registering them with the router and
 drains before stopping — the same rollout discipline Serve uses for slow
@@ -42,12 +55,25 @@ from ray_dynamic_batching_tpu.serve.autoscaling import (
 from ray_dynamic_batching_tpu.serve.long_poll import LongPollHost
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.serve.router import Router
+from ray_dynamic_batching_tpu.serve.store import (
+    ControllerStore,
+    InMemoryStore,
+    ReplicaCatalog,
+    ReplicatedStore,
+    StaleEpochError,
+)
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("controller")
 
 CHECKPOINT_KEY = "serve:controller:checkpoint"  # ref controller.py:79-80
 REPLICA_SET_KEY = "serve:replicas:{deployment}"
+PREFIX_DIGEST_KEY = "serve:prefix_digests:{deployment}"
+# Controller-store keys (the replicated state the standby replays).
+STORE_CONFIG_KEY = "serve:deployments/{deployment}/config"
+STORE_REGISTRY_KEY = "serve:deployments/{deployment}/replicas"
+STORE_GOVERNOR_KEY = "serve:governor/{deployment}"
+STORE_GRAY_KEY = "serve:gray/{deployment}"
 
 
 @dataclass
@@ -154,7 +180,13 @@ class _DeploymentState:
 
 
 class ServeController:
-    """Singleton control loop owning deployments, routers, and scaling."""
+    """Singleton control loop owning deployments, routers, and scaling.
+
+    ``store`` is the transactional home of every piece of mutable
+    controller state (GCS move); ``catalog`` registers the live
+    data-plane objects so a failover successor adopts them instead of
+    cold-starting the world.
+    """
 
     def __init__(
         self,
@@ -162,17 +194,24 @@ class ServeController:
         long_poll: Optional[LongPollHost] = None,
         control_interval_s: float = 0.5,
         placement: Optional[PlacementManager] = None,
+        store: Optional[ControllerStore] = None,
+        catalog: Optional[ReplicaCatalog] = None,
     ) -> None:
         self.kv = kv or KVStore()
         self.long_poll = long_poll or LongPollHost()
         self.placement = placement
         self.control_interval_s = control_interval_s
+        self.store = store or InMemoryStore()
+        self.catalog = catalog
         self._deployments: Dict[str, _DeploymentState] = {}
         self._factories: Dict[str, Callable] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_checkpoint: Optional[str] = None
+        # True once this controller was deposed (lease lost / stale-epoch
+        # write rejected): it must stop acting as leader, permanently.
+        self._fenced = False
         # Structured decision ring (scheduler/audit.py): deploys, scale
         # moves, heals, rollouts — surfaced per deployment in status().
         self.audit = AuditLog("serve")
@@ -194,104 +233,144 @@ class ServeController:
         (the reference re-imports deployment code the same way)."""
         self._factories[name] = factory
 
+    def _apply_router_policies(self, router: Router,
+                               config: DeploymentConfig) -> None:
+        """Re-derive the router's gray/hedge policy objects from the
+        deployment config. These are data-plane POLICY, not store-owned
+        state: a failover successor rebuilds them from the persisted
+        config, so bare writes here are correct by construction."""
+        from ray_dynamic_batching_tpu.serve.failover import (
+            HedgeManager,
+            HedgePolicy,
+        )
+        from ray_dynamic_batching_tpu.serve.grayhealth import GrayHealthPolicy
+
+        if config.gray_eject_after != router.gray.policy.eject_after:
+            router.gray.policy = GrayHealthPolicy(
+                eject_after=config.gray_eject_after
+            )
+        if config.hedge_interactive and router.hedge is None:
+            router.hedge = HedgeManager(router, HedgePolicy())
+        elif not config.hedge_interactive and router.hedge is not None:
+            router.hedge.close()
+            router.hedge = None
+
     def deploy(
         self,
         config: DeploymentConfig,
         factory: Optional[Callable] = None,
+        _recovered: bool = False,
     ) -> Router:
+        """``_recovered`` marks the deploy that immediately follows a
+        failover adoption: it re-binds the SAME config, so the restart
+        budget / unhealthy verdict restored by ``_adopt`` must survive
+        (only a genuinely fresh user deploy resets them)."""
         with self._lock:
             if factory is not None:
                 self.register_factory(config.name, factory)
             if config.name not in self._factories:
                 raise KeyError(f"no factory registered for {config.name!r}")
-            from ray_dynamic_batching_tpu.serve.failover import (
-                HedgeManager,
-                HedgePolicy,
-            )
+            from ray_dynamic_batching_tpu.serve.failover import HedgePolicy
             from ray_dynamic_batching_tpu.serve.grayhealth import (
                 GrayHealthPolicy,
             )
 
             state = self._deployments.get(config.name)
-            if state is None:
-                state = _DeploymentState(
-                    config=config,
-                    factory=self._factories[config.name],
-                    router=Router(
-                        config.name,
-                        gray_policy=GrayHealthPolicy(
-                            eject_after=config.gray_eject_after
-                        ),
-                        hedge_policy=(HedgePolicy()
-                                      if config.hedge_interactive
-                                      else None),
-                    ),
-                )
-                # Breaker trip/recover events are control-plane decisions:
-                # they share the controller's audit ring with heals and
-                # scale moves (one timeline per deployment).
-                state.router.audit = self.audit
-                self._deployments[config.name] = state
-            else:
-                # Deliver user_config only when it CHANGED (including a
-                # change TO {} — clearing must reach the hook): the user's
-                # reconfigure can be expensive (weight reloads) and must
-                # not re-run because an unrelated knob moved.
-                prev_user = state.config.user_config
-                prev_version = state.config.version
-                state.config = config
-                # Gray/hedge knobs live on the ROUTER, not the replicas:
-                # a redeploy must reprice them here or status() reports
-                # the new config while the router keeps enforcing the
-                # old policy until the next controller restart.
-                router = state.router
-                if config.gray_eject_after != router.gray.policy.eject_after:
-                    router.gray.policy = GrayHealthPolicy(
-                        eject_after=config.gray_eject_after
+            with self.store.txn() as txn:
+                if state is None:
+                    router = (self.catalog.router(config.name)
+                              if self.catalog is not None else None)
+                    if router is None:
+                        router = Router(
+                            config.name,
+                            gray_policy=GrayHealthPolicy(
+                                eject_after=config.gray_eject_after
+                            ),
+                            hedge_policy=(HedgePolicy()
+                                          if config.hedge_interactive
+                                          else None),
+                        )
+                    else:
+                        # Adopted (failover): reprice its policies from
+                        # THIS config — the live object may carry the old
+                        # leader's knobs.
+                        self._apply_router_policies(router, config)
+                    state = _DeploymentState(
+                        config=config,
+                        factory=self._factories[config.name],
+                        router=router,
                     )
-                if config.hedge_interactive and router.hedge is None:
-                    router.hedge = HedgeManager(router, HedgePolicy())
-                elif not config.hedge_interactive and router.hedge is not None:
-                    router.hedge.close()
-                    router.hedge = None
-                # A redeploy may carry NEW code: future replica starts
-                # (rollout replacements included) must build from the
-                # freshly registered factory, not the one captured at
-                # first deploy.
-                state.factory = self._factories[config.name]
-                state.restarts = 0  # a fresh deploy resets the budget
-                state.unhealthy = False
-                if config.version and config.version != prev_version:
-                    # Version change -> ROLLING update: old-version
-                    # replicas keep serving as-is until _reconcile retires
-                    # them in bounded batches (pushing the new config into
-                    # doomed replicas would run expensive reconfigures
-                    # twice and blur which version produced a response).
-                    logger.info(
-                        "%s: rolling update %r -> %r over %d replicas",
-                        config.name, prev_version, config.version,
-                        len(state.replicas),
+                    # Breaker trip/recover events are control-plane
+                    # decisions: they share the controller's audit ring
+                    # with heals and scale moves (one timeline per
+                    # deployment).
+                    state.router.audit = self.audit
+                    self._deployments[config.name] = state
+                    if self.catalog is not None:
+                        self.catalog.register_router(config.name,
+                                                     state.router)
+                else:
+                    # Deliver user_config only when it CHANGED (including a
+                    # change TO {} — clearing must reach the hook): the
+                    # user's reconfigure can be expensive (weight reloads)
+                    # and must not re-run because an unrelated knob moved.
+                    prev_user = state.config.user_config
+                    prev_version = state.config.version
+                    state.config = config
+                    # Gray/hedge knobs live on the ROUTER, not the
+                    # replicas: a redeploy must reprice them here or
+                    # status() reports the new config while the router
+                    # keeps enforcing the old policy until the next
+                    # controller restart.
+                    self._apply_router_policies(state.router, config)
+                    # A redeploy may carry NEW code: future replica starts
+                    # (rollout replacements included) must build from the
+                    # freshly registered factory, not the one captured at
+                    # first deploy.
+                    state.factory = self._factories[config.name]
+                    if not _recovered:
+                        # a fresh deploy resets the budget
+                        state.restarts = 0
+                        state.unhealthy = False
+                    if config.version and config.version != prev_version:
+                        # Version change -> ROLLING update: old-version
+                        # replicas keep serving as-is until _reconcile
+                        # retires them in bounded batches (pushing the new
+                        # config into doomed replicas would run expensive
+                        # reconfigures twice and blur which version
+                        # produced a response).
+                        logger.info(
+                            "%s: rolling update %r -> %r over %d replicas",
+                            config.name, prev_version, config.version,
+                            len(state.replicas),
+                        )
+                    else:
+                        # Push changed batching/concurrency knobs to
+                        # RUNNING replicas (otherwise re-deploys silently
+                        # produce a mixed-config replica set).
+                        for r in state.replicas:
+                            r.reconfigure(
+                                max_batch_size=config.max_batch_size,
+                                batch_wait_timeout_s=(
+                                    config.batch_wait_timeout_s
+                                ),
+                                max_ongoing_requests=(
+                                    config.max_ongoing_requests
+                                ),
+                                user_config=(
+                                    config.user_config
+                                    if config.user_config != prev_user
+                                    else None
+                                ),
+                            )
+                if config.autoscaling is not None:
+                    state.policy = AutoscalingPolicy(
+                        config.autoscaling, interval_s=self.control_interval_s
                     )
                 else:
-                    # Push changed batching/concurrency knobs to RUNNING
-                    # replicas (otherwise re-deploys silently produce a
-                    # mixed-config replica set).
-                    for r in state.replicas:
-                        r.reconfigure(
-                            max_batch_size=config.max_batch_size,
-                            batch_wait_timeout_s=config.batch_wait_timeout_s,
-                            max_ongoing_requests=config.max_ongoing_requests,
-                            user_config=(
-                                config.user_config
-                                if config.user_config != prev_user else None
-                            ),
-                        )
-            if config.autoscaling is not None:
-                state.policy = AutoscalingPolicy(
-                    config.autoscaling, interval_s=self.control_interval_s
-                )
-            else:
-                state.policy = None  # autoscaling removed -> pin num_replicas
+                    # autoscaling removed -> pin num_replicas
+                    state.policy = None
+                self._persist(txn, state)
             self.admission.configure(
                 config.name,
                 AdmissionPolicy(rate_rps=config.admission_rate_rps,
@@ -315,12 +394,20 @@ class ServeController:
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
-            state = self._deployments.pop(name, None)
-            if state is None:
-                return
+            with self.store.txn() as txn:
+                state = self._deployments.pop(name, None)
+                if state is None:
+                    return
+                txn.delete(STORE_CONFIG_KEY.format(deployment=name))
+                txn.delete(STORE_REGISTRY_KEY.format(deployment=name))
+                txn.delete(STORE_GOVERNOR_KEY.format(deployment=name))
+                txn.delete(STORE_GRAY_KEY.format(deployment=name))
+                victims = state.replicas
+                state.replicas = []
+            if self.catalog is not None:
+                # A redeploy must never adopt this CLOSED router.
+                self.catalog.unregister_router(name)
             self.admission.configure(name, None)
-            victims = state.replicas
-            state.replicas = []
             self._publish(state)
             state.router.close()
             self._checkpoint()
@@ -343,11 +430,33 @@ class ServeController:
         with self._lock:
             return sorted(self._deployments)
 
+    # --- durable mirror (store transactions) ------------------------------
+    def _persist(self, txn, state: _DeploymentState) -> None:
+        """Write one deployment's durable mirror into the open
+        transaction. Canonical JSON + the txn's no-op elision keep the
+        steady-state control loop from appending anything to the log."""
+        cfg = state.config
+        txn.put_json(STORE_CONFIG_KEY.format(deployment=cfg.name),
+                     cfg.to_json())
+        txn.put_json(STORE_REGISTRY_KEY.format(deployment=cfg.name), {
+            "ids": [r.replica_id for r in state.replicas],
+            "versions": {r.replica_id: getattr(r, "version", "")
+                         for r in state.replicas},
+            "ordinal": state.next_replica_ordinal,
+            "restarts": state.restarts,
+            "unhealthy": state.unhealthy,
+            "reserved_chips": sorted(state.pgroups),
+        })
+
     # --- state machine (ref deployment_state.py scale/heal) ---------------
     def _start_replica(self, state: _DeploymentState) -> Replica:
         cfg = state.config
-        rid = f"{cfg.name}#{state.next_replica_ordinal}"
-        state.next_replica_ordinal += 1
+        with self.store.txn() as txn:
+            rid = f"{cfg.name}#{state.next_replica_ordinal}"
+            state.next_replica_ordinal += 1
+            # The ordinal is durable: a failover successor must never
+            # mint a replica id the old leader already used.
+            self._persist(txn, state)
         # Gang-acquire chips BEFORE building the replica (ref: the
         # deployment scheduler waits on the PG, then places the actor in it
         # — deployment_scheduler.py / gcs_placement_group_scheduler.cc).
@@ -399,10 +508,19 @@ class ServeController:
                 self.placement.remove(pg)
             raise
         if pg is not None:
-            state.pgroups[rid] = pg
+            with self.store.txn() as txn:
+                state.pgroups[rid] = pg
+                self._persist(txn, state)
+            if self.catalog is not None:
+                # The reservation survives controller death WITH its
+                # replica: a failover successor re-binds it in _adopt so
+                # retiring the adopted replica still frees the chips.
+                self.catalog.register_pgroup(rid, pg)
         # Stamp the config version the replica was BUILT from: the rollout
         # stage retires replicas whose stamp differs from the target.
         replica.version = cfg.version
+        if self.catalog is not None:
+            self.catalog.register_replica(rid, replica)
         logger.info(
             "started replica %s%s%s", rid,
             f" (version {cfg.version!r})" if cfg.version else "",
@@ -414,6 +532,9 @@ class ServeController:
         pg = state.pgroups.pop(replica.replica_id, None)
         if pg is not None and self.placement is not None:
             self.placement.remove(pg)
+        if self.catalog is not None:
+            self.catalog.unregister_replica(replica.replica_id)
+            self.catalog.unregister_pgroup(replica.replica_id)
 
     def _redeliver(
         self,
@@ -429,183 +550,212 @@ class ServeController:
         marks a crashed/wedged victim (heal) vs a planned rollout."""
         router.requeue_drained(requests, victim_id, dead=dead)
 
-    def _reconcile(self, state: _DeploymentState) -> List[Callable[[], None]]:
+    def _reconcile(
+        self,
+        state: _DeploymentState,
+        deferred: Optional[List[Callable[[], None]]] = None,
+    ) -> List[Callable[[], None]]:
         """Drive actual replica count to target; replace unhealthy.
 
-        Returns deferred (blocking) stop actions — callers run them AFTER
-        releasing the controller lock, so a slow drain or a wedged callable
-        can't freeze the whole control plane."""
+        Collects deferred (blocking) stop actions into ``deferred`` (the
+        caller's list when given) and returns it — callers run them AFTER
+        releasing the controller lock, so a slow drain or a wedged
+        callable can't freeze the whole control plane. Collecting into
+        the CALLER'S list matters on the fencing path: a StaleEpochError
+        from a mid-reconcile commit propagates, but the stop/release
+        actions already collected must still run (their victims are
+        already out of the routing set — leaking their threads and chips
+        helps nobody, least of all the successor). The whole pass is one
+        store transaction: the durable mirror commits exactly once per
+        reconcile, and only when something changed."""
         cfg = state.config
-        deferred: List[Callable[[], None]] = []
-        # Heal: replace dead replicas up to max_restarts
-        # (ref gcs_actor_manager.cc:1361-1393 restart budget). A replica
-        # the gray-health monitor EJECTED (sustained straggling through
-        # its whole probation) rides the same path: replaced like a dead
-        # one, so the planner reclaims the chip from gray failures too.
-        alive: List[Replica] = []
-        for r in state.replicas:
-            ejected = state.router.gray.state(r.replica_id) == "ejected"
-            if r.healthy() and not ejected:
-                alive.append(r)
-                continue
-            logger.warning(
-                "replica %s %s; replacing", r.replica_id,
-                "gray-ejected (straggler)" if ejected else "unhealthy",
-            )
-            # Salvage queued work, then stop the victim INLINE (its loop is
-            # dead or wedged, so the join is bounded) — the replacement may
-            # land on the same chips, which must be genuinely free: chip
-            # reservation released AND, for engines, HBM buffers dropped
-            # (LLMReplica.stop releases them once the loop has exited).
-            salvaged = r.drain_queue()
-            r.stop(timeout_s=2.0, drain=False)
-            self._release_chips(state, r)
-            replacement: Optional[Replica] = None
-            if state.restarts < cfg.max_restarts:
-                state.restarts += 1
-                try:
-                    replacement = self._start_replica(state)
-                    alive.append(replacement)
-                except PlacementError as e:
-                    # Transient chip shortage is not a crash: hand the
-                    # restart back and let a later control step retry via
-                    # the scale-up loop below.
-                    state.restarts -= 1
-                    logger.warning(
-                        "%s: replacement blocked: %s", cfg.name, e
-                    )
-                except Exception:  # noqa: BLE001 — a failing start must not
-                    # abort the control step (deferred redeliveries of other
-                    # replicas would be dropped); the burned restart counts,
-                    # so a crash-looping factory still exhausts its budget.
-                    logger.exception(
-                        "%s: replacement start failed", cfg.name
-                    )
-            else:
-                state.unhealthy = True
-                logger.error(
-                    "%s: restart budget (%d) exhausted; deployment "
-                    "unhealthy until redeployed",
-                    cfg.name, cfg.max_restarts,
+        if deferred is None:
+            deferred = []
+        with self.store.txn() as txn:
+            # Heal: replace dead replicas up to max_restarts
+            # (ref gcs_actor_manager.cc:1361-1393 restart budget). A replica
+            # the gray-health monitor EJECTED (sustained straggling through
+            # its whole probation) rides the same path: replaced like a dead
+            # one, so the planner reclaims the chip from gray failures too.
+            alive: List[Replica] = []
+            for r in state.replicas:
+                ejected = state.router.gray.state(r.replica_id) == "ejected"
+                if r.healthy() and not ejected:
+                    alive.append(r)
+                    continue
+                logger.warning(
+                    "replica %s %s; replacing", r.replica_id,
+                    "gray-ejected (straggler)" if ejected else "unhealthy",
                 )
-            if salvaged:
-                deferred.append(
-                    lambda reqs=salvaged, rt=state.router, vid=r.replica_id: (
-                        self._redeliver(rt, reqs, vid, dead=True)
+                # Salvage queued work, then stop the victim INLINE (its
+                # loop is dead or wedged, so the join is bounded) — the
+                # replacement may land on the same chips, which must be
+                # genuinely free: chip reservation released AND, for
+                # engines, HBM buffers dropped (LLMReplica.stop releases
+                # them once the loop has exited).
+                salvaged = r.drain_queue()
+                r.stop(timeout_s=2.0, drain=False)
+                self._release_chips(state, r)
+                replacement: Optional[Replica] = None
+                if state.restarts < cfg.max_restarts:
+                    state.restarts += 1
+                    try:
+                        replacement = self._start_replica(state)
+                        alive.append(replacement)
+                    except StaleEpochError:
+                        # A fenced write means this controller was
+                        # deposed: it must STOP mutating, not log-and-
+                        # continue — re-raise past the broad handler so
+                        # _on_fenced runs (the split-brain guard).
+                        raise
+                    except PlacementError as e:
+                        # Transient chip shortage is not a crash: hand the
+                        # restart back and let a later control step retry
+                        # via the scale-up loop below.
+                        state.restarts -= 1
+                        logger.warning(
+                            "%s: replacement blocked: %s", cfg.name, e
+                        )
+                    except Exception:  # noqa: BLE001 — a failing start must
+                        # not abort the control step (deferred redeliveries
+                        # of other replicas would be dropped); the burned
+                        # restart counts, so a crash-looping factory still
+                        # exhausts its budget.
+                        logger.exception(
+                            "%s: replacement start failed", cfg.name
+                        )
+                else:
+                    state.unhealthy = True
+                    logger.error(
+                        "%s: restart budget (%d) exhausted; deployment "
+                        "unhealthy until redeployed",
+                        cfg.name, cfg.max_restarts,
                     )
+                if salvaged:
+                    deferred.append(
+                        lambda reqs=salvaged, rt=state.router,
+                        vid=r.replica_id: (
+                            self._redeliver(rt, reqs, vid, dead=True)
+                        )
+                    )
+                self.audit.record(
+                    "heal",
+                    key=cfg.name,
+                    observed={"unhealthy": r.replica_id,
+                              "gray_ejected": ejected,
+                              "salvaged_requests": len(salvaged)},
+                    diff={
+                        "replaced": r.replica_id,
+                        "replacement": (replacement.replica_id
+                                        if replacement is not None else None),
+                    },
+                    note=("" if replacement is not None
+                          else "restart budget exhausted or start failed"),
                 )
-            self.audit.record(
-                "heal",
-                key=cfg.name,
-                observed={"unhealthy": r.replica_id,
-                          "gray_ejected": ejected,
-                          "salvaged_requests": len(salvaged)},
-                diff={
-                    "replaced": r.replica_id,
-                    "replacement": (replacement.replica_id
-                                    if replacement is not None else None),
-                },
-                note=("" if replacement is not None
-                      else "restart budget exhausted or start failed"),
-            )
-        state.replicas = alive
-        # Rolling update (ref deployment_state.py rollout): while replicas
-        # with a DIFFERENT version stamp exist, retire them in batches of
-        # at most ceil(rolling_max_unavailable_fraction * target) — and
-        # only as many as keep the serving set at or above
-        # target - batch, so both versions serve through the rollout and
-        # unavailability stays bounded. Retired replicas drain in the
-        # deferred stop (graceful: in-flight work finishes); the scale-up
-        # loop below starts their new-version replacements this same pass.
-        if cfg.version and not state.unhealthy:
-            outdated = [
-                r for r in state.replicas
-                if getattr(r, "version", "") != cfg.version
-            ]
-            if outdated:
-                batch = max(
-                    1, math.ceil(
-                        cfg.rolling_max_unavailable_fraction
-                        * cfg.num_replicas
-                    ),
-                )
-                floor = cfg.num_replicas - batch
-                can_stop = max(0, len(state.replicas) - floor)
-                for victim in outdated[: min(batch, can_stop)]:
-                    state.replicas.remove(victim)
-                    logger.info(
-                        "rolling out replica %s (version %r -> %r)",
-                        victim.replica_id,
-                        getattr(victim, "version", ""), cfg.version,
+            state.replicas = alive
+            # Rolling update (ref deployment_state.py rollout): while
+            # replicas with a DIFFERENT version stamp exist, retire them in
+            # batches of at most
+            # ceil(rolling_max_unavailable_fraction * target) — and only as
+            # many as keep the serving set at or above target - batch, so
+            # both versions serve through the rollout and unavailability
+            # stays bounded. Retired replicas drain in the deferred stop
+            # (graceful: in-flight work finishes); the scale-up loop below
+            # starts their new-version replacements this same pass.
+            if cfg.version and not state.unhealthy:
+                outdated = [
+                    r for r in state.replicas
+                    if getattr(r, "version", "") != cfg.version
+                ]
+                if outdated:
+                    batch = max(
+                        1, math.ceil(
+                            cfg.rolling_max_unavailable_fraction
+                            * cfg.num_replicas
+                        ),
                     )
-                    self.audit.record(
-                        "rolling_update",
-                        key=cfg.name,
-                        before={"version": getattr(victim, "version", "")},
-                        after={"version": cfg.version},
-                        diff={"retired": victim.replica_id},
-                    )
-                    victim._stopped = True  # stale handles stop assigning
-                    # Same salvage discipline as the heal path: queued
-                    # (unstarted) requests move to surviving/new replicas
-                    # immediately instead of gambling on the victim's drain
-                    # window; only the in-flight batch finishes on the
-                    # victim, with a rollout-sized timeout (a busy LLM
-                    # replica's batch can legitimately run tens of
-                    # seconds — the default 5 s drain would reject it).
-                    salvaged = victim.drain_queue()
-                    if salvaged:
+                    floor = cfg.num_replicas - batch
+                    can_stop = max(0, len(state.replicas) - floor)
+                    for victim in outdated[: min(batch, can_stop)]:
+                        state.replicas.remove(victim)
+                        logger.info(
+                            "rolling out replica %s (version %r -> %r)",
+                            victim.replica_id,
+                            getattr(victim, "version", ""), cfg.version,
+                        )
+                        self.audit.record(
+                            "rolling_update",
+                            key=cfg.name,
+                            before={"version": getattr(victim, "version", "")},
+                            after={"version": cfg.version},
+                            diff={"retired": victim.replica_id},
+                        )
+                        victim._stopped = True  # stale handles stop assigning
+                        # Same salvage discipline as the heal path: queued
+                        # (unstarted) requests move to surviving/new replicas
+                        # immediately instead of gambling on the victim's
+                        # drain window; only the in-flight batch finishes on
+                        # the victim, with a rollout-sized timeout (a busy
+                        # LLM replica's batch can legitimately run tens of
+                        # seconds — the default 5 s drain would reject it).
+                        salvaged = victim.drain_queue()
+                        if salvaged:
+                            deferred.append(
+                                lambda reqs=salvaged, rt=state.router,
+                                vid=victim.replica_id: (
+                                    self._redeliver(rt, reqs, vid)
+                                )
+                            )
                         deferred.append(
-                            lambda reqs=salvaged, rt=state.router,
-                            vid=victim.replica_id: (
-                                self._redeliver(rt, reqs, vid)
+                            lambda v=victim, st=state: (
+                                v.stop(timeout_s=60.0),
+                                self._release_chips(st, v),
                             )
                         )
-                    deferred.append(
-                        lambda v=victim, st=state: (
-                            v.stop(timeout_s=60.0),
-                            self._release_chips(st, v),
-                        )
+            # Scale to target — but an exhausted restart budget stops the
+            # crash-loop: no replacements until a fresh deploy() resets it
+            # (ref gcs_actor_manager.cc:1361-1393 — actors stay DEAD once
+            # max_restarts is spent).
+            n_before_scale = len(state.replicas)
+            while len(state.replicas) < cfg.num_replicas \
+                    and not state.unhealthy:
+                try:
+                    state.replicas.append(self._start_replica(state))
+                except StaleEpochError:
+                    raise  # deposed: stop mutating (see heal path note)
+                except PlacementError as e:
+                    # Not enough chips: hold at the current count and retry
+                    # on later control steps (ref: the PG stays pending).
+                    logger.warning("%s: scale-up blocked: %s", cfg.name, e)
+                    break
+                except Exception:  # noqa: BLE001 — hold and retry next step
+                    logger.exception("%s: replica start failed", cfg.name)
+                    break
+            while len(state.replicas) > cfg.num_replicas:
+                victim = state.replicas.pop()  # newest first, ref compact
+                deferred.append(
+                    lambda v=victim, st=state: (
+                        v.stop(),
+                        self._release_chips(st, v),
                     )
-        # Scale to target — but an exhausted restart budget stops the
-        # crash-loop: no replacements until a fresh deploy() resets it
-        # (ref gcs_actor_manager.cc:1361-1393 — actors stay DEAD once
-        # max_restarts is spent).
-        n_before_scale = len(state.replicas)
-        while len(state.replicas) < cfg.num_replicas and not state.unhealthy:
-            try:
-                state.replicas.append(self._start_replica(state))
-            except PlacementError as e:
-                # Not enough chips: hold at the current count and retry on
-                # later control steps (ref: the PG stays pending).
-                logger.warning("%s: scale-up blocked: %s", cfg.name, e)
-                break
-            except Exception:  # noqa: BLE001 — hold and retry next step
-                logger.exception("%s: replica start failed", cfg.name)
-                break
-        while len(state.replicas) > cfg.num_replicas:
-            victim = state.replicas.pop()  # newest first, ref compact strategy
-            deferred.append(
-                lambda v=victim, st=state: (
-                    v.stop(),
-                    self._release_chips(st, v),
                 )
-            )
-        if len(state.replicas) != n_before_scale:
-            self.audit.record(
-                "scale",
-                key=cfg.name,
-                observed={"target": cfg.num_replicas},
-                before={"replicas": n_before_scale},
-                after={"replicas": len(state.replicas)},
-                diff={"delta": len(state.replicas) - n_before_scale},
-            )
-        # Publish only on membership change: every publish clears the
-        # router's queue-len cache, so steady-state reconciles must be quiet.
-        if [r.replica_id for r in state.replicas] != [
-            r.replica_id for r in state.router.replicas()
-        ]:
-            self._publish(state)  # routing stops before deferred drains run
+            if len(state.replicas) != n_before_scale:
+                self.audit.record(
+                    "scale",
+                    key=cfg.name,
+                    observed={"target": cfg.num_replicas},
+                    before={"replicas": n_before_scale},
+                    after={"replicas": len(state.replicas)},
+                    diff={"delta": len(state.replicas) - n_before_scale},
+                )
+            # Publish only on membership change: every publish clears the
+            # router's queue-len cache, so steady-state reconciles must be
+            # quiet.
+            if [r.replica_id for r in state.replicas] != [
+                r.replica_id for r in state.router.replicas()
+            ]:
+                self._publish(state)  # routing stops before deferred drains
+            self._persist(txn, state)
         return deferred
 
     def _publish(self, state: _DeploymentState) -> None:
@@ -650,32 +800,143 @@ class ServeController:
                 continue
         self.admission.observe(state.config.name, depth_frac, compliance)
 
+    def _publish_prefix_digests(self, state: "_DeploymentState") -> None:
+        """Collect each replica's bounded prefix-page digest chains and
+        push them to the router's digest directory (+ the long-poll
+        channel, so out-of-process routers ride the same mechanism as
+        replica-set changes). Cluster-wide prefix routing (ISSUE 11):
+        the router scores candidates by longest matching digest chain
+        before the pow-2 pick."""
+        directory = getattr(state.router, "digests", None)
+        if directory is None:
+            return
+        changed = False
+        for r in state.replicas:
+            fn = getattr(r, "prefix_digests", None)
+            if fn is None:
+                continue
+            try:
+                pub = fn()
+            except Exception:  # noqa: BLE001 — stats must not stop control
+                continue
+            if pub and directory.publish(
+                r.replica_id, pub["page_size"], pub["digests"]
+            ):
+                changed = True
+        if changed:
+            self.long_poll.notify_changed(
+                PREFIX_DIGEST_KEY.format(deployment=state.config.name),
+                directory.snapshot(),
+            )
+
+    def _renew_leadership(self) -> bool:
+        """Heartbeat the store lease. A lapsed-but-UNCLAIMED lease (a
+        long reconcile outran the renew cadence, nobody took over) is
+        re-acquired by the same owner — same epoch, no fence, the
+        control plane must not self-destruct with no successor. Only a
+        lease another owner actually TOOK fences this controller
+        permanently."""
+        if self._fenced:
+            return False
+        if isinstance(self.store, ReplicatedStore):
+            if not self.store.renew():
+                if self.store.acquire_leadership() is None:
+                    self._on_fenced(None)
+                    return False
+                logger.warning(
+                    "lease lapsed unclaimed; re-acquired at epoch %d",
+                    self.store.epoch,
+                )
+        return True
+
+    def _on_fenced(self, exc: Optional[StaleEpochError]) -> None:
+        self._fenced = True
+        self._stop.set()
+        epoch = getattr(self.store, "epoch", 0)
+        fence = getattr(getattr(self.store, "log", None), "fence_epoch",
+                        epoch)
+        logger.error(
+            "controller fenced at epoch %d (log fence %d): a standby took "
+            "over; this instance stops leading%s",
+            epoch, fence, f" ({exc})" if exc is not None else "",
+        )
+        self.audit.record(
+            "store_fenced",
+            observed={"epoch": epoch, "fence": fence},
+            note="lease lost or stale-epoch write rejected; control loop "
+                 "stopped",
+        )
+
     def _control_step(self) -> None:
+        if not self._renew_leadership():
+            return
+        # Deferred stop/release actions run even if the step is fenced
+        # mid-way: their victims are already unpublished and (where a
+        # txn committed) out of the durable registry, so skipping them
+        # would leak replica threads, HBM, and chip reservations that no
+        # successor will ever reclaim.
         deferred: List[Callable[[], None]] = []
-        with self._lock:
-            for state in list(self._deployments.values()):
-                self._observe_gray(state)
-                self._observe_admission(state)
-                if state.policy is not None:
-                    metrics = state.router.demand_metrics()
-                    target = state.policy.step(
-                        metrics["total_ongoing"], len(state.replicas)
-                    )
-                    if target is not None and target != state.config.num_replicas:
-                        logger.info(
-                            "%s: autoscale %d -> %d (ongoing=%.0f)",
-                            state.config.name, state.config.num_replicas,
-                            target, metrics["total_ongoing"],
+        try:
+            with self._lock:
+                for state in list(self._deployments.values()):
+                    self._observe_gray(state)
+                    self._observe_admission(state)
+                    self._publish_prefix_digests(state)
+                    if state.policy is not None:
+                        metrics = state.router.demand_metrics()
+                        target = state.policy.step(
+                            metrics["total_ongoing"], len(state.replicas)
                         )
-                        state.config.num_replicas = target
-                try:
-                    deferred.extend(self._reconcile(state))
-                except Exception:  # noqa: BLE001 — one deployment's failure
-                    # must not drop other deployments' deferred actions
-                    logger.exception(
-                        "%s: reconcile failed", state.config.name
-                    )
-            self._checkpoint()
+                        if target is not None \
+                                and target != state.config.num_replicas:
+                            logger.info(
+                                "%s: autoscale %d -> %d (ongoing=%.0f)",
+                                state.config.name,
+                                state.config.num_replicas,
+                                target, metrics["total_ongoing"],
+                            )
+                            with self.store.txn() as txn:
+                                state.config.num_replicas = target
+                                self._persist(txn, state)
+                    with self.store.txn() as txn:
+                        # Durable governor/gray mirrors (elided unless a
+                        # state actually changed). The governor mirror is
+                        # READ BACK by recover(): a failover successor
+                        # keeps enforcing the degraded-mode contract
+                        # instead of re-admitting the flood. The gray
+                        # mirror is observability — live verdicts ride
+                        # the ADOPTED router's monitor object; this is
+                        # the durable record of what was declared.
+                        txn.put_json(
+                            STORE_GOVERNOR_KEY.format(
+                                deployment=state.config.name
+                            ),
+                            {"state": ("degraded" if self.admission.degraded(
+                                state.config.name) else "normal")},
+                        )
+                        txn.put_json(
+                            STORE_GRAY_KEY.format(
+                                deployment=state.config.name
+                            ),
+                            state.router.gray.states(),
+                        )
+                    try:
+                        self._reconcile(state, deferred)
+                    except StaleEpochError:
+                        # The fence outranks per-deployment isolation: a
+                        # deposed leader must stop the WHOLE step, not
+                        # shrug one deployment off and mutate the next —
+                        # re-raise to the fencing handler below.
+                        raise
+                    except Exception:  # noqa: BLE001 — one deployment's
+                        # failure must not drop other deployments' deferred
+                        # actions
+                        logger.exception(
+                            "%s: reconcile failed", state.config.name
+                        )
+                self._checkpoint()
+        except StaleEpochError as e:
+            self._on_fenced(e)  # falls through: deferred still runs
         for action in deferred:  # blocking stops run outside the lock
             action()
 
@@ -695,6 +956,17 @@ class ServeController:
         )
         self._thread.start()
 
+    def crash(self) -> None:
+        """Chaos/test harness: kill the control loop WITHOUT draining the
+        data plane — the in-process analogue of controller death.
+        Replicas, routers and in-flight requests keep running; the lease
+        simply stops being renewed, so a standby (sharing the replicated
+        store's log + lease) takes over when it lapses."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
     def shutdown(self) -> None:
         self._stop.set()
         if self._thread is not None:
@@ -702,10 +974,21 @@ class ServeController:
             self._thread = None
         with self._lock:
             victims: List[Tuple[_DeploymentState, Replica]] = []
-            for state in self._deployments.values():
-                victims.extend((state, r) for r in state.replicas)
-                state.replicas = []
-                state.router.close()
+            try:
+                with self.store.txn() as txn:
+                    for state in self._deployments.values():
+                        victims.extend((state, r) for r in state.replicas)
+                        state.replicas = []
+                        state.router.close()
+                        self._persist(txn, state)
+            except StaleEpochError:
+                # A deposed controller still tears down its local
+                # references; the durable mirror belongs to the NEW
+                # leader now (its registry is the truth).
+                logger.warning(
+                    "shutdown on a deposed controller: durable mirror "
+                    "left to the current leader"
+                )
         for state, r in victims:
             r.stop()
             self._release_chips(state, r)
@@ -720,18 +1003,110 @@ class ServeController:
             sort_keys=True,
         )
         # Checkpoint-on-change: steady-state control steps must not rewrite
-        # the KV file twice a second.
+        # the KV file twice a second. (Legacy mirror — the store's
+        # per-deployment keys are the authoritative durable state now;
+        # this kv blob keeps pre-store restart flows working.)
         if payload != self._last_checkpoint:
             self.kv.put(CHECKPOINT_KEY, payload)
             self._last_checkpoint = payload
 
+    def _adopt(self, name: str, cfg: DeploymentConfig) -> None:
+        """Failover adoption: re-bind the live router and the surviving
+        replicas recorded in the store instead of cold-starting the
+        world. Only replicas recorded but missing (or unhealthy) get
+        restarted — by the deploy/reconcile pass that follows."""
+        registry = self.store.get_json(
+            STORE_REGISTRY_KEY.format(deployment=name)
+        ) or {}
+        router = self.catalog.router(name) if self.catalog else None
+        if router is None:
+            return  # nothing live to adopt: deploy() cold-starts
+        with self._lock:
+            with self.store.txn() as txn:
+                state = _DeploymentState(
+                    config=cfg, factory=self._factories[name], router=router,
+                )
+                state.next_replica_ordinal = int(registry.get("ordinal", 0))
+                # The health ledger survives the failover: a deployment
+                # the old leader declared unhealthy (restart budget
+                # spent) must NOT resume crash-looping on the successor
+                # — "actors stay DEAD once max_restarts is spent" holds
+                # across leaders.
+                state.restarts = int(registry.get("restarts", 0))
+                state.unhealthy = bool(registry.get("unhealthy", False))
+                adopted: List[Replica] = []
+                for rid in registry.get("ids", []):
+                    r = self.catalog.replica(rid)
+                    if r is None:
+                        continue  # died with the old leader: reconcile
+                        # restarts it from the registry count
+                    # Adopt healthy AND unhealthy survivors: the heal
+                    # pass retires unhealthy ones through its normal
+                    # salvage/stop/release path (dropping them here
+                    # would orphan their queues and chip reservations).
+                    adopted.append(r)
+                    pg = self.catalog.pgroup(rid)
+                    if pg is not None:
+                        state.pgroups[rid] = pg
+                state.replicas = adopted
+                state.router.audit = self.audit
+                self._deployments[name] = state
+                self._persist(txn, state)
+        if adopted:
+            self.audit.record(
+                "failover_adopt",
+                key=name,
+                observed={"epoch": getattr(self.store, "epoch", 0)},
+                diff={"adopted": [r.replica_id for r in adopted]},
+                note="live data plane re-bound after controller failover",
+            )
+
     def recover(self) -> List[str]:
-        """Restore deployments from the checkpoint (factories must already
-        be re-registered). Returns recovered deployment names."""
+        """Restore deployments from the store (factories must already be
+        re-registered); falls back to the legacy kv checkpoint when the
+        store is empty. With a catalog, live replicas/routers recorded in
+        the store are ADOPTED — a controller failover re-binds the
+        running data plane instead of restarting it. Returns recovered
+        deployment names."""
+        if isinstance(self.store, ReplicatedStore):
+            self.store.catch_up()
+        prefix = "serve:deployments/"
+        names = sorted({
+            k[len(prefix):].split("/")[0]
+            for k in self.store.keys(prefix)
+            if k.endswith("/config")
+        })
+        recovered = []
+        if names:
+            for name in names:
+                if name not in self._factories:
+                    logger.warning(
+                        "stored deployment %r has no factory; skipping", name
+                    )
+                    continue
+                cfg = DeploymentConfig.from_json(self.store.get_json(
+                    STORE_CONFIG_KEY.format(deployment=name)
+                ))
+                adopted = False
+                if self.catalog is not None and name not in self._deployments:
+                    self._adopt(name, cfg)
+                    adopted = name in self._deployments
+                self.deploy(cfg, _recovered=adopted)
+                governor = self.store.get_json(
+                    STORE_GOVERNOR_KEY.format(deployment=name)
+                )
+                if governor is not None:
+                    # Keep enforcing the old leader's degraded-mode
+                    # declaration; recovery still exits through the
+                    # normal hysteresis once the flood actually ebbs.
+                    self.admission.force_state(
+                        name, governor.get("state") == "degraded"
+                    )
+                recovered.append(name)
+            return recovered
         raw = self.kv.get(CHECKPOINT_KEY)
         if raw is None:
             return []
-        recovered = []
         for name, cfg_json in json.loads(raw).items():
             if name not in self._factories:
                 logger.warning(
@@ -741,6 +1116,24 @@ class ServeController:
             self.deploy(DeploymentConfig.from_json(cfg_json))
             recovered.append(name)
         return recovered
+
+    def store_status(self) -> Dict[str, Any]:
+        """The replicated-store view: version watermark, leadership epoch,
+        fencing. Separate from the by-name deployment map in status()
+        so dashboard consumers never see a phantom deployment."""
+        out: Dict[str, Any] = {
+            "kind": type(self.store).__name__,
+            "version": self.store.version,
+            "fenced": self._fenced,
+        }
+        if isinstance(self.store, ReplicatedStore):
+            out.update(
+                epoch=self.store.epoch,
+                leader=self.store.is_leader(),
+                log_records=len(self.store.log),
+                rejected_appends=self.store.log.rejected_appends,
+            )
+        return out
 
     def status(self) -> Dict[str, Any]:
         with self._lock:

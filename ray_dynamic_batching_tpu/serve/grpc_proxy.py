@@ -40,7 +40,9 @@ from ray_dynamic_batching_tpu.utils.tracing import parse_traceparent, tracer
 logger = get_logger("grpc_proxy")
 
 GRPC_REQUESTS = m.Counter(
-    "rdb_grpc_requests_total", "gRPC requests", tag_keys=("method", "code")
+    "rdb_grpc_requests_total", "gRPC requests",
+    tag_keys=("method", "code", "shard"),
+    bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K},
 )
 
 try:  # grpcio is present in the image; gate anyway (env contract)
@@ -67,9 +69,13 @@ class GRPCProxy:
         request_timeout_s: float = 60.0,
         max_workers: int = 16,
         admission=None,
+        shard_id: str = "0",
     ) -> None:
         if not HAVE_GRPC:
             raise RuntimeError("grpcio is not installed")
+        # Front-door shard identity (serve/frontdoor.py): tags every gRPC
+        # metric family; "0" is the unsharded default.
+        self.shard_id = str(shard_id)
         # Optional serve.admission.AdmissionController — same instance
         # (and therefore the same buckets/governor state) as the HTTP
         # proxy's, so a tenant cannot dodge its budget by switching doors.
@@ -80,6 +86,10 @@ class GRPCProxy:
         self.request_timeout_s = request_timeout_s
         self._server: Optional["grpc.Server"] = None
         self._max_workers = max_workers
+
+    def _count(self, method: str, code: str) -> None:
+        GRPC_REQUESTS.inc(tags={"method": method, "code": code,
+                                "shard": self.shard_id})
 
     # --- handlers ----------------------------------------------------------
     def _resolve(self, body: dict):
@@ -95,11 +105,11 @@ class GRPCProxy:
         try:
             body = json.loads(request or b"{}")
         except json.JSONDecodeError as e:
-            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "INVALID"})
+            self._count("Predict", "INVALID")
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad JSON: {e}")
         handle, err = self._resolve(body)
         if handle is None:
-            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "NOT_FOUND"})
+            self._count("Predict", "NOT_FOUND")
             context.abort(grpc.StatusCode.NOT_FOUND, err)
         tenant, qos = self._identity(body, context, "Predict")
         # Ingest span for the gRPC front door; a ``traceparent`` field in
@@ -129,15 +139,15 @@ class GRPCProxy:
         try:
             result = future.result(timeout=timeout)
         except TimeoutError:
-            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "DEADLINE"})
+            self._count("Predict", "DEADLINE")
             context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out"
             )
         except Exception as e:  # noqa: BLE001 — status mapping below
             code, status = self._error_status(e)
-            GRPC_REQUESTS.inc(tags={"method": "Predict", "code": code})
+            self._count("Predict", code)
             context.abort(status, str(e))
-        GRPC_REQUESTS.inc(tags={"method": "Predict", "code": "OK"})
+        self._count("Predict", "OK")
         return json.dumps({"result": _to_jsonable(result)}).encode()
 
     def _identity(self, body: dict, context, method: str):
@@ -158,9 +168,7 @@ class GRPCProxy:
         try:
             return tenant, normalize_qos(declared)
         except BadRequest as e:
-            GRPC_REQUESTS.inc(
-                tags={"method": method, "code": "INVALID_ARGUMENT"}
-            )
+            self._count(method, "INVALID_ARGUMENT")
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
     @staticmethod
@@ -184,9 +192,7 @@ class GRPCProxy:
             ok, retry_after_s = self.admission.admit(deployment, tenant, qos)
         if ok:
             return
-        GRPC_REQUESTS.inc(
-            tags={"method": method, "code": "RESOURCE_EXHAUSTED"}
-        )
+        self._count(method, "RESOURCE_EXHAUSTED")
         context.set_trailing_metadata(
             (("retry-after-s", f"{retry_after_s:.3f}"),)
         )
@@ -222,15 +228,11 @@ class GRPCProxy:
         try:
             body = json.loads(request or b"{}")
         except json.JSONDecodeError as e:
-            GRPC_REQUESTS.inc(
-                tags={"method": "PredictStream", "code": "INVALID"}
-            )
+            self._count("PredictStream", "INVALID")
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad JSON: {e}")
         handle, err = self._resolve(body)
         if handle is None:
-            GRPC_REQUESTS.inc(
-                tags={"method": "PredictStream", "code": "NOT_FOUND"}
-            )
+            self._count("PredictStream", "NOT_FOUND")
             context.abort(grpc.StatusCode.NOT_FOUND, err)
         tenant, qos = self._identity(body, context, "PredictStream")
         # Admission inside the request span, same as Predict: the
@@ -268,23 +270,19 @@ class GRPCProxy:
             try:
                 result = future.result(timeout=max(0.001, remaining()))
                 yield json.dumps({"result": _to_jsonable(result)}).encode()
-                GRPC_REQUESTS.inc(
-                    tags={"method": "PredictStream", "code": "OK"}
-                )
+                self._count("PredictStream", "OK")
                 return
             except Exception as e:  # noqa: BLE001
                 error = e
         # Replica/timeout errors terminate the RPC with a real gRPC status
         # (same mapping as Predict), not an OK stream with an error body.
         if isinstance(error, TimeoutError):
-            GRPC_REQUESTS.inc(
-                tags={"method": "PredictStream", "code": "DEADLINE"}
-            )
+            self._count("PredictStream", "DEADLINE")
             context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED, "stream timed out"
             )
         code, status = self._error_status(error)
-        GRPC_REQUESTS.inc(tags={"method": "PredictStream", "code": code})
+        self._count("PredictStream", code)
         context.abort(status, str(error))
 
     def _healthz(self, request: bytes, context) -> bytes:

@@ -214,6 +214,29 @@ class LLMReplica(Replica):
         return (merged.percentile(0.5), merged.percentile(0.95),
                 len(merged))
 
+    def prefix_digests(self, limit: int = 128) -> Optional[dict]:
+        """Bounded prefix-page digest publication merged across this
+        replica's bucket engines (cluster-wide prefix routing, ISSUE 11).
+        The controller collects this each control step and pushes it to
+        the router's digest directory over the long-poll channel."""
+        merged: dict = {}
+        page_size = None
+        for engine in self.engines.values():
+            fn = getattr(engine, "prefix_digests", None)
+            if fn is None:
+                continue
+            pub = fn(limit)
+            if pub is None:
+                continue
+            page_size = pub["page_size"]
+            for key, n in pub["digests"].items():
+                if len(merged) >= limit:
+                    break
+                merged.setdefault(key, n)
+        if page_size is None:
+            return None
+        return {"page_size": page_size, "digests": merged}
+
     # --- router-facing surface --------------------------------------------
     def queue_len(self) -> int:
         return sum(
@@ -316,6 +339,7 @@ class LLMDeployment:
         paged: bool = False,
         page_size: int = 128,
         kv_pool_pages: Optional[int] = None,
+        host_spill_pages: int = 0,
     ) -> None:
         self.model_name = model_name
         self.num_slots = num_slots
@@ -327,6 +351,9 @@ class LLMDeployment:
         self.ttft_horizon = ttft_horizon
         self.max_admissions_per_step = max_admissions_per_step
         self.prefix_cache_size = prefix_cache_size
+        # HBM -> host-RAM spill tier for shed prefix pins (ISSUE 11):
+        # pages of host residency per engine; 0 = off.
+        self.host_spill_pages = host_spill_pages
         # Session rows are PER ENGINE: handle-level affinity steers a
         # session's turns back to the replica holding its row, but a
         # conversation that outgrows its length bucket lands on a larger
@@ -754,6 +781,7 @@ class LLMDeployment:
             paged=self.paged,
             page_size=self.page_size,
             kv_pool_pages=self.kv_pool_pages,
+            host_spill_pages=self.host_spill_pages,
         )
 
     # Controller protocol: factories exposing make_replica own replica

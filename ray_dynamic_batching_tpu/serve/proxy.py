@@ -49,11 +49,14 @@ from ray_dynamic_batching_tpu.utils.tracing import parse_traceparent, tracer
 logger = get_logger("proxy")
 
 PROXY_REQUESTS = m.Counter(
-    "rdb_proxy_requests_total", "HTTP requests", tag_keys=("route", "code")
+    "rdb_proxy_requests_total", "HTTP requests",
+    tag_keys=("route", "code", "shard"),
+    bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K},
 )
 PROXY_LATENCY_MS = m.Histogram(
     "rdb_proxy_request_latency_ms", "End-to-end HTTP request latency",
-    tag_keys=("route",),
+    tag_keys=("route", "shard"),
+    bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K},
 )
 
 MAX_BODY_BYTES = 64 * 1024 * 1024
@@ -111,10 +114,15 @@ class HTTPProxy:
         status_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         request_timeout_s: float = 60.0,
         admission: Optional[Any] = None,
+        shard_id: str = "0",
     ) -> None:
         self.router = router
         self.host = host
         self.port = port
+        # Front-door shard identity (serve/frontdoor.py): tags every
+        # proxy metric family so per-shard load skew is observable; "0"
+        # is the unsharded default.
+        self.shard_id = str(shard_id)
         self.status_fn = status_fn
         self.request_timeout_s = request_timeout_s
         # Optional serve.admission.AdmissionController: consulted BEFORE
@@ -440,7 +448,9 @@ class HTTPProxy:
                 if body is None:  # oversized: answer and drop the connection
                     resp = self._response(413, {"error": "body too large"},
                                           reason="Payload Too Large")
-                    PROXY_REQUESTS.inc(tags={"route": "oversized", "code": "413"})
+                    PROXY_REQUESTS.inc(tags={"route": "oversized",
+                                             "code": "413",
+                                             "shard": self.shard_id})
                     writer.write(resp)
                     await writer.drain()
                     break
@@ -463,9 +473,11 @@ class HTTPProxy:
                     code = resp.split(b" ", 2)[1].decode()
                 if psp is not None:
                     psp.attributes.update(route=route, code=code)
-                PROXY_REQUESTS.inc(tags={"route": route, "code": code})
+                PROXY_REQUESTS.inc(tags={"route": route, "code": code,
+                                         "shard": self.shard_id})
                 PROXY_LATENCY_MS.observe(
-                    m.now_ms() - t_req, tags={"route": route},
+                    m.now_ms() - t_req,
+                    tags={"route": route, "shard": self.shard_id},
                     trace_id=psp.trace_id if psp is not None else None,
                 )
                 if resp is None:

@@ -28,7 +28,9 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
 
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
 from ray_dynamic_batching_tpu.serve.failover import (
@@ -50,12 +52,15 @@ from ray_dynamic_batching_tpu.utils.tracing import tracer
 logger = get_logger("router")
 
 ROUTED_TOTAL = m.Counter(
-    "rdb_router_routed_total", "Requests routed", tag_keys=("deployment",)
+    "rdb_router_routed_total", "Requests routed",
+    tag_keys=("deployment", "shard"),
+    bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K},
 )
 ROUTER_REJECTED = m.Counter(
     "rdb_router_rejected_total",
     "Requests rejected (reason: backoff_exhausted | breaker_open)",
-    tag_keys=("deployment", "reason"),
+    tag_keys=("deployment", "reason", "shard"),
+    bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K},
 )
 
 QUEUE_LEN_CACHE_TTL_S = 0.1          # ref pow_2_scheduler queue-len cache
@@ -218,6 +223,118 @@ class _CachedLen:
         self.at = at
 
 
+class PrefixDigestDirectory:
+    """Cluster-wide prefix index: replica_id -> published digest chains.
+
+    Each replica publishes the digest keys of the prompt-page prefixes
+    its paged pool (HBM prefix cache + host spill tier) can serve —
+    16-byte hashes, never token bytes, bounded per replica. The router
+    scores candidates by the LONGEST chain matching an incoming prompt
+    and prefers the holders before the pow-2 pick, turning the
+    per-replica CoW prefix cache into a cluster-wide tier: prompts
+    sharing a system prefix converge on the replicas whose pools
+    already hold it.
+
+    Expiry is by replacement: every publish supersedes the replica's
+    previous set wholesale (an entry the replica no longer advertises —
+    evicted and not spilled — stops matching immediately), and
+    :meth:`prune` drops departed replicas with the replica set. Thread-
+    safe; reads are lock + dict probes.
+    """
+
+    def __init__(self, max_digests_per_replica: int = 256) -> None:
+        self.max_digests_per_replica = int(max_digests_per_replica)
+        self._lock = threading.Lock()
+        self._page_size: Optional[int] = None
+        # replica_id -> {digest_hex: chain_len}
+        self._by_replica: Dict[str, Dict[str, int]] = {}
+        self.publishes = 0
+
+    def publish(self, replica_id: str, page_size: int,
+                digests: Dict[str, int]) -> bool:
+        """Replace ``replica_id``'s advertised set; returns True when the
+        directory changed (the controller forwards changes — and only
+        changes — over the long-poll channel)."""
+        bounded = dict(list(digests.items())
+                       [: self.max_digests_per_replica])
+        with self._lock:
+            if (self._by_replica
+                    and self._page_size is not None
+                    and page_size != self._page_size):
+                # Mixed page sizes cannot share one digest space: chains
+                # would never match across them. The CURRENT publishers'
+                # size wins; a disagreeing publisher is dropped (it
+                # still serves, just un-steered). Once every publisher
+                # at the old size has left (rolling update to a new
+                # page size), the first new publisher re-anchors it.
+                self._by_replica.pop(replica_id, None)
+                return False
+            self._page_size = int(page_size)
+            if self._by_replica.get(replica_id) == bounded:
+                return False
+            self._by_replica[replica_id] = bounded
+            self.publishes += 1
+            return True
+
+    def prune(self, live: set) -> None:
+        with self._lock:
+            for rid in [r for r in self._by_replica if r not in live]:
+                del self._by_replica[rid]
+
+    def chain_for(self, payload: Any) -> List[str]:
+        """The request's digest chain (hex level keys, deepest last) —
+        empty when the directory is idle or the payload has no tokens
+        spanning a full page. Hashing costs one O(L) pass; skipped
+        entirely while nothing is published."""
+        with self._lock:
+            ps = self._page_size
+            empty = not self._by_replica
+        if empty or ps is None or not isinstance(payload, dict):
+            return []
+        tokens = payload.get("tokens")
+        if not isinstance(tokens, (list, tuple)) or len(tokens) <= ps:
+            return []
+        from ray_dynamic_batching_tpu.engine.paging import digest_chain
+
+        try:
+            arr = np.asarray(tokens, np.int32)
+        except (TypeError, ValueError, OverflowError):
+            # Malformed client tokens must not crash the ROUTING layer —
+            # un-steered routing proceeds and the replica-level
+            # validation rejects the payload the same way it would have
+            # before any digest was ever published.
+            return []
+        if arr.ndim != 1:
+            return []  # nested lists convert, but are not a token row
+        max_n = (arr.size - 1) // ps  # >=1 tail token stays prefillable
+        return [k.hex() for k in digest_chain(arr, ps, max_n)]
+
+    def best(self, chain: List[str],
+             candidate_ids: List[str]) -> Tuple[int, Set[str]]:
+        """(depth, holders): the longest chain level any candidate
+        advertises, and every candidate advertising it. (0, {}) when
+        nothing matches — the caller falls straight through to pow-2."""
+        with self._lock:
+            for depth in range(len(chain), 0, -1):
+                key = chain[depth - 1]
+                holders = {
+                    rid for rid in candidate_ids
+                    if key in self._by_replica.get(rid, ())
+                }
+                if holders:
+                    return depth, holders
+        return 0, set()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "page_size": self._page_size,
+                "replicas": {rid: dict(d)
+                             for rid, d in self._by_replica.items()},
+                "publishes": self.publishes,
+            }
+
+
 class Router:
     """Routes requests for one deployment over its live replica set."""
 
@@ -235,6 +352,18 @@ class Router:
     ) -> None:
         self.deployment = deployment
         self.max_assign_timeout_s = max_assign_timeout_s
+        # Front-door shard identity for metric families. "0" is the
+        # unsharded default; an embedder running a per-shard router tier
+        # (N routers behind N front-door shards) stamps each router with
+        # its shard id so the routed/rejected series split per shard.
+        # The single-router-per-deployment topology this controller
+        # builds keeps the default.
+        self.shard = "0"
+        # Cluster-wide prefix routing (ISSUE 11): per-replica digest
+        # publications, matched against request prompts — longest
+        # matching chain narrows the pow-2 pool to the replicas whose
+        # page pools already hold the prefix.
+        self.digests = PrefixDigestDirectory()
         self._replicas: List[Replica] = list(replicas or [])
         self._lock = threading.Lock()
         self._len_cache: Dict[str, _CachedLen] = {}
@@ -293,6 +422,7 @@ class Router:
             for rid in [b for b in self._breakers if b not in live]:
                 del self._breakers[rid]
         self.gray.prune(live)
+        self.digests.prune(live)
         for r in replicas:
             self._wire(r)
         logger.info(
@@ -400,6 +530,7 @@ class Router:
         candidates: List[Replica],
         locality_hint: Optional[str],
         multiplexed_model_id: Optional[str] = None,
+        digest_chain: Optional[List[str]] = None,
     ) -> Optional[Replica]:
         if not candidates:
             return None
@@ -420,6 +551,20 @@ class Router:
             ]
             if local:
                 candidates = local
+        # Cluster-wide prefix routing: narrow to the replicas advertising
+        # the LONGEST digest chain matching this prompt — their page
+        # pools already hold the prefix, so admission borrows pages
+        # instead of recomputing them. Ties (several replicas at the
+        # same depth) and no-match both fall through to the pow-2 pick
+        # below; a preference must sharpen routing, never starve it.
+        if digest_chain:
+            depth, holders = self.digests.best(
+                digest_chain, [r.replica_id for r in candidates]
+            )
+            if depth > 0:
+                held = [r for r in candidates if r.replica_id in holders]
+                if held:
+                    candidates = held
         now = time.monotonic()
         if len(candidates) == 1:
             chosen = candidates[0]
@@ -450,6 +595,10 @@ class Router:
         with tracer().span(
             "router.assign", deployment=self.deployment, lane=self.deployment
         ) as sp:
+            # Computed ONCE per assignment (one O(L) hash pass), empty
+            # while no replica has published digests — the non-LLM hot
+            # path pays two dict probes.
+            digest_chain = self.digests.chain_for(request.payload)
             attempts = 0
             window_s = min(
                 timeout_s if timeout_s is not None else
@@ -496,7 +645,8 @@ class Router:
                         if self.gray.state(r.replica_id) != "ejected"
                     ] or graded
                 chosen = self._choose(
-                    candidates, locality_hint, request.multiplexed_model_id
+                    candidates, locality_hint, request.multiplexed_model_id,
+                    digest_chain=digest_chain,
                 )
                 # chaos: a dropped assignment RPC — falls into the normal
                 # backoff/retry path, like a lost PushActorTask in the
@@ -518,7 +668,8 @@ class Router:
                         # armed at first assign must follow it.
                         request._assigned_replica = chosen.replica_id
                         self.total_routed += 1
-                        ROUTED_TOTAL.inc(tags={"deployment": self.deployment})
+                        ROUTED_TOTAL.inc(tags={"deployment": self.deployment,
+                                               "shard": self.shard})
                         # A dispatch onto a probationed replica IS its
                         # probe: start the next probe window.
                         self.gray.mark_probe(chosen.replica_id)
@@ -538,7 +689,8 @@ class Router:
                         else "backoff_exhausted"
                     )
                     ROUTER_REJECTED.inc(
-                        tags={"deployment": self.deployment, "reason": reason}
+                        tags={"deployment": self.deployment,
+                              "reason": reason, "shard": self.shard}
                     )
                     exc = RequestDropped(
                         f"{self.deployment}: no replica accepted within "

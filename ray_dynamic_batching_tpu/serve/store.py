@@ -1,0 +1,459 @@
+"""Replicated controller store — the control plane's GCS move.
+
+Re-creates the role Ray's GCS plays for Serve's controller
+(``gcs_server`` owning actor/placement/KV state, Serve checkpointing
+through it so a controller restart is a recovery, not an outage): every
+piece of ``ServeController`` mutable state lives behind a small
+versioned key-value store written through TRANSACTIONS, with two
+implementations:
+
+- :class:`InMemoryStore` — single-process, the default; transactions
+  are atomic batches against a dict (the reference's
+  ``in_memory_store_client``).
+- :class:`ReplicatedStore` — the same surface over a shared append-only
+  :class:`StoreLog` plus a :class:`LeaderLease`. Every transaction
+  commits as one log record stamped with the writer's **epoch**; a
+  standby replays the log to reconstruct the leader's exact state and
+  takes over by acquiring the lease, which BUMPS the epoch and fences
+  the log — the old leader's next commit raises
+  :class:`StaleEpochError` instead of corrupting state it no longer
+  owns (the classic GCS/raft fencing rule: a deposed leader must fail
+  loudly, never write quietly).
+
+Why epoch fencing and not just a lock: the failure mode is a leader
+that is *slow*, not dead — it wakes up after the standby took over and
+tries to finish a half-done reconcile. A lock it still believes it
+holds cannot stop it; a monotone epoch checked at the single append
+point can, atomically, for every key at once.
+
+The transaction API is deliberately tiny (``get``/``put``/``delete``
+staged, committed atomically on context exit, no-op writes elided so a
+steady-state control loop appends nothing) because the lint rule
+``store-discipline`` (tools/lint/store.py) holds the controller to it:
+any bare attribute write to controller-owned state outside a
+``with store.txn() as t:`` block is a finding. The discipline is what
+keeps "replicated store" from rotting back into "a dict plus hope".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_dynamic_batching_tpu.utils.logging import get_logger
+
+logger = get_logger("store")
+
+
+class StaleEpochError(RuntimeError):
+    """A write carried an epoch older than the log's fence: the writer
+    was deposed (its lease expired and a standby acquired leadership).
+    The only correct reaction is to stop acting as leader — retrying
+    would re-submit a decision the new leader may have already
+    contradicted."""
+
+    def __init__(self, message: str, epoch: int, fence: int) -> None:
+        super().__init__(message)
+        self.epoch = epoch
+        self.fence = fence
+
+
+@dataclass
+class LogRecord:
+    """One committed transaction: the unit of replication."""
+
+    index: int                  # position in the log, 0-based, dense
+    epoch: int                  # writer's leadership epoch
+    ops: List[Tuple[str, str, Optional[str]]]  # ("put", k, v) | ("delete", k, None)
+    wall_time: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"index": self.index, "epoch": self.epoch,
+                "ops": [list(op) for op in self.ops],
+                "wall_time": self.wall_time}
+
+
+class StoreLog:
+    """Shared append-only replication substrate with an epoch fence.
+
+    The log is the ONE serialization point between a live leader and a
+    recovering standby: ``append`` atomically checks the writer's epoch
+    against the fence and either commits or raises
+    :class:`StaleEpochError`. ``fence_to`` only ever raises the fence
+    (monotone), so a deposed leader can never re-open its own window.
+    """
+
+    def __init__(self, now: Callable[[], float] = time.time) -> None:
+        self._records: List[LogRecord] = []
+        self._fence_epoch = 0
+        self._lock = threading.Lock()
+        self._now = now
+        self.rejected_appends = 0
+
+    @property
+    def fence_epoch(self) -> int:
+        with self._lock:
+            return self._fence_epoch
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def fence_to(self, epoch: int) -> None:
+        """Raise the fence (monotone): appends below ``epoch`` now fail."""
+        with self._lock:
+            self._fence_epoch = max(self._fence_epoch, int(epoch))
+
+    def append(self, epoch: int,
+               ops: List[Tuple[str, str, Optional[str]]]) -> int:
+        """Commit one transaction's ops at ``epoch``; returns the new
+        record's index. Stale epochs are REJECTED atomically under the
+        same lock that orders commits — there is no window where a
+        deposed leader's record lands between the fence check and the
+        append."""
+        with self._lock:
+            if epoch < self._fence_epoch:
+                self.rejected_appends += 1
+                raise StaleEpochError(
+                    f"append at epoch {epoch} rejected: log fenced at "
+                    f"epoch {self._fence_epoch} (a standby took over; "
+                    "this writer was deposed)",
+                    epoch=epoch, fence=self._fence_epoch,
+                )
+            rec = LogRecord(
+                index=len(self._records), epoch=epoch, ops=list(ops),
+                wall_time=self._now(),
+            )
+            self._records.append(rec)
+            return rec.index
+
+    def read_from(self, index: int) -> List[LogRecord]:
+        with self._lock:
+            return list(self._records[index:])
+
+
+class LeaderLease:
+    """Time-bounded leadership with a monotone epoch.
+
+    ``acquire(owner)`` succeeds when the lease is free, expired, or
+    already held by ``owner``; a NEW holder bumps the epoch. ``renew``
+    extends the current holder's window. The clock is injected so the
+    simulator drives lease expiry on virtual time and the failover test
+    can expire a lease deterministically instead of sleeping."""
+
+    def __init__(self, duration_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.duration_s = float(duration_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._holder: Optional[str] = None
+        self._epoch = 0
+        self._expires_at = 0.0
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def holder(self) -> Optional[str]:
+        with self._lock:
+            if self._holder is not None and self._clock() >= self._expires_at:
+                return None  # expired: readable as vacant
+            return self._holder
+
+    def expired(self) -> bool:
+        with self._lock:
+            return self._holder is None or self._clock() >= self._expires_at
+
+    def acquire(self, owner: str) -> Optional[int]:
+        """Try to take (or keep) the lease; returns the epoch on success
+        (bumped for a NEW holder), None while another holder's lease is
+        live. Acquisition by a new holder is the takeover edge."""
+        with self._lock:
+            now = self._clock()
+            if (self._holder is not None and self._holder != owner
+                    and now < self._expires_at):
+                return None
+            if self._holder != owner:
+                self._epoch += 1
+            self._holder = owner
+            self._expires_at = now + self.duration_s
+            return self._epoch
+
+    def renew(self, owner: str) -> bool:
+        """Extend the holder's window; False when ``owner`` no longer
+        holds the lease (it must stop acting as leader)."""
+        with self._lock:
+            if self._holder != owner or self._clock() >= self._expires_at:
+                return False
+            self._expires_at = self._clock() + self.duration_s
+            return True
+
+    def revoke(self) -> None:
+        """Administratively vacate (the chaos harness's controller-kill:
+        a crashed leader stops renewing; revoke models the expiry
+        without waiting out the wall clock)."""
+        with self._lock:
+            self._expires_at = 0.0
+
+
+class _Txn:
+    """Staged write set committed atomically on context exit.
+
+    Reads see staged writes (read-your-writes inside the txn); no-op
+    puts (value unchanged vs the committed state) are ELIDED so a
+    control loop that re-derives the same state every tick appends
+    nothing to the log. An exception inside the block discards the
+    stage — half a reconcile never commits."""
+
+    def __init__(self, store: "ControllerStore") -> None:
+        self._store = store
+        self._stage: Dict[str, Optional[str]] = {}  # None = delete
+
+    def get(self, key: str) -> Optional[str]:
+        if key in self._stage:
+            return self._stage[key]
+        return self._store.get(key)
+
+    def put(self, key: str, value: str) -> None:
+        if not isinstance(value, str):
+            raise TypeError(
+                f"store values are strings (JSON); got {type(value).__name__}"
+            )
+        if self._store.get(key) == value:
+            self._stage.pop(key, None)  # no-op write: elide
+            return
+        self._stage[key] = value
+
+    def put_json(self, key: str, value: Any) -> None:
+        """Canonical JSON put — sort_keys so an identical dict is a
+        byte-identical (and therefore elided) write."""
+        self.put(key, json.dumps(value, sort_keys=True))
+
+    def delete(self, key: str) -> None:
+        if self._store.get(key) is not None:
+            self._stage[key] = None
+
+    def ops(self) -> List[Tuple[str, str, Optional[str]]]:
+        return [
+            ("delete", k, None) if v is None else ("put", k, v)
+            for k, v in sorted(self._stage.items())
+        ]
+
+    def __enter__(self) -> "_Txn":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None and self._stage:
+            self._store._commit(self.ops())
+        return False
+
+
+class ControllerStore:
+    """Versioned KV surface the controller writes through transactions.
+
+    ``version`` counts committed transactions — a cheap "did anything
+    change" watermark for observers (status/dashboard)."""
+
+    def __init__(self) -> None:
+        self._data: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._version = 0
+
+    # --- read side --------------------------------------------------------
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._data.get(key)
+
+    def get_json(self, key: str) -> Optional[Any]:
+        raw = self.get(key)
+        return None if raw is None else json.loads(raw)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    def snapshot(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._data)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # --- write side -------------------------------------------------------
+    def txn(self) -> _Txn:
+        """The ONLY write path (store-discipline contract)."""
+        return _Txn(self)
+
+    def _apply(self, ops: List[Tuple[str, str, Optional[str]]]) -> None:
+        with self._lock:
+            for kind, key, value in ops:
+                if kind == "put":
+                    self._data[key] = value  # type: ignore[assignment]
+                elif kind == "delete":
+                    self._data.pop(key, None)
+                else:
+                    raise ValueError(f"unknown store op kind {kind!r}")
+            self._version += 1
+
+    def _commit(self, ops: List[Tuple[str, str, Optional[str]]]) -> None:
+        self._apply(ops)
+
+
+class InMemoryStore(ControllerStore):
+    """Single-process store: transactions apply atomically, no log."""
+
+
+@dataclass
+class _ReplicaState:
+    applied_index: int = 0
+    epoch: int = 0
+    is_leader: bool = False
+
+
+class ReplicatedStore(ControllerStore):
+    """Log-replicated store with leader lease + epoch fencing.
+
+    Many instances may share one :class:`StoreLog`/:class:`LeaderLease`
+    pair (live: one per would-be controller; sim: leaders and standbys
+    on the virtual clock). Exactly one is leader at a time; only the
+    leader's transactions commit. A standby calls :meth:`catch_up` to
+    replay new records and :meth:`acquire_leadership` to take over when
+    the lease lapses.
+    """
+
+    def __init__(self, log: StoreLog, lease: LeaderLease, owner: str) -> None:
+        super().__init__()
+        self.log = log
+        self.lease = lease
+        self.owner = owner
+        self._repl = _ReplicaState()
+
+    # --- leadership -------------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self._repl.epoch
+
+    def is_leader(self) -> bool:
+        return self._repl.is_leader and self.lease.holder() == self.owner
+
+    def acquire_leadership(self) -> Optional[int]:
+        """Take the lease (if free/expired), replay the whole log, and
+        fence out the previous epoch. Returns the new epoch, or None
+        while another leader's lease is live. Replay BEFORE fencing
+        would race the old leader's final commits; fencing first means
+        everything replayed is everything that will ever exist below
+        this epoch."""
+        epoch = self.lease.acquire(self.owner)
+        if epoch is None:
+            return None
+        self.log.fence_to(epoch)
+        self.catch_up()
+        self._repl.epoch = epoch
+        self._repl.is_leader = True
+        logger.info("%s: leadership acquired at epoch %d (log index %d)",
+                    self.owner, epoch, self._repl.applied_index)
+        return epoch
+
+    def renew(self) -> bool:
+        """Heartbeat; False demotes this instance (stop leading)."""
+        ok = self.lease.renew(self.owner)
+        if not ok and self._repl.is_leader:
+            self._repl.is_leader = False
+            logger.warning("%s: lease lost (epoch %d); demoted",
+                           self.owner, self._repl.epoch)
+        return ok
+
+    def catch_up(self) -> int:
+        """Apply records this instance has not seen; returns how many.
+        Standbys call this on their watch loop; a fresh leader calls it
+        inside :meth:`acquire_leadership`."""
+        new = self.log.read_from(self._repl.applied_index)
+        for rec in new:
+            self._apply(rec.ops)
+            self._repl.applied_index = rec.index + 1
+        return len(new)
+
+    # --- write side (fenced) ----------------------------------------------
+    def _commit(self, ops: List[Tuple[str, str, Optional[str]]]) -> None:
+        if not self._repl.is_leader:
+            raise StaleEpochError(
+                f"{self.owner}: commit refused — not the leader "
+                f"(epoch {self._repl.epoch}, fence {self.log.fence_epoch})",
+                epoch=self._repl.epoch, fence=self.log.fence_epoch,
+            )
+        index = self.log.append(self._repl.epoch, ops)  # raises when fenced
+        self._apply(ops)
+        self._repl.applied_index = index + 1
+
+
+class ReplicaCatalog:
+    """Process-local registry of LIVE data-plane objects (replicas and
+    routers) that survive a controller death.
+
+    In the reference, replica actors and router processes outlive the
+    controller; a recovering controller re-syncs with them instead of
+    restarting the world. In this in-process re-creation the catalog IS
+    that survival: controllers register the objects they start, a
+    failover successor adopts whatever is still alive and healthy, and
+    only replicas recorded in the store but missing (or dead) here get
+    restarted. Clients' handles keep working through a failover because
+    the ROUTER object they hold is adopted, not replaced."""
+
+    def __init__(self) -> None:
+        self._replicas: Dict[str, Any] = {}
+        self._routers: Dict[str, Any] = {}
+        # replica_id -> live placement group: chip reservations outlive
+        # the controller exactly like the replicas holding them, so a
+        # failover successor can release them when it later retires an
+        # adopted replica (otherwise the chips leak forever).
+        self._pgroups: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def register_replica(self, replica_id: str, replica: Any) -> None:
+        with self._lock:
+            self._replicas[replica_id] = replica
+
+    def unregister_replica(self, replica_id: str) -> None:
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+
+    def replica(self, replica_id: str) -> Optional[Any]:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def register_router(self, deployment: str, router: Any) -> None:
+        with self._lock:
+            self._routers[deployment] = router
+
+    def unregister_router(self, deployment: str) -> None:
+        """Drop a deleted deployment's router: a later redeploy must
+        build fresh, never adopt the CLOSED router (whose failover and
+        hedge workers are gone for good)."""
+        with self._lock:
+            self._routers.pop(deployment, None)
+
+    def router(self, deployment: str) -> Optional[Any]:
+        with self._lock:
+            return self._routers.get(deployment)
+
+    def register_pgroup(self, replica_id: str, pg: Any) -> None:
+        with self._lock:
+            self._pgroups[replica_id] = pg
+
+    def unregister_pgroup(self, replica_id: str) -> None:
+        with self._lock:
+            self._pgroups.pop(replica_id, None)
+
+    def pgroup(self, replica_id: str) -> Optional[Any]:
+        with self._lock:
+            return self._pgroups.get(replica_id)
+
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)

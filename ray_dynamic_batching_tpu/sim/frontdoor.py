@@ -1,0 +1,309 @@
+"""Sim twin of the distributed control plane (ISSUE 11).
+
+The million-user scenarios only run here: this module drives the REAL
+control-plane classes — :class:`~ray_dynamic_batching_tpu.serve.
+frontdoor.FrontDoor` (shard ring + gossip ledgers), :class:`~ray_dynamic_
+batching_tpu.serve.store.ReplicatedStore`/:class:`StoreLog`/:class:`Leader
+Lease` (epoch-fenced failover), and :class:`~ray_dynamic_batching_tpu.
+serve.router.PrefixDigestDirectory` (cluster-wide prefix routing) — on
+the virtual clock, so shard gossip, store failover, and digest routing
+are deterministic events and two same-seed runs render byte-identical
+reports.
+
+One run plays THREE sub-twins over one seeded flood:
+
+- **gossip budget**: arrivals admit through the sharded front door while
+  gossip rounds fire on the virtual clock; the report carries the drift
+  audit (fleet admissions vs the central oracle, bounded by
+  ``(N-1) * rate * staleness``).
+- **store failover**: a leader controller heartbeats transactions into
+  the shared log until it is killed mid-flood; the standby acquires the
+  lease when it lapses (epoch bump, log fence) and the deposed leader's
+  next write is REJECTED — the report pins the epoch numbers and the
+  :class:`StaleEpochError`.
+- **digest routing**: admitted requests route over model replicas whose
+  prefix caches publish digest chains into a real
+  ``PrefixDigestDirectory``; the same workload replays with digest
+  routing OFF (pure pow-2) as the per-replica baseline arm, so the
+  cluster-hit-rate-beats-baseline claim is measured, not assumed.
+
+The gate (tools/run_frontdoor_soak.py --sim) asserts determinism,
+accounting conservation, budget conservation within the staleness
+bound, the epoch-fenced failover, and the hit-rate win.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ray_dynamic_batching_tpu.serve.frontdoor import FrontDoor
+from ray_dynamic_batching_tpu.serve.router import PrefixDigestDirectory
+from ray_dynamic_batching_tpu.serve.store import (
+    LeaderLease,
+    ReplicatedStore,
+    StaleEpochError,
+    StoreLog,
+)
+from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
+
+DEPLOYMENT = "llm"
+
+
+@dataclass
+class FrontDoorScenario:
+    """Deterministic control-plane flood: parameters shared by the CI
+    smoke (tools/frontdoor_smoke.json canon) and ad-hoc what-ifs."""
+
+    seed: int = 0
+    duration_s: float = 30.0
+    # Front door: 4 shards, global budget 200 rps under a 400 rps flood
+    # (2x over-subscribed — the budget must bind).
+    n_shards: int = 4
+    rate_rps: float = 200.0
+    burst: float = 200.0
+    offered_rps: float = 400.0
+    gossip_interval_s: float = 0.5
+    # Store failover: leader killed mid-flood; standby takes over when
+    # the lease lapses.
+    control_interval_s: float = 0.5
+    lease_duration_s: float = 2.0
+    kill_leader_at_s: float = 12.0
+    # Digest routing: model replicas with bounded prefix caches serving
+    # a prompt-family mix (a few hot system prompts + cold tails).
+    n_replicas: int = 3
+    n_families: int = 6
+    family_chain_pages: int = 3
+    replica_cache_entries: int = 4
+    n_sessions: int = 40
+    hot_family_bias: float = 0.7  # fraction of traffic on 2 hot families
+
+
+class _ModelReplica:
+    """Digest-routing model replica: a bounded LRU of digest-chain keys
+    standing in for the paged prefix cache, plus a busy counter standing
+    in for queue depth."""
+
+    def __init__(self, rid: str, cache_entries: int) -> None:
+        self.rid = rid
+        self.cache: "collections.OrderedDict" = collections.OrderedDict()
+        self.cache_entries = cache_entries
+        self.busy = 0
+        self.completed = 0
+        self.hits = 0
+        self.misses = 0
+
+    def digests(self) -> Dict[str, int]:
+        return {key: level for key, level in self.cache.items()}
+
+    def serve(self, chain: List[str]) -> bool:
+        """True on a prefix hit (deepest chain key cached)."""
+        hit = any(key in self.cache for key in reversed(chain))
+        if hit:
+            self.hits += 1
+            deepest = next(k for k in reversed(chain) if k in self.cache)
+            self.cache.move_to_end(deepest)
+        else:
+            self.misses += 1
+        # Serving publishes the full chain (the admission inserts every
+        # full-page prefix, exactly like PagedPrefixCache.insert).
+        for level, key in enumerate(chain, start=1):
+            if key not in self.cache:
+                self.cache[key] = level
+        while len(self.cache) > self.cache_entries:
+            self.cache.popitem(last=False)
+        return hit
+
+
+def _family_chain(family: int, pages: int) -> List[str]:
+    """Synthetic digest chain for a prompt family — stable strings play
+    the role of the blake2b level keys (the directory treats keys as
+    opaque)."""
+    return [f"fam{family}:{level}" for level in range(1, pages + 1)]
+
+
+def _run_arm(sc: FrontDoorScenario, digest_routing: bool) -> Dict[str, Any]:
+    """One full deterministic run; the baseline arm re-runs the same
+    seed with digest routing disabled."""
+    clock = VirtualClock()
+    loop = EventLoop(clock)
+    rng = random.Random(sc.seed)
+
+    # --- front door (real classes, virtual clock) -----------------------
+    fd = FrontDoor(n_shards=sc.n_shards, clock=clock.now_s,
+                   gossip_interval_s=sc.gossip_interval_s)
+    fd.configure(DEPLOYMENT, sc.rate_rps, sc.burst)
+
+    # --- replicated store (real classes, virtual clock) -----------------
+    log = StoreLog(now=clock.now_s)
+    lease = LeaderLease(sc.lease_duration_s, clock=clock.now_s)
+    leader = ReplicatedStore(log, lease, "ctl-A")
+    assert leader.acquire_leadership() == 1
+    standby = ReplicatedStore(log, lease, "ctl-B")
+    store_state: Dict[str, Any] = {
+        "leader": "ctl-A", "epoch": 1, "failover_at_s": None,
+        "stale_write_rejected": False, "stale_error": "",
+        "heartbeats": {"ctl-A": 0, "ctl-B": 0},
+        "completions_while_leaderless": 0,
+    }
+
+    # --- digest-routing data plane --------------------------------------
+    replicas = {f"r{i}": _ModelReplica(f"r{i}", sc.replica_cache_entries)
+                for i in range(sc.n_replicas)}
+    directory = PrefixDigestDirectory()
+    counts = {"arrivals": 0, "admitted": 0, "rejected": 0, "completed": 0,
+              "errors": 0}
+
+    def route(chain: List[str]) -> _ModelReplica:
+        ids = sorted(replicas)
+        if digest_routing and chain:
+            depth, holders = directory.best(chain, ids)
+            if depth > 0:
+                ids = sorted(holders)
+        if len(ids) == 1:
+            return replicas[ids[0]]
+        a, b = rng.sample(ids, 2)
+        return replicas[a if replicas[a].busy <= replicas[b].busy else b]
+
+    def service_time(hit: bool, chain: List[str]) -> float:
+        # Prefill dominates cold admissions; a prefix hit skips it.
+        return 0.01 + (0.0 if hit else 0.01 * len(chain))
+
+    def arrival(session: int, family: int) -> None:
+        counts["arrivals"] += 1
+        payload = {"session_id": f"s{session}"}
+        _, ok, _retry = fd.admit(DEPLOYMENT, payload=payload,
+                                 tenant=f"t{session % 4}")
+        if not ok:
+            counts["rejected"] += 1
+            return
+        counts["admitted"] += 1
+        chain = _family_chain(family, sc.family_chain_pages)
+        replica = route(chain)
+        hit = replica.serve(chain)
+        replica.busy += 1
+
+        def complete(r=replica) -> None:
+            r.busy -= 1
+            r.completed += 1
+            counts["completed"] += 1
+            if store_state["leader"] is None:
+                store_state["completions_while_leaderless"] += 1
+
+        loop.schedule_in(service_time(hit, chain) * 1000.0, complete)
+
+    # Seeded arrival schedule (exponential gaps), fixed up front so both
+    # arms replay the identical offered load.
+    t_ms = 0.0
+    horizon_ms = sc.duration_s * 1000.0
+    hot = (0, 1)
+    while True:
+        t_ms += rng.expovariate(sc.offered_rps) * 1000.0
+        if t_ms >= horizon_ms:
+            break
+        session = rng.randrange(sc.n_sessions)
+        if rng.random() < sc.hot_family_bias:
+            family = hot[rng.randrange(len(hot))]
+        else:
+            family = 2 + rng.randrange(sc.n_families - 2)
+        loop.schedule_at(t_ms, lambda s=session, f=family: arrival(s, f))
+
+    # Gossip rounds on the virtual clock.
+    def gossip() -> None:
+        fd.gossip_round()
+        if clock.now_ms() + sc.gossip_interval_s * 1000.0 < horizon_ms:
+            loop.schedule_in(sc.gossip_interval_s * 1000.0, gossip)
+
+    loop.schedule_in(sc.gossip_interval_s * 1000.0, gossip)
+
+    # Control ticks: the live leader heartbeats a transaction; the
+    # standby replays the log and takes over once the lease lapses.
+    # Digest publications ride the control tick, like the live
+    # controller's _publish_prefix_digests.
+    def control_tick() -> None:
+        now_s = clock.now_s()
+        if store_state["leader"] == "ctl-A" \
+                and now_s >= sc.kill_leader_at_s:
+            store_state["leader"] = None  # killed: stops renewing
+        active = {"ctl-A": leader, "ctl-B": standby}.get(
+            store_state["leader"] or ""
+        )
+        if active is not None and active.renew():
+            with active.txn() as txn:
+                txn.put_json("serve:heartbeat", {
+                    "owner": active.owner,
+                    "tick": store_state["heartbeats"][active.owner] + 1,
+                })
+            store_state["heartbeats"][active.owner] += 1
+        elif store_state["leader"] is None:
+            epoch = standby.acquire_leadership()
+            if epoch is not None:
+                store_state["leader"] = "ctl-B"
+                store_state["epoch"] = epoch
+                store_state["failover_at_s"] = round(now_s, 3)
+                # The deposed leader wakes up and tries to finish a
+                # half-done write: the fence must reject it.
+                try:
+                    with leader.txn() as txn:
+                        txn.put_json("serve:heartbeat",
+                                     {"owner": "ctl-A", "tick": -1})
+                except StaleEpochError as e:
+                    store_state["stale_write_rejected"] = True
+                    store_state["stale_error"] = str(e)
+        for rid in sorted(replicas):
+            directory.publish(rid, 128, replicas[rid].digests())
+        if clock.now_ms() + sc.control_interval_s * 1000.0 < horizon_ms:
+            loop.schedule_in(sc.control_interval_s * 1000.0, control_tick)
+
+    loop.schedule_in(sc.control_interval_s * 1000.0, control_tick)
+
+    # Drift audited AT the flood horizon (the allowance line keeps
+    # growing while arrivals have stopped — auditing later would read
+    # artificially under-admitted), then drain so in-flight completions
+    # land.
+    loop.run_until(horizon_ms)
+    drift = fd.drift_audit(DEPLOYMENT)
+    loop.run_until(horizon_ms + 5_000.0)
+    hits = sum(r.hits for r in replicas.values())
+    misses = sum(r.misses for r in replicas.values())
+    return {
+        "digest_routing": digest_routing,
+        "counts": counts,
+        "drift": drift,
+        "frontdoor": fd.stats(),
+        "store": {
+            **{k: v for k, v in store_state.items()
+               if k != "stale_error"},
+            "stale_error": store_state["stale_error"][:80],
+            "log_records": len(log),
+            "rejected_appends": log.rejected_appends,
+            "fence_epoch": log.fence_epoch,
+        },
+        "routing": {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / max(1, hits + misses), 4),
+            "per_replica": {
+                rid: {"completed": r.completed, "hits": r.hits,
+                      "misses": r.misses}
+                for rid, r in sorted(replicas.items())
+            },
+            "directory_publishes": directory.snapshot()["publishes"],
+        },
+    }
+
+
+def run_frontdoor_sim(
+    scenario: Optional[FrontDoorScenario] = None,
+) -> Dict[str, Any]:
+    """Both arms (digest routing on / off) over the identical seeded
+    flood; the gate compares their hit rates and checks every
+    conservation invariant on the routed arm."""
+    sc = scenario or FrontDoorScenario()
+    return {
+        "scenario": vars(sc),
+        "routed": _run_arm(sc, digest_routing=True),
+        "baseline": _run_arm(sc, digest_routing=False),
+    }

@@ -29,6 +29,12 @@ OTHER_LABEL = "__other__"
 # Default top-K for tenant labels (override per metric via bounded_tags).
 DEFAULT_TENANT_TOP_K = 16
 
+# Default top-K for front-door shard labels on proxy/router families: the
+# shard id is infrastructure-controlled (not client input) but scales with
+# the front-door fleet, so it is bounded the same way — a misconfigured
+# 200-shard ring must not mint 200x series cardinality per family.
+DEFAULT_SHARD_TOP_K = 8
+
 
 def _tags(tags: Optional[Dict[str, str]]) -> TagMap:
     return tuple(sorted((tags or {}).items()))

@@ -441,14 +441,16 @@ class TestCircuitBreaker:
                 trip.future.result(timeout=5)
             assert router.breaker_states()["r0"]["state"] == "open"
             before = ROUTER_REJECTED.get(
-                tags={"deployment": "cbreason", "reason": "breaker_open"}
+                tags={"deployment": "cbreason", "reason": "breaker_open",
+                      "shard": "0"}
             )
             rejected = Request(model="cbreason", payload=2, slo_ms=10_000)
             assert not router.assign_request(rejected)
             with pytest.raises(RequestDropped, match="breaker_open"):
                 rejected.future.result(timeout=1)
             after = ROUTER_REJECTED.get(
-                tags={"deployment": "cbreason", "reason": "breaker_open"}
+                tags={"deployment": "cbreason", "reason": "breaker_open",
+                      "shard": "0"}
             )
             assert after == before + 1
         finally:

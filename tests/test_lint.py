@@ -1212,3 +1212,97 @@ class TestShedAccounting:
 
         report = run(paths=[DEFAULT_TARGET], rules={"shed-accounting"})
         assert report.new == [], [f.format() for f in report.new]
+
+
+# --- store-discipline ------------------------------------------------------
+
+BARE_CONTROLLER_WRITE = """
+    class ServeController:
+        def deploy(self, config):
+            state = self._deployments[config.name]
+            state.restarts = 0
+            return state
+"""
+
+
+class TestStoreDiscipline:
+    def test_bare_write_outside_txn_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/controller.py",
+                              BARE_CONTROLLER_WRITE,
+                              rules={"store-discipline"})
+        assert rules_found(report) == ["store-discipline"]
+        assert "store transaction API" in report.new[0].message
+
+    def test_write_inside_txn_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/controller.py", """
+            class ServeController:
+                def deploy(self, config):
+                    with self.store.txn() as txn:
+                        state = self._deployments[config.name]
+                        state.restarts = 0
+                        txn.put_json("k", {"restarts": 0})
+        """, rules={"store-discipline"})
+        assert report.new == []
+
+    def test_chained_attribute_write_flags(self, tmp_path):
+        # state.config.num_replicas = n mutates controller state through
+        # the chain — the rule matches any watched name IN the chain.
+        report = lint_fixture(tmp_path, "serve/controller.py", """
+            class ServeController:
+                def _control_step(self):
+                    for state in self._deployments.values():
+                        state.config.num_replicas = 3
+        """, rules={"store-discipline"})
+        assert rules_found(report) == ["store-discipline"]
+
+    def test_subscript_write_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/controller.py", """
+            class ServeController:
+                def deploy(self, name, state):
+                    self._deployments[name] = state
+        """, rules={"store-discipline"})
+        assert rules_found(report) == ["store-discipline"]
+
+    def test_init_is_exempt(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/controller.py", """
+            class ServeController:
+                def __init__(self):
+                    self._deployments = {}
+                    self.restarts = 0
+        """, rules={"store-discipline"})
+        assert report.new == []
+
+    def test_unwatched_attrs_and_locals_are_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/controller.py", """
+            class ServeController:
+                def _tick(self, state):
+                    state.policy = None
+                    replicas = []
+                    self._last_checkpoint = "x"
+        """, rules={"store-discipline"})
+        assert report.new == []
+
+    def test_rule_scoped_to_serve_controller(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/router.py",
+                              BARE_CONTROLLER_WRITE,
+                              rules={"store-discipline"})
+        assert report.new == []
+        report = lint_fixture(tmp_path, "engine/controller.py",
+                              BARE_CONTROLLER_WRITE,
+                              rules={"store-discipline"})
+        assert report.new == []
+
+    def test_reasoned_pragma_suppresses(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/controller.py", """
+            class ServeController:
+                def adopt(self, state):
+                    state.restarts = 0  # rdb-lint: disable=store-discipline (adoption re-derives from the already-persisted registry)
+        """, rules={"store-discipline"})
+        assert report.new == []
+        assert report.pragma_suppressed == 1
+
+    def test_shipped_controller_is_clean(self):
+        from tools.lint.core import DEFAULT_TARGET
+
+        report = run(paths=[DEFAULT_TARGET], rules={"store-discipline"})
+        assert report.new == [], [f.format() for f in report.new]

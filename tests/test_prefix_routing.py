@@ -1,0 +1,257 @@
+"""Cluster-wide prefix routing: digest directory, router preference,
+controller publication (ISSUE 11 satellite coverage).
+
+Digest publish/expire, longest-chain candidate narrowing, tie-breaks
+falling back to pow-2, and the controller pushing replica publications
+over the long-poll channel.
+"""
+
+import numpy as np
+import pytest
+
+from ray_dynamic_batching_tpu.engine.paging import (
+    PageAllocator,
+    PagedPrefixCache,
+    digest_chain,
+)
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.serve import (
+    DeploymentConfig,
+    ServeController,
+)
+from ray_dynamic_batching_tpu.serve.controller import PREFIX_DIGEST_KEY
+from ray_dynamic_batching_tpu.serve.replica import Replica
+from ray_dynamic_batching_tpu.serve.router import (
+    PrefixDigestDirectory,
+    Router,
+)
+
+
+def _chain(tokens, page_size=4):
+    return [k.hex() for k in digest_chain(
+        np.asarray(tokens, np.int32), page_size,
+        (len(tokens) - 1) // page_size,
+    )]
+
+
+class TestDigestChain:
+    def test_shared_helper_matches_prefix_cache_keys(self):
+        """One identity: the router's chain and the cache's level keys
+        must be the same bytes or cluster routing steers to replicas
+        that then miss."""
+        alloc = PageAllocator(16)
+        cache = PagedPrefixCache(8, page_size=4, allocator=alloc)
+        prompt = np.arange(1, 14, dtype=np.int32)  # 13 tokens, 3 pages
+        assert cache._level_keys(prompt, 3) == digest_chain(prompt, 4, 3)
+
+    def test_chain_is_prefix_consistent(self):
+        a = digest_chain(np.arange(16, dtype=np.int32), 4)
+        b = digest_chain(np.arange(8, dtype=np.int32), 4)
+        assert a[:2] == b  # sharing pages => sharing level keys
+
+
+class TestDigestDirectory:
+    def test_publish_and_longest_chain(self):
+        d = PrefixDigestDirectory()
+        chain = _chain(list(range(13)))  # 3 levels
+        assert d.publish("r0", 4, {chain[0]: 1})
+        assert d.publish("r1", 4, {chain[0]: 1, chain[2]: 3})
+        depth, holders = d.best(chain, ["r0", "r1", "r2"])
+        assert depth == 3 and holders == {"r1"}
+
+    def test_tie_returns_all_holders(self):
+        d = PrefixDigestDirectory()
+        chain = _chain(list(range(13)))
+        d.publish("r0", 4, {chain[1]: 2})
+        d.publish("r1", 4, {chain[1]: 2})
+        depth, holders = d.best(chain, ["r0", "r1"])
+        assert depth == 2 and holders == {"r0", "r1"}
+
+    def test_expire_by_replacement_and_prune(self):
+        d = PrefixDigestDirectory()
+        chain = _chain(list(range(13)))
+        d.publish("r0", 4, {chain[2]: 3})
+        assert d.best(chain, ["r0"])[0] == 3
+        # Re-publication WITHOUT the digest (evicted, not spilled):
+        # stops matching immediately.
+        assert d.publish("r0", 4, {})
+        assert d.best(chain, ["r0"]) == (0, set())
+        d.publish("r1", 4, {chain[0]: 1})
+        d.prune({"r0"})  # r1 left the replica set
+        assert d.best(chain, ["r1"]) == (0, set())
+
+    def test_unchanged_publish_reports_no_change(self):
+        d = PrefixDigestDirectory()
+        chain = _chain(list(range(13)))
+        assert d.publish("r0", 4, {chain[0]: 1})
+        assert not d.publish("r0", 4, {chain[0]: 1})
+
+    def test_bounded_per_replica(self):
+        d = PrefixDigestDirectory(max_digests_per_replica=2)
+        d.publish("r0", 4, {f"{i:032x}": 1 for i in range(50)})
+        assert len(d.snapshot()["replicas"]["r0"]) == 2
+
+    def test_page_size_conflict_drops_the_publisher(self):
+        d = PrefixDigestDirectory()
+        chain = _chain(list(range(13)))
+        d.publish("r0", 4, {chain[0]: 1})
+        assert not d.publish("r1", 8, {chain[0]: 1})
+        assert "r1" not in d.snapshot()["replicas"]
+
+    def test_chain_for_requires_tokens_past_one_page(self):
+        d = PrefixDigestDirectory()
+        assert d.chain_for({"tokens": list(range(20))}) == []  # idle dir
+        d.publish("r0", 4, {"aa": 1})
+        assert d.chain_for({"tokens": [1, 2, 3]}) == []   # < one page
+        assert d.chain_for("not-a-dict") == []
+        assert d.chain_for({"x": 1}) == []
+        chain = d.chain_for({"tokens": list(range(13))})
+        assert chain == _chain(list(range(13)))
+
+
+def _echo(payloads):
+    return list(payloads)
+
+
+class TestRouterDigestRouting:
+    def _router(self, n=3):
+        reps = [Replica(f"r{i}", "d", _echo, max_batch_size=4,
+                        batch_wait_timeout_s=0.001)
+                for i in range(n)]
+        for r in reps:
+            r.start()
+        router = Router("d", replicas=reps)
+        return router, reps
+
+    def test_longest_chain_holder_wins_before_pow2(self):
+        router, reps = self._router()
+        try:
+            tokens = list(range(13))
+            chain = _chain(tokens)
+            router.digests.publish("r2", 4, {chain[2]: 3})
+            router.digests.publish("r0", 4, {chain[0]: 1})
+            for _ in range(8):
+                req = Request(model="d", payload={"tokens": tokens},
+                              slo_ms=10_000.0)
+                assert router.assign_request(req)
+                assert req._assigned_replica == "r2"
+                req.future.result(timeout=5)
+        finally:
+            for r in reps:
+                r.stop()
+
+    def test_tie_falls_back_to_pow2_spread(self):
+        router, reps = self._router()
+        try:
+            tokens = list(range(13))
+            chain = _chain(tokens)
+            router.digests.publish("r0", 4, {chain[1]: 2})
+            router.digests.publish("r1", 4, {chain[1]: 2})
+            seen = set()
+            for _ in range(24):
+                req = Request(model="d", payload={"tokens": tokens},
+                              slo_ms=10_000.0)
+                assert router.assign_request(req)
+                seen.add(req._assigned_replica)
+                req.future.result(timeout=5)
+            # Both tied holders serve (pow-2 among them); the non-holder
+            # never does.
+            assert seen == {"r0", "r1"}
+        finally:
+            for r in reps:
+                r.stop()
+
+    def test_no_match_routes_like_plain_pow2(self):
+        router, reps = self._router()
+        try:
+            router.digests.publish("r0", 4, {"deadbeef" * 4: 1})
+            seen = set()
+            for i in range(30):
+                req = Request(model="d",
+                              payload={"tokens": list(range(13))},
+                              slo_ms=10_000.0)
+                assert router.assign_request(req)
+                seen.add(req._assigned_replica)
+                req.future.result(timeout=5)
+            assert len(seen) >= 2  # nobody monopolizes without a match
+        finally:
+            for r in reps:
+                r.stop()
+
+    def test_membership_change_prunes_directory(self):
+        router, reps = self._router()
+        try:
+            router.digests.publish("r1", 4, {"aa": 1})
+            router.update_replicas(reps[:1])
+            assert "r1" not in router.digests.snapshot()["replicas"]
+        finally:
+            for r in reps:
+                r.stop()
+
+
+class TestControllerPublishesDigests:
+    def test_digests_flow_replica_to_router_over_long_poll(self):
+        """A replica exposing ``prefix_digests`` gets its publication
+        collected on the control step, into the router directory AND
+        the long-poll channel (out-of-process routers ride that)."""
+        ctl = ServeController(control_interval_s=0.02)
+        router = ctl.deploy(
+            DeploymentConfig(name="digesty", num_replicas=1),
+            factory=lambda: _echo,
+        )
+        try:
+            rep = router.replicas()[0]
+            published = {"page_size": 128, "digests": {"ab" * 16: 2}}
+            rep.prefix_digests = lambda: published  # LLMReplica surface
+            state = ctl._deployments["digesty"]
+            ctl._publish_prefix_digests(state)
+            snap = router.digests.snapshot()
+            assert snap["replicas"][rep.replica_id] == {"ab" * 16: 2}
+            key = PREFIX_DIGEST_KEY.format(deployment="digesty")
+            updates = ctl.long_poll.listen_for_change({key: -1},
+                                                      timeout_s=1.0)
+            assert key in updates
+            assert updates[key][1]["replicas"][rep.replica_id]
+            # Unchanged publication: no fresh long-poll notification.
+            sid = updates[key][0]
+            ctl._publish_prefix_digests(state)
+            assert ctl.long_poll.snapshot_ids().get(key) == sid
+        finally:
+            ctl.shutdown()
+
+
+@pytest.mark.parametrize("size", [5, 12])
+def test_chain_for_respects_strict_prefill_bound(size):
+    """A prompt of exactly N full pages publishes N-1 levels for lookup
+    (>= 1 tail token must remain to prefill) — chain_for mirrors the
+    cache's strict bound so routing never steers toward an unusable
+    full-prompt match."""
+    d = PrefixDigestDirectory()
+    d.publish("r0", 4, {"aa": 1})
+    chain = d.chain_for({"tokens": list(range(size))})
+    assert len(chain) == (size - 1) // 4
+
+
+class TestReviewRegressions:
+    def test_page_size_reanchors_after_all_publishers_leave(self):
+        """A rolling update to a new page size must not disable digest
+        routing forever: once every old-size publisher is pruned, the
+        first new publisher re-anchors the directory."""
+        d = PrefixDigestDirectory()
+        d.publish("r0", 128, {"aa": 1})
+        assert not d.publish("r1", 64, {"bb": 1})  # mixed: dropped
+        d.prune(set())  # rolling update retired every old replica
+        assert d.publish("r2", 64, {"bb": 1})      # re-anchored
+        assert d.snapshot()["page_size"] == 64
+        assert d.best(["bb"], ["r2"]) == (1, {"r2"})
+
+    def test_malformed_tokens_never_crash_routing(self):
+        """Client-controlled tokens must not raise inside the routing
+        layer once digests are published — un-steered routing proceeds
+        and replica-level validation owns the rejection."""
+        d = PrefixDigestDirectory()
+        d.publish("r0", 4, {"aa": 1})
+        assert d.chain_for({"tokens": ["a", "b", "c", "d", "e", "f"]}) \
+            == []
+        assert d.chain_for({"tokens": [2 ** 70] * 8}) == []
+        assert d.chain_for({"tokens": [[1, 2]] * 8}) == []

@@ -259,6 +259,16 @@ def _smoke() -> int:
                       tag_keys=("tenant",), bounded_tags={"tenant": 4})
         for i in range(40):
             t.inc(tags={"tenant": f"tenant-{i}"})
+        # Front-door shard labels (ISSUE 11): a mis-sized 40-shard ring
+        # against the DEFAULT_SHARD_TOP_K bound — the proxy/router
+        # families all carry this tag now, so the collapse must hold for
+        # it exactly like for tenants.
+        fdm = m.Counter("smoke_shard_total", "shard-tagged smoke",
+                        tag_keys=("deployment", "shard", "outcome"),
+                        bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K})
+        for i in range(40):
+            fdm.inc(tags={"deployment": "llm", "shard": f"fd-{i}",
+                          "outcome": "admit"})
         h = m.Histogram("smoke_latency_ms", "smoke latency",
                         tag_keys=("model",))
         for v in (0.4, 3.0, 42.0, 900.0):
@@ -308,6 +318,20 @@ def _smoke() -> int:
            if l.startswith("smoke_tenant_total{")) != 5:
         errors.append(
             "expected exactly 4 named tenant series + __other__"
+        )
+    overflow = 40 - m.DEFAULT_SHARD_TOP_K
+    if (f'smoke_shard_total{{deployment="llm",outcome="admit",'
+            f'shard="__other__"}} {float(overflow)}') not in text:
+        errors.append(
+            "shard label flood did not collapse into __other__ "
+            f"(expected {overflow} overflow increments in one series)"
+        )
+    n_shard_series = sum(1 for l in text.splitlines()
+                         if l.startswith("smoke_shard_total{"))
+    if n_shard_series != m.DEFAULT_SHARD_TOP_K + 1:
+        errors.append(
+            f"expected exactly {m.DEFAULT_SHARD_TOP_K} named shard "
+            f"series + __other__, saw {n_shard_series}"
         )
     n_exemplars = len(re.findall(r' # \{trace_id="', text))
     if n_exemplars < 1:

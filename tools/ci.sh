@@ -30,6 +30,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_mesh_soak.py --sim
   echo "== overload conformance (sim: 5x saturation, QoS floors, tools/overload_smoke.json) =="
   python tools/run_overload_soak.py --sim
+  echo "== control-plane conformance (sim: sharded front door, controller-kill failover, digest routing, tools/frontdoor_smoke.json) =="
+  python tools/run_frontdoor_soak.py --sim
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
   exec python -m pytest tests/ -q -m "not slow"
 fi
@@ -72,6 +74,10 @@ python tools/run_mesh_soak.py --sim
 echo "== overload conformance (sim 5x + live mixed-class soak, only 200s/429s) =="
 python tools/run_overload_soak.py --sim
 python tools/run_overload_soak.py --live --smoke
+
+echo "== control-plane conformance (sim + live: controller killed mid-flood, epoch-fenced failover, gossip budget, digest routing) =="
+python tools/run_frontdoor_soak.py --sim
+python tools/run_frontdoor_soak.py --live --smoke
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q

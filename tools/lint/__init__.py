@@ -17,6 +17,9 @@ host-sync-in-hot-path  decode/step loop syncs are explicit; no Python
                        branches on traced values inside jitted fns
 span-hygiene           spans always enter/exit; exporter exceptions are
                        contained off the request path
+store-discipline       controller-owned mutable state mutates only
+                       inside serve/store.py transactions (the
+                       replicated-store contract)
 =====================  ==================================================
 
 See tools/lint/core.py for pragmas (`# rdb-lint: disable=<rule>

@@ -288,6 +288,7 @@ def _all_checkers() -> List[Checker]:
     from tools.lint.retry import UnboundedRetryChecker
     from tools.lint.shed import ShedAccountingChecker
     from tools.lint.spans import SpanHygieneChecker
+    from tools.lint.store import StoreDisciplineChecker
     from tools.lint.vmem import TileAlignmentChecker, VmemBudgetChecker
 
     return [
@@ -299,6 +300,7 @@ def _all_checkers() -> List[Checker]:
         SimDeterminismChecker(),
         UnboundedRetryChecker(),
         ShedAccountingChecker(),
+        StoreDisciplineChecker(),
     ]
 
 

@@ -1,0 +1,139 @@
+"""store-discipline — controller state mutates only through store txns.
+
+ISSUE 11 moved every piece of ``ServeController`` mutable state behind
+the ``serve/store.py`` transaction API so a controller death is a
+failover (the standby replays the epoch-fenced log) rather than an
+outage. That abstraction rots in exactly one way: someone writes
+``state.replicas = ...`` or ``self._deployments[name] = ...`` directly
+and the durable mirror silently diverges from the in-memory truth —
+harmless until the first failover, catastrophic then. This rule catches
+the bare write at lint time.
+
+A finding is raised when, in a ``serve/controller.py`` file, an
+assignment (plain, augmented, or subscript) targets a CONTROLLER-OWNED
+state attribute —
+
+    ``_deployments``, ``config``, ``replicas``, ``restarts``,
+    ``unhealthy``, ``next_replica_ordinal``, ``pgroups``
+
+(anywhere in the attribute chain, so ``state.config.num_replicas = n``
+counts) — and the statement is not lexically inside a
+``with <store>.txn() as ...:`` (or ``.transaction()``) block.
+
+Scope notes, deliberate:
+
+- ``__init__`` bodies are exempt: constructing empty state is not
+  mutating replicated state.
+- Mutation via method call (``state.replicas.append(...)``,
+  ``.pop(...)``) inside a txn-wrapped function is the normal idiom; the
+  rule is lexical over assignments, which is where the rot historically
+  starts (the PR 11 refactor wrapped every such site).
+- Derived objects (autoscaling ``policy``, router gray/hedge policies,
+  registered ``factory`` callables) are re-derived from the persisted
+  config on recovery and are intentionally NOT in the attribute set.
+
+Known-correct exceptions carry reasoned pragmas
+(``# rdb-lint: disable=store-discipline (<why>)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from tools.lint.core import Checker, FileCtx, Scope, dotted_name, in_dirs
+
+# Attribute names (anywhere in the write target's chain) that are
+# controller-owned replicated state.
+CONTROLLER_STATE_ATTRS = {
+    "_deployments",
+    "config",
+    "replicas",
+    "restarts",
+    "unhealthy",
+    "next_replica_ordinal",
+    "pgroups",
+}
+
+_TXN_CALL_SUFFIXES = (".txn", ".transaction")
+
+
+def _target_attrs(node: ast.AST) -> Set[str]:
+    """Every attribute name along a write target's chain:
+    ``state.config.num_replicas`` -> {config, num_replicas};
+    ``self._deployments[name]`` -> {_deployments}."""
+    out: Set[str] = set()
+    while True:
+        if isinstance(node, ast.Attribute):
+            out.add(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:
+            return out
+
+
+def _is_txn_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ""
+    return name.endswith(_TXN_CALL_SUFFIXES) or name in ("txn", "transaction")
+
+
+class StoreDisciplineChecker(Checker):
+    rule = "store-discipline"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.endswith("controller.py") and in_dirs(relpath,
+                                                             {"serve"})
+
+    def begin_file(self, ctx: FileCtx) -> None:
+        # Node ids lexically inside a `with <store>.txn() as ...:` body.
+        self._in_txn: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(_is_txn_call(item.context_expr)
+                       for item in node.items):
+                continue
+            for child in node.body:
+                for sub in ast.walk(child):
+                    self._in_txn.add(id(sub))
+
+    def _watched_targets(self, node: ast.AST):
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            return [node.target]
+        if isinstance(node, ast.Delete):
+            return node.targets
+        return []
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        targets = self._watched_targets(node)
+        if not targets:
+            return
+        fn = scope.current_function()
+        if (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name == "__init__"):
+            return  # constructing empty state is not mutating it
+        for target in targets:
+            if not isinstance(target, (ast.Attribute, ast.Subscript)):
+                continue  # bare locals are not controller state
+            hit = _target_attrs(target) & CONTROLLER_STATE_ATTRS
+            if not hit:
+                continue
+            if id(node) in self._in_txn:
+                continue
+            self.report(
+                ctx, node,
+                f"bare write to controller-owned state "
+                f"({', '.join(sorted(hit))}) outside the store "
+                "transaction API — wrap the mutation in "
+                "`with self.store.txn() as txn:` and persist the "
+                "durable mirror, or the replicated store silently "
+                "diverges from memory and the next failover replays "
+                "stale state",
+                scope,
+            )
+            break  # one finding per statement

@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""Control-plane conformance gate — kill the controller mid-flood.
+
+The contract under test is ISSUE 11's distributed control plane:
+
+  - the SHARDED FRONT DOOR's per-shard gossip ledgers enforce one
+    GLOBAL admission budget, with over-admission bounded by
+    ``(N-1) * rate * gossip_staleness``;
+  - a controller death is a FAILOVER, not an outage: the standby
+    replays the epoch-fenced log, adopts the live data plane, and the
+    deposed leader's writes are provably rejected (StaleEpochError);
+  - CLUSTER-WIDE PREFIX ROUTING beats the per-replica baseline:
+    prompts sharing a prefix converge on the replicas holding it.
+
+Two modes:
+
+  --sim    the deterministic twin (sim/frontdoor.py): the full scenario
+           on the virtual clock, run TWICE and compared byte-for-byte,
+           with accounting conservation, the budget staleness bound,
+           the epoch-fenced failover, and the hit-rate win all gated
+           against tools/frontdoor_smoke.json. Milliseconds of wall
+           time — the CI fast lane's gate.
+  --live   a real ServeController PAIR sharing an epoch-fenced StoreLog
+           + LeaderLease + ReplicaCatalog, fronted by a real sharded
+           FrontDoor, flooded from threads while the leader is
+           crashed mid-flood: the standby acquires the lease, adopts
+           the running replicas/router, heals a subsequently-killed
+           replica, and the old leader's post-lease write is pinned
+           REJECTED. Zero client-visible system errors throughout.
+
+Exit: 0 conformant, 1 violation, 2 usage.
+
+Examples:
+  python tools/run_frontdoor_soak.py --sim
+  python tools/run_frontdoor_soak.py --live --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "frontdoor_smoke.json")
+
+
+def _floors(section: str) -> dict:
+    with open(SMOKE_PATH) as f:
+        return json.load(f)["floors"][section]
+
+
+def run_sim(seed: int = 0) -> int:
+    from ray_dynamic_batching_tpu.sim.frontdoor import (
+        FrontDoorScenario,
+        run_frontdoor_sim,
+    )
+
+    floors = _floors("sim")
+    sc = FrontDoorScenario(seed=seed)
+    reports = [run_frontdoor_sim(sc) for _ in range(2)]
+    blobs = [json.dumps(r, sort_keys=True) for r in reports]
+    failures = []
+    if blobs[0] != blobs[1]:
+        failures.append("nondeterministic: same seed produced different "
+                        "report bytes")
+    rt = reports[0]["routed"]
+    bl = reports[0]["baseline"]
+    c = rt["counts"]
+    # --- accounting conservation ---------------------------------------
+    if c["arrivals"] != c["admitted"] + c["rejected"]:
+        failures.append(
+            f"accounting leak: {c['arrivals']} arrivals != "
+            f"{c['admitted']} admitted + {c['rejected']} rejected"
+        )
+    if c["completed"] != c["admitted"] or c["errors"]:
+        failures.append(
+            f"client-visible loss: admitted {c['admitted']}, completed "
+            f"{c['completed']}, errors {c['errors']} — the controller "
+            "kill leaked into the data plane"
+        )
+    # --- global budget within the gossip staleness bound ---------------
+    drift = rt["drift"]
+    if drift["over_admitted"] > drift["bound"]:
+        failures.append(
+            f"global budget violated: over-admission "
+            f"{drift['over_admitted']} exceeds the staleness bound "
+            f"{drift['bound']} ((N-1)*rate*staleness)"
+        )
+    ratio = drift["admitted"] / max(1.0, drift["allowed"])
+    if ratio < floors["min_admitted_ratio"]:
+        failures.append(
+            f"under-admission: {ratio:.3f} of the allowance used under a "
+            f"2x flood (floor {floors['min_admitted_ratio']}) — the "
+            "gossip view is starving shards"
+        )
+    # --- epoch-fenced store failover ------------------------------------
+    st = rt["store"]
+    if st["epoch"] != floors["failover_epoch"] or st["leader"] != "ctl-B":
+        failures.append(
+            f"no failover: leader {st['leader']!r} at epoch {st['epoch']}"
+        )
+    if not st["stale_write_rejected"] or st["rejected_appends"] < 1:
+        failures.append(
+            "deposed leader's write was NOT rejected — epoch fencing "
+            "failed (split-brain)"
+        )
+    sc_d = reports[0]["scenario"]
+    lag = (st["failover_at_s"] or 1e9) - sc_d["kill_leader_at_s"]
+    max_lag = (sc_d["lease_duration_s"]
+               + floors["max_failover_lag_ticks"]
+               * sc_d["control_interval_s"])
+    if lag > max_lag:
+        failures.append(
+            f"failover took {lag:.1f}s after the kill (budget "
+            f"{max_lag:.1f}s = lease + {floors['max_failover_lag_ticks']} "
+            "ticks)"
+        )
+    if st["completions_while_leaderless"] \
+            < floors["min_leaderless_completions"]:
+        failures.append(
+            "no completions while leaderless — the data plane stalled "
+            "with the controller (it must not: routing is push-updated)"
+        )
+    # --- cluster prefix routing beats the per-replica baseline ----------
+    hit, base_hit = rt["routing"]["hit_rate"], bl["routing"]["hit_rate"]
+    if hit < floors["min_hit_rate"]:
+        failures.append(
+            f"cluster hit-rate {hit:.4f} under floor "
+            f"{floors['min_hit_rate']}"
+        )
+    if hit < base_hit + floors["min_hit_rate_margin_over_baseline"]:
+        failures.append(
+            f"digest routing won nothing: {hit:.4f} vs baseline "
+            f"{base_hit:.4f} (needs +"
+            f"{floors['min_hit_rate_margin_over_baseline']})"
+        )
+    summary = {
+        "mode": "sim",
+        "deterministic": blobs[0] == blobs[1],
+        "counts": c,
+        "drift": drift,
+        "store": {k: st[k] for k in ("leader", "epoch", "failover_at_s",
+                                     "stale_write_rejected",
+                                     "rejected_appends",
+                                     "completions_while_leaderless")},
+        "hit_rate": {"routed": hit, "baseline": base_hit},
+        "violations": failures,
+    }
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 1 if failures else 0
+
+
+def run_live(n_requests: int, rps: float) -> int:
+    from ray_dynamic_batching_tpu.serve import (
+        DeploymentConfig,
+        DeploymentHandle,
+        FrontDoor,
+        LeaderLease,
+        ReplicaCatalog,
+        ReplicatedStore,
+        ServeController,
+        StaleEpochError,
+        StoreLog,
+        is_shed,
+    )
+
+    floors = _floors("live")
+
+    def factory():
+        def work(payloads):
+            time.sleep(0.001)
+            return [p * 2 for p in payloads]
+        return work
+
+    log = StoreLog()
+    lease = LeaderLease(duration_s=1.0)
+    catalog = ReplicaCatalog()
+    store_a = ReplicatedStore(log, lease, "ctl-A")
+    assert store_a.acquire_leadership() == 1
+    ctl_a = ServeController(control_interval_s=0.05, store=store_a,
+                            catalog=catalog)
+    router = ctl_a.deploy(
+        DeploymentConfig(name="soak", num_replicas=2, max_batch_size=4,
+                         batch_wait_timeout_s=0.002, max_restarts=8),
+        factory=factory,
+    )
+    ctl_a.start()
+    handle = DeploymentHandle(router, default_slo_ms=30_000.0)
+
+    fd = FrontDoor(n_shards=2, gossip_interval_s=0.05)
+    # Global budget far above the offered load: the live arm proves the
+    # failover path, not shedding (the sim arm owns the budget math).
+    fd.configure("soak", rate_rps=max(10_000.0, rps * 4), burst=rps * 4)
+    fd.start()
+
+    violations = []
+    ctl_b = None
+    try:
+        assert handle.remote(1).result(timeout=10) == 2  # warmup
+        futures = []
+        rejected = 0
+        kill_at = n_requests // 3
+        interval = 1.0 / rps if rps > 0 else 0.0
+        t_kill = None
+        for i in range(n_requests):
+            _sid, ok, _ra = fd.admit(
+                "soak", payload={"session_id": f"s{i % 16}"},
+                tenant=f"tenant-{i % 3}",
+            )
+            if not ok:
+                rejected += 1
+                continue
+            futures.append((i, handle.remote(i)))
+            if i == kill_at:
+                # --- the controller-kill chaos -------------------------
+                t_kill = time.monotonic()
+                ctl_a.crash()       # loop dead; replicas keep serving
+                lease.revoke()      # model the lease lapsing, CI-fast
+                store_b = ReplicatedStore(log, lease, "ctl-B")
+                ctl_b = ServeController(control_interval_s=0.05,
+                                        store=store_b, catalog=catalog)
+                ctl_b.register_factory("soak", factory)
+                assert store_b.acquire_leadership() == 2
+                recovered = ctl_b.recover()
+                ctl_b.start()
+                if recovered != ["soak"]:
+                    violations.append(
+                        f"standby recovered {recovered}, expected ['soak']"
+                    )
+            if interval:
+                time.sleep(interval)
+        failover_s = time.monotonic() - (t_kill or time.monotonic())
+        # The deposed leader tries one more write: must be fenced.
+        stale_rejected = False
+        try:
+            with ctl_a.store.txn() as txn:
+                txn.put("serve:heartbeat", '{"owner": "ctl-A"}')
+        except StaleEpochError:
+            stale_rejected = True
+        if not stale_rejected:
+            violations.append(
+                "old leader's post-lease write was NOT rejected — "
+                "epoch fencing failed"
+            )
+        # Post-failover heal: kill one replica; the STANDBY must replace
+        # it (proof the successor is a functioning controller, not a
+        # read replica).
+        victim = ctl_b.get_router("soak").replicas()[0]
+        victim.stop(timeout_s=2.0, drain=False)
+        deadline = time.monotonic() + floors["failover_s_budget"]
+        healed = False
+        while time.monotonic() < deadline:
+            heals = [a for a in ctl_b.audit.to_dicts()
+                     if a["trigger"] == "heal"]
+            if heals and len(ctl_b.get_router("soak").replicas()) == 2:
+                healed = True
+                break
+            time.sleep(0.05)
+        if not healed:
+            violations.append(
+                "standby never healed the killed replica within "
+                f"{floors['failover_s_budget']}s — the successor is not "
+                "a functioning controller"
+            )
+        completed = shed = system_errors = 0
+        first_error = None
+        for i, fut in futures:
+            try:
+                if fut.result(timeout=30) == i * 2:
+                    completed += 1
+                else:
+                    system_errors += 1
+                    first_error = first_error or f"wrong result for {i}"
+            except Exception as e:  # noqa: BLE001 — classification is the test
+                if is_shed(e):
+                    shed += 1
+                else:
+                    system_errors += 1
+                    first_error = first_error or f"{type(e).__name__}: {e}"
+        if system_errors:
+            violations.append(
+                f"{system_errors} client-visible system error(s) through "
+                f"the controller kill; first: {first_error}"
+            )
+        if completed < floors["min_completed_fraction"] * len(futures):
+            violations.append(
+                f"only {completed}/{len(futures)} admitted requests "
+                "completed — the failover shed traffic it should have "
+                "carried"
+            )
+        adopts = [a for a in ctl_b.audit.to_dicts()
+                  if a["trigger"] == "failover_adopt"]
+        if not adopts or adopts[0]["observed"].get("epoch") != 2:
+            violations.append(
+                "no epoch-stamped failover_adopt audit record on the "
+                "standby"
+            )
+        summary = {
+            "mode": "live",
+            "requests": n_requests,
+            "admitted": len(futures),
+            "frontdoor_rejected": rejected,
+            "completed": completed,
+            "shed": shed,
+            "system_errors": system_errors,
+            "failover_s": round(failover_s, 3),
+            "stale_write_rejected": stale_rejected,
+            "log_rejected_appends": log.rejected_appends,
+            "standby_store": ctl_b.store_status() if ctl_b else None,
+            "frontdoor": fd.stats(),
+            "violations": violations,
+        }
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    finally:
+        fd.stop()
+        if ctl_b is not None:
+            ctl_b.shutdown()
+        ctl_a.shutdown()
+    return 1 if violations else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--sim", action="store_true",
+                      help="deterministic sim conformance (CI fast lane)")
+    mode.add_argument("--live", action="store_true",
+                      help="threaded soak against a real controller pair")
+    ap.add_argument("--smoke", action="store_true",
+                    help="live: shrink to a quick CI-sized soak")
+    ap.add_argument("--requests", type=int, default=600)
+    ap.add_argument("--rps", type=float, default=300.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.sim:
+        return run_sim(seed=args.seed)
+    n = 180 if args.smoke else args.requests
+    return run_live(n, args.rps)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
