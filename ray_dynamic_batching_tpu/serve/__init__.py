@@ -32,6 +32,13 @@ from ray_dynamic_batching_tpu.serve.controller import (
     DeploymentConfig,
     ServeController,
 )
+from ray_dynamic_batching_tpu.serve.fabric import (
+    ControlFabric,
+    FabricUnreachable,
+    default_fabric,
+    parse_partition_spec,
+    reset_fabric,
+)
 from ray_dynamic_batching_tpu.serve.frontdoor import (
     FrontDoor,
     FrontDoorShard,
@@ -39,6 +46,7 @@ from ray_dynamic_batching_tpu.serve.frontdoor import (
     HashRing,
 )
 from ray_dynamic_batching_tpu.serve.store import (
+    CompactedLogError,
     ControllerStore,
     InMemoryStore,
     LeaderLease,
@@ -46,6 +54,7 @@ from ray_dynamic_batching_tpu.serve.store import (
     ReplicatedStore,
     StaleEpochError,
     StoreLog,
+    StoreSnapshot,
 )
 from ray_dynamic_batching_tpu.serve.failover import (
     DrainEvicted,
@@ -97,11 +106,15 @@ __all__ = [
     "status",
     "AutoscalingConfig",
     "AutoscalingPolicy",
+    "CompactedLogError",
     "CompletionsHandle",
+    "ControlFabric",
     "ControllerStore",
+    "default_fabric",
     "DeploymentConfig",
     "DeploymentHandle",
     "DrainEvicted",
+    "FabricUnreachable",
     "FrontDoor",
     "FrontDoorShard",
     "GlobalBudget",
@@ -112,6 +125,9 @@ __all__ = [
     "ReplicatedStore",
     "StaleEpochError",
     "StoreLog",
+    "StoreSnapshot",
+    "parse_partition_spec",
+    "reset_fabric",
     "FailoverManager",
     "FailoverPolicy",
     "GrayHealthMonitor",

@@ -52,6 +52,11 @@ from ray_dynamic_batching_tpu.serve.autoscaling import (
     AutoscalingConfig,
     AutoscalingPolicy,
 )
+from ray_dynamic_batching_tpu.serve.fabric import (
+    ControlFabric,
+    FabricUnreachable,
+    default_fabric,
+)
 from ray_dynamic_batching_tpu.serve.long_poll import LongPollHost
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.serve.router import Router
@@ -196,6 +201,7 @@ class ServeController:
         placement: Optional[PlacementManager] = None,
         store: Optional[ControllerStore] = None,
         catalog: Optional[ReplicaCatalog] = None,
+        fabric: Optional[ControlFabric] = None,
     ) -> None:
         self.kv = kv or KVStore()
         self.long_poll = long_poll or LongPollHost()
@@ -203,6 +209,11 @@ class ServeController:
         self.control_interval_s = control_interval_s
         self.store = store or InMemoryStore()
         self.catalog = catalog
+        # The control-plane message seam: controller→router pushes
+        # (long-poll notifies, digest publications) route through it so
+        # the partition soak can cut the controller off from its data
+        # plane. Unconfigured it is the zero-overhead passthrough.
+        self.fabric = fabric if fabric is not None else default_fabric()
         self._deployments: Dict[str, _DeploymentState] = {}
         self._factories: Dict[str, Callable] = {}
         self._lock = threading.RLock()
@@ -215,6 +226,11 @@ class ServeController:
         # Structured decision ring (scheduler/audit.py): deploys, scale
         # moves, heals, rollouts — surfaced per deployment in status().
         self.audit = AuditLog("serve")
+        # The store's split-brain defense (store_unreachable self-
+        # demotion) files into the SAME ring as fences and heals.
+        if isinstance(self.store, ReplicatedStore) \
+                and self.store.audit is None:
+            self.store.audit = self.audit
         # Token-bucket admission + overload governor (serve/admission.py):
         # the proxies consult it pre-queue; this control loop feeds it
         # queue-depth/compliance signals each step, and its governor
@@ -759,11 +775,18 @@ class ServeController:
         return deferred
 
     def _publish(self, state: _DeploymentState) -> None:
-        """Push the replica set to routers via long poll (ref long_poll)."""
+        """Push the replica set to routers via long poll (ref long_poll).
+        The in-process router object updates directly (it is the live
+        data plane the catalog adopts across failovers); the long-poll
+        NOTIFY — the out-of-process push edge — rides the fabric, so a
+        partitioned observer simply keeps its last snapshot and catches
+        up on heal (snapshot ids are monotone)."""
         state.router.update_replicas(state.replicas)
-        self.long_poll.notify_changed(
+        self.fabric.cast(
+            "controller.push", self.long_poll.notify_changed,
             REPLICA_SET_KEY.format(deployment=state.config.name),
             [r.replica_id for r in state.replicas],
+            src="controller", dst="router",
         )
 
     # --- control loop -----------------------------------------------------
@@ -819,14 +842,28 @@ class ServeController:
                 pub = fn()
             except Exception:  # noqa: BLE001 — stats must not stop control
                 continue
-            if pub and directory.publish(
-                r.replica_id, pub["page_size"], pub["digests"]
-            ):
-                changed = True
+            if not pub:
+                continue
+            try:
+                # Digest pushes ride the fabric: a controller partitioned
+                # from its routers leaves the directory on its LAST
+                # published set (stale steering hints degrade hit rate,
+                # never correctness — the replica-level cache still
+                # validates) and the next reachable tick republishes.
+                if self.fabric.call(
+                    "controller.digest_push", directory.publish,
+                    r.replica_id, pub["page_size"], pub["digests"],
+                    src="controller", dst="router",
+                ):
+                    changed = True
+            except FabricUnreachable:
+                continue
         if changed:
-            self.long_poll.notify_changed(
+            self.fabric.cast(
+                "controller.push", self.long_poll.notify_changed,
                 PREFIX_DIGEST_KEY.format(deployment=state.config.name),
                 directory.snapshot(),
+                src="controller", dst="router",
             )
 
     def _renew_leadership(self) -> bool:
@@ -839,14 +876,24 @@ class ServeController:
         if self._fenced:
             return False
         if isinstance(self.store, ReplicatedStore):
-            if not self.store.renew():
-                if self.store.acquire_leadership() is None:
-                    self._on_fenced(None)
-                    return False
-                logger.warning(
-                    "lease lapsed unclaimed; re-acquired at epoch %d",
-                    self.store.epoch,
-                )
+            try:
+                if not self.store.renew():
+                    if self.store.acquire_leadership() is None:
+                        self._on_fenced(None)
+                        return False
+                    logger.warning(
+                        "lease lapsed unclaimed; re-acquired at epoch %d",
+                        self.store.epoch,
+                    )
+            except FabricUnreachable as e:
+                # Partitioned from the lease or the log: NOT fenced —
+                # nobody provably took over. Skip the step and retry
+                # next tick; on heal the same owner re-acquires (same
+                # epoch) if no standby claimed the lapsed lease, or the
+                # acquire returns None and fences us properly.
+                logger.warning("leadership heartbeat unreachable "
+                               "(%s); skipping control step", e)
+                return False
         return True
 
     def _on_fenced(self, exc: Optional[StaleEpochError]) -> None:
@@ -937,6 +984,15 @@ class ServeController:
                 self._checkpoint()
         except StaleEpochError as e:
             self._on_fenced(e)  # falls through: deferred still runs
+        except FabricUnreachable as e:
+            # A partition opened MID-step (appends unreachable). The
+            # store's own bounded-window defense decides demotion; the
+            # controller just stops mutating this tick and retries — on
+            # a healed partition it resumes, on a lost lease the next
+            # _renew_leadership fences it. Deferred stops still run:
+            # their victims are already out of the routing set.
+            logger.warning("control step partitioned from the store "
+                           "(%s); retrying next tick", e)
         for action in deferred:  # blocking stops run outside the lock
             action()
 

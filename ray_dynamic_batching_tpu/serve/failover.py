@@ -43,6 +43,7 @@ from ray_dynamic_batching_tpu.engine.request import (
     RequestStale,
     now_ms,
 )
+from ray_dynamic_batching_tpu.serve.fabric import FabricUnreachable
 from ray_dynamic_batching_tpu.serve.grayhealth import median_or_zero
 from ray_dynamic_batching_tpu.utils.chaos import ChaosInjected
 from ray_dynamic_batching_tpu.utils.logging import get_logger
@@ -116,11 +117,15 @@ def is_retryable(exc: BaseException) -> bool:
 
     ``ChaosInjected`` is the test-harness stand-in for every injected
     fault (dropped RPC, killed batch) and classifies retryable;
+    ``FabricUnreachable`` (a control-plane message eaten by a partition
+    or the fabric chaos policy) likewise — the payload was never the
+    problem, a healed edge or a different replica may serve it;
     ``RequestStale``/``RequestDropped`` are shed outcomes (terminal by
     design); everything else — ``BadRequest``, user-callable exceptions,
     contract violations — is a non-retryable user/server error whose
     retry would just fail again."""
-    return isinstance(exc, (RetryableSystemError, ChaosInjected))
+    return isinstance(exc, (RetryableSystemError, ChaosInjected,
+                            FabricUnreachable))
 
 
 def is_shed(exc: BaseException) -> bool:

@@ -37,7 +37,23 @@ rounds each shard is blind to what the other ``N-1`` shards admitted in
 the window, so fleet over-admission versus the oracle is bounded by
 ``(N - 1) * rate * staleness`` (+ one request per shard of rounding) —
 tighten the gossip interval and the front door converges on the central
-bucket it replaces.
+bucket it replaces. That bound only holds while gossip FLOWS: a
+partitioned shard's staleness grows without limit, and with it the
+over-admission. The ledger therefore enforces its own staleness
+contract (ISSUE 12, opt-in via ``staleness_bound_s``): when any
+expected peer's newest state is older than the bound, the ledger DEGRADES
+fail-closed to a conservative local-fraction budget — own admissions
+against ``allowed / N`` — so a gossip-partitioned fleet in aggregate
+never exceeds the global allowance, at the price of under-admission
+until heal. The transition is audited (``ledger_degraded``), counted
+(``rdb_frontdoor_ledger_degraded_total``) and gauged; when gossip
+resumes the ledger re-converges to the exact merged fleet count and
+exits degraded mode.
+
+Partition seam: peer-state absorption (the partitionable shard↔shard
+edge) routes through the control fabric (``serve/fabric.py``), so the
+partition soak drops/delays/duplicates gossip with the same seeded
+policy the store and the long-poll channel ride.
 
 Clock-injected throughout: the sim twin (sim/frontdoor.py) runs shards,
 gossip, and budget math on the virtual clock, byte-deterministically.
@@ -53,6 +69,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
+from ray_dynamic_batching_tpu.serve.fabric import ControlFabric, default_fabric
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
 from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
@@ -75,6 +92,21 @@ FRONTDOOR_DRIFT = m.Gauge(
     "Fleet admitted minus central-oracle allowance (positive = "
     "over-admission within the gossip staleness bound)",
     tag_keys=("deployment",),
+)
+FRONTDOOR_LEDGER_DEGRADED = m.Counter(
+    "rdb_frontdoor_ledger_degraded_total",
+    "Ledger transitions into fail-closed degraded mode (peer gossip "
+    "staler than the bound: admit against the local fraction of the "
+    "global budget until heal)",
+    tag_keys=("deployment", "shard"),
+    bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K},
+)
+FRONTDOOR_LEDGER_DEGRADED_GAUGE = m.Gauge(
+    "rdb_frontdoor_ledger_degraded",
+    "1 while the shard's ledger for the deployment is in fail-closed "
+    "degraded mode, else 0",
+    tag_keys=("deployment", "shard"),
+    bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K},
 )
 
 
@@ -167,13 +199,37 @@ class GlobalAdmissionLedger:
     states arrive as serialized sketches and are kept BY REPLACEMENT
     keyed on shard id — merging happens at read time over own + latest
     peers, which makes gossip idempotent (delta-state CRDT) where naive
-    fold-on-receive would double-count every re-delivery."""
+    fold-on-receive would double-count every re-delivery.
 
-    def __init__(self, shard_id: str, budget: GlobalBudget) -> None:
+    Staleness contract (fail-closed, opt-in via ``staleness_bound_s``
+    > 0 with ``n_shards`` > 1): every absorb stamps arrival time; when
+    ANY expected peer's newest state is older than the bound — the
+    stalest peer governs, so a PARTIAL partition (same-side gossip
+    still fresh, the far side frozen) degrades exactly like a full one;
+    a peer never heard from counts from the budget anchor —
+    :meth:`check` degrades to own admissions against
+    ``allowed / n_shards``. All shards degrading independently still
+    sum to at most the global allowance — the partition can only
+    UNDER-admit, never over-admit unboundedly. Fresh gossip from every
+    peer clears the degradation and the merged count resumes
+    (re-convergence is automatic: the CRDT replacement needs no repair
+    pass). Departed shards are RETIRED (:meth:`retire_peer`): their
+    final history keeps counting but never goes stale, and the live
+    fleet width shrinks with them."""
+
+    def __init__(self, shard_id: str, budget: GlobalBudget,
+                 n_shards: int = 1,
+                 staleness_bound_s: float = 0.0) -> None:
         self.shard_id = shard_id
         self.budget = budget
+        self.n_shards = max(1, int(n_shards))
+        self.staleness_bound_s = float(staleness_bound_s)
         self._own = QuantileSketch(relative_accuracy=0.01)
         self._peers: Dict[str, Dict[str, Any]] = {}
+        self._peer_seen_at: Dict[str, float] = {}
+        self._static_peers: set = set()   # departed: final history, exempt
+        self.degraded = False
+        self.degraded_entries = 0    # transitions INTO degraded mode
 
     @property
     def own_count(self) -> int:
@@ -197,19 +253,66 @@ class GlobalAdmissionLedger:
             out.merge(p)
         return out
 
+    def peer_staleness_s(self, now: float) -> float:
+        """Age of the STALEST live peer's newest state (the budget
+        anchor stands in for peers never heard from). The stalest peer
+        governs because any invisible slice of the fleet voids the
+        merged count — a partial partition must fail closed exactly
+        like a full one."""
+        live = {sid: t for sid, t in self._peer_seen_at.items()
+                if sid not in self._static_peers}
+        ages = [now - t for t in live.values()]
+        if len(live) < self.n_shards - 1:
+            ages.append(now - self.budget.t0)
+        return max(0.0, max(ages)) if ages else 0.0
+
+    def stale(self, now: float) -> bool:
+        return (self.n_shards > 1
+                and self.staleness_bound_s > 0.0
+                and self.peer_staleness_s(now) > self.staleness_bound_s)
+
+    def refresh(self, now: float) -> None:
+        """Re-evaluate the degraded flag from the staleness contract
+        alone (no admission decision): gossip progress and the passage
+        of time must move the flag — and the gauge/audit riding it —
+        even for a deployment nobody is admitting through."""
+        self.degraded = self.stale(now)
+
+    def retire_peer(self, shard_id: str) -> None:
+        """A peer left the ring for good: its (final-flushed) history
+        keeps counting in the merged view but is exempt from the
+        staleness contract, and the live fleet width shrinks — the
+        degraded local fraction is a share of the SURVIVORS."""
+        self._static_peers.add(shard_id)
+        self._peer_seen_at.pop(shard_id, None)
+        self.n_shards = max(1, self.n_shards - 1)
+
     def check(self, now: float) -> Tuple[bool, float]:
         """(would_admit, retry_after_s) against the GLOBAL allowance as
         this shard currently sees it — read-only, so a later local-layer
         reject never burns a global token. The retry hint is when the
         allowance line reaches the known count — exact once gossip
-        catches up, conservative before."""
-        allowed = self.budget.allowed(now)
-        count = self.merged_count()
+        catches up, conservative before.
+
+        When peer gossip is staler than the bound, the decision
+        DEGRADES fail-closed: own admissions against the local fraction
+        ``allowed / n_shards`` (flagged on ``self.degraded``; the shard
+        audits and meters the transition)."""
+        if self.stale(now):
+            self.degraded = True
+            allowed = self.budget.allowed(now) / self.n_shards
+            count = self._own.count
+            rate = self.budget.rate_rps / self.n_shards
+        else:
+            self.degraded = False
+            allowed = self.budget.allowed(now)
+            count = self.merged_count()
+            rate = self.budget.rate_rps
         if count < allowed:
             return True, 0.0
-        if self.budget.rate_rps <= 0.0:
+        if rate <= 0.0:
             return False, 60.0  # administratively closed: poll slowly
-        return False, (count - allowed + 1.0) / self.budget.rate_rps
+        return False, (count - allowed + 1.0) / rate
 
     def commit(self, now: float) -> None:
         """Record one admission (after every layer passed)."""
@@ -226,13 +329,33 @@ class GlobalAdmissionLedger:
         """This shard's serialized contribution (gossip payload)."""
         return self._own.to_dict()
 
-    def absorb(self, shard_id: str, state: Dict[str, Any]) -> None:
+    def absorb(self, shard_id: str, state: Dict[str, Any],
+               now: Optional[float] = None) -> None:
+        """Keep ``shard_id``'s latest state by replacement; ``now``
+        stamps the arrival for the staleness contract. Idempotent and
+        reorder-safe by construction — a duplicated or late gossip
+        delivery replaces with the same (or an older) state, never
+        double-counts."""
         if shard_id == self.shard_id:
             return
-        self._peers[shard_id] = state
+        prev_state = self._peers.get(shard_id)
+        # A peer's own-admission count is monotone, so it doubles as the
+        # CRDT version: a reordered (late) delivery carrying an OLDER
+        # state must not rewind the newer one already absorbed.
+        if (prev_state is None
+                or int(state.get("count", 0))
+                >= int(prev_state.get("count", 0))):
+            self._peers[shard_id] = state
+        if now is not None:
+            prev = self._peer_seen_at.get(shard_id)
+            # The freshness stamp is monotone per peer too: a straggler
+            # delivery cannot rewind the staleness the contract judges.
+            if prev is None or now >= prev:
+                self._peer_seen_at[shard_id] = now
 
     def forget(self, shard_id: str) -> None:
         self._peers.pop(shard_id, None)
+        self._peer_seen_at.pop(shard_id, None)
 
 
 class GossipBus:
@@ -279,6 +402,8 @@ class FrontDoorShard:
         shard_id: str,
         clock: Callable[[], float] = time.monotonic,
         local: Optional[Any] = None,
+        n_shards: int = 1,
+        staleness_bound_s: float = 0.0,
     ) -> None:
         self.shard_id = str(shard_id)
         self._clock = clock
@@ -287,19 +412,75 @@ class FrontDoorShard:
         # global cap (checked first — the global budget is the outer
         # contract).
         self.local = local
+        # Fail-closed staleness contract knobs (0 disables — legacy
+        # fail-open); the FrontDoor sets them fleet-wide.
+        self.n_shards = max(1, int(n_shards))
+        self.staleness_bound_s = float(staleness_bound_s)
+        # Audit sink for ledger_degraded transitions (the FrontDoor
+        # shares its ring so degradations land next to drift audits).
+        self.audit: Optional[AuditLog] = None
         self._lock = threading.Lock()
         self._ledgers: Dict[str, GlobalAdmissionLedger] = {}
+        self._was_degraded: Dict[str, bool] = {}
         self.admitted = 0
         self.rejected = 0
+        self.degraded_rejects = 0
 
     def configure(self, deployment: str,
                   budget: Optional[GlobalBudget]) -> None:
         with self._lock:
             if budget is None:
                 self._ledgers.pop(deployment, None)
+                self._was_degraded.pop(deployment, None)
             else:
                 self._ledgers[deployment] = GlobalAdmissionLedger(
-                    self.shard_id, budget
+                    self.shard_id, budget,
+                    n_shards=self.n_shards,
+                    staleness_bound_s=self.staleness_bound_s,
+                )
+
+    def _note_degradation_edge(self, deployment: str,
+                               ledger: GlobalAdmissionLedger,
+                               now: float) -> None:
+        """Audit + meter the degraded-mode EDGES (called with the shard
+        lock held; transitions are rare, the steady state is one dict
+        probe + compare)."""
+        was = self._was_degraded.get(deployment, False)
+        if ledger.degraded == was:
+            return
+        self._was_degraded[deployment] = ledger.degraded
+        tags = {"deployment": deployment, "shard": self.shard_id}
+        if ledger.degraded:
+            ledger.degraded_entries += 1
+            FRONTDOOR_LEDGER_DEGRADED.inc(tags=tags)
+            FRONTDOOR_LEDGER_DEGRADED_GAUGE.set(1.0, tags=tags)
+            if self.audit is not None:
+                self.audit.record(
+                    "ledger_degraded",
+                    key=deployment,
+                    observed={
+                        "shard": self.shard_id,
+                        "peer_staleness_s": round(
+                            ledger.peer_staleness_s(now), 3),
+                        "bound_s": ledger.staleness_bound_s,
+                        "own_count": ledger.own_count,
+                        "local_fraction_allowance": round(
+                            ledger.budget.allowed(now) / ledger.n_shards,
+                            3),
+                    },
+                    note="peer gossip staler than the bound: fail-closed "
+                         "to the local-fraction budget until heal",
+                )
+        else:
+            FRONTDOOR_LEDGER_DEGRADED_GAUGE.set(0.0, tags=tags)
+            if self.audit is not None:
+                self.audit.record(
+                    "ledger_reconverged",
+                    key=deployment,
+                    observed={"shard": self.shard_id,
+                              "merged_count": ledger.merged_count()},
+                    note="gossip resumed inside the bound: merged fleet "
+                         "view restored",
                 )
 
     def admit(self, deployment: str, tenant: str = "",
@@ -317,9 +498,13 @@ class FrontDoorShard:
         with self._lock:
             ledger = self._ledgers.get(deployment)
             if ledger is not None:
-                ok, retry_after_s = ledger.check(self._clock())
+                now = self._clock()
+                ok, retry_after_s = ledger.check(now)
+                self._note_degradation_edge(deployment, ledger, now)
                 if not ok:
                     self.rejected += 1
+                    if ledger.degraded:
+                        self.degraded_rejects += 1
                     outcome = "reject"
                 else:
                     outcome = None
@@ -349,15 +534,44 @@ class FrontDoorShard:
 
     def absorb_states(self, shard_id: str,
                       states: Dict[str, Dict[str, Any]]) -> None:
+        """Absorb one peer's ledger states, stamped at DELIVERY time —
+        a fabric-delayed absorb arrives late and the staleness contract
+        must judge what this shard actually knew, not what was sent."""
         with self._lock:
+            now = self._clock()
             for dep, state in states.items():
                 ledger = self._ledgers.get(dep)
                 if ledger is not None:
-                    ledger.absorb(shard_id, state)
+                    ledger.absorb(shard_id, state, now=now)
 
     def ledger(self, deployment: str) -> Optional[GlobalAdmissionLedger]:
         with self._lock:
             return self._ledgers.get(deployment)
+
+    def refresh_degradation(self) -> None:
+        """Sweep every ledger's degraded flag from the staleness
+        contract and account the edges. Driven by the gossip round, so
+        an IDLE deployment still degrades when its peers go silent and
+        — critically — re-converges (gauge back to 0, audited) on heal
+        instead of standing as a false alarm until the next admission
+        happens to arrive."""
+        with self._lock:
+            now = self._clock()
+            for dep, ledger in self._ledgers.items():
+                ledger.refresh(now)
+                self._note_degradation_edge(dep, ledger, now)
+
+    def ledger_snapshot(self) -> Dict[str, Any]:
+        """Degradation view for stats(): transition count + which
+        deployments are currently fail-closed."""
+        with self._lock:
+            return {
+                "degraded_entries": sum(lg.degraded_entries
+                                        for lg in self._ledgers.values()),
+                "degraded_now": sorted(dep for dep, lg in
+                                       self._ledgers.items()
+                                       if lg.degraded),
+            }
 
 
 class FrontDoor:
@@ -374,11 +588,21 @@ class FrontDoor:
         gossip_interval_s: float = 0.2,
         vnodes: int = 64,
         local_admission_factory: Optional[Callable[[], Any]] = None,
+        fabric: Optional[ControlFabric] = None,
+        staleness_bound_s: float = 0.0,
     ) -> None:
         if n_shards <= 0:
             raise ValueError(f"n_shards must be positive, got {n_shards}")
         self._clock = clock
         self.gossip_interval_s = float(gossip_interval_s)
+        # The shard↔shard absorb edge routes through the fabric so a
+        # partition/chaos policy applies to gossip; unconfigured it is
+        # the zero-overhead passthrough.
+        self.fabric = fabric if fabric is not None else default_fabric()
+        # Fail-closed staleness bound per ledger (0 = disabled). A sane
+        # arming is a few gossip intervals: missing one round is jitter,
+        # missing several is a partition.
+        self.staleness_bound_s = float(staleness_bound_s)
         self.bus = GossipBus()
         self.shards: Dict[str, FrontDoorShard] = {}
         ids = [f"fd-{i}" for i in range(n_shards)]
@@ -387,6 +611,8 @@ class FrontDoor:
                 sid, clock=clock,
                 local=(local_admission_factory()
                        if local_admission_factory is not None else None),
+                n_shards=n_shards,
+                staleness_bound_s=self.staleness_bound_s,
             )
         self.ring = HashRing(ids, vnodes=vnodes)
         self._budgets: Dict[str, GlobalBudget] = {}
@@ -396,7 +622,11 @@ class FrontDoor:
         self._departed_admitted: Dict[str, int] = {}
         # Drift audits land next to heals/replans/governor flips — the
         # front door is a control plane and owes the same paper trail.
+        # Shards share the ring so ledger_degraded transitions file into
+        # the same timeline as the drift they bound.
         self.audit = AuditLog("frontdoor", now=clock)
+        for shard in self.shards.values():
+            shard.audit = self.audit
         self.gossip_rounds = 0
         self._last_gossip_at = clock()
         self._stop = threading.Event()
@@ -421,6 +651,9 @@ class FrontDoor:
         for shard in self.shards.values():
             shard.configure(deployment, budget)
 
+    def budget(self, deployment: str) -> Optional[GlobalBudget]:
+        return self._budgets.get(deployment)
+
     # --- routing + admission ----------------------------------------------
     def shard_for(self, key: str) -> FrontDoorShard:
         return self.shards[self.ring.shard_for(key)]
@@ -440,13 +673,28 @@ class FrontDoor:
         """One full exchange: every shard publishes, every shard absorbs
         every peer's latest. Deterministic (sorted shard order) — the
         sim twin calls this on virtual-time ticks; live mode calls it
-        from the gossip thread."""
+        from the gossip thread.
+
+        The PARTITIONABLE edge is peer→shard absorption, routed through
+        the fabric with the peer as ``src`` and the reader as ``dst``:
+        a node-group partition drops exactly the cross-side exchanges
+        while same-side gossip keeps flowing — the asymmetry the
+        fail-closed staleness contract is tested against. The board
+        publish/collect itself is a process-local snapshot (each shard
+        logically owns its slice), so those stay direct."""
         for sid in sorted(self.shards):
-            self.bus.publish(sid, self.shards[sid].gossip_states())
+            self.bus.publish(sid, self.shards[sid].gossip_states())  # rdb-lint: disable=fabric-discipline (publish lands on the shard's own board slice — the network edge is the peer→shard absorb below)
         for sid in sorted(self.shards):
             shard = self.shards[sid]
-            for peer_id, states in self.bus.collect(sid):
-                shard.absorb_states(peer_id, states)
+            for peer_id, states in self.bus.collect(sid):  # rdb-lint: disable=fabric-discipline (collect reads the local board snapshot; delivery to the reader is the fabric-routed absorb)
+                self.fabric.cast(
+                    "frontdoor.gossip", shard.absorb_states, peer_id,
+                    states, src=peer_id, dst=sid,
+                )
+            # Degradation edges move with GOSSIP progress, not only
+            # admission traffic: an idle deployment's gauge must clear
+            # on heal and set on silence all the same.
+            shard.refresh_degradation()
             FRONTDOOR_GOSSIP.inc(tags={"shard": sid})
         self.gossip_rounds += 1
         self._last_gossip_at = self._clock()
@@ -464,9 +712,15 @@ class FrontDoor:
             return
         self.ring.remove(shard_id)
         departed = self.shards.pop(shard_id)
+        # Nobody will ever refresh the departed shard's gauge series
+        # again: clear it now or a shard removed mid-degradation stands
+        # as a false alarm forever.
+        for dep in self._budgets:
+            FRONTDOOR_LEDGER_DEGRADED_GAUGE.set(
+                0.0, tags={"deployment": dep, "shard": shard_id})
         # Final flush: peers must account the departed shard's full
         # history or the fleet view under-counts forever.
-        self.bus.publish(shard_id, departed.gossip_states())
+        self.bus.publish(shard_id, departed.gossip_states())  # rdb-lint: disable=fabric-discipline (membership admin runs where the board lives; a shard leaves the ring exactly once, not over a partitionable edge)
         # And the ORACLE must too: true_admitted sums live shards' own
         # counts, so the departed shard's history moves to a baseline.
         for dep in self._budgets:
@@ -476,8 +730,21 @@ class FrontDoor:
                     self._departed_admitted.get(dep, 0) + ledger.own_count
                 )
         for sid in sorted(self.shards):
-            for peer_id, states in self.bus.collect(sid):
-                self.shards[sid].absorb_states(peer_id, states)
+            for peer_id, states in self.bus.collect(sid):  # rdb-lint: disable=fabric-discipline (same admin pass: survivors adopt the departed history synchronously so the oracle never under-counts)
+                self.shards[sid].absorb_states(peer_id, states)  # rdb-lint: disable=fabric-discipline (membership flush must be atomic with the ring change — deferring it through chaos would double- or zero-count the departed shard)
+            # The departed shard's history is final: exempt it from the
+            # staleness contract and shrink the live fleet width, or the
+            # survivors would degrade fail-closed forever on a peer that
+            # can never gossip again.
+            for dep in self._budgets:
+                ledger = self.shards[sid].ledger(dep)
+                if ledger is not None:
+                    ledger.retire_peer(shard_id)
+            # Ledgers configured AFTER this removal must be born at the
+            # surviving fleet width too — a new deployment sized for the
+            # old N would wait forever on a peer that no longer exists
+            # and degrade fail-closed permanently.
+            self.shards[sid].n_shards = len(self.shards)
         self.audit.record(
             "shard_removed",
             observed={"shard": shard_id,
@@ -565,7 +832,9 @@ class FrontDoor:
     def stats(self) -> Dict[str, Any]:
         return {
             "shards": {
-                sid: {"admitted": s.admitted, "rejected": s.rejected}
+                sid: {"admitted": s.admitted, "rejected": s.rejected,
+                      "degraded_rejects": s.degraded_rejects,
+                      **s.ledger_snapshot()}
                 for sid, s in sorted(self.shards.items())
             },
             "gossip_rounds": self.gossip_rounds,

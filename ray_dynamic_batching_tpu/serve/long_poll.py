@@ -10,6 +10,17 @@ per-request control traffic (SURVEY.md §3.5 note).
 
 In-process design: a condition variable replaces the RPC long poll; the
 client is a daemon thread re-arming the listen, same contract.
+
+Partition seam (ISSUE 12): the client's listen — the router/handle →
+controller edge — routes through the control fabric
+(``long_poll.listen``). A partitioned listen raises
+:class:`~ray_dynamic_batching_tpu.serve.fabric.FabricUnreachable`; the
+client treats it exactly like a timed-out poll and re-arms, so a router
+cut off from the controller keeps serving its LAST pushed state (stale
+but consistent — the reference's long-poll clients behave the same) and
+reconverges on heal because snapshot ids are monotone: every re-armed
+listen asks for "anything newer than what I have", which makes missed
+pushes self-healing and duplicated pushes no-ops.
 """
 
 from __future__ import annotations
@@ -17,6 +28,11 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_dynamic_batching_tpu.serve.fabric import (
+    ControlFabric,
+    FabricUnreachable,
+    default_fabric,
+)
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("long_poll")
@@ -78,10 +94,15 @@ class LongPollClient:
         host: LongPollHost,
         callbacks: Dict[str, Callable[[Any], None]],
         poll_timeout_s: float = 1.0,
+        fabric: Optional[ControlFabric] = None,
+        node: str = "router",
     ) -> None:
         self.host = host
         self.callbacks = dict(callbacks)
         self.poll_timeout_s = poll_timeout_s
+        self.fabric = fabric if fabric is not None else default_fabric()
+        self.node = node
+        self.unreachable_polls = 0
         self._ids: Dict[str, int] = {k: -1 for k in callbacks}
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -92,8 +113,10 @@ class LongPollClient:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                updates = self.host.listen_for_change(
-                    dict(self._ids), timeout_s=self.poll_timeout_s
+                updates = self.fabric.call(
+                    "long_poll.listen", self.host.listen_for_change,
+                    dict(self._ids), timeout_s=self.poll_timeout_s,
+                    src=self.node, dst="controller",
                 )
                 for key, (sid, value) in updates.items():
                     self._ids[key] = sid
@@ -101,6 +124,13 @@ class LongPollClient:
                         self.callbacks[key](value)
                     except Exception:  # noqa: BLE001 — bad callback must not kill poller
                         logger.exception("long-poll callback for %r failed", key)
+            except FabricUnreachable:
+                # Partitioned from the controller: behave like a timeout
+                # — keep last-known state, back off one window, re-arm.
+                # Snapshot ids are monotone, so the first post-heal
+                # listen returns everything missed in one response.
+                self.unreachable_polls += 1
+                self._stop.wait(self.poll_timeout_s)
             except Exception:  # noqa: BLE001
                 logger.exception("long-poll listen failed")
                 self._stop.wait(self.poll_timeout_s)

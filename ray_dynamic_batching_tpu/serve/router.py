@@ -240,6 +240,15 @@ class PrefixDigestDirectory:
     evicted and not spilled — stops matching immediately), and
     :meth:`prune` drops departed replicas with the replica set. Thread-
     safe; reads are lock + dict probes.
+
+    Partition semantics (ISSUE 12): the controller feeds this directory
+    over the fabric's ``controller.digest_push`` edge, so publishes can
+    be dropped, duplicated, or arrive late. Replacement makes all three
+    harmless: a duplicated publish of the same set is detected unchanged
+    (returns False, no long-poll notify), a dropped one leaves the LAST
+    advertised set steering (stale hints degrade hit-rate, never
+    correctness — the replica-level cache still validates), and the
+    next reachable control tick republishes the truth.
     """
 
     def __init__(self, max_digests_per_replica: int = 256) -> None:

@@ -33,6 +33,32 @@ steady-state control loop appends nothing) because the lint rule
 any bare attribute write to controller-owned state outside a
 ``with store.txn() as t:`` block is a finding. The discipline is what
 keeps "replicated store" from rotting back into "a dict plus hope".
+
+Partition defense (ISSUE 12):
+
+- **One clock.** ``StoreLog`` record stamps, ``LeaderLease`` expiry, and
+  the control fabric all read ONE injected clock (live default:
+  ``time.monotonic``; sim: the virtual clock). The lease judges expiry
+  on ITS OWN clock — the grantor's — so a renewer with a skewed clock
+  can never extend real leadership beyond ``duration_s`` of grantor
+  time.
+- **The fabric seam.** Every cross-component exchange — append, read,
+  fence, snapshot, lease acquire/renew — routes through a
+  :class:`~ray_dynamic_batching_tpu.serve.fabric.ControlFabric`
+  (``fabric-discipline`` lint rule), so a partition or chaos policy
+  applies to the store exactly like to gossip.
+- **Split-brain self-demotion.** Lease and log are ONE failure domain:
+  a leader whose appends fail REACHABILITY (not just epoch) for a
+  bounded window self-demotes (``store_unreachable`` audit) and stops
+  renewing, instead of serving stale state until the fence finally
+  catches it. On heal, the same owner may re-acquire (same epoch, no
+  fence) if nobody took over meanwhile.
+- **Snapshots + log compaction.** The leader takes an epoch-consistent
+  :class:`StoreSnapshot` at the commit point every ``snapshot_every``
+  records and truncates the log behind it; standby recovery is
+  snapshot + tail replay, so failover time is O(tail), not O(uptime).
+  ``read_from`` of a compacted index raises :class:`CompactedLogError`
+  loudly — never a silent gap.
 """
 
 from __future__ import annotations
@@ -43,6 +69,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_dynamic_batching_tpu.serve.fabric import (
+    ControlFabric,
+    FabricUnreachable,
+    default_fabric,
+)
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("store")
@@ -61,6 +92,21 @@ class StaleEpochError(RuntimeError):
         self.fence = fence
 
 
+class CompactedLogError(RuntimeError):
+    """A read asked for records the log already truncated behind a
+    snapshot. Failing LOUDLY here is the contract: silently returning
+    the surviving suffix would hand a standby a state with an invisible
+    gap — the most dangerous kind of divergence. The reader must
+    restore the latest snapshot, then re-read from its index."""
+
+    def __init__(self, message: str, index: int, first_index: int,
+                 snapshot_index: int) -> None:
+        super().__init__(message)
+        self.index = index
+        self.first_index = first_index
+        self.snapshot_index = snapshot_index
+
+
 @dataclass
 class LogRecord:
     """One committed transaction: the unit of replication."""
@@ -68,12 +114,30 @@ class LogRecord:
     index: int                  # position in the log, 0-based, dense
     epoch: int                  # writer's leadership epoch
     ops: List[Tuple[str, str, Optional[str]]]  # ("put", k, v) | ("delete", k, None)
-    wall_time: float = 0.0
+    wall_time: float = 0.0      # control-plane clock stamp (shared clock)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"index": self.index, "epoch": self.epoch,
                 "ops": [list(op) for op in self.ops],
                 "wall_time": self.wall_time}
+
+
+@dataclass(frozen=True)
+class StoreSnapshot:
+    """An epoch-consistent image of the store at a commit point.
+
+    ``index`` is the NEXT log index after the last transaction the
+    snapshot includes (== the taker's applied_index at the commit
+    point); replaying the log from ``index`` on top of ``data`` is
+    exactly equivalent to replaying the whole log — even when the tail
+    carries a LATER epoch's records (a takeover between snapshot and
+    restore): restore sets the reader's cursor to ``index``, so the
+    newer-epoch tail replays exactly once, never double-applies."""
+
+    index: int
+    epoch: int
+    version: int                 # committed-txn watermark at the point
+    data: Dict[str, str]
 
 
 class StoreLog:
@@ -84,23 +148,41 @@ class StoreLog:
     against the fence and either commits or raises
     :class:`StaleEpochError`. ``fence_to`` only ever raises the fence
     (monotone), so a deposed leader can never re-open its own window.
-    """
 
-    def __init__(self, now: Callable[[], float] = time.time) -> None:
+    Compaction: :meth:`install_snapshot` records the latest snapshot and
+    truncates every record below its index — and ONLY below it, so a
+    suffix the snapshot does not cover can never be orphaned. ``clock``
+    is the shared control-plane clock (the same instance the lease and
+    the fabric read)."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
         self._records: List[LogRecord] = []
+        self._first_index = 0          # index of _records[0] (post-compaction)
+        self._snapshot: Optional[StoreSnapshot] = None
         self._fence_epoch = 0
         self._lock = threading.Lock()
-        self._now = now
+        self._clock = clock
         self.rejected_appends = 0
+        self.appended_total = 0        # survives compaction (uptime proxy)
 
     @property
     def fence_epoch(self) -> int:
         with self._lock:
             return self._fence_epoch
 
+    @property
+    def first_index(self) -> int:
+        with self._lock:
+            return self._first_index
+
     def __len__(self) -> int:
+        """Records currently RETAINED (the replayable tail)."""
         with self._lock:
             return len(self._records)
+
+    def next_index(self) -> int:
+        with self._lock:
+            return self._first_index + len(self._records)
 
     def fence_to(self, epoch: int) -> None:
         """Raise the fence (monotone): appends below ``epoch`` now fail."""
@@ -124,15 +206,58 @@ class StoreLog:
                     epoch=epoch, fence=self._fence_epoch,
                 )
             rec = LogRecord(
-                index=len(self._records), epoch=epoch, ops=list(ops),
-                wall_time=self._now(),
+                index=self._first_index + len(self._records), epoch=epoch,
+                ops=list(ops), wall_time=self._clock(),
             )
             self._records.append(rec)
+            self.appended_total += 1
             return rec.index
 
     def read_from(self, index: int) -> List[LogRecord]:
+        """Records at ``index`` and after. Asking below the compaction
+        horizon raises :class:`CompactedLogError` — restore the latest
+        snapshot and re-read from its index instead."""
         with self._lock:
-            return list(self._records[index:])
+            if index < self._first_index:
+                raise CompactedLogError(
+                    f"read_from({index}) below the compaction horizon "
+                    f"(first retained index {self._first_index}): the "
+                    "records were truncated behind a snapshot — restore "
+                    "it, then replay the tail",
+                    index=index, first_index=self._first_index,
+                    snapshot_index=(self._snapshot.index
+                                    if self._snapshot is not None else -1),
+                )
+            return list(self._records[index - self._first_index:])
+
+    # --- snapshot + compaction --------------------------------------------
+    def install_snapshot(self, snap: StoreSnapshot) -> None:
+        """Record ``snap`` as the latest snapshot and truncate the log
+        strictly BEHIND it. A snapshot claiming records that were never
+        committed (index beyond the log head) or regressing behind the
+        current horizon is rejected — truncation can never orphan an
+        un-snapshotted suffix because only this method truncates, and
+        only up to an index the snapshot provably covers."""
+        with self._lock:
+            head = self._first_index + len(self._records)
+            if snap.index > head:
+                raise ValueError(
+                    f"snapshot at index {snap.index} claims records the "
+                    f"log never committed (head {head}) — refusing to "
+                    "truncate an un-snapshotted suffix"
+                )
+            if snap.index < self._first_index:
+                raise ValueError(
+                    f"snapshot at index {snap.index} regresses behind the "
+                    f"compaction horizon ({self._first_index})"
+                )
+            self._snapshot = snap
+            self._records = self._records[snap.index - self._first_index:]
+            self._first_index = snap.index
+
+    def latest_snapshot(self) -> Optional[StoreSnapshot]:
+        with self._lock:
+            return self._snapshot
 
 
 class LeaderLease:
@@ -142,12 +267,19 @@ class LeaderLease:
     already held by ``owner``; a NEW holder bumps the epoch. ``renew``
     extends the current holder's window. The clock is injected so the
     simulator drives lease expiry on virtual time and the failover test
-    can expire a lease deterministically instead of sleeping."""
+    can expire a lease deterministically instead of sleeping.
+
+    Clock-skew contract: expiry is judged on THIS lease's injected
+    clock — the grantor's — at the moment of each call. ``renew`` takes
+    no timestamp from the renewer, so a renewer whose own clock runs
+    fast or slow can never stretch real leadership beyond ``duration_s``
+    of grantor time per renewal (pinned by the skew test)."""
 
     def __init__(self, duration_s: float = 5.0,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self.duration_s = float(duration_s)
-        self._clock = clock
+        self.clock = clock
+        self._clock = clock  # internal alias (one source, read everywhere)
         self._lock = threading.Lock()
         self._holder: Optional[str] = None
         self._epoch = 0
@@ -325,14 +457,57 @@ class ReplicatedStore(ControllerStore):
     leader's transactions commit. A standby calls :meth:`catch_up` to
     replay new records and :meth:`acquire_leadership` to take over when
     the lease lapses.
-    """
 
-    def __init__(self, log: StoreLog, lease: LeaderLease, owner: str) -> None:
+    Partition defense: every log/lease exchange routes through
+    ``fabric`` (the message seam), and lease + log are treated as ONE
+    failure domain — a leader whose appends are UNREACHABLE for
+    ``unreachable_demote_after_s`` self-demotes (audited
+    ``store_unreachable``) and stops renewing, so the standby on the
+    log's side of the partition takes over within one lease window
+    instead of the old leader serving stale state until fenced.
+    ``snapshot_every > 0`` arms log compaction: an epoch-consistent
+    snapshot at the commit point every N records, recovery = snapshot +
+    tail replay (O(tail), not O(uptime))."""
+
+    def __init__(self, log: StoreLog, lease: LeaderLease, owner: str,
+                 fabric: Optional[ControlFabric] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 snapshot_every: int = 0,
+                 unreachable_demote_after_s: Optional[float] = None) -> None:
         super().__init__()
         self.log = log
         self.lease = lease
         self.owner = owner
+        self.fabric = fabric if fabric is not None else default_fabric()
+        # ONE control-plane clock: default to the lease's (the grantor's)
+        # so log stamps, lease expiry, and the demotion window agree.
+        self._clock = clock if clock is not None else lease.clock
+        self.snapshot_every = int(snapshot_every)
+        # Demote well inside one lease window: the standby must find the
+        # lease lapsed at most one duration after the leader went blind.
+        self.unreachable_demote_after_s = (
+            float(unreachable_demote_after_s)
+            if unreachable_demote_after_s is not None
+            else lease.duration_s / 2.0
+        )
+        self._unreachable_since: Optional[float] = None
         self._repl = _ReplicaState()
+        self.self_demotions = 0
+        self.snapshots_taken = 0
+        # How the last catch_up reconstructed state (the failover-time
+        # ratchet reads this): records replayed, and whether a snapshot
+        # seeded the replay.
+        self.last_recovery: Dict[str, int] = {
+            "snapshot_index": -1, "tail_replayed": 0,
+        }
+        # Worst single replay any catch_up ever did: the O(tail) ratchet
+        # pins this against snapshot_every — with compaction armed it
+        # stays bounded no matter how long the log's total history is.
+        self.max_tail_replayed = 0
+        # Optional structured audit ring (scheduler/audit.py); the
+        # controller shares its own so store_unreachable lands next to
+        # heals and fences.
+        self.audit: Optional[Any] = None
 
     # --- leadership -------------------------------------------------------
     @property
@@ -345,15 +520,30 @@ class ReplicatedStore(ControllerStore):
     def acquire_leadership(self) -> Optional[int]:
         """Take the lease (if free/expired), replay the whole log, and
         fence out the previous epoch. Returns the new epoch, or None
-        while another leader's lease is live. Replay BEFORE fencing
-        would race the old leader's final commits; fencing first means
-        everything replayed is everything that will ever exist below
-        this epoch."""
-        epoch = self.lease.acquire(self.owner)
+        while another leader's lease is live; raises
+        :class:`FabricUnreachable` when the log cannot be reached —
+        leadership is NOT assumed on a partial acquire (a lease without
+        a replayed, fenced log is exactly the split-brain this layer
+        exists to prevent). Replay BEFORE fencing would race the old
+        leader's final commits; fencing first means everything replayed
+        is everything that will ever exist below this epoch."""
+        # Probe the log BEFORE touching the lease: same-holder acquire
+        # EXTENDS the lease window, so a self-demoted leader that is
+        # partitioned from the log but not the lease would otherwise
+        # keep re-extending its own lease on every retry and lock the
+        # reachable standby out forever — the quiet split-brain this
+        # whole layer exists to prevent. No log, no candidacy.
+        self.catch_up()  # raises FabricUnreachable when the log is cut off
+        epoch = self.fabric.call(
+            "lease.acquire", self.lease.acquire, self.owner,
+            src=self.owner, dst="lease",
+        )
         if epoch is None:
             return None
-        self.log.fence_to(epoch)
+        self.fabric.call("store.fence", self.log.fence_to, epoch,
+                         src=self.owner, dst="log")
         self.catch_up()
+        self._unreachable_since = None
         self._repl.epoch = epoch
         self._repl.is_leader = True
         logger.info("%s: leadership acquired at epoch %d (log index %d)",
@@ -361,23 +551,160 @@ class ReplicatedStore(ControllerStore):
         return epoch
 
     def renew(self) -> bool:
-        """Heartbeat; False demotes this instance (stop leading)."""
-        ok = self.lease.renew(self.owner)
+        """Heartbeat; False demotes this instance (stop leading). A
+        self-demoted instance (appends unreachable) returns False
+        WITHOUT renewing: deliberately letting the lease lapse is what
+        hands leadership to a standby that can still reach the log —
+        renewing a lease you cannot write under IS the split-brain.
+
+        Lease and log are ONE failure domain: a successful lease renew
+        also PROBES the log — a tail read carried on the ``store.append``
+        edge, the same channel commits use — so the bounded
+        self-demotion window runs even while the control loop is
+        quiescent (elided steady-state transactions append nothing, and
+        without the probe an idle leader would happily renew through a
+        partition it could never write across)."""
+        if not self._repl.is_leader:
+            return False
+        try:
+            ok = self.fabric.call("lease.renew", self.lease.renew,
+                                  self.owner, src=self.owner, dst="lease")
+        except FabricUnreachable:
+            ok = False
         if not ok and self._repl.is_leader:
             self._repl.is_leader = False
             logger.warning("%s: lease lost (epoch %d); demoted",
                            self.owner, self._repl.epoch)
+            return False
+        if ok:
+            try:
+                # The probe rides the APPEND edge (it is a heartbeat-
+                # append in spirit): a fault that eats only appends must
+                # open — and keep open — the same unreachability window
+                # real commits do. Probing a different edge would let a
+                # healthy read channel mask a dead write channel and
+                # the leader would renew forever over a log it can
+                # never write to.
+                self.fabric.call(
+                    "store.append", self.log.read_from,
+                    self._repl.applied_index, src=self.owner, dst="log",
+                )
+                self._unreachable_since = None
+            except FabricUnreachable:
+                self._note_unreachable()  # may self-demote (bounded)
+                return self._repl.is_leader
         return ok
 
     def catch_up(self) -> int:
         """Apply records this instance has not seen; returns how many.
         Standbys call this on their watch loop; a fresh leader calls it
-        inside :meth:`acquire_leadership`."""
-        new = self.log.read_from(self._repl.applied_index)
+        inside :meth:`acquire_leadership`. When the cursor has fallen
+        behind the compaction horizon, restore the latest snapshot and
+        replay only the tail — the O(tail) failover path. The snapshot
+        may be an OLDER epoch's than the tail (takeover raced the
+        snapshot): restore moves the cursor to the snapshot index, so
+        the newer-epoch tail applies exactly once."""
+        restored_index = -1
+        while True:
+            try:
+                new = self.fabric.call(
+                    "store.read", self.log.read_from,
+                    self._repl.applied_index, src=self.owner, dst="log",
+                )
+                break
+            except CompactedLogError:
+                # The leader may compact AGAIN between our restore and
+                # the tail read (it keeps committing while we recover);
+                # each retry restores a strictly newer snapshot — the
+                # cursor only moves forward — so the loop terminates.
+                snap = self.fabric.call(
+                    "store.snapshot", self.log.latest_snapshot,
+                    src=self.owner, dst="log",
+                )
+                if snap is None or snap.index <= self._repl.applied_index:
+                    # Compacted with no (or a non-advancing) snapshot:
+                    # impossible by install_snapshot's construction —
+                    # fail loud rather than spin.
+                    raise
+                self._restore(snap)
+                restored_index = snap.index
         for rec in new:
             self._apply(rec.ops)
             self._repl.applied_index = rec.index + 1
+        if restored_index >= 0 or new:
+            # A no-op poll leaves the stats alone so the LAST real
+            # recovery (the failover's snapshot + tail replay — what the
+            # O(tail) ratchet grades) stays readable.
+            self.last_recovery = {"snapshot_index": restored_index,
+                                  "tail_replayed": len(new)}
+            self.max_tail_replayed = max(self.max_tail_replayed, len(new))
         return len(new)
+
+    def _restore(self, snap: StoreSnapshot) -> None:
+        """Replace local state wholesale with the snapshot image and move
+        the replay cursor to its index (never double-apply: everything
+        below the index is IN the image, everything at/after it replays
+        from the tail)."""
+        with self._lock:
+            self._data = dict(snap.data)
+            self._version = snap.version
+        self._repl.applied_index = snap.index
+        logger.info("%s: restored snapshot at index %d (epoch %d)",
+                    self.owner, snap.index, snap.epoch)
+
+    # --- split-brain defense ----------------------------------------------
+    def _note_unreachable(self) -> None:
+        now = self._clock()
+        if self._unreachable_since is None:
+            self._unreachable_since = now
+            return
+        window = now - self._unreachable_since
+        if window >= self.unreachable_demote_after_s and self._repl.is_leader:
+            self._repl.is_leader = False
+            self.self_demotions += 1
+            logger.error(
+                "%s: log unreachable for %.3fs (bound %.3fs) at epoch %d — "
+                "self-demoting; the lease will lapse and a standby that can "
+                "reach the log takes over",
+                self.owner, window, self.unreachable_demote_after_s,
+                self._repl.epoch,
+            )
+            if self.audit is not None:
+                self.audit.record(
+                    "store_unreachable",
+                    observed={"owner": self.owner,
+                              "epoch": self._repl.epoch,
+                              "unreachable_s": round(window, 3),
+                              "bound_s": self.unreachable_demote_after_s},
+                    note="appends failed reachability for the bounded "
+                         "window; self-demoted instead of serving stale "
+                         "state until fenced",
+                )
+
+    # --- snapshots ---------------------------------------------------------
+    def _maybe_snapshot(self) -> None:
+        """At the commit point (just appended + applied): if the
+        replayable tail outgrew ``snapshot_every``, publish an
+        epoch-consistent snapshot and compact the log behind it. A
+        snapshot that cannot reach the log is skipped — it is an
+        optimization, never a correctness dependency."""
+        if self.snapshot_every <= 0:
+            return
+        if self._repl.applied_index - self.log.first_index \
+                < self.snapshot_every:
+            return
+        snap = StoreSnapshot(
+            index=self._repl.applied_index,
+            epoch=self._repl.epoch,
+            version=self.version,
+            data=self.snapshot(),
+        )
+        try:
+            self.fabric.call("store.snapshot", self.log.install_snapshot,
+                             snap, src=self.owner, dst="log")
+        except FabricUnreachable:
+            return
+        self.snapshots_taken += 1
 
     # --- write side (fenced) ----------------------------------------------
     def _commit(self, ops: List[Tuple[str, str, Optional[str]]]) -> None:
@@ -387,9 +714,18 @@ class ReplicatedStore(ControllerStore):
                 f"(epoch {self._repl.epoch}, fence {self.log.fence_epoch})",
                 epoch=self._repl.epoch, fence=self.log.fence_epoch,
             )
-        index = self.log.append(self._repl.epoch, ops)  # raises when fenced
+        try:
+            index = self.fabric.call(
+                "store.append", self.log.append, self._repl.epoch, ops,
+                src=self.owner, dst="log",
+            )  # raises StaleEpochError when fenced
+        except FabricUnreachable:
+            self._note_unreachable()
+            raise
+        self._unreachable_since = None
         self._apply(ops)
         self._repl.applied_index = index + 1
+        self._maybe_snapshot()
 
 
 class ReplicaCatalog:

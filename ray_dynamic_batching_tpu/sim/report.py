@@ -151,6 +151,41 @@ def format_gray_timeline(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def format_partition_story(report: Dict[str, Any]) -> str:
+    """Terminal block for one partition-sim arm (sim/frontdoor.
+    run_partition_sim): the leadership story, the replay cost, the
+    over-admission vs its fail-closed bound, and per-shard ledger
+    degradation — the human-readable face of what the soak gate pins."""
+    st = report.get("store", {})
+    lines = [
+        f"partition[{report.get('scenario', {}).get('name', '?')}] "
+        f"leader={st.get('leader')} epoch={st.get('epoch')} "
+        f"self_demotions={st.get('self_demotions')} "
+        f"split_brain_commits={st.get('split_brain_commits')} "
+        f"fence_rejections={st.get('rejected_appends')}",
+        f"  log: appended_total={st.get('appended_total')} "
+        f"tail={st.get('log_tail_records')} "
+        f"max_tail_replayed={st.get('max_tail_replayed')} "
+        f"snapshots={st.get('snapshots_taken')}",
+        f"  budget: max_over_admitted={report.get('max_over_admitted')} "
+        f"bound={report.get('degrade_bound')} "
+        f"reconverged={report.get('reconverged')}",
+    ]
+    for fo in st.get("failovers", []):
+        lines.append(
+            f"  failover @{fo['at_s']}s -> {fo['owner']} "
+            f"epoch {fo['epoch']} (snapshot_index={fo['snapshot_index']}, "
+            f"tail_replayed={fo['tail_replayed']})"
+        )
+    for sid, lg in sorted((report.get("ledgers") or {}).items()):
+        if lg.get("degraded_entries"):
+            lines.append(
+                f"  ledger {sid}: degraded {lg['degraded_entries']}x, "
+                f"merged={lg['merged']} stale_at_end={lg['stale_at_end']}"
+            )
+    return "\n".join(lines)
+
+
 def _round(value: Any, nd: int = 4) -> Any:
     if isinstance(value, float):
         return round(value, nd)

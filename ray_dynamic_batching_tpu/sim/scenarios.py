@@ -12,7 +12,8 @@ CPU tables are re-measured); committed-table replays go through
 
 from __future__ import annotations
 
-from typing import Dict
+from dataclasses import dataclass
+from typing import Dict, Tuple
 
 from ray_dynamic_batching_tpu.engine.workload import RatePattern
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
@@ -325,6 +326,134 @@ def slice_failure_scenario(seed: int = 0) -> Scenario:
         seed=seed,
         monitoring_interval_s=2.0,
         failures=[EngineFailure(at_s=10.0, engine=0, chip=1)],
+    )
+
+
+# --- control-plane partition matrix (ISSUE 12) ------------------------------
+#
+# These fixtures parameterize sim/frontdoor.run_partition_sim, which rides
+# the REAL fabric/store/frontdoor classes on the virtual clock — the same
+# objects the live soak partitions, not simplified stand-ins. Node names:
+# controllers ctl-A (initial leader) / ctl-B (cold standby), store
+# substrate "log" + "lease", front-door shards fd-0..fd-{n-1}. Partition
+# windows are virtual seconds in the fabric spec grammar
+# (serve/fabric.parse_partition_spec).
+
+
+@dataclass
+class PartitionScenario:
+    """One partition-defense story: a seeded 2x-oversubscribed admission
+    flood over a sharded front door plus a leader/standby replicated
+    store, with fabric partition windows cut mid-run. The CI smoke
+    (tools/run_partition_soak.py --sim, tools/partition_smoke.json
+    floors) replays each fixture twice and compares bytes."""
+
+    name: str = "partition"
+    seed: int = 0
+    duration_s: float = 30.0
+    drain_s: float = 5.0
+    # Front door: global budget under an over-subscribed flood (the
+    # budget must bind, so over-admission during the partition is
+    # measurable against the allowance line).
+    n_shards: int = 4
+    rate_rps: float = 200.0
+    burst: float = 200.0
+    offered_rps: float = 400.0
+    gossip_interval_s: float = 0.5
+    # Fail-closed bound: 3 missed gossip rounds is a partition, not
+    # jitter.
+    staleness_bound_s: float = 1.5
+    n_sessions: int = 40
+    n_tenants: int = 4
+    # Store: leader heartbeats a txn per tick; ctl-B is a COLD standby
+    # (created at start, catches up only inside acquire_leadership —
+    # the realistic new-controller-process failover, and what makes the
+    # snapshot + tail-replay path the one under test).
+    control_interval_s: float = 0.5
+    lease_duration_s: float = 2.0
+    snapshot_every: int = 16
+    # Synthetic uptime: preloaded txns before the flood, so failover
+    # replay cost is judged against a LONG log (the O(tail) ratchet).
+    preload_txns: int = 0
+    # Fabric chaos: partition windows + per-edge drop/delay/dup.
+    partition_spec: str = ""
+    edge_spec: str = ""
+
+
+PARTITION_SCENARIOS: Tuple[str, ...] = (
+    "symmetric_split",
+    "leader_isolated",
+    "gossip_only",
+    "partition_during_flood",
+    "heal_reconverge",
+)
+
+
+def partition_scenario(kind: str = "leader_isolated",
+                       seed: int = 0) -> PartitionScenario:
+    """The partition matrix. Each entry is one failure class from the
+    ISSUE 12 taxonomy; ARCHITECTURE.md's "Partition semantics" table
+    names each class's detector / degraded mode / client outcome /
+    heal path — these fixtures are the executable versions."""
+    if kind == "symmetric_split":
+        # The control plane tears in half: the leader keeps two shards
+        # but loses log, lease, AND the other half's gossip. Renewal
+        # becomes unreachable -> the leader demotes on the lease-loss
+        # path; the standby's side owns the quorum substrate and takes
+        # over; BOTH gossip sides degrade fail-closed, then re-converge
+        # on heal.
+        return PartitionScenario(
+            name=kind, seed=seed,
+            partition_spec=("ctl-A+fd-0+fd-1|ctl-B+log+lease+fd-2+fd-3"
+                            "@t=10:heal=10"),
+        )
+    if kind == "leader_isolated":
+        # THE asymmetric case: the leader can renew its lease but not
+        # reach the log. Without defense it would stay leader on a
+        # heartbeat it cannot write under (split-brain); with it, the
+        # bounded unreachable window self-demotes (store_unreachable),
+        # the lease lapses unrenewed, and the standby — which CAN reach
+        # the log — takes over by snapshot + tail replay. The long
+        # preloaded log is what the O(tail) failover ratchet grades.
+        return PartitionScenario(
+            name=kind, seed=seed,
+            preload_txns=400,
+            partition_spec="ctl-A|log@t=10:heal=12",
+        )
+    if kind == "gossip_only":
+        # Store untouched; the shard mesh splits 2|2. Each side's
+        # ledgers lose half the fleet, degrade fail-closed at the
+        # staleness bound (bounded over-admission, never unbounded),
+        # and re-converge to exact global counts on heal.
+        return PartitionScenario(
+            name=kind, seed=seed,
+            partition_spec="fd-0+fd-1|fd-2+fd-3@t=10:heal=10",
+        )
+    if kind == "partition_during_flood":
+        # Correlated worst case: leader isolation AND a gossip split
+        # open together at peak offered load (4x the budget), plus
+        # chaos-duplicated gossip so the CRDT replacement's idempotence
+        # is load-bearing, not decorative.
+        return PartitionScenario(
+            name=kind, seed=seed,
+            offered_rps=800.0,
+            preload_txns=200,
+            partition_spec=("ctl-A|log@t=12:heal=8;"
+                            "fd-0+fd-1|fd-2+fd-3@t=12:heal=8"),
+            edge_spec="frontdoor.gossip=-1:dup:p0.2",
+        )
+    if kind == "heal_reconverge":
+        # A minority shard drops off and returns; the long post-heal
+        # window pins EXACT re-convergence (every shard's merged count
+        # equals the oracle) and that degraded mode exits cleanly.
+        return PartitionScenario(
+            name=kind, seed=seed,
+            duration_s=35.0,
+            partition_spec="fd-0+fd-1+fd-2|fd-3@t=8:heal=6",
+        )
+    raise ValueError(
+        f"unknown partition scenario {kind!r} "
+        f"(known: {', '.join(PARTITION_SCENARIOS)})"
     )
 
 

@@ -1,0 +1,354 @@
+"""Control-fabric seam (ISSUE 12): spec parsing, partition windows,
+seeded edge chaos, and the split-brain defenses that ride the seam —
+leader self-demotion when the log is unreachable, the no-candidacy
+probe that keeps a cut-off leader from re-extending its own lease, and
+the long-poll client surviving a controller partition."""
+
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
+from ray_dynamic_batching_tpu.serve.fabric import (
+    ControlFabric,
+    FabricUnreachable,
+    parse_fabric_spec,
+    parse_partition_spec,
+)
+from ray_dynamic_batching_tpu.serve.long_poll import (
+    LongPollClient,
+    LongPollHost,
+)
+from ray_dynamic_batching_tpu.serve.store import (
+    LeaderLease,
+    ReplicatedStore,
+    StaleEpochError,
+    StoreLog,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestPartitionSpec:
+    def test_parses_sides_window_and_heal(self):
+        parts = parse_partition_spec("ctl-A+fd-0|log+lease@t=10:heal=5")
+        assert len(parts) == 1
+        p = parts[0]
+        assert p.a == frozenset({"ctl-A", "fd-0"})
+        assert p.b == frozenset({"log", "lease"})
+        assert p.at_s == 10.0 and p.heal_s == 5.0
+        assert not p.open_at(9.9)
+        assert p.open_at(10.0) and p.open_at(14.9)
+        assert not p.open_at(15.0)
+
+    def test_no_heal_means_forever(self):
+        (p,) = parse_partition_spec("a|b@t=1")
+        assert p.open_at(1e9)
+
+    def test_multiple_windows(self):
+        parts = parse_partition_spec("a|b@t=1:heal=2;c|d@t=5")
+        assert len(parts) == 2
+
+    def test_empty_string_is_no_partitions(self):
+        assert parse_partition_spec("") == []
+
+    @pytest.mark.parametrize("bad", [
+        "a|b",                # no window
+        "a@t=1",              # no sides
+        "|b@t=1",             # empty side
+        "a|a@t=1",            # same node both sides
+        "a|b@heal=2",         # no t
+        "a|b@t=1:mend=2",     # bad token
+    ])
+    def test_invalid_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_partition_spec(bad)
+
+
+class TestFabricSpec:
+    def test_parses_modes(self):
+        table = parse_fabric_spec(
+            "e1=-1:drop,e2=3:dup:p0.5,e3=-1:delay5-20"
+        )
+        assert table["e1"][0] == -1 and table["e1"][2].mode == "drop"
+        assert table["e2"] == (3, 0.5, table["e2"][2])
+        assert table["e3"][2].mode == "delay"
+        assert table["e3"][2].delay_ms == (5.0, 20.0)
+
+    @pytest.mark.parametrize("bad", [
+        "e1",                 # no mode
+        "e1=-1",              # still no mode
+        "e1=-1:warp9",        # unknown mode
+        "e1=-1:delay20-5",    # inverted range
+        "e1=-1:drop:q0.5",    # bad suffix
+    ])
+    def test_invalid_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_fabric_spec(bad)
+
+
+class TestPassthrough:
+    def test_unconfigured_fabric_is_transparent(self):
+        fab = ControlFabric(partition_spec="", edge_spec="", seed=0)
+        assert not fab.active
+        assert fab.call("store.append", lambda x: x + 1, 41) == 42
+        seen = []
+        assert fab.cast("controller.push", seen.append, "v") is True
+        assert seen == ["v"]
+        # Zero accounting on the passthrough: live canon unchanged.
+        assert fab.stats() == {}
+
+
+class TestPartitionWindows:
+    def _fab(self, clock, spec):
+        return ControlFabric(clock=clock, seed=0,
+                             partition_spec=spec, edge_spec="")
+
+    def test_call_crossing_open_window_raises(self):
+        clock = FakeClock()
+        fab = self._fab(clock, "a|b@t=5:heal=5")
+        assert fab.call("e", lambda: 1, src="a", dst="b") == 1  # closed
+        clock.advance(5.0)
+        with pytest.raises(FabricUnreachable) as ei:
+            fab.call("e", lambda: 1, src="a", dst="b")
+        assert ei.value.edge == "e" and ei.value.src == "a"
+        clock.advance(5.0)  # healed
+        assert fab.call("e", lambda: 1, src="a", dst="b") == 1
+        assert fab.stats()["e.dropped"] == 1
+        assert fab.stats()["e.delivered"] == 2
+
+    def test_cast_crossing_is_silently_dropped(self):
+        clock = FakeClock()
+        fab = self._fab(clock, "a|b@t=0")
+        seen = []
+        assert fab.cast("e", seen.append, "x", src="a", dst="b") is False
+        assert seen == []
+
+    def test_same_side_and_unnamed_endpoints_untouched(self):
+        clock = FakeClock()
+        fab = self._fab(clock, "a+b|c@t=0")
+        assert fab.call("e", lambda: 1, src="a", dst="b") == 1
+        assert fab.call("e", lambda: 1, src="a") == 1       # dst unnamed
+        assert fab.call("e", lambda: 1, src="x", dst="c") == 1  # x unplaced
+
+    def test_group_assignment_places_nodes(self):
+        clock = FakeClock()
+        fab = self._fab(clock, "routers|controller@t=0")
+        fab.assign("fd-0", "routers")
+        fab.assign("ctl-A", "controller")
+        with pytest.raises(FabricUnreachable):
+            fab.call("e", lambda: 1, src="fd-0", dst="ctl-A")
+
+    def test_partition_active_tracks_windows(self):
+        clock = FakeClock()
+        fab = self._fab(clock, "a|b@t=2:heal=3")
+        assert not fab.partition_active()
+        clock.advance(2.0)
+        assert fab.partition_active()
+        clock.advance(3.0)
+        assert not fab.partition_active()
+
+
+class TestEdgeChaos:
+    def test_drop_budget_consumes_then_delivers(self):
+        fab = ControlFabric(partition_spec="", edge_spec="e=2:drop",
+                            seed=0)
+        for _ in range(2):
+            with pytest.raises(FabricUnreachable):
+                fab.call("e", lambda: 1)
+        assert fab.call("e", lambda: 1) == 1  # budget spent
+        assert fab.stats() == {"e.dropped": 2, "e.delivered": 1}
+
+    def test_dup_delivers_twice(self):
+        fab = ControlFabric(partition_spec="", edge_spec="e=-1:dup",
+                            seed=0)
+        seen = []
+        fab.cast("e", seen.append, "m")
+        assert seen == ["m", "m"]
+        assert fab.stats()["e.duplicated"] == 1
+
+    def test_delay_routes_through_scheduler_deterministically(self):
+        def run(seed):
+            scheduled = []
+            fab = ControlFabric(
+                scheduler=lambda ms, fn: scheduled.append((ms, fn)),
+                partition_spec="", edge_spec="e=-1:delay5-20", seed=seed,
+            )
+            seen = []
+            assert fab.cast("e", seen.append, "m") is True
+            assert seen == []  # deferred, not delivered inline
+            (ms, fn), = scheduled
+            assert 5.0 <= ms <= 20.0
+            fn()
+            assert seen == ["m"]
+            return ms
+
+        assert run(7) == run(7)       # seeded draw replays
+        assert run(7) != run(8)       # and actually depends on the seed
+
+    def test_other_edges_unaffected(self):
+        fab = ControlFabric(partition_spec="", edge_spec="e=-1:drop",
+                            seed=0)
+        assert fab.call("other", lambda: 1) == 1
+
+
+class TestStoreUnderPartition:
+    """The asymmetric split-brain case end to end on a fake clock."""
+
+    def _stack(self, spec, demote_after=1.0):
+        clock = FakeClock()
+        fab = ControlFabric(clock=clock, seed=0, partition_spec=spec,
+                            edge_spec="")
+        log = StoreLog(clock=clock)
+        lease = LeaderLease(duration_s=2.0, clock=clock)
+        a = ReplicatedStore(log, lease, "ctl-A", fabric=fab, clock=clock,
+                            unreachable_demote_after_s=demote_after)
+        b = ReplicatedStore(log, lease, "ctl-B", fabric=fab, clock=clock)
+        return clock, fab, log, lease, a, b
+
+    def test_leader_isolated_from_log_self_demotes(self):
+        clock, fab, log, lease, a, b = self._stack(
+            "ctl-A|log@t=5:heal=20")
+        a.audit = AuditLog("store", now=clock)
+        assert a.acquire_leadership() == 1
+        with a.txn() as t:
+            t.put("k", "v1")
+        clock.advance(5.0)  # partition opens
+        with pytest.raises(FabricUnreachable):
+            with a.txn() as t:
+                t.put("k", "v2")
+        assert a._repl.is_leader  # first failure only opens the window
+        clock.advance(1.0)
+        with pytest.raises(FabricUnreachable):
+            with a.txn() as t:
+                t.put("k", "v3")
+        assert not a.is_leader()
+        assert a.self_demotions == 1
+        triggers = [r["trigger"] for r in a.audit.to_dicts()]
+        assert "store_unreachable" in triggers
+        # Demoted: renew refuses, deliberately letting the lease lapse.
+        assert a.renew() is False
+
+    def test_renew_probe_demotes_a_quiescent_leader(self):
+        # No appends at all: the lease-renew heartbeat's log probe must
+        # still notice the partition (elided txns append nothing).
+        clock, fab, log, lease, a, b = self._stack("ctl-A|log@t=5")
+        assert a.acquire_leadership() == 1
+        for _ in range(4):         # healthy heartbeats keep the lease
+            clock.advance(1.0)
+            assert a.renew() is True
+        clock.advance(1.0)         # t=5: partition opens
+        assert a.renew() is True   # window opens, still inside bound
+        clock.advance(1.0)
+        assert a.renew() is False  # bounded window elapsed: demoted
+        assert a.self_demotions == 1
+
+    def test_cutoff_leader_cannot_re_extend_its_lease(self):
+        # acquire_leadership probes the LOG before touching the lease: a
+        # demoted leader partitioned from the log must not keep its own
+        # lease alive by retrying acquire (that would lock the standby
+        # out forever).
+        clock, fab, log, lease, a, b = self._stack("ctl-A|log@t=5")
+        assert a.acquire_leadership() == 1
+        clock.advance(5.0)
+        a.renew()
+        clock.advance(1.0)
+        a.renew()  # demoted
+        with pytest.raises(FabricUnreachable):
+            a.acquire_leadership()
+        clock.advance(1.1)  # past the last renew + duration: lease lapses
+        assert lease.holder() is None
+        # The standby — on the log's side — takes over and replays.
+        assert b.acquire_leadership() == 2
+        assert b.get("k") is None  # nothing was ever committed as "k"
+
+    def test_deposed_epoch_bounces_off_the_fence_after_heal(self):
+        clock, fab, log, lease, a, b = self._stack(
+            "ctl-A|log@t=5:heal=10")
+        assert a.acquire_leadership() == 1
+        with a.txn() as t:
+            t.put("k", "v1")
+        clock.advance(6.0)
+        a.renew()
+        clock.advance(1.0)
+        a.renew()  # demoted
+        clock.advance(2.0)
+        assert b.acquire_leadership() == 2
+        clock.advance(7.0)  # heal (t=15)
+        # ctl-A wakes up and tries to finish its half-done write at its
+        # old epoch: the fence — not luck — rejects it.
+        with pytest.raises(StaleEpochError):
+            fab.call("store.append", log.append, 1,
+                     [("put", "k", "stale")], src="ctl-A", dst="log")
+        assert log.rejected_appends == 1
+        assert b.get("k") == "v1"
+        # Post-heal, the deposed owner's candidacy is a clean acquire
+        # attempt: denied while ctl-B's lease is live (same-holder
+        # re-acquire keeps the epoch — no spurious fence).
+        assert b.acquire_leadership() == 2
+        assert a.acquire_leadership() is None
+
+
+class TestLongPollUnderPartition:
+    def test_client_rides_out_a_partition_and_reconverges(self):
+        # Real threads + real time: the listen edge drops while the
+        # window is open; the client keeps its last state and catches
+        # up on heal (snapshot ids are monotone).
+        fab = ControlFabric(partition_spec="", edge_spec="", seed=0)
+        host = LongPollHost()
+        seen = []
+        client = LongPollClient(host, {"cfg": seen.append},
+                                poll_timeout_s=0.02, fabric=fab,
+                                node="router")
+        try:
+            host.notify_changed("cfg", "v1")
+            deadline = time.monotonic() + 2.0
+            while "v1" not in seen and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert seen == ["v1"]
+            fab.configure(partition_spec="router|controller@t=0")
+            time.sleep(0.1)  # drain the listen armed pre-partition
+            host.notify_changed("cfg", "v2")
+            time.sleep(0.1)
+            assert seen == ["v1"]            # cut off: last state held
+            assert client.unreachable_polls >= 1
+            host.notify_changed("cfg", "v3")  # missed pushes pile up
+            fab.configure(partition_spec="")  # heal
+            deadline = time.monotonic() + 2.0
+            while "v3" not in seen and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # One re-armed listen returns ONLY the latest snapshot: the
+            # missed v2 is superseded, never replayed out of order.
+            assert seen == ["v1", "v3"]
+        finally:
+            client.stop()
+
+
+class TestAppendOnlyFault:
+    def test_append_only_fault_still_demotes_the_leader(self):
+        """A gray fault that eats ONLY appends (reads fine) must open —
+        and keep open — the self-demotion window: the renew probe rides
+        the store.append edge, so a healthy read channel can never mask
+        a dead write channel."""
+        clock = FakeClock()
+        fab = ControlFabric(clock=clock, seed=0, partition_spec="",
+                            edge_spec="store.append=-1:drop")
+        log = StoreLog(clock=clock)
+        lease = LeaderLease(duration_s=2.0, clock=clock)
+        a = ReplicatedStore(log, lease, "ctl-A", fabric=fab, clock=clock,
+                            unreachable_demote_after_s=1.0)
+        assert a.acquire_leadership() == 1  # reads/lease/fence all fine
+        clock.advance(0.5)
+        assert a.renew() is True    # probe fails: window opens
+        clock.advance(1.0)
+        assert a.renew() is False   # bounded window elapsed: demoted
+        assert a.self_demotions == 1

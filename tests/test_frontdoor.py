@@ -326,3 +326,176 @@ class TestConcurrentShardAdmission:
         for t in threads:
             t.join()
         assert sum(admitted) == 50
+
+
+# --- fail-closed staleness contract (ISSUE 12) ------------------------------
+
+
+class TestLedgerStaleness:
+    def _ledger(self, clock, n_shards=4, bound=1.0, rate=10.0,
+                burst=20.0, shard="fd-0"):
+        lg = GlobalAdmissionLedger(
+            shard, GlobalBudget(rate_rps=rate, burst=burst, t0=clock()),
+            n_shards=n_shards, staleness_bound_s=bound,
+        )
+        return lg
+
+    def _fresh_peers(self, lg, clock, n=3):
+        for i in range(n):
+            lg.absorb(f"peer-{i}", {"count": 0}, now=clock())
+
+    def test_degrades_fail_closed_when_gossip_stops(self):
+        clock = FakeClock()
+        lg = self._ledger(clock)                 # allowed = 20 + 10t
+        self._fresh_peers(lg, clock)
+        assert lg.check(clock())[0] and not lg.degraded
+        clock.advance(2.0)                       # > 1.0s bound, no gossip
+        ok, _ = lg.check(clock())
+        assert lg.degraded
+        # Local fraction: allowed(2.0)/4 = 10 — own admissions only.
+        admitted = 0
+        while lg.admit(clock())[0]:
+            admitted += 1
+        assert admitted == 10                    # not the full 40
+
+    def test_all_shards_degraded_never_exceed_the_global_allowance(self):
+        """Fail-closed means the partition can only UNDER-admit: every
+        shard degrading to allowed/N sums to at most the allowance."""
+        clock = FakeClock()
+        shards = [self._ledger(clock, shard=f"fd-{i}") for i in range(4)]
+        for lg in shards:
+            self._fresh_peers(lg, clock)
+        clock.advance(3.0)                       # full gossip silence
+        total = 0
+        for lg in shards:
+            while lg.admit(clock())[0]:
+                total += 1
+        # The GLOBAL line holds to within the same one-request-per-shard
+        # rounding the healthy (N-1)*rate*staleness bound carries —
+        # bounded forever, however long the partition lasts.
+        assert total <= 20 + 10 * 3.0 + len(shards)
+
+    def test_stalest_peer_governs_partial_partitions(self):
+        clock = FakeClock()
+        lg = self._ledger(clock)
+        self._fresh_peers(lg, clock)
+        clock.advance(2.0)
+        # Two of three peers gossip on (same side); the third is cut
+        # off — the merged count is missing a fleet slice, so the
+        # ledger must STILL fail closed.
+        lg.absorb("peer-0", {"count": 0}, now=clock())
+        lg.absorb("peer-1", {"count": 0}, now=clock())
+        lg.check(clock())
+        assert lg.degraded
+
+    def test_never_heard_peer_counts_from_the_anchor(self):
+        clock = FakeClock()
+        lg = self._ledger(clock)                 # nobody ever gossiped
+        clock.advance(2.0)
+        lg.check(clock())
+        assert lg.degraded
+
+    def test_reconverges_on_heal(self):
+        clock = FakeClock()
+        lg = self._ledger(clock)
+        self._fresh_peers(lg, clock)
+        clock.advance(2.0)
+        lg.check(clock())
+        assert lg.degraded and lg.degraded_entries == 0  # shard meters it
+        for i in range(3):
+            lg.absorb(f"peer-{i}", {"count": 5}, now=clock())
+        ok, _ = lg.check(clock())
+        assert not lg.degraded
+        assert lg.merged_count() == 15           # merged view restored
+
+    def test_retired_peer_is_exempt_and_shrinks_the_fleet(self):
+        clock = FakeClock()
+        lg = self._ledger(clock, n_shards=4)
+        self._fresh_peers(lg, clock)
+        lg.absorb("peer-2", {"count": 9}, now=clock())
+        lg.retire_peer("peer-2")
+        assert lg.n_shards == 3
+        clock.advance(10.0)
+        lg.absorb("peer-0", {"count": 0}, now=clock())
+        lg.absorb("peer-1", {"count": 0}, now=clock())
+        lg.check(clock())
+        assert not lg.degraded                   # the ghost never stales
+        assert lg.merged_count() == 9            # its history still counts
+
+    def test_reordered_absorb_cannot_rewind_a_newer_state(self):
+        clock = FakeClock()
+        lg = self._ledger(clock, n_shards=2)  # one expected peer
+        lg.absorb("peer-0", {"count": 5}, now=clock())
+        clock.advance(0.5)
+        # A fabric-delayed older payload lands late: the monotone count
+        # guard keeps the newer state; the freshness stamp still moves.
+        lg.absorb("peer-0", {"count": 3}, now=clock())
+        assert lg.peer_count() == 5
+        assert lg.peer_staleness_s(clock()) == 0.0
+
+    def test_degradation_is_audited_metered_and_reconverges(self):
+        clock = FakeClock()
+        fd = FrontDoor(n_shards=2, clock=clock, gossip_interval_s=0.2,
+                       staleness_bound_s=0.5)
+        fd.configure("llm", rate_rps=10.0, burst=4.0)
+        fd.gossip_round()                        # anchor freshness
+        clock.advance(1.0)                       # silence > bound
+        shard = fd.shards["fd-0"]
+        shard.admit("llm")
+        assert shard.ledger("llm").degraded
+        assert shard.ledger("llm").degraded_entries == 1
+        degraded = [a for a in fd.audit.to_dicts()
+                    if a["trigger"] == "ledger_degraded"]
+        assert degraded and degraded[0]["observed"]["shard"] == "fd-0"
+        fd.gossip_round()                        # heal
+        shard.admit("llm")
+        assert not shard.ledger("llm").degraded
+        assert any(a["trigger"] == "ledger_reconverged"
+                   for a in fd.audit.to_dicts())
+        assert shard.ledger_snapshot()["degraded_entries"] == 1
+
+    def test_deployment_configured_after_removal_sizes_for_survivors(self):
+        """A ledger born AFTER a shard removal must expect the
+        SURVIVING fleet — sized for the original N it would wait
+        forever on a ghost peer and degrade fail-closed permanently."""
+        clock = FakeClock()
+        fd = FrontDoor(n_shards=4, clock=clock, gossip_interval_s=0.2,
+                       staleness_bound_s=0.5)
+        fd.configure("old", rate_rps=10.0, burst=10.0)
+        fd.remove_shard("fd-3")
+        fd.configure("new-dep", rate_rps=10.0, burst=10.0)
+        lg = fd.shards["fd-0"].ledger("new-dep")
+        assert lg.n_shards == 3
+        # Healthy gossip among the survivors: never degrades, however
+        # long the (ghost-free) fleet runs.
+        for _ in range(20):
+            clock.advance(0.2)
+            fd.gossip_round()
+        fd.shards["fd-0"].admit("new-dep")
+        assert not lg.degraded
+
+    def test_idle_deployment_degrades_and_heals_via_gossip_sweep(self):
+        """The degradation edges (flag, gauge, audit) move with GOSSIP
+        progress: a deployment nobody admits through still enters
+        degraded mode when its peers go silent and — critically —
+        clears on heal instead of standing as a false alarm until the
+        next admission."""
+        from ray_dynamic_batching_tpu.serve.fabric import ControlFabric
+
+        clock = FakeClock()
+        fab = ControlFabric(clock=clock, seed=0,
+                            partition_spec="fd-0|fd-1@t=0", edge_spec="")
+        fd = FrontDoor(n_shards=2, clock=clock, gossip_interval_s=0.2,
+                       staleness_bound_s=0.5, fabric=fab)
+        fd.configure("llm", rate_rps=10.0, burst=4.0)
+        clock.advance(1.0)
+        fd.gossip_round()  # absorbs dropped; the sweep sees the silence
+        lg = fd.shards["fd-0"].ledger("llm")
+        assert lg.degraded and lg.degraded_entries == 1
+        assert any(a["trigger"] == "ledger_degraded"
+                   for a in fd.audit.to_dicts())
+        fab.configure(partition_spec="")  # heal
+        fd.gossip_round()
+        assert not lg.degraded
+        assert any(a["trigger"] == "ledger_reconverged"
+                   for a in fd.audit.to_dicts())

@@ -1306,3 +1306,147 @@ class TestStoreDiscipline:
 
         report = run(paths=[DEFAULT_TARGET], rules={"store-discipline"})
         assert report.new == [], [f.format() for f in report.new]
+
+
+# --- fabric-discipline ------------------------------------------------------
+
+DIRECT_LOG_APPEND = """
+    class ReplicatedStore:
+        def _commit(self, ops):
+            index = self.log.append(self._repl.epoch, ops)
+            return index
+"""
+
+
+class TestFabricDiscipline:
+    def test_direct_log_append_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/store.py",
+                              DIRECT_LOG_APPEND,
+                              rules={"fabric-discipline"})
+        assert rules_found(report) == ["fabric-discipline"]
+        assert "store.append" in report.new[0].message
+
+    def test_fabric_routed_append_is_clean(self, tmp_path):
+        # The seam takes the bound method as an ARGUMENT: no watched
+        # call expression exists, so routed traffic passes by
+        # construction.
+        report = lint_fixture(tmp_path, "serve/store.py", """
+            class ReplicatedStore:
+                def _commit(self, ops):
+                    return self.fabric.call(
+                        "store.append", self.log.append,
+                        self._repl.epoch, ops,
+                        src=self.owner, dst="log",
+                    )
+        """, rules={"fabric-discipline"})
+        assert report.new == []
+
+    def test_lease_calls_flag(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/store.py", """
+            class ReplicatedStore:
+                def renew(self):
+                    return self.lease.renew(self.owner)
+
+                def take(self):
+                    return self.lease.acquire(self.owner)
+        """, rules={"fabric-discipline"})
+        assert rules_found(report) == ["fabric-discipline"] * 2
+
+    def test_snapshot_and_read_calls_flag(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/store.py", """
+            class ReplicatedStore:
+                def catch_up(self):
+                    recs = self.log.read_from(0)
+                    self.log.install_snapshot(None)
+                    return recs
+        """, rules={"fabric-discipline"})
+        assert rules_found(report) == ["fabric-discipline"] * 2
+
+    def test_subscripted_receiver_still_flags(self, tmp_path):
+        # self.shards[sid].absorb_states(...) must not hide behind the
+        # subscript.
+        report = lint_fixture(tmp_path, "serve/frontdoor.py", """
+            class FrontDoor:
+                def gossip_round(self):
+                    for sid in sorted(self.shards):
+                        self.shards[sid].absorb_states(sid, {})
+        """, rules={"fabric-discipline"})
+        assert rules_found(report) == ["fabric-discipline"]
+
+    def test_bus_calls_flag(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/frontdoor.py", """
+            class FrontDoor:
+                def gossip_round(self):
+                    self.bus.publish("fd-0", {})
+                    return self.bus.collect("fd-0")
+        """, rules={"fabric-discipline"})
+        assert rules_found(report) == ["fabric-discipline"] * 2
+
+    def test_long_poll_listen_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/long_poll.py", """
+            class LongPollClient:
+                def _loop(self):
+                    return self.host.listen_for_change({}, timeout_s=1.0)
+        """, rules={"fabric-discipline"})
+        assert rules_found(report) == ["fabric-discipline"]
+
+    def test_out_of_scope_files_are_clean(self, tmp_path):
+        # Same code outside the watched serve files: no finding.
+        report = lint_fixture(tmp_path, "serve/router.py",
+                              DIRECT_LOG_APPEND,
+                              rules={"fabric-discipline"})
+        assert report.new == []
+        report = lint_fixture(tmp_path, "engine/store.py",
+                              DIRECT_LOG_APPEND,
+                              rules={"fabric-discipline"})
+        assert report.new == []
+
+    def test_reasoned_pragma_suppresses(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/frontdoor.py", """
+            class FrontDoor:
+                def gossip_round(self):
+                    self.bus.publish("fd-0", {})  # rdb-lint: disable=fabric-discipline (the board is process-local; the network edge is the absorb)
+        """, rules={"fabric-discipline"})
+        assert report.new == []
+        assert report.pragma_suppressed == 1
+
+    def test_shipped_tree_is_clean(self):
+        from tools.lint.core import DEFAULT_TARGET
+
+        report = run(paths=[DEFAULT_TARGET], rules={"fabric-discipline"})
+        assert report.new == [], [f.format() for f in report.new]
+
+
+class TestSimDeterminismCoversFabric:
+    def test_wall_clock_in_serve_fabric_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/fabric.py", """
+            import time
+
+            def partition_open(self):
+                return time.time() - self.t0 > self.at_s
+        """, rules={"sim-determinism"})
+        assert rules_found(report) == ["sim-determinism"]
+
+    def test_unseeded_rng_in_serve_fabric_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/fabric.py", """
+            import random
+
+            def draw(self):
+                return random.Random().random()
+        """, rules={"sim-determinism"})
+        assert rules_found(report) == ["sim-determinism"]
+
+    def test_other_serve_files_stay_uncovered(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/router.py", """
+            import time
+
+            def now(self):
+                return time.time()
+        """, rules={"sim-determinism"})
+        assert report.new == []
+
+    def test_shipped_fabric_is_clean(self):
+        from tools.lint.core import DEFAULT_TARGET
+
+        report = run(paths=[DEFAULT_TARGET], rules={"sim-determinism"})
+        assert report.new == [], [f.format() for f in report.new]

@@ -1,0 +1,64 @@
+"""Partition-defense sim matrix (ISSUE 12): byte-determinism and the
+core invariants of two representative arms, at pytest speed. The full
+five-scenario gate (plus the live arm) runs in
+tools/run_partition_soak.py; this file keeps the tier-1 suite honest
+if that gate is skipped."""
+
+import json
+
+import pytest
+
+from ray_dynamic_batching_tpu.sim.frontdoor import run_partition_sim
+from ray_dynamic_batching_tpu.sim.scenarios import (
+    PARTITION_SCENARIOS,
+    partition_scenario,
+)
+
+
+def _run_twice(kind):
+    r1 = run_partition_sim(partition_scenario(kind))
+    r2 = run_partition_sim(partition_scenario(kind))
+    return r1, r2
+
+
+class TestPartitionSim:
+    def test_unknown_scenario_raises(self):
+        with pytest.raises(ValueError):
+            partition_scenario("half-open-schism")
+
+    def test_matrix_names_are_constructible(self):
+        for kind in PARTITION_SCENARIOS:
+            assert partition_scenario(kind).name == kind
+
+    def test_leader_isolation_story(self):
+        r1, r2 = _run_twice("leader_isolated")
+        assert json.dumps(r1, sort_keys=True) == \
+            json.dumps(r2, sort_keys=True)
+        st = r1["store"]
+        # The asymmetric case: bounded self-demotion, failover to the
+        # standby on the log's side, zero split-brain, O(tail) replay.
+        assert st["self_demotions"]["ctl-A"] == 1
+        assert st["leader"] == "ctl-B" and st["epoch"] == 2
+        assert st["stale_write_rejected"] and st["rejected_appends"] >= 1
+        assert st["split_brain_commits"] == 0
+        assert st["appended_total"] >= 400         # long synthetic log
+        assert st["max_tail_replayed"] <= 16       # replay stays O(tail)
+        c = r1["counts"]
+        assert c["arrivals"] == c["admitted"] + c["rejected"]
+        assert c["completed"] == c["admitted"]
+
+    def test_gossip_partition_story(self):
+        r1, r2 = _run_twice("gossip_only")
+        assert json.dumps(r1, sort_keys=True) == \
+            json.dumps(r2, sort_keys=True)
+        # Store untouched; every shard degrades fail-closed within the
+        # bound and re-converges exactly on heal.
+        st = r1["store"]
+        assert st["leader"] == "ctl-A" and st["epoch"] == 1
+        assert st["rejected_appends"] == 0
+        assert all(lg["degraded_entries"] >= 1
+                   for lg in r1["ledgers"].values())
+        assert r1["max_over_admitted"] <= r1["degrade_bound"]
+        assert r1["reconverged"]
+        assert all(not lg["stale_at_end"]
+                   for lg in r1["ledgers"].values())

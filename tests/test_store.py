@@ -20,12 +20,14 @@ from ray_dynamic_batching_tpu.serve import (
     ServeController,
 )
 from ray_dynamic_batching_tpu.serve.store import (
+    CompactedLogError,
     InMemoryStore,
     LeaderLease,
     ReplicaCatalog,
     ReplicatedStore,
     StaleEpochError,
     StoreLog,
+    StoreSnapshot,
 )
 
 
@@ -109,7 +111,7 @@ class TestLeaseAndLog:
 
 class TestReplicatedStore:
     def _pair(self, clock):
-        log = StoreLog(now=clock)
+        log = StoreLog(clock=clock)
         lease = LeaderLease(duration_s=2.0, clock=clock)
         return (log, lease,
                 ReplicatedStore(log, lease, "A"),
@@ -599,3 +601,205 @@ class TestSecondReviewRegressions:
             if ctl_b is not None:
                 ctl_b.shutdown()
             ctl_a.shutdown()
+
+
+# --- clock unification (ISSUE 12 satellite) --------------------------------
+
+
+class TestOneControlClock:
+    def test_log_and_lease_share_one_injected_clock(self):
+        """StoreLog record stamps, lease expiry, and the replicated
+        store's demotion window all read ONE clock — no time.time /
+        time.monotonic mixture (the PR 12 bugfix)."""
+        clock = FakeClock(100.0)
+        log = StoreLog(clock=clock)
+        lease = LeaderLease(duration_s=2.0, clock=clock)
+        store = ReplicatedStore(log, lease, "A", clock=clock)
+        assert store.acquire_leadership() == 1
+        with store.txn() as t:
+            t.put("k", "v")
+        (rec,) = log.read_from(0)
+        assert rec.wall_time == 100.0  # the shared clock, not wall time
+
+    def test_replicated_store_defaults_to_the_lease_clock(self):
+        clock = FakeClock(7.0)
+        store = ReplicatedStore(StoreLog(clock=clock),
+                                LeaderLease(duration_s=2.0, clock=clock),
+                                "A")
+        assert store._clock() == 7.0
+
+    def test_skewed_renewer_cannot_outlive_the_grantor_clock(self):
+        """Expiry is judged on the LEASE's injected clock — the
+        grantor's — at call time. A renewer whose own clock runs fast
+        (or that renews in a tight burst) gets exactly duration_s of
+        grantor time per renewal, never more: renewals do not stack,
+        and no renewer-supplied timestamp exists to lie with."""
+        grantor = FakeClock()
+        lease = LeaderLease(duration_s=2.0, clock=grantor)
+        assert lease.acquire("A") == 1
+        for _ in range(50):             # frantic burst of renewals
+            assert lease.renew("A")
+        grantor.advance(2.5)            # one window of GRANTOR time
+        assert lease.expired()
+        assert lease.holder() is None
+        assert not lease.renew("A")     # real leadership really ended
+        assert lease.acquire("B") == 2
+
+
+# --- snapshots + log compaction (ISSUE 12) ---------------------------------
+
+
+class TestSnapshotCompaction:
+    def _leader(self, clock, snapshot_every=4):
+        log = StoreLog(clock=clock)
+        lease = LeaderLease(duration_s=30.0, clock=clock)
+        store = ReplicatedStore(log, lease, "A", clock=clock,
+                                snapshot_every=snapshot_every)
+        assert store.acquire_leadership() == 1
+        return log, lease, store
+
+    def test_snapshot_at_commit_point_truncates_the_log(self):
+        clock = FakeClock()
+        log, lease, store = self._leader(clock, snapshot_every=4)
+        for i in range(10):
+            with store.txn() as t:
+                t.put("k", f"v{i}")
+        assert store.snapshots_taken >= 2
+        snap = log.latest_snapshot()
+        assert snap is not None and snap.epoch == 1
+        assert log.first_index == snap.index
+        assert len(log) < 10              # truncated behind the snapshot
+        assert log.appended_total == 10   # history accounting survives
+
+    def test_read_from_compacted_index_fails_loudly(self):
+        clock = FakeClock()
+        log, lease, store = self._leader(clock, snapshot_every=4)
+        for i in range(8):
+            with store.txn() as t:
+                t.put("k", f"v{i}")
+        with pytest.raises(CompactedLogError) as ei:
+            log.read_from(0)
+        assert ei.value.first_index == log.first_index
+        assert ei.value.snapshot_index == log.latest_snapshot().index
+        # The horizon itself (and beyond) still reads fine.
+        assert log.read_from(log.first_index) is not None
+
+    def test_cold_standby_recovers_by_snapshot_plus_tail(self):
+        clock = FakeClock()
+        log, lease, store = self._leader(clock, snapshot_every=16)
+        for i in range(50):
+            with store.txn() as t:
+                t.put(f"k{i % 7}", f"v{i}")
+        standby = ReplicatedStore(log, lease, "B", clock=clock)
+        standby.catch_up()
+        assert standby.snapshot() == store.snapshot()
+        assert standby.version == store.version
+        assert standby.last_recovery["snapshot_index"] >= 0
+        # O(tail): the replay is bounded by the compaction interval,
+        # never the 50-record history.
+        assert standby.max_tail_replayed <= 16
+
+    def test_snapshot_racing_takeover_replays_never_double_applies(self):
+        """A standby restores an epoch-1 snapshot while epoch-2 records
+        are already in the tail: the newer-epoch tail must replay
+        exactly once on top of the image (version arithmetic pins
+        exactly-once: each record bumps version by 1)."""
+        clock = FakeClock()
+        log, lease, a = self._leader(clock, snapshot_every=4)
+        for i in range(6):
+            with a.txn() as t:
+                t.put("k", f"v{i}")
+        # Takeover: B replays (via snapshot), fences epoch 2, and
+        # appends MORE records beyond the epoch-1 snapshot.
+        lease.revoke()
+        b = ReplicatedStore(log, lease, "B", clock=clock,
+                            snapshot_every=4)
+        assert b.acquire_leadership() == 2
+        with b.txn() as t:
+            t.put("k2", "w1")
+        with b.txn() as t:
+            t.put("k2", "w2")
+        snap = log.latest_snapshot()
+        # Cold replica C: restores SOME snapshot, replays the rest —
+        # including any epoch-2 tail — exactly once.
+        c = ReplicatedStore(log, lease, "C", clock=clock)
+        c.catch_up()
+        assert c.snapshot() == b.snapshot()
+        assert c.version == b.version       # exactly-once: no double-apply
+        assert c._repl.applied_index == b._repl.applied_index
+        assert snap is not None
+
+    def test_truncation_never_orphans_an_unsnapshotted_suffix(self):
+        clock = FakeClock()
+        log = StoreLog(clock=clock)
+        log.append(1, [("put", "a", "1")])
+        log.append(1, [("put", "b", "2")])
+        with pytest.raises(ValueError):
+            # Claims records the log never committed: refused.
+            log.install_snapshot(StoreSnapshot(
+                index=5, epoch=1, version=5, data={}))
+        ok = StoreSnapshot(index=2, epoch=1, version=2,
+                           data={"a": "1", "b": "2"})
+        log.install_snapshot(ok)
+        with pytest.raises(ValueError):
+            # Regressing behind the horizon: refused too.
+            log.install_snapshot(StoreSnapshot(
+                index=1, epoch=1, version=1, data={"a": "1"}))
+
+    def test_restore_is_wholesale_not_a_merge(self):
+        """A standby that replayed a PREFIX (including keys later
+        deleted) and then fell behind the compaction horizon must end
+        up byte-identical to the leader — deletions included."""
+        clock = FakeClock()
+        log, lease, a = self._leader(clock, snapshot_every=100)
+        with a.txn() as t:
+            t.put("doomed", "x")
+        standby = ReplicatedStore(log, lease, "B", clock=clock)
+        standby.catch_up()
+        assert standby.get("doomed") == "x"
+        with a.txn() as t:
+            t.delete("doomed")
+        for i in range(99):
+            with a.txn() as t:
+                t.put("k", f"v{i}")
+        # The leader's compaction has left the standby's cursor behind
+        # the horizon.
+        assert log.first_index > standby._repl.applied_index
+        standby.catch_up()
+        assert standby.get("doomed") is None
+        assert standby.snapshot() == a.snapshot()
+
+    def test_catch_up_survives_compaction_racing_the_restore(self):
+        """The leader keeps committing (and compacting) WHILE a standby
+        recovers: the snapshot the standby fetched can be truncated
+        past before its tail read. catch_up must loop — restore the
+        newer snapshot and retry — not crash with CompactedLogError."""
+        clock = FakeClock()
+        log, lease, leader = self._leader(clock, snapshot_every=4)
+        for i in range(6):
+            with leader.txn() as t:
+                t.put("k", f"v{i}")
+
+        class RacingFabric:
+            """Passthrough that lets the leader commit 6 more records
+            (advancing the compaction horizon) right after handing the
+            standby its FIRST — now stale — snapshot."""
+
+            def __init__(self):
+                self.snapshot_fetches = 0
+
+            def call(self, edge, fn, *args, src="", dst="", **kwargs):
+                out = fn(*args, **kwargs)
+                if edge == "store.snapshot":
+                    self.snapshot_fetches += 1
+                    if self.snapshot_fetches == 1:
+                        for i in range(6):
+                            with leader.txn() as t:
+                                t.put("k", f"race{i}")
+                return out
+
+        standby = ReplicatedStore(log, lease, "B", clock=clock,
+                                  fabric=RacingFabric())
+        standby.catch_up()  # must not raise
+        assert standby.snapshot() == leader.snapshot()
+        assert standby.version == leader.version

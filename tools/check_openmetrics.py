@@ -269,6 +269,19 @@ def _smoke() -> int:
         for i in range(40):
             fdm.inc(tags={"deployment": "llm", "shard": f"fd-{i}",
                           "outcome": "admit"})
+        # Control-fabric families (ISSUE 12): drive the REAL fabric with
+        # a flood of 40 distinct edge labels against its 12-edge bound —
+        # the rdb_fabric_messages_total series cap must hold even if a
+        # runaway caller mints edge names, and the partition gauge must
+        # expose. (The fabric is armed with a never-opening window so
+        # messages count without any being dropped.)
+        from ray_dynamic_batching_tpu.serve.fabric import ControlFabric
+
+        fab = ControlFabric(partition_spec="left|right@t=999999",
+                            edge_spec="", seed=0)
+        for i in range(40):
+            fab.cast(f"edge-{i}", lambda: None)
+        fab.partition_active()  # refreshes the gauge (0: window unopened)
         h = m.Histogram("smoke_latency_ms", "smoke latency",
                         tag_keys=("model",))
         for v in (0.4, 3.0, 42.0, 900.0):
@@ -332,6 +345,18 @@ def _smoke() -> int:
         errors.append(
             f"expected exactly {m.DEFAULT_SHARD_TOP_K} named shard "
             f"series + __other__, saw {n_shard_series}"
+        )
+    n_fabric_series = sum(1 for l in text.splitlines()
+                          if l.startswith("rdb_fabric_messages_total{"))
+    if n_fabric_series != 12 + 1:
+        errors.append(
+            f"expected exactly 12 named fabric edge series + __other__, "
+            f"saw {n_fabric_series} — the edge label bound broke"
+        )
+    if "rdb_fabric_partition_active 0.0" not in text:
+        errors.append(
+            "fabric partition gauge missing from the exposition "
+            "(expected rdb_fabric_partition_active 0.0 with no open window)"
         )
     n_exemplars = len(re.findall(r' # \{trace_id="', text))
     if n_exemplars < 1:

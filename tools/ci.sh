@@ -32,6 +32,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_overload_soak.py --sim
   echo "== control-plane conformance (sim: sharded front door, controller-kill failover, digest routing, tools/frontdoor_smoke.json) =="
   python tools/run_frontdoor_soak.py --sim
+  echo "== partition-defense conformance (sim matrix: split-brain self-demotion, fail-closed admission, O(tail) failover, tools/partition_smoke.json) =="
+  python tools/run_partition_soak.py --sim
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
   exec python -m pytest tests/ -q -m "not slow"
 fi
@@ -78,6 +80,10 @@ python tools/run_overload_soak.py --live --smoke
 echo "== control-plane conformance (sim + live: controller killed mid-flood, epoch-fenced failover, gossip budget, digest routing) =="
 python tools/run_frontdoor_soak.py --sim
 python tools/run_frontdoor_soak.py --live --smoke
+
+echo "== partition-defense conformance (sim matrix + live: leader cut off from the log mid-flood, zero split-brain, fail-closed gossip, snapshot failover) =="
+python tools/run_partition_soak.py --sim
+python tools/run_partition_soak.py --live --smoke
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q

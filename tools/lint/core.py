@@ -284,6 +284,7 @@ def _all_checkers() -> List[Checker]:
     # with the rule modules.
     from tools.lint.determinism import SimDeterminismChecker
     from tools.lint.event_loop import EventLoopBlockingChecker
+    from tools.lint.fabric import FabricDisciplineChecker
     from tools.lint.host_sync import HostSyncChecker
     from tools.lint.retry import UnboundedRetryChecker
     from tools.lint.shed import ShedAccountingChecker
@@ -301,6 +302,7 @@ def _all_checkers() -> List[Checker]:
         UnboundedRetryChecker(),
         ShedAccountingChecker(),
         StoreDisciplineChecker(),
+        FabricDisciplineChecker(),
     ]
 
 

@@ -17,6 +17,16 @@ instances are the only legitimate time/randomness sources:
   ``random.Random(seed)`` passes;
 - ``numpy.random.*`` module-level calls likewise; ``default_rng(seed)``
   passes, ``default_rng()`` does not.
+
+The walk ALSO covers ``serve/fabric.py`` (ISSUE 12): the control
+fabric's chaos paths — partition windows, delay draws, duplicate
+decisions — run inside the sim twin on the virtual clock, so a wall
+clock or unseeded RNG there breaks byte-determinism exactly like one in
+``sim/`` would. (The fabric's live-mode DEFAULTS — ``time.monotonic``
+as the default clock argument, daemon timers in the default scheduler —
+are attribute references and constructor plumbing, not calls, and pass
+the rule by construction; an actual ``time.time()`` read in a chaos
+decision would not.)
 """
 
 from __future__ import annotations
@@ -34,7 +44,12 @@ class SimDeterminismChecker(Checker):
     rule = "sim-determinism"
 
     def applies(self, relpath: str) -> bool:
-        return in_dirs(relpath, {"sim"})
+        if in_dirs(relpath, {"sim"}):
+            return True
+        # The fabric's chaos decisions must replay byte-identically on
+        # the virtual clock — same contract as sim/ proper.
+        return (relpath.rsplit("/", 1)[-1] == "fabric.py"
+                and in_dirs(relpath, {"serve"}))
 
     def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
         if not isinstance(node, ast.Call):

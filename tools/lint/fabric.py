@@ -1,0 +1,105 @@
+"""fabric-discipline — cross-component control traffic goes through the seam.
+
+ISSUE 12 routed every cross-component control-plane exchange — store
+appends/reads/fencing, lease acquire/renew, shard gossip absorption,
+long-poll listens — through the :class:`serve.fabric.ControlFabric`
+seam, so partitions and chaos policies apply to the WHOLE control plane
+uniformly. The abstraction rots in exactly one way: someone writes
+``self.log.append(...)`` directly and that edge silently becomes
+un-partitionable — the chaos soak keeps passing while the code it was
+supposed to cover grows a perfect-network blind spot.
+
+A finding is raised for a direct CALL in serve/{store,frontdoor,
+long_poll}.py whose dotted target ends with a watched cross-component
+suffix. Fabric-routed usage never trips the rule by construction: the
+seam takes the bound method as an ARGUMENT (``fabric.call("store.append",
+self.log.append, ...)``), so no watched call expression appears.
+
+Scope notes, deliberate:
+
+- Local READS of shared objects (``lease.holder()``, ``log.fence_epoch``,
+  ``log.first_index``) are not watched: they are advisory views; the
+  authoritative checks happen at the fabric-routed append/acquire.
+- Intentional local fast paths (the gossip board's process-local
+  publish/collect, membership-change flushes that must be atomic with
+  the ring update) carry reasoned pragmas
+  (``# rdb-lint: disable=fabric-discipline (<why>)``).
+- The rule keys on file BASENAME within serve/ so test fixture trees
+  exercise it exactly like the shipped tree.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from tools.lint.core import Checker, FileCtx, Scope, in_dirs
+
+# file basename -> {watched dotted-call suffix: canonical fabric edge}
+WATCHED_CALLS: Dict[str, Dict[str, str]] = {
+    "store.py": {
+        ".log.append": "store.append",
+        ".log.read_from": "store.read",
+        ".log.fence_to": "store.fence",
+        ".log.install_snapshot": "store.snapshot",
+        ".log.latest_snapshot": "store.snapshot",
+        ".lease.acquire": "lease.acquire",
+        ".lease.renew": "lease.renew",
+    },
+    "frontdoor.py": {
+        ".bus.publish": "frontdoor.gossip",
+        ".bus.collect": "frontdoor.gossip",
+        ".absorb_states": "frontdoor.gossip",
+    },
+    "long_poll.py": {
+        ".listen_for_change": "long_poll.listen",
+    },
+}
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted attribute suffix with subscripts elided, so
+    ``self.shards[sid].absorb_states`` reads ``self.shards.absorb_states``
+    — a subscripted receiver must not hide a watched call."""
+    parts = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        else:
+            return ".".join(reversed(parts))
+
+
+class FabricDisciplineChecker(Checker):
+    rule = "fabric-discipline"
+
+    def applies(self, relpath: str) -> bool:
+        base = relpath.rsplit("/", 1)[-1]
+        return base in WATCHED_CALLS and in_dirs(relpath, {"serve"})
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        dotted = _attr_chain(node.func)
+        if not dotted:
+            return
+        base = ctx.relpath.rsplit("/", 1)[-1]
+        for suffix, edge in WATCHED_CALLS[base].items():
+            # `self.log.append` matches ".log.append"; a bare receiver
+            # (`log.append`) matches the suffix sans its leading dot.
+            if dotted.endswith(suffix) or dotted == suffix[1:]:
+                self.report(
+                    ctx, node,
+                    f"direct cross-component call {dotted}(...) bypasses "
+                    f"the control-fabric seam — route it through "
+                    f"fabric.call/cast on the {edge!r} edge so partitions "
+                    "and chaos policies apply, or pragma the intentional "
+                    "local fast path with a reason",
+                    scope,
+                )
+                return

@@ -1,0 +1,492 @@
+#!/usr/bin/env python
+"""Partition-defense conformance gate — cut the control plane in half.
+
+The contract under test is ISSUE 12's partition-defense layer:
+
+  - SPLIT-BRAIN DEFENSE: a leader that can renew its lease but not
+    reach the log (the asymmetric partition) SELF-DEMOTES within a
+    bounded window (``store_unreachable`` audit) instead of serving
+    stale state until fenced; every deposed-epoch append is rejected at
+    the fence — ZERO split-brain committed writes, count pinned;
+  - FAIL-CLOSED ADMISSION: gossip-partitioned front-door shards degrade
+    to a local-fraction budget at the staleness bound (audited
+    ``ledger_degraded``), so fleet over-admission is bounded by
+    ``(N-1) * rate * staleness_bound`` — never unbounded — and the
+    ledgers re-converge to EXACT global counts on heal;
+  - O(TAIL) FAILOVER: standby recovery is snapshot + tail replay; the
+    replay cost is ratcheted against ``snapshot_every`` and must NOT
+    scale with total log length (pinned against a long synthetic-uptime
+    log);
+  - the data plane never surfaces a client-visible system error through
+    any of it.
+
+Two modes:
+
+  --sim    the deterministic matrix (sim/scenarios.PARTITION_SCENARIOS
+           x sim/frontdoor.run_partition_sim): five partition classes —
+           symmetric split, leader-isolated-from-log-but-not-lease,
+           gossip-only, partition-during-flood, heal-and-reconverge —
+           each run TWICE and compared byte-for-byte, gated against
+           tools/partition_smoke.json. The CI fast lane's gate.
+  --live   a real ServeController pair on a shared epoch-fenced
+           StoreLog + LeaderLease + ReplicaCatalog behind one
+           ControlFabric, flooded from threads while the fabric cuts
+           the leader off from the log mid-flood; then a gossip
+           partition against a binding budget on the sharded front
+           door. Asserts the same invariants on wall-clock time.
+
+Exit: 0 conformant, 1 violation, 2 usage.
+
+Examples:
+  python tools/run_partition_soak.py --sim
+  python tools/run_partition_soak.py --live --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "partition_smoke.json")
+
+
+def _floors(section: str) -> dict:
+    with open(SMOKE_PATH) as f:
+        return json.load(f)["floors"][section]
+
+
+def _gate_sim_arm(kind: str, report: dict, floors: dict,
+                  failures: list) -> None:
+    """Per-scenario invariants on one (already determinism-checked)
+    partition-sim report."""
+    from ray_dynamic_batching_tpu.serve.fabric import parse_partition_spec
+
+    def fail(msg: str) -> None:
+        failures.append(f"[{kind}] {msg}")
+
+    c = report["counts"]
+    st = report["store"]
+    sc = report["scenario"]
+    # --- accounting conservation ----------------------------------------
+    if c["arrivals"] != c["admitted"] + c["rejected"]:
+        fail(f"accounting leak: {c['arrivals']} arrivals != "
+             f"{c['admitted']} admitted + {c['rejected']} rejected")
+    if c["completed"] != c["admitted"]:
+        fail(f"client-visible loss: admitted {c['admitted']} but "
+             f"completed {c['completed']} — the partition leaked into "
+             "the data plane")
+    # --- zero split-brain ------------------------------------------------
+    if st["split_brain_commits"] > floors["max_split_brain_commits"]:
+        fail(f"{st['split_brain_commits']} split-brain commit(s): a "
+             "deposed epoch's write landed in the log")
+    # --- bounded over-admission, fail-closed -----------------------------
+    if report["max_over_admitted"] > report["degrade_bound"]:
+        fail(f"over-admission {report['max_over_admitted']} exceeds the "
+             f"fail-closed bound {report['degrade_bound']} "
+             "((N-1)*rate*staleness_bound + N)")
+    drift = report["drift"]
+    ratio = drift["admitted"] / max(1.0, drift["allowed"])
+    if ratio < floors["min_admitted_ratio"]:
+        fail(f"under-admission: {ratio:.3f} of the allowance used under "
+             f"a 2x flood (floor {floors['min_admitted_ratio']}) — "
+             "fail-closed mode is starving the fleet")
+    # --- re-convergence on heal -----------------------------------------
+    if not report["reconverged"]:
+        fail("ledgers did NOT re-converge to exact global counts after "
+             f"heal: {report['ledgers']} vs oracle "
+             f"{report['true_admitted']}")
+    # --- per-class expectations -----------------------------------------
+    failover_kinds = {"symmetric_split", "leader_isolated",
+                      "partition_during_flood"}
+    gossip_kinds = {"symmetric_split", "gossip_only",
+                    "partition_during_flood", "heal_reconverge"}
+    if kind in failover_kinds:
+        if st["leader"] != "ctl-B" or st["epoch"] != 2:
+            fail(f"no failover: leader {st['leader']!r} at epoch "
+                 f"{st['epoch']} (expected ctl-B at 2)")
+        if not st["stale_write_rejected"] or st["rejected_appends"] < 1:
+            fail("deposed epoch's write was NOT rejected at the fence "
+                 "(split-brain)")
+        partitions = parse_partition_spec(sc["partition_spec"])
+        open_at = min(p.at_s for p in partitions)
+        lag = (st["failovers"][0]["at_s"] - open_at
+               if st["failovers"] else 1e9)
+        if lag > floors["max_failover_lag_s"]:
+            fail(f"failover lagged the partition by {lag:.1f}s (budget "
+                 f"{floors['max_failover_lag_s']}s = demote window + "
+                 "lease + ticks)")
+    else:
+        if st["leader"] != "ctl-A" or st["epoch"] != 1:
+            fail(f"spurious failover: leader {st['leader']!r} at epoch "
+                 f"{st['epoch']} with the store un-partitioned")
+        if st["rejected_appends"] != 0:
+            fail(f"{st['rejected_appends']} fence rejection(s) with the "
+                 "store un-partitioned")
+    if kind == "leader_isolated":
+        if st["self_demotions"]["ctl-A"] < 1 or st["demote_audits"] < 1:
+            fail("the isolated leader never self-demoted "
+                 "(store_unreachable) — it served stale state until "
+                 "fenced")
+        if st["appended_total"] < sc["preload_txns"]:
+            fail(f"synthetic uptime log too short "
+                 f"({st['appended_total']} < {sc['preload_txns']})")
+        if st["max_tail_replayed"] > floors["max_tail_replayed"]:
+            fail(f"recovery replayed {st['max_tail_replayed']} records "
+                 f"(> {floors['max_tail_replayed']}): failover scales "
+                 "with uptime, not tail")
+    if kind in gossip_kinds:
+        undegraded = [sid for sid, lg in report["ledgers"].items()
+                      if lg["degraded_entries"] < 1]
+        if undegraded:
+            fail(f"shards {undegraded} never degraded fail-closed "
+                 "through the gossip partition")
+        stale_end = [sid for sid, lg in report["ledgers"].items()
+                     if lg["stale_at_end"]]
+        if stale_end:
+            fail(f"shards {stale_end} still stale after heal — "
+                 "degraded mode did not exit")
+    if kind == "partition_during_flood":
+        if report["fabric"].get("frontdoor.gossip.duplicated", 0) < 1:
+            fail("chaos duplication never fired — the CRDT idempotence "
+                 "arm ran without duplicates")
+
+
+def run_sim(seed: int = 0) -> int:
+    from ray_dynamic_batching_tpu.sim.frontdoor import run_partition_sim
+    from ray_dynamic_batching_tpu.sim.report import format_partition_story
+    from ray_dynamic_batching_tpu.sim.scenarios import (
+        PARTITION_SCENARIOS,
+        partition_scenario,
+    )
+
+    floors = _floors("sim")
+    failures: list = []
+    summaries = {}
+    for kind in PARTITION_SCENARIOS:
+        reports = [run_partition_sim(partition_scenario(kind, seed=seed))
+                   for _ in range(2)]
+        blobs = [json.dumps(r, sort_keys=True) for r in reports]
+        if blobs[0] != blobs[1]:
+            failures.append(f"[{kind}] nondeterministic: same seed "
+                            "produced different report bytes")
+        _gate_sim_arm(kind, reports[0], floors, failures)
+        print(format_partition_story(reports[0]), file=sys.stderr)
+        st = reports[0]["store"]
+        summaries[kind] = {
+            "deterministic": blobs[0] == blobs[1],
+            "leader": st["leader"], "epoch": st["epoch"],
+            "self_demotions": st["self_demotions"],
+            "split_brain_commits": st["split_brain_commits"],
+            "fence_rejections": st["rejected_appends"],
+            "max_tail_replayed": st["max_tail_replayed"],
+            "appended_total": st["appended_total"],
+            "max_over_admitted": reports[0]["max_over_admitted"],
+            "degrade_bound": reports[0]["degrade_bound"],
+            "reconverged": reports[0]["reconverged"],
+            "degraded_entries": {
+                sid: lg["degraded_entries"]
+                for sid, lg in reports[0]["ledgers"].items()},
+        }
+    print(json.dumps({"mode": "sim", "scenarios": summaries,
+                      "violations": failures},
+                     indent=2, sort_keys=True))
+    return 1 if failures else 0
+
+
+def run_live(n_requests: int, rps: float) -> int:
+    from ray_dynamic_batching_tpu.serve import (
+        ControlFabric,
+        DeploymentConfig,
+        DeploymentHandle,
+        FrontDoor,
+        LeaderLease,
+        ReplicaCatalog,
+        ReplicatedStore,
+        ServeController,
+        StaleEpochError,
+        StoreLog,
+        is_shed,
+    )
+
+    floors = _floors("live")
+    preload = 1500
+    snapshot_every = 100
+
+    def factory():
+        def work(payloads):
+            time.sleep(0.001)
+            return [p * 2 for p in payloads]
+        return work
+
+    # ONE fabric under the whole control plane; armed mid-flood.
+    fabric = ControlFabric(partition_spec="", edge_spec="", seed=0)
+    log = StoreLog()
+    lease = LeaderLease(duration_s=1.0)
+    catalog = ReplicaCatalog()
+    store_a = ReplicatedStore(log, lease, "ctl-A", fabric=fabric,
+                              snapshot_every=snapshot_every)
+    assert store_a.acquire_leadership() == 1
+    # Long synthetic uptime BEFORE the flood: the O(tail) pin is that
+    # failover replay cost tracks snapshot_every, not this number.
+    for i in range(preload):
+        with store_a.txn() as txn:
+            txn.put_json("serve:synthetic_uptime", {"i": i})
+    ctl_a = ServeController(control_interval_s=0.05, store=store_a,
+                            catalog=catalog, fabric=fabric)
+    router = ctl_a.deploy(
+        DeploymentConfig(name="soak", num_replicas=2, max_batch_size=4,
+                         batch_wait_timeout_s=0.002, max_restarts=8),
+        factory=factory,
+    )
+    ctl_a.start()
+    handle = DeploymentHandle(router, default_slo_ms=30_000.0)
+
+    fd = FrontDoor(n_shards=2, gossip_interval_s=0.05, fabric=fabric,
+                   staleness_bound_s=0.5)
+    # Phase A budget far above the offered load: the flood proves the
+    # failover path; the bounded-over-admission math runs in phase B
+    # against a BINDING budget.
+    fd.configure("soak", rate_rps=max(10_000.0, rps * 4), burst=rps * 4)
+    fd.start()
+
+    violations: list = []
+    ctl_b = None
+    try:
+        assert handle.remote(1).result(timeout=10) == 2  # warmup
+        futures = []
+        rejected = 0
+        part_at = n_requests // 3
+        interval = 1.0 / rps if rps > 0 else 0.0
+        t_partition = None
+        for i in range(n_requests):
+            _sid, ok, _ra = fd.admit(
+                "soak", payload={"session_id": f"s{i % 16}"},
+                tenant=f"tenant-{i % 3}",
+            )
+            if not ok:
+                rejected += 1
+                continue
+            futures.append((i, handle.remote(i)))
+            if i == part_at:
+                # --- the asymmetric cut: leader | log, lease untouched --
+                t_partition = time.monotonic()
+                fabric.configure(partition_spec="ctl-A|log@t=0", seed=0)
+            if interval:
+                time.sleep(interval)
+        # --- bounded self-demotion ------------------------------------
+        deadline = time.monotonic() + floors["demote_s_budget"]
+        while time.monotonic() < deadline and store_a.is_leader():
+            time.sleep(0.02)
+        demote_s = time.monotonic() - (t_partition or time.monotonic())
+        if store_a.is_leader():
+            violations.append(
+                "leader never self-demoted while partitioned from the "
+                f"log (waited {floors['demote_s_budget']}s)"
+            )
+        if store_a.self_demotions < 1:
+            violations.append("no store_unreachable self-demotion "
+                              "counted on the isolated leader")
+        if not any(a["trigger"] == "store_unreachable"
+                   for a in ctl_a.audit.to_dicts()):
+            violations.append("no store_unreachable audit record")
+        # --- standby takeover: snapshot + tail replay ------------------
+        t0 = time.monotonic()
+        store_b = ReplicatedStore(log, lease, "ctl-B", fabric=fabric,
+                                  snapshot_every=snapshot_every)
+        ctl_b = ServeController(control_interval_s=0.05, store=store_b,
+                                catalog=catalog, fabric=fabric)
+        ctl_b.register_factory("soak", factory)
+        epoch = None
+        acq_deadline = time.monotonic() + floors["failover_s_budget"]
+        while time.monotonic() < acq_deadline:
+            epoch = store_b.acquire_leadership()
+            if epoch is not None:
+                break
+            time.sleep(0.02)
+        failover_s = time.monotonic() - t0
+        takeover_index = log.next_index()
+        if epoch != 2:
+            violations.append(f"standby acquired epoch {epoch!r}, "
+                              "expected 2")
+        recovered = ctl_b.recover()
+        ctl_b.start()
+        if recovered != ["soak"]:
+            violations.append(
+                f"standby recovered {recovered}, expected ['soak']")
+        rec = dict(store_b.last_recovery)
+        if rec["snapshot_index"] < 0:
+            violations.append(
+                "standby recovery never restored a snapshot — "
+                "compaction is not bounding failover")
+        if store_b.max_tail_replayed > floors["max_tail_replayed"]:
+            violations.append(
+                f"failover replayed {store_b.max_tail_replayed} records "
+                f"(> {floors['max_tail_replayed']}) against a "
+                f"{log.appended_total}-append log: failover time scales "
+                "with uptime")
+        if log.appended_total < preload:
+            violations.append(
+                f"synthetic uptime log too short ({log.appended_total})")
+        if failover_s > floors["failover_s_budget"]:
+            violations.append(
+                f"failover took {failover_s:.2f}s (budget "
+                f"{floors['failover_s_budget']}s)")
+        # --- heal; the deposed epoch must bounce off the fence ---------
+        fabric.configure(partition_spec="", seed=0)
+        stale_rejected = fence_rejected = False
+        try:
+            with ctl_a.store.txn() as txn:
+                txn.put("serve:heartbeat", '{"owner": "ctl-A"}')
+        except StaleEpochError:
+            stale_rejected = True
+        try:
+            # The wire-level probe: a raw epoch-1 append at the log.
+            log.append(1, [("put", "serve:split-brain-probe", "stale")])
+        except StaleEpochError:
+            fence_rejected = True
+        if not stale_rejected:
+            violations.append("deposed leader's commit was not refused")
+        if not fence_rejected or log.rejected_appends < 1:
+            violations.append("stale-epoch append was NOT rejected at "
+                              "the fence (split-brain)")
+        split_brain = [rec.index for rec in log.read_from(takeover_index)
+                       if rec.epoch < 2]
+        if split_brain:
+            violations.append(
+                f"{len(split_brain)} deposed-epoch record(s) committed "
+                f"after the takeover: {split_brain[:4]}")
+        # --- phase B: gossip partition against a BINDING budget --------
+        gossip_rate, gossip_offered, window_s = 200.0, 400.0, 1.2
+        fd.configure("gossiped", rate_rps=gossip_rate, burst=50.0)
+        time.sleep(0.3)  # a few clean gossip rounds anchor the ledgers
+        fabric.configure(partition_spec="fd-0|fd-1@t=0", seed=0)
+        t_end = time.monotonic() + window_s
+        j = 0
+        while time.monotonic() < t_end:
+            fd.admit("gossiped", payload={"session_id": f"g{j % 8}"},
+                     tenant="gossip-pop")
+            j += 1
+            time.sleep(1.0 / gossip_offered)
+        gossip_drift = fd.drift_audit("gossiped")
+        # Same analytic bound the sim arms use: (N-1)*rate*bound + N.
+        gossip_bound = (max(1, len(fd.shards) - 1)
+                        * gossip_rate * fd.staleness_bound_s
+                        + len(fd.shards))
+        if gossip_drift["over_admitted"] > gossip_bound:
+            violations.append(
+                f"gossip-partition over-admission "
+                f"{gossip_drift['over_admitted']} exceeds the "
+                f"fail-closed bound {gossip_bound:.1f}")
+        degraded_entries = sum(
+            s.ledger("gossiped").degraded_entries
+            for s in fd.shards.values())
+        if degraded_entries < 1:
+            violations.append("no shard degraded fail-closed through "
+                              "the gossip partition")
+        fabric.configure(partition_spec="", seed=0)
+        time.sleep(0.4)  # several healed gossip rounds
+        oracle = fd.true_admitted("gossiped")
+        unconverged = {
+            sid: s.ledger("gossiped").merged_count()
+            for sid, s in sorted(fd.shards.items())
+            if s.ledger("gossiped").merged_count() != oracle
+        }
+        if unconverged:
+            violations.append(
+                f"post-heal ledgers did not re-converge to the oracle "
+                f"{oracle}: {unconverged}")
+        for s in fd.shards.values():
+            # Refresh the decision-time degraded flag so the summary's
+            # stats() reflect the healed mesh, not the last admission.
+            s.ledger("gossiped").check(time.monotonic())
+        # --- client outcomes -------------------------------------------
+        completed = shed = system_errors = 0
+        first_error = None
+        for i, fut in futures:
+            try:
+                if fut.result(timeout=30) == i * 2:
+                    completed += 1
+                else:
+                    system_errors += 1
+                    first_error = first_error or f"wrong result for {i}"
+            except Exception as e:  # noqa: BLE001 — classification is the test
+                if is_shed(e):
+                    shed += 1
+                else:
+                    system_errors += 1
+                    first_error = (first_error
+                                   or f"{type(e).__name__}: {e}")
+        if system_errors:
+            violations.append(
+                f"{system_errors} client-visible system error(s) "
+                f"through the partition; first: {first_error}")
+        if completed < floors["min_completed_fraction"] * len(futures):
+            violations.append(
+                f"only {completed}/{len(futures)} admitted requests "
+                "completed — the partition shed traffic it should have "
+                "carried")
+        summary = {
+            "mode": "live",
+            "requests": n_requests,
+            "admitted": len(futures),
+            "frontdoor_rejected": rejected,
+            "completed": completed,
+            "shed": shed,
+            "system_errors": system_errors,
+            "demote_s": round(demote_s, 3),
+            "failover_s": round(failover_s, 3),
+            "self_demotions": store_a.self_demotions,
+            "recovery": rec,
+            "max_tail_replayed": store_b.max_tail_replayed,
+            "appended_total": log.appended_total,
+            "log_tail_records": len(log),
+            "stale_write_rejected": stale_rejected,
+            "fence_rejected": fence_rejected,
+            "log_rejected_appends": log.rejected_appends,
+            "split_brain_commits": len(split_brain),
+            "gossip": {
+                "over_admitted": gossip_drift["over_admitted"],
+                "bound": round(gossip_bound, 1),
+                "degraded_entries": degraded_entries,
+                "reconverged": not unconverged,
+                "oracle": oracle,
+            },
+            "frontdoor": fd.stats(),
+            "violations": violations,
+        }
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    finally:
+        fd.stop()
+        if ctl_b is not None:
+            ctl_b.shutdown()
+        ctl_a.shutdown()
+    return 1 if violations else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--sim", action="store_true",
+                      help="deterministic partition matrix (CI fast lane)")
+    mode.add_argument("--live", action="store_true",
+                      help="threaded soak against a real controller pair")
+    ap.add_argument("--smoke", action="store_true",
+                    help="live: shrink to a quick CI-sized soak")
+    ap.add_argument("--requests", type=int, default=600)
+    ap.add_argument("--rps", type=float, default=300.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.sim:
+        return run_sim(seed=args.seed)
+    n = 180 if args.smoke else args.requests
+    return run_live(n, args.rps)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
