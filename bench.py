@@ -113,6 +113,7 @@ def bench_llm_serving(
     quantize_kv: bool = False,
     paged: bool = False,
     mesh: int = 1,
+    spec: bool = False,
 ) -> dict:
     """North star: continuous-batching decode through the serving path.
 
@@ -126,6 +127,14 @@ def bench_llm_serving(
     pool when ``paged`` — and ``tok_s_per_chip`` normalizes by the
     slice width (whole-slice tokens / chips), the planner's
     per-chip-throughput convention for mesh profile rows.
+
+    ``spec`` attaches the ``gpt2_draft`` companion (ISSUE 13's A/B
+    axis; composes with ``paged`` — scratch-page drafts + splice
+    commits — but NOT with ``mesh`` > 1, which the engine rejects
+    loudly). The row stamps the measured ``spec_acceptance`` so a
+    capture can never be read without its acceptance context: at ~0
+    (untrained draft) the row measures the bounded-degradation floor,
+    at a real acceptance it measures the Leviathan multiplier.
     """
     import numpy as np
 
@@ -151,6 +160,7 @@ def bench_llm_serving(
             max_admissions_per_step=max_admissions_per_step,
             quantize_kv=quantize_kv,
             paged=paged,
+            draft_model_name="gpt2_draft" if spec else None,
         )
     devices = None
     slice_pg = slice_mgr = None
@@ -247,6 +257,9 @@ def bench_llm_serving(
     # KV positions — slabs reserve everything up front, pages only what
     # is live.
     kv_occupancy = round(replica.engine.kv_occupancy(), 4)
+    # Acceptance context for the spec arm (None off / before any round):
+    # a spec capture without its acceptance rate is unreadable.
+    acceptance = replica.engine.spec_acceptance() if spec else None
     replica.stop(timeout_s=2.0, drain=False)
     if slice_mgr is not None:
         slice_mgr.remove(slice_pg)
@@ -262,6 +275,9 @@ def bench_llm_serving(
         "max_new_tokens": max_new_tokens,
         "paged": paged,
         "mesh": mesh,
+        "spec": spec,
+        "spec_acceptance": (None if acceptance is None
+                            else round(acceptance, 4)),
         "kv_occupancy": kv_occupancy,
     }
 
@@ -492,6 +508,10 @@ def main() -> dict:
     # record). Composes with --paged: the TP-paged arm is the
     # mesh-native serving configuration the planner prices.
     mesh = int(os.environ.get("RDB_BENCH_MESH", "1") or 1)
+    # --spec on (RDB_BENCH_SPEC=1) attaches the gpt2_draft companion —
+    # ISSUE 13's A/B axis; composes with --paged (scratch-page drafts +
+    # splice commits). The rows stamp the measured acceptance rate.
+    spec = os.environ.get("RDB_BENCH_SPEC") == "1"
     llm_kwargs = dict(
         num_slots=8 if fast else 64,
         saturation_requests=16 if fast else 192,
@@ -499,6 +519,7 @@ def main() -> dict:
         decode_horizon=8 if fast else 32,
         paged=paged,
         mesh=mesh,
+        spec=spec,
     )
     try:
         llm = bench_llm_serving(**llm_kwargs)
@@ -575,6 +596,7 @@ def main() -> dict:
         "scope": "llm" if llm_only else "fast" if fast else "full",
         "paged": paged,
         "mesh": mesh,
+        "spec": spec,
         "ttft_p50_ms": llm["ttft_p50_ms"],
         "ttft_p99_ms": llm["ttft_p99_ms"],
         "llm": llm,
@@ -600,9 +622,18 @@ if __name__ == "__main__":
              "placement A/B axis, ROADMAP item 2; also "
              "RDB_BENCH_MESH=N; composes with --paged)",
     )
+    ap.add_argument(
+        "--spec", choices=("on", "off"), default=None,
+        help="attach the gpt2_draft speculative companion to the llm "
+             "rows (ISSUE 13's A/B axis; also RDB_BENCH_SPEC=1; "
+             "composes with --paged, rows stamp the acceptance rate; "
+             "NOT with --mesh > 1 — the engine rejects paged+spec+mesh)",
+    )
     cli = ap.parse_args()
     if cli.paged is not None:
         os.environ["RDB_BENCH_PAGED"] = "1" if cli.paged == "on" else "0"
     if cli.mesh is not None:
         os.environ["RDB_BENCH_MESH"] = str(cli.mesh)
+    if cli.spec is not None:
+        os.environ["RDB_BENCH_SPEC"] = "1" if cli.spec == "on" else "0"
     print(json.dumps(main()))

@@ -76,6 +76,7 @@ from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.ops.tile_math import (
     lane_aligned_page,
     pages_for,
+    spec_scratch_pages,
 )
 from ray_dynamic_batching_tpu.profiles.table import bucket_up
 from ray_dynamic_batching_tpu.utils.logging import get_logger
@@ -143,13 +144,30 @@ class _Slot:
         return self.request is None
 
 
+# Speculation observability (ISSUE 13 satellite): the ``paged`` tag
+# ("true"/"false") splits the slab and paged spec arms so an A/B capture
+# can never conflate them; accepted + rejected == drafted is a per-round
+# conservation invariant pinned in tier-1 (tests/test_spec_paged.py).
 SPEC_ROUNDS = m.Counter(
     "rdb_decode_spec_rounds_total", "Speculative verify rounds",
-    tag_keys=("model",),
+    tag_keys=("model", "paged"),
 )
 SPEC_ACCEPTED = m.Counter(
     "rdb_decode_spec_accepted_total", "Draft tokens accepted by verify",
-    tag_keys=("model",),
+    tag_keys=("model", "paged"),
+)
+SPEC_DRAFTED = m.Counter(
+    "rdb_decode_spec_drafted_total", "Draft tokens proposed to verify",
+    tag_keys=("model", "paged"),
+)
+SPEC_REJECTED = m.Counter(
+    "rdb_decode_spec_rejected_total", "Draft tokens rejected by verify",
+    tag_keys=("model", "paged"),
+)
+SPEC_ACCEPTANCE = m.Gauge(
+    "rdb_decode_spec_acceptance",
+    "Rolling draft-token acceptance rate (accepted/drafted, bounded "
+    "window)", tag_keys=("model", "paged"),
 )
 PREFIX_HITS = m.Counter(
     "rdb_decode_prefix_hits_total", "Prompt-prefix KV cache hits",
@@ -450,6 +468,20 @@ class DecodeEngine:
         self.model = model
         self.device = device
         self.mesh = mesh
+        if paged and draft_model is not None and mesh is not None:
+            # Loud, like the draft-model conflict ISSUE 13 lifted (and
+            # the PR 10 TP-paged pattern): the spec verify window would
+            # need the scratch-page scatter AND the staircase kernel
+            # runnable per-shard under shard_map — neither is wired yet,
+            # and a silent slab/plain fallback would mislabel every A/B
+            # capture stamped from the config. Checked BEFORE any
+            # sharding work so a misconfigured replica fails in
+            # microseconds, not after a multi-GB param reshard.
+            raise ValueError(
+                "speculative decoding over a TP-mesh paged pool is not "
+                "supported yet: run paged+spec on single-chip replicas, "
+                "or drop the draft model for mesh slices"
+            )
         # Weight-only int8: decode streams the whole weight set per step,
         # so weight BYTES set tokens/s; kernels live in HBM as int8 and
         # dequantize inside each program (convert+scale fused into the
@@ -510,12 +542,6 @@ class DecodeEngine:
         self.page_size = int(page_size)
         self._page_journal: Optional[PageEventJournal] = None
         if self.paged:
-            if draft_model is not None:
-                raise ValueError(
-                    "speculative decoding over the paged KV pool is not "
-                    "supported: the draft cache would need its own page "
-                    "tables — run spec engines on the slab path"
-                )
             if not lane_aligned_page(self.page_size):
                 raise ValueError(
                     f"page_size {self.page_size} must be a 128-lane "
@@ -700,6 +726,19 @@ class DecodeEngine:
         self.draft_model = draft_model
         self.spec_tokens = max(1, int(spec_tokens))
         self._dcache = None
+        # Rolling (accepted, drafted) pairs per spec round: feeds the
+        # rdb_decode_spec_acceptance gauge, spec_acceptance(), the bench
+        # row's acceptance stamp, and the sim's profiled-acceptance
+        # input. Bounded so a long-lived engine tracks the incident, not
+        # the healthy morning.
+        self._spec_acc_window: collections.deque = collections.deque(
+            maxlen=512
+        )
+        # Per-round scratch bookkeeping (paged spec): slot -> (first
+        # table index, scratch page ids). ALWAYS resolved (spliced or
+        # freed) before the round's harvest, so no scratch page can
+        # outlive its round or leak through a finish.
+        self._spec_scratch: Dict[int, Tuple[int, List[int]]] = {}
         if draft_model is not None:
             if draft_params is None:
                 raise ValueError("draft_model requires draft_params")
@@ -715,6 +754,13 @@ class DecodeEngine:
             with self._device_ctx():
                 # Headroom past max_len: the draft drafts spec_tokens+1
                 # ahead of the verified length near the end of the cache.
+                # The draft cache stays a SLAB even on paged engines: the
+                # shared pool's pages are target-geometry tensors (K, H
+                # of the big model), so the small draft would need a
+                # second pool of its own shape for a footprint that is a
+                # rounding error next to the target's — the TARGET-side
+                # KV of drafted tokens is what pages (scratch pages,
+                # spliced on accept).
                 self._dcache = draft_model.make_cache(
                     num_slots, max_len + self.spec_tokens + 1
                 )
@@ -1057,7 +1103,15 @@ class DecodeEngine:
         # the draft cache complete — it is never verified.
         d = drafts[:k].T  # [B, k]
         window = jnp.concatenate([tokens, d], axis=1)  # [B, k+1]
-        logits, cache = self.model.verify_step(params, window, cache, active)
+        # Paged engines verify through the page-table scatter + the
+        # staircase paged read (scratch pages pre-arranged host-side by
+        # _reserve_spec_scratch); the slab path is unchanged. Same
+        # window, same greedy rule — ONE accept computation below serves
+        # both, which is what keeps paged+spec and slab+spec
+        # byte-identical.
+        verify = (self.model.verify_step_paged if self.paged
+                  else self.model.verify_step)
+        logits, cache = verify(params, window, cache, active)
         logits = logits.astype(jnp.float32)
         # Same per-request bias as the plain path (ONE rule — _apply_bias —
         # broadcast over the window) so biased greedy stays
@@ -1544,8 +1598,23 @@ class DecodeEngine:
         (prefix/session entries) are shed before giving up. Page
         starvation is slot starvation's twin: the request goes back to
         the queue untouched and waits for EOS frees, exactly like a
-        slot-starved single — never silently dropped."""
-        need = max(0, pages_for(int(prompt.size) + 1, self.page_size)
+        slot-starved single — never silently dropped.
+
+        Spec engines reserve the first verify window's headroom
+        alongside the KV (``pages_for(len + spec_tokens + 1)`` — THE
+        shared round rule, ``tile_math.spec_scratch_pages``, called
+        here with len = prompt size since the pending first token is
+        row 0 OF the window): a slot admitted into a pool that cannot
+        even host one round would otherwise thrash the round-scratch
+        reclaim path from its very first step."""
+        if self._dcache is not None:
+            need_pages = spec_scratch_pages(
+                int(prompt.size), self.spec_tokens + 1, self.page_size,
+                self._paged_capacity,
+            )
+        else:
+            need_pages = pages_for(int(prompt.size) + 1, self.page_size)
+        need = max(0, need_pages
                    - int(opts.get("_session_share", 0)))
         while True:
             try:
@@ -2559,41 +2628,195 @@ class DecodeEngine:
             and float(np.abs(self._freq[active]).max(initial=0.0)) == 0.0
         )
 
+    # --- paged spec-round page bookkeeping (ISSUE 13 tentpole) -----------
+    def _reserve_spec_scratch(self) -> bool:
+        """Extend each active slot's device table to cover this round's
+        verify window ``[len, len + k + 1)`` with SCRATCH pages drawn
+        from the shared pool (``tile_math.spec_scratch_pages`` — the
+        admission headroom rule re-applied per round). Scratch pages are
+        named by the table (the verify scatter writes through them) but
+        are NOT yet owned by the slot: the round's outcome splices the
+        accepted prefix's pages into ``slot.pages`` and frees the
+        rejected tail (:meth:`_splice_spec_pages`).
+
+        Under pool pressure, cache pins shed first (same ladder as
+        :meth:`_ensure_page_headroom`); if the pool still cannot host a
+        window, every page taken for THIS round is returned and the
+        caller degrades to a plain paged step — speculation is an
+        optimization, and the degradation is bounded (the non-spec paged
+        arm), never a truncated live stream."""
+        win = self.spec_tokens + 1
+        for i in np.flatnonzero(self._active_mask):
+            slot = self._slots[i]
+            if slot.free:
+                continue
+            need = spec_scratch_pages(
+                int(self._len_host[i]), win, self.page_size,
+                self._paged_capacity,
+            )
+            delta = need - len(slot.pages)
+            if delta <= 0:
+                continue  # partial-page headroom covers the window
+            while not self._allocator.can_alloc(delta):
+                if not self._reclaim_cache_pins():
+                    break
+            if not self._allocator.can_alloc(delta):
+                self._rollback_spec_scratch()
+                return False
+            pids = self._allocator.alloc(delta)
+            n0 = len(slot.pages)
+            self._spec_scratch[int(i)] = (n0, pids)
+            self._table_host[i, n0:n0 + delta] = pids
+            self._table_dirty = True
+        return True
+
+    def _rollback_spec_scratch(self) -> None:
+        """Give back every scratch page of an unresolved round (aborted
+        reserve, or a round that died between reserve and splice). The
+        table row is REBUILT from the slot's owned pages rather than
+        sentinel-stamping the recorded span: between a crashed round and
+        this rollback the row may have been rewritten by a plain step's
+        headroom growth or a finish + fresh admission at the same index,
+        and blind sentinels over that span would silently void a live
+        occupant's KV writes (mode=\"drop\") — the corruption class the
+        regression test pins. The scratch pages themselves are still
+        exclusively round-held (refcount 1, never in ``slot.pages``), so
+        the decref is unconditionally correct."""
+        for i, (_n0, pids) in self._spec_scratch.items():
+            self._table_host[i] = table_array(
+                self._slots[i].pages, self._n_table_entries, self.num_pages
+            )
+            self._table_dirty = True
+            self._allocator.decref(pids)
+        self._spec_scratch.clear()
+
+    def _splice_spec_pages(self, lengths_host: np.ndarray) -> None:
+        """Resolve the round's scratch pages from the verified lengths:
+        scratch pages whose table span is covered by the ACCEPTED length
+        commit by page-table splice — re-pointed into ``slot.pages``
+        with zero KV bytes copied (the entries already name them; the
+        accepted tokens' k/v landed there during verify) — and the
+        rejected tail frees back to the pool, its table entries reset to
+        the sentinel. Each movement is an allocator-journal event
+        (``spec_commit``/``spec_reject``), the acceptance signal the
+        Perfetto export renders next to ``decode.turn`` spans. Runs
+        BEFORE the harvest so a finishing slot frees exactly the pages
+        it owns. The dict is drained up front: were an entry to survive
+        its own resolution, a later rollback would decref the same
+        pages twice."""
+        items = list(self._spec_scratch.items())
+        self._spec_scratch.clear()
+        for i, (n0, pids) in items:
+            slot = self._slots[i]
+            covered = pages_for(int(lengths_host[i]), self.page_size)
+            commit_n = max(0, min(covered - n0, len(pids)))
+            committed, rejected = pids[:commit_n], pids[commit_n:]
+            if committed:
+                slot.pages.extend(committed)
+                self._page_journal.record(
+                    "spec_commit", len(committed),
+                    self._allocator.allocated_pages, slot=int(i),
+                )
+            if rejected:
+                self._table_host[i, n0 + commit_n:n0 + len(pids)] = \
+                    self.num_pages
+                self._table_dirty = True
+                self._allocator.decref(rejected)
+                self._page_journal.record(
+                    "spec_reject", len(rejected),
+                    self._allocator.allocated_pages, slot=int(i),
+                )
+
+    def spec_acceptance(self) -> Optional[float]:
+        """Rolling draft-token acceptance rate (accepted/drafted over
+        the bounded round window), or None before the first round —
+        stamped into bench rows and the profiled-acceptance input the
+        sim's spec pricing consumes."""
+        if not self._spec_acc_window:
+            return None
+        acc = sum(a for a, _ in self._spec_acc_window)
+        drafted = sum(d for _, d in self._spec_acc_window)
+        return acc / drafted if drafted else None
+
     def _spec_step(self) -> None:
         k = self.spec_tokens
-        (_samp_f, _samp_i, bias_ids_d, bias_vals_d) = \
-            self._sampling_arrays()
-        self._scan_start_ms = now_ms()
-        packed, self._cache, self._dcache = self._spec_fn(
-            self.params,
-            self._cache,
-            self._dcache,
-            jnp.asarray(np.stack([
-                self._tokens[:, 0],
-                self._active_mask.astype(np.int32),
-            ])),
-            bias_ids_d,
-            bias_vals_d,
-        )
-        ph = np.asarray(packed)  # ONE fetch per round  # rdb-lint: disable=host-sync-in-hot-path (THE one fetch per spec round: ph carries tokens+counts+lengths packed)
+        paged_tag = "true" if self.paged else "false"
+        if self.paged:
+            if self._spec_scratch:
+                # A previous round died between reserve and splice (a
+                # device error the loop swallowed): its scratch would
+                # otherwise leak refcounts forever and shadow-occupy the
+                # pool. Roll it back before arranging a fresh window.
+                self._rollback_spec_scratch()
+            if not self._reserve_spec_scratch():
+                # Pool too tight for a verify window this round: one
+                # plain paged step instead (its own headroom ladder may
+                # capacity-evict, but the spec path never does) — under
+                # sustained pressure throughput degrades to the non-spec
+                # paged arm, not off a cliff.
+                return self._step(horizon=1)
+        try:
+            # From here to the packed fetch, scratch is armed but
+            # unresolved: ANY failure — table upload, sampling-state
+            # upload, the dispatch itself — must roll it back NOW, not
+            # at the next spec round (there may never be one: a sampled
+            # row can pin _use_spec() False for the engine's remaining
+            # lifetime, shadow-occupying the pool), then let the loop's
+            # error handling see the error.
+            if self.paged:
+                self._refresh_table()
+            (_samp_f, _samp_i, bias_ids_d, bias_vals_d) = \
+                self._sampling_arrays()
+            self._scan_start_ms = now_ms()
+            packed, self._cache, self._dcache = self._spec_fn(
+                self.params,
+                self._cache,
+                self._dcache,
+                jnp.asarray(np.stack([
+                    self._tokens[:, 0],
+                    self._active_mask.astype(np.int32),
+                ])),
+                bias_ids_d,
+                bias_vals_d,
+            )
+            ph = np.asarray(packed)  # ONE fetch per round  # rdb-lint: disable=host-sync-in-hot-path (THE one fetch per spec round: ph carries tokens+counts+lengths packed)
+        except BaseException:
+            if self.paged:
+                self._rollback_spec_scratch()
+            raise
         self._scan_end_ms = now_ms()
         if _tracer().enabled:
             self._record_turn_span(k, self._active_mask, spec=True)
         out = ph[: k + 1]        # [k+1, B]
         n_out = ph[k + 1]        # [B]
         lengths = ph[k + 2]      # [B]
+        if self.paged:
+            # Accepted prefixes commit by page-table splice, rejected
+            # tails free — resolved from the post-round lengths BEFORE
+            # the harvest can finish (and free) any slot.
+            self._splice_spec_pages(lengths)
         self.steps += 1
         DECODE_STEPS.inc(tags={"model": self.model.name})
-        SPEC_ROUNDS.inc(tags={"model": self.model.name})
+        tags = {"model": self.model.name, "paged": paged_tag}
+        SPEC_ROUNDS.inc(tags=tags)
         live = np.asarray([
             not slot.free and self._active_mask[i] and n_out[i] > 0
             for i, slot in enumerate(self._slots)
         ])
-        if live.any():  # one summed increment, not one .inc() per slot
-            SPEC_ACCEPTED.inc(
-                int((n_out[live] - 1).sum()),
-                tags={"model": self.model.name},
-            )
+        active_n = int(self._active_mask.sum())
+        drafted = k * active_n
+        accepted = int((n_out[live] - 1).sum()) if live.any() else 0
+        # Conservation by construction, pinned in tier-1:
+        # accepted + rejected == drafted, per round.
+        if drafted:
+            SPEC_DRAFTED.inc(drafted, tags=tags)
+            SPEC_REJECTED.inc(drafted - accepted, tags=tags)
+            self._spec_acc_window.append((accepted, drafted))
+            rate = self.spec_acceptance()
+            if rate is not None:
+                SPEC_ACCEPTANCE.set(rate, tags=tags)
+        if accepted:  # one summed increment, not one .inc() per slot
+            SPEC_ACCEPTED.inc(accepted, tags=tags)
         # Same harvest as the plain scan, with advanced = (j < n_out):
         # a short row is draft rejection, not cache capacity.
         self._harvest(
@@ -2912,6 +3135,12 @@ class DecodeEngine:
                 "events": self._page_journal.snapshot(),
                 "journal_total": self._page_journal.total,
                 "journal_rotated": self._page_journal.rotated_out,
+            }
+        if self.draft_model is not None:
+            out["spec"] = {
+                "spec_tokens": self.spec_tokens,
+                "acceptance": self.spec_acceptance(),
+                "rounds_windowed": len(self._spec_acc_window),
             }
         return out
 

@@ -75,7 +75,8 @@ class OutOfPages(Exception):
 class PageEventJournal:
     """Bounded ring of allocator events — the paged pool's flight
     recorder. Placement and paging decisions (allocs, EOS frees, CoW
-    borrows, cache-pin reclaims, capacity evictions) spend milliseconds
+    borrows, cache-pin reclaims, capacity evictions, speculative
+    splice-commits/reject-frees) spend milliseconds
     that are invisible between a decode-turn span's start and end; the
     journal stamps each one with the SAME monotonic-ms clock the tracer
     uses, so ``utils/trace_export.py`` renders them as Perfetto instant
@@ -89,7 +90,7 @@ class PageEventJournal:
     """
 
     KINDS = ("alloc", "free", "cow_copy", "cache_reclaim", "eviction",
-             "spill", "reload")
+             "spill", "reload", "spec_commit", "spec_reject")
 
     def __init__(self, capacity: int = 4096) -> None:
         if capacity <= 0:

@@ -199,6 +199,40 @@ class CausalLM(ServableModel):
         )
         return logits, new_cache
 
+    def verify_step_paged(
+        self,
+        params,
+        tokens: jax.Array,   # [B, T] pending token + proposed continuation
+        cache: PagedKVCache,
+        active: jax.Array,   # [B] bool
+    ) -> Tuple[jax.Array, PagedKVCache]:
+        """Paged mirror of :meth:`verify_step` — the speculative-verify
+        primitive over the page pool. Row b's T-token window starts at
+        its own ``lengths[b]``; k/v scatter through the page table into
+        the round's scratch pages (per-row positions, ``mode="drop"``
+        for rows steered out of bounds), and attention reads the
+        STAIRCASE window (row t attends positions <= lengths + t — the
+        ``paged_window_mask`` rule, fused in the paged kernel and
+        streamed by the gather fallback). ``lengths`` are NOT advanced —
+        the caller accepts a per-row prefix and sets them, exactly the
+        slab contract, which is what keeps paged+spec greedy decoding
+        byte-identical to slab+spec."""
+        B, T = tokens.shape
+        S = cache.capacity
+        base = cache.lengths[:, None]  # [B, 1]
+        positions = base + jnp.arange(T)[None, :]
+        # Out-of-bounds positions for inactive/overflowing rows: their
+        # scatter steers to the sentinel page and their outputs are
+        # never accepted (the engine clamps n_out to remaining room).
+        positions = jnp.where(
+            active[:, None] & (positions < S), positions, S
+        )
+        logits, new_cache = self.module.apply(
+            params, tokens, positions, None, cache, scatter_writes=True,
+            page_table=cache.page_table, kv_lengths=cache.lengths,
+        )
+        return logits, new_cache
+
     def decode_step(
         self,
         params,
@@ -365,6 +399,27 @@ LLAMA3_8B = DecoderConfig(
     rope_theta=500000.0,
 )
 
+# Draft companion for gpt2_medium (ISSUE 13 bench A/B): same vocab and
+# position style so its proposals index the target's logit space, ~1/40
+# of the FLOPs — the Leviathan-shaped draft geometry. Random-init
+# weights make on-chip acceptance ~0 (the captured row then measures the
+# bounded-degradation floor, honestly stamped via spec_acceptance);
+# trained weights turn the same arm into the speedup measurement.
+GPT2_DRAFT = DecoderConfig(
+    vocab_size=50257,
+    d_model=256,
+    num_layers=4,
+    num_heads=4,
+    num_kv_heads=4,
+    mlp_dim=1024,
+    max_seq_len=1024,
+    pos="learned",
+    norm="ln",
+    gated_mlp=False,
+    use_bias=True,
+    tie_embeddings=True,
+)
+
 TINY_LM = DecoderConfig(
     vocab_size=512,
     d_model=64,
@@ -396,6 +451,11 @@ def _gpt2_medium(**kwargs) -> CausalLM:
 @register_model("llama3_8b", slo=ModelSLO(latency_slo_ms=150.0))
 def _llama3_8b(**kwargs) -> CausalLM:
     return CausalLM(LLAMA3_8B, name="llama3_8b", **kwargs)
+
+
+@register_model("gpt2_draft")
+def _gpt2_draft(**kwargs) -> CausalLM:
+    return CausalLM(GPT2_DRAFT, name="gpt2_draft", **kwargs)
 
 
 @register_model("llama_tiny")

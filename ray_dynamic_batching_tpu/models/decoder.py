@@ -283,41 +283,47 @@ class DecoderLayer(nn.Module):
                 k_w, v_w = k, v
             B, T = positions.shape
             if page_table is not None:
-                # Paged decode (T == 1 only): the cache arrays are page
-                # POOLS [L, P, ps, K, H]; the token's logical position
-                # maps through the slot's page-table row to a physical
-                # (page, offset). Unallocated entries carry the sentinel
-                # P, and logically-overflowing rows are steered to it
-                # too, so mode="drop" voids exactly the writes the slab
-                # path's out-of-bounds scatter voids.
-                if T != 1 or scatter_writes:
+                # Paged writes: the cache arrays are page POOLS
+                # [L, P, ps, K, H]; each token's logical position maps
+                # through the slot's page-table row to a physical
+                # (page, offset). Two patterns share the rule — plain
+                # decode (T == 1, positions = lengths) and the
+                # speculative-verify window (``scatter_writes``: T ==
+                # k+1 per-row positions starting at each slot's own
+                # length, landing in the round's scratch pages).
+                # Unallocated entries carry the sentinel P, and
+                # logically-overflowing rows are steered to it too, so
+                # mode="drop" voids exactly the writes the slab path's
+                # out-of-bounds scatter voids.
+                if T != 1 and not scatter_writes:
                     raise NotImplementedError(
                         "paged cache writes support single-token decode "
-                        "only; prefill/verify run on row caches and "
-                        "commit through the engine's page scatter"
+                        "and per-row scatter windows (spec verify) only; "
+                        "prefill runs on row caches and commits through "
+                        "the engine's page scatter"
                     )
                 P = k_full.shape[1]
                 ps = k_full.shape[2]
                 n_entries = page_table.shape[1]
-                idx = positions[:, 0]
-                rows = jnp.arange(B)
+                idx = positions  # [B, T]
+                rows = jnp.arange(B)[:, None]
                 pidx = jnp.minimum(idx // ps, n_entries - 1)
                 pid = jnp.where(
                     idx < n_entries * ps, page_table[rows, pidx], P
                 )
                 off = idx % ps
                 k_full = k_full.at[layer_idx, pid, off].set(
-                    k_w[:, 0], mode="drop"
+                    k_w, mode="drop"
                 )
                 v_full = v_full.at[layer_idx, pid, off].set(
-                    v_w[:, 0], mode="drop"
+                    v_w, mode="drop"
                 )
                 if quantized:
                     ks_full = ks_full.at[layer_idx, pid, off].set(
-                        k_s[:, 0], mode="drop"
+                        k_s, mode="drop"
                     )
                     vs_full = vs_full.at[layer_idx, pid, off].set(
-                        v_s[:, 0], mode="drop"
+                        v_s, mode="drop"
                     )
             elif scatter_writes:
                 # Batched multi-token writes at PER-ROW positions (the
@@ -532,3 +538,19 @@ def decode_mask(lengths: jax.Array, capacity: int) -> jax.Array:
     """Attend to positions [0, lengths] inclusive. lengths [B] -> [B,1,1,S]."""
     pos = jnp.arange(capacity)[None, None, None, :]
     return pos <= lengths[:, None, None, None]
+
+
+def paged_window_mask(lengths: jax.Array, capacity: int,
+                      window: int) -> jax.Array:
+    """STAIRCASE window over the paged logical view: verify-window row t
+    (the token written at position ``lengths + t``) attends positions
+    [0, lengths + t] inclusive. lengths [B] -> [B, 1, window, S].
+
+    This is THE paged window rule — the Pallas paged kernel computes the
+    same staircase in-kernel from the prefetched lengths, and the gather
+    fallback streams this mask — so kernel and fallback can never
+    disagree about what a spec-verify row may attend. ``window == 1`` is
+    exactly :func:`decode_mask` (plain paged decode)."""
+    pos = jnp.arange(capacity)[None, None, None, :]
+    bound = (lengths[:, None] + jnp.arange(window)[None, :])
+    return pos <= bound[:, None, :, None]

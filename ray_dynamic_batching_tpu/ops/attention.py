@@ -227,7 +227,10 @@ def _paged_attention(
     # (S = NP * ps) and re-enter the slab path. Sentinel/garbage pages
     # clamp to a real page, then the length mask voids their positions —
     # the same never-attended-garbage invariant the slab cache relies on.
-    from ray_dynamic_batching_tpu.models.decoder import decode_mask
+    # Tq > 1 is the speculative-verify window: the STAIRCASE mask (row t
+    # attends <= lengths + t, paged_window_mask — the same rule the
+    # kernel computes in-VMEM from the prefetched lengths).
+    from ray_dynamic_batching_tpu.models.decoder import paged_window_mask
 
     P = k.shape[0]
     safe = jnp.minimum(page_table, P - 1)
@@ -242,7 +245,7 @@ def _paged_attention(
     ks_g = vs_g = None
     if k_scale is not None:
         ks_g, vs_g = logical(k_scale), logical(v_scale)
-    win = decode_mask(kv_lengths, NP * ps)  # [B, 1, 1, S]
+    win = paged_window_mask(kv_lengths, NP * ps, q.shape[1])
     return dot_product_attention(
         q, k_g, v_g, mask=win, scale=scale, k_scale=ks_g, v_scale=vs_g,
     )

@@ -314,21 +314,23 @@ def _decode_attention(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "interpret")
+    jax.jit, static_argnames=("scale", "window", "interpret")
 )
 def _paged_decode_attention(
-    q: jax.Array,          # [B, K, G, H]  (Tq == 1)
+    q: jax.Array,          # [B, K, Tq*G, H]  rows ordered (t, g)
     k: jax.Array,          # [P, ps, K, H] page pool
     v: jax.Array,
     page_table: jax.Array,  # [B, NP] int32, sentinel P
-    lengths: jax.Array,     # [B] int32 — attend positions <= lengths[b]
+    lengths: jax.Array,     # [B] int32 — row t attends pos <= lengths[b]+t
     k_scale: Optional[jax.Array],  # [P, K, ps] f32 (int8 pool), or None
     v_scale: Optional[jax.Array],
     *,
     scale: float,
+    window: int,
     interpret: bool,
 ) -> jax.Array:
-    B, K, G, H = q.shape
+    B, K, R, H = q.shape
+    G = R // window
     P, ps = k.shape[0], k.shape[1]
     NP = page_table.shape[1]
     kb = _pick_heads_block(K)
@@ -344,7 +346,7 @@ def _paged_decode_attention(
         return (jnp.minimum(pt[b, p], P - 1), 0, j, 0)
 
     in_specs = [
-        pl.BlockSpec((1, kb, G, H), lambda b, j, p, pt, ln: (b, j, 0, 0)),
+        pl.BlockSpec((1, kb, R, H), lambda b, j, p, pt, ln: (b, j, 0, 0)),
         pl.BlockSpec((1, ps, kb, H), kv_index),
         pl.BlockSpec((1, ps, kb, H), kv_index),
     ]
@@ -363,11 +365,14 @@ def _paged_decode_attention(
         o_ref, m_ref, l_ref, acc_ref = rest[2 if has_scales else 0:][:4]
         b = pl.program_id(0)
         p = pl.program_id(2)
-        # In-kernel validity from the prefetched lengths: page p covers
-        # logical positions [p*ps, (p+1)*ps); decode attends <= lengths
-        # (the slab decode_mask rule). No mask array is streamed at all.
-        pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (G, ps), 1)
-        valid = pos <= len_ref[b]
+        # In-kernel STAIRCASE validity from the prefetched lengths: page
+        # p covers logical positions [p*ps, (p+1)*ps); window row t (row
+        # r = t*G + g) attends pos <= lengths[b] + t — the spec-verify
+        # window rule, whose Tq == 1 degenerate case is exactly the slab
+        # decode_mask bound. No mask array is streamed at all.
+        pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (R, ps), 1)
+        t_of_row = jax.lax.broadcasted_iota(jnp.int32, (R, ps), 0) // G
+        valid = pos <= len_ref[b] + t_of_row
         _scan_tile(
             q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref,
             acc_ref, valid=valid, scale=scale, num_s=NP,
@@ -378,18 +383,18 @@ def _paged_decode_attention(
         grid=(B, K // kb, NP),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (1, kb, G, H), lambda b, j, p, pt, ln: (b, j, 0, 0)
+            (1, kb, R, H), lambda b, j, p, pt, ln: (b, j, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((kb, G), jnp.float32),
-            pltpu.VMEM((kb, G), jnp.float32),
-            pltpu.VMEM((kb, G, H), jnp.float32),
+            pltpu.VMEM((kb, R), jnp.float32),
+            pltpu.VMEM((kb, R), jnp.float32),
+            pltpu.VMEM((kb, R, H), jnp.float32),
         ],
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, K, G, H), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, K, R, H), q.dtype),
         compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -415,10 +420,13 @@ def paged_decode_attention(
     aren't the paged decode pattern (caller falls back to the explicit
     gather — same decline contract as :func:`decode_attention`).
 
-    q [B, 1, N, H]; k/v [P, ps, K, H] page pools with K dividing N;
-    page_table [B, NP] int32 (sentinel P = unallocated); kv_lengths [B]
-    (attend logical positions <= kv_lengths[b], the ``decode_mask``
-    rule). ``k_scale``/``v_scale`` [P, ps, K] enable the int8-pool path.
+    q [B, Tq, N, H] with Tq <= MAX_WINDOW_FOR_KERNEL; k/v [P, ps, K, H]
+    page pools with K dividing N; page_table [B, NP] int32 (sentinel P =
+    unallocated); kv_lengths [B]. Window row t attends logical positions
+    <= kv_lengths[b] + t — the STAIRCASE rule of the speculative-verify
+    window (``models/decoder.py::paged_window_mask`` owns it), whose
+    Tq == 1 case is exactly the plain-decode ``decode_mask`` bound.
+    ``k_scale``/``v_scale`` [P, ps, K] enable the int8-pool path.
 
     Eligibility is the lane-alignment + VMEM-budget contract of
     ``ops/tile_math.py``: the page IS the KV tile, so its streamed
@@ -438,9 +446,11 @@ def paged_decode_attention(
     divide — replicated heads fall back to the gather path, which GSPMD
     partitions from the pool's NamedSharding.
     """
-    if q.ndim != 4 or k.ndim != 4 or q.shape[1] != 1:
+    if q.ndim != 4 or k.ndim != 4:
         return None
     B, Tq, N, H = q.shape
+    if not (1 <= Tq <= MAX_WINDOW_FOR_KERNEL):
+        return None  # wide windows are prefill-shaped: gather/flash path
     P, ps, K, Hk = k.shape
     if Hk != H or v.shape != k.shape or K == 0 or N % K != 0:
         return None
@@ -464,16 +474,23 @@ def paged_decode_attention(
     # budgets the block the kernel will ACTUALLY stream on one core.
     k_local = tile_math.shard_heads(K, tp)
     kb = _pick_heads_block(k_local)
+    G = N // K
     if tile_math.paged_tile_bytes(
             ps, kb, H, k.dtype.itemsize,
-            with_scales=k_scale is not None) > VMEM_BLOCK_BUDGET_BYTES:
+            with_scales=k_scale is not None,
+            # G is shard-invariant: a shard keeps N/tp query per K/tp kv
+            # heads, so each head block still carries Tq*G window rows.
+            window=Tq, G=G,
+    ) > VMEM_BLOCK_BUDGET_BYTES:
         return None  # page too fat for VMEM double-buffering: gather path
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else H ** -0.5
-    G = N // K
-    # Rows ordered per kv head: [B, 1, K, G, H] -> [B, K, G, H].
-    q_r = q.reshape(B, K, G, H)
+    # Rows ordered (t, g) per kv head: [B, Tq, K, G, H] ->
+    # [B, K, Tq*G, H] (Tq == 1 collapses to the historical layout).
+    q_r = q.reshape(B, Tq, K, G, H).transpose(0, 2, 1, 3, 4).reshape(
+        B, K, Tq * G, H
+    )
     ks = vs = None
     if k_scale is not None:
         # [P, ps, K] -> [P, K, ps]: the page becomes the (lane) trailing
@@ -486,22 +503,22 @@ def paged_decode_attention(
         out = _paged_decode_attention_tp(
             mesh, mesh_axis, q_r, k, v, page_table.astype(jnp.int32),
             kv_lengths.astype(jnp.int32), ks, vs,
-            scale=float(scale), interpret=bool(interpret),
+            scale=float(scale), window=int(Tq), interpret=bool(interpret),
         )
     else:
         out = _paged_decode_attention(
             q_r, k, v, page_table.astype(jnp.int32),
             kv_lengths.astype(jnp.int32), ks, vs,
-            scale=float(scale), interpret=bool(interpret),
+            scale=float(scale), window=int(Tq), interpret=bool(interpret),
         )
-    return out.reshape(B, K, 1, G, H).transpose(0, 2, 1, 3, 4).reshape(
-        B, 1, N, H
+    return out.reshape(B, K, Tq, G, H).transpose(0, 2, 1, 3, 4).reshape(
+        B, Tq, N, H
     )
 
 
 def _paged_decode_attention_tp(
     mesh, axis: str, q_r, k, v, page_table, kv_lengths, ks, vs,
-    *, scale: float, interpret: bool,
+    *, scale: float, window: int, interpret: bool,
 ):
     """The TP wrapper: ``shard_map`` the paged kernel over the mesh's
     ``axis`` with q/pools split on the kv-head dim and the page
@@ -530,7 +547,7 @@ def _paged_decode_attention_tp(
         vs_l = rest[1] if has_scales else None
         return _paged_decode_attention(
             q_l, k_l, v_l, pt, ln, ks_l, vs_l,
-            scale=scale, interpret=interpret,
+            scale=scale, window=window, interpret=interpret,
         )
 
     return shard_map(

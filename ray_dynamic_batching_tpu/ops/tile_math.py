@@ -105,6 +105,19 @@ def shard_heads(num_kv_heads: int, tp: int) -> int:
     return num_kv_heads
 
 
+def spec_scratch_pages(length: int, spec_window: int,
+                       page_size: int, capacity: int) -> int:
+    """Pages a speculative verify round needs a slot's table to cover:
+    the round writes the ``spec_window`` (= spec_tokens + 1) positions
+    ``[length, length + spec_window)``, clamped to the slot's logical
+    ``capacity``. Shared by the engine's scratch-page reservation
+    (``DecodeEngine._reserve_spec_scratch``) and the admission headroom
+    rule (``pages_for(len + spec_tokens + 1)``), so the two can never
+    disagree about a round's page demand."""
+    return pages_for(min(int(length) + int(spec_window), int(capacity)),
+                     page_size)
+
+
 def lane_aligned_page(page_size: int) -> bool:
     """A KV page is tile-legal iff its size is a LANE multiple: the int8
     scale tile streams as [1, kb, page_size] with the page as its lane
@@ -118,6 +131,8 @@ def paged_tile_bytes(
     H: int,
     kv_itemsize: int,
     with_scales: bool = False,
+    window: int = 1,
+    G: int = 1,
 ) -> int:
     """Double-buffered VMEM footprint of one PAGED decode-attention grid
     step's streamed blocks — the model the paged kernel's runtime guard
@@ -130,12 +145,26 @@ def paged_tile_bytes(
       LANE dim — hence :func:`lane_aligned_page`);
     - NO mask tile: validity is computed in-kernel from the prefetched
       per-slot lengths, so the paged path streams no mask at all.
+
+    ``window`` > 1 (the speculative-verify Tq == k+1 window) adds the
+    SCRATCH-HEADROOM term: the q/out blocks ([1, kb, window*G, H]) and
+    the f32 online-softmax accumulator ([kb, window*G, H] VMEM scratch)
+    grow with the window's row count, and for decode's Tq == 1 they are
+    the small riders the base model documents away — a wide window makes
+    them first-class. ``window == 1`` returns EXACTLY the historical
+    value (agreement pins in tests/test_lint.py stay byte-stable).
     """
     kv = 2 * padded_block_bytes((1, page_size, kb, H), kv_itemsize)
     scale_b = (
         2 * padded_block_bytes((1, kb, page_size), 4) if with_scales else 0
     )
-    return DOUBLE_BUFFER * (kv + scale_b)
+    total = DOUBLE_BUFFER * (kv + scale_b)
+    if window > 1:
+        rows = int(window) * max(1, int(G))
+        qo = 2 * padded_block_bytes((1, kb, rows, H), kv_itemsize)
+        acc = padded_block_bytes((kb, rows, H), 4)  # f32 scratch, single
+        total += DOUBLE_BUFFER * qo + acc
+    return total
 
 
 def decode_tile_bytes(

@@ -20,7 +20,12 @@ TPU-first differences from the reference CSVs:
   absorb: weights/tp + its activation shard), and throughput the whole
   slice's. Single-chip rows are ``mesh="1x1"``, the loader default, so
   every committed table reads unchanged and every lookup that doesn't
-  ask for a mesh keeps seeing exactly the rows it always did.
+  ask for a mesh keeps seeing exactly the rows it always did;
+- a ``spec`` column generalizes the table to speculative decoding
+  (ISSUE 13): ``spec="on"`` rows describe one VERIFY ROUND (draft +
+  window verify), converted to an effective per-step cost by
+  :func:`expected_tokens_per_round` at the session's acceptance rate.
+  ``"off"`` is the loader default — pre-spec tables read unchanged.
 """
 
 from __future__ import annotations
@@ -43,6 +48,15 @@ class ProfileRow:
     compile_ms: float            # one-time XLA compile cost for this bucket
     throughput_sps: float = 0.0  # batch_size / latency
     mesh: str = "1x1"            # mesh shape this row was measured at
+    # Speculative-decoding axis (ISSUE 13): "off" rows are plain decode
+    # steps; "on" rows were measured with a draft model attached, and
+    # their latency_ms is the cost of ONE VERIFY ROUND (draft k+1 steps
+    # + the target's window verify). The effective per-step cost at
+    # acceptance rate a is latency_ms / expected_tokens_per_round(a, k)
+    # — the conversion every consumer (packer, sim engine) applies, so
+    # a spec row never pretends a round is a step. Pre-spec tables load
+    # as "off" and default lookups are byte-identical.
+    spec: str = "off"
 
     def with_throughput(self) -> "ProfileRow":
         tput = self.batch_size / (self.latency_ms / 1000.0) if self.latency_ms else 0.0
@@ -55,6 +69,7 @@ class ProfileRow:
             self.compile_ms,
             tput,
             self.mesh,
+            self.spec,
         )
 
 
@@ -84,7 +99,32 @@ CSV_FIELDS = [
     "compile_ms",
     "throughput_sps",
     "mesh",
+    "spec",
 ]
+
+
+def expected_tokens_per_round(acceptance: float, spec_tokens: int) -> float:
+    """Expected emitted tokens of one speculative verify round when each
+    draft token is accepted independently with probability
+    ``acceptance`` (the Leviathan et al. expectation): a round emits the
+    longest accepted draft prefix plus the target's own correction —
+    between 1 and k+1 tokens — so
+
+        E[n] = (1 - a^(k+1)) / (1 - a)      (a < 1; k+1 at a == 1).
+
+    THE shared conversion between a spec profile row's per-ROUND latency
+    and an effective per-step cost: the packer, the sim engine, and the
+    soak grade all divide by this — one formula, so the planner's belief
+    and the simulated timeline can never disagree about what an
+    acceptance rate is worth. Clamped to [1, k+1]; a <= 0 (total
+    collapse) is exactly 1 token per round."""
+    k = max(0, int(spec_tokens))
+    a = float(acceptance)
+    if a <= 0.0:
+        return 1.0
+    if a >= 1.0:
+        return float(k + 1)
+    return (1.0 - a ** (k + 1)) / (1.0 - a)
 
 
 class BatchProfile:
@@ -94,18 +134,26 @@ class BatchProfile:
         self.model_name = model_name
         self.rows: List[ProfileRow] = sorted(
             (r.with_throughput() for r in rows),
-            key=lambda r: (r.seq_len, r.batch_size, r.mesh),
+            key=lambda r: (r.seq_len, r.batch_size, r.mesh, r.spec),
         )
 
     # --- construction -----------------------------------------------------
     def add(self, row: ProfileRow) -> None:
         self.rows.append(row.with_throughput())
-        self.rows.sort(key=lambda r: (r.seq_len, r.batch_size, r.mesh))
+        self.rows.sort(
+            key=lambda r: (r.seq_len, r.batch_size, r.mesh, r.spec)
+        )
 
     # --- lookups (always round batch UP to a profiled bucket) -------------
-    def _seq_rows(self, seq_len: int = 0, mesh: str = "1x1"
-                  ) -> List[ProfileRow]:
-        pool = [r for r in self.rows if r.mesh == mesh]
+    def _seq_rows(self, seq_len: int = 0, mesh: str = "1x1",
+                  spec: str = "off") -> List[ProfileRow]:
+        pool = [r for r in self.rows if r.mesh == mesh and r.spec == spec]
+        if not pool and spec != "off":
+            # A spec session on a table with no spec rows: fall back to
+            # the plain rows (the caller's speedup conversion then sees
+            # spec pricing as unavailable — never a KeyError mid-plan).
+            pool = [r for r in self.rows if r.mesh == mesh
+                    and r.spec == "off"]
         rows = [r for r in pool if r.seq_len == seq_len]
         if not rows and pool:
             # fall back to nearest profiled seq bucket >= requested
@@ -123,17 +171,23 @@ class BatchProfile:
     def buckets(self, seq_len: int = 0, mesh: str = "1x1") -> List[int]:
         return [r.batch_size for r in self._seq_rows(seq_len, mesh)]
 
+    def specs(self) -> List[str]:
+        """Spec arms this table has rows for ("off" first)."""
+        return sorted({r.spec for r in self.rows})
+
     def bucket_for(self, batch_size: int, seq_len: int = 0,
-                   mesh: str = "1x1") -> Optional[ProfileRow]:
+                   mesh: str = "1x1", spec: str = "off"
+                   ) -> Optional[ProfileRow]:
         """Smallest profiled bucket >= batch_size (None if beyond the table)."""
-        for r in self._seq_rows(seq_len, mesh):
+        for r in self._seq_rows(seq_len, mesh, spec):
             if r.batch_size >= batch_size:
                 return r
         return None
 
     def row_for(self, batch_size: int, seq_len: int = 0,
-                mesh: str = "1x1") -> Optional[ProfileRow]:
-        for r in self._seq_rows(seq_len, mesh):
+                mesh: str = "1x1", spec: str = "off"
+                ) -> Optional[ProfileRow]:
+        for r in self._seq_rows(seq_len, mesh, spec):
             if r.batch_size == batch_size:
                 return r
         return None
@@ -165,18 +219,30 @@ class BatchProfile:
         rows = self._seq_rows(seq_len, mesh)
         return max((r.throughput_sps for r in rows), default=0.0)
 
-    def weights_hbm_bytes(self, mesh: Optional[str] = None) -> int:
+    def weights_hbm_bytes(self, mesh: Optional[str] = None,
+                          spec: Optional[str] = None) -> int:
         """Lower bound on resident footprint: min over rows (≈ weights).
 
         ``mesh`` restricts to rows measured at that shape — necessary
         on mixed-mesh tables, where per-chip footprints differ by slice
         width (a 1x2 row carries twice the weight shard of a 1x4 row)
         and the unrestricted min would always answer with the WIDEST
-        mesh's shard, underpricing uploads to narrower shapes. Falls
-        back to the all-rows min when the table has no rows at the
-        requested shape (the pre-mesh behavior, and the safe lower
-        bound when a shape is missing)."""
+        mesh's shard, underpricing uploads to narrower shapes. ``spec``
+        restricts analogously on mixed-arm tables: a spec row's
+        footprint includes the draft model's weights, which the plain
+        rows' min would shave off. Falls back progressively (drop the
+        spec restriction, then the mesh one) when the table has no rows
+        at the requested combination — the pre-mesh behavior, and the
+        safe lower bound when a shape is missing."""
         if mesh is not None:
+            if spec is not None:
+                at_both = min(
+                    (r.hbm_bytes for r in self.rows
+                     if r.mesh == mesh and r.spec == spec),
+                    default=0,
+                )
+                if at_both > 0:
+                    return at_both
             at_mesh = min(
                 (r.hbm_bytes for r in self.rows if r.mesh == mesh),
                 default=0,
@@ -217,6 +283,8 @@ class BatchProfile:
                     compile_ms=float(rec.get("compile_ms", 0) or 0),
                     # Pre-mesh tables have no column: single-chip rows.
                     mesh=str(rec.get("mesh") or "1x1"),
+                    # Pre-spec tables have no column: plain decode rows.
+                    spec=str(rec.get("spec") or "off"),
                 )
             )
         return cls(model_name, rows)

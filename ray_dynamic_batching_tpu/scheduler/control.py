@@ -104,14 +104,22 @@ class LiveScheduler:
 
     # --- registration (ref models_config) ---------------------------------
     def register_model(self, name: str, slo_ms: float, seq_len: int = 0,
-                       mesh_shape: str = "1x1") -> None:
+                       mesh_shape: str = "1x1", spec: str = "off",
+                       spec_acceptance: float = 0.0,
+                       spec_tokens: int = 4) -> None:
         """``mesh_shape`` is the model's preferred serving slice
         ("1x4" = a 4-chip TP replica priced from its mesh profile
         rows); replans degrade it to surviving geometry when the wide
-        slices are gone (scheduler/replan.degrade_sessions)."""
+        slices are gone (scheduler/replan.degrade_sessions).
+        ``spec="on"`` prices the model from its spec profile rows at
+        the PROFILED ``spec_acceptance`` (ISSUE 13; same ModelEntry
+        surface as the sim scheduler — defaults byte-identical)."""
         if name not in self.packer.profiles:
             raise KeyError(f"no batch profile for model {name!r}")
-        self._models[name] = ModelEntry(name, slo_ms, seq_len, mesh_shape)
+        self._models[name] = ModelEntry(
+            name, slo_ms, seq_len, mesh_shape, spec=spec,
+            spec_acceptance=spec_acceptance, spec_tokens=spec_tokens,
+        )
 
     # --- ingress path (ref submit_request, scheduler.py:734-751) ----------
     def submit_request(self, request: Request) -> bool:

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 from ray_dynamic_batching_tpu.profiles.table import (
     BatchProfile,
     ProfileRow,
+    expected_tokens_per_round,
     mesh_chips,
 )
 from ray_dynamic_batching_tpu.utils.config import get_config
@@ -52,6 +53,14 @@ class Session:
     # plans over mesh_chips(mesh_shape)-wide chip SETS. "1x1" = the
     # classic single-chip duty-cycle placement.
     mesh_shape: str = "1x1"
+    # Speculative serving arm (ISSUE 13): "on" prices this model from
+    # its spec profile rows — a row's per-ROUND latency divided by
+    # expected_tokens_per_round(spec_acceptance, spec_tokens), the
+    # planner's honest belief about what the PROFILED acceptance rate
+    # buys. "off" (default) is byte-identical to the pre-spec packer.
+    spec: str = "off"
+    spec_acceptance: float = 0.0
+    spec_tokens: int = 4
 
     @property
     def chips(self) -> int:
@@ -143,6 +152,22 @@ class SquishyBinPacker:
         self.occupancy_pricing = "batch"
         self.occupancy_floor = 0.35
 
+    def _session_wl(self, session: Session, row: ProfileRow) -> float:
+        """Worst-case EFFECTIVE step latency of ``session`` at ``row``:
+        a spec row's latency is one verify ROUND, so a spec session
+        divides it by the expected tokens that round emits at the
+        PROFILED acceptance rate (``expected_tokens_per_round`` — one
+        shared formula with the sim engine's execution model). Non-spec
+        sessions (and spec sessions whose table lacks spec rows — the
+        ``_seq_rows`` fallback hands back plain rows, ``row.spec ==
+        "off"``) price exactly as before, bit for bit."""
+        wl = worst_latency_ms(row)
+        if session.spec == "on" and row.spec == "on":
+            wl = wl / expected_tokens_per_round(
+                session.spec_acceptance, session.spec_tokens
+            )
+        return wl
+
     def _turn_cost_ms(self, wl: float, fill: float) -> float:
         """Expected cost of one duty-cycle turn at ``fill`` (0..1] of the
         bucket: the fill-invariant floor is the weight stream every
@@ -165,9 +190,10 @@ class SquishyBinPacker:
         prof = self.profiles[session.model]
         budget_ms = self._effective_slo(session) * self.compute_fraction
         best = None
-        for row in prof._seq_rows(session.seq_len, session.mesh_shape):
+        for row in prof._seq_rows(session.seq_len, session.mesh_shape,
+                                  session.spec):
             if (
-                worst_latency_ms(row) <= budget_ms
+                self._session_wl(session, row) <= budget_ms
                 and row.hbm_bytes <= self.hbm_budget
             ):
                 best = row
@@ -188,14 +214,15 @@ class SquishyBinPacker:
                 # No bucket fits the SLO: serve at the smallest bucket anyway
                 # (degraded), one request-rate's worth of nodes.
                 prof = self.profiles[session.model]
-                rows = prof._seq_rows(session.seq_len, session.mesh_shape)
+                rows = prof._seq_rows(session.seq_len, session.mesh_shape,
+                                      session.spec)
                 if not rows:
                     raise KeyError(
                         f"no profile rows for {session.model} at mesh "
                         f"{session.mesh_shape}"
                     )
                 row = rows[0]
-            wl = worst_latency_ms(row)
+            wl = self._session_wl(session, row)
             max_throughput = row.batch_size / (wl / 1000.0)
             n_full = int(session.rate_rps // max_throughput)
             residue_rate = session.rate_rps - n_full * max_throughput
@@ -227,7 +254,8 @@ class SquishyBinPacker:
         duty = batch/rate*1000, occupancy = latency/duty (ref nexus.py:263-268).
         """
         prof = self.profiles[session.model]
-        rows = prof._seq_rows(session.seq_len, session.mesh_shape)
+        rows = prof._seq_rows(session.seq_len, session.mesh_shape,
+                              session.spec)
         rows = [r for r in rows if r.hbm_bytes <= self.hbm_budget]
         if not rows:
             return None
@@ -237,10 +265,10 @@ class SquishyBinPacker:
         feasible = False
         for cand in rows:
             fill_ms = cand.batch_size / rate * 1000.0
-            if worst_latency_ms(cand) + fill_ms <= slo:
+            if self._session_wl(session, cand) + fill_ms <= slo:
                 chosen = cand
                 feasible = True
-        wl = worst_latency_ms(chosen)
+        wl = self._session_wl(session, chosen)
         duty = max(chosen.batch_size / rate * 1000.0, wl)
         if not feasible:
             # Even the smallest bucket cannot FILL within the SLO at this
@@ -293,10 +321,10 @@ class SquishyBinPacker:
             s = p.session
             need = max(math.ceil(duty * s.rate_rps / 1000.0), 1)
             prof = self.profiles[s.model]
-            row = prof.bucket_for(need, s.seq_len, s.mesh_shape)
+            row = prof.bucket_for(need, s.seq_len, s.mesh_shape, s.spec)
             if row is None:
                 return None  # rate too high for any compiled bucket at this duty
-            wl = worst_latency_ms(row)
+            wl = self._session_wl(s, row)
             if wl + duty > self._effective_slo(s):
                 return None  # wait-one-cycle + compute would blow the SLO
             # Capacity pricing at the EXPECTED turn fill (need requests
@@ -416,6 +444,13 @@ def _pick_llm_row(
     """
     best: Optional[LLMPlacement] = None
     for row in profile.rows:
+        if row.spec != "off":
+            # Spec rows cost one verify ROUND, not one step (ISSUE 13):
+            # pricing them here without the expected_tokens_per_round
+            # conversion would mis-unit step_ms/compute_fraction by up
+            # to E(a,k)x. This packer plans plain decode engines; the
+            # duty-cycle packer above is the spec-aware one.
+            continue
         if row.latency_ms <= 0 or row.hbm_bytes <= 0:
             continue
         if row.hbm_bytes > hbm_budget:

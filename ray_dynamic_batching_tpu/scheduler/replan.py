@@ -56,6 +56,13 @@ class ModelEntry:
     # Preferred serving mesh shape: "1x1" = single chip (the default,
     # and the only shape pre-mesh deployments ever register).
     mesh_shape: str = "1x1"
+    # Speculative serving arm (ISSUE 13): "on" makes the packer price
+    # this model from its spec profile rows at the PROFILED acceptance
+    # rate (Session.spec/spec_acceptance/spec_tokens). Defaults keep
+    # every pre-spec registration byte-identical.
+    spec: str = "off"
+    spec_acceptance: float = 0.0
+    spec_tokens: int = 4
 
 
 def weighted_attainment(
@@ -99,6 +106,9 @@ def sessions_for(
             rate_rps=rates.get(e.name, 0.0),
             seq_len=e.seq_len,
             mesh_shape=e.mesh_shape,
+            spec=e.spec,
+            spec_acceptance=e.spec_acceptance,
+            spec_tokens=e.spec_tokens,
         )
         for e in models.values()
     ]
@@ -185,14 +195,25 @@ def transfer_cost(
         if prof is None:
             cost += 1.0
             continue
+        # Keyed by the session's SPEC arm too (ISSUE 13): a spec
+        # session's resident program set (draft + verify) is described
+        # by its spec rows — compile_ms/hbm differ from the plain arm,
+        # and on a spec-only table the default "off" lookup would find
+        # nothing and silently price the 1000 ms compile guess.
         row = prof.row_for(
-            p.batch_size, p.session.seq_len, plan.mesh_shape
-        ) or prof.bucket_for(p.batch_size, p.session.seq_len, plan.mesh_shape)
+            p.batch_size, p.session.seq_len, plan.mesh_shape,
+            p.session.spec,
+        ) or prof.bucket_for(p.batch_size, p.session.seq_len,
+                             plan.mesh_shape, p.session.spec)
         compile_ms = row.compile_ms if row else 1000.0
-        # Upload priced at the PLAN's shape: each chip of the slice
-        # uploads its own weight shard (mixed-mesh tables differ per
-        # shape; single-shape tables are unchanged).
-        weight_mb = prof.weights_hbm_bytes(plan.mesh_shape) / 1e6
+        # Upload priced at the PLAN's shape AND the session's spec arm:
+        # each chip of the slice uploads its own weight shard, and a
+        # spec session's set includes the draft model's weights (the
+        # plain rows' min would shave them off). Single-shape/-arm
+        # tables are unchanged.
+        weight_mb = prof.weights_hbm_bytes(
+            plan.mesh_shape, p.session.spec
+        ) / 1e6
         cost += compile_ms + weight_mb  # ms-equivalent weighting
         if resident_meshes is not None and name in resident_meshes:
             cost += reshard_cost(

@@ -395,18 +395,15 @@ class LLMDeployment:
         # Paged KV pool (ISSUE 7): per-engine free-list pages replace the
         # per-slot slabs — HBM occupancy follows cached tokens, admission
         # waits on pages not slabs, prefix/session reuse is by reference
-        # (CoW). Incompatible with draft models (raised here). On a
-        # multi-chip (TP) replica the pool shards over the mesh's kv-head
-        # axis with a replica-global page table/allocator (ROADMAP item
-        # 2 — see DecodeEngine and ARCHITECTURE "Mesh placements").
+        # (CoW). Draft models compose (ISSUE 13): speculative rounds
+        # draft into scratch pages and commit accepted prefixes by
+        # page-table splice — except on a multi-chip (TP) replica, where
+        # the pool shards over the mesh's kv-head axis (ROADMAP item 2)
+        # and paged+spec+mesh stays excluded (DecodeEngine raises loudly
+        # at build, the PR 10 pattern).
         self.paged = bool(paged)
         self.page_size = int(page_size)
         self.kv_pool_pages = kv_pool_pages
-        if self.paged and draft_model_name is not None:
-            raise ValueError(
-                "paged=True with a draft model is not supported "
-                "(speculative decoding runs on the slab path)"
-            )
         self._dtype = dtype
         self._model = model
         self._params = params

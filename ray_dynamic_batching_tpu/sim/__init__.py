@@ -29,6 +29,7 @@ from ray_dynamic_batching_tpu.sim.report import (
     slo_attainment,
 )
 from ray_dynamic_batching_tpu.sim.simulator import (
+    AcceptanceCollapse,
     EngineDegradation,
     EngineFailure,
     Scenario,
@@ -59,6 +60,7 @@ __all__ = [
     "merged_hop_sketches",
     "render_json",
     "slo_attainment",
+    "AcceptanceCollapse",
     "EngineDegradation",
     "EngineFailure",
     "Scenario",

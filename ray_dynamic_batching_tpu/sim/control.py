@@ -118,10 +118,16 @@ class SimScheduler:
 
     # --- registration (live register_model contract) ----------------------
     def register_model(self, name: str, slo_ms: float,
-                       seq_len: int = 0, mesh_shape: str = "1x1") -> None:
+                       seq_len: int = 0, mesh_shape: str = "1x1",
+                       spec: str = "off", spec_acceptance: float = 0.0,
+                       spec_tokens: int = 4) -> None:
         if name not in self.packer.profiles:
             raise KeyError(f"no batch profile for model {name!r}")
-        self._models[name] = ModelEntry(name, slo_ms, seq_len, mesh_shape)
+        self._models[name] = ModelEntry(
+            name, slo_ms, seq_len, mesh_shape,
+            spec=spec, spec_acceptance=spec_acceptance,
+            spec_tokens=spec_tokens,
+        )
 
     # --- ingress (live submit_request: demand recorded before enqueue) ----
     def submit(self, model: str, qos_class: str = DEFAULT_QOS_CLASS,

@@ -38,7 +38,10 @@ from __future__ import annotations
 import random
 from typing import Dict, List, Optional
 
-from ray_dynamic_batching_tpu.profiles.table import BatchProfile
+from ray_dynamic_batching_tpu.profiles.table import (
+    BatchProfile,
+    expected_tokens_per_round,
+)
 from ray_dynamic_batching_tpu.scheduler.nexus import NodePlan, Placement
 from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
 from ray_dynamic_batching_tpu.sim.queue import SimQueueManager
@@ -60,6 +63,7 @@ class SimEngine:
         occupancy_floor: float = 0.35,
         width: int = 1,
         chip_ids: Optional[List[str]] = None,
+        spec_rates: Optional[Dict[str, float]] = None,
     ) -> None:
         if occupancy_model not in ("batch", "slot"):
             raise ValueError(
@@ -96,6 +100,18 @@ class SimEngine:
         # report's slot_occupancy) so slab-vs-paged what-ifs compare it.
         self.occupancy_model = occupancy_model
         self.occupancy_floor = float(occupancy_floor)
+        # Speculative cost model (ISSUE 13): model -> LIVE draft-token
+        # acceptance rate, a dict SHARED across the cluster's engines
+        # and mutated by AcceptanceCollapse scenario events — the sim's
+        # ground truth, which may diverge from the PROFILED rate the
+        # planner priced with (that divergence is exactly what the
+        # acceptance-collapse chaos arm measures). A spec placement's
+        # step cost is its spec row's per-ROUND latency divided by
+        # expected_tokens_per_round(live_rate, k); absent from the dict,
+        # the session's planned rate applies.
+        self.spec_rates: Dict[str, float] = (
+            spec_rates if spec_rates is not None else {}
+        )
         self._plan = NodePlan()
         self._pending: Optional[NodePlan] = None
         self._cycle_start_ms = 0.0
@@ -243,7 +259,14 @@ class SimEngine:
     def _step_latency_ms(self, p: Placement) -> float:
         """The cost model: the profile row for the placement's compiled
         bucket. Falls back to the placement's planned latency when the
-        table lacks the row (the planner sized it from SOME row)."""
+        table lacks the row (the planner sized it from SOME row).
+
+        Spec placements (session.spec == "on") execute at the spec
+        row's per-ROUND latency divided by the expected tokens per
+        round at the LIVE acceptance rate (``spec_rates`` — collapse
+        events move it out from under the planner's profiled belief).
+        Same ``expected_tokens_per_round`` formula the packer priced
+        with: only the RATE can diverge, never the model."""
         prof = self.profiles.get(p.session.model)
         row = None
         if prof is not None:
@@ -252,15 +275,25 @@ class SimEngine:
             # would miss them and flatten every TP step to planned
             # worst-case latency, jitter-free).
             mesh = p.session.mesh_shape
-            row = prof.row_for(p.batch_size, p.session.seq_len, mesh) \
-                or prof.bucket_for(p.batch_size, p.session.seq_len, mesh)
+            spec = p.session.spec
+            row = (prof.row_for(p.batch_size, p.session.seq_len, mesh,
+                                spec)
+                   or prof.bucket_for(p.batch_size, p.session.seq_len,
+                                      mesh, spec))
         if row is None:
             return p.latency_ms
         mean = row.latency_ms
         if self.jitter_rng is not None and row.latency_std_ms > 0:
-            return max(
+            mean = max(
                 0.1 * mean,
                 self.jitter_rng.gauss(mean, row.latency_std_ms),
+            )
+        if p.session.spec == "on" and row.spec == "on":
+            rate = self.spec_rates.get(
+                p.session.model, p.session.spec_acceptance
+            )
+            mean = mean / expected_tokens_per_round(
+                rate, p.session.spec_tokens
             )
         return mean
 

@@ -18,6 +18,7 @@ from typing import Dict, Tuple
 from ray_dynamic_batching_tpu.engine.workload import RatePattern
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile, ProfileRow
 from ray_dynamic_batching_tpu.sim.simulator import (
+    AcceptanceCollapse,
     EngineDegradation,
     EngineFailure,
     Scenario,
@@ -37,12 +38,15 @@ def linear_profile(
     compile_ms: float = 1000.0,
     std_fraction: float = 0.0,
     mesh: str = "1x1",
+    spec: str = "off",
 ) -> BatchProfile:
     """Latency = base + per_sample*batch — the canonical accelerator
     shape (same generator as ``tests/fixtures.py``, duplicated here so
     shipped tools never import the test tree). ``mesh`` stamps the rows
     as measured over that slice shape (per-slice latency, per-chip
-    footprint — the ProfileRow mesh-axis contract)."""
+    footprint — the ProfileRow mesh-axis contract); ``spec`` stamps them
+    as speculative verify-ROUND costs (the ProfileRow spec-axis
+    contract)."""
     rows = [
         ProfileRow(
             batch_size=b,
@@ -52,6 +56,7 @@ def linear_profile(
             hbm_bytes=int((weight_mb + act_mb_per_sample * b) * MB),
             compile_ms=compile_ms,
             mesh=mesh,
+            spec=spec,
         )
         for b in buckets
     ]
@@ -326,6 +331,88 @@ def slice_failure_scenario(seed: int = 0) -> Scenario:
         seed=seed,
         monitoring_interval_s=2.0,
         failures=[EngineFailure(at_s=10.0, engine=0, chip=1)],
+    )
+
+
+# --- speculative decoding over the paged engine (ISSUE 13) ------------------
+
+
+SPEC_ROUND_OVERHEAD = 1.4   # verify round cost vs a plain step (draft k+1
+                            # steps + window verify on top of one target step)
+SPEC_PROFILED_ACCEPTANCE = 0.7
+SPEC_COLLAPSED_ACCEPTANCE = 0.05
+
+
+def spec_profiles() -> Dict[str, BatchProfile]:
+    """The spec-soak fixtures: the single-chip trio plus ``paged_llm``,
+    a decode-shaped model with BOTH arms profiled — plain rows (one
+    decode step) and ``spec="on"`` rows at ``SPEC_ROUND_OVERHEAD`` x the
+    step cost (one verify round: draft k+1 cheap steps + the target's
+    k+1-window verify). At the profiled acceptance 0.7 with k=4 a round
+    emits E = (1-0.7^5)/0.3 ~ 2.77 tokens, so the spec arm's effective
+    step cost is ~2x cheaper than plain — the Leviathan multiplier the
+    paged engine's memory-bound decode path exists to collect."""
+    profiles = dict(fixture_profiles())
+    plain = linear_profile(
+        "paged_llm", base_ms=8.0, per_sample_ms=1.0, weight_mb=1500,
+        act_mb_per_sample=4.0,
+    )
+    spec = linear_profile(
+        "paged_llm", base_ms=8.0 * SPEC_ROUND_OVERHEAD,
+        per_sample_ms=1.0 * SPEC_ROUND_OVERHEAD, weight_mb=1800,
+        act_mb_per_sample=4.0, spec="on",
+    )
+    profiles["paged_llm"] = BatchProfile("paged_llm",
+                                         plain.rows + spec.rows)
+    return profiles
+
+
+def spec_scenario(spec: bool = False, collapse: bool = False,
+                  seed: int = 0) -> Scenario:
+    """The speculative-decoding soak fixture (``tools/run_spec_soak.py``),
+    three arms over IDENTICAL traffic on the slot-priced (paged) cost
+    model:
+
+    - ``spec=False``: the plain paged arm — the baseline the win
+      condition is measured against.
+    - ``spec=True``: speculation at the profiled acceptance rate. The
+      planner prices the spec rows ~2x cheaper per effective step, so
+      the same 2 chips carry the offered load that mildly saturates the
+      plain arm — the gate asserts it completes MORE at equal-or-better
+      attainment (the ISSUE 13 sim win condition).
+    - ``collapse=True``: adversarial prompts drive the LIVE acceptance
+      to ~0 from t=8s to t=22s while the planner keeps its profiled
+      belief. A verify round still emits >= 1 token, so the worst case
+      is the round overhead (1.4x a plain step) — the gate floors
+      throughput at a bounded factor of the plain arm and requires zero
+      drops (client-visible errors)."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="paged_llm", slo_ms=900.0,
+                pattern=RatePattern("constant", base_rps=850.0),
+                spec=spec,
+                spec_acceptance=SPEC_PROFILED_ACCEPTANCE,
+                spec_tokens=4,
+            ),
+            SimModelSpec(
+                name="fast", slo_ms=200.0,
+                pattern=RatePattern("constant", base_rps=40.0),
+            ),
+        ],
+        duration_s=30.0,
+        drain_s=5.0,
+        n_engines=2,
+        seed=seed,
+        max_queue_len=16384,
+        monitoring_interval_s=2.0,
+        decode_occupancy_model="slot",
+        spec_collapses=(
+            [AcceptanceCollapse(
+                at_s=8.0, model="paged_llm",
+                rate=SPEC_COLLAPSED_ACCEPTANCE, heal_at_s=22.0,
+            )] if collapse else []
+        ),
     )
 
 

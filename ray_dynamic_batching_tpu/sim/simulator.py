@@ -58,7 +58,8 @@ _PATTERN_FIELDS = (
 # silently-defaulted what-if is a confidently wrong one — reject loudly.
 _MODEL_KEYS = frozenset(
     ("name", "slo_ms", "seq_len", "rate_rps", "pattern", "poisson",
-     "class_mix", "tenant", "mesh_shape")
+     "class_mix", "tenant", "mesh_shape", "spec", "spec_acceptance",
+     "spec_tokens")
     + _PATTERN_FIELDS
 )
 
@@ -87,6 +88,14 @@ class SimModelSpec:
     # from the profile table's mesh rows; ROADMAP item 2). "1x1" keeps
     # the classic single-chip contract.
     mesh_shape: str = "1x1"
+    # Speculative serving arm (ISSUE 13): spec=True prices and executes
+    # this model through its spec profile rows at spec_acceptance (the
+    # PROFILED draft-token acceptance rate — what an on-chip capture's
+    # rdb_decode_spec_acceptance gauge read). AcceptanceCollapse events
+    # move the LIVE rate out from under this belief mid-run.
+    spec: bool = False
+    spec_acceptance: float = 0.7
+    spec_tokens: int = 4
 
     def __post_init__(self) -> None:
         if self.class_mix is None:
@@ -129,6 +138,9 @@ class SimModelSpec:
                        for k, v in dict(d.get("class_mix", {})).items()},
             tenant=str(d.get("tenant", DEFAULT_TENANT)),
             mesh_shape=str(d.get("mesh_shape", "1x1")),
+            spec=bool(d.get("spec", False)),
+            spec_acceptance=float(d.get("spec_acceptance", 0.7)),
+            spec_tokens=int(d.get("spec_tokens", 4)),
         )
 
 
@@ -207,6 +219,52 @@ class EngineDegradation:
 
 
 @dataclass
+class AcceptanceCollapse:
+    """One injected speculative-acceptance collapse (ISSUE 13 chaos):
+    from ``at_s`` the named model's LIVE draft-token acceptance rate
+    drops to ``rate`` (adversarial prompts the draft cannot predict —
+    the planner keeps pricing at the PROFILED rate), recovering to the
+    model's ``spec_acceptance`` at ``heal_at_s`` (None = collapsed to
+    the horizon). The gate's claim: throughput degrades to within a
+    bounded factor of the non-spec paged arm — a verify round always
+    emits >= 1 token — never off a cliff, with zero client-visible
+    errors."""
+
+    at_s: float
+    model: str
+    rate: float = 0.0
+    heal_at_s: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.rate <= 1.0:
+            raise ValueError(
+                f"collapse rate must be in [0, 1], got {self.rate}"
+            )
+        if self.heal_at_s is not None and self.heal_at_s <= self.at_s:
+            raise ValueError(
+                f"heal_at_s ({self.heal_at_s}) must be after at_s "
+                f"({self.at_s})"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AcceptanceCollapse":
+        known = {"at_s", "model", "rate", "heal_at_s"}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown collapse key(s) {sorted(unknown)}; known: "
+                f"{sorted(known)}"
+            )
+        return cls(
+            at_s=float(d["at_s"]),
+            model=str(d["model"]),
+            rate=float(d.get("rate", 0.0)),
+            heal_at_s=(None if d.get("heal_at_s") is None
+                       else float(d["heal_at_s"])),
+        )
+
+
+@dataclass
 class Scenario:
     """One simulated deployment under one traffic story."""
 
@@ -247,6 +305,10 @@ class Scenario:
     # Injected GRAY failures (straggler conformance): slowdowns the gray
     # monitor — not liveness — must catch.
     degradations: List[EngineDegradation] = field(default_factory=list)
+    # Injected speculative-acceptance collapses (ISSUE 13 chaos):
+    # adversarial traffic drives a model's LIVE acceptance toward 0
+    # while the planner keeps its profiled belief.
+    spec_collapses: List[AcceptanceCollapse] = field(default_factory=list)
     # Gray-detection knobs (serve/grayhealth.GrayHealthPolicy fields).
     # None = detection disabled: canon scenarios stay byte-identical.
     gray: Optional[Dict[str, Any]] = None
@@ -346,6 +408,10 @@ class Scenario:
                 EngineDegradation.from_dict(g)
                 for g in d.get("degradations", [])
             ],
+            spec_collapses=[
+                AcceptanceCollapse.from_dict(c)
+                for c in d.get("spec_collapses", [])
+            ],
             gray=d.get("gray"),
             admission=d.get("admission"),
         )
@@ -426,6 +492,14 @@ class Simulation:
                 f"engine_widths has {len(sc.engine_widths)} entries for "
                 f"{sc.n_engines} engines"
             )
+        # LIVE speculative acceptance per spec model: seeded from each
+        # spec's PROFILED rate, shared by every engine (one dict — the
+        # cluster serves one traffic population), mutated by
+        # AcceptanceCollapse events at virtual time.
+        spec_rates: Dict[str, float] = {
+            spec.name: spec.spec_acceptance
+            for spec in sc.models if spec.spec
+        }
         engines = []
         chip_base = 0
         for i in range(sc.n_engines):
@@ -444,7 +518,8 @@ class Simulation:
                           jitter_rng=jitter_rng,
                           occupancy_model=sc.decode_occupancy_model,
                           occupancy_floor=sc.occupancy_floor,
-                          width=width, chip_ids=chips)
+                          width=width, chip_ids=chips,
+                          spec_rates=spec_rates)
             )
         packer = SquishyBinPacker(
             self.profiles, hbm_budget_bytes=sc.hbm_budget_bytes
@@ -471,7 +546,10 @@ class Simulation:
         for spec in sc.models:
             sched.register_model(spec.name, slo_ms=spec.slo_ms,
                                  seq_len=spec.seq_len,
-                                 mesh_shape=spec.mesh_shape)
+                                 mesh_shape=spec.mesh_shape,
+                                 spec="on" if spec.spec else "off",
+                                 spec_acceptance=spec.spec_acceptance,
+                                 spec_tokens=spec.spec_tokens)
 
         # Admission control at virtual time: the LIVE controller module
         # with the virtual clock injected (deterministic buckets), wired
@@ -579,6 +657,26 @@ class Simulation:
             else:
                 loop.schedule_at(
                     f.at_s * 1000.0, lambda e=engines[f.engine]: e.fail()
+                )
+
+        specs_by_name = {spec.name: spec for spec in sc.models}
+        for c in sc.spec_collapses:
+            target = specs_by_name.get(c.model)
+            if target is None or not target.spec:
+                raise ValueError(
+                    f"acceptance collapse names {c.model!r}, which is not "
+                    "a spec=True model in this scenario"
+                )
+            loop.schedule_at(
+                c.at_s * 1000.0,
+                lambda m=c.model, r=c.rate: spec_rates.__setitem__(m, r),
+            )
+            if c.heal_at_s is not None:
+                loop.schedule_at(
+                    c.heal_at_s * 1000.0,
+                    lambda m=c.model, r=target.spec_acceptance: (
+                        spec_rates.__setitem__(m, r)
+                    ),
                 )
 
         for g in sc.degradations:
@@ -723,6 +821,24 @@ class Simulation:
                  "stall_ms": g.stall_ms, "heal_at_s": g.heal_at_s}
                 for g in sc.degradations
             ],
+            # Speculative arm (conditional: pre-spec scenarios stay
+            # byte-identical): planned vs final LIVE acceptance per spec
+            # model, plus the injected collapse timeline.
+            **({"spec": {
+                "models": {
+                    spec.name: {
+                        "spec_tokens": spec.spec_tokens,
+                        "planned_acceptance": spec.spec_acceptance,
+                        "final_acceptance": spec_rates[spec.name],
+                    }
+                    for spec in sc.models if spec.spec
+                },
+                "collapses": [
+                    {"at_s": c.at_s, "model": c.model, "rate": c.rate,
+                     "heal_at_s": c.heal_at_s}
+                    for c in sc.spec_collapses
+                ],
+            }} if spec_rates else {}),
             # Per-replica gray_state timeline (sim/report.gray_timeline
             # slices it per engine): every detector transition with its
             # virtual timestamp, plus the final verdicts.

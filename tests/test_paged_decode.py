@@ -173,11 +173,19 @@ class TestPagedKernel:
             q, k[:, :100], v[:, :100], pt, lens, interpret=True
         ) is None
 
-    def test_kernel_declines_wide_window(self):
+    def test_small_window_runs_staircase(self):
+        """Tq > 1 no longer declines (ISSUE 13): the spec-verify window
+        runs through the kernel with STAIRCASE validity — row t attends
+        <= lengths + t (tests/test_spec_paged.py pins the values; here
+        only the accept/decline contract)."""
         q, k, v, _ks, _vs, pt, lens, _ = self._pool(jnp.float32)
-        q2 = jnp.concatenate([q, q], axis=1)  # Tq == 2: not paged decode
+        q2 = jnp.concatenate([q, q], axis=1)  # Tq == 2: spec window
         assert da.paged_decode_attention(
             q2, k, v, pt, lens, interpret=True
+        ) is not None
+        q9 = jnp.concatenate([q] * 9, axis=1)  # past the kernel band
+        assert da.paged_decode_attention(
+            q9, k, v, pt, lens, interpret=True
         ) is None
 
 
@@ -387,13 +395,12 @@ class TestPoolBehavior:
         assert snap["paged"] is False and "page_journal" not in snap
 
     def test_paged_rejects_bad_config(self, lm):
-        # (TP meshes no longer reject: ROADMAP item 2 shards the pool —
-        # see tests/test_tp_paged_decode.py.)
+        # (TP meshes no longer reject — ROADMAP item 2 shards the pool,
+        # tests/test_tp_paged_decode.py — and neither do draft models:
+        # ISSUE 13 lifts speculation onto the paged pool, pinned in
+        # tests/test_spec_paged.py. Only paged+spec+MESH still raises.)
         model, params = lm
         queue = RequestQueue(model.name, max_len=16)
-        with pytest.raises(ValueError, match="speculative"):
-            DecodeEngine(model, params, queue, paged=True,
-                         draft_model=model, draft_params=params)
         with pytest.raises(ValueError, match="128-lane"):
             DecodeEngine(model, params, queue, paged=True, page_size=100)
         with pytest.raises(ValueError, match="cannot back"):
@@ -434,12 +441,16 @@ class TestPagedServing:
         finally:
             replica.stop(timeout_s=2.0, drain=False)
 
-    def test_paged_with_draft_raises_at_deployment(self):
+    def test_paged_with_draft_accepted_at_deployment(self):
+        """ISSUE 13: the deployment-level paged+draft rejection is
+        lifted — speculation rides the paged pool (scratch pages +
+        splice commits); only paged+spec+mesh still raises, at engine
+        build (tests/test_spec_paged.py)."""
         from ray_dynamic_batching_tpu.serve.llm import LLMDeployment
 
-        with pytest.raises(ValueError, match="paged"):
-            LLMDeployment("llama_tiny", paged=True,
-                          draft_model_name="llama_tiny")
+        dep = LLMDeployment("llama_tiny", paged=True,
+                            draft_model_name="llama_tiny")
+        assert dep.paged and dep.draft_model_name == "llama_tiny"
 
 
 @pytest.mark.slow  # chunked-prefill paths compile several extra programs

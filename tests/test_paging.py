@@ -67,17 +67,27 @@ class TestPageAllocator:
             a.incref([0])
 
     def test_random_10k_op_sequence_conserves(self):
-        """Seeded 10k random admit/finish/share/unshare ops against a
-        shadow owner model: after every op, free + allocated == pool,
-        refcounts match the shadow's owner counts exactly (so no page is
-        reachable from two owners without refcount >= 2), and nothing
-        ever goes negative."""
+        """Seeded 10k random admit/finish/share/unshare PLUS speculative
+        draft-reserve / splice-commit / reject-free ops (ISSUE 13)
+        against a shadow owner model: after every op, free + allocated
+        == pool, refcounts match the shadow's owner counts exactly (so
+        no page is reachable from two owners without refcount >= 2), and
+        nothing ever goes negative.
+
+        The spec ops mirror DecodeEngine's round lifecycle: a slot with
+        an in-flight round holds SCRATCH pages (a transient owner); the
+        round resolves by splicing a random prefix into the slot's own
+        run (ownership transfer, no refcount motion — the no-copy
+        commit) and freeing the rejected tail. Rounds stay in flight
+        across arbitrary interleaved shares/evictions/finishes before
+        resolving."""
         rng = np.random.default_rng(0)
         a = PageAllocator(64)
-        owners = {}  # owner id -> list of pages (one ref each)
+        owners = {}   # owner id -> list of pages (one ref each)
+        scratch = {}  # owner id -> in-flight spec round's scratch pages
         next_id = 0
         for _ in range(10_000):
-            op = rng.integers(0, 4)
+            op = rng.integers(0, 6)
             if op == 0:  # admit: allocate 1..8 pages for a new owner
                 n = int(rng.integers(1, 9))
                 try:
@@ -88,6 +98,9 @@ class TestPageAllocator:
             elif op == 1 and owners:  # finish: drop one owner entirely
                 k = list(owners)[int(rng.integers(0, len(owners)))]
                 a.decref(owners.pop(k))
+                pending = scratch.pop(k, None)
+                if pending:  # its round's scratch rolls back too
+                    a.decref(pending)
             elif op == 2 and owners:  # share: new owner borrows a prefix
                 k = list(owners)[int(rng.integers(0, len(owners)))]
                 take = int(rng.integers(1, len(owners[k]) + 1))
@@ -102,19 +115,53 @@ class TestPageAllocator:
                 owners[k] = owners[k][take:]
                 if not owners[k]:
                     del owners[k]
+                    pending = scratch.pop(k, None)
+                    if pending:
+                        a.decref(pending)
+            elif op == 4 and owners:  # draft-reserve: arm a spec round
+                live = [k for k in owners if k not in scratch]
+                if live:
+                    k = live[int(rng.integers(0, len(live)))]
+                    n = int(rng.integers(1, 3))
+                    if a.can_alloc(n):
+                        scratch[k] = a.alloc(n)
+            elif op == 5 and scratch:  # resolve: splice-commit + reject
+                k = list(scratch)[int(rng.integers(0, len(scratch)))]
+                pids = scratch.pop(k)
+                commit_n = int(rng.integers(0, len(pids) + 1))
+                if k in owners:
+                    owners[k].extend(pids[:commit_n])  # the splice:
+                    # ownership transfer, zero refcount motion
+                else:
+                    commit_n = 0  # owner finished mid-round: full reject
+                if pids[commit_n:]:
+                    a.decref(pids[commit_n:])  # rejected tail frees
             a.check()
             # Shadow-model agreement: refcount == number of owner lists
-            # holding the page.
+            # (slots AND in-flight rounds) holding the page.
             counts = {}
-            for pages in owners.values():
+            for pages in list(owners.values()) + list(scratch.values()):
                 for p in pages:
                     counts[p] = counts.get(p, 0) + 1
             for p in range(a.num_pages):
                 assert a.refcount[p] == counts.get(p, 0)
+        for pids in scratch.values():
+            a.decref(pids)
         for pages in owners.values():
             a.decref(pages)
         assert a.free_pages == a.num_pages
         a.check()
+
+    def test_journal_accepts_spec_kinds(self):
+        from ray_dynamic_batching_tpu.engine.paging import PageEventJournal
+
+        j = PageEventJournal()
+        j.record("spec_commit", 1, 3, slot=0)
+        j.record("spec_reject", 2, 1, slot=1)
+        kinds = [e["kind"] for e in j.snapshot()]
+        assert kinds == ["spec_commit", "spec_reject"]
+        with pytest.raises(ValueError):
+            j.record("spec_banana", 1, 0)
 
 
 class TestPagedPrefixCache:

@@ -1,0 +1,265 @@
+"""Acceptance-priced speculative planning (ISSUE 13, sim/planner half).
+
+The spec profile axis and the ONE shared conversion formula
+(``expected_tokens_per_round``) must make the packer's pricing and the
+sim engine's execution agree — and the acceptance-collapse chaos mode
+must degrade throughput to a bounded factor of the plain arm, never a
+cliff. Pure host tests (no jax)."""
+
+import pytest
+
+from ray_dynamic_batching_tpu.profiles.table import (
+    BatchProfile,
+    ProfileRow,
+    expected_tokens_per_round,
+)
+from ray_dynamic_batching_tpu.scheduler.nexus import Session, SquishyBinPacker
+from ray_dynamic_batching_tpu.sim import Simulation, render_json
+from ray_dynamic_batching_tpu.sim.scenarios import (
+    SPEC_ROUND_OVERHEAD,
+    spec_profiles,
+    spec_scenario,
+)
+from ray_dynamic_batching_tpu.sim.simulator import (
+    AcceptanceCollapse,
+    Scenario,
+    SimModelSpec,
+)
+
+
+class TestExpectedTokensPerRound:
+    def test_bounds_and_endpoints(self):
+        # A round always emits at least the target's own token...
+        assert expected_tokens_per_round(0.0, 4) == 1.0
+        assert expected_tokens_per_round(-1.0, 4) == 1.0
+        # ...and at most the whole window.
+        assert expected_tokens_per_round(1.0, 4) == 5.0
+        assert expected_tokens_per_round(2.0, 4) == 5.0
+
+    def test_leviathan_expectation(self):
+        # E = (1 - a^(k+1)) / (1 - a): the geometric-prefix expectation.
+        e = expected_tokens_per_round(0.7, 4)
+        assert abs(e - (1 - 0.7 ** 5) / 0.3) < 1e-12
+        assert 1.0 < e < 5.0
+
+    def test_monotone_in_acceptance(self):
+        vals = [expected_tokens_per_round(a / 10, 4) for a in range(11)]
+        assert vals == sorted(vals)
+
+
+class TestSpecProfileAxis:
+    def _table(self):
+        return BatchProfile("m", [
+            ProfileRow(8, 0, 10.0, 0.0, 100, 0.0),
+            ProfileRow(8, 0, 14.0, 0.0, 120, 0.0, spec="on"),
+        ])
+
+    def test_default_lookup_sees_only_off_rows(self):
+        prof = self._table()
+        assert prof.row_for(8).spec == "off"
+        assert prof.row_for(8).latency_ms == 10.0
+        assert prof.row_for(8, spec="on").latency_ms == 14.0
+        assert prof.specs() == ["off", "on"]
+
+    def test_spec_lookup_falls_back_to_off_rows(self):
+        """A spec session over a table with no spec rows prices from
+        the plain rows (row.spec == 'off' disables the speedup) — never
+        a KeyError mid-plan."""
+        prof = BatchProfile("m", [ProfileRow(8, 0, 10.0, 0.0, 100, 0.0)])
+        row = prof.row_for(8, spec="on")
+        assert row is not None and row.spec == "off"
+
+    def test_csv_roundtrip_keeps_spec_column(self):
+        prof = self._table()
+        back = BatchProfile.from_csv("m", prof.to_csv())
+        assert [r.spec for r in back.rows] == ["off", "on"]
+        # Pre-spec CSVs (no column) load as "off".
+        legacy = "batch_size,seq_len,latency_ms\n8,0,10.0\n"
+        assert BatchProfile.from_csv("m", legacy).rows[0].spec == "off"
+
+
+class TestPackerSpecPricing:
+    def _packer(self):
+        rows = [ProfileRow(b, 0, 1.0 + b, 0.0, 100 << 20, 0.0)
+                for b in (1, 8, 32)]
+        rows += [ProfileRow(b, 0, (1.0 + b) * 1.4, 0.0, 100 << 20, 0.0,
+                            spec="on") for b in (1, 8, 32)]
+        return SquishyBinPacker({"m": BatchProfile("m", rows)},
+                                hbm_budget_bytes=8 << 30)
+
+    def test_spec_session_prices_effective_latency(self):
+        packer = self._packer()
+        off = Session("m", slo_ms=500.0, rate_rps=100.0)
+        on = Session("m", slo_ms=500.0, rate_rps=100.0, spec="on",
+                     spec_acceptance=0.7, spec_tokens=4)
+        row_off = packer.saturate_row(off)
+        row_on = packer.saturate_row(on)
+        assert row_off.spec == "off" and row_on.spec == "on"
+        e = expected_tokens_per_round(0.7, 4)
+        assert packer._session_wl(on, row_on) == pytest.approx(
+            (row_on.latency_ms) / e
+        )
+        # The honest claim: at alpha=0.7 the spec arm is ~2x cheaper.
+        assert (packer._session_wl(on, row_on)
+                < packer._session_wl(off, row_off))
+
+    def test_off_session_is_byte_identical(self):
+        """spec='off' sessions never touch the conversion — pre-spec
+        plans are bit-for-bit what they were (canon safety)."""
+        packer = self._packer()
+        s = Session("m", slo_ms=500.0, rate_rps=100.0)
+        row = packer.saturate_row(s)
+        from ray_dynamic_batching_tpu.scheduler.nexus import worst_latency_ms
+        assert packer._session_wl(s, row) == worst_latency_ms(row)
+
+    def test_llm_colocation_packer_skips_spec_rows(self):
+        """Review regression: _pick_llm_row plans PLAIN decode engines —
+        a spec row's per-ROUND latency must never be priced as a
+        per-token step cost (mis-unit by up to E(a,k)x). On a table
+        carrying both arms, the chosen placement comes from the off
+        row even when the spec row would win on raw numbers."""
+        from ray_dynamic_batching_tpu.scheduler.nexus import (
+            LLMSession,
+            pack_llm_engines,
+        )
+
+        rows = [
+            ProfileRow(16, 128, 20.0, 0.0, 200 << 20, 0.0),
+            # "Cheaper-looking" spec row: smaller fraction if mis-read
+            # as a step cost.
+            ProfileRow(16, 128, 10.0, 0.0, 100 << 20, 0.0, spec="on"),
+        ]
+        chips = pack_llm_engines(
+            [LLMSession("m", rate_tok_s=100.0, token_slo_ms=100.0)],
+            {"m": BatchProfile("m", rows)},
+            hbm_budget_bytes=8 << 30,
+        )
+        placed = chips[0][0]
+        assert placed.step_ms == 20.0  # the off row, not the round row
+
+    def test_zero_acceptance_spec_prices_round_overhead(self):
+        """Collapsed acceptance: E -> 1, so the spec arm prices at the
+        full round cost — WORSE than plain by the bounded overhead
+        factor, which is the collapse arm's whole story."""
+        packer = self._packer()
+        on = Session("m", slo_ms=500.0, rate_rps=100.0, spec="on",
+                     spec_acceptance=0.0, spec_tokens=4)
+        row = packer.saturate_row(on)
+        off_row = packer.saturate_row(Session("m", 500.0, 100.0))
+        assert packer._session_wl(on, row) == pytest.approx(
+            1.4 * (off_row.latency_ms)
+        )
+
+
+class TestTransferPricing:
+    def test_transfer_cost_prices_the_spec_arm(self):
+        """Review regression: pointing an engine at a spec placement
+        prices the SPEC rows' compile/footprint (draft weights
+        included), not the plain arm's — and off sessions stay
+        byte-identical."""
+        from ray_dynamic_batching_tpu.scheduler.nexus import (
+            NodePlan,
+            Placement,
+            Session,
+        )
+        from ray_dynamic_batching_tpu.scheduler.replan import transfer_cost
+
+        prof = BatchProfile("m", [
+            ProfileRow(8, 0, 10.0, 0.0, 1500 * 1024 * 1024, 500.0),
+            ProfileRow(8, 0, 14.0, 0.0, 1800 * 1024 * 1024, 900.0,
+                       spec="on"),
+        ])
+
+        def plan(spec):
+            s = Session("m", slo_ms=500.0, rate_rps=10.0, spec=spec,
+                        spec_acceptance=0.7)
+            return NodePlan(placements=[
+                Placement(s, 8, 10.0, 0.5, 1500 * 1024 * 1024)
+            ], duty_cycle_ms=20.0)
+
+        off_cost = transfer_cost(frozenset(), plan("off"), {"m": prof})
+        on_cost = transfer_cost(frozenset(), plan("on"), {"m": prof})
+        mb = 1024 * 1024 / 1e6
+        assert off_cost == pytest.approx(500.0 + 1500 * mb)
+        assert on_cost == pytest.approx(900.0 + 1800 * mb)
+
+
+class TestSpecScenario:
+    def test_spec_arm_beats_paged_arm(self):
+        """The ISSUE 13 sim win condition: same scenario, the spec arm's
+        busy-normalized throughput (tok/s/chip proxy) beats the plain
+        paged arm at equal-or-better SLO attainment."""
+        paged = Simulation(spec_profiles(), spec_scenario()).run()
+        spec = Simulation(spec_profiles(), spec_scenario(spec=True)).run()
+        m_p, m_s = paged["models"]["paged_llm"], spec["models"]["paged_llm"]
+        assert m_s["slo_attainment"] >= m_p["slo_attainment"]
+        assert m_s["completed"] >= m_p["completed"]
+        busy_p = sum(c["busy_ms"] for c in paged["chips"].values())
+        busy_s = sum(c["busy_ms"] for c in spec["chips"].values())
+        tput_p = m_p["completed"] / busy_p
+        tput_s = m_s["completed"] / busy_s
+        # At alpha=0.7, k=4, overhead 1.4: E/overhead ~ 1.98x; well
+        # above 1.3 with planner slack.
+        assert tput_s > 1.3 * tput_p
+        assert spec["spec"]["models"]["paged_llm"]["planned_acceptance"] \
+            == 0.7
+
+    def test_collapse_is_bounded_not_a_cliff(self):
+        """Acceptance-collapse chaos: the worst case of a verify round
+        is >= 1 token, so throughput degrades to within the round
+        overhead of the plain arm — zero drops, bounded completed
+        deficit."""
+        paged = Simulation(spec_profiles(), spec_scenario()).run()
+        collapse = Simulation(
+            spec_profiles(), spec_scenario(spec=True, collapse=True)
+        ).run()
+        m_p = paged["models"]["paged_llm"]
+        m_c = collapse["models"]["paged_llm"]
+        assert m_c["dropped"] == 0
+        accounted = (m_c["completed"] + m_c["stale"] + m_c["dropped"]
+                     + m_c["pending"])
+        assert m_c["arrivals"] == accounted
+        # Bounded factor: the collapse arm completes at least
+        # 1/SPEC_ROUND_OVERHEAD of the plain arm's volume (with slack).
+        floor = 1.0 / (SPEC_ROUND_OVERHEAD * 1.15)
+        assert m_c["completed"] >= floor * m_p["completed"]
+        assert collapse["spec"]["collapses"][0]["model"] == "paged_llm"
+
+    def test_byte_deterministic(self):
+        blobs = [
+            render_json(Simulation(
+                spec_profiles(), spec_scenario(spec=True, collapse=True)
+            ).run())
+            for _ in range(2)
+        ]
+        assert blobs[0] == blobs[1]
+
+    def test_collapse_validation(self):
+        with pytest.raises(ValueError, match="not a spec=True model"):
+            Simulation(spec_profiles(), Scenario(
+                models=[SimModelSpec(name="fast", slo_ms=100.0)],
+                duration_s=1.0, n_engines=1,
+                spec_collapses=[AcceptanceCollapse(at_s=0.5, model="fast")],
+            )).run()
+        with pytest.raises(ValueError, match="rate must be in"):
+            AcceptanceCollapse(at_s=1.0, model="m", rate=1.5)
+        with pytest.raises(ValueError, match="heal_at_s"):
+            AcceptanceCollapse(at_s=1.0, model="m", rate=0.1, heal_at_s=0.5)
+
+    def test_scenario_from_dict_roundtrip(self):
+        sc = Scenario.from_dict({
+            "models": [{"name": "paged_llm", "slo_ms": 900.0,
+                        "rate_rps": 100.0, "spec": True,
+                        "spec_acceptance": 0.6, "spec_tokens": 3}],
+            "n_engines": 1,
+            "spec_collapses": [{"at_s": 5.0, "model": "paged_llm",
+                                "rate": 0.1, "heal_at_s": 9.0}],
+        })
+        assert sc.models[0].spec and sc.models[0].spec_tokens == 3
+        assert sc.spec_collapses[0].heal_at_s == 9.0
+
+    def test_no_spec_block_without_spec_models(self):
+        """Canon safety: pre-spec scenarios' reports carry NO spec key —
+        existing canon byte comparisons cannot move."""
+        report = Simulation(spec_profiles(), spec_scenario()).run()
+        assert "spec" not in report

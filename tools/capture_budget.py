@@ -80,6 +80,14 @@ CPU_MEASURED = {
         "source": "estimate: bench_llm phases + paged-pool program "
                   "compiles (cache-warm after the bench_llm step)",
     },
+    "bench_llm_spec": {
+        "seconds": 560,
+        "source": "estimate: bench_llm phases + gpt2_draft init + the "
+                  "spec round programs (draft prefill/scan + window "
+                  "verify) compiling on a warm cache after the paged "
+                  "step — ISSUE 13's paged-vs-paged+spec pair in one "
+                  "pass",
+    },
     "bench_llm_tp": {
         "seconds": 560,
         "source": "estimate: bench_llm phases + GSPMD-sharded program "
@@ -106,6 +114,7 @@ STEP_CAPS = {
     "first_light": wd.FIRST_LIGHT_TIMEOUT_S,
     "bench_llm": wd.BENCH_LLM_TIMEOUT_S,
     "bench_llm_paged": wd.BENCH_LLM_TIMEOUT_S,
+    "bench_llm_spec": wd.BENCH_LLM_TIMEOUT_S,
     "bench_llm_tp": wd.BENCH_LLM_TIMEOUT_S,
     "bench": wd.BENCH_TIMEOUT_S,
     "profiles": wd.PROFILES_TIMEOUT_S,

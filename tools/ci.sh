@@ -28,6 +28,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_straggler_soak.py --sim
   echo "== mesh-placement conformance (sim: TP slices as schedulable units, slice death + degrade, tools/mesh_smoke.json) =="
   python tools/run_mesh_soak.py --sim
+  echo "== speculative-decoding conformance (sim: acceptance-priced spec arm beats paged, collapse bounded, tools/spec_smoke.json) =="
+  python tools/run_spec_soak.py --sim
   echo "== overload conformance (sim: 5x saturation, QoS floors, tools/overload_smoke.json) =="
   python tools/run_overload_soak.py --sim
   echo "== control-plane conformance (sim: sharded front door, controller-kill failover, digest routing, tools/frontdoor_smoke.json) =="
@@ -72,6 +74,10 @@ python tools/run_straggler_soak.py --live --smoke
 
 echo "== mesh-placement conformance (sim: TP slices as schedulable units, slice death + degrade) =="
 python tools/run_mesh_soak.py --sim
+
+echo "== speculative-decoding conformance (sim three-arm + live paged+spec engines: exactness, conservation, collapse bounded) =="
+python tools/run_spec_soak.py --sim
+env JAX_PLATFORMS=cpu python tools/run_spec_soak.py --live
 
 echo "== overload conformance (sim 5x + live mixed-class soak, only 200s/429s) =="
 python tools/run_overload_soak.py --sim

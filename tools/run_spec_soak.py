@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Speculative-decoding conformance gate — acceptance-priced planning +
+acceptance-collapse chaos (ISSUE 13).
+
+Two modes:
+
+  --sim    (CI fast lane) three deterministic arms of
+           ``sim/scenarios.spec_scenario`` over IDENTICAL traffic, each
+           run TWICE for byte-identical reports, graded against the
+           shrink-only ``tools/spec_smoke.json`` ratchet:
+             - paged:    the plain paged arm (baseline)
+             - spec:     speculation at the profiled acceptance — must
+                         beat the paged arm's busy-normalized throughput
+                         (the sim's tok/s/chip proxy) at equal-or-better
+                         SLO attainment (the ISSUE 13 win condition)
+             - collapse: adversarial prompts drive the LIVE acceptance
+                         to ~0 mid-run while the planner keeps its
+                         profiled belief — completed volume must stay
+                         above a bounded factor of the paged arm (a
+                         verify round always emits >= 1 token: the worst
+                         case is the round overhead, never a cliff),
+                         with zero drops and exact conservation.
+  --live   (CI full lane) a real paged+spec DecodeEngine pair on CPU
+           (llama_tiny target): a SELF-draft (acceptance 1.0) and an
+           adversarial DIVERGENT draft (acceptance ~0 — the live
+           acceptance-collapse analogue) must both produce byte-
+           identical greedy tokens vs a plain paged engine, with zero
+           client-visible errors, counter conservation (accepted +
+           rejected == drafted), and the collapsed arm's round count
+           bounded by the token count (>= 1 token per round — the
+           cliff-proof).
+
+Exit: 0 conformant, 1 violation, 2 usage.
+
+Examples:
+  python tools/run_spec_soak.py --sim
+  python tools/run_spec_soak.py --live
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RATCHET = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "spec_smoke.json")
+
+
+def _load_floors() -> dict:
+    with open(RATCHET) as f:
+        return json.load(f)["floors"]
+
+
+def _conservation(report: dict, failures: list, arm: str) -> None:
+    for name, s in report["models"].items():
+        accounted = (s["completed"] + s["stale"] + s["dropped"]
+                     + s["pending"])
+        if s["arrivals"] != accounted:
+            failures.append(
+                f"{arm}/{name}: accounting leak — {s['arrivals']} arrivals "
+                f"vs {accounted} accounted; a spec round made requests "
+                "vanish"
+            )
+
+
+def run_sim(seed: int = 0) -> int:
+    from ray_dynamic_batching_tpu.sim import Simulation, render_json
+    from ray_dynamic_batching_tpu.sim.scenarios import (
+        spec_profiles,
+        spec_scenario,
+    )
+
+    floors = _load_floors()
+    failures: list = []
+    arms = {}
+    for arm, kwargs in (("paged", {}), ("spec", {"spec": True}),
+                        ("collapse", {"spec": True, "collapse": True})):
+        reports = [
+            Simulation(spec_profiles(), spec_scenario(seed=seed, **kwargs)
+                       ).run()
+            for _ in range(2)
+        ]
+        if render_json(reports[0]) != render_json(reports[1]):
+            failures.append(f"{arm}: nondeterministic — same seed produced "
+                            "different report bytes")
+        arms[arm] = reports[0]
+        _conservation(reports[0], failures, arm)
+
+    def tput(report):
+        busy = sum(c["busy_ms"] for c in report["chips"].values())
+        return report["models"]["paged_llm"]["completed"] / max(busy, 1e-9)
+
+    m_paged = arms["paged"]["models"]["paged_llm"]
+    m_spec = arms["spec"]["models"]["paged_llm"]
+    m_coll = arms["collapse"]["models"]["paged_llm"]
+
+    # Win condition: spec beats paged tok/s/chip at >= attainment.
+    f = floors["spec_vs_paged"]
+    if m_spec["slo_attainment"] < m_paged["slo_attainment"]:
+        failures.append(
+            f"spec: attainment {m_spec['slo_attainment']:.4f} under the "
+            f"paged arm's {m_paged['slo_attainment']:.4f} — speculation "
+            "must never cost SLO"
+        )
+    ratio = tput(arms["spec"]) / max(tput(arms["paged"]), 1e-12)
+    if ratio < f["throughput_ratio"]:
+        failures.append(
+            f"spec: busy-normalized throughput only {ratio:.3f}x the paged "
+            f"arm (floor {f['throughput_ratio']}) — the acceptance-priced "
+            "arm is not collecting the multiplier"
+        )
+    if m_spec["completed"] < m_paged["completed"]:
+        failures.append(
+            f"spec: completed {m_spec['completed']} < paged arm's "
+            f"{m_paged['completed']}"
+        )
+    if "spec" not in arms["spec"]:
+        failures.append("spec: report carries no spec block — the arm ran "
+                        "without spec pricing and proved nothing")
+
+    # Collapse: bounded degradation, zero client-visible errors.
+    f = floors["collapse"]
+    if m_coll["dropped"] != 0:
+        failures.append(
+            f"collapse: {m_coll['dropped']} dropped request(s) — the "
+            "collapse must shed by deadline economics, never drop"
+        )
+    if m_coll["slo_attainment"] < f["slo_attainment"]:
+        failures.append(
+            f"collapse: attainment {m_coll['slo_attainment']:.4f} under "
+            f"ratcheted floor {f['slo_attainment']}"
+        )
+    frac = m_coll["completed"] / max(m_paged["completed"], 1)
+    if frac < f["completed_vs_paged"]:
+        failures.append(
+            f"collapse: completed only {frac:.3f} of the paged arm "
+            f"(floor {f['completed_vs_paged']}) — degradation fell off "
+            "the bounded-round cliff"
+        )
+
+    summary = {
+        "metric": "spec_soak",
+        "mode": "sim",
+        "ok": not failures,
+        "attainment": {arm: arms[arm]["models"]["paged_llm"]
+                       ["slo_attainment"] for arm in arms},
+        "completed": {arm: arms[arm]["models"]["paged_llm"]["completed"]
+                      for arm in arms},
+        "throughput_ratio_spec_vs_paged": round(ratio, 4),
+        "collapse_completed_vs_paged": round(frac, 4),
+        "violations": failures,
+    }
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    if failures:
+        for v in failures:
+            print(f"spec soak FAILED: {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_live(n_requests: int = 8) -> int:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from ray_dynamic_batching_tpu.engine.decode import (
+        DecodeEngine,
+        SPEC_ACCEPTED,
+        SPEC_DRAFTED,
+        SPEC_REJECTED,
+    )
+    from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+    from ray_dynamic_batching_tpu.engine.request import Request
+    from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+    from ray_dynamic_batching_tpu.models.base import get_model
+
+    model = get_model("llama_tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    divergent = get_model("llama_tiny", dtype=jnp.float32)
+    divergent_params = divergent.init(jax.random.PRNGKey(7))
+
+    def run(draft_params=None, draft=None):
+        queue = RequestQueue(model.name, max_len=256)
+        kw = dict(num_slots=4, max_len=96, prompt_buckets=[8, 16],
+                  eos_token_id=None, default_max_new_tokens=16,
+                  decode_horizon=4, paged=True, page_size=128)
+        if draft is not None:
+            kw.update(draft_model=draft, draft_params=draft_params,
+                      spec_tokens=4)
+        engine = DecodeEngine(model, params, queue, **kw)
+        rng = np.random.default_rng(11)
+        reqs = []
+        for _ in range(n_requests):
+            r = Request(model=model.name, payload={
+                "tokens": rng.integers(1, 500,
+                                       int(rng.integers(3, 28))).tolist(),
+                "max_new_tokens": 16,
+            }, slo_ms=600_000.0)
+            queue.add_request(r)
+            reqs.append(r)
+        engine.run_until_idle(timeout_s=600)
+        outs, errors = [], 0
+        for r in reqs:
+            try:
+                outs.append(tuple(r.future.result(timeout=10).tokens))
+            except Exception:  # noqa: BLE001 — classification is the gate
+                errors += 1
+        engine._allocator.check()
+        leaked = engine.num_pages - engine._allocator.free_pages
+        return outs, errors, engine, leaked
+
+    tags = {"model": model.name, "paged": "true"}
+    before = (SPEC_ACCEPTED.get(tags=tags), SPEC_REJECTED.get(tags=tags),
+              SPEC_DRAFTED.get(tags=tags))
+    violations = []
+    plain, err0, _, leak0 = run()
+    self_toks, err1, self_eng, leak1 = run(params, model)
+    adv_toks, err2, adv_eng, leak2 = run(divergent_params, divergent)
+    if err0 or err1 or err2:
+        violations.append(
+            f"client-visible errors: plain={err0} self={err1} adv={err2}"
+        )
+    if self_toks != plain:
+        violations.append("self-draft paged+spec tokens diverge from "
+                          "plain paged — greedy exactness broken")
+    if adv_toks != plain:
+        violations.append("adversarial-draft paged+spec tokens diverge "
+                          "from plain paged — the live acceptance "
+                          "collapse corrupted a stream")
+    if leak0 or leak1 or leak2:
+        violations.append(
+            f"page leak after drain: plain={leak0} self={leak1} "
+            f"adv={leak2}"
+        )
+    a = SPEC_ACCEPTED.get(tags=tags) - before[0]
+    rj = SPEC_REJECTED.get(tags=tags) - before[1]
+    d = SPEC_DRAFTED.get(tags=tags) - before[2]
+    if not d or a + rj != d:
+        violations.append(
+            f"counter conservation broken: accepted {a} + rejected {rj} "
+            f"!= drafted {d}"
+        )
+    # Cliff-proof: every round emits >= 1 token per live slot, so even
+    # the collapsed arm's round count is bounded by the token volume.
+    total_tokens = sum(len(t) for t in adv_toks)
+    if adv_eng.steps > total_tokens:
+        violations.append(
+            f"collapsed arm ran {adv_eng.steps} rounds for "
+            f"{total_tokens} tokens — rounds stopped emitting"
+        )
+    summary = {
+        "metric": "spec_soak",
+        "mode": "live",
+        "ok": not violations,
+        "requests": n_requests,
+        "acceptance": {"self": self_eng.spec_acceptance(),
+                       "adversarial": adv_eng.spec_acceptance()},
+        "counters": {"accepted": a, "rejected": rj, "drafted": d},
+        "rounds": {"self": self_eng.steps, "adversarial": adv_eng.steps},
+        "violations": violations,
+    }
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    if violations:
+        for v in violations:
+            print(f"spec soak FAILED: {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--sim", action="store_true",
+                      help="deterministic three-arm sim gate (CI fast lane)")
+    mode.add_argument("--live", action="store_true",
+                      help="real paged+spec engines on CPU (full lane)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.live:
+        return run_live()
+    return run_sim(seed=args.seed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

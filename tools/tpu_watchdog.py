@@ -325,6 +325,24 @@ def capture_bench_llm_paged() -> bool:
     )
 
 
+def capture_bench_llm_spec() -> bool:
+    """The paged+spec arm of the llm A/B (bench.py --paged on --spec
+    on): ISSUE 13's speculative decoding over the paged pool, measured
+    against the same window's paged record — one relay pass captures
+    paged-vs-paged+spec, per the standing on-chip-debt note. The row
+    stamps spec_acceptance; with the untrained gpt2_draft it reads ~0,
+    so this capture measures the bounded-degradation floor (the
+    acceptance-collapse worst case) on real silicon — the speedup
+    measurement lands the day a trained draft checkpoint does."""
+    return capture_bench(
+        step_name="bench_llm_spec",
+        env_extra={"RDB_BENCH_SCOPE": "llm", "RDB_BENCH_PAGED": "1",
+                   "RDB_BENCH_SPEC": "1"},
+        timeout_s=BENCH_LLM_TIMEOUT_S, prefix="bench_llm_spec",
+        expected_scope="llm",
+    )
+
+
 def capture_bench_llm_tp() -> bool:
     """The TP-paged arm of the llm A/B (bench.py --mesh 2 --paged on):
     ROADMAP item 2's mesh-placement serving configuration — the page
@@ -534,6 +552,7 @@ STEPS = [
     ("first_light", capture_first_light),
     ("bench_llm", capture_bench_llm),
     ("bench_llm_paged", capture_bench_llm_paged),
+    ("bench_llm_spec", capture_bench_llm_spec),
     ("bench_llm_tp", capture_bench_llm_tp),
     ("bench", capture_bench),
     ("profiles", capture_profiles),
