@@ -114,6 +114,8 @@ def bench_llm_serving(
     paged: bool = False,
     mesh: int = 1,
     spec: bool = False,
+    prefill: str = "default",
+    long_frac: float = 0.0,
 ) -> dict:
     """North star: continuous-batching decode through the serving path.
 
@@ -135,6 +137,16 @@ def bench_llm_serving(
     capture can never be read without its acceptance context: at ~0
     (untrained draft) the row measures the bounded-degradation floor,
     at a real acceptance it measures the Leviathan multiplier.
+
+    ``prefill`` pins the admission path (ISSUE 15's A/B axis, composes
+    with ``paged``): "chunked" forces the token-budget chunk-train
+    scheduler, "mono" the legacy monolithic groups, "default" the
+    engine's own choice (chunked on paged, mono on slab).
+    ``long_frac`` mixes that fraction of OVER-BUCKET prompts (~3x the
+    base prompt) into both phases — the long-prompt traffic whose
+    head-of-line stall the chunked arm exists to remove; the TTFT
+    percentiles of the two arms under the same mix ARE the ISSUE 15
+    measurement.
     """
     import numpy as np
 
@@ -148,6 +160,11 @@ def bench_llm_serving(
     from ray_dynamic_batching_tpu.serve.router import Router
 
     rng = np.random.default_rng(0)
+    if prefill not in ("default", "mono", "chunked"):
+        raise ValueError(f"prefill must be default|mono|chunked, "
+                         f"got {prefill!r}")
+    chunked_prefill = {"default": None, "mono": False,
+                       "chunked": True}[prefill]
     t_build = time.perf_counter()
     if deployment is None:
         deployment = LLMDeployment(
@@ -161,6 +178,7 @@ def bench_llm_serving(
             quantize_kv=quantize_kv,
             paged=paged,
             draft_model_name="gpt2_draft" if spec else None,
+            chunked_prefill=chunked_prefill,
         )
     devices = None
     slice_pg = slice_mgr = None
@@ -200,9 +218,17 @@ def bench_llm_serving(
          f"{time.perf_counter() - t_build:.1f}s "
          f"(slots={num_slots}, max_len={max_len})")
 
+    # Long-prompt mix: over-bucket prompts (~3x base, capped so prompt
+    # + generation fits the cache) that admit as multi-chunk trains on
+    # the chunked arm and monolithic chunked fills on the mono arm.
+    long_len = min(prompt_len * 3, max_len - max_new_tokens - 1)
+
     def payload():
+        plen = prompt_len
+        if long_frac > 0.0 and rng.random() < long_frac:
+            plen = long_len
         return {
-            "tokens": rng.integers(1, vocab, size=prompt_len).tolist(),
+            "tokens": rng.integers(1, vocab, size=plen).tolist(),
             "max_new_tokens": max_new_tokens,
         }
 
@@ -279,6 +305,10 @@ def bench_llm_serving(
         "spec_acceptance": (None if acceptance is None
                             else round(acceptance, 4)),
         "kv_occupancy": kv_occupancy,
+        "prefill": ("chunked" if replica.engine.chunked_prefill
+                    else "mono"),
+        "prefill_token_budget": replica.engine.prefill_token_budget,
+        "long_frac": long_frac,
     }
 
 
@@ -512,6 +542,12 @@ def main() -> dict:
     # ISSUE 13's A/B axis; composes with --paged (scratch-page drafts +
     # splice commits). The rows stamp the measured acceptance rate.
     spec = os.environ.get("RDB_BENCH_SPEC") == "1"
+    # --prefill {mono,chunked} (RDB_BENCH_PREFILL) pins the admission
+    # path — ISSUE 15's A/B axis; RDB_BENCH_LONG_FRAC mixes over-bucket
+    # prompts into both phases so the arms measure the head-of-line
+    # stall the token-budget scheduler removes.
+    prefill = os.environ.get("RDB_BENCH_PREFILL", "default") or "default"
+    long_frac = float(os.environ.get("RDB_BENCH_LONG_FRAC", "0") or 0)
     llm_kwargs = dict(
         num_slots=8 if fast else 64,
         saturation_requests=16 if fast else 192,
@@ -520,6 +556,8 @@ def main() -> dict:
         paged=paged,
         mesh=mesh,
         spec=spec,
+        prefill=prefill,
+        long_frac=long_frac,
     )
     try:
         llm = bench_llm_serving(**llm_kwargs)
@@ -597,6 +635,8 @@ def main() -> dict:
         "paged": paged,
         "mesh": mesh,
         "spec": spec,
+        "prefill": llm.get("prefill", prefill),
+        "long_frac": long_frac,
         "ttft_p50_ms": llm["ttft_p50_ms"],
         "ttft_p99_ms": llm["ttft_p99_ms"],
         "llm": llm,
@@ -629,6 +669,19 @@ if __name__ == "__main__":
              "composes with --paged, rows stamp the acceptance rate; "
              "NOT with --mesh > 1 — the engine rejects paged+spec+mesh)",
     )
+    ap.add_argument(
+        "--prefill", choices=("mono", "chunked"), default=None,
+        help="pin the llm rows' admission path (ISSUE 15's A/B axis; "
+             "also RDB_BENCH_PREFILL; composes with --paged — chunked "
+             "is the paged engine's default, mono the legacy "
+             "monolithic-group baseline)",
+    )
+    ap.add_argument(
+        "--long-frac", type=float, default=None,
+        help="fraction of over-bucket (~3x) prompts mixed into the llm "
+             "phases (also RDB_BENCH_LONG_FRAC; the long-prompt traffic "
+             "whose TTFT stall the chunked arm removes)",
+    )
     cli = ap.parse_args()
     if cli.paged is not None:
         os.environ["RDB_BENCH_PAGED"] = "1" if cli.paged == "on" else "0"
@@ -636,4 +689,8 @@ if __name__ == "__main__":
         os.environ["RDB_BENCH_MESH"] = str(cli.mesh)
     if cli.spec is not None:
         os.environ["RDB_BENCH_SPEC"] = "1" if cli.spec == "on" else "0"
+    if cli.prefill is not None:
+        os.environ["RDB_BENCH_PREFILL"] = cli.prefill
+    if cli.long_frac is not None:
+        os.environ["RDB_BENCH_LONG_FRAC"] = str(cli.long_frac)
     print(json.dumps(main()))

@@ -33,9 +33,22 @@ Two throughput levers on the hot loop:
   EOS mid-horizon produce discarded tokens for the remainder — bounded waste
   traded for sync amortization. With free slots and a non-empty queue the
   engine drops to single steps so admissions stay prompt.
-- **Admission cap**: at most ``max_admissions_per_step`` prefills run
-  between decode steps, so a burst of arrivals can no longer stall every
-  active slot behind a serial prefill train (head-of-line blocking).
+- **Token-budgeted chunked admission** (paged engines; slab opt-in via
+  ``chunked_prefill=True``): EVERY admission is a chunk train — the
+  prompt split into compiled ``<=C``-token chunk programs whose k/v
+  scatter straight through the slot's page table (pages granted per
+  chunk from the shared allocator, CoW-borrowed prefix pages skipped) —
+  and the engine's own step loop spends at most
+  ``prefill_token_budget`` tokens advancing pending trains between
+  decode turns. A burst of arrivals therefore never stalls an active
+  slot behind a serial prefill train (head-of-line blocking): the stall
+  bound is ONE chunk program per decode turn, regardless of how much
+  prefill is queued. The final chunk program samples the first token
+  in-program, so TTFT ends at a ``[B]`` ids fetch — never a logits
+  round-trip. Engines running the legacy monolithic path instead ration
+  admissions by count (``max_admissions_per_step`` prefills between
+  decode steps), which merely bounds how MANY full-prompt programs
+  stall each round.
 
 Streaming: requests carrying a :class:`~.request.TokenStream` get every
 token pushed as it reaches the host, before the sequence finishes (ref
@@ -144,6 +157,30 @@ class _Slot:
         return self.request is None
 
 
+@dataclass
+class _ChunkTrain:
+    """One admission mid-chunked-prefill: the unit the token-budget
+    scheduler advances between decode turns. The train HOLDS its slot
+    (``_free_slots`` excludes it) and — paged — the pages granted so
+    far (``opts['_pages']``: CoW-borrowed head + per-chunk grants);
+    ``pos`` is the next global position to prefill, ``base`` the first
+    position this train computes (positions below it were seeded from
+    borrowed prefix/session pages, or a slab session row)."""
+
+    req: Request
+    prompt: np.ndarray
+    opts: Dict
+    slot_idx: int
+    C: int                 # chunk width (compiled program shape)
+    pos: int = 0           # next global position to prefill
+    base: int = 0          # first computed position (CoW/session skip)
+    total: int = 0         # prompt length (prefill ends here)
+    row: Any = None        # slab mode: private row cache
+    last: Any = None       # slab mode: last chunk's take-row logits
+    insert_prefix: bool = False  # slab: publish chunk 0 on completion
+    started_ms: float = 0.0
+
+
 # Speculation observability (ISSUE 13 satellite): the ``paged`` tag
 # ("true"/"false") splits the slab and paged spec arms so an A/B capture
 # can never conflate them; accepted + rejected == drafted is a per-round
@@ -191,6 +228,22 @@ PAGE_EVICTIONS = m.Counter(
     "rdb_decode_page_evictions_total",
     "Slots capacity-finished to reclaim pages (over-subscribed pool)",
     tag_keys=("model",),
+)
+# Token-budget prefill scheduler (ISSUE 15): chunk programs dispatched,
+# trains parked on page starvation, and the live pending-train depth.
+PREFILL_CHUNKS = m.Counter(
+    "rdb_decode_prefill_chunks_total",
+    "Chunk programs dispatched by the token-budget prefill scheduler",
+    tag_keys=("model",),
+)
+PREFILL_STARVED = m.Counter(
+    "rdb_decode_prefill_starved_total",
+    "Chunk dispatches deferred by page starvation (train parked)",
+    tag_keys=("model",),
+)
+PREFILL_PENDING = m.Gauge(
+    "rdb_decode_prefill_pending_trains",
+    "Chunk trains awaiting prefill budget", tag_keys=("model",),
 )
 
 
@@ -461,6 +514,8 @@ class DecodeEngine:
         page_size: int = 128,
         kv_pool_pages: Optional[int] = None,
         host_spill_pages: int = 0,
+        chunked_prefill: Optional[bool] = None,
+        prefill_token_budget: Optional[int] = None,
     ):
         from ray_dynamic_batching_tpu.utils.compile_cache import maybe_enable
 
@@ -663,6 +718,32 @@ class DecodeEngine:
         self.ttft_horizon = min(max(1, int(ttft_horizon)),
                                 self.decode_horizon)
         self.max_admissions_per_step = max(1, int(max_admissions_per_step))
+        # --- token-budget chunked admission (ISSUE 15 tentpole) ---------
+        # Chunked prefill is the UNIVERSAL admission path on the paged
+        # engine (pages-direct chunk k/v, first-token fusion); slab
+        # engines opt in (row-cache chunks + fused commit) — the A/B arm
+        # the exactness matrix compares. ``prefill_token_budget`` is the
+        # most prefill tokens one scheduler round may spend between
+        # decode turns; clamped to >= one chunk width so a full-width
+        # chunk can always dispatch (otherwise nothing would ever
+        # admit). With the default budget of exactly one chunk, no
+        # running stream ever waits more than ONE chunk program between
+        # its turns — the stall bound tier-1 pins.
+        if chunked_prefill is None:
+            chunked_prefill = self.paged
+        self.chunked_prefill = bool(chunked_prefill)
+        _chunk_w = self.prompt_buckets[-1] if self.prompt_buckets \
+            else max_len
+        self.prefill_token_budget = max(
+            int(prefill_token_budget or _chunk_w), _chunk_w
+        )
+        self._trains: List[_ChunkTrain] = []   # FIFO (arrival order)
+        self._train_slots: set = set()
+        # Interleave cadence log (bounded): ("chunk", tokens) /
+        # ("turn", horizon) events, the stall-bound pin's observable.
+        self.interleave_log: collections.deque = collections.deque(
+            maxlen=4096
+        )
         # TTFT decomposition: (queue_wait, scan_wait, prefill) per admission
         # over a rolling window — queue_wait is arrival->dequeue (slot
         # starvation + waiting out in-flight scans), scan_wait the portion
@@ -716,6 +797,12 @@ class DecodeEngine:
         # bias_ids=6, bias_vals=7, counts=8).
         self._decode_fn = jax.jit(
             self._decode_impl, donate_argnums=(1, 8), static_argnums=(3,)
+        )
+        # Pages-direct chunk program (chunked paged admission): one jit,
+        # retraced per (group, width) shape; the pool cache (arg 2) is
+        # donated across chunks.
+        self._chunk_paged_fn = jax.jit(
+            self._chunk_group_paged_impl, donate_argnums=(2,)
         )
         # Speculative decoding (greedy rows only): a small draft proposes
         # spec_tokens continuations per slot, the target verifies the whole
@@ -966,6 +1053,48 @@ class DecodeEngine:
         )
         return first, cache
 
+    def _chunk_group_paged_impl(self, params, tokmask, cache, tables,
+                                meta_i, meta_f, bias_ids, bias_vals):
+        """One chunk program for a GROUP of chunk trains, pages-direct
+        (ISSUE 15 tentpole): each row is one train's next ``<=W``-token
+        chunk, scattered straight through its own page-table row
+        (``tables`` [g, NP] — CoW-borrowed head pages sit below the
+        row's ``start`` and are never written; the unallocated tail is
+        sentinel-steered and drops, like the spec verify scatter), with
+        the staircase read bounded by the row's own start. The cache
+        argument is DONATED across chunks — XLA updates the pool in
+        place, no row cache, no commit copy.
+
+        First-token fusion: ``_sample_tokens`` runs in-program on every
+        row's take-row logits, so a FINAL chunk's admission ends at a
+        ``[g]`` ids fetch — never a logits round-trip. Final rows also
+        scatter their verified prompt length into ``cache.lengths``;
+        non-final rows are steered to the sentinel slot (``mode="drop"``
+        voids both). ``meta_i`` [6, g] packs slot-or-sentinel / start /
+        take_idx / top_k / seed / new_len; ``meta_f`` [2, g] packs
+        temperature / top_p — the admission-group packed-transfer
+        convention."""
+        tokens, attn_mask = tokmask[0], tokmask[1]
+        slots, starts, take_idx, topk, seeds, new_len = (
+            meta_i[0], meta_i[1], meta_i[2], meta_i[3], meta_i[4],
+            meta_i[5],
+        )
+        temps, topp = meta_f[0], meta_f[1]
+        params = self._mp(params)
+        taken, pools = self.model.prefill_chunk_paged(
+            params, tokens, attn_mask, cache, tables, starts, take_idx
+        )
+        lengths = cache.lengths.at[slots].set(new_len, mode="drop")
+        cache = cache.replace(
+            k=pools.k, v=pools.v, lengths=lengths,
+            k_scale=pools.k_scale, v_scale=pools.v_scale,
+        )
+        first = self._sample_tokens(
+            taken, temps, topk, seeds, jnp.zeros_like(slots), bias_ids,
+            bias_vals, topp,
+        )
+        return first, cache
+
     def _decode_impl(self, params, cache, step_state, horizon: int,
                      samp_f, samp_i, bias_ids, bias_vals, counts):
         """``horizon`` chained decode steps in one program (one host sync).
@@ -1209,6 +1338,71 @@ class DecodeEngine:
             self._warmup_impl()
 
     def _warmup_impl(self) -> None:
+        if self.chunked_prefill and self.paged:
+            # Chunked-universal admission: warm the pages-direct chunk
+            # program at every (bucket, group) shape the pump can
+            # produce, plus the (1, C_max) long-train shape (covered by
+            # group size 1 at the largest bucket). All-sentinel tables:
+            # every page write drops, the lengths scatter steers to the
+            # sentinel slot — the full program compiles without touching
+            # a real page.
+            for b in self.prompt_buckets:
+                for g in self._admit_group_sizes():
+                    first, self._cache = self._chunk_paged_fn(
+                        self.params,
+                        jnp.stack([
+                            jnp.zeros((g, b), jnp.int32),
+                            jnp.ones((g, b), jnp.int32),
+                        ]),
+                        self._cache,
+                        jnp.full((g, self._n_table_entries),
+                                 self.num_pages, jnp.int32),
+                        jnp.stack([
+                            jnp.full((g,), self.num_slots, jnp.int32),
+                            jnp.zeros((g,), jnp.int32),
+                            jnp.zeros((g,), jnp.int32),
+                            jnp.zeros((g,), jnp.int32),
+                            jnp.zeros((g,), jnp.int32),
+                            jnp.zeros((g,), jnp.int32),
+                        ]),
+                        jnp.stack([
+                            jnp.zeros((g,), jnp.float32),
+                            jnp.ones((g,), jnp.float32),
+                        ]),
+                        jnp.zeros((g, self.max_bias_entries), jnp.int32),
+                        jnp.zeros((g, self.max_bias_entries),
+                                  jnp.float32),
+                    )
+                    first.block_until_ready()
+        elif self.chunked_prefill:
+            # Slab chunked trains ride the row-cache chunk + fused
+            # commit programs — warm THOSE, not the monolithic groups
+            # this engine never dispatches (a cold chunk program is a
+            # 20-40s XLA compile on the first real request, exactly
+            # what warmup exists to prevent).
+            C = self.prompt_buckets[-1]
+            chunk_fn, commit_fn, _seed, _ex = self._long_prefill_fns(C)
+            row = self.model.make_cache(1, self._long_row_cap(C))
+            last, row = chunk_fn(
+                self.params,
+                jnp.zeros((1, C), jnp.int32),
+                jnp.ones((1, C), jnp.int32),
+                row, jnp.int32(0), jnp.int32(0),
+            )
+            first, self._cache = commit_fn(
+                self._cache, row,
+                jnp.zeros((3,), jnp.int32),
+                last,
+                jnp.asarray([0.0, 1.0], jnp.float32),
+                jnp.zeros((1, self.max_bias_entries), jnp.int32),
+                jnp.zeros((1, self.max_bias_entries), jnp.float32),
+            )
+            first.block_until_ready()
+        else:
+            self._warmup_prefill_groups()
+        self._warmup_decode()
+
+    def _warmup_prefill_groups(self) -> None:
         for b in self.prompt_buckets:
             for g in self._admit_group_sizes():
                 tokmask = jnp.stack([
@@ -1241,6 +1435,8 @@ class DecodeEngine:
                     *extra,
                 )
                 first.block_until_ready()
+
+    def _warmup_decode(self) -> None:
         B = self.num_slots
         warm_samp_f = jnp.stack([
             jnp.zeros((B,), jnp.float32),
@@ -1263,22 +1459,27 @@ class DecodeEngine:
             )
             packed.block_until_ready()
         if self._dcache is not None:
-            for b in self.prompt_buckets:
-                for g in self._admit_group_sizes():
-                    self._dcache = self._draft_prefill_fn(b, g)(
-                        self.draft_params,
-                        jnp.stack([
-                            jnp.zeros((g, b), dtype=jnp.int32),
-                            jnp.ones((g, b), dtype=jnp.int32),
-                        ]),
-                        self._dcache,
-                        jnp.stack([
-                            jnp.arange(g, dtype=jnp.int32) % self.num_slots,
-                            jnp.zeros((g,), jnp.int32),
-                            jnp.zeros((g,), jnp.int32),
-                            jnp.zeros((g,), jnp.int32),
-                        ]),
-                    )
+            if not self.chunked_prefill:
+                # Draft group-prefill programs serve the MONO admission
+                # path only; chunked engines replay prompts through the
+                # lazily-compiled draft chunk program instead.
+                for b in self.prompt_buckets:
+                    for g in self._admit_group_sizes():
+                        self._dcache = self._draft_prefill_fn(b, g)(
+                            self.draft_params,
+                            jnp.stack([
+                                jnp.zeros((g, b), dtype=jnp.int32),
+                                jnp.ones((g, b), dtype=jnp.int32),
+                            ]),
+                            self._dcache,
+                            jnp.stack([
+                                jnp.arange(g, dtype=jnp.int32)
+                                % self.num_slots,
+                                jnp.zeros((g,), jnp.int32),
+                                jnp.zeros((g,), jnp.int32),
+                                jnp.zeros((g,), jnp.int32),
+                            ]),
+                        )
             packed, self._cache, self._dcache = self._spec_fn(
                 self.params,
                 self._cache,
@@ -1309,15 +1510,25 @@ class DecodeEngine:
         self._cache = self._cache.replace(
             lengths=jnp.zeros((self.num_slots,), dtype=jnp.int32)
         )
+        n_warm = len(self._prefill_fns)
+        if self.chunked_prefill and self.paged:
+            # Chunk shapes live in ONE retracing jit, not _prefill_fns.
+            n_warm = len(self.prompt_buckets) * len(
+                self._admit_group_sizes()
+            )
         logger.info(
-            "%s: warmed %d prefill programs + decode horizons {1, %d, %d}",
-            self.model.name, len(self._prefill_fns), self.ttft_horizon,
-            self.decode_horizon,
+            "%s: warmed %d %s programs + decode horizons {1, %d, %d}",
+            self.model.name, n_warm,
+            "chunk" if self.chunked_prefill and self.paged else "prefill",
+            self.ttft_horizon, self.decode_horizon,
         )
 
     # --- admission ---------------------------------------------------------
     def _free_slots(self) -> List[int]:
-        return [i for i, s in enumerate(self._slots) if s.free]
+        return [
+            i for i, s in enumerate(self._slots)
+            if s.free and i not in self._train_slots
+        ]
 
     def _prep_prompt(self, req: Request) -> Tuple[np.ndarray, int, Dict]:
         """Validate one request BEFORE it costs a dispatch; returns
@@ -1464,7 +1675,10 @@ class DecodeEngine:
         free = self._free_slots()
         if not free:
             return 0
-        if self._active_mask.any():
+        if self._active_mask.any() and not self.chunked_prefill:
+            # Legacy monolithic rationing: the admission COUNT bounds the
+            # stall. Chunked engines admit into trains instead — the
+            # token budget, not this cap, paces their prefill work.
             free = free[: self.max_admissions_per_step]
         batch = self.queue.get_batch(len(free), discard_stale=True)
         # Mid-admission visibility: these requests are in NEITHER the
@@ -1481,6 +1695,8 @@ class DecodeEngine:
         # would otherwise strand them forever.
         self._admitting_batch = batch
         try:
+            if self.chunked_prefill:
+                return self._admit_chunked(batch, free)
             return self._admit_batch(batch, free)
         finally:
             self._admitting = 0
@@ -1587,6 +1803,454 @@ class DecodeEngine:
                 continue
             admitted += 1
         return admitted
+
+    # --- token-budget chunked admission (ISSUE 15 tentpole) ----------------
+    def _admit_chunked(self, batch: List[Request],
+                       free: List[int]) -> int:
+        """Universal chunked admission: every dequeued request becomes a
+        :class:`_ChunkTrain` holding a slot; NO prefill dispatches here —
+        the token-budget scheduler (:meth:`_pump_prefill`) advances
+        trains between decode turns, so dequeue latency is microseconds
+        and the stall bound is owned by one place."""
+        t_dequeue = now_ms()
+        started = 0
+        for req in batch:
+            req.admit_ms = t_dequeue
+            try:
+                prompt, bucket, opts = self._prep_prompt(req)
+            except Exception as e:  # noqa: BLE001 — bad prompt must not kill loop
+                req.reject(e)
+                continue
+            slot_idx = free[started]  # len(batch) <= len(free) by dequeue
+            try:
+                self._start_train(req, prompt, bucket, opts, slot_idx)
+            except Exception as e:  # noqa: BLE001 — no-dangle rule
+                logger.exception(
+                    "%s: train admission failed", self.model.name
+                )
+                self._release_pages(opts)
+                req.reject(e)
+                continue
+            started += 1
+        return started
+
+    def _start_train(self, req: Request, prompt: np.ndarray, bucket: int,
+                     opts: Dict, slot_idx: int) -> None:
+        """Create the chunk train for one admission: resolve prefix /
+        session reuse (paged: CoW page borrows with the base floored to
+        a page boundary — the partial boundary page belongs to its owner
+        and its positions are in the prompt, so the train recomputes
+        them into its own pages; slab: row-cache seeding exactly like
+        the legacy long path) and park the train for the budget pump.
+        Fresh bucketed prompts keep their bucket as the chunk width so
+        same-bucket trains group into one program; long prompts and
+        seeded continuations chunk at the largest bucket."""
+        C_max = self.prompt_buckets[-1]
+        total = int(prompt.size)
+        base = 0
+        row = None
+        insert_prefix = False
+        W = bucket if bucket > 0 else C_max
+        sessions = (self.paged_sessions if self.paged
+                    else self.session_cache)
+        hit = None
+        if sessions is not None and opts["session_id"]:
+            hit = sessions.lookup(opts["session_id"], prompt)
+            if hit is None:
+                opts["_session_miss"] = True
+        if self.paged:
+            opts.setdefault("_pages", [])
+            opts["_shared_pages"] = 0
+            if hit is not None:
+                shared_ids, stored_len = hit
+                n_share = stored_len // self.page_size
+                # Counted at REGISTRATION (_register, via _session_hit):
+                # a starvation-valve requeue re-admits and re-looks-up —
+                # counting here would double-count, the same hazard the
+                # legacy path dodged by counting after the requeue
+                # window.
+                opts["_session_hit"] = True
+                if n_share > 0:
+                    head = list(shared_ids[:n_share])
+                    self._allocator.incref(head)
+                    opts["_pages"] = head
+                    opts["_shared_pages"] = n_share
+                    base = n_share * self.page_size
+                    self._page_journal.record(
+                        "cow_copy", n_share,
+                        self._allocator.allocated_pages, source="session",
+                    )
+                W = C_max
+            # NOTE: prefix-cache lookup is deferred to the train's FIRST
+            # chunk dispatch (_maybe_borrow_prefix) — the legacy fill
+            # path looked up at fill time, after earlier admissions in
+            # the same dequeue had published their pages, and two
+            # identical queued prompts must keep sharing.
+        else:
+            # Slab trains always chunk at the largest bucket: ONE
+            # compiled program set (chunk/commit/seed) serves every
+            # train, and the chunk-granular prefix cache's fixed width
+            # is exactly C_max.
+            W = C_max
+            row = self.model.make_cache(1, self._long_row_cap(W))
+            if hit is not None:
+                ek, ev, eks, evs, elen = hit
+                opts["_session_hit"] = True  # counted at _register
+                seed_fn, _ = self._session_fns()
+                row = seed_fn(row, ek, ev, eks, evs, jnp.int32(elen))
+                base = int(elen)
+            elif self.prefix_cache is not None and total > W:
+                phit = self.prefix_cache.lookup(prompt)
+                if phit is not None:
+                    _c, _co, seed_fn, _ex = self._long_prefill_fns(W)
+                    row = seed_fn(row, *phit)
+                    base = W
+                    PREFIX_HITS.inc(tags={"model": self.model.name,
+                                          "granularity": "chunk"})
+                else:
+                    insert_prefix = True
+                    PREFIX_MISSES.inc(tags={"model": self.model.name,
+                                            "granularity": "chunk"})
+        self._trains.append(_ChunkTrain(
+            req=req, prompt=prompt, opts=opts, slot_idx=slot_idx, C=W,
+            pos=base, base=base, total=total, row=row,
+            insert_prefix=insert_prefix, started_ms=now_ms(),
+        ))
+        self._train_slots.add(slot_idx)
+
+    def _pump_prefill(self) -> None:
+        """Spend at most ``prefill_token_budget`` tokens advancing
+        pending chunk trains — the engine-owned interleave that replaced
+        the count-based admission cap. FCFS head-first (oldest train's
+        TTFT first); paged engines batch same-width trains into ONE
+        chunk program per dispatch. Page-starved trains park for the
+        round (counted) instead of evicting live streams; a round where
+        NOTHING could progress while no stream is active triggers the
+        starvation valve (requeue the newest train) so parked trains
+        can never deadlock the pool among themselves."""
+        if not self._trains:
+            return
+        model_tag = {"model": self.model.name}
+        budget = self.prefill_token_budget
+        parked: set = set()
+        dispatched_any = False
+        while budget > 0:
+            head = next(
+                (t for t in self._trains if id(t) not in parked), None
+            )
+            if head is None or head.C > budget:
+                break
+            if self.paged:
+                members = [head]
+                # Group SINGLE-chunk trains only: a multi-chunk train
+                # dispatches solo so it can complete (and publish its
+                # prefix pages) before an identical queued prompt's
+                # first chunk looks the prefix up — batching two copies
+                # of the same long prompt would compute both.
+                if head.total - head.base <= head.C:
+                    cap = min(self.max_admissions_per_step,
+                              max(1, budget // head.C))
+                    for t in self._trains:
+                        if len(members) >= cap:
+                            break
+                        if (t is head or id(t) in parked
+                                or t.C != head.C
+                                or t.total - t.base > t.C):
+                            continue
+                        members.append(t)
+                ready = []
+                for t in members:
+                    self._maybe_borrow_prefix(t)
+                    if self._grant_train_pages(t):
+                        ready.append(t)
+                    else:
+                        parked.add(id(t))
+                        PREFILL_STARVED.inc(tags=model_tag)
+                if not ready:
+                    continue
+                try:
+                    self._dispatch_chunk_group(ready)
+                except Exception as e:  # noqa: BLE001 — no-dangle rule
+                    logger.exception(
+                        "%s: chunk dispatch failed", self.model.name
+                    )
+                    for t in ready:
+                        self._drop_train(t, e)
+                    continue
+                budget -= head.C * len(ready)
+            else:
+                try:
+                    self._advance_train_slab(head)
+                except Exception as e:  # noqa: BLE001 — no-dangle rule
+                    logger.exception(
+                        "%s: chunk dispatch failed", self.model.name
+                    )
+                    self._drop_train(head, e)
+                    continue
+                budget -= head.C
+            dispatched_any = True
+            if self.interleave_hook is not None:
+                # Colocation fairness: co-tenant engines get their scans
+                # between chunk dispatches, exactly as the legacy
+                # ``between=`` callback provided.
+                self.interleave_hook()
+        if (self.paged and not dispatched_any and self._trains
+                and not self._active_mask.any()):
+            self._relieve_train_starvation()
+        PREFILL_PENDING.set(float(len(self._trains)), tags=model_tag)
+
+    def _drain_prefill(self) -> None:
+        """Pump pending chunk trains to completion (tests and manual
+        drivers that dequeued via ``_admit`` and want the admission
+        fully registered; the serving loop never calls this — it pumps
+        one budget per turn). Decode turns run ONLY when trains are
+        parked behind pages that active streams hold — EOS is then the
+        only thing that can free them."""
+        while self._trains:
+            before = (sum(t.pos for t in self._trains), len(self._trains))
+            self._pump_prefill()
+            after = (sum(t.pos for t in self._trains), len(self._trains))
+            if after != before:
+                continue
+            if self._active_mask.any():
+                # Starved behind live streams: advance them one turn so
+                # finishes can free pages (a spin here would never end —
+                # nothing else releases what the actives hold).
+                self._step(horizon=1)
+                continue
+            # No progress and nothing decoding: trains are parked on
+            # pages only EOS could free — a driver bug, not a wait.
+            raise TimeoutError(
+                f"{self.model.name}: chunk trains cannot progress "
+                "(page-starved with no active streams)"
+            )
+
+    def _maybe_borrow_prefix(self, train: _ChunkTrain) -> None:
+        """Longest-shared-page-prefix CoW borrow, resolved at the
+        train's FIRST chunk dispatch (not at dequeue): earlier trains
+        from the same burst publish their pages at completion, and the
+        legacy fill-time lookup let an identical queued prompt share
+        them — dequeue-time lookup would always miss. Borrowed pages
+        become the train's head; ``pos``/``base`` jump past the shared
+        positions."""
+        if (not self.paged or self.paged_prefix is None
+                or train.pos != train.base or train.pos != 0
+                or train.opts.get("_shared_pages", 0)
+                or train.opts.get("_prefix_done")
+                or train.total <= self.page_size):
+            return
+        train.opts["_prefix_done"] = True
+        phit = self.paged_prefix.lookup(train.prompt)
+        if phit is None and self.host_spill is not None:
+            phit = self._reload_spilled_prefix(train.prompt)
+        if phit is None:
+            PREFIX_MISSES.inc(tags={"model": self.model.name,
+                                    "granularity": "page"})
+            return
+        shared_ids, shared_len = phit
+        head = list(shared_ids)
+        self._allocator.incref(head)
+        train.opts["_pages"] = head + train.opts["_pages"]
+        train.opts["_shared_pages"] = len(head)
+        train.pos = train.base = shared_len
+        self._page_journal.record(
+            "cow_copy", len(head), self._allocator.allocated_pages,
+            source="prefix",
+        )
+        PREFIX_HITS.inc(tags={"model": self.model.name,
+                              "granularity": "page"})
+
+    def _grant_train_pages(self, train: _ChunkTrain) -> bool:
+        """Per-chunk page grant: extend the train's page run to cover
+        the NEXT chunk's real positions (final chunks also cover the
+        first generated token — or the first spec verify window on spec
+        engines, the shared ``spec_scratch_pages`` rule). Cache pins
+        shed first; a still-starved train parks (False) — live streams
+        are never evicted to feed an admission."""
+        take = min(train.C, train.total - train.pos)
+        final = train.pos + take >= train.total
+        if final:
+            if self._dcache is not None:
+                need = spec_scratch_pages(
+                    train.total, self.spec_tokens + 1, self.page_size,
+                    self._paged_capacity,
+                )
+            else:
+                need = pages_for(
+                    min(train.total + 1, self._paged_capacity),
+                    self.page_size,
+                )
+        else:
+            need = pages_for(train.pos + take, self.page_size)
+        delta = need - len(train.opts["_pages"])
+        if delta <= 0:
+            return True
+        while not self._allocator.can_alloc(delta):
+            if not self._reclaim_cache_pins():
+                break
+        if not self._allocator.can_alloc(delta):
+            return False
+        train.opts["_pages"].extend(self._allocator.alloc(delta))
+        return True
+
+    def _dispatch_chunk_group(self, trains: List[_ChunkTrain]) -> None:
+        """ONE pages-direct chunk program for up to a compiled group of
+        same-width trains: chunk k/v scatter through per-row page-table
+        rows, first token sampled in-program for final rows. Pad rows
+        duplicate row 0 (identical data to identical pages — idempotent,
+        the group-admission convention)."""
+        W = trains[0].C
+        n = len(trains)
+        group = next(s for s in self._admit_group_sizes() if s >= n)
+        tokens = np.zeros((group, W), np.int32)
+        mask = np.zeros((group, W), np.int32)
+        tables = np.full((group, self._n_table_entries), self.num_pages,
+                         np.int32)
+        meta_i = np.zeros((6, group), np.int32)
+        meta_f = np.zeros((2, group), np.float32)
+        bias_ids = np.zeros((group, self.max_bias_entries), np.int32)
+        bias_vals = np.zeros((group, self.max_bias_entries), np.float32)
+        finals: List[Tuple[int, _ChunkTrain]] = []
+        for i, t in enumerate(trains):
+            piece = t.prompt[t.pos : t.pos + W]
+            take = int(piece.size)
+            final = t.pos + take >= t.total
+            tokens[i, :take] = piece
+            mask[i, :take] = 1
+            tables[i] = table_array(
+                t.opts["_pages"], self._n_table_entries, self.num_pages
+            )
+            # Non-final rows steer the lengths scatter to the sentinel
+            # slot: only the FINAL chunk publishes the verified length.
+            meta_i[0, i] = t.slot_idx if final else self.num_slots
+            meta_i[1, i] = t.pos
+            meta_i[2, i] = take - 1
+            meta_i[3, i] = t.opts["top_k"]
+            meta_i[4, i] = t.opts["seed"]
+            meta_i[5, i] = t.total
+            meta_f[0, i] = t.opts["temperature"]
+            meta_f[1, i] = t.opts.get("top_p", 1.0)
+            bias_ids[i], bias_vals[i] = self._bias_arrays(t.opts)
+            if final:
+                finals.append((i, t))
+        for i in range(n, group):
+            tokens[i] = tokens[0]
+            mask[i] = mask[0]
+            tables[i] = tables[0]
+            meta_i[:, i] = meta_i[:, 0]
+            meta_f[:, i] = meta_f[:, 0]
+            bias_ids[i] = bias_ids[0]
+            bias_vals[i] = bias_vals[0]
+        first, self._cache = self._chunk_paged_fn(
+            self.params,
+            jnp.asarray(np.stack([tokens, mask])),
+            self._cache,
+            jnp.asarray(tables),
+            jnp.asarray(meta_i),
+            jnp.asarray(meta_f),
+            jnp.asarray(bias_ids),
+            jnp.asarray(bias_vals),
+        )
+        for t in trains:
+            t.pos = min(t.pos + W, t.total)
+        PREFILL_CHUNKS.inc(n, tags={"model": self.model.name})
+        self.interleave_log.append(("chunk", W * n))
+        if not finals:
+            return
+        first_host = np.asarray(first)  # rdb-lint: disable=host-sync-in-hot-path (THE one fetch per chunk dispatch: the fused first-token ids — TTFT ends here, never at a logits round-trip)
+        t_done = now_ms()
+        for i, t in finals:
+            self._retire_train(t)
+            if self.paged_prefix is not None:
+                # Publish BEFORE registration: a stop-on-first-token
+                # finish frees the slot's pages, and the insert must pin
+                # them first (the legacy after_commit contract).
+                self.paged_prefix.insert(t.prompt, t.opts["_pages"])
+            if self._dcache is not None:
+                # The draft has no pages-direct path (its cache is a
+                # slab): replay the whole prompt through the draft's
+                # chunk program so speculation starts synced.
+                self._draft_long_fill(
+                    t.prompt, t.slot_idx, self.prompt_buckets[-1]
+                )
+            self._register(t.slot_idx, t.req, int(first_host[i]), t.opts,
+                           t_done)
+
+    def _advance_train_slab(self, train: _ChunkTrain) -> None:
+        """One row-cache chunk for a slab train (the legacy chunk
+        program under the token budget); the final chunk flows into the
+        fused commit+sample dispatch via ``_commit_and_register``."""
+        C = train.C
+        chunk_fn, commit_fn, _seed, extract_fn = self._long_prefill_fns(C)
+        piece = train.prompt[train.pos : train.pos + C]
+        take = int(piece.size)
+        tokens = np.zeros((1, C), np.int32)
+        mask = np.zeros((1, C), np.int32)
+        tokens[0, :take] = piece
+        mask[0, :take] = 1
+        train.last, train.row = chunk_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(mask),
+            train.row, jnp.int32(train.pos), jnp.int32(take - 1),
+        )
+        if train.insert_prefix and train.pos == 0 and take == C:
+            # Chunk 0 was full: its k/v depend only on the first C token
+            # ids — exactly reusable (the legacy after_first hook).
+            self.prefix_cache.insert(
+                train.prompt, *extract_fn(train.row, C)
+            )
+        train.pos += take
+        PREFILL_CHUNKS.inc(tags={"model": self.model.name})
+        self.interleave_log.append(("chunk", C))
+        if train.pos >= train.total:
+            self._retire_train(train)
+            self._commit_and_register(
+                train.req, train.prompt, train.opts, train.slot_idx,
+                commit_fn, train.row, train.last, C,
+            )
+
+    def _retire_train(self, train: _ChunkTrain) -> None:
+        if train in self._trains:
+            self._trains.remove(train)
+        self._train_slots.discard(train.slot_idx)
+
+    def _drop_train(self, train: _ChunkTrain, exc: Exception) -> None:
+        """A failed train must never dangle: release its pages (borrowed
+        head decrefs its borrow) and reject the caller."""
+        self._retire_train(train)
+        self._release_pages(train.opts)
+        train.req.reject(exc)
+
+    def _relieve_train_starvation(self) -> None:
+        """Deadlock valve for per-chunk grants: with no active streams
+        there is no EOS to free pages, so an all-parked train set would
+        wait forever on pages the OTHER parked trains hold. Requeue the
+        NEWEST train (least sunk prefill cost — the slot-starvation
+        requeue's twin), releasing its grant back to the pool. A LONE
+        starved train should be impossible (the pool must back one
+        slot's worth by construction, and with no actives + drained
+        cache pins nothing else holds pages) — but if it ever happens,
+        requeue it too: back in the queue, deadline-based staleness
+        eventually rejects it, the legacy page-starvation economics,
+        instead of the loop spinning on an unservable train forever.
+        Prefer a train that has not dispatched yet (pos == base): zero
+        sunk prefill cost AND no metrics to double-count."""
+        if not self._trains:
+            return
+        train = next(
+            (t for t in reversed(self._trains) if t.pos == t.base),
+            self._trains[-1],
+        )
+        self._retire_train(train)
+        self._release_pages(train.opts)
+        if not self.queue.add_request(train.req, reject_on_full=False,
+                                      requeue=True):
+            self.queue.count_external_drop(
+                train.req, reason="requeue_refused"
+            )
+            train.req.reject(RequestDropped(
+                f"{train.req.request_id}: queue refused requeue during "
+                "page-starved chunked admission"
+            ))
 
     # --- paged admission bookkeeping ---------------------------------------
     def _alloc_admission_pages(self, req: Request, prompt: np.ndarray,
@@ -2317,6 +2981,11 @@ class DecodeEngine:
             )
 
         PREFILLS_TOTAL.inc(tags={"model": self.model.name})
+        if opts.get("_session_hit"):
+            # Chunked trains count their session hit here, past every
+            # requeue window (mono session fills count at fill start —
+            # they are equally past it).
+            SESSION_HITS.inc(tags={"model": self.model.name})
         if opts.get("_session_miss"):
             SESSION_MISSES.inc(tags={"model": self.model.name})
         TTFT_MS.observe(
@@ -2540,6 +3209,12 @@ class DecodeEngine:
         when slots are free but nothing is queued — so an arrival during the
         scan waits at most ttft_horizon substeps, not decode_horizon."""
         if self.decode_horizon <= 1:
+            return 1
+        if self._trains:
+            # Chunk trains pending: single-step turns keep the
+            # chunk/turn interleave cadence tight — a long scan between
+            # chunks would stretch every pending train's TTFT by the
+            # whole scan.
             return 1
         if not self._free_slots():
             return self.decode_horizon
@@ -2785,6 +3460,7 @@ class DecodeEngine:
                 self._rollback_spec_scratch()
             raise
         self._scan_end_ms = now_ms()
+        self.interleave_log.append(("turn", k))
         if _tracer().enabled:
             self._record_turn_span(k, self._active_mask, spec=True)
         out = ph[: k + 1]        # [k+1, B]
@@ -2864,6 +3540,8 @@ class DecodeEngine:
         )
         packed_host = np.asarray(packed)          # ONE fetch per dispatch  # rdb-lint: disable=host-sync-in-hot-path (THE one fetch per dispatch: packed carries tokens+advanced+lengths)
         self._scan_end_ms = now_ms()
+        if active_at_dispatch.any():
+            self.interleave_log.append(("turn", h))
         if _tracer().enabled and active_at_dispatch.any():
             self._record_turn_span(h, active_at_dispatch)
         toks_host = packed_host[:h]               # [h, B]
@@ -2988,9 +3666,11 @@ class DecodeEngine:
         with self._device_ctx():
             while time.monotonic() < deadline:
                 admitted = self._admit()
+                self._pump_prefill()
                 if self._active_mask.any():
                     self._step()
-                elif not admitted and len(self.queue) == 0:
+                elif (not admitted and not self._trains
+                        and len(self.queue) == 0):
                     return
         raise TimeoutError(f"{self.model.name}: decode did not drain")
 
@@ -2999,6 +3679,7 @@ class DecodeEngine:
             while self._run.is_set():
                 try:
                     self._admit()
+                    self._pump_prefill()
                     if self._active_mask.any():
                         self._step()
                         ACTIVE_SLOTS.set(
@@ -3015,7 +3696,7 @@ class DecodeEngine:
                                 / self.num_pages,
                                 tags={"model": self.model.name},
                             )
-                    else:
+                    elif not self._trains:
                         self.queue.wait_for_requests(self.idle_wait_s)
                     self.last_heartbeat = time.monotonic()
                 except Exception:  # noqa: BLE001 — engine must not die silently
@@ -3066,6 +3747,14 @@ class DecodeEngine:
                     self._free_slot_pages(i)
                 self._slots[i] = _Slot()
                 self._active_mask[i] = False
+        # Chunk trains are in-flight requests too (slot held, pages
+        # granted, no tokens yet): reject + release, never strand.
+        for train in list(self._trains):
+            train.req.reject(exc)
+            if self._allocator is not None:
+                self._release_pages(train.opts)
+        self._trains.clear()
+        self._train_slots.clear()
 
     def start(self) -> None:
         if self._thread is not None:
@@ -3125,6 +3814,11 @@ class DecodeEngine:
             "active_slots": self.active_slots,
             "kv_occupancy": self.kv_occupancy(),
             "ttft": self.ttft_breakdown(),
+            "prefill": {
+                "mode": "chunked" if self.chunked_prefill else "mono",
+                "token_budget": self.prefill_token_budget,
+                "pending_trains": len(self._trains),
+            },
         }
         if self.paged:
             out["page_size"] = self.page_size
@@ -3154,4 +3848,5 @@ class DecodeEngine:
         (dequeued but not yet slotted — invisible to both queue depth
         and ``active_slots``; drain logic that ignores this window
         aborts requests seconds from their first token)."""
-        return self._admitting > 0 or bool(self._active_mask.any())
+        return (self._admitting > 0 or bool(self._trains)
+                or bool(self._active_mask.any()))

@@ -199,6 +199,51 @@ class CausalLM(ServableModel):
         )
         return logits, new_cache
 
+    def prefill_chunk_paged(
+        self,
+        params,
+        tokens: jax.Array,     # [B, W] one chunk per row (tail right-padded)
+        attn_mask: jax.Array,  # [B, W] 1 = real token
+        cache: PagedKVCache,
+        tables: jax.Array,     # [B, NP] per-row page-table rows
+        starts: jax.Array,     # [B] global position of tokens[:, 0] per row
+        take_idx: jax.Array,   # [B] per-row logits row to return
+    ) -> Tuple[jax.Array, PagedKVCache]:
+        """Pages-DIRECT chunked prefill: one chunk of B independent (and
+        independently-positioned) prompt fills, written straight through
+        per-row page-table rows — no private row cache, no commit copy.
+        The speculative-verify primitive generalized to KNOWN tokens: row
+        b's chunk occupies global positions ``[starts[b], starts[b]+W)``,
+        k/v scatter through ``tables`` into the pages the engine granted
+        for this chunk (positions past logical capacity steer to the
+        sentinel and DROP — a CoW-borrowed prefix page is below
+        ``starts`` by construction and is never written), and attention
+        reads the STAIRCASE window (row t attends positions <=
+        starts + t — the ``paged_window_mask`` rule with the chunk's
+        start as the length; the Tq==1 case is ``decode_mask``). Padded
+        tail positions write garbage k/v beyond the final length exactly
+        like the slab chunk path; nothing ever attends them.
+        ``lengths``/``page_table`` pass through untouched — the caller
+        owns both (the engine scatters verified lengths itself at the
+        final chunk). Returns (logits at ``take_idx`` [B, V], cache)."""
+        B, W = tokens.shape
+        S = tables.shape[1] * cache.page_size
+        positions = starts[:, None] + jnp.broadcast_to(
+            jnp.arange(W)[None, :], (B, W)
+        )
+        # Overflowing positions (an unaligned continuation's padded tail
+        # can run past logical capacity) steer to S: their scatter drops
+        # at the sentinel and their outputs are never taken.
+        positions = jnp.where(positions < S, positions, S)
+        logits, new_cache = self.module.apply(
+            params, tokens, positions, None, cache, scatter_writes=True,
+            page_table=tables, kv_lengths=starts,
+        )
+        taken = jnp.take_along_axis(
+            logits, take_idx[:, None, None], axis=1
+        )[:, 0]
+        return taken, new_cache
+
     def verify_step_paged(
         self,
         params,

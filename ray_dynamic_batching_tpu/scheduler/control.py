@@ -106,7 +106,8 @@ class LiveScheduler:
     def register_model(self, name: str, slo_ms: float, seq_len: int = 0,
                        mesh_shape: str = "1x1", spec: str = "off",
                        spec_acceptance: float = 0.0,
-                       spec_tokens: int = 4) -> None:
+                       spec_tokens: int = 4,
+                       prefill_chunk_ms: float = 0.0) -> None:
         """``mesh_shape`` is the model's preferred serving slice
         ("1x4" = a 4-chip TP replica priced from its mesh profile
         rows); replans degrade it to surviving geometry when the wide
@@ -119,6 +120,7 @@ class LiveScheduler:
         self._models[name] = ModelEntry(
             name, slo_ms, seq_len, mesh_shape, spec=spec,
             spec_acceptance=spec_acceptance, spec_tokens=spec_tokens,
+            prefill_chunk_ms=prefill_chunk_ms,
         )
 
     # --- ingress path (ref submit_request, scheduler.py:734-751) ----------

@@ -61,6 +61,13 @@ class Session:
     spec: str = "off"
     spec_acceptance: float = 0.0
     spec_tokens: int = 4
+    # Chunk-interleaved prefill overhead (ISSUE 15): the expected
+    # per-turn milliseconds of budgeted chunk work riding each decode
+    # turn of this model (prefill_token_budget's worth of chunk program
+    # between turns, amortized over the turns a cycle runs). 0.0 — the
+    # default every pre-chunked registration keeps — is byte-identical
+    # to the pre-interleave packer.
+    prefill_chunk_ms: float = 0.0
 
     @property
     def chips(self) -> int:
@@ -166,6 +173,14 @@ class SquishyBinPacker:
             wl = wl / expected_tokens_per_round(
                 session.spec_acceptance, session.spec_tokens
             )
+        if session.prefill_chunk_ms > 0.0:
+            # Chunk-interleaved turns (ISSUE 15): each decode turn of a
+            # chunked-admission engine may carry one budget's worth of
+            # chunk program between it and the next — the stall bound
+            # the engine enforces is exactly the cost the planner must
+            # price, or co-located tenants get admitted into turns that
+            # are secretly longer than their profile row.
+            wl = wl + session.prefill_chunk_ms
         return wl
 
     def _turn_cost_ms(self, wl: float, fill: float) -> float:

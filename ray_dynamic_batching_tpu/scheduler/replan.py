@@ -63,6 +63,10 @@ class ModelEntry:
     spec: str = "off"
     spec_acceptance: float = 0.0
     spec_tokens: int = 4
+    # Chunk-interleaved prefill overhead per decode turn (ISSUE 15;
+    # Session.prefill_chunk_ms — 0.0 keeps pre-chunked registrations
+    # byte-identical).
+    prefill_chunk_ms: float = 0.0
 
 
 def weighted_attainment(
@@ -109,6 +113,7 @@ def sessions_for(
             spec=e.spec,
             spec_acceptance=e.spec_acceptance,
             spec_tokens=e.spec_tokens,
+            prefill_chunk_ms=e.prefill_chunk_ms,
         )
         for e in models.values()
     ]

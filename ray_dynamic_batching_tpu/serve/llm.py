@@ -340,6 +340,8 @@ class LLMDeployment:
         page_size: int = 128,
         kv_pool_pages: Optional[int] = None,
         host_spill_pages: int = 0,
+        chunked_prefill: Optional[bool] = None,
+        prefill_token_budget: Optional[int] = None,
     ) -> None:
         self.model_name = model_name
         self.num_slots = num_slots
@@ -404,6 +406,12 @@ class LLMDeployment:
         self.paged = bool(paged)
         self.page_size = int(page_size)
         self.kv_pool_pages = kv_pool_pages
+        # Token-budget chunked admission (ISSUE 15): None = the engine's
+        # default (chunked on paged engines — the universal path — mono
+        # on slabs); False forces the legacy monolithic arm (the
+        # ``bench.py --prefill mono`` A/B baseline).
+        self.chunked_prefill = chunked_prefill
+        self.prefill_token_budget = prefill_token_budget
         self._dtype = dtype
         self._model = model
         self._params = params
@@ -779,6 +787,8 @@ class LLMDeployment:
             page_size=self.page_size,
             kv_pool_pages=self.kv_pool_pages,
             host_spill_pages=self.host_spill_pages,
+            chunked_prefill=self.chunked_prefill,
+            prefill_token_budget=self.prefill_token_budget,
         )
 
     # Controller protocol: factories exposing make_replica own replica

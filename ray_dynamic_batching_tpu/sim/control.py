@@ -120,18 +120,20 @@ class SimScheduler:
     def register_model(self, name: str, slo_ms: float,
                        seq_len: int = 0, mesh_shape: str = "1x1",
                        spec: str = "off", spec_acceptance: float = 0.0,
-                       spec_tokens: int = 4) -> None:
+                       spec_tokens: int = 4,
+                       prefill_chunk_ms: float = 0.0) -> None:
         if name not in self.packer.profiles:
             raise KeyError(f"no batch profile for model {name!r}")
         self._models[name] = ModelEntry(
             name, slo_ms, seq_len, mesh_shape,
             spec=spec, spec_acceptance=spec_acceptance,
-            spec_tokens=spec_tokens,
+            spec_tokens=spec_tokens, prefill_chunk_ms=prefill_chunk_ms,
         )
 
     # --- ingress (live submit_request: demand recorded before enqueue) ----
     def submit(self, model: str, qos_class: str = DEFAULT_QOS_CLASS,
-               tenant: str = DEFAULT_TENANT) -> bool:
+               tenant: str = DEFAULT_TENANT,
+               prefill_ms: float = 0.0) -> bool:
         entry = self._models.get(model)
         if entry is None:
             return False
@@ -157,6 +159,7 @@ class SimScheduler:
                 seq_len=entry.seq_len,
                 qos_class=qos_class,
                 tenant=tenant,
+                prefill_ms=prefill_ms,
             )
         )
 

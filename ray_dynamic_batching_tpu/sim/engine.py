@@ -64,11 +64,19 @@ class SimEngine:
         width: int = 1,
         chip_ids: Optional[List[str]] = None,
         spec_rates: Optional[Dict[str, float]] = None,
+        prefill_mode: str = "mono",
+        prefill_chunk_ms: float = 0.0,
+        prefill_chunks_per_turn: int = 1,
     ) -> None:
         if occupancy_model not in ("batch", "slot"):
             raise ValueError(
                 f"unknown occupancy_model {occupancy_model!r} "
                 "(want 'batch' or 'slot')"
+            )
+        if prefill_mode not in ("mono", "chunked"):
+            raise ValueError(
+                f"unknown prefill_mode {prefill_mode!r} "
+                "(want 'mono' or 'chunked')"
             )
         self.engine_id = engine_id
         self.queues = queues
@@ -112,6 +120,20 @@ class SimEngine:
         self.spec_rates: Dict[str, float] = (
             spec_rates if spec_rates is not None else {}
         )
+        # Prefill interleave model (ISSUE 15): long-prompt requests
+        # carry ``prefill_ms`` of prefill cost BEYOND the profile row.
+        # "mono" executes it inside the popped turn (the whole train
+        # stalls the slice — head-of-line blocking, the legacy
+        # admission). "chunked" enqueues it on a FIFO chunk backlog the
+        # engine drains between cycles at ``prefill_chunk_ms x
+        # prefill_chunks_per_turn`` per cycle — the virtual-clock twin
+        # of the engine's token-budget scheduler: decode turns advance
+        # every cycle, and a long request completes when its last chunk
+        # event lands.
+        self.prefill_mode = prefill_mode
+        self.prefill_chunk_ms = float(prefill_chunk_ms)
+        self.prefill_chunks_per_turn = max(1, int(prefill_chunks_per_turn))
+        self._prefill_backlog: List[list] = []  # [queue, request, remaining]
         self._plan = NodePlan()
         self._pending: Optional[NodePlan] = None
         self._cycle_start_ms = 0.0
@@ -297,6 +319,49 @@ class SimEngine:
             )
         return mean
 
+    def _drain_prefill_backlog(self) -> float:
+        """Spend up to one cycle's chunk budget advancing the FIFO
+        prefill backlog; requests whose last chunk lands complete at
+        that virtual instant. Returns the virtual time spent (0.0 with
+        an empty backlog — the pre-interleave timeline, bit for bit)."""
+        if not self._prefill_backlog:
+            return 0.0
+        # Deadline economics FIRST: a train whose owner is already past
+        # its deadline is shed like the queue's own stale discard (the
+        # live engine never admits it — the queue stales it before a
+        # slot frees) — never silently retained, never a drop.
+        now = self.clock.now_ms()
+        keep = []
+        for entry in self._prefill_backlog:
+            if entry[1].deadline_ms < now:
+                entry[0].count_backlog_stale(entry[1])
+            else:
+                keep.append(entry)
+        self._prefill_backlog = keep
+        quantum = self.prefill_chunk_ms * self.prefill_chunks_per_turn
+        spent = 0.0
+        while self._prefill_backlog and spent < quantum - 1e-9:
+            entry = self._prefill_backlog[0]
+            step = min(entry[2], quantum - spent)
+            entry[2] -= step
+            spent += step
+            if entry[2] <= 1e-9:
+                self._prefill_backlog.pop(0)
+                entry[0].record_batch_completion([entry[1]], now + spent)
+        self.busy_ms += spent
+        return spent
+
+    def flush_prefill_backlog(self) -> int:
+        """End-of-run shed: trains still holding chunks when the
+        simulation horizon closes are discarded as stale (the live
+        drain's abort path) so accounting conserves exactly. Returns
+        the count."""
+        n = len(self._prefill_backlog)
+        for queue, req, _remaining in self._prefill_backlog:
+            queue.count_backlog_stale(req)
+        self._prefill_backlog = []
+        return n
+
     def _on_cycle_start(self) -> None:
         if not self.alive:
             return
@@ -304,11 +369,19 @@ class SimEngine:
             self._plan = self._pending
             self._pending = None
             self.swap_count += 1
+        # Budgeted chunk work rides the cycle boundary: at most one
+        # quantum between decode turns — the engine-side stall bound.
+        spent = self._drain_prefill_backlog()
         if not self._plan.placements:
-            self.loop.schedule_in(self.idle_wait_ms, self._on_cycle_start)
+            self.loop.schedule_in(
+                max(self.idle_wait_ms, spent), self._on_cycle_start
+            )
             return
         self._cycle_start_ms = self.clock.now_ms()
-        self._on_slice(0)
+        if spent > 0.0:
+            self.loop.schedule_in(spent, lambda: self._on_slice(0))
+        else:
+            self._on_slice(0)
 
     def _on_slice(self, idx: int) -> None:
         if not self.alive:
@@ -351,9 +424,28 @@ class SimEngine:
                     )
             self.slots_filled += len(batch)
             self.slots_offered += max(1, p.batch_size)
-            queue.record_batch_completion(
-                batch, self.clock.now_ms() + exec_ms
-            )
+            # Long-prompt prefill beyond the profile row (ISSUE 15):
+            # mono runs the whole train inside THIS turn (stalling the
+            # slice and everything behind it); chunked defers it to the
+            # cycle-boundary backlog — those requests complete when
+            # their last budgeted chunk event lands, while the rest of
+            # the batch completes on time.
+            deferred = []
+            if self.prefill_mode == "chunked" and self.prefill_chunk_ms > 0.0:
+                deferred = [r for r in batch
+                            if getattr(r, "prefill_ms", 0.0) > 0.0]
+                self._prefill_backlog.extend(
+                    [queue, r, r.prefill_ms] for r in deferred
+                )
+            else:
+                exec_ms += sum(getattr(r, "prefill_ms", 0.0)
+                               for r in batch)
+            done = ([r for r in batch if r not in deferred]
+                    if deferred else batch)
+            if done:
+                queue.record_batch_completion(
+                    done, self.clock.now_ms() + exec_ms
+                )
             self.busy_ms += exec_ms
             self.batches += 1
             self.requests += len(batch)

@@ -58,6 +58,12 @@ class SimRequest:
     # Stamped at dequeue: the boundary between the sim's two ledger hops
     # (queue.wait = arrival -> pop, engine.step = pop -> completion).
     popped_ms: Optional[float] = None
+    # Prefill cost BEYOND the profile-row step (ISSUE 15): > 0 marks a
+    # long-prompt request whose prefill the engine executes either
+    # inside its turn (mono — head-of-line blocking) or as budgeted
+    # chunk events between turns (chunked). 0.0 = a bucketed prompt
+    # whose prefill the row already covers.
+    prefill_ms: float = 0.0
 
     @property
     def deadline_ms(self) -> float:
@@ -195,6 +201,16 @@ class SimRequestQueue:
         self.total_completed += len(batch)
         self.total_violations += violations
         return violations
+
+    def count_backlog_stale(self, req: SimRequest) -> None:
+        """A popped request shed OUTSIDE the queue (the chunked-prefill
+        backlog's deadline economics, ISSUE 15): its train's remaining
+        chunks would land past the deadline, so the engine discards it
+        exactly like the queue's own stale rule — and it must stay
+        accounted (arrivals == completed + stale + dropped + pending),
+        the live ``count_external_drop`` contract."""
+        self.total_stale += 1
+        self._cls(req.qos_class)["stale"] += 1
 
     def slo_compliance(self) -> float:
         if not self._recent_outcomes:

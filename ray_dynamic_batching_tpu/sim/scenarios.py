@@ -416,6 +416,76 @@ def spec_scenario(spec: bool = False, collapse: bool = False,
     )
 
 
+# --- chunked-prefill interleave (ISSUE 15) ----------------------------------
+
+# One long prompt's full prefill beyond the profile row (the mono arm
+# executes it inside the popped turn), and the chunk quantum the
+# chunked arm spends between decode turns. 8 chunks per train: a
+# 120 ms train vs a 15 ms stall bound.
+INTERLEAVE_LONG_PREFILL_MS = 120.0
+INTERLEAVE_CHUNK_MS = 15.0
+
+
+def interleave_profiles() -> Dict[str, BatchProfile]:
+    """The interleave-soak fixtures: a latency-sensitive interactive
+    model sharing chips with a decode-shaped LLM whose traffic carries
+    long prompts. The LLM's profile rows cover only the BUCKETED step —
+    the long-prompt prefill cost rides per-request (SimRequest
+    .prefill_ms), which is exactly what makes the two admission
+    disciplines diverge."""
+    return {
+        "interactive": linear_profile(
+            "interactive", base_ms=2.0, per_sample_ms=0.5,
+            weight_mb=100, act_mb_per_sample=0.5,
+        ),
+        "llm_long": linear_profile(
+            "llm_long", base_ms=10.0, per_sample_ms=1.5,
+            weight_mb=1500, act_mb_per_sample=4.0,
+        ),
+    }
+
+
+def interleave_scenario(chunked: bool = False, seed: int = 0) -> Scenario:
+    """The interleave-soak fixture (``tools/run_interleave_soak.py``),
+    two arms over IDENTICAL traffic on the slot-priced cost model: an
+    interactive stream (SLO 250 ms) co-located with an LLM whose
+    arrivals are 70% long prompts, plus a long-prompt FLASH CROWD
+    (spike 12 -> 42 rps mid-run). The mono arm runs each long train
+    inside its turn — every pop behind it waits the full 120 ms — so
+    the interactive p50 inflates under the crowd; the chunked arm
+    spends the same milliseconds as 15 ms budgeted chunk events between
+    decode turns, and the interactive stream keeps its cadence. The
+    gate pins the p50 gap, equal-or-better completions, and exact
+    conservation."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="interactive", slo_ms=250.0,
+                pattern=RatePattern("constant", base_rps=50.0),
+            ),
+            SimModelSpec(
+                name="llm_long", slo_ms=4000.0,
+                pattern=RatePattern(
+                    "spike", base_rps=12.0, amplitude=30.0,
+                    spike_at_s=10.0, spike_len_s=12.0,
+                ),
+                long_frac=0.7,
+                long_prefill_ms=INTERLEAVE_LONG_PREFILL_MS,
+            ),
+        ],
+        duration_s=40.0,
+        drain_s=12.0,
+        n_engines=2,
+        seed=seed,
+        max_queue_len=16384,
+        monitoring_interval_s=2.0,
+        decode_occupancy_model="slot",
+        prefill_mode="chunked" if chunked else "mono",
+        prefill_chunk_ms=INTERLEAVE_CHUNK_MS if chunked else 0.0,
+        prefill_chunks_per_turn=1,
+    )
+
+
 # --- control-plane partition matrix (ISSUE 12) ------------------------------
 #
 # These fixtures parameterize sim/frontdoor.run_partition_sim, which rides

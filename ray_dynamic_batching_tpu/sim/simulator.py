@@ -59,7 +59,7 @@ _PATTERN_FIELDS = (
 _MODEL_KEYS = frozenset(
     ("name", "slo_ms", "seq_len", "rate_rps", "pattern", "poisson",
      "class_mix", "tenant", "mesh_shape", "spec", "spec_acceptance",
-     "spec_tokens")
+     "spec_tokens", "long_frac", "long_prefill_ms")
     + _PATTERN_FIELDS
 )
 
@@ -96,6 +96,13 @@ class SimModelSpec:
     spec: bool = False
     spec_acceptance: float = 0.7
     spec_tokens: int = 4
+    # Long-prompt mix (ISSUE 15): ``long_frac`` of this model's arrivals
+    # carry ``long_prefill_ms`` of prefill cost beyond the profile row
+    # (a seeded per-model draw — deterministic, independent of
+    # interleaving). How that cost executes is the SCENARIO's
+    # ``prefill_mode`` (mono head-of-line vs budgeted chunk events).
+    long_frac: float = 0.0
+    long_prefill_ms: float = 0.0
 
     def __post_init__(self) -> None:
         if self.class_mix is None:
@@ -109,6 +116,14 @@ class SimModelSpec:
         if self.class_mix and sum(self.class_mix.values()) <= 0:
             raise ValueError(
                 f"{self.name}: class_mix fractions must sum > 0"
+            )
+        if not 0.0 <= self.long_frac <= 1.0:
+            raise ValueError(
+                f"{self.name}: long_frac must be in [0, 1]"
+            )
+        if self.long_frac > 0.0 and self.long_prefill_ms <= 0.0:
+            raise ValueError(
+                f"{self.name}: long_frac > 0 needs long_prefill_ms > 0"
             )
 
     @classmethod
@@ -141,6 +156,8 @@ class SimModelSpec:
             spec=bool(d.get("spec", False)),
             spec_acceptance=float(d.get("spec_acceptance", 0.7)),
             spec_tokens=int(d.get("spec_tokens", 4)),
+            long_frac=float(d.get("long_frac", 0.0)),
+            long_prefill_ms=float(d.get("long_prefill_ms", 0.0)),
         )
 
 
@@ -299,6 +316,16 @@ class Scenario:
     # fill-invariant floor). Slot occupancy is reported in BOTH modes.
     decode_occupancy_model: str = "batch"
     occupancy_floor: float = 0.35
+    # Prefill interleave model (ISSUE 15): "mono" executes a long
+    # request's prefill inside its popped turn (head-of-line blocking —
+    # the legacy admission); "chunked" spends it as
+    # ``prefill_chunk_ms x prefill_chunks_per_turn`` virtual-clock
+    # chunk events between cycles — the token-budget scheduler's twin.
+    # The packer prices chunk-interleaved turns via
+    # Session.prefill_chunk_ms when chunked.
+    prefill_mode: str = "mono"
+    prefill_chunk_ms: float = 0.0
+    prefill_chunks_per_turn: int = 1
     # Injected engine deaths (chaos conformance): each kills one sim
     # engine at virtual time t; the monitor heals over survivors.
     failures: List[EngineFailure] = field(default_factory=list)
@@ -401,6 +428,11 @@ class Scenario:
                 d.get("decode_occupancy_model", "batch")
             ),
             occupancy_floor=float(d.get("occupancy_floor", 0.35)),
+            prefill_mode=str(d.get("prefill_mode", "mono")),
+            prefill_chunk_ms=float(d.get("prefill_chunk_ms", 0.0)),
+            prefill_chunks_per_turn=int(
+                d.get("prefill_chunks_per_turn", 1)
+            ),
             failures=[
                 EngineFailure.from_dict(f) for f in d.get("failures", [])
             ],
@@ -500,6 +532,11 @@ class Simulation:
             spec.name: spec.spec_acceptance
             for spec in sc.models if spec.spec
         }
+        if sc.prefill_mode == "chunked" and sc.prefill_chunk_ms <= 0.0:
+            raise ValueError(
+                "prefill_mode='chunked' needs prefill_chunk_ms > 0 — a "
+                "zero-cost chunk would silently price as mono"
+            )
         engines = []
         chip_base = 0
         for i in range(sc.n_engines):
@@ -519,7 +556,11 @@ class Simulation:
                           occupancy_model=sc.decode_occupancy_model,
                           occupancy_floor=sc.occupancy_floor,
                           width=width, chip_ids=chips,
-                          spec_rates=spec_rates)
+                          spec_rates=spec_rates,
+                          prefill_mode=sc.prefill_mode,
+                          prefill_chunk_ms=sc.prefill_chunk_ms,
+                          prefill_chunks_per_turn=(
+                              sc.prefill_chunks_per_turn))
             )
         packer = SquishyBinPacker(
             self.profiles, hbm_budget_bytes=sc.hbm_budget_bytes
@@ -544,12 +585,21 @@ class Simulation:
             gray_policy=sc.gray_policy(),
         )
         for spec in sc.models:
+            # Chunk-interleaved turns are priced to the planner only
+            # when the scenario runs them (one quantum may ride each
+            # turn) — mono scenarios register byte-identically.
+            chunk_price = (
+                sc.prefill_chunk_ms * sc.prefill_chunks_per_turn
+                if sc.prefill_mode == "chunked" and spec.long_frac > 0.0
+                else 0.0
+            )
             sched.register_model(spec.name, slo_ms=spec.slo_ms,
                                  seq_len=spec.seq_len,
                                  mesh_shape=spec.mesh_shape,
                                  spec="on" if spec.spec else "off",
                                  spec_acceptance=spec.spec_acceptance,
-                                 spec_tokens=spec.spec_tokens)
+                                 spec_tokens=spec.spec_tokens,
+                                 prefill_chunk_ms=chunk_price)
 
         # Admission control at virtual time: the LIVE controller module
         # with the virtual clock injected (deterministic buckets), wired
@@ -591,6 +641,13 @@ class Simulation:
             spec.name: random.Random(sc.seed * 4099 + 17 * i)
             for i, spec in enumerate(sc.models)
         }
+        # Long-prompt tagging (ISSUE 15): its own per-model seeded
+        # stream, drawn ONLY for models with a long mix — canon
+        # scenarios consume no RNG state and stay byte-identical.
+        long_rngs = {
+            spec.name: random.Random(sc.seed * 6007 + 23 * i)
+            for i, spec in enumerate(sc.models)
+        }
 
         arrival_counts: Dict[str, int] = {}
         class_offered: Dict[str, Dict[str, int]] = {}
@@ -612,10 +669,16 @@ class Simulation:
             arrival_counts[model] = arrival_counts.get(model, 0) + 1
             per_cls = class_offered.setdefault(model, {})
             per_cls[qos] = per_cls.get(qos, 0) + 1
+            spec_m = specs[model]
+            pre_ms = 0.0
+            if (spec_m.long_frac > 0.0
+                    and long_rngs[model].random() < spec_m.long_frac):
+                pre_ms = spec_m.long_prefill_ms
             loop.schedule_at(
                 t_s * 1000.0,
-                lambda m=model, q=qos, t=specs[model].tenant: (
-                    sched.submit(m, qos_class=q, tenant=t)
+                lambda m=model, q=qos, t=specs[model].tenant,
+                pm=pre_ms: (
+                    sched.submit(m, qos_class=q, tenant=t, prefill_ms=pm)
                 ),
             )
 
@@ -706,6 +769,10 @@ class Simulation:
 
         horizon_ms = (sc.duration_s + sc.drain_s) * 1000.0
         events = loop.run_until(horizon_ms)
+        for e in engines:
+            # Chunk trains still in flight at the horizon shed as stale
+            # (the live drain's abort path) — conservation stays exact.
+            e.flush_prefill_backlog()
         elapsed_ms = clock.now_ms()
         # Kept for post-run consumers that need the raw (mergeable) hop
         # sketches rather than the report's rendered quantiles — the

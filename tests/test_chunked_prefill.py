@@ -1,0 +1,446 @@
+"""Token-budgeted chunked prefill interleaved with paged decode
+(ISSUE 15 tentpole acceptance; tier-1).
+
+Two contracts:
+
+- **Token exactness**: chunked-interleaved admission (the universal path
+  on paged engines; slab opt-in) emits BYTE-IDENTICAL tokens to the
+  monolithic-prefill arm — paged + slab, f32 + int8-KV, greedy + the
+  seeded sampled row, XLA fallback + CPU-interpreted Pallas kernel, and
+  the chunked+spec / chunked+mesh compositions. Pages-direct chunk k/v
+  (scatter through the slot's page table, no row cache, no commit copy)
+  is a pure layout/scheduling change.
+
+- **Stall bound**: with budget B, the engine's own step loop spends at
+  most B prefill tokens between decode turns — under a saturating
+  long-prompt burst, no active stream ever waits more than one chunk
+  program (the budget's worth) between its turns. The count-based
+  ``max_admissions_per_step`` rationing merely bounded how MANY
+  monolithic programs stalled each round; the budget bounds the stall
+  itself.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
+from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.engine.request import Request
+from ray_dynamic_batching_tpu.models import registry  # noqa: F401
+from ray_dynamic_batching_tpu.models.base import get_model
+from ray_dynamic_batching_tpu.ops.attention import set_attention_backend
+
+from tests.test_paged_decode import _workload
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model("llama_tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm_int8(lm):
+    model = get_model("llama_tiny_int8kv", dtype=jnp.float32)
+    return model, lm[1]
+
+
+def _run(model, params, *, paged, chunked, queue_reqs=None, **kw):
+    queue = RequestQueue(model.name, max_len=256)
+    defaults = dict(
+        num_slots=4, max_len=96, prompt_buckets=[8, 16, 32],
+        eos_token_id=None, default_max_new_tokens=8, decode_horizon=4,
+        paged=paged, page_size=128, chunked_prefill=chunked,
+    )
+    defaults.update(kw)
+    engine = DecodeEngine(model, params, queue, **defaults)
+    if queue_reqs is not None:
+        reqs = queue_reqs(queue, model.name)
+    else:
+        reqs = _workload(queue, model.name)
+    engine.run_until_idle(timeout_s=300)
+    tokens = [tuple(r.future.result(timeout=5).tokens) for r in reqs]
+    if paged:
+        engine._allocator.check()
+    return tokens, engine
+
+
+def _mixed_workload(queue, model_name, seed=3):
+    """Short bucketed + long (over-bucket, multi-chunk) prompts, greedy
+    plus one seeded sampled row — every admission shape in one pass."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i, plen in enumerate((5, 17, 70, 88, 30, 12)):
+        payload = {
+            "tokens": rng.integers(1, 500, plen).tolist(),
+            "max_new_tokens": int(rng.integers(4, 10)),
+        }
+        if i == 4:
+            payload.update(temperature=0.7, top_k=12, seed=99)
+        req = Request(model=model_name, payload=payload, slo_ms=60_000.0)
+        queue.add_request(req)
+        reqs.append(req)
+    return reqs
+
+
+class TestTokenExactness:
+    def test_paged_chunked_matches_paged_mono(self, lm):
+        """THE acceptance pin: chunked-interleaved admission on the
+        paged engine is byte-identical to the monolithic arm — short
+        bucketed prompts (single-chunk trains), long multi-chunk
+        trains, greedy and the seeded sampled row."""
+        model, params = lm
+        mono, _ = _run(model, params, paged=True, chunked=False,
+                       queue_reqs=_mixed_workload)
+        chunked, engine = _run(model, params, paged=True, chunked=True,
+                               queue_reqs=_mixed_workload)
+        assert chunked == mono
+        # Drained chunked engine returns every page (per-chunk grants
+        # all transferred to slots and freed at finish).
+        assert engine._allocator.free_pages == engine.num_pages
+
+    def test_slab_chunked_matches_slab_mono(self, lm):
+        model, params = lm
+        mono, _ = _run(model, params, paged=False, chunked=False,
+                       queue_reqs=_mixed_workload)
+        chunked, _ = _run(model, params, paged=False, chunked=True,
+                          queue_reqs=_mixed_workload)
+        assert chunked == mono
+
+    def test_all_four_arms_agree(self, lm):
+        """paged/slab x chunked/mono on the standard seeded workload:
+        one token stream, four layouts."""
+        model, params = lm
+        arms = {
+            (paged, chunked): _run(model, params, paged=paged,
+                                   chunked=chunked)[0]
+            for paged in (False, True)
+            for chunked in (False, True)
+        }
+        baseline = arms[(False, False)]
+        assert all(v == baseline for v in arms.values())
+
+    @pytest.mark.slow
+    def test_int8_kv_chunked_matches_mono(self, lm_int8):
+        """Quantized pool: chunk writes quantize per row at the pool
+        write exactly as the commit scatter did — codes and scale
+        planes land identically."""
+        model, params = lm_int8
+        mono, _ = _run(model, params, paged=True, chunked=False,
+                       queue_reqs=_mixed_workload)
+        chunked, _ = _run(model, params, paged=True, chunked=True,
+                          queue_reqs=_mixed_workload)
+        assert chunked == mono
+        s_mono, _ = _run(model, params, paged=False, chunked=False,
+                         queue_reqs=_mixed_workload)
+        s_chunked, _ = _run(model, params, paged=False, chunked=True,
+                            queue_reqs=_mixed_workload)
+        assert s_chunked == s_mono
+        assert s_mono == mono
+
+    @pytest.mark.slow
+    def test_pallas_interpret_kernel_arm(self, lm):
+        """Forced-Pallas backend (CPU interpret): decode turns ride the
+        page-table kernel while wide chunk windows decline to the
+        gather — the mixed-path stream still matches the XLA arm."""
+        model, params = lm
+        xla, _ = _run(model, params, paged=True, chunked=True,
+                      queue_reqs=_mixed_workload)
+        set_attention_backend("pallas")
+        try:
+            kernel, _ = _run(model, params, paged=True, chunked=True,
+                             queue_reqs=_mixed_workload)
+        finally:
+            set_attention_backend("auto")
+        assert kernel == xla
+
+    @pytest.mark.slow
+    def test_chunked_spec_composition(self, lm):
+        """chunked+spec: the draft replays the prompt through its own
+        chunk program after the target's final chunk; a self-draft
+        (acceptance 1.0) spec engine on the chunked path stays
+        byte-identical to plain chunked and to mono."""
+        model, params = lm
+        plain, _ = _run(model, params, paged=True, chunked=True)
+        spec, engine = _run(
+            model, params, paged=True, chunked=True,
+            draft_model=model, draft_params=params, spec_tokens=3,
+        )
+        assert spec == plain
+        mono, _ = _run(model, params, paged=True, chunked=False)
+        assert plain == mono
+
+    @pytest.mark.slow
+    def test_chunked_mesh_token_exact(self, lm, eight_devices):
+        """chunked+mesh: the chunk program's scatter and staircase
+        gather partition under GSPMD over the sharded pool — TP=2
+        chunked matches single-chip chunked AND TP=2 mono."""
+        from ray_dynamic_batching_tpu.parallel.mesh import (
+            MeshConfig,
+            build_mesh,
+        )
+
+        model, params = lm
+        single, _ = _run(model, params, paged=True, chunked=True)
+        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        tp_chunked, _ = _run(model, params, paged=True, chunked=True,
+                             mesh=mesh)
+        mesh2 = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        tp_mono, _ = _run(model, params, paged=True, chunked=False,
+                          mesh=mesh2)
+        assert tp_chunked == single
+        assert tp_chunked == tp_mono
+
+    @pytest.mark.slow
+    def test_session_continuation_chunked(self, lm):
+        """Paged chunked session continuation: the borrow floors to a
+        page boundary and the train recomputes the partial boundary
+        positions — turn-2 tokens match a fresh no-cache engine fed the
+        same concatenated history."""
+        model, params = lm
+
+        def turns(session_cache_size, chunked):
+            queue = RequestQueue(model.name, max_len=256)
+            engine = DecodeEngine(
+                model, params, queue, num_slots=2, max_len=160,
+                prompt_buckets=[16], eos_token_id=None,
+                default_max_new_tokens=6, decode_horizon=2,
+                paged=True, page_size=128, chunked_prefill=chunked,
+                session_cache_size=session_cache_size,
+            )
+            rng = np.random.default_rng(5)
+            t1 = rng.integers(1, 500, 40).tolist()
+            r1 = Request(model=model.name, payload={
+                "tokens": t1, "max_new_tokens": 6,
+                "session_id": "s1",
+            }, slo_ms=60_000.0)
+            queue.add_request(r1)
+            engine.run_until_idle(timeout_s=300)
+            out1 = r1.future.result(timeout=5).tokens
+            t2 = t1 + out1[:-1] + rng.integers(1, 500, 9).tolist()
+            r2 = Request(model=model.name, payload={
+                "tokens": t2, "max_new_tokens": 6,
+                "session_id": "s1",
+            }, slo_ms=60_000.0)
+            queue.add_request(r2)
+            engine.run_until_idle(timeout_s=300)
+            out2 = r2.future.result(timeout=5).tokens
+            return tuple(out1), tuple(out2), engine
+
+        o1_hit, o2_hit, engine = turns(4, chunked=True)
+        o1_cold, o2_cold, _ = turns(0, chunked=True)
+        o1_mono, o2_mono, _ = turns(4, chunked=False)
+        assert (o1_hit, o2_hit) == (o1_cold, o2_cold)
+        assert (o1_hit, o2_hit) == (o1_mono, o2_mono)
+        from ray_dynamic_batching_tpu.engine.decode import SESSION_HITS
+
+        assert SESSION_HITS.get(tags={"model": model.name}) >= 1
+
+    def test_prefix_cow_chunked(self, lm):
+        """Two long prompts sharing a >1-page head: the second train
+        borrows the published pages by reference (CoW) and still emits
+        the tokens a cold engine would."""
+        model, params = lm
+
+        def run(prefix_cache_size):
+            queue = RequestQueue(model.name, max_len=256)
+            engine = DecodeEngine(
+                model, params, queue, num_slots=2, max_len=224,
+                prompt_buckets=[16], eos_token_id=None,
+                default_max_new_tokens=5, decode_horizon=2,
+                paged=True, page_size=128, chunked_prefill=True,
+                prefix_cache_size=prefix_cache_size,
+            )
+            rng = np.random.default_rng(9)
+            head = rng.integers(1, 500, 130).tolist()  # > one page
+            outs = []
+            for tail_seed in (1, 2):
+                tail = np.random.default_rng(tail_seed).integers(
+                    1, 500, 7
+                ).tolist()
+                r = Request(model=model.name, payload={
+                    "tokens": head + tail, "max_new_tokens": 5,
+                }, slo_ms=60_000.0)
+                queue.add_request(r)
+                engine.run_until_idle(timeout_s=300)
+                outs.append(tuple(r.future.result(timeout=5).tokens))
+            return outs, engine
+
+        cold, _ = run(0)
+        warm, engine = run(4)
+        assert warm == cold
+        from ray_dynamic_batching_tpu.engine.decode import PREFIX_HITS
+
+        assert PREFIX_HITS.get(
+            tags={"model": model.name, "granularity": "page"}
+        ) >= 1
+
+
+class TestStallBound:
+    def test_budget_bounds_chunks_between_turns(self, lm):
+        """Under a saturating long-prompt burst with one long-lived
+        active stream, the interleave cadence log shows at most
+        ``prefill_token_budget`` chunk tokens between consecutive decode
+        turns — no serial prefill train, ever."""
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=6, max_len=96,
+            prompt_buckets=[8, 16], eos_token_id=None,
+            default_max_new_tokens=48, decode_horizon=4,
+            paged=True, page_size=128, chunked_prefill=True,
+        )
+        budget = engine.prefill_token_budget
+        rng = np.random.default_rng(2)
+        # One short request first: it registers and stays decoding
+        # through the whole burst (48 new tokens).
+        live = Request(model=model.name, payload={
+            "tokens": rng.integers(1, 500, 4).tolist(),
+            "max_new_tokens": 48,
+        }, slo_ms=60_000.0)
+        queue.add_request(live)
+        engine._admit()
+        engine._drain_prefill()
+        assert engine.active_slots == 1
+        engine.interleave_log.clear()
+        burst = []
+        for _ in range(4):
+            r = Request(model=model.name, payload={
+                "tokens": rng.integers(1, 500, 80).tolist(),  # 5 chunks
+                "max_new_tokens": 4,
+            }, slo_ms=60_000.0)
+            queue.add_request(r)
+            burst.append(r)
+        engine.run_until_idle(timeout_s=300)
+        for r in burst + [live]:
+            r.future.result(timeout=5)
+        log = list(engine.interleave_log)
+        assert any(kind == "chunk" for kind, _ in log)
+        # Between consecutive turns, chunk tokens never exceed the
+        # budget while a stream was active (the whole log here: the
+        # live stream outlasts the burst).
+        since_turn = 0
+        for kind, amount in log:
+            if kind == "turn":
+                since_turn = 0
+            else:
+                since_turn += amount
+                assert since_turn <= budget, log
+
+    def test_budget_clamps_to_chunk_width(self, lm):
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=96,
+            prompt_buckets=[8, 32], paged=True, chunked_prefill=True,
+            prefill_token_budget=4,  # below one chunk: clamped up
+        )
+        assert engine.prefill_token_budget == 32
+
+    def test_trains_force_single_step_turns(self, lm):
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=96,
+            prompt_buckets=[8], decode_horizon=8, paged=True,
+            chunked_prefill=True,
+        )
+        assert engine._pick_horizon() in (engine.ttft_horizon, 1)
+        engine._trains.append(object())  # sentinel: a pending train
+        try:
+            assert engine._pick_horizon() == 1
+        finally:
+            engine._trains.clear()
+
+    def test_paged_chunked_never_runs_monolithic_prefill(self, lm):
+        """First-token fusion: every admission flows through the chunk
+        program — the monolithic prefill programs are never compiled or
+        dispatched on the chunked paged path."""
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=4, max_len=96,
+            prompt_buckets=[8, 16], eos_token_id=None,
+            default_max_new_tokens=4, decode_horizon=2,
+            paged=True, chunked_prefill=True,
+        )
+
+        def boom(*a, **k):
+            raise AssertionError("monolithic prefill dispatched")
+
+        engine._prefill_fn = boom
+        reqs = _workload(queue, model.name, n=4)
+        engine.run_until_idle(timeout_s=300)
+        for r in reqs:
+            r.future.result(timeout=5)
+        assert engine.steps > 0
+
+
+class TestTrainLifecycle:
+    def test_page_starved_trains_park_then_drain(self, lm):
+        """An over-subscribed pool: trains park on grant failure (no
+        live stream is ever evicted for an admission) and drain as EOS
+        frees pages — conservation holds, nobody drops."""
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=4, max_len=192,
+            prompt_buckets=[16], eos_token_id=None,
+            default_max_new_tokens=4, decode_horizon=1,
+            paged=True, page_size=128, kv_pool_pages=3,
+            chunked_prefill=True,
+        )
+        rng = np.random.default_rng(4)
+        reqs = []
+        for _ in range(5):
+            r = Request(model=model.name, payload={
+                "tokens": rng.integers(1, 500, 10).tolist(),
+                "max_new_tokens": 4,
+            }, slo_ms=60_000.0)
+            queue.add_request(r)
+            reqs.append(r)
+        engine.run_until_idle(timeout_s=300)
+        for r in reqs:
+            assert r.future.result(timeout=5).tokens
+        engine._allocator.check()
+        assert engine._allocator.free_pages == engine.num_pages
+
+    def test_abort_rejects_pending_trains(self, lm):
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=96,
+            prompt_buckets=[8], paged=True, chunked_prefill=True,
+        )
+        r = Request(model=model.name, payload={
+            "tokens": [1, 2, 3], "max_new_tokens": 4,
+        }, slo_ms=60_000.0)
+        queue.add_request(r)
+        engine._admit()   # train parked, nothing dispatched yet
+        assert engine.busy
+        engine.abort_active(RuntimeError("shutdown"))
+        with pytest.raises(RuntimeError):
+            r.future.result(timeout=5)
+        assert not engine._trains
+        assert engine._allocator.free_pages == engine.num_pages
+
+    def test_snapshot_carries_prefill_block(self, lm):
+        model, params = lm
+        queue = RequestQueue(model.name, max_len=256)
+        engine = DecodeEngine(
+            model, params, queue, num_slots=2, max_len=96,
+            prompt_buckets=[8], paged=True,
+        )
+        snap = engine.snapshot()
+        assert snap["prefill"]["mode"] == "chunked"
+        assert snap["prefill"]["token_budget"] == \
+            engine.prefill_token_budget
+        assert snap["prefill"]["pending_trains"] == 0
+        slab = DecodeEngine(
+            model, params, RequestQueue(model.name, max_len=16),
+            num_slots=2, max_len=96, prompt_buckets=[8],
+        )
+        assert slab.snapshot()["prefill"]["mode"] == "mono"

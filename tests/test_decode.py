@@ -534,11 +534,16 @@ class TestSpeculativeDecode:
         # ...then a long admission forces plain interleave steps.
         r2 = submit(q, [(i * 7) % 50 + 1 for i in range(20)],
                     max_new_tokens=30)
-        rounds0 = SPEC_ROUNDS.get(tags={"model": model.name})
-        acc0 = SPEC_ACCEPTED.get(tags={"model": model.name})
+        # Stale-read fix (ISSUE 15 ride-along): PR 13 split these
+        # counters by a ``paged`` tag — the old model-only read keyed a
+        # series nothing ever increments, so this test silently graded
+        # zero rounds. Slab engine: paged="false".
+        tags = {"model": model.name, "paged": "false"}
+        rounds0 = SPEC_ROUNDS.get(tags=tags)
+        acc0 = SPEC_ACCEPTED.get(tags=tags)
         spec.run_until_idle(timeout_s=180)
-        rounds = SPEC_ROUNDS.get(tags={"model": model.name}) - rounds0
-        acc = SPEC_ACCEPTED.get(tags={"model": model.name}) - acc0
+        rounds = SPEC_ROUNDS.get(tags=tags) - rounds0
+        acc = SPEC_ACCEPTED.get(tags=tags) - acc0
         assert len(r1.future.result(timeout=5).tokens) == 30
         assert len(r2.future.result(timeout=5).tokens) == 30
         # Self-draft: every verified round must accept all 3 proposals

@@ -404,6 +404,23 @@ class TestEventLoopBlocking:
 # --- host-sync-in-hot-path ------------------------------------------------
 
 class TestHostSync:
+    def test_chunk_scheduler_functions_are_hot(self, tmp_path):
+        """ISSUE 15: the token-budget prefill scheduler's dispatch path
+        joined the configured hot set — a bare device fetch inside a
+        chunk dispatch is a finding without a reasoned pragma."""
+        from tools.lint.host_sync import HOT_FUNCTIONS
+
+        assert {"_pump_prefill", "_dispatch_chunk_group",
+                "_advance_train_slab", "_grant_train_pages"} <= \
+            HOT_FUNCTIONS["engine/decode.py"]
+        report = lint_fixture(tmp_path, "engine/decode.py", """
+            import numpy as np
+
+            def _dispatch_chunk_group(self, trains):
+                return np.asarray(trains[0].first)
+        """)
+        assert rules_found(report) == ["host-sync-in-hot-path"]
+
     def test_hot_path_marker_plus_asarray_flags(self, tmp_path):
         report = lint_fixture(tmp_path, "engine/eng.py", """
             import numpy as np

@@ -218,6 +218,8 @@ class TestPoolBehavior:
                 queue.add_request(r)
                 reqs.append(r)
             engine._admit()
+            if engine.chunked_prefill:
+                engine._drain_prefill()
             for _ in range(4):
                 engine._step(horizon=1)
             occ[paged] = engine.kv_occupancy()
@@ -243,6 +245,7 @@ class TestPoolBehavior:
         }, slo_ms=60_000.0)
         queue.add_request(r)
         engine._admit()
+        engine._drain_prefill()  # chunked-universal: grants land here
         assert engine._allocator.allocated_pages == 1
         while not engine._slots[0].free:
             engine._step(horizon=1)

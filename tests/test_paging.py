@@ -80,14 +80,23 @@ class TestPageAllocator:
         run (ownership transfer, no refcount motion — the no-copy
         commit) and freeing the rejected tail. Rounds stay in flight
         across arbitrary interleaved shares/evictions/finishes before
-        resolving."""
+        resolving.
+
+        ISSUE 15 extends the mix with PER-CHUNK PAGE GRANTS: a chunk
+        train's owner grows its run incrementally (one grant per chunk
+        dispatch, exactly ``DecodeEngine._grant_train_pages``) instead
+        of reserving everything at admission, and a starved train can
+        be requeued — releasing every granted page AND its borrowed
+        CoW head in one decref. Growth interleaves with every other op
+        class, so a grant can land between another owner's share and
+        its eviction."""
         rng = np.random.default_rng(0)
         a = PageAllocator(64)
         owners = {}   # owner id -> list of pages (one ref each)
         scratch = {}  # owner id -> in-flight spec round's scratch pages
         next_id = 0
         for _ in range(10_000):
-            op = rng.integers(0, 6)
+            op = rng.integers(0, 7)
             if op == 0:  # admit: allocate 1..8 pages for a new owner
                 n = int(rng.integers(1, 9))
                 try:
@@ -136,6 +145,17 @@ class TestPageAllocator:
                     commit_n = 0  # owner finished mid-round: full reject
                 if pids[commit_n:]:
                     a.decref(pids[commit_n:])  # rejected tail frees
+            elif op == 6 and owners:  # per-chunk grant: grow one owner
+                k = list(owners)[int(rng.integers(0, len(owners)))]
+                n = int(rng.integers(1, 4))
+                if a.can_alloc(n):
+                    owners[k].extend(a.alloc(n))  # the chunk's grant
+                elif rng.integers(0, 2):  # starved: maybe requeue —
+                    # the train releases grants AND borrowed head alike
+                    a.decref(owners.pop(k))
+                    pending = scratch.pop(k, None)
+                    if pending:
+                        a.decref(pending)
             a.check()
             # Shadow-model agreement: refcount == number of owner lists
             # (slots AND in-flight rounds) holding the page.

@@ -497,3 +497,109 @@ class TestRunSimCLI:
 
         assert main(["--model", "fast=200"]) == 2
         assert main(["--model", "malformed"]) == 2
+
+
+class TestInterleavePrefill:
+    """ISSUE 15: virtual-clock chunked-prefill interleave + packer
+    pricing of chunk-interleaved turns."""
+
+    def _run(self, chunked, seed=0):
+        from ray_dynamic_batching_tpu.sim.scenarios import (
+            interleave_profiles,
+            interleave_scenario,
+        )
+
+        return Simulation(
+            interleave_profiles(),
+            interleave_scenario(chunked=chunked, seed=seed),
+        ).run()
+
+    def test_arms_deterministic_and_chunked_wins(self):
+        a1, a2 = self._run(False), self._run(False)
+        b1, b2 = self._run(True), self._run(True)
+        assert render_json(a1) == render_json(a2)
+        assert render_json(b1) == render_json(b2)
+        ia_mono = a1["models"]["interactive"]
+        ia_chunk = b1["models"]["interactive"]
+        # The interleave's whole point: long-prompt head-of-line
+        # blocking leaves the interactive p50; volume does not drop.
+        assert ia_chunk["latency_p50_ms"] < ia_mono["latency_p50_ms"]
+        total = lambda r: sum(  # noqa: E731
+            m["completed"] for m in r["models"].values()
+        )
+        assert total(b1) >= total(a1)
+
+    def test_conservation_with_chunk_backlog(self):
+        for chunked in (False, True):
+            report = self._run(chunked)
+            for name, s in report["models"].items():
+                accounted = (s["completed"] + s["stale"] + s["dropped"]
+                             + s["pending"])
+                assert s["arrivals"] == accounted, (chunked, name)
+                assert s["dropped"] == 0
+
+    def test_long_draw_is_seeded_and_canon_free(self):
+        """Canon guard: scenarios without a long mix consume NO RNG
+        state from the long-draw stream and stay byte-identical to the
+        pre-interleave simulator."""
+        from ray_dynamic_batching_tpu.sim.scenarios import (
+            fixture_profiles,
+            smoke_scenario,
+        )
+
+        r1 = Simulation(fixture_profiles(), smoke_scenario(seed=0)).run()
+        assert round(r1["models"]["fast"]["slo_attainment"], 4) == 0.9559
+        assert round(r1["models"]["burst"]["slo_attainment"], 4) == 0.8463
+
+    def test_chunked_requires_chunk_cost(self):
+        import pytest
+
+        from ray_dynamic_batching_tpu.sim.scenarios import (
+            interleave_profiles,
+            interleave_scenario,
+        )
+
+        sc = interleave_scenario(chunked=True)
+        sc.prefill_chunk_ms = 0.0
+        with pytest.raises(ValueError, match="prefill_chunk_ms"):
+            Simulation(interleave_profiles(), sc).run()
+
+    def test_packer_prices_chunk_interleaved_turns(self):
+        """Session.prefill_chunk_ms = 0 is bit-identical to the
+        pre-chunked packer; > 0 adds exactly the quantum to the
+        effective step latency (the stall bound's planner-side price)."""
+        from ray_dynamic_batching_tpu.scheduler.nexus import Session
+        from ray_dynamic_batching_tpu.sim.scenarios import (
+            fixture_profiles,
+        )
+
+        profiles = fixture_profiles()
+        packer = SquishyBinPacker(profiles)
+        base = Session(model="fast", slo_ms=200.0, rate_rps=50.0)
+        priced = Session(model="fast", slo_ms=200.0, rate_rps=50.0,
+                         prefill_chunk_ms=3.0)
+        row = packer.saturate_row(base)
+        assert packer._session_wl(base, row) + 3.0 == \
+            packer._session_wl(priced, row)
+        plan_base = packer.residue_node(base)
+        plan_priced = packer.residue_node(priced)
+        assert plan_priced.placements[0].latency_ms == \
+            plan_base.placements[0].latency_ms + 3.0
+
+    def test_scenario_dict_roundtrip_with_prefill_knobs(self):
+        sc = Scenario.from_dict({
+            "models": [
+                {"name": "llm_long", "slo_ms": 4000, "rate_rps": 10,
+                 "long_frac": 0.5, "long_prefill_ms": 100.0},
+            ],
+            "prefill_mode": "chunked",
+            "prefill_chunk_ms": 12.5,
+            "prefill_chunks_per_turn": 2,
+        })
+        assert sc.prefill_mode == "chunked"
+        assert sc.prefill_chunk_ms == 12.5
+        assert sc.models[0].long_frac == 0.5
+        import pytest
+
+        with pytest.raises(ValueError, match="long_frac"):
+            SimModelSpec(name="x", slo_ms=100.0, long_frac=0.3)

@@ -298,6 +298,7 @@ class TestSpliceSemantics:
             }, slo_ms=60_000.0)
             queue.add_request(r)
             engine._admit()
+            engine._drain_prefill()  # chunked-universal: grants land here
             assert engine._allocator.allocated_pages == expect, (
                 spec, plen)
             engine.run_until_idle(timeout_s=120)
@@ -323,6 +324,7 @@ class TestSpliceSemantics:
         }, slo_ms=60_000.0)
         queue.add_request(r)
         engine._admit()
+        engine._drain_prefill()
         engine._len_host[0] = 126  # window crosses -> scratch needed
         allocated_before = engine._allocator.allocated_pages
 
@@ -364,6 +366,7 @@ class TestSpliceSemantics:
         }, slo_ms=60_000.0)
         queue.add_request(r)
         engine._admit()  # len 124: one page covers the first window
+        engine._drain_prefill()
         # Arm a round whose window crosses into page 2 -> 1 scratch page.
         engine._len_host[0] = 126
         assert engine._reserve_spec_scratch()

@@ -80,6 +80,13 @@ CPU_MEASURED = {
         "source": "estimate: bench_llm phases + paged-pool program "
                   "compiles (cache-warm after the bench_llm step)",
     },
+    "bench_llm_chunked": {
+        "seconds": 520,
+        "source": "estimate: bench_llm phases + chunk-program compiles "
+                  "(one per (bucket, group) shape, cache-warm after the "
+                  "mono-paged step) + the 30%-long-prompt mix's extra "
+                  "prefill tokens",
+    },
     "bench_llm_spec": {
         "seconds": 560,
         "source": "estimate: bench_llm phases + gpt2_draft init + the "
@@ -114,6 +121,7 @@ STEP_CAPS = {
     "first_light": wd.FIRST_LIGHT_TIMEOUT_S,
     "bench_llm": wd.BENCH_LLM_TIMEOUT_S,
     "bench_llm_paged": wd.BENCH_LLM_TIMEOUT_S,
+    "bench_llm_chunked": wd.BENCH_LLM_TIMEOUT_S,
     "bench_llm_spec": wd.BENCH_LLM_TIMEOUT_S,
     "bench_llm_tp": wd.BENCH_LLM_TIMEOUT_S,
     "bench": wd.BENCH_TIMEOUT_S,

@@ -30,6 +30,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_mesh_soak.py --sim
   echo "== speculative-decoding conformance (sim: acceptance-priced spec arm beats paged, collapse bounded, tools/spec_smoke.json) =="
   python tools/run_spec_soak.py --sim
+  echo "== chunked-prefill interleave conformance (sim: long-prompt flash crowd, TTFT ratchet, tools/interleave_smoke.json) =="
+  python tools/run_interleave_soak.py --sim
   echo "== overload conformance (sim: 5x saturation, QoS floors, tools/overload_smoke.json) =="
   python tools/run_overload_soak.py --sim
   echo "== control-plane conformance (sim: sharded front door, controller-kill failover, digest routing, tools/frontdoor_smoke.json) =="
@@ -78,6 +80,10 @@ python tools/run_mesh_soak.py --sim
 echo "== speculative-decoding conformance (sim three-arm + live paged+spec engines: exactness, conservation, collapse bounded) =="
 python tools/run_spec_soak.py --sim
 env JAX_PLATFORMS=cpu python tools/run_spec_soak.py --live
+
+echo "== chunked-prefill interleave conformance (sim flash crowd + live chunked-vs-mono exactness/stall bound) =="
+python tools/run_interleave_soak.py --sim
+env JAX_PLATFORMS=cpu python tools/run_interleave_soak.py --live
 
 echo "== overload conformance (sim 5x + live mixed-class soak, only 200s/429s) =="
 python tools/run_overload_soak.py --sim

@@ -36,6 +36,12 @@ from tools.lint.core import (
 HOT_FUNCTIONS: Dict[str, Set[str]] = {
     "engine/decode.py": {
         "_step", "_spec_step", "_harvest", "_interleave_step",
+        # ISSUE 15: the token-budget prefill scheduler runs between
+        # every decode turn — its chunk dispatches are steady-state
+        # serving latency exactly like the scan, with ONE designed
+        # fetch (the fused first-token ids) per chunk program.
+        "_pump_prefill", "_dispatch_chunk_group", "_advance_train_slab",
+        "_grant_train_pages",
     },
     "engine/worker.py": {"_run_placement"},
 }

@@ -319,7 +319,13 @@ def capture_bench_llm_paged() -> bool:
     without the pair."""
     return capture_bench(
         step_name="bench_llm_paged",
-        env_extra={"RDB_BENCH_SCOPE": "llm", "RDB_BENCH_PAGED": "1"},
+        # Pinned to the MONO admission arm (chunked became the paged
+        # default in ISSUE 15): this row stays comparable to the prior
+        # paged records AND serves as the baseline half of the
+        # bench_llm_chunked A/B pair captured in the same window.
+        env_extra={"RDB_BENCH_SCOPE": "llm", "RDB_BENCH_PAGED": "1",
+                   "RDB_BENCH_PREFILL": "mono",
+                   "RDB_BENCH_LONG_FRAC": "0.3"},
         timeout_s=BENCH_LLM_TIMEOUT_S, prefix="bench_llm_paged",
         expected_scope="llm",
     )
@@ -339,6 +345,25 @@ def capture_bench_llm_spec() -> bool:
         env_extra={"RDB_BENCH_SCOPE": "llm", "RDB_BENCH_PAGED": "1",
                    "RDB_BENCH_SPEC": "1"},
         timeout_s=BENCH_LLM_TIMEOUT_S, prefix="bench_llm_spec",
+        expected_scope="llm",
+    )
+
+
+def capture_bench_llm_chunked() -> bool:
+    """The chunked-prefill arm of the llm A/B (bench.py --paged on
+    --prefill chunked --long-frac 0.3): ISSUE 15's token-budget
+    admission over the paged pool under a 30% long-prompt mix,
+    measured against the same window's mono-paged record
+    (bench_llm_paged runs --prefill mono below so the pair shares one
+    window) — the TTFT-p50 delta between the two rows IS the
+    interleave's on-chip win, against the 197 ms round-3 record the
+    ROADMAP's <150 ms target is ratcheted on."""
+    return capture_bench(
+        step_name="bench_llm_chunked",
+        env_extra={"RDB_BENCH_SCOPE": "llm", "RDB_BENCH_PAGED": "1",
+                   "RDB_BENCH_PREFILL": "chunked",
+                   "RDB_BENCH_LONG_FRAC": "0.3"},
+        timeout_s=BENCH_LLM_TIMEOUT_S, prefix="bench_llm_chunked",
         expected_scope="llm",
     )
 
@@ -552,6 +577,7 @@ STEPS = [
     ("first_light", capture_first_light),
     ("bench_llm", capture_bench_llm),
     ("bench_llm_paged", capture_bench_llm_paged),
+    ("bench_llm_chunked", capture_bench_llm_chunked),
     ("bench_llm_spec", capture_bench_llm_spec),
     ("bench_llm_tp", capture_bench_llm_tp),
     ("bench", capture_bench),
