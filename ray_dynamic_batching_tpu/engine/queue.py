@@ -277,6 +277,11 @@ class RequestQueue:
         # percentile surface as the deprecated RollingWindow(1000).
         self.latency_window = RollingSketch(1000)
         self.queue_delay_window = RollingSketch(1000)
+        # Service-time slice of the same completions (total minus queue
+        # delay): the live "engine.step" hop the SLO observatory grades
+        # against the cost model's profile-row prediction — same hop
+        # name, same sketch type as the sim's virtual-event ledger.
+        self.service_window = RollingSketch(1000)
         self._recent_outcomes = []
         self.total_enqueued = 0
         self.total_dropped = 0
@@ -523,7 +528,9 @@ class RequestQueue:
                 ok = total_ms <= req.slo_ms
                 violations += 0 if ok else 1
                 self.latency_window.observe(total_ms)
-                self.queue_delay_window.observe(req.queue_delay_ms(t))
+                delay_ms = req.queue_delay_ms(t)
+                self.queue_delay_window.observe(delay_ms)
+                self.service_window.observe(max(0.0, total_ms - delay_ms))
                 self._recent_outcomes.append(ok)
                 c = self._cls(req.qos_class)
                 c["completed"] += 1

@@ -63,6 +63,57 @@ class RateTracker:
                 return 0.0
             return min(self.window_s, sec - self._buckets[0][0] + 1)
 
+    def count_between(self, t0_s: float, t1_s: float) -> Optional[int]:
+        """Arrivals recorded in ``[t0_s, t1_s)``, or None when the
+        sliding window has already rotated past ``t0_s`` — the truth is
+        gone, and a partial count would read as a real (low) rate.
+        Forecast scoring uses this to grade a prediction against what
+        ACTUALLY arrived over its horizon."""
+        sec = int(self._clock())
+        lo, hi = int(t0_s), int(t1_s)
+        with self._lock:
+            self._prune(sec)
+            if lo <= sec - int(self.window_s):
+                return None
+            return sum(c for s, c in self._buckets if lo <= s < hi)
+
+    def forecast_rps(self, horizon_s: float, alpha: float = 0.5,
+                     beta: float = 0.2,
+                     min_span_s: float = 0.0) -> Optional[float]:
+        """Short-horizon arrival forecast (requests/sec ``horizon_s``
+        from now) via Holt's linear method — EWMA level + trend over the
+        per-second buckets. Pure arithmetic over data already held: no
+        randomness, no state kept between calls, jax-free.
+
+        REFUSES (returns None) rather than extrapolating when the
+        evidence is thin: an empty window, a covered span below
+        ``min_span_s``, or fewer than two CLOSED seconds of history.
+        The current partial second is always excluded — it under-reads
+        by construction (the cold-window foot-gun ``rate_rps`` guards
+        with its span floor)."""
+        now_sec = int(self._clock())
+        with self._lock:
+            self._prune(now_sec)
+            if not self._buckets:
+                return None
+            span = min(self.window_s, now_sec - self._buckets[0][0] + 1)
+            if span < min_span_s:
+                return None
+            counts = dict(self._buckets)
+            first = self._buckets[0][0]
+        last_closed = now_sec - 1
+        if last_closed - first < 1:
+            return None
+        # Contiguous per-second series, gaps are genuine zeros.
+        series = [float(counts.get(s, 0))
+                  for s in range(first, last_closed + 1)]
+        level, trend = series[0], 0.0
+        for x in series[1:]:
+            prev = level
+            level = alpha * x + (1.0 - alpha) * (level + trend)
+            trend = beta * (level - prev) + (1.0 - beta) * trend
+        return max(0.0, level + trend * float(horizon_s))
+
 
 class RateRegistry:
     """Per-model trackers + significant-change detection for the control loop
@@ -129,6 +180,20 @@ class RateRegistry:
             if delta > threshold or -delta > threshold * decrease_multiplier:
                 out[model] = rate
         return out
+
+    def forecasts(self, horizon_s: float, alpha: float = 0.5,
+                  beta: float = 0.2,
+                  min_span_s: float = 0.0) -> Dict[str, Optional[float]]:
+        """Per-model ``forecast_rps``; a refusing tracker stays in the
+        map as None so consumers can COUNT refusals instead of silently
+        seeing fewer models (the observatory's never-silent rule)."""
+        with self._lock:
+            items = list(self._trackers.items())
+        return {
+            model: t.forecast_rps(horizon_s, alpha=alpha, beta=beta,
+                                  min_span_s=min_span_s)
+            for model, t in items
+        }
 
     def mark_scheduled(self, rates: Optional[Dict[str, float]] = None) -> None:
         self._last_scheduled.update(rates if rates is not None else self.rates())

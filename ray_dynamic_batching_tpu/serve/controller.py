@@ -42,6 +42,7 @@ from ray_dynamic_batching_tpu.parallel.placement import (
     PlacementError,
     PlacementManager,
 )
+from ray_dynamic_batching_tpu.engine.rates import RateRegistry
 from ray_dynamic_batching_tpu.runtime.kv import KVStore
 from ray_dynamic_batching_tpu.scheduler.audit import AuditLog
 from ray_dynamic_batching_tpu.serve.admission import (
@@ -58,6 +59,7 @@ from ray_dynamic_batching_tpu.serve.fabric import (
     default_fabric,
 )
 from ray_dynamic_batching_tpu.serve.long_poll import LongPollHost
+from ray_dynamic_batching_tpu.serve.observatory import SLOObservatory
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.serve.router import Router
 from ray_dynamic_batching_tpu.serve.store import (
@@ -68,6 +70,7 @@ from ray_dynamic_batching_tpu.serve.store import (
     StaleEpochError,
 )
 from ray_dynamic_batching_tpu.utils.logging import get_logger
+from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
 
 logger = get_logger("controller")
 
@@ -237,6 +240,18 @@ class ServeController:
         # transitions land in the SAME audit ring as heals and replans.
         self.admission = AdmissionController()
         self.admission.audit = self.audit
+        # SLO observatory (serve/observatory.py — the SAME classes the
+        # sim ticks on its virtual clock): burn-rate alerts graded from
+        # the replicas' per-class queue counters, arrival forecasts
+        # scored against the demand the control loop itself aggregates,
+        # and sim-fidelity drift replayed every few steps. Demand is
+        # observed as per-step enqueued-counter DELTAS — no hot-path
+        # instrumentation; integer-second rate buckets make control-
+        # tick granularity exact.
+        self.rates = RateRegistry()
+        self.observatory = SLOObservatory("serve")
+        self.observatory.audit = self.audit
+        self._observed_enqueued: Dict[str, float] = {}
 
     # --- deploy API (ref serve.run / deploy) ------------------------------
     def register_factory(
@@ -823,6 +838,43 @@ class ServeController:
                 continue
         self.admission.observe(state.config.name, depth_frac, compliance)
 
+    def _observe_slo(
+        self, state: "_DeploymentState"
+    ) -> Tuple[Dict[str, Dict[str, float]], Dict[str, Any]]:
+        """One deployment's observatory inputs for this step: the
+        replicas' per-class queue counters summed (the SAME cumulative
+        ``class_stats()`` slices the sim grades burn from), plus the
+        merged per-hop latency sketches (queue.wait from the delay
+        windows, engine.step from the service windows). Demand is
+        derived here too — the enqueued-counter delta since the last
+        step feeds the rate registry and the fidelity replay ring, so
+        the hot path carries zero new instrumentation."""
+        name = state.config.name
+        counters: Dict[str, Dict[str, float]] = {}
+        delay_views = []
+        service_views = []
+        for r in state.replicas:
+            try:
+                for qos, c in r.queue.class_stats().items():
+                    agg = counters.setdefault(qos, {})
+                    for k, v in c.items():
+                        agg[k] = agg.get(k, 0.0) + v
+                delay_views.append(r.queue.queue_delay_window.view())
+                service_views.append(r.queue.service_window.view())
+            except Exception:  # noqa: BLE001 — stats must not stop control
+                continue
+        enqueued = sum(c.get("enqueued", 0.0) for c in counters.values())
+        delta = enqueued - self._observed_enqueued.get(name, 0.0)
+        self._observed_enqueued[name] = enqueued
+        if delta > 0:
+            self.rates.record(name, int(delta))
+            self.observatory.note_arrivals(name, int(delta))
+        hops = {
+            "queue.wait": QuantileSketch.merged(delay_views),
+            "engine.step": QuantileSketch.merged(service_views),
+        }
+        return counters, hops
+
     def _publish_prefix_digests(self, state: "_DeploymentState") -> None:
         """Collect each replica's bounded prefix-page digest chains and
         push them to the router's digest directory (+ the long-poll
@@ -925,9 +977,18 @@ class ServeController:
         deferred: List[Callable[[], None]] = []
         try:
             with self._lock:
+                slo_counters: Dict[str, Dict[str, Dict[str, float]]] = {}
+                slo_hops: Dict[str, Dict[str, Any]] = {}
                 for state in list(self._deployments.values()):
                     self._observe_gray(state)
                     self._observe_admission(state)
+                    try:
+                        counters, hops = self._observe_slo(state)
+                        if counters:
+                            slo_counters[state.config.name] = counters
+                        slo_hops[state.config.name] = hops
+                    except Exception:  # noqa: BLE001 — stats must not
+                        pass           # stop control
                     self._publish_prefix_digests(state)
                     if state.policy is not None:
                         metrics = state.router.demand_metrics()
@@ -981,6 +1042,15 @@ class ServeController:
                         logger.exception(
                             "%s: reconcile failed", state.config.name
                         )
+                try:
+                    # One observatory tick per control step — the same
+                    # cumulative counters + hop sketches the sim twin
+                    # feeds its instance of the SAME classes.
+                    self.observatory.tick(slo_counters, self.rates,
+                                          slo_hops)
+                except Exception:  # noqa: BLE001 — observability must
+                    # not stop control
+                    logger.exception("observatory tick failed")
                 self._checkpoint()
         except StaleEpochError as e:
             self._on_fenced(e)  # falls through: deferred still runs
@@ -1215,6 +1285,11 @@ class ServeController:
                     # Admission governor state (serve/admission.py):
                     # normal vs degraded + whether a policy is installed.
                     "admission": self.admission.snapshot(name),
+                    # SLO observatory (serve/observatory.py): burn-rate
+                    # alert states/transitions filtered to this
+                    # deployment, plus forecast-error and fidelity-drift
+                    # instruments (per-model — shared across the app).
+                    "observatory": self.observatory.snapshot(key=name),
                     # Per-version replica counts: mid-rollout both the old
                     # and the new version appear here (ref deployment_state
                     # rollout status).

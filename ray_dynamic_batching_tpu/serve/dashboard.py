@@ -88,6 +88,48 @@ async function tick() {
       }
       html += '</table>';
     }
+    const obs = s.observatory ?? {};
+    const alerts = Object.entries(obs.alerts?.states ?? {});
+    if (alerts.length) {
+      // SLO observatory: burn-rate alert machine per (deployment/qos).
+      html += '<h2>SLO observatory (burn-rate alerts)</h2>'
+            + '<table><tr><th>deployment/qos</th><th>state</th>'
+            + '<th>fast burn</th><th>slow burn</th></tr>';
+      for (const [key, a] of alerts) {
+        const st = a.state === 'ok' ? 'ok'
+                 : a.state === 'page' ? 'CRITICAL' : 'warning';
+        html += `<tr><td>${esc(key)}</td>`
+              + `<td class="${st}">${esc(a.state)}</td>`
+              + `<td>${a.fast_burn == null ? '—' : a.fast_burn.toFixed(2)}</td>`
+              + `<td>${a.slow_burn == null ? '—' : a.slow_burn.toFixed(2)}</td>`
+              + `</tr>`;
+      }
+      html += '</table>';
+    }
+    const fc = Object.entries(obs.forecast ?? {});
+    if (fc.length) {
+      html += '<h2>arrival forecast error</h2><table><tr><th>model</th>'
+            + '<th>scored</th><th>refused</th><th>p50 |err| rps</th>'
+            + '<th>p95 |err| rps</th></tr>';
+      for (const [name, f] of fc)
+        html += `<tr><td>${esc(name)}</td><td>${f.scored}</td>`
+              + `<td>${f.refused}</td>`
+              + `<td>${f.p50_abs_err_rps == null ? '—' : f.p50_abs_err_rps.toFixed(2)}</td>`
+              + `<td>${f.p95_abs_err_rps == null ? '—' : f.p95_abs_err_rps.toFixed(2)}</td></tr>`;
+      html += '</table>';
+    }
+    const fid = Object.entries(obs.fidelity?.last?.models ?? {});
+    if (fid.length) {
+      html += '<h2>sim-fidelity drift</h2><table><tr><th>model</th>'
+            + '<th>drifting hops</th><th>ungraded</th></tr>';
+      for (const [name, r] of fid) {
+        const bad = (r.drifting_hops ?? []).join(', ');
+        html += `<tr><td>${esc(name)}</td>`
+              + `<td class="${bad ? 'CRITICAL' : 'ok'}">${esc(bad || 'none')}</td>`
+              + `<td>${esc(Object.keys(r.ungraded ?? {}).join(', '))}</td></tr>`;
+      }
+      html += '</table>';
+    }
     const audit = s.audit ?? [];
     if (audit.length) {
       // Replan timeline: one marker per decision, positioned by wall time

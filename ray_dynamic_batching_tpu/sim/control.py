@@ -41,6 +41,10 @@ from ray_dynamic_batching_tpu.serve.grayhealth import (
     GrayHealthPolicy,
     ratio_observations,
 )
+from ray_dynamic_batching_tpu.serve.observatory import (
+    ObservatoryPolicy,
+    SLOObservatory,
+)
 from ray_dynamic_batching_tpu.sim.clock import EventLoop, VirtualClock
 from ray_dynamic_batching_tpu.sim.engine import SimEngine
 from ray_dynamic_batching_tpu.sim.queue import (
@@ -65,6 +69,7 @@ class SimScheduler:
         rate_window_s: float = 10.0,
         rate_min_span_s: float = 0.0,
         gray_policy: Optional[GrayHealthPolicy] = None,
+        observatory_policy: Optional[ObservatoryPolicy] = None,
     ) -> None:
         self.packer = packer
         self.engines = list(engines)
@@ -115,6 +120,19 @@ class SimScheduler:
         # (model, qos_class) -> rejected-at-admission count (the third
         # accounting category: offered = rejected + enqueued outcomes).
         self.admission_rejected: Dict[Tuple[str, str], int] = {}
+        # SLO observatory (serve/observatory.py — the SAME classes the
+        # live controller ticks, on the virtual clock). None = disabled:
+        # canon scenarios stay byte-identical. The fidelity price fn
+        # reads the CURRENT plan's profile rows — the planner's belief,
+        # jitter- and degradation-free — so a seeded mispricing drifts
+        # engine.step and only engine.step.
+        self.observatory: Optional[SLOObservatory] = None
+        if observatory_policy is not None:
+            self.observatory = SLOObservatory(
+                "sim", policy=observatory_policy, clock=clock.now_s,
+                price=self._fidelity_price,
+            )
+            self.observatory.audit = self.audit
 
     # --- registration (live register_model contract) ----------------------
     def register_model(self, name: str, slo_ms: float,
@@ -151,6 +169,8 @@ class SimScheduler:
                 )
                 return False
         self.rates.record(model)
+        if self.observatory is not None:
+            self.observatory.note_arrivals(model)
         return self.queues.queue(model).add_request(
             SimRequest(
                 model=model,
@@ -356,6 +376,33 @@ class SimScheduler:
         self.rebalance(trigger="gray")
         return True
 
+    def _fidelity_price(self, model: str) -> Optional[Dict[str, float]]:
+        """The cost model's BELIEF about one request's engine.step cost:
+        the profile row for the model's placement in the CURRENT plan —
+        jitter-free, degradation-blind (that blindness is the signal the
+        fidelity monitor exists to measure). Prices ONLY engine.step:
+        queue.wait is emergent from load, not priced by the profile
+        tables, so it must land in ``ungraded`` — a mispriced engine
+        can never defame the queue, and vice versa. None when the model
+        is not placed (unpriced, counted — never silently graded)."""
+        for node_plan in self._current_plan:
+            for p in node_plan.placements:
+                if p.session.model != model:
+                    continue
+                prof = self.packer.profiles.get(model)
+                row = None
+                if prof is not None:
+                    row = (prof.row_for(p.batch_size, p.session.seq_len,
+                                        p.session.mesh_shape,
+                                        p.session.spec)
+                           or prof.bucket_for(p.batch_size,
+                                              p.session.seq_len,
+                                              p.session.mesh_shape,
+                                              p.session.spec))
+                ms = p.latency_ms if row is None else row.latency_ms
+                return {"engine.step": float(ms)}
+        return None
+
     def _on_monitor(self) -> None:
         # Horizon check at FIRE time, not re-arm time: a tick armed just
         # before duration_s would otherwise land in the drain phase and
@@ -379,6 +426,17 @@ class SimScheduler:
         )
         if changed and not healed and not grayed:  # those already replanned
             self.rebalance(trigger="rate_change")
+        if self.observatory is not None:
+            # One observatory tick per monitor tick — cumulative class
+            # counters + the live hop sketches, same signals the serve
+            # controller feeds it (shared classes, shared diet).
+            self.observatory.tick(
+                {name: q.class_stats()
+                 for name, q in self.queues.queues().items()},
+                self.rates,
+                {name: dict(q.hop_sketches)
+                 for name, q in self.queues.queues().items()},
+            )
         self.loop.schedule_in(
             max(self.monitoring_interval_s * 1000.0, 1.0),
             self._on_monitor,

@@ -151,6 +151,48 @@ def format_gray_timeline(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def alert_timeline(report: Dict[str, Any]) -> Dict[str, list]:
+    """Per-(deployment/qos) burn-alert timeline from one report:
+    ``"model/qos" -> ordered [{at, from, to, fast_burn, slow_burn},
+    ...]``. Empty when the scenario ran without the observatory. The
+    observatory soak reads this to pin the overload arm's transition
+    sequence (``ok -> warning -> page -> resolved``) — the alert
+    analogue of :func:`gray_timeline`."""
+    obs = report.get("observatory") or {}
+    alerts = obs.get("alerts") or {}
+    out: Dict[str, list] = {}
+    for t in alerts.get("timeline", []):
+        out.setdefault(f"{t['key']}/{t['qos']}", []).append(
+            {k: t[k] for k in ("at", "from", "to", "fast_burn",
+                               "slow_burn") if k in t}
+        )
+    return out
+
+
+def format_alert_timeline(report: Dict[str, Any]) -> str:
+    """Terminal block for the burn-alert timeline."""
+    timeline = alert_timeline(report)
+    if not timeline:
+        return "alerts: observatory disabled or no transitions"
+    lines = [f"{'deployment/qos':<20} {'t(s)':>8}  transition"]
+    for key in sorted(timeline):
+        for t in timeline[key]:
+            lines.append(
+                f"{key:<20} {t['at']:>8.2f}  {t['from']} -> {t['to']}"
+                f"  (fast={t.get('fast_burn')} slow={t.get('slow_burn')})"
+            )
+    final = ((report.get("observatory") or {}).get("alerts") or {}).get(
+        "final_states", {}
+    )
+    if final:
+        lines.append("final: " + ", ".join(
+            f"{key}/{qos}={st}"
+            for key, per_qos in sorted(final.items())
+            for qos, st in sorted(per_qos.items())
+        ))
+    return "\n".join(lines)
+
+
 def format_partition_story(report: Dict[str, Any]) -> str:
     """Terminal block for one partition-sim arm (sim/frontdoor.
     run_partition_sim): the leadership story, the replay cost, the

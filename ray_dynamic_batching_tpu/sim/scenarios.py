@@ -644,3 +644,122 @@ def correlated_failure_scenario(seed: int = 0) -> Scenario:
             EngineFailure(at_s=10.4, engine=1),
         ],
     )
+
+
+# --- SLO observatory (ISSUE 16) ---------------------------------------------
+
+# Shared observatory knobs for the soak fixtures: SRE window lengths
+# shrunk onto a sub-minute virtual horizon (fast 10 s / slow 30 s at
+# 2 s epochs) so a 45 s scenario exercises the WHOLE alert lifecycle —
+# fire, page, age out, resolve — and drift replays land every other
+# monitor tick. page_burn stays multi-window: both horizons must burn.
+OBSERVATORY_SOAK_POLICY = {
+    "slo_target": 0.99,
+    "fast_window_s": 10.0,
+    "slow_window_s": 30.0,
+    "epochs_per_window": 5,
+    "warn_burn": 2.0,
+    "page_burn": 10.0,
+    "min_accounted": 20,
+    "warn_after": 1,
+    "page_after": 1,
+    "resolve_after": 2,
+    "resolved_hold_ticks": 3,
+    "forecast_horizon_s": 5.0,
+    "forecast_min_span_s": 3.0,
+    "replay_every_ticks": 2,
+    "drift_tolerance": 0.5,
+    "drift_min_count": 5,
+    "drift_min_abs_ms": 1.0,
+}
+
+
+def observatory_overload_scenario(seed: int = 0) -> Scenario:
+    """The observatory soak's BURN arm (``tools/run_observatory_soak.py
+    --sim``): ``burst`` spikes 30 -> 430 rps for 8 s — roughly double
+    the ~230 rps two-chip SLO capacity — then subsides to a base load
+    the pair serves trivially (no residual shed trickle to re-trip the
+    alert after it clears). Expected story: the spike's sheds and
+    violations torch the 1% error budget (fast AND slow burn past
+    ``page_burn``), the alert machine walks ``ok -> warning -> page``;
+    after the spike both windows rotate the incident out and the clear
+    streak lands ``page -> resolved`` (then ``-> ok`` once the resolved
+    hold expires). The gate pins that exact sequence, twice,
+    byte-identically."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="burst", slo_ms=2000.0,
+                pattern=RatePattern(
+                    "spike", base_rps=30.0, amplitude=400.0,
+                    spike_at_s=10.0, spike_len_s=8.0,
+                ),
+                class_mix={"interactive": 0.2, "best_effort": 0.8},
+            ),
+        ],
+        duration_s=50.0,
+        drain_s=5.0,
+        n_engines=2,
+        seed=seed,
+        max_queue_len=256,
+        monitoring_interval_s=1.0,
+        observatory=dict(OBSERVATORY_SOAK_POLICY),
+    )
+
+
+def observatory_mispricing_scenario(seed: int = 0) -> Scenario:
+    """The observatory soak's GUILTY-HOP arm: light steady traffic with
+    a generous SLO (no burn alerts — this arm isolates the fidelity
+    instrument), but the one chip runs 3x SLOW from t=1 s and never
+    heals, with NO gray detection armed to catch it. The cost model
+    keeps pricing ``engine.step`` from the profile row, so live runs
+    ~3x its prediction — drift ~0.67 against the 0.5 tolerance. The
+    gate asserts the ``fidelity_drift`` audit record names
+    ``engine.step`` and does NOT name ``queue.wait`` (unpriced by
+    contract: the profile tables never claimed to know queueing, so a
+    mispriced engine cannot defame the queue)."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="fast", slo_ms=4000.0,
+                pattern=RatePattern("constant", base_rps=40.0),
+            ),
+        ],
+        duration_s=40.0,
+        drain_s=5.0,
+        n_engines=1,
+        seed=seed,
+        monitoring_interval_s=1.0,
+        degradations=[
+            EngineDegradation(at_s=1.0, engine=0, factor=3.0),
+        ],
+        observatory=dict(OBSERVATORY_SOAK_POLICY),
+    )
+
+
+def observatory_steady_scenario(seed: int = 0) -> Scenario:
+    """The observatory soak's SILENCE arm: comfortably-provisioned
+    steady traffic, nothing injected. Expected story: ZERO alert
+    transitions, zero fidelity-drift records (``engine.step`` graded
+    clean, ``queue.wait`` ungraded by contract), and a working
+    forecaster — predictions scored every horizon with small error.
+    An observatory that pages on a healthy cluster is worse than none;
+    this arm is the false-positive gate."""
+    return Scenario(
+        models=[
+            SimModelSpec(
+                name="fast", slo_ms=2000.0,
+                pattern=RatePattern("constant", base_rps=50.0),
+            ),
+            SimModelSpec(
+                name="fat", slo_ms=4000.0,
+                pattern=RatePattern("constant", base_rps=6.0),
+            ),
+        ],
+        duration_s=40.0,
+        drain_s=5.0,
+        n_engines=3,
+        seed=seed,
+        monitoring_interval_s=1.0,
+        observatory=dict(OBSERVATORY_SOAK_POLICY),
+    )

@@ -360,6 +360,27 @@ class Scenario:
     # (serve/admission.AdmissionPolicy knobs; None = admit everything).
     # The LIVE AdmissionController runs here on the virtual clock.
     admission: Optional[Dict[str, Any]] = None
+    # SLO observatory knobs (serve/observatory.ObservatoryPolicy fields).
+    # None = disabled: canon scenarios stay byte-identical. The LIVE
+    # observatory classes run here on the virtual clock.
+    observatory: Optional[Dict[str, Any]] = None
+
+    def observatory_policy(self):
+        from ray_dynamic_batching_tpu.serve.observatory import (
+            ObservatoryPolicy,
+        )
+        import dataclasses as _dc
+
+        if self.observatory is None:
+            return None
+        known = {f.name for f in _dc.fields(ObservatoryPolicy)}
+        unknown = set(self.observatory) - known
+        if unknown:
+            raise ValueError(
+                f"unknown observatory key(s) {sorted(unknown)}; known: "
+                f"{sorted(known)}"
+            )
+        return ObservatoryPolicy(**self.observatory)
     arrivals: Optional[List[Arrival]] = field(default=None, repr=False)
 
     def admission_policy(self) -> Optional[AdmissionPolicy]:
@@ -446,6 +467,7 @@ class Scenario:
             ],
             gray=d.get("gray"),
             admission=d.get("admission"),
+            observatory=d.get("observatory"),
         )
 
 
@@ -583,6 +605,7 @@ class Simulation:
             rate_window_s=sc.rate_window_s,
             rate_min_span_s=sc.rate_min_span_s,
             gray_policy=sc.gray_policy(),
+            observatory_policy=sc.observatory_policy(),
         )
         for spec in sc.models:
             # Chunk-interleaved turns are priced to the planner only
@@ -926,6 +949,20 @@ class Simulation:
                     },
                 }
             ),
+            # SLO observatory block (conditional: pre-observatory
+            # scenarios stay byte-identical). Alert timelines join
+            # gray_timeline as first-class scenario output via
+            # sim/report.alert_timeline.
+            **({"observatory": {
+                **sched.observatory.snapshot(),
+                "alerts": {
+                    "timeline": [
+                        dict(t)
+                        for t in sched.observatory.burn.transitions
+                    ],
+                    "final_states": sched.observatory.burn.states(),
+                },
+            }} if sched.observatory is not None else {}),
             "models": models,
             "chips": chips,
             "chips_used": sum(1 for e in engines if e.batches > 0),

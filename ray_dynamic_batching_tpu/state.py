@@ -104,6 +104,16 @@ class StateAPI:
         out.sort(key=lambda r: r.get("wall_time", 0.0))
         return out[-last:]
 
+    def alerts(self) -> Dict[str, Any]:
+        """Cluster-wide SLO observatory rollup (serve/observatory.py):
+        every (deployment/qos) burn-alert state plus the per-model
+        forecast-error and fidelity-drift instruments. Empty when the
+        controller predates the observatory (or none is attached)."""
+        obs = getattr(self.controller, "observatory", None)
+        if obs is None:
+            return {}
+        return obs.snapshot()
+
     def summary(self) -> Dict[str, Any]:
         good, warn = slo_thresholds()
         return {
@@ -115,6 +125,7 @@ class StateAPI:
             "resources": self.resources(),
             "audit": self.list_audit(),
             "slo_thresholds": {"good": good, "warn": warn},
+            "observatory": self.alerts(),
         }
 
 

@@ -44,9 +44,12 @@ class TestStateAPI:
         summary = api.summary()
         assert set(summary) == {
             "deployments", "replicas", "queues", "scheduler", "jobs",
-            "resources", "audit", "slo_thresholds",
+            "resources", "audit", "slo_thresholds", "observatory",
         }
         assert summary["slo_thresholds"] == {"good": 0.98, "warn": 0.95}
+        # The observatory block is present even before any burn: alert
+        # states (all ok) + forecast/fidelity snapshots per deployment.
+        assert "alerts" in summary["observatory"]
         # The controller's decision ring surfaces: deploying 2 replicas
         # recorded at least a deploy + a scale event for this deployment.
         triggers = {a["trigger"] for a in summary["audit"]}

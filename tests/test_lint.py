@@ -1467,3 +1467,41 @@ class TestSimDeterminismCoversFabric:
 
         report = run(paths=[DEFAULT_TARGET], rules={"sim-determinism"})
         assert report.new == [], [f.format() for f in report.new]
+
+
+class TestSimDeterminismCoversObservatory:
+    """ISSUE 16: the observatory's instruments run verbatim inside
+    SimScheduler at virtual time, so serve/observatory.py carries the
+    same no-wall-clock contract as sim/ and serve/fabric.py."""
+
+    def test_wall_clock_in_observatory_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/observatory.py", """
+            import time
+
+            class BurnWindow:
+                def observe(self, misses, accounted):
+                    self._snaps.append((time.monotonic(), misses, accounted))
+        """, rules={"sim-determinism"})
+        assert rules_found(report) == ["sim-determinism"]
+
+    def test_clock_injected_observatory_is_clean(self, tmp_path):
+        # The shipped idiom: clock=time.monotonic as a constructor
+        # DEFAULT is an attribute reference, not a call — epochs rotate
+        # off self._clock() so the sim twin swaps in virtual time.
+        report = lint_fixture(tmp_path, "serve/observatory.py", """
+            import time
+
+            class BurnWindow:
+                def __init__(self, clock=time.monotonic):
+                    self._clock = clock
+
+                def observe(self, misses, accounted):
+                    self._snaps.append((self._clock(), misses, accounted))
+        """, rules={"sim-determinism"})
+        assert report.new == []
+
+    def test_shipped_observatory_is_clean(self):
+        from tools.lint.core import DEFAULT_TARGET
+
+        report = run(paths=[DEFAULT_TARGET], rules={"sim-determinism"})
+        assert report.new == [], [f.format() for f in report.new]

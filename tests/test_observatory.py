@@ -1,0 +1,494 @@
+"""SLO observatory (ISSUE 16): burn math, hysteresis, forecast, drift.
+
+The observatory is the shared-component pattern's third instance (after
+the rate estimator and the control fabric): ONE set of classes ticked by
+``ServeController._control_step`` on the wall clock and by
+``SimScheduler._on_monitor`` at virtual time. These tests pin the math
+on a manual clock (no sleeps, no flake), then close with the parity
+test: the same overload story through the REAL sim scheduler and a REAL
+threaded controller must walk the identical alert lifecycle.
+"""
+
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.engine.rates import RateRegistry, RateTracker
+from ray_dynamic_batching_tpu.serve.observatory import (
+    ALERT_STATES,
+    BurnRateMonitor,
+    BurnWindow,
+    FidelityMonitor,
+    ForecastScorer,
+    ObservatoryPolicy,
+    SLOObservatory,
+    budget_counters,
+)
+from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
+
+
+class ManualClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def counters(completed=0, stale=0, dropped=0, violations=0):
+    return {"completed": float(completed), "stale": float(stale),
+            "dropped": float(dropped), "violations": float(violations)}
+
+
+# --- budget accounting ------------------------------------------------------
+
+class TestBudgetCounters:
+    def test_matches_slo_attainment_formula(self):
+        # misses = violations + stale + dropped; accounted = completed +
+        # stale + dropped — the sim/report.slo_attainment accounting.
+        misses, accounted = budget_counters(
+            counters(completed=90, stale=3, dropped=2, violations=5))
+        assert misses == 10.0
+        assert accounted == 95.0
+        assert 1.0 - misses / accounted == pytest.approx(
+            1.0 - 10.0 / 95.0)
+
+    def test_empty_slice_grades_zero_over_zero(self):
+        assert budget_counters({}) == (0.0, 0.0)
+
+
+# --- burn windows -----------------------------------------------------------
+
+class TestBurnWindow:
+    def test_burn_monotone_in_misses(self):
+        # Property: with the window baseline fixed, burn is strictly
+        # increasing in misses — more failure can never read as less.
+        clk = ManualClock()
+        w = BurnWindow(10.0, 5, clk.now)
+        w.observe(0.0, 0.0)
+        burns = [w.burn(miss, 100.0, budget=0.01, min_accounted=10)
+                 for miss in range(0, 50, 5)]
+        assert all(b is not None for b in burns)
+        assert burns == sorted(burns)
+        assert all(b < a for b, a in zip(burns, burns[1:]))
+
+    def test_burn_unit_is_budget_multiples(self):
+        # Burning EXACTLY the budget (1% misses at slo 0.99) reads 1.0.
+        clk = ManualClock()
+        w = BurnWindow(10.0, 5, clk.now)
+        w.observe(0.0, 0.0)
+        assert w.burn(1.0, 100.0, budget=0.01, min_accounted=10) \
+            == pytest.approx(1.0)
+        assert w.burn(10.0, 100.0, budget=0.01, min_accounted=10) \
+            == pytest.approx(10.0)
+
+    def test_epoch_rotation_ages_an_incident_out(self):
+        # An incident's misses must leave the window once the whole
+        # horizon rotates past them — recency by rotation, not decay.
+        clk = ManualClock()
+        w = BurnWindow(10.0, 5, clk.now)  # 2 s epochs
+        w.observe(0.0, 0.0)
+        clk.advance(2.0)
+        w.observe(50.0, 100.0)  # the incident: 50% miss rate
+        burning = w.burn(50.0, 100.0, budget=0.01, min_accounted=10)
+        assert burning == pytest.approx(50.0 / 100.0 / 0.01)
+        # Clean epochs push the baseline past the incident snapshot.
+        misses, acc = 50.0, 100.0
+        for _ in range(6):
+            clk.advance(2.0)
+            acc += 100.0  # clean traffic, zero new misses
+            w.observe(misses, acc)
+        aged = w.burn(misses, acc, budget=0.01, min_accounted=10)
+        assert aged == pytest.approx(0.0)
+
+    def test_under_min_accounted_is_ungraded(self):
+        clk = ManualClock()
+        w = BurnWindow(10.0, 5, clk.now)
+        w.observe(0.0, 0.0)
+        assert w.burn(3.0, 5.0, budget=0.01, min_accounted=10) is None
+
+
+# --- hysteresis machine -----------------------------------------------------
+
+def _policy(**kw):
+    base = dict(
+        slo_target=0.99, fast_window_s=10.0, slow_window_s=30.0,
+        epochs_per_window=5, warn_burn=2.0, page_burn=10.0,
+        min_accounted=10, warn_after=1, page_after=1, resolve_after=2,
+        resolved_hold_ticks=2,
+    )
+    base.update(kw)
+    return ObservatoryPolicy(**base)
+
+
+def _drive(monitor, clk, miss_acc_pairs, key="dep", qos="standard"):
+    """Feed one cumulative (misses, accounted) slice per 1 s tick; the
+    counters dict synthesizes misses as violations (completed-but-late),
+    so accounted == completed."""
+    fired = []
+    for misses, accounted in miss_acc_pairs:
+        clk.advance(1.0)
+        fired += monitor.tick({key: {qos: counters(
+            completed=accounted, violations=misses)}})
+    return fired
+
+
+class TestBurnAlertHysteresis:
+    def test_full_lifecycle_pins(self):
+        clk = ManualClock()
+        mon = BurnRateMonitor("test", _policy(), clock=clk.now)
+        # Burn hard for 4 ticks, then run clean until resolved ages out.
+        traj = [(i * 50.0, i * 100.0) for i in range(1, 5)]
+        m4, a4 = traj[-1]
+        traj += [(m4, a4 + i * 100.0) for i in range(1, 16)]
+        _drive(mon, clk, traj)
+        seq = [f"{t['from']}->{t['to']}" for t in mon.transitions]
+        assert seq == ["ok->warning", "warning->page", "page->resolved",
+                       "resolved->ok"]
+        assert mon.states() == {"dep": {"standard": "ok"}}
+
+    def test_no_flap_on_boundary_straddling_burst(self):
+        # A short burst stays visible in the fast window while epochs
+        # rotate it toward the edge, so the burn hovers around the warn
+        # threshold for several ticks. Flap-proofing means the machine
+        # crosses ONCE each way — exactly one warning, exactly one
+        # clear — never an ok/warning oscillation while the burst ages.
+        clk = ManualClock()
+        mon = BurnRateMonitor("test", _policy(), clock=clk.now)
+        traj, misses, acc = [], 0.0, 0.0
+        for i in range(40):
+            misses += 30.0 if i in (10, 11) else 0.0
+            acc += 100.0
+            traj.append((misses, acc))
+        _drive(mon, clk, traj)
+        seq = [f"{t['from']}->{t['to']}" for t in mon.transitions]
+        assert seq == ["ok->warning", "warning->ok"]
+        assert mon.states() == {"dep": {"standard": "ok"}}
+
+    def test_resolved_relapse_reenters_warning_not_ok(self):
+        # A recurrence during the resolved hold must go BACK to warning
+        # (the incident is not over), never silently to ok.
+        clk = ManualClock()
+        mon = BurnRateMonitor("test", _policy(resolved_hold_ticks=8),
+                              clock=clk.now)
+        traj = [(i * 50.0, i * 100.0) for i in range(1, 5)]
+        m4, a4 = traj[-1]
+        # Enough clean ticks for the incident to rotate out of the fast
+        # window (10 s) and land page -> resolved before the relapse.
+        traj += [(m4, a4 + i * 100.0) for i in range(1, 15)]
+        m5, a5 = traj[-1]
+        traj += [(m5 + i * 50.0, a5 + i * 100.0) for i in range(1, 3)]
+        _drive(mon, clk, traj)
+        seq = [f"{t['from']}->{t['to']}" for t in mon.transitions]
+        assert seq[:3] == ["ok->warning", "warning->page",
+                           "page->resolved"]
+        assert seq[3] == "resolved->warning"
+
+    def test_ungraded_tick_holds_state(self):
+        # Below min_accounted the window refuses to grade: no resolve
+        # by absence of data, no page by absence of data.
+        clk = ManualClock()
+        mon = BurnRateMonitor("test", _policy(), clock=clk.now)
+        traj = [(i * 50.0, i * 100.0) for i in range(1, 5)]  # -> page
+        _drive(mon, clk, traj)
+        assert mon.states() == {"dep": {"standard": "page"}}
+        m4, a4 = traj[-1]
+        # Starved ticks: cumulative counters freeze, delta < floor.
+        _drive(mon, clk, [(m4, a4)] * 20)
+        assert mon.states() == {"dep": {"standard": "page"}}
+
+    def test_page_needs_both_windows(self):
+        # The multi-window rule: a fast spike whose slow-window burn
+        # stays under page_burn may warn but must not page.
+        clk = ManualClock()
+        mon = BurnRateMonitor(
+            "test",
+            _policy(fast_window_s=4.0, slow_window_s=40.0,
+                    epochs_per_window=4, page_after=1),
+            clock=clk.now)
+        traj, misses, acc = [], 0.0, 0.0
+        for _ in range(20):  # long clean preamble fills the slow window
+            acc += 100.0
+            traj.append((misses, acc))
+        for _ in range(2):  # short hot burst
+            misses += 15.0
+            acc += 100.0
+            traj.append((misses, acc))
+        _drive(mon, clk, traj)
+        tos = [t["to"] for t in mon.transitions]
+        assert "warning" in tos
+        assert "page" not in tos
+
+
+# --- forecast scoring -------------------------------------------------------
+
+class TestForecast:
+    def test_cold_start_refuses_below_min_span(self):
+        clk = ManualClock(100.0)
+        tr = RateTracker(window_s=10.0, clock=clk.now)
+        tr.record(5)
+        clk.advance(1.0)
+        tr.record(5)
+        # 2 s of history < min_span_s=3: refuse, don't extrapolate.
+        assert tr.forecast_rps(5.0, min_span_s=3.0) is None
+        clk.advance(3.0)
+        tr.record(5)
+        assert tr.forecast_rps(5.0, min_span_s=3.0) is not None
+
+    def test_forecast_is_deterministic(self):
+        def run():
+            clk = ManualClock(50.0)
+            tr = RateTracker(window_s=30.0, clock=clk.now)
+            out = []
+            for i in range(20):
+                tr.record(10 + (i % 3))
+                clk.advance(1.0)
+                out.append(tr.forecast_rps(5.0, min_span_s=3.0))
+            return out
+
+        a, b = run(), run()
+        assert [repr(x) for x in a] == [repr(x) for x in b]
+
+    def test_tracks_constant_rate(self):
+        clk = ManualClock(10.0)
+        tr = RateTracker(window_s=60.0, clock=clk.now)
+        for _ in range(30):
+            tr.record(20)
+            clk.advance(1.0)
+        got = tr.forecast_rps(5.0, min_span_s=3.0)
+        assert got == pytest.approx(20.0, rel=0.1)
+
+    def test_count_between_refuses_once_rotated(self):
+        clk = ManualClock(10.0)
+        tr = RateTracker(window_s=5.0, clock=clk.now)
+        tr.record(7)
+        clk.advance(1.0)
+        tr.record(7)
+        assert tr.count_between(10.0, 11.0) == 7
+        clk.advance(30.0)
+        tr.record(1)  # rotates the short window far past t=10
+        assert tr.count_between(10.0, 11.0) is None
+
+    def test_scorer_counts_refusals_and_scores(self):
+        clk = ManualClock(10.0)
+        rates = RateRegistry(window_s=60.0, clock=clk.now)
+        policy = ObservatoryPolicy(forecast_horizon_s=3.0,
+                                   forecast_min_span_s=3.0)
+        scorer = ForecastScorer(policy, clock=clk.now)
+        for _ in range(12):
+            rates.record("m", 10)
+            scorer.tick(rates)
+            clk.advance(1.0)
+        snap = scorer.snapshot()["m"]
+        assert snap["refused"] > 0          # the cold window refused
+        assert snap["scored"] > 0           # matured predictions graded
+        assert snap["p50_abs_err_rps"] is not None
+        assert snap["p50_abs_err_rps"] < 5.0
+
+
+# --- fidelity drift ---------------------------------------------------------
+
+def _live_hops(wait_ms, step_ms, n=50):
+    hops = {}
+    for hop, ms in (("queue.wait", wait_ms), ("engine.step", step_ms)):
+        sk = QuantileSketch()
+        sk.observe(ms, n=n)
+        hops[hop] = sk
+    return {"m": hops}
+
+
+class TestFidelityDrift:
+    def test_guilty_hop_named_innocent_stays_unpriced(self):
+        clk = ManualClock()
+        policy = ObservatoryPolicy(replay_every_ticks=1,
+                                   drift_min_count=5)
+        mon = FidelityMonitor("test", policy, clock=clk.now,
+                              price=lambda model: {"engine.step": 10.0})
+        mon.note_arrivals("m", 50)
+        mon.tick(_live_hops(wait_ms=200.0, step_ms=30.0))
+        report = mon.snapshot()["last"]["models"]["m"]
+        # The engine runs 3x its price: guilty, named.
+        assert report["drifting_hops"] == ["engine.step"]
+        # queue.wait is wildly slow too — but the cost model never
+        # priced it, so it is ungraded-with-reason, never defamed.
+        assert report["ungraded"]["queue.wait"]["reason"] == "not-priced"
+
+    def test_price_at_arrival_absorbs_replans(self):
+        # A replan that re-prices future arrivals must not indict the
+        # history the old plan served: arrivals are stamped with the
+        # price AT ARRIVAL, so predicted forms the same mixture live
+        # does. 50 arrivals priced 10 ms + 50 priced 2 ms vs a live
+        # sketch holding the same 50/50 mixture: no drift.
+        clk = ManualClock()
+        policy = ObservatoryPolicy(replay_every_ticks=1,
+                                   drift_min_count=5)
+        price = {"engine.step": 10.0}
+        mon = FidelityMonitor("test", policy, clock=clk.now,
+                              price=lambda model: dict(price))
+        mon.note_arrivals("m", 50)
+        price["engine.step"] = 2.0  # the replan
+        mon.note_arrivals("m", 50)
+        live = QuantileSketch()
+        live.observe(10.0, n=50)
+        live.observe(2.0, n=50)
+        mon.tick({"m": {"engine.step": live}})
+        report = mon.snapshot()["last"]["models"]["m"]
+        assert report["drifting_hops"] == []
+        assert report["hops"]["engine.step"]["ok"] is True
+
+    def test_unpriced_model_is_ungraded_never_silent(self):
+        clk = ManualClock()
+        policy = ObservatoryPolicy(replay_every_ticks=1)
+        mon = FidelityMonitor("test", policy, clock=clk.now, price=None)
+        mon.note_arrivals("m", 20)
+        mon.tick(_live_hops(wait_ms=5.0, step_ms=20.0))
+        report = mon.snapshot()["last"]["models"]["m"]
+        assert report["drifting_hops"] == []
+        assert report["ungraded_reason"] == "unpriced: no cost model"
+        assert all(e["reason"] == "not-priced"
+                   for e in report["ungraded"].values())
+
+    def test_replay_cadence(self):
+        clk = ManualClock()
+        policy = ObservatoryPolicy(replay_every_ticks=4)
+        mon = FidelityMonitor("test", policy, clock=clk.now,
+                              price=lambda model: {"engine.step": 5.0})
+        mon.note_arrivals("m", 20)
+        for _ in range(12):
+            mon.tick(_live_hops(wait_ms=1.0, step_ms=5.0))
+        assert mon.replays == 3
+
+
+# --- observatory determinism ------------------------------------------------
+
+class TestObservatoryDeterminism:
+    def test_same_trajectory_same_bytes(self):
+        # The full SLOObservatory on a manual clock: two identical
+        # drives must snapshot identically (repr-level) — the property
+        # the sim soak's byte-compare relies on.
+        import json
+
+        def run():
+            clk = ManualClock(5.0)
+            obs = SLOObservatory(
+                "t",
+                policy=ObservatoryPolicy(
+                    fast_window_s=6.0, slow_window_s=18.0,
+                    epochs_per_window=3, min_accounted=10,
+                    forecast_horizon_s=3.0, forecast_min_span_s=2.0,
+                    replay_every_ticks=2),
+                clock=clk.now,
+                price=lambda model: {"engine.step": 4.0},
+            )
+            rates = RateRegistry(window_s=30.0, clock=clk.now)
+            live = QuantileSketch()
+            acc = miss = 0.0
+            for i in range(25):
+                rates.record("m", 12)
+                obs.note_arrivals("m", 12)
+                live.observe(4.0, n=12)
+                acc += 12.0
+                miss += 6.0 if 8 <= i < 12 else 0.0
+                obs.tick({"m": {"standard": counters(
+                    completed=acc, violations=miss)}},
+                    rates, {"m": {"engine.step": live}})
+                clk.advance(1.0)
+            return json.dumps(obs.snapshot(), sort_keys=True)
+
+        assert run() == run()
+
+
+# --- sim/live parity --------------------------------------------------------
+
+LIFECYCLE = ["ok->warning", "warning->page", "page->resolved",
+             "resolved->ok"]
+
+
+class TestAlertLifecycleParity:
+    """The acceptance pin: the SAME observatory classes, ticked by the
+    sim scheduler at virtual time and by a real threaded controller on
+    the wall clock, walk the SAME alert lifecycle through an overload."""
+
+    def test_sim_overload_walks_pinned_lifecycle(self):
+        from ray_dynamic_batching_tpu.sim import Simulation
+        from ray_dynamic_batching_tpu.sim.scenarios import (
+            fixture_profiles,
+            observatory_overload_scenario,
+        )
+
+        report = Simulation(fixture_profiles(),
+                            observatory_overload_scenario(seed=0)).run()
+        timeline = report["observatory"]["alerts"]["timeline"]
+        seq = [f"{t['from']}->{t['to']}" for t in timeline
+               if t["qos"] == "best_effort"]
+        assert seq == LIFECYCLE
+        final = report["observatory"]["alerts"]["final_states"]
+        assert all(st == "ok" for qmap in final.values()
+                   for st in qmap.values())
+
+    def test_live_overload_walks_pinned_lifecycle(self):
+        from ray_dynamic_batching_tpu.serve import (
+            DeploymentConfig,
+            DeploymentHandle,
+            ServeController,
+            is_shed,
+        )
+
+        def work(payloads):
+            time.sleep(0.002)
+            return [p * 2 for p in payloads]
+
+        ctl = ServeController(control_interval_s=0.02)
+        ctl.observatory = SLOObservatory("serve", policy=ObservatoryPolicy(
+            fast_window_s=2.0, slow_window_s=6.0, epochs_per_window=4,
+            min_accounted=10, warn_after=1, page_after=1,
+            resolve_after=2, resolved_hold_ticks=3,
+        ))
+        ctl.observatory.audit = ctl.audit
+        router = ctl.deploy(
+            DeploymentConfig(name="par", num_replicas=2, max_batch_size=4,
+                             batch_wait_timeout_s=0.002),
+            factory=lambda: work,
+        )
+        ctl.start()
+        good = DeploymentHandle(router, default_slo_ms=2_000.0)
+        bad = DeploymentHandle(router, default_slo_ms=1.0)
+        futures = []
+
+        def state():
+            return (ctl.observatory.burn.states()
+                    .get("par", {}).get("standard", "ok"))
+
+        def drive(handle, seconds, until=""):
+            start = time.monotonic()
+            i = 0
+            while time.monotonic() - start < seconds:
+                futures.append(handle.remote(i))
+                i += 1
+                if until and state() == until:
+                    return True
+                time.sleep(0.005)
+            return not until
+
+        try:
+            drive(good, 1.0)
+            assert drive(bad, 8.0, until="page"), \
+                f"never paged (state={state()!r})"
+            assert drive(good, 15.0, until="ok"), \
+                f"never recovered (state={state()!r})"
+            for f in futures:
+                try:
+                    f.result(timeout=30)
+                except Exception as e:  # noqa: BLE001 — classify
+                    # Stale sheds ARE the burn phase's misses; anything
+                    # else is a real system error.
+                    assert is_shed(e), e
+            seq = [f"{t['from']}->{t['to']}"
+                   for t in ctl.observatory.burn.transitions]
+            assert seq == LIFECYCLE
+        finally:
+            ctl.shutdown()

@@ -296,6 +296,25 @@ def _smoke() -> int:
         for i in range(200):
             s.observe(1.0 + (i % 37), tags={"hop": "queue.wait"})
             s.observe(10.0 + (i % 11), tags={"hop": "engine.step"})
+        # SLO-observatory families (ISSUE 16): flood the REAL module
+        # singletons from serve/observatory.py — 40 distinct deployment
+        # names against the top-8 deployment bound on the burn gauge, 40
+        # model names against the forecast-error summary's top-8 model
+        # bound — plus one alert-state and one fidelity-drift sample, so
+        # a runaway deploy loop cannot mint unbounded alerting series.
+        from ray_dynamic_batching_tpu.serve import observatory as obs
+
+        for i in range(40):
+            obs.SLO_BURN_RATE.set(
+                1.5, tags={"deployment": f"dep-{i}", "qos": "standard",
+                           "window": "fast"})
+            obs.FORECAST_ERROR.observe(
+                float(i % 7), tags={"model": f"model-{i}"})
+        obs.SLO_ALERT_STATE.set(
+            float(obs.ALERT_STATES.index("page")),
+            tags={"deployment": "dep-0", "qos": "standard"})
+        obs.FIDELITY_DRIFT.set(
+            0.42, tags={"hop": "engine.step", "model": "dep-0"})
         proxy = HTTPProxy(ProxyRouter(), port=0).start()
         try:
             url = f"http://127.0.0.1:{proxy.port}/metrics"
@@ -365,6 +384,42 @@ def _smoke() -> int:
     if 'smoke_hop_ms{hop="queue.wait",quantile="0.5"}' not in text:
         errors.append("sketch family missing its quantile series "
                       "(summary exposition did not render)")
+    n_burn_series = sum(1 for l in text.splitlines()
+                        if l.startswith("rdb_slo_burn_rate{"))
+    if n_burn_series != 8 + 1:
+        errors.append(
+            f"expected exactly 8 named deployment burn-rate series + "
+            f"__other__, saw {n_burn_series} — the deployment label "
+            "bound broke"
+        )
+    if 'rdb_slo_burn_rate{deployment="__other__"' not in text:
+        errors.append(
+            "deployment label flood did not collapse into __other__ on "
+            "rdb_slo_burn_rate"
+        )
+    if ('rdb_slo_alert_state{deployment="dep-0",qos="standard"} 2.0'
+            not in text):
+        errors.append(
+            "rdb_slo_alert_state missing or not encoding 'page' as "
+            "index 2 of ALERT_STATES"
+        )
+    n_forecast_models = sum(
+        1 for l in text.splitlines()
+        if l.startswith("rdb_forecast_error_count{"))
+    if n_forecast_models != 8 + 1:
+        errors.append(
+            f"expected exactly 8 named model forecast-error summaries + "
+            f"__other__, saw {n_forecast_models} — the model label "
+            "bound broke"
+        )
+    if 'rdb_forecast_error{model="model-0",quantile="0.5"}' not in text:
+        errors.append(
+            "rdb_forecast_error summary missing its quantile series"
+        )
+    if ('rdb_fidelity_drift{hop="engine.step",model="dep-0"} 0.42'
+            not in text):
+        errors.append("rdb_fidelity_drift gauge missing from the "
+                      "exposition")
     if errors:
         print("OPENMETRICS SMOKE FAILED:", file=sys.stderr)
         for e in errors:

@@ -38,6 +38,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_frontdoor_soak.py --sim
   echo "== partition-defense conformance (sim matrix: split-brain self-demotion, fail-closed admission, O(tail) failover, tools/partition_smoke.json) =="
   python tools/run_partition_soak.py --sim
+  echo "== SLO-observatory conformance (sim: burn alert fires+resolves, guilty hop named, steady arm silent, tools/observatory_smoke.json) =="
+  python tools/run_observatory_soak.py --sim
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
   exec python -m pytest tests/ -q -m "not slow"
 fi
@@ -96,6 +98,10 @@ python tools/run_frontdoor_soak.py --live --smoke
 echo "== partition-defense conformance (sim matrix + live: leader cut off from the log mid-flood, zero split-brain, fail-closed gossip, snapshot failover) =="
 python tools/run_partition_soak.py --sim
 python tools/run_partition_soak.py --live --smoke
+
+echo "== SLO-observatory conformance (sim three-arm + live: pinned alert lifecycle, guilty hop named, forecasts scored) =="
+python tools/run_observatory_soak.py --sim
+python tools/run_observatory_soak.py --live --smoke
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q

@@ -13,6 +13,10 @@ Usage:
     python tools/dump_trace.py spans.jsonl --hops           # per-request
         latency budget ledger table (utils/hops decomposition: one row
         per request, one column per hop + the unattributed residual)
+    python tools/dump_trace.py spans.jsonl --alerts         # the SLO
+        observatory's audited timeline: burn-alert transitions
+        (observatory.alert marker spans) and fidelity-drift changes
+        (observatory.drift), one row each, in observatory-clock order
 
 Capture a JSONL during any run with:
     from ray_dynamic_batching_tpu.utils.tracing import tracer
@@ -52,6 +56,10 @@ def main(argv=None) -> int:
     parser.add_argument("--hops", action="store_true",
                         help="print the per-request hop ledger table "
                              "instead of converting")
+    parser.add_argument("--alerts", action="store_true",
+                        help="print the SLO observatory's alert + "
+                             "fidelity-drift timeline instead of "
+                             "converting")
     args = parser.parse_args(argv)
 
     spans = read_spans_jsonl(args.spans)
@@ -79,6 +87,46 @@ def main(argv=None) -> int:
         print(f"{len(ledgers)} request ledger(s); {skipped} non-request "
               f"trace(s) skipped; every row conserves "
               "(sum(hops) + unattributed == e2e)")
+        return 0
+    if args.alerts:
+        # The observatory stamps a zero-length marker span per burn-alert
+        # transition and per fidelity-drift change; render them as the
+        # audited incident timeline, ordered by the observatory's own
+        # clock stamp (at_s — virtual time in sim captures, wall time
+        # live), so the story reads in decision order even if the
+        # exporter saw spans out of order.
+        rows = []
+        for s in spans:
+            a = s.attributes
+            if s.name == "observatory.alert":
+                rows.append((
+                    float(a.get("at_s", 0.0)), "alert",
+                    f"{a.get('deployment')}/{a.get('qos')}",
+                    f"{a.get('alert_from')} -> {a.get('alert_to')}",
+                    f"fast={a.get('fast_burn')} slow={a.get('slow_burn')}",
+                ))
+            elif s.name == "observatory.drift":
+                hops = a.get("drifting_hops") or ""
+                rows.append((
+                    float(a.get("at_s", 0.0)), "drift",
+                    str(a.get("model")),
+                    f"mispriced [{hops}]" if hops else "cleared",
+                    "",
+                ))
+        if not rows:
+            print(f"no observatory spans in {args.spans} "
+                  f"({len(spans)} spans) — was the observatory ticking "
+                  "while the exporter was installed?", file=sys.stderr)
+            return 1
+        rows.sort(key=lambda r: r[0])
+        print(f"{'t(s)':>10}  {'kind':<6} {'subject':<26} "
+              f"{'event':<22} detail")
+        for at, kind, subject, event, detail in rows:
+            print(f"{at:>10.2f}  {kind:<6} {subject:<26} "
+                  f"{event:<22} {detail}")
+        n_alerts = sum(1 for r in rows if r[1] == "alert")
+        print(f"{len(rows)} observatory event(s): {n_alerts} alert "
+              f"transition(s), {len(rows) - n_alerts} drift change(s)")
         return 0
     if args.summary:
         print(json.dumps(trace_summary(spans), indent=2))

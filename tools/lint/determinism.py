@@ -27,6 +27,14 @@ as the default clock argument, daemon timers in the default scheduler —
 are attribute references and constructor plumbing, not calls, and pass
 the rule by construction; an actual ``time.time()`` read in a chaos
 decision would not.)
+
+``serve/observatory.py`` (ISSUE 16) is covered for the same reason:
+the SLO observatory's burn windows, forecast scoring, and fidelity
+replays run verbatim inside ``SimScheduler`` at virtual time — a
+``time.monotonic()`` CALL in an epoch rotation or a replay cadence
+would smear wall time into sim reports. Like the fabric, its live-mode
+default (``clock=time.monotonic`` as a constructor default) is an
+attribute reference, not a call, and passes by construction.
 """
 
 from __future__ import annotations
@@ -46,9 +54,10 @@ class SimDeterminismChecker(Checker):
     def applies(self, relpath: str) -> bool:
         if in_dirs(relpath, {"sim"}):
             return True
-        # The fabric's chaos decisions must replay byte-identically on
-        # the virtual clock — same contract as sim/ proper.
-        return (relpath.rsplit("/", 1)[-1] == "fabric.py"
+        # The fabric's chaos decisions and the observatory's instruments
+        # must replay byte-identically on the virtual clock — same
+        # contract as sim/ proper.
+        return (relpath.rsplit("/", 1)[-1] in ("fabric.py", "observatory.py")
                 and in_dirs(relpath, {"serve"}))
 
     def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:

@@ -1,0 +1,454 @@
+#!/usr/bin/env python
+"""SLO-observatory conformance gate — fire an alert, name a guilty hop,
+stay silent on a healthy cluster.
+
+ISSUE 16's tentpole (serve/observatory.py) is one set of classes ticked
+by BOTH control planes: ServeController._control_step live and
+SimScheduler._on_monitor at virtual time. This gate proves the three
+instruments tell the truth in both hosts:
+
+  --sim    (default; the CI fast lane) three deterministic fixtures
+           from sim/scenarios.py, each run TWICE for byte-identical
+           reports, graded against tools/observatory_smoke.json:
+             - observatory_overload_scenario: a 30 -> 430 rps spike on
+               two chips. The burn machine must walk the PINNED
+               lifecycle ok -> warning -> page -> resolved -> ok on the
+               paged (deployment, qos) — page only inside the incident
+               window, resolve only after it — with every other class
+               silent and all final states ok.
+             - observatory_mispricing_scenario: one chip runs 3x slow
+               forever with no gray detection armed; the cost model
+               keeps pricing from the profile row. The fidelity_drift
+               audit record must name engine.step and must NOT name
+               queue.wait (unpriced by contract — a mispriced engine
+               cannot defame the queue).
+             - observatory_steady_scenario: comfortable steady state.
+               ZERO alert transitions, ZERO drift records, and a
+               working forecaster (scored > 0, error bounded) — the
+               false-positive gate.
+  --live   a real ServeController + threaded replicas running the SAME
+           observatory classes on the wall clock, with soak-speed
+           windows: a warm phase (all ok), a burn phase (1 ms SLO so
+           every completion is a violation) that must reach `page`,
+           and a recovery phase that must land `resolved` then `ok` —
+           the live face of the overload arm's pinned sequence. Also
+           asserts forecast predictions get scored and the fidelity
+           instrument reports unpriced hops as ungraded-with-reason
+           (never silently).
+
+Exit: 0 conformant, 1 violation, 2 usage.
+
+Examples:
+  python tools/run_observatory_soak.py --sim
+  python tools/run_observatory_soak.py --live --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RATCHET = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "observatory_smoke.json")
+
+
+def _load_floors() -> dict:
+    with open(RATCHET) as f:
+        return json.load(f)["floors"]
+
+
+def _conservation(report: dict, failures: list, arm: str) -> None:
+    for name, s in report["models"].items():
+        accounted = (s["completed"] + s["stale"] + s["dropped"]
+                     + s["pending"])
+        if s["arrivals"] != accounted:
+            failures.append(
+                f"{arm}/{name}: accounting leak — {s['arrivals']} arrivals "
+                f"vs {accounted} accounted"
+            )
+
+
+def _run_twice(scenario, failures: list, arm: str):
+    """Same seed, twice: the observatory must not cost determinism."""
+    from ray_dynamic_batching_tpu.sim import Simulation, render_json
+    from ray_dynamic_batching_tpu.sim.scenarios import fixture_profiles
+
+    blobs = [render_json(Simulation(fixture_profiles(), scenario).run())
+             for _ in range(2)]
+    if blobs[0] != blobs[1]:
+        failures.append(f"{arm}: nondeterministic — same seed produced "
+                        "different report bytes")
+    return json.loads(blobs[0]), blobs[0] == blobs[1]
+
+
+def _sequences(report: dict) -> dict:
+    """(key, qos) -> ["ok->warning", ...] from the observatory's bounded
+    transition ring (report.observatory.alerts.timeline)."""
+    out: dict = {}
+    for t in report["observatory"]["alerts"]["timeline"]:
+        out.setdefault((t["key"], t["qos"]), []).append(
+            f"{t['from']}->{t['to']}")
+    return out
+
+
+def run_sim(seed: int = 0) -> int:
+    from ray_dynamic_batching_tpu.sim.report import format_alert_timeline
+    from ray_dynamic_batching_tpu.sim.scenarios import (
+        observatory_mispricing_scenario,
+        observatory_overload_scenario,
+        observatory_steady_scenario,
+    )
+
+    floors = _load_floors()
+    failures: list = []
+
+    # --- overload arm: the pinned burn-alert lifecycle --------------------
+    f = floors["overload"]
+    sc = observatory_overload_scenario(seed=seed)
+    report, det_a = _run_twice(sc, failures, "overload")
+    _conservation(report, failures, "overload")
+    obs = report["observatory"]
+    paged = (f["paged_key"], f["paged_qos"])
+    seqs = _sequences(report)
+    if seqs.get(paged) != f["sequence"]:
+        failures.append(
+            f"overload: {paged} walked {seqs.get(paged)} — the pinned "
+            f"lifecycle is {f['sequence']}"
+        )
+    for pair, seq in seqs.items():
+        if pair != paged:
+            failures.append(
+                f"overload: {pair} transitioned ({seq}) — only {paged} "
+                "should alert; a healthy class was defamed"
+            )
+    spike_at = sc.models[0].pattern.spike_at_s
+    spike_end = spike_at + sc.models[0].pattern.spike_len_s
+    times = {t["to"]: t["at"]
+             for t in obs["alerts"]["timeline"]
+             if (t["key"], t["qos"]) == paged}
+    if "page" in times and not (
+            spike_at <= times["page"] <= spike_at + f["page_latency_s"]):
+        failures.append(
+            f"overload: page at t={times['page']}s — outside "
+            f"[{spike_at}, {spike_at + f['page_latency_s']}]s of spike onset"
+        )
+    if "resolved" in times and times["resolved"] <= spike_end:
+        failures.append(
+            f"overload: resolved at t={times['resolved']}s, before the "
+            f"spike even ended (t={spike_end}s) — a flap, not a recovery"
+        )
+    final = obs["alerts"]["final_states"]
+    bad_final = {k: qmap for k, qmap in final.items()
+                 if any(st != "ok" for st in qmap.values())}
+    if bad_final:
+        failures.append(f"overload: final alert states {bad_final} != ok — "
+                        "the incident never fully cleared")
+    slo_triggers = [a["trigger"] for a in report["audit"]
+                    if a["trigger"].startswith("slo_")]
+    if "slo_resolved" not in slo_triggers:
+        failures.append("overload: no slo_resolved audit record — the "
+                        "recovery left no decision trail")
+    scored = obs["forecast"].get(f["paged_key"], {}).get("scored", 0)
+    if scored < f["min_forecast_scored"]:
+        failures.append(
+            f"overload: only {scored} forecasts scored < "
+            f"{f['min_forecast_scored']} — the predictor went ungraded"
+        )
+    for name, floor in f["slo_attainment"].items():
+        got = report["models"][name]["slo_attainment"]
+        if got < floor:
+            failures.append(
+                f"overload/{name}: attainment {got:.4f} < floor {floor}")
+
+    # --- mispricing arm: the guilty hop, and ONLY the guilty hop ----------
+    fm = floors["mispricing"]
+    mreport, det_b = _run_twice(observatory_mispricing_scenario(seed=seed),
+                                failures, "mispricing")
+    _conservation(mreport, failures, "mispricing")
+    mobs = mreport["observatory"]
+    if _sequences(mreport):
+        failures.append(
+            f"mispricing: burn alerts fired ({_sequences(mreport)}) — this "
+            "arm isolates the fidelity instrument"
+        )
+    drift_records = [a for a in mreport["audit"]
+                     if a["trigger"] == "fidelity_drift"]
+    named = sorted({hop for a in drift_records
+                    for hop in a["diff"]["mispriced"]})
+    if fm["guilty_hop"] not in named:
+        failures.append(
+            f"mispricing: no fidelity_drift record names "
+            f"{fm['guilty_hop']} (named: {named}) — the 3x chip went "
+            "unindicted"
+        )
+    if fm["innocent_hop"] in named:
+        failures.append(
+            f"mispricing: {fm['innocent_hop']} was named ({named}) — an "
+            "unpriced hop was defamed"
+        )
+    last = (mobs["fidelity"]["last"]["models"]
+            .get(fm["model"], {}))
+    worst = (last.get("hops", {}).get(fm["guilty_hop"], {})
+             .get("worst_drift", 0.0))
+    if worst < fm["min_drift"]:
+        failures.append(
+            f"mispricing: final {fm['guilty_hop']} drift {worst:.4f} < "
+            f"{fm['min_drift']} — the mispricing washed out"
+        )
+    innocent = last.get("ungraded", {}).get(fm["innocent_hop"], {})
+    if innocent.get("reason") != "not-priced":
+        failures.append(
+            f"mispricing: {fm['innocent_hop']} ungraded reason "
+            f"{innocent.get('reason')!r} != 'not-priced' — the "
+            "never-silent contract broke"
+        )
+
+    # --- steady arm: the false-positive gate ------------------------------
+    fs = floors["steady"]
+    sreport, det_c = _run_twice(observatory_steady_scenario(seed=seed),
+                                failures, "steady")
+    _conservation(sreport, failures, "steady")
+    sobs = sreport["observatory"]
+    if sobs["alerts"]["timeline"]:
+        failures.append(
+            f"steady: {len(sobs['alerts']['timeline'])} alert transition(s) "
+            "on a healthy cluster — an observatory that pages on steady "
+            "state is worse than none"
+        )
+    noisy = [a["trigger"] for a in sreport["audit"]
+             if a["trigger"].startswith(("slo_", "fidelity_"))]
+    if noisy:
+        failures.append(f"steady: observatory audit records {noisy} on a "
+                        "healthy cluster")
+    for model, fstats in sobs["forecast"].items():
+        if fstats["scored"] < fs["min_forecast_scored"]:
+            failures.append(
+                f"steady/{model}: {fstats['scored']} forecasts scored < "
+                f"{fs['min_forecast_scored']}"
+            )
+        err = fstats.get("p95_abs_err_rps")
+        if err is not None and err > fs["max_p95_abs_err_rps"]:
+            failures.append(
+                f"steady/{model}: forecast p95 error {err:.2f} rps > "
+                f"{fs['max_p95_abs_err_rps']} — the predictor is noise"
+            )
+    for name, floor in fs["slo_attainment"].items():
+        got = sreport["models"][name]["slo_attainment"]
+        if got < floor:
+            failures.append(
+                f"steady/{name}: attainment {got:.4f} < floor {floor}")
+
+    summary = {
+        "mode": "sim",
+        "deterministic": det_a and det_b and det_c,
+        "overload": {
+            "timeline": format_alert_timeline(report).split("\n"),
+            "forecast_scored": scored,
+            "attainment": {
+                name: round(s["slo_attainment"], 4)
+                for name, s in report["models"].items()
+            },
+        },
+        "mispricing": {
+            "named_hops": named,
+            "worst_drift": round(worst, 4),
+            "drift_records": len(drift_records),
+        },
+        "steady": {
+            "transitions": len(sobs["alerts"]["timeline"]),
+            "forecast": {
+                model: {
+                    "scored": fstats["scored"],
+                    "p95_abs_err_rps":
+                        None if fstats["p95_abs_err_rps"] is None
+                        else round(fstats["p95_abs_err_rps"], 2),
+                }
+                for model, fstats in sobs["forecast"].items()
+            },
+        },
+        "violations": failures,
+    }
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 1 if failures else 0
+
+
+def run_live(smoke: bool) -> int:
+    from ray_dynamic_batching_tpu.serve import (
+        DeploymentConfig,
+        DeploymentHandle,
+        ServeController,
+        is_shed,
+    )
+    from ray_dynamic_batching_tpu.serve.observatory import (
+        ObservatoryPolicy,
+        SLOObservatory,
+    )
+
+    floors = _load_floors()["live"]
+    violations: list = []
+
+    def work(payloads):
+        time.sleep(0.002)  # visible but tiny batch cost
+        return [p * 2 for p in payloads]
+
+    ctl = ServeController(control_interval_s=0.05)
+    # Soak-speed windows: the alert MATH is the deployed default; only
+    # the horizons are shrunk so the whole lifecycle lands inside a CI
+    # smoke. Installed before start() so every tick runs this policy.
+    ctl.observatory = SLOObservatory("serve", policy=ObservatoryPolicy(
+        fast_window_s=4.0, slow_window_s=12.0, epochs_per_window=4,
+        min_accounted=10, warn_after=1, page_after=1, resolve_after=2,
+        resolved_hold_ticks=4, forecast_horizon_s=3.0,
+        forecast_min_span_s=2.0, replay_every_ticks=4,
+    ))
+    ctl.observatory.audit = ctl.audit
+    router = ctl.deploy(
+        DeploymentConfig(name="obs", num_replicas=2, max_batch_size=4,
+                         batch_wait_timeout_s=0.002),
+        factory=lambda: work,
+    )
+    ctl.start()
+    good = DeploymentHandle(router, default_slo_ms=2_000.0)
+    # 1 ms SLO: every completion is a violation — a deterministic burn
+    # source that needs no queue-collapse tuning.
+    bad = DeploymentHandle(router, default_slo_ms=1.0)
+    futures: list = []
+    seen: list = []
+
+    def state_of() -> str:
+        return (ctl.observatory.burn.states()
+                .get("obs", {}).get("standard", "ok"))
+
+    def drive(handle, seconds: float, interval_s: float = 0.01,
+              until: str = "") -> bool:
+        start = time.monotonic()
+        i = 0
+        while time.monotonic() - start < seconds:
+            futures.append(handle.remote(i))
+            i += 1
+            st = state_of()
+            if not seen or seen[-1] != st:
+                seen.append(st)
+            if until and st == until:
+                return True
+            time.sleep(interval_s)
+        return not until
+
+    try:
+        scale = 0.6 if smoke else 1.0
+        drive(good, 2.5 * scale)                     # warm: all ok
+        if state_of() != "ok":
+            violations.append(f"warm phase ended in {state_of()!r}, not ok")
+        if not drive(bad, floors["page_s_budget"], until="page"):
+            violations.append(
+                f"burn phase never reached page within "
+                f"{floors['page_s_budget']}s (state={state_of()!r})"
+            )
+        if not drive(good, floors["resolve_s_budget"], until="resolved"):
+            violations.append(
+                f"recovery never reached resolved within "
+                f"{floors['resolve_s_budget']}s (state={state_of()!r})"
+            )
+        if not drive(good, floors["resolve_s_budget"], until="ok"):
+            violations.append(
+                f"resolved never aged back to ok within "
+                f"{floors['resolve_s_budget']}s (state={state_of()!r})"
+            )
+        # The sequence the state machine walked, deduped to edges — the
+        # live twin of the sim arm's pinned lifecycle.
+        expected = ["ok", "warning", "page", "resolved", "ok"]
+        if seen != expected:
+            violations.append(
+                f"live lifecycle {seen} != pinned {expected} — the "
+                "machine flapped or skipped a stage"
+            )
+        completed = errors = shed = 0
+        first_error = None
+        for i, fut in enumerate(futures):
+            try:
+                fut.result(timeout=30)
+                completed += 1
+            except Exception as e:  # noqa: BLE001 — classification is the test
+                if is_shed(e):
+                    shed += 1
+                else:
+                    errors += 1
+                    first_error = first_error or f"{type(e).__name__}: {e}"
+        if errors:
+            violations.append(
+                f"{errors} client-visible system error(s); first: "
+                f"{first_error}"
+            )
+        snap = ctl.observatory.snapshot(key="obs")
+        scored = snap["forecast"].get("obs", {}).get("scored", 0)
+        if scored < floors["min_forecast_scored"]:
+            violations.append(
+                f"{scored} forecasts scored < {floors['min_forecast_scored']}"
+                " — the live predictor went ungraded"
+            )
+        fmodels = snap["fidelity"]["last"].get("models", {})
+        ungraded = fmodels.get("obs", {}).get("ungraded", {})
+        missing = [hop for hop, entry in ungraded.items()
+                   if not entry.get("reason")]
+        if missing:
+            violations.append(
+                f"ungraded hops without a reason: {missing} — the "
+                "never-silent contract broke"
+            )
+        if fmodels and fmodels.get("obs", {}).get("drifting_hops"):
+            violations.append(
+                f"live fidelity named {fmodels['obs']['drifting_hops']} "
+                "with no cost model installed"
+            )
+        status = ctl.status().get("obs", {})
+        if "observatory" not in status:
+            violations.append("status() carries no observatory block")
+        from ray_dynamic_batching_tpu.utils import metrics as m
+        text = m.default_registry().prometheus_text()
+        for family in ("rdb_slo_burn_rate", "rdb_slo_alert_state",
+                       "rdb_forecast_error"):
+            if family not in text:
+                violations.append(f"{family} missing from the exposition")
+        summary = {
+            "mode": "live",
+            "lifecycle": seen,
+            "requests": len(futures),
+            "completed": completed,
+            "shed": shed,
+            "system_errors": errors,
+            "forecast_scored": scored,
+            "alert_transitions": [
+                {k: t[k] for k in ("qos", "from", "to")}
+                for t in list(ctl.observatory.burn.transitions)
+            ],
+            "violations": violations,
+        }
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    finally:
+        ctl.shutdown()
+    return 1 if violations else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--sim", action="store_true",
+                      help="deterministic sim conformance (CI fast lane)")
+    mode.add_argument("--live", action="store_true",
+                      help="threaded soak against a real controller")
+    ap.add_argument("--smoke", action="store_true",
+                    help="live: shrink to a quick CI-sized soak")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.live:
+        return run_live(smoke=args.smoke)
+    return run_sim(seed=args.seed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -516,19 +516,55 @@ def _budget_report() -> None:
     _log(f"budget report rc={rec['rc']}")
 
 
+def _observatory_report() -> None:
+    """Forecast-error and fidelity-drift baselines for the window the
+    SLO demo just captured: a quick live observatory soak (real
+    controller, compressed burn windows) whose summary JSON — alert
+    lifecycle, forecasts scored, never-silent drift verdicts — lands in
+    profiles/tpu_v5e/observatory_report.json alongside the budget
+    report, so the first on-chip window records what the observatory
+    saw, not just what the demo measured. Report-only, riding the same
+    post-record hook: a soak violation here is signal to commit, not a
+    reason to discard the verified record (the CI lanes are the
+    enforcing copies)."""
+    rec = run_step("observatory_report", [
+        sys.executable, "tools/run_observatory_soak.py",
+        "--live", "--smoke",
+    ], 300.0)
+    try:
+        payload = json.loads(rec["stdout"])
+    except ValueError:
+        payload = {"stdout_tail": rec["stdout"][-2000:],
+                   "stderr_tail": rec["stderr"][-1000:]}
+    payload["rc"] = rec["rc"]
+    with open(os.path.join(OUT_DIR, "observatory_report.json"), "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+    _log(f"observatory report rc={rec['rc']}")
+
+
+def _slo_post_record() -> None:
+    # Budget report first (it reads the spans the demo just wrote),
+    # then the observatory baseline; each is best-effort on its own.
+    try:
+        _budget_report()
+    except Exception as e:  # noqa: BLE001 — derived report only
+        _log(f"budget report hook failed: {e}")
+    _observatory_report()
+
+
 def capture_slo_demo() -> bool:
     return _capture_demo(
         "slo_demo",
         [sys.executable, "tools/run_slo_demo.py", "profiles/tpu_v5e", "60",
          "--trace"],
         SLO_TIMEOUT_S, "slo_demo.json",
-        f"tpu_v5e: on-chip SLO demo record + per-hop budget report "
-        f"{_now()}",
+        f"tpu_v5e: on-chip SLO demo record + budget + observatory "
+        f"reports {_now()}",
         # rc 4 = flight-record self-checks failed: the SLO record is
         # still real measured ground truth (and the budget report will
         # say what the capture was missing) — commit, don't discard.
         ok_rcs=(0, 2, 4),
-        post_record=_budget_report,
+        post_record=_slo_post_record,
     )
 
 
