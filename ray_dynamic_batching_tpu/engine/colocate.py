@@ -407,7 +407,8 @@ class ColocatedLLMEngines:
         """Test/driver hook: one pass without the thread."""
         t0 = time.perf_counter()
         progressed = self._pass()
-        self._wall_ms += (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            self._wall_ms += (time.perf_counter() - t0) * 1000.0
         return progressed
 
     def run_until_idle(self, timeout_s: float = 60.0) -> None:
@@ -438,7 +439,8 @@ class ColocatedLLMEngines:
                     logger.exception("%s: pass failed", self.name)
                     progressed = False
                     time.sleep(0.05)  # rdb-lint: disable=event-loop-blocking (pass error backoff on the colocation executor's own thread)
-                self._wall_ms += (time.perf_counter() - t0) * 1000.0
+                with self._lock:
+                    self._wall_ms += (time.perf_counter() - t0) * 1000.0
                 if not progressed:
                     time.sleep(self.idle_wait_s)  # rdb-lint: disable=event-loop-blocking (idle wait on the colocation executor's own thread)
 

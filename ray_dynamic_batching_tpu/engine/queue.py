@@ -40,6 +40,7 @@ from ray_dynamic_batching_tpu.engine.request import (
     RequestStale,
     now_ms,
 )
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
 from ray_dynamic_batching_tpu.utils.sketch import RollingSketch
 from ray_dynamic_batching_tpu.utils import metrics as m
 from ray_dynamic_batching_tpu.utils.tracing import tracer
@@ -258,7 +259,7 @@ class RequestQueue:
         self.model = model
         self.max_len = max_len
         self._buckets = ClassBuckets()
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("request_queue")
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
         # Optional decision ring (scheduler/audit.AuditLog): class-aware
@@ -555,9 +556,14 @@ class RequestQueue:
 
     def slo_compliance(self) -> float:
         """Fraction of recent completions inside SLO (1.0 when idle)."""
-        if not self._recent_outcomes:
+        # Snapshot under the lock: an unlocked sum()/len() pair can
+        # straddle complete_batch's trim and report > 1.0 (the sum sees
+        # the pre-trim list, the len the post-trim one).
+        with self._lock:
+            outcomes = list(self._recent_outcomes)
+        if not outcomes:
             return 1.0
-        return sum(self._recent_outcomes) / len(self._recent_outcomes)
+        return sum(outcomes) / len(outcomes)
 
     def stats(self) -> Dict[str, float]:
         return {

@@ -14,6 +14,8 @@ import time
 from collections import deque
 from typing import Deque, Dict, Optional, Tuple
 
+from ray_dynamic_batching_tpu.utils.concurrency import assert_owner
+
 
 class RateTracker:
     """Requests/sec over a sliding window (one instance per model)."""
@@ -37,6 +39,7 @@ class RateTracker:
             self._prune(sec)
 
     def _prune(self, now_sec: int) -> None:
+        assert_owner(self._lock)  # callers hold it (record / rate_rps)
         cutoff = now_sec - int(self.window_s)
         while self._buckets and self._buckets[0][0] <= cutoff:
             _, c = self._buckets.popleft()

@@ -23,6 +23,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
+
 _req_counter = itertools.count(1)
 
 
@@ -70,7 +72,7 @@ class TokenStream:
 
     def __init__(self, max_buffer: int = 4096) -> None:
         self._chunks: deque = deque()
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("token_stream")
         self._cond = threading.Condition(self._lock)
         self._closed = False
         self._error: Optional[Exception] = None

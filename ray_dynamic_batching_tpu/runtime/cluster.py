@@ -322,7 +322,8 @@ class ProcessReplica:
         # The poller must be OUT of the C shm calls before close() frees
         # the mappings (shm_bridge.py:240 documents the segfault); leak
         # rather than close under a live thread.
-        poller = self._poller
+        with self._lock:
+            poller = self._poller
         if poller is not None:
             poller.join(2.0)
         exc = RequestDropped(f"{self.replica_id} stopped")

@@ -356,12 +356,16 @@ class LiveScheduler:
 
     # --- observability (ref metrics.json writer, scheduler.py:969-983) ----
     def snapshot(self) -> Dict:
+        # Snapshot the plan reference under the lock: rebalance rebinds
+        # it from the monitor thread while metrics writers read here.
+        with self._lock:
+            plan = list(self._current_plan)
         return {
             "time": self._clock(),
             "rates_rps": self.rates.rates(),
             "scheduled_rates_rps": self.rates.scheduled_rates(),
             "queues": self.queues.stats(),
-            "plan": [n.describe() for n in self._current_plan],
+            "plan": [n.describe() for n in plan],
             "engines": [e.describe() for e in self.engines],
             "schedule_changes": self.schedule_changes,
             "audit": self.audit.to_dicts(last=20),

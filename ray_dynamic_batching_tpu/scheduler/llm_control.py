@@ -33,6 +33,7 @@ from ray_dynamic_batching_tpu.engine.colocate import ColocatedLLMEngines
 from ray_dynamic_batching_tpu.engine.decode import DecodeEngine
 from ray_dynamic_batching_tpu.engine.queue import QueueManager, RequestQueue
 from ray_dynamic_batching_tpu.engine.rates import RateRegistry
+from ray_dynamic_batching_tpu.utils.concurrency import assert_owner
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
 from ray_dynamic_batching_tpu.profiles.table import BatchProfile
 from ray_dynamic_batching_tpu.scheduler.audit import AuditLog, plan_diff
@@ -507,6 +508,7 @@ class LLMLiveScheduler:
         rejected host-side (Request.reject/fulfill tolerate the wedged
         call completing later); queued work lives in the SHARED queues
         and flows to the replacements. Caller holds the lock."""
+        assert_owner(self._lock)
         wedged = [
             chip for chip in self.chips
             if chip.running

@@ -59,6 +59,7 @@ from ray_dynamic_batching_tpu.serve.fabric import (
     default_fabric,
 )
 from ray_dynamic_batching_tpu.serve.long_poll import LongPollHost
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
 from ray_dynamic_batching_tpu.serve.observatory import SLOObservatory
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.serve.router import Router
@@ -219,7 +220,7 @@ class ServeController:
         self.fabric = fabric if fabric is not None else default_fabric()
         self._deployments: Dict[str, _DeploymentState] = {}
         self._factories: Dict[str, Callable] = {}
-        self._lock = threading.RLock()
+        self._lock = OrderedLock("controller", reentrant=True)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_checkpoint: Optional[str] = None
@@ -1121,13 +1122,16 @@ class ServeController:
 
     # --- checkpoint / recovery (ref controller.py:545, app_state:1096) ----
     def _checkpoint(self) -> None:
-        payload = json.dumps(
-            {
+        # Snapshot configs under the (reentrant) lock: an API-thread
+        # deploy() resizing _deployments mid-walk raises "dictionary
+        # changed size during iteration" in this comprehension — the
+        # PR-8 registry race on the control plane.
+        with self._lock:
+            configs = {
                 name: state.config.to_json()
                 for name, state in self._deployments.items()
-            },
-            sort_keys=True,
-        )
+            }
+        payload = json.dumps(configs, sort_keys=True)
         # Checkpoint-on-change: steady-state control steps must not rewrite
         # the KV file twice a second. (Legacy mirror — the store's
         # per-deployment keys are the authoritative durable state now;
@@ -1214,9 +1218,13 @@ class ServeController:
                     STORE_CONFIG_KEY.format(deployment=name)
                 ))
                 adopted = False
-                if self.catalog is not None and name not in self._deployments:
+                with self._lock:
+                    absent = self.catalog is not None and \
+                        name not in self._deployments
+                if absent:
                     self._adopt(name, cfg)
-                    adopted = name in self._deployments
+                    with self._lock:
+                        adopted = name in self._deployments
                 self.deploy(cfg, _recovered=adopted)
                 governor = self.store.get_json(
                     STORE_GOVERNOR_KEY.format(deployment=name)

@@ -62,6 +62,7 @@ import time  # live-mode default clock only; the sim twin injects VirtualClock
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock, assert_owner
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
 
@@ -247,7 +248,7 @@ class ControlFabric:
     ) -> None:
         self._clock = clock
         self._scheduler = scheduler
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("fabric")
         self._groups: Dict[str, str] = {}
         self._seed = seed if seed is not None else self._config_seed()
         self._rng = random.Random(self._seed)
@@ -302,45 +303,56 @@ class ControlFabric:
 
     @property
     def active(self) -> bool:
-        return self._active
+        return self._active  # rdb-lint: disable=lock-discipline (arming flag flipped in quiesced configure(); one-op staleness on the passthrough fast path is benign and locking would serialize every edge)
 
     # --- partition evaluation ---------------------------------------------
     def _side(self, name: str) -> str:
+        assert_owner(self._lock)  # callers hold it (_crosses)
         return self._groups.get(name, name)
+
+    def _refresh_gauge_locked(self, open_now: bool) -> None:
+        """Edge-triggered gauge refresh; caller holds ``_lock`` so two
+        concurrent evaluations cannot interleave the compare and the
+        write (a lost update would freeze the exported gauge wrong)."""
+        assert_owner(self._lock)
+        val = 1 if open_now else 0
+        if val != self._partition_gauge:
+            self._partition_gauge = val
+            FABRIC_PARTITION.set(float(val))
 
     def partition_active(self, now: Optional[float] = None) -> bool:
         """True while ANY configured partition window is open (whether or
         not a given edge crosses it); refreshes the gauge on edges."""
-        if not self._partitions:
-            return False
-        t = (self._clock() if now is None else now) - self._t0
-        open_now = any(p.open_at(t) for p in self._partitions)
-        val = 1 if open_now else 0
-        if val != self._partition_gauge:
-            self._partition_gauge = val
-            FABRIC_PARTITION.set(float(val))
-        return open_now
+        with self._lock:
+            if not self._partitions:
+                return False
+            t = (self._clock() if now is None else now) - self._t0
+            open_now = any(p.open_at(t) for p in self._partitions)
+            self._refresh_gauge_locked(open_now)
+            return open_now
 
     def _crosses(self, src: str, dst: str) -> bool:
-        if not self._partitions or not src or not dst:
-            # Unnamed endpoints cannot be placed on a side: untouched.
-            self.partition_active()
-            return False
-        t = self._clock() - self._t0
-        sa, sb = self._side(src), self._side(dst)
-        crossing = False
-        open_now = False
-        for p in self._partitions:
-            if not p.open_at(t):
-                continue
-            open_now = True
-            if (sa in p.a and sb in p.b) or (sa in p.b and sb in p.a):
-                crossing = True
-        val = 1 if open_now else 0
-        if val != self._partition_gauge:
-            self._partition_gauge = val
-            FABRIC_PARTITION.set(float(val))
-        return crossing
+        with self._lock:
+            if not self._partitions or not src or not dst:
+                # Unnamed endpoints cannot be placed on a side: untouched
+                # — but still refresh the gauge on this edge visit.
+                if self._partitions:
+                    t = self._clock() - self._t0
+                    self._refresh_gauge_locked(
+                        any(p.open_at(t) for p in self._partitions))
+                return False
+            t = self._clock() - self._t0
+            sa, sb = self._side(src), self._side(dst)
+            crossing = False
+            open_now = False
+            for p in self._partitions:
+                if not p.open_at(t):
+                    continue
+                open_now = True
+                if (sa in p.a and sb in p.b) or (sa in p.b and sb in p.a):
+                    crossing = True
+            self._refresh_gauge_locked(open_now)
+            return crossing
 
     def _edge_verdict(self, edge: str) -> Optional[EdgeChaos]:
         """Consume one unit of the edge's chaos budget, or None."""
@@ -380,7 +392,7 @@ class ControlFabric:
         transports model latency at the caller, not here; drops and
         partitions are the failure modes that matter for appends and
         renews."""
-        if not self._active:
+        if not self._active:  # rdb-lint: disable=lock-discipline (passthrough fast path: arming flips in quiesced configure(); a one-call-stale read only delays chaos onset by one edge)
             return fn(*args, **kwargs)
         if self._crosses(src, dst):
             self._count(edge, "dropped")
@@ -411,7 +423,7 @@ class ControlFabric:
         timer fires them, so a delayed gossip absorb can land out of
         order with a later round — exactly the reordering the
         delta-state CRDT consumers must (and do) tolerate."""
-        if not self._active:
+        if not self._active:  # rdb-lint: disable=lock-discipline (passthrough fast path: arming flips in quiesced configure(); a one-call-stale read only delays chaos onset by one edge)
             deliver(*args)
             return True
         if self._crosses(src, dst):

@@ -44,6 +44,7 @@ from ray_dynamic_batching_tpu.engine.request import (
     now_ms,
 )
 from ray_dynamic_batching_tpu.serve.fabric import FabricUnreachable
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
 from ray_dynamic_batching_tpu.serve.grayhealth import median_or_zero
 from ray_dynamic_batching_tpu.utils.chaos import ChaosInjected
 from ray_dynamic_batching_tpu.utils.logging import get_logger
@@ -226,7 +227,7 @@ class FailoverManager:
         # (due_monotonic_ms, seq, request, excluded_replica_id,
         #  submitted_ms — the failover hop span's start)
         self._heap: List[Tuple[float, int, Request, str, float]] = []
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(OrderedLock("failover"))
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
         # --- accounting (surfaced via stats() -> router -> status()) ---
@@ -424,12 +425,14 @@ class FailoverManager:
             ))
 
     def stats(self) -> dict:
+        with self._cond:
+            pending = float(len(self._heap))
         return {
             "retries": float(self.retries),
             "shed_deadline": float(self.shed_deadline),
             "shed_attempts": float(self.shed_attempts),
             "stream_aborted": float(self.stream_aborted),
-            "pending": float(len(self._heap)),
+            "pending": pending,
         }
 
 
@@ -546,7 +549,7 @@ class HedgeManager:
         self._seq = itertools.count()
         # (due_monotonic_ms, seq, request, primary_replica_id)
         self._heap: List[Tuple[float, int, Request, str]] = []
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(OrderedLock("failover"))
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
         self._threshold_cache: Tuple[float, float] = (0.0, float("-inf"))
@@ -821,6 +824,11 @@ class HedgeManager:
             self._cond.notify_all()
 
     def stats(self) -> dict:
+        # _heap is the cond's domain, the counters are _stats_lock's;
+        # take them sequentially (never nested) so neither orders
+        # against the other.
+        with self._cond:
+            pending = float(len(self._heap))
         with self._stats_lock:
             return {
                 "armed": float(self.armed),
@@ -829,5 +837,5 @@ class HedgeManager:
                 "won": float(self.won),
                 "lost": float(self.lost),
                 "late": float(self.late),
-                "pending": float(len(self._heap)),
+                "pending": pending,
             }

@@ -48,6 +48,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_dynamic_batching_tpu.utils.concurrency import assert_owner
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
 
@@ -210,6 +211,7 @@ class GrayHealthMonitor:
 
     # --- state machine ----------------------------------------------------
     def _st(self, rid: str) -> _ReplicaGrayState:
+        assert_owner(self._lock)  # callers hold it (tick)
         st = self._states.get(rid)
         if st is None:
             st = self._states[rid] = _ReplicaGrayState(

@@ -514,21 +514,30 @@ class LLMDeployment:
             tree_weight_bytes as tree_bytes,
         )
 
-        # _ensure_model already quantized self._params when requested, so a
+        # Snapshot the write-once model state under the init lock: these
+        # attrs are published by _ensure_model under it, and a planner
+        # thread may size slots while another deployment thread is still
+        # initializing the draft pair.
+        with self._init_lock:
+            model, params = self._model, self._params
+            draft_model = self._draft_model
+            draft_params = self._draft_params
+
+        # _ensure_model already quantized the params when requested, so a
         # plain byte count is exact for both modes.
-        weights_bytes = tree_bytes(self._params) / max(1, n_chips)
+        weights_bytes = tree_bytes(params) / max(1, n_chips)
         budget = float(cfg.hbm_budget_bytes)
         per_slot = float(
-            self._model.kv_bytes_per_slot(max_len or self.max_len)
+            model.kv_bytes_per_slot(max_len or self.max_len)
         ) / max(1, n_chips)
-        if self._draft_model is not None:
+        if draft_model is not None:
             # Speculative decoding doubles the residency story: the draft's
             # weights leave the budget, and every slot also carries a draft
             # KV row (with spec-token headroom) — omit either and the
             # "fits" answer OOMs on the chip.
-            weights_bytes += tree_bytes(self._draft_params) / max(1, n_chips)
+            weights_bytes += tree_bytes(draft_params) / max(1, n_chips)
             per_slot += float(
-                self._draft_model.kv_bytes_per_slot(
+                draft_model.kv_bytes_per_slot(
                     (max_len or self.max_len) + self.spec_tokens + 1
                 )
             ) / max(1, n_chips)
@@ -542,7 +551,7 @@ class LLMDeployment:
             weights_bytes += (
                 self.session_cache_size
                 * float(sum(
-                    self._model.kv_bytes_per_slot(b)
+                    model.kv_bytes_per_slot(b)
                     for b in self.length_buckets
                 ))
             ) / max(1, n_chips)
@@ -732,6 +741,12 @@ class LLMDeployment:
         # explicit measured config outranks both the table plan and the
         # analytic HBM model below.
         self._ensure_model()
+        # Same snapshot discipline as auto_num_slots: the model/param
+        # pairs are published under _init_lock by _ensure_model.
+        with self._init_lock:
+            model, params = self._model, self._params
+            draft_model = self._draft_model
+            draft_params = self._draft_params
         max_len = max_len or self.max_len
         num_slots = num_slots if num_slots is not None else self.num_slots
         decode_horizon = self.decode_horizon
@@ -764,8 +779,8 @@ class LLMDeployment:
             fitting = [b for b in prompt_buckets if b <= max_len]
             prompt_buckets = fitting or [max_len]
         return DecodeEngine(
-            self._model,
-            self._params,
+            model,
+            params,
             queue,
             num_slots=num_slots,
             max_len=max_len,
@@ -777,8 +792,8 @@ class LLMDeployment:
             max_admissions_per_step=self.max_admissions_per_step,
             prefix_cache_size=self.prefix_cache_size,
             session_cache_size=self.session_cache_size,
-            draft_model=self._draft_model,
-            draft_params=self._draft_params,
+            draft_model=draft_model,
+            draft_params=draft_params,
             spec_tokens=self.spec_tokens,
             quantize_weights=self.quantize_weights,
             device=device,

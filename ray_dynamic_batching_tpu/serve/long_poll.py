@@ -33,6 +33,7 @@ from ray_dynamic_batching_tpu.serve.fabric import (
     FabricUnreachable,
     default_fabric,
 )
+from ray_dynamic_batching_tpu.utils.concurrency import assert_owner
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("long_poll")
@@ -64,21 +65,23 @@ class LongPollHost:
         """Block until any listened key's snapshot id exceeds the given id;
         returns {key: (snapshot_id, value)} for every advanced key (empty on
         timeout — the client simply re-arms, ref long_poll.py:242)."""
-
-        def updates() -> Dict[str, Tuple[int, Any]]:
-            return {
-                k: snap
-                for k, last_id in keys_to_ids.items()
-                if (snap := self._snapshots.get(k)) is not None
-                and snap[0] > last_id
-            }
-
         with self._cond:
-            out = updates()
+            out = self._updates_locked(keys_to_ids)
             if out:
                 return out
             self._cond.wait(timeout_s)
-            return updates()
+            return self._updates_locked(keys_to_ids)
+
+    def _updates_locked(
+        self, keys_to_ids: Dict[str, int]
+    ) -> Dict[str, Tuple[int, Any]]:
+        assert_owner(self._cond)  # callers hold it (listen_for_change)
+        return {
+            k: snap
+            for k, last_id in keys_to_ids.items()
+            if (snap := self._snapshots.get(k)) is not None
+            and snap[0] > last_id
+        }
 
     def snapshot_ids(self) -> Dict[str, int]:
         with self._lock:

@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_dynamic_batching_tpu.engine.rates import RateRegistry
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
 from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
@@ -208,7 +209,7 @@ class BurnRateMonitor:
         self.scope = scope
         self.policy = policy
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("observatory")
         self._states: Dict[Tuple[str, str], _AlertState] = {}
         self.audit = None
         # Bounded ring (GrayHealthMonitor's cap): a flapping deployment
@@ -397,7 +398,7 @@ class ForecastScorer:
                  clock=time.monotonic) -> None:
         self.policy = policy
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("observatory")
         # model -> (made_at_s, predicted_rps)
         self._pending: Dict[str, Tuple[float, float]] = {}
         self._sketches: Dict[str, QuantileSketch] = {}
@@ -503,7 +504,7 @@ class FidelityMonitor:
         self._clock = clock
         self.price = price
         self.audit = None
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("observatory")
         # (t_s, model, price-at-arrival) ring — the PR-3 WorkloadDriver
         # recording path, in-process and bounded.
         self._ring: deque = deque(maxlen=policy.arrival_ring)

@@ -45,6 +45,7 @@ from ray_dynamic_batching_tpu.serve.grayhealth import (
 )
 from ray_dynamic_batching_tpu.serve.replica import Replica
 from ray_dynamic_batching_tpu.utils.chaos import chaos
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock, assert_owner
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
 from ray_dynamic_batching_tpu.utils.tracing import tracer
@@ -114,6 +115,7 @@ class CircuitBreaker:
         batch ran) must not wedge the breaker half-open forever: after a
         cooldown's worth of silence the slot is forfeit and the next
         request may probe."""
+        assert_owner(self._lock)  # _locked suffix: callers hold it
         return (
             self._state == "half_open"
             and self._clock() - self._half_open_at >= self.cooldown_s
@@ -374,7 +376,7 @@ class Router:
         # page pools already hold the prefix.
         self.digests = PrefixDigestDirectory()
         self._replicas: List[Replica] = list(replicas or [])
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("router_pool")
         self._len_cache: Dict[str, _CachedLen] = {}
         self.total_routed = 0
         # Per-replica breakers persist across replica-set updates: a

@@ -74,6 +74,7 @@ from ray_dynamic_batching_tpu.serve.fabric import (
     FabricUnreachable,
     default_fabric,
 )
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 
 logger = get_logger("store")
@@ -160,7 +161,7 @@ class StoreLog:
         self._first_index = 0          # index of _records[0] (post-compaction)
         self._snapshot: Optional[StoreSnapshot] = None
         self._fence_epoch = 0
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("store_log")
         self._clock = clock
         self.rejected_appends = 0
         self.appended_total = 0        # survives compaction (uptime proxy)
@@ -280,7 +281,7 @@ class LeaderLease:
         self.duration_s = float(duration_s)
         self.clock = clock
         self._clock = clock  # internal alias (one source, read everywhere)
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("lease")
         self._holder: Optional[str] = None
         self._epoch = 0
         self._expires_at = 0.0
@@ -392,7 +393,7 @@ class ControllerStore:
 
     def __init__(self) -> None:
         self._data: Dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("store")
         self._version = 0
 
     # --- read side --------------------------------------------------------

@@ -137,7 +137,7 @@ class ChaosInjector:
     def should_fail(self, point: str) -> bool:
         """Consume one unit of the point's failure budget (thread-safe).
         Free when chaos is unconfigured: one unlocked attribute read."""
-        if not self._active:
+        if not self._active:  # rdb-lint: disable=lock-discipline (unconfigured fast path: arming flips in quiesced configure(); one-op staleness only shifts chaos onset by one call)
             return False
         with self._lock:
             budget = self._budgets.get(point)
@@ -202,7 +202,7 @@ class ChaosInjector:
         spec can slow exactly one replica of a fleet. Consumes one unit
         of the matched entry's budget. Free when unconfigured: one
         unlocked attribute read."""
-        if not self._slow_active:
+        if not self._slow_active:  # rdb-lint: disable=lock-discipline (unconfigured fast path: arming flips in quiesced configure(); one-op staleness only shifts chaos onset by one call)
             return None
         keys = ([f"{point}@{instance}"] if instance is not None else [])
         keys.append(point)
@@ -230,7 +230,7 @@ class ChaosInjector:
 
     @property
     def active(self) -> bool:
-        return self._active
+        return self._active  # rdb-lint: disable=lock-discipline (observability read of the arming flag; torn/stale by one op is benign)
 
 
 _GLOBAL: Optional[ChaosInjector] = None

@@ -17,6 +17,7 @@ import warnings
 from collections import deque
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
 from ray_dynamic_batching_tpu.utils.sketch import QuantileSketch
 
 TagMap = Tuple[Tuple[str, str], ...]
@@ -52,7 +53,7 @@ class Metric:
         # stable tenants of a deployment register early and stay named).
         self.bounded_tags = dict(bounded_tags or {})
         self._bounded_seen: Dict[str, set] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics")
         _default_registry.register(self)
 
     def _normalize_tags(
@@ -488,7 +489,10 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._metrics: Dict[str, Metric] = {}
-        self._lock = threading.Lock()
+        # Same rank as the per-metric locks: the registry snapshots and
+        # releases before touching any Metric (the PR-8 fix), so the two
+        # are never held together.
+        self._lock = OrderedLock("metrics")
 
     def register(self, metric: Metric) -> None:
         with self._lock:

@@ -36,6 +36,8 @@ import math
 import threading
 from typing import Any, Dict, Iterable, Optional
 
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock, assert_owner
+
 __all__ = ["QuantileSketch", "RollingSketch"]
 
 
@@ -286,11 +288,11 @@ class RollingSketch:
         self._cur = QuantileSketch(**self._params)
         self._prev: Optional[QuantileSketch] = None
         self._total = 0
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("sketch")
 
     @property
     def relative_accuracy(self) -> float:
-        return self._cur.relative_accuracy
+        return self._cur.relative_accuracy  # rdb-lint: disable=lock-discipline (config read: every epoch's sketch is built from the same _params, so either epoch object answers identically)
 
     def observe(self, value: float, n: int = 1) -> None:
         with self._lock:
@@ -303,6 +305,7 @@ class RollingSketch:
 
     def _view(self) -> QuantileSketch:
         """Caller must hold ``self._lock``."""
+        assert_owner(self._lock)
         if self._prev is None:
             return self._cur
         merged = QuantileSketch(**self._params)
@@ -363,4 +366,4 @@ class RollingSketch:
 
     def __repr__(self) -> str:
         return (f"RollingSketch(window={self.window}, "
-                f"count={self.count}, total={self._total})")
+                f"count={self.count}, total={self._total})")  # rdb-lint: disable=lock-discipline (debug repr: a torn count is cosmetic, and taking the lock here could self-deadlock a log line emitted under it)

@@ -27,6 +27,7 @@ import threading
 from typing import Any, Dict, Iterable, List, Optional
 
 from ray_dynamic_batching_tpu.utils import metrics as m
+from ray_dynamic_batching_tpu.utils.concurrency import assert_owner
 from ray_dynamic_batching_tpu.utils.tracing import Span
 
 # Spans a sink refused (cap reached, sink closed): counted per sink, and
@@ -283,9 +284,11 @@ class FileSpanExporter:
         self._written = 0
         self._dropped = 0
         self._pending = 0
-        self._f.write(self._header_line())
+        with self._lock:
+            self._f.write(self._header_line())
 
     def _header_line(self) -> str:
+        assert_owner(self._lock)  # counts must not move mid-render
         body = json.dumps({_HEADER_KEY: {
             "truncated": self._dropped > 0,
             "spans": self._written,

@@ -1,0 +1,112 @@
+"""Scaffolding for hammer-style race regression tests.
+
+A hammer test re-creates a specific race by running each *role* (an
+attacker mutating shared state, an observer reading it) in a tight
+loop on its own thread. All threads are released together by a barrier
+so the loops overlap from the very first iteration, runtime is bounded
+by a wall-clock deadline, and every exception is captured per role —
+never swallowed — and surfaced by :meth:`HammerResult.raise_errors`.
+
+The harness is deterministic in everything but the interleaving
+itself: fixed role order, barrier start, fixed duration.
+``test_concurrency.py`` self-tests it against the canonical CPython
+race (resizing a dict mid-iteration raises ``RuntimeError``) so a
+hammer that would miss the bug class fails loudly, not silently.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Mapping
+
+DEFAULT_DURATION_S = 0.75
+
+# The interpreter's default switch interval (5 ms) lets a short
+# critical section finish inside one timeslice far too often; the
+# hammer shrinks it so preemption lands MID-iteration, where races
+# live. Restored after the run.
+DEFAULT_SWITCH_INTERVAL_S = 1e-4
+
+
+@dataclass
+class HammerResult:
+    """What the hammer observed: per-role loop counts and exceptions."""
+
+    iterations: Dict[str, int] = field(default_factory=dict)
+    errors: Dict[str, List[BaseException]] = field(default_factory=dict)
+
+    def all_errors(self) -> List[BaseException]:
+        return [e for errs in self.errors.values() for e in errs]
+
+    def raise_errors(self) -> None:
+        """Re-raise the first captured exception (its role named)."""
+        for role, errs in self.errors.items():
+            if errs:
+                raise AssertionError(
+                    f"hammer role '{role}' raised after "
+                    f"{self.iterations.get(role, 0)} iterations"
+                ) from errs[0]
+
+
+def hammer(
+    roles: Mapping[str, Callable[[], None]],
+    duration_s: float = DEFAULT_DURATION_S,
+    threads_per_role: int = 1,
+    stop_on_error: bool = True,
+    switch_interval_s: float = DEFAULT_SWITCH_INTERVAL_S,
+) -> HammerResult:
+    """Run each role body in a tight loop on its own thread(s).
+
+    ``roles`` maps a role name to a zero-arg callable; each thread
+    loops the callable until ``duration_s`` elapses (or any thread
+    errors, when ``stop_on_error``). A barrier releases every thread
+    at once so contention starts immediately rather than after the
+    first role warms up alone. ``switch_interval_s`` tightens the
+    interpreter's thread-switch interval for the run (restored after).
+    """
+    result = HammerResult(
+        iterations={name: 0 for name in roles},
+        errors={name: [] for name in roles},
+    )
+    stop = threading.Event()
+    barrier = threading.Barrier(len(roles) * threads_per_role)
+    count_lock = threading.Lock()
+
+    def _runner(name: str, body: Callable[[], None]) -> None:
+        barrier.wait()
+        deadline = time.monotonic() + duration_s
+        done = 0
+        try:
+            while not stop.is_set() and time.monotonic() < deadline:
+                body()
+                done += 1
+        except BaseException as exc:  # captured, surfaced by the test
+            with count_lock:
+                result.errors[name].append(exc)
+            if stop_on_error:
+                stop.set()
+        finally:
+            with count_lock:
+                result.iterations[name] += done
+
+    threads = [
+        threading.Thread(
+            target=_runner, args=(name, body),
+            name=f"hammer-{name}-{i}", daemon=True,
+        )
+        for name, body in roles.items()
+        for i in range(threads_per_role)
+    ]
+    prev_interval = sys.getswitchinterval()
+    sys.setswitchinterval(switch_interval_s)
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration_s * 10 + 30)
+    finally:
+        sys.setswitchinterval(prev_interval)
+    return result

@@ -1,0 +1,292 @@
+"""Lock-hierarchy runtime tests: OrderedLock arming/enforcement,
+Condition compatibility, assert_owner — plus the hammer regressions
+for the two races the concurrency pass fixed (the controller
+checkpoint dict walk and the queue SLO sum/len straddle)."""
+
+import threading
+import types
+
+import pytest
+
+from ray_dynamic_batching_tpu.utils.concurrency import (
+    LOCK_RANKS,
+    LOCKORDER_ENV_VAR,
+    LockOrderError,
+    OrderedLock,
+    assert_owner,
+    held_ranks,
+    lockorder_armed,
+)
+from tests.hammer_util import hammer
+
+
+# --- the declared hierarchy ------------------------------------------------
+
+class TestLockRanks:
+    def test_levels_are_unique_and_positive(self):
+        levels = list(LOCK_RANKS.values())
+        assert len(set(levels)) == len(levels)
+        assert all(lv > 0 for lv in levels)
+
+    def test_documented_order_holds(self):
+        # The control plane is outermost, instrumentation innermost —
+        # the ordering ARCHITECTURE.md's "Lock hierarchy" documents.
+        chain = ["controller", "store", "lease", "store_log",
+                 "router_pool", "failover", "observatory",
+                 "request_queue", "token_stream", "allocator",
+                 "fabric", "sketch", "metrics"]
+        assert list(LOCK_RANKS) == chain
+        assert [LOCK_RANKS[n] for n in chain] == sorted(
+            LOCK_RANKS[n] for n in chain)
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.setenv(LOCKORDER_ENV_VAR, "1")
+        assert lockorder_armed()
+        monkeypatch.setenv(LOCKORDER_ENV_VAR, "0")
+        assert not lockorder_armed()
+        monkeypatch.delenv(LOCKORDER_ENV_VAR)
+        assert not lockorder_armed()
+
+
+# --- OrderedLock -----------------------------------------------------------
+
+class TestOrderedLock:
+    def test_unknown_rank_rejected_at_construction(self):
+        with pytest.raises(ValueError, match="unknown lock rank"):
+            OrderedLock("bogus")
+
+    def test_unarmed_is_a_plain_lock(self):
+        outer = OrderedLock("metrics", armed=False)
+        inner = OrderedLock("store", armed=False)
+        with outer:            # inverted order: unarmed never checks
+            with inner:
+                assert held_ranks() == []
+        assert outer.held_by_me() is None
+
+    def test_armed_accepts_increasing_ranks(self):
+        a = OrderedLock("store", armed=True)
+        b = OrderedLock("metrics", armed=True)
+        with a:
+            with b:
+                assert held_ranks() == ["store", "metrics"]
+        assert held_ranks() == []
+
+    def test_armed_raises_on_inversion_before_blocking(self):
+        a = OrderedLock("metrics", armed=True)
+        b = OrderedLock("store", armed=True)
+        with a:
+            with pytest.raises(LockOrderError, match="metrics"):
+                b.acquire()
+        # The refused acquisition left no state behind.
+        assert held_ranks() == []
+        with b:
+            assert held_ranks() == ["store"]
+
+    def test_armed_raises_on_equal_rank(self):
+        # Two locks sharing a family (Metric vs registry) must never be
+        # co-held; strict increase makes equal rank a violation too.
+        a = OrderedLock("metrics", armed=True)
+        b = OrderedLock("metrics", armed=True)
+        with a:
+            with pytest.raises(LockOrderError, match="strictly increase"):
+                b.acquire()
+
+    def test_armed_self_reacquire_raises_instead_of_deadlocking(self):
+        lock = OrderedLock("store", armed=True)
+        with lock:
+            with pytest.raises(LockOrderError):
+                lock.acquire()
+
+    def test_reentrant_reacquire_is_allowed(self):
+        lock = OrderedLock("controller", reentrant=True, armed=True)
+        with lock:
+            with lock:
+                assert held_ranks() == ["controller"]
+            assert lock.held_by_me()
+        assert held_ranks() == []
+        assert not lock.held_by_me()
+
+    def test_release_by_non_owner_raises(self):
+        lock = OrderedLock("store", armed=True)
+        lock.acquire()
+        err = []
+
+        def alien():
+            try:
+                lock.release()
+            except LockOrderError as e:
+                err.append(e)
+
+        t = threading.Thread(target=alien)
+        t.start()
+        t.join()
+        lock.release()
+        assert len(err) == 1
+
+    def test_condition_over_armed_ordered_lock(self):
+        # threading.Condition probes _is_owned(); wait/notify must work
+        # without tripping the order check.
+        lock = OrderedLock("request_queue", armed=True)
+        cond = threading.Condition(lock)
+        ready = []
+
+        def producer():
+            with cond:
+                ready.append(1)
+                cond.notify()
+
+        with cond:
+            t = threading.Thread(target=producer)
+            t.start()
+            assert cond.wait_for(lambda: ready, timeout=5.0)
+        t.join()
+        assert held_ranks() == []
+
+
+class TestAssertOwner:
+    def test_bare_lock_passes_silently(self):
+        assert_owner(threading.Lock())  # cannot name an owner: no-op
+
+    def test_armed_lock_enforces_ownership(self):
+        lock = OrderedLock("sketch", armed=True)
+        with pytest.raises(LockOrderError, match="does not hold"):
+            assert_owner(lock)
+        with lock:
+            assert_owner(lock)
+
+    def test_unarmed_ordered_lock_passes_silently(self):
+        assert_owner(OrderedLock("sketch", armed=False))
+
+
+# --- the hammer harness proves it can catch the bug class ------------------
+
+class TestHammerUtil:
+    def test_detects_dict_resize_mid_iteration(self):
+        """The PR-8 bug class, un-fixed: an unlocked dict comprehension
+        racing a resize raises RuntimeError. The hammer must catch it —
+        this is the sensitivity proof for the regression tests below."""
+        # A stable population makes the walk long enough for the
+        # tightened switch interval to land a preemption inside it.
+        shared = {i: i for i in range(-512, 0)}
+
+        def attack():
+            for i in range(64):
+                shared[i] = i
+            for i in range(64):
+                del shared[i]
+
+        def observe():
+            # Python-level iteration (dict() over the view iterates in
+            # C under one GIL hold and cannot be interrupted).
+            {k: v for k, v in shared.items()}
+
+        result = hammer({"attack": attack, "observe": observe},
+                        duration_s=2.0)
+        assert any(isinstance(e, RuntimeError)
+                   for e in result.all_errors()), (
+            "hammer failed to reproduce the canonical dict-resize race")
+
+    def test_clean_roles_report_iterations_and_no_errors(self):
+        lock = threading.Lock()
+        shared = {}
+
+        def attack():
+            with lock:
+                shared[0] = shared.get(0, 0) + 1
+
+        def observe():
+            with lock:
+                dict(shared.items())
+
+        result = hammer({"attack": attack, "observe": observe},
+                        duration_s=0.2)
+        result.raise_errors()
+        assert result.iterations["attack"] > 0
+        assert result.iterations["observe"] > 0
+
+
+# --- hammer regressions for the fixed races --------------------------------
+
+class _NullKV:
+    """Checkpoint sink: _checkpoint only needs .put()."""
+
+    def put(self, key, value):
+        pass
+
+
+def _fake_state(i):
+    cfg = types.SimpleNamespace(to_json=lambda i=i: {"name": f"d{i}"})
+    return types.SimpleNamespace(config=cfg)
+
+
+class TestCheckpointHammer:
+    def test_checkpoint_survives_concurrent_deploys(self):
+        """ServeController._checkpoint walks _deployments in a dict
+        comprehension. Before the fix it walked OUTSIDE the lock: an
+        API-thread deploy() resizing the dict mid-walk raised
+        'dictionary changed size during iteration' (the PR-8 registry
+        race on the control plane). The fix snapshots under the
+        (reentrant) controller lock; this hammer re-creates the attack
+        the fix defends against."""
+        from ray_dynamic_batching_tpu.serve.controller import (
+            ServeController,
+        )
+
+        c = ServeController(kv=_NullKV())
+        with c._lock:
+            for i in range(200):
+                c._deployments[f"d{i}"] = _fake_state(i)
+
+        def deploy():
+            # What deploy()/delete_deployment() do to the dict shape,
+            # under the lock as they always did.
+            with c._lock:
+                for i in range(200, 264):
+                    c._deployments[f"d{i}"] = _fake_state(i)
+                for i in range(200, 264):
+                    del c._deployments[f"d{i}"]
+
+        def checkpoint():
+            c._checkpoint()
+
+        result = hammer({"deploy": deploy, "checkpoint": checkpoint})
+        result.raise_errors()
+        assert result.iterations["checkpoint"] > 0
+        assert result.iterations["deploy"] > 0
+
+
+class TestSloComplianceHammer:
+    def test_slo_compliance_stays_a_fraction(self):
+        """RequestQueue.slo_compliance computed sum()/len() over
+        _recent_outcomes WITHOUT the lock. record_batch_completion
+        appends then trims the list (del [:-SLO_WINDOW]) under the
+        lock, so an unlocked reader could sum the pre-trim list and
+        divide by the post-trim length — 'compliance' > 1.0. The fix
+        snapshots under the lock; the invariant 0 <= v <= 1 must now
+        hold under sustained completion pressure."""
+        from ray_dynamic_batching_tpu.engine.queue import (
+            SLO_WINDOW,
+            Request,
+            RequestQueue,
+        )
+
+        q = RequestQueue("m")
+        # Every outcome is ok=True (enormous SLO): any value other
+        # than exactly 1.0 is a torn read. 3/4 of a window per batch
+        # makes the append-then-trim resize happen every iteration.
+        batch = [
+            Request(model="m", payload=None, slo_ms=1e12,
+                    request_id=f"r{i}")
+            for i in range(SLO_WINDOW * 3 // 4)
+        ]
+
+        def complete():
+            q.record_batch_completion(batch)
+
+        def read():
+            v = q.slo_compliance()
+            assert v == 1.0, f"torn compliance read: {v}"
+
+        result = hammer({"complete": complete, "read": read})
+        result.raise_errors()
+        assert result.iterations["read"] > 0

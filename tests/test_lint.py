@@ -1505,3 +1505,491 @@ class TestSimDeterminismCoversObservatory:
 
         report = run(paths=[DEFAULT_TARGET], rules={"sim-determinism"})
         assert report.new == [], [f.format() for f in report.new]
+
+
+# --- lock-discipline ------------------------------------------------------
+
+# The PR-6/8/9 bug shape: _n is written under the lock in inc(), read
+# bare in peek().
+UNGUARDED_READ = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def inc(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            return self._n{pragma}
+"""
+
+
+class TestLockDiscipline:
+    def test_unguarded_read_is_flagged(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/c.py",
+                              UNGUARDED_READ.format(pragma=""),
+                              rules={"lock-discipline"})
+        assert rules_found(report) == ["lock-discipline"]
+        f = report.new[0]
+        assert "read of `self._n` outside `_lock`" in f.message
+        assert f.symbol == "Counter.peek"
+
+    def test_fully_guarded_class_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/c.py", """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+
+                def peek(self):
+                    with self._lock:
+                        return self._n
+        """, rules={"lock-discipline"})
+        assert report.new == []
+
+    def test_unlocked_iteration_is_the_pr8_registry_race(self, tmp_path):
+        # The exact PR-8 shape: a dict another thread resizes, walked
+        # bare — gets the dedicated container finding, not a plain read.
+        report = lint_fixture(tmp_path, "serve/reg.py", """
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._metrics = {}
+
+                def register(self, name, m):
+                    with self._lock:
+                        self._metrics[name] = m
+
+                def snapshot(self):
+                    return {k: v for k, v in self._metrics.items()}
+        """, rules={"lock-discipline"})
+        assert rules_found(report) == ["lock-discipline"]
+        assert "PR-8 registry race" in report.new[0].message
+        assert "snapshot it under the lock" in report.new[0].message
+
+    def test_check_then_act_is_a_toctou_finding(self, tmp_path):
+        # The classic lazy-init race: the None check runs outside the
+        # lock that guards the write IN THE SAME function.
+        report = lint_fixture(tmp_path, "serve/eng.py", """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._model = None
+
+                def ensure(self):
+                    if self._model is None:
+                        with self._lock:
+                            self._model = object()
+                    return self._model
+        """, rules={"lock-discipline"})
+        assert all(r == "lock-discipline" for r in rules_found(report))
+        assert any("check-then-act race (TOCTOU)" in f.message
+                   for f in report.new)
+
+    def test_assert_owner_marks_method_as_guarded(self, tmp_path):
+        # A callers-hold-it helper opening with assert_owner(self._lock)
+        # is analyzed as running entirely under the lock.
+        report = lint_fixture(tmp_path, "engine/c.py", """
+            import threading
+
+            from ray_dynamic_batching_tpu.utils.concurrency import (
+                assert_owner,
+            )
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+
+                def _n_locked(self):
+                    assert_owner(self._lock)
+                    return self._n
+        """, rules={"lock-discipline"})
+        assert report.new == []
+
+    def test_nested_def_does_not_inherit_the_lock(self, tmp_path):
+        # A closure is one submit() away from another thread: the
+        # enclosing with-block's guarantee must not transfer.
+        report = lint_fixture(tmp_path, "engine/c.py", """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+
+                def arm(self):
+                    with self._lock:
+                        def cb():
+                            return self._n
+                        return cb
+        """, rules={"lock-discipline"})
+        assert rules_found(report) == ["lock-discipline"]
+        assert "read of `self._n`" in report.new[0].message
+
+    def test_condition_aliases_its_lock(self, tmp_path):
+        # Guarding under self._cond IS guarding under self._lock when
+        # the condition wraps it.
+        report = lint_fixture(tmp_path, "engine/q.py", """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._items.append(x)
+                        self._cond.notify()
+
+                def pop(self):
+                    with self._cond:
+                        return self._items.pop()
+        """, rules={"lock-discipline"})
+        assert report.new == []
+
+    def test_reasoned_pragma_suppresses(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/c.py",
+            UNGUARDED_READ.format(
+                pragma="  # rdb-lint: disable=lock-discipline "
+                       "(atomic int read; staleness tolerated)"),
+            rules={"lock-discipline"},
+        )
+        assert report.new == []
+        assert report.pragma_suppressed == 1
+
+    def test_baseline_suppresses(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "engine/c.py", UNGUARDED_READ.format(pragma=""),
+            baseline=_baseline([{
+                "rule": "lock-discipline", "path": "engine/c.py",
+                "symbol": "Counter.peek", "count": 1,
+                "reason": "legacy bare read; conversion tracked",
+            }]),
+            rules={"lock-discipline"},
+        )
+        assert report.new == [] and not report.failed
+
+
+# --- lock-ordering --------------------------------------------------------
+
+class TestLockOrdering:
+    def test_rank_inversion_is_flagged(self, tmp_path):
+        # metrics (130) is the innermost rank: taking store (20) while
+        # holding it inverts the declared hierarchy.
+        report = lint_fixture(tmp_path, "serve/x.py", """
+            from ray_dynamic_batching_tpu.utils.concurrency import (
+                OrderedLock,
+            )
+
+            class X:
+                def __init__(self):
+                    self._m = OrderedLock("metrics")
+                    self._s = OrderedLock("store")
+
+                def bad(self):
+                    with self._m:
+                        with self._s:
+                            pass
+        """, rules={"lock-ordering"})
+        assert rules_found(report) == ["lock-ordering"]
+        msg = report.new[0].message
+        assert "rank inversion" in msg
+        assert "'store' (rank 20)" in msg and "'metrics' (rank 130)" in msg
+
+    def test_declared_order_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/x.py", """
+            from ray_dynamic_batching_tpu.utils.concurrency import (
+                OrderedLock,
+            )
+
+            class X:
+                def __init__(self):
+                    self._s = OrderedLock("store")
+                    self._m = OrderedLock("metrics")
+
+                def good(self):
+                    with self._s:
+                        with self._m:
+                            pass
+        """, rules={"lock-ordering"})
+        assert report.new == []
+
+    def test_inversion_through_one_level_call(self, tmp_path):
+        # The edge resolves through a same-class call: bad() holds
+        # metrics while _grab() takes store.
+        report = lint_fixture(tmp_path, "serve/x.py", """
+            from ray_dynamic_batching_tpu.utils.concurrency import (
+                OrderedLock,
+            )
+
+            class X:
+                def __init__(self):
+                    self._m = OrderedLock("metrics")
+                    self._s = OrderedLock("store")
+
+                def bad(self):
+                    with self._m:
+                        self._grab()
+
+                def _grab(self):
+                    with self._s:
+                        pass
+        """, rules={"lock-ordering"})
+        assert rules_found(report) == ["lock-ordering"]
+        assert "via X._grab()" in report.new[0].message
+
+    def test_self_deadlock_lexical(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/x.py", """
+            import threading
+
+            class X:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """, rules={"lock-ordering"})
+        assert rules_found(report) == ["lock-ordering"]
+        assert "self-deadlock" in report.new[0].message
+
+    def test_self_deadlock_via_call(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/x.py", """
+            import threading
+
+            class X:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """, rules={"lock-ordering"})
+        assert rules_found(report) == ["lock-ordering"]
+        assert "via X._inner()" in report.new[0].message
+
+    def test_reentrant_reacquire_is_clean_lexically_and_via_call(
+            self, tmp_path):
+        # The controller pattern: a reentrant lock re-taken by a helper
+        # the holder calls (deploy -> _checkpoint) is safe, not a
+        # self-deadlock — lexically or through the call edge.
+        report = lint_fixture(tmp_path, "serve/x.py", """
+            import threading
+
+            class X:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:
+                            self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """, rules={"lock-ordering"})
+        assert report.new == []
+
+    def test_unknown_rank_is_flagged(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/x.py", """
+            from ray_dynamic_batching_tpu.utils.concurrency import (
+                OrderedLock,
+            )
+
+            class X:
+                def __init__(self):
+                    self._l = OrderedLock("bogus")
+        """, rules={"lock-ordering"})
+        assert rules_found(report) == ["lock-ordering"]
+        assert "unknown rank 'bogus'" in report.new[0].message
+
+    def test_cycle_reported_with_witness_path(self, tmp_path):
+        # Two module-local locks taken in opposite orders by two
+        # functions: no ranks, so no inversion — but the whole-run
+        # graph has an a->b->a cycle, reported with the witness.
+        report = lint_fixture(tmp_path, "serve/x.py", """
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def forward():
+                with a:
+                    with b:
+                        pass
+
+            def backward():
+                with b:
+                    with a:
+                        pass
+        """, rules={"lock-ordering"})
+        assert rules_found(report) == ["lock-ordering"]
+        msg = report.new[0].message
+        assert "potential deadlock" in msg
+        assert "serve/x.py:a" in msg and "serve/x.py:b" in msg
+        # The witness names both edges' functions and ends where it
+        # started.
+        assert "in forward" in msg and "in backward" in msg
+        assert msg.count("->") >= 2
+
+    def test_lock_graph_rides_json_output(self, tmp_path, capsys):
+        path = tmp_path / "serve" / "x.py"
+        path.parent.mkdir(parents=True)
+        path.write_text(textwrap.dedent("""
+            from ray_dynamic_batching_tpu.utils.concurrency import (
+                OrderedLock,
+            )
+
+            class X:
+                def __init__(self):
+                    self._s = OrderedLock("store")
+                    self._m = OrderedLock("metrics")
+
+                def good(self):
+                    with self._s:
+                        with self._m:
+                            pass
+        """))
+        rc = lint_main([str(tmp_path), "--json", "--no-baseline",
+                        "--rules", "lock-ordering"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        graph = payload["lock_graph"]
+        assert graph["ranks"]["metrics"] == 130
+        ids = {n["id"] for n in graph["nodes"]}
+        assert {"rank:store", "rank:metrics"} <= ids
+        assert any(e["from"] == "rank:store" and e["to"] == "rank:metrics"
+                   for e in graph["edges"])
+
+    def test_baseline_suppresses(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "serve/x.py", """
+            import threading
+
+            class X:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """,
+            baseline=_baseline([{
+                "rule": "lock-ordering", "path": "serve/x.py",
+                "symbol": "X.bad", "count": 1,
+                "reason": "legacy recursive hold; refactor tracked",
+            }]),
+            rules={"lock-ordering"},
+        )
+        assert report.new == [] and not report.failed
+
+
+# --- event-loop-blocking: sync-primitive tier ------------------------------
+
+class TestEventLoopSyncPrimitives:
+    def test_sync_lock_with_in_async_serve_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/proxy.py", """
+            async def handler(self):
+                with self._lock:
+                    return 1
+        """, rules={"event-loop-blocking"})
+        assert rules_found(report) == ["event-loop-blocking"]
+        assert "synchronous lock `_lock`" in report.new[0].message
+
+    def test_lock_acquire_in_async_serve_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/proxy.py", """
+            async def handler(self):
+                self._lock.acquire()
+        """, rules={"event-loop-blocking"})
+        assert rules_found(report) == ["event-loop-blocking"]
+        assert ".acquire()" in report.new[0].message
+
+    def test_queue_get_in_async_serve_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/proxy.py", """
+            async def handler(self):
+                return self._queue.get()
+        """, rules={"event-loop-blocking"})
+        assert rules_found(report) == ["event-loop-blocking"]
+        assert ".get()" in report.new[0].message
+
+    def test_sync_def_lock_use_is_clean(self, tmp_path):
+        # Worker threads may block on locks; only the event loop can't.
+        report = lint_fixture(tmp_path, "serve/proxy.py", """
+            def worker(self):
+                with self._lock:
+                    return self._queue.get()
+        """, rules={"event-loop-blocking"})
+        assert report.new == []
+
+    def test_engine_async_lock_is_out_of_scope(self, tmp_path):
+        # The sync-primitive tier is serve/-only: engine async code is
+        # the (stricter) domain of the engine's own structure.
+        report = lint_fixture(tmp_path, "engine/x.py", """
+            async def step(self):
+                with self._lock:
+                    return 1
+        """, rules={"event-loop-blocking"})
+        assert report.new == []
+
+
+# --- concurrency rules: shipped-tree parity --------------------------------
+
+class TestConcurrencyRulesShipped:
+    def test_new_rules_are_in_the_default_set(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "lock-discipline" in out
+        assert "lock-ordering" in out
+
+    def test_baseline_ships_empty_for_concurrency_rules(self):
+        baseline = load_baseline(lint_core.DEFAULT_BASELINE)
+        rules = {e["rule"] for e in baseline.get("entries", [])}
+        assert "lock-discipline" not in rules
+        assert "lock-ordering" not in rules
+
+    def test_shipped_tree_clean_under_lock_rules(self):
+        report = run(rules={"lock-discipline", "lock-ordering"})
+        assert report.new == [], [f.format() for f in report.new]
+
+    def test_linter_lock_table_matches_runtime(self):
+        # The tile_math pattern: one model, two enforcers. The checker
+        # loads concurrency.py standalone; drift here means the static
+        # graph and the armed runtime disagree about the hierarchy.
+        from tools.lint import lockorder
+
+        from ray_dynamic_batching_tpu.utils.concurrency import LOCK_RANKS
+
+        assert lockorder.LOCK_RANKS == LOCK_RANKS

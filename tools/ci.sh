@@ -69,8 +69,8 @@ python tools/run_sim.py --smoke
 echo "== chaos conformance (sim: injected engine death, heal + accounting) =="
 python tools/run_chaos_soak.py --sim
 
-echo "== chaos conformance (live soak: injected failures, zero system errors) =="
-python tools/run_chaos_soak.py --live --smoke
+echo "== chaos conformance (live soak: injected failures, zero system errors; lock hierarchy armed — OrderedLock raises on the first out-of-rank acquire) =="
+env RDB_TESTING_LOCKORDER=1 python tools/run_chaos_soak.py --live --smoke
 
 echo "== straggler conformance (sim + live: one replica 10x slow, probation then reclaim, hedge conservation) =="
 python tools/run_straggler_soak.py --sim

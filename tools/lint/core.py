@@ -248,6 +248,9 @@ class Report:
     pragma_suppressed: int = 0
     files_scanned: int = 0
     errors: List[str] = field(default_factory=list)
+    # Whole-run artifacts checkers contribute (``contribute_extras``
+    # hook) — e.g. lock-ordering's acquires-while-holding graph.
+    extras: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def failed(self) -> bool:
@@ -263,14 +266,16 @@ class Report:
         )
 
     def to_json(self) -> str:
-        return json.dumps({
+        payload = {
             "new": [f.to_dict() for f in self.new],
             "baselined": [f.to_dict() for f in self.baselined],
             "pragma_suppressed": self.pragma_suppressed,
             "files_scanned": self.files_scanned,
             "errors": self.errors,
             "failed": self.failed,
-        }, indent=2)
+        }
+        payload.update(self.extras)
+        return json.dumps(payload, indent=2)
 
     def format_text(self) -> str:
         out = [f.format() for f in self.new]
@@ -286,6 +291,8 @@ def _all_checkers() -> List[Checker]:
     from tools.lint.event_loop import EventLoopBlockingChecker
     from tools.lint.fabric import FabricDisciplineChecker
     from tools.lint.host_sync import HostSyncChecker
+    from tools.lint.lockorder import LockOrderingChecker
+    from tools.lint.locks import LockDisciplineChecker
     from tools.lint.retry import UnboundedRetryChecker
     from tools.lint.shed import ShedAccountingChecker
     from tools.lint.spans import SpanHygieneChecker
@@ -303,6 +310,8 @@ def _all_checkers() -> List[Checker]:
         ShedAccountingChecker(),
         StoreDisciplineChecker(),
         FabricDisciplineChecker(),
+        LockDisciplineChecker(),
+        LockOrderingChecker(),
     ]
 
 
@@ -377,6 +386,18 @@ def run(
             checker.findings = all_findings
             checker.begin_file(ctx)
         _Walker(ctx, applicable).walk(ctx.tree)
+
+    # Whole-run hooks: cross-file analyses (the lock-ordering cycle
+    # check) finish after every file is walked; extras contributors
+    # (the lock graph) attach their artifacts to the report.
+    for checker in checkers:
+        checker.findings = all_findings
+        finish = getattr(checker, "finish", None)
+        if finish is not None:
+            finish()
+        contribute = getattr(checker, "contribute_extras", None)
+        if contribute is not None:
+            contribute(report.extras)
 
     # --- pragma suppression (reason mandatory) ---------------------------
     survivors: List[Finding] = []

@@ -18,11 +18,20 @@ lexical tiers:
   reasoned pragma, because the same helper is one refactor away from
   running under the proxy's loop (exactly how the router's backoff
   sleep used to reach the event loop through ``handle.remote``).
+- **sync-primitive tier** (inside ``async def``, serve/ only): taking a
+  ``threading.Lock`` (``with self._lock:`` / ``.acquire()``) or a
+  ``Queue.get()``. These park the loop for as long as a WORKER THREAD
+  holds the other side — a lock shared with a replica loop turns a
+  worker stall into a front-door stall for every connection. Brief,
+  never-held-across-IO locks are legitimate but must say so with a
+  reasoned pragma; the async-native fix is asyncio primitives or
+  ``asyncio.to_thread``.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Optional
 
 from tools.lint.core import (
@@ -30,6 +39,12 @@ from tools.lint.core import (
 )
 
 _SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output", "Popen"}
+
+# Lock-shaped receiver names for the sync-primitive tier: the linter
+# cannot type-infer, but this stack's locks all follow the naming
+# discipline the lock-discipline rule enforces.
+_LOCKISH = re.compile(r"(^|_)(lock|cond|mutex|rlock|not_empty)$")
+_QUEUEISH = re.compile(r"(^|_)(q|queue|inbox|work_items)$")
 
 
 class EventLoopBlockingChecker(Checker):
@@ -39,9 +54,49 @@ class EventLoopBlockingChecker(Checker):
         return in_dirs(relpath, {"serve", "engine"})
 
     def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)) and \
+                scope.in_async and in_dirs(ctx.relpath, {"serve"}):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, (ast.Name, ast.Attribute)):
+                    name = (_dotted(expr) or "").split(".")[-1]
+                    if _LOCKISH.search(name):
+                        self.report(
+                            ctx, item.context_expr,
+                            f"synchronous lock `{name}` acquired inside "
+                            "`async def` — if a worker thread holds it "
+                            "across slow work the event loop parks for "
+                            "every connection; use an asyncio primitive, "
+                            "offload via asyncio.to_thread, or pragma "
+                            "with the reason the hold is provably brief",
+                            scope,
+                        )
+            return
         if not isinstance(node, ast.Call):
             return
         dotted = _dotted(node.func) or ""
+
+        if scope.in_async and in_dirs(ctx.relpath, {"serve"}) and \
+                isinstance(node.func, ast.Attribute):
+            recv = (_dotted(node.func.value) or "").split(".")[-1]
+            if node.func.attr == "acquire" and _LOCKISH.search(recv):
+                self.report(
+                    ctx, node,
+                    f"synchronous `{recv}.acquire()` inside `async def` "
+                    "blocks the event loop until the holder releases — "
+                    "use an asyncio primitive or asyncio.to_thread",
+                    scope,
+                )
+                return
+            if node.func.attr == "get" and _QUEUEISH.search(recv):
+                self.report(
+                    ctx, node,
+                    f"blocking `{recv}.get()` inside `async def` parks "
+                    "the event loop until a producer shows up — use "
+                    "asyncio.Queue or offload via asyncio.to_thread",
+                    scope,
+                )
+                return
 
         if dotted == "time.sleep":
             if scope.in_async:

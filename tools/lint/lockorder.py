@@ -1,0 +1,443 @@
+"""lock-ordering — the static acquires-while-holding graph has no cycles.
+
+Deadlocks need two locks and two opinions about their order. The
+runtime half of the defense is ``utils/concurrency.py``: every core
+lock family carries a declared rank (``LOCK_RANKS``) and, armed under
+``RDB_TESTING_LOCKORDER``, an :class:`OrderedLock` raises on the first
+out-of-rank acquisition. This rule is the static half, built on the
+SAME standalone-loaded table (the tile_math pattern: one model, two
+enforcers that cannot drift):
+
+- per module, build the **acquires-while-holding graph**: a ``with
+  self._a:`` block lexically containing ``with self._b:`` is an edge
+  ``a -> b``; a call made while holding a lock resolves ONE level deep
+  within the same module (``self.m()`` -> this class's method, bare
+  ``f()`` -> module function, ``x.m()`` -> the unique class defining
+  ``m``), contributing edges to every lock the callee acquires.
+- locks constructed as ``OrderedLock("<rank>")`` resolve to hierarchy
+  ranks (global nodes); plain ``threading.Lock``/``RLock``/
+  ``Condition`` stay module-local nodes. ``Condition(self._lock)``
+  aliases its lock.
+- findings: an edge between ranked locks whose level does not strictly
+  increase (**rank inversion** — the armed runtime would raise here); a
+  same-lock self-edge on a non-reentrant lock (self-deadlock); an
+  ``OrderedLock`` naming a rank missing from the table; and any
+  **cycle** in the whole-run graph, reported with the witnessing path
+  (``a -> b (file:line in Sym) -> a (...)``).
+
+The full graph (nodes/edges/ranks) rides ``--json`` output as
+``lock_graph`` so the dashboard — or a future tool — can render it.
+
+What the static pass cannot see — cross-module nesting through object
+references (``self.queue.add_request()`` from the router) — is exactly
+what the armed runtime enforcement covers; the two are one defense.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.core import (
+    Checker, FileCtx, Finding, REPO_ROOT, Scope, dotted_name,
+)
+from tools.lint.locks import _LOCKISH_NAME, _self_attr
+
+_CONCURRENCY_PATH = (
+    REPO_ROOT / "ray_dynamic_batching_tpu" / "utils" / "concurrency.py"
+)
+
+
+def _load_concurrency():
+    spec = importlib.util.spec_from_file_location(
+        "_rdb_lint_concurrency", _CONCURRENCY_PATH
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+concurrency_module = _load_concurrency()
+LOCK_RANKS: Dict[str, int] = dict(concurrency_module.LOCK_RANKS)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "OrderedLock"}
+
+
+@dataclass
+class _LockDef:
+    node_id: str              # "rank:<name>" or "<path>:<Class>.<attr>"
+    rank: Optional[str]       # hierarchy rank name, if OrderedLock
+    reentrant: bool
+
+
+@dataclass
+class _Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    symbol: str
+    via: str = ""             # "" lexical; "via <callee>()" for calls
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.src, self.dst, self.via)
+
+
+def _ctor_name(call: ast.Call) -> str:
+    return (dotted_name(call.func) or "").split(".")[-1]
+
+
+def _ordered_lock_rank(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value
+    for kw in call.keywords:
+        if kw.arg == "rank" and isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _is_reentrant(call: ast.Call) -> bool:
+    if _ctor_name(call) == "RLock":
+        return True
+    for kw in call.keywords:
+        if kw.arg == "reentrant" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+class _ModuleIndex:
+    """Lock definitions + function index for one module."""
+
+    def __init__(self, ctx: FileCtx) -> None:
+        self.ctx = ctx
+        self.locks: Dict[Tuple[str, str], _LockDef] = {}  # (cls, attr)
+        self.bad_ranks: List[Tuple[ast.Call, str, str]] = []
+        self.module_funcs: Dict[str, ast.AST] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.methods: Dict[Tuple[str, str], ast.AST] = {}
+        self.method_owners: Dict[str, List[str]] = {}
+        self._aliases: Dict[Tuple[str, str], str] = {}
+
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.methods[(node.name, item.name)] = item
+                        self.method_owners.setdefault(
+                            item.name, []).append(node.name)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _ctor_name(node.value) in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._define("", t.id, node.value)
+
+        for cls_name, cls in self.classes.items():
+            cond_aliases: List[Tuple[str, ast.Call]] = []
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                ctor = _ctor_name(node.value)
+                if ctor not in _LOCK_CTORS:
+                    continue
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if ctor == "Condition" and node.value.args:
+                        cond_aliases.append((attr, node.value))
+                    else:
+                        self._define(cls_name, attr, node.value)
+            for attr, call in cond_aliases:
+                base = _self_attr(call.args[0])
+                if base is not None and (cls_name, base) in self.locks:
+                    self._aliases[(cls_name, attr)] = base
+                else:
+                    self._define(cls_name, attr, call)
+
+    def _define(self, cls: str, attr: str, call: ast.Call) -> None:
+        rank = None
+        reentrant = _is_reentrant(call)
+        if _ctor_name(call) == "OrderedLock":
+            rank = _ordered_lock_rank(call)
+            if rank is not None and rank not in LOCK_RANKS:
+                self.bad_ranks.append((call, cls, rank))
+                rank = None
+        if rank is not None:
+            node_id = f"rank:{rank}"
+        else:
+            owner = f"{cls}.{attr}" if cls else attr
+            node_id = f"{self.ctx.relpath}:{owner}"
+        self.locks[(cls, attr)] = _LockDef(node_id, rank, reentrant)
+
+    def resolve(self, cls: str, expr: ast.AST) -> Optional[_LockDef]:
+        """The lock a with-item's context expression names, if any."""
+        attr = _self_attr(expr)
+        if attr is not None and cls:
+            attr = self._aliases.get((cls, attr), attr)
+            if (cls, attr) in self.locks:
+                return self.locks[(cls, attr)]
+            if _LOCKISH_NAME.search(attr):
+                # Base-class lock used by a subclass: module-local node.
+                d = _LockDef(f"{self.ctx.relpath}:{cls}.{attr}", None,
+                             False)
+                self.locks[(cls, attr)] = d
+                return d
+            return None
+        if isinstance(expr, ast.Name) and ("", expr.id) in self.locks:
+            return self.locks[("", expr.id)]
+        return None
+
+    def resolve_call(self, cls: str,
+                     call: ast.Call) -> Optional[Tuple[str, ast.AST]]:
+        """One-level same-module callee: ('Class.m', fn) or ('f', fn)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            fn = self.module_funcs.get(func.id)
+            return (func.id, fn) if fn is not None else None
+        if not isinstance(func, ast.Attribute):
+            return None
+        if isinstance(func.value, ast.Name) and func.value.id == "self":
+            fn = self.methods.get((cls, func.attr))
+            if fn is not None:
+                return (f"{cls}.{func.attr}", fn)
+            return None
+        owners = self.method_owners.get(func.attr, [])
+        if len(owners) == 1 and owners[0] != cls:
+            return (f"{owners[0]}.{func.attr}",
+                    self.methods[(owners[0], func.attr)])
+        return None
+
+
+class LockOrderingChecker(Checker):
+    rule = "lock-ordering"
+
+    def __init__(self) -> None:
+        self._edges: Dict[Tuple[str, str, str], _Edge] = {}
+        self._nodes: Dict[str, _LockDef] = {}
+        self._cycle_reported: Set[frozenset] = set()
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        pass  # all work happens per-module in begin_file / finish
+
+    # --- per-module analysis ---------------------------------------------
+    def begin_file(self, ctx: FileCtx) -> None:
+        index = _ModuleIndex(ctx)
+        for call, cls, rank in index.bad_ranks:
+            self.findings.append(Finding(
+                rule=self.rule, path=ctx.relpath,
+                line=call.lineno, col=call.col_offset,
+                message=(
+                    f"OrderedLock names unknown rank '{rank}' — declare "
+                    f"it in utils/concurrency.LOCK_RANKS (known: "
+                    f"{', '.join(sorted(LOCK_RANKS))})"
+                ),
+                symbol=cls,
+            ))
+        for d in index.locks.values():
+            self._nodes.setdefault(d.node_id, d)
+
+        # Pass 1: per-function lexical acquisitions (for call edges).
+        acquires: Dict[int, Set[str]] = {}
+        for cls, fn in self._functions(index):
+            got: Set[str] = set()
+            self._collect_acquires(index, cls, fn, got)
+            acquires[id(fn)] = got
+
+        # Pass 2: held-tracking walk emitting edges.
+        for cls, fn in self._functions(index):
+            sym = f"{cls}.{fn.name}" if cls else fn.name
+            self._walk(index, cls, sym, fn, [], acquires, ctx)
+
+    def _functions(self, index: _ModuleIndex):
+        for name, fn in index.module_funcs.items():
+            yield "", fn
+        for (cls, _name), fn in index.methods.items():
+            yield cls, fn
+
+    def _collect_acquires(self, index: _ModuleIndex, cls: str,
+                          root: ast.AST, out: Set[str]) -> None:
+        """Lock node-ids ``root`` acquires lexically (its own body only —
+        nested defs are closures running on their own schedule)."""
+        for node in ast.iter_child_nodes(root):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    d = index.resolve(cls, item.context_expr)
+                    if d is not None:
+                        out.add(d.node_id)
+            self._collect_acquires(index, cls, node, out)
+
+    def _walk(self, index: _ModuleIndex, cls: str, sym: str,
+              node: ast.AST, held: List[_LockDef],
+              acquires: Dict[int, Set[str]], ctx: FileCtx) -> None:
+        """Dispatch every CHILD of ``node`` through :meth:`_visit` —
+        the entry point takes a function whose body is its children."""
+        for child in ast.iter_child_nodes(node):
+            self._visit(index, cls, sym, child, held, acquires, ctx)
+
+    def _visit(self, index: _ModuleIndex, cls: str, sym: str,
+               node: ast.AST, held: List[_LockDef],
+               acquires: Dict[int, Set[str]], ctx: FileCtx) -> None:
+        """Process ``node`` ITSELF (then its children): a with-body
+        statement must be matched as a With, not only skimmed for
+        nested children, or lexical nesting two levels deep vanishes."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # Closures run on their own schedule: fresh held set.
+            self._walk(index, cls, sym, node, [], acquires, ctx)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            got: List[_LockDef] = []
+            for item in node.items:
+                d = index.resolve(cls, item.context_expr)
+                if d is None:
+                    continue
+                self._edge(held, d, ctx, item.context_expr, sym, "")
+                got.append(d)
+            for stmt in node.body:
+                self._visit(index, cls, sym, stmt, held + got,
+                            acquires, ctx)
+            return
+        if isinstance(node, ast.Call) and held:
+            resolved = index.resolve_call(cls, node)
+            if resolved is not None:
+                callee_sym, fn = resolved
+                for node_id in sorted(acquires.get(id(fn), ())):
+                    d = self._nodes.get(node_id)
+                    if d is not None:
+                        self._edge(held, d, ctx, node, sym,
+                                   f"via {callee_sym}()")
+        self._walk(index, cls, sym, node, held, acquires, ctx)
+
+    # --- edges + findings --------------------------------------------------
+    def _edge(self, held: Sequence[_LockDef], dst: _LockDef,
+              ctx: FileCtx, site: ast.AST, sym: str, via: str) -> None:
+        for src in held:
+            if src.node_id == dst.node_id:
+                # Reentrant re-acquisition is safe on both lexical and
+                # call edges: resolved calls are same-module synchronous
+                # (same thread), exactly what an RLock permits.
+                if not dst.reentrant:
+                    self.findings.append(Finding(
+                        rule=self.rule, path=ctx.relpath,
+                        line=site.lineno, col=site.col_offset,
+                        message=(
+                            f"self-deadlock: re-acquiring non-reentrant "
+                            f"lock '{dst.node_id}' while already holding "
+                            f"it{' ' + via if via else ''} — a "
+                            f"threading.Lock blocks its own owner forever"
+                        ),
+                        symbol=sym,
+                    ))
+                continue
+            edge = _Edge(src.node_id, dst.node_id, ctx.relpath,
+                         site.lineno, sym, via)
+            self._edges.setdefault(edge.key(), edge)
+            if src.rank is not None and dst.rank is not None and \
+                    LOCK_RANKS[dst.rank] <= LOCK_RANKS[src.rank]:
+                self.findings.append(Finding(
+                    rule=self.rule, path=ctx.relpath,
+                    line=site.lineno, col=site.col_offset,
+                    message=(
+                        f"rank inversion: acquiring '{dst.rank}' (rank "
+                        f"{LOCK_RANKS[dst.rank]}) while holding "
+                        f"'{src.rank}' (rank {LOCK_RANKS[src.rank]})"
+                        f"{' ' + via if via else ''} — LOCK_RANKS says "
+                        f"'{dst.rank}' is acquired first; another thread "
+                        f"taking them in declared order deadlocks "
+                        f"against this path"
+                    ),
+                    symbol=sym,
+                ))
+
+    # --- whole-run cycle detection ----------------------------------------
+    def finish(self) -> None:
+        graph: Dict[str, List[_Edge]] = {}
+        for edge in self._edges.values():
+            graph.setdefault(edge.src, []).append(edge)
+        for edges in graph.values():
+            edges.sort(key=lambda e: (e.dst, e.path, e.line))
+
+        for start in sorted(graph):
+            cycle = self._find_cycle(graph, start)
+            if cycle is None:
+                continue
+            members = frozenset(e.src for e in cycle)
+            if members in self._cycle_reported:
+                continue
+            self._cycle_reported.add(members)
+            first = cycle[0]
+            witness = " -> ".join(
+                f"{e.src} ({e.path}:{e.line} in {e.symbol}"
+                f"{', ' + e.via if e.via else ''})"
+                for e in cycle
+            ) + f" -> {cycle[-1].dst}"
+            self.findings.append(Finding(
+                rule=self.rule, path=first.path, line=first.line, col=0,
+                message=(
+                    f"potential deadlock: the acquires-while-holding "
+                    f"graph has a cycle — {witness}; two threads "
+                    f"entering it from different edges block forever"
+                ),
+                symbol=first.symbol,
+            ))
+
+    def _find_cycle(self, graph: Dict[str, List[_Edge]],
+                    start: str) -> Optional[List[_Edge]]:
+        """DFS from ``start``; a path of edges returning to ``start``."""
+        path: List[_Edge] = []
+        on_path: Set[str] = {start}
+        visited: Set[str] = set()
+
+        def dfs(node: str) -> bool:
+            visited.add(node)
+            for edge in graph.get(node, ()):
+                if edge.dst == start:
+                    path.append(edge)
+                    return True
+                if edge.dst in on_path or edge.dst in visited:
+                    continue
+                path.append(edge)
+                on_path.add(edge.dst)
+                if dfs(edge.dst):
+                    return True
+                on_path.discard(edge.dst)
+                path.pop()
+            return False
+
+        return path if dfs(start) else None
+
+    # --- --json export -----------------------------------------------------
+    def contribute_extras(self, extras: Dict) -> None:
+        nodes = []
+        for node_id in sorted(self._nodes):
+            d = self._nodes[node_id]
+            nodes.append({
+                "id": node_id, "rank": d.rank,
+                "level": LOCK_RANKS.get(d.rank) if d.rank else None,
+                "reentrant": d.reentrant,
+            })
+        edges = [
+            {"from": e.src, "to": e.dst, "path": e.path, "line": e.line,
+             "symbol": e.symbol, "via": e.via}
+            for e in sorted(self._edges.values(),
+                            key=lambda e: (e.src, e.dst, e.via))
+        ]
+        extras["lock_graph"] = {
+            "ranks": dict(LOCK_RANKS), "nodes": nodes, "edges": edges,
+        }

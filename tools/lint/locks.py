@@ -1,0 +1,371 @@
+"""lock-discipline — an attribute guarded somewhere is guarded everywhere.
+
+Three review cycles caught the same bug class by hand before this rule
+existed: PR 6 moved ``admit()``'s counters under the lock, PR 8 found
+registry reads racing engine writes ("dictionary changed size during
+iteration"), PR 9 closed the hedge first-token claim race. The shape is
+always identical — a class protects ``self._x`` with ``with
+self._lock:`` in one method and touches it bare in another. This rule
+infers the discipline per class and holds every access to it.
+
+Inference (per ``ClassDef``, lexical):
+
+- **lock attributes**: ``self.X = threading.Lock()/RLock()/Condition()``
+  or ``OrderedLock(...)`` (``utils/concurrency.py``). A condition built
+  over an existing lock (``threading.Condition(self._lock)``) ALIASES
+  it — guarding under either name is the same lock. ``with self.Y:``
+  over a lock-ish name (``*_lock``/``*_cond``/``lock``/``mutex``) also
+  counts, so subclasses guarding a base-class lock still participate.
+- **guarded attributes**: every ``self._x`` (underscore-private only)
+  WRITTEN inside a ``with self.<lock>:`` block outside ``__init__``.
+- **findings**: any access to a guarded attribute outside its lock —
+  - *container iteration/copy* (``for k in self._x``, ``len``,
+    ``list``/``sorted``/``dict``/``set``/``tuple``, ``.items()``/
+    ``.keys()``/``.values()``/``.copy()``, mutators like ``.append``)
+    is flagged specially: the exact PR-8 failure (an unlocked walk of a
+    dict another thread resizes raises — or silently yields a torn
+    view).
+  - *check-then-act (TOCTOU)*: a guarded attribute READ outside the
+    lock in a function that also writes it under the lock — the classic
+    ``if self._x is None: with lock: self._x = ...`` race — is its own
+    finding kind.
+  - plain unguarded reads/writes otherwise.
+
+Scope rules, deliberate:
+
+- ``__init__``/``__del__`` are exempt (construction/teardown of state
+  nothing else can reach yet).
+- a method that calls ``assert_owner(self.<lock>)`` is analyzed as
+  running entirely under that lock — the runtime helper doubles as the
+  lexical contract "my callers hold it".
+- nested ``def`` bodies do NOT inherit the enclosing ``with`` (a
+  closure is one ``submit()`` away from another thread); lambdas and
+  comprehensions DO (they overwhelmingly run inline under the block
+  that builds them).
+- accesses through any receiver other than ``self`` are out of scope —
+  cross-instance discipline is the lock-ordering rule's territory.
+
+Escapes: a reasoned pragma (``# rdb-lint: disable=lock-discipline
+(<why>)``) on benign sites (atomic flag reads, single-thread phases);
+the baseline ships EMPTY for this rule and must stay so.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.lint.core import Checker, FileCtx, Finding, Scope, dotted_name
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "OrderedLock"}
+_LOCKISH_NAME = re.compile(r"(^|_)(lock|cond|mutex|rlock|not_empty)$")
+
+# Calls on a guarded container that iterate/copy/mutate it — the PR-8
+# shape when made outside the lock.
+_CONTAINER_METHODS = {
+    "items", "keys", "values", "copy", "append", "appendleft", "pop",
+    "popleft", "extend", "add", "update", "remove", "discard", "clear",
+    "setdefault", "insert", "sort",
+}
+_CONTAINER_FUNCS = {"len", "list", "sorted", "dict", "set", "tuple",
+                    "sum", "min", "max", "iter", "enumerate"}
+
+_EXEMPT_METHODS = {"__init__", "__del__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a ``self.x`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _base_self_attr(node: ast.AST) -> Optional[str]:
+    """The self-attribute at the ROOT of a target chain:
+    ``self._d[k].f`` -> '_d' (a write through it mutates ``_d``'s
+    contents)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        got = _self_attr(node)
+        if got is not None:
+            return got
+        node = node.value
+    return None
+
+
+def _is_lock_ctor(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    name = dotted_name(call.func) or ""
+    return name.split(".")[-1] in _LOCK_CTORS
+
+
+@dataclass
+class _Access:
+    attr: str
+    kind: str                 # "write" | "read" | "container"
+    node: ast.AST
+    method: str               # outermost method name
+    held: FrozenSet[str]      # canonical lock names held at the access
+
+
+@dataclass
+class _ClassAnalysis:
+    name: str
+    canonical: Dict[str, str] = field(default_factory=dict)  # attr -> lock
+    accesses: List[_Access] = field(default_factory=list)
+
+
+class _MethodWalker:
+    """One method's lexical walk: tracks held locks, records accesses."""
+
+    def __init__(self, analysis: _ClassAnalysis, method: str) -> None:
+        self.a = analysis
+        self.method = method
+        self._skip: Set[int] = set()  # attr nodes already classified
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is None:
+            return None
+        if attr in self.a.canonical:
+            return self.a.canonical[attr]
+        if _LOCKISH_NAME.search(attr):
+            # Base-class lock guarded here: adopt it by name.
+            self.a.canonical[attr] = attr
+            return attr
+        return None
+
+    def _record(self, attr: Optional[str], kind: str, node: ast.AST,
+                held: FrozenSet[str]) -> None:
+        if attr is None or not attr.startswith("_"):
+            return
+        if attr in self.a.canonical:
+            return  # the locks themselves are not guarded data
+        self.a.accesses.append(
+            _Access(attr, kind, node, self.method, held))
+
+    def walk(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                self.walk(item.context_expr, held)
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    inner.add(lock)
+            for child in node.body:
+                self.walk(child, frozenset(inner))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A nested def is a closure: it may run on any thread, so
+            # the enclosing with-block's guarantee does not transfer.
+            for child in ast.iter_child_nodes(node):
+                self.walk(child, frozenset())
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                base = _base_self_attr(t)
+                if base is not None:
+                    self._record(base, "write", t, held)
+                    for sub in ast.walk(t):
+                        self._skip.add(id(sub))
+                else:
+                    self.walk(t, held)
+            value = getattr(node, "value", None)
+            if value is not None:
+                self.walk(value, held)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                base = _base_self_attr(t)
+                if base is not None:
+                    self._record(base, "write", t, held)
+                    for sub in ast.walk(t):
+                        self._skip.add(id(sub))
+                else:
+                    self.walk(t, held)
+            return
+        if isinstance(node, ast.Call):
+            # len(self._x) / list(self._x) / sorted(self._x.items()) ...
+            fname = dotted_name(node.func) or ""
+            if fname in _CONTAINER_FUNCS:
+                for arg in node.args:
+                    attr = _self_attr(arg)
+                    if attr is not None:
+                        self._record(attr, "container", arg, held)
+                        self._skip.add(id(arg))
+            # self._x.items() / self._x.append(...) ...
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _CONTAINER_METHODS:
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    self._record(attr, "container", node.func.value, held)
+                    self._skip.add(id(node.func.value))
+            for child in ast.iter_child_nodes(node):
+                self.walk(child, held)
+            return
+        if isinstance(node, ast.For):
+            attr = _self_attr(node.iter)
+            if attr is not None:
+                self._record(attr, "container", node.iter, held)
+                self._skip.add(id(node.iter))
+            for child in ast.iter_child_nodes(node):
+                self.walk(child, held)
+            return
+        if isinstance(node, ast.comprehension):
+            attr = _self_attr(node.iter)
+            if attr is not None:
+                self._record(attr, "container", node.iter, held)
+                self._skip.add(id(node.iter))
+            for child in ast.iter_child_nodes(node):
+                self.walk(child, held)
+            return
+        if isinstance(node, ast.Attribute) and id(node) not in self._skip:
+            attr = _self_attr(node)
+            if attr is not None:
+                kind = "read" if isinstance(node.ctx, ast.Load) else "write"
+                self._record(attr, kind, node, held)
+                self._skip.add(id(node.value))
+        for child in ast.iter_child_nodes(node):
+            self.walk(child, held)
+
+
+def _assert_owner_locks(fn: ast.AST, analysis: _ClassAnalysis) -> Set[str]:
+    """Locks declared held for the whole method via
+    ``assert_owner(self.<lock>)`` anywhere in its body."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        if name.split(".")[-1] != "assert_owner" or not node.args:
+            continue
+        attr = _self_attr(node.args[0])
+        if attr is None:
+            continue
+        out.add(analysis.canonical.get(attr, attr))
+        analysis.canonical.setdefault(attr, attr)
+    return out
+
+
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def begin_file(self, ctx: FileCtx) -> None:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(ctx, node)
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        pass  # all work happens per-class in begin_file
+
+    # --- per-class analysis ----------------------------------------------
+    def _methods(self, cls: ast.ClassDef):
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _collect_locks(self, cls: ast.ClassDef,
+                       analysis: _ClassAnalysis) -> None:
+        # Two passes so `self._cond = Condition(self._lock)` resolves
+        # regardless of declaration order.
+        assigns: List[Tuple[str, ast.Call]] = []
+        for fn in self._methods(cls):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None and _is_lock_ctor(node.value):
+                        assigns.append((attr, node.value))
+        for attr, call in assigns:
+            analysis.canonical.setdefault(attr, attr)
+        for attr, call in assigns:
+            ctor = (dotted_name(call.func) or "").split(".")[-1]
+            if ctor == "Condition" and call.args:
+                base = _self_attr(call.args[0])
+                if base is not None and base in analysis.canonical:
+                    analysis.canonical[attr] = analysis.canonical[base]
+
+    def _check_class(self, ctx: FileCtx, cls: ast.ClassDef) -> None:
+        analysis = _ClassAnalysis(cls.name)
+        self._collect_locks(cls, analysis)
+
+        for fn in self._methods(cls):
+            if fn.name in _EXEMPT_METHODS:
+                continue
+            walker = _MethodWalker(analysis, fn.name)
+            base_held = frozenset(_assert_owner_locks(fn, analysis))
+            for child in ast.iter_child_nodes(fn):
+                walker.walk(child, base_held)
+
+        if not analysis.canonical:
+            return
+
+        # Guard inference: locks under which each attr was WRITTEN.
+        guards: Dict[str, Set[str]] = {}
+        for acc in analysis.accesses:
+            if acc.kind == "write" and acc.held:
+                guards.setdefault(acc.attr, set()).update(acc.held)
+
+        # Functions that write each attr under its guard (TOCTOU side).
+        writes_under_guard: Dict[str, Set[str]] = {}
+        for acc in analysis.accesses:
+            if acc.kind == "write" and acc.held & guards.get(acc.attr,
+                                                             set()):
+                writes_under_guard.setdefault(acc.attr,
+                                              set()).add(acc.method)
+
+        for acc in analysis.accesses:
+            guard = guards.get(acc.attr)
+            if not guard:
+                continue
+            if acc.held & guard:
+                continue
+            lock_desc = "/".join(sorted(guard))
+            if acc.kind == "read" and \
+                    acc.method in writes_under_guard.get(acc.attr, set()):
+                msg = (
+                    f"check-then-act race (TOCTOU): `self.{acc.attr}` is "
+                    f"read outside `{lock_desc}` but written under it in "
+                    f"this same function — the value can change between "
+                    f"the check and the act; move the read inside the "
+                    f"locked region (re-check under the lock)"
+                )
+            elif acc.kind == "container":
+                msg = (
+                    f"iteration/copy/mutation of guarded container "
+                    f"`self.{acc.attr}` outside `{lock_desc}` — another "
+                    f"thread resizing it mid-walk raises 'dictionary "
+                    f"changed size' or yields a torn view (the PR-8 "
+                    f"registry race); snapshot it under the lock first"
+                )
+            elif acc.kind == "write":
+                msg = (
+                    f"write to `self.{acc.attr}` outside `{lock_desc}` — "
+                    f"the attribute is written under that lock elsewhere "
+                    f"in {analysis.name}; an unlocked write races every "
+                    f"guarded reader"
+                )
+            else:
+                msg = (
+                    f"read of `self.{acc.attr}` outside `{lock_desc}` — "
+                    f"the attribute is written under that lock; an "
+                    f"unlocked read can observe torn/stale state"
+                )
+            self.findings.append(Finding(
+                rule=self.rule,
+                path=ctx.relpath,
+                line=getattr(acc.node, "lineno", 0),
+                col=getattr(acc.node, "col_offset", 0),
+                message=msg,
+                symbol=f"{analysis.name}.{acc.method}",
+            ))
