@@ -85,6 +85,13 @@ from ray_dynamic_batching_tpu.engine.paging import (
     digest_chain,
     table_array,
 )
+from ray_dynamic_batching_tpu.engine.pagefabric import (
+    PREFIX,
+    STREAM,
+    PageParcel,
+    export_prefix_parcel,
+    export_stream_parcel,
+)
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.ops.tile_math import (
     lane_aligned_page,
@@ -92,6 +99,7 @@ from ray_dynamic_batching_tpu.ops.tile_math import (
     spec_scratch_pages,
 )
 from ray_dynamic_batching_tpu.profiles.table import bucket_up
+from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
 from ray_dynamic_batching_tpu.utils.tracing import link_to as _link_to
@@ -881,6 +889,22 @@ class DecodeEngine:
         # Requests mid-admission (dequeued, not yet slotted) — see _admit.
         self._admitting = 0
         self._admitting_batch: List[Request] = []
+        # --- page-fabric mailboxes (live migration + prefix push) ---
+        # Slots are engine-thread-owned; the controller/courier request
+        # work through these thread-safe mailboxes and the loop services
+        # them between decode turns (_service_fabric). The lock reuses
+        # the "allocator" rank (100) — its reserved purpose — and must
+        # NEVER be held across queue (80) or request-fulfil (90) calls:
+        # _service_fabric pops under the lock into locals, releases,
+        # then processes.
+        self._fabric_lock = OrderedLock("allocator")
+        self._migrate_out_q: List[Tuple[str, Callable[[PageParcel], bool]]] = []
+        self._push_out_q: List[Tuple[bytes, Callable[[PageParcel], bool]]] = []
+        self._parcel_in_q: List[PageParcel] = []
+        self.migrated_out = 0
+        self.migrated_in = 0
+        self.pushes_out = 0
+        self.pushes_in = 0
         self._thread: Optional[threading.Thread] = None
         self._run = threading.Event()
         self.steps = 0
@@ -2372,7 +2396,20 @@ class DecodeEngine:
                 limit - len(digests)
             ).items():
                 digests.setdefault(key, n)
-        return {"page_size": self.page_size, "digests": digests}
+        out: Dict[str, Any] = {
+            "page_size": self.page_size, "digests": digests,
+        }
+        if self.host_spill is not None:
+            # Spill round-trip convergence fix: a reload moves an entry
+            # between tiers without changing this engine's advertised
+            # union, so replacement-expiry upstream sees "unchanged" and
+            # never notifies out-of-process routers. Surface the reloaded
+            # keys so the controller forces a push (key present only when
+            # non-empty — steady-state publications stay byte-identical).
+            reloaded = self.host_spill.drain_republish()
+            if reloaded:
+                out["reloaded"] = reloaded
+        return out
 
     def _reclaim_cache_pins(self) -> bool:
         """Shed one LRU cache pin under pool pressure — prefix entries
@@ -3658,6 +3695,345 @@ class DecodeEngine:
             elif lengths_host[i] >= self.max_len:
                 self._finish(i, "capacity")
 
+    # --- page fabric (live stream migration + prefix push) -----------------
+    def live_stream_ids(self) -> List[str]:
+        """Request ids of migration-eligible streams: slotted, past
+        their first token, not mid-chunked-prefill (trains hold no
+        emitted tokens yet, so they are requeue-safe under the
+        at-most-once-after-first-token pin and drain the old way).
+        Benign-racy read for planners; eligibility is re-checked on the
+        engine thread at service time."""
+        out: List[str] = []
+        for i, s in enumerate(self._slots):
+            if s.free or s.request is None or i in self._train_slots:
+                continue
+            if s.generated:
+                out.append(s.request.request_id)
+        return out
+
+    def request_migration(
+        self, request_id: str,
+        deliver: Callable[[PageParcel], bool],
+    ) -> bool:
+        """Thread-safe: ask this engine to migrate ``request_id`` out
+        through ``deliver`` at its next between-turns service point.
+        ``deliver`` is invoked ON the engine thread with the frozen
+        parcel and must return True only once the destination accepted
+        it; the slot is committed (freed without fulfil) on True and
+        left decoding untouched on False/raise. Returns False if the
+        stream is not live here (advisory — a stream that finishes
+        before service is simply skipped, duplicates are harmless)."""
+        if not self.paged:
+            return False
+        live = any(
+            (not s.free) and s.request is not None
+            and s.request.request_id == request_id
+            for s in self._slots
+        )
+        if not live:
+            return False
+        with self._fabric_lock:
+            self._migrate_out_q.append((request_id, deliver))
+        return True
+
+    def request_prefix_push(
+        self, key: bytes, deliver: Callable[[PageParcel], bool],
+    ) -> bool:
+        """Thread-safe: export prefix-cache entry ``key`` as a push
+        parcel through ``deliver`` at the next service point (skipped
+        if evicted by then)."""
+        if not self.paged or self.paged_prefix is None:
+            return False
+        with self._fabric_lock:
+            self._push_out_q.append((key, deliver))
+        return True
+
+    def accept_parcel(self, parcel: PageParcel) -> bool:
+        """Thread-safe destination half of the courier edge: admission-
+        check ``parcel`` and enqueue it for import on the engine thread.
+        The checks are ADVISORY (the free-pages read races the engine
+        thread benignly); the import path keeps its own OOM fallback
+        chain (reclaim cache pins -> capacity-truncate), so a stale
+        accept is honest, never corrupting. A False return leaves the
+        source slot untouched — it simply resumes decoding."""
+        if not self.paged or parcel.page_size != self.page_size:
+            return False
+        if parcel.kind == STREAM:
+            if parcel.resume_len > self.max_len:
+                return False
+            s = parcel.sampling
+            if (float(s.get("temperature", 0.0)) > 0.0
+                    and int(s.get("base_seed", -1)) != self.base_seed):
+                # Sampled rows only resume byte-identically under the
+                # same engine-level PRNG base key; greedy rows never
+                # consult it.
+                return False
+            with self._fabric_lock:
+                pending = [p for p in self._parcel_in_q
+                           if p.kind == STREAM]
+                free_slots = sum(
+                    1 for i, sl in enumerate(self._slots)
+                    if sl.free and i not in self._train_slots
+                )
+                if len(pending) + 1 > free_slots:
+                    return False
+                pend_pages = sum(p.n_pages for p in pending)
+                if not self._allocator.can_alloc(
+                        pend_pages + parcel.n_pages):
+                    return False
+                self._parcel_in_q.append(parcel)
+            return True
+        # Prefix pushes are speculative: admission only rejects the
+        # impossible (bigger than the pool); a tight pool skips the
+        # install at import time rather than deepening pressure.
+        if parcel.n_pages > self.num_pages:
+            return False
+        with self._fabric_lock:
+            self._parcel_in_q.append(parcel)
+        return True
+
+    def _fabric_pending(self) -> bool:
+        if not self.paged:
+            return False
+        with self._fabric_lock:
+            return bool(self._parcel_in_q or self._migrate_out_q
+                        or self._push_out_q)
+
+    def _service_fabric(self) -> None:
+        """Engine thread, between decode turns: drain the parcel
+        mailboxes and process them. Pops under the rank-100 fabric lock
+        into locals FIRST, then processes unlocked — the handlers call
+        into queue accounting (rank 80) and request futures (rank 90),
+        which must never nest under rank 100."""
+        if not self.paged:
+            return
+        with self._fabric_lock:
+            if not (self._parcel_in_q or self._migrate_out_q
+                    or self._push_out_q):
+                return
+            inbound, self._parcel_in_q = self._parcel_in_q, []
+            moves, self._migrate_out_q = self._migrate_out_q, []
+            pushes, self._push_out_q = self._push_out_q, []
+        for parcel in inbound:
+            self._import_parcel(parcel)
+        for rid, deliver in moves:
+            self._migrate_stream_out(rid, deliver)
+        for key, deliver in pushes:
+            self._push_prefix_out(key, deliver)
+
+    def _migrate_stream_out(
+        self, request_id: str,
+        deliver: Callable[[PageParcel], bool],
+    ) -> None:
+        """Freeze -> deliver -> commit. The export is read-only and the
+        slot is torn down only AFTER the courier acknowledged delivery,
+        so every failure mode (courier death, partition mid-parcel,
+        destination refusal) leaves the stream decoding here as if the
+        directive never arrived."""
+        idx = None
+        for i, s in enumerate(self._slots):
+            if (not s.free and s.request is not None
+                    and s.request.request_id == request_id
+                    and i not in self._train_slots and s.generated):
+                idx = i
+                break
+        if idx is None:
+            return  # finished/moved since requested — nothing to do
+        slot = self._slots[idx]
+        req = slot.request
+        parcel = export_stream_parcel(self, idx)
+        ok = False
+        try:
+            ok = bool(deliver(parcel))
+        except Exception:  # noqa: BLE001 — courier faults must not kill the stream
+            logger.exception(
+                "%s: migrate_out delivery failed for %s",
+                self.model.name, request_id,
+            )
+        if not ok:
+            return
+        # Commit: the destination owns the stream now. Mirror _finish's
+        # slot/sampling reset WITHOUT fulfil or completion accounting —
+        # the same TokenStream keeps flowing from the new engine, and
+        # note_migrated_out closes this queue's books instead.
+        self._page_journal.record(
+            "migrate_out", parcel.n_pages,
+            self._allocator.allocated_pages,
+            slot=int(idx), bytes=parcel.nbytes, request=request_id,
+        )
+        self.queue.note_migrated_out(req)
+        self._free_slot_pages(idx)
+        self._slots[idx] = _Slot()
+        self._active_mask[idx] = False
+        self._temps[idx] = 0.0
+        self._topk[idx] = 0
+        self._topp[idx] = 1.0
+        self._seeds[idx] = 0
+        self._bias_ids[idx] = 0
+        self._bias_vals[idx] = 0.0
+        self._pres[idx] = 0.0
+        self._freq[idx] = 0.0
+        self.migrated_out += 1
+
+    def _import_parcel(self, parcel: PageParcel) -> None:
+        if parcel.kind == PREFIX:
+            self._install_prefix(parcel)
+            return
+        idx = None
+        for i, s in enumerate(self._slots):
+            if s.free and i not in self._train_slots:
+                idx = i
+                break
+        need = parcel.n_pages
+        if idx is not None:
+            while not self._allocator.can_alloc(need):
+                # Accepted capacity evaporated (admissions raced the
+                # courier): cache pins are optimizations, inbound live
+                # streams are not.
+                if not self._reclaim_cache_pins():
+                    break
+        if idx is None or not self._allocator.can_alloc(need):
+            # OOM-after-accept last resort: a complete-but-truncated
+            # result — the same honest contract as cache exhaustion.
+            self._fulfill_truncated(parcel)
+            return
+        pages = self._allocator.alloc(need) if need else []
+        if parcel.payload:
+            self._write_pages(pages, parcel.payload)
+        self._register_migrated(idx, parcel, pages)
+        self._page_journal.record(
+            "migrate_in", need, self._allocator.allocated_pages,
+            slot=int(idx), bytes=parcel.nbytes,
+            request=parcel.request.request_id,
+        )
+        self.queue.note_migrated_in(parcel.request)
+        self.migrated_in += 1
+
+    def _register_migrated(
+        self, slot_idx: int, parcel: PageParcel, pages: List[int],
+    ) -> None:
+        """Splice an imported stream into ``slot_idx`` and resume it.
+        The _register variant for a stream that already emitted tokens:
+        no stream_put / TTFT / prefill accounting (all happened at the
+        source), device ``lengths`` set explicitly (normally the
+        prefill program's job), penalty counts reconstructed from the
+        generated list (the counts row of a live slot equals
+        ``bincount(generated)`` — the scan counts only tokens it
+        sampled plus the register-counted first token, and a live slot
+        kept every one of them)."""
+        slot = self._slots[slot_idx]
+        slot.request = parcel.request
+        slot.generated = list(parcel.generated)
+        slot.max_new_tokens = parcel.max_new_tokens
+        slot.prefill_done_ms = parcel.prefill_done_ms
+        slot.last_token = int(parcel.generated[-1])
+        slot.stop = parcel.stop
+        slot.session_id = parcel.session_id
+        slot.prompt_tokens = parcel.prompt_tokens
+        slot.pages = list(pages)
+        slot.shared_pages = 0
+        self._len_host[slot_idx] = int(parcel.cache_len)
+        self._table_host[slot_idx] = table_array(
+            slot.pages, self._n_table_entries, self.num_pages
+        )
+        self._table_dirty = True
+        self._tokens[slot_idx, 0] = slot.last_token
+        self._active_mask[slot_idx] = True
+        s = parcel.sampling
+        self._temps[slot_idx] = float(s.get("temperature", 0.0))
+        self._topk[slot_idx] = int(s.get("top_k", 0))
+        self._topp[slot_idx] = float(s.get("top_p", 1.0))
+        self._seeds[slot_idx] = int(s.get("seed", 0))
+        self._bias_ids[slot_idx] = np.asarray(s["bias_ids"]) \
+            if "bias_ids" in s else 0
+        self._bias_vals[slot_idx] = np.asarray(s["bias_vals"]) \
+            if "bias_vals" in s else 0.0
+        self._pres[slot_idx] = float(s.get("presence_penalty", 0.0))
+        self._freq[slot_idx] = float(s.get("frequency_penalty", 0.0))
+        self._sampling_dev = None  # host arrays changed
+        with self._device_ctx():
+            self._cache = self._cache.replace(
+                lengths=self._cache.lengths.at[slot_idx].set(
+                    int(parcel.cache_len)
+                )
+            )
+            if self._pres[slot_idx] or self._freq[slot_idx]:
+                vocab = int(self._counts.shape[1])
+                row = np.bincount(
+                    np.asarray(parcel.generated, np.int64) % vocab,
+                    minlength=vocab,
+                )[:vocab].astype(np.int32)
+                self._counts = self._counts.at[slot_idx].set(
+                    jnp.asarray(row)
+                )
+
+    def _fulfill_truncated(self, parcel: PageParcel) -> None:
+        """Destination-OOM fallback after accept: resolve the stream as
+        complete-but-truncated instead of stranding it (the source
+        already committed the hand-off and cannot take it back)."""
+        req = parcel.request
+        t = now_ms()
+        self.queue.note_migrated_in(req)
+        req.fulfill(DecodeResult(
+            tokens=list(parcel.generated),
+            finish_reason="capacity",
+            ttft_ms=parcel.prefill_done_ms - req.arrival_ms,
+            total_ms=t - req.arrival_ms,
+        ))
+        self.queue.record_batch_completion([req], completed_at_ms=t)
+        self.completed += 1
+        logger.warning(
+            "%s: migrated-in stream %s capacity-truncated "
+            "(destination OOM after accept)",
+            self.model.name, req.request_id,
+        )
+
+    def _push_prefix_out(
+        self, key: bytes, deliver: Callable[[PageParcel], bool],
+    ) -> None:
+        parcel = export_prefix_parcel(self, key)
+        if parcel is None:
+            return  # evicted between planning and export
+        ok = False
+        try:
+            ok = bool(deliver(parcel))
+        except Exception:  # noqa: BLE001 — a failed push costs nothing
+            logger.exception(
+                "%s: prefix push delivery failed", self.model.name
+            )
+        if not ok:
+            return
+        self._page_journal.record(
+            "push_out", parcel.n_pages,
+            self._allocator.allocated_pages, bytes=parcel.nbytes,
+        )
+        self.pushes_out += 1
+
+    def _install_prefix(self, parcel: PageParcel) -> None:
+        """Install a pushed prefix parcel digest-direct: alloc, write,
+        publish under the parcel's chain address. Skips duplicates and
+        tight pools (a speculative warm must never evict local state to
+        make room for itself)."""
+        cache = self.paged_prefix
+        if cache is None or not parcel.digest:
+            return
+        if parcel.digest in cache._entries:
+            return
+        need = parcel.n_pages
+        if not self._allocator.can_alloc(need):
+            return
+        pages = self._allocator.alloc(need)
+        self._write_pages(pages, parcel.payload)
+        if cache.install(parcel.digest, pages):
+            self._page_journal.record(
+                "push_in", need, self._allocator.allocated_pages,
+                bytes=parcel.nbytes,
+            )
+            self.pushes_in += 1
+        # Pin symmetry: install increfs for the cache; drop the alloc's
+        # own hold (pages free immediately on the losing race branch).
+        self._allocator.decref(pages)
+
     # --- loop --------------------------------------------------------------
     def run_until_idle(self, timeout_s: float = 60.0) -> None:
         """Drive admissions + steps until queue and slots are empty (tests,
@@ -3665,12 +4041,14 @@ class DecodeEngine:
         deadline = time.monotonic() + timeout_s
         with self._device_ctx():
             while time.monotonic() < deadline:
+                self._service_fabric()
                 admitted = self._admit()
                 self._pump_prefill()
                 if self._active_mask.any():
                     self._step()
                 elif (not admitted and not self._trains
-                        and len(self.queue) == 0):
+                        and len(self.queue) == 0
+                        and not self._fabric_pending()):
                     return
         raise TimeoutError(f"{self.model.name}: decode did not drain")
 
@@ -3678,6 +4056,7 @@ class DecodeEngine:
         with self._device_ctx():
             while self._run.is_set():
                 try:
+                    self._service_fabric()
                     self._admit()
                     self._pump_prefill()
                     if self._active_mask.any():
@@ -3755,6 +4134,18 @@ class DecodeEngine:
                 self._release_pages(train.opts)
         self._trains.clear()
         self._train_slots.clear()
+        # Accepted-but-unimported inbound parcels hold live streams the
+        # SOURCE already released (note_migrated_out closed its books);
+        # reject them too — they entered no books here, so conservation
+        # holds on both sides.
+        if self.paged:
+            with self._fabric_lock:
+                inbound, self._parcel_in_q = self._parcel_in_q, []
+                self._migrate_out_q.clear()
+                self._push_out_q.clear()
+            for parcel in inbound:
+                if parcel.kind == STREAM and parcel.request is not None:
+                    parcel.request.reject(exc)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -3830,6 +4221,12 @@ class DecodeEngine:
                 "journal_total": self._page_journal.total,
                 "journal_rotated": self._page_journal.rotated_out,
             }
+            out["fabric"] = {
+                "migrated_out": self.migrated_out,
+                "migrated_in": self.migrated_in,
+                "pushes_out": self.pushes_out,
+                "pushes_in": self.pushes_in,
+            }
         if self.draft_model is not None:
             out["spec"] = {
                 "spec_tokens": self.spec_tokens,
@@ -3847,6 +4244,9 @@ class DecodeEngine:
         """Work in flight: active slots OR requests mid-admission
         (dequeued but not yet slotted — invisible to both queue depth
         and ``active_slots``; drain logic that ignores this window
-        aborts requests seconds from their first token)."""
+        aborts requests seconds from their first token). Accepted-but-
+        unimported inbound parcels count too: the source already
+        committed the hand-off."""
         return (self._admitting > 0 or bool(self._trains)
-                or bool(self._active_mask.any()))
+                or bool(self._active_mask.any())
+                or self._fabric_pending())

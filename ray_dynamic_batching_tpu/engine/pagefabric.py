@@ -1,0 +1,179 @@
+"""KV page parcels — page sets as shippable objects (ISSUE 18 tentpole).
+
+PAPER.md's layer survey names the plasma object store (L2) as the one
+substrate layer this repo had not re-created: Ray makes data a
+first-class shippable object. Our page sets are already refcounted
+(PageAllocator), cluster-identified by :func:`~.paging.digest_chain`,
+and spillable to host RAM (HostSpillTier) — this module adds the last
+property, *mobility*. A :class:`PageParcel` is a page run serialized to
+host numpy (int8 scale planes included) plus everything needed to
+resume it elsewhere:
+
+- **Stream parcels** carry a LIVE decode stream: the page contents
+  covering its cached tokens, the full sampling row
+  (temperature/top-k/top-p/seed/penalties/bias — and ``base_seed``,
+  because the device PRNG key is ``fold_in(fold_in(PRNGKey(base_seed),
+  seed), len(generated))``, all host-derivable), and the stream cursor
+  (the ``generated`` list + the live :class:`Request` object itself).
+  Re-registering the parcel on a destination engine resumes the SAME
+  ``TokenStream`` — re-routed, never retried — so the
+  at-most-once-after-first-token pin holds across the move and the
+  client sees an uninterrupted stream.
+- **Prefix parcels** carry one prefix-cache entry addressed by its
+  chain digest: the receiver installs it digest-direct
+  (``PagedPrefixCache.install`` — token bytes never leave the source
+  replica) so a hot prompt warms peers ahead of demand.
+
+The export/import functions here run ON the owning engine's thread
+(the engine services its parcel mailboxes between decode turns — see
+``DecodeEngine._service_fabric``); everything in this module is
+therefore single-threaded with respect to the engine state it touches.
+The transfer plane that moves parcels BETWEEN engines lives in
+``serve/kv_fabric.py`` and rides the ControlFabric seam, so chaos
+partition windows apply to couriers exactly as to every other control
+edge.
+
+Token-exactness across a migration is a host-arithmetic fact, pinned in
+tier-1: the sampled-token key depends only on (base_seed, per-request
+seed, len(generated)) and the penalty counts row equals
+``bincount(generated)`` for any live slot (the first token is counted
+at register; a surviving slot accepted every token the scan counted) —
+all of which the parcel carries or the importer reconstructs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_dynamic_batching_tpu.engine.paging import digest_chain
+from ray_dynamic_batching_tpu.ops.tile_math import pages_for
+
+# Parcel kinds: a live stream move vs a speculative prefix replication.
+STREAM = "stream"
+PREFIX = "prefix"
+
+
+@dataclass
+class PageParcel:
+    """One shippable page set + the state to resume it elsewhere.
+
+    In-process transfer object: arrays are host numpy copies (gathered
+    off-device by the exporter), ``request`` is the live Request whose
+    TokenStream keeps flowing after the import splices the pages in.
+    ``digest`` is the chain address of the deepest full page covered
+    (``b""`` when less than one full page is cached) — the same 16-byte
+    identity the prefix caches, spill tier, and router directory key by.
+    """
+
+    kind: str                                   # STREAM | PREFIX
+    page_size: int
+    cache_len: int                              # tokens the pages cover
+    payload: Dict[str, np.ndarray]              # k/v [+ k_scale/v_scale]
+    digest: bytes = b""
+    src: str = ""                               # exporting engine/replica
+    # --- stream-only resume state ------------------------------------
+    request: Optional[Any] = None               # live engine Request
+    generated: List[int] = field(default_factory=list)
+    max_new_tokens: int = 0
+    prefill_done_ms: float = 0.0
+    stop: frozenset = frozenset()
+    session_id: Optional[str] = None
+    prompt_tokens: Optional[np.ndarray] = None
+    sampling: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def n_pages(self) -> int:
+        return pages_for(self.cache_len, self.page_size)
+
+    @property
+    def nbytes(self) -> int:
+        """Courier-priced size: page plane bytes + the resume tokens.
+        This is the ``parcel_bytes`` the replanner multiplies by the
+        courier rate (scheduler/replan.py::migration_parcel_cost)."""
+        n = sum(int(a.nbytes) for a in self.payload.values())
+        n += 4 * (len(self.generated)
+                  + (int(self.prompt_tokens.size)
+                     if self.prompt_tokens is not None else 0))
+        return n
+
+    @property
+    def resume_len(self) -> int:
+        """KV capacity a destination must offer: cached tokens plus the
+        tokens the stream may still generate."""
+        return self.cache_len + max(
+            0, self.max_new_tokens - len(self.generated)
+        )
+
+
+def export_stream_parcel(engine, slot_idx: int) -> PageParcel:
+    """Freeze ``slot_idx``'s live stream into a parcel (engine thread,
+    between decode turns). READ-ONLY: the slot keeps every page and all
+    host/device state — a failed delivery simply resumes decoding here,
+    because nothing was torn down to build the parcel."""
+    slot = engine._slots[slot_idx]
+    cache_len = int(engine._len_host[slot_idx])
+    need = pages_for(cache_len, engine.page_size)
+    # Headroom pages past the cached length hold garbage (the scan only
+    # attends < lengths); exporting them would ship dead bytes.
+    page_ids = list(slot.pages[:need])
+    payload = engine._read_pages(page_ids) if page_ids else {}
+    tokens = np.concatenate([
+        np.asarray(slot.prompt_tokens, np.int32)
+        if slot.prompt_tokens is not None else np.zeros((0,), np.int32),
+        np.asarray(slot.generated, np.int32),
+    ])
+    chain = digest_chain(tokens, engine.page_size)
+    return PageParcel(
+        kind=STREAM,
+        page_size=engine.page_size,
+        cache_len=cache_len,
+        payload=payload,
+        digest=chain[-1] if chain else b"",
+        src=engine.model.name,
+        request=slot.request,
+        generated=list(slot.generated),
+        max_new_tokens=slot.max_new_tokens,
+        prefill_done_ms=slot.prefill_done_ms,
+        stop=slot.stop,
+        session_id=slot.session_id,
+        prompt_tokens=slot.prompt_tokens,
+        sampling={
+            "temperature": float(engine._temps[slot_idx]),
+            "top_k": int(engine._topk[slot_idx]),
+            "top_p": float(engine._topp[slot_idx]),
+            "seed": int(engine._seeds[slot_idx]),
+            "presence_penalty": float(engine._pres[slot_idx]),
+            "frequency_penalty": float(engine._freq[slot_idx]),
+            "bias_ids": np.array(engine._bias_ids[slot_idx]),
+            "bias_vals": np.array(engine._bias_vals[slot_idx]),
+            # Exactness gate: the device PRNG base key is engine-level,
+            # so a sampled row only resumes byte-identically on an
+            # engine sharing it (accept_parcel refuses otherwise).
+            "base_seed": int(engine.base_seed),
+        },
+    )
+
+
+def export_prefix_parcel(engine, key: bytes) -> Optional[PageParcel]:
+    """One prefix-cache entry as a push parcel (engine thread). The
+    pages are pinned by the cache and never rewritten after publication
+    (CoW invariant), so the gather races nothing; None when the entry
+    was evicted between planning and export."""
+    cache = engine.paged_prefix
+    if cache is None:
+        return None
+    entry = cache._entries.get(key)
+    if entry is None:
+        return None
+    page_ids = list(entry)
+    return PageParcel(
+        kind=PREFIX,
+        page_size=engine.page_size,
+        cache_len=len(page_ids) * engine.page_size,
+        payload=engine._read_pages(page_ids),
+        digest=key,
+        src=engine.model.name,
+    )

@@ -90,7 +90,8 @@ class PageEventJournal:
     """
 
     KINDS = ("alloc", "free", "cow_copy", "cache_reclaim", "eviction",
-             "spill", "reload", "spec_commit", "spec_reject")
+             "spill", "reload", "spec_commit", "spec_reject",
+             "migrate_out", "migrate_in", "push_out", "push_in")
 
     def __init__(self, capacity: int = 4096) -> None:
         if capacity <= 0:
@@ -323,6 +324,12 @@ class PagedPrefixCache(_PinnedLRU):
                  allocator: PageAllocator):
         super().__init__(capacity, allocator)
         self.page_size = int(page_size)
+        # Per-entry hit counts (bumped on lookup hits at the level that
+        # matched): the push-replication planner's hotness ranking —
+        # ``hot()`` orders what THIS pool can export by demand actually
+        # observed here. Bounded lazily against 4x capacity so counters
+        # of long-evicted entries cannot accumulate forever.
+        self._hits: Dict[bytes, int] = {}
 
     def _pages_of(self, value) -> Sequence[int]:
         return value
@@ -355,6 +362,11 @@ class PagedPrefixCache(_PinnedLRU):
         for n in range(max_n, 0, -1):
             entry = self._get(keys[n - 1])
             if entry is not None:
+                key = keys[n - 1]
+                self._hits[key] = self._hits.get(key, 0) + 1
+                if len(self._hits) > 4 * self.capacity:
+                    self._hits = {k: v for k, v in self._hits.items()
+                                  if k in self._entries}
                 return list(entry), n * self.page_size
         return None
 
@@ -366,6 +378,34 @@ class PagedPrefixCache(_PinnedLRU):
         for n, key in enumerate(self._level_keys(prompt, n_full), start=1):
             if key not in self._entries:
                 self._put(key, tuple(page_ids[:n]))
+
+    def install(self, key: bytes, page_ids: Sequence[int]) -> bool:
+        """Publish ONE entry under a pre-computed digest ``key`` — the
+        fabric-push install path. A peer replica ships pages addressed
+        by the chain digest alone (16 bytes; token bytes never leave
+        their replica), so the receiver cannot recompute level keys —
+        it trusts the digest the way the router's directory already
+        does. ``page_ids`` must be held by the caller (refcount >= 1);
+        ``_put`` increfs the cache's own pin, the caller then drops its
+        hold — pin symmetry identical to a spill reload republishing.
+        Returns False (and pins nothing) when the key is already
+        present — a duplicate push refreshes recency instead."""
+        if key in self._entries:
+            self._get(key)
+            return False
+        self._put(key, tuple(page_ids))
+        return True
+
+    def hot(self, limit: int = 8) -> List[Tuple[str, int, int]]:
+        """The ``limit`` hottest RESIDENT entries as ``(digest_hex,
+        chain_len, hits)``, hit-rank ordered, zero-hit entries elided —
+        what the push planner considers worth replicating from here."""
+        ranked = sorted(
+            (k for k in self._entries if self._hits.get(k, 0) > 0),
+            key=lambda k: -self._hits.get(k, 0),
+        )
+        return [(k.hex(), len(self._entries[k]), self._hits.get(k, 0))
+                for k in ranked[:limit]]
 
 
 class PagedSessionCache(_PinnedLRU):
@@ -455,6 +495,14 @@ class HostSpillTier:
         self.spills = 0
         self.reloads = 0
         self.dropped = 0  # entries LRU-evicted from the tier itself
+        # Digests whose pages came BACK from host RAM since the last
+        # publication drain. A reload moves the entry between tiers
+        # without changing the union the replica advertises, so the
+        # directory's replacement-expiry sees "unchanged" and skips the
+        # long-poll notify — out-of-process routers would never converge
+        # after a spill round-trip. The controller drains this via
+        # ``prefix_digests`` and forces the push.
+        self._republish: List[str] = []
 
     def __contains__(self, key: bytes) -> bool:
         return key in self._entries
@@ -505,10 +553,20 @@ class HostSpillTier:
         del self._entries[key]
         self.pages_held -= n
         self.reloads += 1
+        self._republish.append(key.hex())
         if self.journal is not None:
             self.journal.record("reload", n, allocator.allocated_pages,
                                 digest=key.hex())
         return page_ids
+
+    def drain_republish(self) -> List[str]:
+        """Digests reloaded since the last drain (cleared on read): the
+        cluster-wide republish signal the controller's digest push path
+        consumes — see ``_republish``'s note on why tier moves must
+        force a directory notify even though the advertised set is
+        unchanged."""
+        out, self._republish = self._republish, []
+        return out
 
     def digests(self, limit: int = 128) -> Dict[str, int]:
         """Spilled entries as ``{digest_hex: chain_len}`` — published to
@@ -525,6 +583,7 @@ class HostSpillTier:
     def clear(self) -> None:
         self._entries.clear()
         self.pages_held = 0
+        self._republish.clear()
 
     def stats(self) -> Dict[str, int]:
         return {"entries": len(self._entries),

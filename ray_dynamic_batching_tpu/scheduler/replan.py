@@ -45,6 +45,15 @@ BRUTE_FORCE_LIMIT = 7  # assignment is brute-forced up to this many nodes
 # upload. One constant shared by matcher objective and audit pricing.
 RESHARD_MB_FACTOR = 2.0
 
+# Live-stream parcel courier rate (ms per MB of parcel bytes): a page
+# fabric migration ships KV page contents + the stream cursor through
+# host RAM across replicas (~8 GB/s effective for the gather-serialize-
+# scatter round trip -> ~0.125 ms/MB). Priced in the SAME objective as
+# resharding so "move the live streams" competes fairly with "move the
+# weights" (ISSUE 18); the sim twin and the soak's pause model read this
+# exact constant, the usual no-drift discipline.
+COURIER_MS_PER_MB = 0.125
+
 
 @dataclass
 class ModelEntry:
@@ -497,6 +506,11 @@ class ReplanDecision:
     # so pre-mesh audit payloads stay byte-identical.
     engine_widths: Optional[List[int]] = None
     mesh_degraded: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # Page-fabric courier share of migration_cost (ISSUE 18): what the
+    # live-stream parcels leaving reassigned engines cost, already summed
+    # into migration_cost. 0.0 (and elided from audits) when the caller
+    # passed no parcel sizes — pre-fabric decisions stay byte-identical.
+    live_migration_cost: float = 0.0
 
     def audit_fields(self) -> Dict[str, Any]:
         """The structured-audit payload (``scheduler/audit.py``), built
@@ -530,6 +544,10 @@ class ReplanDecision:
                 if n.mesh_shape != "1x1":
                     entry["mesh"] = n.mesh_shape
                 placements.append(entry)
+        if self.live_migration_cost > 0:
+            observed["live_migration_cost"] = round(
+                self.live_migration_cost, 1
+            )
         return {
             "observed": observed,
             "inputs": {
@@ -552,6 +570,7 @@ def decide_replan(
     capacity_factors: Optional[Sequence[float]] = None,
     engine_widths: Optional[Sequence[int]] = None,
     engine_meshes: Optional[Sequence[str]] = None,
+    live_parcel_bytes: Optional[Sequence[float]] = None,
 ) -> ReplanDecision:
     """One replan, decided but not applied: bin-pack the sessions, match
     the resulting node plans onto the engines with minimal movement, and
@@ -571,7 +590,14 @@ def decide_replan(
     a TP=4 model falls back to its TP=2 row when only a half-slice
     remains), plans land only on width-matching engines, and moving a
     resident model between shapes is priced as a weight-reshard. None =
-    the classic one-chip-per-engine domain, byte-identical decisions."""
+    the classic one-chip-per-engine domain, byte-identical decisions.
+
+    ``live_parcel_bytes`` (aligned with ``engine_models``; ISSUE 18)
+    gives each engine's live-stream KV parcel size: engines whose model
+    set CHANGES under the new assignment must also courier those streams
+    to their new homes, priced at :data:`COURIER_MS_PER_MB` in the same
+    objective — a replan that would bounce many hot streams loses to one
+    that leaves them put. None keeps pre-fabric decisions byte-identical."""
     engine_models = [frozenset(m) for m in engine_models]
     widths: Optional[List[int]] = None
     mesh_degraded: Dict[str, Dict[str, str]] = {}
@@ -618,6 +644,19 @@ def decide_replan(
         for e, n in enumerate(assignment)
         if n is not None
     )
+    live_cost = 0.0
+    if live_parcel_bytes is not None:
+        parcels = [float(b) for b in live_parcel_bytes]
+        if len(parcels) != len(engine_models):
+            raise ValueError(
+                f"live_parcel_bytes has {len(parcels)} entries for "
+                f"{len(engine_models)} engines"
+            )
+        for e, n in enumerate(assignment):
+            new = frozenset(n.models) if n is not None else frozenset()
+            if new != engine_models[e] and parcels[e] > 0:
+                live_cost += parcels[e] / 1e6 * COURIER_MS_PER_MB
+        migration_cost += live_cost
     return ReplanDecision(
         plan=plan,
         assignment=assignment,
@@ -631,4 +670,5 @@ def decide_replan(
         derated=derated,
         engine_widths=widths,
         mesh_degraded=mesh_degraded,
+        live_migration_cost=live_cost,
     )

@@ -58,6 +58,7 @@ from ray_dynamic_batching_tpu.serve.fabric import (
     FabricUnreachable,
     default_fabric,
 )
+from ray_dynamic_batching_tpu.serve.kv_fabric import KVPageFabric
 from ray_dynamic_batching_tpu.serve.long_poll import LongPollHost
 from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
 from ray_dynamic_batching_tpu.serve.observatory import SLOObservatory
@@ -218,6 +219,10 @@ class ServeController:
         # the partition soak can cut the controller off from its data
         # plane. Unconfigured it is the zero-overhead passthrough.
         self.fabric = fabric if fabric is not None else default_fabric()
+        # KV page fabric transfer plane (ISSUE 18): live-stream couriers
+        # for zero-drop drains + the prefix push-replication tick. Rides
+        # the same ControlFabric, so partition windows cut couriers too.
+        self.kv_fabric = KVPageFabric(fabric=self.fabric)
         self._deployments: Dict[str, _DeploymentState] = {}
         self._factories: Dict[str, Callable] = {}
         self._lock = OrderedLock("controller", reentrant=True)
@@ -582,6 +587,34 @@ class ServeController:
         marks a crashed/wedged victim (heal) vs a planned rollout."""
         router.requeue_drained(requests, victim_id, dead=dead)
 
+    def _migrate_live_streams(
+        self, victim: Replica, state: _DeploymentState,
+    ) -> None:
+        """Deferred pre-stop directive: migrate the victim's live decode
+        streams to surviving replicas through the page fabric (zero-drop
+        rolling update / scale-down). Runs OUTSIDE the controller lock —
+        it polls the drain for seconds. Peers resolve HERE, at run time,
+        so replacements started in the same reconcile pass are already
+        in ``state.replicas``. Replica kinds without a fabric surface
+        (batch replicas, slab engines) fall through to the stop()'s own
+        drain window — exactly the pre-fabric behavior. The heal path
+        never routes here: a dead engine cannot export its pages, so
+        salvage/requeue remains its only honest option."""
+        if not hasattr(victim, "live_stream_ids"):
+            return
+        peers = [r for r in state.replicas
+                 if r is not victim and not getattr(r, "_stopped", False)]
+        if not peers:
+            return
+        stats = self.kv_fabric.drain_streams(victim, peers, timeout_s=20.0)
+        if stats["requested"] or stats["remaining"]:
+            self.audit.record(
+                "live_migration",
+                key=state.config.name,
+                observed=stats,
+                diff={"migrated_from": victim.replica_id},
+            )
+
     def _reconcile(
         self,
         state: _DeploymentState,
@@ -738,6 +771,17 @@ class ServeController:
                                     self._redeliver(rt, reqs, vid)
                                 )
                             )
+                        # Migration directive BEFORE the stop: live
+                        # streams move to the surviving set (peers
+                        # resolved at run time, after this pass's
+                        # scale-up started the replacements) — rolling
+                        # updates are zero-drop by construction, the
+                        # stop's drain window is the fallback.
+                        deferred.append(
+                            lambda v=victim, st=state: (
+                                self._migrate_live_streams(v, st)
+                            )
+                        )
                         deferred.append(
                             lambda v=victim, st=state: (
                                 v.stop(timeout_s=60.0),
@@ -765,6 +809,14 @@ class ServeController:
                     break
             while len(state.replicas) > cfg.num_replicas:
                 victim = state.replicas.pop()  # newest first, ref compact
+                victim._stopped = True  # stale handles stop assigning
+                # Zero-drop shrink: same migration-before-stop directive
+                # as the rolling update above.
+                deferred.append(
+                    lambda v=victim, st=state: (
+                        self._migrate_live_streams(v, st)
+                    )
+                )
                 deferred.append(
                     lambda v=victim, st=state: (
                         v.stop(),
@@ -909,6 +961,14 @@ class ServeController:
                     src="controller", dst="router",
                 ):
                     changed = True
+                if pub.get("reloaded"):
+                    # Spill round-trip fix: a reload moved an entry
+                    # between that replica's tiers WITHOUT changing its
+                    # advertised union, so replacement-expiry reports
+                    # "unchanged" — force the long-poll push anyway or
+                    # out-of-process routers never reconverge on where
+                    # the entry now lives.
+                    changed = True
             except FabricUnreachable:
                 continue
         if changed:
@@ -991,6 +1051,18 @@ class ServeController:
                     except Exception:  # noqa: BLE001 — stats must not
                         pass           # stop control
                     self._publish_prefix_digests(state)
+                    try:
+                        # Prefix push-replication tick: hot entries move
+                        # toward least-loaded peers ahead of demand.
+                        # Only the directives are enqueued here (cheap);
+                        # parcel delivery happens on the engines'
+                        # threads at their next service points.
+                        self.kv_fabric.push_hot_prefixes(
+                            state.config.name, state.replicas,
+                            getattr(state.router, "digests", None),
+                        )
+                    except Exception:  # noqa: BLE001 — pushes are
+                        pass           # optimizations, never control-fatal
                     if state.policy is not None:
                         metrics = state.router.demand_metrics()
                         target = state.policy.step(

@@ -97,6 +97,8 @@ FABRIC_PARTITION = m.Gauge(
 #   controller.push   controller -> router long-poll notify
 #   controller.digest_push  controller -> router digest directory
 #   long_poll.listen  router/handle -> controller long-poll listen
+#   courier.migrate   KVPageFabric -> replica live-stream parcel delivery
+#   courier.push      KVPageFabric -> replica prefix-push parcel delivery
 
 
 class FabricUnreachable(RuntimeError):

@@ -221,6 +221,7 @@ class LLMReplica(Replica):
         the router's digest directory over the long-poll channel."""
         merged: dict = {}
         page_size = None
+        reloaded: List[str] = []
         for engine in self.engines.values():
             fn = getattr(engine, "prefix_digests", None)
             if fn is None:
@@ -229,13 +230,84 @@ class LLMReplica(Replica):
             if pub is None:
                 continue
             page_size = pub["page_size"]
+            # Spill round-trip republish (page fabric, satellite fix):
+            # forwarded so the controller can force a directory push even
+            # when the advertised union is unchanged.
+            reloaded.extend(pub.get("reloaded", ()))
             for key, n in pub["digests"].items():
                 if len(merged) >= limit:
                     break
                 merged.setdefault(key, n)
         if page_size is None:
             return None
-        return {"page_size": page_size, "digests": merged}
+        out: dict = {"page_size": page_size, "digests": merged}
+        if reloaded:
+            out["reloaded"] = reloaded
+        return out
+
+    # --- page fabric surface (live migration + prefix push) ---------------
+    def live_stream_ids(self) -> List[str]:
+        """Migration-eligible stream ids across this replica's bucket
+        engines (paged engines only; slab engines migrate nothing)."""
+        out: List[str] = []
+        for engine in self.engines.values():
+            fn = getattr(engine, "live_stream_ids", None)
+            if fn is not None and engine.paged:
+                out.extend(fn())
+        return out
+
+    def request_migration(self, request_id: str, deliver) -> bool:
+        """Ask whichever bucket engine holds ``request_id`` to migrate it
+        out through ``deliver`` (see DecodeEngine.request_migration)."""
+        for engine in self.engines.values():
+            if engine.paged and engine.request_migration(
+                    request_id, deliver):
+                return True
+        return False
+
+    def accept_parcel(self, parcel) -> bool:
+        """Destination half of the courier edge at replica granularity:
+        stream parcels route to the smallest capacity bucket that fits
+        the stream's resume length (same bandwidth-per-token rule as
+        fresh admissions), falling back to any accepting engine; prefix
+        parcels go to the largest engine (where long prompts land)."""
+        if self._stopped:
+            return False
+        if parcel.kind == "stream":
+            need = parcel.resume_len
+            for bucket in sorted(self.engines):
+                if bucket >= need and self.engines[bucket].accept_parcel(
+                        parcel):
+                    return True
+            for bucket in sorted(self.engines, reverse=True):
+                if self.engines[bucket].accept_parcel(parcel):
+                    return True
+            return False
+        return self.engine.accept_parcel(parcel)
+
+    def hot_prefixes(self, limit: int = 8) -> List[tuple]:
+        """Hit-ranked resident prefix entries across bucket engines, as
+        ``(digest_hex, n_pages, hits)`` — the push planner's ranking."""
+        out: List[tuple] = []
+        for engine in self.engines.values():
+            cache = getattr(engine, "paged_prefix", None)
+            if cache is None:
+                continue
+            out.extend(cache.hot(limit))
+        out.sort(key=lambda t: -t[2])
+        return out[:limit]
+
+    def request_prefix_push(self, digest_hex: str, deliver) -> bool:
+        """Export the prefix entry addressed by ``digest_hex`` through
+        ``deliver`` from whichever engine holds it."""
+        key = bytes.fromhex(digest_hex)
+        for engine in self.engines.values():
+            cache = getattr(engine, "paged_prefix", None)
+            if cache is None or key not in cache._entries:
+                continue
+            if engine.request_prefix_push(key, deliver):
+                return True
+        return False
 
     # --- router-facing surface --------------------------------------------
     def queue_len(self) -> int:

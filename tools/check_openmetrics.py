@@ -315,6 +315,18 @@ def _smoke() -> int:
             tags={"deployment": "dep-0", "qos": "standard"})
         obs.FIDELITY_DRIFT.set(
             0.42, tags={"hop": "engine.step", "model": "dep-0"})
+        # KV-page-fabric courier families (ISSUE 18): flood the REAL
+        # module singletons from serve/kv_fabric.py — 40 distinct edge
+        # labels against the 8-edge bound on the parcel counter (only
+        # two canonical courier edges exist; a mislabeled caller must
+        # collapse, not mint series) and 40 deployment names against the
+        # push counter's top-8 deployment bound.
+        from ray_dynamic_batching_tpu.serve import kv_fabric as kvf
+
+        for i in range(40):
+            kvf.PARCELS.inc(tags={"edge": f"courier-{i}",
+                                  "outcome": "shipped"})
+            kvf.PREFIX_PUSHES.inc(tags={"deployment": f"dep-{i}"})
         proxy = HTTPProxy(ProxyRouter(), port=0).start()
         try:
             url = f"http://127.0.0.1:{proxy.port}/metrics"
@@ -402,6 +414,27 @@ def _smoke() -> int:
         errors.append(
             "rdb_slo_alert_state missing or not encoding 'page' as "
             "index 2 of ALERT_STATES"
+        )
+    n_parcel_series = sum(1 for l in text.splitlines()
+                          if l.startswith("rdb_fabric_parcels_total{"))
+    if n_parcel_series != 8 + 1:
+        errors.append(
+            f"expected exactly 8 named courier edge series + __other__ "
+            f"on rdb_fabric_parcels_total, saw {n_parcel_series} — the "
+            "edge label bound broke"
+        )
+    if 'rdb_fabric_parcels_total{edge="__other__"' not in text:
+        errors.append(
+            "courier edge flood did not collapse into __other__ on "
+            "rdb_fabric_parcels_total"
+        )
+    n_push_series = sum(1 for l in text.splitlines()
+                        if l.startswith("rdb_prefix_pushes_total{"))
+    if n_push_series != 8 + 1:
+        errors.append(
+            f"expected exactly 8 named deployment series + __other__ on "
+            f"rdb_prefix_pushes_total, saw {n_push_series} — the "
+            "deployment label bound broke"
         )
     n_forecast_models = sum(
         1 for l in text.splitlines()

@@ -40,6 +40,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_partition_soak.py --sim
   echo "== SLO-observatory conformance (sim: burn alert fires+resolves, guilty hop named, steady arm silent, tools/observatory_smoke.json) =="
   python tools/run_observatory_soak.py --sim
+  echo "== KV-fabric migration conformance (sim: rolling update migrates every live stream, zero drops, exact conservation, tools/migration_smoke.json) =="
+  python tools/run_migration_soak.py --sim
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
   exec python -m pytest tests/ -q -m "not slow"
 fi
@@ -102,6 +104,10 @@ python tools/run_partition_soak.py --live --smoke
 echo "== SLO-observatory conformance (sim three-arm + live: pinned alert lifecycle, guilty hop named, forecasts scored) =="
 python tools/run_observatory_soak.py --sim
 python tools/run_observatory_soak.py --live --smoke
+
+echo "== KV-fabric migration conformance (sim two-arm + live two-engine rolling update: zero drops, token exactness through a mid-stream move, page + queue conservation) =="
+python tools/run_migration_soak.py --sim
+env RDB_TESTING_LOCKORDER=1 JAX_PLATFORMS=cpu python tools/run_migration_soak.py --live
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q

@@ -54,6 +54,14 @@ WATCHED_CALLS: Dict[str, Dict[str, str]] = {
     "long_poll.py": {
         ".listen_for_change": "long_poll.listen",
     },
+    # ISSUE 18: parcel delivery is a courier edge. The fabric-routed
+    # form passes the bound method as an argument
+    # (``fabric.call(edge, dst.accept_parcel, parcel, ...)``) so it
+    # never trips; a direct ``dst.accept_parcel(parcel)`` would dodge
+    # the chaos/partition windows the couriers exist to honor.
+    "kv_fabric.py": {
+        ".accept_parcel": "courier.migrate",
+    },
 }
 
 

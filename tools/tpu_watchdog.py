@@ -78,6 +78,8 @@ LLM_DEMO_TIMEOUT_S = 20 * 60.0
 KERNEL_AB_TIMEOUT_S = 15 * 60.0
 # First-light: 2 geometries x 2 backends.
 FIRST_LIGHT_TIMEOUT_S = 8 * 60.0
+# One real migration + one recompute-from-scratch prefill, tiny model.
+MIGRATE_TIMEOUT_S = 10 * 60.0
 MAX_ATTEMPTS = 4             # per step, while the relay is alive
 
 # A matmul plus a HOST FETCH (block_until_ready alone returns early on the
@@ -609,6 +611,24 @@ def capture_first_light() -> bool:
     )
 
 
+def capture_bench_llm_migrate() -> bool:
+    """KV-fabric migration economics on chip (ISSUE 18): one live
+    stream frozen, parcelled, and resumed on a second paged engine,
+    timed against paying a recompute-from-scratch prefill TTFT for the
+    same prompt — the pause-vs-recompute ratio the replanner's
+    COURIER_MS_PER_MB pricing claims. Only rc 0 commits: rc 1 means no
+    migration happened, and a record proving the opposite of the step's
+    point must not land."""
+    return _capture_demo(
+        "bench_llm_migrate",
+        [sys.executable, "tools/run_migration_soak.py", "--bench",
+         "--record", os.path.join(OUT_DIR, "bench_llm_migrate.json")],
+        MIGRATE_TIMEOUT_S, "bench_llm_migrate.json",
+        f"tpu_v5e: on-chip migration pause vs recompute TTFT {_now()}",
+        ok_rcs=(0,),
+    )
+
+
 STEPS = [
     ("first_light", capture_first_light),
     ("bench_llm", capture_bench_llm),
@@ -616,6 +636,7 @@ STEPS = [
     ("bench_llm_chunked", capture_bench_llm_chunked),
     ("bench_llm_spec", capture_bench_llm_spec),
     ("bench_llm_tp", capture_bench_llm_tp),
+    ("bench_llm_migrate", capture_bench_llm_migrate),
     ("bench", capture_bench),
     ("profiles", capture_profiles),
     ("slo_demo", capture_slo_demo),
