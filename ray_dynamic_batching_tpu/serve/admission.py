@@ -137,6 +137,16 @@ class AdmissionPolicy:
     depth_low: float = 0.1
     compliance_low: float = 0.80
     compliance_high: float = 0.95
+    # --- congested state (metastability defense, serve/retrybudget.py) ---
+    # While first-attempt SLO compliance sits at/below this floor the
+    # deployment is CONGESTED: the retry/hedge budget is held at zero —
+    # every re-dispatch would displace a first attempt that already
+    # cannot make its deadline, which is how retries hold a recovered
+    # cluster in collapse (metastable failure). 0.0 disables the state.
+    congested_floor: float = 0.0
+    # Exit bar (hysteresis): compliance must recover to at least this
+    # before the budget is restored; 0.0 defaults to compliance_high.
+    congested_exit: float = 0.0
 
     def __post_init__(self) -> None:
         if self.burst <= 0.0:
@@ -147,6 +157,13 @@ class AdmissionPolicy:
             raise ValueError(
                 "compliance_high must be >= compliance_low (hysteresis)"
             )
+        if self.congested_floor > 0.0:
+            if self.congested_exit <= 0.0:
+                self.congested_exit = self.compliance_high
+            if self.congested_exit < self.congested_floor:
+                raise ValueError(
+                    "congested_exit must be >= congested_floor (hysteresis)"
+                )
 
     def class_rate(self, qos: str, degraded: bool) -> float:
         if not degraded:
@@ -163,6 +180,12 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._policies: Dict[str, AdmissionPolicy] = {}
         self._degraded: Dict[str, bool] = {}
+        # Congested: first-attempt attainment under floor — the retry
+        # budget is zeroed until it recovers (ISSUE 19 metastability
+        # defense). Orthogonal to degraded: a deployment can shed
+        # best-effort (degraded) without being so far gone that
+        # re-dispatches must stop (congested).
+        self._congested: Dict[str, bool] = {}
         # (deployment, tenant, qos) -> bucket; tenants over the policy's
         # top-K collapse into one shared overflow bucket (see
         # AdmissionPolicy.max_tenants).
@@ -191,6 +214,7 @@ class AdmissionController:
             if policy is None:
                 self._policies.pop(deployment, None)
                 self._degraded.pop(deployment, None)
+                self._congested.pop(deployment, None)
                 self._tenants_seen.pop(deployment, None)
                 for key in [k for k in self._buckets if k[0] == deployment]:
                     del self._buckets[key]
@@ -216,15 +240,24 @@ class AdmissionController:
         with self._lock:
             return self._degraded.get(deployment, False)
 
-    def force_state(self, deployment: str, degraded: bool) -> None:
+    def congested(self, deployment: str) -> bool:
+        with self._lock:
+            return self._congested.get(deployment, False)
+
+    def force_state(self, deployment: str, degraded: bool,
+                    congested: Optional[bool] = None) -> None:
         """Restore the governor state from a durable mirror (controller
         failover: the successor's fresh controller must keep enforcing
         the degraded-mode contract the old leader declared, not re-admit
         the flood until its own hysteresis re-detects it). Bucket rates
-        re-derive lazily at the next admit, as with observe()."""
+        re-derive lazily at the next admit, as with observe().
+        ``congested=None`` leaves the congested verdict untouched (old
+        mirrors predate the key)."""
         with self._lock:
             if deployment in self._policies:
                 self._degraded[deployment] = bool(degraded)
+                if congested is not None:
+                    self._congested[deployment] = bool(congested)
 
     # --- the admission decision -------------------------------------------
     def admit(
@@ -301,6 +334,7 @@ class AdmissionController:
                 return None
             recent_rejects = self._rejects_since_observe.pop(deployment, 0)
             degraded = self._degraded.get(deployment, False)
+            transition = None
             if not degraded and (
                 depth_frac >= policy.depth_high
                 or slo_compliance <= policy.compliance_low
@@ -314,32 +348,71 @@ class AdmissionController:
             ):
                 self._degraded[deployment] = False
                 transition = "recover"
-            else:
+            # Congested hysteresis (its OWN axis — a tick may flip both):
+            # enter at/below the attainment floor, exit only at/above the
+            # exit bar. No zero-rejects gate here: while congested the
+            # budget itself sheds re-dispatches, so rejects are the
+            # defense WORKING, not evidence the flood persists.
+            congest_transition = None
+            if policy.congested_floor > 0.0:
+                congested = self._congested.get(deployment, False)
+                if not congested and \
+                        slo_compliance <= policy.congested_floor:
+                    self._congested[deployment] = True
+                    congest_transition = "congest"
+                elif congested and \
+                        slo_compliance >= policy.congested_exit:
+                    self._congested[deployment] = False
+                    congest_transition = "clear_congestion"
+            if transition is None and congest_transition is None:
                 return None
-            self.transitions += 1
+            self.transitions += (transition is not None) + (
+                congest_transition is not None)
             now_degraded = self._degraded[deployment]
+            now_congested = self._congested.get(deployment, False)
             fractions = dict(policy.degraded_class_fractions)
-        GOVERNOR_STATE.set(
-            1.0 if now_degraded else 0.0, tags={"deployment": deployment}
-        )
-        logger.warning(
-            "%s: admission governor %s (depth_frac=%.3f compliance=%.3f)",
-            deployment, transition.upper(), depth_frac, slo_compliance,
-        )
-        if self.audit is not None:
-            self.audit.record(
-                "admission_governor",
-                key=deployment,
-                observed={"depth_frac": round(depth_frac, 4),
-                          "slo_compliance": round(slo_compliance, 4)},
-                before={"state": "normal" if now_degraded else "degraded"},
-                after={"state": "degraded" if now_degraded else "normal"},
-                diff={"class_rate_fractions": (
-                    fractions if now_degraded else
-                    {c: 1.0 for c in fractions}
-                )},
+        if transition is not None:
+            GOVERNOR_STATE.set(
+                1.0 if now_degraded else 0.0, tags={"deployment": deployment}
             )
-        return transition
+            logger.warning(
+                "%s: admission governor %s (depth_frac=%.3f "
+                "compliance=%.3f)",
+                deployment, transition.upper(), depth_frac, slo_compliance,
+            )
+            if self.audit is not None:
+                self.audit.record(
+                    "admission_governor",
+                    key=deployment,
+                    observed={"depth_frac": round(depth_frac, 4),
+                              "slo_compliance": round(slo_compliance, 4)},
+                    before={"state": "normal" if now_degraded
+                            else "degraded"},
+                    after={"state": "degraded" if now_degraded
+                           else "normal"},
+                    diff={"class_rate_fractions": (
+                        fractions if now_degraded else
+                        {c: 1.0 for c in fractions}
+                    )},
+                )
+        if congest_transition is not None:
+            logger.warning(
+                "%s: admission governor %s (compliance=%.3f floor=%.3f)",
+                deployment, congest_transition.upper(), slo_compliance,
+                policy.congested_floor,
+            )
+            if self.audit is not None:
+                self.audit.record(
+                    "admission_governor",
+                    key=deployment,
+                    observed={"slo_compliance": round(slo_compliance, 4),
+                              "congested_floor": policy.congested_floor},
+                    before={"congested": not now_congested},
+                    after={"congested": now_congested},
+                    diff={"retry_budget": ("zeroed" if now_congested
+                                           else "restored")},
+                )
+        return transition or congest_transition
 
     # --- observability -----------------------------------------------------
     def snapshot(self, deployment: str) -> Dict[str, object]:
@@ -350,6 +423,7 @@ class AdmissionController:
                 "state": ("degraded"
                           if self._degraded.get(deployment, False)
                           else "normal"),
+                "congested": self._congested.get(deployment, False),
                 "rate_rps": policy.rate_rps if policy else None,
                 "buckets": sum(
                     1 for k in self._buckets if k[0] == deployment

@@ -79,6 +79,8 @@ logger = get_logger("controller")
 CHECKPOINT_KEY = "serve:controller:checkpoint"  # ref controller.py:79-80
 REPLICA_SET_KEY = "serve:replicas:{deployment}"
 PREFIX_DIGEST_KEY = "serve:prefix_digests:{deployment}"
+QUARANTINE_KEY = "serve:quarantine:{deployment}"
+STORE_QUARANTINE_KEY = "serve:quarantine/{deployment}"
 # Controller-store keys (the replicated state the standby replays).
 STORE_CONFIG_KEY = "serve:deployments/{deployment}/config"
 STORE_REGISTRY_KEY = "serve:deployments/{deployment}/replicas"
@@ -138,6 +140,12 @@ class DeploymentConfig:
     # is EJECTED (replaced like a dead one, chip reclaimed). 0 = detect
     # and probation only, never auto-eject.
     gray_eject_after: int = 0
+    # --- metastable-failure defense (serve/retrybudget.py) ---
+    # Re-dispatches (failover retries + hedges) allowed per recent
+    # first-attempt dispatch; None = track without enforcing. The
+    # governor's `congested` verdict zeroes the budget in either mode.
+    retry_budget_fraction: Optional[float] = None
+    retry_budget_window: int = 512
 
     def to_json(self) -> Dict[str, Any]:
         d = {
@@ -159,6 +167,8 @@ class DeploymentConfig:
             "admission_burst": self.admission_burst,
             "hedge_interactive": self.hedge_interactive,
             "gray_eject_after": self.gray_eject_after,
+            "retry_budget_fraction": self.retry_budget_fraction,
+            "retry_budget_window": self.retry_budget_window,
         }
         if self.autoscaling is not None:
             d["autoscaling"] = vars(self.autoscaling)
@@ -258,6 +268,10 @@ class ServeController:
         self.observatory = SLOObservatory("serve")
         self.observatory.audit = self.audit
         self._observed_enqueued: Dict[str, float] = {}
+        # Last-published quarantine fingerprint set per deployment: the
+        # gossip tick fans out only on membership change (hit counters
+        # mutate constantly and must not re-trigger pushes).
+        self._quarantine_published: Dict[str, frozenset] = {}
 
     # --- deploy API (ref serve.run / deploy) ------------------------------
     def register_factory(
@@ -281,6 +295,9 @@ class ServeController:
             HedgePolicy,
         )
         from ray_dynamic_batching_tpu.serve.grayhealth import GrayHealthPolicy
+        from ray_dynamic_batching_tpu.serve.retrybudget import (
+            RetryBudgetPolicy,
+        )
 
         if config.gray_eject_after != router.gray.policy.eject_after:
             router.gray.policy = GrayHealthPolicy(
@@ -291,6 +308,17 @@ class ServeController:
         elif not config.hedge_interactive and router.hedge is not None:
             router.hedge.close()
             router.hedge = None
+        budget = getattr(router, "retry_budget", None)
+        if budget is not None and (
+            budget.policy.fraction != config.retry_budget_fraction
+            or budget.policy.window != config.retry_budget_window
+        ):
+            # Reprice keeps the ledger: recent first-attempt volume stays
+            # honest across a knob change.
+            budget.reconfigure(RetryBudgetPolicy(
+                fraction=config.retry_budget_fraction,
+                window=config.retry_budget_window,
+            ))
 
     def deploy(
         self,
@@ -311,6 +339,9 @@ class ServeController:
             from ray_dynamic_batching_tpu.serve.grayhealth import (
                 GrayHealthPolicy,
             )
+            from ray_dynamic_batching_tpu.serve.retrybudget import (
+                RetryBudgetPolicy,
+            )
 
             state = self._deployments.get(config.name)
             with self.store.txn() as txn:
@@ -326,6 +357,10 @@ class ServeController:
                             hedge_policy=(HedgePolicy()
                                           if config.hedge_interactive
                                           else None),
+                            retry_budget_policy=RetryBudgetPolicy(
+                                fraction=config.retry_budget_fraction,
+                                window=config.retry_budget_window,
+                            ),
                         )
                     else:
                         # Adopted (failover): reprice its policies from
@@ -585,7 +620,7 @@ class ServeController:
         replica, shed accounting when hopeless (terminal rejection
         belongs to the failover layer, not the heal path). ``dead``
         marks a crashed/wedged victim (heal) vs a planned rollout."""
-        router.requeue_drained(requests, victim_id, dead=dead)
+        router.requeue_drained(requests, victim_id, dead=dead)  # rdb-lint: disable=retry-amplification (heal-path salvage of a dead replica's queue — relocation of admitted work, not client-visible retry amplification)
 
     def _migrate_live_streams(
         self, victim: Replica, state: _DeploymentState,
@@ -979,6 +1014,34 @@ class ServeController:
                 src="controller", dst="router",
             )
 
+    def _publish_quarantine(self, state: "_DeploymentState") -> None:
+        """Gossip the deployment's query-of-death fingerprints the same
+        way prefix digests travel: durable mirror first (a failover
+        successor keeps fencing known poison), then a long-poll push so
+        every out-of-process front door merges the set and rejects
+        repeats at admission. Fans out only when MEMBERSHIP changed —
+        hit counters mutate on every front-door block and must not
+        re-trigger pushes. Lost pushes are safe: a missed entry costs
+        one more bisection on its next appearance, never correctness."""
+        name = state.config.name
+        registry = getattr(state.router, "quarantine", None)
+        if registry is None:
+            return
+        snap = registry.snapshot()
+        fps = frozenset(snap)
+        if fps == self._quarantine_published.get(name, frozenset()):
+            return
+        with self.store.txn() as txn:
+            txn.put_json(STORE_QUARANTINE_KEY.format(deployment=name),
+                         snap)
+        if not self.fabric.cast(
+            "controller.push", self.long_poll.notify_changed,
+            QUARANTINE_KEY.format(deployment=name), snap,
+            src="controller", dst="router",
+        ):
+            return  # dropped: republished on the next tick
+        self._quarantine_published[name] = fps
+
     def _renew_leadership(self) -> bool:
         """Heartbeat the store lease. A lapsed-but-UNCLAIMED lease (a
         long reconcile outran the renew cadence, nobody took over) is
@@ -1051,6 +1114,16 @@ class ServeController:
                     except Exception:  # noqa: BLE001 — stats must not
                         pass           # stop control
                     self._publish_prefix_digests(state)
+                    self._publish_quarantine(state)
+                    # Governor -> budget coupling: while this deployment
+                    # is congested (first-attempt attainment under
+                    # floor), its retry/hedge budget is held at zero so
+                    # recovery is monotone — amplification stops first.
+                    budget = getattr(state.router, "retry_budget", None)
+                    if budget is not None:
+                        budget.set_congested(
+                            self.admission.congested(state.config.name)
+                        )
                     try:
                         # Prefix push-replication tick: hot entries move
                         # toward least-loaded peers ahead of demand.
@@ -1093,7 +1166,9 @@ class ServeController:
                                 deployment=state.config.name
                             ),
                             {"state": ("degraded" if self.admission.degraded(
-                                state.config.name) else "normal")},
+                                state.config.name) else "normal"),
+                             "congested": self.admission.congested(
+                                state.config.name)},
                         )
                         txn.put_json(
                             STORE_GRAY_KEY.format(
@@ -1305,9 +1380,26 @@ class ServeController:
                     # Keep enforcing the old leader's degraded-mode
                     # declaration; recovery still exits through the
                     # normal hysteresis once the flood actually ebbs.
+                    # `congested` rides the same mirror (absent in
+                    # pre-budget mirrors -> None leaves it untouched);
+                    # the first control step pushes it back into the
+                    # router's retry budget.
                     self.admission.force_state(
-                        name, governor.get("state") == "degraded"
+                        name, governor.get("state") == "degraded",
+                        congested=governor.get("congested"),
                     )
+                quarantined = self.store.get_json(
+                    STORE_QUARANTINE_KEY.format(deployment=name)
+                )
+                if quarantined:
+                    # Known queries of death stay fenced across the
+                    # failover: merge the durable mirror into the adopted
+                    # router's registry before traffic resumes.
+                    with self._lock:
+                        st = self._deployments.get(name)
+                    if st is not None and getattr(
+                            st.router, "quarantine", None) is not None:
+                        st.router.quarantine.merge(quarantined)
                 recovered.append(name)
             return recovered
         raw = self.kv.get(CHECKPOINT_KEY)
@@ -1362,6 +1454,10 @@ class ServeController:
                     "gray": state.router.gray.snapshot(),
                     "hedge": (state.router.hedge.stats()
                               if state.router.hedge is not None else None),
+                    # Anti-amplification budget + query-of-death fence
+                    # (ISSUE 19): the metastable-failure defense pair.
+                    "retry_budget": state.router.retry_budget.stats(),
+                    "quarantine": state.router.quarantine.stats(),
                     # Admission governor state (serve/admission.py):
                     # normal vs degraded + whether a policy is installed.
                     "admission": self.admission.snapshot(name),

@@ -113,6 +113,37 @@ class RetriesExhausted(Exception):
         self.cause = cause
 
 
+class RetryBudgetExhausted(Exception):
+    """Terminal: the deployment's amplification budget
+    (serve/retrybudget.py) refused this re-dispatch — retries+hedges
+    already consumed their configured fraction of recent first-attempt
+    volume, or the overload governor declared the deployment congested.
+    Maps to 429 + Retry-After (RESOURCE_EXHAUSTED): the system is
+    shedding load deliberately, exactly like an admission reject — the
+    client backs off; the payload was never the problem."""
+
+    def __init__(self, message: str, cause: Optional[Exception] = None,
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.cause = cause
+        self.retry_after_s = retry_after_s
+
+
+class PoisonRequest(Exception):
+    """Terminal: batch bisection (serve/replica.py) isolated this
+    request as a query of death — its CONTENT crashes execution, so a
+    retry replicates the fault instead of recovering from it. Maps to
+    400 (gRPC INVALID_ARGUMENT), is never retried or hedged, and its
+    fingerprint lands in the QuarantineRegistry so front doors refuse
+    the identical query at admission."""
+
+    def __init__(self, message: str, cause: Optional[Exception] = None,
+                 fingerprint: str = ""):
+        super().__init__(message)
+        self.cause = cause
+        self.fingerprint = fingerprint
+
+
 def is_retryable(exc: BaseException) -> bool:
     """True for system failures the failover layer may re-dispatch.
 
@@ -164,8 +195,15 @@ def reject_disposition(exc: BaseException) -> RejectDisposition:
     from ray_dynamic_batching_tpu.engine.request import BadRequest
     from ray_dynamic_batching_tpu.serve.admission import AdmissionRejected
 
-    if isinstance(exc, BadRequest):
+    if isinstance(exc, (BadRequest, PoisonRequest)):
+        # A bisection-isolated poison is the payload's fault by proof of
+        # execution: same user-class surface as a validation failure.
         return RejectDisposition("user", 400, "INVALID_ARGUMENT")
+    if isinstance(exc, RetryBudgetExhausted):
+        return RejectDisposition(
+            "capacity", 429, "RESOURCE_EXHAUSTED",
+            retry_after_s=float(getattr(exc, "retry_after_s", 1.0) or 1.0),
+        )
     if getattr(exc, "reason", "") == "breaker_open":
         # Router terminal reject because EVERY live replica's breaker was
         # open: the system is failing, not merely full — 503, not 429.
@@ -234,6 +272,7 @@ class FailoverManager:
         self.retries = 0
         self.shed_deadline = 0
         self.shed_attempts = 0
+        self.shed_budget = 0
         self.stream_aborted = 0
 
     # --- replica-facing sink ---------------------------------------------
@@ -249,7 +288,7 @@ class FailoverManager:
                 self.stream_aborted += 1
                 req.reject(exc)
                 continue
-            self.submit(req, exc, exclude_replica=replica.replica_id)
+            self.submit(req, exc, exclude_replica=replica.replica_id)  # rdb-lint: disable=retry-amplification (submit() prices the budget itself — consulting here too would double-charge each re-dispatch)
 
     def on_batch_success(self, replica: Any) -> None:
         self.router.record_replica_success(replica.replica_id)
@@ -285,6 +324,23 @@ class FailoverManager:
             request.reject(RequestStale(
                 f"{request.request_id}: deadline unreachable after system "
                 f"failure ({exc})"
+            ))
+            return False
+        # Amplification budget (serve/retrybudget.py): a backoff retry is
+        # a re-dispatch drawing from the deployment's retry/hedge pool.
+        # Drain requeues (``immediate=True``) are exempt by design — a
+        # planned drain MOVES admitted work instead of amplifying it, and
+        # charging it would turn every rolling update into a shed storm.
+        budget = getattr(self.router, "retry_budget", None)
+        if not immediate and budget is not None \
+                and not budget.try_spend("retry"):
+            self.shed_budget += 1
+            FAILOVER_SHED.inc(
+                tags={"deployment": deployment, "reason": "retry_budget"}
+            )
+            request.reject(RetryBudgetExhausted(
+                f"{request.request_id}: retry budget exhausted "
+                f"(last failure: {exc})", cause=exc,
             ))
             return False
         with self._cond:
@@ -327,7 +383,7 @@ class FailoverManager:
                 ReplicaDeadError(f"{victim_id} died with request queued")
                 if dead else DrainEvicted(f"drained from {victim_id}")
             )
-            self.submit(req, exc, exclude_replica=victim_id, immediate=True)
+            self.submit(req, exc, exclude_replica=victim_id, immediate=True)  # rdb-lint: disable=retry-amplification (drain requeues MOVE admitted work off a retiring replica; immediate=True is the budget-exempt path submit() documents)
 
     # --- internals ----------------------------------------------------------
     def _backoff_ms(self, attempts: int) -> float:
@@ -376,6 +432,22 @@ class FailoverManager:
                     return
                 (_due, _seq, request, excluded,
                  submitted_ms) = heapq.heappop(self._heap)
+            # Deadline recheck at POP time: submit() priced the backoff
+            # into its pre-sleep check, but the cond wait is not exact
+            # (scheduler wakeup slop, notify storms) and the profiled
+            # attempt cost may have moved while we slept — a retry must
+            # never dispatch past the deadline it was admitted under.
+            if request.remaining_ms() < self._expected_latency_ms():
+                self.shed_deadline += 1
+                FAILOVER_SHED.inc(tags={
+                    "deployment": self.router.deployment,
+                    "reason": "deadline",
+                })
+                request.reject(RequestStale(
+                    f"{request.request_id}: backoff outlived the "
+                    f"admission deadline"
+                ))
+                continue
             try:
                 # assign_request owns terminal rejection (RequestDropped
                 # after its capped backoff window) — capped further by the
@@ -431,6 +503,7 @@ class FailoverManager:
             "retries": float(self.retries),
             "shed_deadline": float(self.shed_deadline),
             "shed_attempts": float(self.shed_attempts),
+            "shed_budget": float(self.shed_budget),
             "stream_aborted": float(self.stream_aborted),
             "pending": pending,
         }
@@ -560,6 +633,7 @@ class HedgeManager:
         self.won = 0
         self.lost = 0
         self.late = 0
+        self.budget_denied = 0
 
     # --- arming (router hot path: one eligibility check + heap push) ------
     def eligible(self, request: Request) -> bool:
@@ -640,7 +714,7 @@ class HedgeManager:
                     self._heap
                 )
             try:
-                self._fire(request, primary_replica)
+                self._fire(request, primary_replica)  # rdb-lint: disable=retry-amplification (_fire consults the hedge budget at fire time, after the delay — charging at pop would price hedges the race already settled)
             except Exception:  # noqa: BLE001 — one bad hedge must not kill
                 # the worker; the primary dispatch is unaffected either way.
                 logger.exception(
@@ -681,6 +755,18 @@ class HedgeManager:
             # No second replica / no deadline budget for a second
             # dispatch: the hedge would only add load, never save the
             # request.
+            self._outcome("late")
+            return
+        # Amplification budget (serve/retrybudget.py): a hedge is a
+        # second dispatch of already-admitted work — it draws from the
+        # same pool as a failover retry. A denied hedge is "late" in the
+        # conservation identity (timer fired, nothing dispatched) plus
+        # its own counter so operators can tell budget pressure from
+        # ordinary late fires.
+        budget = getattr(self.router, "retry_budget", None)
+        if budget is not None and not budget.try_spend("hedge"):
+            with self._stats_lock:
+                self.budget_denied += 1
             self._outcome("late")
             return
         # The primary exceeded the deployment's profiled p95 with nothing
@@ -837,5 +923,6 @@ class HedgeManager:
                 "won": float(self.won),
                 "lost": float(self.lost),
                 "late": float(self.late),
+                "budget_denied": float(self.budget_denied),
                 "pending": pending,
             }

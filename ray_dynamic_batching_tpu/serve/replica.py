@@ -26,7 +26,8 @@ from contextlib import ExitStack
 from ray_dynamic_batching_tpu.engine.batching import OpportunisticBatch
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
 from ray_dynamic_batching_tpu.engine.request import Request, RequestDropped
-from ray_dynamic_batching_tpu.serve.failover import is_retryable
+from ray_dynamic_batching_tpu.serve.failover import PoisonRequest, is_retryable
+from ray_dynamic_batching_tpu.serve.quarantine import poison_fingerprint
 from ray_dynamic_batching_tpu.utils.chaos import chaos
 from ray_dynamic_batching_tpu.utils.logging import get_logger
 from ray_dynamic_batching_tpu.utils import metrics as m
@@ -125,6 +126,14 @@ class Replica:
         # batch here for re-dispatch instead of poisoning the futures.
         # None (bare replicas in tests / engine tier) = reject as before.
         self.failure_sink = None
+        # Quarantine registry (serve/quarantine.QuarantineRegistry), wired
+        # by the router on registration: a non-retryable BATCH failure on a
+        # wired replica triggers query-of-death bisection instead of
+        # rejecting every co-batched innocent. None = legacy reject-all.
+        self.quarantine = None
+        self.bisect_probes = 0
+        self.rescue_batches = 0
+        self.poison_isolated = 0
 
     # --- router-facing surface -------------------------------------------
     def queue_len(self) -> int:
@@ -180,14 +189,17 @@ class Replica:
 
     # --- loop -------------------------------------------------------------
     def _stream_generator_batch(
-        self, batch: List[Request], gen: Any
+        self, batch: List[Request], gen: Any, stream: bool = True
     ) -> List[Any]:
         """Generator batching (ref ``serve/batching.py:209-276``): the
         callable yields, per step, a list of one chunk per request; each
         chunk streams to its request immediately, and the per-request chunk
         lists become the final results. A ``StopIteration``-style sentinel
         of ``None`` skips a request for that step (ref's semantics for
-        unequal-length generator outputs)."""
+        unequal-length generator outputs). ``stream=False`` collects
+        without emitting (bisection probes: a probe that later FAILS must
+        not have leaked tokens to innocents, or their rescue re-execution
+        would double-emit)."""
         collected: List[List[Any]] = [[] for _ in batch]
         for step in gen:
             if len(step) != len(batch):
@@ -199,8 +211,110 @@ class Replica:
                 if chunk is None:
                     continue
                 collected[i].append(chunk)
-                req.stream_put(chunk)
+                if stream:
+                    req.stream_put(chunk)
         return collected
+
+    def _execute_batch(
+        self, batch: List[Request], defer_stream: bool = False
+    ) -> List[Any]:
+        """One execution of the user callable over ``batch`` — the unit
+        the bisection re-runs. The chaos poison hook fires here so armed
+        query-of-death markers fail every probe that contains them (the
+        property isolation depends on). ``defer_stream`` holds generator
+        chunks until the whole generator completes, then replays them —
+        token-exact streams even when earlier probes of the same requests
+        failed partway."""
+        chaos().maybe_poison(
+            "replica.process_batch", [r.payload for r in batch]
+        )
+        results = self.fn([r.payload for r in batch])
+        if inspect.isgenerator(results):
+            results = self._stream_generator_batch(
+                batch, results, stream=not defer_stream
+            )
+            if defer_stream:
+                for req, chunks in zip(batch, results):
+                    for chunk in chunks:
+                        req.stream_put(chunk)
+        if len(results) != len(batch):
+            raise ValueError(
+                f"callable returned {len(results)} results for "
+                f"{len(batch)} requests"
+            )
+        return results
+
+    def _bisect_poison(self, batch: List[Request], exc: Exception) -> None:
+        """Query-of-death isolation: a non-retryable failure on a batch of
+        N is presumed to be ONE request's content. Binary-search it in
+        exactly ``ceil(log2 N)`` re-executions — each round probes the
+        first half of the suspect set; a raise implicates that half (the
+        other half parks as pending innocents), success fulfills it and
+        implicates the other half. The survivor is rejected terminally
+        (``PoisonRequest``, 4xx, never retried), fingerprinted into the
+        quarantine registry so every front door refuses repeats, and the
+        parked innocents get one rescue execution (re-bisected if it
+        fails again — multi-poison batches resolve recursively).
+
+        Never raises: isolation is the replica's last line before
+        reject-all, so its own failures degrade to rejection, not a dead
+        loop."""
+        suspects = list(batch)
+        deferred: List[Request] = []  # parked innocents, rescued at the end
+        probes = 0
+        while len(suspects) > 1:
+            mid = (len(suspects) + 1) // 2
+            lo, hi = suspects[:mid], suspects[mid:]
+            probes += 1
+            self.bisect_probes += 1
+            try:
+                results = self._execute_batch(lo, defer_stream=True)
+            except Exception as probe_exc:  # noqa: BLE001 — verdict, not crash
+                exc = probe_exc
+                deferred.extend(hi)
+                suspects = lo
+            else:
+                for req, res in zip(lo, results):
+                    req.fulfill(res)
+                self.queue.record_batch_completion(lo)
+                suspects = hi
+        poison = suspects[0]
+        fp = poison_fingerprint(self.deployment, poison.payload)
+        self.poison_isolated += 1
+        if self.quarantine is not None:
+            self.quarantine.add(fp, self.deployment, stage="isolated")
+        poison.reject(PoisonRequest(
+            f"{poison.request_id}: query of death isolated by batch "
+            f"bisection ({probes} probes over batch of {len(batch)}): "
+            f"{exc}",
+            cause=exc, fingerprint=fp,
+        ))
+        logger.warning(
+            "%s: quarantined poison request %s (fingerprint %s, %d probes)",
+            self.replica_id, poison.request_id, fp, probes,
+        )
+        if not deferred:
+            return
+        # Rescue pass: innocents whose half was implicated then cleared by
+        # a deeper probe were never executed — run them once, token-exact.
+        self.rescue_batches += 1
+        try:
+            results = self._execute_batch(deferred, defer_stream=True)
+        except Exception as rescue_exc:  # noqa: BLE001 — may be 2nd poison
+            if is_retryable(rescue_exc) and self.failure_sink is not None:
+                # System fault during rescue: these requests are innocent
+                # and retryable — failover re-dispatches them.
+                self.failure_sink.on_batch_failure(
+                    self, deferred, rescue_exc
+                )
+            else:
+                # A SECOND poison in the same batch: recurse (a singleton
+                # skips the loop above and is condemned directly).
+                self._bisect_poison(deferred, rescue_exc)
+        else:
+            for req, res in zip(deferred, results):
+                req.fulfill(res)
+            self.queue.record_batch_completion(deferred)
 
     def _process_batch(self, batch: List[Request]) -> None:
         with self._ongoing_lock:
@@ -248,14 +362,7 @@ class Replica:
                                 lane=self.replica_id,
                             )
                         )
-                results = self.fn([r.payload for r in batch])
-                if inspect.isgenerator(results):
-                    results = self._stream_generator_batch(batch, results)
-            if len(results) != len(batch):
-                raise ValueError(
-                    f"callable returned {len(results)} results for "
-                    f"{len(batch)} requests"
-                )
+                results = self._execute_batch(batch)
             if slowdown is not None:
                 if slowdown.mode == "latency_multiplier":
                     # The batch "runs" factor x as long as it measured —
@@ -289,8 +396,16 @@ class Replica:
                 # terminal — retrying a bad payload just fails again.
                 sink.on_batch_failure(self, batch, e)
             else:
-                for req in batch:
-                    req.reject(e)
+                if self.quarantine is not None and len(batch) > 1:
+                    # Router-wired replica, multi-request batch: presume
+                    # query of death and bisect — innocents complete, the
+                    # poison alone is condemned + quarantined. Bare
+                    # replicas and singleton batches keep the legacy
+                    # reject-with-original-exception contract.
+                    self._bisect_poison(batch, e)
+                else:
+                    for req in batch:
+                        req.reject(e)
                 if sink is not None:
                     # A user error is terminal for the REQUEST but proof
                     # of life for the REPLICA (it executed the callable):
@@ -422,4 +537,8 @@ class Replica:
     def stats(self) -> dict:
         s = self.queue.stats()
         s["ongoing"] = float(self.queue_len())
+        if self.poison_isolated or self.bisect_probes:
+            s["bisect_probes"] = float(self.bisect_probes)
+            s["rescue_batches"] = float(self.rescue_batches)
+            s["poison_isolated"] = float(self.poison_isolated)
         return s

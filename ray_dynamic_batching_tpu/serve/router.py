@@ -38,6 +38,12 @@ from ray_dynamic_batching_tpu.serve.failover import (
     FailoverPolicy,
     HedgeManager,
     HedgePolicy,
+    PoisonRequest,
+)
+from ray_dynamic_batching_tpu.serve.quarantine import QuarantineRegistry
+from ray_dynamic_batching_tpu.serve.retrybudget import (
+    RetryBudget,
+    RetryBudgetPolicy,
 )
 from ray_dynamic_batching_tpu.serve.grayhealth import (
     GrayHealthMonitor,
@@ -59,7 +65,8 @@ ROUTED_TOTAL = m.Counter(
 )
 ROUTER_REJECTED = m.Counter(
     "rdb_router_rejected_total",
-    "Requests rejected (reason: backoff_exhausted | breaker_open)",
+    "Requests rejected (reason: backoff_exhausted | breaker_open | "
+    "quarantined)",
     tag_keys=("deployment", "reason", "shard"),
     bounded_tags={"shard": m.DEFAULT_SHARD_TOP_K},
 )
@@ -360,6 +367,7 @@ class Router:
         breaker_slow_threshold: int = BREAKER_SLOW_THRESHOLD,
         gray_policy: Optional[GrayHealthPolicy] = None,
         hedge_policy: Optional[HedgePolicy] = None,
+        retry_budget_policy: Optional[RetryBudgetPolicy] = None,
     ) -> None:
         self.deployment = deployment
         self.max_assign_timeout_s = max_assign_timeout_s
@@ -395,6 +403,16 @@ class Router:
         # opt-in: None = never hedge.
         self.hedge = (HedgeManager(self, hedge_policy)
                       if hedge_policy is not None else None)
+        # Anti-amplification ledger (ISSUE 19): failover retries and hedge
+        # fires draw from one per-deployment budget funded by first-attempt
+        # volume. Permissive (track-only) unless a policy sets a fraction;
+        # the governor's `congested` verdict zeroes it in either mode.
+        self.retry_budget = RetryBudget(deployment, retry_budget_policy)
+        # Query-of-death fence: fingerprints isolated by replica-side batch
+        # bisection; checked at assign so a quarantined payload never
+        # reaches a replica again. Gossiped cluster-wide by the controller
+        # alongside the prefix digests.
+        self.quarantine = QuarantineRegistry()
         # Optional decision ring (the controller shares its own): breaker
         # trip/recover events are control-plane decisions and belong next
         # to heals and scale moves.
@@ -413,10 +431,16 @@ class Router:
         # sheds all land in the controller's shared timeline.
         self._audit = ring
         self.gray.audit = ring
+        self.quarantine.audit = ring
 
     def _wire(self, replica: Replica) -> None:
         if hasattr(replica, "failure_sink"):
             replica.failure_sink = self.failover
+        # Arms query-of-death bisection: a wired replica isolates poison
+        # requests instead of rejecting every co-batched innocent, and its
+        # verdicts land in the shared (gossiped) registry.
+        if hasattr(replica, "quarantine"):
+            replica.quarantine = self.quarantine
         # Class-aware displacement sheds are control-plane decisions: the
         # replica's queue records them into the same ring as heals,
         # breaker trips and governor transitions.
@@ -525,7 +549,7 @@ class Router:
         the failover path (deadline-budgeted, different replica) instead
         of erroring it back to callers. ``dead`` distinguishes a crashed
         replica (heal) from a planned retirement (rollout)."""
-        self.failover.requeue(requests, victim_id, dead=dead)
+        self.failover.requeue(requests, victim_id, dead=dead)  # rdb-lint: disable=retry-amplification (drain salvage relocates admitted work; FailoverManager.requeue routes it budget-exempt by design)
 
     # --- pow-2 choice -----------------------------------------------------
     def _queue_len(self, replica: Replica, now: float) -> int:
@@ -599,6 +623,22 @@ class Router:
         failed); ``timeout_s`` caps this call's backoff window below the
         router default (retries budget against the request's remaining
         admission deadline)."""
+        # Quarantine fence FIRST — a known query of death must never
+        # reach a replica again (a repeat would re-pay the bisection it
+        # already lost). Free while the registry is empty; re-dispatches
+        # hit it too, so an isolation elsewhere mid-flight still fences.
+        fp = self.quarantine.check(self.deployment, request.payload)
+        if fp is not None:
+            ROUTER_REJECTED.inc(
+                tags={"deployment": self.deployment,
+                      "reason": "quarantined", "shard": self.shard}
+            )
+            request.reject(PoisonRequest(
+                f"{self.deployment}: payload quarantined as query of "
+                f"death (fingerprint {fp})",
+                fingerprint=fp,
+            ))
+            return False
         # Assignment is its own traced hop: attempts > 1 means the request
         # burned wall-clock in backoff against saturated replicas — the
         # flight record shows that as router.assign duration, distinct
@@ -674,6 +714,11 @@ class Router:
                         # Invalidate the cache entry so bursts spread out.
                         self._len_cache.pop(chosen.replica_id, None)
                         request.attempts += 1
+                        if request.attempts == 1:
+                            # First dispatch funds the retry budget;
+                            # re-dispatches drew from it before reaching
+                            # this path (failover.submit / hedge._fire).
+                            self.retry_budget.record_first_attempt()
                         # The hedge fire path reads this: a failover
                         # re-dispatch moves the request, and the timer
                         # armed at first assign must follow it.

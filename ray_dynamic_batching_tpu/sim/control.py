@@ -35,6 +35,11 @@ from ray_dynamic_batching_tpu.scheduler.replan import (
     ModelEntry,
     decide_replan,
     sessions_for,
+    weighted_attainment,
+)
+from ray_dynamic_batching_tpu.serve.retrybudget import (
+    RetryBudget,
+    RetryBudgetPolicy,
 )
 from ray_dynamic_batching_tpu.serve.grayhealth import (
     GrayHealthMonitor,
@@ -133,6 +138,36 @@ class SimScheduler:
                 price=self._fidelity_price,
             )
             self.observatory.audit = self.audit
+        # --- client-retry model (ISSUE 19) --------------------------------
+        # None = disabled: no stale-shed hook is installed, canon
+        # scenarios stay byte-identical. enable_retries() turns stale
+        # sheds into budgeted resubmissions — the amplification loop
+        # that makes overload metastable when unbounded.
+        self._retry_policy: Optional[RetryBudgetPolicy] = None
+        self.retry_max_attempts = 0
+        self.retry_backoff_ms = 0.0
+        self.retry_budgets: Dict[str, RetryBudget] = {}
+        self.retry_submitted: Dict[str, int] = {}
+        # Per-class resubmission counts so the conservation identity
+        # extends under retries: offered + resubmitted_classes ==
+        # admission_rejected + enqueued, per (model, class).
+        self.retry_submitted_classes: Dict[str, Dict[str, int]] = {}
+        self.retry_denied: Dict[str, int] = {}
+        self.retry_exhausted: Dict[str, int] = {}
+        # Windowed weighted attainment sampled at monitor ticks — the
+        # recovery timeline the metastability pin grades.
+        self.attainment_timeline: List[Dict] = []
+        self._attainment_prev: Dict[str, Dict[str, Dict[str, float]]] = {}
+        # --- query-of-death tracking (ISSUE 19) ---------------------------
+        # Engines report isolations here (the sim twin of the replica ->
+        # router quarantine gossip); repeats of a quarantined poison_id
+        # are fenced at submit, never reaching a queue.
+        self._poison_quarantined: set = set()
+        self.poison_injected: Dict[str, int] = {}
+        self.poison_fenced: Dict[str, int] = {}
+        self.poison_isolations: List[Dict] = []
+        for e in self.engines:
+            e.on_poison = self._note_poison
 
     # --- registration (live register_model contract) ----------------------
     def register_model(self, name: str, slo_ms: float,
@@ -151,10 +186,30 @@ class SimScheduler:
     # --- ingress (live submit_request: demand recorded before enqueue) ----
     def submit(self, model: str, qos_class: str = DEFAULT_QOS_CLASS,
                tenant: str = DEFAULT_TENANT,
-               prefill_ms: float = 0.0) -> bool:
+               prefill_ms: float = 0.0,
+               poison_id: Optional[str] = None,
+               retry_attempt: int = 0) -> bool:
         entry = self._models.get(model)
         if entry is None:
             return False
+        if poison_id is not None:
+            self.poison_injected[model] = (
+                self.poison_injected.get(model, 0) + 1
+            )
+            if poison_id in self._poison_quarantined:
+                # Front-door fence (live QuarantineRegistry.check): a
+                # quarantined query of death is rejected at admission —
+                # it never reaches a queue, never poisons a batch twice.
+                self.poison_fenced[model] = (
+                    self.poison_fenced.get(model, 0) + 1
+                )
+                # The fence IS a front-door rejection (live: 4xx from the
+                # proxy) — count it so per-class conservation holds.
+                key = (model, qos_class)
+                self.admission_rejected[key] = (
+                    self.admission_rejected.get(key, 0) + 1
+                )
+                return False
         if self.admission is not None:
             ok, _retry_after_s = self.admission.admit(
                 model, tenant, qos_class
@@ -171,6 +226,10 @@ class SimScheduler:
         self.rates.record(model)
         if self.observatory is not None:
             self.observatory.note_arrivals(model)
+        if self._retry_policy is not None and retry_attempt == 0:
+            # First attempts FUND the budget (work-conserving fraction
+            # of real demand); retries only spend it.
+            self._retry_budget(model).record_first_attempt()
         return self.queues.queue(model).add_request(
             SimRequest(
                 model=model,
@@ -180,8 +239,95 @@ class SimScheduler:
                 qos_class=qos_class,
                 tenant=tenant,
                 prefill_ms=prefill_ms,
+                retry_attempt=retry_attempt,
+                poison_id=poison_id,
             )
         )
+
+    # --- client-retry model (ISSUE 19) ------------------------------------
+    def enable_retries(self, max_attempts: int = 3,
+                       backoff_ms: float = 50.0,
+                       budget_fraction: Optional[float] = None,
+                       budget_window: int = 512,
+                       min_first_attempts: int = 16) -> None:
+        """Turn stale sheds into client resubmissions with fresh
+        deadlines — the retry amplification loop. Each shed consults a
+        per-model :class:`RetryBudget` (the live serve-tier class, not a
+        re-expression): ``budget_fraction=None`` models naive clients
+        (unbounded retries — the metastable control arm), a fraction
+        bounds retry volume to that share of first-attempt demand, and
+        the admission governor's congested state zeroes it entirely."""
+        if max_attempts < 1:
+            raise ValueError("retry max_attempts must be >= 1")
+        if backoff_ms < 0:
+            raise ValueError("retry backoff_ms must be >= 0")
+        self._retry_policy = RetryBudgetPolicy(
+            fraction=budget_fraction, window=budget_window,
+            min_first_attempts=min_first_attempts,
+        )
+        self.retry_max_attempts = int(max_attempts)
+        self.retry_backoff_ms = float(backoff_ms)
+        self.queues.on_stale = self._on_stale_shed
+        for q in self.queues.queues().values():
+            q.on_stale = self._on_stale_shed
+
+    def _retry_budget(self, model: str) -> RetryBudget:
+        budget = self.retry_budgets.get(model)
+        if budget is None:
+            budget = RetryBudget(f"sim:{model}", self._retry_policy)
+            self.retry_budgets[model] = budget
+        return budget
+
+    def _on_stale_shed(self, queue, req: SimRequest) -> None:
+        """Stale-shed hook: the client saw a deadline miss and retries —
+        unless it has exhausted its attempts or the budget denies the
+        resubmission (the defense that keeps recovery monotone)."""
+        attempt = req.retry_attempt
+        if attempt + 1 >= self.retry_max_attempts:
+            self.retry_exhausted[req.model] = (
+                self.retry_exhausted.get(req.model, 0) + 1
+            )
+            return
+        if not self._retry_budget(req.model).try_spend("retry"):
+            self.retry_denied[req.model] = (
+                self.retry_denied.get(req.model, 0) + 1
+            )
+            return
+        self.retry_submitted[req.model] = (
+            self.retry_submitted.get(req.model, 0) + 1
+        )
+        per_cls = self.retry_submitted_classes.setdefault(req.model, {})
+        per_cls[req.qos_class] = per_cls.get(req.qos_class, 0) + 1
+        delay_ms = max(self.retry_backoff_ms * (2 ** attempt), 0.001)
+        self.loop.schedule_in(
+            delay_ms,
+            lambda m=req.model, q=req.qos_class, t=req.tenant,
+            pm=req.prefill_ms, p=req.poison_id, a=attempt + 1:
+            self.submit(m, qos_class=q, tenant=t, prefill_ms=pm,
+                        poison_id=p, retry_attempt=a),
+        )
+
+    def _note_poison(self, poison_id: str, model: str) -> None:
+        """Engine-side bisection condemned a query of death: quarantine
+        its id cluster-wide (the sim twin of the registry gossip) so a
+        repeat submission is fenced at the front door."""
+        new = poison_id not in self._poison_quarantined
+        self._poison_quarantined.add(poison_id)
+        self.poison_isolations.append({
+            "t_s": round(self.clock.now_s(), 6),
+            "model": model,
+            "poison_id": poison_id,
+            "new": new,
+        })
+        if new:
+            self.audit.record(
+                "poison_quarantine",
+                key=model,
+                observed={"poison_id": poison_id},
+                diff={"quarantined": poison_id},
+                note="query of death isolated by batch bisection; "
+                     "repeats fence at the front door",
+            )
 
     # --- scheduling: decide via the shared pure step, apply to sim engines
     def rebalance(
@@ -328,6 +474,7 @@ class SimScheduler:
             serial += 1
             if self.gray is not None:
                 engine.track_ratios = True
+            engine.on_poison = self._note_poison
             self.engines.append(engine)
             engine.start()
             reformed.append(engine)
@@ -418,6 +565,8 @@ class SimScheduler:
                 self.admission.observe(
                     name, len(q) / max(1, q.max_len), q.slo_compliance()
                 )
+        if self._retry_policy is not None:
+            self._sample_attainment()
         healed = self.check_engine_health()
         grayed = self.check_gray_health()
         changed = self.rates.changed_models(
@@ -441,6 +590,83 @@ class SimScheduler:
             max(self.monitoring_interval_s * 1000.0, 1.0),
             self._on_monitor,
         )
+
+    def _sample_attainment(self) -> None:
+        """One monitor-tick sample of WINDOWED weighted attainment per
+        model (counter deltas since the previous tick, priced by the
+        shared :func:`weighted_attainment`) — the recovery timeline the
+        metastability pin reads: did attainment return to its pre-fault
+        level within the horizon, or did retries keep it pinned down?
+        Also mirrors the live controller's congested push: the
+        governor's verdict zeroes the model's retry budget."""
+        counted = ("completed", "violations", "stale", "dropped",
+                   "enqueued")
+        sample: Dict[str, Dict[str, float]] = {}
+        for name in sorted(self.queues.queues()):
+            q = self.queues.queues()[name]
+            if self.admission is not None:
+                self._retry_budget(name).set_congested(
+                    self.admission.congested(name)
+                )
+            cur = q.class_stats()
+            prev = self._attainment_prev.get(name, {})
+            delta = {
+                cls: {k: c.get(k, 0.0) - prev.get(cls, {}).get(k, 0.0)
+                      for k in counted}
+                for cls, c in cur.items()
+            }
+            self._attainment_prev[name] = {
+                cls: {k: c.get(k, 0.0) for k in counted}
+                for cls, c in cur.items()
+            }
+            sample[name] = {
+                "weighted_attainment": weighted_attainment(delta),
+                "completed": sum(d["completed"] for d in delta.values()),
+                "congested": (
+                    1.0 if self._retry_budget(name).congested else 0.0
+                ),
+            }
+        self.attainment_timeline.append({
+            "t_s": round(self.clock.now_s(), 6),
+            "models": sample,
+        })
+
+    def retry_report(self) -> Dict:
+        """Report block for the retry model (rendered only when the
+        scenario enables it — canon stays byte-identical)."""
+        return {
+            "max_attempts": self.retry_max_attempts,
+            "backoff_ms": self.retry_backoff_ms,
+            "budgets": {m: b.stats()
+                        for m, b in sorted(self.retry_budgets.items())},
+            "resubmitted": dict(sorted(self.retry_submitted.items())),
+            "resubmitted_classes": {
+                m: dict(sorted(c.items()))
+                for m, c in sorted(self.retry_submitted_classes.items())
+            },
+            "denied": dict(sorted(self.retry_denied.items())),
+            "exhausted": dict(sorted(self.retry_exhausted.items())),
+            "attainment_timeline": list(self.attainment_timeline),
+        }
+
+    def poison_report(self) -> Dict:
+        """Report block for query-of-death injections (rendered only
+        when the scenario injects poison)."""
+        return {
+            "injected": dict(sorted(self.poison_injected.items())),
+            "fenced": dict(sorted(self.poison_fenced.items())),
+            "quarantined": sorted(self._poison_quarantined),
+            "isolations": list(self.poison_isolations),
+            "engines": {
+                e.engine_id: {
+                    "probes": e.poison_probes,
+                    "isolated": e.poison_isolated,
+                    "rescues": e.poison_rescues,
+                }
+                for e in sorted(self.engines, key=lambda e: e.engine_id)
+                if e.poison_isolated
+            },
+        }
 
     # --- observability (live snapshot shape) ------------------------------
     # snapshot()/schedule_log mirror LiveScheduler's surface on purpose:

@@ -35,6 +35,7 @@ that asymmetry is the live system's too.
 
 from __future__ import annotations
 
+import math
 import random
 from typing import Dict, List, Optional
 
@@ -173,6 +174,15 @@ class SimEngine:
         # a half-empty one).
         self.slots_filled = 0
         self.slots_offered = 0
+        # Query-of-death accounting (ISSUE 19): a popped batch holding a
+        # poison request fails, and the engine pays ceil(log2(B)) full
+        # bisection probes plus one rescue pass to isolate it (the live
+        # replica's _bisect_poison cost model). The scheduler's
+        # on_poison hook quarantines the condemned id cluster-wide.
+        self.on_poison = None
+        self.poison_probes = 0
+        self.poison_rescues = 0
+        self.poison_isolated = 0
 
     # --- scheduler-facing surface (duck-matches ReplicaEngine) -----------
     @property
@@ -424,6 +434,29 @@ class SimEngine:
                     )
             self.slots_filled += len(batch)
             self.slots_offered += max(1, p.batch_size)
+            poisoned = [r for r in batch
+                        if getattr(r, "poison_id", None) is not None]
+            if poisoned:
+                # The step raised: bisect to isolate the query of death.
+                # Cost = the failed step + one full re-execution per
+                # probe (ceil(log2 B) of them) + one rescue pass for the
+                # deferred half — same probe count the live replica's
+                # bisection pin asserts. Innocents complete at the
+                # delayed instant; the poison is terminally condemned
+                # and its id quarantined at the front door.
+                probes = (int(math.ceil(math.log2(len(batch))))
+                          if len(batch) > 1 else 0)
+                rescue = 1 if len(batch) > 1 else 0
+                exec_ms += exec_ms * (probes + rescue)
+                self.poison_probes += probes
+                self.poison_rescues += rescue
+                self.poison_isolated += len(poisoned)
+                for r in poisoned:
+                    queue.count_poisoned(r)
+                    if self.on_poison is not None:
+                        self.on_poison(r.poison_id, r.model)
+                batch = [r for r in batch
+                         if getattr(r, "poison_id", None) is None]
             # Long-prompt prefill beyond the profile row (ISSUE 15):
             # mono runs the whole train inside THIS turn (stalling the
             # slice and everything behind it); chunked defers it to the

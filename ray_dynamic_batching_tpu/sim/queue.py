@@ -64,6 +64,14 @@ class SimRequest:
     # chunk events between turns (chunked). 0.0 = a bucketed prompt
     # whose prefill the row already covers.
     prefill_ms: float = 0.0
+    # Client-retry generation (ISSUE 19): 0 = first attempt; a stale-shed
+    # request resubmitted by the retry model arrives again with this
+    # bumped — the amplification axis the retry budget bounds.
+    retry_attempt: int = 0
+    # Non-None marks a query of death (ISSUE 19): executing a batch that
+    # contains it fails the batch, and isolation costs the engine
+    # ceil(log2(B)) bisection probes plus a rescue pass.
+    poison_id: Optional[str] = None
 
     @property
     def deadline_ms(self) -> float:
@@ -94,6 +102,11 @@ class SimRequestQueue:
         # class-aware displacement sheds land in the same timeline live
         # queues feed).
         self.audit = None
+        # Optional stale-shed hook (ISSUE 19): called with (queue, req)
+        # when get_batch discards a request past its deadline. The
+        # scheduler's client-retry model hangs off this; None (default)
+        # is byte-identical to the pre-retry simulator.
+        self.on_stale = None
         # --- stats (same counters as engine/queue.py) ---
         self.latency_samples: List[float] = []
         self._recent_outcomes: List[bool] = []
@@ -102,6 +115,7 @@ class SimRequestQueue:
         self.total_stale = 0
         self.total_completed = 0
         self.total_violations = 0
+        self.total_poisoned = 0
         # Shared per-class accounting (engine/queue.ClassCounters — the
         # live queue's implementation, imported like ClassBuckets).
         self._classes = ClassCounters()
@@ -163,6 +177,8 @@ class SimRequestQueue:
             if discard_stale and req.deadline_ms < now + expected_latency_ms:
                 self.total_stale += 1
                 self._cls(req.qos_class)["stale"] += 1
+                if self.on_stale is not None:
+                    self.on_stale(self, req)
                 continue
             req.popped_ms = now
             out.append(req)
@@ -201,6 +217,17 @@ class SimRequestQueue:
         self.total_completed += len(batch)
         self.total_violations += violations
         return violations
+
+    def count_poisoned(self, req: SimRequest) -> None:
+        """A popped query of death condemned by engine-side bisection
+        (ISSUE 19): terminally rejected, never completed, never retried —
+        accounted as a drop (it missed its SLO as surely as a displaced
+        request) plus its own counter so the report can tell poison
+        verdicts from load shedding. Conservation holds: arrivals ==
+        completed + stale + dropped + pending."""
+        self.total_dropped += 1
+        self.total_poisoned += 1
+        self._cls(req.qos_class)["dropped"] += 1
 
     def count_backlog_stale(self, req: SimRequest) -> None:
         """A popped request shed OUTSIDE the queue (the chunked-prefill
@@ -257,12 +284,16 @@ class SimQueueManager:
         # Shared decision ring handed to every queue created from here
         # (set by the simulation before traffic starts).
         self.audit = None
+        # Shared stale-shed hook, likewise handed to every queue (set by
+        # the scheduler when the client-retry model is enabled).
+        self.on_stale = None
         self._queues: Dict[str, SimRequestQueue] = {}
 
     def queue(self, model: str) -> SimRequestQueue:
         if model not in self._queues:
             q = SimRequestQueue(model, self.clock, self.max_len)
             q.audit = self.audit
+            q.on_stale = self.on_stale
             self._queues[model] = q
         return self._queues[model]
 

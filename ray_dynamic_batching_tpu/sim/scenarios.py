@@ -21,6 +21,7 @@ from ray_dynamic_batching_tpu.sim.simulator import (
     AcceptanceCollapse,
     EngineDegradation,
     EngineFailure,
+    PoisonInjection,
     Scenario,
     SimModelSpec,
 )
@@ -735,6 +736,155 @@ def observatory_mispricing_scenario(seed: int = 0) -> Scenario:
         ],
         observatory=dict(OBSERVATORY_SOAK_POLICY),
     )
+
+
+# --- compound-fault scenario matrix (ISSUE 19) -------------------------------
+#
+# Single-fault soaks prove each defense in isolation; production outages
+# are COMPOUND — a spike lands while a chip is dying, a query of death
+# arrives mid-overload, and the client retry loop amplifies whatever is
+# already wrong (Bronson et al.'s metastable-failure shape). The matrix
+# composes the existing fault axes into named compound scenarios over
+# one shared deployment, with the client-retry model armed in EVERY
+# entry: retries are the amplifier that turns a transient fault into a
+# sustained one, so every compound story is graded with amplification
+# live. ``defenses=True`` arms the budget fraction + the governor's
+# congested floor; ``defenses=False`` is the naive-client control arm
+# (unbounded retries, no congested coupling) the metastability pin must
+# grade STRICTLY worse.
+
+# Every fault fires inside [COMPOUND_FAULT_AT_S, COMPOUND_FAULT_END_S];
+# the metastability pin compares windowed attainment before the fault
+# against the window after COMPOUND_RECOVER_BY_S — recovery must be
+# monotone and complete within the bounded horizon.
+COMPOUND_FAULT_AT_S = 12.0
+COMPOUND_FAULT_END_S = 24.0
+COMPOUND_RECOVER_BY_S = 38.0
+COMPOUND_DURATION_S = 50.0
+
+# The fault axes a compound name may compose ("retries" is implicit in
+# every entry and accepted in names for readability).
+COMPOUND_AXES: Tuple[str, ...] = (
+    "spike", "death", "slowchip", "poison", "retries",
+)
+
+COMPOUND_SCENARIOS: Tuple[str, ...] = (
+    "spike+retries",          # overload + retry storm
+    "death+retries",          # engine death + retry storm
+    "slowchip+retries",       # gray straggler + retry storm
+    "poison+retries",         # query of death + retry storm
+    "spike+death",            # overload lands on a dying cluster
+    "spike+poison",           # query of death arrives mid-overload
+    "death+slowchip",         # death + gray straggler (half-lame heal)
+    "spike+death+poison",     # the kitchen sink
+)
+
+# The designated metastability scenario: the matrix soak runs its
+# control arm (defenses=False) alongside and pins that the defended arm
+# recovers to >= 0.95x pre-fault attainment within the horizon while
+# the naive arm recovers strictly worse.
+METASTABILITY_SCENARIO = "spike+death"
+
+
+def compound_scenario(name: str, defenses: bool = True,
+                      seed: int = 0) -> Scenario:
+    """Build one named compound-fault scenario (cross-product grammar:
+    ``axis+axis[+axis]`` over :data:`COMPOUND_AXES`).
+
+    Shared deployment: 3 chips, ``fast`` (interactive mix, 60 rps) +
+    ``burst`` (150 rps steady — ~0.65 of the 2-chip post-death
+    capacity) with token-bucket admission armed. Client retries: up to
+    6 attempts, 50 ms exponential backoff — every stale shed re-enters
+    the front door as fresh demand. The defended arm bounds that to
+    0.25x first-attempt volume and lets the governor's congested state
+    zero it; the control arm retries without bound."""
+    axes = [a for a in name.split("+") if a]
+    unknown = set(axes) - set(COMPOUND_AXES)
+    if unknown:
+        raise ValueError(
+            f"unknown compound axis(es) {sorted(unknown)} in {name!r}; "
+            f"known: {', '.join(COMPOUND_AXES)}"
+        )
+    spike = "spike" in axes
+    # Base demand sits at ~0.65 of POST-death capacity (150 rps burst on
+    # the 2 surviving chips' ~230 rps): room enough for the defended arm
+    # to recover fully within the horizon, tight enough that unbounded
+    # retry amplification (up to 5 re-dispatches per shed) keeps the
+    # naive arm shedding past it — the metastable gap the pin grades.
+    burst_pattern = (
+        RatePattern("spike", base_rps=150.0, amplitude=250.0,
+                    spike_at_s=COMPOUND_FAULT_AT_S, spike_len_s=10.0)
+        if spike else RatePattern("constant", base_rps=150.0)
+    )
+    sc = Scenario(
+        models=[
+            SimModelSpec(
+                name="fast", slo_ms=400.0,
+                pattern=RatePattern("constant", base_rps=60.0),
+                class_mix={"interactive": 0.5, "standard": 0.5},
+            ),
+            SimModelSpec(
+                name="burst", slo_ms=500.0,
+                pattern=burst_pattern,
+                class_mix={"interactive": 0.2, "standard": 0.3,
+                           "best_effort": 0.5},
+            ),
+        ],
+        duration_s=COMPOUND_DURATION_S,
+        drain_s=5.0,
+        n_engines=3,
+        seed=seed,
+        max_queue_len=2048,
+        monitoring_interval_s=1.0,
+        admission={
+            "rate_rps": 500.0,
+            "burst": 60.0,
+            "degraded_class_fractions": {
+                "interactive": 1.0, "standard": 0.6, "best_effort": 0.1,
+            },
+            "depth_high": 0.15,
+            "depth_low": 0.02,
+            # The congested floor is a DEFENSE: while first-attempt
+            # compliance sits under it, the governor zeroes the retry
+            # budget so recovery is monotone. The control arm runs
+            # without it (0.0 = disabled).
+            **({"congested_floor": 0.55, "congested_exit": 0.85}
+               if defenses else {}),
+        },
+        retry={
+            "max_attempts": 6,
+            "backoff_ms": 50.0,
+            # Work-conserving bound vs naive unbounded clients.
+            "budget_fraction": 0.25 if defenses else None,
+            "budget_window": 256,
+            "min_first_attempts": 16,
+        },
+    )
+    if "death" in axes:
+        sc.failures.append(
+            EngineFailure(at_s=COMPOUND_FAULT_AT_S, engine=2)
+        )
+    if "slowchip" in axes:
+        sc.degradations.append(
+            EngineDegradation(at_s=COMPOUND_FAULT_AT_S, engine=0,
+                              factor=8.0,
+                              heal_at_s=COMPOUND_FAULT_END_S)
+        )
+        # Gray detection armed (straggler_scenario's ratio-space knobs)
+        # so the straggler is repriced, not just endured.
+        sc.gray = {
+            "p50_ratio": 3.0, "p95_ratio": 3.0, "min_abs_ms": 0.5,
+            "min_samples": 2, "min_peers": 1, "suspect_after": 2,
+            "probation_after": 2, "heal_after": 2,
+            "probation_capacity": 0.4,
+        }
+    if "poison" in axes:
+        sc.poisons.append(
+            PoisonInjection(at_s=COMPOUND_FAULT_AT_S + 2.0,
+                            model="burst",
+                            repeat_at_s=COMPOUND_RECOVER_BY_S - 8.0)
+        )
+    return sc
 
 
 def observatory_steady_scenario(seed: int = 0) -> Scenario:

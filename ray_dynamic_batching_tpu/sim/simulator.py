@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from ray_dynamic_batching_tpu.engine.request import (
+    DEFAULT_QOS_CLASS,
     DEFAULT_TENANT,
     QOS_RANK,
 )
@@ -66,7 +67,8 @@ _MODEL_KEYS = frozenset(
 # AdmissionPolicy knobs a scenario's "admission" object may set.
 _ADMISSION_KEYS = frozenset(
     ("rate_rps", "burst", "degraded_class_fractions", "depth_high",
-     "depth_low", "compliance_low", "compliance_high", "max_tenants")
+     "depth_low", "compliance_low", "compliance_high", "max_tenants",
+     "congested_floor", "congested_exit")
 )
 
 
@@ -282,6 +284,63 @@ class AcceptanceCollapse:
 
 
 @dataclass
+class PoisonInjection:
+    """One injected query of death (ISSUE 19): at ``at_s`` a poison
+    request is submitted to ``model`` — any batch executing it fails,
+    and the engine pays ceil(log2 B) bisection probes plus a rescue
+    pass to isolate it (the live replica's quarantine path, priced at
+    virtual time). ``repeat_at_s`` resubmits the SAME poison later: the
+    scenario's claim is that the repeat is fenced at the front door
+    (quarantine gossip), never poisoning a second batch."""
+
+    at_s: float
+    model: str
+    poison_id: str = ""
+    qos_class: str = DEFAULT_QOS_CLASS
+    repeat_at_s: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.at_s < 0:
+            raise ValueError(f"poison at_s must be >= 0, got {self.at_s}")
+        if self.repeat_at_s is not None and self.repeat_at_s <= self.at_s:
+            raise ValueError(
+                f"repeat_at_s ({self.repeat_at_s}) must be after at_s "
+                f"({self.at_s})"
+            )
+        if self.qos_class not in QOS_RANK:
+            raise ValueError(
+                f"poison qos_class {self.qos_class!r} unknown "
+                f"(known: {sorted(QOS_RANK)})"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PoisonInjection":
+        known = {"at_s", "model", "poison_id", "qos_class", "repeat_at_s"}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown poison key(s) {sorted(unknown)}; known: "
+                f"{sorted(known)}"
+            )
+        return cls(
+            at_s=float(d["at_s"]),
+            model=str(d["model"]),
+            poison_id=str(d.get("poison_id", "")),
+            qos_class=str(d.get("qos_class", DEFAULT_QOS_CLASS)),
+            repeat_at_s=(None if d.get("repeat_at_s") is None
+                         else float(d["repeat_at_s"])),
+        )
+
+
+# Client-retry model knobs a scenario's "retry" object may set
+# (SimScheduler.enable_retries parameters).
+_RETRY_KEYS = frozenset(
+    ("max_attempts", "backoff_ms", "budget_fraction", "budget_window",
+     "min_first_attempts")
+)
+
+
+@dataclass
 class Scenario:
     """One simulated deployment under one traffic story."""
 
@@ -336,6 +395,37 @@ class Scenario:
     # adversarial traffic drives a model's LIVE acceptance toward 0
     # while the planner keeps its profiled belief.
     spec_collapses: List[AcceptanceCollapse] = field(default_factory=list)
+    # Injected queries of death (ISSUE 19): each poisons one batch;
+    # bisection isolates it at ceil(log2 B) probe cost and repeats are
+    # fenced at the front door.
+    poisons: List[PoisonInjection] = field(default_factory=list)
+    # Client-retry model knobs (ISSUE 19; SimScheduler.enable_retries
+    # parameters). None = no retry loop: canon scenarios stay
+    # byte-identical. budget_fraction=None inside the dict models naive
+    # unbounded clients — the metastable control arm.
+    retry: Optional[Dict[str, Any]] = None
+
+    def retry_config(self) -> Optional[Dict[str, Any]]:
+        if self.retry is None:
+            return None
+        unknown = set(self.retry) - _RETRY_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown retry key(s) {sorted(unknown)}; known: "
+                f"{sorted(_RETRY_KEYS)}"
+            )
+        return {
+            "max_attempts": int(self.retry.get("max_attempts", 3)),
+            "backoff_ms": float(self.retry.get("backoff_ms", 50.0)),
+            "budget_fraction": (
+                None if self.retry.get("budget_fraction") is None
+                else float(self.retry["budget_fraction"])
+            ),
+            "budget_window": int(self.retry.get("budget_window", 512)),
+            "min_first_attempts": int(
+                self.retry.get("min_first_attempts", 16)
+            ),
+        }
     # Gray-detection knobs (serve/grayhealth.GrayHealthPolicy fields).
     # None = detection disabled: canon scenarios stay byte-identical.
     gray: Optional[Dict[str, Any]] = None
@@ -465,6 +555,10 @@ class Scenario:
                 AcceptanceCollapse.from_dict(c)
                 for c in d.get("spec_collapses", [])
             ],
+            poisons=[
+                PoisonInjection.from_dict(p) for p in d.get("poisons", [])
+            ],
+            retry=d.get("retry"),
             gray=d.get("gray"),
             admission=d.get("admission"),
             observatory=d.get("observatory"),
@@ -636,6 +730,13 @@ class Simulation:
                 admission.configure(spec.name, policy)
             sched.admission = admission
         queues.audit = sched.audit  # displacement sheds are audited too
+        retry_cfg = sc.retry_config()
+        if retry_cfg is not None:
+            # Stale sheds become budgeted client resubmissions with fresh
+            # deadlines — the retry amplification loop the metastability
+            # scenarios exercise with budgets on (bounded) and off
+            # (naive clients, the control arm).
+            sched.enable_retries(**retry_cfg)
 
         # Only arrivals the horizon will actually fire count as offered
         # load: a recorded trace longer than duration_s is TRUNCATED and
@@ -704,6 +805,39 @@ class Simulation:
                     sched.submit(m, qos_class=q, tenant=t, prefill_ms=pm)
                 ),
             )
+
+        for i, p in enumerate(sc.poisons):
+            if p.model not in known:
+                raise ValueError(
+                    f"poison names model {p.model!r}, which this scenario "
+                    "never registered"
+                )
+            pid = p.poison_id or f"qod{i}"
+            # Injections are offered load like any arrival — conservation
+            # (offered == rejected + enqueued) must hold over them too,
+            # with the quarantine fence counting as a front-door reject.
+            per_cls = class_offered.setdefault(p.model, {})
+            n_inj = 1 + (1 if p.repeat_at_s is not None else 0)
+            per_cls[p.qos_class] = per_cls.get(p.qos_class, 0) + n_inj
+            arrival_counts[p.model] = (
+                arrival_counts.get(p.model, 0) + n_inj
+            )
+            loop.schedule_at(
+                p.at_s * 1000.0,
+                lambda m=p.model, q=p.qos_class, t=specs[p.model].tenant,
+                pid=pid: sched.submit(m, qos_class=q, tenant=t,
+                                      poison_id=pid),
+            )
+            if p.repeat_at_s is not None:
+                # Same fingerprint, later arrival: the quarantine fence's
+                # moment of truth.
+                loop.schedule_at(
+                    p.repeat_at_s * 1000.0,
+                    lambda m=p.model, q=p.qos_class,
+                    t=specs[p.model].tenant, pid=pid: sched.submit(
+                        m, qos_class=q, tenant=t, poison_id=pid
+                    ),
+                )
 
         for f in sc.failures:
             if not 0 <= f.engine < sc.n_engines:
@@ -841,6 +975,11 @@ class Simulation:
                 "stale": int(stats["stale"]),
                 "violations": int(stats["violations"]),
                 "pending": int(stats["depth"]),
+                # Poison verdicts are a subset of "dropped" (conservation
+                # unchanged); keyed out only in poison scenarios so canon
+                # reports keep their exact key set.
+                **({"poisoned": int(queue.total_poisoned)}
+                   if sc.poisons else {}),
                 "slo_attainment": slo_attainment(stats),
                 # Class-weighted attainment: the planner's pricing of a
                 # miss (scheduler/replan.weighted_attainment — interactive
@@ -911,6 +1050,15 @@ class Simulation:
                  "stall_ms": g.stall_ms, "heal_at_s": g.heal_at_s}
                 for g in sc.degradations
             ],
+            # Query-of-death arm (conditional: poison-free scenarios stay
+            # byte-identical): injection/fence/isolation ledger plus the
+            # per-engine bisection cost actually paid.
+            **({"poison": sched.poison_report()} if sc.poisons else {}),
+            # Client-retry arm (conditional, same discipline): budget
+            # stats, resubmission/denial counts, and the monitor-tick
+            # windowed-attainment timeline the metastability pin grades.
+            **({"retry": sched.retry_report()}
+               if retry_cfg is not None else {}),
             # Speculative arm (conditional: pre-spec scenarios stay
             # byte-identical): planned vs final LIVE acceptance per spec
             # model, plus the injected collapse timeline.

@@ -43,6 +43,18 @@ from typing import Dict, Optional, Tuple
 
 ENV_VAR = "RDB_TESTING_FAILURE"
 SLOWDOWN_ENV_VAR = "RDB_TESTING_SLOWDOWN"
+# Query-of-death injection (ISSUE 19): a batch whose payloads carry the
+# poison marker raises at execution, driving the replica's bisection +
+# quarantine path end-to-end. Grammar: ``point=N[:pP]`` — the first N
+# DISTINCT marked requests seen at the point are armed as poisonous;
+# an armed marker keeps failing every re-execution that contains it
+# (bisection probes included), which is what makes isolation possible.
+POISON_ENV_VAR = "RDB_TESTING_POISON"
+# Payload marker: a dict payload with this key truthy (or a string
+# payload containing the token) is poison-eligible. The VALUE of the
+# marker is the poison's identity — distinct values are distinct
+# poisons against the injection budget.
+POISON_MARKER = "__rdb_poison__"
 
 SLOWDOWN_MODES = (
     "latency_multiplier", "stall_before_first_token", "stuck_stream",
@@ -78,6 +90,15 @@ class ChaosInjected(RuntimeError):
     """Raised at an injection point whose failure budget fired."""
 
 
+class PoisonInjected(RuntimeError):
+    """Raised by a batch execution containing an armed poison marker.
+
+    Deliberately NOT a :class:`ChaosInjected` subclass: chaos failures
+    classify *retryable* (the payload was never the problem) while a
+    poison is the payload itself — it must reach the replica's
+    non-retryable path so bisection, not failover, handles it."""
+
+
 class ChaosInjector:
     def __init__(self, spec: Optional[str] = None,
                  seed: Optional[int] = None) -> None:
@@ -98,8 +119,19 @@ class ChaosInjector:
         self._slow_fired: Dict[str, int] = {}
         self._slow_rng = random.Random(self._seed)
         self._slow_active = False
+        # Poison (query-of-death) injection state: budgets count DISTINCT
+        # armed markers; an armed marker stays poisonous for every later
+        # execution containing it (bisection needs the fault to follow
+        # the request through probe subsets deterministically).
+        self._poison_budgets: Dict[str, int] = {}
+        self._poison_probs: Dict[str, float] = {}
+        self._poison_armed: Dict[str, set] = {}
+        self._poison_fired: Dict[str, int] = {}
+        self._poison_rng = random.Random(self._seed)
+        self._poison_active = False
         self.configure(spec if spec is not None else os.environ.get(ENV_VAR, ""))
         self.configure_slowdowns(os.environ.get(SLOWDOWN_ENV_VAR, ""))
+        self.configure_poisons(os.environ.get(POISON_ENV_VAR, ""))
 
     @staticmethod
     def _config_seed() -> int:
@@ -228,6 +260,95 @@ class ChaosInjector:
         with self._lock:
             return self._slow_fired.get(key, 0)
 
+    # --- poison (query-of-death) injection --------------------------------
+    def configure_poisons(self, spec: str,
+                          seed: Optional[int] = None) -> None:
+        """Parse ``point=N[:pP],...`` — same grammar and all-or-nothing
+        swap/reseed discipline as :meth:`configure`. ``N`` bounds how
+        many DISTINCT poison markers may arm at the point (-1 =
+        unlimited); ``:pP`` makes each arming opportunity probabilistic
+        over the seeded RNG."""
+        budgets: Dict[str, int] = {}
+        probs: Dict[str, float] = {}
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            if "=" not in part:
+                raise ValueError(f"bad poison spec entry {part!r}")
+            point, rhs = part.split("=", 1)
+            prob = 1.0
+            if ":p" in rhs:
+                rhs, prob_s = rhs.split(":p", 1)
+                prob = float(prob_s)
+            budgets[point.strip()] = int(rhs)
+            probs[point.strip()] = prob
+        with self._lock:
+            self._poison_budgets = budgets
+            self._poison_probs = probs
+            self._poison_armed = {}
+            self._poison_fired = {}
+            if seed is not None:
+                self._seed = seed
+            self._poison_rng = random.Random(self._seed)
+            self._poison_active = bool(budgets)
+
+    @staticmethod
+    def poison_marker(payload) -> Optional[str]:
+        """The payload's poison identity, or None. Dict payloads carry
+        ``{POISON_MARKER: <id>}``; string payloads embed the token."""
+        if isinstance(payload, dict):
+            marker = payload.get(POISON_MARKER)
+            if marker:
+                return str(marker)
+            return None
+        if isinstance(payload, str) and POISON_MARKER in payload:
+            return payload
+        return None
+
+    def poison_verdict(self, point: str, payloads) -> Optional[int]:
+        """Index of the first poisonous payload in this execution, or
+        None. An already-armed marker fires WITHOUT consuming budget (a
+        poison stays poisonous — that is what bisection relies on); an
+        unarmed marker arms iff the point's distinct-marker budget and
+        probability allow. Free when unconfigured: one unlocked read."""
+        if not self._poison_active:  # rdb-lint: disable=lock-discipline (unconfigured fast path: arming flips in quiesced configure_poisons(); one-op staleness only shifts poison onset by one call)
+            return None
+        with self._lock:
+            budget = self._poison_budgets.get(point)
+            if budget is None:
+                return None
+            armed = self._poison_armed.setdefault(point, set())
+            for idx, payload in enumerate(payloads):
+                marker = self.poison_marker(payload)
+                if marker is None:
+                    continue
+                if marker in armed:
+                    self._poison_fired[point] = \
+                        self._poison_fired.get(point, 0) + 1
+                    return idx
+                if budget == 0:
+                    continue
+                prob = self._poison_probs.get(point, 1.0)
+                if prob < 1.0 and self._poison_rng.random() >= prob:
+                    continue
+                armed.add(marker)
+                if budget > 0:
+                    self._poison_budgets[point] = budget - 1
+                    budget -= 1
+                self._poison_fired[point] = \
+                    self._poison_fired.get(point, 0) + 1
+                return idx
+            return None
+
+    def maybe_poison(self, point: str, payloads) -> None:
+        idx = self.poison_verdict(point, payloads)
+        if idx is not None:
+            raise PoisonInjected(
+                f"poison injected at {point} (batch index {idx})"
+            )
+
+    def poison_fired(self, point: str) -> int:
+        with self._lock:
+            return self._poison_fired.get(point, 0)
+
     @property
     def active(self) -> bool:
         return self._active  # rdb-lint: disable=lock-discipline (observability read of the arming flag; torn/stale by one op is benign)
@@ -249,13 +370,15 @@ def chaos() -> ChaosInjector:
 
 
 def reset_chaos(spec: str = "", seed: Optional[int] = None,
-                slowdown: str = "") -> ChaosInjector:
+                slowdown: str = "", poison: str = "") -> ChaosInjector:
     """Re-configure (and optionally reseed) the global injector (tests /
     soak harnesses): ``reset_chaos(spec, seed=N)`` pins the probabilistic
     failure schedule for a deterministic replay. ``slowdown`` carries the
-    gray-failure spec — cleared by default, so every existing
-    ``reset_chaos("")`` teardown also disarms slowdowns."""
+    gray-failure spec and ``poison`` the query-of-death spec — both
+    cleared by default, so every existing ``reset_chaos("")`` teardown
+    also disarms them."""
     inj = chaos()
     inj.configure(spec, seed=seed)
     inj.configure_slowdowns(slowdown, seed=seed)
+    inj.configure_poisons(poison, seed=seed)
     return inj

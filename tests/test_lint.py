@@ -762,6 +762,120 @@ class TestUnboundedRetry:
         assert report.new == [], report.format_text()
 
 
+# --- retry-amplification --------------------------------------------------
+
+# The bug class (ISSUE 19): a re-dispatch site with no budget in sight —
+# under a fault storm every shed retries unbudgeted and the retry volume
+# IS the overload (the metastable loop).
+UNBUDGETED_REDISPATCH = """
+    def on_replica_dead(router, requests, victim_id):
+        router.failover.requeue(requests, victim_id, dead=True)
+"""
+
+# The compliant shape (FailoverManager.submit): admission and
+# amplification priced in one function.
+BUDGETED_REDISPATCH = """
+    def on_replica_dead(router, requests, victim_id):
+        budget = getattr(router, "retry_budget", None)
+        for req in requests:
+            if budget is not None and not budget.try_spend("retry"):
+                req.reject(RuntimeError("budget"))
+                continue
+            router.failover.requeue([req], victim_id, dead=True)
+"""
+
+
+class TestRetryAmplification:
+    def test_unbudgeted_redispatch_is_flagged(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/heal.py",
+                              UNBUDGETED_REDISPATCH,
+                              rules={"retry-amplification"})
+        assert rules_found(report) == ["retry-amplification"]
+        assert "budget consult" in report.new[0].message
+        assert report.new[0].symbol == "on_replica_dead"
+
+    def test_budget_consult_in_same_function_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/heal.py",
+                              BUDGETED_REDISPATCH,
+                              rules={"retry-amplification"})
+        assert report.new == []
+
+    def test_retry_budget_attribute_read_counts_as_consult(self, tmp_path):
+        # The `router.retry_budget` attribute form (no getattr string).
+        report = lint_fixture(tmp_path, "serve/heal.py", """
+            def rescue(router, req, exc):
+                if router.retry_budget.congested:
+                    req.reject(exc)
+                    return
+                router.failover.submit(req, exc)
+        """, rules={"retry-amplification"})
+        assert report.new == []
+
+    def test_failover_submit_is_a_redispatch_verb(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/heal.py", """
+            def rescue(router, req, exc):
+                router.failover.submit(req, exc)
+        """, rules={"retry-amplification"})
+        assert rules_found(report) == ["retry-amplification"]
+
+    def test_plain_executor_submit_is_not_a_redispatch(self, tmp_path):
+        # `submit` only counts on a failover object (or inside a
+        # Failover/Hedge manager) — a thread-pool submit amplifies
+        # nothing.
+        report = lint_fixture(tmp_path, "serve/pool.py", """
+            def schedule(executor, fn):
+                return executor.submit(fn)
+        """, rules={"retry-amplification"})
+        assert report.new == []
+
+    def test_submit_inside_hedge_manager_is_a_redispatch(self, tmp_path):
+        report = lint_fixture(tmp_path, "serve/hedge.py", """
+            class HedgeManager:
+                def fire(self, req):
+                    self.submit(req)
+        """, rules={"retry-amplification"})
+        assert rules_found(report) == ["retry-amplification"]
+        assert report.new[0].symbol == "HedgeManager.fire"
+
+    def test_lambda_deferred_redispatch_is_still_flagged(self, tmp_path):
+        # Deferring via lambda is still authored in this function — the
+        # budget decision belongs where the re-dispatch is scheduled.
+        report = lint_fixture(tmp_path, "serve/defer.py", """
+            def on_failure(loop, router, req, exc):
+                loop.call_later(0.05, lambda: router.failover.submit(req, exc))
+        """, rules={"retry-amplification"})
+        assert rules_found(report) == ["retry-amplification"]
+
+    def test_outside_serve_is_out_of_scope(self, tmp_path):
+        report = lint_fixture(tmp_path, "sim/heal.py",
+                              UNBUDGETED_REDISPATCH,
+                              rules={"retry-amplification"})
+        assert report.new == []
+
+    def test_reasoned_pragma_suppresses(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "serve/heal.py",
+            UNBUDGETED_REDISPATCH.replace(
+                "dead=True)",
+                "dead=True)  # rdb-lint: disable=retry-amplification "
+                "(drain salvage moves admitted work)",
+            ),
+            rules={"retry-amplification"},
+        )
+        assert report.new == []
+        assert report.pragma_suppressed == 1
+
+    def test_shipped_serve_tree_is_clean(self):
+        # Satellite pin: every re-dispatch site in the shipped serve/
+        # tree either consults a budget or carries a reasoned pragma.
+        report = run(
+            paths=[lint_core.REPO_ROOT / "ray_dynamic_batching_tpu"
+                   / "serve"],
+            rules={"retry-amplification"},
+        )
+        assert report.new == [], report.format_text()
+
+
 # --- pragmas --------------------------------------------------------------
 
 SLEEPY = """

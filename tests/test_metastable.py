@@ -1,0 +1,534 @@
+"""Metastable-failure defense (ISSUE 19): retry/hedge budgets,
+query-of-death bisection + quarantine, the congested governor state, and
+the compound-fault scenario matrix.
+
+The contract under test: amplified load (retries, hedges) is bounded by
+a work-conserving budget funded by first-attempt volume; a poison
+request is isolated by batch bisection in exactly ceil(log2 B)
+re-executions, condemned terminally (4xx, never retried), and fenced at
+every front door on repeat; and the compound-fault matrix is
+byte-deterministic with the metastability recovery pin graded by
+tools/run_matrix_soak.py.
+"""
+
+import math
+import threading
+import time
+
+import pytest
+
+from ray_dynamic_batching_tpu.engine.request import Request, RequestStale, TokenStream
+from ray_dynamic_batching_tpu.serve import (
+    DeploymentConfig,
+    DeploymentHandle,
+    FailoverPolicy,
+    Replica,
+    ServeController,
+    is_retryable,
+)
+from ray_dynamic_batching_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionPolicy,
+)
+from ray_dynamic_batching_tpu.serve.failover import (
+    FailoverManager,
+    PoisonRequest,
+    RetryBudgetExhausted,
+    reject_disposition,
+)
+from ray_dynamic_batching_tpu.serve.quarantine import (
+    QuarantineRegistry,
+    poison_fingerprint,
+)
+from ray_dynamic_batching_tpu.serve.retrybudget import (
+    RetryBudget,
+    RetryBudgetPolicy,
+)
+from ray_dynamic_batching_tpu.sim import Simulation, render_json
+from ray_dynamic_batching_tpu.sim.scenarios import (
+    COMPOUND_AXES,
+    COMPOUND_SCENARIOS,
+    METASTABILITY_SCENARIO,
+    compound_scenario,
+    fixture_profiles,
+)
+from ray_dynamic_batching_tpu.utils.chaos import POISON_MARKER, reset_chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    reset_chaos("")
+    yield
+    reset_chaos("")
+
+
+# --- retry budget ledger ---------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_permissive_mode_grants_but_accounts(self):
+        b = RetryBudget("d")  # fraction=None: track, never deny
+        for _ in range(5):
+            b.record_first_attempt()
+        assert all(b.try_spend("retry") for _ in range(50))
+        s = b.stats()
+        assert s["enforcing"] is False
+        assert s["granted"] == {"retry": 50}
+        assert s["denied"] == {}
+        assert s["first_attempts_total"] == 5
+
+    def test_enforcing_fraction_bounds_amplification(self):
+        b = RetryBudget("d", RetryBudgetPolicy(
+            fraction=0.25, window=512, min_first_attempts=4))
+        for _ in range(20):
+            b.record_first_attempt()
+        # 0.25 x 20 recent first attempts = 5 re-dispatches, then denial.
+        grants = [b.try_spend("retry") for _ in range(8)]
+        assert grants == [True] * 5 + [False] * 3
+        s = b.stats()
+        assert s["granted"] == {"retry": 5}
+        assert s["denied"] == {"retry": 3}
+
+    def test_hedges_and_retries_draw_from_one_pool(self):
+        b = RetryBudget("d", RetryBudgetPolicy(
+            fraction=0.1, window=512, min_first_attempts=4))
+        for _ in range(20):
+            b.record_first_attempt()
+        assert b.try_spend("hedge")      # 0.1 x 20 = 2
+        assert b.try_spend("retry")
+        assert not b.try_spend("retry")  # the hedge spent from the pool
+
+    def test_min_first_attempts_floor_disables_enforcement(self):
+        # A fraction of nothing is noise: below the volume floor every
+        # spend is granted even at fraction=0.
+        b = RetryBudget("d", RetryBudgetPolicy(
+            fraction=0.0, window=512, min_first_attempts=16))
+        for _ in range(15):
+            b.record_first_attempt()
+        assert b.try_spend("retry")
+        b.record_first_attempt()  # 16th: the floor arms enforcement
+        assert not b.try_spend("retry")
+
+    def test_congested_zeroes_budget_in_both_modes(self):
+        for policy in (None, RetryBudgetPolicy(fraction=0.5, window=512,
+                                               min_first_attempts=0)):
+            b = RetryBudget("d", policy)
+            for _ in range(32):
+                b.record_first_attempt()
+            b.set_congested(True)
+            assert not b.try_spend("retry")
+            assert b.stats()["denied"] == {"retry": 1}
+            b.set_congested(False)  # recovery restores the fraction
+            assert b.try_spend("retry")
+
+    def test_two_epoch_rotation_bounds_recent(self):
+        b = RetryBudget("d", RetryBudgetPolicy(
+            fraction=0.5, window=4, min_first_attempts=0))
+        for _ in range(4):
+            b.record_first_attempt()  # rotates: prev=4, cur=0
+        assert b.stats()["recent_first_attempts"] == 4
+        for _ in range(3):
+            b.record_first_attempt()
+        assert b.stats()["recent_first_attempts"] == 7
+        # The next attempt rotates again: the oldest epoch ages out, so
+        # "recent" is count-bounded in [window, 2*window) — clock-free.
+        b.record_first_attempt()
+        assert b.stats()["recent_first_attempts"] == 4
+        assert b.stats()["first_attempts_total"] == 8
+
+    def test_reconfigure_keeps_ledger(self):
+        b = RetryBudget("d")
+        b.record_first_attempt(8)
+        assert b.try_spend("retry")
+        b.reconfigure(RetryBudgetPolicy(fraction=0.0, window=512,
+                                        min_first_attempts=0))
+        s = b.stats()
+        assert s["enforcing"] is True
+        assert s["granted"] == {"retry": 1}       # history survived
+        assert not b.try_spend("retry")           # new knobs apply
+
+    def test_fraction_validation(self):
+        with pytest.raises(ValueError):
+            RetryBudgetPolicy(fraction=1.5)
+        with pytest.raises(ValueError):
+            RetryBudgetPolicy(window=0)
+
+
+# --- query-of-death bisection ----------------------------------------------
+
+
+def _mixed_fn(payloads):
+    return [p if isinstance(p, dict) else p * 2 for p in payloads]
+
+
+def _poison_batch(size, poison_at, fn=_mixed_fn, stream=False):
+    """A bare replica with a wired quarantine, a batch of ``size`` with
+    the query of death at index ``poison_at``, chaos armed to poison the
+    batch-execution point."""
+    rep = Replica("r0", "d", fn, max_batch_size=size,
+                  batch_wait_timeout_s=0.001)
+    rep.quarantine = QuarantineRegistry()
+    reset_chaos(poison="replica.process_batch=1")
+    batch = []
+    for i in range(size):
+        payload = {POISON_MARKER: "qod"} if i == poison_at else i
+        batch.append(Request(
+            model="d", payload=payload, slo_ms=30_000.0,
+            stream=TokenStream() if stream else None,
+        ))
+    return rep, batch
+
+
+class TestBisection:
+    @pytest.mark.parametrize("size", [2, 4, 8, 32])
+    @pytest.mark.parametrize("poison_at", ["first", "last"])
+    def test_isolates_in_exactly_log2_probes(self, size, poison_at):
+        at = 0 if poison_at == "first" else size - 1
+        rep, batch = _poison_batch(size, at)
+        rep._process_batch(batch)
+        # The pin: ceil(log2 B) re-executions, independent of where the
+        # poison sits in the batch.
+        assert rep.bisect_probes == math.ceil(math.log2(size))
+        assert rep.poison_isolated == 1
+        for i, req in enumerate(batch):
+            if i == at:
+                with pytest.raises(PoisonRequest):
+                    req.future.result(timeout=1)
+            else:
+                # Innocents complete token-exactly despite co-batching.
+                assert req.future.result(timeout=1) == i * 2
+        fp = poison_fingerprint("d", batch[at].payload)
+        assert rep.quarantine.contains(fp)
+
+    def test_streaming_innocents_are_token_exact(self):
+        # Probes run with deferred streams: an innocent whose probe
+        # failed partway must not leak chunks — its rescue emission is
+        # the only one the client sees, exactly once.
+        def gen_fn(payloads):
+            def gen():
+                yield [f"{p}-a" for p in payloads]
+                yield [f"{p}-b" for p in payloads]
+            return gen()
+
+        rep, batch = _poison_batch(4, 1, fn=gen_fn, stream=True)
+        rep._process_batch(batch)
+        assert rep.bisect_probes == 2
+        for i, req in enumerate(batch):
+            if i == 1:
+                with pytest.raises(PoisonRequest):
+                    req.future.result(timeout=1)
+                continue
+            assert req.future.result(timeout=1) == [f"{i}-a", f"{i}-b"]
+            assert list(req.stream) == [f"{i}-a", f"{i}-b"]
+
+    def test_singleton_batch_keeps_legacy_rejection(self):
+        # B=1: nothing to bisect — the original exception surfaces and
+        # no probe is spent.
+        rep, batch = _poison_batch(1, 0)
+        rep._process_batch(batch)
+        assert rep.bisect_probes == 0
+        assert rep.poison_isolated == 0
+        with pytest.raises(Exception):
+            batch[0].future.result(timeout=1)
+
+    def test_poison_request_is_terminal_4xx(self):
+        exc = PoisonRequest("qod isolated", fingerprint="abc123")
+        assert not is_retryable(exc)
+        d = reject_disposition(exc)
+        assert 400 <= d.http_status < 500
+        assert d.retry_after_s is None  # never retry a poison
+
+    def test_two_poisons_in_one_batch_both_condemned(self):
+        rep, batch = _poison_batch(8, 2)
+        batch[6].payload = {POISON_MARKER: "qod2"}
+        # Two DISTINCT markers may arm (the seeded-poison point bound).
+        reset_chaos(poison="replica.process_batch=2")
+        rep._process_batch(batch)
+        assert rep.poison_isolated == 2
+        for i, req in enumerate(batch):
+            if i in (2, 6):
+                with pytest.raises(PoisonRequest):
+                    req.future.result(timeout=1)
+            else:
+                assert req.future.result(timeout=1) == i * 2
+
+
+# --- quarantine registry ---------------------------------------------------
+
+
+class TestQuarantineRegistry:
+    def test_front_door_check_matches_fingerprint(self):
+        reg = QuarantineRegistry()
+        payload = {"v": 1, "text": "crash me"}
+        fp = poison_fingerprint("d", payload)
+        reg.add(fp, "d", stage="isolated")
+        assert reg.check("d", {"text": "crash me", "v": 1}) == fp  # order-insensitive
+        assert reg.check("d", {"v": 2, "text": "crash me"}) is None
+        assert reg.check("other", payload) is None  # per-model fingerprints
+
+    def test_gossip_merge_converges(self):
+        a, b = QuarantineRegistry(), QuarantineRegistry()
+        a.add("fp-a", "d")
+        b.add("fp-b", "d")
+        assert a.merge(b.snapshot())
+        assert b.merge(a.snapshot())
+        assert a.snapshot().keys() == b.snapshot().keys() == {"fp-a", "fp-b"}
+        # Converged: another exchange changes nothing (gossip quiesces).
+        assert not a.merge(b.snapshot())
+        assert not b.merge(a.snapshot())
+
+    def test_merge_takes_max_hits_not_sum(self):
+        a, b = QuarantineRegistry(), QuarantineRegistry()
+        a.add("fp", "d")
+        a.add("fp", "d")           # hits=2 locally
+        b.merge(a.snapshot())
+        b.merge(a.snapshot())      # re-gossip must not double-count
+        assert b.snapshot()["fp"]["hits"] == 2
+
+    def test_bounded_fifo_eviction(self):
+        reg = QuarantineRegistry(max_entries=4)
+        for i in range(6):
+            reg.add(f"fp{i}", "d")
+        assert len(reg) == 4
+        assert reg.stats()["evicted"] == 2
+        assert not reg.contains("fp0") and not reg.contains("fp1")
+        assert reg.contains("fp5")
+
+
+# --- congested governor hysteresis -----------------------------------------
+
+
+class TestCongestedGovernor:
+    def _ctl(self):
+        # compliance_low sits BELOW the congested floor here so the test
+        # reads the congest axis alone (observe() reports the degrade
+        # transition first when both flip on one tick).
+        ctl = AdmissionController()
+        ctl.configure("d", AdmissionPolicy(
+            rate_rps=100.0, compliance_low=0.3, compliance_high=0.9,
+            congested_floor=0.55, congested_exit=0.85,
+        ))
+        return ctl
+
+    def test_enter_hold_exit(self):
+        ctl = self._ctl()
+        assert not ctl.congested("d")
+        assert ctl.observe("d", 0.0, 0.50) == "congest"
+        assert ctl.congested("d")
+        # Between floor and exit: hysteresis holds the state (no flap).
+        assert ctl.observe("d", 0.0, 0.70) is None
+        assert ctl.congested("d")
+        assert ctl.observe("d", 0.0, 0.90) == "clear_congestion"
+        assert not ctl.congested("d")
+
+    def test_congested_is_orthogonal_to_degraded(self):
+        # A compliance dip below compliance_low but above the congested
+        # floor degrades (sheds best-effort) without zeroing budgets.
+        ctl = AdmissionController()
+        ctl.configure("d", AdmissionPolicy(
+            rate_rps=100.0, congested_floor=0.55, congested_exit=0.85,
+        ))
+        assert ctl.observe("d", 0.0, 0.70) == "degrade"
+        assert not ctl.congested("d")
+
+    def test_exit_below_floor_rejected(self):
+        with pytest.raises(ValueError):
+            AdmissionPolicy(rate_rps=1.0, congested_floor=0.8,
+                            congested_exit=0.5)
+
+
+# --- failover deadline discipline (satellite 2) ----------------------------
+
+
+class _StubQueue:
+    def __init__(self):
+        self.p50_ms = 0.0
+        self.latency_window = self
+
+    def percentile(self, q):
+        return self.p50_ms
+
+
+class _StubReplica:
+    def __init__(self, queue):
+        self.queue = queue
+
+
+class _StubRouter:
+    deployment = "d"
+
+    def __init__(self):
+        self._queue = _StubQueue()
+        self.assigns = 0
+
+    def replicas(self):
+        return [_StubReplica(self._queue)]
+
+    def assign_request(self, request, exclude=None, timeout_s=None):
+        self.assigns += 1
+        request.fulfill("redispatched")
+        return True
+
+
+class TestFailoverDeadline:
+    def test_backoff_never_scheduled_past_deadline(self):
+        # The pre-sleep check: remaining budget is priced BEFORE the
+        # backoff sleep — a retry that cannot finish in time sheds now
+        # instead of sleeping through its own deadline.
+        router = _StubRouter()
+        fm = FailoverManager(router, FailoverPolicy(
+            backoff_initial_s=0.1, backoff_max_s=0.1, jitter=0.0))
+        try:
+            req = Request(model="d", payload=1, slo_ms=60.0)
+            req.attempts = 1
+            assert not fm.submit(req, RuntimeError("boom"))
+            with pytest.raises(RequestStale):
+                req.future.result(timeout=1)
+            assert fm.shed_deadline == 1
+            assert router.assigns == 0
+        finally:
+            fm.close()
+
+    def test_pop_time_recheck_after_cost_moved(self):
+        # The deadline is RECOMPUTED at wakeup: if the profiled attempt
+        # cost moved while the retry slept, it sheds instead of
+        # dispatching past the budget it was admitted under.
+        router = _StubRouter()
+        fm = FailoverManager(router, FailoverPolicy(
+            backoff_initial_s=0.05, backoff_max_s=0.05, jitter=0.0))
+        try:
+            req = Request(model="d", payload=1, slo_ms=500.0)
+            req.attempts = 1
+            assert fm.submit(req, RuntimeError("boom"))
+            # While the worker sleeps out the backoff, the replica set's
+            # p50 blows up far past the remaining budget.
+            router._queue.p50_ms = 60_000.0
+            with pytest.raises(RequestStale):
+                req.future.result(timeout=2)
+            assert router.assigns == 0
+        finally:
+            fm.close()
+
+    def test_budget_denial_is_terminal_429(self):
+        router = _StubRouter()
+        router.retry_budget = RetryBudget("d", RetryBudgetPolicy(
+            fraction=0.0, window=512, min_first_attempts=0))
+        fm = FailoverManager(router, FailoverPolicy())
+        try:
+            req = Request(model="d", payload=1, slo_ms=30_000.0)
+            req.attempts = 1
+            assert not fm.submit(req, RuntimeError("boom"))
+            with pytest.raises(RetryBudgetExhausted) as ei:
+                req.future.result(timeout=1)
+            d = reject_disposition(ei.value)
+            assert d.http_status == 429
+            assert d.retry_after_s is not None
+            assert fm.shed_budget == 1
+        finally:
+            fm.close()
+
+    def test_drain_requeue_is_budget_exempt(self):
+        # immediate=True moves admitted work (drain salvage) — it must
+        # not draw from, nor be denied by, the amplification budget.
+        router = _StubRouter()
+        router.retry_budget = RetryBudget("d", RetryBudgetPolicy(
+            fraction=0.0, window=512, min_first_attempts=0))
+        fm = FailoverManager(router, FailoverPolicy())
+        try:
+            req = Request(model="d", payload=1, slo_ms=30_000.0)
+            assert fm.submit(req, RuntimeError("drain"), immediate=True)
+            assert req.future.result(timeout=2) == "redispatched"
+            assert router.retry_budget.stats()["granted"] == {}
+        finally:
+            fm.close()
+
+
+# --- end-to-end: live quarantine fence (the tier-1 pin) ---------------------
+
+
+class TestLivePoisonPin:
+    def test_poison_isolated_quarantined_and_fenced(self):
+        def work(payloads):
+            return [p["v"] * 2 for p in payloads]
+
+        ctl = ServeController(control_interval_s=0.05)
+        router = ctl.deploy(
+            DeploymentConfig(name="pin", num_replicas=1, max_batch_size=4,
+                             batch_wait_timeout_s=0.05),
+            factory=lambda: work,
+        )
+        ctl.start()
+        handle = DeploymentHandle(router, default_slo_ms=30_000.0)
+        poison_payload = {POISON_MARKER: "qod-pin", "v": -1}
+        try:
+            assert handle.remote({"v": 7}).result(timeout=10) == 14
+            reset_chaos(poison="replica.process_batch=1")
+            innocents = [handle.remote({"v": i}) for i in range(3)]
+            poisoned = handle.remote(poison_payload)
+            with pytest.raises(PoisonRequest):
+                poisoned.result(timeout=10)
+            for i, fut in enumerate(innocents):
+                assert fut.result(timeout=10) == i * 2
+            replica = router.replicas()[0]
+            assert replica.stats()["poison_isolated"] == 1
+            # The fence: the same payload again is rejected AT THE FRONT
+            # DOOR — no second bisection, the replica never sees it.
+            with pytest.raises(PoisonRequest):
+                handle.remote(dict(poison_payload)).result(timeout=10)
+            assert replica.stats()["poison_isolated"] == 1
+            assert router.quarantine.stats()["hits"] >= 2
+        finally:
+            reset_chaos("")
+            ctl.shutdown()
+
+
+# --- compound-fault matrix --------------------------------------------------
+
+
+class TestCompoundMatrix:
+    def test_matrix_names_compose_all_axes(self):
+        assert len(COMPOUND_SCENARIOS) >= 8
+        for name in COMPOUND_SCENARIOS:
+            for axis in name.split("+"):
+                assert axis in COMPOUND_AXES
+            # Construction validates the full cross-product wiring.
+            compound_scenario(name)
+        assert METASTABILITY_SCENARIO in COMPOUND_SCENARIOS
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError):
+            compound_scenario("spike+gamma_rays")
+
+    def test_metastability_scenario_is_byte_deterministic(self):
+        runs = [
+            Simulation(fixture_profiles(),
+                       compound_scenario(METASTABILITY_SCENARIO)).run()
+            for _ in range(2)
+        ]
+        assert render_json(runs[0]) == render_json(runs[1])
+
+    def test_poison_scenario_fences_and_conserves(self):
+        report = Simulation(
+            fixture_profiles(), compound_scenario("poison+retries")
+        ).run()
+        poison = report["poison"]
+        assert sum(poison["injected"].values()) == 2
+        assert sum(poison["fenced"].values()) == 1   # the repeat, at the door
+        assert len(poison["isolations"]) == 1
+        assert poison["quarantined"]
+        # Conservation extends over the retry loop: resubmissions re-enter
+        # the full submit path, the fence counts as a front-door reject.
+        resub = report["retry"]["resubmitted_classes"]
+        for model, mr in report["models"].items():
+            for cls, c in mr["classes"].items():
+                offered = c["offered"] + resub.get(model, {}).get(cls, 0)
+                assert offered == c["admission_rejected"] + c["enqueued"], \
+                    f"{model}/{cls}"
+
+    def test_control_arm_disables_budgets_only(self):
+        defended = compound_scenario(METASTABILITY_SCENARIO)
+        control = compound_scenario(METASTABILITY_SCENARIO, defenses=False)
+        assert defended.retry_config()["budget_fraction"] is not None
+        assert control.retry_config()["budget_fraction"] is None
+        # Same fault story in both arms — only the defense differs.
+        assert len(control.failures) == len(defended.failures)

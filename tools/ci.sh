@@ -42,6 +42,8 @@ if [ "${1:-}" = "fast" ]; then
   python tools/run_observatory_soak.py --sim
   echo "== KV-fabric migration conformance (sim: rolling update migrates every live stream, zero drops, exact conservation, tools/migration_smoke.json) =="
   python tools/run_migration_soak.py --sim
+  echo "== compound-fault matrix conformance (sim: metastability recovery pin + control arm, retry-extended conservation, poison ledger, tools/matrix_smoke.json) =="
+  python tools/run_matrix_soak.py --sim
   echo "== pytest fast lane (queue/scheduler/router/controller logic) =="
   exec python -m pytest tests/ -q -m "not slow"
 fi
@@ -108,6 +110,9 @@ python tools/run_observatory_soak.py --live --smoke
 echo "== KV-fabric migration conformance (sim two-arm + live two-engine rolling update: zero drops, token exactness through a mid-stream move, page + queue conservation) =="
 python tools/run_migration_soak.py --sim
 env RDB_TESTING_LOCKORDER=1 JAX_PLATFORMS=cpu python tools/run_migration_soak.py --live
+
+echo "== compound-fault matrix conformance (sim matrix + live query-of-death: bisection isolates in ceil(log2 B) probes, quarantine fences the repeat, retry budget priced) =="
+python tools/run_matrix_soak.py --sim --live
 
 echo "== pytest (fake 8-chip CPU cluster) =="
 python -m pytest tests/ -q

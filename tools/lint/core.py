@@ -293,7 +293,10 @@ def _all_checkers() -> List[Checker]:
     from tools.lint.host_sync import HostSyncChecker
     from tools.lint.lockorder import LockOrderingChecker
     from tools.lint.locks import LockDisciplineChecker
-    from tools.lint.retry import UnboundedRetryChecker
+    from tools.lint.retry import (
+        RetryAmplificationChecker,
+        UnboundedRetryChecker,
+    )
     from tools.lint.shed import ShedAccountingChecker
     from tools.lint.spans import SpanHygieneChecker
     from tools.lint.store import StoreDisciplineChecker
@@ -307,6 +310,7 @@ def _all_checkers() -> List[Checker]:
         SpanHygieneChecker(),
         SimDeterminismChecker(),
         UnboundedRetryChecker(),
+        RetryAmplificationChecker(),
         ShedAccountingChecker(),
         StoreDisciplineChecker(),
         FabricDisciplineChecker(),

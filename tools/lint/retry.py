@@ -1,4 +1,6 @@
-"""unbounded-retry — retry/backoff loops must budget deadline or attempts.
+"""Retry rules: unbounded-retry and retry-amplification.
+
+unbounded-retry — retry/backoff loops must budget deadline or attempts.
 
 The failover layer's whole contract is that a re-dispatched request
 cannot circulate forever: every retry decision checks the admission
@@ -22,6 +24,35 @@ A loop is a finding when, in ``serve/`` or ``engine/``:
 Event-pacing loops (``while not stop.is_set():``, ``while active:``)
 have a non-constant test and are out of scope — they are bounded by
 their condition, not by a budget.
+
+retry-amplification — re-dispatch call sites must consult a budget.
+
+Metastable failures (Bronson et al., HotOS '21) are born at re-dispatch
+call sites: every retry, hedge, or requeue is load the cluster did not
+admit, and an unbudgeted one turns a transient fault into a sustained
+overload that outlives its trigger. The serve tier's contract
+(serve/retrybudget.py) is that amplified work draws from a
+work-conserving budget funded by first-attempt volume — so every
+lexical re-dispatch site in ``serve/`` must either consult a budget
+object IN THE SAME FUNCTION or carry a reasoned pragma saying why it is
+exempt (e.g. drain requeues MOVE admitted work rather than amplifying
+it, or the consult lives one frame down in the callee).
+
+A call is a finding when, in ``serve/``:
+
+- its target's final segment is a re-dispatch verb (``requeue``,
+  ``requeue_drained``, ``resubmit``, ``redispatch``, ``_fire``), or is
+  ``submit`` on a failover object (dotted path mentions ``failover``,
+  or the enclosing class is a Failover/Hedge manager), AND
+- the enclosing function shows no budget consult: no call to
+  ``try_spend``/``record_first_attempt``, no ``retry_budget``/``budget``
+  name or attribute, no ``"retry_budget"`` string constant (the
+  ``getattr(router, "retry_budget", None)`` idiom).
+
+``FailoverManager.submit`` is the compliant exemplar: the re-dispatch
+enqueue and the ``budget.try_spend("retry")`` consult live in one
+function, so the reviewer sees admission and amplification priced
+together.
 """
 
 from __future__ import annotations
@@ -29,7 +60,7 @@ from __future__ import annotations
 import ast
 
 from tools.lint.core import (
-    Checker, FileCtx, Scope, dotted_name as _dotted, in_dirs,
+    Checker, FileCtx, Finding, Scope, dotted_name as _dotted, in_dirs,
 )
 
 _SLEEP_CALLS = {"time.sleep", "asyncio.sleep", "sleep"}
@@ -90,3 +121,90 @@ class UnboundedRetryChecker(Checker):
             "the awaited condition stops arriving",
             scope,
         )
+
+
+_REDISPATCH_SUFFIXES = {
+    "requeue", "requeue_drained", "resubmit", "redispatch", "_fire",
+}
+_BUDGET_CALL_SUFFIXES = {"try_spend", "record_first_attempt"}
+_BUDGET_NAMES = {"retry_budget", "budget"}
+
+
+def _own_nodes(fn: ast.AST):
+    """The function's own statements — nested def/class bodies are their
+    own analysis units (each gets its own visit); lambdas stay: a
+    re-dispatch deferred via lambda is still authored here."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _consults_budget(fn: ast.AST) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            dotted = _dotted(sub.func) or ""
+            if dotted.rsplit(".", 1)[-1] in _BUDGET_CALL_SUFFIXES:
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr in _BUDGET_NAMES:
+                return True
+        elif isinstance(sub, ast.Name):
+            if sub.id in _BUDGET_NAMES:
+                return True
+        elif isinstance(sub, ast.Constant):
+            if sub.value == "retry_budget":
+                return True
+    return False
+
+
+class RetryAmplificationChecker(Checker):
+    rule = "retry-amplification"
+
+    def applies(self, relpath: str) -> bool:
+        return in_dirs(relpath, {"serve"})
+
+    def visit(self, node: ast.AST, ctx: FileCtx, scope: Scope) -> None:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        in_amplifier_class = any(
+            "Failover" in c or "Hedge" in c for c in scope.class_stack
+        )
+        triggers = []
+        for sub in _own_nodes(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func) or ""
+            last = dotted.rsplit(".", 1)[-1]
+            if last in _REDISPATCH_SUFFIXES:
+                triggers.append((sub, last))
+            elif last == "submit" and (
+                "failover" in dotted.lower() or in_amplifier_class
+            ):
+                triggers.append((sub, last))
+        if not triggers or _consults_budget(node):
+            return
+        # Symbol must name the enclosing function: the walker dispatches
+        # this def BEFORE pushing it onto the scope stack.
+        sym = scope.symbol()
+        sym = f"{sym}.{node.name}" if sym != "<module>" else node.name
+        for call, verb in triggers:
+            self.findings.append(Finding(
+                rule=self.rule, path=ctx.relpath,
+                line=getattr(call, "lineno", 0),
+                col=getattr(call, "col_offset", 0),
+                message=(
+                    f"re-dispatch `{verb}(...)` without a budget consult "
+                    "in this function: retries/hedges/requeues amplify "
+                    "load the cluster never admitted — consult "
+                    "retry_budget.try_spend(...) here, or pragma with "
+                    "the reason the site is exempt (see "
+                    "FailoverManager.submit for the compliant shape)"
+                ),
+                symbol=sym,
+            ))

@@ -1,0 +1,389 @@
+#!/usr/bin/env python
+"""Compound-fault matrix gate — metastability defense under composed faults.
+
+Single-fault soaks (chaos, overload, straggler) prove each defense in
+isolation; this gate composes them. Every compound scenario in
+``sim/scenarios.COMPOUND_SCENARIOS`` runs with the client-retry model
+armed — retries are the amplifier that turns a transient fault into a
+metastable one (Bronson et al., HotOS '21) — and the defended arm's
+retry budgets + congested governor must keep recovery MONOTONE. Two
+modes:
+
+  --sim    (CI fast lane) every named compound scenario runs TWICE
+           (byte-identical reports), graded against per-scenario
+           weighted-attainment floors (tools/matrix_smoke.json), exact
+           per-class conservation, and the poison ledger (injected
+           queries of death isolated, repeats fenced at the front
+           door). The METASTABILITY pin runs the designated scenario's
+           control arm (budgets disabled) alongside: the defended arm
+           must recover to >= recovery_ratio_floor x its pre-fault
+           windowed attainment within the horizon, and the control arm
+           must recover STRICTLY worse — amplification, not the fault,
+           is what the budgets remove.
+  --live   (CI full lane) a real ServeController + replica with a
+           seeded chaos poison (RDB_TESTING_POISON grammar): one query
+           of death inside a real batch. Asserts the replica isolates
+           it by bisection (innocents complete token-exactly, the
+           poison rejects 4xx terminal), the QuarantineRegistry
+           fingerprints it, and a SECOND submission of the same payload
+           is rejected at the front door without reaching any replica.
+
+Exit: 0 conformant, 1 violation, 2 usage.
+
+Examples:
+  python tools/run_matrix_soak.py --sim
+  python tools/run_matrix_soak.py --sim --live
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RATCHET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "matrix_smoke.json")
+
+
+def _check_conservation(model_report, failures, label, resubmitted=None):
+    """Exact per-class conservation, extended for the retry model: a
+    budget-granted resubmission re-enters the full submit path (that IS
+    the amplification loop), so the front-door identity becomes
+    offered + resubmitted == admission_rejected + enqueued."""
+    resubmitted = resubmitted or {}
+    for cls, c in (model_report.get("classes") or {}).items():
+        arrivals = c["offered"] + resubmitted.get(cls, 0)
+        if arrivals != c["admission_rejected"] + c["enqueued"]:
+            failures.append(
+                f"{label}/{cls}: offered+resubmitted {arrivals} != "
+                f"admission_rejected {c['admission_rejected']} + enqueued "
+                f"{c['enqueued']} — requests vanished before the queue"
+            )
+        accounted = (c["completed"] + c["stale"] + c["dropped"]
+                     + c["pending"])
+        if c["enqueued"] != accounted:
+            failures.append(
+                f"{label}/{cls}: enqueued {c['enqueued']} != completed+"
+                f"stale+dropped+pending {accounted} — a shed went "
+                "unaccounted"
+            )
+
+
+def _window_attainment(timeline, lo=None, hi=None):
+    """Mean windowed weighted attainment over monitor ticks in [lo, hi)
+    — ticks that completed nothing carry no evidence and are skipped."""
+    vals = []
+    for s in timeline:
+        if lo is not None and s["t_s"] < lo:
+            continue
+        if hi is not None and s["t_s"] >= hi:
+            continue
+        for v in s["models"].values():
+            if v["completed"] > 0:
+                vals.append(v["weighted_attainment"])
+    return sum(vals) / len(vals) if vals else 1.0
+
+
+def run_sim(seed: int = 0) -> int:
+    from ray_dynamic_batching_tpu.sim import Simulation, render_json
+    from ray_dynamic_batching_tpu.sim.scenarios import (
+        COMPOUND_FAULT_AT_S,
+        COMPOUND_RECOVER_BY_S,
+        COMPOUND_SCENARIOS,
+        METASTABILITY_SCENARIO,
+        compound_scenario,
+        fixture_profiles,
+    )
+
+    with open(RATCHET_PATH) as f:
+        floors = json.load(f)["floors"]["sim"]
+
+    failures = []
+    per_scenario = {}
+    meta_defended = None
+    for name in COMPOUND_SCENARIOS:
+        runs = [
+            Simulation(fixture_profiles(),
+                       compound_scenario(name, seed=seed)).run()
+            for _ in range(2)
+        ]
+        if render_json(runs[0]) != render_json(runs[1]):
+            failures.append(
+                f"{name}: nondeterministic — same-seed runs differ"
+            )
+        report = runs[0]
+        wa = {m: v["weighted_attainment"]
+              for m, v in report["models"].items()}
+        for model, floor in floors["weighted_attainment"][name].items():
+            if wa[model] < floor:
+                failures.append(
+                    f"{name}: {model} weighted attainment "
+                    f"{wa[model]:.4f} under floor {floor} — the compound "
+                    "fault broke through the defenses"
+                )
+        resub_classes = report["retry"]["resubmitted_classes"]
+        for model, mr in report["models"].items():
+            _check_conservation(mr, failures, f"{name}/{model}",
+                                resubmitted=resub_classes.get(model))
+        timeline = report["retry"]["attainment_timeline"]
+        pre = _window_attainment(timeline, hi=COMPOUND_FAULT_AT_S)
+        post = _window_attainment(timeline, lo=COMPOUND_RECOVER_BY_S)
+        if name == METASTABILITY_SCENARIO:
+            meta_defended = (pre, post)
+        entry = {
+            "weighted_attainment": {m: round(v, 4)
+                                    for m, v in sorted(wa.items())},
+            "pre_fault_attainment": round(pre, 4),
+            "recovery_attainment": round(post, 4),
+            "resubmitted": report["retry"]["resubmitted"],
+            "denied": report["retry"]["denied"],
+        }
+        if "poison" in name:
+            ledger = report["poison"]
+            injected = sum(ledger["injected"].values())
+            fenced = sum(ledger["fenced"].values())
+            if injected < 2:
+                failures.append(
+                    f"{name}: only {injected} poison submission(s) — the "
+                    "repeat never arrived; the fence went ungraded"
+                )
+            if fenced < floors["poison"]["min_fenced"]:
+                failures.append(
+                    f"{name}: {fenced} poison submission(s) fenced at the "
+                    "front door — quarantine never blocked the repeat"
+                )
+            if len(ledger["isolations"]) < floors["poison"][
+                    "min_isolations"]:
+                failures.append(
+                    f"{name}: no bisection isolation in the poison ledger"
+                )
+            entry["poison"] = {"injected": injected, "fenced": fenced,
+                               "isolations": len(ledger["isolations"])}
+        per_scenario[name] = entry
+
+    # --- metastability pin: defended recovery vs the naive control arm ---
+    control = Simulation(
+        fixture_profiles(),
+        compound_scenario(METASTABILITY_SCENARIO, defenses=False,
+                          seed=seed),
+    ).run()
+    control_post = _window_attainment(
+        control["retry"]["attainment_timeline"], lo=COMPOUND_RECOVER_BY_S
+    )
+    pre, post = meta_defended
+    ratio_floor = floors["metastability"]["recovery_ratio_floor"]
+    if post < ratio_floor * pre:
+        failures.append(
+            f"{METASTABILITY_SCENARIO}: defended recovery attainment "
+            f"{post:.4f} under {ratio_floor} x pre-fault {pre:.4f} — "
+            "recovery is not complete within the horizon"
+        )
+    min_gap = floors["metastability"]["min_control_gap"]
+    if control_post >= post - min_gap:
+        failures.append(
+            f"{METASTABILITY_SCENARIO}: control-arm recovery "
+            f"{control_post:.4f} is not strictly worse than defended "
+            f"{post:.4f} (gap floor {min_gap}) — the budgets are not "
+            "what carries recovery"
+        )
+    if sum(control["retry"]["denied"].values()) != 0:
+        failures.append(
+            "control arm denied re-dispatches — defenses leaked into "
+            "the naive arm; the comparison is void"
+        )
+
+    summary = {
+        "mode": "sim",
+        "scenarios": per_scenario,
+        "metastability": {
+            "scenario": METASTABILITY_SCENARIO,
+            "fault_at_s": COMPOUND_FAULT_AT_S,
+            "recover_by_s": COMPOUND_RECOVER_BY_S,
+            "defended_pre": round(pre, 4),
+            "defended_recovery": round(post, 4),
+            "control_recovery": round(control_post, 4),
+        },
+        "violations": failures,
+    }
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 1 if failures else 0
+
+
+def run_live(batch_size: int = 8) -> int:
+    from ray_dynamic_batching_tpu.serve.controller import (
+        DeploymentConfig,
+        ServeController,
+    )
+    from ray_dynamic_batching_tpu.serve.failover import PoisonRequest
+    from ray_dynamic_batching_tpu.serve.handle import DeploymentHandle
+    from ray_dynamic_batching_tpu.utils.chaos import (
+        POISON_MARKER,
+        reset_chaos,
+    )
+
+    with open(RATCHET_PATH) as f:
+        floors = json.load(f)["floors"]["live"]
+
+    def work(payloads):
+        time.sleep(0.001)
+        return [p["v"] * 2 for p in payloads]
+
+    violations = []
+    ctl = ServeController(control_interval_s=0.05)
+    router = ctl.deploy(
+        DeploymentConfig(
+            name="matrix", num_replicas=1, max_batch_size=batch_size,
+            batch_wait_timeout_s=0.05, max_ongoing_requests=64,
+        ),
+        factory=lambda: work,
+    )
+    ctl.start()
+    handle = DeploymentHandle(router, default_slo_ms=30_000.0)
+    poison_payload = {POISON_MARKER: "qod-live", "v": -1}
+    try:
+        # Warmup proves the clean path before arming.
+        assert handle.remote({"v": 1}).result(timeout=10) == 2
+        # Seeded poison mode: ONE distinct marker may arm at the batch
+        # execution point (the RDB_TESTING_POISON="replica.process_batch
+        # =1" grammar) — armed markers fire persistently, which is what
+        # the bisection probes rely on.
+        reset_chaos(poison="replica.process_batch=1")
+
+        # One full batch: innocents + the query of death, in flight
+        # together so they share the poisoned execution.
+        innocents = [handle.remote({"v": i}) for i in range(batch_size - 1)]
+        poisoned = handle.remote(poison_payload)
+
+        poison_err = None
+        try:
+            poisoned.result(timeout=30)
+        except PoisonRequest as e:
+            poison_err = e
+        except Exception as e:  # noqa: BLE001 — classification is the test
+            violations.append(
+                f"poison rejected as {type(e).__name__}, not "
+                f"PoisonRequest: {e}"
+            )
+        if poison_err is None and not violations:
+            violations.append(
+                "the query of death COMPLETED — bisection never "
+                "condemned it"
+            )
+        for i, fut in enumerate(innocents):
+            try:
+                if fut.result(timeout=30) != i * 2:
+                    violations.append(
+                        f"innocent #{i} returned a wrong result after "
+                        "bisection — re-execution corrupted it"
+                    )
+            except Exception as e:  # noqa: BLE001
+                violations.append(
+                    f"innocent #{i} failed ({type(e).__name__}: {e}) — "
+                    "bisection must rescue every non-poison request"
+                )
+
+        replica = router.replicas()[0]
+        stats = replica.stats()
+        if stats.get("poison_isolated", 0) != 1:
+            violations.append(
+                f"replica isolated {stats.get('poison_isolated', 0)} "
+                "poisons, want exactly 1"
+            )
+        probes = stats.get("bisect_probes", 0)
+        if probes < floors["min_bisect_probes"]:
+            violations.append(
+                f"{probes} bisection probes recorded (floor "
+                f"{floors['min_bisect_probes']}) — the poison was not "
+                "isolated by bisection"
+            )
+        max_probes = math.ceil(math.log2(batch_size))
+        if probes > max_probes:
+            violations.append(
+                f"{probes} bisection probes for a batch of <= "
+                f"{batch_size} — over the ceil(log2 B) = {max_probes} "
+                "bound"
+            )
+        if len(router.quarantine) < 1:
+            violations.append(
+                "QuarantineRegistry is empty after an isolation"
+            )
+
+        # The fence: the SAME payload again must reject at the front
+        # door — identical fingerprint, no replica involvement.
+        try:
+            handle.remote(dict(poison_payload)).result(timeout=10)
+            violations.append(
+                "repeat of a quarantined payload COMPLETED — the front "
+                "door never consulted the registry"
+            )
+        except PoisonRequest:
+            pass
+        except Exception as e:  # noqa: BLE001
+            violations.append(
+                f"repeat rejected as {type(e).__name__}, not "
+                f"PoisonRequest: {e}"
+            )
+        stats_after = router.replicas()[0].stats()
+        if stats_after.get("poison_isolated", 0) != 1:
+            violations.append(
+                "a second isolation ran for the fenced repeat — the "
+                "poison reached a replica again"
+            )
+        quarantine_audit = [
+            a for a in ctl.audit.to_dicts()
+            if a["trigger"] == "poison_quarantine"
+        ]
+        if not quarantine_audit:
+            violations.append(
+                "no poison_quarantine record in the audit ring"
+            )
+        budget_stats = router.retry_budget.stats()
+        if budget_stats["first_attempts_total"] < batch_size:
+            violations.append(
+                f"retry budget saw {budget_stats['first_attempts_total']}"
+                f" first attempts for {batch_size + 2} submissions — "
+                "first-attempt funding is broken"
+            )
+        summary = {
+            "mode": "live",
+            "batch_size": batch_size,
+            "bisect_probes": probes,
+            "rescue_batches": stats.get("rescue_batches", 0),
+            "poison_isolated": stats_after.get("poison_isolated", 0),
+            "quarantine": router.quarantine.stats(),
+            "retry_budget": budget_stats,
+            "violations": violations,
+        }
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    finally:
+        reset_chaos("")
+        ctl.shutdown()
+    return 1 if violations else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sim", action="store_true",
+                    help="deterministic compound-matrix conformance")
+    ap.add_argument("--live", action="store_true",
+                    help="live seeded-poison bisection + quarantine soak")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if not (args.sim or args.live):
+        ap.error("pick a mode: --sim and/or --live")
+    rc = 0
+    if args.sim:
+        rc = run_sim(seed=args.seed) or rc
+    if args.live:
+        rc = run_live(batch_size=args.batch_size) or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
