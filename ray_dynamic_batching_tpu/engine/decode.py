@@ -93,10 +93,16 @@ from ray_dynamic_batching_tpu.engine.pagefabric import (
     export_stream_parcel,
 )
 from ray_dynamic_batching_tpu.engine.queue import RequestQueue
+from ray_dynamic_batching_tpu.ops import jit_model
 from ray_dynamic_batching_tpu.ops.tile_math import (
     lane_aligned_page,
     pages_for,
     spec_scratch_pages,
+)
+from ray_dynamic_batching_tpu.utils.compile_ledger import (
+    PHASE_WARMUP,
+    get_ledger,
+    instrument,
 )
 from ray_dynamic_batching_tpu.profiles.table import bucket_up
 from ray_dynamic_batching_tpu.utils.concurrency import OrderedLock
@@ -803,15 +809,15 @@ class DecodeEngine:
         # Donations: cache (arg 1) and counts (arg 8 — params=0,
         # cache=1, step_state=2, horizon=3, samp_f=4, samp_i=5,
         # bias_ids=6, bias_vals=7, counts=8).
-        self._decode_fn = jax.jit(
+        self._decode_fn = instrument("decode_step", jax.jit(
             self._decode_impl, donate_argnums=(1, 8), static_argnums=(3,)
-        )
+        ))
         # Pages-direct chunk program (chunked paged admission): one jit,
         # retraced per (group, width) shape; the pool cache (arg 2) is
         # donated across chunks.
-        self._chunk_paged_fn = jax.jit(
+        self._chunk_paged_fn = instrument("chunk_prefill", jax.jit(
             self._chunk_group_paged_impl, donate_argnums=(2,)
-        )
+        ))
         # Speculative decoding (greedy rows only): a small draft proposes
         # spec_tokens continuations per slot, the target verifies the whole
         # window in ONE forward, and the accepted prefix + the target's
@@ -859,12 +865,12 @@ class DecodeEngine:
                 self._dcache = draft_model.make_cache(
                     num_slots, max_len + self.spec_tokens + 1
                 )
-            self._spec_fn = jax.jit(
+            self._spec_fn = instrument("spec_verify", jax.jit(
                 self._spec_impl, donate_argnums=(1, 2)
-            )
-            self._draft_catchup_fn = jax.jit(
+            ))
+            self._draft_catchup_fn = instrument("draft_catchup", jax.jit(
                 self._draft_catchup_impl, donate_argnums=(1,)
-            )
+            ))
         def _reset_counts(counts, slot, first_tok):
             # Fresh tenant: zero the reused row, then count the PREFILL-
             # sampled first token (the scan only counts tokens it samples
@@ -876,7 +882,9 @@ class DecodeEngine:
             )
             return counts.at[slot, first_tok].set(1)
 
-        self._zero_counts_fn = jax.jit(_reset_counts, donate_argnums=(0,))
+        self._zero_counts_fn = instrument(
+            "zero_counts", jax.jit(_reset_counts, donate_argnums=(0,))
+        )
         # Device copies of the per-slot sampling arrays: they change only
         # at admission/finish, but _step dispatches every few ms — without
         # the cache every dispatch re-uploads seven small host arrays
@@ -1328,15 +1336,23 @@ class DecodeEngine:
         fn = self._prefill_fns.get(("draft", bucket, group))
         if fn is None:
             # Donate the draft cache (arg 2 in the packed signature).
-            fn = jax.jit(self._draft_prefill_impl, donate_argnums=(2,))
+            fn = instrument("draft_prefill", jax.jit(
+                self._draft_prefill_impl, donate_argnums=(2,)
+            ))
             self._prefill_fns[("draft", bucket, group)] = fn
         return fn
 
     def _admit_group_sizes(self) -> List[int]:
-        """Compiled prefill group widths: powers of two up to the admission
-        cap, plus the cap itself when it isn't one — every chunk width
-        _admit can produce must round up to a width warmup compiled, or a
-        burst pays a 20-40s XLA compile mid-serving."""
+        """Compiled prefill/chunk group widths: powers of two up to
+        ``max_admissions_per_step``, plus the cap itself when it isn't
+        one. The cap is a GROUP-WIDTH clamp on both arms — the legacy
+        mono arm's ``_admit`` batches that many full-prompt prefills,
+        and the chunked arm's ``_pump_prefill`` batches up to that many
+        same-width single-chunk trains per dispatch (its PACING is the
+        token budget, not this count). Either way, every group width
+        the engine can dispatch must round up to a width warmup
+        compiled, or a burst pays a 20-40s XLA compile mid-serving —
+        the warmup-coverage contract (``ops/jit_model.py``)."""
         sizes, s = [], 1
         while s <= self.max_admissions_per_step:
             sizes.append(s)
@@ -1349,17 +1365,58 @@ class DecodeEngine:
         fn = self._prefill_fns.get((bucket, group))
         if fn is None:
             # Donate the big cache (arg 2) — updated in place in HBM.
-            impl = (self._prefill_paged_impl if self.paged
-                    else self._prefill_impl)
-            fn = jax.jit(impl, donate_argnums=(2,))
+            name = ("prefill_group_paged" if self.paged
+                    else "prefill_group")
+            fn = instrument(name, jax.jit(
+                self._prefill_paged_impl if self.paged
+                else self._prefill_impl,
+                donate_argnums=(2,),
+            ))
             self._prefill_fns[(bucket, group)] = fn
         return fn
 
     def warmup(self) -> None:
-        """Compile every (prompt bucket, group size) + both decode horizons
-        before serving."""
-        with self._device_ctx():
-            self._warmup_impl()
+        """Compile every hot-path program before serving: the arm's
+        admission programs (chunked-paged: the chunk program over every
+        (bucket x group) shape; slab-chunked: the long chunk + fused
+        commit pair; mono: the (bucket x group) prefill grid) plus the
+        decode horizons {1, ttft, decode} and the spec/draft programs
+        when a draft rides along.
+
+        Contract-bearing (ISSUE 20): the whole run is bracketed by the
+        compile ledger's warmup phase — ``end_warmup`` arms the
+        steady-state mark, after which ANY compile is a recorded
+        violation — and the ledger's warmup counts are cross-checked
+        against ``ops/jit_model.required_for``: a registered program
+        this arm needs that warmup did not compile raises HERE, at
+        startup, instead of stalling a request 20-40s mid-serving."""
+        ledger = get_ledger()
+        before = ledger.counts(phase=PHASE_WARMUP)
+        ledger.begin_warmup()
+        try:
+            with self._device_ctx():
+                self._warmup_impl()
+        finally:
+            ledger.end_warmup()
+        after = ledger.counts(phase=PHASE_WARMUP)
+        if after == before:
+            # Zero new compiles: every program was already cached (this
+            # engine was warmed before) — nothing to cross-check.
+            return
+        required = jit_model.required_for(
+            self.chunked_prefill, self.paged, self.draft_model is not None
+        )
+        gaps = [
+            p.name for p in required
+            if after.get(p.name, 0) <= before.get(p.name, 0)
+        ]
+        if gaps:
+            raise RuntimeError(
+                f"warmup coverage gap: registered hot-path program(s) "
+                f"{gaps} compiled nothing during warmup — the warmup "
+                "routine and ops/jit_model.required_for disagree; fix "
+                "whichever is wrong before this engine serves"
+            )
 
     def _warmup_impl(self) -> None:
         if self.chunked_prefill and self.paged:
@@ -1683,9 +1740,13 @@ class DecodeEngine:
         return ids, vals
 
     def _admit(self) -> int:
-        """Fill free slots from the queue (continuous batching join), at most
-        ``max_admissions_per_step`` at a time so prefills interleave with
-        decode steps instead of stalling every active slot.
+        """Fill free slots from the queue (continuous batching join).
+        Chunked engines admit into chunk TRAINS — their prefill work is
+        paced by ``prefill_token_budget`` in ``_pump_prefill``, so
+        admission itself takes every free slot. The legacy monolithic
+        arm rations by COUNT instead (at most
+        ``max_admissions_per_step`` full-prompt prefills between decode
+        steps) so prefills interleave with decode turns.
 
         Same-bucket prompts prefill as ONE batched program call (group
         padded to the next compiled power-of-two width by duplicating row 0
@@ -1693,9 +1754,9 @@ class DecodeEngine:
         idempotent), so a burst of admissions costs one dispatch per bucket
         rather than one per request.
 
-        The cap only applies while slots are actively decoding (it exists to
-        protect THEIR latency); an idle engine ramps by filling every free
-        slot at once — there is nothing to stall."""
+        The mono count cap only applies while slots are actively decoding
+        (it exists to protect THEIR latency); an idle engine ramps by
+        filling every free slot at once — there is nothing to stall."""
         free = self._free_slots()
         if not free:
             return 0
@@ -2637,7 +2698,9 @@ class DecodeEngine:
     def _paged_seed_fn(self) -> Callable:
         fn = self._prefill_fns.get("paged_seed")
         if fn is None:
-            fn = jax.jit(self._seed_paged_impl, donate_argnums=(0,))
+            fn = instrument("paged_seed", jax.jit(
+                self._seed_paged_impl, donate_argnums=(0,)
+            ))
             self._prefill_fns["paged_seed"] = fn
         return fn
 
@@ -2649,14 +2712,24 @@ class DecodeEngine:
         fns = self._prefill_fns.get(("long", chunk))
         if fns is None:
             fns = (
-                jax.jit(self._prefill_chunk_impl, donate_argnums=(3,)),
+                instrument("long_chunk", jax.jit(
+                    self._prefill_chunk_impl, donate_argnums=(3,)
+                )),
                 # Only the shared cache (arg 0) can alias the output; the
                 # row cache's [L,1,row_cap,K,H] matches no output shape, so
                 # donating it buys nothing and warns on every compile.
-                jax.jit(self._commit_long_paged_impl if self.paged
-                        else self._commit_long_impl, donate_argnums=(0,)),
-                jax.jit(self._seed_prefix_impl, donate_argnums=(0,)),
-                jax.jit(self._extract_prefix_impl, static_argnums=(1,)),
+                instrument(
+                    "long_commit_paged" if self.paged else "long_commit",
+                    jax.jit(self._commit_long_paged_impl if self.paged
+                            else self._commit_long_impl,
+                            donate_argnums=(0,)),
+                ),
+                instrument("prefix_seed", jax.jit(
+                    self._seed_prefix_impl, donate_argnums=(0,)
+                )),
+                instrument("prefix_extract", jax.jit(
+                    self._extract_prefix_impl, static_argnums=(1,)
+                )),
             )
             self._prefill_fns[("long", chunk)] = fns
         return fns
@@ -2842,8 +2915,11 @@ class DecodeEngine:
         fns = self._prefill_fns.get("session")
         if fns is None:
             fns = (
-                jax.jit(self._seed_session_impl, donate_argnums=(0,)),
-                jax.jit(self._extract_row_impl),
+                instrument("session_seed", jax.jit(
+                    self._seed_session_impl, donate_argnums=(0,)
+                )),
+                instrument("session_extract",
+                           jax.jit(self._extract_row_impl)),
             )
             self._prefill_fns["session"] = fns
         return fns
@@ -2957,8 +3033,10 @@ class DecodeEngine:
                 )
 
             fns = (
-                jax.jit(chunk_impl, donate_argnums=(3,)),
-                jax.jit(commit_row, donate_argnums=(0,)),
+                instrument("draft_long_chunk",
+                           jax.jit(chunk_impl, donate_argnums=(3,))),
+                instrument("draft_long_commit",
+                           jax.jit(commit_row, donate_argnums=(0,))),
             )
             self._prefill_fns[("draft_long", C)] = fns
         chunk_fn, commit_fn = fns

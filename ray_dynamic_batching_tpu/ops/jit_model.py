@@ -1,0 +1,319 @@
+"""Shared static model of the engine's hot-path jit programs.
+
+Single source of truth for WHICH jit entry points exist on the decode
+hot path, what their donation contracts are, what shape grid each one
+retraces over, and which warmup routine is responsible for compiling it
+before serving. Consumed by BOTH enforcers (the ``tile_math`` /
+``concurrency.LOCK_RANKS`` pattern applied to the jit layer):
+
+- at runtime, ``DecodeEngine._warmup_impl`` cross-checks the compile
+  ledger (``utils/compile_ledger.py``) against :func:`required_for` —
+  a registered program its arm needs that warmup did NOT compile is a
+  hard error at startup, not a 20-40s XLA stall mid-serving;
+- statically, three rdb-lint rules load this module standalone
+  (importlib, no jax): ``jit-retrace-hazard`` analyses the registered
+  impl bodies (decode.py jits them via ``jax.jit(self._impl)`` at init,
+  invisible to the decorator-based host-sync rule),
+  ``donation-discipline`` pins every ``jax.jit`` creation site's
+  ``donate_argnums``/``static_argnums`` to the contract recorded here,
+  and ``warmup-coverage`` requires every registered program to be
+  invoked inside its declared ``warmed_by`` routine (and every
+  UNregistered ``self._*_fn = jax.jit(...)`` assignment to either join
+  the registry or carry a reasoned pragma).
+
+Deliberately dependency-free (no jax import): the linter loads this
+module standalone so ``python -m tools.lint`` stays fast and runs in
+environments without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Tuple
+
+# Engine arms a program serves. An engine instance activates a subset
+# (see required_for); warmup is judged per-arm, so the mono engine is
+# not required to warm chunk programs it never dispatches.
+ARM_ALWAYS = "always"            # every engine configuration
+ARM_CHUNKED_PAGED = "chunked_paged"  # chunked_prefill and paged
+ARM_CHUNKED_SLAB = "chunked_slab"    # chunked_prefill, slab cache
+ARM_MONO = "mono"                # legacy monolithic admission
+ARM_SPEC = "spec"                # draft model attached
+ARM_SPEC_MONO = "spec_mono"      # draft model AND mono admission
+
+
+@dataclass(frozen=True)
+class JitProgram:
+    """One hot-path jit entry point and its contracts.
+
+    ``attr`` is the engine attribute (or factory method) holding the
+    compiled callable; ``impl`` the method jit-wrapped at creation.
+    ``donate``/``static`` are the EXACT ``donate_argnums`` /
+    ``static_argnums`` the creation site must pass — ``donated`` names
+    the buffers those positions carry, so a contract change has to say
+    what it un-donates. ``grid`` documents the shape axes the program
+    retraces over; ``warmed_by`` names the warmup routine that must
+    invoke ``attr`` (empty iff lazy, with a mandatory ``lazy_reason``).
+    """
+
+    name: str
+    attr: str
+    impl: str
+    donate: Tuple[int, ...] = ()
+    static: Tuple[int, ...] = ()
+    donated: Tuple[str, ...] = ()
+    grid: str = ""
+    warmed_by: str = ""
+    lazy_reason: str = ""
+    arm: str = ARM_ALWAYS
+
+    def __post_init__(self) -> None:
+        if not self.warmed_by and not self.lazy_reason:
+            raise ValueError(
+                f"jit program {self.name!r}: not warmed and no "
+                "lazy_reason — every hot-path program is either warmed "
+                "or explains why a first-hit compile is acceptable"
+            )
+
+
+HOT_PROGRAMS: Tuple[JitProgram, ...] = (
+    JitProgram(
+        name="decode_step",
+        attr="_decode_fn", impl="_decode_impl",
+        donate=(1, 8), static=(3,),
+        donated=("cache", "counts"),
+        grid="horizon in {1, ttft_horizon, decode_horizon}",
+        warmed_by="_warmup_decode", arm=ARM_ALWAYS,
+    ),
+    JitProgram(
+        name="chunk_prefill",
+        attr="_chunk_paged_fn", impl="_chunk_group_paged_impl",
+        donate=(2,),
+        donated=("pool cache",),
+        grid="(bucket x group) via _admit_group_sizes",
+        warmed_by="_warmup_impl", arm=ARM_CHUNKED_PAGED,
+    ),
+    JitProgram(
+        name="prefill_group",
+        attr="_prefill_fn", impl="_prefill_impl",
+        donate=(2,),
+        donated=("cache",),
+        grid="(bucket x group) via _admit_group_sizes",
+        warmed_by="_warmup_prefill_groups", arm=ARM_MONO,
+    ),
+    JitProgram(
+        name="prefill_group_paged",
+        attr="_prefill_fn", impl="_prefill_paged_impl",
+        donate=(2,),
+        donated=("cache",),
+        grid="(bucket x group) via _admit_group_sizes",
+        warmed_by="_warmup_prefill_groups", arm=ARM_MONO,
+    ),
+    JitProgram(
+        name="spec_verify",
+        attr="_spec_fn", impl="_spec_impl",
+        donate=(1, 2),
+        donated=("cache", "draft cache"),
+        grid="one shape: (num_slots x spec_window)",
+        warmed_by="_warmup_decode", arm=ARM_SPEC,
+    ),
+    JitProgram(
+        name="draft_catchup",
+        attr="_draft_catchup_fn", impl="_draft_catchup_impl",
+        donate=(1,),
+        donated=("draft cache",),
+        grid="window h in {1, ttft_horizon, decode_horizon}",
+        warmed_by="_warmup_decode", arm=ARM_SPEC,
+    ),
+    JitProgram(
+        name="draft_prefill",
+        attr="_draft_prefill_fn", impl="_draft_prefill_impl",
+        donate=(2,),
+        donated=("draft cache",),
+        grid="(bucket x group) via _admit_group_sizes",
+        warmed_by="_warmup_decode", arm=ARM_SPEC_MONO,
+    ),
+    JitProgram(
+        name="zero_counts",
+        attr="_zero_counts_fn", impl="_reset_counts",
+        donate=(0,),
+        donated=("counts",),
+        grid="one shape: (num_slots x vocab)",
+        warmed_by="_warmup_decode", arm=ARM_ALWAYS,
+    ),
+    # --- registered-lazy programs (legacy/slab arms and cold session
+    # moves). Each lazy_reason is load-bearing: warmup-coverage treats an
+    # UNregistered lazy jit as a finding, so adding a factory means
+    # writing down why its first-hit compile is acceptable.
+    JitProgram(
+        name="long_chunk",
+        attr="_long_prefill_fns", impl="_prefill_chunk_impl",
+        donate=(3,),
+        donated=("row cache",),
+        grid="chunk = largest bucket (one per engine)",
+        warmed_by="_warmup_impl", arm=ARM_CHUNKED_SLAB,
+    ),
+    JitProgram(
+        name="long_commit",
+        attr="_long_prefill_fns", impl="_commit_long_impl",
+        donate=(0,),
+        donated=("cache",),
+        grid="chunk = largest bucket (one per engine)",
+        warmed_by="_warmup_impl", arm=ARM_CHUNKED_SLAB,
+    ),
+    JitProgram(
+        name="long_commit_paged",
+        attr="_long_prefill_fns", impl="_commit_long_paged_impl",
+        donate=(0,),
+        donated=("cache",),
+        grid="chunk = largest bucket",
+        lazy_reason="mono-paged engines reach long fills only for "
+        "prompts past the largest bucket, which may never arrive; the "
+        "persistent compilation cache absorbs the first-hit cost",
+        arm=ARM_MONO,
+    ),
+    JitProgram(
+        name="prefix_seed",
+        attr="_long_prefill_fns", impl="_seed_prefix_impl",
+        donate=(0,),
+        donated=("row cache",),
+        grid="one shape per chunk size",
+        lazy_reason="prefix-cache CoW seeding rides the long-fill path; "
+        "slab engines with no long prompts never dispatch it",
+        arm=ARM_MONO,
+    ),
+    JitProgram(
+        name="prefix_extract",
+        attr="_long_prefill_fns", impl="_extract_prefix_impl",
+        static=(1,),
+        grid="one shape per (chunk, prefix length bucket)",
+        lazy_reason="runs once per prefix PUBLISH (cold, off the decode "
+        "turn); publishing is already an amortized slow path",
+        arm=ARM_MONO,
+    ),
+    JitProgram(
+        name="paged_seed",
+        attr="_paged_seed_fn", impl="_seed_paged_impl",
+        donate=(0,),
+        donated=("row cache",),
+        grid="one shape: (1 x row_cap)",
+        lazy_reason="legacy mono-paged session/prefix seeding only; the "
+        "chunked-universal arm seeds pages-direct through the chunk "
+        "program and never calls this",
+        arm=ARM_MONO,
+    ),
+    JitProgram(
+        name="session_seed",
+        attr="_session_fns", impl="_seed_session_impl",
+        donate=(0,),
+        donated=("row cache",),
+        grid="one shape: (1 x max_len)",
+        lazy_reason="slab session continuation only — sessions may "
+        "never be enabled; first turn-2 on a restart pays it once",
+        arm=ARM_MONO,
+    ),
+    JitProgram(
+        name="session_extract",
+        attr="_session_fns", impl="_extract_row_impl",
+        grid="one shape: (1 x max_len)",
+        lazy_reason="runs once per session FINISH (cold, off the "
+        "decode turn) to pin the finished row",
+        arm=ARM_MONO,
+    ),
+    JitProgram(
+        name="draft_long_chunk",
+        attr="_draft_long_fill", impl="chunk_impl",
+        donate=(3,),
+        donated=("draft row cache",),
+        grid="chunk = largest bucket",
+        lazy_reason="spec engines see long prompts rarely; the draft's "
+        "chunk program compiles once at the first long admission and "
+        "the chunk-stall bound already prices that turn",
+        arm=ARM_SPEC,
+    ),
+    JitProgram(
+        name="draft_long_commit",
+        attr="_draft_long_fill", impl="commit_row",
+        donate=(0,),
+        donated=("draft cache",),
+        grid="chunk = largest bucket",
+        lazy_reason="paired with draft_long_chunk — same cold path",
+        arm=ARM_SPEC,
+    ),
+)
+
+_BY_NAME: Dict[str, JitProgram] = {p.name: p for p in HOT_PROGRAMS}
+
+
+def program(name: str) -> JitProgram:
+    return _BY_NAME[name]
+
+
+def program_names() -> Tuple[str, ...]:
+    return tuple(_BY_NAME)
+
+
+def warmed_programs() -> Tuple[JitProgram, ...]:
+    return tuple(p for p in HOT_PROGRAMS if p.warmed_by)
+
+
+def lazy_programs() -> Tuple[JitProgram, ...]:
+    return tuple(p for p in HOT_PROGRAMS if not p.warmed_by)
+
+
+def registered_impls() -> FrozenSet[str]:
+    """Impl callable names the registry knows — the retrace rule's
+    analysis set and warmup-coverage's registration check."""
+    return frozenset(p.impl for p in HOT_PROGRAMS)
+
+
+def registered_attrs() -> FrozenSet[str]:
+    """Engine attributes / factories that legally hold jit programs."""
+    return frozenset(p.attr for p in HOT_PROGRAMS)
+
+
+def donation_contract(impl: str) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """(donate_argnums, static_argnums) the creation site wrapping
+    ``impl`` must pass. KeyError for unregistered impls — callers decide
+    whether unknown means 'not hot path' or 'finding'."""
+    for p in HOT_PROGRAMS:
+        if p.impl == impl:
+            return (p.donate, p.static)
+    raise KeyError(impl)
+
+
+def required_for(chunked_prefill: bool, paged: bool,
+                 has_draft: bool) -> Tuple[JitProgram, ...]:
+    """Warmed programs an engine configuration MUST compile during
+    warmup — the runtime coverage check's ground truth. Mirrors the
+    dispatch in ``DecodeEngine._warmup_impl``: chunked+paged warms the
+    chunk program, slab-chunked the long chunk/commit pair, mono the
+    (bucket x group) prefill grid; spec engines add verify + catch-up,
+    and only MONO spec engines add the draft group-prefill grid."""
+    arms = {ARM_ALWAYS}
+    if chunked_prefill and paged:
+        arms.add(ARM_CHUNKED_PAGED)
+    elif chunked_prefill:
+        arms.add(ARM_CHUNKED_SLAB)
+    else:
+        arms.add(ARM_MONO)
+    if has_draft:
+        arms.add(ARM_SPEC)
+        if not chunked_prefill:
+            arms.add(ARM_SPEC_MONO)
+    out = []
+    for p in warmed_programs():
+        if p.arm not in arms:
+            continue
+        # The prefill_group pair is impl-dispatched on paged-ness; only
+        # one of the two compiles on a given engine.
+        if p.name == "prefill_group" and paged:
+            continue
+        if p.name == "prefill_group_paged" and not paged:
+            continue
+        # Slab-arm long programs: _commit_long_impl serves slab engines,
+        # _commit_long_paged_impl is registered lazy for mono-paged.
+        if p.name == "long_commit" and paged:
+            continue
+        out.append(p)
+    return tuple(out)

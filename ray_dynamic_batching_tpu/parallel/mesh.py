@@ -154,7 +154,7 @@ def _sharded_alloc(mesh: Mesh, make_fn, spec) -> Any:
         f.name: _shard(getattr(spec, f.name, None), getattr(shapes, f.name))
         for f in dataclasses.fields(shapes)
     })
-    return jax.jit(make_fn, out_shardings=shardings)()
+    return jax.jit(make_fn, out_shardings=shardings)()  # rdb-lint: disable=jit-retrace-hazard (one-shot cache allocation at engine construction — jit only carries out_shardings so GSPMD places the buffers; never called on the serving path)
 
 
 def make_sharded_cache(

@@ -301,5 +301,5 @@ def make_pp_train_state(
     rng: Optional[jax.Array] = None,
 ) -> Tuple[Any, Any]:
     params = pmodel.shard_init(rng if rng is not None else jax.random.PRNGKey(0))
-    opt_state = jax.jit(optimizer.init)(params)
+    opt_state = jax.jit(optimizer.init)(params)  # rdb-lint: disable=jit-retrace-hazard (one-shot optimizer-state init at train-state construction; jit only propagates stage shardings to the moment buffers)
     return params, opt_state

@@ -58,7 +58,7 @@ def make_sharded_train_state(
     params = model.init(rng if rng is not None else jax.random.PRNGKey(0))
     params = shard_params(mesh, model, params)
     # init under jit so moment buffers inherit the param shardings via GSPMD
-    opt_state = jax.jit(optimizer.init)(params)
+    opt_state = jax.jit(optimizer.init)(params)  # rdb-lint: disable=jit-retrace-hazard (one-shot optimizer-state init at train-state construction, off the serving path)
     return params, opt_state
 
 

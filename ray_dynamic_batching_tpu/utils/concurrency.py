@@ -66,6 +66,10 @@ LOCKORDER_ENV_VAR = "RDB_TESTING_LOCKORDER"
 #                  delivery; near-leaf by design.
 #   sketch         RollingSketch epoch state — read under queue /
 #                  observatory locks.
+#   compile_ledger CompileLedger episode/violation state — updated from
+#                  jax.monitoring callbacks during dispatch; bumps the
+#                  rdb_jit_compiles_total counter while held, so it must
+#                  sit ABOVE every dispatcher lock and BELOW metrics.
 #   metrics        Metric/registry state — THE innermost: counters are
 #                  bumped under every other lock in the stack.
 LOCK_RANKS: Dict[str, int] = {
@@ -81,6 +85,7 @@ LOCK_RANKS: Dict[str, int] = {
     "allocator": 100,
     "fabric": 110,
     "sketch": 120,
+    "compile_ledger": 125,
     "metrics": 130,
 }
 

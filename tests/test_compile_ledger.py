@@ -1,0 +1,155 @@
+"""Compile flight recorder (ISSUE 20): episode counting over
+jax.monitoring events, phase attribution, the steady-state mark, and
+byte-stable serialization.
+
+The counting unit under test is the EPISODE — one wrapped call in which
+any compile event fired — because jax emits several backend_compile
+bursts per trace (three on a first call, two on a retrace, measured);
+raw events would overcount every compile.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_dynamic_batching_tpu.utils.compile_ledger import (
+    PHASE_STARTUP,
+    PHASE_STEADY,
+    PHASE_WARMUP,
+    SteadyStateViolation,
+    get_ledger,
+)
+
+
+@pytest.fixture()
+def ledger():
+    led = get_ledger()
+    led.reset()
+    yield led
+    # Leave the process ledger disarmed so later tests' compiles are
+    # plain startup episodes, never false violations.
+    led.reset()
+
+
+def _toy(scale):
+    # A fresh jit per test: its cache is empty, so first-call compiles
+    # are deterministic regardless of what ran before in the process.
+    return jax.jit(lambda x: x * scale)
+
+
+class TestEpisodeCounting:
+    def test_first_call_is_exactly_one_episode(self, ledger):
+        fn = ledger.instrument("toy", _toy(2))
+        fn(jnp.ones((4,)))
+        assert ledger.counts()["toy"] == 1
+
+    def test_cached_dispatch_records_nothing(self, ledger):
+        fn = ledger.instrument("toy", _toy(3))
+        fn(jnp.ones((4,)))
+        before = ledger.counts()["toy"]
+        fn(jnp.ones((4,)))
+        fn(jnp.ones((4,)))
+        assert ledger.counts()["toy"] == before
+
+    def test_forced_retrace_counts_exactly_once_per_shape(self, ledger):
+        fn = ledger.instrument("toy", _toy(5))
+        fn(jnp.ones((4,)))          # startup compile
+        ledger.begin_warmup()
+        fn(jnp.ones((8,)))          # new shape: ONE warmup episode
+        fn(jnp.ones((8,)))          # cached
+        ledger.end_warmup()
+        assert ledger.counts()["toy"] == 2
+        assert ledger.counts(phase=PHASE_STARTUP)["toy"] == 1
+        assert ledger.counts(phase=PHASE_WARMUP)["toy"] == 1
+        assert ledger.counts(phase=PHASE_STEADY) == {}
+
+    def test_result_passes_through_wrapper(self, ledger):
+        fn = ledger.instrument("toy", _toy(7))
+        out = fn(jnp.ones((2,)))
+        assert out.tolist() == [7.0, 7.0]
+
+
+class TestSteadyStateMark:
+    def test_violation_recorded_and_gate_raises(self, ledger):
+        fn = ledger.instrument("toy", _toy(11))
+        ledger.begin_warmup()
+        fn(jnp.ones((4,)))
+        # Built during warmup: jnp.ones itself compiles on first use of
+        # a shape, and a steady-phase constant build would be a real
+        # (unattributed) violation of its own.
+        x16 = jnp.ones((16,))
+        ledger.end_warmup()
+        ledger.check_steady()  # clean so far
+        fn(x16)                # post-warmup retrace: the violation
+        v = ledger.violations()
+        assert len(v) == 1
+        assert v[0]["fn"] == "toy"
+        assert "16" in v[0]["shapes"]
+        assert "test_compile_ledger" in v[0]["callsite"]
+        with pytest.raises(SteadyStateViolation) as exc:
+            ledger.check_steady()
+        assert "toy" in str(exc.value)
+
+    def test_nested_warmups_arm_only_at_depth_zero(self, ledger):
+        fn = ledger.instrument("toy", _toy(13))
+        ledger.begin_warmup()
+        ledger.begin_warmup()
+        ledger.end_warmup()
+        # Still inside the outer warmup: compiles are warmup, not steady.
+        fn(jnp.ones((4,)))
+        ledger.end_warmup()
+        assert ledger.counts(phase=PHASE_WARMUP)["toy"] == 1
+        assert ledger.violations() == []
+        assert ledger.phase == PHASE_STEADY
+
+    def test_force_arm_via_steady_state(self, ledger):
+        fn = ledger.instrument("toy", _toy(17))
+        ledger.steady_state()
+        fn(jnp.ones((4,)))
+        with pytest.raises(SteadyStateViolation):
+            ledger.check_steady()
+
+
+class TestReport:
+    def test_report_is_byte_stable(self, ledger):
+        fn = ledger.instrument("toy", _toy(19))
+        ledger.begin_warmup()
+        fn(jnp.ones((4,)))
+        ledger.end_warmup()
+        first = ledger.to_json()
+        second = ledger.to_json()
+        assert first == second
+        payload = json.loads(first)
+        assert payload["functions"]["toy"]["episodes"] == 1
+        assert payload["by_phase"][PHASE_WARMUP] >= 1
+        assert payload["violations"] == []
+        assert first.endswith("\n")
+
+    def test_reset_clears_everything(self, ledger):
+        fn = ledger.instrument("toy", _toy(23))
+        ledger.steady_state()
+        fn(jnp.ones((4,)))
+        ledger.reset()
+        assert ledger.counts() == {}
+        assert ledger.violations() == []
+        assert ledger.phase == PHASE_STARTUP
+
+    def test_wrapper_is_thread_attributed(self, ledger):
+        # Frames are thread-local: a compile on a worker thread charges
+        # the program the WORKER wrapped, not whatever the main thread
+        # happens to be running.
+        fn = ledger.instrument("worker_toy", _toy(29))
+        done = threading.Event()
+
+        def work():
+            fn(jnp.ones((6,)))
+            done.set()
+
+        t = threading.Thread(target=work)
+        t.start()
+        t.join(timeout=60)
+        assert done.is_set()
+        assert ledger.counts()["worker_toy"] == 1

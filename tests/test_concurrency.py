@@ -34,7 +34,7 @@ class TestLockRanks:
         chain = ["controller", "store", "lease", "store_log",
                  "router_pool", "failover", "observatory",
                  "request_queue", "token_stream", "allocator",
-                 "fabric", "sketch", "metrics"]
+                 "fabric", "sketch", "compile_ledger", "metrics"]
         assert list(LOCK_RANKS) == chain
         assert [LOCK_RANKS[n] for n in chain] == sorted(
             LOCK_RANKS[n] for n in chain)

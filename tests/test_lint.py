@@ -2107,3 +2107,374 @@ class TestConcurrencyRulesShipped:
         from ray_dynamic_batching_tpu.utils.concurrency import LOCK_RANKS
 
         assert lockorder.LOCK_RANKS == LOCK_RANKS
+
+
+# --- jit discipline rules (ISSUE 20) ---------------------------------------
+
+# The exact hazard the tree-sweep found three times (parallel/mesh.py
+# sharded-cache alloc, parallel/train.py + pipeline.py optimizer init):
+# a jax.jit created and invoked in one expression — the compile cache
+# dies with the expression, so EVERY call re-traces.
+SWEPT_IMMEDIATE_INVOKE = """
+    import jax
+
+    def make_sharded_alloc(make_fn, shardings):
+        {pragma}
+        return jax.jit(make_fn, out_shardings=shardings)()
+"""
+
+
+class TestJitRetraceHazard:
+    def test_swept_immediate_invoke_regression_is_flagged(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "parallel/alloc.py",
+            SWEPT_IMMEDIATE_INVOKE.format(pragma=""),
+            rules={"jit-retrace-hazard"})
+        assert rules_found(report) == ["jit-retrace-hazard"]
+        assert "immediately invoked" in report.new[0].message
+
+    def test_factory_return_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "parallel/train.py", """
+            import jax
+
+            def make_step(step):
+                return jax.jit(step, donate_argnums=(0,))
+        """, rules={"jit-retrace-hazard"})
+        assert report.new == []
+
+    def test_pragma_with_reason_suppresses(self, tmp_path):
+        src = """
+            import jax
+
+            def make_sharded_alloc(make_fn, shardings):
+                return jax.jit(make_fn, out_shardings=shardings)()  # rdb-lint: disable=jit-retrace-hazard (one-shot alloc at construction)
+        """
+        report = lint_fixture(tmp_path, "parallel/alloc.py", src,
+                              rules={"jit-retrace-hazard"})
+        assert report.new == [] and report.pragma_suppressed >= 1
+
+    def test_baselined_hazard_does_not_fail(self, tmp_path):
+        report = lint_fixture(
+            tmp_path, "parallel/alloc.py",
+            SWEPT_IMMEDIATE_INVOKE.format(pragma="pass"),
+            rules={"jit-retrace-hazard"},
+            baseline=_baseline([{
+                "rule": "jit-retrace-hazard", "path": "parallel/alloc.py",
+                "symbol": "make_sharded_alloc", "count": 1,
+                "reason": "legacy one-shot alloc; conversion tracked",
+            }]),
+        )
+        assert report.new == [] and len(report.baselined) == 1
+
+    def test_jit_of_lambda_inside_function_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            def make(x):
+                return jax.jit(lambda y: y + x)
+        """, rules={"jit-retrace-hazard"})
+        assert rules_found(report) == ["jit-retrace-hazard"]
+        assert "lambda" in report.new[0].message
+
+    def test_module_level_jit_of_lambda_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            double = jax.jit(lambda y: y * 2)
+        """, rules={"jit-retrace-hazard"})
+        assert report.new == []
+
+    def test_non_literal_static_argnums_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/build.py", """
+            import jax
+
+            def build(impl, statics):
+                return jax.jit(impl, static_argnums=statics)
+        """, rules={"jit-retrace-hazard"})
+        assert rules_found(report) == ["jit-retrace-hazard"]
+        assert "not a literal" in report.new[0].message
+
+    def test_branch_on_traced_param_in_registered_impl_flags(
+            self, tmp_path):
+        # decode.py jits _decode_impl via jax.jit(self._decode_impl) at
+        # init — no decorator, so host-sync never saw its body. The
+        # registry (ops/jit_model.py) closes the gap: params is traced
+        # (arg 0; only jit arg 3 = horizon is static).
+        report = lint_fixture(tmp_path, "ops/decode.py", """
+            class Engine:
+                def _decode_impl(self, params, cache, ids, horizon):
+                    if params:
+                        return ids
+                    return cache
+        """, rules={"jit-retrace-hazard"})
+        assert rules_found(report) == ["jit-retrace-hazard"]
+        assert "'params'" in report.new[0].message
+
+    def test_branch_on_static_param_in_registered_impl_is_clean(
+            self, tmp_path):
+        # horizon is def index 4 = jit arg 3 — static per the registry
+        # contract for decode_step, so a Python branch on it is legal.
+        report = lint_fixture(tmp_path, "ops/decode.py", """
+            class Engine:
+                def _decode_impl(self, params, cache, ids, horizon):
+                    if horizon:
+                        return ids
+                    return cache
+        """, rules={"jit-retrace-hazard"})
+        assert report.new == []
+
+    def test_same_body_in_unregistered_method_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/decode.py", """
+            class Engine:
+                def _decode_helper(self, params, cache, ids, horizon):
+                    if params:
+                        return ids
+                    return cache
+        """, rules={"jit-retrace-hazard"})
+        assert report.new == []
+
+    def test_int_coercion_in_registered_impl_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "ops/decode.py", """
+            class Engine:
+                def _decode_impl(self, params, cache, ids, horizon):
+                    n = int(ids)
+                    return n
+        """, rules={"jit-retrace-hazard"})
+        assert rules_found(report) == ["jit-retrace-hazard"]
+
+
+class TestDonationDiscipline:
+    def test_contract_drift_is_flagged(self, tmp_path):
+        # Registry records donate_argnums=(1, 8) for _decode_impl; a
+        # creation site passing (1,) un-donates the counts buffer.
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            class Engine:
+                def __init__(self):
+                    self._decode_fn = jax.jit(
+                        self._decode_impl, donate_argnums=(1,),
+                        static_argnums=(3,))
+        """, rules={"donation-discipline"})
+        assert rules_found(report) == ["donation-discipline"]
+        assert "(1, 8)" in report.new[0].message
+
+    def test_matching_contract_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            class Engine:
+                def __init__(self):
+                    self._decode_fn = jax.jit(
+                        self._decode_impl, donate_argnums=(1, 8),
+                        static_argnums=(3,))
+        """, rules={"donation-discipline"})
+        assert report.new == []
+
+    def test_non_literal_donate_argnums_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            DONATE = (1, 8)
+
+            class Engine:
+                def __init__(self):
+                    self._decode_fn = jax.jit(
+                        self._decode_impl, donate_argnums=DONATE,
+                        static_argnums=(3,))
+        """, rules={"donation-discipline"})
+        assert any("not a literal" in f.message for f in report.new)
+
+    def test_use_after_donate_is_flagged(self, tmp_path):
+        # _decode_fn donates args (1, 8): reading self._cache after the
+        # call without rebinding reads a deleted buffer.
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            class Engine:
+                def step(self):
+                    out = self._decode_fn(self.params, self._cache)
+                    return self._cache.sum()
+        """, rules={"donation-discipline"})
+        assert rules_found(report) == ["donation-discipline"]
+        assert "read again" in report.new[0].message
+
+    def test_rebind_in_same_statement_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            class Engine:
+                def step(self):
+                    out, self._cache = self._decode_fn(
+                        self.params, self._cache)
+                    return out
+        """, rules={"donation-discipline"})
+        assert report.new == []
+
+    def test_donated_attr_never_rebound_is_flagged(self, tmp_path):
+        # zero_counts donates arg 0; a bare call leaves self._counts
+        # pointing at a deleted buffer.
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            class Engine:
+                def boot(self):
+                    self._zero_counts_fn(self._counts)
+        """, rules={"donation-discipline"})
+        assert rules_found(report) == ["donation-discipline"]
+        assert "never rebound" in report.new[0].message
+
+    def test_later_rebind_then_read_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            class Engine:
+                def boot(self):
+                    self._counts = self._zero_counts_fn(self._counts)
+                    return self._counts
+        """, rules={"donation-discipline"})
+        assert report.new == []
+
+    def test_pragma_with_reason_suppresses(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            class Engine:
+                def boot(self):
+                    self._zero_counts_fn(self._counts)  # rdb-lint: disable=donation-discipline (counts rebuilt from scratch next step)
+        """, rules={"donation-discipline"})
+        assert report.new == [] and report.pragma_suppressed >= 1
+
+
+class TestWarmupCoverage:
+    COMPLETE = """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._decode_fn = jax.jit(
+                    self._decode_impl, donate_argnums=(1, 8),
+                    static_argnums=(3,))
+            def _warmup_decode(self):
+                self._decode_fn(None, None, None, 1)
+    """
+
+    def test_complete_warmup_is_clean(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", self.COMPLETE,
+                              rules={"warmup-coverage"})
+        assert report.new == []
+
+    def test_unregistered_jit_in_engine_class_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            class Engine:
+                def __init__(self):
+                    self._decode_fn = jax.jit(
+                        self._decode_impl, donate_argnums=(1, 8),
+                        static_argnums=(3,))
+                    self._magic_fn = jax.jit(self._magic_impl)
+                def _warmup_decode(self):
+                    self._decode_fn(None, None, None, 1)
+        """, rules={"warmup-coverage"})
+        assert rules_found(report) == ["warmup-coverage"]
+        assert "_magic_impl" in report.new[0].message
+
+    def test_missing_warmup_method_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            class Engine:
+                def __init__(self):
+                    self._decode_fn = jax.jit(
+                        self._decode_impl, donate_argnums=(1, 8),
+                        static_argnums=(3,))
+        """, rules={"warmup-coverage"})
+        assert rules_found(report) == ["warmup-coverage"]
+        assert "_warmup_decode" in report.new[0].message
+
+    def test_warmup_not_invoking_program_flags(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            class Engine:
+                def __init__(self):
+                    self._decode_fn = jax.jit(
+                        self._decode_impl, donate_argnums=(1, 8),
+                        static_argnums=(3,))
+                def _warmup_decode(self):
+                    pass
+        """, rules={"warmup-coverage"})
+        assert rules_found(report) == ["warmup-coverage"]
+        assert "never invokes" in report.new[0].message
+
+    def test_non_engine_dir_is_out_of_scope(self, tmp_path):
+        report = lint_fixture(tmp_path, "parallel/eng.py", """
+            import jax
+
+            class Engine:
+                def __init__(self):
+                    self._decode_fn = jax.jit(
+                        self._decode_impl, donate_argnums=(1, 8),
+                        static_argnums=(3,))
+        """, rules={"warmup-coverage"})
+        assert report.new == []
+
+    def test_class_without_registered_impls_is_out_of_scope(
+            self, tmp_path):
+        # worker.py-style AOT compiles of model.apply are not the
+        # registry's purview — only classes that jit registered impls.
+        report = lint_fixture(tmp_path, "engine/worker.py", """
+            import jax
+
+            class ModelWorker:
+                def compile(self, model, args):
+                    return jax.jit(model.apply).lower(*args).compile()
+        """, rules={"warmup-coverage"})
+        assert report.new == []
+
+    def test_pragma_with_reason_suppresses(self, tmp_path):
+        report = lint_fixture(tmp_path, "engine/eng.py", """
+            import jax
+
+            class Engine:
+                def __init__(self):
+                    self._decode_fn = jax.jit(
+                        self._decode_impl, donate_argnums=(1, 8),
+                        static_argnums=(3,))
+                    self._magic_fn = jax.jit(self._magic_impl)  # rdb-lint: disable=warmup-coverage (cold admin path, compiles once per restart)
+                def _warmup_decode(self):
+                    self._decode_fn(None, None, None, 1)
+        """, rules={"warmup-coverage"})
+        assert report.new == [] and report.pragma_suppressed >= 1
+
+
+class TestJitRulesShipped:
+    def test_new_rules_are_in_the_default_set(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("jit-retrace-hazard", "donation-discipline",
+                     "warmup-coverage"):
+            assert rule in out
+
+    def test_baseline_ships_empty_for_jit_rules(self):
+        baseline = load_baseline(lint_core.DEFAULT_BASELINE)
+        rules = {e["rule"] for e in baseline.get("entries", [])}
+        assert not rules & {"jit-retrace-hazard", "donation-discipline",
+                            "warmup-coverage"}
+
+    def test_shipped_tree_clean_under_jit_rules(self):
+        report = run(rules={"jit-retrace-hazard", "donation-discipline",
+                            "warmup-coverage"})
+        assert report.new == [], [f.format() for f in report.new]
+
+    def test_linter_registry_matches_runtime(self):
+        # One model, two enforcers: the standalone importlib load the
+        # rules use must expose the same registry the engine warms.
+        from tools.lint import jit_discipline
+
+        from ray_dynamic_batching_tpu.ops import jit_model
+
+        lint_model = jit_discipline._jit_model()
+        assert lint_model.registered_impls() == (
+            jit_model.registered_impls())
+        assert [p.name for p in lint_model.HOT_PROGRAMS] == [
+            p.name for p in jit_model.HOT_PROGRAMS]
+
+    def test_json_output_has_per_rule_timings(self, tmp_path, capsys):
+        assert lint_main(["--json", str(tmp_path / "empty")]) in (0, 1)
+        out = capsys.readouterr().out
+        payload = json.loads(out)
+        # Path doesn't exist -> error run, but the timing block is
+        # structural: every active rule reports a number.
+        assert "timings" in payload

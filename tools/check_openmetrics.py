@@ -327,6 +327,17 @@ def _smoke() -> int:
             kvf.PARCELS.inc(tags={"edge": f"courier-{i}",
                                   "outcome": "shipped"})
             kvf.PREFIX_PUSHES.inc(tags={"deployment": f"dep-{i}"})
+        # Compile-ledger family (ISSUE 20): flood the REAL singleton's
+        # fn label with 40 distinct names against its 16-fn bound — the
+        # label is a closed set (ops/jit_model.py registry +
+        # __unattributed__) by construction, but a runaway instrument()
+        # caller must collapse into __other__, not mint series.
+        from ray_dynamic_batching_tpu.utils.compile_ledger import (
+            COMPILES,
+        )
+
+        for i in range(40):
+            COMPILES.inc(tags={"fn": f"rogue-{i}", "phase": "steady"})
         proxy = HTTPProxy(ProxyRouter(), port=0).start()
         try:
             url = f"http://127.0.0.1:{proxy.port}/metrics"
@@ -453,6 +464,22 @@ def _smoke() -> int:
             not in text):
         errors.append("rdb_fidelity_drift gauge missing from the "
                       "exposition")
+    n_compile_series = sum(1 for l in text.splitlines()
+                           if l.startswith("rdb_jit_compiles_total{"))
+    if n_compile_series != 16 + 1:
+        errors.append(
+            f"expected exactly 16 named fn series + __other__ on "
+            f"rdb_jit_compiles_total, saw {n_compile_series} — the fn "
+            "label bound broke"
+        )
+    overflow_compiles = 40 - 16
+    if (f'rdb_jit_compiles_total{{fn="__other__",phase="steady"}} '
+            f'{float(overflow_compiles)}' not in text):
+        errors.append(
+            "jit fn label flood did not collapse into __other__ on "
+            f"rdb_jit_compiles_total (expected {overflow_compiles} "
+            "overflow increments in one series)"
+        )
     if errors:
         print("OPENMETRICS SMOKE FAILED:", file=sys.stderr)
         for e in errors:

@@ -18,6 +18,8 @@ if [ "${1:-}" = "fast" ]; then
   python -m tools.lint
   echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
   python tools/check_openmetrics.py --smoke
+  echo "== compile discipline gate (warmup + seed-17 segment under the compile ledger: zero post-warmup compiles, warmup counts vs tools/compile_budget.json) =="
+  python tools/check_compiles.py
   echo "== latency budget gate (hop ledger vs tools/budgets/ttft.json, seeded run_slo_demo --trace capture) =="
   python tools/check_budgets.py tools/budgets/fixture_spans.jsonl
   echo "== what-if simulator smoke (deterministic, tools/sim_smoke.json floors) =="
@@ -63,6 +65,9 @@ python -m tools.lint
 
 echo "== /metrics exposition gate (OpenMetrics + exemplars) =="
 python tools/check_openmetrics.py --smoke
+
+echo "== compile discipline gate (warmup + seed-17 segment under the compile ledger: zero post-warmup compiles, warmup counts vs tools/compile_budget.json) =="
+python tools/check_compiles.py
 
 echo "== latency budget gate (hop ledger vs tools/budgets/ttft.json, seeded run_slo_demo --trace capture) =="
 python tools/check_budgets.py tools/budgets/fixture_spans.jsonl

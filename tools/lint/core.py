@@ -25,6 +25,7 @@ from __future__ import annotations
 import ast
 import json
 import re
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -202,14 +203,23 @@ class _Walker:
     """The single shared recursive walk: maintains Scope, dispatches
     every node to every applicable checker."""
 
-    def __init__(self, ctx: FileCtx, checkers: Sequence[Checker]) -> None:
+    def __init__(self, ctx: FileCtx, checkers: Sequence[Checker],
+                 timings: Optional[Dict[str, int]] = None) -> None:
         self.ctx = ctx
         self.checkers = checkers
         self.scope = Scope()
+        # rule -> accumulated ns across visit dispatch; shared across
+        # files by run() so --json can emit a per-rule elapsed_ms block.
+        self.timings = timings if timings is not None else {}
 
     def walk(self, node: ast.AST) -> None:
+        timings = self.timings
         for checker in self.checkers:
+            t0 = time.perf_counter_ns()
             checker.visit(node, self.ctx, self.scope)
+            timings[checker.rule] = (
+                timings.get(checker.rule, 0) + time.perf_counter_ns() - t0
+            )
 
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             self.scope.func_stack.append(
@@ -291,6 +301,11 @@ def _all_checkers() -> List[Checker]:
     from tools.lint.event_loop import EventLoopBlockingChecker
     from tools.lint.fabric import FabricDisciplineChecker
     from tools.lint.host_sync import HostSyncChecker
+    from tools.lint.jit_discipline import (
+        DonationDisciplineChecker,
+        JitRetraceHazardChecker,
+        WarmupCoverageChecker,
+    )
     from tools.lint.lockorder import LockOrderingChecker
     from tools.lint.locks import LockDisciplineChecker
     from tools.lint.retry import (
@@ -316,6 +331,9 @@ def _all_checkers() -> List[Checker]:
         FabricDisciplineChecker(),
         LockDisciplineChecker(),
         LockOrderingChecker(),
+        JitRetraceHazardChecker(),
+        DonationDisciplineChecker(),
+        WarmupCoverageChecker(),
     ]
 
 
@@ -364,6 +382,7 @@ def run(
     report = Report()
     all_findings: List[Finding] = []
     contexts: Dict[str, FileCtx] = {}
+    timings: Dict[str, int] = {c.rule: 0 for c in checkers}
 
     for p in target_paths:
         if not p.exists():
@@ -388,20 +407,33 @@ def run(
         report.files_scanned += 1
         for checker in applicable:
             checker.findings = all_findings
+            t0 = time.perf_counter_ns()
             checker.begin_file(ctx)
-        _Walker(ctx, applicable).walk(ctx.tree)
+            timings[checker.rule] += time.perf_counter_ns() - t0
+        _Walker(ctx, applicable, timings).walk(ctx.tree)
 
     # Whole-run hooks: cross-file analyses (the lock-ordering cycle
     # check) finish after every file is walked; extras contributors
     # (the lock graph) attach their artifacts to the report.
     for checker in checkers:
         checker.findings = all_findings
+        t0 = time.perf_counter_ns()
         finish = getattr(checker, "finish", None)
         if finish is not None:
             finish()
         contribute = getattr(checker, "contribute_extras", None)
         if contribute is not None:
             contribute(report.extras)
+        timings[checker.rule] += time.perf_counter_ns() - t0
+
+    # Per-rule wall time (visit dispatch + begin_file + finish/extras),
+    # emitted in --json so a slow rule is visible in CI without a
+    # profiler run.
+    report.extras["timings"] = {
+        "elapsed_ms": {
+            rule: round(ns / 1e6, 3) for rule, ns in sorted(timings.items())
+        }
+    }
 
     # --- pragma suppression (reason mandatory) ---------------------------
     survivors: List[Finding] = []
